@@ -1,0 +1,4 @@
+from radixmesh_tpu.cache.radix_tree import RadixTree, TreeNode, MatchResult
+from radixmesh_tpu.cache.kv_pool import PagedKVPool, SlotAllocator
+
+__all__ = ["RadixTree", "TreeNode", "MatchResult", "PagedKVPool", "SlotAllocator"]

@@ -1,0 +1,198 @@
+"""Paged KV pool: preallocated ``jax.Array`` pages in TPU HBM.
+
+TPU-native replacement for the reference's KV storage, where ``torch``
+tensors merely hold KV *indices* and the actual pool
+(``token_to_kv_pool_allocator``) is an external SGLang object the reference
+calls ``free()`` on (``radix_cache.py:104-107,188-199``). Here the pool is a
+first-class component:
+
+- One preallocated, donated ``jax.Array`` of shape
+  ``[2, layers, num_slots, kv_heads, head_dim]`` (K and V stacked) lives in
+  HBM for the model's whole life — no allocation inside the serving loop,
+  static shapes for XLA.
+- A host-side :class:`SlotAllocator` free-list hands out token-granularity
+  slot indices; the radix tree stores those indices as its node values and
+  returns them to the allocator on eviction.
+- Writes/gathers are jitted scatter/gather ops; under ``tp`` sharding the
+  ``kv_heads`` axis is sharded over the mesh so each chip holds its head
+  shard of every page (see ``parallel/sharding.py``).
+
+``page_size`` groups slots into contiguous pages for the Pallas
+paged-attention kernel (``ops/paged_attention.py``): slot ``s`` lives in
+page ``s // page_size`` at offset ``s % page_size``. The allocator always
+hands out whole pages so a request's slots are page-contiguous.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SlotAllocator", "PagedKVPool"]
+
+
+class SlotAllocator:
+    """Host-side free-list allocator of KV token slots, page-granular.
+
+    Pages (groups of ``page_size`` consecutive slots) are the allocation
+    unit; ``alloc(n)`` returns slot indices covering ``ceil(n/page_size)``
+    pages. Freeing accepts any subset of slots and returns a page to the
+    free list once every slot in it is free.
+    """
+
+    def __init__(self, num_slots: int, page_size: int = 1):
+        if num_slots % page_size != 0:
+            raise ValueError("num_slots must be a multiple of page_size")
+        self.num_slots = num_slots
+        self.page_size = page_size
+        self.num_pages = num_slots // page_size
+        # LIFO free list of pages with zero allocated slots.
+        self._free_pages: list[int] = list(range(self.num_pages - 1, -1, -1))
+        # Per-slot allocation state (True = handed out and not yet freed) and
+        # per-page count of allocated slots. A page re-enters the free list
+        # exactly when its allocated count returns to zero, so the unused
+        # tail slots of a partially-filled page are reclaimed with it.
+        self._slot_allocated = np.zeros(num_slots, dtype=bool)
+        self._page_alloc_count = np.zeros(self.num_pages, dtype=np.int32)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free_pages) * self.page_size
+
+    def alloc(self, n_tokens: int) -> np.ndarray | None:
+        """Allocate slots for ``n_tokens`` tokens (whole pages); ``None`` if
+        the pool can't satisfy the request (caller should evict and retry,
+        mirroring the reference's evict-then-insert flow)."""
+        if n_tokens <= 0:
+            return np.empty(0, dtype=np.int32)
+        n_pages = -(-n_tokens // self.page_size)
+        if n_pages > len(self._free_pages):
+            return None
+        pages = [self._free_pages.pop() for _ in range(n_pages)]
+        slots = (
+            np.asarray(pages, dtype=np.int32)[:, None] * self.page_size
+            + np.arange(self.page_size, dtype=np.int32)[None, :]
+        ).reshape(-1)[:n_tokens]
+        self._slot_allocated[slots] = True
+        pg, counts = np.unique(slots // self.page_size, return_counts=True)
+        self._page_alloc_count[pg] = counts.astype(np.int32)
+        return slots
+
+    def free(self, slots: np.ndarray) -> None:
+        slots = np.asarray(slots, dtype=np.int32)
+        if slots.size == 0:
+            return
+        if len(np.unique(slots)) != len(slots) or not np.all(
+            self._slot_allocated[slots]
+        ):
+            # Checked before any mutation so the allocator stays consistent.
+            raise ValueError("double free of KV slots")
+        self._slot_allocated[slots] = False
+        pages, counts = np.unique(slots // self.page_size, return_counts=True)
+        self._page_alloc_count[pages] -= counts.astype(np.int32)
+        for p in pages[self._page_alloc_count[pages] == 0]:
+            self._free_pages.append(int(p))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_kv(kv: jax.Array, slots: jax.Array, new_kv: jax.Array) -> jax.Array:
+    # kv: [2, L, S, H, D]; slots: [n]; new_kv: [2, L, n, H, D]
+    return kv.at[:, :, slots].set(new_kv)
+
+
+@jax.jit
+def _gather_kv(kv: jax.Array, slots: jax.Array) -> jax.Array:
+    return kv[:, :, slots]
+
+
+class PagedKVPool:
+    """Preallocated paged KV storage for every layer of one model replica."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        num_layers: int,
+        num_kv_heads: int,
+        head_dim: int,
+        page_size: int = 1,
+        dtype: Any = jnp.bfloat16,
+        sharding: jax.sharding.Sharding | None = None,
+    ):
+        self.num_slots = num_slots
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.page_size = page_size
+        self.dtype = dtype
+        self.allocator = SlotAllocator(num_slots, page_size)
+        zeros = partial(
+            jnp.zeros,
+            (2, num_layers, num_slots, num_kv_heads, head_dim),
+            dtype=dtype,
+        )
+        if sharding is not None:
+            self.kv = jax.device_put(zeros(), sharding)
+        else:
+            self.kv = zeros()
+
+    @property
+    def num_pages(self) -> int:
+        return self.allocator.num_pages
+
+    # ---- allocation (host side) ----
+
+    def alloc(self, n_tokens: int) -> np.ndarray | None:
+        return self.allocator.alloc(n_tokens)
+
+    def free(self, slots: np.ndarray) -> None:
+        self.allocator.free(slots)
+
+    @property
+    def free_slots(self) -> int:
+        return self.allocator.free_slots
+
+    # ---- device ops ----
+
+    def write(self, slots: np.ndarray | jax.Array, k: jax.Array, v: jax.Array) -> None:
+        """Write per-layer K/V for ``n`` tokens at ``slots``.
+
+        ``k``/``v``: ``[L, n, kv_heads, head_dim]``. The pool array is
+        donated through the scatter so HBM is updated in place. The token
+        count is padded up to a power-of-two bucket (by repeating the last
+        slot/value — an idempotent duplicate write) so ``jax.jit`` compiles
+        O(log max_n) scatter variants instead of one per distinct length.
+        """
+        slots = np.asarray(slots, dtype=np.int32)
+        n = len(slots)
+        if n == 0:
+            return
+        bucket = max(8, 1 << (n - 1).bit_length())
+        if bucket != n:
+            pad = bucket - n
+            slots = np.concatenate([slots, np.repeat(slots[-1:], pad)])
+            k = jnp.concatenate([k, jnp.repeat(k[:, -1:], pad, axis=1)], axis=1)
+            v = jnp.concatenate([v, jnp.repeat(v[:, -1:], pad, axis=1)], axis=1)
+        new_kv = jnp.stack([k, v]).astype(self.dtype)
+        self.kv = _scatter_kv(self.kv, jnp.asarray(slots, dtype=jnp.int32), new_kv)
+
+    def gather(self, slots: np.ndarray | jax.Array) -> jax.Array:
+        """Gather ``[2, L, n, kv_heads, head_dim]`` for the given slots
+        (debug/test path; the attention kernels read pages directly)."""
+        return _gather_kv(self.kv, jnp.asarray(slots, dtype=jnp.int32))
+
+    def page_table(self, slots: np.ndarray) -> np.ndarray:
+        """Page ids covering a page-aligned run of slots — the block table
+        the paged-attention kernel consumes."""
+        slots = np.asarray(slots, dtype=np.int32)
+        if slots.size == 0:
+            return np.empty(0, dtype=np.int32)
+        if self.page_size == 1:
+            return slots
+        heads = slots[:: self.page_size]
+        if np.any(heads % self.page_size != 0):
+            raise ValueError("slots are not page-aligned")
+        return heads // self.page_size

@@ -1,0 +1,466 @@
+"""Host-side radix prefix tree over token ids.
+
+Capability parity with the reference's single-node cache
+(``radix/sglang/srt/mem_cache/radix_cache.py:87-436``): prefix match with
+node splitting, insert, LRU eviction of unlocked leaves, lock-refcounting
+with evictable/protected size accounting, paged keys (``page_size >= 1``),
+and a KV-cache event journal. Re-designed, not translated:
+
+- Tree values are **numpy int32 arrays of KV slot indices** into a
+  :class:`~radixmesh_tpu.cache.kv_pool.PagedKVPool` whose pages are
+  ``jax.Array`` s in TPU HBM — the tree itself is pointer-chasing host code
+  that must never appear inside a ``jit`` trace.
+- Key comparison is vectorized with numpy instead of the reference's
+  per-token Python loop (``radix_cache.py:14-32``).
+- The event journal's ``BlockStored``/``BlockRemoved``/``AllBlocksCleared``
+  types are actually defined here (they are undefined names in the
+  reference, ``radix_cache.py:379-424``, making events unusable there).
+- Values are any object supporting ``len()`` and slicing; the distributed
+  layer (``cache/mesh_cache.py``) wraps values with origin-rank metadata the
+  same way the reference's ``RadixMesh`` does (``radix_mesh.py:21-63``).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+__all__ = [
+    "TreeNode",
+    "MatchResult",
+    "RadixTree",
+    "BlockStored",
+    "BlockRemoved",
+    "AllBlocksCleared",
+]
+
+_node_ids = itertools.count()
+
+
+def match_len(a: np.ndarray, b: np.ndarray) -> int:
+    """Length of the common prefix of two int arrays (vectorized analog of
+    the reference's ``_key_match_page_size1``, ``radix_cache.py:14-20``)."""
+    n = min(len(a), len(b))
+    if n == 0:
+        return 0
+    eq = a[:n] == b[:n]
+    return n if eq.all() else int(np.argmin(eq))
+
+
+def as_key(key: Sequence[int] | np.ndarray) -> np.ndarray:
+    arr = np.asarray(key, dtype=np.int32)
+    if arr.ndim != 1:
+        raise ValueError("keys must be 1-D token-id sequences")
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# KV-cache event journal (reference radix_cache.py:379-436, with the event
+# types actually defined).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BlockStored:
+    block_hashes: tuple[int, ...]
+    parent_block_hash: int | None
+    token_ids: tuple[int, ...]
+    block_size: int
+
+
+@dataclass(frozen=True)
+class BlockRemoved:
+    block_hashes: tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class AllBlocksCleared:
+    pass
+
+
+def _block_hash(parent_hash: int | None, tokens: tuple[int, ...]) -> int:
+    return hash((parent_hash, tokens))
+
+
+class TreeNode:
+    """Radix-tree node (reference ``radix_cache.py:35-64``): ``children``
+    keyed by first token (or first-page tuple), ``key`` = token-id array,
+    ``value`` = KV slot indices (or a mesh value wrapper), ``lock_ref``
+    protects against eviction, ``last_access_time`` orders LRU."""
+
+    __slots__ = (
+        "children",
+        "parent",
+        "key",
+        "value",
+        "lock_ref",
+        "last_access_time",
+        "hit_count",
+        "block_hashes",
+        "id",
+    )
+
+    def __init__(self, parent: "TreeNode | None" = None):
+        self.children: dict[Any, TreeNode] = {}
+        self.parent = parent
+        self.key: np.ndarray = np.empty(0, dtype=np.int32)
+        self.value: Any = None
+        self.lock_ref = 0
+        self.last_access_time = time.monotonic()
+        self.hit_count = 0
+        # Chained per-page hashes of the path down to (and including) this
+        # node's key, used by the event journal for parent-hash chaining.
+        self.block_hashes: tuple[int, ...] | None = None
+        self.id = next(_node_ids)
+
+    @property
+    def evicted(self) -> bool:
+        return self.value is None
+
+    def __lt__(self, other: "TreeNode") -> bool:
+        return self.last_access_time < other.last_access_time
+
+    def __repr__(self) -> str:
+        return (
+            f"TreeNode(id={self.id}, len={len(self.key)}, "
+            f"lock={self.lock_ref}, children={len(self.children)})"
+        )
+
+
+@dataclass
+class MatchResult:
+    """Prefix-match result (reference ``radix_cache.py:67-84``).
+
+    ``values`` holds one value object per matched node along the path (the
+    last possibly a slice); ``last_node`` anchors lock-ref operations. Use
+    :meth:`indices` to concatenate numpy slot-index values for the KV pool.
+    """
+
+    values: list[Any] = field(default_factory=list)
+    last_node: "TreeNode | None" = None
+
+    @property
+    def length(self) -> int:
+        return sum(len(v) for v in self.values)
+
+    def indices(self) -> np.ndarray:
+        if not self.values:
+            return np.empty(0, dtype=np.int32)
+        return np.concatenate([np.asarray(v, dtype=np.int32) for v in self.values])
+
+
+class RadixTree:
+    """Single-node radix prefix cache (reference ``RadixCache``,
+    ``radix_cache.py:87-436``).
+
+    Parameters
+    ----------
+    page_size:
+        Match/insert granularity in tokens. ``1`` matches per-token (the
+        reference's default and the mesh layer's fixed mode,
+        ``radix_mesh.py:87-89``); larger values match whole pages, which is
+        what the TPU paged-attention kernel wants (dense page tiles).
+    on_free:
+        Called with a concatenated numpy array of slot indices when eviction
+        frees them (the reference calls
+        ``token_to_kv_pool_allocator.free()``, ``radix_cache.py:188-199``).
+    enable_events:
+        Record :class:`BlockStored`/:class:`BlockRemoved` journal entries
+        for external observers (reference ``radix_cache.py:379-436``).
+    """
+
+    def __init__(
+        self,
+        page_size: int = 1,
+        on_free: Callable[[np.ndarray], None] | None = None,
+        enable_events: bool = False,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.page_size = page_size
+        self.on_free = on_free
+        self.enable_events = enable_events
+        self._time = time_fn
+        self._events: list[Any] = []
+        # All remaining state (root, size counters) is established by reset().
+        self.reset()
+
+    # ---- key plumbing ----
+
+    def _child_key(self, key: np.ndarray) -> Any:
+        if self.page_size == 1:
+            return int(key[0])
+        return tuple(int(t) for t in key[: self.page_size])
+
+    def _aligned_len(self, n: int) -> int:
+        return n - (n % self.page_size)
+
+    def _match(self, a: np.ndarray, b: np.ndarray) -> int:
+        m = match_len(a, b)
+        if self.page_size > 1:
+            m = self._aligned_len(m)
+        return m
+
+    # ---- public API ----
+
+    def reset(self, root_value: Any = None) -> None:
+        """Clear the tree (reference ``radix_cache.py:118-125``), returning
+        every stored KV slot to the pool via ``on_free``."""
+        if self.on_free is not None and getattr(self, "root", None) is not None:
+            freed = self.all_values_flatten()
+            if freed.size:
+                self.on_free(freed)
+        self.root = TreeNode()
+        self.root.key = np.empty(0, dtype=np.int32)
+        self.root.value = root_value
+        self.root.lock_ref = 1
+        self.root.last_access_time = self._time()
+        self.evictable_size_ = 0
+        self.protected_size_ = 0
+        if self.enable_events:
+            self._events.append(AllBlocksCleared())
+
+    def match_prefix(self, key: Sequence[int], split_partial: bool = True) -> MatchResult:
+        """Longest cached prefix of ``key``.
+
+        Walks child links, splitting a node in place when the match ends
+        mid-node (reference ``radix_cache.py:127-162,252-294``). With
+        ``split_partial=False`` the walk is read-only and the final value is
+        returned as a slice view — the router-replica mode (reference
+        ``radix_mesh.py:247-271`` deliberately avoids splits on the router).
+        """
+        key = as_key(key)
+        if self.page_size > 1:
+            key = key[: self._aligned_len(len(key))]
+        node = self.root
+        values: list[Any] = []
+        now = self._time()
+        node.last_access_time = now
+        while len(key) > 0:
+            child = node.children.get(self._child_key(key))
+            if child is None:
+                break
+            m = self._match(child.key, key)
+            if m == 0:
+                break
+            child.last_access_time = now
+            child.hit_count += 1
+            if m < len(child.key):
+                if split_partial:
+                    child = self._split_node(child, m)
+                    values.append(child.value)
+                    node = child
+                else:
+                    # Read-only walk (router replica mode): return the
+                    # partial value as a slice but anchor last_node at the
+                    # deepest FULLY matched node, so lock-ref operations
+                    # never protect tokens beyond the matched prefix.
+                    values.append(child.value[:m])
+                break
+            values.append(child.value)
+            node = child
+            key = key[m:]
+        return MatchResult(values=values, last_node=node)
+
+    def insert(self, key: Sequence[int], value: Any) -> int:
+        """Insert ``key``→``value``; returns the length of the prefix that
+        was already present (reference ``radix_cache.py:164-170,296-327``).
+
+        ``value`` must satisfy ``len(value) == len(key)`` and support
+        slicing. Over the already-present prefix the existing value is kept
+        (value-conflict policy lives in the mesh layer).
+        """
+        key = as_key(key)
+        if len(value) != len(key):
+            raise ValueError(f"value length {len(value)} != key length {len(key)}")
+        if self.page_size > 1:
+            n = self._aligned_len(len(key))
+            key, value = key[:n], value[:n]
+        if len(key) == 0:
+            return 0
+        return self._insert_helper(self.root, key, value)
+
+    def evict(self, num_tokens: int) -> int:
+        """Evict LRU unlocked leaves until ``num_tokens`` slots are freed
+        (reference ``radix_cache.py:179-202,366-377``). Returns slots freed."""
+        leaves = [n for n in self._collect_leaves() if n.lock_ref == 0]
+        heapq.heapify(leaves)
+        freed = 0
+        freed_arrays: list[np.ndarray] = []
+        while leaves and freed < num_tokens:
+            node = heapq.heappop(leaves)
+            if node is self.root or node.lock_ref > 0:
+                continue
+            freed += len(node.key)
+            if node.value is not None:
+                freed_arrays.append(np.asarray(node.value, dtype=np.int32))
+            self._record_remove_event(node)
+            parent = node.parent
+            del parent.children[self._child_key(node.key)]
+            self.evictable_size_ -= len(node.key)
+            if parent is not self.root and not parent.children and parent.lock_ref == 0:
+                heapq.heappush(leaves, parent)
+        if freed_arrays and self.on_free is not None:
+            self.on_free(np.concatenate(freed_arrays))
+        return freed
+
+    def inc_lock_ref(self, node: TreeNode) -> None:
+        """Protect the path root→``node`` from eviction (reference
+        ``radix_cache.py:204-216``)."""
+        while node is not None and node is not self.root:
+            if node.lock_ref == 0:
+                self.evictable_size_ -= len(node.key)
+                self.protected_size_ += len(node.key)
+            node.lock_ref += 1
+            node = node.parent
+
+    def dec_lock_ref(self, node: TreeNode) -> None:
+        """Release one protection ref along root→``node`` (reference
+        ``radix_cache.py:218-230``)."""
+        while node is not None and node is not self.root:
+            if node.lock_ref == 1:
+                self.evictable_size_ += len(node.key)
+                self.protected_size_ -= len(node.key)
+            if node.lock_ref > 0:
+                node.lock_ref -= 1
+            node = node.parent
+
+    # ---- introspection (reference radix_cache.py:172-177,232-248,354-364) ----
+
+    def evictable_size(self) -> int:
+        return self.evictable_size_
+
+    def protected_size(self) -> int:
+        return self.protected_size_
+
+    def total_size(self) -> int:
+        return sum(len(n.key) for n in self._all_nodes() if n is not self.root)
+
+    def all_values_flatten(self) -> np.ndarray:
+        vals = [
+            np.asarray(n.value, dtype=np.int32)
+            for n in self._all_nodes()
+            if n is not self.root and n.value is not None
+        ]
+        if not vals:
+            return np.empty(0, dtype=np.int32)
+        return np.concatenate(vals)
+
+    def pretty_print(self) -> str:
+        lines: list[str] = []
+
+        def walk(node: TreeNode, depth: int) -> None:
+            if node is not self.root:
+                lines.append(
+                    "  " * depth
+                    + f"{list(node.key[:8])}{'...' if len(node.key) > 8 else ''} "
+                    + f"lock={node.lock_ref} value={node.value!r:.60}"
+                )
+            for child in node.children.values():
+                walk(child, depth + 1)
+
+        walk(self.root, -1)
+        return "\n".join(lines)
+
+    def take_events(self) -> list[Any]:
+        ev, self._events = self._events, []
+        return ev
+
+    # ---- internals ----
+
+    def _split_node(self, node: TreeNode, split_len: int) -> TreeNode:
+        """Split ``node`` so its first ``split_len`` tokens become a new
+        parent; returns the new parent (reference ``radix_cache.py:277-294``)."""
+        new_node = TreeNode(parent=node.parent)
+        new_node.key = node.key[:split_len]
+        new_node.value = None if node.value is None else node.value[:split_len]
+        new_node.lock_ref = node.lock_ref
+        new_node.last_access_time = node.last_access_time
+        new_node.hit_count = node.hit_count
+        new_node.children = {self._child_key(node.key[split_len:]): node}
+        node.parent.children[self._child_key(new_node.key)] = new_node
+        node.key = node.key[split_len:]
+        node.value = None if node.value is None else node.value[split_len:]
+        node.parent = new_node
+        if node.block_hashes is not None:
+            # Page-chained hashes are a pure function of the root path, so a
+            # split just partitions them between the two nodes.
+            n_pages = split_len // max(self.page_size, 1)
+            new_node.block_hashes = node.block_hashes[:n_pages]
+            node.block_hashes = node.block_hashes[n_pages:]
+        return new_node
+
+    def _insert_helper(self, node: TreeNode, key: np.ndarray, value: Any) -> int:
+        node.last_access_time = self._time()
+        total_prefix = 0
+        while True:
+            child = node.children.get(self._child_key(key))
+            if child is None:
+                leaf = TreeNode(parent=node)
+                leaf.key = key
+                leaf.value = value
+                leaf.last_access_time = self._time()
+                node.children[self._child_key(key)] = leaf
+                self.evictable_size_ += len(key)
+                self._record_store_event(leaf)
+                return total_prefix
+            m = self._match(child.key, key)
+            child.last_access_time = self._time()
+            if m < len(child.key):
+                child = self._split_node(child, m)
+            total_prefix += m
+            if m == len(key):
+                return total_prefix
+            key = key[m:]
+            value = value[m:]
+            node = child
+
+    def _collect_leaves(self) -> list[TreeNode]:
+        return [
+            n for n in self._all_nodes() if n is not self.root and not n.children
+        ]
+
+    def _all_nodes(self) -> Iterable[TreeNode]:
+        stack = [self.root]
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    # ---- event journal ----
+
+    def _record_store_event(self, node: TreeNode) -> None:
+        if not self.enable_events:
+            return
+        parent = node.parent
+        parent_hash = (
+            parent.block_hashes[-1]
+            if parent is not None and parent.block_hashes
+            else None
+        )
+        hashes = []
+        toks = tuple(int(t) for t in node.key)
+        page = max(self.page_size, 1)
+        h = parent_hash
+        for i in range(0, len(toks), page):
+            h = _block_hash(h, toks[i : i + page])
+            hashes.append(h)
+        node.block_hashes = tuple(hashes)
+        self._events.append(
+            BlockStored(
+                block_hashes=tuple(hashes),
+                parent_block_hash=parent_hash,
+                token_ids=toks,
+                block_size=page,
+            )
+        )
+
+    def _record_remove_event(self, node: TreeNode) -> None:
+        if not self.enable_events:
+            return
+        if node.block_hashes:
+            self._events.append(BlockRemoved(block_hashes=node.block_hashes))

@@ -1,0 +1,200 @@
+"""Cluster + cache configuration.
+
+Capability parity with the reference's ``config/cache_config.py``:
+``ServerArgs`` holds the prefill/decode/router address lists plus this node's
+address, derives the node's single role and global/local rank from its
+position in those lists (``cache_config.py:20-35,50-75``), enforces exactly
+one membership and at most one router (``cache_config.py:47-48``), and every
+node in a cluster must share an identical config except ``local_addr``
+(reference ``README.md:122-124``).
+
+Extensions for the TPU stack (absent in the reference, which has no model
+runtime): a ``model`` section and a ``mesh`` section describing the
+``jax.sharding.Mesh`` axes each node uses for its local model replica.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+DEFAULT_MAX_MSG_BYTES = 16 * 1024 * 1024  # mirror of reference cache_config.py:12
+
+
+class NodeRole(enum.Enum):
+    """Node roles (reference ``radix/core_enum.py:4-7`` RadixMode)."""
+
+    PREFILL = "prefill"
+    DECODE = "decode"
+    ROUTER = "router"
+
+
+@dataclass
+class MeshConfig:
+    """Topology + cache sizing for one node of the cache mesh.
+
+    Global rank space mirrors the reference (``cache_config.py:20-28``):
+    prefill nodes occupy ranks ``[0, P)``, decode ``[P, P+D)``, routers
+    ``[P+D, ...)``.
+    """
+
+    prefill_nodes: list[str] = field(default_factory=list)
+    decode_nodes: list[str] = field(default_factory=list)
+    router_nodes: list[str] = field(default_factory=list)
+    local_addr: str = ""
+    # Max serialized oplog size; also the transport buffer size
+    # (reference cache_config.py:12-14 couples these the same way).
+    max_msg_bytes: int = DEFAULT_MAX_MSG_BYTES
+    protocol: str = "tcp"  # "tcp" (C++ native) | "tcp-py" | "inproc"
+    page_size: int = 1
+    # Cache sizing: number of KV slots (tokens) the paged pool holds.
+    num_kv_slots: int = 65536
+    # Mesh GC / heartbeat cadence (seconds). Reference hardcodes 10s
+    # (radix_mesh.py:133,166); configurable here so tests run fast.
+    gc_interval_s: float = 10.0
+    tick_interval_s: float = 10.0
+    # Optional model/mesh sections for serving nodes.
+    model: dict[str, Any] = field(default_factory=dict)
+    mesh_axes: dict[str, int] = field(default_factory=dict)  # e.g. {"dp":2,"tp":4}
+
+    # ---- derived rank space (reference cache_config.py:20-35) ----
+
+    @property
+    def num_prefill(self) -> int:
+        return len(self.prefill_nodes)
+
+    @property
+    def num_decode(self) -> int:
+        return len(self.decode_nodes)
+
+    @property
+    def num_ring(self) -> int:
+        """Ring members = prefill + decode nodes (routers stay outside,
+        reference ``sync_algo.py:57-75``)."""
+        return self.num_prefill + self.num_decode
+
+    def is_prefill_rank(self, rank: int) -> bool:
+        return 0 <= rank < self.num_prefill
+
+    def is_decode_rank(self, rank: int) -> bool:
+        return self.num_prefill <= rank < self.num_ring
+
+    def is_router_rank(self, rank: int) -> bool:
+        return rank >= self.num_ring
+
+    def role_of_rank(self, rank: int) -> NodeRole:
+        if self.is_prefill_rank(rank):
+            return NodeRole.PREFILL
+        if self.is_decode_rank(rank):
+            return NodeRole.DECODE
+        return NodeRole.ROUTER
+
+    def addr_of_rank(self, rank: int) -> str:
+        all_nodes = self.prefill_nodes + self.decode_nodes + self.router_nodes
+        return all_nodes[rank]
+
+    def prefill_addr(self, prefill_rank: int) -> str:
+        """Address of prefill node by global rank (reference
+        ``radix_mesh.py:447-451``)."""
+        return self.prefill_nodes[prefill_rank]
+
+    def decode_addr(self, decode_rank: int) -> str:
+        """Address of decode node by global rank (reference
+        ``radix_mesh.py:453-457``)."""
+        return self.decode_nodes[decode_rank - self.num_prefill]
+
+    # ---- this node's identity ----
+
+    def local_identity(self) -> tuple[NodeRole, int, int]:
+        """Return (role, global_rank, local_rank) for ``local_addr``.
+
+        Enforces exactly-one-membership like the reference
+        (``cache_config.py:50-75``).
+        """
+        memberships = []
+        for role, nodes, base in (
+            (NodeRole.PREFILL, self.prefill_nodes, 0),
+            (NodeRole.DECODE, self.decode_nodes, self.num_prefill),
+            (NodeRole.ROUTER, self.router_nodes, self.num_ring),
+        ):
+            for i, addr in enumerate(nodes):
+                if addr == self.local_addr:
+                    memberships.append((role, base + i, i))
+        if len(memberships) != 1:
+            raise ValueError(
+                f"local_addr {self.local_addr!r} must appear in exactly one "
+                f"node list, found {len(memberships)} memberships"
+            )
+        return memberships[0]
+
+    @property
+    def local_role(self) -> NodeRole:
+        return self.local_identity()[0]
+
+    @property
+    def local_rank(self) -> int:
+        return self.local_identity()[1]
+
+    def validate(self) -> None:
+        if len(self.router_nodes) > 1:
+            # Reference restriction (cache_config.py:47-48); multi-router is
+            # future work in both.
+            raise ValueError("at most one router node is supported")
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        all_nodes = self.prefill_nodes + self.decode_nodes + self.router_nodes
+        if len(set(all_nodes)) != len(all_nodes):
+            raise ValueError("node addresses must be unique across roles")
+        self.local_identity()  # raises on bad membership
+
+
+def load_config(path: str) -> MeshConfig:
+    """Load a YAML config file into a validated :class:`MeshConfig`
+    (reference ``load_server_args``, ``cache_config.py:38-76``)."""
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    known = {
+        "prefill_nodes",
+        "decode_nodes",
+        "router_nodes",
+        "local_addr",
+        "max_msg_bytes",
+        "protocol",
+        "page_size",
+        "num_kv_slots",
+        "gc_interval_s",
+        "tick_interval_s",
+        "model",
+        "mesh_axes",
+    }
+    unknown = set(raw) - known
+    if unknown:
+        # Every node must share an identical config (reference
+        # README.md:122-124); a typo'd key must fail fast, not silently
+        # default one node into a different rank space.
+        raise ValueError(f"unknown config keys: {sorted(unknown)}")
+    cfg = MeshConfig(
+        prefill_nodes=list(raw.get("prefill_nodes", [])),
+        decode_nodes=list(raw.get("decode_nodes", [])),
+        router_nodes=list(raw.get("router_nodes", [])),
+        local_addr=raw.get("local_addr", ""),
+        max_msg_bytes=int(raw.get("max_msg_bytes", DEFAULT_MAX_MSG_BYTES)),
+        protocol=raw.get("protocol", "tcp"),
+        page_size=int(raw.get("page_size", 1)),
+        num_kv_slots=int(raw.get("num_kv_slots", 65536)),
+        gc_interval_s=float(raw.get("gc_interval_s", 10.0)),
+        tick_interval_s=float(raw.get("tick_interval_s", 10.0)),
+        model=dict(raw.get("model", {})),
+        mesh_axes=dict(raw.get("mesh_axes", {})),
+    )
+    cfg.validate()
+    return cfg
+
+
+def parse_addr(addr: str) -> tuple[str, int]:
+    """``"host:port"`` → ``(host, port)`` (reference ``communicator.py:133-135``)."""
+    host, _, port = addr.rpartition(":")
+    return host, int(port)

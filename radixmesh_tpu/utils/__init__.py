@@ -1,0 +1,4 @@
+from radixmesh_tpu.utils.logging import configure_logger, get_logger
+from radixmesh_tpu.utils.sync import CountDownLatch, AtomicCounter
+
+__all__ = ["configure_logger", "get_logger", "CountDownLatch", "AtomicCounter"]

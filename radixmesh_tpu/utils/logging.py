@@ -1,0 +1,31 @@
+"""Node-rank-prefixed logging.
+
+Capability parity with the reference's ``util/log.py:5-13`` (a
+``configure_logger(prefix)`` that stamps ``[timestamp][node@rank]`` on every
+line), extended with per-module child loggers so subsystems can be filtered.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_ROOT_NAME = "radixmesh_tpu"
+
+
+def configure_logger(prefix: str = "", level: int = logging.INFO) -> logging.Logger:
+    """Configure the framework root logger with a node-identity prefix.
+
+    ``prefix`` is typically ``f"{role}@{rank}"`` so multi-process logs
+    interleave legibly.
+    """
+    fmt = f"[%(asctime)s][{prefix}][%(levelname)s] %(message)s" if prefix else (
+        "[%(asctime)s][%(levelname)s] %(message)s"
+    )
+    logging.basicConfig(level=level, format=fmt, force=True)
+    logger = logging.getLogger(_ROOT_NAME)
+    logger.setLevel(level)
+    return logger
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return logging.getLogger(f"{_ROOT_NAME}.{name}" if name else _ROOT_NAME)

@@ -1,0 +1,17 @@
+"""Test harness config: force CPU jax with an 8-device virtual mesh.
+
+This mirrors the reference's multi-node-without-a-cluster strategy
+(``correctness.py:22-29`` runs 6 localhost processes): correctness gates run
+on CPU so they're cheap; TPU-only paths (Pallas compiled kernels) are
+exercised by ``bench.py`` on real hardware.
+"""
+
+import os
+
+# Must run before the first `import jax` anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

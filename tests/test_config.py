@@ -1,0 +1,92 @@
+"""Tests for MeshConfig rank derivation + YAML loading (reference
+``config/cache_config.py:20-76`` semantics)."""
+
+import pytest
+
+from radixmesh_tpu.config import MeshConfig, NodeRole, load_config, parse_addr
+
+
+def cluster(local="p0"):
+    return MeshConfig(
+        prefill_nodes=["p0", "p1", "p2"],
+        decode_nodes=["d0", "d1"],
+        router_nodes=["r0"],
+        local_addr=local,
+    )
+
+
+class TestRanks:
+    def test_rank_space(self):
+        cfg = cluster()
+        assert cfg.num_prefill == 3 and cfg.num_decode == 2 and cfg.num_ring == 5
+        assert [cfg.role_of_rank(r) for r in range(6)] == [
+            NodeRole.PREFILL,
+            NodeRole.PREFILL,
+            NodeRole.PREFILL,
+            NodeRole.DECODE,
+            NodeRole.DECODE,
+            NodeRole.ROUTER,
+        ]
+
+    def test_local_identity(self):
+        assert cluster("p1").local_identity() == (NodeRole.PREFILL, 1, 1)
+        assert cluster("d0").local_identity() == (NodeRole.DECODE, 3, 0)
+        assert cluster("r0").local_identity() == (NodeRole.ROUTER, 5, 0)
+
+    def test_addr_lookup(self):
+        cfg = cluster()
+        assert cfg.prefill_addr(2) == "p2"
+        assert cfg.decode_addr(4) == "d1"
+        assert cfg.addr_of_rank(5) == "r0"
+
+    def test_membership_enforced(self):
+        with pytest.raises(ValueError):
+            cluster("nope").local_identity()
+
+    def test_multi_router_rejected(self):
+        cfg = cluster()
+        cfg.router_nodes = ["r0", "r1"]
+        with pytest.raises(ValueError):
+            cfg.validate()
+
+    def test_duplicate_addr_rejected(self):
+        cfg = cluster()
+        cfg.decode_nodes = ["p0", "d1"]
+        with pytest.raises(ValueError):
+            cfg.validate()
+
+
+class TestYaml:
+    def test_load(self, tmp_path):
+        p = tmp_path / "node.yaml"
+        p.write_text(
+            """
+prefill_nodes: ["localhost:50000", "localhost:50001"]
+decode_nodes: ["localhost:50003"]
+router_nodes: ["localhost:50010"]
+local_addr: "localhost:50001"
+protocol: inproc
+page_size: 1
+num_kv_slots: 1024
+"""
+        )
+        cfg = load_config(str(p))
+        assert cfg.local_identity() == (NodeRole.PREFILL, 1, 1)
+        assert cfg.num_kv_slots == 1024
+        assert cfg.protocol == "inproc"
+
+    def test_unknown_key_rejected(self, tmp_path):
+        p = tmp_path / "bad.yaml"
+        p.write_text(
+            """
+prefill_nodes: ["a"]
+decode_node: ["b"]
+local_addr: "a"
+"""
+        )
+        with pytest.raises(ValueError, match="unknown config keys"):
+            load_config(str(p))
+
+    def test_parse_addr(self):
+        assert parse_addr("localhost:50000") == ("localhost", 50000)
+        assert parse_addr("10.0.0.1:99") == ("10.0.0.1", 99)

@@ -1,0 +1,127 @@
+"""Tests for the paged KV pool + slot allocator (TPU-native replacement for
+the reference's external ``token_to_kv_pool_allocator``, SURVEY §2)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.kv_pool import PagedKVPool, SlotAllocator
+
+
+class TestSlotAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = SlotAllocator(16, page_size=1)
+        s1 = a.alloc(5)
+        assert s1 is not None and len(s1) == 5
+        assert a.free_slots == 11
+        a.free(s1)
+        assert a.free_slots == 16
+
+    def test_exhaustion_returns_none(self):
+        a = SlotAllocator(4, page_size=1)
+        assert a.alloc(4) is not None
+        assert a.alloc(1) is None
+
+    def test_unique_slots(self):
+        a = SlotAllocator(64, page_size=1)
+        s1, s2 = a.alloc(30), a.alloc(30)
+        assert len(np.intersect1d(s1, s2)) == 0
+
+    def test_page_granularity(self):
+        a = SlotAllocator(32, page_size=4)
+        s = a.alloc(6)  # rounds up to 2 pages = 8 slots, returns first 6
+        assert len(s) == 6
+        assert a.free_slots == 32 - 8
+        # Slots are page-contiguous.
+        assert s[0] % 4 == 0
+        np.testing.assert_array_equal(s[:4] - s[0], np.arange(4))
+
+    def test_partial_free_reclaims_page_when_complete(self):
+        a = SlotAllocator(8, page_size=4)
+        s = a.alloc(4)
+        a.free(s[:2])
+        assert a.free_slots == 4  # page not yet whole
+        a.free(s[2:])
+        assert a.free_slots == 8
+
+    def test_partial_page_tail_slots_reclaimed(self):
+        # alloc(6) with page_size=4 occupies 2 pages; freeing the 6 returned
+        # slots must reclaim both pages (the 2 unused tail slots with them).
+        a = SlotAllocator(8, page_size=4)
+        s = a.alloc(6)
+        assert a.free_slots == 0
+        a.free(s)
+        assert a.free_slots == 8
+
+    def test_subset_double_free_detected(self):
+        a = SlotAllocator(8, page_size=4)
+        s = a.alloc(4)
+        a.free(s[:2])
+        with pytest.raises(ValueError):
+            a.free(s[:2])  # re-freeing the same subset must not complete a page
+        a.free(s[2:])
+        assert a.free_slots == 8
+
+    def test_double_free_raises(self):
+        a = SlotAllocator(8, page_size=1)
+        s = a.alloc(2)
+        a.free(s)
+        with pytest.raises(ValueError):
+            a.free(s)
+
+    def test_zero_alloc(self):
+        a = SlotAllocator(8, page_size=1)
+        assert len(a.alloc(0)) == 0
+
+
+class TestPagedKVPool:
+    def test_write_gather_roundtrip(self):
+        pool = PagedKVPool(
+            num_slots=32, num_layers=2, num_kv_heads=2, head_dim=4, dtype=jnp.float32
+        )
+        slots = pool.alloc(3)
+        k = jnp.arange(2 * 3 * 2 * 4, dtype=jnp.float32).reshape(2, 3, 2, 4)
+        v = -k
+        pool.write(slots, k, v)
+        got = pool.gather(slots)
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(k))
+        np.testing.assert_allclose(np.asarray(got[1]), np.asarray(v))
+
+    def test_writes_do_not_clobber_other_slots(self):
+        pool = PagedKVPool(
+            num_slots=16, num_layers=1, num_kv_heads=1, head_dim=2, dtype=jnp.float32
+        )
+        s1, s2 = pool.alloc(2), pool.alloc(2)
+        ones = jnp.ones((1, 2, 1, 2))
+        pool.write(s1, ones, ones)
+        pool.write(s2, ones * 2, ones * 2)
+        np.testing.assert_allclose(np.asarray(pool.gather(s1)[0]), np.asarray(ones))
+        np.testing.assert_allclose(np.asarray(pool.gather(s2)[0]), np.asarray(ones) * 2)
+
+    def test_page_table(self):
+        pool = PagedKVPool(
+            num_slots=32,
+            num_layers=1,
+            num_kv_heads=1,
+            head_dim=2,
+            page_size=4,
+            dtype=jnp.float32,
+        )
+        slots = pool.alloc(8)
+        table = pool.page_table(slots)
+        assert len(table) == 2
+        np.testing.assert_array_equal(table, slots[::4] // 4)
+
+    def test_free_via_tree_eviction_callback(self):
+        from radixmesh_tpu.cache.radix_tree import RadixTree
+
+        pool = PagedKVPool(
+            num_slots=8, num_layers=1, num_kv_heads=1, head_dim=2, dtype=jnp.float32
+        )
+        tree = RadixTree(on_free=pool.free)
+        slots = pool.alloc(8)
+        tree.insert(np.arange(8), slots)
+        assert pool.free_slots == 0
+        assert pool.alloc(1) is None
+        tree.evict(8)
+        assert pool.free_slots == 8

@@ -1,0 +1,270 @@
+"""Unit tests for the host-side radix tree.
+
+The reference has no unit tests (SURVEY §4); these cover the capability set
+of ``radix_cache.py:87-436``: match/insert/split, paged keys, LRU eviction,
+lock refs, size accounting, and the event journal.
+"""
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.radix_tree import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    MatchResult,
+    RadixTree,
+    match_len,
+)
+
+
+def ids(n, start=0):
+    return np.arange(start, start + n, dtype=np.int32)
+
+
+def make_tree(**kw):
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    return RadixTree(time_fn=clock, **kw)
+
+
+class TestMatchLen:
+    def test_basic(self):
+        assert match_len(ids(5), ids(5)) == 5
+        assert match_len(ids(5), ids(3)) == 3
+        assert match_len(np.array([1, 2, 9]), np.array([1, 2, 3])) == 2
+        assert match_len(np.array([7]), np.array([1])) == 0
+        assert match_len(ids(0), ids(5)) == 0
+
+
+class TestInsertMatch:
+    def test_empty_tree_match(self):
+        tree = make_tree()
+        res = tree.match_prefix([1, 2, 3])
+        assert res.length == 0
+        assert res.last_node is tree.root
+
+    def test_insert_then_match_exact(self):
+        tree = make_tree()
+        key, val = [1, 2, 3], np.array([10, 11, 12], dtype=np.int32)
+        assert tree.insert(key, val) == 0
+        res = tree.match_prefix(key)
+        assert res.length == 3
+        np.testing.assert_array_equal(res.indices(), val)
+
+    def test_match_partial_splits_node(self):
+        tree = make_tree()
+        tree.insert([1, 2, 3, 4], np.array([10, 11, 12, 13], dtype=np.int32))
+        res = tree.match_prefix([1, 2, 99])
+        assert res.length == 2
+        np.testing.assert_array_equal(res.indices(), [10, 11])
+        # The node was split: the matched node holds exactly [1, 2].
+        np.testing.assert_array_equal(res.last_node.key, [1, 2])
+        # Full key still reachable.
+        res2 = tree.match_prefix([1, 2, 3, 4])
+        assert res2.length == 4
+        np.testing.assert_array_equal(res2.indices(), [10, 11, 12, 13])
+
+    def test_readonly_match_does_not_split(self):
+        tree = make_tree()
+        tree.insert([1, 2, 3, 4], np.array([10, 11, 12, 13], dtype=np.int32))
+        before = tree.total_size()
+        res = tree.match_prefix([1, 2], split_partial=False)
+        assert res.length == 2
+        np.testing.assert_array_equal(res.indices(), [10, 11])
+        assert tree.total_size() == before
+        # Node count unchanged: root has a single 4-token child.
+        assert len(tree.root.children) == 1
+        only = next(iter(tree.root.children.values()))
+        assert len(only.key) == 4
+        # last_node anchors at the deepest FULLY matched node, so locking it
+        # never protects tokens beyond the matched prefix.
+        assert res.last_node is tree.root
+        tree.inc_lock_ref(res.last_node)
+        assert tree.protected_size() == 0
+        assert tree.evict(100) == 4
+
+    def test_insert_returns_existing_prefix_len(self):
+        tree = make_tree()
+        tree.insert([1, 2, 3], np.array([10, 11, 12], dtype=np.int32))
+        got = tree.insert([1, 2, 3, 4, 5], np.array([10, 11, 12, 13, 14], dtype=np.int32))
+        assert got == 3
+        res = tree.match_prefix([1, 2, 3, 4, 5])
+        assert res.length == 5
+
+    def test_insert_idempotent(self):
+        tree = make_tree()
+        v = np.array([10, 11, 12], dtype=np.int32)
+        tree.insert([1, 2, 3], v)
+        assert tree.insert([1, 2, 3], v) == 3
+        assert tree.total_size() == 3
+
+    def test_branching(self):
+        tree = make_tree()
+        tree.insert([1, 2, 3], np.array([10, 11, 12], dtype=np.int32))
+        tree.insert([1, 2, 7, 8], np.array([10, 11, 20, 21], dtype=np.int32))
+        tree.insert([5, 6], np.array([30, 31], dtype=np.int32))
+        assert tree.match_prefix([1, 2, 3]).length == 3
+        np.testing.assert_array_equal(
+            tree.match_prefix([1, 2, 7, 8]).indices(), [10, 11, 20, 21]
+        )
+        np.testing.assert_array_equal(tree.match_prefix([5, 6, 9]).indices(), [30, 31])
+        assert tree.total_size() == 2 + 1 + 2 + 2  # [1,2],[3],[7,8],[5,6]
+
+    def test_value_length_mismatch_raises(self):
+        tree = make_tree()
+        with pytest.raises(ValueError):
+            tree.insert([1, 2, 3], np.array([1], dtype=np.int32))
+
+
+class TestPaged:
+    def test_paged_match_whole_pages_only(self):
+        tree = make_tree(page_size=4)
+        tree.insert(ids(8), ids(8, start=100))
+        # 6-token query matches only the first full page (4 tokens).
+        res = tree.match_prefix(ids(6))
+        assert res.length == 4
+        np.testing.assert_array_equal(res.indices(), ids(4, start=100))
+
+    def test_paged_insert_truncates_partial_page(self):
+        tree = make_tree(page_size=4)
+        tree.insert(ids(6), ids(6, start=100))
+        assert tree.total_size() == 4
+
+    def test_paged_divergence_inside_page(self):
+        tree = make_tree(page_size=2)
+        tree.insert([1, 2, 3, 4], np.array([10, 11, 12, 13], dtype=np.int32))
+        # Diverges at token 3 (inside second page) -> only first page matches.
+        res = tree.match_prefix([1, 2, 3, 9])
+        assert res.length == 2
+
+
+class TestEviction:
+    def test_evict_lru_and_free_callback(self):
+        freed = []
+        tree = make_tree(on_free=lambda idx: freed.append(np.array(idx)))
+        tree.insert([1, 2], np.array([10, 11], dtype=np.int32))
+        tree.insert([3, 4], np.array([20, 21], dtype=np.int32))
+        tree.insert([5, 6], np.array([30, 31], dtype=np.int32))
+        tree.match_prefix([1, 2])  # refresh [1,2] -> LRU is [3,4]
+        n = tree.evict(2)
+        assert n == 2
+        assert tree.match_prefix([3, 4]).length == 0
+        assert tree.match_prefix([1, 2]).length == 2
+        np.testing.assert_array_equal(np.concatenate(freed), [20, 21])
+
+    def test_evict_respects_lock(self):
+        tree = make_tree()
+        tree.insert([1, 2], np.array([10, 11], dtype=np.int32))
+        res = tree.match_prefix([1, 2])
+        tree.inc_lock_ref(res.last_node)
+        assert tree.evict(10) == 0
+        assert tree.match_prefix([1, 2]).length == 2
+        tree.dec_lock_ref(res.last_node)
+        assert tree.evict(10) == 2
+        assert tree.match_prefix([1, 2]).length == 0
+
+    def test_evict_cascades_to_parent(self):
+        tree = make_tree()
+        tree.insert([1, 2, 3, 4], np.array([10, 11, 12, 13], dtype=np.int32))
+        tree.match_prefix([1, 2])  # split into [1,2] -> [3,4]
+        assert tree.evict(4) == 4
+        assert tree.total_size() == 0
+
+    def test_size_accounting(self):
+        tree = make_tree()
+        tree.insert([1, 2, 3], np.array([10, 11, 12], dtype=np.int32))
+        assert tree.evictable_size() == 3
+        assert tree.protected_size() == 0
+        res = tree.match_prefix([1, 2, 3])
+        tree.inc_lock_ref(res.last_node)
+        assert tree.evictable_size() == 0
+        assert tree.protected_size() == 3
+        tree.dec_lock_ref(res.last_node)
+        assert tree.evictable_size() == 3
+        assert tree.protected_size() == 0
+
+    def test_lock_accounting_across_split(self):
+        tree = make_tree()
+        tree.insert([1, 2, 3, 4], np.array([10, 11, 12, 13], dtype=np.int32))
+        res = tree.match_prefix([1, 2])  # splits; lock only the [1,2] node
+        tree.inc_lock_ref(res.last_node)
+        assert tree.protected_size() == 2
+        assert tree.evictable_size() == 2
+        # Only the unlocked tail can be evicted.
+        assert tree.evict(100) == 2
+        tree.dec_lock_ref(res.last_node)
+        assert tree.evict(100) == 2
+
+
+class TestEventsAndReset:
+    def test_store_and_remove_events(self):
+        tree = make_tree(enable_events=True)
+        ev0 = tree.take_events()
+        assert any(isinstance(e, AllBlocksCleared) for e in ev0)
+        tree.insert([1, 2, 3], np.array([10, 11, 12], dtype=np.int32))
+        (stored,) = [e for e in tree.take_events() if isinstance(e, BlockStored)]
+        assert stored.token_ids == (1, 2, 3)
+        assert stored.parent_block_hash is None
+        tree.evict(3)
+        (removed,) = [e for e in tree.take_events() if isinstance(e, BlockRemoved)]
+        # Every per-page block hash is reported, not just the last one, so an
+        # external observer mirroring the journal stays consistent.
+        assert removed.block_hashes == stored.block_hashes
+
+    def test_event_parent_chaining(self):
+        tree = make_tree(enable_events=True)
+        tree.insert([1, 2], np.array([10, 11], dtype=np.int32))
+        tree.insert([1, 2, 3, 4], np.array([10, 11, 12, 13], dtype=np.int32))
+        events = [e for e in tree.take_events() if isinstance(e, BlockStored)]
+        assert len(events) == 2
+        assert events[1].parent_block_hash == events[0].block_hashes[-1]
+
+    def test_event_chaining_survives_split(self):
+        tree = make_tree(enable_events=True)
+        tree.insert([1, 2, 3, 4], np.array([10, 11, 12, 13], dtype=np.int32))
+        (e0,) = [e for e in tree.take_events() if isinstance(e, BlockStored)]
+        tree.insert([1, 2, 9, 9], np.array([10, 11, 30, 31], dtype=np.int32))
+        (e1,) = [e for e in tree.take_events() if isinstance(e, BlockStored)]
+        # The new [9,9] leaf chains off the hash of the stored [1,2] prefix.
+        assert e1.parent_block_hash == e0.block_hashes[1]
+        # Evicting everything removes every hash that was ever stored.
+        tree.evict(100)
+        removed = [
+            h
+            for e in tree.take_events()
+            if isinstance(e, BlockRemoved)
+            for h in e.block_hashes
+        ]
+        assert sorted(removed) == sorted(e0.block_hashes + e1.block_hashes)
+
+    def test_reset(self):
+        tree = make_tree()
+        tree.insert([1, 2, 3], np.array([10, 11, 12], dtype=np.int32))
+        tree.reset()
+        assert tree.total_size() == 0
+        assert tree.match_prefix([1, 2, 3]).length == 0
+        assert tree.evictable_size() == 0
+
+    def test_reset_returns_slots_to_pool(self):
+        from radixmesh_tpu.cache.kv_pool import PagedKVPool
+        import jax.numpy as jnp
+
+        pool = PagedKVPool(
+            num_slots=8, num_layers=1, num_kv_heads=1, head_dim=2, dtype=jnp.float32
+        )
+        tree = make_tree(on_free=pool.free)
+        tree.insert(np.arange(8), pool.alloc(8))
+        assert pool.free_slots == 0
+        tree.reset()
+        assert pool.free_slots == 8
+
+    def test_all_values_flatten(self):
+        tree = make_tree()
+        tree.insert([1, 2], np.array([10, 11], dtype=np.int32))
+        tree.insert([5], np.array([30], dtype=np.int32))
+        assert sorted(tree.all_values_flatten().tolist()) == [10, 11, 30]
