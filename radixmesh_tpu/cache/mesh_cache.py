@@ -1,0 +1,613 @@
+"""MeshCache: the distributed radix prefix cache.
+
+Capability parity with the reference's ``RadixMesh``
+(``radix/radix_mesh.py:72-495``), re-designed rather than translated:
+
+- **Roles**: PREFILL / DECODE nodes hold real KV (slot indices into their
+  local :class:`PagedKVPool`); the ROUTER holds a rank-only replica used for
+  cache-aware routing (``radix_mesh.py:76-84``, ``core_enum.py:4-7``).
+- **Replication**: every local insert is broadcast as an idempotent INSERT
+  oplog around a TCP ring of prefill+decode nodes; the master (rank 0) fans
+  every oplog out to the router, which never sends
+  (``radix_mesh.py:325-347``, ``sync_algo.py:57-96``). TTLs bound each oplog
+  to one ring lap. Receivers apply then forward with the decremented TTL —
+  unlike the reference, which re-enters its local send path with a *fresh*
+  TTL and relies on the origin-drop check to terminate
+  (``radix_mesh.py:335,401``).
+- **Conflict resolution**: multi-writer conflicts (different origin rank for
+  the same prefix) resolve to the lowest origin rank on every node
+  (``policy/conflict.py``); the losing value is recorded in ``dup_nodes``
+  for distributed GC (``radix_mesh.py:273-323,466-495``).
+- **Distributed GC**: each prefill/decode node periodically rings a
+  GC_QUERY for its unlocked duplicates; peers vote; unanimity (= ring size)
+  at the origin frees the duplicate's KV slots on its owner and a GC_EXEC
+  lap retires the entry everywhere (``radix_mesh.py:148-166,362-389``).
+  Reference quirks fixed: the GC thread no longer exits permanently the
+  first time it finds nothing (``radix_mesh.py:157-158``), GC payloads
+  survive serialization (``cache_oplog.py:58-66``), and ``dup_nodes`` is
+  guarded by the same lock as the tree (it's a plain dict shared across
+  three threads in the reference, ``radix_mesh.py:97,310,365,476``).
+- **Startup barrier**: the tick originator rings a TICK with a two-lap TTL;
+  every node (router included, via master fan-out) blocks in
+  :meth:`wait_ready` until it has seen two laps — proof the ring is
+  connected (``radix_mesh.py:118-135,435-445``, reference ``README.md:91-93``).
+- **DELETE** is implemented (unlocked exact-key leaf removal, replicated)
+  instead of the reference's no-op stub (``radix_mesh.py:417-418,428-429``).
+
+Threading model: one re-entrant lock serializes all tree + dup_nodes
+mutation; transport reader threads, the ticker, the GC thread, and user
+threads all take it. Tree operations are microseconds, so contention is not
+a factor at oplog rates; KV data movement never holds the lock (it rides
+ICI collectives / the engine's jitted ops, not this control plane).
+
+Outbound oplogs are **enqueued under the lock** (so wire order always
+matches local application order — two racing non-commutative ops can never
+replicate in the opposite order) and transmitted by a dedicated sender
+thread, so the network is never touched while holding the lock: an
+unreachable ring successor back-pressures the queue, it cannot stall local
+match/insert traffic.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from radixmesh_tpu.cache.kv_pool import PagedKVPool
+from radixmesh_tpu.cache.mesh_values import PrefillValue, RouterValue
+from radixmesh_tpu.cache.oplog import GCEntry, NodeKey, Oplog, OplogType, deserialize, serialize
+from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode, as_key
+from radixmesh_tpu.comm.communicator import Communicator, create_communicator
+from radixmesh_tpu.config import MeshConfig, NodeRole
+from radixmesh_tpu.policy.conflict import NodeRankConflictResolver
+from radixmesh_tpu.policy.sync_algo import BaseSyncAlgo, get_sync_algo
+from radixmesh_tpu.utils.logging import get_logger
+from radixmesh_tpu.utils.sync import AtomicCounter
+
+__all__ = ["MeshCache", "RouterMatchResult"]
+
+
+@dataclass
+class RouterMatchResult:
+    """Router-mode match: which nodes hold the longest cached prefix
+    (reference ``RouterMatchResult``, ``radix_mesh.py:66-69``). ``-1`` means
+    no node of that role holds any of the prefix."""
+
+    prefill_rank: int
+    decode_rank: int
+    match_len: int = 0
+
+
+class MeshCache:
+    def __init__(
+        self,
+        cfg: MeshConfig,
+        pool: PagedKVPool | None = None,
+        sync_algo: BaseSyncAlgo | None = None,
+        resolver: type[NodeRankConflictResolver] = NodeRankConflictResolver,
+    ):
+        cfg.validate()
+        self.cfg = cfg
+        self.role, self.rank, self.local_rank = cfg.local_identity()
+        self.pool = pool
+        self.sync = sync_algo or get_sync_algo()
+        self.resolver = resolver
+        self.log = get_logger(f"mesh.{self.role.value}@{self.rank}")
+
+        # The mesh replicates at token granularity like the reference
+        # (radix_mesh.py:87-89 pins page_size=1); engine-level trees may use
+        # larger pages locally.
+        self.tree = RadixTree(page_size=1)
+        self._lock = threading.RLock()
+        self._logic_op = AtomicCounter()
+        self.dup_nodes: dict[NodeKey, PrefillValue | RouterValue] = {}
+        self.tick_counts: dict[int, int] = {}
+        self.metrics = {
+            "oplogs_sent": 0,
+            "oplogs_received": 0,
+            "conflicts": 0,
+            "gc_freed_slots": 0,
+            "gc_rounds": 0,
+        }
+
+        self._comm: Communicator | None = None
+        self._router_comms: list[Communicator] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._started = False
+        self._out_q: queue.Queue[bytes | None] = queue.Queue()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "MeshCache":
+        """Open transports and start background threads. Unlike the
+        reference (whose constructor blocks in the tick barrier,
+        ``radix_mesh.py:101-142``), startup and readiness are separate:
+        call :meth:`wait_ready` to block on ring verification."""
+        topo = self.sync.topo(self.cfg)
+        # Master fans out to routers over dedicated send-only channels
+        # (radix_mesh.py:103-109).
+        for router_addr in topo.routers:
+            self._router_comms.append(
+                create_communicator(
+                    self.cfg.protocol, None, router_addr, self.cfg.max_msg_bytes
+                )
+            )
+        self._comm = create_communicator(
+            self.cfg.protocol,
+            topo.bind_addr,
+            topo.next_node,
+            self.cfg.max_msg_bytes,
+        )
+        self._comm.register_rcv_callback(self.oplog_received)
+        # Mark started before spawning threads: the ticker's first tick must
+        # not be dropped by the _started gate in _send_bytes.
+        self._started = True
+        if self.sync.can_send(self.cfg):
+            t = threading.Thread(target=self._sender, daemon=True, name="mesh-sender")
+            t.start()
+            self._threads.append(t)
+        if self.sync.can_tick(self.cfg):
+            t = threading.Thread(target=self._ticker, daemon=True, name="mesh-ticker")
+            t.start()
+            self._threads.append(t)
+        if self.role is not NodeRole.ROUTER:
+            t = threading.Thread(target=self._gc_loop, daemon=True, name="mesh-gc")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def wait_ready(self, timeout: float | None = None) -> bool:
+        """Block until the startup tick has circulated the ring twice
+        (two-round verification, reference ``radix_mesh.py:435-445``)."""
+        origin = getattr(self.sync, "tick_origin_rank")(self.cfg)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._stop.is_set():
+            with self._lock:
+                if self.tick_counts.get(origin, 0) >= 2:
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+        return False
+
+    def close(self) -> None:
+        self._stop.set()
+        self._out_q.put(None)  # wake the sender thread
+        for t in self._threads:
+            t.join(timeout=2)
+        if self._comm is not None:
+            self._comm.close()
+        for c in self._router_comms:
+            c.close()
+
+    # ------------------------------------------------------------------
+    # public cache API
+    # ------------------------------------------------------------------
+
+    def insert(self, key, slot_indices: np.ndarray) -> int:
+        """Insert a locally-computed prefix (KV already written to the local
+        pool at ``slot_indices``) and replicate it around the ring
+        (reference ``radix_mesh.py:193-201``). Prefill/decode only."""
+        if self.role is NodeRole.ROUTER:
+            raise RuntimeError("router nodes hold no KV; insert is P/D-only")
+        key = as_key(key)
+        value = PrefillValue(slot_indices, self.rank)
+        if len(value) != len(key):
+            raise ValueError("slot_indices length must equal key length")
+        with self._lock:
+            prefix_len = self._mesh_insert(key, value)
+            # Enqueued under the lock: wire order == application order.
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.INSERT,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self.sync.data_ttl(self.cfg),
+                    key=key,
+                    value=np.asarray(slot_indices, dtype=np.int32),
+                    value_rank=self.rank,
+                )
+            )
+        return prefix_len
+
+    def match_prefix(self, key) -> MatchResult | RouterMatchResult:
+        """P/D: longest cached prefix with rank-tagged values. Router:
+        which prefill/decode ranks hold the longest prefix
+        (reference ``radix_mesh.py:203-238``)."""
+        with self._lock:
+            if self.role is NodeRole.ROUTER:
+                res = self.tree.match_prefix(key, split_partial=False)
+                return self._route_from_values(res.values)
+            return self.tree.match_prefix(key)
+
+    def local_prefix_indices(self, key) -> np.ndarray:
+        """Longest *locally-usable* cached prefix: the leading run of
+        matched values whose origin rank is this node — those are the only
+        slot indices valid in the local KV pool. (The reference
+        concatenates indices regardless of origin, ``radix_mesh.py:208-218``,
+        which is only sound because it never attaches a model.)"""
+        with self._lock:
+            res = self.tree.match_prefix(key)
+            runs = []
+            for v in res.values:
+                if not isinstance(v, PrefillValue) or v.rank != self.rank:
+                    break
+                runs.append(v.indices)
+        if not runs:
+            return np.empty(0, dtype=np.int32)
+        return np.concatenate(runs)
+
+    def delete(self, key) -> bool:
+        """Remove an exact-key unlocked leaf and replicate the deletion
+        (upgrade of the reference's DELETE stub, ``radix_mesh.py:417-418``)."""
+        key = as_key(key)
+        with self._lock:
+            removed = self._apply_delete(key)
+            if removed:
+                # Only a successful local delete replicates — broadcasting a
+                # refused delete (locked/mid-node key) would desynchronize
+                # replicas that can apply it.
+                self._broadcast(
+                    Oplog(
+                        op_type=OplogType.DELETE,
+                        origin_rank=self.rank,
+                        logic_id=self._logic_op.next(),
+                        ttl=self.sync.data_ttl(self.cfg),
+                        key=key,
+                    )
+                )
+        return removed
+
+    def reset_all(self) -> None:
+        """Clear the local replica and replicate RESET (reference
+        ``radix_mesh.py:419-420``)."""
+        with self._lock:
+            self._apply_reset()
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.RESET,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self.sync.data_ttl(self.cfg),
+                )
+            )
+
+    # lock-ref passthroughs (protect active requests from GC agreement)
+    def inc_lock_ref(self, node: TreeNode) -> None:
+        with self._lock:
+            self.tree.inc_lock_ref(node)
+
+    def dec_lock_ref(self, node: TreeNode) -> None:
+        with self._lock:
+            self.tree.dec_lock_ref(node)
+
+    # ------------------------------------------------------------------
+    # replication: receive path
+    # ------------------------------------------------------------------
+
+    def oplog_received(self, data: bytes) -> None:
+        """Transport callback (reference ``radix_mesh.py:391-420``)."""
+        op = deserialize(data)
+        with self._lock:
+            self.metrics["oplogs_received"] += 1
+            op.ttl -= 1
+            if op.op_type is OplogType.TICK:
+                # Counted before the origin-drop so the originator observes
+                # its own tick completing each lap (radix_mesh.py:356-360).
+                self.tick_counts[op.origin_rank] = (
+                    self.tick_counts.get(op.origin_rank, 0) + 1
+                )
+                if op.ttl > 0:
+                    self._forward(op)
+                return
+            if op.op_type in (OplogType.GC_QUERY, OplogType.GC_EXEC):
+                self._gc_handle(op)
+                return
+            if op.origin_rank == self.rank:
+                return  # lap complete (radix_mesh.py:401-402)
+            if op.ttl <= 0 and self.role is not NodeRole.ROUTER:
+                # TTL accounts ring laps; the router sits outside the ring
+                # and receives master fan-out copies whose TTL reflects how
+                # far around the ring the master sat — it must apply them
+                # regardless (the reference sidesteps this by re-sending
+                # with a fresh TTL, radix_mesh.py:335).
+                return
+            if op.op_type is OplogType.INSERT:
+                if self.role is NodeRole.ROUTER:
+                    value = RouterValue(op.value_rank, len(op.key))
+                else:
+                    value = PrefillValue(op.value, op.value_rank)
+                self._mesh_insert(op.key, value)
+            elif op.op_type is OplogType.DELETE:
+                self._apply_delete(op.key)
+            elif op.op_type is OplogType.RESET:
+                self._apply_reset()
+            if op.ttl > 0:
+                self._forward(op)
+
+    # ------------------------------------------------------------------
+    # replication: send path
+    # ------------------------------------------------------------------
+
+    def _broadcast(self, op: Oplog) -> None:
+        """First transmission of a locally-originated oplog
+        (reference ``radix_mesh.py:325-347``)."""
+        self._send_bytes(serialize(op))
+
+    def _forward(self, op: Oplog) -> None:
+        """Ring-forward a received oplog with its decremented TTL."""
+        self._send_bytes(serialize(op))
+
+    def _send_bytes(self, data: bytes) -> None:
+        """Enqueue for transmission. Called under the lock by receive-path
+        forwards and after local application by the public API — either way
+        the single FIFO queue makes wire order equal application order."""
+        if not self._started or not self.sync.can_send(self.cfg):
+            return
+        self.metrics["oplogs_sent"] += 1
+        self._out_q.put(data)
+
+    def _sender(self) -> None:
+        """Dedicated transmit thread: the only place the control plane
+        touches the network, so a slow/unreachable successor can never
+        stall tree operations."""
+        while True:
+            data = self._out_q.get()
+            if data is None or self._stop.is_set():
+                return
+            try:
+                self._comm.send(data)
+                if self.rank == self.sync.master_rank(self.cfg):
+                    # Master fans out to routers (radix_mesh.py:344-347).
+                    for rc in self._router_comms:
+                        rc.send(data)
+            except Exception:  # noqa: BLE001 — transport errors must not kill the sender
+                if not self._stop.is_set():
+                    self.log.exception("failed to transmit oplog")
+
+    # ------------------------------------------------------------------
+    # tree mutation with conflict resolution
+    # ------------------------------------------------------------------
+
+    def _values_conflict(self, existing, new) -> bool:
+        return existing.rank != new.rank
+
+    def _mesh_insert(self, key: np.ndarray, value) -> int:
+        """Insert walk with rank-conflict resolution (reference
+        ``_insert_helper``, ``radix_mesh.py:273-323``). Caller holds the
+        lock. Returns the length of the already-present prefix."""
+        tree = self.tree
+        node = tree.root
+        node.last_access_time = tree._time()
+        total = 0
+        while True:
+            child = node.children.get(tree._child_key(key))
+            if child is None:
+                leaf = TreeNode(parent=node)
+                leaf.key = key
+                leaf.value = value
+                leaf.last_access_time = tree._time()
+                node.children[tree._child_key(key)] = leaf
+                tree.evictable_size_ += len(key)
+                return total
+            m = tree._match(child.key, key)
+            if m < len(child.key):
+                child = tree._split_node(child, m)
+            child.last_access_time = tree._time()
+            new_seg = value[:m]
+            if self._values_conflict(child.value, new_seg):
+                self.metrics["conflicts"] += 1
+                full_key = self._full_key(child)
+                if self.resolver.keep(child.value.rank, new_seg.rank):
+                    # Existing wins; the incoming copy is a duplicate
+                    # (radix_mesh.py:309-310).
+                    self._record_dup(full_key, new_seg)
+                else:
+                    # New wins; swap in place and remember the loser
+                    # (radix_mesh.py:303-307,466-495).
+                    old = child.value
+                    child.value = new_seg
+                    self._record_dup(full_key, old)
+            total += m
+            if m == len(key):
+                return total
+            key = key[m:]
+            value = value[m:]
+            node = child
+
+    def _full_key(self, node: TreeNode) -> np.ndarray:
+        """Token path root→node (reference ``_full_key``,
+        ``radix_mesh.py:459-464``)."""
+        parts = []
+        while node is not None and node is not self.tree.root:
+            parts.append(node.key)
+            node = node.parent
+        if not parts:
+            return np.empty(0, dtype=np.int32)
+        return np.concatenate(parts[::-1])
+
+    def _record_dup(self, full_key: np.ndarray, loser) -> None:
+        self.dup_nodes[NodeKey(full_key, loser.rank)] = loser
+
+    def _apply_delete(self, key: np.ndarray) -> bool:
+        res = self.tree.match_prefix(key, split_partial=False)
+        node = res.last_node
+        if (
+            res.length != len(key)
+            or node is self.tree.root
+            or len(self._full_key(node)) != len(key)
+            or node.children
+            or node.lock_ref > 0
+        ):
+            return False
+        del node.parent.children[self.tree._child_key(node.key)]
+        self.tree.evictable_size_ -= len(node.key)
+        self._free_local(node.value)
+        return True
+
+    def _apply_reset(self) -> None:
+        for n in list(self.tree._all_nodes()):
+            if n is not self.tree.root:
+                self._free_local(n.value)
+        # Swapped-out losers awaiting GC also hold locally-owned slots;
+        # dropping them without freeing would leak pool capacity forever.
+        for loser in self.dup_nodes.values():
+            self._free_local(loser)
+        self.tree.reset()
+        self.dup_nodes.clear()
+
+    def _free_local(self, value) -> None:
+        """Return KV slots to the local pool iff this node owns them."""
+        if (
+            self.pool is not None
+            and isinstance(value, PrefillValue)
+            and value.rank == self.rank
+            and len(value.indices)
+        ):
+            self.pool.free(value.indices)
+
+    # ------------------------------------------------------------------
+    # routing scan
+    # ------------------------------------------------------------------
+
+    def _route_from_values(self, values) -> RouterMatchResult:
+        """Scan matched ranks from the tail: the deepest prefill writer and
+        the deepest decode writer win (reference ``radix_mesh.py:219-238``)."""
+        prefill_rank = decode_rank = -1
+        for v in reversed(values):
+            if prefill_rank == -1 and self.cfg.is_prefill_rank(v.rank):
+                prefill_rank = v.rank
+            if decode_rank == -1 and self.cfg.is_decode_rank(v.rank):
+                decode_rank = v.rank
+            if prefill_rank != -1 and decode_rank != -1:
+                break
+        return RouterMatchResult(
+            prefill_rank=prefill_rank,
+            decode_rank=decode_rank,
+            match_len=sum(len(v) for v in values),
+        )
+
+    # ------------------------------------------------------------------
+    # heartbeat / startup barrier
+    # ------------------------------------------------------------------
+
+    def _ticker(self) -> None:
+        """Periodic ring tick (reference ``radix_mesh.py:118-133``). The
+        first tick fires immediately so startup isn't gated on the
+        interval."""
+        while not self._stop.is_set():
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.TICK,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self.sync.tick_ttl(self.cfg),
+                )
+            )
+            self._stop.wait(self.cfg.tick_interval_s)
+
+    # ------------------------------------------------------------------
+    # distributed GC (reference radix_mesh.py:148-166,362-389)
+    # ------------------------------------------------------------------
+
+    def _gc_loop(self) -> None:
+        # Unlike the reference — whose GC thread `return`s forever the first
+        # time it finds nothing (radix_mesh.py:157-158) — this loop runs for
+        # the node's lifetime.
+        while not self._stop.is_set():
+            self._stop.wait(self.cfg.gc_interval_s)
+            if self._stop.is_set():
+                return
+            self.run_gc_round()
+
+    def run_gc_round(self) -> None:
+        """Originate one GC_QUERY lap for locally-unlocked duplicates.
+        Public so tests (and operators) can trigger a round on demand."""
+        with self._lock:
+            entries = [
+                GCEntry(
+                    key=np.asarray(nk.tokens, dtype=np.int32),
+                    value_rank=nk.value_rank,
+                    agree=1,
+                )
+                for nk in self.dup_nodes
+                if self._gc_agrees(np.asarray(nk.tokens, dtype=np.int32))
+            ]
+            if not entries:
+                return
+            self.metrics["gc_rounds"] += 1
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.GC_QUERY,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self.sync.gc_ttl(self.cfg),
+                    gc=entries,
+                )
+            )
+
+    def _gc_agrees(self, key: np.ndarray) -> bool:
+        """A node agrees to collect a duplicate iff the key's path is not
+        lock-protected here (reference ``radix_mesh.py:385-389``)."""
+        res = self.tree.match_prefix(key, split_partial=False)
+        node = res.last_node
+        while node is not None and node is not self.tree.root:
+            if node.lock_ref > 0:
+                return False
+            node = node.parent
+        return True
+
+    def _gc_handle(self, op: Oplog) -> None:
+        """Caller holds the lock; op.ttl already decremented."""
+        if op.op_type is OplogType.GC_QUERY:
+            if op.origin_rank == self.rank:
+                # Query completed its lap: unanimity = every ring member
+                # agreed (radix_mesh.py:368-384).
+                unanimous = [e for e in op.gc if e.agree >= self.cfg.num_ring]
+                if not unanimous:
+                    return
+                for e in unanimous:
+                    self._gc_collect(e)
+                self._broadcast(
+                    Oplog(
+                        op_type=OplogType.GC_EXEC,
+                        origin_rank=self.rank,
+                        logic_id=self._logic_op.next(),
+                        ttl=self.sync.gc_ttl(self.cfg),
+                        gc=[GCEntry(e.key, e.value_rank, e.agree) for e in unanimous],
+                    )
+                )
+                return
+            for e in op.gc:
+                if self._gc_agrees(e.key):
+                    e.agree += 1
+            if op.ttl > 0:
+                self._forward(op)
+            return
+        # GC_EXEC: everyone retires the duplicate; the slot owner frees
+        # (radix_mesh.py:363-366).
+        if op.origin_rank != self.rank:
+            for e in op.gc:
+                self._gc_collect(e)
+            if op.ttl > 0:
+                self._forward(op)
+
+    def _gc_collect(self, e: GCEntry) -> None:
+        loser = self.dup_nodes.pop(NodeKey(e.key, e.value_rank), None)
+        if loser is None:
+            return
+        if (
+            isinstance(loser, PrefillValue)
+            and loser.rank == self.rank
+            and self.pool is not None
+            and len(loser.indices)
+        ):
+            self.pool.free(loser.indices)
+            self.metrics["gc_freed_slots"] += len(loser.indices)

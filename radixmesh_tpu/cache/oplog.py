@@ -1,0 +1,190 @@
+"""Idempotent cache-oplog protocol + compact binary wire format.
+
+Capability parity with the reference's ``radix/cache_oplog.py`` +
+``communication/serializer.py``: oplogs are idempotent radix-tree operations
+(INSERT/DELETE/RESET), ring-control messages (TICK), and distributed-GC
+messages (GC_QUERY/GC_EXEC), each carrying the origin node's rank, a
+per-node monotonic logic id, and a TTL decremented per ring hop
+(``cache_oplog.py:13-56``).
+
+Deliberate departures from the reference:
+
+- **Binary, not JSON.** The reference serializes via ``to_dict()`` + JSON
+  (``serializer.py:21-27``), which is slow and — worse — ``to_dict`` omits
+  the ``gc_query``/``gc_exec`` payloads (``cache_oplog.py:58-66``), so GC
+  never works across the wire. Here the wire format is a fixed-layout
+  struct + raw int32 arrays, and every field round-trips (tested).
+- Router values carry their true token length (the reference's
+  ``RouterRadixMeshTreeValue.__len__`` returns 1, ``radix_mesh.py:47-63``,
+  which under-reports match lengths on the router).
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "OplogType",
+    "GCEntry",
+    "Oplog",
+    "NodeKey",
+    "serialize",
+    "deserialize",
+]
+
+_MAGIC = 0x52  # 'R'
+_VERSION = 1
+_HEADER = struct.Struct("<BBBxiqii")  # magic, ver, type, pad, origin, logic, ttl, value_rank
+
+
+class OplogType(enum.IntEnum):
+    """Reference ``cache_oplog.py:13-22``."""
+
+    INSERT = 1
+    DELETE = 2
+    RESET = 3
+    GC_QUERY = 4
+    GC_EXEC = 5
+    TICK = 10
+
+
+@dataclass
+class GCEntry:
+    """One duplicate-KV candidate in a GC round (reference ``GCQuery``,
+    ``cache_oplog.py:43-45``, extended with the origin rank that identifies
+    which copy of the key is the duplicate)."""
+
+    key: np.ndarray  # token ids
+    value_rank: int  # origin rank of the duplicated value
+    agree: int = 1  # unanimity counter, incremented per agreeing node
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, GCEntry)
+            and self.value_rank == other.value_rank
+            and self.agree == other.agree
+            and np.array_equal(self.key, other.key)
+        )
+
+
+@dataclass
+class Oplog:
+    """One replicated tree operation (reference ``CacheOplog``,
+    ``cache_oplog.py:48-56``)."""
+
+    op_type: OplogType
+    origin_rank: int  # node that created the oplog
+    logic_id: int  # per-origin monotonic id (radix_mesh.py:431-433)
+    ttl: int  # remaining ring hops
+    key: np.ndarray = field(default_factory=lambda: np.empty(0, dtype=np.int32))
+    value: np.ndarray = field(default_factory=lambda: np.empty(0, dtype=np.int32))
+    value_rank: int = -1  # origin rank of the *value* (INSERT); -1 if n/a
+    gc: list[GCEntry] = field(default_factory=list)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Oplog)
+            and self.op_type == other.op_type
+            and self.origin_rank == other.origin_rank
+            and self.logic_id == other.logic_id
+            and self.ttl == other.ttl
+            and self.value_rank == other.value_rank
+            and np.array_equal(self.key, other.key)
+            and np.array_equal(self.value, other.value)
+            and self.gc == other.gc
+        )
+
+
+class NodeKey:
+    """Hashable (tokens, value_rank) identity for duplicate-KV bookkeeping
+    (reference ``ImmutableNodeKey``, ``cache_oplog.py:25-40``)."""
+
+    __slots__ = ("tokens", "value_rank", "_hash")
+
+    def __init__(self, tokens: Sequence[int] | np.ndarray, value_rank: int):
+        self.tokens = tuple(int(t) for t in tokens)
+        self.value_rank = value_rank
+        self._hash = hash((self.tokens, value_rank))
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, NodeKey)
+            and self._hash == other._hash
+            and self.value_rank == other.value_rank
+            and self.tokens == other.tokens
+        )
+
+    def __repr__(self) -> str:
+        return f"NodeKey(rank={self.value_rank}, tokens={self.tokens[:8]}...)"
+
+
+def _arr(a: np.ndarray | None) -> np.ndarray:
+    return np.ascontiguousarray(
+        np.empty(0, dtype=np.int32) if a is None else np.asarray(a, dtype=np.int32)
+    )
+
+
+def serialize(op: Oplog) -> bytes:
+    """Oplog → bytes. Every field — including GC payloads — round-trips
+    (fixing the reference's ``to_dict`` omission, ``cache_oplog.py:58-66``)."""
+    key, value = _arr(op.key), _arr(op.value)
+    parts = [
+        _HEADER.pack(
+            _MAGIC,
+            _VERSION,
+            int(op.op_type),
+            op.origin_rank,
+            op.logic_id,
+            op.ttl,
+            op.value_rank,
+        ),
+        struct.pack("<III", len(key), len(value), len(op.gc)),
+        key.tobytes(),
+        value.tobytes(),
+    ]
+    for e in op.gc:
+        ek = _arr(e.key)
+        parts.append(struct.pack("<iiI", e.agree, e.value_rank, len(ek)))
+        parts.append(ek.tobytes())
+    return b"".join(parts)
+
+
+def deserialize(buf: bytes | memoryview) -> Oplog:
+    buf = memoryview(buf)
+    magic, ver, op_type, origin, logic, ttl, value_rank = _HEADER.unpack_from(buf, 0)
+    if magic != _MAGIC:
+        raise ValueError(f"bad oplog magic {magic:#x}")
+    if ver != _VERSION:
+        raise ValueError(f"unsupported oplog version {ver}")
+    off = _HEADER.size
+    key_len, val_len, n_gc = struct.unpack_from("<III", buf, off)
+    off += 12
+    key = np.frombuffer(buf, dtype=np.int32, count=key_len, offset=off).copy()
+    off += 4 * key_len
+    value = np.frombuffer(buf, dtype=np.int32, count=val_len, offset=off).copy()
+    off += 4 * val_len
+    gc: list[GCEntry] = []
+    for _ in range(n_gc):
+        agree, vrank, eklen = struct.unpack_from("<iiI", buf, off)
+        off += 12
+        ek = np.frombuffer(buf, dtype=np.int32, count=eklen, offset=off).copy()
+        off += 4 * eklen
+        gc.append(GCEntry(key=ek, value_rank=vrank, agree=agree))
+    return Oplog(
+        op_type=OplogType(op_type),
+        origin_rank=origin,
+        logic_id=logic,
+        ttl=ttl,
+        key=key,
+        value=value,
+        value_rank=value_rank,
+        gc=gc,
+    )

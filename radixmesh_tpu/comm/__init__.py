@@ -1,0 +1,3 @@
+from radixmesh_tpu.comm.communicator import Communicator, create_communicator
+
+__all__ = ["Communicator", "create_communicator"]

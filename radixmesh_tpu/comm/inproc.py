@@ -1,0 +1,109 @@
+"""In-process transport hub for unit tests.
+
+The reference's "fake backend" is real TCP on loopback (SURVEY §4); that
+pattern is kept in ``tests/test_multiprocess.py``, but unit tests of the
+mesh-cache logic want a transport with no sockets, no ports, and
+deterministic delivery. Messages are delivered on a single per-hub worker
+thread, preserving per-link FIFO order like TCP does.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+from radixmesh_tpu.comm.communicator import Communicator
+
+__all__ = ["InprocCommunicator", "InprocHub"]
+
+
+class InprocHub:
+    """Shared registry of listening endpoints + one delivery thread."""
+
+    _default: "InprocHub | None" = None
+    _default_lock = threading.Lock()
+
+    def __init__(self):
+        self._listeners: dict[str, InprocCommunicator] = {}
+        self._q: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def default(cls) -> "InprocHub":
+        with cls._default_lock:
+            if cls._default is None:
+                cls._default = cls()
+            return cls._default
+
+    @classmethod
+    def reset_default(cls) -> None:
+        with cls._default_lock:
+            hub, cls._default = cls._default, None
+        if hub is not None:
+            hub._q.put(None)
+
+    def register(self, addr: str, comm: "InprocCommunicator") -> None:
+        with self._lock:
+            if addr in self._listeners:
+                raise ValueError(f"address {addr!r} already bound")
+            self._listeners[addr] = comm
+
+    def unregister(self, addr: str) -> None:
+        with self._lock:
+            self._listeners.pop(addr, None)
+
+    def post(self, target: str, data: bytes) -> None:
+        self._q.put((target, data))
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            target, data = item
+            with self._lock:
+                comm = self._listeners.get(target)
+            if comm is not None and comm._callback is not None:
+                try:
+                    comm._callback(data)
+                except Exception:  # noqa: BLE001 — a bad callback must not kill delivery
+                    import logging
+
+                    logging.getLogger("radixmesh_tpu.comm").exception(
+                        "inproc receive callback failed"
+                    )
+
+
+class InprocCommunicator(Communicator):
+    def __init__(self, bind_addr: str | None, target_addr: str | None, hub: InprocHub | None = None):
+        self._hub = hub or InprocHub.default()
+        self._bind = bind_addr
+        self._target = target_addr
+        self._callback: Callable[[bytes], None] | None = None
+        self._closed = False
+        if bind_addr is not None:
+            self._hub.register(bind_addr, self)
+
+    def send(self, data: bytes) -> None:
+        if self._closed:
+            raise RuntimeError("communicator closed")
+        if self._target is None:
+            raise RuntimeError("send-only target not configured")
+        self._hub.post(self._target, bytes(data))
+
+    def register_rcv_callback(self, fn: Callable[[bytes], None]) -> None:
+        self._callback = fn
+
+    def is_ordered(self) -> bool:
+        return True
+
+    def target_address(self) -> str | None:
+        return self._target
+
+    def close(self) -> None:
+        self._closed = True
+        if self._bind is not None:
+            self._hub.unregister(self._bind)

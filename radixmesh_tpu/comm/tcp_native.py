@@ -1,0 +1,160 @@
+"""ctypes binding to the native C++ DCN transport (``native/transport.cpp``).
+
+The shared library is compiled on demand with ``g++`` (no pybind11; plain C
+ABI + ctypes per the environment constraints) and cached next to the source.
+Capability parity with the reference's TcpCommunicator
+(``communication/communicator.py:138-270``): length-framed ordered delivery,
+persistent auto-reconnecting sender, listener thread pool, asymmetric
+listen-only / send-only endpoints.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable
+
+from radixmesh_tpu.comm.communicator import Communicator
+from radixmesh_tpu.config import DEFAULT_MAX_MSG_BYTES, parse_addr
+from radixmesh_tpu.utils.logging import get_logger
+
+__all__ = ["NativeTcpCommunicator", "load_native_lib"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "transport.cpp")
+_LIB = os.path.join(_HERE, "native", "libtransport.so")
+
+_CALLBACK_T = ctypes.CFUNCTYPE(
+    None, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_void_p
+)
+
+_lib_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _build() -> None:
+    cmd = [
+        "g++",
+        "-std=c++17",
+        "-O3",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        "-o",
+        _LIB,
+        _SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def load_native_lib() -> ctypes.CDLL:
+    """Load (building if needed) the native transport library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        lib.rm_listener_create.restype = ctypes.c_void_p
+        lib.rm_listener_create.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            _CALLBACK_T,
+            ctypes.c_void_p,
+        ]
+        lib.rm_listener_close.argtypes = [ctypes.c_void_p]
+        lib.rm_sender_create.restype = ctypes.c_void_p
+        lib.rm_sender_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64]
+        lib.rm_send.restype = ctypes.c_int
+        lib.rm_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.rm_sender_connected.restype = ctypes.c_int
+        lib.rm_sender_connected.argtypes = [ctypes.c_void_p]
+        lib.rm_sender_flush.argtypes = [ctypes.c_void_p]
+        lib.rm_sender_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeTcpCommunicator(Communicator):
+    def __init__(
+        self,
+        bind_addr: str | None,
+        target_addr: str | None,
+        max_msg_bytes: int = DEFAULT_MAX_MSG_BYTES,
+    ):
+        self._lib = load_native_lib()
+        self._log = get_logger("comm.tcp")
+        self._bind = bind_addr
+        self._target = target_addr
+        self._max_msg = max_msg_bytes
+        self._callback: Callable[[bytes], None] | None = None
+        self._listener = None
+        self._sender = None
+        self._closed = False
+
+        # Keep a reference to the ctypes callback trampoline for the life of
+        # the listener — if it's collected, the C side calls freed memory.
+        def _trampoline(data, length, _user):
+            cb = self._callback
+            if cb is None:
+                return
+            try:
+                cb(ctypes.string_at(data, length))
+            except Exception:  # noqa: BLE001 — receiver bugs must not kill the reader thread
+                self._log.exception("receive callback failed")
+
+        self._c_callback = _CALLBACK_T(_trampoline)
+
+        if bind_addr is not None:
+            host, port = parse_addr(bind_addr)
+            self._listener = self._lib.rm_listener_create(
+                host.encode(), port, max_msg_bytes, self._c_callback, None
+            )
+            if not self._listener:
+                raise OSError(f"failed to bind native listener on {bind_addr}")
+        if target_addr is not None:
+            host, port = parse_addr(target_addr)
+            self._sender = self._lib.rm_sender_create(host.encode(), port, max_msg_bytes)
+            if not self._sender:
+                raise OSError(f"failed to create native sender to {target_addr}")
+
+    def send(self, data: bytes) -> None:
+        if self._closed:
+            raise RuntimeError("communicator closed")
+        if self._sender is None:
+            raise RuntimeError("send-only target not configured")
+        if len(data) > self._max_msg:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds max_msg_bytes={self._max_msg}"
+            )
+        rc = self._lib.rm_send(self._sender, data, len(data))
+        if rc != 0:
+            raise RuntimeError(f"native send failed (rc={rc})")
+
+    def register_rcv_callback(self, fn: Callable[[bytes], None]) -> None:
+        self._callback = fn
+
+    def is_ordered(self) -> bool:
+        return True
+
+    def target_address(self) -> str | None:
+        return self._target
+
+    def flush(self) -> None:
+        if self._sender is not None:
+            self._lib.rm_sender_flush(self._sender)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._sender is not None:
+            self._lib.rm_sender_close(self._sender)
+            self._sender = None
+        if self._listener is not None:
+            self._lib.rm_listener_close(self._listener)
+            self._listener = None

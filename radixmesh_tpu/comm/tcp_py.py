@@ -1,0 +1,165 @@
+"""Pure-Python TCP transport — fallback + wire-compat cross-check for the
+native transport.
+
+Same framing as the native module and the reference
+(``[4-byte big-endian length][payload]``, reference ``README.md:76-81``,
+``communicator.py:190``): the two implementations interoperate, which the
+transport tests verify.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Callable
+
+from radixmesh_tpu.comm.communicator import Communicator
+from radixmesh_tpu.config import DEFAULT_MAX_MSG_BYTES, parse_addr
+from radixmesh_tpu.utils.logging import get_logger
+
+__all__ = ["PyTcpCommunicator"]
+
+_LEN = struct.Struct(">I")
+
+
+class PyTcpCommunicator(Communicator):
+    def __init__(
+        self,
+        bind_addr: str | None,
+        target_addr: str | None,
+        max_msg_bytes: int = DEFAULT_MAX_MSG_BYTES,
+    ):
+        self._log = get_logger("comm.tcp_py")
+        self._bind = bind_addr
+        self._target = target_addr
+        self._max_msg = max_msg_bytes
+        self._callback: Callable[[bytes], None] | None = None
+        self._closed = threading.Event()
+        self._send_lock = threading.Lock()
+        self._send_sock: socket.socket | None = None
+        self._listen_sock: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+
+        if bind_addr is not None:
+            host, port = parse_addr(bind_addr)
+            self._listen_sock = socket.create_server((host, port), backlog=64)
+            t = threading.Thread(target=self._accept_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # ---- receive path (reference communicator.py:212-257) ----
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._listen_sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            t = threading.Thread(target=self._handle_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                hdr = self._recv_all(conn, 4)
+                if hdr is None:
+                    return
+                (length,) = _LEN.unpack(hdr)
+                if length == 0 or length > self._max_msg:
+                    self._log.error("dropping conn: bad frame length %d", length)
+                    return
+                payload = self._recv_all(conn, length)
+                if payload is None:
+                    return
+                cb = self._callback
+                if cb is not None:
+                    try:
+                        cb(payload)
+                    except Exception:  # noqa: BLE001
+                        self._log.exception("receive callback failed")
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_all(conn: socket.socket, n: int) -> bytes | None:
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            try:
+                r = conn.recv_into(view[got:], n - got)
+            except OSError:
+                return None
+            if r == 0:
+                return None
+            got += r
+        return bytes(buf)
+
+    # ---- send path (reference communicator.py:162-210) ----
+
+    def _connect(self) -> socket.socket:
+        host, port = parse_addr(self._target)
+        while not self._closed.is_set():
+            try:
+                s = socket.create_connection((host, port), timeout=5)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(None)
+                return s
+            except OSError:
+                time.sleep(0.1)
+        raise RuntimeError("communicator closed while connecting")
+
+    def send(self, data: bytes) -> None:
+        if self._closed.is_set():
+            raise RuntimeError("communicator closed")
+        if self._target is None:
+            raise RuntimeError("send-only target not configured")
+        if len(data) > self._max_msg:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds max_msg_bytes={self._max_msg}"
+            )
+        frame = _LEN.pack(len(data)) + data
+        with self._send_lock:
+            # Retry (reconnecting) until delivered or closed — a silently
+            # dropped frame diverges ring replicas unrecoverably (receivers
+            # have no gap detection), so at-least-once beats fail-fast here.
+            while not self._closed.is_set():
+                try:
+                    if self._send_sock is None:
+                        self._send_sock = self._connect()
+                    self._send_sock.sendall(frame)
+                    return
+                except OSError:
+                    if self._send_sock is not None:
+                        self._send_sock.close()
+                        self._send_sock = None
+                    time.sleep(0.05)
+            raise RuntimeError("communicator closed while sending")
+
+    def register_rcv_callback(self, fn: Callable[[bytes], None]) -> None:
+        self._callback = fn
+
+    def is_ordered(self) -> bool:
+        return True
+
+    def target_address(self) -> str | None:
+        return self._target
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._send_sock is not None:
+            self._send_sock.close()
+        if self._listen_sock is not None:
+            self._listen_sock.close()
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()

@@ -135,7 +135,9 @@ class MeshConfig:
         return self.local_identity()[0]
 
     @property
-    def local_rank(self) -> int:
+    def global_rank(self) -> int:
+        """This node's rank in the global rank space (distinct from the
+        within-role local rank, ``local_identity()[2]``)."""
         return self.local_identity()[1]
 
     def validate(self) -> None:

@@ -1,0 +1,10 @@
+from radixmesh_tpu.policy.conflict import NodeRankConflictResolver
+from radixmesh_tpu.policy.sync_algo import BaseSyncAlgo, RingSyncAlgo, TopoResult, get_sync_algo
+
+__all__ = [
+    "NodeRankConflictResolver",
+    "BaseSyncAlgo",
+    "RingSyncAlgo",
+    "TopoResult",
+    "get_sync_algo",
+]

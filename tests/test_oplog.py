@@ -1,0 +1,94 @@
+"""Oplog protocol + binary serializer tests (reference ``cache_oplog.py`` /
+``serializer.py`` capabilities, with the GC-payload-drop quirk fixed)."""
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.oplog import (
+    GCEntry,
+    NodeKey,
+    Oplog,
+    OplogType,
+    deserialize,
+    serialize,
+)
+
+
+def roundtrip(op):
+    return deserialize(serialize(op))
+
+
+class TestSerializer:
+    def test_insert_roundtrip(self):
+        op = Oplog(
+            op_type=OplogType.INSERT,
+            origin_rank=2,
+            logic_id=12345678901,
+            ttl=5,
+            key=np.array([1, 2, 3], dtype=np.int32),
+            value=np.array([100, 101, 102], dtype=np.int32),
+            value_rank=2,
+        )
+        assert roundtrip(op) == op
+
+    def test_tick_roundtrip_empty_payload(self):
+        op = Oplog(op_type=OplogType.TICK, origin_rank=3, logic_id=7, ttl=10)
+        got = roundtrip(op)
+        assert got == op
+        assert len(got.key) == 0 and len(got.value) == 0
+
+    def test_gc_payload_survives_wire(self):
+        # The reference drops gc fields in to_dict (cache_oplog.py:58-66);
+        # here they must round-trip fully.
+        op = Oplog(
+            op_type=OplogType.GC_QUERY,
+            origin_rank=1,
+            logic_id=9,
+            ttl=5,
+            gc=[
+                GCEntry(key=np.array([5, 6], dtype=np.int32), value_rank=4, agree=3),
+                GCEntry(key=np.array([9], dtype=np.int32), value_rank=0, agree=1),
+            ],
+        )
+        got = roundtrip(op)
+        assert got == op
+        assert got.gc[0].agree == 3 and got.gc[0].value_rank == 4
+        np.testing.assert_array_equal(got.gc[1].key, [9])
+
+    def test_gc_exec_roundtrip(self):
+        op = Oplog(
+            op_type=OplogType.GC_EXEC,
+            origin_rank=0,
+            logic_id=1,
+            ttl=5,
+            gc=[GCEntry(key=np.array([1, 2, 3], dtype=np.int32), value_rank=2)],
+        )
+        assert roundtrip(op) == op
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError, match="magic"):
+            deserialize(b"\x00" * 64)
+
+    def test_bad_version_rejected(self):
+        buf = bytearray(serialize(Oplog(OplogType.TICK, 0, 0, 1)))
+        buf[1] = 99
+        with pytest.raises(ValueError, match="version"):
+            deserialize(bytes(buf))
+
+    def test_large_payload(self):
+        key = np.arange(100_000, dtype=np.int32)
+        op = Oplog(OplogType.INSERT, 0, 1, 5, key=key, value=key * 2, value_rank=0)
+        got = roundtrip(op)
+        np.testing.assert_array_equal(got.value, key * 2)
+
+
+class TestNodeKey:
+    def test_hash_and_eq(self):
+        a = NodeKey([1, 2, 3], 0)
+        b = NodeKey(np.array([1, 2, 3]), 0)
+        c = NodeKey([1, 2, 3], 1)
+        assert a == b and hash(a) == hash(b)
+        assert a != c
+        d = {a: "x"}
+        assert d[b] == "x"
+        assert c not in d

@@ -1,0 +1,90 @@
+"""Ring topology policy + conflict resolver tests (reference
+``policy/sync_algo.py`` / ``conflict_resolve.py`` semantics)."""
+
+import pytest
+
+from radixmesh_tpu.config import MeshConfig
+from radixmesh_tpu.policy import NodeRankConflictResolver, RingSyncAlgo, get_sync_algo
+
+
+def cfg(local, prefill=3, decode=2, router=1):
+    return MeshConfig(
+        prefill_nodes=[f"p{i}" for i in range(prefill)],
+        decode_nodes=[f"d{i}" for i in range(decode)],
+        router_nodes=[f"r{i}" for i in range(router)],
+        local_addr=local,
+    )
+
+
+class TestRingSyncAlgo:
+    def setup_method(self):
+        self.algo = RingSyncAlgo()
+
+    def test_ring_order_and_successor(self):
+        c = cfg("p0")
+        assert self.algo.ring(c) == ["p0", "p1", "p2", "d0", "d1"]
+        assert self.algo.topo(c).next_node == "p1"
+        assert self.algo.topo(cfg("p2")).next_node == "d0"
+        # Last decode node wraps to first prefill node.
+        assert self.algo.topo(cfg("d1")).next_node == "p0"
+
+    def test_master_fans_out_to_routers(self):
+        assert self.algo.topo(cfg("p0")).routers == ["r0"]
+        for other in ("p1", "p2", "d0", "d1"):
+            assert self.algo.topo(cfg(other)).routers == []
+
+    def test_router_outside_ring(self):
+        t = self.algo.topo(cfg("r0"))
+        assert t.next_node is None and t.routers == []
+        assert not self.algo.can_send(cfg("r0"))
+        assert self.algo.can_recv(cfg("r0"))
+
+    def test_full_ring_reaches_everyone_within_ttl(self):
+        # Walking data_ttl hops from any origin visits every ring member.
+        c = cfg("p0")
+        ring = self.algo.ring(c)
+        ttl = self.algo.data_ttl(c)
+        for start in range(len(ring)):
+            seen = {ring[(start + i) % len(ring)] for i in range(ttl)}
+            assert seen == set(ring)
+
+    def test_ttls(self):
+        c = cfg("p0")
+        assert self.algo.data_ttl(c) == 5
+        assert self.algo.tick_ttl(c) == 10
+        assert self.algo.gc_ttl(c) == 5
+
+    def test_tick_origin(self):
+        assert self.algo.can_tick(cfg("d0"))
+        for other in ("p0", "p1", "p2", "d1"):
+            assert not self.algo.can_tick(cfg(other))
+        # No decode nodes -> master ticks (fallback beyond the reference).
+        no_decode = MeshConfig(
+            prefill_nodes=["p0", "p1"], decode_nodes=[], local_addr="p0"
+        )
+        assert self.algo.can_tick(no_decode)
+
+    def test_factory(self):
+        assert isinstance(get_sync_algo("ring"), RingSyncAlgo)
+        with pytest.raises(ValueError):
+            get_sync_algo("star")
+
+
+class TestConflictResolver:
+    def test_lowest_rank_wins(self):
+        keep = NodeRankConflictResolver.keep
+        assert keep(0, 1)  # existing lower -> keep existing
+        assert keep(2, 2)  # tie -> keep existing (stability)
+        assert not keep(3, 1)  # new lower -> replace
+
+    def test_total_order_convergence(self):
+        # Whatever order writes arrive in, the surviving rank is the min —
+        # the property that makes master-free replication converge.
+        import itertools
+
+        for perm in itertools.permutations([3, 1, 2, 0]):
+            survivor = perm[0]
+            for new in perm[1:]:
+                if not NodeRankConflictResolver.keep(survivor, new):
+                    survivor = new
+            assert survivor == 0
