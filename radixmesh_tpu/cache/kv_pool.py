@@ -7,9 +7,11 @@ calls ``free()`` on (``radix_cache.py:104-107,188-199``). Here the pool is a
 first-class component:
 
 - One preallocated, donated ``jax.Array`` of shape
-  ``[2, layers, num_slots, kv_heads, head_dim]`` (K and V stacked) lives in
-  HBM for the model's whole life — no allocation inside the serving loop,
-  static shapes for XLA.
+  ``[2, layers, kv_heads, num_slots, head_dim]`` (K and V stacked,
+  head-major) lives in HBM for the model's whole life — no allocation
+  inside the serving loop, static shapes for XLA, and per-layer pages view
+  as ``[kv_heads, num_pages, page, head_dim]`` by pure reshape for the
+  Pallas paged-attention kernel.
 - A host-side :class:`SlotAllocator` free-list hands out token-granularity
   slot indices; the radix tree stores those indices as its node values and
   returns them to the allocator on eviction.
@@ -100,13 +102,14 @@ class SlotAllocator:
 
 @partial(jax.jit, donate_argnums=(0,))
 def _scatter_kv(kv: jax.Array, slots: jax.Array, new_kv: jax.Array) -> jax.Array:
-    # kv: [2, L, S, H, D]; slots: [n]; new_kv: [2, L, n, H, D]
-    return kv.at[:, :, slots].set(new_kv)
+    # kv: [2, L, H, S, D]; slots: [n]; new_kv: [2, L, H, n, D]
+    return kv.at[:, :, :, slots].set(new_kv)
 
 
 @jax.jit
 def _gather_kv(kv: jax.Array, slots: jax.Array) -> jax.Array:
-    return kv[:, :, slots]
+    # → [2, L, n, H, D] (token-major, for tests/debug)
+    return kv[:, :, :, slots].transpose(0, 1, 3, 2, 4)
 
 
 class PagedKVPool:
@@ -129,9 +132,14 @@ class PagedKVPool:
         self.page_size = page_size
         self.dtype = dtype
         self.allocator = SlotAllocator(num_slots, page_size)
+        # Head-major layout [2, L, Hkv, slots, D]: per-layer pages view as
+        # [Hkv, num_pages, page, D] by pure reshape (no copy), which is the
+        # layout the Pallas paged-attention kernel DMAs (batch dims of its
+        # MXU contractions must lead), and the natural axis to shard over
+        # `tp` (each chip holds its head shard of every page).
         zeros = partial(
             jnp.zeros,
-            (2, num_layers, num_slots, num_kv_heads, head_dim),
+            (2, num_layers, num_kv_heads, num_slots, head_dim),
             dtype=dtype,
         )
         if sharding is not None:
@@ -176,8 +184,15 @@ class PagedKVPool:
             slots = np.concatenate([slots, np.repeat(slots[-1:], pad)])
             k = jnp.concatenate([k, jnp.repeat(k[:, -1:], pad, axis=1)], axis=1)
             v = jnp.concatenate([v, jnp.repeat(v[:, -1:], pad, axis=1)], axis=1)
-        new_kv = jnp.stack([k, v]).astype(self.dtype)
+        # [L, n, H, D] → head-major [L, H, n, D].
+        new_kv = jnp.stack([k, v]).astype(self.dtype).transpose(0, 1, 3, 2, 4)
         self.kv = _scatter_kv(self.kv, jnp.asarray(slots, dtype=jnp.int32), new_kv)
+
+    def pages_for_layer(self, layer: int) -> tuple[jax.Array, jax.Array]:
+        """(k_pages, v_pages), each ``[Hkv, num_pages, page, D]`` — a
+        zero-copy view of this layer's pool, the kernel's input layout."""
+        shape = (self.num_kv_heads, self.num_pages, self.page_size, self.head_dim)
+        return self.kv[0, layer].reshape(shape), self.kv[1, layer].reshape(shape)
 
     def gather(self, slots: np.ndarray | jax.Array) -> jax.Array:
         """Gather ``[2, L, n, kv_heads, head_dim]`` for the given slots

@@ -41,11 +41,22 @@ a factor at oplog rates; KV data movement never holds the lock (it rides
 ICI collectives / the engine's jitted ops, not this control plane).
 
 Outbound oplogs are **enqueued under the lock** (so wire order always
-matches local application order — two racing non-commutative ops can never
-replicate in the opposite order) and transmitted by a dedicated sender
-thread, so the network is never touched while holding the lock: an
-unreachable ring successor back-pressures the queue, it cannot stall local
-match/insert traffic.
+matches each origin's local application order — one node's racing
+non-commutative ops can never replicate out of order) and transmitted by a
+dedicated sender thread, so the network is never touched while holding the
+lock: an unreachable ring successor cannot stall local match/insert
+traffic. The queue is bounded; a peer outage long enough to fill it drops
+oplogs with a counter + log line rather than growing the heap or blocking
+— safe because the tree is a *cache*: a missed INSERT costs a replica a
+cache hit, not correctness.
+
+Consistency model (same as the reference's, ``README.md:60-67``): per-origin
+FIFO + idempotent ops + rank-total-order conflict resolution give eventual
+convergence for INSERTs. Cross-origin DELETE/INSERT races can leave a key
+present on some replicas and absent on others — tolerated deliberately,
+again by cache semantics (the replica that kept it serves extra hits; the
+one that dropped it re-misses). Strict convergence would need tombstoned
+logical clocks, which nothing downstream requires.
 """
 
 from __future__ import annotations
@@ -119,7 +130,12 @@ class MeshCache:
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._started = False
-        self._out_q: queue.Queue[bytes | None] = queue.Queue()
+        # Bounded so a long peer outage cannot grow the heap without limit.
+        # Overflow drops the oplog (counted + logged): the tree is a cache,
+        # so a peer missing an insert only costs it a cache hit, and
+        # periodic ticks/GC rounds re-circulate — honest degradation beats
+        # blocking the mesh lock on a dead network.
+        self._out_q: queue.Queue[bytes | None] = queue.Queue(maxsize=65536)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -166,7 +182,7 @@ class MeshCache:
     def wait_ready(self, timeout: float | None = None) -> bool:
         """Block until the startup tick has circulated the ring twice
         (two-round verification, reference ``radix_mesh.py:435-445``)."""
-        origin = getattr(self.sync, "tick_origin_rank")(self.cfg)
+        origin = self.sync.tick_origin_rank(self.cfg)
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._stop.is_set():
             with self._lock:
@@ -351,8 +367,17 @@ class MeshCache:
         the single FIFO queue makes wire order equal application order."""
         if not self._started or not self.sync.can_send(self.cfg):
             return
-        self.metrics["oplogs_sent"] += 1
-        self._out_q.put(data)
+        try:
+            self._out_q.put_nowait(data)
+            self.metrics["oplogs_sent"] += 1
+        except queue.Full:
+            self.metrics["oplogs_dropped"] = self.metrics.get("oplogs_dropped", 0) + 1
+            if self.metrics["oplogs_dropped"] % 1000 == 1:
+                self.log.error(
+                    "outbound oplog queue full (%d dropped) — ring successor "
+                    "unreachable for an extended period?",
+                    self.metrics["oplogs_dropped"],
+                )
 
     def _sender(self) -> None:
         """Dedicated transmit thread: the only place the control plane
@@ -380,47 +405,27 @@ class MeshCache:
         return existing.rank != new.rank
 
     def _mesh_insert(self, key: np.ndarray, value) -> int:
-        """Insert walk with rank-conflict resolution (reference
-        ``_insert_helper``, ``radix_mesh.py:273-323``). Caller holds the
-        lock. Returns the length of the already-present prefix."""
-        tree = self.tree
-        node = tree.root
-        node.last_access_time = tree._time()
-        total = 0
-        while True:
-            child = node.children.get(tree._child_key(key))
-            if child is None:
-                leaf = TreeNode(parent=node)
-                leaf.key = key
-                leaf.value = value
-                leaf.last_access_time = tree._time()
-                node.children[tree._child_key(key)] = leaf
-                tree.evictable_size_ += len(key)
-                return total
-            m = tree._match(child.key, key)
-            if m < len(child.key):
-                child = tree._split_node(child, m)
-            child.last_access_time = tree._time()
-            new_seg = value[:m]
-            if self._values_conflict(child.value, new_seg):
-                self.metrics["conflicts"] += 1
-                full_key = self._full_key(child)
-                if self.resolver.keep(child.value.rank, new_seg.rank):
-                    # Existing wins; the incoming copy is a duplicate
-                    # (radix_mesh.py:309-310).
-                    self._record_dup(full_key, new_seg)
-                else:
-                    # New wins; swap in place and remember the loser
-                    # (radix_mesh.py:303-307,466-495).
-                    old = child.value
-                    child.value = new_seg
-                    self._record_dup(full_key, old)
-            total += m
-            if m == len(key):
-                return total
-            key = key[m:]
-            value = value[m:]
-            node = child
+        """Insert with rank-conflict resolution via the tree's conflict
+        hook (reference overrides the whole walk instead,
+        ``radix_mesh.py:273-323``). Caller holds the lock. Returns the
+        length of the already-present prefix."""
+        return self.tree.insert(key, value, on_conflict=self._resolve_conflict)
+
+    def _resolve_conflict(self, child: TreeNode, new_seg):
+        """Called by the tree for each matched node whose value differs
+        from the incoming segment (mesh values compare by origin rank);
+        returns the winning value and records the loser for GC."""
+        self.metrics["conflicts"] += 1
+        full_key = self._full_key(child)
+        if self.resolver.keep(child.value.rank, new_seg.rank):
+            # Existing wins; the incoming copy is a duplicate
+            # (radix_mesh.py:309-310).
+            self._record_dup(full_key, new_seg)
+            return child.value
+        # New wins; swap in place and remember the loser
+        # (radix_mesh.py:303-307,466-495).
+        self._record_dup(full_key, child.value)
+        return new_seg
 
     def _full_key(self, node: TreeNode) -> np.ndarray:
         """Token path root→node (reference ``_full_key``,
@@ -434,7 +439,22 @@ class MeshCache:
         return np.concatenate(parts[::-1])
 
     def _record_dup(self, full_key: np.ndarray, loser) -> None:
-        self.dup_nodes[NodeKey(full_key, loser.rank)] = loser
+        nk = NodeKey(full_key, loser.rank)
+        prev = self.dup_nodes.get(nk)
+        if prev is not None and prev is not loser:
+            # A fresh losing copy for the same (key, rank) — e.g. the origin
+            # recomputed KV after its first copy lost — replaces the entry.
+            # The previous loser is now referenced by neither the tree nor
+            # dup_nodes, so free its locally-owned slots immediately instead
+            # of leaking them; identical indices (idempotent re-delivery)
+            # are kept, not freed.
+            if not (
+                isinstance(prev, PrefillValue)
+                and isinstance(loser, PrefillValue)
+                and np.array_equal(prev.indices, loser.indices)
+            ):
+                self._free_local(prev)
+        self.dup_nodes[nk] = loser
 
     def _apply_delete(self, key: np.ndarray) -> bool:
         res = self.tree.match_prefix(key, split_partial=False)

@@ -266,13 +266,22 @@ class RadixTree:
             key = key[m:]
         return MatchResult(values=values, last_node=node)
 
-    def insert(self, key: Sequence[int], value: Any) -> int:
+    def insert(
+        self,
+        key: Sequence[int],
+        value: Any,
+        on_conflict: Callable[[TreeNode, Any], Any] | None = None,
+    ) -> int:
         """Insert ``key``→``value``; returns the length of the prefix that
         was already present (reference ``radix_cache.py:164-170,296-327``).
 
         ``value`` must satisfy ``len(value) == len(key)`` and support
         slicing. Over the already-present prefix the existing value is kept
-        (value-conflict policy lives in the mesh layer).
+        by default; with ``on_conflict`` set, each matched node whose value
+        differs (``!=``) from the incoming segment is resolved by the
+        callback, whose return value replaces the node's value — the hook
+        the distributed layer uses for rank-conflict resolution (reference
+        ``radix_mesh.py:273-323`` overrides the whole walk instead).
         """
         key = as_key(key)
         if len(value) != len(key):
@@ -282,7 +291,7 @@ class RadixTree:
             key, value = key[:n], value[:n]
         if len(key) == 0:
             return 0
-        return self._insert_helper(self.root, key, value)
+        return self._insert_helper(self.root, key, value, on_conflict)
 
     def evict(self, num_tokens: int) -> int:
         """Evict LRU unlocked leaves until ``num_tokens`` slots are freed
@@ -394,7 +403,13 @@ class RadixTree:
             node.block_hashes = node.block_hashes[n_pages:]
         return new_node
 
-    def _insert_helper(self, node: TreeNode, key: np.ndarray, value: Any) -> int:
+    def _insert_helper(
+        self,
+        node: TreeNode,
+        key: np.ndarray,
+        value: Any,
+        on_conflict: Callable[[TreeNode, Any], Any] | None = None,
+    ) -> int:
         node.last_access_time = self._time()
         total_prefix = 0
         while True:
@@ -412,6 +427,10 @@ class RadixTree:
             child.last_access_time = self._time()
             if m < len(child.key):
                 child = self._split_node(child, m)
+            if on_conflict is not None:
+                new_seg = value[:m]
+                if child.value != new_seg:
+                    child.value = on_conflict(child, new_seg)
             total_prefix += m
             if m == len(key):
                 return total_prefix

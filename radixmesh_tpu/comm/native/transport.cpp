@@ -218,18 +218,18 @@ struct RmSender {
   std::deque<std::vector<uint8_t>> queue;
   uint64_t queued_bytes = 0;
   std::thread send_thread;
-  int fd = -1;
+  std::atomic<int> fd{-1};
 
   bool ensure_connected() {
-    if (fd >= 0) return true;
-    fd = connect_to(host, port);
-    connected.store(fd >= 0);
-    return fd >= 0;
+    if (fd.load() >= 0) return true;
+    fd.store(connect_to(host, port));
+    connected.store(fd.load() >= 0);
+    return fd.load() >= 0;
   }
 
   void drop_connection() {
-    if (fd >= 0) close(fd);
-    fd = -1;
+    int f = fd.exchange(-1);
+    if (f >= 0) close(f);
     connected.store(false);
   }
 
@@ -261,7 +261,8 @@ struct RmSender {
           if (stopping.load()) return;
           std::this_thread::sleep_for(std::chrono::milliseconds(kConnectRetryMs));
         }
-        if (send_all(fd, hdr, 4) && send_all(fd, msg.data(), msg.size())) break;
+        int f = fd.load();
+        if (send_all(f, hdr, 4) && send_all(f, msg.data(), msg.size())) break;
         drop_connection();
       }
     }
@@ -315,6 +316,13 @@ void rm_sender_close(void* handle) {
   }
   s->cv_pop.notify_all();
   s->cv_push.notify_all();
+  // Unblock a send_all() stalled on a wedged peer (full TCP buffer):
+  // shutdown makes the in-flight ::send fail immediately so the thread can
+  // observe `stopping` — without this, join() can hang for minutes.
+  {
+    int f = s->fd.load();
+    if (f >= 0) shutdown(f, SHUT_RDWR);
+  }
   if (s->send_thread.joinable()) s->send_thread.join();
   s->drop_connection();
   delete s;

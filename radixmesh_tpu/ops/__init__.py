@@ -1,0 +1,14 @@
+from radixmesh_tpu.ops.norm import rms_norm
+from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
+from radixmesh_tpu.ops.attention import attend_prefill, attend_decode_ref, paged_attention
+from radixmesh_tpu.ops.sampling import sample_tokens
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "attend_prefill",
+    "attend_decode_ref",
+    "paged_attention",
+    "sample_tokens",
+]

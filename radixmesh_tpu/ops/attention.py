@@ -1,0 +1,137 @@
+"""Attention: prefill (dense causal with cached-prefix reuse) and decode
+(paged, reading radix-cache pages).
+
+This is the seam the reference leaves open — its commented-out SGLang
+scheduler hooks show where a model runtime would consume the radix cache's
+``MatchResult.device_indices`` (``radix_cache.py:439-519``). Here that
+contract is realized for TPU:
+
+- ``attend_prefill``: new tokens attend causally to themselves *and* to an
+  already-cached prefix gathered from the paged KV pool — the prefix-reuse
+  path that turns a radix-cache hit into skipped prefill FLOPs.
+- ``paged_attention``: decode-step attention over non-contiguous KV pages
+  via the Pallas kernel (``ops/paged_attention.py``) on TPU, with a
+  gather-based jnp reference used on CPU and as the numerics oracle.
+
+All dense math is einsum-based so XLA maps it onto the MXU; softmax runs in
+fp32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[.., seq, kv_heads, dim] → [.., seq, kv_heads * n_rep, dim] (GQA)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+@partial(jax.jit, static_argnames=())
+def attend_prefill(
+    q: jnp.ndarray,  # [B, S_new, Hq, D]
+    k: jnp.ndarray,  # [B, S_ctx, Hkv, D]  (cached prefix ++ new, rotated)
+    v: jnp.ndarray,  # [B, S_ctx, Hkv, D]
+    q_positions: jnp.ndarray,  # [B, S_new] absolute positions of q tokens
+    kv_lengths: jnp.ndarray,  # [B] valid context length (prefix + new)
+) -> jnp.ndarray:
+    """Causal attention where queries start mid-context (after a cached
+    prefix): query at absolute position p attends to kv positions <= p.
+    Padding beyond ``kv_lengths`` is masked. Returns [B, S_new, Hq, D]."""
+    B, S_new, Hq, D = q.shape
+    Hkv = k.shape[2]
+    k = _repeat_kv(k, Hq // Hkv)
+    v = _repeat_kv(v, Hq // Hkv)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
+    # Inputs stay in their native dtype (bf16 rides the MXU one-pass);
+    # accumulation and softmax are fp32. HIGHEST stops XLA from demoting
+    # fp32 inputs to bf16 multiplies (the TPU default).
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q,
+        k,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    logits = logits * scale
+    kv_pos = jnp.arange(k.shape[1])[None, None, None, :]  # [1,1,1,K]
+    causal = kv_pos <= q_positions[:, None, :, None]  # [B,1,Q,K]
+    valid = kv_pos < kv_lengths[:, None, None, None]
+    logits = jnp.where(causal & valid, logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        weights,
+        v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return out.astype(q.dtype)
+
+
+@partial(jax.jit, static_argnames=())
+def attend_decode_ref(
+    q: jnp.ndarray,  # [B, Hq, D] one new token per sequence
+    k_pages: jnp.ndarray,  # [Hkv, P, page, D] head-major paged pool (one layer)
+    v_pages: jnp.ndarray,  # [Hkv, P, page, D]
+    page_table: jnp.ndarray,  # [B, max_pages] page ids (padded arbitrarily)
+    lengths: jnp.ndarray,  # [B] context length incl. current token
+) -> jnp.ndarray:
+    """Gather-based paged decode attention — the numerics oracle for the
+    Pallas kernel and the CPU execution path."""
+    B, Hq, D = q.shape
+    Hkv, _, page, _ = k_pages.shape
+    max_ctx = page_table.shape[1] * page
+    # [Hkv, B, maxp, page, D] → token-major [B, ctx, Hkv, D].
+    k = k_pages[:, page_table].reshape(Hkv, B, max_ctx, D).transpose(1, 2, 0, 3)
+    v = v_pages[:, page_table].reshape(Hkv, B, max_ctx, D).transpose(1, 2, 0, 3)
+    k = _repeat_kv(k, Hq // Hkv)
+    v = _repeat_kv(v, Hq // Hkv)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
+    logits = (
+        jnp.einsum(
+            "bhd,bkhd->bhk",
+            q,
+            k,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        * scale
+    )
+    valid = jnp.arange(max_ctx)[None, None, :] < lengths[:, None, None]
+    logits = jnp.where(valid, logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhk,bkhd->bhd",
+        weights,
+        v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return out.astype(q.dtype)
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    use_kernel: bool | None = None,
+) -> jnp.ndarray:
+    """Decode attention over radix-cache pages. Dispatches to the Pallas
+    TPU kernel on TPU backends, the jnp reference elsewhere."""
+    if use_kernel is None:
+        use_kernel = jax.default_backend() not in ("cpu",)
+    if use_kernel:
+        from radixmesh_tpu.ops.paged_attention import paged_attention_kernel
+
+        return paged_attention_kernel(q, k_pages, v_pages, page_table, lengths)
+    return attend_decode_ref(q, k_pages, v_pages, page_table, lengths)

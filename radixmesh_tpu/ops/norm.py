@@ -1,0 +1,21 @@
+"""RMSNorm.
+
+The reference has no model/ops layer (SURVEY §1: "no model/ops layer");
+this is part of the serving stack the north star requires
+(BASELINE.json "north_star"). Computed in float32 regardless of input dtype
+— bf16 accumulation visibly degrades perplexity — and left un-fused: XLA
+fuses the normalize-scale chain into neighbouring ops better than a
+hand-written kernel would here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

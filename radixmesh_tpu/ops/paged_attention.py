@@ -1,0 +1,178 @@
+"""Pallas TPU decode kernel: attention over non-contiguous radix-cache pages.
+
+This is the op SURVEY §7 calls the hard part (a): the radix cache hands the
+scheduler a *page table* (page ids into the paged KV pool, arbitrary order,
+shared across requests that share a prefix), and decode attention must
+gather those pages without materializing a dense [B, max_ctx, H, D] copy in
+HBM — the copy is exactly the bandwidth decode can't afford.
+
+Design (one program per sequence, grid = (B,)):
+
+- The KV pool pages stay in HBM (``memory_space=ANY``); the page table and
+  sequence lengths ride scalar prefetch (SMEM) so the kernel can compute
+  DMA source addresses before the body runs.
+- Pages are DMA'd HBM→VMEM **double-buffered**: page ``i+1``'s copy is in
+  flight while page ``i`` is being contracted on the MXU.
+- Online softmax (running max / sum / weighted accumulator, fp32) across
+  the page loop, GQA via a [Hkv, G, D] query layout contracted against
+  each [page, Hkv, D] KV tile.
+- Per-sequence page counts bound the loop work: DMA start *and* wait are
+  predicated on the same ``page < n_pages(seq)`` condition (no hangs), and
+  out-of-range lanes are masked to -inf before the softmax update.
+
+The jnp oracle is ``ops/attention.py::attend_decode_ref``; numerics are
+compared in ``tests/test_ops.py`` (interpreter mode on CPU) and on real TPU
+by ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_attention_kernel"]
+
+
+def _kernel(
+    # scalar prefetch
+    page_table_ref,  # SMEM [B, max_pages]
+    lengths_ref,  # SMEM [B]
+    # inputs
+    q_ref,  # VMEM [1, Hq, D]
+    k_hbm,  # ANY  [Hkv, P, page, D]
+    v_hbm,  # ANY  [Hkv, P, page, D]
+    # outputs
+    o_ref,  # VMEM [1, Hq, D]
+    # scratch
+    k_buf,  # VMEM [2, Hkv, page, D]
+    v_buf,  # VMEM [2, Hkv, page, D]
+    sem,  # DMA [2, 2]
+    *,
+    page: int,
+    n_kv_heads: int,
+    max_pages: int,
+):
+    b = pl.program_id(0)
+    n = lengths_ref[b]
+    n_pages = pl.cdiv(n, page)
+    hq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    g = hq // n_kv_heads
+
+    scale = 1.0 / (d ** 0.5)
+    # [Hkv, G, D] query layout so one einsum covers all GQA groups.
+    q = (q_ref[0].astype(jnp.float32) * scale).reshape(n_kv_heads, g, d)
+
+    def dma(buf_ref, hbm_ref, slot, page_idx, which):
+        return pltpu.make_async_copy(
+            hbm_ref.at[:, page_table_ref[b, page_idx]],
+            buf_ref.at[slot],
+            sem.at[which, slot],
+        )
+
+    @pl.when(n_pages > 0)
+    def _():
+        dma(k_buf, k_hbm, 0, 0, 0).start()
+        dma(v_buf, v_hbm, 0, 0, 1).start()
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+        next_slot = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            dma(k_buf, k_hbm, next_slot, i + 1, 0).start()
+            dma(v_buf, v_hbm, next_slot, i + 1, 1).start()
+
+        @pl.when(i < n_pages)
+        def _():
+            dma(k_buf, k_hbm, slot, i, 0).wait()
+            dma(v_buf, v_hbm, slot, i, 1).wait()
+
+        k = k_buf[slot].astype(jnp.float32)  # [Hkv, page, D]
+        v = v_buf[slot].astype(jnp.float32)
+        # [Hkv, G, page] scores on the MXU (batch dim 0 on both operands —
+        # Mosaic requires batch dims in matching positions).
+        s = jax.lax.dot_general(
+            q,
+            k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        pos = i * page + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page), 2)
+        s = jnp.where(pos < n, s, -jnp.inf)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)  # [Hkv, G, page]
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # [Hkv, G, D] accumulator update.
+        pv = jax.lax.dot_general(
+            p,
+            v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * corr + pv
+        valid = i < n_pages
+        return (
+            jnp.where(valid, m_new, m),
+            jnp.where(valid, l_new, l),
+            jnp.where(valid, acc_new, acc),
+        )
+
+    m0 = jnp.full((n_kv_heads, g, 1), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((n_kv_heads, g, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((n_kv_heads, g, d), dtype=jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, max_pages, body, (m0, l0, acc0))
+    out = (acc / l).reshape(hq, d)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_kernel(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [Hkv, P, page, D] head-major (PagedKVPool.pages_for_layer)
+    v_pages: jnp.ndarray,  # [Hkv, P, page, D]
+    page_table: jnp.ndarray,  # [B, max_pages] int32
+    lengths: jnp.ndarray,  # [B] int32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    Hkv, _, page, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    kernel = functools.partial(
+        _kernel, page=page, n_kv_heads=Hkv, max_pages=max_pages
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, Hkv, page, D), k_pages.dtype),
+            pltpu.VMEM((2, Hkv, page, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(page_table, dtype=jnp.int32),
+        jnp.asarray(lengths, dtype=jnp.int32),
+        q,
+        k_pages,
+        v_pages,
+    )

@@ -1,0 +1,63 @@
+"""Rotary position embeddings (RoPE) with Llama-3 frequency scaling.
+
+Position-dependent but cache-friendly: K is stored in the paged KV pool
+*already rotated* (rotation depends only on the token's absolute position,
+which is immutable for a cached prefix — this is what makes radix prefix
+reuse sound for RoPE models).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 500000.0,
+    llama3_scaling: dict | None = None,
+) -> jnp.ndarray:
+    """Inverse frequencies [head_dim // 2], optionally with the Llama-3.x
+    long-context NTK-by-parts rescale (factor/low_freq/high_freq/original
+    context length)."""
+    inv = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if llama3_scaling:
+        factor = llama3_scaling.get("factor", 8.0)
+        low = llama3_scaling.get("low_freq_factor", 1.0)
+        high = llama3_scaling.get("high_freq_factor", 4.0)
+        orig = llama3_scaling.get("original_max_position_embeddings", 8192)
+        wavelen = 2.0 * jnp.pi / inv
+        low_bound = orig / low
+        high_bound = orig / high
+        smooth = (orig / wavelen - low) / (high - low)
+        scaled = jnp.where(
+            wavelen > low_bound,
+            inv / factor,
+            jnp.where(
+                wavelen < high_bound,
+                inv,
+                (1.0 - smooth) * inv / factor + smooth * inv,
+            ),
+        )
+        inv = scaled
+    return inv
+
+
+@partial(jax.jit, static_argnames=())
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate ``x`` ([..., seq, heads, head_dim]) by absolute ``positions``
+    ([..., seq]). Uses the interleaved-half convention (rotate_half), fp32
+    internally."""
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq[None, :]
+    cos = jnp.cos(angles)[..., :, None, :]  # [..., seq, 1, dim/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,48 @@
+"""Token sampling: greedy / temperature / top-k / top-p, fully jittable
+(static control flow; masking instead of data-dependent branches)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("top_k",))
+def sample_tokens(
+    logits: jnp.ndarray,
+    rng: jax.Array,
+    temperature: float | jnp.ndarray = 0.0,
+    top_k: int = 0,
+    top_p: float | jnp.ndarray = 1.0,
+) -> jnp.ndarray:
+    """Sample one token id per row of ``logits`` [..., vocab].
+
+    ``temperature==0`` → greedy. ``top_k``/``top_p`` filter before the
+    categorical draw. All paths execute; selection is by ``jnp.where`` so a
+    single compiled executable serves every setting of the dynamic args.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.maximum(jnp.asarray(temperature, dtype=jnp.float32), 1e-6)
+    scaled = logits.astype(jnp.float32) / t
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # top-p (nucleus): keep the smallest set of tokens with cumulative
+    # probability >= top_p, always including the argmax.
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_mask = cum - probs >= jnp.asarray(top_p, dtype=jnp.float32)
+    sorted_filtered = jnp.where(cutoff_mask, -jnp.inf, sorted_logits)
+    # Map the per-row threshold back to the unsorted logits.
+    threshold = jnp.min(
+        jnp.where(jnp.isfinite(sorted_filtered), sorted_filtered, jnp.inf),
+        axis=-1,
+        keepdims=True,
+    )
+    filtered = jnp.where(scaled < threshold, -jnp.inf, scaled)
+    sampled = jax.random.categorical(rng, filtered, axis=-1)
+    use_greedy = jnp.asarray(temperature, dtype=jnp.float32) <= 0.0
+    return jnp.where(use_greedy, greedy, sampled)

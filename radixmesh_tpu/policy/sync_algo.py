@@ -63,6 +63,11 @@ class BaseSyncAlgo(abc.ABC):
     def can_tick(self, cfg: MeshConfig) -> bool: ...
 
     @abc.abstractmethod
+    def tick_origin_rank(self, cfg: MeshConfig) -> int:
+        """Global rank of the node that originates heartbeat ticks — the
+        rank every node's startup barrier watches for."""
+
+    @abc.abstractmethod
     def data_ttl(self, cfg: MeshConfig) -> int: ...
 
     @abc.abstractmethod

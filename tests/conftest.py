@@ -1,17 +1,24 @@
-"""Test harness config: force CPU jax with an 8-device virtual mesh.
+"""Test harness config: force CPU jax with an 8-device virtual platform.
 
 This mirrors the reference's multi-node-without-a-cluster strategy
 (``correctness.py:22-29`` runs 6 localhost processes): correctness gates run
-on CPU so they're cheap; TPU-only paths (Pallas compiled kernels) are
+on CPU so they're cheap; TPU-only paths (compiled Pallas kernels) are
 exercised by ``bench.py`` on real hardware.
+
+NOTE: this environment pins ``JAX_PLATFORMS=axon`` (a TPU tunnel plugin)
+and re-asserts it at interpreter startup, so the env var alone does NOT
+switch the backend — ``jax.config.update`` is required.
 """
 
 import os
 
-# Must run before the first `import jax` anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -221,6 +221,25 @@ class TestDistributedGC:
         # Winner's copy is intact.
         assert all(v.rank == 0 for v in loser.match_prefix(key).values)
 
+    def test_relosing_reinsert_frees_previous_loser_slots(self, cluster):
+        # A losing writer that recomputes KV and re-inserts must not leak
+        # its first copy: the superseded dup entry's slots return to the
+        # pool immediately (they are referenced by neither tree nor GC).
+        key = [2, 2, 2]
+        winner, loser = cluster.node(0), cluster.node(2)
+        insert_with_pool(winner, key)
+        first = insert_with_pool(loser, key)
+        nk = NodeKey(key, loser.rank)
+        assert wait_for(lambda: nk in loser.dup_nodes)
+        free_before = loser.pool.free_slots
+        second = loser.pool.alloc(len(key))
+        loser.insert(key, second)
+        assert loser.pool.free_slots == free_before  # first copy freed, second taken
+        stored = loser.dup_nodes[nk]
+        np.testing.assert_array_equal(stored.indices, second)
+        assert isinstance(stored, PrefillValue)
+        del first
+
     def test_gc_refused_while_any_node_holds_lock(self, cluster):
         key = [4, 4, 4]
         winner, loser = cluster.node(0), cluster.node(1)

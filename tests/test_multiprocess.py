@@ -38,8 +38,11 @@ def _wait_for(pred, timeout=15.0):
 
 
 def _worker(local_addr, prefill, decode, router, barrier, errq):
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
     try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
         import numpy as np
 
         from radixmesh_tpu.cache.mesh_cache import MeshCache

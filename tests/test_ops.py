@@ -1,0 +1,209 @@
+"""Numerics tests for the TPU compute ops (CPU jax; the Pallas kernel runs
+in interpreter mode here and compiled on real TPU via bench.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.ops.attention import attend_decode_ref, attend_prefill
+from radixmesh_tpu.ops.norm import rms_norm
+from radixmesh_tpu.ops.paged_attention import paged_attention_kernel
+from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
+from radixmesh_tpu.ops.sampling import sample_tokens
+
+
+class TestRmsNorm:
+    def test_matches_manual(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
+        w = jax.random.normal(jax.random.PRNGKey(1), (16,))
+        got = rms_norm(x, w)
+        want = x / np.sqrt(np.mean(np.square(x), axis=-1, keepdims=True) + 1e-5) * w
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+    def test_bf16_computes_in_fp32(self):
+        x = (jax.random.normal(jax.random.PRNGKey(0), (4, 64)) * 100).astype(
+            jnp.bfloat16
+        )
+        w = jnp.ones((64,), dtype=jnp.bfloat16)
+        got = rms_norm(x, w)
+        assert got.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(got.astype(jnp.float32))))
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        inv = rope_frequencies(64)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 10, 4, 64))
+        pos = jnp.arange(10)[None, :]
+        y = apply_rope(x, pos, inv)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_position_zero_is_identity(self):
+        inv = rope_frequencies(32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 2, 32))
+        y = apply_rope(x, jnp.zeros((1, 1), dtype=jnp.int32), inv)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+
+    def test_relative_property(self):
+        # <R(p)q, R(p+k)x> depends only on k: shift both positions, dot
+        # products are unchanged.
+        inv = rope_frequencies(64)
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 1, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, 64))
+        def dot_at(p0, p1):
+            qr = apply_rope(q, jnp.array([[p0]]), inv)
+            kr = apply_rope(k, jnp.array([[p1]]), inv)
+            return float(jnp.sum(qr * kr))
+        assert dot_at(3, 7) == pytest.approx(dot_at(103, 107), rel=1e-4)
+
+    def test_llama3_scaling_changes_low_freqs(self):
+        base = rope_frequencies(128)
+        scaled = rope_frequencies(
+            128,
+            llama3_scaling={
+                "factor": 8.0,
+                "low_freq_factor": 1.0,
+                "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 8192,
+            },
+        )
+        # High-frequency (early) components unchanged, low-frequency scaled.
+        np.testing.assert_allclose(np.asarray(base[:8]), np.asarray(scaled[:8]))
+        assert np.all(np.asarray(scaled[-8:]) < np.asarray(base[-8:]))
+
+
+class TestPrefillAttention:
+    def test_causal_first_token_attends_self_only(self):
+        B, S, H, D = 1, 4, 2, 8
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D))
+        pos = jnp.arange(S)[None, :]
+        out = attend_prefill(q, k, v, pos, jnp.array([S]))
+        np.testing.assert_allclose(
+            np.asarray(out[0, 0]), np.asarray(v[0, 0]), rtol=1e-5
+        )
+
+    def test_prefix_continuation_matches_full_prefill(self):
+        # Attention over [prefix + new] computed in one shot must equal
+        # prefill of the new chunk against cached prefix KV — the equality
+        # that makes radix prefix reuse exact.
+        B, S, H, D = 1, 8, 2, 16
+        n_prefix = 5
+        rng = jax.random.PRNGKey(0)
+        q = jax.random.normal(rng, (B, S, H, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D))
+        pos = jnp.arange(S)[None, :]
+        full = attend_prefill(q, k, v, pos, jnp.array([S]))
+        cont = attend_prefill(
+            q[:, n_prefix:], k, v, pos[:, n_prefix:], jnp.array([S])
+        )
+        np.testing.assert_allclose(
+            np.asarray(full[:, n_prefix:]), np.asarray(cont), rtol=2e-5, atol=1e-5
+        )
+
+    def test_gqa_grouping(self):
+        # 4 q heads over 2 kv heads == repeating kv to 4 heads.
+        B, S, D = 1, 6, 8
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, 4, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, S, 2, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, S, 2, D))
+        pos = jnp.arange(S)[None, :]
+        got = attend_prefill(q, k, v, pos, jnp.array([S]))
+        krep = jnp.repeat(k, 2, axis=2)
+        vrep = jnp.repeat(v, 2, axis=2)
+        want = attend_prefill(q, krep, vrep, pos, jnp.array([S]))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def _paged_setup(key, B=3, Hq=8, Hkv=2, D=32, page=8, n_pages_pool=16, max_pages=4):
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (B, Hq, D), dtype=jnp.float32)
+    # Head-major pool layout (PagedKVPool.pages_for_layer).
+    k_pages = jax.random.normal(ks[1], (Hkv, n_pages_pool, page, D), dtype=jnp.float32)
+    v_pages = jax.random.normal(ks[2], (Hkv, n_pages_pool, page, D), dtype=jnp.float32)
+    # Non-contiguous, per-sequence page tables.
+    page_table = jax.random.permutation(ks[3], n_pages_pool)[: B * max_pages].reshape(
+        B, max_pages
+    )
+    lengths = jnp.array([1, page + 3, page * max_pages])[:B]
+    return q, k_pages, v_pages, page_table.astype(jnp.int32), lengths.astype(jnp.int32)
+
+
+class TestPagedAttention:
+    def test_kernel_matches_reference(self):
+        args = _paged_setup(jax.random.PRNGKey(0))
+        want = attend_decode_ref(*args)
+        got = paged_attention_kernel(*args, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    def test_kernel_matches_reference_bf16(self):
+        q, kp, vp, pt, ln = _paged_setup(jax.random.PRNGKey(7))
+        q, kp, vp = (x.astype(jnp.bfloat16) for x in (q, kp, vp))
+        want = attend_decode_ref(q, kp, vp, pt, ln).astype(jnp.float32)
+        got = paged_attention_kernel(q, kp, vp, pt, ln, interpret=True).astype(
+            jnp.float32
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=3e-2, atol=3e-2)
+
+    def test_single_token_context(self):
+        q, kp, vp, pt, ln = _paged_setup(jax.random.PRNGKey(1), B=1)
+        ln = jnp.array([1], dtype=jnp.int32)
+        want = attend_decode_ref(q, kp, vp, pt, ln)
+        got = paged_attention_kernel(q, kp, vp, pt, ln, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    def test_kernel_reads_the_page_table(self):
+        # Attention is permutation-invariant over its KV set (positions are
+        # baked into K via RoPE), so page *order* must NOT change the output
+        # — but substituting a different page must.
+        q, kp, vp, pt, ln = _paged_setup(jax.random.PRNGKey(2), B=1)
+        ln = jnp.array([32], dtype=jnp.int32)
+        base = paged_attention_kernel(q, kp, vp, pt, ln, interpret=True)
+        swapped = pt.at[0, 0].set(pt[0, 1]).at[0, 1].set(pt[0, 0])
+        perm = paged_attention_kernel(q, kp, vp, swapped, ln, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(base), np.asarray(perm), rtol=2e-5, atol=2e-5
+        )
+        unused = [p for p in range(kp.shape[1]) if p not in np.asarray(pt[0])][0]
+        substituted = pt.at[0, 1].set(unused)
+        other = paged_attention_kernel(q, kp, vp, substituted, ln, interpret=True)
+        assert not np.allclose(np.asarray(base), np.asarray(other))
+
+
+class TestSampling:
+    def test_greedy(self):
+        logits = jnp.array([[0.1, 5.0, 0.2], [3.0, 0.0, 0.1]])
+        out = sample_tokens(logits, jax.random.PRNGKey(0), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(out), [1, 0])
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.array([[10.0, 9.0, -5.0, -6.0]])
+        draws = [
+            int(sample_tokens(logits, jax.random.PRNGKey(i), temperature=1.0, top_k=2)[0])
+            for i in range(50)
+        ]
+        assert set(draws) <= {0, 1}
+        assert len(set(draws)) == 2  # actually samples, not greedy
+
+    def test_top_p_keeps_argmax(self):
+        logits = jnp.array([[100.0, 0.0, 0.0, 0.0]])
+        out = sample_tokens(
+            logits, jax.random.PRNGKey(0), temperature=1.0, top_p=0.1
+        )
+        assert int(out[0]) == 0
+
+    def test_temperature_flattens(self):
+        logits = jnp.array([[2.0, 1.0]])
+        hot = [
+            int(sample_tokens(logits, jax.random.PRNGKey(i), temperature=10.0)[0])
+            for i in range(200)
+        ]
+        # At high temperature both tokens appear frequently.
+        assert min(hot.count(0), hot.count(1)) > 30
