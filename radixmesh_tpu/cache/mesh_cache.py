@@ -194,8 +194,7 @@ class MeshCache:
         return False
 
     def close(self) -> None:
-        self._stop.set()
-        self._out_q.put(None)  # wake the sender thread
+        self._stop.set()  # sender thread polls _stop; no sentinel needed
         for t in self._threads:
             t.join(timeout=2)
         if self._comm is not None:
@@ -382,11 +381,14 @@ class MeshCache:
     def _sender(self) -> None:
         """Dedicated transmit thread: the only place the control plane
         touches the network, so a slow/unreachable successor can never
-        stall tree operations."""
-        while True:
-            data = self._out_q.get()
-            if data is None or self._stop.is_set():
-                return
+        stall tree operations. Polls with a timeout instead of a queue
+        sentinel: close() on a *full* queue must not need to enqueue
+        anything to stop this thread."""
+        while not self._stop.is_set():
+            try:
+                data = self._out_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
             try:
                 self._comm.send(data)
                 if self.rank == self.sync.master_rank(self.cfg):
@@ -400,9 +402,6 @@ class MeshCache:
     # ------------------------------------------------------------------
     # tree mutation with conflict resolution
     # ------------------------------------------------------------------
-
-    def _values_conflict(self, existing, new) -> bool:
-        return existing.rank != new.rank
 
     def _mesh_insert(self, key: np.ndarray, value) -> int:
         """Insert with rank-conflict resolution via the tree's conflict

@@ -218,28 +218,44 @@ struct RmSender {
   std::deque<std::vector<uint8_t>> queue;
   uint64_t queued_bytes = 0;
   std::thread send_thread;
-  std::atomic<int> fd{-1};
+  std::atomic<bool> done{false};
+  // fd_mu guards the fd's lifecycle so rm_sender_close's shutdown() can
+  // never race drop_connection()'s close() onto a reused descriptor.
+  std::mutex fd_mu;
+  int fd = -1;
 
   bool ensure_connected() {
-    if (fd.load() >= 0) return true;
-    fd.store(connect_to(host, port));
-    connected.store(fd.load() >= 0);
-    return fd.load() >= 0;
+    {
+      std::lock_guard<std::mutex> lk(fd_mu);
+      if (fd >= 0) return true;
+    }
+    int f = connect_to(host, port);
+    std::lock_guard<std::mutex> lk(fd_mu);
+    fd = f;
+    connected.store(fd >= 0);
+    return fd >= 0;
   }
 
   void drop_connection() {
-    int f = fd.exchange(-1);
-    if (f >= 0) close(f);
+    std::lock_guard<std::mutex> lk(fd_mu);
+    if (fd >= 0) close(fd);
+    fd = -1;
     connected.store(false);
+  }
+
+  void shutdown_fd() {
+    std::lock_guard<std::mutex> lk(fd_mu);
+    if (fd >= 0) shutdown(fd, SHUT_RDWR);
   }
 
   void run() {
     while (true) {
+      if (stopping.load() && queue.empty()) { done.store(true); return; }
       std::vector<uint8_t> msg;
       {
         std::unique_lock<std::mutex> lk(mu);
         cv_pop.wait(lk, [this] { return stopping.load() || !queue.empty(); });
-        if (stopping.load() && queue.empty()) return;
+        if (stopping.load() && queue.empty()) { lk.unlock(); done.store(true); return; }
         msg = std::move(queue.front());
         queue.pop_front();
         queued_bytes -= msg.size();
@@ -258,11 +274,17 @@ struct RmSender {
       // changes) is the cure for, not frame loss.
       while (!stopping.load()) {
         while (!ensure_connected()) {
-          if (stopping.load()) return;
+          if (stopping.load()) { done.store(true); return; }
           std::this_thread::sleep_for(std::chrono::milliseconds(kConnectRetryMs));
         }
-        int f = fd.load();
-        if (send_all(f, hdr, 4) && send_all(f, msg.data(), msg.size())) break;
+        if (stopping.load()) break;  // close() may have fired mid-reconnect
+        int f;
+        {
+          std::lock_guard<std::mutex> lk(fd_mu);
+          f = fd;
+        }
+        if (f >= 0 && send_all(f, hdr, 4) && send_all(f, msg.data(), msg.size()))
+          break;
         drop_connection();
       }
     }
@@ -318,10 +340,11 @@ void rm_sender_close(void* handle) {
   s->cv_push.notify_all();
   // Unblock a send_all() stalled on a wedged peer (full TCP buffer):
   // shutdown makes the in-flight ::send fail immediately so the thread can
-  // observe `stopping` — without this, join() can hang for minutes.
-  {
-    int f = s->fd.load();
-    if (f >= 0) shutdown(f, SHUT_RDWR);
+  // observe `stopping`. Retried because the sender may be mid-reconnect at
+  // the moment of the first shutdown (fd == -1) and connect afterwards.
+  for (int i = 0; i < 500 && !s->done.load(); ++i) {
+    s->shutdown_fd();
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
   }
   if (s->send_thread.joinable()) s->send_thread.join();
   s->drop_connection();

@@ -1,0 +1,37 @@
+from radixmesh_tpu.models.llama import (
+    ModelConfig,
+    init_params,
+    prefill_forward,
+    decode_step,
+    param_logical_axes,
+    convert_hf_state_dict,
+)
+from radixmesh_tpu.models import qwen2  # noqa: F401  (registers presets)
+
+_PRESETS = {
+    "llama3-8b": ModelConfig.llama3_8b,
+    "llama3-tiny": ModelConfig.tiny,
+    "qwen2-72b": qwen2.qwen2_72b,
+    "qwen2-7b": qwen2.qwen2_7b,
+    "qwen2-tiny": qwen2.qwen2_tiny,
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    """Model registry: named presets for the BASELINE.json target configs."""
+    try:
+        cfg = _PRESETS[name]()
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; known: {sorted(_PRESETS)}")
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "prefill_forward",
+    "decode_step",
+    "param_logical_axes",
+    "convert_hf_state_dict",
+    "get_config",
+]

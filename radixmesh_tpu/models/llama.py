@@ -1,0 +1,392 @@
+"""Llama-3-family transformer, pure functional JAX (no flax/nnx).
+
+The reference is cache-only — "There is no model, no attention kernel, no
+scheduler" (SURVEY, verified over all 37 files) — so this module implements
+the serving stack's model side that the north star requires
+(``BASELINE.json``: Llama-3-8B on v5e, Qwen2-72B 32k on v5p). Design:
+
+- **Params are a flat pytree** with per-layer tensors stacked on a leading
+  layer axis, consumed by ``lax.scan`` — one traced layer body instead of
+  ``n_layers`` copies, which keeps XLA compile time flat in depth and makes
+  layer-sharded (pp) layouts a reshape away.
+- **Two entry points**: ``prefill_forward`` (new tokens attend to an
+  optional cached prefix — the radix-cache reuse path) and ``decode_step``
+  (one token per sequence; writes K/V into the paged pool *inside* the scan
+  and attends via the Pallas paged kernel on TPU). Everything under one
+  ``jit`` per call; the KV pool array is donated so decode updates HBM in
+  place.
+- **Sharding-ready**: ``param_logical_axes`` names every axis logically
+  ("embed", "q_heads", "kv_heads", "ffn", "vocab"); ``parallel/sharding.py``
+  maps logical names to mesh axes (tp/dp/...) so the same model code runs
+  single-chip or pjit-sharded.
+- Qwen2 is the same architecture with QKV biases and its own dims
+  (``models/qwen2.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from radixmesh_tpu.ops.attention import attend_prefill, paged_attention
+from radixmesh_tpu.ops.norm import rms_norm
+from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "prefill_forward",
+    "decode_step",
+    "param_logical_axes",
+    "convert_hf_state_dict",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 128256
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate: int = 14336
+    rope_theta: float = 500000.0
+    # Tuple of (key, value) pairs, not a dict: ModelConfig is a jit-static
+    # argument and must hash.
+    rope_scaling: tuple | None = None
+    rms_eps: float = 1e-5
+    qkv_bias: bool = False  # True for Qwen2
+    tie_embeddings: bool = False
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def llama3_8b(cls) -> "ModelConfig":
+        return cls(
+            rope_scaling=(
+                ("factor", 8.0),
+                ("low_freq_factor", 1.0),
+                ("high_freq_factor", 4.0),
+                ("original_max_position_embeddings", 8192),
+            )
+        )
+
+    @classmethod
+    def tiny(cls) -> "ModelConfig":
+        """Test/bench config: same architecture, toy dims."""
+        return cls(
+            vocab_size=512,
+            hidden=128,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            head_dim=32,
+            intermediate=256,
+            max_seq_len=512,
+        )
+
+
+def _dense_init(key, shape, in_axis_size, dtype):
+    scale = 1.0 / np.sqrt(in_axis_size)
+    return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
+    keys = jax.random.split(key, 8)
+    L, H = cfg.n_layers, cfg.hidden
+    qd, kvd = cfg.n_heads * cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
+    params = {
+        "embed": _dense_init(keys[0], (cfg.vocab_size, H), H, cfg.dtype),
+        "final_norm": jnp.ones((H,), dtype=cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), dtype=cfg.dtype),
+            "mlp_norm": jnp.ones((L, H), dtype=cfg.dtype),
+            "wq": _dense_init(keys[1], (L, H, qd), H, cfg.dtype),
+            "wk": _dense_init(keys[2], (L, H, kvd), H, cfg.dtype),
+            "wv": _dense_init(keys[3], (L, H, kvd), H, cfg.dtype),
+            "wo": _dense_init(keys[4], (L, qd, H), qd, cfg.dtype),
+            "w_gate": _dense_init(keys[5], (L, H, cfg.intermediate), H, cfg.dtype),
+            "w_up": _dense_init(keys[6], (L, H, cfg.intermediate), H, cfg.dtype),
+            "w_down": _dense_init(
+                keys[7], (L, cfg.intermediate, H), cfg.intermediate, cfg.dtype
+            ),
+        },
+    }
+    if cfg.qkv_bias:
+        params["layers"]["bq"] = jnp.zeros((L, qd), dtype=cfg.dtype)
+        params["layers"]["bk"] = jnp.zeros((L, kvd), dtype=cfg.dtype)
+        params["layers"]["bv"] = jnp.zeros((L, kvd), dtype=cfg.dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(
+            jax.random.fold_in(key, 99), (H, cfg.vocab_size), H, cfg.dtype
+        )
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> dict:
+    """Logical axis names per parameter, mapped to mesh axes by
+    ``parallel/sharding.py`` (tp shards "q_heads"/"kv_heads"/"ffn"/"vocab",
+    everything else replicates)."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "final_norm": ("embed",),
+        "layers": {
+            "attn_norm": ("layer", "embed"),
+            "mlp_norm": ("layer", "embed"),
+            "wq": ("layer", "embed", "q_heads"),
+            "wk": ("layer", "embed", "kv_heads"),
+            "wv": ("layer", "embed", "kv_heads"),
+            "wo": ("layer", "q_heads", "embed"),
+            "w_gate": ("layer", "embed", "ffn"),
+            "w_up": ("layer", "embed", "ffn"),
+            "w_down": ("layer", "ffn", "embed"),
+        },
+    }
+    if cfg.qkv_bias:
+        axes["layers"]["bq"] = ("layer", "q_heads")
+        axes["layers"]["bk"] = ("layer", "kv_heads")
+        axes["layers"]["bv"] = ("layer", "kv_heads")
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# fp32 inputs on TPU are otherwise demoted to one-pass bf16 multiplies;
+# HIGHEST makes fp32 honest and is a no-op for bf16 operands.
+_PREC = jax.lax.Precision.HIGHEST
+
+
+def _qkv(lp: dict, x: jnp.ndarray, cfg: ModelConfig):
+    """x: [B, S, H] → q [B,S,Hq,D], k/v [B,S,Hkv,D]."""
+    q = jnp.einsum("bsh,hd->bsd", x, lp["wq"], precision=_PREC)
+    k = jnp.einsum("bsh,hd->bsd", x, lp["wk"], precision=_PREC)
+    v = jnp.einsum("bsh,hd->bsd", x, lp["wv"], precision=_PREC)
+    if cfg.qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    B, S = x.shape[:2]
+    return (
+        q.reshape(B, S, cfg.n_heads, cfg.head_dim),
+        k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim),
+        v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim),
+    )
+
+
+def _mlp(lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+    gate = jax.nn.silu(jnp.einsum("bsh,hi->bsi", x, lp["w_gate"], precision=_PREC))
+    up = jnp.einsum("bsh,hi->bsi", x, lp["w_up"], precision=_PREC)
+    return jnp.einsum("bsi,ih->bsh", gate * up, lp["w_down"], precision=_PREC)
+
+
+def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum(
+        "bsh,hv->bsv", x, head, preferred_element_type=jnp.float32, precision=_PREC
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def prefill_forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, S_new]
+    positions: jnp.ndarray,  # [B, S_new] absolute positions
+    cached_k: jnp.ndarray,  # [L, B, P_max, Hkv, D] rotated prefix K, RIGHT-aligned
+    cached_v: jnp.ndarray,  # [L, B, P_max, Hkv, D]
+    prefix_lengths: jnp.ndarray,  # [B] valid cached-prefix tokens (≤ P_max)
+):
+    """Prefill new tokens against an optional cached prefix.
+
+    Ragged prefixes are **right-aligned** in the ``P_max`` prefix region
+    (row ``b`` occupies ``[P_max - prefix_lengths[b], P_max)``); the front
+    padding is masked via ``kv_start``, so batched prefill with different
+    hit lengths is exact. Pass ``P_max = 0`` arrays for no cache.
+
+    Returns ``(logits [B,S,V], new_k [L,B,S,Hkv,D], new_v [...])`` — the
+    caller writes new_k/new_v into the paged pool at the slots the radix
+    tree allocated, which is how a served prompt becomes a reusable cached
+    prefix (the contract the reference's commented-out scheduler hooks
+    sketch, ``radix_cache.py:439-519``).
+    """
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens]
+    p_max = cached_k.shape[2]
+    s_new = tokens.shape[1]
+    pad = p_max - prefix_lengths  # [B] front padding per row
+    # Index-space position of query t (abs position p) inside the context
+    # buffer [pad | prefix | new]: p + pad.
+    attn_pos = positions + pad[:, None]
+    kv_end = jnp.full_like(prefix_lengths, p_max + s_new)
+
+    def layer(x, xs):
+        lp, ck, cv = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(lp, h, cfg)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        k_ctx = jnp.concatenate([ck, k], axis=1)  # [B, P_max + S, Hkv, D]
+        v_ctx = jnp.concatenate([cv, v], axis=1)
+        attn = attend_prefill(q, k_ctx, v_ctx, attn_pos, kv_end, kv_start=pad)
+        x = x + jnp.einsum(
+            "bsqd,qdh->bsh",
+            attn.reshape(attn.shape[0], attn.shape[1], cfg.n_heads, cfg.head_dim),
+            lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
+            precision=_PREC,
+        )
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(lp, h2)
+        return x, (k, v)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cached_k, cached_v)
+    )
+    return _logits(params, cfg, x), new_k, new_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "page_size"), donate_argnums=(3,))
+def decode_step(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] current token per sequence
+    kv_pool: jnp.ndarray,  # [2, L, Hkv, num_slots, D] (donated)
+    slots: jnp.ndarray,  # [B] pool slot for this token's KV
+    page_table: jnp.ndarray,  # [B, max_pages]
+    lengths: jnp.ndarray,  # [B] context length incl. this token
+    page_size: int = 16,
+):
+    """One decode step for a continuous batch: writes this token's K/V into
+    the paged pool inside the layer scan, attends over the radix-cache
+    pages (Pallas kernel on TPU), returns ``(logits [B,V], kv_pool)``.
+
+    ``page_size`` is a property of the pool/page-table pairing (static so
+    the pages view is a pure reshape)."""
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    positions = lengths - 1  # [B]
+    x = params["embed"][tokens][:, None, :]  # [B, 1, H]
+    B = tokens.shape[0]
+    num_slots = kv_pool.shape[3]
+    pages_shape = (cfg.n_kv_heads, num_slots // page_size, page_size, cfg.head_dim)
+
+    def layer(carry, xs):
+        x, kv_pool = carry
+        l_idx, lp = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(lp, h, cfg)  # [B,1,*,D]
+        q = apply_rope(q, positions[:, None], inv_freq)
+        k = apply_rope(k, positions[:, None], inv_freq)
+        # This layer's pool slice, updated with the new token's K/V at
+        # `slots` (head-major: [2, Hkv, num_slots, D]).
+        new_kv = jnp.stack(
+            [k[:, 0].transpose(1, 0, 2), v[:, 0].transpose(1, 0, 2)]
+        ).astype(kv_pool.dtype)  # [2, Hkv, B, D]
+        layer_kv = kv_pool[:, l_idx].at[:, :, slots].set(new_kv)
+        kv_pool = kv_pool.at[:, l_idx].set(layer_kv)
+        attn = paged_attention(
+            q[:, 0],
+            layer_kv[0].reshape(pages_shape),
+            layer_kv[1].reshape(pages_shape),
+            page_table,
+            lengths,
+        )
+        x = x + jnp.einsum(
+            "bqd,qdh->bh",
+            attn.reshape(B, cfg.n_heads, cfg.head_dim),
+            lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
+            precision=_PREC,
+        )[:, None, :]
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(lp, h2)
+        return (x, kv_pool), None
+
+    (x, kv_pool), _ = jax.lax.scan(
+        layer, (x, kv_pool), (jnp.arange(cfg.n_layers), params["layers"])
+    )
+    return _logits(params, cfg, x)[:, 0], kv_pool
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint conversion
+# ---------------------------------------------------------------------------
+
+
+def convert_hf_state_dict(cfg: ModelConfig, state: dict) -> dict:
+    """Map a HuggingFace Llama/Qwen2 state dict (numpy arrays, HF names)
+    into this module's stacked-layer param pytree.
+
+    Accepts ``model.layers.{i}.self_attn.q_proj.weight`` etc. (HF stores
+    ``[out, in]``; we store ``[in, out]`` so every projection is applied as
+    ``x @ W``).
+    """
+    L = cfg.n_layers
+
+    def get(name):
+        return np.asarray(state[name])
+
+    def proj(name_fmt):
+        return jnp.stack(
+            [
+                jnp.asarray(get(name_fmt.format(i)).T, dtype=cfg.dtype)
+                for i in range(L)
+            ]
+        )
+
+    params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=cfg.dtype),
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype=cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.stack(
+                [
+                    jnp.asarray(
+                        get(f"model.layers.{i}.input_layernorm.weight"),
+                        dtype=cfg.dtype,
+                    )
+                    for i in range(L)
+                ]
+            ),
+            "mlp_norm": jnp.stack(
+                [
+                    jnp.asarray(
+                        get(f"model.layers.{i}.post_attention_layernorm.weight"),
+                        dtype=cfg.dtype,
+                    )
+                    for i in range(L)
+                ]
+            ),
+            "wq": proj("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": proj("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": proj("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": proj("model.layers.{}.self_attn.o_proj.weight"),
+            "w_gate": proj("model.layers.{}.mlp.gate_proj.weight"),
+            "w_up": proj("model.layers.{}.mlp.up_proj.weight"),
+            "w_down": proj("model.layers.{}.mlp.down_proj.weight"),
+        },
+    }
+    if cfg.qkv_bias:
+        for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
+            params["layers"][ours] = jnp.stack(
+                [
+                    jnp.asarray(
+                        get(f"model.layers.{i}.self_attn.{theirs}.bias"),
+                        dtype=cfg.dtype,
+                    )
+                    for i in range(L)
+                ]
+            )
+    if cfg.tie_embeddings:
+        pass
+    elif "lm_head.weight" in state:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype=cfg.dtype)
+    else:
+        params["lm_head"] = params["embed"].T
+    return params

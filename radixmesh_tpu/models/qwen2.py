@@ -1,0 +1,56 @@
+"""Qwen2 model family: architecturally Llama with QKV biases and its own
+dimensions, so the forward/param machinery is ``models/llama.py`` reused
+verbatim — only the configs differ. Target config Qwen2-72B @ 32k context
+is the BASELINE.json v5p-64 scale-out gate."""
+
+from __future__ import annotations
+
+from radixmesh_tpu.models.llama import ModelConfig
+
+
+def qwen2_72b() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=152064,
+        hidden=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        head_dim=128,
+        intermediate=29568,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        qkv_bias=True,
+        max_seq_len=32768,
+    )
+
+
+def qwen2_7b() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=152064,
+        hidden=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        head_dim=128,
+        intermediate=18944,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        qkv_bias=True,
+        max_seq_len=32768,
+    )
+
+
+def qwen2_tiny() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=512,
+        hidden=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=32,
+        intermediate=256,
+        rope_theta=1000000.0,
+        rms_eps=1e-6,
+        qkv_bias=True,
+        max_seq_len=512,
+    )

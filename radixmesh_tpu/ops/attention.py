@@ -39,41 +39,48 @@ def attend_prefill(
     q: jnp.ndarray,  # [B, S_new, Hq, D]
     k: jnp.ndarray,  # [B, S_ctx, Hkv, D]  (cached prefix ++ new, rotated)
     v: jnp.ndarray,  # [B, S_ctx, Hkv, D]
-    q_positions: jnp.ndarray,  # [B, S_new] absolute positions of q tokens
-    kv_lengths: jnp.ndarray,  # [B] valid context length (prefix + new)
+    q_positions: jnp.ndarray,  # [B, S_new] index-space positions of q tokens
+    kv_lengths: jnp.ndarray,  # [B] valid context end (index space)
+    kv_start: jnp.ndarray | None = None,  # [B] valid context begin (ragged pad)
 ) -> jnp.ndarray:
     """Causal attention where queries start mid-context (after a cached
-    prefix): query at absolute position p attends to kv positions <= p.
-    Padding beyond ``kv_lengths`` is masked. Returns [B, S_new, Hq, D]."""
+    prefix): query at index-space position p attends to kv indices in
+    ``[kv_start, min(p+1, kv_lengths))``. ``kv_start`` masks front padding
+    when ragged cached prefixes are right-aligned into a fixed-size prefix
+    region (see ``models/llama.py::prefill_forward``). Returns
+    [B, S_new, Hq, D]."""
     B, S_new, Hq, D = q.shape
     Hkv = k.shape[2]
-    k = _repeat_kv(k, Hq // Hkv)
-    v = _repeat_kv(v, Hq // Hkv)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
-    # Inputs stay in their native dtype (bf16 rides the MXU one-pass);
-    # accumulation and softmax are fp32. HIGHEST stops XLA from demoting
-    # fp32 inputs to bf16 multiplies (the TPU default).
+    G = Hq // Hkv
+    # Group queries instead of repeating K/V (a Hq/Hkv-fold memory copy on
+    # long contexts); inputs stay in their native dtype (bf16 rides the MXU
+    # one-pass), accumulation and softmax are fp32, and HIGHEST stops XLA
+    # from demoting fp32 inputs to bf16 multiplies (the TPU default).
+    qg = q.reshape(B, S_new, Hkv, G, D)
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk",
-        q,
+        "bqhgd,bkhd->bhgqk",
+        qg,
         k,
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
     logits = logits * scale
-    kv_pos = jnp.arange(k.shape[1])[None, None, None, :]  # [1,1,1,K]
-    causal = kv_pos <= q_positions[:, None, :, None]  # [B,1,Q,K]
-    valid = kv_pos < kv_lengths[:, None, None, None]
+    kv_pos = jnp.arange(k.shape[1])[None, None, None, None, :]  # [1,1,1,1,K]
+    causal = kv_pos <= q_positions[:, None, None, :, None]  # [B,1,1,Q,K]
+    valid = kv_pos < kv_lengths[:, None, None, None, None]
+    if kv_start is not None:
+        valid = valid & (kv_pos >= kv_start[:, None, None, None, None])
     logits = jnp.where(causal & valid, logits, _NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd",
+        "bhgqk,bkhd->bqhgd",
         weights,
         v.astype(jnp.float32),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
-    return out.astype(q.dtype)
+    return out.reshape(B, S_new, Hq, D).astype(q.dtype)
 
 
 @partial(jax.jit, static_argnames=())
@@ -88,34 +95,35 @@ def attend_decode_ref(
     Pallas kernel and the CPU execution path."""
     B, Hq, D = q.shape
     Hkv, _, page, _ = k_pages.shape
+    G = Hq // Hkv
     max_ctx = page_table.shape[1] * page
-    # [Hkv, B, maxp, page, D] → token-major [B, ctx, Hkv, D].
+    # [Hkv, B, maxp, page, D] → token-major [B, ctx, Hkv, D]; queries are
+    # grouped rather than repeating K/V.
     k = k_pages[:, page_table].reshape(Hkv, B, max_ctx, D).transpose(1, 2, 0, 3)
     v = v_pages[:, page_table].reshape(Hkv, B, max_ctx, D).transpose(1, 2, 0, 3)
-    k = _repeat_kv(k, Hq // Hkv)
-    v = _repeat_kv(v, Hq // Hkv)
+    qg = q.reshape(B, Hkv, G, D)
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
     logits = (
         jnp.einsum(
-            "bhd,bkhd->bhk",
-            q,
+            "bhgd,bkhd->bhgk",
+            qg,
             k,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
         * scale
     )
-    valid = jnp.arange(max_ctx)[None, None, :] < lengths[:, None, None]
+    valid = jnp.arange(max_ctx)[None, None, None, :] < lengths[:, None, None, None]
     logits = jnp.where(valid, logits, _NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
-        "bhk,bkhd->bhd",
+        "bhgk,bkhd->bhgd",
         weights,
         v.astype(jnp.float32),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
-    return out.astype(q.dtype)
+    return out.reshape(B, Hq, D).astype(q.dtype)
 
 
 def paged_attention(
@@ -127,9 +135,12 @@ def paged_attention(
     use_kernel: bool | None = None,
 ) -> jnp.ndarray:
     """Decode attention over radix-cache pages. Dispatches to the Pallas
-    TPU kernel on TPU backends, the jnp reference elsewhere."""
+    TPU kernel on TPU backends, the jnp reference elsewhere (CPU, or shapes
+    the TPU DMA can't tile: head_dim must be a lane multiple of 128 —
+    production models are all D=128)."""
     if use_kernel is None:
-        use_kernel = jax.default_backend() not in ("cpu",)
+        head_dim = q.shape[-1]
+        use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
     if use_kernel:
         from radixmesh_tpu.ops.paged_attention import paged_attention_kernel
 

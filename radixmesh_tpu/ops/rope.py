@@ -17,14 +17,18 @@ import jax.numpy as jnp
 def rope_frequencies(
     head_dim: int,
     theta: float = 500000.0,
-    llama3_scaling: dict | None = None,
+    llama3_scaling: dict | tuple | None = None,
 ) -> jnp.ndarray:
     """Inverse frequencies [head_dim // 2], optionally with the Llama-3.x
     long-context NTK-by-parts rescale (factor/low_freq/high_freq/original
-    context length)."""
+    context length). ``llama3_scaling`` may be a dict or a tuple of
+    ``(key, value)`` pairs (the hashable form ModelConfig stores so it can
+    be a jit-static argument)."""
     inv = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if isinstance(llama3_scaling, tuple):
+        llama3_scaling = dict(llama3_scaling)
     if llama3_scaling:
         factor = llama3_scaling.get("factor", 8.0)
         low = llama3_scaling.get("low_freq_factor", 1.0)

@@ -35,6 +35,9 @@ def sample_tokens(
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_mask = cum - probs >= jnp.asarray(top_p, dtype=jnp.float32)
+    # The argmax (sorted position 0) is always kept, even for top_p == 0.
+    rank = jnp.arange(cutoff_mask.shape[-1])
+    cutoff_mask = cutoff_mask & (rank > 0)
     sorted_filtered = jnp.where(cutoff_mask, -jnp.inf, sorted_logits)
     # Map the per-row threshold back to the unsorted logits.
     threshold = jnp.min(

@@ -199,6 +199,15 @@ class TestSampling:
         )
         assert int(out[0]) == 0
 
+    def test_top_p_zero_degenerates_to_argmax(self):
+        # top_p=0 must still keep the argmax (the keep-first carve-out).
+        logits = jnp.array([[0.0, 3.0, 1.0]])
+        for i in range(5):
+            out = sample_tokens(
+                logits, jax.random.PRNGKey(i), temperature=1.0, top_p=0.0
+            )
+            assert int(out[0]) == 1
+
     def test_temperature_flattens(self):
         logits = jnp.array([[2.0, 1.0]])
         hot = [
