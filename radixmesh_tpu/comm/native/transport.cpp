@@ -27,7 +27,10 @@
 //             the network itself.
 
 #include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
 #include <netdb.h>
+#include <poll.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -48,8 +51,15 @@ namespace {
 
 constexpr uint64_t kMaxQueueBytes = 64ull * 1024 * 1024;
 constexpr int kConnectRetryMs = 100;
+constexpr int kConnectTimeoutMs = 5000;
 
-int connect_to(const std::string& host, int port) {
+// Non-blocking connect with poll: a blocked target (SYN black hole) can
+// otherwise pin the sender thread inside connect() for the kernel's ~2min
+// SYN-retry budget, which rm_sender_close's shutdown_fd() cannot interrupt
+// because the fd is not yet published. Polls in kConnectRetryMs slices,
+// aborting early when `stop` is set.
+int connect_to(const std::string& host, int port,
+               const std::atomic<bool>* stop) {
   struct addrinfo hints;
   std::memset(&hints, 0, sizeof(hints));
   hints.ai_family = AF_UNSPEC;
@@ -61,7 +71,34 @@ int connect_to(const std::string& host, int port) {
   for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
     fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
-    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    int rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd;
+      pfd.fd = fd;
+      pfd.events = POLLOUT;
+      pfd.revents = 0;
+      int waited = 0;
+      while (waited < kConnectTimeoutMs && !(stop && stop->load())) {
+        int pr = poll(&pfd, 1, kConnectRetryMs);
+        if (pr > 0 || (pr < 0 && errno != EINTR)) break;
+        waited += kConnectRetryMs;
+      }
+      int err = 0;
+      socklen_t elen = sizeof(err);
+      if (!(pfd.revents & POLLOUT) ||
+          getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0 || err != 0) {
+        close(fd);
+        fd = -1;
+        continue;
+      }
+      rc = 0;
+    }
+    if (rc == 0) {
+      fcntl(fd, F_SETFL, flags);
+      break;
+    }
     close(fd);
     fd = -1;
   }
@@ -229,7 +266,7 @@ struct RmSender {
       std::lock_guard<std::mutex> lk(fd_mu);
       if (fd >= 0) return true;
     }
-    int f = connect_to(host, port);
+    int f = connect_to(host, port, &stopping);
     std::lock_guard<std::mutex> lk(fd_mu);
     fd = f;
     connected.store(fd >= 0);

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from radixmesh_tpu.ops.attention import attend_prefill, paged_attention
+from radixmesh_tpu.ops.attention import attend_prefill, paged_attention_pool
 from radixmesh_tpu.ops.norm import rms_norm
 from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -276,7 +276,10 @@ def decode_step(
     x = params["embed"][tokens][:, None, :]  # [B, 1, H]
     B = tokens.shape[0]
     num_slots = kv_pool.shape[3]
-    pages_shape = (cfg.n_kv_heads, num_slots // page_size, page_size, cfg.head_dim)
+    pages_shape = (
+        2, cfg.n_layers, cfg.n_kv_heads,
+        num_slots // page_size, page_size, cfg.head_dim,
+    )
 
     def layer(carry, xs):
         x, kv_pool = carry
@@ -285,19 +288,15 @@ def decode_step(
         q, k, v = _qkv(lp, h, cfg)  # [B,1,*,D]
         q = apply_rope(q, positions[:, None], inv_freq)
         k = apply_rope(k, positions[:, None], inv_freq)
-        # This layer's pool slice, updated with the new token's K/V at
-        # `slots` (head-major: [2, Hkv, num_slots, D]).
-        new_kv = jnp.stack(
-            [k[:, 0].transpose(1, 0, 2), v[:, 0].transpose(1, 0, 2)]
-        ).astype(kv_pool.dtype)  # [2, Hkv, B, D]
-        layer_kv = kv_pool[:, l_idx].at[:, :, slots].set(new_kv)
-        kv_pool = kv_pool.at[:, l_idx].set(layer_kv)
-        attn = paged_attention(
-            q[:, 0],
-            layer_kv[0].reshape(pages_shape),
-            layer_kv[1].reshape(pages_shape),
-            page_table,
-            lengths,
+        # Scatter this token's K/V into the pool carry: O(B) rows touched,
+        # in place (the pool is donated) — never a per-layer slice copy.
+        new_kv = jnp.stack([k[:, 0], v[:, 0]], axis=1).astype(
+            kv_pool.dtype
+        )  # [B, 2, Hkv, D]
+        kv_pool = kv_pool.at[:, l_idx, :, slots].set(new_kv)
+        # Attention DMAs only this layer's pages out of the whole pool.
+        attn = paged_attention_pool(
+            q[:, 0], kv_pool.reshape(pages_shape), page_table, lengths, l_idx
         )
         x = x + jnp.einsum(
             "bqd,qdh->bh",

@@ -1,6 +1,6 @@
 from radixmesh_tpu.ops.norm import rms_norm
 from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
-from radixmesh_tpu.ops.attention import attend_prefill, attend_decode_ref, paged_attention
+from radixmesh_tpu.ops.attention import attend_prefill, attend_decode_ref, paged_attention, paged_attention_pool
 from radixmesh_tpu.ops.sampling import sample_tokens
 
 __all__ = [
@@ -10,5 +10,6 @@ __all__ = [
     "attend_prefill",
     "attend_decode_ref",
     "paged_attention",
+    "paged_attention_pool",
     "sample_tokens",
 ]

@@ -27,13 +27,6 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
-    """[.., seq, kv_heads, dim] → [.., seq, kv_heads * n_rep, dim] (GQA)."""
-    if n_rep == 1:
-        return x
-    return jnp.repeat(x, n_rep, axis=-2)
-
-
 @partial(jax.jit, static_argnames=())
 def attend_prefill(
     q: jnp.ndarray,  # [B, S_new, Hq, D]
@@ -145,4 +138,26 @@ def paged_attention(
         from radixmesh_tpu.ops.paged_attention import paged_attention_kernel
 
         return paged_attention_kernel(q, k_pages, v_pages, page_table, lengths)
+    return attend_decode_ref(q, k_pages, v_pages, page_table, lengths)
+
+
+def paged_attention_pool(
+    q: jnp.ndarray,  # [B, Hq, D]
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] full-pool pages view
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    layer: jnp.ndarray | int,
+    use_kernel: bool | None = None,
+) -> jnp.ndarray:
+    """Decode attention reading ``layer``'s pages straight out of the whole
+    multi-layer pool — the scan-over-layers hot path (``decode_step``): no
+    per-layer pool slice is ever materialized in HBM."""
+    if use_kernel is None:
+        head_dim = q.shape[-1]
+        use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
+    if use_kernel:
+        from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+
+        return paged_attention_pool_kernel(q, kv_pages, page_table, lengths, layer)
+    k_pages, v_pages = kv_pages[0, layer], kv_pages[1, layer]
     return attend_decode_ref(q, k_pages, v_pages, page_table, lengths)

@@ -34,17 +34,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["paged_attention_kernel"]
+__all__ = ["paged_attention_kernel", "paged_attention_pool_kernel"]
 
 
 def _kernel(
     # scalar prefetch
     page_table_ref,  # SMEM [B, max_pages]
     lengths_ref,  # SMEM [B]
+    layer_ref,  # SMEM [1] — which layer's pages to read
     # inputs
     q_ref,  # VMEM [1, Hq, D]
-    k_hbm,  # ANY  [Hkv, P, page, D]
-    v_hbm,  # ANY  [Hkv, P, page, D]
+    kv_hbm,  # ANY  [2, L, Hkv, P, page, D] — the whole pool, zero-copy
     # outputs
     o_ref,  # VMEM [1, Hq, D]
     # scratch
@@ -58,6 +58,7 @@ def _kernel(
 ):
     b = pl.program_id(0)
     n = lengths_ref[b]
+    layer = layer_ref[0]
     n_pages = pl.cdiv(n, page)
     hq = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -67,17 +68,19 @@ def _kernel(
     # [Hkv, G, D] query layout so one einsum covers all GQA groups.
     q = (q_ref[0].astype(jnp.float32) * scale).reshape(n_kv_heads, g, d)
 
-    def dma(buf_ref, hbm_ref, slot, page_idx, which):
+    def dma(buf_ref, slot, page_idx, which):
+        # which: 0 = K, 1 = V. Source block [Hkv, page, D] — contiguous
+        # [page, D] rows per head in the head-major pool layout.
         return pltpu.make_async_copy(
-            hbm_ref.at[:, page_table_ref[b, page_idx]],
+            kv_hbm.at[which, layer, :, page_table_ref[b, page_idx]],
             buf_ref.at[slot],
             sem.at[which, slot],
         )
 
     @pl.when(n_pages > 0)
     def _():
-        dma(k_buf, k_hbm, 0, 0, 0).start()
-        dma(v_buf, v_hbm, 0, 0, 1).start()
+        dma(k_buf, 0, 0, 0).start()
+        dma(v_buf, 0, 0, 1).start()
 
     def body(i, carry):
         m, l, acc = carry
@@ -86,13 +89,13 @@ def _kernel(
 
         @pl.when(i + 1 < n_pages)
         def _():
-            dma(k_buf, k_hbm, next_slot, i + 1, 0).start()
-            dma(v_buf, v_hbm, next_slot, i + 1, 1).start()
+            dma(k_buf, next_slot, i + 1, 0).start()
+            dma(v_buf, next_slot, i + 1, 1).start()
 
         @pl.when(i < n_pages)
         def _():
-            dma(k_buf, k_hbm, slot, i, 0).wait()
-            dma(v_buf, v_hbm, slot, i, 1).wait()
+            dma(k_buf, slot, i, 0).wait()
+            dma(v_buf, slot, i, 1).wait()
 
         k = k_buf[slot].astype(jnp.float32)  # [Hkv, page, D]
         v = v_buf[slot].astype(jnp.float32)
@@ -135,32 +138,35 @@ def _kernel(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def paged_attention_kernel(
+def paged_attention_pool_kernel(
     q: jnp.ndarray,  # [B, Hq, D]
-    k_pages: jnp.ndarray,  # [Hkv, P, page, D] head-major (PagedKVPool.pages_for_layer)
-    v_pages: jnp.ndarray,  # [Hkv, P, page, D]
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] — full pool pages view
     page_table: jnp.ndarray,  # [B, max_pages] int32
     lengths: jnp.ndarray,  # [B] int32
+    layer: jnp.ndarray | int,  # which layer's pages to attend over
     interpret: bool = False,
 ) -> jnp.ndarray:
+    """Primary entry: the whole (multi-layer) pool rides in HBM untouched
+    and the kernel DMAs only ``layer``'s pages — so a scan-over-layers
+    decode step costs O(context pages) HBM traffic per layer, never a
+    materialized per-layer slice (which would be O(pool size))."""
     B, Hq, D = q.shape
-    Hkv, _, page, _ = k_pages.shape
+    _, _, Hkv, _, page, _ = kv_pages.shape
     max_pages = page_table.shape[1]
     kernel = functools.partial(
         _kernel, page=page, n_kv_heads=Hkv, max_pages=max_pages
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, Hkv, page, D), k_pages.dtype),
-            pltpu.VMEM((2, Hkv, page, D), v_pages.dtype),
+            pltpu.VMEM((2, Hkv, page, D), kv_pages.dtype),
+            pltpu.VMEM((2, Hkv, page, D), kv_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
@@ -172,7 +178,23 @@ def paged_attention_kernel(
     )(
         jnp.asarray(page_table, dtype=jnp.int32),
         jnp.asarray(lengths, dtype=jnp.int32),
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
         q,
-        k_pages,
-        v_pages,
+        kv_pages,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_kernel(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [Hkv, P, page, D] head-major (PagedKVPool.pages_for_layer)
+    v_pages: jnp.ndarray,  # [Hkv, P, page, D]
+    page_table: jnp.ndarray,  # [B, max_pages] int32
+    lengths: jnp.ndarray,  # [B] int32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-layer convenience wrapper (tests, layer-at-a-time callers)."""
+    kv_pages = jnp.stack([k_pages, v_pages])[:, None]  # [2, 1, Hkv, P, page, D]
+    return paged_attention_pool_kernel(
+        q, kv_pages, page_table, lengths, 0, interpret=interpret
     )
