@@ -1,0 +1,173 @@
+"""Headline benchmark: paged-decode throughput on one chip.
+
+Prints ONE JSON line:
+``{"metric": "decode_tokens_per_sec_per_chip", "value": N, "unit": "tok/s",
+"vs_baseline": N}``.
+
+The reference publishes no numbers (SURVEY §6: ``README.md:58`` unchecked,
+``BASELINE.json`` ``published: {}``; its ``src.test.benchmark`` has no
+timers), so ``vs_baseline`` is the speedup of this framework's radix-paged
+decode path (Pallas paged attention over the KV pool, ``decode_step``)
+over a reference-style dense-cache decode measured in the same run — i.e.
+what a naive contiguous-KV port (the torch idiom the reference's tensors
+assume) would do on the same chip, same model, same batch.
+
+Model: Llama-architecture ~1B config (bf16), continuous batch of 64 at
+context 1024, page_size 16. Shapes shrink automatically on CPU so the
+script stays runnable anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _dense_decode_step_fn(cfg):
+    """Reference-style baseline: contiguous per-sequence KV cache
+    [L, B, max_len, Hkv, D] (the layout a direct torch port would keep),
+    dense attention over the full padded context."""
+    from radixmesh_tpu.models.llama import _logits, _mlp, _qkv, _PREC
+    from radixmesh_tpu.ops.norm import rms_norm
+    from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
+
+    def dense_attn(q, k, v, lengths):  # q [B,Hq,D], k/v [B,S,Hkv,D]
+        b, hq, d = q.shape
+        hkv = k.shape[2]
+        qg = q.reshape(b, hkv, hq // hkv, d)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        logits = jnp.einsum(
+            "bhgd,bkhd->bhgk", qg, k, preferred_element_type=jnp.float32
+        ) * scale
+        valid = jnp.arange(k.shape[1])[None, None, None, :] < lengths[:, None, None, None]
+        w = jax.nn.softmax(jnp.where(valid, logits, -1e30), axis=-1)
+        out = jnp.einsum(
+            "bhgk,bkhd->bhgd", w, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(b, hq, d).astype(q.dtype)
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, cache_k, cache_v, tokens, lengths):
+        inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+        positions = lengths - 1
+        x = params["embed"][tokens][:, None, :]
+        b = tokens.shape[0]
+
+        def layer(carry, xs):
+            x, ck, cv = carry
+            l_idx, lp = xs
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+            q, k, v = _qkv(lp, h, cfg)
+            q = apply_rope(q, positions[:, None], inv_freq)
+            k = apply_rope(k, positions[:, None], inv_freq)
+            lk = jax.vmap(lambda c, kk, p: jax.lax.dynamic_update_slice(
+                c, kk, (p, 0, 0)))(ck[l_idx], k.astype(ck.dtype), positions)
+            lv = jax.vmap(lambda c, vv, p: jax.lax.dynamic_update_slice(
+                c, vv, (p, 0, 0)))(cv[l_idx], v.astype(cv.dtype), positions)
+            ck, cv = ck.at[l_idx].set(lk), cv.at[l_idx].set(lv)
+            attn = dense_attn(q[:, 0], lk, lv, lengths)
+            x = x + jnp.einsum(
+                "bqd,qdh->bh",
+                attn.reshape(b, cfg.n_heads, cfg.head_dim),
+                lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
+                precision=_PREC,
+            )[:, None, :]
+            h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+            x = x + _mlp(lp, h2)
+            return (x, ck, cv), None
+
+        (x, cache_k, cache_v), _ = jax.lax.scan(
+            layer, (x, cache_k, cache_v), (jnp.arange(cfg.n_layers), params["layers"])
+        )
+        return _logits(params, cfg, x)[:, 0], cache_k, cache_v
+
+    return step
+
+
+def _time_loop(run_once, iters: int) -> float:
+    """Seconds per iteration (post-warmup, state threaded through)."""
+    state = run_once(None)  # warmup / compile
+    state = run_once(state)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = run_once(state)
+    jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    from radixmesh_tpu.models.llama import ModelConfig, decode_step, init_params
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = ModelConfig(
+            vocab_size=32768, hidden=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, head_dim=128, intermediate=8192, rope_scaling=None,
+        )
+        batch, ctx, page_size, iters = 64, 1024, 16, 32
+    else:
+        cfg = ModelConfig.tiny()
+        batch, ctx, page_size, iters = 8, 128, 16, 8
+    log(f"bench: backend={jax.default_backend()} batch={batch} ctx={ctx} "
+        f"layers={cfg.n_layers} hidden={cfg.hidden}")
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch,)), jnp.int32)
+    lengths = jnp.full((batch,), ctx, jnp.int32)
+
+    # --- paged path (this framework) -------------------------------------
+    num_slots = batch * ctx
+    max_pages = ctx // page_size
+    # each sequence owns a contiguous page run; decode writes token ctx-1
+    page_table = jnp.asarray(
+        np.arange(batch * max_pages, dtype=np.int32).reshape(batch, max_pages))
+    slots = jnp.asarray(np.arange(batch, dtype=np.int32) * ctx + (ctx - 1))
+    kv_pool = jnp.zeros(
+        (2, cfg.n_layers, cfg.n_kv_heads, num_slots, cfg.head_dim), cfg.dtype)
+
+    def run_paged(state):
+        pool = kv_pool if state is None else state
+        logits, pool = decode_step(
+            params, cfg, tokens, pool, slots, page_table, lengths, page_size)
+        return pool
+    sec_paged = _time_loop(run_paged, iters)
+    tok_s = batch / sec_paged
+    log(f"paged decode: {sec_paged*1e3:.2f} ms/step, {tok_s:.1f} tok/s")
+
+    # --- dense baseline (reference-style contiguous cache) ---------------
+    del kv_pool
+    dense_step = _dense_decode_step_fn(cfg)
+    dense_shape = (cfg.n_layers, batch, ctx, cfg.n_kv_heads, cfg.head_dim)
+    ck0 = jnp.zeros(dense_shape, cfg.dtype)
+    cv0 = jnp.zeros(dense_shape, cfg.dtype)
+
+    def run_dense(state):
+        ck, cv = (ck0, cv0) if state is None else state
+        logits, ck, cv = dense_step(params, ck, cv, tokens, lengths)
+        return ck, cv
+    sec_dense = _time_loop(run_dense, iters)
+    log(f"dense decode: {sec_dense*1e3:.2f} ms/step, {batch/sec_dense:.1f} tok/s")
+
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(sec_dense / sec_paged, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

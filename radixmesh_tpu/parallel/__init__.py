@@ -1,0 +1,35 @@
+"""Multi-chip parallelism: device meshes, logical-axis shardings, and the
+sharded train/serve steps.
+
+The reference has **no parallelism of any kind** (SURVEY §2 checklist:
+``grep -ri "tensor.parallel|pipeline|all_reduce|nccl|mpi"`` over
+``/root/reference`` is empty; its only distribution mechanism is the oplog
+ring). These are net-new TPU-first components required by the north star
+(Llama-3-8B on v5e-16, Qwen2-72B 32k on v5p-64, ``BASELINE.json``):
+
+- ``sharding``  — ``Mesh`` over (dp, sp, tp) axes; logical→physical rules
+  mapping ``models.param_logical_axes`` names onto mesh axes.
+- ``train``     — pjit'd causal-LM training step (grads ride XLA psum over
+  ICI; no hand-written collectives).
+- ``ring_attention`` — ``shard_map`` + ``ppermute`` blockwise attention for
+  sequence lengths that exceed one chip's HBM (the 32k config).
+"""
+
+from radixmesh_tpu.parallel.sharding import (
+    MeshPlan,
+    batch_sharding,
+    make_mesh,
+    param_sharding,
+    shard_params,
+)
+from radixmesh_tpu.parallel.train import make_train_state, make_train_step
+
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "param_sharding",
+    "shard_params",
+    "batch_sharding",
+    "make_train_state",
+    "make_train_step",
+]

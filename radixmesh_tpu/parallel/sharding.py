@@ -1,0 +1,122 @@
+"""Device mesh + logical-axis sharding rules.
+
+TPU-first design: the model code names every parameter axis *logically*
+(``models/llama.py:param_logical_axes`` — "embed", "q_heads", "kv_heads",
+"ffn", "vocab", "layer"); this module maps those names onto physical mesh
+axes and produces ``NamedSharding`` pytrees for pjit. XLA then inserts all
+collectives (psum for TP matmul reductions, all-gathers for sp attention)
+— nothing here hand-schedules communication, per the scaling-book recipe:
+pick a mesh, annotate shardings, let XLA insert collectives.
+
+Mesh axes:
+
+- ``dp`` — data parallel: batch split; params replicated; grad psum.
+- ``sp`` — sequence parallel: prefill/train activations split along the
+  sequence axis (long-context prefill; ring attention in
+  ``parallel/ring_attention.py`` rides this same axis).
+- ``tp`` — tensor parallel: attention heads + FFN hidden split (Megatron
+  layout: column-parallel wq/wk/wv/w_gate/w_up, row-parallel wo/w_down,
+  so each transformer block needs exactly two psums, inserted by XLA).
+
+No EP axis: both target model families (Llama-3, Qwen2 — ``BASELINE.json``
+"configs") are dense, per SURVEY §2's parallelism checklist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "LOGICAL_RULES",
+    "MeshPlan",
+    "make_mesh",
+    "logical_to_spec",
+    "param_sharding",
+    "shard_params",
+    "batch_sharding",
+]
+
+# Logical axis name -> mesh axis (None = replicated along that axis).
+# "layer" stays unsharded: layers are consumed by lax.scan; a pipeline
+# ("pp") layout would instead split the scan into per-stage scans.
+LOGICAL_RULES: dict[str, str | None] = {
+    "vocab": "tp",
+    "q_heads": "tp",
+    "kv_heads": "tp",
+    "ffn": "tp",
+    "embed": None,
+    "layer": None,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Factorization of the device count over (dp, sp, tp)."""
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    @classmethod
+    def auto(cls, n_devices: int, max_tp: int = 4) -> "MeshPlan":
+        """Default factorization: favor tp (ICI-local, most bandwidth-
+        hungry), then sp, then dp — e.g. 8 -> (dp=1, sp=2, tp=4),
+        4 -> (1, 1, 4), 16 -> (2, 2, 4).
+
+        ``max_tp`` caps head sharding (kv heads must stay divisible; Llama-3
+        has 8 kv heads -> raise to 8 for it). Deployments pass an explicit
+        plan; auto exists so the dryrun exercises every axis."""
+        tp = math.gcd(n_devices, max_tp)
+        rest = n_devices // tp
+        sp = 2 if rest % 2 == 0 else 1
+        dp = rest // sp
+        return cls(dp=dp, sp=sp, tp=tp)
+
+
+def make_mesh(plan: MeshPlan | None = None, devices: list | None = None) -> Mesh:
+    """Build a ``(dp, sp, tp)`` Mesh. With no plan, factorize all visible
+    devices. tp is placed on the innermost (fastest-wraparound ICI) axis."""
+    devices = devices if devices is not None else jax.devices()
+    if plan is None:
+        plan = MeshPlan.auto(len(devices))
+    if plan.n_devices > len(devices):
+        raise ValueError(
+            f"mesh plan {plan} needs {plan.n_devices} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[: plan.n_devices]).reshape(plan.dp, plan.sp, plan.tp)
+    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+
+
+def logical_to_spec(axes: tuple) -> P:
+    """("layer","embed","q_heads") -> PartitionSpec(None, None, "tp")."""
+    return P(*(LOGICAL_RULES.get(name) for name in axes))
+
+
+def param_sharding(logical_axes: Any, mesh: Mesh) -> Any:
+    """Map a pytree of logical-axis tuples (``param_logical_axes(cfg)``)
+    to a matching pytree of ``NamedSharding``."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes)),
+        logical_axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def shard_params(params: Any, logical_axes: Any, mesh: Mesh) -> Any:
+    """Place an (unsharded) param pytree onto the mesh."""
+    return jax.device_put(params, param_sharding(logical_axes, mesh))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Token batches [B, S, ...]: batch over dp, sequence over sp."""
+    return NamedSharding(mesh, P("dp", "sp", *([None] * (ndim - 2))))

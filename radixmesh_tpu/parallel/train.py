@@ -1,0 +1,117 @@
+"""Sharded causal-LM training step (pjit over the (dp, sp, tp) mesh).
+
+The reference has no training anywhere (SURVEY: "no training, and no
+parallelism ... anywhere in the tree"); this is the net-new piece that
+makes the framework's model side complete and gives the driver's
+``dryrun_multichip`` a full sharded step to compile: params tp-sharded by
+logical axis, batch dp×sp-sharded, grad reduction + TP psums all inserted
+by XLA from the sharding annotations alone.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from radixmesh_tpu.models.llama import (
+    ModelConfig,
+    init_params,
+    param_logical_axes,
+    prefill_forward,
+)
+from radixmesh_tpu.parallel.sharding import batch_sharding, param_sharding
+
+__all__ = ["TrainState", "causal_lm_loss", "make_train_state", "make_train_step"]
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def _empty_prefix(cfg: ModelConfig, batch: int):
+    """Zero-length cached prefix: training attends over the raw sequence."""
+    shape = (cfg.n_layers, batch, 0, cfg.n_kv_heads, cfg.head_dim)
+    k = jnp.zeros(shape, dtype=cfg.dtype)
+    return k, k, jnp.zeros((batch,), dtype=jnp.int32)
+
+
+def causal_lm_loss(params: Any, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy over ``tokens [B, S]``."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    b, s = inputs.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    ck, cv, plen = _empty_prefix(cfg, b)
+    logits, _, _ = prefill_forward(params, cfg, inputs, positions, ck, cv, plen)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def opt_state_sharding(opt_state_shapes: Any, p_shard: Any, mesh) -> Any:
+    """Sharding pytree for an optax state: any subtree that mirrors the
+    param pytree (adam mu/nu, sgd trace, ...) gets the param shardings;
+    every other leaf (step counts, scalars) is replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+    p_def = jax.tree_util.tree_structure(p_shard)
+
+    def rec(node):
+        if jax.tree_util.tree_structure(node) == p_def:
+            return p_shard
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if isinstance(node, tuple):  # includes NamedTuple optax states
+            mapped = [rec(c) for c in node]
+            return type(node)(*mapped) if hasattr(node, "_fields") else tuple(mapped)
+        if isinstance(node, list):
+            return [rec(c) for c in node]
+        return replicated
+
+    return rec(opt_state_shapes)
+
+
+def make_train_state(
+    cfg: ModelConfig,
+    key: jax.Array,
+    mesh,
+    optimizer: optax.GradientTransformation,
+) -> TrainState:
+    """Initialize params directly sharded on the mesh (out_shardings on the
+    jitted init — no host-side full copy), opt state sharded to match."""
+    p_shard = param_sharding(param_logical_axes(cfg), mesh)
+    params = jax.jit(partial(init_params, cfg), out_shardings=p_shard)(key)
+    o_shard = opt_state_sharding(
+        jax.eval_shape(optimizer.init, params), p_shard, mesh
+    )
+    opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
+    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    mesh,
+    optimizer: optax.GradientTransformation,
+):
+    """Returns jitted ``step(state, tokens) -> (state, loss)``.
+
+    State is donated (params/opt updated in place in HBM); the batch is
+    constrained to (dp, sp) so XLA derives: psum over dp+sp for grads,
+    psum over tp inside each block's row-parallel matmuls."""
+    tok_shard = batch_sharding(mesh)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, tokens: jnp.ndarray):
+        tokens = jax.lax.with_sharding_constraint(tokens, tok_shard)
+        loss, grads = jax.value_and_grad(causal_lm_loss)(state.params, cfg, tokens)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return step
