@@ -1,0 +1,95 @@
+"""Cache-aware request routing (reference
+``router/cache_aware_router.py:15-39``).
+
+The router node's :class:`MeshCache` replica stores *which rank* wrote
+each prefix (rank-only values, no KV) — so routing a request is one
+read-only tree walk. Semantics matched to the reference:
+
+- **Warm-up** (``:20-25``): until ``finish_warm_up()`` the router reports
+  no match so traffic spreads over the hash ring.
+- **Hit** (``:28-34``): matched prefill/decode rank → that node's address.
+- **Miss per role** (``:30-37``): consistent hash over that role's nodes.
+
+Net-new beyond the reference: the hash rings are built once and updated
+on topology change (not rebuilt per request), and the result carries the
+matched prefix length so the serving frontend can report hit-rate —
+the north-star metric (``BASELINE.json``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+from radixmesh_tpu.cache.mesh_cache import MeshCache, RouterMatchResult
+from radixmesh_tpu.config import MeshConfig
+from radixmesh_tpu.router.consistent_hash import ConsistentHash
+
+__all__ = ["CacheAwareRouter", "RouteResult"]
+
+
+@dataclass
+class RouteResult:
+    """Where to send a request (reference ``RouteResult``,
+    ``cache_aware_router.py:8-11``), plus hit telemetry."""
+
+    prefill_addr: str
+    decode_addr: str
+    prefill_cache_hit: bool = False
+    decode_cache_hit: bool = False
+    match_len: int = 0
+
+
+class CacheAwareRouter:
+    def __init__(self, mesh_cache: MeshCache, config: MeshConfig):
+        if not config.prefill_nodes or not config.decode_nodes:
+            raise ValueError("router needs at least one prefill and one decode node")
+        self.mesh_cache = mesh_cache
+        self.config = config
+        self._warm_up = True
+        self._prefill_ring = ConsistentHash(config.prefill_nodes)
+        self._decode_ring = ConsistentHash(config.decode_nodes)
+
+    def finish_warm_up(self) -> None:
+        """Enable cache-aware decisions (reference ``:20-21``)."""
+        self._warm_up = False
+
+    # -- topology changes (net-new: reference lists node add/remove as
+    # roadmap, README.md:49-50) --
+
+    def add_node(self, role: str, addr: str) -> None:
+        (self._prefill_ring if role == "prefill" else self._decode_ring).add_node(addr)
+
+    def remove_node(self, role: str, addr: str) -> None:
+        ring = self._prefill_ring if role == "prefill" else self._decode_ring
+        ring.remove_node(addr)
+
+    def cache_aware_route(self, key: Sequence[int]) -> RouteResult:
+        """Route one request's token ids (reference ``:23-39``)."""
+        if self._warm_up:
+            match = RouterMatchResult(-1, -1)
+        else:
+            match = self.mesh_cache.match_prefix(key)
+            assert isinstance(match, RouterMatchResult), (
+                "cache_aware_route requires a ROUTER-mode MeshCache"
+            )
+
+        if match.prefill_rank >= 0:
+            prefill_addr = self.config.prefill_addr(match.prefill_rank)
+            p_hit = True
+        else:
+            prefill_addr = self._prefill_ring.get_node(key)
+            p_hit = False
+        if match.decode_rank >= 0:
+            decode_addr = self.config.decode_addr(match.decode_rank)
+            d_hit = True
+        else:
+            decode_addr = self._decode_ring.get_node(key)
+            d_hit = False
+        return RouteResult(
+            prefill_addr=prefill_addr,
+            decode_addr=decode_addr,
+            prefill_cache_hit=p_hit,
+            decode_cache_hit=d_hit,
+            match_len=match.match_len if match.prefill_rank >= 0 or match.decode_rank >= 0 else 0,
+        )

@@ -1,0 +1,159 @@
+"""Routing-layer tests (reference ``router/cache_aware_router.py``;
+routing assertions in ``test/correctness.py:57-74,95-103``)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.kv_pool import PagedKVPool
+from radixmesh_tpu.cache.mesh_cache import MeshCache
+from radixmesh_tpu.comm.inproc import InprocHub
+from radixmesh_tpu.config import MeshConfig, NodeRole
+from radixmesh_tpu.router import CacheAwareRouter, ConsistentHash
+
+
+def wait_for(pred, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestConsistentHash:
+    def test_deterministic(self):
+        ring = ConsistentHash(["a", "b", "c"])
+        key = [1, 2, 3]
+        assert ring.get_node(key) == ring.get_node(key)
+        assert ConsistentHash(["a", "b", "c"]).get_node(key) == ring.get_node(key)
+
+    def test_spread(self):
+        ring = ConsistentHash([f"n{i}" for i in range(4)], virtual_nodes=32)
+        owners = {ring.get_node([i, i + 1]) for i in range(200)}
+        assert len(owners) == 4  # every node gets some keys
+
+    def test_remove_node_only_moves_its_keys(self):
+        ring = ConsistentHash([f"n{i}" for i in range(4)], virtual_nodes=16)
+        keys = [[i, 7 * i] for i in range(100)]
+        before = {tuple(k): ring.get_node(k) for k in keys}
+        ring.remove_node("n2")
+        for k in keys:
+            owner = ring.get_node(k)
+            assert owner != "n2"
+            if before[tuple(k)] != "n2":  # unaffected keys stay put
+                assert owner == before[tuple(k)]
+
+    def test_empty_ring(self):
+        assert ConsistentHash().get_node([1]) is None
+
+    def test_string_and_bytes_keys(self):
+        ring = ConsistentHash(["a", "b"])
+        assert ring.get_node("hello") in ("a", "b")
+        assert ring.get_node(b"hello") in ("a", "b")
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    InprocHub.reset_default()
+    yield
+    InprocHub.reset_default()
+
+
+@pytest.fixture
+def cluster():
+    prefill = ["p0", "p1"]
+    decode = ["d0"]
+    router = ["r0"]
+    nodes = []
+    for addr in prefill + decode + router:
+        cfg = MeshConfig(
+            prefill_nodes=prefill,
+            decode_nodes=decode,
+            router_nodes=router,
+            local_addr=addr,
+            protocol="inproc",
+            tick_interval_s=0.05,
+            gc_interval_s=30.0,
+        )
+        pool = (
+            None
+            if cfg.local_role is NodeRole.ROUTER
+            else PagedKVPool(num_slots=128, num_layers=1, num_kv_heads=1, head_dim=2)
+        )
+        nodes.append(MeshCache(cfg, pool=pool).start())
+    for n in nodes:
+        assert n.wait_ready(timeout=10)
+    yield nodes
+    for n in nodes:
+        n.close()
+
+
+class TestCacheAwareRouter:
+    def _router(self, cluster) -> CacheAwareRouter:
+        node = next(n for n in cluster if n.role is NodeRole.ROUTER)
+        return CacheAwareRouter(node, node.cfg)
+
+    def test_warm_up_uses_hash_ring(self, cluster):
+        router = self._router(cluster)
+        key = [1, 2, 3]
+        slots = cluster[1].pool.alloc(3)
+        cluster[1].insert(key, slots)
+        wait_for(lambda: router.mesh_cache.match_prefix(key).prefill_rank == 1)
+        r = router.cache_aware_route(key)
+        assert not r.prefill_cache_hit and not r.decode_cache_hit
+        assert r.prefill_addr in ("p0", "p1") and r.decode_addr == "d0"
+
+    def test_hit_routes_to_writer(self, cluster):
+        router = self._router(cluster)
+        router.finish_warm_up()
+        key = [5, 6, 7, 8]
+        slots = cluster[1].pool.alloc(4)
+        cluster[1].insert(key, slots)  # prefill rank 1 writes
+        assert wait_for(
+            lambda: router.mesh_cache.match_prefix(key).prefill_rank == 1
+        )
+        r = router.cache_aware_route(key)
+        assert r.prefill_cache_hit and r.prefill_addr == "p1"
+        assert not r.decode_cache_hit and r.decode_addr == "d0"  # hash fallback
+        assert r.match_len == 4
+
+    def test_decode_writer_reported(self, cluster):
+        router = self._router(cluster)
+        router.finish_warm_up()
+        key = [9, 10, 11]
+        decode_node = next(n for n in cluster if n.role is NodeRole.DECODE)
+        slots = decode_node.pool.alloc(3)
+        decode_node.insert(key, slots)
+        assert wait_for(
+            lambda: router.mesh_cache.match_prefix(key).decode_rank >= 0
+        )
+        r = router.cache_aware_route(key)
+        assert r.decode_cache_hit and r.decode_addr == "d0"
+
+    def test_miss_routes_consistently(self, cluster):
+        router = self._router(cluster)
+        router.finish_warm_up()
+        key = [42, 43, 44]
+        r1 = router.cache_aware_route(key)
+        r2 = router.cache_aware_route(key)
+        assert (r1.prefill_addr, r1.decode_addr) == (r2.prefill_addr, r2.decode_addr)
+        assert not r1.prefill_cache_hit
+
+    def test_remove_node_reroutes(self, cluster):
+        router = self._router(cluster)
+        router.finish_warm_up()
+        hit_p0 = next(
+            k for k in ([i, i] for i in range(100))
+            if router.cache_aware_route(k).prefill_addr == "p0"
+        )
+        router.remove_node("prefill", "p0")
+        assert router.cache_aware_route(hit_p0).prefill_addr == "p1"
+
+    def test_requires_router_mode(self, cluster):
+        prefill_node = cluster[0]
+        router = CacheAwareRouter(prefill_node, prefill_node.cfg)
+        router.finish_warm_up()
+        with pytest.raises(AssertionError):
+            router.cache_aware_route([1, 2])
