@@ -1,0 +1,22 @@
+"""Serving engine: continuous batching over the radix prefix cache.
+
+The reference is cache-only; its commented-out SGLang scheduler hooks
+(``radix_cache.py:439-519``: ``cache_finished_req`` /
+``cache_unfinished_req`` against a ``req_to_token_pool``) document the
+runtime contract it was built to slot into. This package implements that
+runtime TPU-first (SURVEY §7 stage 5):
+
+- prefill reuses the longest cached prefix (skipped FLOPs = the north-star
+  hit-rate metric), writes new KV into the paged pool, and publishes the
+  prompt to the radix tree mid-request (``cache_unfinished_req``);
+- decode runs one fixed-shape batched step per iteration (static shapes
+  for XLA; inactive rows masked to a scratch page);
+- finished requests publish their full sequence and release locks
+  (``cache_finished_req``); pool pressure triggers LRU eviction of
+  unlocked tree leaves.
+"""
+
+from radixmesh_tpu.engine.engine import Engine, EngineStats
+from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
+
+__all__ = ["Engine", "EngineStats", "Request", "RequestState", "SamplingParams"]

@@ -1,0 +1,421 @@
+"""Continuous-batching serving engine over the radix prefix cache.
+
+Realizes the scheduler contract the reference documents but leaves
+commented out (``radix_cache.py:439-519``): prefix match → lock → compute
+→ publish (``cache_unfinished_req`` mid-request, ``cache_finished_req`` at
+completion) → unlock, with LRU eviction under pool pressure.
+
+TPU-first shape discipline:
+
+- **Prefill** runs per request with sequence/prefix lengths padded to
+  power-of-two buckets — O(log max_len²) compiled variants total, each an
+  MXU-dense batch-1 call. The cached prefix is gathered right-aligned so
+  ragged hit lengths stay exact (``models/llama.py:prefill_forward``).
+- **Decode** is ONE fixed-shape jitted step per iteration for the whole
+  batch: static ``[max_batch]`` rows, static page-table width. Inactive
+  rows point at a reserved scratch page and their outputs are ignored —
+  shapes never depend on how many requests are live.
+- The KV pool array is donated through both paths; host-side tree
+  mutation happens between device steps (SURVEY §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from radixmesh_tpu.cache.kv_pool import PagedKVPool
+from radixmesh_tpu.cache.radix_tree import RadixTree
+from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
+from radixmesh_tpu.models.llama import ModelConfig, decode_step, prefill_forward
+from radixmesh_tpu.ops.sampling import sample_tokens
+from radixmesh_tpu.utils.logging import get_logger
+
+__all__ = ["Engine", "EngineStats"]
+
+
+def _pow2_at_least(n: int, floor: int = 8) -> int:
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass
+class EngineStats:
+    """Hit-rate + throughput counters (the reference never increments its
+    ``hit_count`` and emits no metrics — SURVEY §5 'observability')."""
+
+    prompt_tokens: int = 0
+    cached_tokens: int = 0  # reused from the radix cache at prefill
+    generated_tokens: int = 0
+    prefills: int = 0
+    decode_steps: int = 0
+    finished: int = 0
+    preemptions: int = 0
+    ttft_s: list[float] = field(default_factory=list)
+
+    @property
+    def hit_rate(self) -> float:
+        """Prefix-cache hit-rate over prompt tokens — the north-star
+        metric (``BASELINE.json``: target ≥70% on ShareGPT)."""
+        return self.cached_tokens / self.prompt_tokens if self.prompt_tokens else 0.0
+
+    @property
+    def p50_ttft_s(self) -> float:
+        return float(np.median(self.ttft_s)) if self.ttft_s else 0.0
+
+
+class Engine:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: dict,
+        *,
+        num_slots: int = 4096,
+        page_size: int = 16,
+        max_batch: int = 8,
+        max_seq_len: int | None = None,
+        rng_seed: int = 0,
+    ):
+        if page_size & (page_size - 1):
+            raise ValueError("page_size must be a power of two")
+        self.cfg = cfg
+        self.params = params
+        self.page_size = page_size
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len or cfg.max_seq_len
+        self.max_pages = -(-self.max_seq_len // page_size)
+        self.log = get_logger("engine")
+
+        self.pool = PagedKVPool(
+            num_slots=num_slots,
+            num_layers=cfg.n_layers,
+            num_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim,
+            page_size=page_size,
+            dtype=cfg.dtype,
+        )
+        self.tree = RadixTree(page_size=page_size, on_free=self.pool.free)
+        # Reserved scratch page: inactive decode rows write/read here.
+        scratch = self.pool.alloc(page_size)
+        assert scratch is not None
+        self._scratch_slot = int(scratch[0])
+        self._scratch_page = self._scratch_slot // page_size
+
+        self.waiting: list[Request] = []
+        self._rows: list[Request | None] = [None] * max_batch
+        self._tokens = np.zeros(max_batch, dtype=np.int32)
+        self._page_table = np.full(
+            (max_batch, self.max_pages), self._scratch_page, dtype=np.int32
+        )
+        self._lengths = np.ones(max_batch, dtype=np.int32)
+        self._temps = np.zeros(max_batch, dtype=np.float32)
+        self._top_ps = np.ones(max_batch, dtype=np.float32)
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self.stats = EngineStats()
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def add_request(
+        self, prompt: Sequence[int], sampling: SamplingParams | None = None
+    ) -> Request:
+        req = Request(
+            prompt=np.asarray(prompt, dtype=np.int32),
+            sampling=sampling or SamplingParams(),
+        )
+        if not (0 < len(req.prompt) < self.max_seq_len):
+            raise ValueError(f"prompt length {len(req.prompt)} out of range")
+        req.submit_time = time.monotonic()
+        self.waiting.append(req)
+        return req
+
+    def step(self) -> None:
+        """One scheduler iteration: admit+prefill queued requests into free
+        rows, then one batched decode step for everything running."""
+        self._admit()
+        if any(r is not None for r in self._rows):
+            self._decode_once()
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(r is not None for r in self._rows)
+
+    def generate(
+        self,
+        prompts: Iterable[Sequence[int]],
+        sampling: SamplingParams | None = None,
+        max_steps: int = 100_000,
+    ) -> list[list[int]]:
+        reqs = [self.add_request(p, sampling) for p in prompts]
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            self.step()
+        assert all(r.state is RequestState.FINISHED for r in reqs), "step budget hit"
+        return [r.generated for r in reqs]
+
+    # ------------------------------------------------------------------
+    # admission + prefill
+    # ------------------------------------------------------------------
+
+    def _free_row(self) -> int:
+        for i, r in enumerate(self._rows):
+            if r is None:
+                return i
+        return -1
+
+    def _alloc_pages(self, n_pages: int) -> np.ndarray | None:
+        """Whole-page allocation with evict-under-pressure retry (the
+        reference's evict-then-insert flow, ``radix_cache.py:179-202``)."""
+        n = n_pages * self.page_size
+        slots = self.pool.alloc(n)
+        if slots is None:
+            self.tree.evict(n - self.pool.free_slots)
+            slots = self.pool.alloc(n)
+        return slots
+
+    def _admit(self) -> None:
+        while self.waiting:
+            row = self._free_row()
+            if row < 0:
+                return
+            req = self.waiting[0]
+            if not self._prefill(req, row):
+                return  # pool exhausted even after evict: wait for finishes
+            self.waiting.pop(0)
+
+    def _prefill(self, req: Request, row: int) -> bool:
+        prompt = req.prompt
+        match = self.tree.match_prefix(prompt)
+        # Reuse the cached prefix, but always leave ≥1 token to prefill so
+        # there are logits to sample the first output token from.
+        reuse = min(
+            match.length, (len(prompt) - 1) // self.page_size * self.page_size
+        )
+        prefix_slots = match.indices()[:reuse]
+        self.tree.inc_lock_ref(match.last_node)
+        req.lock_node = match.last_node
+
+        n_new = len(prompt) - reuse
+        own = self._alloc_pages(-(-n_new // self.page_size))
+        if own is None:
+            self.tree.dec_lock_ref(req.lock_node)
+            req.lock_node = None
+            return False
+
+        s_b = _pow2_at_least(n_new)
+        p_b = _pow2_at_least(reuse, floor=self.page_size) if reuse else 0
+        tokens = np.zeros((1, s_b), dtype=np.int32)
+        tokens[0, :n_new] = prompt[reuse:]
+        positions = (reuse + np.arange(s_b, dtype=np.int32))[None]
+        kv_shape = (self.cfg.n_layers, 1, p_b, self.cfg.n_kv_heads, self.cfg.head_dim)
+        cached_k = jnp.zeros(kv_shape, dtype=self.cfg.dtype)
+        cached_v = jnp.zeros(kv_shape, dtype=self.cfg.dtype)
+        if reuse:
+            g = self.pool.gather(prefix_slots)  # [2, L, n, Hkv, D]
+            cached_k = cached_k.at[:, 0, p_b - reuse :].set(g[0])
+            cached_v = cached_v.at[:, 0, p_b - reuse :].set(g[1])
+        logits, new_k, new_v = prefill_forward(
+            self.params,
+            self.cfg,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            cached_k,
+            cached_v,
+            jnp.full((1,), reuse, dtype=jnp.int32),
+        )
+        self.pool.write(own[:n_new], new_k[:, 0, :n_new], new_v[:, 0, :n_new])
+
+        self._rng, key = jax.random.split(self._rng)
+        first = int(
+            sample_tokens(
+                logits[0, n_new - 1 : n_new],
+                key,
+                temperature=req.sampling.temperature,
+                top_p=req.sampling.top_p,
+            )[0]
+        )
+        now = time.monotonic()
+        req.first_token_time = now
+        req.output_tokens = [first]
+        req.prefix_len = reuse
+        req.kv_len = len(prompt)
+        req.token_slots = np.concatenate([prefix_slots, own[:n_new]])
+        req.own_slots = own
+        req.state = RequestState.RUNNING
+        req.row = row
+
+        self.stats.prefills += 1
+        self.stats.prompt_tokens += len(prompt)
+        self.stats.cached_tokens += reuse
+        self.stats.ttft_s.append(now - req.submit_time)
+
+        # cache_unfinished_req: publish the prompt so concurrent requests
+        # can reuse it immediately (radix_cache.py:488-519).
+        self._publish(req, len(prompt))
+
+        # Wire the decode row.
+        self._rows[row] = req
+        self._tokens[row] = first
+        self._temps[row] = req.sampling.temperature
+        self._top_ps[row] = req.sampling.top_p
+        self._page_table[row] = self._scratch_page
+        n_pages = -(-req.kv_len // self.page_size)
+        self._page_table[row, :n_pages] = (
+            req.token_slots[:: self.page_size] // self.page_size
+        )
+        return True
+
+    # ------------------------------------------------------------------
+    # publish / release (the cache_*_req contract)
+    # ------------------------------------------------------------------
+
+    def _sequence_key(self, req: Request, key_len: int) -> np.ndarray:
+        if key_len <= len(req.prompt):
+            return req.prompt[:key_len]
+        return np.concatenate(
+            [
+                req.prompt,
+                np.asarray(
+                    req.output_tokens[: key_len - len(req.prompt)], dtype=np.int32
+                ),
+            ]
+        )
+
+    def _publish(self, req: Request, key_len: int) -> None:
+        """Insert the first ``key_len`` tokens (whose KV is in the pool)
+        into the tree; canonicalize shared prefixes; move the lock to the
+        deepest published node."""
+        key = self._sequence_key(req, key_len)
+        matched = self.tree.insert(key, req.token_slots[:key_len].copy())
+        m2 = self.tree.match_prefix(key)
+        new_lock = m2.last_node
+        if matched > req.prefix_len:
+            # Over [prefix_len, matched) the tree kept already-present
+            # slots. Where they're ours (this request published them
+            # earlier) nothing changes; where another request published the
+            # same tokens first, ours are duplicates — point our page table
+            # at the canonical slots and free only the differing ones.
+            canon = m2.indices()
+            old = req.token_slots[: len(canon)].copy()
+            dup = old[old != canon]
+            if dup.size:
+                req.token_slots[: len(canon)] = canon
+                req.own_slots = np.setdiff1d(req.own_slots, dup)
+                self.pool.free(dup)
+        # Slots now referenced by tree nodes are tree-owned: drop them from
+        # own_slots so release() never double-frees them.
+        aligned = key_len - key_len % self.page_size
+        tree_owned = req.token_slots[matched:aligned]
+        if tree_owned.size:
+            req.own_slots = np.setdiff1d(req.own_slots, tree_owned)
+        if new_lock is not req.lock_node:
+            self.tree.inc_lock_ref(new_lock)
+            if req.lock_node is not None:
+                self.tree.dec_lock_ref(req.lock_node)
+            req.lock_node = new_lock
+
+    def _release(self, req: Request) -> None:
+        """cache_finished_req (radix_cache.py:439-486): publish the full
+        sequence, free unpublished residue, release the lock, free the row."""
+        self._publish(req, req.kv_len)
+        if req.own_slots.size:
+            self.pool.free(req.own_slots)
+            req.own_slots = np.empty(0, dtype=np.int32)
+        if req.lock_node is not None:
+            self.tree.dec_lock_ref(req.lock_node)
+            req.lock_node = None
+        if req.row >= 0:
+            self._rows[req.row] = None
+            self._page_table[req.row] = self._scratch_page
+            self._lengths[req.row] = 1
+            self._tokens[req.row] = 0
+            req.row = -1
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+
+    def _decode_once(self) -> None:
+        slots = np.full(self.max_batch, self._scratch_slot, dtype=np.int32)
+        lengths = np.ones(self.max_batch, dtype=np.int32)
+        preempted: list[Request] = []
+        for row, req in enumerate(self._rows):
+            if req is None:
+                continue
+            page_idx, offset = divmod(req.kv_len, self.page_size)
+            if offset == 0:  # crossing into a fresh page
+                new = self._alloc_pages(1)
+                if new is None:
+                    preempted.append(req)
+                    continue
+                req.own_slots = np.concatenate([req.own_slots, new])
+                self._page_table[row, page_idx] = new[0] // self.page_size
+                slot = int(new[0])
+            else:
+                slot = int(
+                    self._page_table[row, page_idx] * self.page_size + offset
+                )
+            slots[row] = slot
+            lengths[row] = req.kv_len + 1
+        for req in preempted:
+            self._preempt(req)
+
+        active = [(row, r) for row, r in enumerate(self._rows) if r is not None]
+        if not active:
+            return
+        self._lengths = lengths
+        self._rng, key = jax.random.split(self._rng)
+        logits, self.pool.kv = decode_step(
+            self.params,
+            self.cfg,
+            jnp.asarray(self._tokens),
+            self.pool.kv,
+            jnp.asarray(slots),
+            jnp.asarray(self._page_table),
+            jnp.asarray(lengths),
+            self.page_size,
+        )
+        sampled = np.asarray(
+            sample_tokens(
+                logits, key, temperature=jnp.asarray(self._temps),
+                top_p=jnp.asarray(self._top_ps),
+            )
+        )
+        self.stats.decode_steps += 1
+
+        for row, req in active:
+            fed = int(self._tokens[row])  # token whose KV was just written
+            req.token_slots = np.append(req.token_slots, slots[row])
+            req.kv_len += 1
+            token = int(sampled[row])
+            req.output_tokens.append(token)
+            self.stats.generated_tokens += 1
+            if req.is_finished_by(token) or req.num_tokens >= self.max_seq_len:
+                # Don't count the terminal token as output if it's a stop.
+                if token in req.sampling.stop_token_ids:
+                    req.output_tokens.pop()
+                    self.stats.generated_tokens -= 1
+                req.state = RequestState.FINISHED
+                self.stats.finished += 1
+                self._release(req)
+            else:
+                self._tokens[row] = token
+
+    def _preempt(self, req: Request) -> None:
+        """Pool exhausted mid-decode even after eviction: publish what we
+        have, free the row, and requeue from scratch (the generated tokens
+        are discarded; the published KV makes the retry a long prefix hit)."""
+        self.stats.preemptions += 1
+        self._release(req)
+        req.state = RequestState.QUEUED
+        req.output_tokens = []
+        req.kv_len = 0
+        req.prefix_len = 0
+        req.token_slots = np.empty(0, dtype=np.int32)
+        self.waiting.insert(0, req)

@@ -1,0 +1,73 @@
+"""Request lifecycle state for the serving engine."""
+
+from __future__ import annotations
+
+import enum
+import itertools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["Request", "RequestState", "SamplingParams"]
+
+_rid_counter = itertools.count()
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0  # 0 = greedy
+    top_p: float = 1.0
+    max_new_tokens: int = 16
+    stop_token_ids: tuple[int, ...] = ()
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    prompt: np.ndarray  # int32 token ids
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    rid: int = field(default_factory=lambda: next(_rid_counter))
+
+    # -- engine-managed state --
+    state: RequestState = RequestState.QUEUED
+    output_tokens: list[int] = field(default_factory=list)
+    row: int = -1  # decode-batch row while RUNNING
+    kv_len: int = 0  # tokens whose KV is in the pool
+    prefix_len: int = 0  # tokens reused from the radix cache at prefill
+    # Slot index per token position [kv_len]: canonical (tree-owned) slots
+    # over the reused prefix, this request's slots after it.
+    token_slots: np.ndarray = field(
+        default_factory=lambda: np.empty(0, dtype=np.int32)
+    )
+    # Slots allocated by/for this request (whole pages; superset of the
+    # tail of token_slots until handed to the tree or freed).
+    own_slots: np.ndarray = field(
+        default_factory=lambda: np.empty(0, dtype=np.int32)
+    )
+    lock_node: object = None  # TreeNode protected while RUNNING
+    submit_time: float = 0.0
+    first_token_time: float = 0.0
+
+    @property
+    def next_token(self) -> int:
+        """Token to feed on the next decode step."""
+        return self.output_tokens[-1]
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt) + len(self.output_tokens)
+
+    @property
+    def generated(self) -> list[int]:
+        return list(self.output_tokens)
+
+    def is_finished_by(self, token: int) -> bool:
+        return (
+            token in self.sampling.stop_token_ids
+            or len(self.output_tokens) >= self.sampling.max_new_tokens
+        )

@@ -22,9 +22,18 @@ def sample_tokens(
     ``temperature==0`` → greedy. ``top_k``/``top_p`` filter before the
     categorical draw. All paths execute; selection is by ``jnp.where`` so a
     single compiled executable serves every setting of the dynamic args.
+    ``temperature``/``top_p`` may be scalars or per-row arrays of shape
+    ``logits.shape[:-1]`` (the continuous-batching engine passes one value
+    per batch row).
     """
     greedy = jnp.argmax(logits, axis=-1)
-    t = jnp.maximum(jnp.asarray(temperature, dtype=jnp.float32), 1e-6)
+    temperature = jnp.broadcast_to(
+        jnp.asarray(temperature, dtype=jnp.float32), logits.shape[:-1]
+    )
+    top_p = jnp.broadcast_to(
+        jnp.asarray(top_p, dtype=jnp.float32), logits.shape[:-1]
+    )
+    t = jnp.maximum(temperature, 1e-6)[..., None]
     scaled = logits.astype(jnp.float32) / t
     if top_k > 0:
         kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
@@ -34,7 +43,7 @@ def sample_tokens(
     sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    cutoff_mask = cum - probs >= jnp.asarray(top_p, dtype=jnp.float32)
+    cutoff_mask = cum - probs >= top_p[..., None]
     # The argmax (sorted position 0) is always kept, even for top_p == 0.
     rank = jnp.arange(cutoff_mask.shape[-1])
     cutoff_mask = cutoff_mask & (rank > 0)
@@ -47,5 +56,4 @@ def sample_tokens(
     )
     filtered = jnp.where(scaled < threshold, -jnp.inf, scaled)
     sampled = jax.random.categorical(rng, filtered, axis=-1)
-    use_greedy = jnp.asarray(temperature, dtype=jnp.float32) <= 0.0
-    return jnp.where(use_greedy, greedy, sampled)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -1,0 +1,157 @@
+"""Serving-engine tests: continuous batching + prefix reuse against a
+full-recompute oracle (tiny fp32 model on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.engine import Engine, RequestState, SamplingParams
+from radixmesh_tpu.models.llama import ModelConfig, init_params, prefill_forward
+
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny().replace(dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    return cfg, params
+
+
+def make_engine(model, **kw):
+    cfg, params = model
+    kw.setdefault("num_slots", 512)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq_len", 128)
+    return Engine(cfg, params, **kw)
+
+
+def oracle_generate(cfg, params, prompt, n_new):
+    """Greedy decode by full dense recompute each step — no cache, no pool."""
+    toks = list(int(t) for t in prompt)
+    for _ in range(n_new):
+        s = len(toks)
+        s_b = max(8, 1 << (s - 1).bit_length())
+        tokens = np.zeros((1, s_b), dtype=np.int32)
+        tokens[0, :s] = toks
+        positions = np.arange(s_b, dtype=np.int32)[None]
+        ck = jnp.zeros((cfg.n_layers, 1, 0, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+        logits, _, _ = prefill_forward(
+            params, cfg, jnp.asarray(tokens), jnp.asarray(positions),
+            ck, ck, jnp.zeros((1,), jnp.int32),
+        )
+        toks.append(int(jnp.argmax(logits[0, s - 1])))
+    return toks[len(prompt) :]
+
+
+def prompts_rng():
+    return np.random.default_rng(3)
+
+
+class TestGenerate:
+    def test_matches_oracle_single(self, model):
+        cfg, params = model
+        prompt = prompts_rng().integers(0, cfg.vocab_size, 13).tolist()
+        eng = make_engine(model)
+        out = eng.generate([prompt], SamplingParams(max_new_tokens=9))[0]
+        assert out == oracle_generate(cfg, params, prompt, 9)
+
+    def test_batch_matches_sequential(self, model):
+        cfg, params = model
+        rng = prompts_rng()
+        prompts = [rng.integers(0, cfg.vocab_size, n).tolist() for n in (5, 12, 21)]
+        eng = make_engine(model)
+        outs = eng.generate(prompts, SamplingParams(max_new_tokens=7))
+        for p, o in zip(prompts, outs):
+            assert o == oracle_generate(cfg, params, p, 7)
+
+    def test_stop_token(self, model):
+        cfg, params = model
+        prompt = prompts_rng().integers(0, cfg.vocab_size, 10).tolist()
+        ref = oracle_generate(cfg, params, prompt, 8)
+        stop = ref[3]
+        eng = make_engine(model)
+        out = eng.generate(
+            [prompt], SamplingParams(max_new_tokens=8, stop_token_ids=(stop,))
+        )[0]
+        assert out == ref[:3]
+
+    def test_more_requests_than_rows(self, model):
+        cfg, params = model
+        rng = prompts_rng()
+        prompts = [rng.integers(0, cfg.vocab_size, 6 + i).tolist() for i in range(7)]
+        eng = make_engine(model, max_batch=2)
+        outs = eng.generate(prompts, SamplingParams(max_new_tokens=5))
+        for p, o in zip(prompts, outs):
+            assert o == oracle_generate(cfg, params, p, 5)
+
+
+class TestPrefixReuse:
+    def test_second_request_hits_cache(self, model):
+        cfg, params = model
+        prompt = prompts_rng().integers(0, cfg.vocab_size, 24).tolist()
+        eng = make_engine(model)
+        out1 = eng.generate([prompt], SamplingParams(max_new_tokens=6))[0]
+        assert eng.stats.cached_tokens == 0
+        out2 = eng.generate([prompt], SamplingParams(max_new_tokens=6))[0]
+        assert out1 == out2
+        # ≥ the page-aligned prompt minus the one-token prefill floor
+        assert eng.stats.cached_tokens >= (len(prompt) - 1) // PAGE * PAGE
+        assert eng.stats.hit_rate > 0.4
+
+    def test_shared_prefix_across_requests(self, model):
+        cfg, params = model
+        rng = prompts_rng()
+        common = rng.integers(0, cfg.vocab_size, 16).tolist()
+        p1 = common + rng.integers(0, cfg.vocab_size, 4).tolist()
+        p2 = common + rng.integers(0, cfg.vocab_size, 5).tolist()
+        eng = make_engine(model)
+        o1, o2 = eng.generate([p1, p2], SamplingParams(max_new_tokens=4))
+        assert o1 == oracle_generate(cfg, params, p1, 4)
+        assert o2 == oracle_generate(cfg, params, p2, 4)
+
+    def test_generated_tokens_are_reusable(self, model):
+        cfg, params = model
+        prompt = prompts_rng().integers(0, cfg.vocab_size, 8).tolist()
+        eng = make_engine(model)
+        out = eng.generate([prompt], SamplingParams(max_new_tokens=10))[0]
+        # A prompt extending into the generated text should hit the cache
+        # beyond the original prompt (cache_finished_req published it).
+        longer = prompt + out[:6]
+        eng.generate([longer], SamplingParams(max_new_tokens=2))
+        assert eng.stats.cached_tokens >= (len(longer) - 1) // PAGE * PAGE
+
+
+class TestMemoryPressure:
+    def test_eviction_keeps_engine_alive(self, model):
+        cfg, params = model
+        rng = prompts_rng()
+        # Pool of 96 slots; each request needs ~24 — the 10 requests only
+        # fit because finished trees get evicted under pressure.
+        eng = make_engine(model, num_slots=96, max_batch=2)
+        prompts = [rng.integers(0, cfg.vocab_size, 16).tolist() for _ in range(10)]
+        outs = eng.generate(prompts, SamplingParams(max_new_tokens=6))
+        for p, o in zip(prompts, outs):
+            assert o == oracle_generate(cfg, params, p, 6)
+
+    def test_all_slots_recovered_after_reset(self, model):
+        eng = make_engine(model)
+        rng = prompts_rng()
+        prompts = [rng.integers(0, eng.cfg.vocab_size, 12).tolist() for _ in range(3)]
+        eng.generate(prompts, SamplingParams(max_new_tokens=4))
+        eng.tree.reset()
+        # everything except the scratch page is back
+        assert eng.pool.free_slots == eng.pool.num_slots - PAGE
+
+
+class TestSamplingIntegration:
+    def test_temperature_sampling_runs(self, model):
+        eng = make_engine(model)
+        prompt = prompts_rng().integers(0, eng.cfg.vocab_size, 9).tolist()
+        out = eng.generate(
+            [prompt], SamplingParams(max_new_tokens=5, temperature=0.8, top_p=0.9)
+        )[0]
+        assert len(out) == 5
+        assert all(0 <= t < eng.cfg.vocab_size for t in out)
