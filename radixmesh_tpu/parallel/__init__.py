@@ -15,6 +15,10 @@ ring). These are net-new TPU-first components required by the north star
   sequence lengths that exceed one chip's HBM (the 32k config).
 """
 
+from radixmesh_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_self_attention,
+)
 from radixmesh_tpu.parallel.sharding import (
     MeshPlan,
     batch_sharding,
@@ -25,6 +29,8 @@ from radixmesh_tpu.parallel.sharding import (
 from radixmesh_tpu.parallel.train import make_train_state, make_train_step
 
 __all__ = [
+    "ring_attention",
+    "ring_self_attention",
     "MeshPlan",
     "make_mesh",
     "param_sharding",

@@ -1,0 +1,111 @@
+"""Ring attention: causal self-attention over a sequence-sharded batch.
+
+The long-context path SURVEY §5 requires ("absent" in the reference; needed
+for the Qwen2-72B 32k config, ``BASELINE.json``): when a sequence doesn't
+fit one chip's HBM, shard it over the ``sp`` mesh axis and rotate K/V
+blocks around the ring with ``ppermute`` while every chip keeps only its
+own query block — HBM per chip is O(S/n), compute stays MXU-dense, and the
+K/V block transfer for step ``i+1`` overlaps step ``i``'s matmuls (XLA
+schedules the collective-permute concurrently with compute since neither
+depends on the other inside the loop body).
+
+Blockwise-causal masking: query block ``i`` attends fully to earlier
+blocks, triangularly to itself, not at all to later blocks; the online
+softmax (running max / sum / accumulator, fp32) makes the blockwise result
+exact, not approximate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+_NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, C, Hq, D] local query block
+    k: jnp.ndarray,  # [B, C, Hkv, D] local key block
+    v: jnp.ndarray,  # [B, C, Hkv, D]
+    axis_name: str,
+) -> jnp.ndarray:
+    """Per-shard body — call INSIDE ``shard_map`` with the sequence axis
+    sharded over ``axis_name``. Returns the local output block [B, C, Hq, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, c, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qg = (q.astype(jnp.float32) * scale).reshape(b, c, hkv, g, d)
+    q_pos = idx * c + jnp.arange(c)  # global positions of local queries
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        m, l, acc, k_cur, v_cur = carry
+        j = (idx - step) % n  # which block we currently hold
+        kv_pos = j * c + jnp.arange(c)
+        # [b, hkv, g, cq, ck] scores.
+        s = jnp.einsum(
+            "bqhgd,bkhd->bhgqk",
+            qg,
+            k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        mask = q_pos[:, None] >= kv_pos[None, :]  # [cq, ck] causal
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum(
+            "bhgqk,bkhd->bhgqd",
+            p,
+            v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * corr[..., None] + pv
+        # Rotate K/V around the ring; the permute overlaps next-step math.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, acc_new, k_nxt, v_nxt
+
+    m0 = jnp.full((b, hkv, g, c), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, c), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, g, c, d), jnp.float32)
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, acc0, k, v))
+    # Fully-masked rows (can't happen for causal self-attention, but keep
+    # the math total): avoid 0/0.
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    # [b, hkv, g, c, d] -> [b, c, hq, d]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, c, hq, d).astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jnp.ndarray,  # [B, S, Hq, D] full (logically sharded) sequence
+    k: jnp.ndarray,  # [B, S, Hkv, D]
+    v: jnp.ndarray,  # [B, S, Hkv, D]
+    mesh: Mesh,
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Top-level convenience: shard the sequence dim over ``mesh[axis]``
+    and run ring attention; heads stay whole (compose with tp by sharding
+    the head dim of the inputs before calling)."""
+    spec = P(None, axis)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
