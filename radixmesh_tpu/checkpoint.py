@@ -1,0 +1,147 @@
+"""Checkpoint / resume.
+
+The reference has none ("no checkpoint/resume anywhere in the tree" —
+SURVEY §5); a restarted node relies on RESET + oplog replay. Here both
+halves are first-class:
+
+- **Model weights**: orbax save/restore of the param pytree, sharding-
+  aware (restores directly onto a target mesh via the params' shardings).
+- **Cache state**: a radix-tree *snapshot* — token keys + slot values +
+  access metadata, NOT the KV pages themselves (they're recomputable; the
+  tree is what took a distributed workload to build). A restarted node
+  restores the tree, re-registers pool allocations, and rejoins the ring;
+  remote peers' oplogs replay idempotently on top (the reference's
+  "same base state + ordered idempotent oplogs" invariant, README.md:60-67).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from radixmesh_tpu.cache.radix_tree import RadixTree, TreeNode
+
+__all__ = [
+    "save_params",
+    "load_params",
+    "tree_snapshot",
+    "tree_restore",
+    "save_tree",
+    "load_tree",
+]
+
+
+# ---------------------------------------------------------------------------
+# model weights (orbax)
+# ---------------------------------------------------------------------------
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_params(path: str, params: Any) -> None:
+    """Write the param pytree with orbax (atomic directory write)."""
+    _ckptr().save(os.path.abspath(path), params, force=True)
+
+
+def load_params(path: str, like: Any | None = None) -> Any:
+    """Restore params. With ``like`` (a pytree of ``jax.ShapeDtypeStruct``
+    carrying shardings, e.g. from ``jax.eval_shape`` + ``param_sharding``),
+    arrays land directly on the target mesh — no host round-trip."""
+    import orbax.checkpoint as ocp
+
+    if like is None:
+        return _ckptr().restore(os.path.abspath(path))
+    restore_args = jax.tree.map(
+        lambda s: ocp.ArrayRestoreArgs(sharding=getattr(s, "sharding", None)), like
+    )
+    return _ckptr().restore(
+        os.path.abspath(path), item=like, restore_args=restore_args
+    )
+
+
+# ---------------------------------------------------------------------------
+# radix-tree snapshot
+# ---------------------------------------------------------------------------
+
+
+def tree_snapshot(tree: RadixTree) -> dict:
+    """Serializable snapshot: every node's (key tokens, slot values, access
+    time, hit count), parent-linked by preorder id. Lock refs are NOT
+    saved — they're per-request runtime state and all requests are gone
+    after a restart."""
+    nodes = []
+    ids: dict[int, int] = {id(tree.root): -1}
+
+    def walk(node: TreeNode, parent_id: int) -> None:
+        for child in node.children.values():
+            nid = len(nodes)
+            ids[id(child)] = nid
+            value = child.value
+            nodes.append(
+                {
+                    "parent": parent_id,
+                    "key": np.asarray(child.key, dtype=np.int32).tolist(),
+                    "value": (
+                        None
+                        if value is None
+                        else np.asarray(value, dtype=np.int32).tolist()
+                    ),
+                    "last_access_time": child.last_access_time,
+                    "hit_count": child.hit_count,
+                }
+            )
+            walk(child, nid)
+
+    walk(tree.root, -1)
+    return {"version": 1, "page_size": tree.page_size, "nodes": nodes}
+
+
+def tree_restore(snapshot: dict, tree: RadixTree) -> int:
+    """Rebuild ``tree`` (cleared first) from a snapshot; returns the number
+    of nodes restored. The caller re-registers slot ownership with its KV
+    pool allocator before serving resumes."""
+    if snapshot.get("version") != 1:
+        raise ValueError(f"unknown snapshot version {snapshot.get('version')}")
+    if snapshot["page_size"] != tree.page_size:
+        raise ValueError("snapshot page_size mismatch")
+    # Detach on_free during the rebuild: reset() must not free pool slots
+    # that the snapshot is about to re-claim.
+    on_free, tree.on_free = tree.on_free, None
+    try:
+        tree.reset()
+    finally:
+        tree.on_free = on_free
+    restored: list[TreeNode] = []
+    for rec in snapshot["nodes"]:
+        parent = tree.root if rec["parent"] < 0 else restored[rec["parent"]]
+        node = TreeNode(parent=parent)
+        node.key = np.asarray(rec["key"], dtype=np.int32)
+        node.value = (
+            None if rec["value"] is None else np.asarray(rec["value"], dtype=np.int32)
+        )
+        node.last_access_time = rec["last_access_time"]
+        node.hit_count = rec["hit_count"]
+        parent.children[tree._child_key(node.key)] = node
+        tree.evictable_size_ += len(node.key)
+        restored.append(node)
+    return len(restored)
+
+
+def save_tree(path: str, tree: RadixTree) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(tree_snapshot(tree), f)
+    os.replace(tmp, path)  # atomic on POSIX
+
+
+def load_tree(path: str, tree: RadixTree) -> int:
+    with open(path) as f:
+        return tree_restore(json.load(f), tree)

@@ -1,0 +1,118 @@
+"""Checkpoint/resume tests: orbax weight round-trip (incl. restore onto a
+sharded mesh) and radix-tree snapshot/restore."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.radix_tree import RadixTree
+from radixmesh_tpu.checkpoint import (
+    load_params,
+    load_tree,
+    save_params,
+    save_tree,
+    tree_restore,
+    tree_snapshot,
+)
+from radixmesh_tpu.models.llama import ModelConfig, init_params, param_logical_axes
+from radixmesh_tpu.parallel.sharding import MeshPlan, make_mesh, param_sharding
+
+
+class TestParamsCheckpoint:
+    def test_round_trip(self, tmp_path):
+        cfg = ModelConfig.tiny().replace(dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        path = str(tmp_path / "ckpt")
+        save_params(path, params)
+        restored = load_params(path)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params,
+            restored,
+        )
+
+    def test_restore_onto_mesh(self, tmp_path):
+        cfg = ModelConfig.tiny().replace(dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        path = str(tmp_path / "ckpt")
+        save_params(path, params)
+
+        mesh = make_mesh(MeshPlan(dp=1, sp=1, tp=2))
+        shardings = param_sharding(param_logical_axes(cfg), mesh)
+        like = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            params,
+            shardings,
+        )
+        restored = load_params(path, like=like)
+        wq = restored["layers"]["wq"]
+        qd = cfg.n_heads * cfg.head_dim
+        assert {s.data.shape[-1] for s in wq.addressable_shards} == {qd // 2}
+        np.testing.assert_array_equal(
+            np.asarray(wq), np.asarray(params["layers"]["wq"])
+        )
+
+
+def build_tree(page_size=1):
+    tree = RadixTree(page_size=page_size)
+    tree.insert([1, 2, 3, 4], np.arange(4, dtype=np.int32))
+    tree.insert([1, 2, 9, 9], np.array([0, 1, 10, 11], dtype=np.int32))
+    tree.insert([7, 7], np.array([20, 21], dtype=np.int32))
+    return tree
+
+
+class TestTreeSnapshot:
+    def test_round_trip_preserves_matches(self):
+        tree = build_tree()
+        snap = tree_snapshot(tree)
+        tree2 = RadixTree(page_size=1)
+        n = tree_restore(snap, tree2)
+        assert n >= 4  # root split produced at least [1,2], [3,4], [9,9], [7,7]
+        for key in ([1, 2, 3, 4], [1, 2, 9, 9], [7, 7], [1, 2], [7, 7, 8]):
+            a, b = tree.match_prefix(key), tree2.match_prefix(key)
+            assert a.length == b.length
+            np.testing.assert_array_equal(a.indices(), b.indices())
+        assert tree2.total_size() == tree.total_size()
+        assert tree2.evictable_size() == tree.evictable_size()
+
+    def test_file_round_trip(self, tmp_path):
+        tree = build_tree()
+        path = str(tmp_path / "tree.json")
+        save_tree(path, tree)
+        tree2 = RadixTree(page_size=1)
+        load_tree(path, tree2)
+        assert tree2.match_prefix([1, 2, 3, 4]).length == 4
+
+    def test_restore_does_not_free_pool_slots(self):
+        freed = []
+        tree = RadixTree(page_size=1, on_free=lambda s: freed.extend(s.tolist()))
+        tree.insert([5, 6], np.array([0, 1], dtype=np.int32))
+        snap = tree_snapshot(tree)
+        tree_restore(snap, tree)  # restore over itself
+        assert freed == []  # reset during restore must not free slots
+        assert tree.match_prefix([5, 6]).length == 2
+
+    def test_page_size_mismatch_rejected(self):
+        snap = tree_snapshot(build_tree())
+        with pytest.raises(ValueError):
+            tree_restore(snap, RadixTree(page_size=4))
+
+    def test_lru_order_survives(self):
+        tree = RadixTree(page_size=1)
+        t = [0.0]
+
+        def clock():
+            t[0] += 1
+            return t[0]
+
+        tree._time = clock
+        tree.insert([1, 1], np.array([0, 1], dtype=np.int32))
+        tree.insert([2, 2], np.array([2, 3], dtype=np.int32))
+        tree.match_prefix([1, 1])  # refresh access time of [1,1]
+        snap = tree_snapshot(tree)
+        freed = []
+        tree2 = RadixTree(page_size=1, on_free=lambda s: freed.extend(s.tolist()))
+        tree_restore(snap, tree2)
+        tree2.evict(2)  # should evict LRU leaf = [2,2]
+        assert sorted(freed) == [2, 3]
