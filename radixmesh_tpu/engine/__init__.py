@@ -16,7 +16,25 @@ runtime TPU-first (SURVEY §7 stage 5):
   unlocked tree leaves.
 """
 
+from radixmesh_tpu.engine.disagg import (
+    DecodeWorker,
+    HandoffPacket,
+    PrefillWorker,
+    pack_handoff,
+    unpack_handoff,
+)
 from radixmesh_tpu.engine.engine import Engine, EngineStats
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 
-__all__ = ["Engine", "EngineStats", "Request", "RequestState", "SamplingParams"]
+__all__ = [
+    "Engine",
+    "EngineStats",
+    "Request",
+    "RequestState",
+    "SamplingParams",
+    "PrefillWorker",
+    "DecodeWorker",
+    "HandoffPacket",
+    "pack_handoff",
+    "unpack_handoff",
+]

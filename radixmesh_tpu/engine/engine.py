@@ -189,24 +189,63 @@ class Engine:
                 return  # pool exhausted even after evict: wait for finishes
             self.waiting.pop(0)
 
-    def _prefill(self, req: Request, row: int) -> bool:
+    def _acquire_prompt_slots(
+        self, req: Request
+    ) -> tuple[int, np.ndarray, np.ndarray] | None:
+        """Lock the longest cached prefix of ``req.prompt`` and allocate
+        pages for the remainder. Returns ``(reuse, prefix_slots, own)``, or
+        ``None`` after full rollback if the pool can't satisfy it. Reuse is
+        page-aligned and always leaves ≥1 token uncached so prefill has
+        logits to sample the first output token from."""
         prompt = req.prompt
         match = self.tree.match_prefix(prompt)
-        # Reuse the cached prefix, but always leave ≥1 token to prefill so
-        # there are logits to sample the first output token from.
         reuse = min(
             match.length, (len(prompt) - 1) // self.page_size * self.page_size
         )
         prefix_slots = match.indices()[:reuse]
         self.tree.inc_lock_ref(match.last_node)
         req.lock_node = match.last_node
-
-        n_new = len(prompt) - reuse
-        own = self._alloc_pages(-(-n_new // self.page_size))
+        own = self._alloc_pages(-(-(len(prompt) - reuse) // self.page_size))
         if own is None:
             self.tree.dec_lock_ref(req.lock_node)
             req.lock_node = None
+            return None
+        return reuse, prefix_slots, own
+
+    def _install_running(self, req: Request, row: int, reuse: int) -> None:
+        """Shared tail of admission (collocated prefill and disaggregated
+        handoff): mark RUNNING, record stats, publish the prompt
+        (``cache_unfinished_req``, ``radix_cache.py:488-519``), and wire the
+        decode row. ``req.kv_len``/``token_slots``/``own_slots``/
+        ``output_tokens``/timing must already be set."""
+        req.prefix_len = reuse
+        req.state = RequestState.RUNNING
+        req.row = row
+
+        self.stats.prefills += 1
+        self.stats.prompt_tokens += len(req.prompt)
+        self.stats.cached_tokens += reuse
+        self.stats.ttft_s.append(req.first_token_time - req.submit_time)
+
+        self._publish(req, len(req.prompt))
+
+        self._rows[row] = req
+        self._tokens[row] = req.output_tokens[-1]
+        self._temps[row] = req.sampling.temperature
+        self._top_ps[row] = req.sampling.top_p
+        self._page_table[row] = self._scratch_page
+        n_pages = -(-req.kv_len // self.page_size)
+        self._page_table[row, :n_pages] = (
+            req.token_slots[:: self.page_size] // self.page_size
+        )
+
+    def _prefill(self, req: Request, row: int) -> bool:
+        prompt = req.prompt
+        acquired = self._acquire_prompt_slots(req)
+        if acquired is None:
             return False
+        reuse, prefix_slots, own = acquired
+        n_new = len(prompt) - reuse
 
         s_b = _pow2_at_least(n_new)
         p_b = _pow2_at_least(reuse, floor=self.page_size) if reuse else 0
@@ -240,35 +279,12 @@ class Engine:
                 top_p=req.sampling.top_p,
             )[0]
         )
-        now = time.monotonic()
-        req.first_token_time = now
+        req.first_token_time = time.monotonic()
         req.output_tokens = [first]
-        req.prefix_len = reuse
         req.kv_len = len(prompt)
         req.token_slots = np.concatenate([prefix_slots, own[:n_new]])
         req.own_slots = own
-        req.state = RequestState.RUNNING
-        req.row = row
-
-        self.stats.prefills += 1
-        self.stats.prompt_tokens += len(prompt)
-        self.stats.cached_tokens += reuse
-        self.stats.ttft_s.append(now - req.submit_time)
-
-        # cache_unfinished_req: publish the prompt so concurrent requests
-        # can reuse it immediately (radix_cache.py:488-519).
-        self._publish(req, len(prompt))
-
-        # Wire the decode row.
-        self._rows[row] = req
-        self._tokens[row] = first
-        self._temps[row] = req.sampling.temperature
-        self._top_ps[row] = req.sampling.top_p
-        self._page_table[row] = self._scratch_page
-        n_pages = -(-req.kv_len // self.page_size)
-        self._page_table[row, :n_pages] = (
-            req.token_slots[:: self.page_size] // self.page_size
-        )
+        self._install_running(req, row, reuse)
         return True
 
     # ------------------------------------------------------------------

@@ -15,6 +15,10 @@ ring). These are net-new TPU-first components required by the north star
   sequence lengths that exceed one chip's HBM (the 32k config).
 """
 
+from radixmesh_tpu.parallel.kv_transfer import (
+    make_kv_page_transfer,
+    prefill_to_decode_perm,
+)
 from radixmesh_tpu.parallel.ring_attention import (
     ring_attention,
     ring_self_attention,
@@ -36,6 +40,8 @@ __all__ = [
     "param_sharding",
     "shard_params",
     "batch_sharding",
+    "make_kv_page_transfer",
+    "prefill_to_decode_perm",
     "make_train_state",
     "make_train_step",
 ]

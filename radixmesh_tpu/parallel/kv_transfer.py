@@ -1,0 +1,69 @@
+"""Intra-slice KV-page movement over ICI via XLA collectives.
+
+The DCN bytes path (``engine/disagg.py``) is right across slices/pods; when
+the prefill and decode shards live on ONE TPU slice, the page block should
+ride the ICI mesh instead of bouncing through host RAM. SURVEY §5
+"distributed communication backend" calls for exactly this split:
+``collective_permute``/all-gather over ICI intra-slice, the framed
+transport over DCN across.
+
+Design: prefill and decode replicas are ranks along one mesh axis (e.g. the
+``dp`` axis carries `P` prefill shards then `D` decode shards). A handoff is
+a static source→destination rank map; the page block ``[n_pages, page,
+Hkv, D]`` moves with one ``ppermute`` — XLA overlaps it with whatever
+compute is in flight, and nothing touches the host.
+
+Shapes must be static under jit, so transfers move fixed-size page batches
+(SURVEY §7 hard part (b)): callers round a prompt's pages up to
+``n_pages`` and ignore the tail, exactly like the engine's power-of-two
+prefill buckets.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["make_kv_page_transfer", "prefill_to_decode_perm"]
+
+
+def prefill_to_decode_perm(
+    n_prefill: int, n_decode: int
+) -> list[tuple[int, int]]:
+    """Source→destination rank pairs sending each prefill rank's block to a
+    decode rank. Ranks follow the reference's global rank space: prefill
+    ``[0, P)`` then decode ``[P, P+D)`` (``config/cache_config.py:20-28``).
+
+    Requires ``n_prefill <= n_decode``: one ``ppermute`` needs unique
+    destinations, and a destination buffer can hold one source block. With
+    more prefill than decode ranks, issue one transfer per round of
+    ``n_decode`` senders instead (each round is a valid injective map)."""
+    if n_prefill <= 0 or n_decode <= 0:
+        raise ValueError("need at least one prefill and one decode rank")
+    if n_prefill > n_decode:
+        raise ValueError(
+            f"{n_prefill} prefill ranks cannot hand off to {n_decode} decode "
+            "ranks in one transfer (destinations must be unique); batch the "
+            "handoff into ceil(P/D) rounds"
+        )
+    return [(i, n_prefill + i) for i in range(n_prefill)]
+
+
+def make_kv_page_transfer(
+    mesh: Mesh,
+    axis_name: str,
+    perm: list[tuple[int, int]],
+):
+    """Returns a jitted ``transfer(block)``: ``block`` is sharded over
+    ``axis_name`` (one page batch per rank); each source rank's shard lands
+    on its destination rank. Ranks that are not a destination keep zeros —
+    the caller's page table decides what is live, so junk pages are never
+    referenced (same discipline as the engine's scratch page)."""
+
+    def shard_fn(x):
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    spec = P(axis_name)
+    return jax.jit(
+        jax.shard_map(shard_fn, mesh=mesh, in_specs=spec, out_specs=spec)
+    )
