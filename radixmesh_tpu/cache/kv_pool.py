@@ -84,6 +84,22 @@ class SlotAllocator:
         self._page_alloc_count[pg] = counts.astype(np.int32)
         return slots
 
+    def reserve(self, slots: np.ndarray) -> None:
+        """Claim *specific* slots (checkpoint restore: a snapshot's tree
+        nodes reference the slot ids they held when saved). Raises if any
+        requested slot is already allocated — restore targets a fresh pool."""
+        slots = np.asarray(slots, dtype=np.int32)
+        if slots.size == 0:
+            return
+        if np.any(self._slot_allocated[slots]):
+            raise ValueError("cannot reserve: slot(s) already allocated")
+        self._slot_allocated[slots] = True
+        pages, counts = np.unique(slots // self.page_size, return_counts=True)
+        newly_used = pages[self._page_alloc_count[pages] == 0]
+        self._page_alloc_count[pages] += counts.astype(np.int32)
+        used = set(int(p) for p in newly_used)
+        self._free_pages = [p for p in self._free_pages if p not in used]
+
     def free(self, slots: np.ndarray) -> None:
         slots = np.asarray(slots, dtype=np.int32)
         if slots.size == 0:
@@ -158,6 +174,9 @@ class PagedKVPool:
 
     def free(self, slots: np.ndarray) -> None:
         self.allocator.free(slots)
+
+    def reserve(self, slots: np.ndarray) -> None:
+        self.allocator.reserve(slots)
 
     @property
     def free_slots(self) -> int:

@@ -18,9 +18,11 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from radixmesh_tpu.cache.radix_tree import RadixTree, TreeNode
@@ -72,18 +74,27 @@ def load_params(path: str, like: Any | None = None) -> Any:
 # ---------------------------------------------------------------------------
 
 
-def tree_snapshot(tree: RadixTree) -> dict:
+def tree_snapshot(tree: RadixTree, pool=None) -> tuple[dict, dict]:
     """Serializable snapshot: every node's (key tokens, slot values, access
-    time, hit count), parent-linked by preorder id. Lock refs are NOT
-    saved — they're per-request runtime state and all requests are gone
-    after a restart."""
+    time, hit count), parent-linked by preorder id, plus the monotonic
+    clock it was taken at (restore rebases access times onto the restoring
+    process's clock — raw ``time.monotonic()`` values don't survive a
+    reboot). Lock refs are NOT saved — they're per-request runtime state
+    and all requests are gone after a restart.
+
+    Returns ``(meta, kv_arrays)``. With ``pool`` (a
+    :class:`~radixmesh_tpu.cache.kv_pool.PagedKVPool`), ``kv_arrays`` maps
+    preorder node id → that node's KV content ``[2, L, n, H, D]`` (float32,
+    a lossless container for bf16/f16 pools) so a restart can serve cache
+    hits from the restored tree; without it, ``kv_arrays`` is empty and the
+    snapshot is metadata-only (router/mesh replicas, where values carry no
+    local KV)."""
     nodes = []
-    ids: dict[int, int] = {id(tree.root): -1}
+    kv_arrays: dict[str, np.ndarray] = {}
 
     def walk(node: TreeNode, parent_id: int) -> None:
         for child in node.children.values():
             nid = len(nodes)
-            ids[id(child)] = nid
             value = child.value
             nodes.append(
                 {
@@ -98,20 +109,56 @@ def tree_snapshot(tree: RadixTree) -> dict:
                     "hit_count": child.hit_count,
                 }
             )
+            if pool is not None and value is not None:
+                slots = np.asarray(value, dtype=np.int32)
+                kv_arrays[str(nid)] = np.asarray(
+                    pool.gather(slots), dtype=np.float32
+                )
             walk(child, nid)
 
     walk(tree.root, -1)
-    return {"version": 1, "page_size": tree.page_size, "nodes": nodes}
+    meta = {
+        "version": 2,
+        "page_size": tree.page_size,
+        "clock": time.monotonic(),
+        "has_kv": pool is not None,
+        "nodes": nodes,
+    }
+    return meta, kv_arrays
 
 
-def tree_restore(snapshot: dict, tree: RadixTree) -> int:
+def tree_restore(
+    snapshot: dict,
+    tree: RadixTree,
+    pool=None,
+    kv_arrays: dict[str, np.ndarray] | None = None,
+) -> int:
     """Rebuild ``tree`` (cleared first) from a snapshot; returns the number
-    of nodes restored. The caller re-registers slot ownership with its KV
-    pool allocator before serving resumes."""
-    if snapshot.get("version") != 1:
+    of nodes restored.
+
+    With ``pool``, each node's slots are re-claimed in the (fresh) pool's
+    allocator and its saved KV content is written back, so the restored
+    tree serves real hits. Restoring slot-valued nodes into a pool
+    *without* their KV content is refused: the tree would reference pages
+    whose contents no longer exist and hits would decode garbage.
+    Metadata-only restore (``pool=None``) leaves the allocator alone and is
+    for replicas whose values carry no local KV."""
+    if snapshot.get("version") not in (1, 2):
         raise ValueError(f"unknown snapshot version {snapshot.get('version')}")
     if snapshot["page_size"] != tree.page_size:
         raise ValueError("snapshot page_size mismatch")
+    if pool is not None and not snapshot.get("has_kv"):
+        raise ValueError(
+            "snapshot has no KV content; restoring it into a KV pool would "
+            "serve hits from pages that were never rewritten — snapshot "
+            "with pool= to include KV, or restore with pool=None"
+        )
+    kv_arrays = kv_arrays or {}
+    # Rebase LRU clocks: a snapshot's monotonic timestamps are meaningless
+    # in a new process (whose clock restarts near 0) — shift so the
+    # snapshot's "now" maps to this process's now, preserving order.
+    now = time.monotonic()
+    snap_clock = snapshot.get("clock", now)
     # Detach on_free during the rebuild: reset() must not free pool slots
     # that the snapshot is about to re-claim.
     on_free, tree.on_free = tree.on_free, None
@@ -121,27 +168,54 @@ def tree_restore(snapshot: dict, tree: RadixTree) -> int:
         tree.on_free = on_free
     restored: list[TreeNode] = []
     for rec in snapshot["nodes"]:
+        nid = len(restored)
         parent = tree.root if rec["parent"] < 0 else restored[rec["parent"]]
         node = TreeNode(parent=parent)
         node.key = np.asarray(rec["key"], dtype=np.int32)
         node.value = (
             None if rec["value"] is None else np.asarray(rec["value"], dtype=np.int32)
         )
-        node.last_access_time = rec["last_access_time"]
+        node.last_access_time = now - max(
+            0.0, snap_clock - rec["last_access_time"]
+        )
         node.hit_count = rec["hit_count"]
         parent.children[tree._child_key(node.key)] = node
         tree.evictable_size_ += len(node.key)
+        if pool is not None and node.value is not None:
+            pool.reserve(node.value)
+            kv = kv_arrays.get(str(nid))
+            if kv is None:
+                raise ValueError(f"snapshot missing KV content for node {nid}")
+            # [2, L, n, H, D] float32 container → pool dtype on write.
+            pool.write(node.value, jnp.asarray(kv[0]), jnp.asarray(kv[1]))
+        # Re-chain the event journal: observers must see the restored
+        # contents, not an AllBlocksCleared followed by silence (parents
+        # precede children in preorder, so hash chaining is well-defined).
+        tree._record_store_event(node)
         restored.append(node)
     return len(restored)
 
 
-def save_tree(path: str, tree: RadixTree) -> None:
+def save_tree(path: str, tree: RadixTree, pool=None) -> None:
+    """Atomic snapshot to ``path`` (JSON metadata); with ``pool``, KV
+    content lands beside it at ``path + '.kv.npz'``."""
+    meta, kv_arrays = tree_snapshot(tree, pool=pool)
+    if pool is not None:
+        tmp_kv = path + ".kv.npz.tmp"
+        with open(tmp_kv, "wb") as f:
+            np.savez_compressed(f, **kv_arrays)
+        os.replace(tmp_kv, path + ".kv.npz")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump(tree_snapshot(tree), f)
+        json.dump(meta, f)
     os.replace(tmp, path)  # atomic on POSIX
 
 
-def load_tree(path: str, tree: RadixTree) -> int:
+def load_tree(path: str, tree: RadixTree, pool=None) -> int:
     with open(path) as f:
-        return tree_restore(json.load(f), tree)
+        meta = json.load(f)
+    kv_arrays = None
+    if pool is not None:
+        with np.load(path + ".kv.npz") as z:
+            kv_arrays = dict(z)
+    return tree_restore(meta, tree, pool=pool, kv_arrays=kv_arrays)

@@ -65,7 +65,7 @@ def build_tree(page_size=1):
 class TestTreeSnapshot:
     def test_round_trip_preserves_matches(self):
         tree = build_tree()
-        snap = tree_snapshot(tree)
+        snap, _ = tree_snapshot(tree)
         tree2 = RadixTree(page_size=1)
         n = tree_restore(snap, tree2)
         assert n >= 4  # root split produced at least [1,2], [3,4], [9,9], [7,7]
@@ -88,15 +88,103 @@ class TestTreeSnapshot:
         freed = []
         tree = RadixTree(page_size=1, on_free=lambda s: freed.extend(s.tolist()))
         tree.insert([5, 6], np.array([0, 1], dtype=np.int32))
-        snap = tree_snapshot(tree)
+        snap, _ = tree_snapshot(tree)
         tree_restore(snap, tree)  # restore over itself
         assert freed == []  # reset during restore must not free slots
         assert tree.match_prefix([5, 6]).length == 2
 
     def test_page_size_mismatch_rejected(self):
-        snap = tree_snapshot(build_tree())
+        snap, _ = tree_snapshot(build_tree())
         with pytest.raises(ValueError):
             tree_restore(snap, RadixTree(page_size=4))
+
+    def test_kv_content_round_trip(self, tmp_path):
+        """A pool-backed snapshot restores real KV into a fresh pool: the
+        allocator re-claims the saved slots and gathers return the saved
+        bytes — a restart can serve hits, not garbage."""
+        from radixmesh_tpu.cache.kv_pool import PagedKVPool
+
+        def fresh_pool():
+            return PagedKVPool(
+                num_slots=64, num_layers=2, num_kv_heads=2, head_dim=4,
+                page_size=4, dtype=jnp.float32,
+            )
+
+        pool = fresh_pool()
+        tree = RadixTree(page_size=4, on_free=pool.free)
+        slots = pool.alloc(8)
+        rng = np.random.default_rng(0)
+        k = jnp.asarray(rng.normal(size=(2, 8, 2, 4)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, 8, 2, 4)), jnp.float32)
+        pool.write(slots, k, v)
+        tree.insert(list(range(8)), slots)
+        path = str(tmp_path / "tree.json")
+        save_tree(path, tree, pool=pool)
+
+        pool2 = fresh_pool()
+        tree2 = RadixTree(page_size=4, on_free=pool2.free)
+        load_tree(path, tree2, pool=pool2)
+        m = tree2.match_prefix(list(range(8)))
+        assert m.length == 8
+        np.testing.assert_array_equal(m.indices(), slots)
+        np.testing.assert_allclose(
+            np.asarray(pool2.gather(slots)), np.asarray(pool.gather(slots))
+        )
+        # Restored slots are owned: the allocator won't hand them out again.
+        got = pool2.alloc(56)
+        assert got is not None and not set(got.tolist()) & set(slots.tolist())
+        assert pool2.alloc(8) is None
+
+    def test_restore_into_pool_without_kv_refused(self):
+        from radixmesh_tpu.cache.kv_pool import PagedKVPool
+
+        snap, _ = tree_snapshot(build_tree())
+        pool = PagedKVPool(num_slots=64, num_layers=1, num_kv_heads=1,
+                           head_dim=4, page_size=1, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="no KV content"):
+            tree_restore(snap, RadixTree(page_size=1), pool=pool)
+
+    def test_reserve_rejects_allocated_slots(self):
+        from radixmesh_tpu.cache.kv_pool import SlotAllocator
+
+        a = SlotAllocator(16, page_size=4)
+        got = a.alloc(4)
+        with pytest.raises(ValueError, match="already allocated"):
+            a.reserve(got)
+        a.reserve(np.array([8, 9, 10, 11], dtype=np.int32))
+        assert a.free_slots == 8  # 4 pages - alloc'd - reserved = 2 pages
+        a.free(np.array([8, 9, 10, 11], dtype=np.int32))
+        assert a.free_slots == 12
+
+    def test_restore_rebases_access_clock(self):
+        """Snapshot timestamps from a long-lived process must not pin
+        restored entries above fresh inserts in LRU order."""
+        tree = build_tree()
+        for n in tree._all_nodes():
+            if n is not tree.root:
+                n.last_access_time += 1e6  # "10 days of uptime"
+        snap, _ = tree_snapshot(tree)
+        snap["clock"] = snap["clock"] + 1e6
+        tree2 = RadixTree(page_size=1)
+        tree_restore(snap, tree2)
+        import time as _t
+
+        now = _t.monotonic()
+        for n in tree2._all_nodes():
+            if n is not tree2.root:
+                assert n.last_access_time <= now
+
+    def test_restore_emits_store_events(self):
+        tree = build_tree()
+        snap, _ = tree_snapshot(tree)
+        tree2 = RadixTree(page_size=1, enable_events=True)
+        n = tree_restore(snap, tree2)
+        events = tree2.take_events()
+        stored = [e for e in events if type(e).__name__ == "BlockStored"]
+        assert len(stored) == n
+        for node in tree2._all_nodes():
+            if node is not tree2.root:
+                assert node.block_hashes
 
     def test_lru_order_survives(self):
         tree = RadixTree(page_size=1)
@@ -110,7 +198,7 @@ class TestTreeSnapshot:
         tree.insert([1, 1], np.array([0, 1], dtype=np.int32))
         tree.insert([2, 2], np.array([2, 3], dtype=np.int32))
         tree.match_prefix([1, 1])  # refresh access time of [1,1]
-        snap = tree_snapshot(tree)
+        snap, _ = tree_snapshot(tree)
         freed = []
         tree2 = RadixTree(page_size=1, on_free=lambda s: freed.extend(s.tolist()))
         tree_restore(snap, tree2)
