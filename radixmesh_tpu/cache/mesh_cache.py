@@ -74,6 +74,7 @@ from radixmesh_tpu.cache.oplog import GCEntry, NodeKey, Oplog, OplogType, deseri
 from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode, as_key
 from radixmesh_tpu.comm.communicator import Communicator, create_communicator
 from radixmesh_tpu.config import MeshConfig, NodeRole
+from radixmesh_tpu.obs.metrics import get_registry
 from radixmesh_tpu.policy.conflict import NodeRankConflictResolver
 from radixmesh_tpu.policy.sync_algo import BaseSyncAlgo, get_sync_algo
 from radixmesh_tpu.utils.logging import get_logger
@@ -117,13 +118,44 @@ class MeshCache:
         self._logic_op = AtomicCounter()
         self.dup_nodes: dict[NodeKey, PrefillValue | RouterValue] = {}
         self.tick_counts: dict[int, int] = {}
-        self.metrics = {
-            "oplogs_sent": 0,
-            "oplogs_received": 0,
-            "conflicts": 0,
-            "gc_freed_slots": 0,
-            "gc_rounds": 0,
+        # Per-node label keeps series distinct when several nodes share a
+        # process (the inproc test harness runs whole rings in-process).
+        reg = get_registry()
+        node = f"{self.role.value}@{self.rank}"
+        self._m_sent = reg.counter(
+            "mesh_oplogs_sent_total", "oplogs enqueued for ring transmission", ("node",)
+        ).labels(node=node)
+        received = reg.counter(
+            "mesh_oplogs_received_total",
+            "oplogs received from the ring",
+            ("node", "type"),
+        )
+        # Pre-resolved per-type children: the receive path runs per message
+        # on the transport reader thread, so label resolution (set compare,
+        # sort, family lock) must not happen there.
+        self._m_received = {
+            t: received.labels(node=node, type=t.name) for t in OplogType
         }
+        self._m_dropped = reg.counter(
+            "mesh_oplogs_dropped_total",
+            "oplogs dropped on outbound-queue overflow",
+            ("node",),
+        ).labels(node=node)
+        self._m_conflicts = reg.counter(
+            "mesh_conflicts_total", "multi-writer value conflicts resolved", ("node",)
+        ).labels(node=node)
+        self._m_gc_rounds = reg.counter(
+            "mesh_gc_rounds_total", "distributed GC query laps originated", ("node",)
+        ).labels(node=node)
+        self._m_gc_freed = reg.counter(
+            "mesh_gc_freed_slots_total", "KV slots reclaimed by distributed GC", ("node",)
+        ).labels(node=node)
+        self._m_lag = reg.histogram(
+            "mesh_oplog_lag_seconds",
+            "origin-to-apply replication lag (origin wall clock; skew degrades "
+            "telemetry only)",
+            ("node",),
+        ).labels(node=node)
 
         self._comm: Communicator | None = None
         self._router_comms: list[Communicator] = []
@@ -294,6 +326,17 @@ class MeshCache:
                 )
             )
 
+    @property
+    def metrics(self) -> dict[str, float]:
+        """Programmatic snapshot of this node's replication counters."""
+        return {
+            "oplogs_sent": self._m_sent.value,
+            "oplogs_dropped": self._m_dropped.value,
+            "conflicts": self._m_conflicts.value,
+            "gc_rounds": self._m_gc_rounds.value,
+            "gc_freed_slots": self._m_gc_freed.value,
+        }
+
     # lock-ref passthroughs (protect active requests from GC agreement)
     def inc_lock_ref(self, node: TreeNode) -> None:
         with self._lock:
@@ -310,8 +353,13 @@ class MeshCache:
     def oplog_received(self, data: bytes) -> None:
         """Transport callback (reference ``radix_mesh.py:391-420``)."""
         op = deserialize(data)
+        self._m_received[op.op_type].inc()
+        # Don't record lag for our own returning oplogs: that sample would
+        # be a full ring lap (the systematically largest value) with no
+        # apply behind it, inflating p99 for operators alerting on lag.
+        if op.ts and op.origin_rank != self.rank:
+            self._m_lag.observe(max(0.0, time.time() - op.ts))
         with self._lock:
-            self.metrics["oplogs_received"] += 1
             op.ttl -= 1
             if op.op_type is OplogType.TICK:
                 # Counted before the origin-drop so the originator observes
@@ -354,6 +402,7 @@ class MeshCache:
     def _broadcast(self, op: Oplog) -> None:
         """First transmission of a locally-originated oplog
         (reference ``radix_mesh.py:325-347``)."""
+        op.ts = time.time()
         self._send_bytes(serialize(op))
 
     def _forward(self, op: Oplog) -> None:
@@ -368,14 +417,15 @@ class MeshCache:
             return
         try:
             self._out_q.put_nowait(data)
-            self.metrics["oplogs_sent"] += 1
+            self._m_sent.inc()
         except queue.Full:
-            self.metrics["oplogs_dropped"] = self.metrics.get("oplogs_dropped", 0) + 1
-            if self.metrics["oplogs_dropped"] % 1000 == 1:
+            self._m_dropped.inc()
+            dropped = int(self._m_dropped.value)
+            if dropped % 1000 == 1:
                 self.log.error(
                     "outbound oplog queue full (%d dropped) — ring successor "
                     "unreachable for an extended period?",
-                    self.metrics["oplogs_dropped"],
+                    dropped,
                 )
 
     def _sender(self) -> None:
@@ -414,7 +464,7 @@ class MeshCache:
         """Called by the tree for each matched node whose value differs
         from the incoming segment (mesh values compare by origin rank);
         returns the winning value and records the loser for GC."""
-        self.metrics["conflicts"] += 1
+        self._m_conflicts.inc()
         full_key = self._full_key(child)
         if self.resolver.keep(child.value.rank, new_seg.rank):
             # Existing wins; the incoming copy is a duplicate
@@ -561,7 +611,7 @@ class MeshCache:
             ]
             if not entries:
                 return
-            self.metrics["gc_rounds"] += 1
+            self._m_gc_rounds.inc()
             self._broadcast(
                 Oplog(
                     op_type=OplogType.GC_QUERY,
@@ -629,4 +679,4 @@ class MeshCache:
             and len(loser.indices)
         ):
             self.pool.free(loser.indices)
-            self.metrics["gc_freed_slots"] += len(loser.indices)
+            self._m_gc_freed.inc(len(loser.indices))

@@ -38,8 +38,13 @@ __all__ = [
 ]
 
 _MAGIC = 0x52  # 'R'
-_VERSION = 1
-_HEADER = struct.Struct("<BBBxiqii")  # magic, ver, type, pad, origin, logic, ttl, value_rank
+_VERSION = 2  # v2 added ts (origin wall-clock, for replication-lag metrics)
+_HEADER = struct.Struct(
+    "<BBBxiqiid"
+)  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts
+# v1 header (no ts): a mixed-version ring during a rolling restart must keep
+# replicating, so v1 frames are still accepted (ts = 0.0 → lag not recorded).
+_HEADER_V1 = struct.Struct("<BBBxiqii")
 
 
 class OplogType(enum.IntEnum):
@@ -85,6 +90,10 @@ class Oplog:
     value: np.ndarray = field(default_factory=lambda: np.empty(0, dtype=np.int32))
     value_rank: int = -1  # origin rank of the *value* (INSERT); -1 if n/a
     gc: list[GCEntry] = field(default_factory=list)
+    # Origin wall-clock (time.time()) at creation; used only for the
+    # replication-lag histogram, so clock skew degrades telemetry, never
+    # correctness. 0.0 = unset.
+    ts: float = 0.0
 
     def __eq__(self, other) -> bool:
         return (
@@ -145,6 +154,7 @@ def serialize(op: Oplog) -> bytes:
             op.logic_id,
             op.ttl,
             op.value_rank,
+            op.ts,
         ),
         struct.pack("<III", len(key), len(value), len(op.gc)),
         key.tobytes(),
@@ -159,12 +169,18 @@ def serialize(op: Oplog) -> bytes:
 
 def deserialize(buf: bytes | memoryview) -> Oplog:
     buf = memoryview(buf)
-    magic, ver, op_type, origin, logic, ttl, value_rank = _HEADER.unpack_from(buf, 0)
+    magic, ver = buf[0], buf[1]
     if magic != _MAGIC:
         raise ValueError(f"bad oplog magic {magic:#x}")
-    if ver != _VERSION:
+    if ver == _VERSION:
+        _, _, op_type, origin, logic, ttl, value_rank, ts = _HEADER.unpack_from(buf, 0)
+        off = _HEADER.size
+    elif ver == 1:
+        _, _, op_type, origin, logic, ttl, value_rank = _HEADER_V1.unpack_from(buf, 0)
+        ts = 0.0
+        off = _HEADER_V1.size
+    else:
         raise ValueError(f"unsupported oplog version {ver}")
-    off = _HEADER.size
     key_len, val_len, n_gc = struct.unpack_from("<III", buf, off)
     off += 12
     key = np.frombuffer(buf, dtype=np.int32, count=key_len, offset=off).copy()
@@ -187,4 +203,5 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
         value=value,
         value_rank=value_rank,
         gc=gc,
+        ts=ts,
     )

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import uuid
 from typing import Any
 
 import jax
@@ -91,6 +92,7 @@ def tree_snapshot(tree: RadixTree, pool=None) -> tuple[dict, dict]:
     local KV)."""
     nodes = []
     kv_arrays: dict[str, np.ndarray] = {}
+    kv_jobs: list[tuple[str, np.ndarray]] = []  # (nid, slots)
 
     def walk(node: TreeNode, parent_id: int) -> None:
         for child in node.children.values():
@@ -110,13 +112,24 @@ def tree_snapshot(tree: RadixTree, pool=None) -> tuple[dict, dict]:
                 }
             )
             if pool is not None and value is not None:
-                slots = np.asarray(value, dtype=np.int32)
-                kv_arrays[str(nid)] = np.asarray(
-                    pool.gather(slots), dtype=np.float32
-                )
+                kv_jobs.append((str(nid), np.asarray(value, dtype=np.int32)))
             walk(child, nid)
 
     walk(tree.root, -1)
+    if kv_jobs:
+        # One padded gather for ALL nodes, split on host: per-node gathers
+        # would compile one XLA variant per distinct node length (the same
+        # compile storm PagedKVPool.write pads to avoid).
+        all_slots = np.concatenate([s for _, s in kv_jobs])
+        padded = 1 << (max(1, len(all_slots)) - 1).bit_length()
+        pad = np.full(padded - len(all_slots), all_slots[0], dtype=np.int32)
+        g = np.asarray(
+            pool.gather(np.concatenate([all_slots, pad])), dtype=np.float32
+        )
+        off = 0
+        for nid, slots in kv_jobs:
+            kv_arrays[nid] = g[:, :, off : off + len(slots)]
+            off += len(slots)
     meta = {
         "version": 2,
         "page_size": tree.page_size,
@@ -198,12 +211,24 @@ def tree_restore(
 
 def save_tree(path: str, tree: RadixTree, pool=None) -> None:
     """Atomic snapshot to ``path`` (JSON metadata); with ``pool``, KV
-    content lands beside it at ``path + '.kv.npz'``."""
+    content lands beside it at ``path + '.kv.npz'``.
+
+    The two files are replaced in separate (individually atomic) steps, so
+    a crash between them can leave metadata from one snapshot next to KV
+    from another. Both carry a shared random snapshot id that
+    :func:`load_tree` verifies — a torn pair fails loudly instead of
+    silently serving hits whose KV belongs to different token keys."""
     meta, kv_arrays = tree_snapshot(tree, pool=pool)
+    sid = uuid.uuid4().hex
+    meta["snapshot_id"] = sid
     if pool is not None:
         tmp_kv = path + ".kv.npz.tmp"
         with open(tmp_kv, "wb") as f:
-            np.savez_compressed(f, **kv_arrays)
+            np.savez_compressed(
+                f,
+                __snapshot_id__=np.frombuffer(sid.encode(), dtype=np.uint8),
+                **kv_arrays,
+            )
         os.replace(tmp_kv, path + ".kv.npz")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -218,4 +243,16 @@ def load_tree(path: str, tree: RadixTree, pool=None) -> int:
     if pool is not None:
         with np.load(path + ".kv.npz") as z:
             kv_arrays = dict(z)
+        kv_sid = kv_arrays.pop("__snapshot_id__", None)
+        meta_sid = meta.get("snapshot_id")
+        if meta_sid is not None or kv_sid is not None:
+            kv_sid_str = (
+                None if kv_sid is None else kv_sid.tobytes().decode(errors="replace")
+            )
+            if kv_sid_str != meta_sid:
+                raise ValueError(
+                    f"torn snapshot: metadata id {meta_sid!r} != KV id "
+                    f"{kv_sid_str!r} (crash between the two file replaces?) — "
+                    "take a fresh snapshot"
+                )
     return tree_restore(meta, tree, pool=pool, kv_arrays=kv_arrays)

@@ -21,6 +21,7 @@ TPU-first shape discipline:
 
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Iterable, Sequence
@@ -33,8 +34,13 @@ from radixmesh_tpu.cache.kv_pool import PagedKVPool
 from radixmesh_tpu.cache.radix_tree import RadixTree
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 from radixmesh_tpu.models.llama import ModelConfig, decode_step, prefill_forward
+from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
 from radixmesh_tpu.ops.sampling import sample_tokens
 from radixmesh_tpu.utils.logging import get_logger
+
+# Per-process engine sequence: disaggregated harnesses run a prefill engine
+# and a decode engine in one process, so each needs its own metric series.
+_engine_seq = itertools.count()
 
 __all__ = ["Engine", "EngineStats"]
 
@@ -80,6 +86,7 @@ class Engine:
         max_batch: int = 8,
         max_seq_len: int | None = None,
         rng_seed: int = 0,
+        name: str | None = None,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
@@ -117,6 +124,41 @@ class Engine:
         self._top_ps = np.ones(max_batch, dtype=np.float32)
         self._rng = jax.random.PRNGKey(rng_seed)
         self.stats = EngineStats()
+
+        reg = get_registry()
+        self.name = name or f"engine{next(_engine_seq)}"
+        lbl = {"engine": self.name}
+        self._m_prompt = reg.counter(
+            "engine_prompt_tokens_total", "prompt tokens admitted", ("engine",)
+        ).labels(**lbl)
+        self._m_cached = reg.counter(
+            "engine_cached_tokens_total",
+            "prompt tokens served from the radix cache",
+            ("engine",),
+        ).labels(**lbl)
+        self._m_generated = reg.counter(
+            "engine_generated_tokens_total", "tokens produced by decode", ("engine",)
+        ).labels(**lbl)
+        self._m_preempt = reg.counter(
+            "engine_preemptions_total",
+            "requests preempted under pool pressure",
+            ("engine",),
+        ).labels(**lbl)
+        self._m_ttft = reg.histogram(
+            "engine_ttft_seconds", "submit-to-first-token latency", ("engine",)
+        ).labels(**lbl)
+        self._m_tpot = reg.histogram(
+            "engine_tpot_seconds",
+            "batched decode step latency (== per-token latency for each "
+            "active request)",
+            ("engine",),
+        ).labels(**lbl)
+        self._m_hit_len = reg.histogram(
+            "engine_prefix_hit_tokens",
+            "prefix-cache hit length per admitted request (tokens)",
+            ("engine",),
+            buckets=TOKEN_LEN_BUCKETS,
+        ).labels(**lbl)
 
     # ------------------------------------------------------------------
     # public API
@@ -226,6 +268,10 @@ class Engine:
         self.stats.prompt_tokens += len(req.prompt)
         self.stats.cached_tokens += reuse
         self.stats.ttft_s.append(req.first_token_time - req.submit_time)
+        self._m_prompt.inc(len(req.prompt))
+        self._m_cached.inc(reuse)
+        self._m_ttft.observe(req.first_token_time - req.submit_time)
+        self._m_hit_len.observe(reuse)
 
         self._publish(req, len(req.prompt))
 
@@ -385,6 +431,7 @@ class Engine:
         active = [(row, r) for row, r in enumerate(self._rows) if r is not None]
         if not active:
             return
+        step_t0 = time.monotonic()
         self._lengths = lengths
         self._rng, key = jax.random.split(self._rng)
         logits, self.pool.kv = decode_step(
@@ -404,6 +451,10 @@ class Engine:
             )
         )
         self.stats.decode_steps += 1
+        # sample_tokens materialized on host above, so this spans the full
+        # dispatch+device time of the step — the per-token latency (TPOT)
+        # seen by every active request.
+        self._m_tpot.observe(time.monotonic() - step_t0)
 
         for row, req in active:
             fed = int(self._tokens[row])  # token whose KV was just written
@@ -417,10 +468,13 @@ class Engine:
                 if token in req.sampling.stop_token_ids:
                     req.output_tokens.pop()
                     self.stats.generated_tokens -= 1
+                else:
+                    self._m_generated.inc()
                 req.state = RequestState.FINISHED
                 self.stats.finished += 1
                 self._release(req)
             else:
+                self._m_generated.inc()
                 self._tokens[row] = token
 
     def _preempt(self, req: Request) -> None:
@@ -428,6 +482,7 @@ class Engine:
         have, free the row, and requeue from scratch (the generated tokens
         are discarded; the published KV makes the retry a long prefix hit)."""
         self.stats.preemptions += 1
+        self._m_preempt.inc()
         self._release(req)
         req.state = RequestState.QUEUED
         req.output_tokens = []

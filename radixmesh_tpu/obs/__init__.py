@@ -1,0 +1,23 @@
+"""Observability: metrics registry + profiler tracing (SURVEY §5)."""
+
+from radixmesh_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+    set_registry,
+)
+from radixmesh_tpu.obs.tracing import annotate, profile, timed
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "get_registry",
+    "set_registry",
+    "annotate",
+    "profile",
+    "timed",
+]

@@ -1,0 +1,358 @@
+"""Prometheus-style metrics: counters, gauges, histograms + text exposition.
+
+The reference has no metrics at all — its ``TreeNode.hit_count`` is never
+incremented and its benchmark emits no timings (SURVEY §5 "observability";
+``radix_cache.py:47``, ``benchmark.py:24-31``). This module supplies the
+rebuild's observability spine: hit-rate / hit-length, oplog traffic + lag,
+GC reclamation, TTFT/TPOT — exposed programmatically (:meth:`Registry.snapshot`)
+and in Prometheus text exposition format (:meth:`Registry.render`) for
+scraping by the serving frontend.
+
+Design notes: metric families are registered once per (name, type); calling
+a registry factory again returns the existing family, so modules can grab
+their metrics at construction time without coordinating. Label sets
+materialize child series on first use. All mutation is lock-guarded —
+series are updated from transport reader threads, the engine loop, and GC
+threads concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable, Sequence
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "get_registry",
+    "set_registry",
+    "DEFAULT_BUCKETS",
+    "TOKEN_LEN_BUCKETS",
+]
+
+# Latency-oriented default buckets (seconds): 1ms .. 60s.
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+# Token-count buckets (powers of two through the 32k long-context config,
+# BASELINE.json "configs") — shared by every hit-length/match-length
+# histogram so dashboards can compare them bucket-for-bucket.
+TOKEN_LEN_BUCKETS: tuple[float, ...] = tuple(float(1 << i) for i in range(16))
+
+
+def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+def _escape(v: str) -> str:
+    """Label-value escaping per the Prometheus exposition spec — an
+    unescaped quote/backslash/newline would make the whole scrape
+    unparseable, not just this series."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Family:
+    """One named metric family; holds labeled child series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: dict[tuple[tuple[str, str], ...], "_Family"] = {}
+        self._labels: tuple[tuple[str, str], ...] = ()
+
+    def labels(self, **labels: str):
+        """Child series for a concrete label assignment."""
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, got {tuple(labels)}"
+            )
+        key = _label_key({k: str(v) for k, v in labels.items()})
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child()
+                child._labels = key
+                self._children[key] = child
+            return child
+
+    def _new_child(self) -> "_Family":
+        return type(self)(self.name, self.help)
+
+    def _series(self) -> Iterable["_Family"]:
+        if self.label_names:
+            with self._lock:
+                return list(self._children.values())
+        return [self]
+
+    # subclasses: _render_lines(self) and snapshot value accessors
+
+
+class Counter(_Family):
+    """Monotonically increasing count."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", label_names: Sequence[str] = ()):
+        super().__init__(name, help, label_names)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _render_lines(self) -> list[str]:
+        return [
+            f"{self.name}{_fmt_labels(s._labels)} {_fmt_value(s._value)}"
+            for s in self._series()
+        ]
+
+
+class Gauge(_Family):
+    """Value that can go up and down."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "", label_names: Sequence[str] = ()):
+        super().__init__(name, help, label_names)
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _render_lines(self) -> list[str]:
+        return [
+            f"{self.name}{_fmt_labels(s._labels)} {_fmt_value(s._value)}"
+            for s in self._series()
+        ]
+
+
+class _HistTimer:
+    def __init__(self, hist: "Histogram"):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.monotonic() - self._t0)
+        return False
+
+
+class Histogram(_Family):
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations ≤ its upper bound; ``+Inf`` bucket == count)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+
+    def _new_child(self) -> "Histogram":
+        return Histogram(self.name, self.help, buckets=self.buckets)
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+
+    def time(self) -> _HistTimer:
+        """``with hist.time(): ...`` observes the block's wall time."""
+        return _HistTimer(self)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket upper bounds (exact enough for
+        p50/p99 telemetry; exact values need the raw samples)."""
+        with self._lock:
+            total = sum(self._counts)
+            if total == 0:
+                return 0.0
+            target = q * total
+            acc = 0
+            for i, ub in enumerate(self.buckets):
+                acc += self._counts[i]
+                if acc >= target:
+                    return ub
+            return float("inf")
+
+    def _render_lines(self) -> list[str]:
+        lines: list[str] = []
+        for s in self._series():
+            with s._lock:
+                cum = 0
+                for i, ub in enumerate(s.buckets):
+                    cum += s._counts[i]
+                    lbl = dict(s._labels)
+                    lbl["le"] = _fmt_value(ub)
+                    lines.append(
+                        f"{self.name}_bucket{_fmt_labels(_label_key(lbl))} {cum}"
+                    )
+                cum += s._counts[-1]
+                lbl = dict(s._labels)
+                lbl["le"] = "+Inf"
+                lines.append(f"{self.name}_bucket{_fmt_labels(_label_key(lbl))} {cum}")
+                lines.append(f"{self.name}_sum{_fmt_labels(s._labels)} {_fmt_value(s._sum)}")
+                lines.append(f"{self.name}_count{_fmt_labels(s._labels)} {cum}")
+        return lines
+
+
+class Registry:
+    """Named metric families; idempotent registration."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, label_names, **kw):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {fam.kind}"
+                    )
+                # A silent mismatch here would corrupt telemetry far from
+                # the bad registration — fail at registration time instead.
+                if tuple(label_names) != fam.label_names:
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{fam.label_names}, not {tuple(label_names)}"
+                    )
+                buckets = kw.get("buckets")
+                if buckets is not None and tuple(sorted(buckets)) != fam.buckets:
+                    raise ValueError(
+                        f"histogram {name!r} already registered with buckets "
+                        f"{fam.buckets}"
+                    )
+                return fam
+            fam = cls(name, help, label_names, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "", label_names: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, label_names)
+
+    def gauge(self, name: str, help: str = "", label_names: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, label_names)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, label_names, buckets=buckets)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: list[str] = []
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+        for f in families:
+            if f.help:
+                out.append(f"# HELP {f.name} {f.help}")
+            out.append(f"# TYPE {f.name} {f.kind}")
+            out.extend(f._render_lines())
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat programmatic view: scalar series by rendered name."""
+        snap: dict[str, float] = {}
+        with self._lock:
+            families = list(self._families.values())
+        for f in families:
+            for s in f._series():
+                key = f"{f.name}{_fmt_labels(s._labels)}"
+                if isinstance(s, Histogram):
+                    snap[key + "_count"] = s.count
+                    snap[key + "_sum"] = s.sum
+                else:
+                    snap[key] = s.value
+        return snap
+
+
+_default = Registry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> Registry:
+    """Process-wide default registry."""
+    return _default
+
+
+def set_registry(reg: Registry) -> Registry:
+    """Swap the process-wide default (tests use this for isolation)."""
+    global _default
+    with _default_lock:
+        _default = reg
+    return reg

@@ -1,0 +1,63 @@
+"""Profiling helpers over ``jax.profiler`` (SURVEY §5: the reference has no
+tracing at all — only rank-prefixed logging, ``util/log.py:5-13``).
+
+Two levels:
+
+- :func:`annotate` — named span inside an already-running trace; shows up
+  on the TensorBoard/xplane timeline alongside XLA ops. No-op overhead when
+  no trace is active.
+- :func:`profile` — capture a full device+host trace of a block into a
+  TensorBoard logdir.
+
+Both degrade to no-ops if the profiler backend is unavailable (e.g. some
+CPU-only CI images), so production code can annotate unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+from radixmesh_tpu.obs.metrics import Histogram
+
+__all__ = ["annotate", "profile", "timed"]
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span on the profiler timeline (xplane TraceAnnotation)."""
+    try:
+        import jax.profiler as _prof
+
+        cm = _prof.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler backend missing
+        cm = contextlib.nullcontext()
+    with cm:
+        yield
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture a device+host profiler trace of the block to ``log_dir``
+    (view with TensorBoard's profile plugin)."""
+    try:
+        import jax.profiler as _prof
+
+        cm = _prof.trace(log_dir)
+    except Exception:  # pragma: no cover - profiler backend missing
+        cm = contextlib.nullcontext()
+    with cm:
+        yield
+
+
+@contextlib.contextmanager
+def timed(hist: Histogram, name: str | None = None) -> Iterator[None]:
+    """Observe the block's wall time into ``hist`` and, when a profiler
+    trace is running, annotate the span with ``name``."""
+    t0 = time.monotonic()
+    with annotate(name or hist.name):
+        try:
+            yield
+        finally:
+            hist.observe(time.monotonic() - t0)

@@ -23,6 +23,7 @@ from typing import Sequence
 
 from radixmesh_tpu.cache.mesh_cache import MeshCache, RouterMatchResult
 from radixmesh_tpu.config import MeshConfig
+from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
 from radixmesh_tpu.router.consistent_hash import ConsistentHash
 
 __all__ = ["CacheAwareRouter", "RouteResult"]
@@ -49,6 +50,27 @@ class CacheAwareRouter:
         self._warm_up = True
         self._prefill_ring = ConsistentHash(config.prefill_nodes)
         self._decode_ring = ConsistentHash(config.decode_nodes)
+        reg = get_registry()
+        routed = reg.counter(
+            "router_requests_total",
+            "routing decisions by role and outcome",
+            ("role", "outcome"),
+        )
+        # Pre-resolved children: label resolution must not run (or be
+        # measured) inside the per-request timed region.
+        self._m_routed = {
+            (role, outcome): routed.labels(role=role, outcome=outcome)
+            for role in ("prefill", "decode")
+            for outcome in ("hit", "fallback")
+        }
+        self._m_route_latency = reg.histogram(
+            "router_route_seconds", "cache-aware routing decision latency"
+        )
+        self._m_match_len = reg.histogram(
+            "router_match_len_tokens",
+            "matched prefix length per routed request (tokens)",
+            buckets=TOKEN_LEN_BUCKETS,
+        )
 
     def finish_warm_up(self) -> None:
         """Enable cache-aware decisions (reference ``:20-21``)."""
@@ -66,6 +88,10 @@ class CacheAwareRouter:
 
     def cache_aware_route(self, key: Sequence[int]) -> RouteResult:
         """Route one request's token ids (reference ``:23-39``)."""
+        with self._m_route_latency.time():
+            return self._route(key)
+
+    def _route(self, key: Sequence[int]) -> RouteResult:
         if self._warm_up:
             match = RouterMatchResult(-1, -1)
         else:
@@ -86,6 +112,9 @@ class CacheAwareRouter:
         else:
             decode_addr = self._decode_ring.get_node(key)
             d_hit = False
+        self._m_routed[("prefill", "hit" if p_hit else "fallback")].inc()
+        self._m_routed[("decode", "hit" if d_hit else "fallback")].inc()
+        self._m_match_len.observe(match.match_len if (p_hit or d_hit) else 0)
         return RouteResult(
             prefill_addr=prefill_addr,
             decode_addr=decode_addr,

@@ -22,3 +22,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics_registry():
+    """Process-global metric counters must not leak between tests."""
+    from radixmesh_tpu.obs.metrics import Registry, get_registry, set_registry
+
+    old = get_registry()
+    set_registry(Registry())
+    yield
+    set_registry(old)

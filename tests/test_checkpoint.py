@@ -204,3 +204,41 @@ class TestTreeSnapshot:
         tree_restore(snap, tree2)
         tree2.evict(2)  # should evict LRU leaf = [2,2]
         assert sorted(freed) == [2, 3]
+
+
+class TestTornSnapshot:
+    def test_mismatched_kv_and_meta_rejected(self, tmp_path):
+        """A crash between the .kv.npz replace and the metadata replace
+        leaves files from two different snapshots; load must refuse the
+        pair rather than serve KV against the wrong token keys."""
+        from radixmesh_tpu.cache.kv_pool import PagedKVPool
+
+        def fresh_pool():
+            return PagedKVPool(
+                num_slots=64, num_layers=1, num_kv_heads=1, head_dim=4,
+                page_size=4, dtype=jnp.float32,
+            )
+
+        pool = fresh_pool()
+        tree = RadixTree(page_size=4, on_free=pool.free)
+        slots = pool.alloc(4)
+        pool.write(
+            slots,
+            jnp.zeros((1, 4, 1, 4), jnp.float32),
+            jnp.zeros((1, 4, 1, 4), jnp.float32),
+        )
+        tree.insert([1, 2, 3, 4], slots)
+        path = str(tmp_path / "tree.json")
+        save_tree(path, tree, pool=pool)
+        kv_bytes = (tmp_path / "tree.json.kv.npz").read_bytes()
+
+        # Second snapshot replaces both files; restore the FIRST snapshot's
+        # kv file next to the SECOND's metadata to simulate the torn state.
+        tree.insert([9, 9, 9, 9], pool.alloc(4))
+        save_tree(path, tree, pool=pool)
+        (tmp_path / "tree.json.kv.npz").write_bytes(kv_bytes)
+
+        pool2 = fresh_pool()
+        tree2 = RadixTree(page_size=4, on_free=pool2.free)
+        with pytest.raises(ValueError, match="torn snapshot"):
+            load_tree(path, tree2, pool=pool2)

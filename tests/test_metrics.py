@@ -1,0 +1,225 @@
+"""Observability subsystem: metric semantics, exposition format, and the
+hooks wired into the engine/mesh/router (SURVEY §5 — the reference ships no
+metrics; ``TreeNode.hit_count`` is never incremented, ``radix_cache.py:47``)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+    set_registry,
+)
+from radixmesh_tpu.obs.tracing import annotate, timed
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Isolate the process-wide registry per test."""
+    old = get_registry()
+    reg = set_registry(Registry())
+    yield reg
+    set_registry(old)
+
+
+class TestCounter:
+    def test_inc(self):
+        c = Counter("c")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError):
+            Counter("c").inc(-1)
+
+    def test_labels(self):
+        c = Counter("c", label_names=("op",))
+        c.labels(op="a").inc()
+        c.labels(op="a").inc()
+        c.labels(op="b").inc(7)
+        assert c.labels(op="a").value == 2
+        assert c.labels(op="b").value == 7
+
+    def test_wrong_labels_rejected(self):
+        c = Counter("c", label_names=("op",))
+        with pytest.raises(ValueError):
+            c.labels(other="x")
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = Gauge("g")
+        g.set(10)
+        g.inc(2)
+        g.dec(5)
+        assert g.value == 7
+
+
+class TestHistogram:
+    def test_cumulative_buckets(self):
+        h = Histogram("h", buckets=(1.0, 10.0))
+        for v in (0.5, 0.7, 5.0, 100.0):
+            h.observe(v)
+        assert h.count == 4
+        assert h.sum == pytest.approx(106.2)
+        text = Registry().render()  # empty registry renders fine
+        assert text == "\n"
+
+    def test_quantile(self):
+        h = Histogram("h", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.7, 3.0):
+            h.observe(v)
+        assert h.quantile(0.5) == 2.0
+        assert h.quantile(1.0) == 4.0
+
+    def test_timer(self):
+        h = Histogram("h")
+        with h.time():
+            pass
+        assert h.count == 1
+
+
+class TestRegistry:
+    def test_idempotent_registration(self, fresh_registry):
+        reg = fresh_registry
+        a = reg.counter("x_total", "help")
+        b = reg.counter("x_total")
+        assert a is b
+
+    def test_type_clash_rejected(self, fresh_registry):
+        reg = fresh_registry
+        reg.counter("x")
+        with pytest.raises(ValueError):
+            reg.gauge("x")
+
+    def test_render_exposition(self, fresh_registry):
+        reg = fresh_registry
+        reg.counter("req_total", "requests", ("code",)).labels(code="200").inc(3)
+        reg.gauge("temp").set(1.5)
+        h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = reg.render()
+        assert "# TYPE req_total counter" in text
+        assert 'req_total{code="200"} 3' in text
+        assert "# TYPE temp gauge" in text
+        assert "temp 1.5" in text
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1"} 1' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+        assert "lat_seconds_count 2" in text
+
+    def test_snapshot(self, fresh_registry):
+        reg = fresh_registry
+        reg.counter("a").inc(2)
+        reg.histogram("h").observe(1.0)
+        snap = reg.snapshot()
+        assert snap["a"] == 2
+        assert snap["h_count"] == 1
+
+    def test_thread_safety_smoke(self, fresh_registry):
+        c = fresh_registry.counter("c")
+
+        def worker():
+            for _ in range(1000):
+                c.inc()
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert c.value == 8000
+
+
+class TestTracing:
+    def test_annotate_noop(self):
+        with annotate("span"):
+            pass
+
+    def test_timed_observes(self):
+        h = Histogram("h")
+        with timed(h, "x"):
+            pass
+        assert h.count == 1
+
+
+class TestOplogTimestamp:
+    def test_ts_round_trips(self):
+        from radixmesh_tpu.cache.oplog import Oplog, OplogType, deserialize, serialize
+
+        op = Oplog(
+            op_type=OplogType.INSERT,
+            origin_rank=1,
+            logic_id=7,
+            ttl=3,
+            key=np.arange(4, dtype=np.int32),
+            value=np.arange(4, dtype=np.int32),
+            value_rank=1,
+            ts=1234.5,
+        )
+        assert deserialize(serialize(op)).ts == 1234.5
+
+
+class TestEngineMetrics:
+    def test_engine_populates_registry(self, fresh_registry):
+        from radixmesh_tpu.engine.engine import Engine
+        from radixmesh_tpu.models.llama import ModelConfig, init_params
+        import jax
+
+        cfg = ModelConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        eng = Engine(cfg, params, num_slots=512, page_size=4, max_batch=2, name="e0")
+        prompt = list(range(1, 20))
+        eng.generate([prompt], max_steps=30)
+        eng.generate([prompt], max_steps=30)  # second pass hits the cache
+        snap = fresh_registry.snapshot()
+        k = '{engine="e0"}'
+        assert snap[f"engine_prompt_tokens_total{k}"] == 2 * len(prompt)
+        assert snap[f"engine_cached_tokens_total{k}"] > 0
+        assert snap[f"engine_generated_tokens_total{k}"] > 0
+        assert snap[f"engine_ttft_seconds{k}_count"] == 2
+        assert snap[f"engine_tpot_seconds{k}_count"] >= 1
+        # counter == stats (the stop-token path must not diverge)
+        assert snap[f"engine_generated_tokens_total{k}"] == eng.stats.generated_tokens
+
+
+class TestMeshMetrics:
+    def test_ring_populates_lag_and_counters(self, fresh_registry):
+        from radixmesh_tpu.comm.inproc import InprocHub
+        from tests.test_mesh_cache import Cluster, insert_with_pool, wait_for
+
+        InprocHub.reset_default()
+        c = Cluster()
+        try:
+            c.wait_ready()
+            prefill = c.node(1)
+            insert_with_pool(prefill, [1, 2, 3])
+            assert wait_for(
+                lambda: all(
+                    n.match_prefix([1, 2, 3]).length == 3 for n in c.ring_nodes
+                )
+            )
+            snap = fresh_registry.snapshot()
+            lag = [
+                v
+                for k, v in snap.items()
+                if k.startswith("mesh_oplog_lag_seconds") and k.endswith("_count")
+            ]
+            assert sum(lag) > 0
+            sent = [v for k, v in snap.items() if k.startswith("mesh_oplogs_sent")]
+            assert sum(sent) > 0
+            assert prefill.metrics["oplogs_sent"] > 0
+            received = [
+                k
+                for k in snap
+                if k.startswith("mesh_oplogs_received_total") and "INSERT" in k
+            ]
+            assert received
+        finally:
+            c.close()
+            InprocHub.reset_default()

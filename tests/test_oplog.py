@@ -92,3 +92,30 @@ class TestNodeKey:
         d = {a: "x"}
         assert d[b] == "x"
         assert c not in d
+
+
+class TestWireVersionCompat:
+    def test_v1_frames_still_accepted(self):
+        """Rolling restart: a v2 node must apply frames from v1 peers
+        (24-byte header, no ts) instead of dropping their replication."""
+        import struct
+
+        key = np.array([1, 2, 3], dtype=np.int32)
+        value = np.array([10, 11, 12], dtype=np.int32)
+        v1 = b"".join(
+            [
+                struct.pack("<BBBxiqii", 0x52, 1, int(OplogType.INSERT), 4, 9, 5, 4),
+                struct.pack("<III", len(key), len(value), 0),
+                key.tobytes(),
+                value.tobytes(),
+            ]
+        )
+        op = deserialize(v1)
+        assert op.op_type is OplogType.INSERT
+        assert op.origin_rank == 4
+        assert op.logic_id == 9
+        assert op.ttl == 5
+        assert op.value_rank == 4
+        assert op.ts == 0.0
+        np.testing.assert_array_equal(op.key, key)
+        np.testing.assert_array_equal(op.value, value)
