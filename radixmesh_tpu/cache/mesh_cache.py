@@ -77,6 +77,7 @@ from radixmesh_tpu.config import MeshConfig, NodeRole
 from radixmesh_tpu.obs.metrics import get_registry
 from radixmesh_tpu.policy.conflict import NodeRankConflictResolver
 from radixmesh_tpu.policy.sync_algo import BaseSyncAlgo, get_sync_algo
+from radixmesh_tpu.policy.topology import TopologyView, decode_view, encode_view
 from radixmesh_tpu.utils.logging import get_logger
 from radixmesh_tpu.utils.sync import AtomicCounter
 
@@ -118,6 +119,21 @@ class MeshCache:
         self._logic_op = AtomicCounter()
         self.dup_nodes: dict[NodeKey, PrefillValue | RouterValue] = {}
         self.tick_counts: dict[int, int] = {}
+        # Elastic membership (policy/topology.py): every TTL and GC
+        # unanimity count derives from the CURRENT view, not static config.
+        self.view = TopologyView.initial(cfg)
+        self._succ_rank: int | None = None
+        self._pending_retarget: str | None = None
+        self._retarget_flag = threading.Event()
+        # A successor is "established" once its channel has been seen
+        # connected; until then sends block with unbounded patience (slow
+        # startup must not read as death). Reset on retarget.
+        self._succ_established = False
+        self._router_state: dict[int, dict] = {}
+        # Fired (under the mesh lock) as (old_view, new_view) after a view
+        # change is adopted; the router uses this to retire/restore hash-
+        # ring members. Keep callbacks cheap and non-blocking.
+        self.on_view_change: list = []
         # Per-node label keeps series distinct when several nodes share a
         # process (the inproc test harness runs whole rings in-process).
         reg = get_registry()
@@ -179,14 +195,19 @@ class MeshCache:
         ``radix_mesh.py:101-142``), startup and readiness are separate:
         call :meth:`wait_ready` to block on ring verification."""
         topo = self.sync.topo(self.cfg)
-        # Master fans out to routers over dedicated send-only channels
-        # (radix_mesh.py:103-109).
-        for router_addr in topo.routers:
-            self._router_comms.append(
-                create_communicator(
-                    self.cfg.protocol, None, router_addr, self.cfg.max_msg_bytes
+        # The view master fans out to routers over dedicated send-only
+        # channels (radix_mesh.py:103-109). Unlike the reference — where
+        # only static rank 0 even *holds* router channels — every P/D node
+        # opens them, because mastership moves to the lowest alive rank
+        # when nodes die (policy/topology.py). Channels are idle unless
+        # this node is the current master.
+        if self.role is not NodeRole.ROUTER:
+            for router_addr in self.cfg.router_nodes:
+                self._router_comms.append(
+                    create_communicator(
+                        self.cfg.protocol, None, router_addr, self.cfg.max_msg_bytes
+                    )
                 )
-            )
         self._comm = create_communicator(
             self.cfg.protocol,
             topo.bind_addr,
@@ -194,10 +215,23 @@ class MeshCache:
             self.cfg.max_msg_bytes,
         )
         self._comm.register_rcv_callback(self.oplog_received)
+        if self.role is not NodeRole.ROUTER:
+            self._succ_rank = self.view.successor_of(self.rank)
         # Mark started before spawning threads: the ticker's first tick must
         # not be dropped by the _started gate in _send_bytes.
         self._started = True
         if self.sync.can_send(self.cfg):
+            # Announce (re)join: on a cold cluster boot everyone is already
+            # in everyone's initial view and this is a no-op lap; after a
+            # restart it prompts the view master to re-include this node.
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.JOIN,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self._data_ttl(),
+                )
+            )
             t = threading.Thread(target=self._sender, daemon=True, name="mesh-sender")
             t.start()
             self._threads.append(t)
@@ -225,7 +259,37 @@ class MeshCache:
             time.sleep(0.01)
         return False
 
-    def close(self) -> None:
+    def close(self, graceful: bool = False) -> None:
+        """Stop threads and close transports. ``graceful=True`` first
+        announces a view without this node, so peers re-form the ring
+        immediately instead of waiting out ``failure_timeout_s``. The
+        default mimics a crash (what failure detection exists to handle)."""
+        if (
+            graceful
+            and self._started
+            and self.role is not NodeRole.ROUTER
+            and self._comm is not None
+            and not self._stop.is_set()
+        ):
+            with self._lock:
+                leave = self.view.without(self.rank)
+                data = serialize(
+                    Oplog(
+                        op_type=OplogType.TOPO,
+                        origin_rank=self.rank,
+                        logic_id=self._logic_op.next(),
+                        ttl=max(1, leave.ring_size),
+                        value=encode_view(leave),
+                        ts=time.time(),
+                    )
+                )
+            try:  # best-effort: the ring may already be gone
+                self._comm.try_send(data, 1.0)
+                if self.rank == self.view.master_rank():
+                    for rc in self._router_comms:
+                        rc.try_send(data, 1.0)
+            except Exception:  # noqa: BLE001
+                pass
         self._stop.set()  # sender thread polls _stop; no sentinel needed
         for t in self._threads:
             t.join(timeout=2)
@@ -256,7 +320,7 @@ class MeshCache:
                     op_type=OplogType.INSERT,
                     origin_rank=self.rank,
                     logic_id=self._logic_op.next(),
-                    ttl=self.sync.data_ttl(self.cfg),
+                    ttl=self._data_ttl(),
                     key=key,
                     value=np.asarray(slot_indices, dtype=np.int32),
                     value_rank=self.rank,
@@ -306,7 +370,7 @@ class MeshCache:
                         op_type=OplogType.DELETE,
                         origin_rank=self.rank,
                         logic_id=self._logic_op.next(),
-                        ttl=self.sync.data_ttl(self.cfg),
+                        ttl=self._data_ttl(),
                         key=key,
                     )
                 )
@@ -322,7 +386,7 @@ class MeshCache:
                     op_type=OplogType.RESET,
                     origin_rank=self.rank,
                     logic_id=self._logic_op.next(),
-                    ttl=self.sync.data_ttl(self.cfg),
+                    ttl=self._data_ttl(),
                 )
             )
 
@@ -373,6 +437,12 @@ class MeshCache:
             if op.op_type in (OplogType.GC_QUERY, OplogType.GC_EXEC):
                 self._gc_handle(op)
                 return
+            if op.op_type is OplogType.TOPO:
+                self._handle_topo(op)
+                return
+            if op.op_type is OplogType.JOIN:
+                self._handle_join(op)
+                return
             if op.origin_rank == self.rank:
                 return  # lap complete (radix_mesh.py:401-402)
             if op.ttl <= 0 and self.role is not NodeRole.ROUTER:
@@ -394,6 +464,154 @@ class MeshCache:
                 self._apply_reset()
             if op.ttl > 0:
                 self._forward(op)
+
+    # ------------------------------------------------------------------
+    # elastic membership (policy/topology.py; reference roadmap README.md:49-50)
+    # ------------------------------------------------------------------
+
+    def _data_ttl(self) -> int:
+        """One lap of the CURRENT ring (generalizes sync_algo's static
+        ``cfg.num_ring`` TTLs to elastic membership)."""
+        return max(1, self.view.ring_size)
+
+    def _tick_ttl(self) -> int:
+        return 2 * max(1, self.view.ring_size)
+
+    def _gc_ttl(self) -> int:
+        return max(1, self.view.ring_size)
+
+    def _handle_topo(self, op: Oplog) -> None:
+        """Caller holds the lock; ttl already decremented."""
+        try:
+            view = decode_view(op.value)
+        except ValueError:
+            self.log.error("malformed TOPO payload from rank %d", op.origin_rank)
+            return
+        self._adopt_view(view)
+        if op.origin_rank != self.rank and op.ttl > 0:
+            self._forward(op)
+
+    def _handle_join(self, op: Oplog) -> None:
+        """A node announced it is (re)joining. The current view master
+        answers with a view that re-includes it; everyone forwards so the
+        JOIN reaches the master wherever it sits. Caller holds the lock."""
+        if op.origin_rank == self.rank:
+            return  # lap complete
+        joiner = op.origin_rank
+        if not self.view.contains(joiner) and self.rank == self.view.master_rank():
+            new_view = self.view.including(joiner)
+            self.log.info(
+                "rank %d rejoining: announcing view epoch=%d alive=%s",
+                joiner, new_view.epoch, new_view.alive,
+            )
+            self._adopt_view(new_view)
+            self._announce_view(new_view)
+        if op.ttl > 0:
+            self._forward(op)
+
+    def _adopt_view(self, view: TopologyView) -> bool:
+        """Adopt ``view`` if it supersedes the current one (higher epoch
+        wins; equal-epoch conflicts merge by intersection one epoch up —
+        both detectors' removals take effect). Caller holds the lock."""
+        cur = self.view
+        if view.epoch < cur.epoch:
+            return False
+        if view.epoch == cur.epoch:
+            if view.alive == cur.alive:
+                return False
+            view = cur.merged_with(view)
+            self.view = view
+            self._after_view_change(cur)
+            self._announce_view(view)  # peers must learn the merge result
+            return True
+        self.view = view
+        self._after_view_change(cur)
+        return True
+
+    def _announce_view(self, view: TopologyView) -> None:
+        self._broadcast(
+            Oplog(
+                op_type=OplogType.TOPO,
+                origin_rank=self.rank,
+                logic_id=self._logic_op.next(),
+                ttl=self._data_ttl(),
+                value=encode_view(view),
+            )
+        )
+
+    def _after_view_change(self, old: TopologyView) -> None:
+        """Recompute the ring successor and notify listeners. Caller holds
+        the lock. The actual transport retarget happens on the sender
+        thread (``_apply_pending_retarget``) so the receive path never
+        blocks on an in-flight send."""
+        view = self.view
+        self.log.info(
+            "topology view epoch=%d alive=%s (was epoch=%d alive=%s)",
+            view.epoch, view.alive, old.epoch, old.alive,
+        )
+        if self.role is not NodeRole.ROUTER:
+            new_succ = view.successor_of(self.rank)
+            if new_succ != self._succ_rank:
+                self._succ_rank = new_succ
+                self._pending_retarget = (
+                    None if new_succ is None else self.cfg.addr_of_rank(new_succ)
+                )
+                self._retarget_flag.set()
+            if not view.contains(self.rank):
+                # Falsely declared dead (we're alive enough to receive
+                # this): ask to be re-included.
+                self.log.warning("this node was removed from the view; rejoining")
+                self._broadcast(
+                    Oplog(
+                        op_type=OplogType.JOIN,
+                        origin_rank=self.rank,
+                        logic_id=self._logic_op.next(),
+                        ttl=self._data_ttl(),
+                    )
+                )
+        for fn in self.on_view_change:
+            try:
+                fn(old, view)
+            except Exception:  # noqa: BLE001 — listener bugs must not break adoption
+                self.log.exception("view-change listener failed")
+
+    def _declare_successor_dead(self) -> None:
+        """Sender-side failure detection fired: the current successor has
+        been unreachable for ``failure_timeout_s``. Adopt a view without
+        it and announce the new view around the re-formed ring."""
+        with self._lock:
+            dead = self._succ_rank
+            if dead is None:
+                return
+            self.log.warning(
+                "ring successor rank %d unreachable for %.1fs — declaring it "
+                "dead and re-forming the ring",
+                dead, self.cfg.failure_timeout_s,
+            )
+            old = self.view
+            new_view = old.without(dead)
+            self.view = new_view
+            self._after_view_change(old)
+            self._announce_view(new_view)
+
+    def _apply_pending_retarget(self) -> None:
+        """Runs on the sender thread only (serialized with sends)."""
+        if not self._retarget_flag.is_set():
+            return
+        with self._lock:
+            target = self._pending_retarget
+            self._retarget_flag.clear()
+        try:
+            self._comm.retarget(target)
+            # A retarget destination is a current view member (it was alive
+            # enough to be in an adopted view / send JOIN), so it gets the
+            # failure deadline, NOT first-contact unbounded patience — a
+            # double failure must fire detection again, not wedge the
+            # sender in a blocking send to a second dead peer. A slow
+            # rejoiner spuriously re-declared dead simply rejoins again.
+            self._succ_established = True
+        except Exception:  # noqa: BLE001
+            self.log.exception("failed to retarget ring successor to %s", target)
 
     # ------------------------------------------------------------------
     # replication: send path
@@ -433,21 +651,98 @@ class MeshCache:
         touches the network, so a slow/unreachable successor can never
         stall tree operations. Polls with a timeout instead of a queue
         sentinel: close() on a *full* queue must not need to enqueue
-        anything to stop this thread."""
+        anything to stop this thread.
+
+        This is also where failure detection lives: in a unidirectional
+        ring only a node's predecessor can observe its death, as the
+        transmit channel stops delivering. The first delivery to each
+        successor blocks indefinitely (cluster startup — peers may still be
+        binding, like the reference's connect-retry loop,
+        ``communicator.py:162-178``); established successors get
+        ``failure_timeout_s`` before being declared dead and ringed around
+        (``_declare_successor_dead``)."""
         while not self._stop.is_set():
+            self._apply_pending_retarget()
             try:
                 data = self._out_q.get(timeout=0.2)
             except queue.Empty:
                 continue
+            while not self._stop.is_set():
+                with self._lock:
+                    has_succ = self._succ_rank is not None
+                if self._retarget_flag.is_set():
+                    self._apply_pending_retarget()
+                    continue
+                if not has_succ:
+                    break  # sole survivor: nothing to ring (fan-out below)
+                try:
+                    if not self._succ_established:
+                        # Never-seen-alive successors get unbounded patience
+                        # (cluster startup: the peer may still be binding,
+                        # like the reference's connect-retry loop). Only a
+                        # peer seen connected at least once can be suspected.
+                        self._comm.send(data)
+                        self._succ_established = self._comm.connected()
+                        break
+                    if self._comm.try_send(data, self.cfg.failure_timeout_s):
+                        break
+                except Exception:  # noqa: BLE001 — transport errors must not kill the sender
+                    if not self._stop.is_set():
+                        self.log.exception("failed to transmit oplog")
+                    break
+                self._declare_successor_dead()
+            # The CURRENT view master fans out to routers (generalizes the
+            # reference's static rank-0 fan-out, radix_mesh.py:344-347, so
+            # routers keep learning the tree after rank 0 dies).
+            with self._lock:
+                is_master = self.rank == self.view.master_rank()
+            if is_master:
+                self._fan_out_to_routers(data)
+
+    def _fan_out_to_routers(self, data: bytes) -> None:
+        """Bounded fan-out: routers are OUTSIDE the ring, so their
+        unavailability must never cost ring liveness — attempts are
+        deadline-bounded and an unreachable router is backed off (its
+        fan-outs dropped) instead of stalling the sender thread per
+        message. A dropped fan-out costs the router cache hits until the
+        next circulating oplog, not correctness."""
+        now = time.monotonic()
+        for rc in self._router_comms:
+            st = self._router_state.setdefault(
+                id(rc), {"established": False, "retry_at": 0.0}
+            )
+            if now < st["retry_at"]:
+                continue  # backing off an unreachable router
+            # Short probe before first contact (a still-booting router just
+            # misses some fan-outs and catches up); full deadline once live.
+            timeout = (
+                self.cfg.failure_timeout_s
+                if st["established"]
+                else min(1.0, self.cfg.failure_timeout_s)
+            )
             try:
-                self._comm.send(data)
-                if self.rank == self.sync.master_rank(self.cfg):
-                    # Master fans out to routers (radix_mesh.py:344-347).
-                    for rc in self._router_comms:
-                        rc.send(data)
-            except Exception:  # noqa: BLE001 — transport errors must not kill the sender
+                if rc.try_send(data, timeout):
+                    st["established"] = True
+                    st["retry_at"] = 0.0
+                else:
+                    # Short retry cadence pre-first-contact (a booting
+                    # router should start receiving within ~a second of
+                    # coming up); long backoff for a router that was live
+                    # and went away.
+                    st["retry_at"] = time.monotonic() + (
+                        self.cfg.failure_timeout_s
+                        if st["established"]
+                        else min(1.0, self.cfg.failure_timeout_s)
+                    )
+                    if st["established"]:
+                        self.log.error(
+                            "router %s unreachable; backing off fan-out",
+                            rc.target_address(),
+                        )
+                    st["established"] = False
+            except Exception:  # noqa: BLE001
                 if not self._stop.is_set():
-                    self.log.exception("failed to transmit oplog")
+                    self.log.exception("router fan-out failed")
 
     # ------------------------------------------------------------------
     # tree mutation with conflict resolution
@@ -551,6 +846,10 @@ class MeshCache:
         the deepest decode writer win (reference ``radix_mesh.py:219-238``)."""
         prefill_rank = decode_rank = -1
         for v in reversed(values):
+            # Dead nodes (outside the current view) must not win routing:
+            # their cached prefixes are unreachable until they rejoin.
+            if not self.view.contains(v.rank):
+                continue
             if prefill_rank == -1 and self.cfg.is_prefill_rank(v.rank):
                 prefill_rank = v.rank
             if decode_rank == -1 and self.cfg.is_decode_rank(v.rank):
@@ -577,7 +876,7 @@ class MeshCache:
                     op_type=OplogType.TICK,
                     origin_rank=self.rank,
                     logic_id=self._logic_op.next(),
-                    ttl=self.sync.tick_ttl(self.cfg),
+                    ttl=self._tick_ttl(),
                 )
             )
             self._stop.wait(self.cfg.tick_interval_s)
@@ -617,7 +916,7 @@ class MeshCache:
                     op_type=OplogType.GC_QUERY,
                     origin_rank=self.rank,
                     logic_id=self._logic_op.next(),
-                    ttl=self.sync.gc_ttl(self.cfg),
+                    ttl=self._gc_ttl(),
                     gc=entries,
                 )
             )
@@ -639,7 +938,7 @@ class MeshCache:
             if op.origin_rank == self.rank:
                 # Query completed its lap: unanimity = every ring member
                 # agreed (radix_mesh.py:368-384).
-                unanimous = [e for e in op.gc if e.agree >= self.cfg.num_ring]
+                unanimous = [e for e in op.gc if e.agree >= self.view.ring_size]
                 if not unanimous:
                     return
                 for e in unanimous:
@@ -649,7 +948,7 @@ class MeshCache:
                         op_type=OplogType.GC_EXEC,
                         origin_rank=self.rank,
                         logic_id=self._logic_op.next(),
-                        ttl=self.sync.gc_ttl(self.cfg),
+                        ttl=self._gc_ttl(),
                         gc=[GCEntry(e.key, e.value_rank, e.agree) for e in unanimous],
                     )
                 )

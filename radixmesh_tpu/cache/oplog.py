@@ -22,6 +22,7 @@ Deliberate departures from the reference:
 from __future__ import annotations
 
 import enum
+import os
 import struct
 from dataclasses import dataclass, field
 from typing import Sequence
@@ -35,6 +36,7 @@ __all__ = [
     "NodeKey",
     "serialize",
     "deserialize",
+    "set_emit_version",
 ]
 
 _MAGIC = 0x52  # 'R'
@@ -42,9 +44,23 @@ _VERSION = 2  # v2 added ts (origin wall-clock, for replication-lag metrics)
 _HEADER = struct.Struct(
     "<BBBxiqiid"
 )  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts
-# v1 header (no ts): a mixed-version ring during a rolling restart must keep
-# replicating, so v1 frames are still accepted (ts = 0.0 → lag not recorded).
+# v1 header (no ts). Rolling-restart compatibility is two-sided:
+# - RECEIVE: v1 frames are always accepted (ts = 0.0 → lag not recorded).
+# - EMIT: v1 peers reject v2 frames, so while any v1 node remains in the
+#   ring, upgraded nodes must emit v1 — set RADIXMESH_WIRE_VERSION=1 (or
+#   set_emit_version(1)) for the duration of the roll, then flip to 2.
 _HEADER_V1 = struct.Struct("<BBBxiqii")
+
+_emit_version = int(os.environ.get("RADIXMESH_WIRE_VERSION", _VERSION))
+
+
+def set_emit_version(version: int) -> None:
+    """Select the wire version ``serialize`` emits (1 during a rolling
+    upgrade from v1 nodes, 2 — the default — otherwise)."""
+    global _emit_version
+    if version not in (1, _VERSION):
+        raise ValueError(f"unsupported wire version {version}")
+    _emit_version = version
 
 
 class OplogType(enum.IntEnum):
@@ -55,6 +71,10 @@ class OplogType(enum.IntEnum):
     RESET = 3
     GC_QUERY = 4
     GC_EXEC = 5
+    # Elastic-membership extensions (the reference lists failure detection
+    # and dynamic add/remove as roadmap, README.md:49-50):
+    TOPO = 6  # value = [epoch, *alive_ranks] — a membership view
+    JOIN = 7  # origin_rank is (re)joining; view master answers with TOPO
     TICK = 10
 
 
@@ -145,17 +165,18 @@ def serialize(op: Oplog) -> bytes:
     """Oplog → bytes. Every field — including GC payloads — round-trips
     (fixing the reference's ``to_dict`` omission, ``cache_oplog.py:58-66``)."""
     key, value = _arr(op.key), _arr(op.value)
+    if _emit_version == 1:
+        header = _HEADER_V1.pack(
+            _MAGIC, 1, int(op.op_type),
+            op.origin_rank, op.logic_id, op.ttl, op.value_rank,
+        )
+    else:
+        header = _HEADER.pack(
+            _MAGIC, _VERSION, int(op.op_type),
+            op.origin_rank, op.logic_id, op.ttl, op.value_rank, op.ts,
+        )
     parts = [
-        _HEADER.pack(
-            _MAGIC,
-            _VERSION,
-            int(op.op_type),
-            op.origin_rank,
-            op.logic_id,
-            op.ttl,
-            op.value_rank,
-            op.ts,
-        ),
+        header,
         struct.pack("<III", len(key), len(value), len(op.gc)),
         key.tobytes(),
         value.tobytes(),

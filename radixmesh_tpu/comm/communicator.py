@@ -37,6 +37,26 @@ class Communicator(abc.ABC):
     def send(self, data: bytes) -> None:
         """Queue ``data`` for delivery to the target (async, ordered)."""
 
+    def try_send(self, data: bytes, timeout_s: float) -> bool:
+        """Attempt delivery, giving up after ``timeout_s``. Returns False
+        on timeout — the failure-detection primitive: a ring predecessor
+        is the only node positioned to observe its successor's death
+        (``policy/topology.py``). Default: delegate to :meth:`send`."""
+        self.send(data)
+        return True
+
+    def retarget(self, target_addr: str | None) -> None:
+        """Atomically switch the send channel to a new target (ring
+        re-formation after a view change). Default: unsupported."""
+        raise NotImplementedError(f"{type(self).__name__} cannot retarget")
+
+    def connected(self) -> bool:
+        """Best-effort: is the send channel currently live? Failure
+        detection only *suspects* peers it has seen connected at least
+        once — a slow-starting peer must never be declared dead before
+        first contact. Default: True (transports without the signal)."""
+        return True
+
     @abc.abstractmethod
     def register_rcv_callback(self, fn: Callable[[bytes], None]) -> None:
         """Register the function invoked with each received message's

@@ -58,6 +58,10 @@ class InprocHub:
     def post(self, target: str, data: bytes) -> None:
         self._q.put((target, data))
 
+    def has_listener(self, addr: str) -> bool:
+        with self._lock:
+            return addr in self._listeners
+
     def _run(self) -> None:
         while True:
             item = self._q.get()
@@ -93,6 +97,27 @@ class InprocCommunicator(Communicator):
         if self._target is None:
             raise RuntimeError("send-only target not configured")
         self._hub.post(self._target, bytes(data))
+
+    def try_send(self, data: bytes, timeout_s: float) -> bool:
+        """Delivery fails if the target has no live listener (the inproc
+        analog of a dead TCP endpoint), after polling for ``timeout_s``."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while not self._closed:
+            if self._hub.has_listener(self._target):
+                self._hub.post(self._target, bytes(data))
+                return True
+            if _time.monotonic() >= deadline:
+                return False
+            _time.sleep(0.005)
+        raise RuntimeError("communicator closed")
+
+    def retarget(self, target_addr: str | None) -> None:
+        self._target = target_addr
+
+    def connected(self) -> bool:
+        return self._target is not None and self._hub.has_listener(self._target)
 
     def register_rcv_callback(self, fn: Callable[[bytes], None]) -> None:
         self._callback = fn

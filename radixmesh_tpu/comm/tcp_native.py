@@ -135,6 +135,52 @@ class NativeTcpCommunicator(Communicator):
         if rc != 0:
             raise RuntimeError(f"native send failed (rc={rc})")
 
+    def try_send(self, data: bytes, timeout_s: float) -> bool:
+        """Failure-detection send. The native sender connects LAZILY — its
+        background thread only dials once the queue is non-empty — so the
+        frame must be enqueued FIRST, then the connection awaited: polling
+        ``connected`` before enqueueing would wait on a dial that never
+        starts. ``connected`` is a liveness signal, not a per-message
+        delivery ack; a frame accepted here is delivered at-least-once by
+        the background retry loop if the peer is ever reachable. On False
+        the frame stays queued: callers either retarget (dropping the old
+        handle and its queue) or back off and let the backlog drain when
+        the peer appears."""
+        import time as _time
+
+        if self._closed:
+            raise RuntimeError("communicator closed")
+        if self._sender is None:
+            raise RuntimeError("send-only target not configured")
+        self.send(data)
+        deadline = _time.monotonic() + timeout_s
+        while not self._lib.rm_sender_connected(self._sender):
+            if self._closed:
+                raise RuntimeError("communicator closed")
+            if _time.monotonic() >= deadline:
+                return False
+            _time.sleep(0.01)
+        return True
+
+    def retarget(self, target_addr: str | None) -> None:
+        """Swap the native sender for one aimed at the new target. Caller
+        (the mesh sender thread) serializes with sends."""
+        old, self._sender = self._sender, None
+        if old is not None:
+            self._lib.rm_sender_close(old)
+        self._target = target_addr
+        if target_addr is not None:
+            host, port = parse_addr(target_addr)
+            sender = self._lib.rm_sender_create(host.encode(), port, self._max_msg)
+            if not sender:
+                raise OSError(f"failed to create native sender to {target_addr}")
+            self._sender = sender
+
+    def connected(self) -> bool:
+        return self._sender is not None and bool(
+            self._lib.rm_sender_connected(self._sender)
+        )
+
     def register_rcv_callback(self, fn: Callable[[bytes], None]) -> None:
         self._callback = fn
 

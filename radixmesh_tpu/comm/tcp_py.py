@@ -103,11 +103,16 @@ class PyTcpCommunicator(Communicator):
 
     # ---- send path (reference communicator.py:162-210) ----
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, deadline: float | None = None) -> socket.socket | None:
         host, port = parse_addr(self._target)
         while not self._closed.is_set():
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
             try:
-                s = socket.create_connection((host, port), timeout=5)
+                timeout = 5.0
+                if deadline is not None:
+                    timeout = min(timeout, max(0.05, deadline - time.monotonic()))
+                s = socket.create_connection((host, port), timeout=timeout)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 s.settimeout(None)
                 return s
@@ -116,6 +121,16 @@ class PyTcpCommunicator(Communicator):
         raise RuntimeError("communicator closed while connecting")
 
     def send(self, data: bytes) -> None:
+        # Retry (reconnecting) until delivered or closed — a silently
+        # dropped frame diverges ring replicas unrecoverably (receivers
+        # have no gap detection), so at-least-once beats fail-fast here.
+        if not self._send_impl(data, deadline=None):
+            raise RuntimeError("communicator closed while sending")
+
+    def try_send(self, data: bytes, timeout_s: float) -> bool:
+        return self._send_impl(data, deadline=time.monotonic() + timeout_s)
+
+    def _send_impl(self, data: bytes, deadline: float | None) -> bool:
         if self._closed.is_set():
             raise RuntimeError("communicator closed")
         if self._target is None:
@@ -126,21 +141,36 @@ class PyTcpCommunicator(Communicator):
             )
         frame = _LEN.pack(len(data)) + data
         with self._send_lock:
-            # Retry (reconnecting) until delivered or closed — a silently
-            # dropped frame diverges ring replicas unrecoverably (receivers
-            # have no gap detection), so at-least-once beats fail-fast here.
             while not self._closed.is_set():
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
                 try:
                     if self._send_sock is None:
-                        self._send_sock = self._connect()
+                        self._send_sock = self._connect(deadline)
+                        if self._send_sock is None:
+                            return False  # deadline hit while connecting
                     self._send_sock.sendall(frame)
-                    return
+                    return True
                 except OSError:
                     if self._send_sock is not None:
                         self._send_sock.close()
                         self._send_sock = None
                     time.sleep(0.05)
-            raise RuntimeError("communicator closed while sending")
+            if deadline is None:
+                raise RuntimeError("communicator closed while sending")
+            return False
+
+    def retarget(self, target_addr: str | None) -> None:
+        """Switch the send channel; the next send connects to the new
+        target. Caller (the mesh sender thread) serializes with sends."""
+        with self._send_lock:
+            if self._send_sock is not None:
+                self._send_sock.close()
+                self._send_sock = None
+            self._target = target_addr
+
+    def connected(self) -> bool:
+        return self._send_sock is not None
 
     def register_rcv_callback(self, fn: Callable[[bytes], None]) -> None:
         self._callback = fn

@@ -56,6 +56,10 @@ class MeshConfig:
     # (radix_mesh.py:133,166); configurable here so tests run fast.
     gc_interval_s: float = 10.0
     tick_interval_s: float = 10.0
+    # How long a ring successor may be unreachable before its predecessor
+    # declares it dead and re-forms the ring (policy/topology.py). The
+    # reference has no failure detection at all (roadmap, README.md:49-50).
+    failure_timeout_s: float = 10.0
     # Optional model/mesh sections for serving nodes.
     model: dict[str, Any] = field(default_factory=dict)
     mesh_axes: dict[str, int] = field(default_factory=dict)  # e.g. {"dp":2,"tp":4}

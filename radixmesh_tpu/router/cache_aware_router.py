@@ -86,6 +86,25 @@ class CacheAwareRouter:
         ring = self._prefill_ring if role == "prefill" else self._decode_ring
         ring.remove_node(addr)
 
+    def watch_topology(self) -> None:
+        """Subscribe to the mesh replica's epoch-numbered view changes
+        (``policy/topology.py``): dead nodes leave the consistent-hash
+        fallback rings, rejoined nodes return — so even cache-miss traffic
+        stops landing on nodes the mesh has declared dead."""
+        self.mesh_cache.on_view_change.append(self._on_view_change)
+
+    def _on_view_change(self, old, new) -> None:
+        for rank in set(old.alive) - set(new.alive):
+            self.remove_node(
+                "prefill" if self.config.is_prefill_rank(rank) else "decode",
+                self.config.addr_of_rank(rank),
+            )
+        for rank in set(new.alive) - set(old.alive):
+            self.add_node(
+                "prefill" if self.config.is_prefill_rank(rank) else "decode",
+                self.config.addr_of_rank(rank),
+            )
+
     def cache_aware_route(self, key: Sequence[int]) -> RouteResult:
         """Route one request's token ids (reference ``:23-39``)."""
         with self._m_route_latency.time():

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import bisect
 import hashlib
+import threading
 from typing import Iterable, Sequence
 
 __all__ = ["ConsistentHash"]
@@ -29,6 +30,9 @@ class ConsistentHash:
         self.virtual_nodes = virtual_nodes
         self._ring: list[int] = []  # sorted hash points
         self._owner: dict[int, str] = {}
+        # add/remove run on the mesh transport-reader thread (topology view
+        # changes) while get_node serves requests on other threads.
+        self._lock = threading.Lock()
         for node in nodes:
             self.add_node(node)
 
@@ -38,17 +42,19 @@ class ConsistentHash:
         ]
 
     def add_node(self, node: str) -> None:
-        for h in self._points(node):
-            if h in self._owner:  # hash collision: first owner keeps it
-                continue
-            bisect.insort(self._ring, h)
-            self._owner[h] = node
+        with self._lock:
+            for h in self._points(node):
+                if h in self._owner:  # hash collision: first owner keeps it
+                    continue
+                bisect.insort(self._ring, h)
+                self._owner[h] = node
 
     def remove_node(self, node: str) -> None:
-        for h in self._points(node):
-            if self._owner.get(h) == node:
-                self._ring.remove(h)
-                del self._owner[h]
+        with self._lock:
+            for h in self._points(node):
+                if self._owner.get(h) == node:
+                    self._ring.remove(h)
+                    del self._owner[h]
 
     def get_node(self, key: Sequence[int] | bytes | str) -> str | None:
         """Owner of ``key``: first ring point clockwise from hash(key)."""
@@ -61,10 +67,14 @@ class ConsistentHash:
         else:
             data = b",".join(str(int(t)).encode() for t in key)
         h = _hash32(data)
-        idx = bisect.bisect_right(self._ring, h)
-        if idx == len(self._ring):  # wraparound
-            idx = 0
-        return self._owner[self._ring[idx]]
+        with self._lock:
+            if not self._ring:
+                return None
+            idx = bisect.bisect_right(self._ring, h)
+            if idx == len(self._ring):  # wraparound
+                idx = 0
+            return self._owner[self._ring[idx]]
 
     def __len__(self) -> int:
-        return len(set(self._owner.values()))
+        with self._lock:
+            return len(set(self._owner.values()))

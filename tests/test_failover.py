@@ -1,0 +1,237 @@
+"""Failure detection + elastic ring membership (SURVEY §5 "failure
+detection / elastic recovery": the reference ships only ring ticks; node
+failure detection and dynamic add/remove are roadmap, ``README.md:49-50``,
+with a TODO marking the missing topology-check thread,
+``radix_mesh.py:143-146``).
+
+Scenarios: crash detection by the ring predecessor, ring re-formation,
+graceful leave, rejoin via JOIN, equal-epoch view merges, and dead-rank
+avoidance in routing.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.kv_pool import PagedKVPool
+from radixmesh_tpu.cache.mesh_cache import MeshCache
+from radixmesh_tpu.comm.inproc import InprocHub
+from radixmesh_tpu.config import MeshConfig, NodeRole
+from radixmesh_tpu.policy.topology import TopologyView, decode_view, encode_view
+from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+
+def wait_for(pred, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    InprocHub.reset_default()
+    yield
+    InprocHub.reset_default()
+
+
+PREFILL = ["p0", "p1", "p2"]
+DECODE = ["d0", "d1"]
+ROUTER = ["r0"]
+
+
+def make_node(addr: str) -> MeshCache:
+    cfg = MeshConfig(
+        prefill_nodes=PREFILL,
+        decode_nodes=DECODE,
+        router_nodes=ROUTER,
+        local_addr=addr,
+        protocol="inproc",
+        tick_interval_s=0.1,
+        gc_interval_s=30.0,
+        failure_timeout_s=0.4,
+    )
+    pool = (
+        None
+        if cfg.local_role is NodeRole.ROUTER
+        else PagedKVPool(num_slots=256, num_layers=1, num_kv_heads=1, head_dim=2)
+    )
+    return MeshCache(cfg, pool=pool)
+
+
+class FailoverCluster:
+    def __init__(self):
+        self.nodes = {a: make_node(a).start() for a in PREFILL + DECODE + ROUTER}
+        for n in self.nodes.values():
+            assert n.wait_ready(timeout=10), f"node {n.rank} never ready"
+
+    def alive_nodes(self):
+        return [n for n in self.nodes.values() if not n._stop.is_set()]
+
+    def close(self):
+        for n in self.nodes.values():
+            n.close()
+
+
+@pytest.fixture
+def cluster():
+    c = FailoverCluster()
+    yield c
+    c.close()
+
+
+def insert_with_pool(node: MeshCache, key) -> np.ndarray:
+    slots = node.pool.alloc(len(key))
+    assert slots is not None
+    node.insert(key, slots)
+    return slots
+
+
+class TestViewSemantics:
+    def test_initial_and_successor(self):
+        v = TopologyView(epoch=0, alive=(0, 1, 2, 3, 4))
+        assert v.successor_of(0) == 1
+        assert v.successor_of(4) == 0
+        w = v.without(1)
+        assert w.epoch == 1 and w.successor_of(0) == 2
+        assert w.without(0).master_rank() == 2
+
+    def test_sole_survivor_has_no_successor(self):
+        v = TopologyView(epoch=3, alive=(2,))
+        assert v.successor_of(2) is None
+
+    def test_equal_epoch_merge_intersects(self):
+        a = TopologyView(epoch=1, alive=(0, 2, 3, 4))  # detector removed 1
+        b = TopologyView(epoch=1, alive=(0, 1, 2, 4))  # detector removed 3
+        m = a.merged_with(b)
+        assert m.epoch == 2
+        assert m.alive == (0, 2, 4)
+
+    def test_encode_decode_round_trip(self):
+        v = TopologyView(epoch=7, alive=(0, 2, 4))
+        assert decode_view(encode_view(v)) == v
+
+
+class TestCrashDetection:
+    def test_predecessor_detects_and_ring_reforms(self, cluster):
+        dead = cluster.nodes["p1"]  # global rank 1
+        dead.close()  # crash: no leave announcement
+
+        survivors = [n for n in cluster.alive_nodes()]
+        # Ticks keep flowing through p0 -> p1, so p0 (the predecessor)
+        # detects within failure_timeout and announces a view without 1.
+        assert wait_for(
+            lambda: all(not n.view.contains(1) for n in survivors), timeout=15
+        ), [n.view for n in survivors]
+        assert all(n.view.epoch >= 1 for n in survivors)
+
+        # Replication works on the re-formed ring (0 -> 2 -> 3 -> 4 -> 0).
+        p0 = cluster.nodes["p0"]
+        insert_with_pool(p0, [5, 6, 7])
+        assert wait_for(
+            lambda: all(
+                n.match_prefix([5, 6, 7]).length == 3
+                for n in survivors
+                if n.role is not NodeRole.ROUTER
+            )
+        )
+
+    def test_router_learns_view_via_fanout(self, cluster):
+        router = cluster.nodes["r0"]
+        cluster.nodes["p1"].close()
+        assert wait_for(lambda: not router.view.contains(1), timeout=15)
+
+
+class TestGracefulLeave:
+    def test_leave_announces_immediately(self, cluster):
+        cluster.nodes["d1"].close(graceful=True)  # global rank 4
+        survivors = cluster.alive_nodes()
+        assert wait_for(
+            lambda: all(not n.view.contains(4) for n in survivors), timeout=5
+        )
+
+
+class TestRejoin:
+    def test_dead_node_rejoins_and_receives_replication(self, cluster):
+        cluster.nodes["p1"].close()
+        survivors = cluster.alive_nodes()
+        assert wait_for(
+            lambda: all(not n.view.contains(1) for n in survivors), timeout=15
+        )
+
+        # Restart rank 1 with the same static config (reference invariant:
+        # identical config except local_cache_addr, README.md:122-124).
+        reborn = make_node("p1").start()
+        cluster.nodes["p1"] = reborn
+        everyone = survivors + [reborn]
+        assert wait_for(
+            lambda: all(n.view.contains(1) for n in everyone), timeout=15
+        ), [n.view for n in everyone]
+
+        # New inserts reach the rejoined node again.
+        insert_with_pool(cluster.nodes["p0"], [8, 8, 8])
+        assert wait_for(lambda: reborn.match_prefix([8, 8, 8]).length == 3)
+
+
+class TestRoutingAvoidsDead:
+    def test_dead_rank_loses_routing(self, cluster):
+        router = cluster.nodes["r0"]
+        p1 = cluster.nodes["p1"]
+        insert_with_pool(p1, [4, 4, 4])
+        assert wait_for(
+            lambda: getattr(router.match_prefix([4, 4, 4]), "prefill_rank", -1) == 1
+        )
+
+        car = CacheAwareRouter(router, router.cfg)
+        car.watch_topology()
+        car.finish_warm_up()
+        assert car.cache_aware_route([4, 4, 4]).prefill_addr == "p1"
+
+        p1.close()
+        assert wait_for(lambda: not router.view.contains(1), timeout=15)
+        # The mesh match must no longer attribute the prefix to rank 1, and
+        # the hash-ring fallback must not pick p1's address either.
+        res = car.cache_aware_route([4, 4, 4])
+        assert res.prefill_addr != "p1"
+        assert not res.prefill_cache_hit
+
+    def test_view_change_updates_hash_rings(self, cluster):
+        router = cluster.nodes["r0"]
+        car = CacheAwareRouter(router, router.cfg)
+        car.watch_topology()
+        car.finish_warm_up()
+        cluster.nodes["p1"].close()
+        assert wait_for(lambda: not router.view.contains(1), timeout=15)
+        # No cold key may fall back onto the dead node.
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            key = rng.integers(0, 1 << 30, size=8).tolist()
+            assert car.cache_aware_route(key).prefill_addr != "p1"
+
+
+class TestDoubleFailure:
+    def test_two_dead_successors_still_reform(self, cluster):
+        """p0's successor (p1) AND the next one (p2) die together: after
+        detecting p1, the retargeted channel to p2 must get the failure
+        deadline too (not first-contact patience), or the ring wedges."""
+        cluster.nodes["p1"].close()
+        cluster.nodes["p2"].close()
+        survivors = cluster.alive_nodes()
+        assert wait_for(
+            lambda: all(
+                not n.view.contains(1) and not n.view.contains(2)
+                for n in survivors
+            ),
+            timeout=20,
+        ), [n.view for n in survivors]
+        insert_with_pool(cluster.nodes["p0"], [7, 7, 7])
+        assert wait_for(
+            lambda: all(
+                n.match_prefix([7, 7, 7]).length == 3
+                for n in survivors
+                if n.role is not NodeRole.ROUTER
+            )
+        )

@@ -119,3 +119,26 @@ class TestWireVersionCompat:
         assert op.ts == 0.0
         np.testing.assert_array_equal(op.key, key)
         np.testing.assert_array_equal(op.value, value)
+
+    def test_emit_v1_round_trips_without_ts(self):
+        """Rolling upgrade: RADIXMESH_WIRE_VERSION=1 makes upgraded nodes
+        emit frames v1 peers can parse; ts is the only casualty."""
+        from radixmesh_tpu.cache.oplog import set_emit_version
+
+        op = Oplog(
+            OplogType.INSERT, 2, 3, 4,
+            key=np.array([1, 2], dtype=np.int32),
+            value=np.array([7, 8], dtype=np.int32),
+            value_rank=2, ts=99.0,
+        )
+        set_emit_version(1)
+        try:
+            buf = serialize(op)
+            assert buf[1] == 1  # version byte
+            got = deserialize(buf)
+        finally:
+            set_emit_version(2)
+        assert got.ts == 0.0
+        assert got.origin_rank == 2 and got.value_rank == 2 and got.ttl == 4
+        np.testing.assert_array_equal(got.key, op.key)
+        np.testing.assert_array_equal(got.value, op.value)
