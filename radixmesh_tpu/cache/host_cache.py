@@ -1,0 +1,256 @@
+"""Hierarchical KV cache: HBM device tier + host-RAM backup tier.
+
+The reference carries HiCache *stubs* — ``TreeNode.host_value``/``loading``
+flags and ``MatchResult.host_hit_length`` that nothing ever sets
+(``radix_cache.py:47-61,67-84``). Here the tier is real:
+
+- :class:`HostKVStore` — a host-RAM arena (numpy, same dtype as the pool)
+  with the pool's page-granular :class:`SlotAllocator`.
+- :class:`HierarchicalCache` — a :class:`RadixTree` whose eviction WRITES
+  BACK device KV to the host store instead of dropping it (the node stays
+  in the tree, host-resident), and whose :meth:`match_and_load` RESTORES a
+  matched host extension into freshly-allocated device slots. Net effect:
+  prefixes that fall out of HBM under pressure still serve cache hits at
+  the cost of a host↔device copy instead of a full prefill recompute.
+
+TPU shape discipline: device→host rides one padded ``pool.gather`` per
+eviction batch and host→device one padded ``pool.write`` per restore —
+both hit the pool's power-of-two jit buckets, so the tier adds no new XLA
+compilation variants. Transfers are synchronous by design: they sit on the
+admission path (a prefill already pays a device round-trip there), never
+inside the jitted decode step.
+
+When the host arena itself fills, host-resident nodes are evicted for real
+in LRU order — the tier degrades to the reference's behavior (recompute),
+never to an error.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from radixmesh_tpu.cache.kv_pool import PagedKVPool, SlotAllocator
+from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode
+from radixmesh_tpu.obs.metrics import get_registry
+from radixmesh_tpu.utils.logging import get_logger
+
+__all__ = ["HostKVStore", "HierarchicalCache"]
+
+
+def gather_padded(pool: PagedKVPool, slots: np.ndarray) -> np.ndarray:
+    """One power-of-two-padded gather (the same bucketing discipline as
+    ``pool.write``), sliced back to ``len(slots)`` on host →
+    ``[2, L, n, H, D]`` numpy in the pool's dtype."""
+    slots = np.asarray(slots, dtype=np.int32)
+    n = len(slots)
+    if n == 0:
+        return np.empty((2, pool.num_layers, 0, pool.num_kv_heads, pool.head_dim))
+    bucket = max(8, 1 << (n - 1).bit_length())
+    padded = (
+        slots
+        if bucket == n
+        else np.concatenate([slots, np.repeat(slots[-1:], bucket - n)])
+    )
+    return np.asarray(pool.gather(padded))[:, :, :n]
+
+
+class HostKVStore:
+    """Host-RAM KV arena mirroring the pool's token-slot layout
+    ``[2, L, slots, H, D]`` (token-major — the gather/write interchange
+    format), with page-granular allocation."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        num_layers: int,
+        num_kv_heads: int,
+        head_dim: int,
+        page_size: int = 1,
+        dtype: Any = jnp.bfloat16,
+    ):
+        self.num_slots = num_slots
+        self.page_size = page_size
+        self.allocator = SlotAllocator(num_slots, page_size)
+        # jnp dtype → numpy (ml_dtypes handles bfloat16 natively).
+        self._arena = np.zeros(
+            (2, num_layers, num_slots, num_kv_heads, head_dim),
+            dtype=jnp.dtype(dtype),
+        )
+
+    @property
+    def free_slots(self) -> int:
+        return self.allocator.free_slots
+
+    def alloc(self, n_tokens: int) -> np.ndarray | None:
+        return self.allocator.alloc(n_tokens)
+
+    def free(self, slots: np.ndarray) -> None:
+        self.allocator.free(slots)
+
+    def write(self, slots: np.ndarray, kv: np.ndarray) -> None:
+        """Store ``kv`` ``[2, L, n, H, D]`` at host ``slots``."""
+        self._arena[:, :, np.asarray(slots, dtype=np.int32)] = kv
+
+    def read(self, slots: np.ndarray) -> np.ndarray:
+        return self._arena[:, :, np.asarray(slots, dtype=np.int32)]
+
+
+class HierarchicalCache(RadixTree):
+    """Radix tree with a write-back host tier behind the device pool."""
+
+    def __init__(
+        self,
+        pool: PagedKVPool,
+        host_store: HostKVStore,
+        page_size: int | None = None,
+        **tree_kw,
+    ):
+        self.pool = pool
+        self.host = host_store
+        self.log = get_logger("hicache")
+        reg = get_registry()
+        self._m_backup = reg.counter(
+            "hicache_backup_tokens_total", "tokens written back HBM → host RAM"
+        )
+        self._m_restore = reg.counter(
+            "hicache_restore_tokens_total", "tokens restored host RAM → HBM"
+        )
+        self._m_host_evicted = reg.counter(
+            "hicache_host_evicted_tokens_total",
+            "host-resident tokens dropped when the host arena filled",
+        )
+        super().__init__(
+            page_size=pool.page_size if page_size is None else page_size,
+            on_free=pool.free,
+            on_free_host=host_store.free,
+            **tree_kw,
+        )
+
+    # ---- device eviction with write-back ----
+
+    def evict(self, num_tokens: int) -> int:
+        return self._evict_impl(num_tokens, writeback=self._writeback)
+
+    def _writeback(self, node: TreeNode) -> bool:
+        """Copy ``node``'s device KV into the host tier. Returns False (→
+        plain eviction) only if the host arena can't make room."""
+        if node.host_value is not None:
+            return True  # already backed up: re-eviction is free
+        slots = np.asarray(node.value, dtype=np.int32)
+        host_slots = self.host.alloc(len(slots))
+        if host_slots is None:
+            self._evict_host(max(1, len(slots) - self.host.free_slots))
+            host_slots = self.host.alloc(len(slots))
+            if host_slots is None:
+                return False
+        host_slots = host_slots[: len(slots)]
+        self.host.write(host_slots, gather_padded(self.pool, slots))
+        node.host_value = host_slots
+        self._m_backup.inc(len(slots))
+        return True
+
+    def _evict_host(self, num_tokens: int) -> int:
+        """LRU-drop host-ONLY nodes (never nodes that still hold device KV
+        — their host copy is just a free re-eviction) to make arena room."""
+        candidates = [
+            n
+            for n in self._all_nodes()
+            if n is not self.root
+            and n.value is None
+            and n.host_value is not None
+            and n.lock_ref == 0
+            and not n.children  # leaves only: keep paths connected
+        ]
+        heapq.heapify(candidates)
+        freed = 0
+        freed_host: list[np.ndarray] = []
+        while candidates and freed < num_tokens:
+            node = heapq.heappop(candidates)
+            freed += len(node.host_value)
+            self._m_host_evicted.inc(len(node.host_value))
+            self._remove_node(node, freed_host)
+            parent = node.parent
+            if (
+                parent is not self.root
+                and parent.value is None
+                and parent.host_value is not None
+                and parent.lock_ref == 0
+                and not parent.children
+            ):
+                heapq.heappush(candidates, parent)
+        if freed_host:
+            self.host.free(np.concatenate(freed_host))
+        return freed
+
+    # ---- host → device restore ----
+
+    def match_and_load(self, key) -> MatchResult:
+        """``match_prefix`` + restore: if the match extends into the host
+        tier, allocate device slots, copy the host KV back into the pool,
+        and reinstate each node's device value — the returned result's
+        ``values``/``last_node`` then cover the full two-tier hit. Nodes
+        that can't be restored (device pool exhausted even after eviction)
+        stay host-resident; the hit is simply shorter."""
+        res = self.match_prefix(key)
+        if not res.host_nodes:
+            return res
+        # Lock the device prefix while restoring: the room-making evictions
+        # below are PLAIN drops (writeback here could free the very host
+        # slots being restored), and they must not take the chain's own
+        # ancestors out from under it. The anchor MOVES DOWN as nodes are
+        # restored, so an earlier-restored node can never be evicted (and
+        # its slots recycled) by a later iteration's room-making.
+        anchor = res.last_node
+        locked = anchor is not None and anchor is not self.root
+        if locked:
+            self.inc_lock_ref(anchor)
+        try:
+            for node in res.host_nodes:
+                if node.host_value is None or node.value is not None:
+                    break  # raced/partial (shouldn't happen single-threaded)
+                n = len(node.host_value)
+                partial = False
+                dev = self.pool.alloc(n)
+                if dev is None:
+                    self._evict_impl(n - self.pool.free_slots, writeback=None)
+                    dev = self.pool.alloc(n)
+                if dev is None:
+                    # Partial restore: split the node at the largest
+                    # page-aligned length the pool can hold; the remainder
+                    # (and everything deeper) stays host-resident.
+                    avail = self._aligned_len(
+                        min(n - self.page_size, self.pool.free_slots)
+                    )
+                    if avail <= 0:
+                        break
+                    node = self._split_node(node, avail)
+                    n = avail
+                    partial = True
+                    dev = self.pool.alloc(n)
+                    if dev is None:
+                        break
+                dev = dev[:n]
+                kv = self.host.read(node.host_value)  # [2, L, n, H, D]
+                self.pool.write(dev, jnp.asarray(kv[0]), jnp.asarray(kv[1]))
+                node.value = dev
+                self.evictable_size_ += len(node.key)
+                self._m_restore.inc(n)
+                res.values.append(node.value)
+                res.last_node = node
+                # Advance the eviction shield to cover this restored node.
+                self.inc_lock_ref(node)
+                if locked:
+                    self.dec_lock_ref(anchor)
+                anchor, locked = node, True
+                if partial:
+                    break  # deeper host nodes no longer touch the device prefix
+        finally:
+            if locked:
+                self.dec_lock_ref(anchor)
+        res.host_values = []
+        res.host_nodes = []
+        return res

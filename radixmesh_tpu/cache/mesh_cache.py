@@ -445,13 +445,14 @@ class MeshCache:
                 return
             if op.origin_rank == self.rank:
                 return  # lap complete (radix_mesh.py:401-402)
-            if op.ttl <= 0 and self.role is not NodeRole.ROUTER:
-                # TTL accounts ring laps; the router sits outside the ring
-                # and receives master fan-out copies whose TTL reflects how
-                # far around the ring the master sat — it must apply them
-                # regardless (the reference sidesteps this by re-sending
-                # with a fresh TTL, radix_mesh.py:335).
-                return
+            # Apply BEFORE any TTL-based drop: with elastic membership an
+            # oplog can carry a TTL computed from a stale (smaller) view,
+            # reaching the last ring member with ttl 0 — dropping it
+            # unapplied would diverge that replica forever (receivers have
+            # no gap detection). Ops are idempotent, so the worst case of
+            # a stale-TTL lap overrun is a harmless re-apply; TTL only
+            # gates FORWARDING (the infinite-circulation backstop when the
+            # origin died mid-lap).
             if op.op_type is OplogType.INSERT:
                 if self.role is NodeRole.ROUTER:
                     value = RouterValue(op.value_rank, len(op.key))
@@ -677,14 +678,19 @@ class MeshCache:
                     break  # sole survivor: nothing to ring (fan-out below)
                 try:
                     if not self._succ_established:
-                        # Never-seen-alive successors get unbounded patience
-                        # (cluster startup: the peer may still be binding,
-                        # like the reference's connect-retry loop). Only a
-                        # peer seen connected at least once can be suspected.
-                        self._comm.send(data)
-                        self._succ_established = self._comm.connected()
-                        break
-                    if self._comm.try_send(data, self.cfg.failure_timeout_s):
+                        # Never-seen-alive successors get startup-grace
+                        # patience (cluster boot: the peer may still be
+                        # binding, like the reference's connect-retry
+                        # loop) — but NOT unbounded patience: a node that
+                        # restarts while its static successor is also dead
+                        # must eventually ring around it or it can never
+                        # deliver its JOIN.
+                        if self._comm.try_send(
+                            data, self.cfg.effective_startup_grace_s
+                        ):
+                            self._succ_established = self._comm.connected()
+                            break
+                    elif self._comm.try_send(data, self.cfg.failure_timeout_s):
                         break
                 except Exception:  # noqa: BLE001 — transport errors must not kill the sender
                     if not self._stop.is_set():
@@ -713,13 +719,11 @@ class MeshCache:
             )
             if now < st["retry_at"]:
                 continue  # backing off an unreachable router
-            # Short probe before first contact (a still-booting router just
-            # misses some fan-outs and catches up); full deadline once live.
-            timeout = (
-                self.cfg.failure_timeout_s
-                if st["established"]
-                else min(1.0, self.cfg.failure_timeout_s)
-            )
+            # Always a SHORT probe: this runs on the ring sender thread, so
+            # a down router must cost at most ~1s per backoff window, never
+            # a full failure_timeout stall of ring replication. Correctness
+            # tolerates dropped fan-outs (the router just misses hits).
+            timeout = min(1.0, self.cfg.failure_timeout_s)
             try:
                 if rc.try_send(data, timeout):
                     st["established"] = True

@@ -98,6 +98,7 @@ class TreeNode:
         "parent",
         "key",
         "value",
+        "host_value",
         "lock_ref",
         "last_access_time",
         "hit_count",
@@ -110,6 +111,12 @@ class TreeNode:
         self.parent = parent
         self.key: np.ndarray = np.empty(0, dtype=np.int32)
         self.value: Any = None
+        # Host-tier slot indices when this node's KV has been written back
+        # to host RAM (the reference's HiCache stubs ``host_value``/
+        # ``backuped``, ``radix_cache.py:47-61``, realized by
+        # ``cache/host_cache.py``). A node may hold both tiers (restored to
+        # device with the host copy retained → re-eviction is free).
+        self.host_value: np.ndarray | None = None
         self.lock_ref = 0
         self.last_access_time = time.monotonic()
         self.hit_count = 0
@@ -121,6 +128,11 @@ class TreeNode:
     @property
     def evicted(self) -> bool:
         return self.value is None
+
+    @property
+    def backuped(self) -> bool:
+        """Reference ``radix_cache.py:60-61``: KV present in the host tier."""
+        return self.host_value is not None
 
     def __lt__(self, other: "TreeNode") -> bool:
         return self.last_access_time < other.last_access_time
@@ -139,19 +151,43 @@ class MatchResult:
     ``values`` holds one value object per matched node along the path (the
     last possibly a slice); ``last_node`` anchors lock-ref operations. Use
     :meth:`indices` to concatenate numpy slot-index values for the KV pool.
+
+    ``host_values``/``host_nodes`` describe the host-tier *extension*: the
+    chain of written-back nodes continuing past the device-resident prefix
+    (the reference's ``host_hit_length``/``last_host_node``,
+    ``radix_cache.py:67-84``). ``HierarchicalCache.load`` restores them
+    into device slots.
     """
 
     values: list[Any] = field(default_factory=list)
     last_node: "TreeNode | None" = None
+    host_values: list[np.ndarray] = field(default_factory=list)
+    host_nodes: list["TreeNode"] = field(default_factory=list)
 
     @property
     def length(self) -> int:
         return sum(len(v) for v in self.values)
 
+    @property
+    def host_length(self) -> int:
+        """Tokens matched beyond ``length`` that live only in host RAM."""
+        return sum(len(v) for v in self.host_values)
+
+    @property
+    def last_host_node(self) -> "TreeNode | None":
+        return self.host_nodes[-1] if self.host_nodes else None
+
     def indices(self) -> np.ndarray:
         if not self.values:
             return np.empty(0, dtype=np.int32)
         return np.concatenate([np.asarray(v, dtype=np.int32) for v in self.values])
+
+    def host_indices(self) -> np.ndarray:
+        if not self.host_values:
+            return np.empty(0, dtype=np.int32)
+        return np.concatenate(
+            [np.asarray(v, dtype=np.int32) for v in self.host_values]
+        )
 
 
 class RadixTree:
@@ -180,9 +216,11 @@ class RadixTree:
         on_free: Callable[[np.ndarray], None] | None = None,
         enable_events: bool = False,
         time_fn: Callable[[], float] = time.monotonic,
+        on_free_host: Callable[[np.ndarray], None] | None = None,
     ):
         self.page_size = page_size
         self.on_free = on_free
+        self.on_free_host = on_free_host
         self.enable_events = enable_events
         self._time = time_fn
         self._events: list[Any] = []
@@ -214,6 +252,14 @@ class RadixTree:
             freed = self.all_values_flatten()
             if freed.size:
                 self.on_free(freed)
+        if self.on_free_host is not None and getattr(self, "root", None) is not None:
+            host = [
+                n.host_value
+                for n in self._all_nodes()
+                if n is not self.root and n.host_value is not None
+            ]
+            if host:
+                self.on_free_host(np.concatenate(host))
         self.root = TreeNode()
         self.root.key = np.empty(0, dtype=np.int32)
         self.root.value = root_value
@@ -236,8 +282,12 @@ class RadixTree:
         key = as_key(key)
         if self.page_size > 1:
             key = key[: self._aligned_len(len(key))]
-        node = self.root
+        node = self.root  # walk pointer: advances through BOTH tiers
+        last_dev = self.root  # lock anchor: deepest device-resident node
         values: list[Any] = []
+        host_values: list[np.ndarray] = []
+        host_nodes: list[TreeNode] = []
+        in_host = False  # device residency is prefix-closed; host extends it
         now = self._time()
         node.last_access_time = now
         while len(key) > 0:
@@ -249,22 +299,46 @@ class RadixTree:
                 break
             child.last_access_time = now
             child.hit_count += 1
+            if not in_host and child.value is None:
+                # Written back to host RAM (value lives in host_value): the
+                # device prefix ends here; keep walking the host extension.
+                in_host = True
+            if in_host and child.host_value is None:
+                break  # structural node with KV in neither tier
             if m < len(child.key):
                 if split_partial:
                     child = self._split_node(child, m)
-                    values.append(child.value)
-                    node = child
+                    if in_host:
+                        host_values.append(child.host_value)
+                        host_nodes.append(child)
+                    else:
+                        values.append(child.value)
+                        last_dev = child
                 else:
                     # Read-only walk (router replica mode): return the
                     # partial value as a slice but anchor last_node at the
                     # deepest FULLY matched node, so lock-ref operations
                     # never protect tokens beyond the matched prefix.
-                    values.append(child.value[:m])
+                    if in_host:
+                        host_values.append(child.host_value[:m])
+                        host_nodes.append(child)
+                    else:
+                        values.append(child.value[:m])
                 break
-            values.append(child.value)
+            if in_host:
+                host_values.append(child.host_value)
+                host_nodes.append(child)
+            else:
+                values.append(child.value)
+                last_dev = child
             node = child
             key = key[m:]
-        return MatchResult(values=values, last_node=node)
+        return MatchResult(
+            values=values,
+            last_node=last_dev,
+            host_values=host_values,
+            host_nodes=host_nodes,
+        )
 
     def insert(
         self,
@@ -294,28 +368,103 @@ class RadixTree:
         return self._insert_helper(self.root, key, value, on_conflict)
 
     def evict(self, num_tokens: int) -> int:
-        """Evict LRU unlocked leaves until ``num_tokens`` slots are freed
-        (reference ``radix_cache.py:179-202,366-377``). Returns slots freed."""
-        leaves = [n for n in self._collect_leaves() if n.lock_ref == 0]
+        """Evict LRU unlocked leaves until ``num_tokens`` device slots are
+        freed (reference ``radix_cache.py:179-202,366-377``). Returns slots
+        freed. With a ``writeback`` hook (see :class:`HierarchicalCache`),
+        evicted KV is copied to host RAM and the node *stays in the tree*
+        host-resident instead of vanishing."""
+        return self._evict_impl(num_tokens, writeback=None)
+
+    def _evict_impl(
+        self,
+        num_tokens: int,
+        writeback: Callable[["TreeNode"], bool] | None,
+    ) -> int:
+        # Candidates are "device leaves": unlocked nodes holding device KV
+        # with no device KV anywhere below them (host-resident descendants
+        # don't pin their ancestors on device). One post-order pass computes
+        # per-node device-descendant counts; evictions then decrement
+        # ancestors incrementally (O(n + evicted·depth), not O(n²)).
+        dev_below: dict[int, int] = {}
+        leaves: list[TreeNode] = []
+        stack: list[tuple[TreeNode, bool]] = [(self.root, False)]
+        while stack:
+            n, processed = stack.pop()
+            if not processed:
+                stack.append((n, True))
+                stack.extend((c, False) for c in n.children.values())
+                continue
+            below = sum(
+                dev_below[id(c)] + (1 if c.value is not None else 0)
+                for c in n.children.values()
+            )
+            dev_below[id(n)] = below
+            if (
+                n is not self.root
+                and n.value is not None
+                and below == 0
+                and n.lock_ref == 0
+            ):
+                leaves.append(n)
         heapq.heapify(leaves)
         freed = 0
         freed_arrays: list[np.ndarray] = []
+        freed_host: list[np.ndarray] = []
         while leaves and freed < num_tokens:
             node = heapq.heappop(leaves)
-            if node is self.root or node.lock_ref > 0:
+            if node is self.root or node.lock_ref > 0 or node.value is None:
                 continue
             freed += len(node.key)
-            if node.value is not None:
-                freed_arrays.append(np.asarray(node.value, dtype=np.int32))
-            self._record_remove_event(node)
+            freed_arrays.append(np.asarray(node.value, dtype=np.int32))
+            if writeback is not None and writeback(node):
+                # KV now lives in node.host_value; release the device slots
+                # but keep the node (its key remains matchable).
+                node.value = None
+                self.evictable_size_ -= len(node.key)
+            else:
+                self._remove_node(node, freed_host)
+            # This node no longer holds device KV: decrement every
+            # ancestor's count; an ancestor reaching zero becomes a
+            # candidate itself.
             parent = node.parent
-            del parent.children[self._child_key(node.key)]
-            self.evictable_size_ -= len(node.key)
-            if parent is not self.root and not parent.children and parent.lock_ref == 0:
+            anc = parent
+            while anc is not None and anc is not self.root:
+                dev_below[id(anc)] -= 1
+                anc = anc.parent
+            dev_below[id(self.root)] -= 1
+            if (
+                parent is not self.root
+                and parent.value is not None
+                and parent.lock_ref == 0
+                and dev_below[id(parent)] == 0
+            ):
                 heapq.heappush(leaves, parent)
         if freed_arrays and self.on_free is not None:
             self.on_free(np.concatenate(freed_arrays))
+        if freed_host and self.on_free_host is not None:
+            self.on_free_host(np.concatenate(freed_host))
         return freed
+
+    def _remove_node(self, node: TreeNode, freed_host: list[np.ndarray]) -> None:
+        """Detach ``node`` (and, transitively, its host-resident subtree —
+        a removed interior node strands its descendants) from the tree."""
+        self._record_remove_event(node)
+        del node.parent.children[self._child_key(node.key)]
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.value is not None:
+                self.evictable_size_ -= len(n.key)
+            if n.host_value is not None:
+                freed_host.append(n.host_value)
+            stack.extend(n.children.values())
+            # Clear both tiers on the detached nodes: any stale reference
+            # (e.g. a restore loop that matched before the removal) must
+            # see "no KV here" rather than freed slot ids.
+            n.value = None
+            n.host_value = None
+            n.children = {}
+
 
     def inc_lock_ref(self, node: TreeNode) -> None:
         """Protect the path root→``node`` from eviction (reference
@@ -387,6 +536,9 @@ class RadixTree:
         new_node = TreeNode(parent=node.parent)
         new_node.key = node.key[:split_len]
         new_node.value = None if node.value is None else node.value[:split_len]
+        new_node.host_value = (
+            None if node.host_value is None else node.host_value[:split_len]
+        )
         new_node.lock_ref = node.lock_ref
         new_node.last_access_time = node.last_access_time
         new_node.hit_count = node.hit_count
@@ -394,6 +546,9 @@ class RadixTree:
         node.parent.children[self._child_key(new_node.key)] = new_node
         node.key = node.key[split_len:]
         node.value = None if node.value is None else node.value[split_len:]
+        node.host_value = (
+            None if node.host_value is None else node.host_value[split_len:]
+        )
         node.parent = new_node
         if node.block_hashes is not None:
             # Page-chained hashes are a pure function of the root path, so a
@@ -437,11 +592,6 @@ class RadixTree:
             key = key[m:]
             value = value[m:]
             node = child
-
-    def _collect_leaves(self) -> list[TreeNode]:
-        return [
-            n for n in self._all_nodes() if n is not self.root and not n.children
-        ]
 
     def _all_nodes(self) -> Iterable[TreeNode]:
         stack = [self.root]

@@ -60,6 +60,17 @@ class MeshConfig:
     # declares it dead and re-forms the ring (policy/topology.py). The
     # reference has no failure detection at all (roadmap, README.md:49-50).
     failure_timeout_s: float = 10.0
+    # Patience for a successor that has NEVER been seen connected (cluster
+    # boot: peers may still be binding; a restart may also target an
+    # already-dead successor, which must eventually be ringed around).
+    # None → max(30s, 3 × failure_timeout_s).
+    startup_grace_s: float | None = None
+
+    @property
+    def effective_startup_grace_s(self) -> float:
+        if self.startup_grace_s is not None:
+            return self.startup_grace_s
+        return max(30.0, 3.0 * self.failure_timeout_s)
     # Optional model/mesh sections for serving nodes.
     model: dict[str, Any] = field(default_factory=dict)
     mesh_axes: dict[str, int] = field(default_factory=dict)  # e.g. {"dp":2,"tp":4}

@@ -87,6 +87,7 @@ class Engine:
         max_seq_len: int | None = None,
         rng_seed: int = 0,
         name: str | None = None,
+        host_cache_slots: int = 0,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
@@ -106,7 +107,23 @@ class Engine:
             page_size=page_size,
             dtype=cfg.dtype,
         )
-        self.tree = RadixTree(page_size=page_size, on_free=self.pool.free)
+        if host_cache_slots > 0:
+            # Hierarchical cache: HBM-evicted prefixes fall back to a
+            # host-RAM tier and are restored on hit instead of recomputed
+            # (cache/host_cache.py; the reference's HiCache stubs made real).
+            from radixmesh_tpu.cache.host_cache import HierarchicalCache, HostKVStore
+
+            host_store = HostKVStore(
+                num_slots=host_cache_slots,
+                num_layers=cfg.n_layers,
+                num_kv_heads=cfg.n_kv_heads,
+                head_dim=cfg.head_dim,
+                page_size=page_size,
+                dtype=cfg.dtype,
+            )
+            self.tree: RadixTree = HierarchicalCache(self.pool, host_store)
+        else:
+            self.tree = RadixTree(page_size=page_size, on_free=self.pool.free)
         # Reserved scratch page: inactive decode rows write/read here.
         scratch = self.pool.alloc(page_size)
         assert scratch is not None
@@ -240,7 +257,12 @@ class Engine:
         page-aligned and always leaves ≥1 token uncached so prefill has
         logits to sample the first output token from."""
         prompt = req.prompt
-        match = self.tree.match_prefix(prompt)
+        # Hierarchical trees restore host-resident extensions into device
+        # slots as part of the match (host→HBM copy beats a recompute).
+        if hasattr(self.tree, "match_and_load"):
+            match = self.tree.match_and_load(prompt)
+        else:
+            match = self.tree.match_prefix(prompt)
         reuse = min(
             match.length, (len(prompt) - 1) // self.page_size * self.page_size
         )

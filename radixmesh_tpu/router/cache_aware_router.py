@@ -32,10 +32,15 @@ __all__ = ["CacheAwareRouter", "RouteResult"]
 @dataclass
 class RouteResult:
     """Where to send a request (reference ``RouteResult``,
-    ``cache_aware_router.py:8-11``), plus hit telemetry."""
+    ``cache_aware_router.py:8-11``), plus hit telemetry.
 
-    prefill_addr: str
-    decode_addr: str
+    An address is ``None`` when NO node of that role is currently alive
+    (every member left the topology view): the caller should surface
+    "no capacity" — queueing or erroring per its policy — rather than
+    dialing."""
+
+    prefill_addr: str | None
+    decode_addr: str | None
     prefill_cache_hit: bool = False
     decode_cache_hit: bool = False
     match_len: int = 0

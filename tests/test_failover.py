@@ -53,6 +53,7 @@ def make_node(addr: str) -> MeshCache:
         tick_interval_s=0.1,
         gc_interval_s=30.0,
         failure_timeout_s=0.4,
+        startup_grace_s=1.0,
     )
     pool = (
         None
@@ -235,3 +236,29 @@ class TestDoubleFailure:
                 if n.role is not NodeRole.ROUTER
             )
         )
+
+
+class TestRestartIntoDeadSuccessor:
+    def test_rejoin_when_static_successor_also_dead(self, cluster):
+        """Ranks 1 and 2 die; rank 1 restarts while rank 2 is still down.
+        Its JOIN initially targets dead rank 2 (the static initial-view
+        successor) — startup grace must expire and ring around it, or the
+        restarted node wedges forever."""
+        cluster.nodes["p1"].close()
+        cluster.nodes["p2"].close()
+        survivors = cluster.alive_nodes()
+        assert wait_for(
+            lambda: all(
+                not n.view.contains(1) and not n.view.contains(2)
+                for n in survivors
+            ),
+            timeout=20,
+        )
+        reborn = make_node("p1").start()
+        cluster.nodes["p1"] = reborn
+        everyone = survivors + [reborn]
+        assert wait_for(
+            lambda: all(n.view.contains(1) for n in everyone), timeout=20
+        ), [n.view for n in everyone]
+        insert_with_pool(cluster.nodes["p0"], [6, 6, 6])
+        assert wait_for(lambda: reborn.match_prefix([6, 6, 6]).length == 3)
