@@ -356,6 +356,15 @@ class RadixTree:
         callback, whose return value replaces the node's value — the hook
         the distributed layer uses for rank-conflict resolution (reference
         ``radix_mesh.py:273-323`` overrides the whole walk instead).
+
+        A matched node that is HOST-resident (``value is None`` after a
+        write-back) ADOPTS the incoming device segment: the caller just
+        recomputed that span's KV, and taking it restores the invariant
+        that device residency is prefix-closed (no device KV below a
+        device-empty node — ``match_prefix`` and eviction both assume it).
+        Adopted spans are NOT counted in the returned already-present
+        length, so callers treat their slots as tree-owned, exactly like a
+        fresh leaf's.
         """
         key = as_key(key)
         if len(value) != len(key):
@@ -424,21 +433,24 @@ class RadixTree:
             else:
                 self._remove_node(node, freed_host)
             # This node no longer holds device KV: decrement every
-            # ancestor's count; an ancestor reaching zero becomes a
-            # candidate itself.
-            parent = node.parent
-            anc = parent
+            # ancestor's count; the nearest DEVICE-holding ancestor (there
+            # may be host-resident/structural nodes in between) becomes a
+            # candidate when its count reaches zero.
+            anc = node.parent
             while anc is not None and anc is not self.root:
                 dev_below[id(anc)] -= 1
                 anc = anc.parent
             dev_below[id(self.root)] -= 1
+            anc = node.parent
+            while anc is not self.root and anc.value is None:
+                anc = anc.parent
             if (
-                parent is not self.root
-                and parent.value is not None
-                and parent.lock_ref == 0
-                and dev_below[id(parent)] == 0
+                anc is not self.root
+                and anc.value is not None
+                and anc.lock_ref == 0
+                and dev_below[id(anc)] == 0
             ):
-                heapq.heappush(leaves, parent)
+                heapq.heappush(leaves, anc)
         if freed_arrays and self.on_free is not None:
             self.on_free(np.concatenate(freed_arrays))
         if freed_host and self.on_free_host is not None:
@@ -582,11 +594,21 @@ class RadixTree:
             child.last_access_time = self._time()
             if m < len(child.key):
                 child = self._split_node(child, m)
-            if on_conflict is not None:
-                new_seg = value[:m]
-                if child.value != new_seg:
-                    child.value = on_conflict(child, new_seg)
-            total_prefix += m
+            if child.value is None:
+                # Host-resident (or structural) node: adopt the caller's
+                # freshly computed device KV for this span. Not counted as
+                # already-present — the caller must hand these slots over
+                # (they are tree-owned now). The host copy, if any, stays:
+                # re-eviction of this node is then free.
+                child.value = value[:m]
+                self.evictable_size_ += len(child.key)
+                self._record_store_event(child)
+            else:
+                if on_conflict is not None:
+                    new_seg = value[:m]
+                    if child.value != new_seg:
+                        child.value = on_conflict(child, new_seg)
+                total_prefix += m
             if m == len(key):
                 return total_prefix
             key = key[m:]

@@ -184,6 +184,8 @@ def load_config(path: str) -> MeshConfig:
         "num_kv_slots",
         "gc_interval_s",
         "tick_interval_s",
+        "failure_timeout_s",
+        "startup_grace_s",
         "model",
         "mesh_axes",
     }
@@ -204,6 +206,12 @@ def load_config(path: str) -> MeshConfig:
         num_kv_slots=int(raw.get("num_kv_slots", 65536)),
         gc_interval_s=float(raw.get("gc_interval_s", 10.0)),
         tick_interval_s=float(raw.get("tick_interval_s", 10.0)),
+        failure_timeout_s=float(raw.get("failure_timeout_s", 10.0)),
+        startup_grace_s=(
+            None
+            if raw.get("startup_grace_s") is None
+            else float(raw["startup_grace_s"])
+        ),
         model=dict(raw.get("model", {})),
         mesh_axes=dict(raw.get("mesh_axes", {})),
     )

@@ -1,0 +1,164 @@
+"""CLI launcher: ``radixmesh-tpu <command> --config-file cfg.yaml``.
+
+The reference's only entry points are two test modules driven by a single
+``--config-file`` flag (``test_util.py:16-23``, ``README.md:33-45``). This
+CLI keeps that one-YAML-per-node operational model (identical config on
+every node except ``local_addr``, reference ``README.md:122-124``) and adds
+real commands:
+
+- ``node``  — run one cache-mesh node (prefill / decode / router). Router
+  nodes also expose the HTTP routing API (``POST /route``).
+- ``serve`` — run a single-node serving engine with the HTTP generate API
+  (cache-mesh-less quickstart; the disaggregated path wires engines to
+  mesh nodes programmatically, see ``engine/disagg.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from radixmesh_tpu.utils.logging import configure_logger, get_logger
+
+__all__ = ["main"]
+
+
+def _apply_platform_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment via jax.config:
+    some deployments pin a platform plugin at interpreter startup
+    (sitecustomize), which silently overrides the env var — the operator's
+    explicit choice must win."""
+    import os
+
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+
+def _run_node(args: argparse.Namespace) -> int:
+    _apply_platform_env()
+    import jax
+
+    from radixmesh_tpu.cache.kv_pool import PagedKVPool
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.config import NodeRole, load_config, parse_addr
+    from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+    from radixmesh_tpu.server.http_frontend import RouterFrontend
+
+    cfg = load_config(args.config_file)
+    role, rank, _ = cfg.local_identity()
+    configure_logger(f"{role.value}@{rank}")
+    log = get_logger("launch")
+
+    pool = None
+    if role is not NodeRole.ROUTER:
+        model = cfg.model or {}
+        pool = PagedKVPool(
+            num_slots=cfg.num_kv_slots,
+            num_layers=int(model.get("n_layers", 1)),
+            num_kv_heads=int(model.get("n_kv_heads", 1)),
+            head_dim=int(model.get("head_dim", 128)),
+            page_size=cfg.page_size,
+        )
+    node = MeshCache(cfg, pool=pool).start()
+    log.info("node started; waiting for ring verification...")
+    if not node.wait_ready(timeout=args.ready_timeout):
+        log.error("startup tick barrier timed out")
+        node.close()
+        return 1
+    log.info("ring verified (view epoch=%d)", node.view.epoch)
+
+    frontend = None
+    if role is NodeRole.ROUTER:
+        router = CacheAwareRouter(node, cfg)
+        router.watch_topology()
+        if not args.warm_up:
+            router.finish_warm_up()
+        host = parse_addr(cfg.local_addr)[0] or "127.0.0.1"
+        frontend = RouterFrontend(router, host=host, port=args.http_port)
+        log.info("routing API on port %d", frontend.port)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        if frontend is not None:
+            frontend.close()
+        node.close(graceful=True)
+    return 0
+
+
+def _run_serve(args: argparse.Namespace) -> int:
+    _apply_platform_env()
+    import jax
+
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.models import get_config, init_params
+    from radixmesh_tpu.server.http_frontend import ServingFrontend
+
+    configure_logger("serve")
+    log = get_logger("launch")
+    cfg = get_config(args.model)
+    log.info("initializing %s (%d layers)...", args.model, cfg.n_layers)
+    params = init_params(cfg, jax.random.PRNGKey(args.seed))
+    engine = Engine(
+        cfg,
+        params,
+        num_slots=args.kv_slots,
+        page_size=args.page_size,
+        max_batch=args.max_batch,
+        host_cache_slots=args.host_cache_slots,
+    )
+    frontend = ServingFrontend(engine, host=args.host, port=args.http_port)
+    print(f"serving {args.model} on http://{args.host}:{frontend.port}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        frontend.close()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="radixmesh-tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    node = sub.add_parser("node", help="run one cache-mesh node")
+    node.add_argument("--config-file", required=True)
+    node.add_argument("--http-port", type=int, default=0, help="router API port")
+    node.add_argument("--ready-timeout", type=float, default=120.0)
+    node.add_argument(
+        "--warm-up",
+        action="store_true",
+        help="start the router in warm-up (spread) mode",
+    )
+    node.set_defaults(fn=_run_node)
+
+    serve = sub.add_parser("serve", help="run a single-node serving engine")
+    serve.add_argument("--model", default="llama3-tiny")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--http-port", type=int, default=8000)
+    serve.add_argument("--kv-slots", type=int, default=4096)
+    serve.add_argument("--page-size", type=int, default=16)
+    serve.add_argument("--max-batch", type=int, default=8)
+    serve.add_argument("--host-cache-slots", type=int, default=0)
+    serve.add_argument("--seed", type=int, default=0)
+    serve.set_defaults(fn=_run_serve)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Serving frontends (HTTP) — the layer the reference leaves out of repo
+(SURVEY §1: "serving frontend (not in repo)")."""
+
+from radixmesh_tpu.server.http_frontend import (
+    EngineRunner,
+    RouterFrontend,
+    ServingFrontend,
+)
+
+__all__ = ["EngineRunner", "RouterFrontend", "ServingFrontend"]

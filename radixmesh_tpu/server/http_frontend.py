@@ -1,0 +1,316 @@
+"""HTTP serving frontend over the engine + cache-aware router.
+
+The reference stops at the cache layer — "serving frontend (not in repo)"
+is the explicit seam above its router (SURVEY §1 L5). This module supplies
+that frontend with stdlib-only HTTP (no framework dependency):
+
+- :class:`ServingFrontend` (prefill/decode nodes): ``POST /generate``
+  (token-ids in, token-ids out; optional SSE streaming), ``GET /metrics``
+  (Prometheus exposition from ``obs/metrics.py``), ``GET /healthz``,
+  ``GET /stats`` (engine hit-rate/TTFT snapshot).
+- :class:`RouterFrontend` (router node): ``POST /route`` → the prefill +
+  decode addresses holding the longest cached prefix
+  (``router/cache_aware_router.py``), plus the same health/metrics.
+
+Threading model: the engine is single-threaded by design (host-side tree
+mutation between device steps, SURVEY §7 hard part (c)); an
+:class:`EngineRunner` thread owns it exclusively, stepping while work
+exists. HTTP handler threads only enqueue requests and poll for their
+completion — they never touch engine internals.
+
+The API is token-ids-native: tokenization is the client's concern (no
+tokenizer download in the serving path). A ``transformers`` tokenizer can
+be layered client-side.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Sequence
+
+from radixmesh_tpu.engine.engine import Engine
+from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
+from radixmesh_tpu.obs.metrics import get_registry
+from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+from radixmesh_tpu.utils.logging import get_logger
+
+__all__ = ["EngineRunner", "ServingFrontend", "RouterFrontend"]
+
+
+class EngineRunner:
+    """Exclusive owner of an :class:`Engine`: a single thread steps the
+    scheduler while work exists; other threads submit and wait."""
+
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="engine-runner"
+        )
+        self.log = get_logger("engine.runner")
+
+    def start(self) -> "EngineRunner":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+
+    def submit(
+        self, prompt: Sequence[int], sampling: SamplingParams | None = None
+    ) -> Request:
+        with self._lock:
+            req = self.engine.add_request(prompt, sampling)
+        self._wake.set()
+        return req
+
+    def wait(self, req: Request, timeout: float | None = None) -> list[int]:
+        """Block until ``req`` finishes; returns its generated tokens."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while req.state is not RequestState.FINISHED:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"request {req.rid} not finished in time")
+            time.sleep(0.002)
+        return req.generated
+
+    def tokens_so_far(self, req: Request) -> list[int]:
+        # list() under the engine lock is not needed: handler threads only
+        # read the append-only list, and a torn read costs one token of
+        # staleness, not corruption (CPython list append is atomic).
+        return list(req.output_tokens)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                has_work = self.engine.has_work()
+                if has_work:
+                    try:
+                        self.engine.step()
+                    except Exception:  # noqa: BLE001 — a bad request must not kill serving
+                        self.log.exception("engine step failed")
+            if not has_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+
+def _json_response(handler: BaseHTTPRequestHandler, code: int, obj: dict) -> None:
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def _read_json(handler: BaseHTTPRequestHandler) -> dict:
+    length = int(handler.headers.get("Content-Length", 0))
+    if length <= 0 or length > 64 * 1024 * 1024:
+        raise ValueError("missing or oversized body")
+    obj = json.loads(handler.rfile.read(length))
+    if not isinstance(obj, dict):
+        raise ValueError("body must be a JSON object")
+    return obj
+
+
+class _FrontendServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ServingFrontend:
+    """HTTP API over one serving engine."""
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 0):
+        self.runner = EngineRunner(engine).start()
+        self.log = get_logger("http.serve")
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route through our logger
+                frontend.log.debug(fmt, *args)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    _json_response(self, 200, {"status": "ok"})
+                elif self.path == "/metrics":
+                    body = get_registry().render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/stats":
+                    s = frontend.runner.engine.stats
+                    _json_response(
+                        self,
+                        200,
+                        {
+                            "hit_rate": s.hit_rate,
+                            "p50_ttft_s": s.p50_ttft_s,
+                            "prompt_tokens": s.prompt_tokens,
+                            "cached_tokens": s.cached_tokens,
+                            "generated_tokens": s.generated_tokens,
+                            "finished": s.finished,
+                            "preemptions": s.preemptions,
+                        },
+                    )
+                else:
+                    _json_response(self, 404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    _json_response(self, 404, {"error": "not found"})
+                    return
+                try:
+                    body = _read_json(self)
+                    ids = body["input_ids"]
+                    if not isinstance(ids, list) or not all(
+                        isinstance(t, int) for t in ids
+                    ):
+                        raise ValueError("input_ids must be a list of ints")
+                    sampling = SamplingParams(
+                        temperature=float(body.get("temperature", 0.0)),
+                        top_p=float(body.get("top_p", 1.0)),
+                        max_new_tokens=int(body.get("max_tokens", 16)),
+                        stop_token_ids=tuple(body.get("stop_token_ids", ())),
+                    )
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    _json_response(self, 400, {"error": str(e)})
+                    return
+                try:
+                    req = frontend.runner.submit(ids, sampling)
+                except ValueError as e:  # e.g. prompt too long
+                    _json_response(self, 400, {"error": str(e)})
+                    return
+                if body.get("stream"):
+                    self._stream(req)
+                    return
+                tokens = frontend.runner.wait(
+                    req, timeout=float(body.get("timeout", 300.0))
+                )
+                _json_response(
+                    self,
+                    200,
+                    {
+                        "output_ids": tokens,
+                        "cached_tokens": req.prefix_len,
+                        "rid": req.rid,
+                    },
+                )
+
+            def _stream(self, req: Request) -> None:
+                """Server-sent events: one ``data:`` line per new token."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                sent = 0
+                while True:
+                    tokens = frontend.runner.tokens_so_far(req)
+                    for t in tokens[sent:]:
+                        self.wfile.write(
+                            f"data: {json.dumps({'token': t})}\n\n".encode()
+                        )
+                    sent = len(tokens)
+                    self.wfile.flush()
+                    if req.state is RequestState.FINISHED:
+                        final = frontend.runner.tokens_so_far(req)
+                        for t in final[sent:]:
+                            self.wfile.write(
+                                f"data: {json.dumps({'token': t})}\n\n".encode()
+                            )
+                        self.wfile.write(
+                            f"data: {json.dumps({'done': True, 'output_ids': final})}\n\n".encode()
+                        )
+                        self.wfile.flush()
+                        return
+                    time.sleep(0.005)
+
+        self._server = _FrontendServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="http-serve"
+        )
+        self._thread.start()
+        self.log.info("serving frontend on %s:%d", host, self.port)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self.runner.close()
+
+
+class RouterFrontend:
+    """HTTP API over a router node's cache-aware router."""
+
+    def __init__(
+        self, router: CacheAwareRouter, host: str = "127.0.0.1", port: int = 0
+    ):
+        self.router = router
+        self.log = get_logger("http.route")
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                frontend.log.debug(fmt, *args)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    _json_response(self, 200, {"status": "ok"})
+                elif self.path == "/metrics":
+                    body = get_registry().render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    _json_response(self, 404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/route":
+                    _json_response(self, 404, {"error": "not found"})
+                    return
+                try:
+                    body = _read_json(self)
+                    ids = body["input_ids"]
+                    if not isinstance(ids, list) or not all(
+                        isinstance(t, int) for t in ids
+                    ):
+                        raise ValueError("input_ids must be a list of ints")
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    _json_response(self, 400, {"error": str(e)})
+                    return
+                res = frontend.router.cache_aware_route(ids)
+                _json_response(
+                    self,
+                    200,
+                    {
+                        # null address = no node of that role alive right
+                        # now (RouteResult contract): caller queues/errors.
+                        "prefill_addr": res.prefill_addr,
+                        "decode_addr": res.decode_addr,
+                        "prefill_cache_hit": res.prefill_cache_hit,
+                        "decode_cache_hit": res.decode_cache_hit,
+                        "match_len": res.match_len,
+                    },
+                )
+
+        self._server = _FrontendServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="http-route"
+        )
+        self._thread.start()
+        self.log.info("router frontend on %s:%d", host, self.port)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

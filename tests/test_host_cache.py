@@ -208,3 +208,57 @@ class TestEngineWithHostTier:
         snap = get_registry().snapshot()
         assert snap.get("hicache_backup_tokens_total", 0) > 0
         assert snap.get("hicache_restore_tokens_total", 0) > 0
+
+
+class TestDeviceClosureInvariant:
+    def test_insert_readopts_host_resident_span(self):
+        """Publishing a recomputed sequence through a written-back prefix
+        re-adopts device KV into the host-resident nodes: the whole path
+        becomes device-resident again (no device leaf stranded below a
+        host node), and the adopted span is NOT reported already-present
+        (its slots are tree-owned now)."""
+        pool, host = make_pool(), make_host()
+        tree = HierarchicalCache(pool, host)
+        k8 = list(range(8))
+        tree.insert(k8, pool.alloc(8))
+        tree.evict(8)
+        assert tree.match_prefix(k8).host_length == 8
+
+        # Recompute: fresh device slots for the full 12-token sequence.
+        k12 = list(range(12))
+        slots = pool.alloc(12)
+        fill(pool, slots, seed=9)
+        matched = tree.insert(k12, slots)
+        assert matched == 0  # adopted spans are not "already present"
+        res = tree.match_prefix(k12)
+        assert res.length == 12 and res.host_length == 0
+        np.testing.assert_array_equal(res.indices(), slots)
+        # Accounting: the full path is evictable again.
+        assert tree.evictable_size() == 12
+
+    def test_evict_skips_host_parent_to_device_ancestor(self):
+        """R → A(dev) → H(host-only) → C(dev): one evict() call must free
+        both C and A (H, holding no device KV, is transparent)."""
+        pool, host = make_pool(num_slots=64), make_host()
+        tree = HierarchicalCache(pool, host)
+        sA = pool.alloc(4)
+        tree.insert(list(range(4)), sA)
+        sH = pool.alloc(4)
+        tree.insert(list(range(8)), np.concatenate([sA, sH]))
+        sC = pool.alloc(4)
+        tree.insert(list(range(12)), np.concatenate([sA, sH, sC]))
+        # Make the middle node host-only by hand (simulating an earlier
+        # partial restore state).
+        res = tree.match_prefix(list(range(8)))
+        h_node = res.last_node
+        assert len(h_node.key) == 4
+        hs = host.alloc(4)
+        host.write(hs, gather_padded(pool, np.asarray(h_node.value)))
+        pool.free(np.asarray(h_node.value))
+        h_node.host_value = hs
+        h_node.value = None
+        tree.evictable_size_ -= 4
+
+        freed = tree.evict(8)  # C then A, skipping H
+        assert freed == 8
+        assert pool.free_slots >= 8

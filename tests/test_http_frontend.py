@@ -1,0 +1,224 @@
+"""HTTP serving + routing frontends (the layer above the reference's
+router that its repo explicitly leaves out — SURVEY §1 L5) and the CLI
+launcher's argument surface."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from radixmesh_tpu.engine.engine import Engine
+from radixmesh_tpu.models.llama import ModelConfig, init_params
+from radixmesh_tpu.server.http_frontend import RouterFrontend, ServingFrontend
+
+
+def _post(url: str, obj: dict, timeout=60):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url: str, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+@pytest.fixture(scope="module")
+def frontend():
+    cfg = ModelConfig.tiny()
+    eng = Engine(
+        cfg,
+        init_params(cfg, jax.random.PRNGKey(0)),
+        num_slots=512,
+        page_size=4,
+        max_batch=2,
+        name="http-test",
+    )
+    f = ServingFrontend(eng, port=0)
+    yield f
+    f.close()
+
+
+class TestServingFrontend:
+    def test_generate(self, frontend):
+        status, out = _post(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            {"input_ids": list(range(1, 20)), "max_tokens": 8},
+        )
+        assert status == 200
+        assert len(out["output_ids"]) >= 1
+        assert out["cached_tokens"] == 0
+
+    def test_generate_hits_cache_on_revisit(self, frontend):
+        prompt = list(range(40, 80))
+        _post(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            {"input_ids": prompt, "max_tokens": 4},
+        )
+        status, out = _post(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            {"input_ids": prompt, "max_tokens": 4},
+        )
+        assert status == 200
+        assert out["cached_tokens"] > 0
+
+    def test_generate_deterministic_greedy(self, frontend):
+        prompt = list(range(90, 120))
+        outs = [
+            _post(
+                f"http://127.0.0.1:{frontend.port}/generate",
+                {"input_ids": prompt, "max_tokens": 6, "temperature": 0.0},
+            )[1]["output_ids"]
+            for _ in range(2)
+        ]
+        assert outs[0] == outs[1]
+
+    def test_streaming_sse(self, frontend):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            data=json.dumps(
+                {"input_ids": list(range(1, 16)), "max_tokens": 5, "stream": True}
+            ).encode(),
+            method="POST",
+        )
+        tokens, done = [], None
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            for line in r:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                evt = json.loads(line[len("data: "):])
+                if evt.get("done"):
+                    done = evt
+                    break
+                tokens.append(evt["token"])
+        assert done is not None
+        assert done["output_ids"] == tokens
+
+    def test_bad_request(self, frontend):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(
+                f"http://127.0.0.1:{frontend.port}/generate",
+                {"input_ids": "not a list"},
+            )
+        assert e.value.code == 400
+
+    def test_healthz_stats_metrics(self, frontend):
+        status, _ = _get(f"http://127.0.0.1:{frontend.port}/healthz")
+        assert status == 200
+        status, body = _get(f"http://127.0.0.1:{frontend.port}/stats")
+        assert status == 200 and b"hit_rate" in body
+        # The module-scoped engine bound its counters to an earlier test's
+        # registry (conftest isolates registries per test), so only check
+        # the endpoint serves a well-formed exposition here; counter
+        # presence is covered by test_metrics.py.
+        status, body = _get(f"http://127.0.0.1:{frontend.port}/metrics")
+        assert status == 200
+
+    def test_concurrent_requests(self, frontend):
+        import concurrent.futures as cf
+
+        prompts = [list(range(s, s + 12)) for s in (1, 50, 100, 150)]
+        with cf.ThreadPoolExecutor(4) as ex:
+            results = list(
+                ex.map(
+                    lambda p: _post(
+                        f"http://127.0.0.1:{frontend.port}/generate",
+                        {"input_ids": p, "max_tokens": 4},
+                    )[1]["output_ids"],
+                    prompts,
+                )
+            )
+        assert all(len(r) >= 1 for r in results)
+
+
+class TestRouterFrontend:
+    def test_route_endpoint(self):
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.comm.inproc import InprocHub
+        from radixmesh_tpu.config import MeshConfig, NodeRole
+        from radixmesh_tpu.cache.kv_pool import PagedKVPool
+        from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+        import time
+
+        InprocHub.reset_default()
+        prefill, decode, router = ["p0"], ["d0"], ["r0"]
+        nodes = []
+        try:
+            for addr in prefill + decode + router:
+                cfg = MeshConfig(
+                    prefill_nodes=prefill,
+                    decode_nodes=decode,
+                    router_nodes=router,
+                    local_addr=addr,
+                    protocol="inproc",
+                    tick_interval_s=0.05,
+                    gc_interval_s=30.0,
+                )
+                pool = (
+                    None
+                    if cfg.local_role is NodeRole.ROUTER
+                    else PagedKVPool(
+                        num_slots=64, num_layers=1, num_kv_heads=1, head_dim=2
+                    )
+                )
+                nodes.append(MeshCache(cfg, pool=pool).start())
+            for n in nodes:
+                assert n.wait_ready(timeout=10)
+            p0 = nodes[0]
+            slots = p0.pool.alloc(3)
+            p0.insert([7, 8, 9], slots)
+            rnode = nodes[2]
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if getattr(rnode.match_prefix([7, 8, 9]), "prefill_rank", -1) == 0:
+                    break
+                time.sleep(0.01)
+            car = CacheAwareRouter(rnode, rnode.cfg)
+            car.finish_warm_up()
+            f = RouterFrontend(car, port=0)
+            try:
+                status, out = _post(
+                    f"http://127.0.0.1:{f.port}/route", {"input_ids": [7, 8, 9, 10]}
+                )
+                assert status == 200
+                assert out["prefill_addr"] == "p0"
+                assert out["prefill_cache_hit"] is True
+                assert out["match_len"] == 3
+                # Cold key falls back to the hash ring.
+                status, out = _post(
+                    f"http://127.0.0.1:{f.port}/route", {"input_ids": [999, 998]}
+                )
+                assert out["prefill_addr"] == "p0"  # only node
+                assert out["prefill_cache_hit"] is False
+            finally:
+                f.close()
+        finally:
+            for n in nodes:
+                n.close()
+            InprocHub.reset_default()
+
+
+class TestLaunchCLI:
+    def test_parser_surface(self):
+        from radixmesh_tpu.launch import main
+
+        with pytest.raises(SystemExit):
+            main(["--help"])
+        with pytest.raises(SystemExit):
+            main([])  # command required
+
+    def test_node_requires_config(self):
+        from radixmesh_tpu.launch import main
+
+        with pytest.raises(SystemExit):
+            main(["node"])
