@@ -1,0 +1,124 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence swap.
+
+The second of the two long-context strategies SURVEY §5 calls for (both
+absent in the reference). Where ring attention (``ring_attention.py``)
+keeps queries resident and rotates K/V blocks ``n-1`` times around the
+``sp`` ring, the Ulysses pattern pays exactly TWO ``all_to_all``
+collectives per attention call:
+
+1. Inputs arrive sequence-sharded ``[B, S/n, H, D]``. An ``all_to_all``
+   redistributes them to head-sharded ``[B, S, H/n, D]`` — each chip now
+   sees the FULL sequence for its slice of heads.
+2. Plain dense causal attention runs locally (full MXU tiles, no loop).
+3. A second ``all_to_all`` on the output swaps back to sequence-sharded.
+
+Trade-off vs ring: Ulysses moves activations twice regardless of ``n``
+(2·B·S·H·D/n per chip) but runs one large fused attention; ring moves K/V
+``n-1`` times but overlaps transfer with compute and has no head-count
+divisibility requirement. Ulysses requires ``H % n == 0`` (its parallelism
+is capped by head count); prefer ring when heads are few (GQA) or the
+mesh is large, Ulysses when attention-per-chip is compute-bound.
+
+GQA note: with ``Hkv < n`` the K/V heads cannot be split ``n`` ways, so
+K/V are all-gathered over ``sp`` instead — still cheap, K/V being
+``G×`` smaller than Q under GQA.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ulysses_attention", "ulysses_self_attention"]
+
+_NEG_INF = -1e30
+
+
+def _dense_causal(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Full-sequence causal attention; q [B,S,Hq,D], k/v [B,S,Hkv,D]."""
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qg = (q.astype(jnp.float32) * scale).reshape(b, s, hkv, g, d)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk",
+        qg,
+        k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+    scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bhgqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, hq, d).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [B, S/n, Hq, D] local sequence shard
+    k: jnp.ndarray,  # [B, S/n, Hkv, D]
+    v: jnp.ndarray,  # [B, S/n, Hkv, D]
+    axis_name: str,
+) -> jnp.ndarray:
+    """Per-shard body — call INSIDE ``shard_map`` with the sequence axis
+    sharded over ``axis_name``. Returns the local output [B, S/n, Hq, D]."""
+    n = jax.lax.psum(1, axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % n:
+        raise ValueError(f"Ulysses needs query heads ({hq}) divisible by sp ({n})")
+
+    # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1).
+    ql = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    if hkv % n == 0:
+        kl = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+        vl = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    else:
+        # GQA with fewer KV heads than chips: replicate K/V (G× smaller
+        # than Q) and slice the group each local Q-head slice attends to.
+        kg = jax.lax.all_gather(k, axis_name, axis=1, tiled=True)
+        vg = jax.lax.all_gather(v, axis_name, axis=1, tiled=True)
+        idx = jax.lax.axis_index(axis_name)
+        g = hq // hkv  # query heads per kv head
+        span = hq // n  # query heads per chip
+        if span % g and g % span:
+            # A local query slice would straddle a kv-group boundary with a
+            # non-covering span — the grouped attention below can't express
+            # that mapping. (Ring attention has no such constraint.)
+            raise ValueError(
+                f"Ulysses GQA needs query-head span ({span}) and group size "
+                f"({g}) to divide one another; use ring attention instead"
+            )
+        h_lo = idx * span  # first local query head (global id)
+        # kv head span covering local query heads [h_lo, h_lo + span)
+        kv_lo = h_lo // g
+        kv_span = max(1, span // g)
+        kl = jax.lax.dynamic_slice_in_dim(kg, kv_lo, kv_span, axis=2)
+        vl = jax.lax.dynamic_slice_in_dim(vg, kv_lo, kv_span, axis=2)
+    out = _dense_causal(ql, kl, vl)
+    # head-sharded -> seq-sharded: split seq (axis 1), gather heads (axis 2).
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_self_attention(
+    q: jnp.ndarray,  # [B, S, Hq, D] full (logically sharded) sequence
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Top-level convenience mirroring :func:`ring_self_attention`."""
+    spec = P(None, axis)
+    fn = jax.shard_map(
+        partial(ulysses_attention, axis_name=axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
