@@ -20,17 +20,112 @@ script stays runnable anywhere.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 from functools import partial
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+_CHILD_ENV = "_RADIXMESH_BENCH_CHILD"
+
+if os.environ.get(_CHILD_ENV):  # only the measuring child touches jax
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _error_json(msg: str) -> str:
+    return json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": None,
+        "unit": "tok/s",
+        "vs_baseline": None,
+        "error": msg[-2000:],
+    })
+
+
+def _probe_backend(timeout: int) -> str | None:
+    """Init the default backend in a THROWAWAY process under a watchdog
+    and report its platform — the init itself is what hangs when the TPU
+    tunnel is down (round-1: >25 min inside ``make_c_api_client``), so it
+    must happen where a timeout can kill it."""
+    code = "import jax; print('PLAT=' + jax.default_backend())"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in proc.stdout.decode(errors="replace").splitlines():
+        if line.startswith("PLAT="):
+            return line[5:].strip()
+    return None
+
+
+def supervise() -> int:
+    """Run the benchmark in a child process under a watchdog.
+
+    Backend init in this environment can hang or die inside the TPU
+    plugin (round-1 artifact: rc=1 before any benchmark code ran), so the
+    parent never imports a backend. A bounded probe decides whether the
+    TPU is reachable at all; only then is the long TPU budget spent —
+    otherwise fall back to CPU immediately so an honest number is
+    recorded within the driver's patience. Total failure prints a
+    parseable error JSON instead of a traceback.
+    """
+    backend = _probe_backend(420)
+    log(f"bench[parent]: probe says default backend = {backend}")
+    if backend == "tpu":
+        attempts = [(None, 1800), ("cpu", 900)]
+    else:
+        attempts = [("cpu", 900)]
+    last_err = "no attempts ran"
+    for platform, timeout in attempts:
+        env = dict(os.environ, **{_CHILD_ENV: "1"})
+        if platform:
+            env["JAX_PLATFORMS"] = platform
+        label = platform or "default"
+        log(f"bench[parent]: attempt backend={label} timeout={timeout}s")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, stdout=subprocess.PIPE, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"backend={label}: timed out after {timeout}s"
+            log(f"bench[parent]: {last_err}")
+            continue
+        out = proc.stdout.decode(errors="replace")
+        for line in reversed(out.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if parsed.get("value") is not None:
+                    print(line, flush=True)
+                    return 0
+                last_err = parsed.get("error", f"backend={label}: null value")
+                break
+        else:
+            last_err = f"backend={label}: rc={proc.returncode}, no JSON line"
+        log(f"bench[parent]: {last_err}")
+    print(_error_json(last_err), flush=True)
+    return 0  # parseable-JSON contract kept even on failure
+
+
+def _pin_platform() -> None:
+    """Honor the operator's platform choice despite sitecustomize plugins
+    (shared fix, ``radixmesh_tpu/utils/platform.py``)."""
+    from radixmesh_tpu.utils.platform import pin_platform
+
+    pin_platform()
 
 
 def _dense_decode_step_fn(cfg):
@@ -110,6 +205,7 @@ def _time_loop(run_once, iters: int) -> float:
 def main() -> None:
     from radixmesh_tpu.models.llama import ModelConfig, decode_step, init_params
 
+    _pin_platform()
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = ModelConfig(
@@ -170,4 +266,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_ENV):
+        try:
+            main()
+        except Exception as exc:  # child must still emit a parseable line
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            print(_error_json(f"{type(exc).__name__}: {exc}"), flush=True)
+            sys.exit(1)
+    else:
+        sys.exit(supervise())

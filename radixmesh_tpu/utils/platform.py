@@ -1,0 +1,34 @@
+"""Platform pinning for deployments with startup-pinned JAX plugins.
+
+Some environments register a TPU platform plugin from ``sitecustomize``
+that re-pins the platform at interpreter startup, silently overriding the
+``JAX_PLATFORMS`` env var — which turns a CPU-mesh test or dryrun into a
+multi-minute hang dialing absent hardware. Pushing the env var through
+``jax.config`` makes the operator's explicit choice win. One shared
+implementation (used by the CLI launcher, ``bench.py``, and the driver
+entry points) so deployment quirks get fixed in one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["pin_platform"]
+
+
+def pin_platform() -> None:
+    """Re-assert the caller's platform choice before any backend touch.
+
+    Honors ``JAX_PLATFORMS``; additionally, if the caller set
+    ``--xla_force_host_platform_device_count`` (a CPU-platform-only flag)
+    without naming a platform, they clearly want CPU devices — pin that.
+    """
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat and "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        plat = "cpu"
+    if plat:
+        jax.config.update("jax_platforms", plat)
