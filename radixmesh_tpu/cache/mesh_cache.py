@@ -757,7 +757,22 @@ class MeshCache:
         hook (reference overrides the whole walk instead,
         ``radix_mesh.py:273-323``). Caller holds the lock. Returns the
         length of the already-present prefix."""
-        return self.tree.insert(key, value, on_conflict=self._resolve_conflict)
+        n = self.tree.insert(key, value, on_conflict=self._resolve_conflict)
+        self._trim_to_budget()
+        return n
+
+    def _trim_to_budget(self) -> None:
+        """Bound the replica: LRU-trim unlocked entries beyond
+        ``cfg.mesh_max_tokens``. Local-only (not replicated) — a trimmed
+        replica re-misses, which cache semantics tolerate; freeing is via
+        ``_free_local`` so foreign-rank indices never touch the pool
+        allocator and advertisement-only replicas free nothing."""
+        budget = self.cfg.mesh_max_tokens
+        if budget <= 0:
+            return
+        excess = self.tree.evictable_size_ + self.tree.protected_size_ - budget
+        if excess > 0:
+            self.tree.evict(excess, on_evict=lambda n: self._free_local(n.value))
 
     def _resolve_conflict(self, child: TreeNode, new_seg):
         """Called by the tree for each matched node whose value differs

@@ -376,18 +376,25 @@ class RadixTree:
             return 0
         return self._insert_helper(self.root, key, value, on_conflict)
 
-    def evict(self, num_tokens: int) -> int:
+    def evict(
+        self,
+        num_tokens: int,
+        on_evict: Callable[["TreeNode"], None] | None = None,
+    ) -> int:
         """Evict LRU unlocked leaves until ``num_tokens`` device slots are
         freed (reference ``radix_cache.py:179-202,366-377``). Returns slots
         freed. With a ``writeback`` hook (see :class:`HierarchicalCache`),
         evicted KV is copied to host RAM and the node *stays in the tree*
-        host-resident instead of vanishing."""
-        return self._evict_impl(num_tokens, writeback=None)
+        host-resident instead of vanishing. ``on_evict`` (mesh replicas,
+        whose values are rank-tagged objects rather than slot arrays)
+        receives each evicted node instead of the ``on_free`` slot batch."""
+        return self._evict_impl(num_tokens, writeback=None, on_evict=on_evict)
 
     def _evict_impl(
         self,
         num_tokens: int,
         writeback: Callable[["TreeNode"], bool] | None,
+        on_evict: Callable[["TreeNode"], None] | None = None,
     ) -> int:
         # Candidates are "device leaves": unlocked nodes holding device KV
         # with no device KV anywhere below them (host-resident descendants
@@ -424,7 +431,10 @@ class RadixTree:
             if node is self.root or node.lock_ref > 0 or node.value is None:
                 continue
             freed += len(node.key)
-            freed_arrays.append(np.asarray(node.value, dtype=np.int32))
+            if on_evict is not None:
+                on_evict(node)
+            else:
+                freed_arrays.append(np.asarray(node.value, dtype=np.int32))
             if writeback is not None and writeback(node):
                 # KV now lives in node.host_value; release the device slots
                 # but keep the node (its key remains matchable).

@@ -52,6 +52,13 @@ class MeshConfig:
     page_size: int = 1
     # Cache sizing: number of KV slots (tokens) the paged pool holds.
     num_kv_slots: int = 65536
+    # Replica-size bound (tokens) for the mesh tree. Serving inserts every
+    # sequence ever published into every replica (router included); without
+    # a bound a long-running deployment leaks linearly in tokens served.
+    # Exceeding it triggers a LOCAL LRU trim (not replicated — a trimmed
+    # replica just re-misses; the reference's mesh evict is a no-op TODO,
+    # radix_mesh.py:349-351). 0 disables.
+    mesh_max_tokens: int = 1 << 20
     # Mesh GC / heartbeat cadence (seconds). Reference hardcodes 10s
     # (radix_mesh.py:133,166); configurable here so tests run fast.
     gc_interval_s: float = 10.0
@@ -74,6 +81,21 @@ class MeshConfig:
     # Optional model/mesh sections for serving nodes.
     model: dict[str, Any] = field(default_factory=dict)
     mesh_axes: dict[str, int] = field(default_factory=dict)  # e.g. {"dp":2,"tp":4}
+    # Serving HTTP port of a P/D node = its cache port + this offset.
+    # Derived (not listed per-node) so the reference's identical-config
+    # invariant (README.md:122-124) holds for the serving tier too.
+    serve_port_offset: int = 1000
+
+    def serve_addr(self, cache_addr: str | None) -> str | None:
+        """Map a node's cache-mesh address to its serving-HTTP address.
+        ``None`` for portless addresses (inproc test hubs have no HTTP)."""
+        if cache_addr is None:
+            return None
+        try:
+            host, port = parse_addr(cache_addr)
+        except ValueError:
+            return None
+        return f"{host}:{port + self.serve_port_offset}"
 
     # ---- derived rank space (reference cache_config.py:20-35) ----
 
@@ -165,6 +187,30 @@ class MeshConfig:
         all_nodes = self.prefill_nodes + self.decode_nodes + self.router_nodes
         if len(set(all_nodes)) != len(all_nodes):
             raise ValueError("node addresses must be unique across roles")
+        if self.model:
+            # Serving deployments derive each P/D node's HTTP port as
+            # cache port + offset: both must be bindable and disjoint
+            # from every cache port (same-host topologies collide).
+            cache_ports = {}
+            for addr in self.prefill_nodes + self.decode_nodes:
+                try:
+                    host, port = parse_addr(addr)
+                except ValueError:
+                    continue  # portless inproc address: no HTTP tier
+                cache_ports.setdefault(host, set()).add(port)
+            for host, ports in cache_ports.items():
+                for port in ports:
+                    serve = port + self.serve_port_offset
+                    if not (0 < serve <= 65535):
+                        raise ValueError(
+                            f"serve port {serve} for {host}:{port} out of range; "
+                            "adjust serve_port_offset"
+                        )
+                    if serve in ports:
+                        raise ValueError(
+                            f"serve port {serve} for {host}:{port} collides "
+                            "with another node's cache port on the same host"
+                        )
         self.local_identity()  # raises on bad membership
 
 
@@ -182,12 +228,14 @@ def load_config(path: str) -> MeshConfig:
         "protocol",
         "page_size",
         "num_kv_slots",
+        "mesh_max_tokens",
         "gc_interval_s",
         "tick_interval_s",
         "failure_timeout_s",
         "startup_grace_s",
         "model",
         "mesh_axes",
+        "serve_port_offset",
     }
     unknown = set(raw) - known
     if unknown:
@@ -204,6 +252,7 @@ def load_config(path: str) -> MeshConfig:
         protocol=raw.get("protocol", "tcp"),
         page_size=int(raw.get("page_size", 1)),
         num_kv_slots=int(raw.get("num_kv_slots", 65536)),
+        mesh_max_tokens=int(raw.get("mesh_max_tokens", 1 << 20)),
         gc_interval_s=float(raw.get("gc_interval_s", 10.0)),
         tick_interval_s=float(raw.get("tick_interval_s", 10.0)),
         failure_timeout_s=float(raw.get("failure_timeout_s", 10.0)),
@@ -214,6 +263,7 @@ def load_config(path: str) -> MeshConfig:
         ),
         model=dict(raw.get("model", {})),
         mesh_axes=dict(raw.get("mesh_axes", {})),
+        serve_port_offset=int(raw.get("serve_port_offset", 1000)),
     )
     cfg.validate()
     return cfg

@@ -17,6 +17,15 @@ TPU-first shape discipline:
   shapes never depend on how many requests are live.
 - The KV pool array is donated through both paths; host-side tree
   mutation happens between device steps (SURVEY §7 hard part (c)).
+
+Mesh integration (the reference's core loop, ``radix_mesh.py:193-238``):
+pass ``mesh=MeshCache(...)`` and every publish is *also* inserted into the
+distributed replica at token granularity, so the ring (and through it the
+router) learns which node holds which prefix. Ownership stays split: the
+engine's local tree owns slot lifetime (LRU evict → ``pool.free``), the
+mesh replica is advertisement-only on a serving node (construct it with
+``pool=None`` so distributed GC retires attribution entries without
+double-freeing slots the engine still references).
 """
 
 from __future__ import annotations
@@ -88,6 +97,8 @@ class Engine:
         rng_seed: int = 0,
         name: str | None = None,
         host_cache_slots: int = 0,
+        pool: PagedKVPool | None = None,
+        mesh=None,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
@@ -98,15 +109,36 @@ class Engine:
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.max_pages = -(-self.max_seq_len // page_size)
         self.log = get_logger("engine")
+        # Distributed replica (cache/mesh_cache.py): publishes advertise
+        # this node's prefixes around the ring so the router can send
+        # shared-prefix requests back here (radix_mesh.py:193-238).
+        self.mesh = mesh
 
-        self.pool = PagedKVPool(
-            num_slots=num_slots,
-            num_layers=cfg.n_layers,
-            num_kv_heads=cfg.n_kv_heads,
-            head_dim=cfg.head_dim,
-            page_size=page_size,
-            dtype=cfg.dtype,
-        )
+        if pool is not None:
+            expected = dict(
+                page_size=page_size,
+                num_layers=cfg.n_layers,
+                num_kv_heads=cfg.n_kv_heads,
+                head_dim=cfg.head_dim,
+                dtype=cfg.dtype,
+            )
+            for attr, want in expected.items():
+                got = getattr(pool, attr)
+                if got != want:
+                    raise ValueError(
+                        f"external pool {attr}={got!r} incompatible with "
+                        f"model/engine {attr}={want!r}"
+                    )
+            self.pool = pool
+        else:
+            self.pool = PagedKVPool(
+                num_slots=num_slots,
+                num_layers=cfg.n_layers,
+                num_kv_heads=cfg.n_kv_heads,
+                head_dim=cfg.head_dim,
+                page_size=page_size,
+                dtype=cfg.dtype,
+            )
         if host_cache_slots > 0:
             # Hierarchical cache: HBM-evicted prefixes fall back to a
             # host-RAM tier and are restored on hit instead of recomputed
@@ -403,6 +435,14 @@ class Engine:
             if req.lock_node is not None:
                 self.tree.dec_lock_ref(req.lock_node)
             req.lock_node = new_lock
+        if self.mesh is not None and aligned > 0:
+            # Advertise the (canonical) published prefix around the ring
+            # (radix_mesh.py:193-201). Only the page-ALIGNED prefix: the
+            # local tree truncates inserts to page multiples, so residue
+            # slots [aligned, key_len) are freed at release — advertising
+            # them would map tokens to recycled slots ring-wide, and the
+            # router would promise hits the node cannot serve.
+            self.mesh.insert(key[:aligned], req.token_slots[:aligned])
 
     def _release(self, req: Request) -> None:
         """cache_finished_req (radix_cache.py:439-486): publish the full

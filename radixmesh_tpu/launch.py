@@ -26,17 +26,11 @@ __all__ = ["main"]
 
 
 def _apply_platform_env() -> None:
-    """Re-assert ``JAX_PLATFORMS`` from the environment via jax.config:
-    some deployments pin a platform plugin at interpreter startup
-    (sitecustomize), which silently overrides the env var — the operator's
-    explicit choice must win."""
-    import os
+    """Re-assert the operator's platform choice via jax.config (shared
+    sitecustomize-override fix, ``utils/platform.py``)."""
+    from radixmesh_tpu.utils.platform import pin_platform
 
-    import jax
-
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        jax.config.update("jax_platforms", plat)
+    pin_platform()
 
 
 def _run_node(args: argparse.Namespace) -> int:
@@ -54,8 +48,36 @@ def _run_node(args: argparse.Namespace) -> int:
     configure_logger(f"{role.value}@{rank}")
     log = get_logger("launch")
 
+    # A P/D node with a ``model:`` section is a SERVING node: one shared KV
+    # pool, an Engine that owns slot lifetime, and an advertisement-only
+    # MeshCache (pool=None — the engine frees slots, the mesh must not)
+    # wired into every publish. This is the reference's end-to-end loop
+    # (radix_mesh.py:193-238): serve → publish → replicate → route back.
+    serving = role is not NodeRole.ROUTER and bool(cfg.model)
     pool = None
-    if role is not NodeRole.ROUTER:
+    mcfg = None
+    if serving:
+        from radixmesh_tpu.models import get_config
+
+        model = cfg.model
+        mcfg = get_config(
+            model.get("preset", "llama3-tiny"), **model.get("overrides", {})
+        )
+        # Engine page size (pow-2 paged-attention granularity) is distinct
+        # from cfg.page_size (mesh replication granularity, default 1).
+        page_size = int(model.get("page_size", 16))
+        pool = PagedKVPool(
+            num_slots=int(model.get("kv_slots", cfg.num_kv_slots)),
+            num_layers=mcfg.n_layers,
+            num_kv_heads=mcfg.n_kv_heads,
+            head_dim=mcfg.head_dim,
+            page_size=page_size,
+            dtype=mcfg.dtype,
+        )
+        node = MeshCache(cfg, pool=None).start()
+    elif role is not NodeRole.ROUTER:
+        # Standalone cache node (no model): the mesh owns the pool, like the
+        # reference's model-less deployment.
         model = cfg.model or {}
         pool = PagedKVPool(
             num_slots=cfg.num_kv_slots,
@@ -64,7 +86,9 @@ def _run_node(args: argparse.Namespace) -> int:
             head_dim=int(model.get("head_dim", 128)),
             page_size=cfg.page_size,
         )
-    node = MeshCache(cfg, pool=pool).start()
+        node = MeshCache(cfg, pool=pool).start()
+    else:
+        node = MeshCache(cfg).start()
     log.info("node started; waiting for ring verification...")
     if not node.wait_ready(timeout=args.ready_timeout):
         log.error("startup tick barrier timed out")
@@ -81,6 +105,29 @@ def _run_node(args: argparse.Namespace) -> int:
         host = parse_addr(cfg.local_addr)[0] or "127.0.0.1"
         frontend = RouterFrontend(router, host=host, port=args.http_port)
         log.info("routing API on port %d", frontend.port)
+    elif serving:
+        from radixmesh_tpu.engine.engine import Engine
+        from radixmesh_tpu.models import init_params
+        from radixmesh_tpu.server.http_frontend import ServingFrontend
+
+        model = cfg.model
+        log.info("initializing model %s...", model.get("preset", "llama3-tiny"))
+        params = init_params(mcfg, jax.random.PRNGKey(int(model.get("seed", 0))))
+        engine = Engine(
+            mcfg,
+            params,
+            pool=pool,
+            page_size=pool.page_size,
+            max_batch=int(model.get("max_batch", 8)),
+            host_cache_slots=int(model.get("host_cache_slots", 0)),
+            mesh=node,
+            name=f"{role.value}{rank}",
+        )
+        host, port = parse_addr(cfg.local_addr)
+        frontend = ServingFrontend(
+            engine, host=host or "127.0.0.1", port=port + cfg.serve_port_offset
+        )
+        log.info("serving API on port %d", frontend.port)
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -289,6 +289,7 @@ class RouterFrontend:
                     _json_response(self, 400, {"error": str(e)})
                     return
                 res = frontend.router.cache_aware_route(ids)
+                cfg = frontend.router.config
                 _json_response(
                     self,
                     200,
@@ -297,6 +298,10 @@ class RouterFrontend:
                         # now (RouteResult contract): caller queues/errors.
                         "prefill_addr": res.prefill_addr,
                         "decode_addr": res.decode_addr,
+                        # Where to POST /generate: the routed node's serving
+                        # HTTP endpoint (cache port + serve_port_offset).
+                        "prefill_serve_addr": cfg.serve_addr(res.prefill_addr),
+                        "decode_serve_addr": cfg.serve_addr(res.decode_addr),
                         "prefill_cache_hit": res.prefill_cache_hit,
                         "decode_cache_hit": res.decode_cache_hit,
                         "match_len": res.match_len,

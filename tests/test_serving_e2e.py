@@ -1,0 +1,171 @@
+"""Full-stack E2E over OS processes + real HTTP + native TCP ring.
+
+The deployment the reference implies but never ships (its entry points
+stop at cache correctness, ``README.md:33-45``): ``launch.py node`` runs
+prefill/decode SERVING nodes (Engine + advertisement-only MeshCache over
+one pool) and a router node with the routing API. A client serves a
+request on the routed node, the publish replicates, and a shared-prefix
+follow-up routes back to that node and hits its cache.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+SERVE_OFFSET = 1000
+
+
+def _free_port_pairs(n, offset=SERVE_OFFSET):
+    """n ports whose +offset siblings are also free."""
+    out = []
+    while len(out) < n:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        if port + offset > 65535:
+            continue
+        try:
+            s2 = socket.socket()
+            s2.bind(("127.0.0.1", port + offset))
+            s2.close()
+        except OSError:
+            continue
+        out.append(port)
+    return out
+
+
+def _post(url, obj, timeout=60.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _wait_http(url, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            _get(url, timeout=2.0)
+            return
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.25)
+    raise TimeoutError(f"{url} never came up: {last}")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    p_port, d_port, r_port, r_http = _free_port_pairs(4)
+    prefill = [f"127.0.0.1:{p_port}"]
+    decode = [f"127.0.0.1:{d_port}"]
+    router = [f"127.0.0.1:{r_port}"]
+    base = {
+        "prefill_nodes": prefill,
+        "decode_nodes": decode,
+        "router_nodes": router,
+        "protocol": "tcp",
+        "tick_interval_s": 0.2,
+        "gc_interval_s": 60.0,
+        "serve_port_offset": SERVE_OFFSET,
+        "model": {
+            "preset": "llama3-tiny",
+            "page_size": 4,
+            "kv_slots": 1024,
+            "max_batch": 4,
+        },
+    }
+    procs = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for addr in prefill + decode + router:
+        cfg = dict(base, local_addr=addr)
+        path = tmp_path / f"{addr.replace(':', '_')}.yaml"
+        path.write_text(json.dumps(cfg))  # JSON is valid YAML
+        cmd = [
+            sys.executable, "-m", "radixmesh_tpu.launch", "node",
+            "--config-file", str(path),
+        ]
+        if addr in router:
+            cmd += ["--http-port", str(r_http)]
+        procs.append(subprocess.Popen(cmd, env=env))
+    urls = {
+        "prefill": f"http://127.0.0.1:{p_port + SERVE_OFFSET}",
+        "decode": f"http://127.0.0.1:{d_port + SERVE_OFFSET}",
+        "router": f"http://127.0.0.1:{r_http}",
+    }
+    try:
+        for u in urls.values():
+            _wait_http(u + "/healthz")
+        yield urls
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_route_then_serve_hits_cache(cluster):
+    prompt = list(range(1, 25))  # 24 tokens
+
+    # Cold: route, then serve on the routed prefill node.
+    r1 = _post(cluster["router"] + "/route", {"input_ids": prompt})
+    assert r1["prefill_serve_addr"] is not None
+    serve_url = "http://" + r1["prefill_serve_addr"]
+    assert serve_url in (cluster["prefill"], cluster["decode"], serve_url)
+    g1 = _post(
+        serve_url + "/generate",
+        {"input_ids": prompt, "max_tokens": 4, "temperature": 0.0},
+        timeout=120.0,
+    )
+    assert len(g1["output_ids"]) == 4
+    assert g1["cached_tokens"] == 0
+
+    # The publish replicates; the router must learn it and route the
+    # shared-prefix follow-up to the SAME node, as a cache hit.
+    follow = prompt + [100, 101, 102]
+    deadline = time.monotonic() + 30
+    r2 = None
+    while time.monotonic() < deadline:
+        r2 = _post(cluster["router"] + "/route", {"input_ids": follow})
+        if r2["prefill_cache_hit"]:
+            break
+        time.sleep(0.25)
+    assert r2 and r2["prefill_cache_hit"], f"router never saw the prefix: {r2}"
+    assert "http://" + r2["prefill_serve_addr"] == serve_url
+    assert r2["match_len"] >= len(prompt)
+
+    # Serving the follow-up on the routed node is a prefix hit.
+    g2 = _post(
+        serve_url + "/generate",
+        {"input_ids": follow, "max_tokens": 4, "temperature": 0.0},
+        timeout=120.0,
+    )
+    assert len(g2["output_ids"]) == 4
+    assert g2["cached_tokens"] >= 24
+
+    # The hit shows up in the node's Prometheus metrics.
+    metrics = _get(serve_url + "/metrics")
+    cached = [
+        l for l in metrics.splitlines()
+        if l.startswith("engine_cached_tokens_total") and not l.startswith("#")
+    ]
+    assert cached and any(float(l.rsplit(" ", 1)[1]) >= 24 for l in cached)
