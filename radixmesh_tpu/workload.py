@@ -1,0 +1,120 @@
+"""Synthetic ShareGPT-style multi-turn serving workload.
+
+The north-star benchmark (``BASELINE.json`` "north_star") targets ≥70%
+prefix-cache hit-rate and p50 TTFT < 200 ms on ShareGPT multi-turn
+conversations — the reference never measures it (its benchmark has no
+timers, ``benchmark.py:24-31``, ``README.md:58``). No dataset download is
+possible (or needed): what makes ShareGPT traffic cache-friendly is its
+*shape* — a system prompt shared across conversations plus per-conversation
+histories that grow turn by turn, so turn k's prompt is turn k-1's full
+context plus a little new text. This module generates exactly that shape,
+deterministically.
+
+Usage::
+
+    wl = MultiTurnWorkload(n_conversations=16, n_turns=4, ...)
+    report = run_engine_workload(engine, wl)
+    report["hit_rate"], report["p50_ttft_s"]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["MultiTurnWorkload", "run_engine_workload"]
+
+
+@dataclass
+class _Conversation:
+    conv_id: int
+    context: list[int] = field(default_factory=list)  # grows with each turn
+
+
+class MultiTurnWorkload:
+    """Deterministic multi-turn conversations over a token-id vocabulary.
+
+    Every conversation opens with the same ``system_len``-token system
+    prefix (cross-conversation sharing); each turn appends fresh user
+    tokens to the conversation's accumulated context (within-conversation
+    sharing — the dominant ShareGPT pattern)."""
+
+    def __init__(
+        self,
+        n_conversations: int = 16,
+        n_turns: int = 4,
+        system_len: int = 32,
+        user_len: int = 16,
+        gen_len: int = 8,
+        vocab_size: int = 512,
+        seed: int = 0,
+    ):
+        self.n_conversations = n_conversations
+        self.n_turns = n_turns
+        self.gen_len = gen_len
+        rng = np.random.default_rng(seed)
+        # Token 0 is avoided: engines commonly reserve low ids for specials.
+        self.system = rng.integers(1, vocab_size, size=system_len).tolist()
+        self._user_turns = [
+            [
+                rng.integers(1, vocab_size, size=user_len).tolist()
+                for _ in range(n_turns)
+            ]
+            for _ in range(n_conversations)
+        ]
+        self.conversations = [
+            _Conversation(conv_id=i, context=list(self.system))
+            for i in range(n_conversations)
+        ]
+
+    def round_prompts(self, turn: int) -> list[tuple[_Conversation, list[int]]]:
+        """Turn ``turn`` of every conversation: (conversation, full prompt)."""
+        out = []
+        for conv in self.conversations:
+            prompt = conv.context + self._user_turns[conv.conv_id][turn]
+            out.append((conv, prompt))
+        return out
+
+    def record_reply(self, conv: _Conversation, prompt: list[int], reply: list[int]) -> None:
+        conv.context = prompt + reply
+
+    @property
+    def max_context_len(self) -> int:
+        """Upper bound on final context length (for pool/engine sizing)."""
+        per_turn = (
+            max(len(t) for turns in self._user_turns for t in turns)
+            + self.gen_len
+        )
+        return len(self.system) + self.n_turns * per_turn
+
+
+def run_engine_workload(engine, workload: MultiTurnWorkload) -> dict:
+    """Drive the workload through an :class:`Engine` turn-round by
+    turn-round (each round's requests run concurrently through the
+    continuous batcher, like simultaneous users) and report the
+    north-star metrics from the engine's own counters."""
+    from radixmesh_tpu.engine.request import SamplingParams
+
+    sampling = SamplingParams(
+        temperature=0.0, max_new_tokens=workload.gen_len
+    )
+    start_prompt = engine.stats.prompt_tokens
+    start_cached = engine.stats.cached_tokens
+    start_ttft = len(engine.stats.ttft_s)
+    for turn in range(workload.n_turns):
+        pairs = workload.round_prompts(turn)
+        replies = engine.generate([p for _, p in pairs], sampling)
+        for (conv, prompt), reply in zip(pairs, replies):
+            workload.record_reply(conv, prompt, reply)
+    prompt_tokens = engine.stats.prompt_tokens - start_prompt
+    cached_tokens = engine.stats.cached_tokens - start_cached
+    ttft = engine.stats.ttft_s[start_ttft:]
+    return {
+        "requests": workload.n_conversations * workload.n_turns,
+        "prompt_tokens": prompt_tokens,
+        "cached_tokens": cached_tokens,
+        "hit_rate": cached_tokens / prompt_tokens if prompt_tokens else 0.0,
+        "p50_ttft_s": float(np.median(ttft)) if ttft else 0.0,
+        "p99_ttft_s": float(np.quantile(ttft, 0.99)) if ttft else 0.0,
+    }
