@@ -190,6 +190,39 @@ def _dense_decode_step_fn(cfg):
     return step
 
 
+def _validate_paged_kernel() -> None:
+    """Compile the Pallas paged-attention kernel through Mosaic on the real
+    chip and assert numerics against the jnp oracle BEFORE timing anything
+    (VERDICT round 1: the kernel had only ever run in interpreter mode).
+    Shapes exercise the awkward cases: shuffled page table, ragged lengths
+    (including one not page-aligned), GQA grouping."""
+    from radixmesh_tpu.ops.attention import attend_decode_ref
+    from radixmesh_tpu.ops.paged_attention import paged_attention_kernel
+
+    rng = np.random.default_rng(42)
+    B, Hq, Hkv, D, page, P = 4, 16, 8, 128, 16, 64
+    max_pages = 8
+    q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.normal(size=(Hkv, P, page, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.normal(size=(Hkv, P, page, D)), jnp.bfloat16)
+    pt = jnp.asarray(
+        rng.permutation(P)[: B * max_pages].reshape(B, max_pages), jnp.int32
+    )
+    ln = jnp.asarray([1, page + 3, 5 * page, max_pages * page], jnp.int32)
+    want = np.asarray(attend_decode_ref(q, kp, vp, pt, ln), np.float32)
+    got = np.asarray(
+        jax.block_until_ready(paged_attention_kernel(q, kp, vp, pt, ln)),
+        np.float32,
+    )
+    err = np.max(np.abs(want - got)) / (np.max(np.abs(want)) + 1e-6)
+    log(f"pallas kernel on-chip validation: max rel err {err:.2e}")
+    if not np.allclose(want, got, rtol=3e-2, atol=3e-2):
+        raise AssertionError(
+            f"paged-attention kernel disagrees with oracle on-chip "
+            f"(max rel err {err:.3e})"
+        )
+
+
 def _time_loop(run_once, iters: int) -> float:
     """Seconds per iteration (post-warmup, state threaded through)."""
     state = run_once(None)  # warmup / compile
@@ -218,6 +251,8 @@ def main() -> None:
         batch, ctx, page_size, iters = 8, 128, 16, 8
     log(f"bench: backend={jax.default_backend()} batch={batch} ctx={ctx} "
         f"layers={cfg.n_layers} hidden={cfg.hidden}")
+    if on_tpu:
+        _validate_paged_kernel()
 
     params = init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
