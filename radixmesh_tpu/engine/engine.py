@@ -42,7 +42,12 @@ import numpy as np
 from radixmesh_tpu.cache.kv_pool import PagedKVPool
 from radixmesh_tpu.cache.radix_tree import RadixTree
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
-from radixmesh_tpu.models.llama import ModelConfig, decode_step, prefill_forward
+from radixmesh_tpu.models.llama import (
+    ModelConfig,
+    decode_step,
+    prefill_chunk_paged,
+    prefill_forward,
+)
 from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
 from radixmesh_tpu.ops.sampling import sample_tokens
 from radixmesh_tpu.utils.logging import get_logger
@@ -99,6 +104,8 @@ class Engine:
         host_cache_slots: int = 0,
         pool: PagedKVPool | None = None,
         mesh=None,
+        prefill_chunk: int = 512,
+        long_prefill_threshold: int = 1024,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
@@ -108,6 +115,12 @@ class Engine:
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.max_pages = -(-self.max_seq_len // page_size)
+        # Long-context admission (SURVEY §5): prompts with more than
+        # ``long_prefill_threshold`` uncached tokens prefill in
+        # ``prefill_chunk``-token chunks against the paged pool (O(S·chunk)
+        # memory) instead of the dense path (O(S²) scores).
+        self.prefill_chunk = prefill_chunk
+        self.long_prefill_threshold = long_prefill_threshold
         self.log = get_logger("engine")
         # Distributed replica (cache/mesh_cache.py): publishes advertise
         # this node's prefixes around the ring so the router can send
@@ -346,6 +359,8 @@ class Engine:
             return False
         reuse, prefix_slots, own = acquired
         n_new = len(prompt) - reuse
+        if n_new > self.long_prefill_threshold:
+            return self._prefill_long(req, row, reuse, prefix_slots, own)
 
         s_b = _pow2_at_least(n_new)
         p_b = _pow2_at_least(reuse, floor=self.page_size) if reuse else 0
@@ -383,6 +398,73 @@ class Engine:
         req.output_tokens = [first]
         req.kv_len = len(prompt)
         req.token_slots = np.concatenate([prefix_slots, own[:n_new]])
+        req.own_slots = own
+        self._install_running(req, row, reuse)
+        return True
+
+    def _prefill_long(
+        self,
+        req: Request,
+        row: int,
+        reuse: int,
+        prefix_slots: np.ndarray,
+        own: np.ndarray,
+    ) -> bool:
+        """Chunked long-context prefill: loop ``prefill_chunk``-token
+        chunks through ``prefill_chunk_paged``, which writes each chunk's
+        K/V into the pool and attends blockwise over all pages so far —
+        the cached prefix is consumed IN PLACE via the page table (no
+        host ``pool.gather`` round-trip), and peak memory stays
+        O(chunk · block) however long the prompt is."""
+        prompt = req.prompt
+        total = len(prompt)
+        token_slots = np.concatenate([prefix_slots, own[: total - reuse]])
+        ps = self.page_size
+        n_pages = -(-total // ps)
+        kv_block = 32
+        maxp = _pow2_at_least(n_pages, floor=kv_block)
+        pt = np.full((1, maxp), self._scratch_page, dtype=np.int32)
+        pt[0, :n_pages] = token_slots[::ps] // ps
+        pt_dev = jnp.asarray(pt)
+
+        C = self.prefill_chunk
+        logits = None
+        n_valid = 0
+        for start in range(reuse, total, C):
+            n_valid = min(C, total - start)
+            toks = np.zeros((1, C), dtype=np.int32)
+            toks[0, :n_valid] = prompt[start : start + n_valid]
+            poss = (start + np.arange(C, dtype=np.int32))[None]
+            # Padded lanes write to the scratch slot (never in any page
+            # table) and their outputs are discarded.
+            sl = np.full((1, C), self._scratch_slot, dtype=np.int32)
+            sl[0, :n_valid] = token_slots[start : start + n_valid]
+            logits, self.pool.kv = prefill_chunk_paged(
+                self.params,
+                self.cfg,
+                jnp.asarray(toks),
+                jnp.asarray(poss),
+                self.pool.kv,
+                jnp.asarray(sl),
+                pt_dev,
+                jnp.asarray([start + n_valid], dtype=jnp.int32),
+                page_size=ps,
+                kv_block_pages=kv_block,
+            )
+
+        self._rng, key = jax.random.split(self._rng)
+        first = int(
+            sample_tokens(
+                logits[0, n_valid - 1 : n_valid],
+                key,
+                temperature=req.sampling.temperature,
+                top_p=req.sampling.top_p,
+            )[0]
+        )
+        req.first_token_time = time.monotonic()
+        req.output_tokens = [first]
+        req.kv_len = total
+        req.token_slots = token_slots
         req.own_slots = own
         self._install_running(req, row, reuse)
         return True

@@ -33,7 +33,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from radixmesh_tpu.ops.attention import attend_prefill, paged_attention_pool
+from radixmesh_tpu.ops.attention import (
+    attend_prefill,
+    attend_prefill_paged,
+    paged_attention_pool,
+)
 from radixmesh_tpu.ops.norm import rms_norm
 from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -252,6 +256,78 @@ def prefill_forward(
         layer, x, (params["layers"], cached_k, cached_v)
     )
     return _logits(params, cfg, x), new_k, new_v
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "page_size", "kv_block_pages"),
+    donate_argnums=(4,),
+)
+def prefill_chunk_paged(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, C] one chunk of the prompt (tail-padded)
+    positions: jnp.ndarray,  # [B, C] absolute positions
+    kv_pool: jnp.ndarray,  # [2, L, Hkv, num_slots, D] (donated)
+    slots: jnp.ndarray,  # [B, C] pool slot per chunk token (pad → scratch)
+    page_table: jnp.ndarray,  # [B, max_pages] request pages, in order
+    kv_lengths: jnp.ndarray,  # [B] context tokens valid after this chunk
+    page_size: int = 16,
+    kv_block_pages: int = 32,
+):
+    """One CHUNK of long-context prefill against the paged pool (SURVEY §5:
+    the 32k Qwen2 gate must never materialize O(S²) scores — VERDICT
+    round-1 gap #4). Writes the chunk's K/V into the pool inside the layer
+    scan, then attends blockwise over ALL pages so far (cached prefix +
+    prior chunks + this chunk) with an online softmax; peak memory is
+    O(C · kv_block), independent of prompt length. The host loops chunks,
+    so compile cost is one variant per (C, max_pages) bucket pair.
+
+    Returns ``(logits [B, C, V], kv_pool)``.
+    """
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens]  # [B, C, H]
+    num_slots = kv_pool.shape[3]
+    pages_shape = (
+        2, cfg.n_layers, cfg.n_kv_heads,
+        num_slots // page_size, page_size, cfg.head_dim,
+    )
+
+    def layer(carry, xs):
+        x, kv_pool = carry
+        l_idx, lp = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(lp, h, cfg)  # [B,C,*,D]
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        # Non-adjacent advanced indices (l_idx, slots[B,C]) put the
+        # broadcast index axes FIRST: target layout [B, C, 2, Hkv, D]
+        # (same convention decode_step relies on with slots[B]).
+        new_kv = jnp.stack([k, v], axis=2).astype(kv_pool.dtype)  # [B,C,2,Hkv,D]
+        kv_pool = kv_pool.at[:, l_idx, :, slots].set(new_kv)
+        attn = attend_prefill_paged(
+            q,
+            kv_pool.reshape(pages_shape),
+            page_table,
+            positions,
+            kv_lengths,
+            l_idx,
+            kv_block_pages=kv_block_pages,
+        )
+        x = x + jnp.einsum(
+            "bsqd,qdh->bsh",
+            attn.reshape(attn.shape[0], attn.shape[1], cfg.n_heads, cfg.head_dim),
+            lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
+            precision=_PREC,
+        )
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(lp, h2)
+        return (x, kv_pool), None
+
+    (x, kv_pool), _ = jax.lax.scan(
+        layer, (x, kv_pool), (jnp.arange(cfg.n_layers), params["layers"])
+    )
+    return _logits(params, cfg, x), kv_pool
 
 
 @partial(jax.jit, static_argnames=("cfg", "page_size"), donate_argnums=(3,))

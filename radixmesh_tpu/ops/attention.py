@@ -119,6 +119,88 @@ def attend_decode_ref(
     return out.reshape(B, Hq, D).astype(q.dtype)
 
 
+@partial(jax.jit, static_argnames=("kv_block_pages",))
+def attend_prefill_paged(
+    q: jnp.ndarray,  # [B, C, Hq, D] one chunk of new tokens
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] full-pool pages view
+    page_table: jnp.ndarray,  # [B, max_pages] this request's pages, in order
+    q_positions: jnp.ndarray,  # [B, C] absolute positions of the chunk
+    kv_lengths: jnp.ndarray,  # [B] valid context tokens (incl. this chunk)
+    layer: jnp.ndarray | int,
+    kv_block_pages: int = 32,
+) -> jnp.ndarray:
+    """Blockwise causal attention for CHUNKED prefill over the paged pool
+    (SURVEY §5 long-context): a chunk of C queries attends to the whole
+    context so far — cached prefix plus already-written chunk K/V — by
+    scanning fixed-size KV page blocks with an online softmax. Peak memory
+    is O(C · block), never O(S²): a 32k prompt prefills in C-token chunks
+    against pages, where the dense path (``attend_prefill``) would
+    materialize a 32k×32k score tensor. Blocks are gathered per scan step
+    (one block resident at a time), contracted on the MXU in fp32.
+
+    ``max_pages`` must be a multiple of ``kv_block_pages`` (callers bucket
+    both to powers of two). Returns [B, C, Hq, D].
+    """
+    B, C, Hq, D = q.shape
+    _, _, Hkv, _, page, _ = kv_pages.shape
+    G = Hq // Hkv
+    max_pages = page_table.shape[1]
+    assert max_pages % kv_block_pages == 0, (max_pages, kv_block_pages)
+    n_blocks = max_pages // kv_block_pages
+    bk = kv_block_pages * page  # tokens per block
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
+    # [B, Hkv, G, C, D] so every block step is one fp32 MXU contraction.
+    qg = (q.astype(jnp.float32) * scale).reshape(B, C, Hkv, G, D).transpose(
+        0, 2, 3, 1, 4
+    )
+    k_layer = kv_pages[0, layer]  # [Hkv, P, page, D]
+    v_layer = kv_pages[1, layer]
+    qpos = q_positions[:, None, None, :, None]  # [B,1,1,C,1]
+
+    def block(carry, blk):
+        m, l, acc = carry
+        pids = jax.lax.dynamic_slice(
+            page_table, (0, blk * kv_block_pages), (B, kv_block_pages)
+        )  # [B, bp]
+        # [Hkv, B, bp, page, D] → [B, Hkv, bk, D]
+        k = k_layer[:, pids].reshape(Hkv, B, bk, D).transpose(1, 0, 2, 3)
+        v = v_layer[:, pids].reshape(Hkv, B, bk, D).transpose(1, 0, 2, 3)
+        s = jax.lax.dot_general(
+            qg,
+            k.astype(jnp.float32),
+            dimension_numbers=(((4,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )  # [B, Hkv, G, C, bk]
+        kv_pos = (blk * bk + jnp.arange(bk))[None, None, None, None, :]
+        ok = (kv_pos <= qpos) & (kv_pos < kv_lengths[:, None, None, None, None])
+        s = jnp.where(ok, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # _NEG_INF-masked lanes give exp(_NEG_INF - m_new) == 0 exactly
+        # (m_new >= first-block valid scores > _NEG_INF for real queries).
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p,
+            v.astype(jnp.float32),
+            dimension_numbers=(((4,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )  # [B, Hkv, G, C, D]
+        acc_new = acc * corr + pv
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, Hkv, G, C, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, C, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, G, C, D), dtype=jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(block, (m0, l0, acc0), jnp.arange(n_blocks))
+    # Padded queries (chunk tail) can end with l == 0; their rows are
+    # discarded by the caller — emit 0 instead of NaN so nothing poisons
+    # downstream reductions.
+    out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, C, Hq, D).astype(q.dtype)
+
+
 def paged_attention(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,

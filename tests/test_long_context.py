@@ -1,0 +1,108 @@
+"""Long-context chunked prefill (SURVEY §5; VERDICT round-1 gap #4).
+
+The dense prefill path materializes O(S²) scores — a 32k prompt would
+need a ~32768² score tensor per head. The chunked path
+(``prefill_chunk_paged`` + ``Engine._prefill_long``) must (a) agree with
+the dense path numerically, and (b) admit a 32k prompt at tiny-model
+scale with peak memory O(S · chunk).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.engine.engine import Engine
+from radixmesh_tpu.engine.request import SamplingParams
+from radixmesh_tpu.models.llama import (
+    ModelConfig,
+    init_params,
+    prefill_chunk_paged,
+    prefill_forward,
+)
+
+CFG = ModelConfig.tiny()
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+GREEDY = SamplingParams(temperature=0.0, max_new_tokens=4)
+
+
+def test_chunked_matches_dense_prefill():
+    """Chunk-by-chunk paged prefill reproduces the dense path's logits."""
+    rng = np.random.default_rng(0)
+    S, C, page = 40, 16, 4
+    prompt = rng.integers(1, CFG.vocab_size, size=S).astype(np.int32)
+
+    tok = jnp.asarray(prompt)[None]
+    pos = jnp.arange(S, dtype=jnp.int32)[None]
+    empty = jnp.zeros((CFG.n_layers, 1, 0, CFG.n_kv_heads, CFG.head_dim), CFG.dtype)
+    want, _, _ = prefill_forward(
+        PARAMS, CFG, tok, pos, empty, empty, jnp.zeros((1,), jnp.int32)
+    )
+
+    num_slots = 256
+    pool = jnp.zeros(
+        (2, CFG.n_layers, CFG.n_kv_heads, num_slots, CFG.head_dim), CFG.dtype
+    )
+    maxp = 16
+    pt = jnp.asarray((np.arange(maxp) + 3).astype(np.int32))[None]
+    slots_all = (np.asarray(pt[0])[:, None] * page + np.arange(page)).reshape(-1)
+    outs = []
+    for start in range(0, S, C):
+        n = min(C, S - start)
+        toks = np.zeros((1, C), np.int32)
+        toks[0, :n] = prompt[start : start + n]
+        poss = (start + np.arange(C, dtype=np.int32))[None]
+        sl = np.zeros((1, C), np.int32)
+        sl[0, :n] = slots_all[start : start + n]
+        logits, pool = prefill_chunk_paged(
+            PARAMS, CFG, jnp.asarray(toks), jnp.asarray(poss), pool,
+            jnp.asarray(sl), pt, jnp.asarray([start + n], jnp.int32),
+            page_size=page, kv_block_pages=4,
+        )
+        outs.append(np.asarray(logits[0, :n], np.float32))
+    got = np.concatenate(outs)
+    np.testing.assert_allclose(
+        got, np.asarray(want[0], np.float32), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_engine_long_path_same_output_as_dense():
+    """Greedy generation through the chunked admission path equals the
+    dense path's output (same params, same prompt)."""
+    prompt = np.random.default_rng(1).integers(1, CFG.vocab_size, 96).tolist()
+    dense = Engine(CFG, PARAMS, num_slots=2048, page_size=4, max_batch=2,
+                   long_prefill_threshold=10_000)
+    out_d = dense.generate([prompt], GREEDY)[0]
+    chunked = Engine(CFG, PARAMS, num_slots=2048, page_size=4, max_batch=2,
+                     prefill_chunk=32, long_prefill_threshold=16)
+    out_c = chunked.generate([prompt], GREEDY)[0]
+    assert out_d == out_c
+    assert chunked.stats.prompt_tokens == len(prompt)
+
+
+def test_32k_prompt_prefills():
+    """The VERDICT gate: a 32k-token prompt admits and generates without
+    ever materializing O(S²) scores (the dense path at this length would
+    need a >4-billion-element score tensor per head; peak live memory here
+    is the pool + O(chunk·block) activations)."""
+    cfg = CFG.replace(max_seq_len=34_000)
+    S = 32_768
+    engine = Engine(
+        cfg, PARAMS, num_slots=S + 2048, page_size=16, max_batch=2,
+        prefill_chunk=2048, long_prefill_threshold=4096,
+    )
+    prompt = np.random.default_rng(2).integers(1, cfg.vocab_size, S).tolist()
+    out = engine.generate([prompt], SamplingParams(temperature=0.0, max_new_tokens=2))[0]
+    assert len(out) == 2
+    assert engine.stats.prompt_tokens == S
+    # The full context is live in the paged pool (32768 tokens of KV).
+    req_pages = -(-S // 16)
+    assert engine.pool.free_slots <= engine.pool.num_slots - req_pages * 16
+
+    # Follow-up sharing the 32k prefix is an (almost) total cache hit.
+    follow = prompt + [7, 8, 9]
+    out2 = engine.generate(
+        [follow], SamplingParams(temperature=0.0, max_new_tokens=2)
+    )[0]
+    assert len(out2) == 2
+    assert engine.stats.cached_tokens >= S - 16  # page-aligned reuse
