@@ -203,6 +203,11 @@ class Engine:
         self._scratch_page = self._scratch_slot // page_size
 
         self.waiting: list[Request] = []
+        # Pressure latch: set on preemption, cleared when a request finishes
+        # (or the batch drains). While set, admission pauses so the
+        # surviving rows run to completion instead of the preempted request
+        # re-admitting into the freed row and thrashing the pool forever.
+        self._pressure = False
         self._rows: list[Request | None] = [None] * max_batch
         self._tokens = np.zeros(max_batch, dtype=np.int32)
         self._page_table = np.full(
@@ -306,11 +311,38 @@ class Engine:
         n = n_pages * self.page_size
         slots = self.pool.alloc(n)
         if slots is None:
-            self.tree.evict(n - self.pool.free_slots)
+            if self.mesh is not None and not hasattr(self.tree, "match_and_load"):
+                # Plain-tree eviction destroys the KV, so the prefix must be
+                # un-advertised ring-wide — otherwise the router keeps
+                # routing shared-prefix requests to a node that can no
+                # longer serve them. (Host-tier trees keep evicted KV
+                # servable via restore, so they stay advertised.)
+                self.tree.evict(
+                    n - self.pool.free_slots, on_evict=self._unadvertise
+                )
+            else:
+                self.tree.evict(n - self.pool.free_slots)
             slots = self.pool.alloc(n)
         return slots
 
+    def _unadvertise(self, node) -> None:
+        """Evict hook: release the node's pool slots (``on_evict`` replaces
+        the tree's ``on_free`` batch, so freeing is this hook's job) and
+        best-effort retract the prefix ring-wide: mesh replicas only apply
+        (and replicate) the DELETE when the key lands on an unlocked leaf
+        there, so a prefix another node extended survives."""
+        self.pool.free(np.asarray(node.value, dtype=np.int32))
+        parts = []
+        while node is not None and node.parent is not None:
+            parts.append(node.key)
+            node = node.parent
+        if parts:
+            self.mesh.delete(np.concatenate(parts[::-1]))
+
     def _admit(self) -> None:
+        if self._pressure and any(r is not None for r in self._rows):
+            return
+        self._pressure = False  # batch drained: safe to admit again
         while self.waiting:
             row = self._free_row()
             if row < 0:
@@ -645,6 +677,7 @@ class Engine:
                 req.state = RequestState.FINISHED
                 self.stats.finished += 1
                 self._release(req)
+                self._pressure = False  # freed memory: resume admission
             else:
                 self._m_generated.inc()
                 self._tokens[row] = token
@@ -655,6 +688,7 @@ class Engine:
         are discarded; the published KV makes the retry a long prefix hit)."""
         self.stats.preemptions += 1
         self._m_preempt.inc()
+        self._pressure = True
         self._release(req)
         req.state = RequestState.QUEUED
         req.output_tokens = []
