@@ -224,13 +224,17 @@ def _validate_paged_kernel() -> None:
 
 
 def _time_loop(run_once, iters: int) -> float:
-    """Seconds per iteration (post-warmup, state threaded through)."""
-    state = run_once(None)  # warmup / compile
-    state = run_once(state)
+    """Seconds per iteration. State is threaded through and ``run_once``
+    receives the iteration number so every step computes something new —
+    identical repeated steps can be served from an execution cache by the
+    device runtime (observed on this TPU tunnel: repeat steps collapse to
+    ~0.03 ms), which would make the timing fiction."""
+    state = run_once(None, 0)  # warmup / compile
+    state = run_once(state, 1)
     jax.block_until_ready(state)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        state = run_once(state)
+    for i in range(iters):
+        state = run_once(state, 2 + i)
     jax.block_until_ready(state)
     return (time.perf_counter() - t0) / iters
 
@@ -256,7 +260,11 @@ def main() -> None:
 
     params = init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
-    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch,)), jnp.int32)
+    # One token batch per timed iteration: distinct tokens -> distinct KV
+    # writes -> no two steps are identical (see _time_loop).
+    token_iters = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (iters + 2, batch)), jnp.int32
+    )
     lengths = jnp.full((batch,), ctx, jnp.int32)
 
     # --- paged path (this framework) -------------------------------------
@@ -269,10 +277,11 @@ def main() -> None:
     kv_pool = jnp.zeros(
         (2, cfg.n_layers, cfg.n_kv_heads, num_slots, cfg.head_dim), cfg.dtype)
 
-    def run_paged(state):
+    def run_paged(state, i):
         pool = kv_pool if state is None else state
         logits, pool = decode_step(
-            params, cfg, tokens, pool, slots, page_table, lengths, page_size)
+            params, cfg, token_iters[i], pool, slots, page_table, lengths,
+            page_size)
         return pool
     sec_paged = _time_loop(run_paged, iters)
     tok_s = batch / sec_paged
@@ -285,9 +294,9 @@ def main() -> None:
     ck0 = jnp.zeros(dense_shape, cfg.dtype)
     cv0 = jnp.zeros(dense_shape, cfg.dtype)
 
-    def run_dense(state):
+    def run_dense(state, i):
         ck, cv = (ck0, cv0) if state is None else state
-        logits, ck, cv = dense_step(params, ck, cv, tokens, lengths)
+        logits, ck, cv = dense_step(params, ck, cv, token_iters[i], lengths)
         return ck, cv
     sec_dense = _time_loop(run_dense, iters)
     log(f"dense decode: {sec_dense*1e3:.2f} ms/step, {batch/sec_dense:.1f} tok/s")

@@ -132,8 +132,15 @@ class HierarchicalCache(RadixTree):
 
     # ---- device eviction with write-back ----
 
-    def evict(self, num_tokens: int) -> int:
-        return self._evict_impl(num_tokens, writeback=self._writeback)
+    def evict(self, num_tokens: int, on_evict=None) -> int:
+        """Evict with host write-back. ``on_evict`` fires only for nodes
+        the host tier could NOT absorb (arena full → KV destroyed) — the
+        hook owns their slot release and any external retraction (e.g. a
+        mesh advertisement); written-back nodes stay matchable and
+        advertised."""
+        return self._evict_impl(
+            num_tokens, writeback=self._writeback, on_evict=on_evict
+        )
 
     def _writeback(self, node: TreeNode) -> bool:
         """Copy ``node``'s device KV into the host tier. Returns False (→

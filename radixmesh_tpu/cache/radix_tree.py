@@ -431,16 +431,21 @@ class RadixTree:
             if node is self.root or node.lock_ref > 0 or node.value is None:
                 continue
             freed += len(node.key)
-            if on_evict is not None:
-                on_evict(node)
-            else:
-                freed_arrays.append(np.asarray(node.value, dtype=np.int32))
-            if writeback is not None and writeback(node):
+            wrote_back = writeback is not None and writeback(node)
+            if wrote_back:
                 # KV now lives in node.host_value; release the device slots
-                # but keep the node (its key remains matchable).
+                # but keep the node (its key remains matchable — no
+                # ``on_evict``: the prefix is still servable via restore).
+                freed_arrays.append(np.asarray(node.value, dtype=np.int32))
                 node.value = None
                 self.evictable_size_ -= len(node.key)
             else:
+                # The KV is destroyed. ``on_evict`` (when given) takes over
+                # slot release so it can also retract/account externally.
+                if on_evict is not None:
+                    on_evict(node)
+                else:
+                    freed_arrays.append(np.asarray(node.value, dtype=np.int32))
                 self._remove_node(node, freed_host)
             # This node no longer holds device KV: decrement every
             # ancestor's count; the nearest DEVICE-holding ancestor (there

@@ -311,12 +311,14 @@ class Engine:
         n = n_pages * self.page_size
         slots = self.pool.alloc(n)
         if slots is None:
-            if self.mesh is not None and not hasattr(self.tree, "match_and_load"):
-                # Plain-tree eviction destroys the KV, so the prefix must be
-                # un-advertised ring-wide — otherwise the router keeps
-                # routing shared-prefix requests to a node that can no
-                # longer serve them. (Host-tier trees keep evicted KV
-                # servable via restore, so they stay advertised.)
+            if self.mesh is not None:
+                # Eviction that DESTROYS KV must un-advertise the prefix
+                # ring-wide — otherwise the router keeps routing
+                # shared-prefix requests to a node that can no longer serve
+                # them. The hook fires per destroyed node only: host-tier
+                # trees invoke it just when write-back fails (a written-back
+                # prefix stays servable via restore, so it stays
+                # advertised).
                 self.tree.evict(
                     n - self.pool.free_slots, on_evict=self._unadvertise
                 )

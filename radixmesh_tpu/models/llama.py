@@ -36,7 +36,7 @@ import numpy as np
 from radixmesh_tpu.ops.attention import (
     attend_prefill,
     attend_prefill_paged,
-    paged_attention_pool,
+    paged_decode_attention,
 )
 from radixmesh_tpu.ops.norm import rms_norm
 from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
@@ -368,17 +368,24 @@ def decode_step(
         q, k, v = _qkv(lp, h, cfg)  # [B,1,*,D]
         q = apply_rope(q, positions[:, None], inv_freq)
         k = apply_rope(k, positions[:, None], inv_freq)
-        # Scatter this token's K/V into the pool carry: O(B) rows touched,
-        # in place (the pool is donated) — never a per-layer slice copy.
-        new_kv = jnp.stack([k[:, 0], v[:, 0]], axis=1).astype(
-            kv_pool.dtype
-        )  # [B, 2, Hkv, D]
-        kv_pool = kv_pool.at[:, l_idx, :, slots].set(new_kv)
-        # Attention DMAs only this layer's pages out of the whole pool.
-        attn = paged_attention_pool(
-            q[:, 0], kv_pool.reshape(pages_shape), page_table, lengths, l_idx,
+        # Fused write+attend: the Pallas kernel writes this token's K/V row
+        # into the (aliased) pool and attends over this layer's pages — the
+        # pool buffer flows through the scan with zero copies. (A separate
+        # XLA scatter + kernel read used to force a full pool copy per
+        # layer: ~4 GB of HBM traffic per step at bench shapes.)
+        attn, kv_pool = paged_decode_attention(
+            q[:, 0],
+            k[:, 0].astype(kv_pool.dtype),
+            v[:, 0].astype(kv_pool.dtype),
+            kv_pool.reshape(pages_shape),
+            slots,
+            page_table,
+            lengths,
+            l_idx,
             mesh=mesh,
         )
+        kv_pool = kv_pool.reshape(2, cfg.n_layers, cfg.n_kv_heads, num_slots,
+                                  cfg.head_dim)
         x = x + jnp.einsum(
             "bqd,qdh->bh",
             attn.reshape(B, cfg.n_heads, cfg.head_dim),

@@ -292,3 +292,101 @@ def paged_attention_pool(
         return paged_attention_pool_kernel(q, kv_pages, page_table, lengths, layer)
     k_pages, v_pages = kv_pages[0, layer], kv_pages[1, layer]
     return attend_decode_ref(q, k_pages, v_pages, page_table, lengths)
+
+
+def paged_decode_fused_sharded(
+    q: jnp.ndarray,  # [B, Hq, D] — Hq sharded over tp
+    k_new: jnp.ndarray,  # [B, Hkv, D] — Hkv sharded over tp
+    v_new: jnp.ndarray,
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] — Hkv sharded over tp
+    slots: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    layer: jnp.ndarray | int,
+    mesh,
+    tp_axis: str = "tp",
+    interpret: bool = False,
+):
+    """Tensor-parallel fused decode kernel: each chip writes + attends its
+    local kv-head shard (heads are embarrassingly parallel; the pool's
+    head axis is sharded to match, so writes are chip-local too)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
+
+    layer_arr = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, tp_axis, None),
+            P(None, tp_axis, None),
+            P(None, tp_axis, None),
+            P(None, None, tp_axis, None, None, None),
+            P(None),
+            P(None, None),
+            P(None),
+            P(None),
+        ),
+        out_specs=(
+            P(None, tp_axis, None),
+            P(None, None, tp_axis, None, None, None),
+        ),
+        check_vma=False,
+    )
+    def local(q, kn, vn, kv, sl, pt, ln, l):
+        return paged_decode_fused_kernel(
+            q, kn, vn, kv, sl, pt, ln, l[0], interpret=interpret
+        )
+
+    return local(q, k_new, v_new, kv_pages, slots, page_table, lengths, layer_arr)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_new: jnp.ndarray,  # [B, Hkv, D] this token's K (post-rope)
+    v_new: jnp.ndarray,  # [B, Hkv, D]
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D]
+    slots: jnp.ndarray,  # [B]
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B] incl. current token
+    layer: jnp.ndarray | int,
+    use_kernel: bool | None = None,
+    mesh=None,
+):
+    """One decode step's KV write + paged attention, fused.
+
+    On TPU this is a single aliased ``pallas_call`` — the pool buffer flows
+    through unchanged (zero copies in the layer scan; the XLA scatter +
+    separate kernel read used to cost a full pool copy per layer). The jnp
+    fallback (CPU/odd shapes) scatters then attends the oracle way.
+    Returns ``(attn [B, Hq, D], kv_pages)``.
+    """
+    if use_kernel is None:
+        head_dim = q.shape[-1]
+        use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
+    if use_kernel:
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            return paged_decode_fused_sharded(
+                q, k_new, v_new, kv_pages, slots, page_table, lengths, layer,
+                mesh,
+            )
+        from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
+
+        return paged_decode_fused_kernel(
+            q, k_new, v_new, kv_pages, slots, page_table, lengths, layer
+        )
+    page = kv_pages.shape[4]
+    pg, off = slots // page, slots % page
+    # Force ``layer`` to an advanced (array) index: the advanced indices
+    # (layer, pg, off) are then non-adjacent, so the broadcast batch axis
+    # lands FIRST → target [B, Hkv, D] regardless of how layer was passed.
+    layer = jnp.asarray(layer)
+    kv_pages = kv_pages.at[0, layer, :, pg, off].set(k_new)
+    kv_pages = kv_pages.at[1, layer, :, pg, off].set(v_new)
+    attn = attend_decode_ref(
+        q, kv_pages[0, layer], kv_pages[1, layer], page_table, lengths
+    )
+    return attn, kv_pages

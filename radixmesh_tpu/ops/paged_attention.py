@@ -1,4 +1,4 @@
-"""Pallas TPU decode kernel: attention over non-contiguous radix-cache pages.
+"""Pallas TPU decode kernels: attention over non-contiguous radix-cache pages.
 
 This is the op SURVEY §7 calls the hard part (a): the radix cache hands the
 scheduler a *page table* (page ids into the paged KV pool, arbitrary order,
@@ -6,19 +6,33 @@ shared across requests that share a prefix), and decode attention must
 gather those pages without materializing a dense [B, max_ctx, H, D] copy in
 HBM — the copy is exactly the bandwidth decode can't afford.
 
-Design (one program per sequence, grid = (B,)):
+Design (grid = (B, Hkv), one program per sequence × kv-head):
 
-- The KV pool pages stay in HBM (``memory_space=ANY``); the page table and
-  sequence lengths ride scalar prefetch (SMEM) so the kernel can compute
-  DMA source addresses before the body runs.
-- Pages are DMA'd HBM→VMEM **double-buffered**: page ``i+1``'s copy is in
-  flight while page ``i`` is being contracted on the MXU.
-- Online softmax (running max / sum / weighted accumulator, fp32) across
-  the page loop, GQA via a [Hkv, G, D] query layout contracted against
-  each [page, Hkv, D] KV tile.
-- Per-sequence page counts bound the loop work: DMA start *and* wait are
-  predicated on the same ``page < n_pages(seq)`` condition (no hangs), and
-  out-of-range lanes are masked to -inf before the softmax update.
+- The KV pool pages stay in HBM (``memory_space=ANY``); the page table,
+  sequence lengths, and layer index ride scalar prefetch (SMEM) so DMA
+  source addresses are computable before the body runs.
+- Each program loops over *compute blocks* of ``pages_per_block`` pages
+  (a few hundred tokens per block), bounded by the sequence's true length
+  — short sequences cost short loops, not ``max_pages`` iterations.
+- Block DMAs are **chain-prefetched across grid steps**: while block ``i``
+  of program ``(b, h)`` is being contracted on the MXU, the copy for the
+  *next* block — which may belong to the next head or the next sequence —
+  is already in flight in the other half of a double buffer. DMA latency
+  is exposed once per kernel launch, not once per program.
+- Online softmax (running max / sum / fp32 accumulator in VMEM scratch)
+  across the block loop; GQA by blocking the query as [G, D] per kv head.
+
+Two entry points share the block loop (``_run_block_loop``):
+
+- ``paged_attention_pool_kernel`` — read-only attention over ``length``
+  tokens already resident in pool pages.
+- ``paged_decode_fused_kernel`` — the decode hot path: ALSO writes the
+  current token's K/V row into the pool through an **aliased** output
+  (``input_output_aliases``), so the pool buffer flows through the layer
+  scan with zero copies. The freshly written row is never read back from
+  HBM within the call: HBM blocks are masked to ``length - 1`` and the
+  current token's contribution is folded in from VMEM — which also kills
+  the read-after-write hazard with cross-program block prefetch.
 
 The jnp oracle is ``ops/attention.py::attend_decode_ref``; numerics are
 compared in ``tests/test_ops.py`` (interpreter mode on CPU) and on real TPU
@@ -31,157 +45,501 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["paged_attention_kernel", "paged_attention_pool_kernel"]
+__all__ = [
+    "paged_attention_kernel",
+    "paged_attention_pool_kernel",
+    "paged_decode_fused_kernel",
+]
+
+# exp(finite - MASK) == 0 without the NaN risk of -inf - -inf.
+_MASK = -0.7 * float(np.finfo(np.float32).max)
+
+
+class _BlockCopy:
+    """Async HBM→VMEM gather of one compute block: ``n_pages`` non-contiguous
+    [page, D] tiles of one kv head copied into a contiguous VMEM buffer."""
+
+    def __init__(self, kv_hbm, which, layer, head, buf, sem, page_table_ref,
+                 flat_offset, n_pages):
+        src = kv_hbm.at[which, layer, head]
+        self._copies = [
+            pltpu.make_async_copy(
+                src.at[page_table_ref[flat_offset + i]], buf.at[i], sem
+            )
+            for i in range(n_pages)
+        ]
+
+    def start(self):
+        for c in self._copies:
+            c.start()
+
+    def wait(self):
+        for c in self._copies:
+            c.wait()
+
+
+def _run_block_loop(
+    *,
+    b,
+    h,
+    layer,
+    hbm_len,  # tokens resident in HBM pages for THIS program's sequence
+    q,  # [G, D] fp32, pre-scaled
+    lengths_ref,
+    page_table_ref,
+    buffer_index_ref,
+    init_flag_ref,
+    kv_hbm,
+    k_buf,
+    v_buf,
+    sems,
+    m_scr,
+    l_scr,
+    acc_scr,
+    page: int,
+    pages_per_block: int,
+    pages_per_seq: int,
+    batch_size: int,
+    num_kv_heads: int,
+    min_length: int,  # lengths_ref value below which a row has no HBM work
+):
+    """Initialize the online-softmax scratch and contract ``hbm_len``
+    tokens of HBM pages into it, chain-prefetching block DMAs across grid
+    programs. Shared by the read-only and fused kernels (their only
+    difference here is how many trailing tokens live outside HBM:
+    ``min_length`` is 1 / 2 respectively)."""
+    bk = page * pages_per_block
+
+    def block_copies(bb, hh, ii, slot):
+        off = bb * pages_per_seq + ii * pages_per_block
+        ck = _BlockCopy(kv_hbm, 0, layer, hh, k_buf.at[slot], sems.at[slot, 0],
+                        page_table_ref, off, pages_per_block)
+        cv = _BlockCopy(kv_hbm, 1, layer, hh, v_buf.at[slot], sems.at[slot, 1],
+                        page_table_ref, off, pages_per_block)
+        return ck, cv
+
+    def next_indices(i):
+        """Grid-order successor of block ``i`` of this (b, h) program,
+        skipping sequences with no HBM work."""
+
+        def advance_b():
+            nb = jax.lax.fori_loop(
+                b + 1,
+                batch_size,
+                lambda _, x: jnp.where(
+                    jnp.logical_and(
+                        x < batch_size,
+                        lengths_ref[jax.lax.clamp(0, x, batch_size - 1)]
+                        < min_length,
+                    ),
+                    x + 1,
+                    x,
+                ),
+                b + 1,
+            )
+            return (nb, 0, 0)
+
+        def advance_h():
+            return jax.lax.cond(
+                h + 1 < num_kv_heads, lambda: (b, h + 1, 0), advance_b
+            )
+
+        return jax.lax.cond(i * bk < hbm_len, lambda: (b, h, i), advance_h)
+
+    m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def body(i, _):
+        init_flag = init_flag_ref[0]
+        init_flag_ref[0] = 0
+        slot = buffer_index_ref[0]
+        nb, nh, ni = next_indices(i + 1)
+
+        @pl.when(init_flag)
+        def _cold_start():
+            ck, cv = block_copies(b, h, i, slot)
+            ck.start()
+            cv.start()
+
+        @pl.when(nb < batch_size)
+        def _prefetch_next():
+            nslot = jnp.where(slot == 0, 1, 0)
+            ck, cv = block_copies(nb, nh, ni, nslot)
+            ck.start()
+            cv.start()
+            buffer_index_ref[0] = nslot
+
+        ck, cv = block_copies(b, h, i, slot)
+        ck.wait()
+        k = k_buf[slot].astype(jnp.float32).reshape(bk, -1)  # [bk, D]
+        s = jax.lax.dot_general(  # [G, bk]
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < hbm_len, s, _MASK)
+
+        m_prev = m_scr[...]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)  # [G, 1]
+        m_new = jnp.maximum(m_prev, m_blk)  # lane-replicated [G, D]
+        p = jnp.exp(s - m_new[:, :1])  # [G, bk]
+        corr = jnp.exp(m_prev - m_new)
+        l_blk = jnp.sum(p, axis=-1, keepdims=True)
+        l_scr[...] = l_scr[...] * corr + l_blk
+        m_scr[...] = m_new
+
+        cv.wait()
+        v = v_buf[slot].astype(jnp.float32).reshape(bk, -1)  # [bk, D]
+        pv = jax.lax.dot_general(  # [G, D]
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr + pv
+        return ()
+
+    jax.lax.fori_loop(0, pl.cdiv(hbm_len, bk), body, ())
 
 
 def _kernel(
     # scalar prefetch
-    page_table_ref,  # SMEM [B, max_pages]
     lengths_ref,  # SMEM [B]
+    page_table_ref,  # SMEM [B * blocks_padded * ppb] flattened
     layer_ref,  # SMEM [1] — which layer's pages to read
+    buffer_index_ref,  # SMEM [1] — double-buffer slot, persists across programs
+    init_flag_ref,  # SMEM [1] — 1 until the very first program cold-starts
     # inputs
-    q_ref,  # VMEM [1, Hq, D]
+    q_ref,  # VMEM [G, D] (block of [B, Hq, 1, D])
     kv_hbm,  # ANY  [2, L, Hkv, P, page, D] — the whole pool, zero-copy
     # outputs
-    o_ref,  # VMEM [1, Hq, D]
+    o_ref,  # VMEM [G, D]
     # scratch
-    k_buf,  # VMEM [2, Hkv, page, D]
-    v_buf,  # VMEM [2, Hkv, page, D]
-    sem,  # DMA [2, 2]
+    m_scr,  # VMEM [G, D] fp32 — running max (lane-replicated)
+    l_scr,  # VMEM [G, D] fp32 — running denominator (lane-replicated)
+    acc_scr,  # VMEM [G, D] fp32 — unnormalized numerator
+    k_buf,  # VMEM [2, ppb, page, D]
+    v_buf,  # VMEM [2, ppb, page, D]
+    sems,  # DMA [2, 2]
     *,
     page: int,
-    n_kv_heads: int,
-    max_pages: int,
+    pages_per_block: int,
+    pages_per_seq: int,
+    batch_size: int,
+    num_kv_heads: int,
 ):
-    b = pl.program_id(0)
-    n = lengths_ref[b]
+    b, h = pl.program_id(0), pl.program_id(1)
     layer = layer_ref[0]
-    n_pages = pl.cdiv(n, page)
-    hq = q_ref.shape[1]
-    d = q_ref.shape[2]
-    g = hq // n_kv_heads
+    length = lengths_ref[b]
 
-    scale = 1.0 / (d ** 0.5)
-    # [Hkv, G, D] query layout so one einsum covers all GQA groups.
-    q = (q_ref[0].astype(jnp.float32) * scale).reshape(n_kv_heads, g, d)
+    # Rows with no work still get a deterministic (zero) output — never
+    # whatever happened to be resident in VMEM.
+    o_ref[...] = jnp.zeros_like(o_ref)
 
-    def dma(buf_ref, slot, page_idx, which):
-        # which: 0 = K, 1 = V. Source block [Hkv, page, D] — contiguous
-        # [page, D] rows per head in the head-major pool layout.
-        return pltpu.make_async_copy(
-            kv_hbm.at[which, layer, :, page_table_ref[b, page_idx]],
-            buf_ref.at[slot],
-            sem.at[which, slot],
+    @pl.when(length > 0)
+    def _program():
+        q = q_ref[...].astype(jnp.float32)  # pre-scaled by the wrapper
+        _run_block_loop(
+            b=b, h=h, layer=layer, hbm_len=length, q=q,
+            lengths_ref=lengths_ref, page_table_ref=page_table_ref,
+            buffer_index_ref=buffer_index_ref, init_flag_ref=init_flag_ref,
+            kv_hbm=kv_hbm, k_buf=k_buf, v_buf=v_buf, sems=sems,
+            m_scr=m_scr, l_scr=l_scr, acc_scr=acc_scr,
+            page=page, pages_per_block=pages_per_block,
+            pages_per_seq=pages_per_seq, batch_size=batch_size,
+            num_kv_heads=num_kv_heads, min_length=1,
         )
+        o_ref[...] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
 
-    @pl.when(n_pages > 0)
-    def _():
-        dma(k_buf, 0, 0, 0).start()
-        dma(v_buf, 0, 0, 1).start()
 
-    def body(i, carry):
-        m, l, acc = carry
-        slot = jax.lax.rem(i, 2)
-        next_slot = jax.lax.rem(i + 1, 2)
+def _fused_kernel(
+    # scalar prefetch
+    lengths_ref,  # SMEM [B] context length INCLUDING the current token
+    page_table_ref,  # SMEM [B * blocks_padded * ppb] flattened
+    slots_ref,  # SMEM [B] pool slot receiving this token's K/V
+    layer_ref,  # SMEM [1]
+    buffer_index_ref,  # SMEM [1]
+    init_flag_ref,  # SMEM [1]
+    # inputs
+    q_ref,  # VMEM [G, D] (block of [B, Hq, 1, D])
+    k_new_ref,  # VMEM [1, D] (block of [B, Hkv, 1, D]) — this token's K
+    v_new_ref,  # VMEM [1, D]
+    kv_hbm,  # ANY [2, L, Hkv, P, page, D] — ALIASED input/output
+    # outputs
+    kv_out,  # ANY — same buffer as kv_hbm (input_output_aliases)
+    o_ref,  # VMEM [G, D]
+    # scratch
+    m_scr, l_scr, acc_scr,  # VMEM [G, D] fp32
+    k_buf, v_buf,  # VMEM [2, ppb, page, D]
+    row_scr,  # VMEM [2, page, D] staging for the page-window RMW writes
+    sems,  # DMA [2, 2]
+    w_sem,  # DMA () for the row writes
+    *,
+    page: int,
+    pages_per_block: int,
+    pages_per_seq: int,
+    batch_size: int,
+    num_kv_heads: int,
+):
+    """Fused decode attention: write this token's K/V row into the pool
+    (replacing the XLA scatter — the pool is aliased through the call, so
+    the scan carry never copies) and attend over all ``length`` tokens,
+    the current one folded in from VMEM (see module docstring)."""
+    b, h = pl.program_id(0), pl.program_id(1)
+    layer = layer_ref[0]
+    length = lengths_ref[b]
+    hbm_len = length - 1  # tokens resident in HBM pages
 
-        @pl.when(i + 1 < n_pages)
-        def _():
-            dma(k_buf, next_slot, i + 1, 0).start()
-            dma(v_buf, next_slot, i + 1, 1).start()
+    slot = slots_ref[b]
+    pg, off = slot // page, slot % page
+    # Write through the ALIASED output ref (same HBM buffer as kv_hbm on
+    # hardware; in interpret mode the alias is simulated by a copy, so
+    # writing the input would be lost). Sublane tiling forbids partial
+    # slices on the page axis, so read-modify-write the WHOLE page: every
+    # other row (earlier, immutable tokens — or never-read future slots)
+    # is rewritten byte-identical, so racing block reads are unaffected.
+    def page_window(which):
+        return kv_out.at[which, layer, h, pg]  # [page, D], full-dim slice
 
-        @pl.when(i < n_pages)
-        def _():
-            dma(k_buf, slot, i, 0).wait()
-            dma(v_buf, slot, i, 1).wait()
+    rk = pltpu.make_async_copy(page_window(0), row_scr.at[0], w_sem)
+    rv = pltpu.make_async_copy(page_window(1), row_scr.at[1], w_sem)
+    wk = pltpu.make_async_copy(row_scr.at[0], page_window(0), w_sem)
+    wv = pltpu.make_async_copy(row_scr.at[1], page_window(1), w_sem)
 
-        k = k_buf[slot].astype(jnp.float32)  # [Hkv, page, D]
-        v = v_buf[slot].astype(jnp.float32)
-        # [Hkv, G, page] scores on the MXU (batch dim 0 on both operands —
-        # Mosaic requires batch dims in matching positions).
-        s = jax.lax.dot_general(
-            q,
-            k,
-            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+    o_ref[...] = jnp.zeros_like(o_ref)  # deterministic for length==0 rows
+
+    @pl.when(length > 0)
+    def _write():
+        rk.start()
+        rv.start()
+        rk.wait()
+        rv.wait()
+        mask = jax.lax.broadcasted_iota(jnp.int32, row_scr.shape[1:], 0) == off
+        row_scr[0] = jnp.where(
+            mask, jnp.broadcast_to(k_new_ref[...], row_scr.shape[1:]), row_scr[0]
+        )
+        row_scr[1] = jnp.where(
+            mask, jnp.broadcast_to(v_new_ref[...], row_scr.shape[1:]), row_scr[1]
+        )
+        wk.start()
+        wv.start()
+
+    @pl.when(length > 0)
+    def _program():
+        q = q_ref[...].astype(jnp.float32)  # pre-scaled by the wrapper
+        _run_block_loop(
+            b=b, h=h, layer=layer, hbm_len=hbm_len, q=q,
+            lengths_ref=lengths_ref, page_table_ref=page_table_ref,
+            buffer_index_ref=buffer_index_ref, init_flag_ref=init_flag_ref,
+            kv_hbm=kv_hbm, k_buf=k_buf, v_buf=v_buf, sems=sems,
+            m_scr=m_scr, l_scr=l_scr, acc_scr=acc_scr,
+            page=page, pages_per_block=pages_per_block,
+            pages_per_seq=pages_per_seq, batch_size=batch_size,
+            num_kv_heads=num_kv_heads, min_length=2,
+        )
+        # Fold in the current token from VMEM (one more online-softmax
+        # step with a single-position block).
+        k_cur = k_new_ref[...].astype(jnp.float32)  # [1, D]
+        v_cur = v_new_ref[...].astype(jnp.float32)
+        s_cur = jax.lax.dot_general(  # [G, 1]
+            q, k_cur,
+            dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        pos = i * page + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page), 2)
-        s = jnp.where(pos < n, s, -jnp.inf)
-
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)  # [Hkv, G, page]
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        # [Hkv, G, D] accumulator update.
-        pv = jax.lax.dot_general(
-            p,
-            v,
-            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )
-        acc_new = acc * corr + pv
-        valid = i < n_pages
-        return (
-            jnp.where(valid, m_new, m),
-            jnp.where(valid, l_new, l),
-            jnp.where(valid, acc_new, acc),
-        )
-
-    m0 = jnp.full((n_kv_heads, g, 1), -jnp.inf, dtype=jnp.float32)
-    l0 = jnp.zeros((n_kv_heads, g, 1), dtype=jnp.float32)
-    acc0 = jnp.zeros((n_kv_heads, g, d), dtype=jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, max_pages, body, (m0, l0, acc0))
-    out = (acc / l).reshape(hq, d)
-    o_ref[0] = out.astype(o_ref.dtype)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, s_cur)
+        p_cur = jnp.exp(s_cur - m_new[:, :1])  # [G, 1]
+        corr = jnp.exp(m_prev - m_new)
+        l_fin = l_scr[...] * corr + p_cur
+        acc_fin = acc_scr[...] * corr + p_cur * v_cur
+        o_ref[...] = (acc_fin / l_fin).astype(o_ref.dtype)
+        wk.wait()
+        wv.wait()
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def _block_geometry(page_table, page: int, pages_per_block: int | None):
+    """(padded page table, ppb): pad max_pages up to a block multiple."""
+    max_pages = page_table.shape[1]
+    if pages_per_block is None:
+        # ~256 tokens per compute block: large enough to amortize per-block
+        # overhead, small enough that double-buffered K+V fits VMEM easily.
+        pages_per_block = max(1, min(max_pages, -(-256 // page)))
+    ppb = min(pages_per_block, max_pages)
+    blocks = -(-max_pages // ppb)
+    padded = blocks * ppb
+    if padded != max_pages:
+        page_table = jnp.pad(page_table, ((0, 0), (0, padded - max_pages)))
+    return page_table, ppb, padded
+
+
+@functools.partial(
+    jax.jit, static_argnames=("pages_per_block", "interpret")
+)
 def paged_attention_pool_kernel(
     q: jnp.ndarray,  # [B, Hq, D]
     kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] — full pool pages view
     page_table: jnp.ndarray,  # [B, max_pages] int32
     lengths: jnp.ndarray,  # [B] int32
     layer: jnp.ndarray | int,  # which layer's pages to attend over
+    pages_per_block: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Primary entry: the whole (multi-layer) pool rides in HBM untouched
+    """Read-only entry: the whole (multi-layer) pool rides in HBM untouched
     and the kernel DMAs only ``layer``'s pages — so a scan-over-layers
     decode step costs O(context pages) HBM traffic per layer, never a
     materialized per-layer slice (which would be O(pool size))."""
     B, Hq, D = q.shape
     _, _, Hkv, _, page, _ = kv_pages.shape
-    max_pages = page_table.shape[1]
+    if Hq % Hkv:
+        raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
+    G = Hq // Hkv
+    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+
+    scale = 1.0 / (D ** 0.5)
+    # [B, Hq, 1, D] + a [G, D] f32 block: hints a <1x128>-friendly layout
+    # for small GQA group sizes (G is often 1-4, far off the 8-sublane tile).
+    q4 = (q.astype(jnp.float32) * scale).reshape(B, Hq, 1, D)
+    q_spec = pl.BlockSpec((None, G, None, D), lambda b, h, *_: (b, h, 0, 0))
+
     kernel = functools.partial(
-        _kernel, page=page, n_kv_heads=Hkv, max_pages=max_pages
+        _kernel,
+        page=page,
+        pages_per_block=ppb,
+        pages_per_seq=padded,
+        batch_size=B,
+        num_kv_heads=Hkv,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(B,),
+        num_scalar_prefetch=5,
+        grid=(B, Hkv),
         in_specs=[
-            pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+            q_spec,
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, Hq, D), lambda b, *_: (b, 0, 0)),
+        out_specs=q_spec,
         scratch_shapes=[
-            pltpu.VMEM((2, Hkv, page, D), kv_pages.dtype),
-            pltpu.VMEM((2, Hkv, page, D), kv_pages.dtype),
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+            pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
         interpret=interpret,
     )(
-        jnp.asarray(page_table, dtype=jnp.int32),
         jnp.asarray(lengths, dtype=jnp.int32),
+        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
         jnp.asarray(layer, dtype=jnp.int32).reshape(1),
-        q,
+        jnp.zeros((1,), jnp.int32),  # double-buffer slot
+        jnp.ones((1,), jnp.int32),  # cold-start flag
+        q4,
         kv_pages,
     )
+    return out.reshape(B, Hq, D).astype(q.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("pages_per_block", "interpret")
+)
+def paged_decode_fused_kernel(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_new: jnp.ndarray,  # [B, Hkv, D] this token's K (post-rope)
+    v_new: jnp.ndarray,  # [B, Hkv, D]
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] — donated/aliased
+    slots: jnp.ndarray,  # [B] pool slot for this token
+    page_table: jnp.ndarray,  # [B, max_pages] int32
+    lengths: jnp.ndarray,  # [B] context length incl. current token
+    layer: jnp.ndarray | int,
+    pages_per_block: int | None = None,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused decode step attention: returns ``(attn_out [B, Hq, D],
+    kv_pages)`` where ``kv_pages`` is the SAME buffer updated in place
+    (the caller threads it as a scan carry with zero copies)."""
+    B, Hq, D = q.shape
+    _, _, Hkv, _, page, _ = kv_pages.shape
+    if Hq % Hkv:
+        raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
+    G = Hq // Hkv
+    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+
+    scale = 1.0 / (D ** 0.5)
+    q4 = (q.astype(jnp.float32) * scale).reshape(B, Hq, 1, D)
+    q_spec = pl.BlockSpec((None, G, None, D), lambda b, h, *_: (b, h, 0, 0))
+    kv_new_spec = pl.BlockSpec((None, None, 1, D), lambda b, h, *_: (b, h, 0, 0))
+
+    kernel = functools.partial(
+        _fused_kernel,
+        page=page,
+        pages_per_block=ppb,
+        pages_per_seq=padded,
+        batch_size=B,
+        num_kv_heads=Hkv,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(B, Hkv),
+        in_specs=[
+            q_spec,
+            kv_new_spec,
+            kv_new_spec,
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            q_spec,
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+            pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+            pltpu.VMEM((2, page, D), kv_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kv_out, out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(kv_pages.shape, kv_pages.dtype),
+            jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32),
+        ],
+        # Flat arg order: 6 scalar-prefetch args, then q (6), k_new (7),
+        # v_new (8), kv_pages (9) → alias kv_pages onto output 0.
+        input_output_aliases={9: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        jnp.asarray(lengths, dtype=jnp.int32),
+        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
+        jnp.asarray(slots, dtype=jnp.int32),
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
+        jnp.zeros((1,), jnp.int32),  # double-buffer slot
+        jnp.ones((1,), jnp.int32),  # cold-start flag
+        q4,
+        k_new.astype(kv_pages.dtype).reshape(B, Hkv, 1, D),
+        v_new.astype(kv_pages.dtype).reshape(B, Hkv, 1, D),
+        kv_pages,
+    )
+    return out.reshape(B, Hq, D).astype(q.dtype), kv_out
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
