@@ -13,7 +13,17 @@ ring). These are net-new TPU-first components required by the north star
   ICI; no hand-written collectives).
 - ``ring_attention`` — ``shard_map`` + ``ppermute`` blockwise attention for
   sequence lengths that exceed one chip's HBM (the 32k config).
+- ``pipeline``  — GPipe-style layer stages over a ``pp`` mesh axis
+  (microbatched ``ppermute`` schedule; the stacked-layer param layout
+  makes stages a reshape).
 """
+
+from radixmesh_tpu.parallel.pipeline import (
+    make_pp_mesh,
+    make_pp_train_step,
+    pipeline_forward,
+    stage_params,
+)
 
 from radixmesh_tpu.parallel.kv_transfer import (
     make_kv_page_transfer,
@@ -44,4 +54,8 @@ __all__ = [
     "prefill_to_decode_perm",
     "make_train_state",
     "make_train_step",
+    "make_pp_mesh",
+    "stage_params",
+    "pipeline_forward",
+    "make_pp_train_step",
 ]

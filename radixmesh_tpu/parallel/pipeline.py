@@ -1,0 +1,206 @@
+"""Pipeline parallelism: layer stages over a ``pp`` mesh axis.
+
+The reference has no parallelism of any kind (SURVEY §2 checklist — PP is
+"optional for 72B" in the rebuild plan); this module adds the pp layout the
+72B/v5p deployment needs when tensor parallelism alone runs out of ICI
+neighbors. TPU-first shape: the model already stacks per-layer params on a
+leading ``[L, ...]`` axis consumed by ``lax.scan`` (``models/llama.py``),
+so a pipeline stage layout is literally a reshape — ``[L, ...] →
+[pp, L/pp, ...]`` with the stage axis sharded over the mesh — and each
+device scans only its own ``L/pp`` layers.
+
+Schedule: GPipe-style microbatching inside one ``shard_map``:
+
+- ``n_micro`` microbatches enter stage 0 one tick apart; every tick each
+  device runs its stage and ``ppermute``s the activation to its successor
+  (reverse-mode AD differentiates straight through — the transpose of a
+  shift is the opposite shift, so the same schedule trains).
+- The loop runs ``n_micro + pp - 1`` ticks; the warm-up/drain bubble does
+  throwaway compute on every stage (predicating it off would save nothing
+  on TPU — all programs in a shard_map run in lockstep).
+- Embedding and the LM head run *outside* the pipeline (they're replicated
+  anyway); the pipeline moves pure ``[mb, S, H]`` activations, one dtype,
+  one shape, every tick — the static-shape discipline XLA wants.
+
+Composability: pp is for the *layer* axis only; tp/sp/dp still come from
+GSPMD sharding annotations (``parallel/sharding.py``). A combined layout
+runs this module's shard_map over the pp axis of a (pp, tp) mesh while
+each stage's matmuls are manually head-sharded — left for when a target
+model actually exceeds single-axis scaling; the pp schedule itself is
+deployment-ready and covered by ``tests/test_pipeline.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from radixmesh_tpu.models.llama import (
+    ModelConfig,
+    _logits,
+    _mlp,
+    _qkv,
+    _PREC,
+)
+from radixmesh_tpu.ops.attention import attend_prefill
+from radixmesh_tpu.ops.norm import rms_norm
+from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "make_pp_mesh",
+    "stage_params",
+    "pipeline_forward",
+    "make_pp_train_step",
+]
+
+
+def make_pp_mesh(pp: int, devices: list | None = None) -> Mesh:
+    """A 1-D ``("pp",)`` mesh over the first ``pp`` devices."""
+    devices = devices if devices is not None else jax.devices()
+    if pp > len(devices):
+        raise ValueError(f"pp={pp} exceeds {len(devices)} devices")
+    return Mesh(devices[:pp], axis_names=("pp",))
+
+
+def stage_params(params: dict, pp: int, mesh: Mesh | None = None) -> dict:
+    """Reshape the stacked layer axis ``[L, ...] → [pp, L/pp, ...]``; with
+    ``mesh``, place the stage axis on the ``pp`` mesh axis (non-layer
+    params replicate)."""
+    L = params["layers"]["wq"].shape[0]
+    if L % pp:
+        raise ValueError(f"n_layers={L} not divisible by pp={pp}")
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda x: x.reshape(pp, L // pp, *x.shape[1:]), params["layers"]
+    )
+    if mesh is not None:
+        stage = NamedSharding(mesh, P("pp"))
+        repl = NamedSharding(mesh, P())
+        out["layers"] = jax.device_put(out["layers"], stage)
+        out = {
+            k: (v if k == "layers" else jax.device_put(v, repl))
+            for k, v in out.items()
+        }
+    return out
+
+
+def _block(cfg: ModelConfig, lp: dict, x: jnp.ndarray, positions: jnp.ndarray,
+           inv_freq: jnp.ndarray) -> jnp.ndarray:
+    """One transformer block, causal self-attention, no KV cache (the
+    training/pipeline body — same math as ``prefill_forward``'s layer with
+    an empty prefix)."""
+    B, S = x.shape[:2]
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q, k, v = _qkv(lp, h, cfg)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    kv_end = jnp.full((B,), S, dtype=jnp.int32)
+    attn = attend_prefill(q, k, v, positions, kv_end)
+    x = x + jnp.einsum(
+        "bsqd,qdh->bsh",
+        attn.reshape(B, S, cfg.n_heads, cfg.head_dim),
+        lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
+        precision=_PREC,
+    )
+    h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    return x + _mlp(lp, h2)
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"))
+def pipeline_forward(
+    params_pp: dict,  # layers leaves [pp, L/pp, ...] sharded over "pp"
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, S]
+    mesh: Mesh,
+    n_micro: int,
+) -> jnp.ndarray:
+    """Causal-LM logits through the layer pipeline. ``B`` must divide into
+    ``n_micro`` microbatches; returns ``[B, S, V]`` replicated."""
+    pp = mesh.shape["pp"]
+    B, S = tokens.shape
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
+    mb = B // n_micro
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    positions = jnp.broadcast_to(
+        jnp.arange(S, dtype=jnp.int32)[None], (mb, S)
+    )
+    x = params_pp["embed"][tokens].reshape(n_micro, mb, S, cfg.hidden)
+
+    def stage_fn(local_layers, h):
+        def body(h, lp):
+            return _block(cfg, lp, h, positions, inv_freq), None
+
+        h, _ = jax.lax.scan(body, h, local_layers)
+        return h
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("pp"), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run(layers_local, x_all):
+        local = jax.tree.map(lambda a: a[0], layers_local)  # drop stage dim
+        idx = jax.lax.axis_index("pp")
+        last = pp - 1
+
+        def tick(carry, t):
+            buf, outs = carry
+            feed = jax.lax.dynamic_index_in_dim(
+                x_all, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+            )
+            inp = jnp.where(idx == 0, feed, buf)
+            y = stage_fn(local, inp)
+            m = t - last
+            safe_m = jnp.clip(m, 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, safe_m, 0, keepdims=False)
+            newval = jnp.where(jnp.logical_and(idx == last, m >= 0), y, cur)
+            outs = jax.lax.dynamic_update_index_in_dim(outs, newval, safe_m, 0)
+            buf = jax.lax.ppermute(
+                y, "pp", [(i, i + 1) for i in range(pp - 1)]
+            )
+            return (buf, outs), None
+
+        buf0 = jnp.zeros_like(x_all[0])
+        outs0 = jnp.zeros_like(x_all)
+        (_, outs), _ = jax.lax.scan(
+            tick, (buf0, outs0), jnp.arange(n_micro + pp - 1)
+        )
+        # Finished activations live on the last stage only; psum replicates
+        # them (every other stage contributes zeros).
+        return jax.lax.psum(jnp.where(idx == last, outs, 0.0), "pp")
+
+    hidden = run(params_pp["layers"], x).reshape(B, S, cfg.hidden)
+    return _logits(params_pp, cfg, hidden)
+
+
+def make_pp_train_step(cfg: ModelConfig, mesh: Mesh, optimizer, n_micro: int):
+    """Jitted ``step((params_pp, opt_state), tokens) -> (state, loss)``
+    training through the pipeline — reverse-mode AD runs the schedule
+    backwards (ppermute transposes to the opposite shift)."""
+
+    def loss_fn(params_pp, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = pipeline_forward(params_pp, cfg, inputs, mesh, n_micro)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    @jax.jit
+    def step(state, tokens):
+        params_pp, opt_state = state
+        loss, grads = jax.value_and_grad(loss_fn)(params_pp, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params_pp)
+        import optax
+
+        params_pp = optax.apply_updates(params_pp, updates)
+        return (params_pp, opt_state), loss
+
+    return step

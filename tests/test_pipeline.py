@@ -1,0 +1,98 @@
+"""Pipeline parallelism: GPipe schedule over the ``pp`` mesh axis.
+
+Numerics gates: the pipelined forward must match the single-device
+``prefill_forward`` (empty prefix) exactly up to dtype noise, and a
+training step through the pipeline must produce the same loss as the
+unpipelined loss on the same batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from radixmesh_tpu.models.llama import ModelConfig, init_params, prefill_forward
+from radixmesh_tpu.parallel.pipeline import (
+    make_pp_mesh,
+    make_pp_train_step,
+    pipeline_forward,
+    stage_params,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs the 8-device CPU mesh"
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny().replace(n_layers=4, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def reference_logits(cfg, params, tokens):
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    shape = (cfg.n_layers, B, 0, cfg.n_kv_heads, cfg.head_dim)
+    empty = jnp.zeros(shape, dtype=cfg.dtype)
+    logits, _, _ = prefill_forward(
+        params, cfg, tokens, positions, empty, empty,
+        jnp.zeros((B,), jnp.int32),
+    )
+    return logits
+
+
+@pytest.mark.parametrize("pp,n_micro", [(2, 2), (2, 4), (4, 4)])
+def test_pipeline_matches_reference(model, pp, n_micro):
+    cfg, params = model
+    mesh = make_pp_mesh(pp)
+    params_pp = stage_params(params, pp, mesh)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (4, 16)), jnp.int32
+    )
+    got = pipeline_forward(params_pp, cfg, tokens, mesh, n_micro)
+    want = reference_logits(cfg, params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_stage_params_requires_divisibility(model):
+    cfg, params = model
+    with pytest.raises(ValueError):
+        stage_params(params, 3)
+
+
+def test_pp_train_step_matches_unpipelined_loss(model):
+    cfg, params = model
+    mesh = make_pp_mesh(2)
+    params_pp = stage_params(params, 2, mesh)
+    opt = optax.sgd(1e-2)
+    step = make_pp_train_step(cfg, mesh, opt, n_micro=2)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(1, cfg.vocab_size, (4, 12)), jnp.int32
+    )
+    state = (params_pp, opt.init(params_pp))
+
+    # Unpipelined reference loss on the same batch.
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    ref = reference_logits(cfg, params, inputs)
+    logp = jax.nn.log_softmax(ref.astype(jnp.float32), axis=-1)
+    want = float(
+        -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    )
+
+    state, loss = step(state, tokens)
+    assert abs(float(loss) - want) < 1e-4
+
+    # A second step actually moves the params (grads flowed through the
+    # ppermute schedule, not just the head).
+    state2, loss2 = step(state, tokens)
+    assert float(loss2) < float(loss)
+    moved = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))),
+        state[0]["layers"], params_pp["layers"],
+    )
+    assert max(jax.tree.leaves(moved)) > 0
