@@ -331,8 +331,11 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
         cfg, params, num_slots=eng_slots, page_size=page_size,
         max_batch=max_batch, name="bench",
     )
+    # Warmup must mirror the measured run's SHAPES (same conversation
+    # count → same batched-prefill buckets), or the group-prefill compile
+    # variants land inside measured TTFTs.
     warm = MultiTurnWorkload(
-        n_conversations=2, vocab_size=cfg.vocab_size, seed=1, **sizes
+        n_conversations=n_conv, vocab_size=cfg.vocab_size, seed=1, **sizes
     )
     run_engine_workload(engine, warm)
     wl = MultiTurnWorkload(

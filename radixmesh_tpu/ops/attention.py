@@ -201,6 +201,104 @@ def attend_prefill_paged(
     return out.transpose(0, 3, 1, 2, 4).reshape(B, C, Hq, D).astype(q.dtype)
 
 
+@partial(jax.jit, static_argnames=("kv_block_pages",))
+def attend_chunk_hybrid(
+    q: jnp.ndarray,  # [B, C, Hq, D] one chunk of new tokens
+    k_cur: jnp.ndarray,  # [B, C, Hkv, D] this chunk's K (post-rope)
+    v_cur: jnp.ndarray,  # [B, C, Hkv, D]
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] full-pool pages view
+    page_table: jnp.ndarray,  # [B, max_pages] this request's pages, in order
+    q_positions: jnp.ndarray,  # [B, C] absolute positions of the chunk
+    prior_lengths: jnp.ndarray,  # [B] context tokens BEFORE this chunk
+    kv_lengths: jnp.ndarray,  # [B] valid context incl. this chunk
+    layer: jnp.ndarray | int,
+    kv_block_pages: int = 32,
+) -> jnp.ndarray:
+    """Chunk attention with the current chunk's K/V taken DENSE from the
+    layer activations instead of read back out of the pool: prior context
+    (cached prefix + earlier chunks) streams blockwise from pages, the
+    chunk itself is one causal dense block, and the two merge through the
+    shared online softmax. This is what lets chunked prefill keep the pool
+    OUT of the layer-scan carry (one scatter per chunk call, after the
+    scan) — with the pool as a carry, XLA materialized a full pool copy
+    per layer (the decode path had the same bug; ``paged_decode_fused``).
+    Returns [B, C, Hq, D]."""
+    B, C, Hq, D = q.shape
+    _, _, Hkv, _, page, _ = kv_pages.shape
+    G = Hq // Hkv
+    max_pages = page_table.shape[1]
+    assert max_pages % kv_block_pages == 0, (max_pages, kv_block_pages)
+    n_blocks = max_pages // kv_block_pages
+    bk = kv_block_pages * page
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
+    qg = (q.astype(jnp.float32) * scale).reshape(B, C, Hkv, G, D).transpose(
+        0, 2, 3, 1, 4
+    )  # [B, Hkv, G, C, D]
+    k_layer = kv_pages[0, layer]  # [Hkv, P, page, D]
+    v_layer = kv_pages[1, layer]
+    qpos = q_positions[:, None, None, :, None]  # [B,1,1,C,1]
+    prior = prior_lengths[:, None, None, None, None]
+
+    def block(carry, blk):
+        m, l, acc = carry
+        pids = jax.lax.dynamic_slice(
+            page_table, (0, blk * kv_block_pages), (B, kv_block_pages)
+        )
+        k = k_layer[:, pids].reshape(Hkv, B, bk, D).transpose(1, 0, 2, 3)
+        v = v_layer[:, pids].reshape(Hkv, B, bk, D).transpose(1, 0, 2, 3)
+        s = jax.lax.dot_general(
+            qg, k.astype(jnp.float32),
+            dimension_numbers=(((4,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )
+        kv_pos = (blk * bk + jnp.arange(bk))[None, None, None, None, :]
+        ok = (kv_pos <= qpos) & (kv_pos < prior)
+        s = jnp.where(ok, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v.astype(jnp.float32),
+            dimension_numbers=(((4,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc * corr + pv), None
+
+    m0 = jnp.full((B, Hkv, G, C, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, C, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, G, C, D), dtype=jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(block, (m0, l0, acc0), jnp.arange(n_blocks))
+
+    # Final block: the chunk itself, dense causal in absolute positions.
+    kc = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)  # [B, Hkv, C, D]
+    vc = v_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
+    s2 = jax.lax.dot_general(
+        qg, kc,
+        dimension_numbers=(((4,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )  # [B, Hkv, G, C, C]
+    kv_abs = prior_lengths[:, None, None, None, None] + jnp.arange(C)[
+        None, None, None, None, :
+    ]
+    ok2 = (kv_abs <= qpos) & (
+        kv_abs < kv_lengths[:, None, None, None, None]
+    )
+    s2 = jnp.where(ok2, s2, _NEG_INF)
+    m_f = jnp.maximum(m, jnp.max(s2, axis=-1, keepdims=True))
+    p2 = jnp.exp(s2 - m_f)
+    corr = jnp.exp(m - m_f)
+    l_f = l * corr + jnp.sum(p2, axis=-1, keepdims=True)
+    acc_f = acc * corr + jax.lax.dot_general(
+        p2, vc,
+        dimension_numbers=(((4,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )
+    out = jnp.where(l_f > 0, acc_f / jnp.maximum(l_f, 1e-30), 0.0)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, C, Hq, D).astype(q.dtype)
+
+
 def paged_attention(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,

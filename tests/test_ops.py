@@ -216,3 +216,135 @@ class TestSampling:
         ]
         # At high temperature both tokens appear frequently.
         assert min(hot.count(0), hot.count(1)) > 30
+
+
+class TestPagedDecodeFused:
+    """Fused write+attend decode kernel: one aliased pallas_call writes the
+    current token's K/V row into the pool and attends over all ``length``
+    tokens (the current one folded in from VMEM, never read back)."""
+
+    def _setup(self, key, B=3, Hq=8, Hkv=2, D=32, page=8, n_pages=16, maxp=4,
+               L=2):
+        ks = jax.random.split(key, 5)
+        q = jax.random.normal(ks[0], (B, Hq, D), dtype=jnp.float32)
+        kv = jax.random.normal(
+            ks[1], (2, L, Hkv, n_pages, page, D), dtype=jnp.float32
+        )
+        k_new = jax.random.normal(ks[2], (B, Hkv, D), dtype=jnp.float32)
+        v_new = jax.random.normal(ks[3], (B, Hkv, D), dtype=jnp.float32)
+        # Non-overlapping per-sequence page tables.
+        pt = jax.random.permutation(ks[4], n_pages)[: B * maxp].reshape(B, maxp)
+        lengths = jnp.array([1, page + 3, page * maxp])[:B]
+        # Current token slot = position (length-1) within row b's pages.
+        pos = lengths - 1
+        slots = pt[jnp.arange(B), pos // page] * page + pos % page
+        return (q, k_new, v_new, kv, slots.astype(jnp.int32),
+                pt.astype(jnp.int32), lengths.astype(jnp.int32))
+
+    def _oracle(self, q, k_new, v_new, kv, slots, pt, lengths, layer):
+        page = kv.shape[4]
+        pg, off = slots // page, slots % page
+        layer_arr = jnp.asarray(layer)
+        kv = kv.at[0, layer_arr, :, pg, off].set(k_new)
+        kv = kv.at[1, layer_arr, :, pg, off].set(v_new)
+        return attend_decode_ref(q, kv[0, layer], kv[1, layer], pt, lengths), kv
+
+    @pytest.mark.parametrize("layer", [0, 1])
+    def test_matches_scatter_then_oracle(self, layer):
+        from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
+
+        args = self._setup(jax.random.PRNGKey(3))
+        want_attn, want_kv = self._oracle(*args, layer)
+        got_attn, got_kv = paged_decode_fused_kernel(*args, layer, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got_attn), np.asarray(want_attn), rtol=2e-5, atol=2e-5
+        )
+        # The pool row writes landed, and nothing else changed.
+        np.testing.assert_allclose(
+            np.asarray(got_kv), np.asarray(want_kv), rtol=1e-6, atol=1e-6
+        )
+
+    def test_single_token_rows(self):
+        """length == 1 rows (fresh/scratch decode rows) take no HBM blocks:
+        output is attention over just the current token — i.e. v_new."""
+        from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
+
+        q, k_new, v_new, kv, slots, pt, lengths = self._setup(
+            jax.random.PRNGKey(4), B=1
+        )
+        lengths = jnp.array([1], dtype=jnp.int32)
+        got_attn, _ = paged_decode_fused_kernel(
+            q, k_new, v_new, kv, slots, pt, lengths, 0, interpret=True
+        )
+        G = q.shape[1] // v_new.shape[1]
+        want = jnp.repeat(v_new, G, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(got_attn), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_dispatch_fallback_matches(self):
+        """paged_decode_attention's jnp fallback equals the oracle."""
+        from radixmesh_tpu.ops.attention import paged_decode_attention
+
+        args = self._setup(jax.random.PRNGKey(5))
+        want_attn, want_kv = self._oracle(*args, 1)
+        got_attn, got_kv = paged_decode_attention(*args, 1, use_kernel=False)
+        np.testing.assert_allclose(
+            np.asarray(got_attn), np.asarray(want_attn), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_allclose(np.asarray(got_kv), np.asarray(want_kv))
+
+
+class TestChunkHybrid:
+    """attend_chunk_hybrid (chunk K/V dense, prior context from pages) must
+    equal attend_prefill_paged with the chunk already written to the pool —
+    the latter is the retained oracle for the hybrid online-softmax merge."""
+
+    def test_hybrid_matches_paged_oracle(self):
+        from radixmesh_tpu.ops.attention import (
+            attend_chunk_hybrid,
+            attend_prefill_paged,
+        )
+
+        rng = np.random.default_rng(11)
+        B, C, Hq, Hkv, D, page, L = 2, 8, 8, 2, 32, 4, 2
+        maxp, kvb = 8, 4
+        prior = np.array([9, 17])  # ragged, not page-aligned
+        kv = jnp.asarray(rng.normal(size=(2, L, Hkv, 64, page, D)), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(B, C, Hq, D)), jnp.float32)
+        k_cur = jnp.asarray(rng.normal(size=(B, C, Hkv, D)), jnp.float32)
+        v_cur = jnp.asarray(rng.normal(size=(B, C, Hkv, D)), jnp.float32)
+        pt = jnp.asarray(
+            rng.permutation(64)[: B * maxp].reshape(B, maxp), jnp.int32
+        )
+        n_valid = np.array([C, C - 3])  # second row's chunk is partial
+        positions = jnp.asarray(prior[:, None] + np.arange(C)[None], jnp.int32)
+        prior_l = jnp.asarray(prior, jnp.int32)
+        kv_len = jnp.asarray(prior + n_valid, jnp.int32)
+
+        got = attend_chunk_hybrid(
+            q, k_cur, v_cur, kv, pt, positions, prior_l, kv_len, 1,
+            kv_block_pages=kvb,
+        )
+
+        # Oracle: write the chunk into its pool slots, then the pure-paged
+        # blockwise path over everything.
+        slots = np.empty((B, C), np.int64)
+        for b in range(B):
+            for j in range(C):
+                pos = prior[b] + j
+                slots[b, j] = int(pt[b, pos // page]) * page + pos % page
+        kv_o = kv
+        for b in range(B):
+            for j in range(int(n_valid[b])):
+                s = slots[b, j]
+                kv_o = kv_o.at[0, 1, :, s // page, s % page].set(k_cur[b, j])
+                kv_o = kv_o.at[1, 1, :, s // page, s % page].set(v_cur[b, j])
+        want = attend_prefill_paged(
+            q, kv_o, pt, positions, kv_len, 1, kv_block_pages=kvb
+        )
+        valid_mask = np.arange(C)[None] < n_valid[:, None]
+        np.testing.assert_allclose(
+            np.asarray(got)[valid_mask], np.asarray(want)[valid_mask],
+            rtol=2e-5, atol=2e-5,
+        )
