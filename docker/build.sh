@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Build the image, then gate it on the multi-process ring test (real TCP
+# between 6 processes inside the container — the reference's correctness
+# topology, SURVEY §4).
+set -euo pipefail
+
+DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+cd "$DIR/.."
+
+docker build -f docker/Dockerfile -t radixmesh-tpu .
+docker run --rm --entrypoint python radixmesh-tpu \
+    -m pytest tests/test_multiprocess.py tests/test_config.py -q
+echo "image OK: radixmesh-tpu"

@@ -359,14 +359,21 @@ class Engine:
                 if row < 0:
                     break
                 req = self.waiting[idx]
-                if self._defer_for_prefix_wave(req, group):
+                # One tree walk serves both the defer check and acquisition
+                # (match_and_load also restores host-tier KV, so a
+                # restorable prefix never triggers a needless deferral).
+                if hasattr(self.tree, "match_and_load"):
+                    match = self.tree.match_and_load(req.prompt)
+                else:
+                    match = self.tree.match_prefix(req.prompt)
+                if self._defer_for_prefix_wave(req, match.length, group):
                     # Admitting this request NOW would recompute a prefix a
                     # groupmate is about to publish; next wave it's a cache
                     # hit instead (the serial-admission sharing the batch
                     # path would otherwise lose).
                     idx += 1
                     continue
-                acquired = self._acquire_prompt_slots(req)
+                acquired = self._acquire_prompt_slots(req, match)
                 if acquired is None:
                     break  # pool exhausted even after evict: wait for finishes
                 self.waiting.pop(idx)
@@ -378,27 +385,42 @@ class Engine:
             made_progress = bool(group)
             if not group:
                 break
-            if (
-                len(group) == 1
-                and len(group[0][0].prompt) - group[0][2]
-                <= self.long_prefill_threshold
-            ):
-                pending = [self._prefill_dense(*group[0])]
-            else:
-                pending = self._prefill_group(group)
-            # Finalize PER WAVE: one batched sample/sync per wave keeps the
-            # RPC-round-trip win without head-of-line-blocking an early
-            # wave's TTFT behind a later wave's (possibly long) prefill.
-            self._finalize_first_tokens(pending)
+            # Sub-waves by prefill-size bucket, shortest first: a short
+            # request must not ride as a padded row through a 32k
+            # groupmate's chunks, nor wait for them to sample its first
+            # token. Each sub-wave finalizes itself (one batched
+            # sample + one device→host sync), so TTFT is bounded by the
+            # request's own bucket.
+            def bucket(member):
+                n_new = len(member[0].prompt) - member[2]
+                return _pow2_at_least(min(n_new, self.prefill_chunk), floor=16)
 
-    def _defer_for_prefix_wave(self, req: Request, group: list[tuple]) -> bool:
-        """True if ``req`` shares ≥1 page of NOT-yet-cached prefix with a
-        request already collected this wave: the groupmate will publish
-        that span, so waiting one wave turns recomputation into a hit."""
+            group.sort(key=bucket)
+            start = 0
+            for i in range(1, len(group) + 1):
+                if i == len(group) or bucket(group[i]) != bucket(group[start]):
+                    sub = group[start:i]
+                    start = i
+                    if (
+                        len(sub) == 1
+                        and len(sub[0][0].prompt) - sub[0][2]
+                        <= self.long_prefill_threshold
+                    ):
+                        pending = [self._prefill_dense(*sub[0])]
+                    else:
+                        pending = self._prefill_group(sub)
+                    self._finalize_first_tokens(pending)
+
+    def _defer_for_prefix_wave(
+        self, req: Request, cached: int, group: list[tuple]
+    ) -> bool:
+        """True if ``req`` shares ≥1 page of NOT-yet-cached prefix (beyond
+        its ``cached`` match length) with a request already collected this
+        wave: the groupmate will publish that span, so waiting one wave
+        turns recomputation into a hit."""
         if not group:
             return False
         prompt = req.prompt
-        cached = self.tree.match_prefix(prompt).length
         span = cached - cached % self.page_size + self.page_size
         if len(prompt) < span:
             return False
@@ -409,20 +431,23 @@ class Engine:
         )
 
     def _acquire_prompt_slots(
-        self, req: Request
+        self, req: Request, match=None
     ) -> tuple[int, np.ndarray, np.ndarray] | None:
         """Lock the longest cached prefix of ``req.prompt`` and allocate
         pages for the remainder. Returns ``(reuse, prefix_slots, own)``, or
         ``None`` after full rollback if the pool can't satisfy it. Reuse is
         page-aligned and always leaves ≥1 token uncached so prefill has
-        logits to sample the first output token from."""
+        logits to sample the first output token from. ``match`` may carry a
+        just-computed match result to avoid a second tree walk."""
         prompt = req.prompt
-        # Hierarchical trees restore host-resident extensions into device
-        # slots as part of the match (host→HBM copy beats a recompute).
-        if hasattr(self.tree, "match_and_load"):
-            match = self.tree.match_and_load(prompt)
-        else:
-            match = self.tree.match_prefix(prompt)
+        if match is None:
+            # Hierarchical trees restore host-resident extensions into
+            # device slots as part of the match (host→HBM copy beats a
+            # recompute).
+            if hasattr(self.tree, "match_and_load"):
+                match = self.tree.match_and_load(prompt)
+            else:
+                match = self.tree.match_prefix(prompt)
         reuse = min(
             match.length, (len(prompt) - 1) // self.page_size * self.page_size
         )

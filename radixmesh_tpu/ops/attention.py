@@ -141,6 +141,31 @@ def attend_prefill_paged(
     ``max_pages`` must be a multiple of ``kv_block_pages`` (callers bucket
     both to powers of two). Returns [B, C, Hq, D].
     """
+    m, l, acc = _page_block_softmax(
+        q, kv_pages, page_table, q_positions, kv_lengths, layer, kv_block_pages
+    )
+    # Padded queries (chunk tail) can end with l == 0; their rows are
+    # discarded by the caller — emit 0 instead of NaN so nothing poisons
+    # downstream reductions.
+    B, C, Hq, D = q.shape
+    out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, C, Hq, D).astype(q.dtype)
+
+
+def _page_block_softmax(
+    q: jnp.ndarray,  # [B, C, Hq, D]
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D]
+    page_table: jnp.ndarray,  # [B, max_pages]
+    q_positions: jnp.ndarray,  # [B, C]
+    kv_bound: jnp.ndarray,  # [B] tokens of pool context to attend (< bound)
+    layer: jnp.ndarray | int,
+    kv_block_pages: int,
+):
+    """Shared core of the chunked-prefill attentions: scan fixed-size page
+    blocks of one layer's pool context, maintaining the online softmax
+    ``(m, l, acc)`` in [B, Hkv, G, C, ·] layout. Causal vs ``q_positions``
+    and bounded by ``kv_bound`` per row. Callers normalize (and may merge
+    further blocks — ``attend_chunk_hybrid`` adds the chunk itself dense)."""
     B, C, Hq, D = q.shape
     _, _, Hkv, _, page, _ = kv_pages.shape
     G = Hq // Hkv
@@ -157,6 +182,7 @@ def attend_prefill_paged(
     k_layer = kv_pages[0, layer]  # [Hkv, P, page, D]
     v_layer = kv_pages[1, layer]
     qpos = q_positions[:, None, None, :, None]  # [B,1,1,C,1]
+    bound = kv_bound[:, None, None, None, None]
 
     def block(carry, blk):
         m, l, acc = carry
@@ -173,7 +199,7 @@ def attend_prefill_paged(
             preferred_element_type=jnp.float32,
         )  # [B, Hkv, G, C, bk]
         kv_pos = (blk * bk + jnp.arange(bk))[None, None, None, None, :]
-        ok = (kv_pos <= qpos) & (kv_pos < kv_lengths[:, None, None, None, None])
+        ok = (kv_pos <= qpos) & (kv_pos < bound)
         s = jnp.where(ok, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -194,11 +220,7 @@ def attend_prefill_paged(
     l0 = jnp.zeros((B, Hkv, G, C, 1), dtype=jnp.float32)
     acc0 = jnp.zeros((B, Hkv, G, C, D), dtype=jnp.float32)
     (m, l, acc), _ = jax.lax.scan(block, (m0, l0, acc0), jnp.arange(n_blocks))
-    # Padded queries (chunk tail) can end with l == 0; their rows are
-    # discarded by the caller — emit 0 instead of NaN so nothing poisons
-    # downstream reductions.
-    out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
-    return out.transpose(0, 3, 1, 2, 4).reshape(B, C, Hq, D).astype(q.dtype)
+    return m, l, acc
 
 
 @partial(jax.jit, static_argnames=("kv_block_pages",))
@@ -224,52 +246,16 @@ def attend_chunk_hybrid(
     per layer (the decode path had the same bug; ``paged_decode_fused``).
     Returns [B, C, Hq, D]."""
     B, C, Hq, D = q.shape
-    _, _, Hkv, _, page, _ = kv_pages.shape
-    G = Hq // Hkv
-    max_pages = page_table.shape[1]
-    assert max_pages % kv_block_pages == 0, (max_pages, kv_block_pages)
-    n_blocks = max_pages // kv_block_pages
-    bk = kv_block_pages * page
-
+    Hkv = k_cur.shape[2]
+    m, l, acc = _page_block_softmax(
+        q, kv_pages, page_table, q_positions, prior_lengths, layer,
+        kv_block_pages,
+    )
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
-    qg = (q.astype(jnp.float32) * scale).reshape(B, C, Hkv, G, D).transpose(
-        0, 2, 3, 1, 4
-    )  # [B, Hkv, G, C, D]
-    k_layer = kv_pages[0, layer]  # [Hkv, P, page, D]
-    v_layer = kv_pages[1, layer]
+    qg = (q.astype(jnp.float32) * scale).reshape(
+        B, C, Hkv, Hq // Hkv, D
+    ).transpose(0, 2, 3, 1, 4)
     qpos = q_positions[:, None, None, :, None]  # [B,1,1,C,1]
-    prior = prior_lengths[:, None, None, None, None]
-
-    def block(carry, blk):
-        m, l, acc = carry
-        pids = jax.lax.dynamic_slice(
-            page_table, (0, blk * kv_block_pages), (B, kv_block_pages)
-        )
-        k = k_layer[:, pids].reshape(Hkv, B, bk, D).transpose(1, 0, 2, 3)
-        v = v_layer[:, pids].reshape(Hkv, B, bk, D).transpose(1, 0, 2, 3)
-        s = jax.lax.dot_general(
-            qg, k.astype(jnp.float32),
-            dimension_numbers=(((4,), (3,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32,
-        )
-        kv_pos = (blk * bk + jnp.arange(bk))[None, None, None, None, :]
-        ok = (kv_pos <= qpos) & (kv_pos < prior)
-        s = jnp.where(ok, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p, v.astype(jnp.float32),
-            dimension_numbers=(((4,), (2,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32,
-        )
-        return (m_new, l_new, acc * corr + pv), None
-
-    m0 = jnp.full((B, Hkv, G, C, 1), _NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((B, Hkv, G, C, 1), dtype=jnp.float32)
-    acc0 = jnp.zeros((B, Hkv, G, C, D), dtype=jnp.float32)
-    (m, l, acc), _ = jax.lax.scan(block, (m0, l0, acc0), jnp.arange(n_blocks))
 
     # Final block: the chunk itself, dense causal in absolute positions.
     kc = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)  # [B, Hkv, C, D]

@@ -1,0 +1,65 @@
+"""Deployment artifacts (docker/ + deploy/configs) stay consistent.
+
+The reference's image is broken at build time (copies a nonexistent
+requirements.txt, ``docker/Dockerfile:32``) — these checks keep ours from
+rotting the same way: every node config must load through the real
+``load_config`` validator, describe ONE identical topology, and agree
+with the compose file's service set; everything the Dockerfile COPYs must
+exist.
+"""
+
+import pathlib
+import re
+
+import yaml
+
+from radixmesh_tpu.config import NodeRole, load_config
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+CONFIGS = sorted((ROOT / "deploy" / "configs").glob("*.yaml"))
+
+
+def test_six_node_topology_loads_and_is_consistent():
+    assert len(CONFIGS) == 6
+    cfgs = [load_config(str(p)) for p in CONFIGS]
+    topo = {
+        (tuple(c.prefill_nodes), tuple(c.decode_nodes), tuple(c.router_nodes))
+        for c in cfgs
+    }
+    assert len(topo) == 1, "configs must be identical except local_addr"
+    roles = [c.local_identity()[0] for c in cfgs]
+    assert roles.count(NodeRole.PREFILL) == 3
+    assert roles.count(NodeRole.DECODE) == 2
+    assert roles.count(NodeRole.ROUTER) == 1
+    # Every cluster member has exactly one config file.
+    addrs = {c.local_addr for c in cfgs}
+    (p, d, r) = next(iter(topo))
+    assert addrs == set(p) | set(d) | set(r)
+
+
+def test_serving_nodes_have_model_sections():
+    for path in CONFIGS:
+        cfg = load_config(str(path))
+        role = cfg.local_identity()[0]
+        if role is NodeRole.ROUTER:
+            assert not cfg.model, "router must not load a model"
+        else:
+            assert cfg.model, f"{path.name}: serving node needs a model section"
+            assert cfg.model.get("preset")
+
+
+def test_compose_services_match_configs():
+    compose = yaml.safe_load((ROOT / "docker" / "compose.yaml").read_text())
+    services = set(compose["services"])
+    assert services == {p.stem for p in CONFIGS}
+    for name, svc in compose["services"].items():
+        cmd = svc["command"]
+        assert cmd[0] == "node"
+        assert f"/configs/{name}.yaml" in cmd
+
+
+def test_dockerfile_copies_exist():
+    text = (ROOT / "docker" / "Dockerfile").read_text()
+    for m in re.finditer(r"^COPY\s+(.+?)\s+\S+$", text, re.M):
+        for src in m.group(1).split():
+            assert (ROOT / src).exists(), f"Dockerfile COPYs missing {src}"
