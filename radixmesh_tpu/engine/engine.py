@@ -106,6 +106,7 @@ class Engine:
         mesh=None,
         prefill_chunk: int = 512,
         long_prefill_threshold: int = 1024,
+        sp_prefill_threshold: int = 4096,
         device_mesh=None,
     ):
         if page_size & (page_size - 1):
@@ -138,6 +139,10 @@ class Engine:
         # memory) instead of the dense path (O(S²) scores).
         self.prefill_chunk = prefill_chunk
         self.long_prefill_threshold = long_prefill_threshold
+        # Sequence-parallel prefill (SURVEY §5 serving-side): fresh prompts
+        # at least this long prefill sp-sharded over the device mesh —
+        # TTFT scales with the sp axis instead of one chip's FLOPs.
+        self.sp_prefill_threshold = sp_prefill_threshold
         self.log = get_logger("engine")
         # Distributed replica (cache/mesh_cache.py): publishes advertise
         # this node's prefixes around the ring so the router can send
@@ -392,8 +397,12 @@ class Engine:
             # sample + one device→host sync), so TTFT is bounded by the
             # request's own bucket.
             def bucket(member):
+                # UNCAPPED size bucket: a 512-token prompt must not share a
+                # sub-wave (and its finalize) with a 32k prompt's chunk
+                # loop. (The chunk SHAPE inside _prefill_group stays capped
+                # at prefill_chunk.)
                 n_new = len(member[0].prompt) - member[2]
-                return _pow2_at_least(min(n_new, self.prefill_chunk), floor=16)
+                return _pow2_at_least(n_new, floor=16)
 
             group.sort(key=bucket)
             start = 0
@@ -401,7 +410,9 @@ class Engine:
                 if i == len(group) or bucket(group[i]) != bucket(group[start]):
                     sub = group[start:i]
                     start = i
-                    if (
+                    if len(sub) == 1 and self._sp_capable(sub[0]):
+                        pending = [self._prefill_sp(*sub[0])]
+                    elif (
                         len(sub) == 1
                         and len(sub[0][0].prompt) - sub[0][2]
                         <= self.long_prefill_threshold
@@ -574,6 +585,55 @@ class Engine:
         req.own_slots = own
         self._install_prefilled(req, row, reuse)
         return (req, logits[0, n_new - 1])
+
+    def _sp_capable(self, member: tuple) -> bool:
+        """A fresh (no cached prefix) long prompt on a mesh with an sp
+        axis prefills sequence-sharded — ring attention over ICI."""
+        req, _, reuse, *_ = member
+        return (
+            self.device_mesh is not None
+            and self.device_mesh.shape.get("sp", 1) > 1
+            and reuse == 0
+            and len(req.prompt) >= self.sp_prefill_threshold
+        )
+
+    def _prefill_sp(
+        self,
+        req: Request,
+        row: int,
+        reuse: int,
+        prefix_slots: np.ndarray,
+        own: np.ndarray,
+    ) -> tuple:
+        """Sequence-parallel prefill of one fresh prompt: the whole span in
+        ONE sharded call (``prefill_forward_sp``), sequence split over the
+        sp mesh axis, ring attention over ICI. KV lands in the paged pool
+        via a sharded scatter."""
+        from radixmesh_tpu.models.llama import prefill_forward_sp
+
+        prompt = req.prompt
+        n = len(prompt)
+        sp = self.device_mesh.shape["sp"]
+        s_b = _pow2_at_least(n, floor=max(16, sp))
+        s_b = -(-s_b // sp) * sp  # shard_map needs S divisible by sp
+        tokens = np.zeros((1, s_b), dtype=np.int32)
+        tokens[0, :n] = prompt
+        positions = np.arange(s_b, dtype=np.int32)[None]
+        logits, new_k, new_v = prefill_forward_sp(
+            self.params,
+            self.cfg,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            self.device_mesh,
+            logits_at=jnp.asarray([n - 1], dtype=jnp.int32),
+        )
+        self.pool.write(own[:n], new_k[:, 0, :n], new_v[:, 0, :n])
+        req.output_tokens = []
+        req.kv_len = n
+        req.token_slots = own[:n].copy()
+        req.own_slots = own
+        self._install_prefilled(req, row, reuse)
+        return (req, logits[0, 0])
 
     def _prefill_group(self, group: list[tuple]) -> list[tuple]:
         """Batched chunked-paged prefill for ``group`` of acquired

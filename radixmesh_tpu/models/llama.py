@@ -45,6 +45,8 @@ __all__ = [
     "ModelConfig",
     "init_params",
     "prefill_forward",
+    "prefill_forward_sp",
+    "prefill_chunk_paged",
     "decode_step",
     "param_logical_axes",
     "convert_hf_state_dict",
@@ -255,6 +257,66 @@ def prefill_forward(
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], cached_k, cached_v)
     )
+    return _logits(params, cfg, x), new_k, new_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "axis"))
+def prefill_forward_sp(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, S] — S divisible by the sp axis size
+    positions: jnp.ndarray,  # [B, S]
+    mesh,
+    axis: str = "sp",
+    logits_at: jnp.ndarray | None = None,  # [B] per-row positions, or None
+):
+    """Sequence-parallel prefill: activations sharded over the ``sp`` mesh
+    axis along S, attention via ring attention (K/V blocks rotate over ICI
+    with ``ppermute`` while each chip keeps its query shard — SURVEY §5's
+    long-context requirement, serving-side). Everything outside attention
+    partitions via GSPMD from the sharding constraint alone.
+
+    Scaling regime: sp multiplies prefill FLOPs/HBM across chips (TTFT for
+    long prompts); the CHUNKED path (``prefill_chunk_paged``) bounds
+    memory on one chip. The engine composes them: sp-prefill the fresh
+    span when a mesh with sp>1 is present, chunk otherwise.
+
+    Returns ``(logits, new_k [L, B, S, Hkv, D], new_v)`` — sequence-
+    sharded; callers scatter into the paged pool (GSPMD inserts the
+    collectives). Logits are [B, S, V] — unless ``logits_at`` gives one
+    position per row, in which case only those rows hit the LM head and
+    logits are [B, 1, V]: a 32k-prompt serve must not materialize an
+    S×vocab tensor it samples one row of.
+    """
+    from radixmesh_tpu.parallel.ring_attention import ring_self_attention
+
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    seq_sharded = NamedSharding(mesh, PartitionSpec(None, axis))
+    tokens = jax.lax.with_sharding_constraint(tokens, seq_sharded)
+    x = params["embed"][tokens]
+
+    def layer(x, xs):
+        lp = xs
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(lp, h, cfg)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        attn = ring_self_attention(q, k, v, mesh, axis=axis)
+        x = x + jnp.einsum(
+            "bsqd,qdh->bsh",
+            attn.reshape(attn.shape[0], attn.shape[1], cfg.n_heads, cfg.head_dim),
+            lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
+            precision=_PREC,
+        )
+        h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(lp, h2)
+        return x, (k, v)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, params["layers"])
+    if logits_at is not None:
+        x = jnp.take_along_axis(x, logits_at[:, None, None], axis=1)
     return _logits(params, cfg, x), new_k, new_v
 
 

@@ -86,3 +86,53 @@ def test_shard_map_kernel_matches_oracle(mesh):
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=2e-5, atol=2e-5,
     )
+
+
+class TestSequenceParallelPrefill:
+    """SP serving prefill (SURVEY §5 serving-side; VERDICT round-1 item 31
+    'nothing in models/ or engine/ calls them'): a fresh long prompt
+    prefills sequence-sharded via ring attention over the sp axis."""
+
+    def test_sp_prefill_matches_dense(self, mesh):
+        from radixmesh_tpu.models.llama import prefill_forward, prefill_forward_sp
+
+        cfg = CFG.replace(dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        S = 64
+        tokens = jnp.asarray(
+            np.random.default_rng(2).integers(1, cfg.vocab_size, (2, S)),
+            jnp.int32,
+        )
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (2, S))
+        got, gk, gv = prefill_forward_sp(params, cfg, tokens, positions, mesh)
+        empty = jnp.zeros((cfg.n_layers, 2, 0, cfg.n_kv_heads, cfg.head_dim),
+                          cfg.dtype)
+        want, wk, wv = prefill_forward(
+            params, cfg, tokens, positions, empty, empty,
+            jnp.zeros((2,), jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(wk), rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_engine_sp_prefill_end_to_end(self, mesh):
+        """An engine on the mesh routes a fresh long prompt through the
+        sp path and its published KV is a valid cache for a follow-up."""
+        cfg = CFG.replace(dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(3))
+        eng = Engine(
+            cfg, params, num_slots=2048, page_size=4, max_batch=2,
+            device_mesh=mesh, sp_prefill_threshold=48,
+        )
+        single = Engine(cfg, params, num_slots=2048, page_size=4, max_batch=2)
+        prompt = np.random.default_rng(5).integers(1, cfg.vocab_size, 60).tolist()
+        out_sp = eng.generate([prompt], GREEDY)[0]
+        out_single = single.generate([prompt], GREEDY)[0]
+        assert out_sp == out_single
+        # Follow-up hits the cache published by the sp prefill.
+        cached_before = eng.stats.cached_tokens
+        out2 = eng.generate([prompt + [9, 8]], GREEDY)[0]
+        assert len(out2) == 6
+        assert eng.stats.cached_tokens - cached_before >= 56
