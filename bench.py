@@ -254,10 +254,15 @@ def _roofline(cfg, batch: int, ctx: int, sec_per_step: float) -> dict:
     round-1 weak #6: ``vs_baseline`` alone is self-referential — these
     anchor the number to the chip's physical ceilings)."""
     n_params = _n_params(cfg)
-    # Matmul FLOPs: 2·params per token (embedding is a lookup, not a
-    # matmul); attention: QK^T + PV per head over the context, EVERY layer.
+    # Matmul FLOPs: 2·params per token — minus the embedding table (a
+    # lookup, not a matmul), plus the LM-head matmul when the table is
+    # tied (it still multiplies) — and attention's QK^T + PV per head over
+    # the context, EVERY layer.
+    matmul_params = n_params - cfg.vocab_size * cfg.hidden
+    if cfg.tie_embeddings:
+        matmul_params += cfg.hidden * cfg.vocab_size
     flops = batch * (
-        2 * (n_params - cfg.vocab_size * cfg.hidden)
+        2 * matmul_params
         + 4 * ctx * cfg.n_heads * cfg.head_dim * cfg.n_layers
     )
     # HBM reads: all weights once (batch amortizes; decode is the
@@ -402,6 +407,9 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
     engine = Engine(
         cfg, params, num_slots=eng_slots, page_size=page_size,
         max_batch=max_batch, name="bench",
+        # One host round trip per 8 tokens: on the RPC-tunneled chip a
+        # round trip costs ~67 ms, which would otherwise BE the TPOT.
+        decode_steps_per_launch=8 if on_tpu else 1,
     )
     # Warmup must mirror the measured run's SHAPES (same conversation
     # count → same batched-prefill buckets), or the group-prefill compile

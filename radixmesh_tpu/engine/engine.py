@@ -44,6 +44,7 @@ from radixmesh_tpu.cache.radix_tree import RadixTree
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 from radixmesh_tpu.models.llama import (
     ModelConfig,
+    decode_multi,
     decode_step,
     prefill_chunk_paged,
     prefill_forward,
@@ -107,6 +108,7 @@ class Engine:
         prefill_chunk: int = 512,
         long_prefill_threshold: int = 1024,
         sp_prefill_threshold: int = 4096,
+        decode_steps_per_launch: int = 1,
         device_mesh=None,
     ):
         if page_size & (page_size - 1):
@@ -143,6 +145,10 @@ class Engine:
         # at least this long prefill sp-sharded over the device mesh —
         # TTFT scales with the sp axis instead of one chip's FLOPs.
         self.sp_prefill_threshold = sp_prefill_threshold
+        # Fused multi-step decode: sample on device and feed back, one
+        # host round trip per k tokens (decode_multi). 1 = classic
+        # step-at-a-time.
+        self.decode_steps_per_launch = decode_steps_per_launch
         self.log = get_logger("engine")
         # Distributed replica (cache/mesh_cache.py): publishes advertise
         # this node's prefixes around the ring so the router can send
@@ -794,6 +800,10 @@ class Engine:
     # ------------------------------------------------------------------
 
     def _decode_once(self) -> None:
+        k = self.decode_steps_per_launch
+        if k > 1 and self._multi_step_ok(k):
+            self._decode_multi_once(k)
+            return
         slots = np.full(self.max_batch, self._scratch_slot, dtype=np.int32)
         lengths = np.ones(self.max_batch, dtype=np.int32)
         preempted: list[Request] = []
@@ -848,26 +858,116 @@ class Engine:
         self._m_tpot.observe(time.monotonic() - step_t0)
 
         for row, req in active:
-            fed = int(self._tokens[row])  # token whose KV was just written
-            req.token_slots = np.append(req.token_slots, slots[row])
-            req.kv_len += 1
-            token = int(sampled[row])
-            req.output_tokens.append(token)
-            self.stats.generated_tokens += 1
-            if req.is_finished_by(token) or req.num_tokens >= self.max_seq_len:
-                # Don't count the terminal token as output if it's a stop.
-                if token in req.sampling.stop_token_ids:
-                    req.output_tokens.pop()
-                    self.stats.generated_tokens -= 1
-                else:
-                    self._m_generated.inc()
-                req.state = RequestState.FINISHED
-                self.stats.finished += 1
-                self._release(req)
-                self._pressure = False  # freed memory: resume admission
+            self._consume_token(req, row, int(slots[row]), int(sampled[row]))
+
+    def _multi_step_ok(self, k: int) -> bool:
+        """Fused k-step decode is safe when every active row has k tokens
+        of page-table headroom; prefer single steps while requests wait
+        (admission happens between launches, so k steps of lockstep decode
+        would delay a queued request's prefill)."""
+        if self.waiting:
+            return False
+        for req in self._rows:
+            if req is None:
+                continue
+            if req.kv_len + k > self.max_seq_len:
+                return False
+            if (req.kv_len + k - 1) // self.page_size >= self.max_pages:
+                return False
+            # A row within k of its output budget would discard most of
+            # the fused launch — bubble compute without a latency win.
+            if req.sampling.max_new_tokens - len(req.output_tokens) < k:
+                return False
+        return True
+
+    def _decode_multi_once(self, k: int) -> None:
+        """One ``decode_multi`` launch: k tokens per active request with a
+        single host round trip (device-side sampling feeds each step). See
+        ``models/llama.py::decode_multi`` for the latency rationale."""
+        lengths = np.ones(self.max_batch, dtype=np.int32)
+        preempted: list[Request] = []
+        for row, req in enumerate(self._rows):
+            if req is None:
+                continue
+            ps = self.page_size
+            ok = True
+            for p_idx in range(req.kv_len // ps, (req.kv_len + k - 1) // ps + 1):
+                if self._page_table[row, p_idx] != self._scratch_page:
+                    continue  # page already provisioned
+                new = self._alloc_pages(1)
+                if new is None:
+                    preempted.append(req)
+                    ok = False
+                    break
+                req.own_slots = np.concatenate([req.own_slots, new])
+                self._page_table[row, p_idx] = new[0] // ps
+            if ok:
+                lengths[row] = req.kv_len + 1
+        for req in preempted:
+            self._preempt(req)
+
+        active = [(row, r) for row, r in enumerate(self._rows) if r is not None]
+        if not active:
+            return
+        step_t0 = time.monotonic()
+        self._lengths = lengths
+        self._rng, key = jax.random.split(self._rng)
+        sampled, self.pool.kv = decode_multi(
+            self.params,
+            self.cfg,
+            jnp.asarray(self._tokens),
+            self.pool.kv,
+            jnp.asarray(self._page_table),
+            jnp.asarray(lengths),
+            key,
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ps),
+            self.page_size,
+            k_steps=k,
+            mesh=self.device_mesh,
+        )
+        sampled = np.asarray(sampled)  # [k, B] — the ONE round trip
+        self.stats.decode_steps += k
+        elapsed = time.monotonic() - step_t0
+        for _ in range(k):
+            self._m_tpot.observe(elapsed / k)
+
+        ps = self.page_size
+        for row, req in active:
+            base = req.kv_len
+            for i in range(k):
+                pos = base + i
+                slot = int(
+                    self._page_table[row, pos // ps] * ps + pos % ps
+                )
+                if self._consume_token(req, row, slot, int(sampled[i, row])):
+                    break  # finished mid-launch: surplus tokens discarded
+
+    def _consume_token(self, req: Request, row: int, slot: int, token: int) -> bool:
+        """Account one decode iteration for ``req``: the fed token's KV
+        landed at ``slot``, ``token`` was sampled. Returns True when the
+        request finished (stop token / length cap) and was released —
+        shared by single-step and fused multi-step decode so the subtle
+        stop/stats bookkeeping cannot drift between them."""
+        req.token_slots = np.append(req.token_slots, slot)
+        req.kv_len += 1
+        req.output_tokens.append(token)
+        self.stats.generated_tokens += 1
+        if req.is_finished_by(token) or req.num_tokens >= self.max_seq_len:
+            # Don't count the terminal token as output if it's a stop.
+            if token in req.sampling.stop_token_ids:
+                req.output_tokens.pop()
+                self.stats.generated_tokens -= 1
             else:
                 self._m_generated.inc()
-                self._tokens[row] = token
+            req.state = RequestState.FINISHED
+            self.stats.finished += 1
+            self._release(req)
+            self._pressure = False  # freed memory: resume admission
+            return True
+        self._m_generated.inc()
+        self._tokens[row] = token
+        return False
 
     def _preempt(self, req: Request) -> None:
         """Pool exhausted mid-decode even after eviction: publish what we

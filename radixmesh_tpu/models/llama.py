@@ -40,6 +40,7 @@ from radixmesh_tpu.ops.attention import (
 )
 from radixmesh_tpu.ops.norm import rms_norm
 from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
+from radixmesh_tpu.ops.sampling import sample_tokens
 
 __all__ = [
     "ModelConfig",
@@ -424,6 +425,77 @@ def decode_step(
     tensor-parallel kernel path: heads/pool sharded over the mesh's tp
     axis, the Pallas kernel shard_map'd per chip; all other ops partition
     via GSPMD from the params/pool shardings."""
+    return _decode_core(
+        params, cfg, tokens, kv_pool, slots, page_table, lengths, page_size,
+        mesh,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "page_size", "k_steps", "mesh"),
+    donate_argnums=(3,),
+)
+def decode_multi(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] current token per sequence
+    kv_pool: jnp.ndarray,  # [2, L, Hkv, num_slots, D] (donated)
+    page_table: jnp.ndarray,  # [B, max_pages] — pages preallocated k ahead
+    lengths: jnp.ndarray,  # [B] context length incl. the first fed token
+    key: jax.Array,
+    temperatures: jnp.ndarray,  # [B]
+    top_ps: jnp.ndarray,  # [B]
+    page_size: int = 16,
+    k_steps: int = 8,
+    mesh=None,
+):
+    """``k_steps`` decode iterations fused in ONE dispatch: sampling stays
+    on device and each sampled token feeds the next step, so the host pays
+    a single round trip per k tokens instead of per token — on RPC-
+    tunneled devices (observed ~67 ms per host materialization) that round
+    trip IS the per-token latency. The caller preallocates pages covering
+    positions ``lengths-1 .. lengths+k-2`` per row; token slots are
+    derived from the page table on device. Returns ``(sampled [k, B],
+    kv_pool)``; stop-token/length bookkeeping happens on host afterwards
+    (surplus tokens past a stop are discarded — latency is bought with a
+    little bubble compute)."""
+    B = tokens.shape[0]
+    rows = jnp.arange(B)
+
+    def step(carry, i):
+        toks, pool, k = carry
+        lens = lengths + i
+        pos = lens - 1
+        slots = (
+            page_table[rows, pos // page_size] * page_size + pos % page_size
+        )
+        logits, pool = _decode_core(
+            params, cfg, toks, pool, slots, page_table, lens, page_size, mesh
+        )
+        k, sk = jax.random.split(k)
+        nxt = sample_tokens(
+            logits, sk, temperature=temperatures, top_p=top_ps
+        ).astype(jnp.int32)
+        return (nxt, pool, k), nxt
+
+    (_, kv_pool, _), sampled = jax.lax.scan(
+        step, (tokens, kv_pool, key), jnp.arange(k_steps)
+    )
+    return sampled, kv_pool
+
+
+def _decode_core(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    kv_pool: jnp.ndarray,
+    slots: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_size: int,
+    mesh,
+):
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     positions = lengths - 1  # [B]
     x = params["embed"][tokens][:, None, :]  # [B, 1, H]

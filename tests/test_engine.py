@@ -155,3 +155,59 @@ class TestSamplingIntegration:
         )[0]
         assert len(out) == 5
         assert all(0 <= t < eng.cfg.vocab_size for t in out)
+
+
+class TestMultiStepDecode:
+    """decode_steps_per_launch > 1: k tokens per launch with device-side
+    sampling — greedy output must be IDENTICAL to step-at-a-time decode
+    (same decode math, same argmax), stops truncate mid-launch, and page
+    boundaries are provisioned ahead."""
+
+    def _engines(self, model, k, **kw):
+        cfg, params = model
+        single = make_engine(model, **kw)
+        multi = make_engine(model, **kw)
+        multi.decode_steps_per_launch = k
+        return single, multi
+
+    @pytest.mark.parametrize("k", [2, 4, 7])
+    def test_greedy_matches_single_step(self, model, k):
+        cfg, params = model
+        single, multi = self._engines(model, k)
+        prompts = [
+            prompts_rng().integers(1, cfg.vocab_size, n).tolist()
+            for n in (9, 14)
+        ]
+        sp = SamplingParams(temperature=0.0, max_new_tokens=13)
+        want = single.generate(prompts, sp)
+        got = multi.generate(prompts, sp)
+        assert got == want
+        assert multi.stats.generated_tokens == single.stats.generated_tokens
+
+    def test_stop_token_truncates_mid_launch(self, model):
+        cfg, params = model
+        single, multi = self._engines(model, 4)
+        prompt = prompts_rng().integers(1, cfg.vocab_size, 10).tolist()
+        ref = single.generate(
+            [prompt], SamplingParams(temperature=0.0, max_new_tokens=12)
+        )[0]
+        stop = ref[5]  # force a stop mid-way (and mid-k-batch)
+        sp = SamplingParams(
+            temperature=0.0, max_new_tokens=12, stop_token_ids=(stop,)
+        )
+        got = multi.generate([prompt], sp)[0]
+        want_len = ref.index(stop)
+        assert got == ref[:want_len]
+
+    def test_crosses_pages_and_reuses_cache(self, model):
+        cfg, params = model
+        single, multi = self._engines(model, 5)
+        prompt = prompts_rng().integers(1, cfg.vocab_size, 7).tolist()
+        sp = SamplingParams(temperature=0.0, max_new_tokens=17)  # > 4 pages
+        want = single.generate([prompt], sp)[0]
+        got = multi.generate([prompt], sp)[0]
+        assert got == want
+        # Published sequence serves a follow-up from cache.
+        follow = prompt + got[:10]
+        multi.generate([follow], SamplingParams(temperature=0.0, max_new_tokens=2))
+        assert multi.stats.cached_tokens >= (len(follow) - 1) // PAGE * PAGE
