@@ -65,6 +65,16 @@ class SlotAllocator:
     def free_slots(self) -> int:
         return len(self._free_pages) * self.page_size
 
+    def is_allocated(self, slots: np.ndarray) -> np.ndarray:
+        """Per-slot allocation state (bool array). Out-of-range ids report
+        False rather than raising — callers use this to filter foreign or
+        stale indices before acting on them."""
+        slots = np.asarray(slots, dtype=np.int64)
+        ok = (slots >= 0) & (slots < self.num_slots)
+        out = np.zeros(len(slots), dtype=bool)
+        out[ok] = self._slot_allocated[slots[ok]]
+        return out
+
     def alloc(self, n_tokens: int) -> np.ndarray | None:
         """Allocate slots for ``n_tokens`` tokens (whole pages); ``None`` if
         the pool can't satisfy the request (caller should evict and retry,

@@ -118,6 +118,16 @@ class MeshCache:
         self._lock = threading.RLock()
         self._logic_op = AtomicCounter()
         self.dup_nodes: dict[NodeKey, PrefillValue | RouterValue] = {}
+        # Slot-ownership ledger for locally-owned duplicate KV. Dup entries
+        # are recorded per conflicted tree node, and node boundaries drift
+        # as later inserts split nodes — so re-delivered oplogs can record
+        # the SAME losing slot under entries of different granularity
+        # (found by tests/test_convergence_sim.py). Every dup-driven free
+        # must therefore go through this map: a slot id is claimed by at
+        # most one entry, claims require the slot to be currently
+        # allocated, and frees release only ids the freeing entry claims —
+        # never a raw index array (which double-frees on overlap).
+        self._dup_pending: dict[int, NodeKey] = {}
         self.tick_counts: dict[int, int] = {}
         # Elastic membership (policy/topology.py): every TTL and GC
         # unanimity count derives from the CURRENT view, not static config.
@@ -757,6 +767,12 @@ class MeshCache:
         hook (reference overrides the whole walk instead,
         ``radix_mesh.py:273-323``). Caller holds the lock. Returns the
         length of the already-present prefix."""
+        # Positions of this op that WIN (or merge cleanly) become
+        # tree-owned; positions that LOSE are re-claimed by _record_dup
+        # during the walk. Releasing the op's ids up front makes that
+        # partition exact even when earlier deliveries claimed the same
+        # ids under since-split node keys (granularity drift).
+        self._unclaim(value)
         n = self.tree.insert(key, value, on_conflict=self._resolve_conflict)
         self._trim_to_budget()
         return n
@@ -807,17 +823,69 @@ class MeshCache:
         if prev is not None and prev is not loser:
             # A fresh losing copy for the same (key, rank) — e.g. the origin
             # recomputed KV after its first copy lost — replaces the entry.
-            # The previous loser is now referenced by neither the tree nor
-            # dup_nodes, so free its locally-owned slots immediately instead
-            # of leaking them; identical indices (idempotent re-delivery)
-            # are kept, not freed.
-            if not (
-                isinstance(prev, PrefillValue)
-                and isinstance(loser, PrefillValue)
-                and np.array_equal(prev.indices, loser.indices)
-            ):
-                self._free_local(prev)
+            # Slots the previous loser claimed and the new one doesn't carry
+            # are referenced by neither the tree nor any dup entry, so free
+            # them now instead of leaking them; shared ids (idempotent
+            # re-delivery) just stay claimed.
+            keep = (
+                set(int(i) for i in loser.indices)
+                if isinstance(loser, PrefillValue)
+                else set()
+            )
+            self._pending_free(nk, exclude=keep)
         self.dup_nodes[nk] = loser
+        self._claim(nk, loser)
+
+    # ---- dup-slot ledger (see __init__._dup_pending) ----
+
+    def _claim(self, nk: NodeKey, value) -> None:
+        """Claim ``value``'s locally-owned, currently-allocated, unclaimed
+        slot ids for entry ``nk``. Ids already claimed elsewhere stay with
+        their owner; ids no longer allocated were freed by an earlier
+        replacement of a coarser entry and must not re-enter the ledger
+        (freeing them again would hit a reallocated tenant)."""
+        if (
+            self.pool is None
+            or not isinstance(value, PrefillValue)
+            or value.rank != self.rank
+            or not len(value.indices)
+        ):
+            return
+        allocated = self.pool.allocator.is_allocated(value.indices)
+        for i, ok in zip(value.indices, allocated):
+            i = int(i)
+            if ok and i not in self._dup_pending:
+                self._dup_pending[i] = nk
+
+    def _unclaim(self, value) -> None:
+        """Release claims on ``value``'s ids without freeing (the ids are
+        becoming tree-owned, or are being freed by an authoritative tree
+        path); pending entries skip unclaimed ids at collect time."""
+        if (
+            not self._dup_pending
+            or not isinstance(value, PrefillValue)
+            or value.rank != self.rank
+        ):
+            return
+        for i in value.indices:
+            self._dup_pending.pop(int(i), None)
+
+    def _pending_free(self, nk: NodeKey, exclude: set[int] | None = None) -> int:
+        """Free every slot id claimed by ``nk`` (minus ``exclude``) and
+        release the claims. Returns the number of slots freed."""
+        if self.pool is None or not self._dup_pending:
+            return 0
+        ids = [
+            i
+            for i, owner in self._dup_pending.items()
+            if owner == nk and (exclude is None or i not in exclude)
+        ]
+        if not ids:
+            return 0
+        for i in ids:
+            del self._dup_pending[i]
+        self.pool.free(np.asarray(ids, dtype=np.int32))
+        return len(ids)
 
     def _apply_delete(self, key: np.ndarray) -> bool:
         res = self.tree.match_prefix(key, split_partial=False)
@@ -841,19 +909,27 @@ class MeshCache:
                 self._free_local(n.value)
         # Swapped-out losers awaiting GC also hold locally-owned slots;
         # dropping them without freeing would leak pool capacity forever.
-        for loser in self.dup_nodes.values():
-            self._free_local(loser)
+        # The ledger (not the entries, which can overlap after granularity
+        # drift) is the exact set of dup-owned ids.
+        if self.pool is not None and self._dup_pending:
+            self.pool.free(np.asarray(sorted(self._dup_pending), dtype=np.int32))
+            self._dup_pending.clear()
         self.tree.reset()
         self.dup_nodes.clear()
 
     def _free_local(self, value) -> None:
-        """Return KV slots to the local pool iff this node owns them."""
+        """Return KV slots to the local pool iff this node owns them
+        (authoritative tree-path frees: evict, delete, reset)."""
         if (
             self.pool is not None
             and isinstance(value, PrefillValue)
             and value.rank == self.rank
             and len(value.indices)
         ):
+            # The tree owned these ids, so no dup entry should claim them —
+            # but release any stale claims so a later GC collect can never
+            # free a since-reallocated slot out from under new data.
+            self._unclaim(value)
             self.pool.free(value.indices)
 
     # ------------------------------------------------------------------
@@ -987,14 +1063,13 @@ class MeshCache:
                 self._forward(op)
 
     def _gc_collect(self, e: GCEntry) -> None:
-        loser = self.dup_nodes.pop(NodeKey(e.key, e.value_rank), None)
+        nk = NodeKey(e.key, e.value_rank)
+        loser = self.dup_nodes.pop(nk, None)
         if loser is None:
             return
-        if (
-            isinstance(loser, PrefillValue)
-            and loser.rank == self.rank
-            and self.pool is not None
-            and len(loser.indices)
-        ):
-            self.pool.free(loser.indices)
-            self._m_gc_freed.inc(len(loser.indices))
+        # Only ids this entry still CLAIMS are freed — ids that migrated to
+        # a finer-granularity entry, were re-adopted by the tree, or were
+        # already freed by a replacement are skipped (ledger contract).
+        freed = self._pending_free(nk)
+        if freed:
+            self._m_gc_freed.inc(freed)

@@ -224,7 +224,6 @@ class Engine:
         self._page_table = np.full(
             (max_batch, self.max_pages), self._scratch_page, dtype=np.int32
         )
-        self._lengths = np.ones(max_batch, dtype=np.int32)
         self._temps = np.zeros(max_batch, dtype=np.float32)
         self._top_ps = np.ones(max_batch, dtype=np.float32)
         self._rng = jax.random.PRNGKey(rng_seed)
@@ -791,7 +790,6 @@ class Engine:
         if req.row >= 0:
             self._rows[req.row] = None
             self._page_table[req.row] = self._scratch_page
-            self._lengths[req.row] = 1
             self._tokens[req.row] = 0
             req.row = -1
 
@@ -832,7 +830,6 @@ class Engine:
         if not active:
             return
         step_t0 = time.monotonic()
-        self._lengths = lengths
         self._rng, key = jax.random.split(self._rng)
         logits, self.pool.kv = decode_step(
             self.params,
@@ -910,7 +907,6 @@ class Engine:
         if not active:
             return
         step_t0 = time.monotonic()
-        self._lengths = lengths
         self._rng, key = jax.random.split(self._rng)
         sampled, self.pool.kv = decode_multi(
             self.params,

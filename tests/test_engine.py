@@ -199,6 +199,29 @@ class TestMultiStepDecode:
         want_len = ref.index(stop)
         assert got == ref[:want_len]
 
+    def test_row_stops_mid_launch_while_others_continue(self, model):
+        # The risky interaction in the fused path: _consume_token releases
+        # row A mid-launch (page table reset, row reassignable) while the
+        # host loop keeps consuming the SAME launch's sampled tokens for
+        # rows B..N — their output must be unaffected by A's release.
+        cfg, params = model
+        single, multi = self._engines(model, 4)
+        rng = prompts_rng()
+        prompts = [rng.integers(1, cfg.vocab_size, n).tolist() for n in (10, 8, 13)]
+        sp0 = SamplingParams(temperature=0.0, max_new_tokens=12)
+        refs = single.generate(prompts, sp0)
+        # Stop token chosen so prompt 0 halts mid-k-batch; with greedy
+        # decode the other rows' streams are unchanged unless they also
+        # emit it (then they truncate identically — still equal to ref).
+        stop = refs[0][5]
+        sp = SamplingParams(
+            temperature=0.0, max_new_tokens=12, stop_token_ids=(stop,)
+        )
+        got = multi.generate(prompts, sp)
+        for out, ref in zip(got, refs):
+            want = ref[: ref.index(stop)] if stop in ref else ref
+            assert out == want
+
     def test_crosses_pages_and_reuses_cache(self, model):
         cfg, params = model
         single, multi = self._engines(model, 5)
