@@ -77,6 +77,8 @@ class EngineStats:
     decode_steps: int = 0
     finished: int = 0
     preemptions: int = 0
+    spec_proposed: int = 0  # draft tokens offered for verification
+    spec_accepted: int = 0  # draft tokens accepted (KV kept, step skipped)
     ttft_s: list[float] = field(default_factory=list)
 
     @property
@@ -109,6 +111,8 @@ class Engine:
         long_prefill_threshold: int = 1024,
         sp_prefill_threshold: int = 4096,
         decode_steps_per_launch: int = 1,
+        spec_decode_tokens: int = 0,
+        spec_ngram: int = 3,
         device_mesh=None,
     ):
         if page_size & (page_size - 1):
@@ -149,6 +153,17 @@ class Engine:
         # host round trip per k tokens (decode_multi). 1 = classic
         # step-at-a-time.
         self.decode_steps_per_launch = decode_steps_per_launch
+        # Speculative decoding by prompt lookup (n-gram drafting): propose
+        # the γ tokens that followed the last occurrence of the current
+        # tail n-gram in prompt+output, verify all of them in ONE chunked
+        # forward (``prefill_chunk_paged``, C=γ+1), accept the longest
+        # correct prefix. Decode latency is weight-streaming-bound, so a
+        # verified draft turns γ sequential steps into one matmul-dense
+        # pass — the classic serving win on repetitive continuations
+        # (quotes, code, multi-turn restatements). Greedy rows only;
+        # rejected tail KV is overwritten by later positional writes.
+        self.spec_decode_tokens = spec_decode_tokens
+        self.spec_ngram = max(2, spec_ngram)
         self.log = get_logger("engine")
         # Distributed replica (cache/mesh_cache.py): publishes advertise
         # this node's prefixes around the ring so the router can send
@@ -798,6 +813,19 @@ class Engine:
     # ------------------------------------------------------------------
 
     def _decode_once(self) -> None:
+        g = self.spec_decode_tokens
+        if g > 0 and self._spec_ok(g):
+            # Draft BEFORE committing to the wide verify launch: when no
+            # row's history repeats its tail there is nothing to verify,
+            # and the plain/fused path emits the same tokens cheaper.
+            drafts = {
+                row: self._draft_for(req)
+                for row, req in enumerate(self._rows)
+                if req is not None
+            }
+            if any(len(d) for d in drafts.values()):
+                self._decode_spec_once(g, drafts)
+                return
         k = self.decode_steps_per_launch
         if k > 1 and self._multi_step_ok(k):
             self._decode_multi_once(k)
@@ -882,30 +910,11 @@ class Engine:
         single host round trip (device-side sampling feeds each step). See
         ``models/llama.py::decode_multi`` for the latency rationale."""
         lengths = np.ones(self.max_batch, dtype=np.int32)
-        preempted: list[Request] = []
-        for row, req in enumerate(self._rows):
-            if req is None:
-                continue
-            ps = self.page_size
-            ok = True
-            for p_idx in range(req.kv_len // ps, (req.kv_len + k - 1) // ps + 1):
-                if self._page_table[row, p_idx] != self._scratch_page:
-                    continue  # page already provisioned
-                new = self._alloc_pages(1)
-                if new is None:
-                    preempted.append(req)
-                    ok = False
-                    break
-                req.own_slots = np.concatenate([req.own_slots, new])
-                self._page_table[row, p_idx] = new[0] // ps
-            if ok:
-                lengths[row] = req.kv_len + 1
-        for req in preempted:
-            self._preempt(req)
-
-        active = [(row, r) for row, r in enumerate(self._rows) if r is not None]
+        active = self._provision_rows(k - 1)
         if not active:
             return
+        for row, req in active:
+            lengths[row] = req.kv_len + 1
         step_t0 = time.monotonic()
         self._rng, key = jax.random.split(self._rng)
         sampled, self.pool.kv = decode_multi(
@@ -938,6 +947,157 @@ class Engine:
                 )
                 if self._consume_token(req, row, slot, int(sampled[i, row])):
                     break  # finished mid-launch: surplus tokens discarded
+
+    def _spec_ok(self, g: int) -> bool:
+        """Speculative verification is safe when every active row decodes
+        greedily (acceptance compares against argmax; stochastic rows
+        would need rejection sampling) and has page-table headroom for the
+        γ+1 verify positions. Like the fused path, plain steps are
+        preferred while requests wait for admission, and rows within one
+        token of their output budget decline (the verify launch's surplus
+        would be discarded — the same bubble ``_multi_step_ok`` avoids)."""
+        if self.waiting:
+            return False
+        any_active = False
+        for row, req in enumerate(self._rows):
+            if req is None:
+                continue
+            any_active = True
+            if req.sampling.temperature != 0.0:
+                return False
+            if req.kv_len + g + 1 > self.max_seq_len:
+                return False
+            if (req.kv_len + g) // self.page_size >= self.max_pages:
+                return False
+            if req.sampling.max_new_tokens - len(req.output_tokens) < 2:
+                return False
+        return any_active
+
+    # Draft lookup scans at most this many trailing history tokens: the
+    # match quality of prompt lookup lives in the recent context, and an
+    # unbounded scan would put O(total-context) host work on the
+    # inter-launch critical path of a 32k-token generation.
+    _SPEC_WINDOW = 1024
+
+    def _draft_for(self, req: Request) -> np.ndarray:
+        hist = self._sequence_key(req, req.kv_len + 1)
+        return self._ngram_draft(
+            hist[-self._SPEC_WINDOW :], self.spec_decode_tokens, self.spec_ngram
+        )
+
+    @staticmethod
+    def _ngram_draft(hist: np.ndarray, gamma: int, n: int) -> np.ndarray:
+        """Prompt-lookup draft: the ``gamma`` tokens that followed the most
+        recent PREVIOUS occurrence of the current tail n-gram (falling back
+        to bigrams). Empty when the history never repeats its tail."""
+        L = len(hist)
+        for nn in range(n, 1, -1):
+            if L <= nn:
+                continue
+            tail = hist[L - nn:]
+            win = np.lib.stride_tricks.sliding_window_view(hist, nn)
+            hits = np.nonzero((win[: L - nn] == tail).all(axis=1))[0]
+            if hits.size:
+                j = int(hits[-1]) + nn  # continuation of the match
+                return hist[j : j + gamma]
+        return hist[:0]
+
+    def _provision_rows(self, extra: int) -> list[tuple[int, "Request"]]:
+        """Ensure every active row's page table covers positions
+        ``kv_len .. kv_len+extra``; preempt rows the pool can't cover.
+        Returns the surviving (row, request) pairs. Shared by the fused
+        multi-step and speculative paths (their only difference was the
+        bound)."""
+        ps = self.page_size
+        preempted: list[Request] = []
+        for row, req in enumerate(self._rows):
+            if req is None:
+                continue
+            for p_idx in range(req.kv_len // ps, (req.kv_len + extra) // ps + 1):
+                if self._page_table[row, p_idx] != self._scratch_page:
+                    continue  # page already provisioned
+                new = self._alloc_pages(1)
+                if new is None:
+                    preempted.append(req)
+                    break
+                req.own_slots = np.concatenate([req.own_slots, new])
+                self._page_table[row, p_idx] = new[0] // ps
+        for req in preempted:
+            self._preempt(req)
+        return [(row, r) for row, r in enumerate(self._rows) if r is not None]
+
+    def _decode_spec_once(self, g: int, drafts: dict[int, np.ndarray]) -> None:
+        """One speculative launch: verify [fed_token, draft…] (C=γ+1
+        positions per row) in a single ``prefill_chunk_paged`` call, accept
+        the longest draft prefix matching the model's own argmax, emit one
+        bonus token. Fed positions' K/V is written by the verify pass
+        itself, so accepted tokens cost no extra work; rejected positions
+        hold stale K/V that the next launch overwrites (slots are purely
+        positional) and that attention never reads (masked by length)."""
+        C = g + 1
+        ps = self.page_size
+        active = self._provision_rows(g)
+        if not active:
+            return
+        step_t0 = time.monotonic()
+
+        B = self.max_batch
+        kv_block = 32
+        maxp = _pow2_at_least(
+            max((r.kv_len + g) // ps + 1 for _, r in active), floor=kv_block
+        )
+        toks = np.zeros((B, C), dtype=np.int32)
+        sl = np.full((B, C), self._scratch_slot, dtype=np.int32)
+        poss = np.zeros((B, C), dtype=np.int32)
+        kvlen = np.zeros((B,), dtype=np.int32)
+        pt = np.full((B, maxp), self._scratch_page, dtype=np.int32)
+        for row, req in active:
+            draft = drafts.get(row, req.prompt[:0])
+            drafts[row] = draft
+            toks[row, 0] = self._tokens[row]
+            toks[row, 1 : 1 + len(draft)] = draft
+            pos = req.kv_len + np.arange(C, dtype=np.int32)
+            poss[row] = np.minimum(pos, self.max_seq_len - 1)
+            n_pages = min((req.kv_len + g) // ps + 1, self.max_pages)
+            pt[row, :n_pages] = self._page_table[row, :n_pages]
+            sl[row] = pt[row, pos // ps] * ps + pos % ps
+            kvlen[row] = req.kv_len + C
+            self.stats.spec_proposed += len(draft)
+
+        logits, self.pool.kv = prefill_chunk_paged(
+            self.params,
+            self.cfg,
+            jnp.asarray(toks),
+            jnp.asarray(poss),
+            self.pool.kv,
+            jnp.asarray(sl),
+            jnp.asarray(pt),
+            jnp.asarray(kvlen),
+            page_size=ps,
+            kv_block_pages=kv_block,
+        )
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [B, C] one sync
+        self.stats.decode_steps += 1
+
+        emitted_total = 0
+        for row, req in active:
+            draft = drafts[row]
+            # Longest draft prefix the model itself would have produced.
+            a = 0
+            while a < len(draft) and greedy[row, a] == draft[a]:
+                a += 1
+            self.stats.spec_accepted += a
+            base = req.kv_len
+            for i in range(a + 1):  # a accepted drafts + 1 bonus token
+                pos = base + i
+                slot = int(self._page_table[row, pos // ps] * ps + pos % ps)
+                token = int(draft[i]) if i < a else int(greedy[row, a])
+                emitted_total += 1
+                if self._consume_token(req, row, slot, token):
+                    break
+        elapsed = time.monotonic() - step_t0
+        for _ in range(max(emitted_total, 1)):
+            self._m_tpot.observe(elapsed / max(emitted_total, 1))
 
     def _consume_token(self, req: Request, row: int, slot: int, token: int) -> bool:
         """Account one decode iteration for ``req``: the fed token's KV
