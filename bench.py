@@ -221,6 +221,58 @@ def _validate_paged_kernel() -> None:
             f"paged-attention kernel disagrees with oracle on-chip "
             f"(max rel err {err:.3e})"
         )
+    _validate_quant_kernels()
+
+
+def _validate_quant_kernels() -> None:
+    """Mosaic-compile + numerics-check the int8-pool kernel variants (the
+    1D per-page scale DMAs and int8 page tiles are exactly the shapes that
+    could lower differently on real hardware than in the interpreter)."""
+    from radixmesh_tpu.ops.attention import attend_decode_ref
+    from radixmesh_tpu.ops.paged_attention import (
+        paged_attention_pool_kernel,
+        paged_decode_fused_kernel,
+    )
+    from radixmesh_tpu.ops.quant import quantize_kv
+
+    rng = np.random.default_rng(43)
+    B, Hq, Hkv, D, page, P, L = 4, 16, 8, 128, 16, 64, 2
+    max_pages = 8
+    kv = jnp.asarray(rng.normal(size=(2, L, Hkv, P * page, D)), jnp.float32)
+    q8, sc = quantize_kv(kv, axis=-1)
+    kvp = q8.reshape(2, L, Hkv, P, page, D)
+    scp = sc.reshape(2, L, Hkv, P, page)
+    q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.bfloat16)
+    pt = jnp.asarray(
+        rng.permutation(P)[: B * max_pages].reshape(B, max_pages), jnp.int32
+    )
+    ln = jnp.asarray([1, page + 3, 5 * page, max_pages * page], jnp.int32)
+    want = np.asarray(
+        attend_decode_ref(q, kvp[0, 1], kvp[1, 1], pt, ln, scp[0, 1], scp[1, 1]),
+        np.float32,
+    )
+    got = np.asarray(
+        jax.block_until_ready(
+            paged_attention_pool_kernel(q, kvp, pt, ln, 1, kv_scales=scp)
+        ),
+        np.float32,
+    )
+    err = np.max(np.abs(want - got)) / (np.max(np.abs(want)) + 1e-6)
+    log(f"int8 pool kernel on-chip validation: max rel err {err:.2e}")
+    if not np.allclose(want, got, rtol=3e-2, atol=3e-2):
+        raise AssertionError(f"int8 pool kernel disagrees on-chip ({err:.3e})")
+    k_new = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+    slots = jnp.asarray(
+        [int(pt[b, (int(ln[b]) - 1) // page]) * page + (int(ln[b]) - 1) % page
+         for b in range(B)],
+        jnp.int32,
+    )
+    out, _, _ = paged_decode_fused_kernel(
+        q, k_new, v_new, kvp, slots, pt, ln, 1, kv_scales=scp
+    )
+    jax.block_until_ready(out)
+    log("int8 fused kernel compiled + ran on-chip")
 
 
 # Public per-chip peaks (bf16 FLOPs, HBM bytes/s) keyed on device_kind

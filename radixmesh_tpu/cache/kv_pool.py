@@ -138,6 +138,31 @@ def _gather_kv(kv: jax.Array, slots: jax.Array) -> jax.Array:
     return kv[:, :, :, slots].transpose(0, 1, 3, 2, 4)
 
 
+@partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_kv_quant(
+    kv: jax.Array,  # int8 [2, L, H, S, D]
+    kv_scale: jax.Array,  # f32 [2, L, H, S]
+    slots: jax.Array,  # [n]
+    new_kv: jax.Array,  # [2, L, H, n, D] float
+):
+    from radixmesh_tpu.ops.quant import quantize_kv
+
+    q, scale = quantize_kv(new_kv, axis=-1)
+    return kv.at[:, :, :, slots].set(q), kv_scale.at[:, :, :, slots].set(scale)
+
+
+@jax.jit
+def _gather_kv_dequant(
+    kv: jax.Array, kv_scale: jax.Array, slots: jax.Array
+) -> jax.Array:
+    # → dequantized f32 [2, L, n, H, D] (token-major, for tests/debug and
+    # the engine's dense-prefill cached-prefix gather)
+    from radixmesh_tpu.ops.quant import dequantize_kv
+
+    deq = dequantize_kv(kv[:, :, :, slots], kv_scale[:, :, :, slots])
+    return deq.transpose(0, 1, 3, 2, 4)
+
+
 class PagedKVPool:
     """Preallocated paged KV storage for every layer of one model replica."""
 
@@ -150,12 +175,20 @@ class PagedKVPool:
         page_size: int = 1,
         dtype: Any = jnp.bfloat16,
         sharding: jax.sharding.Sharding | None = None,
+        quant: str | None = None,
     ):
         self.num_slots = num_slots
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
         self.page_size = page_size
+        self.quant = quant
+        if quant is not None:
+            from radixmesh_tpu.ops.quant import KV_QUANT_DTYPES
+
+            if quant not in KV_QUANT_DTYPES:
+                raise ValueError(f"unknown kv quantization {quant!r}")
+            dtype = KV_QUANT_DTYPES[quant]
         self.dtype = dtype
         self.allocator = SlotAllocator(num_slots, page_size)
         # Head-major layout [2, L, Hkv, slots, D]: per-layer pages view as
@@ -172,6 +205,25 @@ class PagedKVPool:
             self.kv = jax.device_put(zeros(), sharding)
         else:
             self.kv = zeros()
+        # Per-(token, head) symmetric scales for quantized pools: value ≈
+        # int8 * scale (ops/quant.py). Same [2, L, Hkv, slots] geometry as
+        # the data minus head_dim — shards identically over `tp`, and the
+        # per-layer pages view is again a pure reshape.
+        self.kv_scale = None
+        if quant is not None:
+            sc = jnp.zeros((2, num_layers, num_kv_heads, num_slots), jnp.float32)
+            if sharding is not None:
+                # Scale sharding mirrors the data's head axis; the slot and
+                # trailing axes are replicated the same way.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                if isinstance(sharding, NamedSharding):
+                    spec = tuple(sharding.spec) + (None,) * 5
+                    sc = jax.device_put(
+                        sc,
+                        NamedSharding(sharding.mesh, PartitionSpec(*spec[:4])),
+                    )
+            self.kv_scale = sc
 
     @property
     def num_pages(self) -> int:
@@ -214,8 +266,14 @@ class PagedKVPool:
             k = jnp.concatenate([k, jnp.repeat(k[:, -1:], pad, axis=1)], axis=1)
             v = jnp.concatenate([v, jnp.repeat(v[:, -1:], pad, axis=1)], axis=1)
         # [L, n, H, D] → head-major [L, H, n, D].
-        new_kv = jnp.stack([k, v]).astype(self.dtype).transpose(0, 1, 3, 2, 4)
-        self.kv = _scatter_kv(self.kv, jnp.asarray(slots, dtype=jnp.int32), new_kv)
+        new_kv = jnp.stack([k, v]).transpose(0, 1, 3, 2, 4)
+        sl = jnp.asarray(slots, dtype=jnp.int32)
+        if self.quant is not None:
+            self.kv, self.kv_scale = _scatter_kv_quant(
+                self.kv, self.kv_scale, sl, new_kv
+            )
+        else:
+            self.kv = _scatter_kv(self.kv, sl, new_kv.astype(self.dtype))
 
     def pages_for_layer(self, layer: int) -> tuple[jax.Array, jax.Array]:
         """(k_pages, v_pages), each ``[Hkv, num_pages, page, D]`` — a
@@ -223,10 +281,25 @@ class PagedKVPool:
         shape = (self.num_kv_heads, self.num_pages, self.page_size, self.head_dim)
         return self.kv[0, layer].reshape(shape), self.kv[1, layer].reshape(shape)
 
+    def scales_pages(self) -> jax.Array | None:
+        """``[2, L, Hkv, num_pages, page]`` pure-reshape view of the scale
+        pool (``None`` for unquantized pools) — the attention ops' scale
+        input layout."""
+        if self.kv_scale is None:
+            return None
+        return self.kv_scale.reshape(
+            2, self.num_layers, self.num_kv_heads, self.num_pages, self.page_size
+        )
+
     def gather(self, slots: np.ndarray | jax.Array) -> jax.Array:
-        """Gather ``[2, L, n, kv_heads, head_dim]`` for the given slots
-        (debug/test path; the attention kernels read pages directly)."""
-        return _gather_kv(self.kv, jnp.asarray(slots, dtype=jnp.int32))
+        """Gather ``[2, L, n, kv_heads, head_dim]`` for the given slots,
+        dequantized for quantized pools (debug/test path and the dense-
+        prefill cached-prefix gather; attention kernels read pages
+        directly)."""
+        sl = jnp.asarray(slots, dtype=jnp.int32)
+        if self.quant is not None:
+            return _gather_kv_dequant(self.kv, self.kv_scale, sl)
+        return _gather_kv(self.kv, sl)
 
     def page_table(self, slots: np.ndarray) -> np.ndarray:
         """Page ids covering a page-aligned run of slots — the block table

@@ -113,10 +113,17 @@ class Engine:
         decode_steps_per_launch: int = 1,
         spec_decode_tokens: int = 0,
         spec_ngram: int = 3,
+        kv_quant: str | None = None,
         device_mesh=None,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
+        if device_mesh is not None and (
+            kv_quant is not None or (pool is not None and pool.quant is not None)
+        ):
+            raise NotImplementedError(
+                "quantized KV + tensor-parallel serving not wired yet"
+            )
         self.cfg = cfg
         # Multi-chip serving (SURVEY §7 stage 7): tp shards heads/ffn/vocab
         # across the device mesh; the SAME scheduler/tree/publish code runs
@@ -176,8 +183,10 @@ class Engine:
                 num_layers=cfg.n_layers,
                 num_kv_heads=cfg.n_kv_heads,
                 head_dim=cfg.head_dim,
-                dtype=cfg.dtype,
+                quant=kv_quant,
             )
+            if kv_quant is None:
+                expected["dtype"] = cfg.dtype
             for attr, want in expected.items():
                 got = getattr(pool, attr)
                 if got != want:
@@ -204,6 +213,7 @@ class Engine:
                 page_size=page_size,
                 dtype=cfg.dtype,
                 sharding=pool_sharding,
+                quant=kv_quant,
             )
         if host_cache_slots > 0:
             # Hierarchical cache: HBM-evicted prefixes fall back to a
@@ -261,6 +271,16 @@ class Engine:
         self._m_preempt = reg.counter(
             "engine_preemptions_total",
             "requests preempted under pool pressure",
+            ("engine",),
+        ).labels(**lbl)
+        self._m_spec_proposed = reg.counter(
+            "engine_spec_proposed_tokens_total",
+            "draft tokens offered to speculative verification",
+            ("engine",),
+        ).labels(**lbl)
+        self._m_spec_accepted = reg.counter(
+            "engine_spec_accepted_tokens_total",
+            "draft tokens accepted by speculative verification",
             ("engine",),
         ).labels(**lbl)
         self._m_ttft = reg.histogram(
@@ -709,7 +729,7 @@ class Engine:
                         lastpos[i] = nv - 1  # this chunk holds the last token
                 else:
                     kvlen[i] = totals[i]
-            logits, self.pool.kv = prefill_chunk_paged(
+            res = prefill_chunk_paged(
                 self.params,
                 self.cfg,
                 jnp.asarray(toks),
@@ -720,7 +740,12 @@ class Engine:
                 jnp.asarray(kvlen),
                 page_size=ps,
                 kv_block_pages=kv_block,
+                kv_scale=self.pool.kv_scale,
             )
+            if self.pool.quant is not None:
+                logits, self.pool.kv, self.pool.kv_scale = res
+            else:
+                logits, self.pool.kv = res
             for i in range(N):
                 if lastpos[i] >= 0:
                     final_logits[i] = logits[i, lastpos[i]]
@@ -859,7 +884,7 @@ class Engine:
             return
         step_t0 = time.monotonic()
         self._rng, key = jax.random.split(self._rng)
-        logits, self.pool.kv = decode_step(
+        res = decode_step(
             self.params,
             self.cfg,
             jnp.asarray(self._tokens),
@@ -869,7 +894,12 @@ class Engine:
             jnp.asarray(lengths),
             self.page_size,
             mesh=self.device_mesh,
+            kv_scale=self.pool.kv_scale,
         )
+        if self.pool.quant is not None:
+            logits, self.pool.kv, self.pool.kv_scale = res
+        else:
+            logits, self.pool.kv = res
         sampled = np.asarray(
             sample_tokens(
                 logits, key, temperature=jnp.asarray(self._temps),
@@ -917,7 +947,7 @@ class Engine:
             lengths[row] = req.kv_len + 1
         step_t0 = time.monotonic()
         self._rng, key = jax.random.split(self._rng)
-        sampled, self.pool.kv = decode_multi(
+        res = decode_multi(
             self.params,
             self.cfg,
             jnp.asarray(self._tokens),
@@ -930,7 +960,12 @@ class Engine:
             self.page_size,
             k_steps=k,
             mesh=self.device_mesh,
+            kv_scale=self.pool.kv_scale,
         )
+        if self.pool.quant is not None:
+            sampled, self.pool.kv, self.pool.kv_scale = res
+        else:
+            sampled, self.pool.kv = res
         sampled = np.asarray(sampled)  # [k, B] — the ONE round trip
         self.stats.decode_steps += k
         elapsed = time.monotonic() - step_t0
@@ -1063,8 +1098,9 @@ class Engine:
             sl[row] = pt[row, pos // ps] * ps + pos % ps
             kvlen[row] = req.kv_len + C
             self.stats.spec_proposed += len(draft)
+            self._m_spec_proposed.inc(len(draft))
 
-        logits, self.pool.kv = prefill_chunk_paged(
+        res = prefill_chunk_paged(
             self.params,
             self.cfg,
             jnp.asarray(toks),
@@ -1075,7 +1111,12 @@ class Engine:
             jnp.asarray(kvlen),
             page_size=ps,
             kv_block_pages=kv_block,
+            kv_scale=self.pool.kv_scale,
         )
+        if self.pool.quant is not None:
+            logits, self.pool.kv, self.pool.kv_scale = res
+        else:
+            logits, self.pool.kv = res
         greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [B, C] one sync
         self.stats.decode_steps += 1
 
@@ -1087,6 +1128,7 @@ class Engine:
             while a < len(draft) and greedy[row, a] == draft[a]:
                 a += 1
             self.stats.spec_accepted += a
+            self._m_spec_accepted.inc(a)
             base = req.kv_len
             for i in range(a + 1):  # a accepted drafts + 1 bonus token
                 pos = base + i

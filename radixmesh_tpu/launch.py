@@ -73,6 +73,7 @@ def _run_node(args: argparse.Namespace) -> int:
             head_dim=mcfg.head_dim,
             page_size=page_size,
             dtype=mcfg.dtype,
+            quant=model.get("kv_quant"),
         )
         node = MeshCache(cfg, pool=None).start()
     elif role is not NodeRole.ROUTER:
@@ -120,6 +121,9 @@ def _run_node(args: argparse.Namespace) -> int:
             page_size=pool.page_size,
             max_batch=int(model.get("max_batch", 8)),
             host_cache_slots=int(model.get("host_cache_slots", 0)),
+            decode_steps_per_launch=int(model.get("decode_steps_per_launch", 1)),
+            spec_decode_tokens=int(model.get("spec_decode_tokens", 0)),
+            kv_quant=model.get("kv_quant"),
             mesh=node,
             name=f"{role.value}{rank}",
         )
@@ -162,6 +166,9 @@ def _run_serve(args: argparse.Namespace) -> int:
         page_size=args.page_size,
         max_batch=args.max_batch,
         host_cache_slots=args.host_cache_slots,
+        decode_steps_per_launch=args.decode_steps_per_launch,
+        spec_decode_tokens=args.spec_decode_tokens,
+        kv_quant=args.kv_quant,
     )
     frontend = ServingFrontend(engine, host=args.host, port=args.http_port)
     print(f"serving {args.model} on http://{args.host}:{frontend.port}", flush=True)
@@ -201,6 +208,19 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument("--max-batch", type=int, default=8)
     serve.add_argument("--host-cache-slots", type=int, default=0)
     serve.add_argument("--seed", type=int, default=0)
+    serve.add_argument(
+        "--decode-steps-per-launch", type=int, default=1,
+        help="fuse k decode steps per device launch (device-side sampling)",
+    )
+    serve.add_argument(
+        "--kv-quant", choices=["int8"], default=None,
+        help="store the KV pool quantized (halves decode HBM traffic)",
+    )
+    serve.add_argument(
+        "--spec-decode-tokens", type=int, default=0,
+        help="speculative decoding: draft up to N tokens by prompt lookup "
+        "and verify them in one chunked pass (greedy rows only)",
+    )
     serve.set_defaults(fn=_run_serve)
 
     args = p.parse_args(argv)

@@ -325,6 +325,7 @@ def prefill_forward_sp(
     jax.jit,
     static_argnames=("cfg", "page_size", "kv_block_pages"),
     donate_argnums=(4,),
+    donate_argnames=("kv_scale",),
 )
 def prefill_chunk_paged(
     params: dict,
@@ -337,6 +338,7 @@ def prefill_chunk_paged(
     kv_lengths: jnp.ndarray,  # [B] context tokens valid after this chunk
     page_size: int = 16,
     kv_block_pages: int = 32,
+    kv_scale: jnp.ndarray | None = None,  # [2, L, Hkv, num_slots] int8 pool
 ):
     """One CHUNK of long-context prefill against the paged pool (SURVEY §5:
     the 32k Qwen2 gate must never materialize O(S²) scores — VERDICT
@@ -350,7 +352,8 @@ def prefill_chunk_paged(
     prompt length; the host loops chunks, so compile cost is one variant
     per (B, C, max_pages) bucket triple.
 
-    Returns ``(logits [B, C, V], kv_pool)``.
+    Returns ``(logits [B, C, V], kv_pool)`` — plus the updated
+    ``kv_scale`` when the pool is int8-quantized.
     """
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     x = params["embed"][tokens]  # [B, C, H]
@@ -360,6 +363,13 @@ def prefill_chunk_paged(
         num_slots // page_size, page_size, cfg.head_dim,
     )
     kv_pages = kv_pool.reshape(pages_shape)
+    scale_pages = (
+        None
+        if kv_scale is None
+        else kv_scale.reshape(
+            2, cfg.n_layers, cfg.n_kv_heads, num_slots // page_size, page_size
+        )
+    )
     # Tokens in the pool BEFORE this chunk: chunk start per row. (Padded
     # rows may carry clamped positions; their outputs are discarded and
     # the masking below stays finite either way.)
@@ -371,6 +381,17 @@ def prefill_chunk_paged(
         q, k, v = _qkv(lp, h, cfg)  # [B,C,*,D]
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
+        if kv_scale is not None:
+            # Quantize NOW and attend the dequantized copy, so the chunk
+            # sees exactly what any later pool read will see (the fused
+            # decode kernel keeps the same invariant) — otherwise logits
+            # drift between a speculative verify pass and plain decode.
+            from radixmesh_tpu.ops.quant import quantize_kv
+
+            k_int, k_sc = quantize_kv(k, axis=-1)  # int8 [B,C,H,D], f32 [B,C,H]
+            v_int, v_sc = quantize_kv(v, axis=-1)
+            k = k_int.astype(jnp.float32) * k_sc[..., None]
+            v = v_int.astype(jnp.float32) * v_sc[..., None]
         attn = attend_chunk_hybrid(
             q,
             k,
@@ -382,6 +403,7 @@ def prefill_chunk_paged(
             kv_lengths,
             l_idx,
             kv_block_pages=kv_block_pages,
+            kv_scales=scale_pages,
         )
         x = x + jnp.einsum(
             "bsqd,qdh->bsh",
@@ -391,8 +413,22 @@ def prefill_chunk_paged(
         )
         h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(lp, h2)
+        if kv_scale is not None:
+            return x, (k_int, v_int, k_sc, v_sc)
         return x, (k.astype(kv_pool.dtype), v.astype(kv_pool.dtype))
 
+    if kv_scale is not None:
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            layer, x, (jnp.arange(cfg.n_layers), params["layers"])
+        )
+        # Already quantized in-layer (same ints attention saw); scatter the
+        # int8 payloads + scales: scan stacks [L, B, C, Hkv(, D)] → the
+        # pool target [:, :, :, slots[B, C]] expects [2, L, Hkv, B, C(, D)].
+        new_kv = jnp.stack([new_k, new_v]).transpose(0, 1, 4, 2, 3, 5)
+        new_s = jnp.stack([new_ks, new_vs]).transpose(0, 1, 4, 2, 3)
+        kv_pool = kv_pool.at[:, :, :, slots].set(new_kv)
+        kv_scale = kv_scale.at[:, :, :, slots].set(new_s)
+        return _logits(params, cfg, x), kv_pool, kv_scale
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (jnp.arange(cfg.n_layers), params["layers"])
     )
@@ -404,7 +440,12 @@ def prefill_chunk_paged(
     return _logits(params, cfg, x), kv_pool
 
 
-@partial(jax.jit, static_argnames=("cfg", "page_size", "mesh"), donate_argnums=(3,))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "page_size", "mesh"),
+    donate_argnums=(3,),
+    donate_argnames=("kv_scale",),
+)
 def decode_step(
     params: dict,
     cfg: ModelConfig,
@@ -415,6 +456,7 @@ def decode_step(
     lengths: jnp.ndarray,  # [B] context length incl. this token
     page_size: int = 16,
     mesh=None,
+    kv_scale: jnp.ndarray | None = None,  # [2, L, Hkv, num_slots] int8 pool
 ):
     """One decode step for a continuous batch: writes this token's K/V into
     the paged pool inside the layer scan, attends over the radix-cache
@@ -427,7 +469,7 @@ def decode_step(
     via GSPMD from the params/pool shardings."""
     return _decode_core(
         params, cfg, tokens, kv_pool, slots, page_table, lengths, page_size,
-        mesh,
+        mesh, kv_scale,
     )
 
 
@@ -435,6 +477,7 @@ def decode_step(
     jax.jit,
     static_argnames=("cfg", "page_size", "k_steps", "mesh"),
     donate_argnums=(3,),
+    donate_argnames=("kv_scale",),
 )
 def decode_multi(
     params: dict,
@@ -449,6 +492,7 @@ def decode_multi(
     page_size: int = 16,
     k_steps: int = 8,
     mesh=None,
+    kv_scale: jnp.ndarray | None = None,
 ):
     """``k_steps`` decode iterations fused in ONE dispatch: sampling stays
     on device and each sampled token feeds the next step, so the host pays
@@ -464,24 +508,30 @@ def decode_multi(
     rows = jnp.arange(B)
 
     def step(carry, i):
-        toks, pool, k = carry
+        toks, pool, scale, k = carry
         lens = lengths + i
         pos = lens - 1
         slots = (
             page_table[rows, pos // page_size] * page_size + pos % page_size
         )
-        logits, pool = _decode_core(
-            params, cfg, toks, pool, slots, page_table, lens, page_size, mesh
+        res = _decode_core(
+            params, cfg, toks, pool, slots, page_table, lens, page_size, mesh,
+            scale,
         )
+        logits, pool = res[0], res[1]
+        if scale is not None:
+            scale = res[2]
         k, sk = jax.random.split(k)
         nxt = sample_tokens(
             logits, sk, temperature=temperatures, top_p=top_ps
         ).astype(jnp.int32)
-        return (nxt, pool, k), nxt
+        return (nxt, pool, scale, k), nxt
 
-    (_, kv_pool, _), sampled = jax.lax.scan(
-        step, (tokens, kv_pool, key), jnp.arange(k_steps)
+    (_, kv_pool, kv_scale, _), sampled = jax.lax.scan(
+        step, (tokens, kv_pool, kv_scale, key), jnp.arange(k_steps)
     )
+    if kv_scale is not None:
+        return sampled, kv_pool, kv_scale
     return sampled, kv_pool
 
 
@@ -495,6 +545,7 @@ def _decode_core(
     lengths: jnp.ndarray,
     page_size: int,
     mesh,
+    kv_scale: jnp.ndarray | None = None,
 ):
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     positions = lengths - 1  # [B]
@@ -506,8 +557,12 @@ def _decode_core(
         num_slots // page_size, page_size, cfg.head_dim,
     )
 
+    scales_shape = (
+        2, cfg.n_layers, cfg.n_kv_heads, num_slots // page_size, page_size,
+    )
+
     def layer(carry, xs):
-        x, kv_pool = carry
+        x, kv_pool, kv_scale = carry
         l_idx, lp = xs
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(lp, h, cfg)  # [B,1,*,D]
@@ -517,20 +572,39 @@ def _decode_core(
         # into the (aliased) pool and attends over this layer's pages — the
         # pool buffer flows through the scan with zero copies. (A separate
         # XLA scatter + kernel read used to force a full pool copy per
-        # layer: ~4 GB of HBM traffic per step at bench shapes.)
-        attn, kv_pool = paged_decode_attention(
-            q[:, 0],
-            k[:, 0].astype(kv_pool.dtype),
-            v[:, 0].astype(kv_pool.dtype),
-            kv_pool.reshape(pages_shape),
-            slots,
-            page_table,
-            lengths,
-            l_idx,
-            mesh=mesh,
-        )
-        kv_pool = kv_pool.reshape(2, cfg.n_layers, cfg.n_kv_heads, num_slots,
-                                  cfg.head_dim)
+        # layer: ~4 GB of HBM traffic per step at bench shapes.) For
+        # quantized pools the raw row goes in (the kernel quantizes) and
+        # the scale pool rides the carry the same zero-copy way.
+        if kv_scale is not None:
+            attn, kv_pages, scale_pages = paged_decode_attention(
+                q[:, 0],
+                k[:, 0],
+                v[:, 0],
+                kv_pool.reshape(pages_shape),
+                slots,
+                page_table,
+                lengths,
+                l_idx,
+                mesh=mesh,
+                kv_scales=kv_scale.reshape(scales_shape),
+            )
+            kv_scale = scale_pages.reshape(
+                2, cfg.n_layers, cfg.n_kv_heads, num_slots
+            )
+        else:
+            attn, kv_pages = paged_decode_attention(
+                q[:, 0],
+                k[:, 0].astype(kv_pool.dtype),
+                v[:, 0].astype(kv_pool.dtype),
+                kv_pool.reshape(pages_shape),
+                slots,
+                page_table,
+                lengths,
+                l_idx,
+                mesh=mesh,
+            )
+        kv_pool = kv_pages.reshape(2, cfg.n_layers, cfg.n_kv_heads, num_slots,
+                                   cfg.head_dim)
         x = x + jnp.einsum(
             "bqd,qdh->bh",
             attn.reshape(B, cfg.n_heads, cfg.head_dim),
@@ -539,12 +613,15 @@ def _decode_core(
         )[:, None, :]
         h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(lp, h2)
-        return (x, kv_pool), None
+        return (x, kv_pool, kv_scale), None
 
-    (x, kv_pool), _ = jax.lax.scan(
-        layer, (x, kv_pool), (jnp.arange(cfg.n_layers), params["layers"])
+    (x, kv_pool, kv_scale), _ = jax.lax.scan(
+        layer, (x, kv_pool, kv_scale), (jnp.arange(cfg.n_layers), params["layers"])
     )
-    return _logits(params, cfg, x)[:, 0], kv_pool
+    logits = _logits(params, cfg, x)[:, 0]
+    if kv_scale is not None:
+        return logits, kv_pool, kv_scale
+    return logits, kv_pool
 
 
 # ---------------------------------------------------------------------------

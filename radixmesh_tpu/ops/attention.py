@@ -83,9 +83,12 @@ def attend_decode_ref(
     v_pages: jnp.ndarray,  # [Hkv, P, page, D]
     page_table: jnp.ndarray,  # [B, max_pages] page ids (padded arbitrarily)
     lengths: jnp.ndarray,  # [B] context length incl. current token
+    k_scales: jnp.ndarray | None = None,  # [Hkv, P, page] int8-pool scales
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Gather-based paged decode attention — the numerics oracle for the
-    Pallas kernel and the CPU execution path."""
+    Pallas kernel and the CPU execution path. With ``*_scales`` the pages
+    are int8 and value ≈ page · scale (``ops/quant.py``)."""
     B, Hq, D = q.shape
     Hkv, _, page, _ = k_pages.shape
     G = Hq // Hkv
@@ -94,6 +97,11 @@ def attend_decode_ref(
     # grouped rather than repeating K/V.
     k = k_pages[:, page_table].reshape(Hkv, B, max_ctx, D).transpose(1, 2, 0, 3)
     v = v_pages[:, page_table].reshape(Hkv, B, max_ctx, D).transpose(1, 2, 0, 3)
+    if k_scales is not None:
+        ks = k_scales[:, page_table].reshape(Hkv, B, max_ctx).transpose(1, 2, 0)
+        vs = v_scales[:, page_table].reshape(Hkv, B, max_ctx).transpose(1, 2, 0)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     qg = q.reshape(B, Hkv, G, D)
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
     logits = (
@@ -128,6 +136,7 @@ def attend_prefill_paged(
     kv_lengths: jnp.ndarray,  # [B] valid context tokens (incl. this chunk)
     layer: jnp.ndarray | int,
     kv_block_pages: int = 32,
+    kv_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Blockwise causal attention for CHUNKED prefill over the paged pool
     (SURVEY §5 long-context): a chunk of C queries attends to the whole
@@ -142,7 +151,8 @@ def attend_prefill_paged(
     both to powers of two). Returns [B, C, Hq, D].
     """
     m, l, acc = _page_block_softmax(
-        q, kv_pages, page_table, q_positions, kv_lengths, layer, kv_block_pages
+        q, kv_pages, page_table, q_positions, kv_lengths, layer, kv_block_pages,
+        kv_scales,
     )
     # Padded queries (chunk tail) can end with l == 0; their rows are
     # discarded by the caller — emit 0 instead of NaN so nothing poisons
@@ -160,6 +170,7 @@ def _page_block_softmax(
     kv_bound: jnp.ndarray,  # [B] tokens of pool context to attend (< bound)
     layer: jnp.ndarray | int,
     kv_block_pages: int,
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] int8 pools
 ):
     """Shared core of the chunked-prefill attentions: scan fixed-size page
     blocks of one layer's pool context, maintaining the online softmax
@@ -181,6 +192,10 @@ def _page_block_softmax(
     )
     k_layer = kv_pages[0, layer]  # [Hkv, P, page, D]
     v_layer = kv_pages[1, layer]
+    ks_layer = vs_layer = None
+    if kv_scales is not None:
+        ks_layer = kv_scales[0, layer]  # [Hkv, P, page]
+        vs_layer = kv_scales[1, layer]
     qpos = q_positions[:, None, None, :, None]  # [B,1,1,C,1]
     bound = kv_bound[:, None, None, None, None]
 
@@ -192,6 +207,11 @@ def _page_block_softmax(
         # [Hkv, B, bp, page, D] → [B, Hkv, bk, D]
         k = k_layer[:, pids].reshape(Hkv, B, bk, D).transpose(1, 0, 2, 3)
         v = v_layer[:, pids].reshape(Hkv, B, bk, D).transpose(1, 0, 2, 3)
+        if ks_layer is not None:
+            ks = ks_layer[:, pids].reshape(Hkv, B, bk).transpose(1, 0, 2)
+            vs = vs_layer[:, pids].reshape(Hkv, B, bk).transpose(1, 0, 2)
+            k = k.astype(jnp.float32) * ks[..., None]
+            v = v.astype(jnp.float32) * vs[..., None]
         s = jax.lax.dot_general(
             qg,
             k.astype(jnp.float32),
@@ -235,6 +255,7 @@ def attend_chunk_hybrid(
     kv_lengths: jnp.ndarray,  # [B] valid context incl. this chunk
     layer: jnp.ndarray | int,
     kv_block_pages: int = 32,
+    kv_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Chunk attention with the current chunk's K/V taken DENSE from the
     layer activations instead of read back out of the pool: prior context
@@ -249,7 +270,7 @@ def attend_chunk_hybrid(
     Hkv = k_cur.shape[2]
     m, l, acc = _page_block_softmax(
         q, kv_pages, page_table, q_positions, prior_lengths, layer,
-        kv_block_pages,
+        kv_block_pages, kv_scales,
     )
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
     qg = (q.astype(jnp.float32) * scale).reshape(
@@ -357,6 +378,7 @@ def paged_attention_pool(
     layer: jnp.ndarray | int,
     use_kernel: bool | None = None,
     mesh=None,
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] int8 pools
 ) -> jnp.ndarray:
     """Decode attention reading ``layer``'s pages straight out of the whole
     multi-layer pool — the scan-over-layers hot path (``decode_step``): no
@@ -368,13 +390,24 @@ def paged_attention_pool(
         use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
     if use_kernel:
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            if kv_scales is not None:
+                raise NotImplementedError(
+                    "quantized KV + tensor-parallel kernel not wired yet"
+                )
             return paged_attention_pool_kernel_sharded(
                 q, kv_pages, page_table, lengths, layer, mesh
             )
         from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
 
-        return paged_attention_pool_kernel(q, kv_pages, page_table, lengths, layer)
+        return paged_attention_pool_kernel(
+            q, kv_pages, page_table, lengths, layer, kv_scales=kv_scales
+        )
     k_pages, v_pages = kv_pages[0, layer], kv_pages[1, layer]
+    if kv_scales is not None:
+        return attend_decode_ref(
+            q, k_pages, v_pages, page_table, lengths,
+            kv_scales[0, layer], kv_scales[1, layer],
+        )
     return attend_decode_ref(q, k_pages, v_pages, page_table, lengths)
 
 
@@ -439,6 +472,7 @@ def paged_decode_attention(
     layer: jnp.ndarray | int,
     use_kernel: bool | None = None,
     mesh=None,
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] — updated too
 ):
     """One decode step's KV write + paged attention, fused.
 
@@ -453,6 +487,10 @@ def paged_decode_attention(
         use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
     if use_kernel:
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            if kv_scales is not None:
+                raise NotImplementedError(
+                    "quantized KV + tensor-parallel kernel not wired yet"
+                )
             return paged_decode_fused_sharded(
                 q, k_new, v_new, kv_pages, slots, page_table, lengths, layer,
                 mesh,
@@ -460,7 +498,8 @@ def paged_decode_attention(
         from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
 
         return paged_decode_fused_kernel(
-            q, k_new, v_new, kv_pages, slots, page_table, lengths, layer
+            q, k_new, v_new, kv_pages, slots, page_table, lengths, layer,
+            kv_scales=kv_scales,
         )
     page = kv_pages.shape[4]
     pg, off = slots // page, slots % page
@@ -468,6 +507,20 @@ def paged_decode_attention(
     # (layer, pg, off) are then non-adjacent, so the broadcast batch axis
     # lands FIRST → target [B, Hkv, D] regardless of how layer was passed.
     layer = jnp.asarray(layer)
+    if kv_scales is not None:
+        from radixmesh_tpu.ops.quant import quantize_kv
+
+        kq, ks = quantize_kv(k_new, axis=-1)
+        vq, vs = quantize_kv(v_new, axis=-1)
+        kv_pages = kv_pages.at[0, layer, :, pg, off].set(kq)
+        kv_pages = kv_pages.at[1, layer, :, pg, off].set(vq)
+        kv_scales = kv_scales.at[0, layer, :, pg, off].set(ks)
+        kv_scales = kv_scales.at[1, layer, :, pg, off].set(vs)
+        attn = attend_decode_ref(
+            q, kv_pages[0, layer], kv_pages[1, layer], page_table, lengths,
+            kv_scales[0, layer], kv_scales[1, layer],
+        )
+        return attn, kv_pages, kv_scales
     kv_pages = kv_pages.at[0, layer, :, pg, off].set(k_new)
     kv_pages = kv_pages.at[1, layer, :, pg, off].set(v_new)
     attn = attend_decode_ref(

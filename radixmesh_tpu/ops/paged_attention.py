@@ -106,21 +106,42 @@ def _run_block_loop(
     batch_size: int,
     num_kv_heads: int,
     min_length: int,  # lengths_ref value below which a row has no HBM work
+    scales_hbm=None,  # ANY [2, L, Hkv, P, page] — int8-pool scales
+    ks_buf=None,  # VMEM [2, ppb, page] f32
+    vs_buf=None,
+    s_sems=None,  # DMA [2, 2]
 ):
     """Initialize the online-softmax scratch and contract ``hbm_len``
     tokens of HBM pages into it, chain-prefetching block DMAs across grid
     programs. Shared by the read-only and fused kernels (their only
     difference here is how many trailing tokens live outside HBM:
-    ``min_length`` is 1 / 2 respectively)."""
+    ``min_length`` is 1 / 2 respectively). With ``scales_hbm`` the pages
+    are int8 and dequantization folds into the contractions: scores scale
+    by the per-token k-scale, probabilities by the v-scale — the int8
+    tiles feed the MXU directly, halving the block DMA bytes."""
     bk = page * pages_per_block
+    quantized = scales_hbm is not None
 
     def block_copies(bb, hh, ii, slot):
         off = bb * pages_per_seq + ii * pages_per_block
-        ck = _BlockCopy(kv_hbm, 0, layer, hh, k_buf.at[slot], sems.at[slot, 0],
-                        page_table_ref, off, pages_per_block)
-        cv = _BlockCopy(kv_hbm, 1, layer, hh, v_buf.at[slot], sems.at[slot, 1],
-                        page_table_ref, off, pages_per_block)
-        return ck, cv
+        copies = [
+            _BlockCopy(kv_hbm, 0, layer, hh, k_buf.at[slot], sems.at[slot, 0],
+                       page_table_ref, off, pages_per_block),
+            _BlockCopy(kv_hbm, 1, layer, hh, v_buf.at[slot], sems.at[slot, 1],
+                       page_table_ref, off, pages_per_block),
+        ]
+        if quantized:
+            copies.append(
+                _BlockCopy(scales_hbm, 0, layer, hh, ks_buf.at[slot],
+                           s_sems.at[slot, 0], page_table_ref, off,
+                           pages_per_block)
+            )
+            copies.append(
+                _BlockCopy(scales_hbm, 1, layer, hh, vs_buf.at[slot],
+                           s_sems.at[slot, 1], page_table_ref, off,
+                           pages_per_block)
+            )
+        return copies
 
     def next_indices(i):
         """Grid-order successor of block ``i`` of this (b, h) program,
@@ -162,26 +183,28 @@ def _run_block_loop(
 
         @pl.when(init_flag)
         def _cold_start():
-            ck, cv = block_copies(b, h, i, slot)
-            ck.start()
-            cv.start()
+            for c in block_copies(b, h, i, slot):
+                c.start()
 
         @pl.when(nb < batch_size)
         def _prefetch_next():
             nslot = jnp.where(slot == 0, 1, 0)
-            ck, cv = block_copies(nb, nh, ni, nslot)
-            ck.start()
-            cv.start()
+            for c in block_copies(nb, nh, ni, nslot):
+                c.start()
             buffer_index_ref[0] = nslot
 
-        ck, cv = block_copies(b, h, i, slot)
-        ck.wait()
+        cs = block_copies(b, h, i, slot)
+        cs[0].wait()
+        if quantized:
+            cs[2].wait()
         k = k_buf[slot].astype(jnp.float32).reshape(bk, -1)  # [bk, D]
         s = jax.lax.dot_general(  # [G, bk]
             q, k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quantized:
+            s = s * ks_buf[slot].reshape(bk)[None, :]
         pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(pos < hbm_len, s, _MASK)
 
@@ -194,7 +217,10 @@ def _run_block_loop(
         l_scr[...] = l_scr[...] * corr + l_blk
         m_scr[...] = m_new
 
-        cv.wait()
+        cs[1].wait()
+        if quantized:
+            cs[3].wait()
+            p = p * vs_buf[slot].reshape(bk)[None, :]
         v = v_buf[slot].astype(jnp.float32).reshape(bk, -1)  # [bk, D]
         pv = jax.lax.dot_general(  # [G, D]
             p, v,
@@ -214,25 +240,24 @@ def _kernel(
     layer_ref,  # SMEM [1] — which layer's pages to read
     buffer_index_ref,  # SMEM [1] — double-buffer slot, persists across programs
     init_flag_ref,  # SMEM [1] — 1 until the very first program cold-starts
-    # inputs
-    q_ref,  # VMEM [G, D] (block of [B, Hq, 1, D])
-    kv_hbm,  # ANY  [2, L, Hkv, P, page, D] — the whole pool, zero-copy
-    # outputs
-    o_ref,  # VMEM [G, D]
-    # scratch
-    m_scr,  # VMEM [G, D] fp32 — running max (lane-replicated)
-    l_scr,  # VMEM [G, D] fp32 — running denominator (lane-replicated)
-    acc_scr,  # VMEM [G, D] fp32 — unnormalized numerator
-    k_buf,  # VMEM [2, ppb, page, D]
-    v_buf,  # VMEM [2, ppb, page, D]
-    sems,  # DMA [2, 2]
-    *,
+    # then: inputs (q_ref, kv_hbm[, scales_hbm]), outputs (o_ref) and
+    # scratch — the quantized variant inserts the scale pool input and the
+    # scale staging buffers, so the tail is unpacked by flag.
+    *refs,
     page: int,
     pages_per_block: int,
     pages_per_seq: int,
     batch_size: int,
     num_kv_heads: int,
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, kv_hbm, scales_hbm, o_ref,
+         m_scr, l_scr, acc_scr, k_buf, v_buf, ks_buf, vs_buf, sems,
+         s_sems) = refs
+    else:
+        q_ref, kv_hbm, o_ref, m_scr, l_scr, acc_scr, k_buf, v_buf, sems = refs
+        scales_hbm = ks_buf = vs_buf = s_sems = None
     b, h = pl.program_id(0), pl.program_id(1)
     layer = layer_ref[0]
     length = lengths_ref[b]
@@ -253,6 +278,8 @@ def _kernel(
             page=page, pages_per_block=pages_per_block,
             pages_per_seq=pages_per_seq, batch_size=batch_size,
             num_kv_heads=num_kv_heads, min_length=1,
+            scales_hbm=scales_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
+            s_sems=s_sems,
         )
         o_ref[...] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
 
@@ -265,31 +292,35 @@ def _fused_kernel(
     layer_ref,  # SMEM [1]
     buffer_index_ref,  # SMEM [1]
     init_flag_ref,  # SMEM [1]
-    # inputs
-    q_ref,  # VMEM [G, D] (block of [B, Hq, 1, D])
-    k_new_ref,  # VMEM [1, D] (block of [B, Hkv, 1, D]) — this token's K
-    v_new_ref,  # VMEM [1, D]
-    kv_hbm,  # ANY [2, L, Hkv, P, page, D] — ALIASED input/output
-    # outputs
-    kv_out,  # ANY — same buffer as kv_hbm (input_output_aliases)
-    o_ref,  # VMEM [G, D]
-    # scratch
-    m_scr, l_scr, acc_scr,  # VMEM [G, D] fp32
-    k_buf, v_buf,  # VMEM [2, ppb, page, D]
-    row_scr,  # VMEM [2, page, D] staging for the page-window RMW writes
-    sems,  # DMA [2, 2]
-    w_sem,  # DMA () for the row writes
-    *,
+    # then: inputs (q, k_new, v_new, kv_hbm[, scales_hbm]), outputs
+    # (kv_out[, scales_out], o_ref) and scratch — unpacked by flag like
+    # ``_kernel``.
+    *refs,
     page: int,
     pages_per_block: int,
     pages_per_seq: int,
     batch_size: int,
     num_kv_heads: int,
+    quantized: bool,
 ):
     """Fused decode attention: write this token's K/V row into the pool
     (replacing the XLA scatter — the pool is aliased through the call, so
     the scan carry never copies) and attend over all ``length`` tokens,
-    the current one folded in from VMEM (see module docstring)."""
+    the current one folded in from VMEM (see module docstring). Quantized
+    pools quantize the incoming row IN-KERNEL (identically to
+    ``ops/quant.py``: symmetric amax/127 over head_dim, round-to-even)
+    and fold the current token DEQUANTIZED, so the attention output
+    matches exactly what any later read of the pool will see."""
+    if quantized:
+        (q_ref, k_new_ref, v_new_ref, kv_hbm, scales_hbm,
+         kv_out, scales_out, o_ref,
+         m_scr, l_scr, acc_scr, k_buf, v_buf, ks_buf, vs_buf,
+         row_scr, srow_scr, sems, s_sems, w_sem, sw_sem) = refs
+    else:
+        (q_ref, k_new_ref, v_new_ref, kv_hbm,
+         kv_out, o_ref,
+         m_scr, l_scr, acc_scr, k_buf, v_buf, row_scr, sems, w_sem) = refs
+        scales_hbm = scales_out = ks_buf = vs_buf = srow_scr = s_sems = None
     b, h = pl.program_id(0), pl.program_id(1)
     layer = layer_ref[0]
     length = lengths_ref[b]
@@ -310,6 +341,34 @@ def _fused_kernel(
     rv = pltpu.make_async_copy(page_window(1), row_scr.at[1], w_sem)
     wk = pltpu.make_async_copy(row_scr.at[0], page_window(0), w_sem)
     wv = pltpu.make_async_copy(row_scr.at[1], page_window(1), w_sem)
+    if quantized:
+        def scale_window(which):
+            return scales_out.at[which, layer, h, pg]  # [page] row
+
+        # Own semaphore: these RMWs overlap the (much larger) wk/wv page
+        # writes, and a shared semaphore would let a page write's
+        # completion satisfy a scale read's wait before the scale row has
+        # actually landed (a hardware-only race — interpret mode runs
+        # copies synchronously).
+        rks = pltpu.make_async_copy(scale_window(0), srow_scr.at[0], sw_sem)
+        rvs = pltpu.make_async_copy(scale_window(1), srow_scr.at[1], sw_sem)
+        wks = pltpu.make_async_copy(srow_scr.at[0], scale_window(0), sw_sem)
+        wvs = pltpu.make_async_copy(srow_scr.at[1], scale_window(1), sw_sem)
+
+    # Current token, possibly quantize→dequantize so attention sees the
+    # pool's eventual contents bit-exactly.
+    k_cur = k_new_ref[...].astype(jnp.float32)  # [1, D]
+    v_cur = v_new_ref[...].astype(jnp.float32)
+    if quantized:
+        from radixmesh_tpu.ops.quant import quantize_kv
+
+        # The SAME quantizer the pool's host write path uses — attention
+        # must see the pool's eventual contents bit-exactly.
+        k_q, k_sc = quantize_kv(k_cur, axis=-1)  # int8 [1, D], f32 [1]
+        v_q, v_sc = quantize_kv(v_cur, axis=-1)
+        k_sc, v_sc = k_sc[0], v_sc[0]
+        k_cur = k_q.astype(jnp.float32) * k_sc
+        v_cur = v_q.astype(jnp.float32) * v_sc
 
     o_ref[...] = jnp.zeros_like(o_ref)  # deterministic for length==0 rows
 
@@ -320,14 +379,26 @@ def _fused_kernel(
         rk.wait()
         rv.wait()
         mask = jax.lax.broadcasted_iota(jnp.int32, row_scr.shape[1:], 0) == off
-        row_scr[0] = jnp.where(
-            mask, jnp.broadcast_to(k_new_ref[...], row_scr.shape[1:]), row_scr[0]
-        )
-        row_scr[1] = jnp.where(
-            mask, jnp.broadcast_to(v_new_ref[...], row_scr.shape[1:]), row_scr[1]
-        )
+        if quantized:
+            new_k_row = jnp.broadcast_to(k_q, row_scr.shape[1:])
+            new_v_row = jnp.broadcast_to(v_q, row_scr.shape[1:])
+        else:
+            new_k_row = jnp.broadcast_to(k_new_ref[...], row_scr.shape[1:])
+            new_v_row = jnp.broadcast_to(v_new_ref[...], row_scr.shape[1:])
+        row_scr[0] = jnp.where(mask, new_k_row, row_scr[0])
+        row_scr[1] = jnp.where(mask, new_v_row, row_scr[1])
         wk.start()
         wv.start()
+        if quantized:
+            rks.start()
+            rvs.start()
+            rks.wait()
+            rvs.wait()
+            smask = jax.lax.broadcasted_iota(jnp.int32, srow_scr.shape[1:], 0) == off
+            srow_scr[0] = jnp.where(smask, k_sc, srow_scr[0])
+            srow_scr[1] = jnp.where(smask, v_sc, srow_scr[1])
+            wks.start()
+            wvs.start()
 
     @pl.when(length > 0)
     def _program():
@@ -341,11 +412,11 @@ def _fused_kernel(
             page=page, pages_per_block=pages_per_block,
             pages_per_seq=pages_per_seq, batch_size=batch_size,
             num_kv_heads=num_kv_heads, min_length=2,
+            scales_hbm=scales_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
+            s_sems=s_sems,
         )
         # Fold in the current token from VMEM (one more online-softmax
         # step with a single-position block).
-        k_cur = k_new_ref[...].astype(jnp.float32)  # [1, D]
-        v_cur = v_new_ref[...].astype(jnp.float32)
         s_cur = jax.lax.dot_general(  # [G, 1]
             q, k_cur,
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -360,6 +431,9 @@ def _fused_kernel(
         o_ref[...] = (acc_fin / l_fin).astype(o_ref.dtype)
         wk.wait()
         wv.wait()
+        if quantized:
+            wks.wait()
+            wvs.wait()
 
 
 def _block_geometry(page_table, page: int, pages_per_block: int | None):
@@ -388,16 +462,20 @@ def paged_attention_pool_kernel(
     layer: jnp.ndarray | int,  # which layer's pages to attend over
     pages_per_block: int | None = None,
     interpret: bool = False,
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] (int8 pool)
 ) -> jnp.ndarray:
     """Read-only entry: the whole (multi-layer) pool rides in HBM untouched
     and the kernel DMAs only ``layer``'s pages — so a scan-over-layers
     decode step costs O(context pages) HBM traffic per layer, never a
-    materialized per-layer slice (which would be O(pool size))."""
+    materialized per-layer slice (which would be O(pool size)). With
+    ``kv_scales`` the pool is int8 (page DMA bytes halve) and scales ride
+    small per-page side copies (``[page]`` f32 rows)."""
     B, Hq, D = q.shape
     _, _, Hkv, _, page, _ = kv_pages.shape
     if Hq % Hkv:
         raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
     G = Hq // Hkv
+    quantized = kv_scales is not None
     page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
 
     scale = 1.0 / (D ** 0.5)
@@ -413,24 +491,43 @@ def paged_attention_pool_kernel(
         pages_per_seq=padded,
         batch_size=B,
         num_kv_heads=Hkv,
+        quantized=quantized,
     )
+    in_specs = [q_spec, pl.BlockSpec(memory_space=pl.ANY)]
+    scratch = [
+        pltpu.VMEM((G, D), jnp.float32),
+        pltpu.VMEM((G, D), jnp.float32),
+        pltpu.VMEM((G, D), jnp.float32),
+        pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+        pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+    ]
+    if quantized:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        scratch += [
+            pltpu.VMEM((2, ppb, page), jnp.float32),
+            pltpu.VMEM((2, ppb, page), jnp.float32),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
+    if quantized:
+        scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(B, Hkv),
-        in_specs=[
-            q_spec,
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=q_spec,
-        scratch_shapes=[
-            pltpu.VMEM((G, D), jnp.float32),
-            pltpu.VMEM((G, D), jnp.float32),
-            pltpu.VMEM((G, D), jnp.float32),
-            pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
-            pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
+    args = [
+        jnp.asarray(lengths, dtype=jnp.int32),
+        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
+        jnp.zeros((1,), jnp.int32),  # double-buffer slot
+        jnp.ones((1,), jnp.int32),  # cold-start flag
+        q4,
+        kv_pages,
+    ]
+    if quantized:
+        args.append(kv_scales)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -439,15 +536,7 @@ def paged_attention_pool_kernel(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
-    )(
-        jnp.asarray(lengths, dtype=jnp.int32),
-        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
-        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
-        jnp.zeros((1,), jnp.int32),  # double-buffer slot
-        jnp.ones((1,), jnp.int32),  # cold-start flag
-        q4,
-        kv_pages,
-    )
+    )(*args)
     return out.reshape(B, Hq, D).astype(q.dtype)
 
 
@@ -465,21 +554,27 @@ def paged_decode_fused_kernel(
     layer: jnp.ndarray | int,
     pages_per_block: int | None = None,
     interpret: bool = False,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] — aliased
+):
     """Fused decode step attention: returns ``(attn_out [B, Hq, D],
-    kv_pages)`` where ``kv_pages`` is the SAME buffer updated in place
-    (the caller threads it as a scan carry with zero copies)."""
+    kv_pages)`` — plus the updated ``kv_scales`` when quantized — where
+    the pool buffers are the SAME memory updated in place (the caller
+    threads them as scan carries with zero copies)."""
     B, Hq, D = q.shape
     _, _, Hkv, _, page, _ = kv_pages.shape
     if Hq % Hkv:
         raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
     G = Hq // Hkv
+    quantized = kv_scales is not None
     page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
 
     scale = 1.0 / (D ** 0.5)
     q4 = (q.astype(jnp.float32) * scale).reshape(B, Hq, 1, D)
     q_spec = pl.BlockSpec((None, G, None, D), lambda b, h, *_: (b, h, 0, 0))
     kv_new_spec = pl.BlockSpec((None, None, 1, D), lambda b, h, *_: (b, h, 0, 0))
+    # Quantized pools receive the raw (f32) row and quantize in-kernel;
+    # bf16 pools store the row as-is.
+    new_dtype = jnp.float32 if quantized else kv_pages.dtype
 
     kernel = functools.partial(
         _fused_kernel,
@@ -488,46 +583,58 @@ def paged_decode_fused_kernel(
         pages_per_seq=padded,
         batch_size=B,
         num_kv_heads=Hkv,
+        quantized=quantized,
     )
+    in_specs = [
+        q_spec,
+        kv_new_spec,
+        kv_new_spec,
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pl.ANY)]
+    out_shape = [jax.ShapeDtypeStruct(kv_pages.shape, kv_pages.dtype)]
+    # Flat arg order: 6 scalar-prefetch args, then q (6), k_new (7),
+    # v_new (8), kv_pages (9) → alias kv_pages onto output 0 (and the
+    # scale pool (10) onto output 1 when quantized).
+    aliases = {9: 0}
+    if quantized:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        out_shape.append(jax.ShapeDtypeStruct(kv_scales.shape, kv_scales.dtype))
+        aliases[10] = 1
+    out_specs.append(q_spec)
+    out_shape.append(jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32))
+
+    scratch = [
+        pltpu.VMEM((G, D), jnp.float32),
+        pltpu.VMEM((G, D), jnp.float32),
+        pltpu.VMEM((G, D), jnp.float32),
+        pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+        pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((2, ppb, page), jnp.float32),
+            pltpu.VMEM((2, ppb, page), jnp.float32),
+        ]
+    scratch.append(pltpu.VMEM((2, page, D), kv_pages.dtype))
+    if quantized:
+        scratch.append(pltpu.VMEM((2, page), jnp.float32))
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
+    if quantized:
+        scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
+    scratch.append(pltpu.SemaphoreType.DMA)
+    if quantized:
+        scratch.append(pltpu.SemaphoreType.DMA)  # scale-row RMW (sw_sem)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
         grid=(B, Hkv),
-        in_specs=[
-            q_spec,
-            kv_new_spec,
-            kv_new_spec,
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            q_spec,
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((G, D), jnp.float32),
-            pltpu.VMEM((G, D), jnp.float32),
-            pltpu.VMEM((G, D), jnp.float32),
-            pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
-            pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
-            pltpu.VMEM((2, page, D), kv_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.SemaphoreType.DMA,
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
     )
-    kv_out, out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct(kv_pages.shape, kv_pages.dtype),
-            jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32),
-        ],
-        # Flat arg order: 6 scalar-prefetch args, then q (6), k_new (7),
-        # v_new (8), kv_pages (9) → alias kv_pages onto output 0.
-        input_output_aliases={9: 0},
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")
-        ),
-        interpret=interpret,
-    )(
+    args = [
         jnp.asarray(lengths, dtype=jnp.int32),
         jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
         jnp.asarray(slots, dtype=jnp.int32),
@@ -535,10 +642,26 @@ def paged_decode_fused_kernel(
         jnp.zeros((1,), jnp.int32),  # double-buffer slot
         jnp.ones((1,), jnp.int32),  # cold-start flag
         q4,
-        k_new.astype(kv_pages.dtype).reshape(B, Hkv, 1, D),
-        v_new.astype(kv_pages.dtype).reshape(B, Hkv, 1, D),
+        k_new.astype(new_dtype).reshape(B, Hkv, 1, D),
+        v_new.astype(new_dtype).reshape(B, Hkv, 1, D),
         kv_pages,
-    )
+    ]
+    if quantized:
+        args.append(kv_scales)
+    res = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*args)
+    if quantized:
+        kv_out, scales_out, out = res
+        return out.reshape(B, Hq, D).astype(q.dtype), kv_out, scales_out
+    kv_out, out = res
     return out.reshape(B, Hq, D).astype(q.dtype), kv_out
 
 

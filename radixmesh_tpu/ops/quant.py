@@ -1,0 +1,50 @@
+"""Int8 KV-cache quantization helpers.
+
+Decode attention is HBM-bandwidth-bound: each step streams the whole
+context's K/V per layer, so storing the pool in int8 with per-token,
+per-head scales halves that traffic (SURVEY §6: HBM bandwidth is the
+usual TPU bottleneck). The reference has no analogue — its "KV" is only
+index tensors (``radix_mesh.py:23``) — this is a TPU-first extension of
+the pool the same way the Pallas kernels are.
+
+Scheme: symmetric per-(token, head) scaling over the head_dim axis —
+``scale = amax/127``, ``q = round(x/scale)`` — the granularity published
+int8-KV work uses to keep quality: one outlier token never inflates its
+neighbours' quantization step. Dequantization folds into attention as
+vector math (scores scale by ``k_scale``, probabilities by ``v_scale``
+before the PV contraction), so the int8 tiles feed the MXU directly and
+no dequantized copy is ever materialized.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["quantize_kv", "dequantize_kv", "KV_QUANT_DTYPES"]
+
+KV_QUANT_DTYPES = {"int8": jnp.int8}
+
+# Zero vectors quantize against this floor instead of dividing by zero;
+# their int8 payload is all-zero either way.
+_EPS = 1e-8
+
+
+def quantize_kv(x: jnp.ndarray, axis: int = -1):
+    """Symmetric int8 quantization along ``axis`` (the head_dim axis).
+
+    Returns ``(q, scale)`` with ``q`` int8 shaped like ``x`` and ``scale``
+    float32 shaped like ``x`` minus ``axis``; ``x ≈ q * scale``.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis)
+    scale = jnp.maximum(amax, _EPS) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / jnp.expand_dims(scale, axis)),
+        -127,
+        127,
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, axis: int = -1):
+    """Inverse of :func:`quantize_kv` (f32)."""
+    return q.astype(jnp.float32) * jnp.expand_dims(scale, axis)
