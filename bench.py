@@ -421,6 +421,23 @@ def main() -> None:
         return ck, cv
     sec_dense = _time_loop(run_dense, iters)
     log(f"dense decode: {sec_dense*1e3:.2f} ms/step, {batch/sec_dense:.1f} tok/s")
+    del ck0, cv0, dense_step
+
+    # --- int8-quantized paged path (halved KV HBM traffic) ---------------
+    kv_pool_q = jnp.zeros(
+        (2, cfg.n_layers, cfg.n_kv_heads, num_slots, cfg.head_dim), jnp.int8)
+    kv_scale_q = jnp.zeros(
+        (2, cfg.n_layers, cfg.n_kv_heads, num_slots), jnp.float32)
+
+    def run_quant(state, i):
+        pool, scale = (kv_pool_q, kv_scale_q) if state is None else state
+        logits, pool, scale = decode_step(
+            params, cfg, token_iters[i], pool, slots, page_table, lengths,
+            page_size, kv_scale=scale)
+        return pool, scale
+    sec_quant = _time_loop(run_quant, iters)
+    log(f"int8 paged decode: {sec_quant*1e3:.2f} ms/step, "
+        f"{batch/sec_quant:.1f} tok/s ({sec_paged/sec_quant:.2f}x vs bf16)")
 
     roof = _roofline(cfg, batch, ctx, sec_paged)
     log(
@@ -436,6 +453,10 @@ def main() -> None:
         "value": round(tok_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(sec_dense / sec_paged, 3),
+        "int8": {
+            "tok_s": round(batch / sec_quant, 1),
+            "vs_bf16": round(sec_paged / sec_quant, 3),
+        },
         "roofline": roof,
         "north_star": north,
     }))

@@ -281,16 +281,6 @@ class PagedKVPool:
         shape = (self.num_kv_heads, self.num_pages, self.page_size, self.head_dim)
         return self.kv[0, layer].reshape(shape), self.kv[1, layer].reshape(shape)
 
-    def scales_pages(self) -> jax.Array | None:
-        """``[2, L, Hkv, num_pages, page]`` pure-reshape view of the scale
-        pool (``None`` for unquantized pools) — the attention ops' scale
-        input layout."""
-        if self.kv_scale is None:
-            return None
-        return self.kv_scale.reshape(
-            2, self.num_layers, self.num_kv_heads, self.num_pages, self.page_size
-        )
-
     def gather(self, slots: np.ndarray | jax.Array) -> jax.Array:
         """Gather ``[2, L, n, kv_heads, head_dim]`` for the given slots,
         dequantized for quantized pools (debug/test path and the dense-

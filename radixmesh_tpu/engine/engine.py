@@ -837,6 +837,16 @@ class Engine:
     # decode
     # ------------------------------------------------------------------
 
+    def _commit_pool_update(self, res):
+        """Unpack a model call's ``(out, kv_pool[, kv_scale])`` result:
+        store the updated pool buffers and return the leading value (the
+        quantized pool threads its scale array through every call)."""
+        if self.pool.quant is not None:
+            out, self.pool.kv, self.pool.kv_scale = res
+        else:
+            out, self.pool.kv = res
+        return out
+
     def _decode_once(self) -> None:
         g = self.spec_decode_tokens
         if g > 0 and self._spec_ok(g):
@@ -896,10 +906,7 @@ class Engine:
             mesh=self.device_mesh,
             kv_scale=self.pool.kv_scale,
         )
-        if self.pool.quant is not None:
-            logits, self.pool.kv, self.pool.kv_scale = res
-        else:
-            logits, self.pool.kv = res
+        logits = self._commit_pool_update(res)
         sampled = np.asarray(
             sample_tokens(
                 logits, key, temperature=jnp.asarray(self._temps),
@@ -962,10 +969,7 @@ class Engine:
             mesh=self.device_mesh,
             kv_scale=self.pool.kv_scale,
         )
-        if self.pool.quant is not None:
-            sampled, self.pool.kv, self.pool.kv_scale = res
-        else:
-            sampled, self.pool.kv = res
+        sampled = self._commit_pool_update(res)
         sampled = np.asarray(sampled)  # [k, B] — the ONE round trip
         self.stats.decode_steps += k
         elapsed = time.monotonic() - step_t0
@@ -1113,10 +1117,7 @@ class Engine:
             kv_block_pages=kv_block,
             kv_scale=self.pool.kv_scale,
         )
-        if self.pool.quant is not None:
-            logits, self.pool.kv, self.pool.kv_scale = res
-        else:
-            logits, self.pool.kv = res
+        logits = self._commit_pool_update(res)
         greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [B, C] one sync
         self.stats.decode_steps += 1
 
