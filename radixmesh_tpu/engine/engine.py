@@ -118,12 +118,6 @@ class Engine:
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
-        if device_mesh is not None and (
-            kv_quant is not None or (pool is not None and pool.quant is not None)
-        ):
-            raise NotImplementedError(
-                "quantized KV + tensor-parallel serving not wired yet"
-            )
         self.cfg = cfg
         # Multi-chip serving (SURVEY §7 stage 7): tp shards heads/ffn/vocab
         # across the device mesh; the SAME scheduler/tree/publish code runs
@@ -450,10 +444,20 @@ class Engine:
                 if i == len(group) or bucket(group[i]) != bucket(group[start]):
                     sub = group[start:i]
                     start = i
-                    if len(sub) == 1 and self._sp_capable(sub[0]):
+                    # Quantized pools always prefill through the chunked
+                    # paged path: it attends the already-quantized K/V
+                    # (see prefill_chunk_paged), so prefill-time logits
+                    # match every later read of the published prefix. The
+                    # dense/sp paths attend full-precision and only
+                    # quantize at pool.write — fine for bf16 pools, an
+                    # invariant break for int8.
+                    if self.pool.quant is None and (
+                        len(sub) == 1 and self._sp_capable(sub[0])
+                    ):
                         pending = [self._prefill_sp(*sub[0])]
                     elif (
-                        len(sub) == 1
+                        self.pool.quant is None
+                        and len(sub) == 1
                         and len(sub[0][0].prompt) - sub[0][2]
                         <= self.long_prefill_threshold
                     ):
@@ -742,10 +746,7 @@ class Engine:
                 kv_block_pages=kv_block,
                 kv_scale=self.pool.kv_scale,
             )
-            if self.pool.quant is not None:
-                logits, self.pool.kv, self.pool.kv_scale = res
-            else:
-                logits, self.pool.kv = res
+            logits = self._commit_pool_update(res)
             for i in range(N):
                 if lastpos[i] >= 0:
                     final_logits[i] = logits[i, lastpos[i]]

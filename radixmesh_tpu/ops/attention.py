@@ -337,6 +337,7 @@ def paged_attention_pool_kernel_sharded(
     mesh,
     tp_axis: str = "tp",
     interpret: bool = False,
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] — Hkv sharded
 ) -> jnp.ndarray:
     """Tensor-parallel wrapper for the Pallas pool kernel: ``shard_map``
     over the tp mesh axis so each chip runs the kernel on its local head
@@ -350,24 +351,33 @@ def paged_attention_pool_kernel_sharded(
     from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
 
     layer_arr = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
+    in_specs = [
+        P(None, tp_axis, None),
+        P(None, None, tp_axis, None, None, None),
+        P(None, None),
+        P(None),
+        P(None),
+    ]
+    args = [q, kv_pages, page_table, lengths, layer_arr]
+    if kv_scales is not None:
+        # Per-(token, head) scales shard with their heads.
+        in_specs.append(P(None, None, tp_axis, None, None))
+        args.append(kv_scales)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            P(None, tp_axis, None),
-            P(None, None, tp_axis, None, None, None),
-            P(None, None),
-            P(None),
-            P(None),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, tp_axis, None),
         check_vma=False,  # pallas_call outputs carry no vma annotation
     )
-    def local(q, kv, pt, ln, l):
-        return paged_attention_pool_kernel(q, kv, pt, ln, l[0], interpret=interpret)
+    def local(q, kv, pt, ln, l, *maybe_scales):
+        sc = maybe_scales[0] if maybe_scales else None
+        return paged_attention_pool_kernel(
+            q, kv, pt, ln, l[0], interpret=interpret, kv_scales=sc
+        )
 
-    return local(q, kv_pages, page_table, lengths, layer_arr)
+    return local(*args)
 
 
 def paged_attention_pool(
@@ -390,12 +400,9 @@ def paged_attention_pool(
         use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
     if use_kernel:
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
-            if kv_scales is not None:
-                raise NotImplementedError(
-                    "quantized KV + tensor-parallel kernel not wired yet"
-                )
             return paged_attention_pool_kernel_sharded(
-                q, kv_pages, page_table, lengths, layer, mesh
+                q, kv_pages, page_table, lengths, layer, mesh,
+                kv_scales=kv_scales,
             )
         from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
 
@@ -423,6 +430,7 @@ def paged_decode_fused_sharded(
     mesh,
     tp_axis: str = "tp",
     interpret: bool = False,
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] — Hkv sharded
 ):
     """Tensor-parallel fused decode kernel: each chip writes + attends its
     local kv-head shard (heads are embarrassingly parallel; the pool's
@@ -433,32 +441,45 @@ def paged_decode_fused_sharded(
     from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
 
     layer_arr = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
+    in_specs = [
+        P(None, tp_axis, None),
+        P(None, tp_axis, None),
+        P(None, tp_axis, None),
+        P(None, None, tp_axis, None, None, None),
+        P(None),
+        P(None, None),
+        P(None),
+        P(None),
+    ]
+    out_specs = [
+        P(None, tp_axis, None),
+        P(None, None, tp_axis, None, None, None),
+    ]
+    args = [q, k_new, v_new, kv_pages, slots, page_table, lengths, layer_arr]
+    if kv_scales is not None:
+        in_specs.append(P(None, None, tp_axis, None, None))
+        out_specs.append(P(None, None, tp_axis, None, None))
+        args.append(kv_scales)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            P(None, tp_axis, None),
-            P(None, tp_axis, None),
-            P(None, tp_axis, None),
-            P(None, None, tp_axis, None, None, None),
-            P(None),
-            P(None, None),
-            P(None),
-            P(None),
-        ),
-        out_specs=(
-            P(None, tp_axis, None),
-            P(None, None, tp_axis, None, None, None),
-        ),
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs),
         check_vma=False,
     )
-    def local(q, kn, vn, kv, sl, pt, ln, l):
+    def local(q, kn, vn, kv, sl, pt, ln, l, *maybe_scales):
+        if maybe_scales:
+            out, kv2, sc2 = paged_decode_fused_kernel(
+                q, kn, vn, kv, sl, pt, ln, l[0], interpret=interpret,
+                kv_scales=maybe_scales[0],
+            )
+            return out, kv2, sc2
         return paged_decode_fused_kernel(
             q, kn, vn, kv, sl, pt, ln, l[0], interpret=interpret
         )
 
-    return local(q, k_new, v_new, kv_pages, slots, page_table, lengths, layer_arr)
+    return local(*args)
 
 
 def paged_decode_attention(
@@ -487,13 +508,9 @@ def paged_decode_attention(
         use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
     if use_kernel:
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
-            if kv_scales is not None:
-                raise NotImplementedError(
-                    "quantized KV + tensor-parallel kernel not wired yet"
-                )
             return paged_decode_fused_sharded(
                 q, k_new, v_new, kv_pages, slots, page_table, lengths, layer,
-                mesh,
+                mesh, kv_scales=kv_scales,
             )
         from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
 
