@@ -132,6 +132,16 @@ class MeshCache:
         # Elastic membership (policy/topology.py): every TTL and GC
         # unanimity count derives from the CURRENT view, not static config.
         self.view = TopologyView.initial(cfg)
+        # Rate limit for tick-triggered view re-announcements (see the
+        # TICK receive branch): at most one per tick interval per node.
+        self._last_view_gossip = 0.0
+        # Inbound-silence tracking for the membership housekeeper: a ring
+        # node that hears NOTHING for a failure timeout may have been
+        # excluded from a view it never received (reborn after its old
+        # rank was declared dead — nobody routes to it, so no message can
+        # tell it). It re-asserts itself with a JOIN.
+        self._last_rx = time.monotonic()
+        self._last_self_join = 0.0
         self._succ_rank: int | None = None
         self._pending_retarget: str | None = None
         self._retarget_flag = threading.Event()
@@ -230,6 +240,10 @@ class MeshCache:
         # Mark started before spawning threads: the ticker's first tick must
         # not be dropped by the _started gate in _send_bytes.
         self._started = True
+        # Silence is only meaningful once the node participates in the
+        # ring; counting the construct-to-start gap would fire a spurious
+        # housekeeper JOIN after a slow model load.
+        self._last_rx = time.monotonic()
         if self.sync.can_send(self.cfg):
             # Announce (re)join: on a cold cluster boot everyone is already
             # in everyone's initial view and this is a no-op lap; after a
@@ -251,6 +265,11 @@ class MeshCache:
             self._threads.append(t)
         if self.role is not NodeRole.ROUTER:
             t = threading.Thread(target=self._gc_loop, daemon=True, name="mesh-gc")
+            t.start()
+            self._threads.append(t)
+            t = threading.Thread(
+                target=self._housekeeper, daemon=True, name="mesh-housekeeper"
+            )
             t.start()
             self._threads.append(t)
         return self
@@ -433,6 +452,7 @@ class MeshCache:
         # apply behind it, inflating p99 for operators alerting on lag.
         if op.ts and op.origin_rank != self.rank:
             self._m_lag.observe(max(0.0, time.time() - op.ts))
+        self._last_rx = time.monotonic()
         with self._lock:
             op.ttl -= 1
             if op.op_type is OplogType.TICK:
@@ -441,6 +461,7 @@ class MeshCache:
                 self.tick_counts[op.origin_rank] = (
                     self.tick_counts.get(op.origin_rank, 0) + 1
                 )
+                self._gossip_view_from_tick(op)
                 if op.ttl > 0:
                     self._forward(op)
                 return
@@ -961,17 +982,78 @@ class MeshCache:
     # heartbeat / startup barrier
     # ------------------------------------------------------------------
 
+    def _gossip_view_from_tick(self, op: Oplog) -> None:
+        """Anti-entropy on the heartbeat (caller holds the lock): adopt a
+        newer piggybacked view; when the ticker's view is STALE, re-announce
+        ours so the epoch difference reaches it within a lap (rate-limited —
+        every node on the ring sees the same stale tick)."""
+        if op.value is None or len(op.value) == 0:
+            return
+        try:
+            view = decode_view(op.value)
+        except ValueError:
+            return
+        if view.epoch >= self.view.epoch:
+            self._adopt_view(view)
+        else:
+            now = time.monotonic()
+            if now - self._last_view_gossip >= self.cfg.tick_interval_s:
+                self._last_view_gossip = now
+                self._announce_view(self.view)
+
+    def _housekeeper(self) -> None:
+        """Membership self-assertion (ring nodes only): if no inbound
+        message has arrived for ``failure_timeout_s``, broadcast a JOIN.
+        Covers the reincarnation race the one-shot startup JOIN misses: a
+        node reborn while the ring still held the FULL view sends its
+        startup JOIN as a no-op, and when the older exclusion view later
+        spreads by gossip, the re-formed ring routes nothing to this node
+        — silence is the only observable signal it gets. A healthy quiet
+        ring still carries ticks, so JOINs fire only when genuinely cut
+        off (or when the tick origin itself is down, where the extra JOIN
+        lap doubles as a poor man's heartbeat)."""
+        timeout = self.cfg.failure_timeout_s
+        while not self._stop.is_set():
+            self._stop.wait(self.cfg.tick_interval_s)
+            if self._stop.is_set():
+                return
+            now = time.monotonic()
+            if now - self._last_rx < timeout or now - self._last_self_join < timeout:
+                continue
+            self._last_self_join = now
+            self.log.warning(
+                "no inbound traffic for %.1fs — re-asserting ring membership",
+                now - self._last_rx,
+            )
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.JOIN,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self._data_ttl(),
+                )
+            )
+
     def _ticker(self) -> None:
         """Periodic ring tick (reference ``radix_mesh.py:118-133``). The
         first tick fires immediately so startup isn't gated on the
-        interval."""
+        interval. Ticks carry the originator's topology view: views are
+        otherwise only announced ON CHANGE, and a storm that crashes and
+        reincarnates most of the ring can leave fresh epoch-0 nodes and a
+        higher-epoch survivor with no changes left to announce — a
+        permanent membership split (found by tests/test_failover_storm.py
+        seed 0). The piggybacked view is the anti-entropy channel that
+        reconciles it."""
         while not self._stop.is_set():
+            with self._lock:
+                view_bytes = encode_view(self.view)
             self._broadcast(
                 Oplog(
                     op_type=OplogType.TICK,
                     origin_rank=self.rank,
                     logic_id=self._logic_op.next(),
                     ttl=self._tick_ttl(),
+                    value=view_bytes,
                 )
             )
             self._stop.wait(self.cfg.tick_interval_s)

@@ -469,14 +469,11 @@ def paged_decode_fused_sharded(
         check_vma=False,
     )
     def local(q, kn, vn, kv, sl, pt, ln, l, *maybe_scales):
-        if maybe_scales:
-            out, kv2, sc2 = paged_decode_fused_kernel(
-                q, kn, vn, kv, sl, pt, ln, l[0], interpret=interpret,
-                kv_scales=maybe_scales[0],
-            )
-            return out, kv2, sc2
+        sc = maybe_scales[0] if maybe_scales else None
+        # Return arity (2- vs 3-tuple) already follows kv_scales, matching
+        # the conditional out_specs.
         return paged_decode_fused_kernel(
-            q, kn, vn, kv, sl, pt, ln, l[0], interpret=interpret
+            q, kn, vn, kv, sl, pt, ln, l[0], interpret=interpret, kv_scales=sc
         )
 
     return local(*args)
