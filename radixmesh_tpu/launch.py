@@ -184,6 +184,37 @@ def _run_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def _run_multihost_dryrun(args: argparse.Namespace) -> int:
+    """One sharded train step with every process's chips in one mesh:
+    the same pjit program as the single-host dryrun, with XLA emitting
+    the cross-host collectives (SURVEY §5 'distributed communication
+    backend' — compute plane)."""
+    from radixmesh_tpu.parallel.multihost import global_mesh, init_multihost
+
+    info = init_multihost(
+        args.coordinator, args.num_processes, args.process_id,
+        local_device_count=args.local_devices,
+    )
+    import math
+
+    from radixmesh_tpu.parallel.sharding import MeshPlan
+    from radixmesh_tpu.parallel.train import run_dryrun_train_step
+
+    plan = None
+    if args.mesh:
+        dp, sp, tp = (int(x) for x in args.mesh.split(","))
+        plan = MeshPlan(dp=dp, sp=sp, tp=tp)
+    mesh = global_mesh(plan)
+    loss = run_dryrun_train_step(mesh)
+    print(
+        f"multihost-dryrun: proc {info.process_index}/{info.process_count} "
+        f"devices {info.local_devices} local / {info.global_devices} global "
+        f"mesh={dict(mesh.shape)} loss={loss:.4f}",
+        flush=True,
+    )
+    return 0 if math.isfinite(loss) else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="radixmesh-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -222,6 +253,24 @@ def main(argv: list[str] | None = None) -> int:
         "and verify them in one chunked pass (greedy rows only)",
     )
     serve.set_defaults(fn=_run_serve)
+
+    mh = sub.add_parser(
+        "multihost-dryrun",
+        help="join a jax.distributed job and run ONE sharded train step "
+        "over the global (cross-host) mesh — the multi-host compute proof",
+    )
+    mh.add_argument("--coordinator", required=True, help="host:port of process 0")
+    mh.add_argument("--num-processes", type=int, required=True)
+    mh.add_argument("--process-id", type=int, required=True)
+    mh.add_argument(
+        "--local-devices", type=int, default=None,
+        help="force N virtual CPU devices per process (rehearsal mode)",
+    )
+    mh.add_argument(
+        "--mesh", default=None, metavar="DP,SP,TP",
+        help="explicit global mesh plan (default: host-aligned auto)",
+    )
+    mh.set_defaults(fn=_run_multihost_dryrun)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -115,3 +115,31 @@ def make_train_step(
         return TrainState(params, opt_state, state.step + 1), loss
 
     return step
+
+
+def run_dryrun_train_step(mesh) -> float:
+    """ONE sharded train step on tiny shapes over ``mesh`` — the shared
+    body of the single-host multichip dryrun (``__graft_entry__``) and the
+    multi-host dryrun (``launch.py multihost-dryrun``); the two must stay
+    the same program so identical meshes provably give identical losses
+    across process topologies (tests/test_multihost.py pins that)."""
+    import numpy as np
+    import optax
+
+    cfg = ModelConfig.tiny()
+    tp = mesh.shape["tp"]
+    # tiny() has 2 kv heads; wider tp needs every shard non-empty.
+    cfg = cfg.replace(
+        n_heads=max(4, tp), n_kv_heads=max(2, tp), intermediate=max(256, 2 * tp)
+    )
+    optimizer = optax.adamw(1e-3)
+    state = make_train_state(cfg, jax.random.PRNGKey(0), mesh, optimizer)
+    step = make_train_step(cfg, mesh, optimizer)
+    batch = max(2, mesh.shape["dp"] * 2)
+    seq = max(16, mesh.shape["sp"] * 8) + 1
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+        dtype=jnp.int32,
+    )
+    state, loss = step(state, tokens)
+    return float(jax.block_until_ready(loss))

@@ -112,15 +112,18 @@ def test_storm_membership_and_replication_survive(seed):
             c.rejoin(addr)
         everyone = c.alive_ring() + [c.router]
         want_ranks = {n.rank for n in c.alive_ring()}
-        assert wait_for(
-            lambda: all(
+        def views_converged():
+            # Membership AND a single common epoch: an equal-epoch merge
+            # bumps one node first and its announcement is in flight for a
+            # moment, so both must be inside the wait.
+            return all(
                 {r for r in range(5) if n.view.contains(r)} == want_ranks
                 for n in everyone
-            ),
-            timeout=20,
-        ), [(n.rank, n.view) for n in everyone]
-        epochs = {n.view.epoch for n in everyone}
-        assert len(epochs) == 1, f"views converged to different epochs {epochs}"
+            ) and len({n.view.epoch for n in everyone}) == 1
+
+        assert wait_for(views_converged, timeout=20), [
+            (n.rank, n.view) for n in everyone
+        ]
 
         # The re-formed ring replicates: one fresh insert reaches every
         # ring node and the router attributes it to the writer.
