@@ -50,7 +50,7 @@ from radixmesh_tpu.models.llama import (
     prefill_forward,
 )
 from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
-from radixmesh_tpu.ops.sampling import sample_tokens
+from radixmesh_tpu.ops.sampling import sample_tokens, spec_verify_sample
 from radixmesh_tpu.utils.logging import get_logger
 
 # Per-process engine sequence: disaggregated harnesses run a prefill engine
@@ -989,13 +989,14 @@ class Engine:
                     break  # finished mid-launch: surplus tokens discarded
 
     def _spec_ok(self, g: int) -> bool:
-        """Speculative verification is safe when every active row decodes
-        greedily (acceptance compares against argmax; stochastic rows
-        would need rejection sampling) and has page-table headroom for the
-        γ+1 verify positions. Like the fused path, plain steps are
-        preferred while requests wait for admission, and rows within one
-        token of their output budget decline (the verify launch's surplus
-        would be discarded — the same bubble ``_multi_step_ok`` avoids)."""
+        """Speculative verification needs page-table headroom for the γ+1
+        verify positions on every active row. Stochastic rows verify by
+        exact rejection sampling (``ops/sampling.py::spec_verify_sample``),
+        so temperature does not disable the path. Like the fused path,
+        plain steps are preferred while requests wait for admission, and
+        rows within one token of their output budget decline (the verify
+        launch's surplus would be discarded — the same bubble
+        ``_multi_step_ok`` avoids)."""
         if self.waiting:
             return False
         any_active = False
@@ -1003,8 +1004,6 @@ class Engine:
             if req is None:
                 continue
             any_active = True
-            if req.sampling.temperature != 0.0:
-                return False
             if req.kv_len + g + 1 > self.max_seq_len:
                 return False
             if (req.kv_len + g) // self.page_size >= self.max_pages:
@@ -1068,9 +1067,12 @@ class Engine:
 
     def _decode_spec_once(self, g: int, drafts: dict[int, np.ndarray]) -> None:
         """One speculative launch: verify [fed_token, draft…] (C=γ+1
-        positions per row) in a single ``prefill_chunk_paged`` call, accept
-        the longest draft prefix matching the model's own argmax, emit one
-        bonus token. Fed positions' K/V is written by the verify pass
+        positions per row) in a single ``prefill_chunk_paged`` call, then
+        accept per row via ``spec_verify_sample`` — greedy rows take the
+        longest argmax-matching draft prefix, stochastic rows accept each
+        draft token with its target probability (exact rejection sampling)
+        — and emit one bonus token. Fed positions' K/V is written by the
+        verify pass
         itself, so accepted tokens cost no extra work; rejected positions
         hold stale K/V that the next launch overwrites (slots are purely
         positional) and that attention never reads (masked by length)."""
@@ -1119,23 +1121,33 @@ class Engine:
             kv_scale=self.pool.kv_scale,
         )
         logits = self._commit_pool_update(res)
-        greedy = np.asarray(jnp.argmax(logits, axis=-1))  # [B, C] one sync
+        draft_len = np.zeros((B,), dtype=np.int32)
+        for row, _ in active:
+            draft_len[row] = len(drafts[row])
+        self._rng, key = jax.random.split(self._rng)
+        accept_len, bonus = spec_verify_sample(
+            logits,
+            jnp.asarray(toks[:, 1:]),
+            jnp.asarray(draft_len),
+            key,
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ps),
+        )
+        accept_len = np.asarray(accept_len)  # [B] one sync
+        bonus = np.asarray(bonus)
         self.stats.decode_steps += 1
 
         emitted_total = 0
         for row, req in active:
             draft = drafts[row]
-            # Longest draft prefix the model itself would have produced.
-            a = 0
-            while a < len(draft) and greedy[row, a] == draft[a]:
-                a += 1
+            a = int(accept_len[row])
             self.stats.spec_accepted += a
             self._m_spec_accepted.inc(a)
             base = req.kv_len
             for i in range(a + 1):  # a accepted drafts + 1 bonus token
                 pos = base + i
                 slot = int(self._page_table[row, pos // ps] * ps + pos % ps)
-                token = int(draft[i]) if i < a else int(greedy[row, a])
+                token = int(draft[i]) if i < a else int(bonus[row])
                 emitted_total += 1
                 if self._consume_token(req, row, slot, token):
                     break

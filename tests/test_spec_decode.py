@@ -103,9 +103,30 @@ class TestSpecExactness:
         got = spec.generate([ref_prompt], sp)
         assert got == want
 
-    def test_temperature_rows_fall_back(self, model):
-        # Stochastic sampling can't be verified against argmax: the spec
-        # path must decline and the engine still serve correctly.
+    def test_stochastic_rows_join_spec_launches(self, model):
+        # Stochastic rows no longer disable speculation: a mixed batch
+        # (greedy repetitive row whose drafts fire + a temperature row)
+        # runs the verify launch for BOTH; the stochastic row is verified
+        # by exact rejection sampling and still emits max_new_tokens
+        # valid ids. (Its own drafts rarely fire with a tiny random
+        # model — the sampled tail almost never repeats — so the greedy
+        # row supplies the launch trigger.)
+        cfg, params = model
+        spec = make_engine(model, spec_decode_tokens=4)
+        rng = prompts_rng()
+        rep = (rng.integers(1, cfg.vocab_size, 5).tolist()) * 4
+        rnd = rng.integers(1, cfg.vocab_size, 9).tolist()
+        reqs = spec.add_request(rep, SamplingParams(temperature=0.0, max_new_tokens=10))
+        reqs2 = spec.add_request(rnd, SamplingParams(temperature=0.9, max_new_tokens=10))
+        while spec.has_work():
+            spec.step()
+        for r in (reqs, reqs2):
+            assert len(r.output_tokens) == 10
+            assert all(0 <= t < cfg.vocab_size for t in r.output_tokens)
+        assert spec.stats.spec_proposed > 0
+
+    def test_nonrepetitive_stochastic_falls_through(self, model):
+        # No repeating tail → empty drafts → the cheap plain path runs.
         cfg, params = model
         spec = make_engine(model, spec_decode_tokens=4)
         prompt = prompts_rng().integers(1, cfg.vocab_size, 8).tolist()
@@ -114,6 +135,7 @@ class TestSpecExactness:
         )[0]
         assert len(out) == 6
         assert spec.stats.spec_proposed == 0
+
 
     def test_cache_publish_after_spec_serves_followup(self, model):
         # Accepted-token KV written by the verify pass must be real: a
@@ -131,3 +153,49 @@ class TestSpecExactness:
         vanilla.generate([prompt], sp)
         want = vanilla.generate([follow], sp)[0]
         assert got == want
+
+
+class TestRejectionSamplingExactness:
+    def test_emitted_distribution_matches_target(self):
+        """The verifier's first emitted token must be distributed exactly
+        as plain sampling from the same filtered distribution, whatever
+        the draft is — the core speculative-sampling identity
+        P(accept d)·δ_d + P(reject)·residual = p."""
+        import jax
+        import jax.numpy as jnp
+
+        from radixmesh_tpu.ops.sampling import (
+            _filtered_logits,
+            spec_verify_sample,
+        )
+
+        V, N = 12, 30_000
+        rng = np.random.default_rng(0)
+        logits_row = jnp.asarray(rng.normal(size=(V,)) * 2.0, jnp.float32)
+        temperature, top_p = 0.8, 0.85
+        # Target distribution: exactly what sample_tokens would draw from.
+        filt = _filtered_logits(
+            logits_row[None, :],
+            jnp.asarray([temperature]),
+            jnp.asarray([top_p]),
+        )
+        target = np.asarray(jax.nn.softmax(filt, axis=-1))[0]
+
+        # Batch N independent verifications of a 1-token draft (both an
+        # in-nucleus and an out-of-nucleus draft token).
+        for draft_tok in (int(np.argmax(target)), int(np.argmin(target))):
+            logits = jnp.broadcast_to(logits_row, (N, 2, V))
+            drafts = jnp.full((N, 1), draft_tok, jnp.int32)
+            dlen = jnp.ones((N,), jnp.int32)
+            accept_len, bonus = spec_verify_sample(
+                logits, drafts, dlen, jax.random.PRNGKey(7),
+                jnp.full((N,), temperature), jnp.full((N,), top_p),
+            )
+            accept_len = np.asarray(accept_len)
+            bonus = np.asarray(bonus)
+            emitted = np.where(accept_len > 0, draft_tok, bonus)
+            freq = np.bincount(emitted, minlength=V) / N
+            # TV distance well under sampling noise for N=30k.
+            tv = 0.5 * np.abs(freq - target).sum()
+            assert tv < 0.02, (draft_tok, tv, freq, target)
+
