@@ -126,6 +126,26 @@ class SlotAllocator:
             self._free_pages.append(int(p))
 
 
+def _pad_to_bucket(slots: np.ndarray, arrays: list, token_axes: list):
+    """Pad ``slots`` (and each array along its token axis) up to a
+    power-of-two bucket by repeating the last slot/value — an idempotent
+    duplicate write — so jitted scatters compile O(log max_n) variants
+    instead of one per distinct length (bucket floor 8)."""
+    n = len(slots)
+    bucket = max(8, 1 << (n - 1).bit_length())
+    if bucket == n:
+        return slots, arrays
+    pad = bucket - n
+    slots = np.concatenate([slots, np.repeat(slots[-1:], pad)])
+    padded = []
+    for arr, ax in zip(arrays, token_axes):
+        last = jax.lax.slice_in_dim(arr, arr.shape[ax] - 1, arr.shape[ax], axis=ax)
+        padded.append(
+            jnp.concatenate([arr, jnp.repeat(last, pad, axis=ax)], axis=ax)
+        )
+    return slots, padded
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _scatter_kv(kv: jax.Array, slots: jax.Array, new_kv: jax.Array) -> jax.Array:
     # kv: [2, L, H, S, D]; slots: [n]; new_kv: [2, L, H, n, D]
@@ -149,6 +169,20 @@ def _scatter_kv_quant(
 
     q, scale = quantize_kv(new_kv, axis=-1)
     return kv.at[:, :, :, slots].set(q), kv_scale.at[:, :, :, slots].set(scale)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_kv_raw(
+    kv: jax.Array,  # int8 [2, L, H, S, D]
+    kv_scale: jax.Array,  # f32 [2, L, H, S]
+    slots: jax.Array,  # [n]
+    new_kv: jax.Array,  # int8 token-major [2, L, n, H, D]
+    new_scale: jax.Array,  # f32 [2, L, n, H]
+):
+    return (
+        kv.at[:, :, :, slots].set(new_kv.transpose(0, 1, 3, 2, 4)),
+        kv_scale.at[:, :, :, slots].set(new_scale.transpose(0, 1, 3, 2)),
+    )
 
 
 @jax.jit
@@ -259,12 +293,7 @@ class PagedKVPool:
         n = len(slots)
         if n == 0:
             return
-        bucket = max(8, 1 << (n - 1).bit_length())
-        if bucket != n:
-            pad = bucket - n
-            slots = np.concatenate([slots, np.repeat(slots[-1:], pad)])
-            k = jnp.concatenate([k, jnp.repeat(k[:, -1:], pad, axis=1)], axis=1)
-            v = jnp.concatenate([v, jnp.repeat(v[:, -1:], pad, axis=1)], axis=1)
+        slots, (k, v) = _pad_to_bucket(slots, [jnp.asarray(k), jnp.asarray(v)], [1, 1])
         # [L, n, H, D] → head-major [L, H, n, D].
         new_kv = jnp.stack([k, v]).transpose(0, 1, 3, 2, 4)
         sl = jnp.asarray(slots, dtype=jnp.int32)
@@ -280,6 +309,35 @@ class PagedKVPool:
         zero-copy view of this layer's pool, the kernel's input layout."""
         shape = (self.num_kv_heads, self.num_pages, self.page_size, self.head_dim)
         return self.kv[0, layer].reshape(shape), self.kv[1, layer].reshape(shape)
+
+    def gather_raw(self, slots: np.ndarray | jax.Array):
+        """``(kv [2, L, n, H, D] in POOL dtype, scales [2, L, n, H] | None)``
+        — the exact stored representation, for shipping across nodes
+        (disaggregated handoff) without a dequantize→requantize round trip
+        (which quadruples int8 wire bytes and drifts the values)."""
+        sl = jnp.asarray(slots, dtype=jnp.int32)
+        kv = self.kv[:, :, :, sl].transpose(0, 1, 3, 2, 4)
+        if self.quant is None:
+            return kv, None
+        return kv, self.kv_scale[:, :, :, sl].transpose(0, 1, 3, 2)
+
+    def write_raw(self, slots: np.ndarray, kv, scales) -> None:
+        """Store already-quantized K/V verbatim (inverse of
+        :meth:`gather_raw`; quantized pools only). ``kv`` token-major
+        ``[2, L, n, H, D]`` int8, ``scales`` ``[2, L, n, H]``."""
+        if self.quant is None:
+            raise ValueError("write_raw targets quantized pools")
+        slots = np.asarray(slots, dtype=np.int32)
+        if len(slots) == 0:
+            return
+        slots, (kv, scales) = _pad_to_bucket(
+            slots,
+            [jnp.asarray(kv, self.dtype), jnp.asarray(scales, jnp.float32)],
+            [2, 2],
+        )
+        self.kv, self.kv_scale = _scatter_kv_raw(
+            self.kv, self.kv_scale, jnp.asarray(slots, jnp.int32), kv, scales
+        )
 
     def gather(self, slots: np.ndarray | jax.Array) -> jax.Array:
         """Gather ``[2, L, n, kv_heads, head_dim]`` for the given slots,

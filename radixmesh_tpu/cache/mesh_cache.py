@@ -259,7 +259,9 @@ class MeshCache:
             t = threading.Thread(target=self._sender, daemon=True, name="mesh-sender")
             t.start()
             self._threads.append(t)
-        if self.sync.can_tick(self.cfg):
+            # Every ring node runs the ticker thread; only the CURRENT
+            # view's tick origin broadcasts (see _view_tick_origin) —
+            # heartbeats must survive the death of the static origin.
             t = threading.Thread(target=self._ticker, daemon=True, name="mesh-ticker")
             t.start()
             self._threads.append(t)
@@ -275,13 +277,15 @@ class MeshCache:
         return self
 
     def wait_ready(self, timeout: float | None = None) -> bool:
-        """Block until the startup tick has circulated the ring twice
-        (two-round verification, reference ``radix_mesh.py:435-445``)."""
-        origin = self.sync.tick_origin_rank(self.cfg)
+        """Block until SOME origin's tick has circulated the ring twice
+        (two-round verification, reference ``radix_mesh.py:435-445``).
+        Any origin proves connectivity — a node (re)starting while the
+        static origin is dead must become ready on the failover origin's
+        heartbeat (``_view_tick_origin``)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._stop.is_set():
             with self._lock:
-                if self.tick_counts.get(origin, 0) >= 2:
+                if any(c >= 2 for c in self.tick_counts.values()):
                     return True
             if deadline is not None and time.monotonic() > deadline:
                 return False
@@ -1034,6 +1038,19 @@ class MeshCache:
                 )
             )
 
+    def _view_tick_origin(self) -> int:
+        """Tick origination follows the VIEW, not static config: the
+        lowest alive decode rank (the reference pins the first decode
+        node, ``sync_algo.py:109-110``), falling back to the lowest alive
+        rank — a dead static origin must not silence the heartbeat (the
+        silence-triggered JOINs would keep membership alive, but as a
+        noisy substitute). On the initial full view this equals the
+        static origin, so the startup barrier is unchanged."""
+        alive = [r for r in self.view.alive]
+        decode = [r for r in alive if self.cfg.is_decode_rank(r)]
+        pool = decode or alive
+        return min(pool) if pool else self.rank
+
     def _ticker(self) -> None:
         """Periodic ring tick (reference ``radix_mesh.py:118-133``). The
         first tick fires immediately so startup isn't gated on the
@@ -1046,16 +1063,18 @@ class MeshCache:
         reconciles it."""
         while not self._stop.is_set():
             with self._lock:
-                view_bytes = encode_view(self.view)
-            self._broadcast(
-                Oplog(
-                    op_type=OplogType.TICK,
-                    origin_rank=self.rank,
-                    logic_id=self._logic_op.next(),
-                    ttl=self._tick_ttl(),
-                    value=view_bytes,
+                is_origin = self._view_tick_origin() == self.rank
+                view_bytes = encode_view(self.view) if is_origin else None
+            if is_origin:
+                self._broadcast(
+                    Oplog(
+                        op_type=OplogType.TICK,
+                        origin_rank=self.rank,
+                        logic_id=self._logic_op.next(),
+                        ttl=self._tick_ttl(),
+                        value=view_bytes,
+                    )
                 )
-            )
             self._stop.wait(self.cfg.tick_interval_s)
 
     # ------------------------------------------------------------------

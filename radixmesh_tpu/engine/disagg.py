@@ -70,6 +70,10 @@ class HandoffPacket:
     # KV covers prompt[kv_start:]; >0 when the sender knows the receiver
     # already caches the first kv_start tokens (tail-only shipping).
     kv_start: int = 0
+    # Per-(token, head) scales when ``kv`` is an int8-quantized payload
+    # ([2, L, n - kv_start, Hkv]); int8 + scales is 4x smaller on the wire
+    # than the dequantized f32 a plain gather would ship.
+    kv_scale: np.ndarray | jax.Array | None = None
 
 
 class PrefillWorker(Engine):
@@ -101,16 +105,17 @@ class PrefillWorker(Engine):
             raise RuntimeError("prefill pool exhausted; could not admit request")
         # Gather before release: release publishes the page-aligned prefix
         # to the tree but frees the tail partial page.
-        kv = np.asarray(self.pool.gather(req.token_slots[skip_prefix:]))
+        kv, kv_scale = self.pool.gather_raw(req.token_slots[skip_prefix:])
         pkt = HandoffPacket(
             prompt=req.prompt,
             first_token=req.output_tokens[0],
-            kv=kv,
+            kv=np.asarray(kv),
             sampling=req.sampling,
             rid=req.rid,
             submit_time=req.submit_time,
             first_token_time=req.first_token_time,
             kv_start=skip_prefix,
+            kv_scale=None if kv_scale is None else np.asarray(kv_scale),
         )
         req.state = RequestState.FINISHED
         self._release(req)
@@ -130,7 +135,7 @@ class DecodeWorker:
     def __init__(self, engine: Engine, comm: Communicator | None = None):
         self.engine = engine
         self.log = get_logger("disagg.decode")
-        self._pending: list[tuple[Request, np.ndarray, int]] = []
+        self._pending: list[tuple[Request, np.ndarray, int, np.ndarray | None]] = []
         self._lock = threading.Lock()
         self.dropped = 0  # tail-only handoffs whose advertised prefix vanished
         self._comm = comm
@@ -156,7 +161,14 @@ class DecodeWorker:
         req.submit_time = pkt.submit_time or time.monotonic()
         req.first_token_time = pkt.first_token_time or time.monotonic()
         with self._lock:
-            self._pending.append((req, np.asarray(pkt.kv), int(pkt.kv_start)))
+            self._pending.append(
+                (
+                    req,
+                    np.asarray(pkt.kv),
+                    int(pkt.kv_start),
+                    None if pkt.kv_scale is None else np.asarray(pkt.kv_scale),
+                )
+            )
         return req
 
     def cached_prefix_len(self, prompt: Sequence[int]) -> int:
@@ -192,8 +204,8 @@ class DecodeWorker:
     def _admit_pending(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []
-        for i, (req, kv, kv_start) in enumerate(pending):
-            if not self._admit_one(req, kv, kv_start):
+        for i, (req, kv, kv_start, kv_scale) in enumerate(pending):
+            if not self._admit_one(req, kv, kv_start, kv_scale):
                 # Re-queue the failed packet AND everything after it —
                 # admission stops at the first failure (row/pool pressure),
                 # it must not drop the rest of the drained batch.
@@ -201,7 +213,13 @@ class DecodeWorker:
                     self._pending[:0] = pending[i:]
                 return
 
-    def _admit_one(self, req: Request, kv: np.ndarray, kv_start: int) -> bool:
+    def _admit_one(
+        self,
+        req: Request,
+        kv: np.ndarray,
+        kv_start: int,
+        kv_scale: np.ndarray | None = None,
+    ) -> bool:
         eng = self.engine
         row = eng._free_row()
         if row < 0:
@@ -232,8 +250,22 @@ class DecodeWorker:
             self.dropped += 1
             return True  # consumed (not re-queued)
         n_new = n - reuse
-        tail = jnp.asarray(kv[:, :, reuse - kv_start : n - kv_start])
-        eng.pool.write(own[:n_new], tail[0], tail[1])
+        lo, hi = reuse - kv_start, n - kv_start
+        tail = jnp.asarray(kv[:, :, lo:hi])
+        scale = kv_scale
+        if scale is not None and eng.pool.quant is not None:
+            # Quantized end-to-end: store the shipped ints verbatim.
+            eng.pool.write_raw(own[:n_new], tail, jnp.asarray(scale[:, :, lo:hi]))
+        elif scale is not None:
+            # Quantized sender, full-precision receiver: dequantize here.
+            deq = tail.astype(jnp.float32) * jnp.asarray(
+                scale[:, :, lo:hi], jnp.float32
+            )[..., None]
+            eng.pool.write(own[:n_new], deq[0], deq[1])
+        else:
+            # Full-precision packet; a quantized receiver's write()
+            # quantizes on store.
+            eng.pool.write(own[:n_new], tail[0], tail[1])
 
         req.kv_len = n
         req.token_slots = np.concatenate([prefix_slots, own[:n_new]])
@@ -253,6 +285,7 @@ def pack_handoff(pkt: HandoffPacket) -> bytes:
     """``[4-byte header length][JSON header][raw KV bytes]`` — rides any
     length-framed :class:`Communicator` unchanged."""
     kv = np.asarray(pkt.kv)
+    scale = None if pkt.kv_scale is None else np.asarray(pkt.kv_scale, np.float32)
     header = json.dumps(
         {
             "prompt": np.asarray(pkt.prompt).tolist(),
@@ -263,6 +296,7 @@ def pack_handoff(pkt: HandoffPacket) -> bytes:
             "kv_shape": list(kv.shape),
             "kv_dtype": jnp.dtype(kv.dtype).name,
             "kv_start": int(pkt.kv_start),
+            "scale_shape": None if scale is None else list(scale.shape),
             "sampling": {
                 "temperature": pkt.sampling.temperature,
                 "top_p": pkt.sampling.top_p,
@@ -271,17 +305,24 @@ def pack_handoff(pkt: HandoffPacket) -> bytes:
             },
         }
     ).encode()
-    return (
-        len(header).to_bytes(_HEADER_LEN_BYTES, "big") + header + kv.tobytes()
-    )
+    parts = [len(header).to_bytes(_HEADER_LEN_BYTES, "big"), header, kv.tobytes()]
+    if scale is not None:
+        parts.append(scale.tobytes())
+    return b"".join(parts)
 
 
 def unpack_handoff(data: bytes) -> HandoffPacket:
     hlen = int.from_bytes(data[:_HEADER_LEN_BYTES], "big")
     h = json.loads(data[_HEADER_LEN_BYTES : _HEADER_LEN_BYTES + hlen])
-    kv = np.frombuffer(
-        data[_HEADER_LEN_BYTES + hlen :], dtype=jnp.dtype(h["kv_dtype"])
-    ).reshape(h["kv_shape"])
+    kv_dtype = jnp.dtype(h["kv_dtype"])
+    n_kv = int(np.prod(h["kv_shape"])) * kv_dtype.itemsize
+    body = data[_HEADER_LEN_BYTES + hlen :]
+    kv = np.frombuffer(body[:n_kv], dtype=kv_dtype).reshape(h["kv_shape"])
+    scale = None
+    if h.get("scale_shape"):
+        scale = np.frombuffer(body[n_kv:], dtype=np.float32).reshape(
+            h["scale_shape"]
+        )
     s = h["sampling"]
     return HandoffPacket(
         prompt=np.asarray(h["prompt"], np.int32),
@@ -297,4 +338,5 @@ def unpack_handoff(data: bytes) -> HandoffPacket:
         submit_time=h["submit_time"],
         first_token_time=h["first_token_time"],
         kv_start=h.get("kv_start", 0),
+        kv_scale=scale,
     )

@@ -60,9 +60,6 @@ class BaseSyncAlgo(abc.ABC):
     def can_recv(self, cfg: MeshConfig) -> bool: ...
 
     @abc.abstractmethod
-    def can_tick(self, cfg: MeshConfig) -> bool: ...
-
-    @abc.abstractmethod
     def tick_origin_rank(self, cfg: MeshConfig) -> int:
         """Global rank of the node that originates heartbeat ticks — the
         rank every node's startup barrier watches for."""
@@ -103,12 +100,11 @@ class RingSyncAlgo(BaseSyncAlgo):
     def can_recv(self, cfg: MeshConfig) -> bool:
         return True
 
-    def can_tick(self, cfg: MeshConfig) -> bool:
-        return cfg.global_rank == self.tick_origin_rank(cfg)
-
     def tick_origin_rank(self, cfg: MeshConfig) -> int:
-        # First decode node originates ticks (sync_algo.py:109-110); fall
-        # back to the master when the cluster has no decode nodes.
+        # INITIAL tick origin: the first decode node (sync_algo.py:109-110),
+        # falling back to the master when the cluster has no decode nodes.
+        # At runtime origination follows the topology view
+        # (``MeshCache._view_tick_origin``) so a dead origin fails over.
         return cfg.num_prefill if cfg.num_decode > 0 else self.master_rank(cfg)
 
     def data_ttl(self, cfg: MeshConfig) -> int:

@@ -199,3 +199,63 @@ class TestIciTransfer:
         # deferred to an XLA error at trace time.
         with pytest.raises(ValueError):
             prefill_to_decode_perm(3, 2)
+
+
+class TestQuantizedHandoff:
+    """Int8 pools ship their exact stored representation (int8 + scales,
+    4x smaller than dequantized f32) and the receiver stores it verbatim —
+    no dequantize→requantize drift across the handoff."""
+
+    def test_quant_to_quant_matches_collocated_quant(self, model):
+        cfg, params = model
+        rng = np.random.default_rng(12)
+        prompts = [rng.integers(0, 64, size=n).tolist() for n in (9, 14)]
+        ref = Engine(cfg, params, num_slots=512, page_size=PAGE, max_batch=4,
+                     max_seq_len=128, kv_quant="int8")
+        want = ref.generate(prompts, SamplingParams(max_new_tokens=8))
+
+        pw = make_prefill(model, kv_quant="int8")
+        dw = make_decode(model, kv_quant="int8")
+        reqs = [
+            dw.submit(pw.prefill_handoff(p, SamplingParams(max_new_tokens=8)))
+            for p in prompts
+        ]
+        dw.run_until_drained()
+        assert [r.generated for r in reqs] == want
+
+    def test_quant_wire_roundtrip_preserves_ints_and_scales(self, model):
+        pw = make_prefill(model, kv_quant="int8")
+        pkt = pw.prefill_handoff(
+            [1, 2, 3, 4, 5, 6, 7], SamplingParams(max_new_tokens=4)
+        )
+        assert np.asarray(pkt.kv).dtype == np.int8
+        assert pkt.kv_scale is not None
+        pkt2 = unpack_handoff(pack_handoff(pkt))
+        np.testing.assert_array_equal(np.asarray(pkt.kv), np.asarray(pkt2.kv))
+        np.testing.assert_array_equal(
+            np.asarray(pkt.kv_scale), np.asarray(pkt2.kv_scale)
+        )
+        # int8 + f32 scales ≈ (1 + 4/D)/4 of the f32 payload a plain
+        # gather would ship.
+        kv_bytes = np.asarray(pkt.kv).nbytes
+        assert np.asarray(pkt.kv_scale).nbytes * 4 <= kv_bytes  # D >= 16
+
+    def test_quant_sender_fp_receiver(self, model):
+        # Mixed deployment: the receiver dequantizes the shipped ints.
+        pw = make_prefill(model, kv_quant="int8")
+        dw = make_decode(model)
+        req = dw.submit(
+            pw.prefill_handoff([3, 1, 4, 1, 5, 9, 2, 6],
+                               SamplingParams(max_new_tokens=6))
+        )
+        dw.run_until_drained()
+        assert len(req.generated) == 6
+
+    def test_fp_sender_quant_receiver(self, model):
+        pw = make_prefill(model)
+        dw = make_decode(model, kv_quant="int8")
+        req = dw.submit(
+            pw.prefill_handoff([2, 7, 1, 8, 2, 8], SamplingParams(max_new_tokens=6))
+        )
+        dw.run_until_drained()
+        assert len(req.generated) == 6

@@ -262,3 +262,23 @@ class TestRestartIntoDeadSuccessor:
         ), [n.view for n in everyone]
         insert_with_pool(cluster.nodes["p0"], [6, 6, 6])
         assert wait_for(lambda: reborn.match_prefix([6, 6, 6]).length == 3)
+
+
+class TestTickOriginFailover:
+    def test_heartbeat_survives_tick_origin_death(self, cluster):
+        # The static tick origin is the first decode node (rank 3). Kill
+        # it: the view's next origin (rank 4) must take over ticking, so
+        # the ring keeps a real heartbeat instead of leaning on
+        # silence-triggered JOINs.
+        cluster.nodes["d0"].close()  # global rank 3, static tick origin
+        survivors = cluster.alive_nodes()
+        assert wait_for(
+            lambda: all(not n.view.contains(3) for n in survivors), timeout=15
+        )
+        baseline = {n.rank: n.tick_counts.get(4, 0) for n in survivors}
+        assert wait_for(
+            lambda: all(
+                n.tick_counts.get(4, 0) > baseline[n.rank] for n in survivors
+            ),
+            timeout=10,
+        ), "rank 4 never took over tick origination"

@@ -55,14 +55,13 @@ class TestRingSyncAlgo:
         assert self.algo.gc_ttl(c) == 5
 
     def test_tick_origin(self):
-        assert self.algo.can_tick(cfg("d0"))
-        for other in ("p0", "p1", "p2", "d1"):
-            assert not self.algo.can_tick(cfg(other))
+        # Initial origin = first decode node (global rank num_prefill).
+        assert self.algo.tick_origin_rank(cfg("d0")) == 3
         # No decode nodes -> master ticks (fallback beyond the reference).
         no_decode = MeshConfig(
             prefill_nodes=["p0", "p1"], decode_nodes=[], local_addr="p0"
         )
-        assert self.algo.can_tick(no_decode)
+        assert self.algo.tick_origin_rank(no_decode) == 0
 
     def test_factory(self):
         assert isinstance(get_sync_algo("ring"), RingSyncAlgo)
