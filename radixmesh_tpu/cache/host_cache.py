@@ -33,7 +33,7 @@ from typing import Any
 import jax.numpy as jnp
 import numpy as np
 
-from radixmesh_tpu.cache.kv_pool import PagedKVPool, SlotAllocator
+from radixmesh_tpu.cache.kv_pool import PagedKVPool, _pad_to_bucket, SlotAllocator
 from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode
 from radixmesh_tpu.obs.metrics import get_registry
 from radixmesh_tpu.utils.logging import get_logger
@@ -41,21 +41,23 @@ from radixmesh_tpu.utils.logging import get_logger
 __all__ = ["HostKVStore", "HierarchicalCache"]
 
 
-def gather_padded(pool: PagedKVPool, slots: np.ndarray) -> np.ndarray:
+def gather_padded(pool: PagedKVPool, slots: np.ndarray):
     """One power-of-two-padded gather (the same bucketing discipline as
     ``pool.write``), sliced back to ``len(slots)`` on host →
-    ``[2, L, n, H, D]`` numpy in the pool's dtype."""
+    ``(kv [2, L, n, H, D], scales [2, L, n, H] | None)`` in the pool's
+    STORED dtype (int8 + scales for quantized pools — the host tier keeps
+    the exact representation, at a quarter of the dequantized bytes)."""
     slots = np.asarray(slots, dtype=np.int32)
     n = len(slots)
     if n == 0:
-        return np.empty((2, pool.num_layers, 0, pool.num_kv_heads, pool.head_dim))
-    bucket = max(8, 1 << (n - 1).bit_length())
-    padded = (
-        slots
-        if bucket == n
-        else np.concatenate([slots, np.repeat(slots[-1:], bucket - n)])
+        empty = np.empty((2, pool.num_layers, 0, pool.num_kv_heads, pool.head_dim))
+        return empty, None
+    padded, _ = _pad_to_bucket(slots, [], [])
+    kv, scales = pool.gather_raw(padded)
+    return (
+        np.asarray(kv)[:, :, :n],
+        None if scales is None else np.asarray(scales)[:, :, :n],
     )
-    return np.asarray(pool.gather(padded))[:, :, :n]
 
 
 class HostKVStore:
@@ -71,14 +73,26 @@ class HostKVStore:
         head_dim: int,
         page_size: int = 1,
         dtype: Any = jnp.bfloat16,
+        quant: str | None = None,
     ):
         self.num_slots = num_slots
         self.page_size = page_size
+        self.quant = quant
+        if quant is not None:
+            from radixmesh_tpu.ops.quant import KV_QUANT_DTYPES
+
+            dtype = KV_QUANT_DTYPES[quant]
         self.allocator = SlotAllocator(num_slots, page_size)
         # jnp dtype → numpy (ml_dtypes handles bfloat16 natively).
         self._arena = np.zeros(
             (2, num_layers, num_slots, num_kv_heads, head_dim),
             dtype=jnp.dtype(dtype),
+        )
+        # Per-(token, head) scales for quantized arenas (ops/quant.py).
+        self._scale_arena = (
+            np.zeros((2, num_layers, num_slots, num_kv_heads), np.float32)
+            if quant is not None
+            else None
         )
 
     @property
@@ -91,12 +105,22 @@ class HostKVStore:
     def free(self, slots: np.ndarray) -> None:
         self.allocator.free(slots)
 
-    def write(self, slots: np.ndarray, kv: np.ndarray) -> None:
-        """Store ``kv`` ``[2, L, n, H, D]`` at host ``slots``."""
-        self._arena[:, :, np.asarray(slots, dtype=np.int32)] = kv
+    def write(
+        self, slots: np.ndarray, kv: np.ndarray, scales: np.ndarray | None = None
+    ) -> None:
+        """Store ``kv`` ``[2, L, n, H, D]`` (+ quant scales) at host
+        ``slots``."""
+        sl = np.asarray(slots, dtype=np.int32)
+        self._arena[:, :, sl] = kv
+        if self._scale_arena is not None:
+            self._scale_arena[:, :, sl] = scales
 
-    def read(self, slots: np.ndarray) -> np.ndarray:
-        return self._arena[:, :, np.asarray(slots, dtype=np.int32)]
+    def read(self, slots: np.ndarray):
+        sl = np.asarray(slots, dtype=np.int32)
+        kv = self._arena[:, :, sl]
+        if self._scale_arena is None:
+            return kv, None
+        return kv, self._scale_arena[:, :, sl]
 
 
 class HierarchicalCache(RadixTree):
@@ -109,6 +133,12 @@ class HierarchicalCache(RadixTree):
         page_size: int | None = None,
         **tree_kw,
     ):
+        if pool.quant != host_store.quant:
+            raise ValueError(
+                f"pool quant={pool.quant!r} and host tier "
+                f"quant={host_store.quant!r} must match: the tier stores the "
+                f"pool's exact representation"
+            )
         self.pool = pool
         self.host = host_store
         self.log = get_logger("hicache")
@@ -155,7 +185,7 @@ class HierarchicalCache(RadixTree):
             if host_slots is None:
                 return False
         host_slots = host_slots[: len(slots)]
-        self.host.write(host_slots, gather_padded(self.pool, slots))
+        self.host.write(host_slots, *gather_padded(self.pool, slots))
         node.host_value = host_slots
         self._m_backup.inc(len(slots))
         return True
@@ -241,8 +271,12 @@ class HierarchicalCache(RadixTree):
                     if dev is None:
                         break
                 dev = dev[:n]
-                kv = self.host.read(node.host_value)  # [2, L, n, H, D]
-                self.pool.write(dev, jnp.asarray(kv[0]), jnp.asarray(kv[1]))
+                kv, scales = self.host.read(node.host_value)  # [2, L, n, H, D]
+                if scales is not None:
+                    # Quantized tier: restore the stored ints verbatim.
+                    self.pool.write_raw(dev, jnp.asarray(kv), jnp.asarray(scales))
+                else:
+                    self.pool.write(dev, jnp.asarray(kv[0]), jnp.asarray(kv[1]))
                 node.value = dev
                 self.evictable_size_ += len(node.key)
                 self._m_restore.inc(n)

@@ -1039,17 +1039,11 @@ class MeshCache:
             )
 
     def _view_tick_origin(self) -> int:
-        """Tick origination follows the VIEW, not static config: the
-        lowest alive decode rank (the reference pins the first decode
-        node, ``sync_algo.py:109-110``), falling back to the lowest alive
-        rank — a dead static origin must not silence the heartbeat (the
-        silence-triggered JOINs would keep membership alive, but as a
-        noisy substitute). On the initial full view this equals the
-        static origin, so the startup barrier is unchanged."""
-        alive = [r for r in self.view.alive]
-        decode = [r for r in alive if self.cfg.is_decode_rank(r)]
-        pool = decode or alive
-        return min(pool) if pool else self.rank
+        """Tick origination follows the VIEW, not static config — a dead
+        static origin must not silence the heartbeat. Policy lives in the
+        sync algo (``view_tick_origin``) so alternative algos control
+        origination the same way they control the static origin."""
+        return self.sync.view_tick_origin(self.cfg, self.view.alive)
 
     def _ticker(self) -> None:
         """Periodic ring tick (reference ``radix_mesh.py:118-133``). The

@@ -222,6 +222,7 @@ class Engine:
                 head_dim=cfg.head_dim,
                 page_size=page_size,
                 dtype=cfg.dtype,
+                quant=self.pool.quant,
             )
             self.tree: RadixTree = HierarchicalCache(self.pool, host_store)
         else:

@@ -60,6 +60,13 @@ class BaseSyncAlgo(abc.ABC):
     def can_recv(self, cfg: MeshConfig) -> bool: ...
 
     @abc.abstractmethod
+    def view_tick_origin(self, cfg: MeshConfig, alive) -> int:
+        """Tick origin for a RUNTIME membership view (``alive`` = iterable
+        of alive global ranks). Defaults to the static origin; algos
+        override to fail origination over when it dies."""
+        return self.tick_origin_rank(cfg)
+
+    @abc.abstractmethod
     def tick_origin_rank(self, cfg: MeshConfig) -> int:
         """Global rank of the node that originates heartbeat ticks — the
         rank every node's startup barrier watches for."""
@@ -103,9 +110,16 @@ class RingSyncAlgo(BaseSyncAlgo):
     def tick_origin_rank(self, cfg: MeshConfig) -> int:
         # INITIAL tick origin: the first decode node (sync_algo.py:109-110),
         # falling back to the master when the cluster has no decode nodes.
-        # At runtime origination follows the topology view
-        # (``MeshCache._view_tick_origin``) so a dead origin fails over.
         return cfg.num_prefill if cfg.num_decode > 0 else self.master_rank(cfg)
+
+    def view_tick_origin(self, cfg: MeshConfig, alive) -> int:
+        # Runtime origination follows the view so a dead origin fails
+        # over: lowest alive decode rank, else lowest alive rank. On the
+        # initial full view this equals ``tick_origin_rank``.
+        alive = list(alive)
+        decode = [r for r in alive if cfg.is_decode_rank(r)]
+        pool = decode or alive
+        return min(pool) if pool else self.tick_origin_rank(cfg)
 
     def data_ttl(self, cfg: MeshConfig) -> int:
         return cfg.num_ring  # one full lap (sync_algo.py:98-101)

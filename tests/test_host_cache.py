@@ -43,7 +43,7 @@ class TestHostKVStore:
         slots = host.alloc(8)
         data = np.random.default_rng(0).normal(size=(2, L, 8, H, D)).astype(np.float32)
         host.write(slots[:8], data)
-        np.testing.assert_array_equal(host.read(slots[:8]), data)
+        np.testing.assert_array_equal(host.read(slots[:8])[0], data)
 
     def test_alloc_exhaustion(self):
         host = make_host(num_slots=8)
@@ -69,7 +69,7 @@ class TestWriteback:
         assert res.host_length == 8
         assert res.last_host_node is not None and res.last_host_node.backuped
         # The host copy holds the same bytes the device held.
-        got = host.read(res.host_indices())
+        got = host.read(res.host_indices())[0]
         np.testing.assert_allclose(got, kv, rtol=1e-6)
 
     def test_match_and_load_restores_device_hit(self):
@@ -84,7 +84,7 @@ class TestWriteback:
         res = tree.match_and_load(key)
         assert res.length == 8
         assert res.host_length == 0
-        restored = np.asarray(gather_padded(pool, res.indices()))
+        restored = np.asarray(gather_padded(pool, res.indices())[0])
         np.testing.assert_allclose(restored, kv, rtol=1e-6)
         # Host copy retained: re-evicting is free (no second gather needed).
         node = res.last_node
@@ -129,7 +129,7 @@ class TestWriteback:
         assert tree.match_prefix(k2).host_length == 12
         res = tree.match_and_load(k2)
         assert res.length == 12
-        got = gather_padded(pool, res.indices())
+        got = gather_padded(pool, res.indices())[0]
         np.testing.assert_allclose(got[:, :, :8], kvs[1], rtol=1e-6)
         np.testing.assert_allclose(got[:, :, 8:], kvs[2], rtol=1e-6)
 
@@ -253,7 +253,7 @@ class TestDeviceClosureInvariant:
         h_node = res.last_node
         assert len(h_node.key) == 4
         hs = host.alloc(4)
-        host.write(hs, gather_padded(pool, np.asarray(h_node.value)))
+        host.write(hs, *gather_padded(pool, np.asarray(h_node.value)))
         pool.free(np.asarray(h_node.value))
         h_node.host_value = hs
         h_node.value = None
@@ -262,3 +262,34 @@ class TestDeviceClosureInvariant:
         freed = tree.evict(8)  # C then A, skipping H
         assert freed == 8
         assert pool.free_slots >= 8
+
+
+class TestQuantizedHostTier:
+    """Quantized pools back up and restore their raw int8 + scales: a
+    quarter of the dequantized host bytes and bit-exact round trips."""
+
+    def test_writeback_restore_round_trip_int8(self):
+        pool = PagedKVPool(num_slots=64, num_layers=L, num_kv_heads=H,
+                           head_dim=D, page_size=PAGE, quant="int8")
+        host = HostKVStore(num_slots=64, num_layers=L, num_kv_heads=H,
+                           head_dim=D, page_size=PAGE, quant="int8")
+        assert host._arena.dtype == np.int8 and host._scale_arena is not None
+        tree = HierarchicalCache(pool, host)
+        key = list(range(8))
+        slots = pool.alloc(8)
+        rng = np.random.default_rng(5)
+        k = jnp.asarray(rng.normal(size=(L, 8, H, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(L, 8, H, D)), jnp.float32)
+        pool.write(slots, k, v)
+        stored_kv, stored_sc = pool.gather_raw(slots)
+        stored_kv, stored_sc = np.asarray(stored_kv), np.asarray(stored_sc)
+        tree.insert(key, slots)
+
+        tree.evict(8)
+        assert tree.match_prefix(key).host_length == 8
+
+        res = tree.match_and_load(key)
+        assert res.length == 8
+        back_kv, back_sc = pool.gather_raw(res.indices())
+        np.testing.assert_array_equal(np.asarray(back_kv), stored_kv)
+        np.testing.assert_array_equal(np.asarray(back_sc), stored_sc)

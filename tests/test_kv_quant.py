@@ -220,6 +220,19 @@ class TestQuantEngine:
         spec = quant_engine(model, spec_decode_tokens=3)
         assert spec.generate([prompt], sp)[0] == ref
 
+    def test_quant_engine_with_host_tier(self, model):
+        # Int8 pool + host-RAM tier: evicted prefixes back up as int8 +
+        # scales and restore verbatim; a follow-up still serves correctly.
+        cfg, params = model
+        eng = quant_engine(model, num_slots=64, host_cache_slots=256)
+        rng = np.random.default_rng(12)
+        sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+        prompts = [rng.integers(1, cfg.vocab_size, 14).tolist() for _ in range(4)]
+        for p in prompts:  # churn a tiny pool to force write-backs
+            eng.generate([p], sp)
+        out = eng.generate([prompts[0]], sp)[0]
+        assert len(out) == 4
+
     def test_sharded_quant_engine_matches_single_device(self, model):
         """tp-sharded serving over a quantized pool: same greedy tokens as
         the unsharded quantized engine (sharding must not change decode
