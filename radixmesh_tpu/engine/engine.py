@@ -311,6 +311,31 @@ class Engine:
         self.waiting.append(req)
         return req
 
+    def cancel(self, rid: int) -> bool:
+        """Abort a queued or running request. Running requests release
+        their batch row; KV computed so far publishes to the radix cache
+        as usual (it is a valid prefix for future hits). The request
+        finishes with whatever output it had — callers check
+        ``req.cancelled``. Returns False for unknown/finished rids.
+        NOT thread-safe against a concurrent ``step``; serialize through
+        the owner (``server/http_frontend.py::EngineRunner.cancel``)."""
+        for i, req in enumerate(self.waiting):
+            if req.rid == rid:
+                self.waiting.pop(i)
+                req.cancelled = True
+                req.state = RequestState.FINISHED
+                self.stats.finished += 1
+                return True
+        for req in self._rows:
+            if req is not None and req.rid == rid:
+                req.cancelled = True
+                req.state = RequestState.FINISHED
+                self.stats.finished += 1
+                self._release(req)
+                self._pressure = False  # freed a row: resume admission
+                return True
+        return False
+
     def step(self) -> None:
         """One scheduler iteration: admit+prefill queued requests into free
         rows, then one batched decode step for everything running."""

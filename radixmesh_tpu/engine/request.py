@@ -50,6 +50,7 @@ class Request:
         default_factory=lambda: np.empty(0, dtype=np.int32)
     )
     lock_node: object = None  # TreeNode protected while RUNNING
+    cancelled: bool = False  # aborted by Engine.cancel (output is partial)
     submit_time: float = 0.0
     first_token_time: float = 0.0
 

@@ -59,7 +59,6 @@ class BaseSyncAlgo(abc.ABC):
     @abc.abstractmethod
     def can_recv(self, cfg: MeshConfig) -> bool: ...
 
-    @abc.abstractmethod
     def view_tick_origin(self, cfg: MeshConfig, alive) -> int:
         """Tick origin for a RUNTIME membership view (``alive`` = iterable
         of alive global ranks). Defaults to the static origin; algos

@@ -71,6 +71,10 @@ class EngineRunner:
         self._wake.set()
         return req
 
+    def cancel(self, rid: int) -> bool:
+        with self._lock:
+            return self.engine.cancel(rid)
+
     def wait(self, req: Request, timeout: float | None = None) -> list[int]:
         """Block until ``req`` finishes; returns its generated tokens."""
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -165,6 +169,16 @@ class ServingFrontend:
                     _json_response(self, 404, {"error": "not found"})
 
             def do_POST(self):
+                if self.path == "/cancel":
+                    try:
+                        rid = int(_read_json(self)["rid"])
+                    except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+                        _json_response(self, 400, {"error": str(e)})
+                        return
+                    _json_response(
+                        self, 200, {"cancelled": frontend.runner.cancel(rid)}
+                    )
+                    return
                 if self.path != "/generate":
                     _json_response(self, 404, {"error": "not found"})
                     return
@@ -202,6 +216,7 @@ class ServingFrontend:
                         "output_ids": tokens,
                         "cached_tokens": req.prefix_len,
                         "rid": req.rid,
+                        **({"cancelled": True} if req.cancelled else {}),
                     },
                 )
 
@@ -227,7 +242,7 @@ class ServingFrontend:
                                 f"data: {json.dumps({'token': t})}\n\n".encode()
                             )
                         self.wfile.write(
-                            f"data: {json.dumps({'done': True, 'output_ids': final})}\n\n".encode()
+                            f"data: {json.dumps({'done': True, 'output_ids': final, **({'cancelled': True} if req.cancelled else {})})}\n\n".encode()
                         )
                         self.wfile.flush()
                         return

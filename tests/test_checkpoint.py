@@ -135,6 +135,40 @@ class TestTreeSnapshot:
         assert got is not None and not set(got.tolist()) & set(slots.tolist())
         assert pool2.alloc(8) is None
 
+    def test_quantized_pool_round_trip_is_lossless(self, tmp_path):
+        """Int8 pools survive the f32 snapshot container bit-exactly: the
+        dequantized copy re-quantizes to the SAME ints and scales (the
+        amax element always maps back to ±127, so scale' == scale)."""
+        from radixmesh_tpu.cache.kv_pool import PagedKVPool
+
+        def fresh_pool():
+            return PagedKVPool(
+                num_slots=64, num_layers=2, num_kv_heads=2, head_dim=4,
+                page_size=4, quant="int8",
+            )
+
+        pool = fresh_pool()
+        tree = RadixTree(page_size=4, on_free=pool.free)
+        slots = pool.alloc(8)
+        rng = np.random.default_rng(1)
+        k = jnp.asarray(rng.normal(size=(2, 8, 2, 4)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, 8, 2, 4)), jnp.float32)
+        pool.write(slots, k, v)
+        tree.insert(list(range(8)), slots)
+        path = str(tmp_path / "tree.json")
+        save_tree(path, tree, pool=pool)
+
+        pool2 = fresh_pool()
+        tree2 = RadixTree(page_size=4, on_free=pool2.free)
+        load_tree(path, tree2, pool=pool2)
+        assert tree2.match_prefix(list(range(8))).length == 8
+        kv1, sc1 = pool.gather_raw(slots)
+        kv2, sc2 = pool2.gather_raw(slots)
+        np.testing.assert_array_equal(np.asarray(kv1), np.asarray(kv2))
+        np.testing.assert_allclose(
+            np.asarray(sc1), np.asarray(sc2), rtol=1e-6
+        )
+
     def test_restore_into_pool_without_kv_refused(self):
         from radixmesh_tpu.cache.kv_pool import PagedKVPool
 

@@ -234,3 +234,40 @@ class TestMultiStepDecode:
         follow = prompt + got[:10]
         multi.generate([follow], SamplingParams(temperature=0.0, max_new_tokens=2))
         assert multi.stats.cached_tokens >= (len(follow) - 1) // PAGE * PAGE
+
+
+class TestCancel:
+    def test_cancel_queued(self, model):
+        cfg, params = model
+        eng = make_engine(model, max_batch=1)
+        r1 = eng.add_request([1, 2, 3], SamplingParams(max_new_tokens=4))
+        r2 = eng.add_request([4, 5, 6], SamplingParams(max_new_tokens=4))
+        assert eng.cancel(r2.rid)
+        while eng.has_work():
+            eng.step()
+        assert len(r1.output_tokens) == 4
+        assert r2.cancelled and r2.output_tokens == []
+        assert not eng.cancel(r2.rid)  # already finished
+
+    def test_cancel_running_releases_row_and_publishes(self, model):
+        cfg, params = model
+        eng = make_engine(model, max_batch=1)
+        prompt = prompts_rng().integers(1, cfg.vocab_size, 10).tolist()
+        req = eng.add_request(prompt, SamplingParams(max_new_tokens=64))
+        for _ in range(6):  # prefill + a few decode steps
+            eng.step()
+        produced = len(req.output_tokens)
+        assert 0 < produced < 64
+        assert eng.cancel(req.rid)
+        assert req.cancelled and len(req.output_tokens) == produced
+        # The row is free for new work and the computed prefix is cached.
+        follow = eng.generate(
+            [prompt + req.output_tokens],
+            SamplingParams(temperature=0.0, max_new_tokens=2),
+        )[0]
+        assert len(follow) == 2
+        assert eng.stats.cached_tokens > 0
+
+    def test_cancel_unknown_rid(self, model):
+        eng = make_engine(model)
+        assert not eng.cancel(10_000)
