@@ -170,7 +170,10 @@ def _run_serve(args: argparse.Namespace) -> int:
         spec_decode_tokens=args.spec_decode_tokens,
         kv_quant=args.kv_quant,
     )
-    frontend = ServingFrontend(engine, host=args.host, port=args.http_port)
+    frontend = ServingFrontend(
+        engine, host=args.host, port=args.http_port,
+        profile_dir=args.profile_dir,
+    )
     print(f"serving {args.model} on http://{args.host}:{frontend.port}", flush=True)
 
     stop = threading.Event()
@@ -242,6 +245,10 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument(
         "--decode-steps-per-launch", type=int, default=1,
         help="fuse k decode steps per device launch (device-side sampling)",
+    )
+    serve.add_argument(
+        "--profile-dir", default=None,
+        help="enable POST /profile captures into this directory",
     )
     serve.add_argument(
         "--kv-quant", choices=["int8"], default=None,

@@ -26,6 +26,7 @@ be layered client-side.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -131,9 +132,22 @@ class _FrontendServer(ThreadingHTTPServer):
 class ServingFrontend:
     """HTTP API over one serving engine."""
 
-    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        engine: Engine,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        profile_dir: str | None = None,
+    ):
         self.runner = EngineRunner(engine).start()
         self.log = get_logger("http.serve")
+        # /profile writes ONLY under this operator-configured directory
+        # (None = endpoint disabled): a network peer must never choose
+        # filesystem paths for the server.
+        self.profile_dir = profile_dir
+        self._profile_lock = threading.Lock()
+        self._profile_seq_lock = threading.Lock()
+        self._profile_seq = 0
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -169,6 +183,49 @@ class ServingFrontend:
                     _json_response(self, 404, {"error": "not found"})
 
             def do_POST(self):
+                if self.path == "/profile":
+                    # Capture a device+host trace of live serving into a
+                    # server-configured logdir (obs/tracing.py::profile —
+                    # exception-safe stop; SURVEY §5: the reference has no
+                    # tracing at all). Clients never supply paths; each
+                    # capture lands in a fresh numbered subdirectory.
+                    if frontend.profile_dir is None:
+                        _json_response(
+                            self, 403,
+                            {"error": "profiling disabled (no --profile-dir)"},
+                        )
+                        return
+                    try:
+                        body = _read_json(self)
+                        seconds = float(body.get("seconds", 3.0))
+                        if not (0.0 < seconds <= 60.0):
+                            raise ValueError("seconds must be in (0, 60]")
+                    except (TypeError, ValueError, json.JSONDecodeError) as e:
+                        _json_response(self, 400, {"error": str(e)})
+                        return
+                    if not frontend._profile_lock.acquire(blocking=False):
+                        _json_response(self, 409, {"error": "profile already running"})
+                        return
+                    try:
+                        from radixmesh_tpu.obs.tracing import profile as _profile
+
+                        with frontend._profile_seq_lock:
+                            frontend._profile_seq += 1
+                            logdir = os.path.join(
+                                frontend.profile_dir,
+                                f"capture-{frontend.profile_seq_str()}",
+                            )
+                        with _profile(logdir):
+                            time.sleep(seconds)
+                    except Exception as e:  # noqa: BLE001 — report, don't kill the handler
+                        _json_response(self, 500, {"error": str(e)})
+                        return
+                    finally:
+                        frontend._profile_lock.release()
+                    _json_response(
+                        self, 200, {"profiled_s": seconds, "logdir": logdir}
+                    )
+                    return
                 if self.path == "/cancel":
                     try:
                         rid = int(_read_json(self)["rid"])
@@ -255,6 +312,9 @@ class ServingFrontend:
         )
         self._thread.start()
         self.log.info("serving frontend on %s:%d", host, self.port)
+
+    def profile_seq_str(self) -> str:
+        return f"{self._profile_seq:04d}"
 
     def close(self) -> None:
         self._server.shutdown()

@@ -222,3 +222,41 @@ class TestLaunchCLI:
 
         with pytest.raises(SystemExit):
             main(["node"])
+
+
+class TestProfileEndpoint:
+    def test_profile_captures_trace(self, tmp_path):
+        import os
+
+        cfg = ModelConfig.tiny()
+        eng = Engine(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                     num_slots=64, page_size=4, max_batch=1, name="http-prof")
+        f = ServingFrontend(eng, port=0, profile_dir=str(tmp_path))
+        try:
+            code, body = _post(
+                f"http://127.0.0.1:{f.port}/profile", {"seconds": 0.2}
+            )
+            assert code == 200, body
+            assert body["logdir"].startswith(str(tmp_path))
+            files = [x for _, _, fs in os.walk(body["logdir"]) for x in fs]
+            assert files, "no trace artifacts written"
+        finally:
+            f.close()
+
+    def test_profile_disabled_and_bad_duration(self, tmp_path, frontend):
+        import urllib.error
+
+        # fixture frontend has no profile_dir → 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"http://127.0.0.1:{frontend.port}/profile", {"seconds": 1})
+        assert ei.value.code == 403
+        cfg = ModelConfig.tiny()
+        eng = Engine(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                     num_slots=64, page_size=4, max_batch=1, name="http-prof2")
+        f = ServingFrontend(eng, port=0, profile_dir=str(tmp_path))
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{f.port}/profile", {"seconds": -1})
+            assert ei.value.code == 400
+        finally:
+            f.close()
