@@ -18,6 +18,9 @@ the north-star metric (``BASELINE.json``).
 
 from __future__ import annotations
 
+import math
+import threading
+import time
 from dataclasses import dataclass
 from typing import Sequence
 
@@ -46,8 +49,46 @@ class RouteResult:
     match_len: int = 0
 
 
+class _LoadTracker:
+    """Leaky-bucket in-flight estimate per address: each routed request
+    adds one unit; units decay exponentially with ``tau`` seconds (the
+    router never sees completions, so decay stands in for them)."""
+
+    def __init__(self, tau_s: float):
+        self.tau = tau_s
+        # One lock: /route runs on concurrent ThreadingHTTPServer handler
+        # threads, and an unlocked read-modify-write would undercount the
+        # hot node exactly when shedding matters.
+        self._lock = threading.Lock()
+        self._load: dict[str, float] = {}
+        self._t: dict[str, float] = {}
+
+    def _decayed(self, addr: str, now: float) -> float:
+        last = self._t.get(addr)
+        if last is None:
+            return 0.0
+        return self._load[addr] * math.exp(-(now - last) / self.tau)
+
+    def note(self, addr: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._load[addr] = self._decayed(addr, now) + 1.0
+            self._t[addr] = now
+
+    def load(self, addr: str) -> float:
+        with self._lock:
+            return self._decayed(addr, time.monotonic())
+
+
 class CacheAwareRouter:
-    def __init__(self, mesh_cache: MeshCache, config: MeshConfig):
+    def __init__(
+        self,
+        mesh_cache: MeshCache,
+        config: MeshConfig,
+        overload_factor: float | None = 3.0,
+        overload_floor: float = 8.0,
+        load_tau_s: float = 10.0,
+    ):
         if not config.prefill_nodes or not config.decode_nodes:
             raise ValueError("router needs at least one prefill and one decode node")
         self.mesh_cache = mesh_cache
@@ -55,6 +96,24 @@ class CacheAwareRouter:
         self._warm_up = True
         self._prefill_ring = ConsistentHash(config.prefill_nodes)
         self._decode_ring = ConsistentHash(config.decode_nodes)
+        # Hot-prefix overload protection (net-new; the reference always
+        # follows the cache): when a cache hit points at a node whose
+        # estimated in-flight load exceeds ``overload_factor`` x the
+        # role's mean (and at least ``overload_floor`` absolute — light
+        # traffic never sheds), the request takes the hash-ring fallback
+        # instead: one recomputed prefix beats a convoy on the hot node.
+        # ``overload_factor=None`` disables shedding.
+        self.overload_factor = overload_factor
+        self.overload_floor = overload_floor
+        self._loads = _LoadTracker(load_tau_s)
+        # Mutated by _on_view_change on the mesh transport-reader thread
+        # while /route handler threads read it: guard with a lock (the
+        # hash rings guard their own state the same way).
+        self._alive_lock = threading.Lock()
+        self._alive = {
+            "prefill": set(config.prefill_nodes),
+            "decode": set(config.decode_nodes),
+        }
         reg = get_registry()
         routed = reg.counter(
             "router_requests_total",
@@ -66,7 +125,7 @@ class CacheAwareRouter:
         self._m_routed = {
             (role, outcome): routed.labels(role=role, outcome=outcome)
             for role in ("prefill", "decode")
-            for outcome in ("hit", "fallback")
+            for outcome in ("hit", "fallback", "shed")
         }
         self._m_route_latency = reg.histogram(
             "router_route_seconds", "cache-aware routing decision latency"
@@ -86,10 +145,14 @@ class CacheAwareRouter:
 
     def add_node(self, role: str, addr: str) -> None:
         (self._prefill_ring if role == "prefill" else self._decode_ring).add_node(addr)
+        with self._alive_lock:
+            self._alive[role].add(addr)
 
     def remove_node(self, role: str, addr: str) -> None:
         ring = self._prefill_ring if role == "prefill" else self._decode_ring
         ring.remove_node(addr)
+        with self._alive_lock:
+            self._alive[role].discard(addr)
 
     def watch_topology(self) -> None:
         """Subscribe to the mesh replica's epoch-numbered view changes
@@ -110,6 +173,25 @@ class CacheAwareRouter:
                 self.config.addr_of_rank(rank),
             )
 
+    def _overloaded(self, role: str, addr: str) -> bool:
+        if self.overload_factor is None:
+            return False
+        with self._alive_lock:
+            alive = set(self._alive[role])  # snapshot vs concurrent view changes
+        alive.add(addr)  # the routed target counts even if it just left the view
+        if len(alive) <= 1:
+            return False  # nowhere to shed to
+        target = self._loads.load(addr)
+        if target < self.overload_floor:
+            return False
+        # Compare against the OTHER nodes' mean: including the target in
+        # the mean makes the threshold unreachable for factor >= n (with
+        # 2 nodes and factor 3 a convoy would never shed). Idle peers
+        # (others_mean ~ 0) shed as soon as the floor is crossed.
+        others = [self._loads.load(a) for a in alive if a != addr]
+        others_mean = sum(others) / len(others)
+        return target > self.overload_factor * others_mean
+
     def cache_aware_route(self, key: Sequence[int]) -> RouteResult:
         """Route one request's token ids (reference ``:23-39``)."""
         with self._m_route_latency.time():
@@ -124,25 +206,42 @@ class CacheAwareRouter:
                 "cache_aware_route requires a ROUTER-mode MeshCache"
             )
 
+        p_out = d_out = None
         if match.prefill_rank >= 0:
             prefill_addr = self.config.prefill_addr(match.prefill_rank)
             p_hit = True
+            if self._overloaded("prefill", prefill_addr):
+                shed = self._prefill_ring.get_node(key, exclude={prefill_addr})
+                if shed is not None:
+                    prefill_addr, p_hit, p_out = shed, False, "shed"
         else:
             prefill_addr = self._prefill_ring.get_node(key)
             p_hit = False
         if match.decode_rank >= 0:
             decode_addr = self.config.decode_addr(match.decode_rank)
             d_hit = True
+            if self._overloaded("decode", decode_addr):
+                shed = self._decode_ring.get_node(key, exclude={decode_addr})
+                if shed is not None:
+                    decode_addr, d_hit, d_out = shed, False, "shed"
         else:
             decode_addr = self._decode_ring.get_node(key)
             d_hit = False
-        self._m_routed[("prefill", "hit" if p_hit else "fallback")].inc()
-        self._m_routed[("decode", "hit" if d_hit else "fallback")].inc()
+        if prefill_addr is not None:
+            self._loads.note(prefill_addr)
+        if decode_addr is not None:
+            self._loads.note(decode_addr)
+        self._m_routed[("prefill", p_out or ("hit" if p_hit else "fallback"))].inc()
+        self._m_routed[("decode", d_out or ("hit" if d_hit else "fallback"))].inc()
         self._m_match_len.observe(match.match_len if (p_hit or d_hit) else 0)
+        # match_len only counts when a ROUTED address actually holds the
+        # match (post-shedding): a shed request lands on a node without
+        # the prefix, and reporting cached tokens there would inflate the
+        # hit-rate the north-star metric watches.
         return RouteResult(
             prefill_addr=prefill_addr,
             decode_addr=decode_addr,
             prefill_cache_hit=p_hit,
             decode_cache_hit=d_hit,
-            match_len=match.match_len if match.prefill_rank >= 0 or match.decode_rank >= 0 else 0,
+            match_len=match.match_len if (p_hit or d_hit) else 0,
         )

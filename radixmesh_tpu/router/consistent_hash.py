@@ -56,8 +56,15 @@ class ConsistentHash:
                     self._ring.remove(h)
                     del self._owner[h]
 
-    def get_node(self, key: Sequence[int] | bytes | str) -> str | None:
-        """Owner of ``key``: first ring point clockwise from hash(key)."""
+    def get_node(
+        self,
+        key: Sequence[int] | bytes | str,
+        exclude: set[str] | None = None,
+    ) -> str | None:
+        """Owner of ``key``: first ring point clockwise from hash(key)
+        whose owner is not in ``exclude`` (overload shedding needs the
+        next-best owner when the natural one is the node being avoided);
+        ``None`` when every owner is excluded."""
         if not self._ring:
             return None
         if isinstance(key, str):
@@ -71,9 +78,11 @@ class ConsistentHash:
             if not self._ring:
                 return None
             idx = bisect.bisect_right(self._ring, h)
-            if idx == len(self._ring):  # wraparound
-                idx = 0
-            return self._owner[self._ring[idx]]
+            for step in range(len(self._ring)):
+                owner = self._owner[self._ring[(idx + step) % len(self._ring)]]
+                if not exclude or owner not in exclude:
+                    return owner
+            return None
 
     def __len__(self) -> int:
         with self._lock:

@@ -146,7 +146,6 @@ class ServingFrontend:
         # filesystem paths for the server.
         self.profile_dir = profile_dir
         self._profile_lock = threading.Lock()
-        self._profile_seq_lock = threading.Lock()
         self._profile_seq = 0
         frontend = self
 
@@ -209,12 +208,15 @@ class ServingFrontend:
                     try:
                         from radixmesh_tpu.obs.tracing import profile as _profile
 
-                        with frontend._profile_seq_lock:
-                            frontend._profile_seq += 1
-                            logdir = os.path.join(
-                                frontend.profile_dir,
-                                f"capture-{frontend.profile_seq_str()}",
-                            )
+                        # _profile_lock is held: the seq needs no lock of
+                        # its own. The timestamp keeps directories unique
+                        # across server restarts into the same base dir.
+                        frontend._profile_seq += 1
+                        logdir = os.path.join(
+                            frontend.profile_dir,
+                            f"capture-{int(time.time())}-"
+                            f"{frontend._profile_seq:04d}",
+                        )
                         with _profile(logdir):
                             time.sleep(seconds)
                     except Exception as e:  # noqa: BLE001 — report, don't kill the handler
@@ -312,9 +314,6 @@ class ServingFrontend:
         )
         self._thread.start()
         self.log.info("serving frontend on %s:%d", host, self.port)
-
-    def profile_seq_str(self) -> str:
-        return f"{self._profile_seq:04d}"
 
     def close(self) -> None:
         self._server.shutdown()

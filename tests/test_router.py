@@ -157,3 +157,82 @@ class TestCacheAwareRouter:
         router.finish_warm_up()
         with pytest.raises(AssertionError):
             router.cache_aware_route([1, 2])
+
+
+class TestOverloadShedding:
+    """Hot-prefix protection: a cache hit pointing at a node whose
+    estimated in-flight load is far above the role's mean takes the hash
+    fallback instead — one recomputed prefix beats a convoy."""
+
+    def _router(self, cluster, **kw) -> CacheAwareRouter:
+        node = next(n for n in cluster if n.role is NodeRole.ROUTER)
+        r = CacheAwareRouter(node, node.cfg, **kw)
+        r.finish_warm_up()
+        return r
+
+    def _advertise(self, cluster, router, key, writer=1):
+        slots = cluster[writer].pool.alloc(len(key))
+        cluster[writer].insert(key, slots)
+        assert wait_for(
+            lambda: router.mesh_cache.match_prefix(key).prefill_rank == writer
+        )
+
+    def test_hot_prefix_sheds_past_threshold(self, cluster):
+        router = self._router(cluster, overload_factor=1.5, overload_floor=5.0)
+        key = [3, 1, 4]
+        self._advertise(cluster, router, key)
+        hot = cluster[1].cfg.prefill_addr(1)
+        addrs = [router.cache_aware_route(key).prefill_addr for _ in range(60)]
+        assert addrs[0] == hot  # cold: follow the cache
+        assert any(a != hot for a in addrs), "overload never shed"
+        # Shedding is temporary pressure relief, not a ban: the hot node
+        # must receive traffic again AFTER the first shed (the shed
+        # target accumulates load, pulling the ratio back down).
+        first_shed = next(i for i, a in enumerate(addrs) if a != hot)
+        assert any(a == hot for a in addrs[first_shed + 1 :]), (
+            "hot node permanently banned after first shed"
+        )
+
+    def test_default_settings_shed_when_peers_idle(self, cluster):
+        # The DEFAULT factor must be reachable (the threshold compares
+        # against the OTHER nodes' mean): a hot node with an idle peer
+        # sheds once the floor is crossed.
+        router = self._router(cluster)  # defaults: factor 3.0, floor 8.0
+        key = [6, 2, 8]
+        self._advertise(cluster, router, key)
+        hot = cluster[1].cfg.prefill_addr(1)
+        addrs = [router.cache_aware_route(key).prefill_addr for _ in range(40)]
+        assert any(a != hot for a in addrs), "default config never shed"
+
+    def test_shed_result_reports_no_match(self, cluster):
+        router = self._router(cluster, overload_factor=1.5, overload_floor=5.0)
+        key = [8, 8, 3]
+        self._advertise(cluster, router, key)
+        hot = cluster[1].cfg.prefill_addr(1)
+        shed = [
+            r
+            for r in (router.cache_aware_route(key) for _ in range(60))
+            if r.prefill_addr != hot
+        ]
+        assert shed, "never shed"
+        for r in shed:  # routed node lacks the prefix → no hit, no match_len
+            assert not r.prefill_cache_hit
+            assert r.match_len == 0
+
+    def test_disabled_never_sheds(self, cluster):
+        router = self._router(cluster, overload_factor=None)
+        key = [2, 7, 1]
+        self._advertise(cluster, router, key)
+        hot = cluster[1].cfg.prefill_addr(1)
+        assert all(
+            router.cache_aware_route(key).prefill_addr == hot for _ in range(40)
+        )
+
+    def test_light_traffic_never_sheds(self, cluster):
+        router = self._router(cluster, overload_factor=1.5, overload_floor=50.0)
+        key = [9, 9, 1]
+        self._advertise(cluster, router, key)
+        hot = cluster[1].cfg.prefill_addr(1)
+        assert all(
+            router.cache_aware_route(key).prefill_addr == hot for _ in range(30)
+        )
