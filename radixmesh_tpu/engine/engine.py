@@ -246,6 +246,7 @@ class Engine:
         )
         self._temps = np.zeros(max_batch, dtype=np.float32)
         self._top_ps = np.ones(max_batch, dtype=np.float32)
+        self._top_ks = np.zeros(max_batch, dtype=np.int32)
         self._rng = jax.random.PRNGKey(rng_seed)
         self.stats = EngineStats()
 
@@ -574,6 +575,7 @@ class Engine:
             self._tokens[row] = req.output_tokens[-1]
         self._temps[row] = req.sampling.temperature
         self._top_ps[row] = req.sampling.top_p
+        self._top_ks[row] = req.sampling.top_k
         self._page_table[row] = self._scratch_page
         n_pages = -(-req.kv_len // self.page_size)
         self._page_table[row, :n_pages] = (
@@ -597,6 +599,7 @@ class Engine:
         logits = [logit for _, logit in pending]
         temps = [r.sampling.temperature for r, _ in pending]
         tops = [r.sampling.top_p for r, _ in pending]
+        topks = [r.sampling.top_k for r, _ in pending]
         pad = n_b - n
         sampled = np.asarray(
             sample_tokens(
@@ -604,6 +607,7 @@ class Engine:
                 key,
                 temperature=jnp.asarray(temps + [0.0] * pad, jnp.float32),
                 top_p=jnp.asarray(tops + [1.0] * pad, jnp.float32),
+                top_k=jnp.asarray(topks + [0] * pad, jnp.int32),
             )
         )[:n]
         now = time.monotonic()
@@ -938,6 +942,7 @@ class Engine:
             sample_tokens(
                 logits, key, temperature=jnp.asarray(self._temps),
                 top_p=jnp.asarray(self._top_ps),
+                top_k=jnp.asarray(self._top_ks),
             )
         )
         self.stats.decode_steps += 1
@@ -995,6 +1000,7 @@ class Engine:
             k_steps=k,
             mesh=self.device_mesh,
             kv_scale=self.pool.kv_scale,
+            top_ks=jnp.asarray(self._top_ks),
         )
         sampled = self._commit_pool_update(res)
         sampled = np.asarray(sampled)  # [k, B] — the ONE round trip
@@ -1158,6 +1164,7 @@ class Engine:
             key,
             jnp.asarray(self._temps),
             jnp.asarray(self._top_ps),
+            jnp.asarray(self._top_ks),
         )
         accept_len = np.asarray(accept_len)  # [B] one sync
         bonus = np.asarray(bonus)

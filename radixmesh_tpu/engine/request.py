@@ -17,6 +17,7 @@ _rid_counter = itertools.count()
 class SamplingParams:
     temperature: float = 0.0  # 0 = greedy
     top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
     max_new_tokens: int = 16
     stop_token_ids: tuple[int, ...] = ()
 

@@ -493,6 +493,7 @@ def decode_multi(
     k_steps: int = 8,
     mesh=None,
     kv_scale: jnp.ndarray | None = None,
+    top_ks: jnp.ndarray | int = 0,  # [B] (0 = off)
 ):
     """``k_steps`` decode iterations fused in ONE dispatch: sampling stays
     on device and each sampled token feeds the next step, so the host pays
@@ -523,7 +524,7 @@ def decode_multi(
             scale = res[2]
         k, sk = jax.random.split(k)
         nxt = sample_tokens(
-            logits, sk, temperature=temperatures, top_p=top_ps
+            logits, sk, temperature=temperatures, top_p=top_ps, top_k=top_ks
         ).astype(jnp.int32)
         return (nxt, pool, scale, k), nxt
 

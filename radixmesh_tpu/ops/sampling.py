@@ -5,8 +5,6 @@ point-mass draft)."""
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
@@ -15,19 +13,29 @@ def _filtered_logits(
     logits: jnp.ndarray,
     temperature: jnp.ndarray,  # broadcastable to logits.shape[:-1]
     top_p: jnp.ndarray,
-    top_k: int = 0,
+    top_k: jnp.ndarray | int = 0,
 ) -> jnp.ndarray:
     """Temperature-scaled logits with top-k/top-p masking (-inf outside
     the nucleus) — the distribution both the sampler and the speculative
-    verifier must agree on."""
+    verifier must agree on. ``top_k`` may be a per-row array (0 = off);
+    the kth threshold is a per-row gather on the sorted logits, so k
+    stays dynamic without recompiling."""
     t = jnp.maximum(temperature, 1e-6)[..., None]
     scaled = logits.astype(jnp.float32) / t
-    if top_k > 0:
-        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
-        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), scaled.shape[:-1])
+    V = scaled.shape[-1]
+    # ONE sort serves both filters: the per-row kth threshold is a gather
+    # on the ascending sort (rows with k == 0 use k = V, a no-op), and
+    # the descending sorted view for top-p is the same sort reversed with
+    # the below-threshold prefix masked — no second O(V log V) pass on
+    # the per-token hot path.
+    k_eff = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    asc = jnp.sort(scaled, axis=-1)  # ascending
+    kth = jnp.take_along_axis(asc, (V - k_eff)[..., None], axis=-1)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
     # top-p (nucleus): keep the smallest set of tokens with cumulative
     # probability >= top_p, always including the argmax.
-    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    sorted_logits = jnp.where(asc < kth, -jnp.inf, asc)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_mask = cum - probs >= top_p[..., None]
@@ -44,12 +52,12 @@ def _filtered_logits(
     return jnp.where(scaled < threshold, -jnp.inf, scaled)
 
 
-@partial(jax.jit, static_argnames=("top_k",))
+@jax.jit
 def sample_tokens(
     logits: jnp.ndarray,
     rng: jax.Array,
     temperature: float | jnp.ndarray = 0.0,
-    top_k: int = 0,
+    top_k: int | jnp.ndarray = 0,
     top_p: float | jnp.ndarray = 1.0,
 ) -> jnp.ndarray:
     """Sample one token id per row of ``logits`` [..., vocab].
@@ -57,9 +65,9 @@ def sample_tokens(
     ``temperature==0`` → greedy. ``top_k``/``top_p`` filter before the
     categorical draw. All paths execute; selection is by ``jnp.where`` so a
     single compiled executable serves every setting of the dynamic args.
-    ``temperature``/``top_p`` may be scalars or per-row arrays of shape
-    ``logits.shape[:-1]`` (the continuous-batching engine passes one value
-    per batch row).
+    ``temperature``/``top_k``/``top_p`` may be scalars or per-row arrays
+    of shape ``logits.shape[:-1]`` (the continuous-batching engine passes
+    one value per batch row; ``top_k`` is dynamic — no recompile per k).
     """
     greedy = jnp.argmax(logits, axis=-1)
     temperature = jnp.broadcast_to(
@@ -81,6 +89,7 @@ def spec_verify_sample(
     rng: jax.Array,
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray | int = 0,  # [B] (0 = off)
 ):
     """Exact speculative verification against a point-mass draft.
 
@@ -102,8 +111,9 @@ def spec_verify_sample(
     temperature = jnp.asarray(temperature, jnp.float32)
     top_p = jnp.asarray(top_p, jnp.float32)
     greedy_row = temperature <= 0.0  # [B]
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), temperature.shape)
     filtered = _filtered_logits(
-        logits, temperature[:, None], top_p[:, None]
+        logits, temperature[:, None], top_p[:, None], top_k[:, None]
     )  # [B, C, V]
     probs = jax.nn.softmax(filtered, axis=-1)
     greedy_tok = jnp.argmax(logits, axis=-1)  # [B, C]

@@ -251,10 +251,11 @@ class ServingFrontend:
                     sampling = SamplingParams(
                         temperature=float(body.get("temperature", 0.0)),
                         top_p=float(body.get("top_p", 1.0)),
+                        top_k=int(body.get("top_k", 0)),
                         max_new_tokens=int(body.get("max_tokens", 16)),
                         stop_token_ids=tuple(body.get("stop_token_ids", ())),
                     )
-                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
                     _json_response(self, 400, {"error": str(e)})
                     return
                 try:

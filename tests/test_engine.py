@@ -271,3 +271,47 @@ class TestCancel:
     def test_cancel_unknown_rid(self, model):
         eng = make_engine(model)
         assert not eng.cancel(10_000)
+
+
+class TestTopK:
+    def test_top_k_one_is_greedy(self, model):
+        cfg, params = model
+        prompt = prompts_rng().integers(1, cfg.vocab_size, 9).tolist()
+        ref = make_engine(model).generate(
+            [prompt], SamplingParams(temperature=0.0, max_new_tokens=8)
+        )[0]
+        # k=1 restricts sampling to the argmax even at high temperature.
+        out = make_engine(model).generate(
+            [prompt],
+            SamplingParams(temperature=1.5, top_k=1, max_new_tokens=8),
+        )[0]
+        assert out == ref
+
+    def test_per_row_top_k_mixed_batch(self, model):
+        cfg, params = model
+        rng = prompts_rng()
+        prompts = [rng.integers(1, cfg.vocab_size, n).tolist() for n in (7, 9)]
+        eng = make_engine(model)
+        r1 = eng.add_request(
+            prompts[0], SamplingParams(temperature=1.2, top_k=1, max_new_tokens=6)
+        )
+        r2 = eng.add_request(
+            prompts[1], SamplingParams(temperature=0.8, max_new_tokens=6)
+        )
+        while eng.has_work():
+            eng.step()
+        ref = make_engine(model).generate(
+            [prompts[0]], SamplingParams(temperature=0.0, max_new_tokens=6)
+        )[0]
+        assert r1.output_tokens == ref  # k=1 row is effectively greedy
+        assert len(r2.output_tokens) == 6
+
+    def test_top_k_with_multi_step_and_spec(self, model):
+        cfg, params = model
+        prompt = (prompts_rng().integers(1, cfg.vocab_size, 5).tolist()) * 3
+        sp = SamplingParams(temperature=1.0, top_k=1, max_new_tokens=9)
+        ref = make_engine(model).generate([prompt], sp)[0]
+        multi = make_engine(model, decode_steps_per_launch=3)
+        assert multi.generate([prompt], sp)[0] == ref
+        spec = make_engine(model, spec_decode_tokens=3)
+        assert spec.generate([prompt], sp)[0] == ref
