@@ -300,6 +300,7 @@ def pack_handoff(pkt: HandoffPacket) -> bytes:
             "sampling": {
                 "temperature": pkt.sampling.temperature,
                 "top_p": pkt.sampling.top_p,
+                "top_k": pkt.sampling.top_k,
                 "max_new_tokens": pkt.sampling.max_new_tokens,
                 "stop_token_ids": list(pkt.sampling.stop_token_ids),
             },
@@ -331,6 +332,7 @@ def unpack_handoff(data: bytes) -> HandoffPacket:
         sampling=SamplingParams(
             temperature=s["temperature"],
             top_p=s["top_p"],
+            top_k=s.get("top_k", 0),  # absent in pre-top-k packets
             max_new_tokens=s["max_new_tokens"],
             stop_token_ids=tuple(s["stop_token_ids"]),
         ),

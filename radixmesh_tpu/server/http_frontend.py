@@ -176,6 +176,8 @@ class ServingFrontend:
                             "generated_tokens": s.generated_tokens,
                             "finished": s.finished,
                             "preemptions": s.preemptions,
+                            "spec_proposed": s.spec_proposed,
+                            "spec_accepted": s.spec_accepted,
                         },
                     )
                 else:

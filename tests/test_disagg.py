@@ -259,3 +259,27 @@ class TestQuantizedHandoff:
         )
         dw.run_until_drained()
         assert len(req.generated) == 6
+
+
+class TestTopKHandoff:
+    def test_top_k_survives_the_wire(self, model):
+        pw = make_prefill(model)
+        pkt = pw.prefill_handoff(
+            [1, 2, 3, 4, 5],
+            SamplingParams(temperature=1.3, top_k=1, max_new_tokens=4),
+        )
+        pkt2 = unpack_handoff(pack_handoff(pkt))
+        assert pkt2.sampling.top_k == 1
+
+    def test_disagg_top_k_one_matches_greedy(self, model):
+        # k=1 at high temperature must stay greedy ACROSS the handoff.
+        want = collocated_generate(model, [[7, 7, 2, 9, 1]], 6)
+        pw, dw = make_prefill(model), make_decode(model)
+        req = dw.submit(
+            pw.prefill_handoff(
+                [7, 7, 2, 9, 1],
+                SamplingParams(temperature=1.3, top_k=1, max_new_tokens=6),
+            )
+        )
+        dw.run_until_drained()
+        assert req.generated == want[0]
