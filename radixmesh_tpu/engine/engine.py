@@ -337,6 +337,14 @@ class Engine:
                 return True
         return False
 
+    def cancel_all(self) -> int:
+        """Abort every queued and running request (shutdown sweep).
+        Returns the number cancelled."""
+        rids = [r.rid for r in self.waiting] + [
+            r.rid for r in self._rows if r is not None
+        ]
+        return sum(1 for rid in rids if self.cancel(rid))
+
     def step(self) -> None:
         """One scheduler iteration: admit+prefill queued requests into free
         rows, then one batched decode step for everything running."""

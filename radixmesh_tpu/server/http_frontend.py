@@ -50,6 +50,7 @@ class EngineRunner:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
+        self._closed = False
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="engine-runner"
         )
@@ -59,7 +60,20 @@ class EngineRunner:
         self._thread.start()
         return self
 
-    def close(self) -> None:
+    def close(self, drain_s: float = 0.0) -> None:
+        """Stop the scheduler thread. With ``drain_s`` > 0, give in-flight
+        requests that long to finish first (then cancel the stragglers so
+        no waiter blocks on a request that will never be stepped again)."""
+        if drain_s > 0:
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self.engine.has_work():
+                        break
+                time.sleep(0.02)
+        with self._lock:
+            self._closed = True  # reject submits racing the sweep
+            self.engine.cancel_all()
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=5)
@@ -68,6 +82,10 @@ class EngineRunner:
         self, prompt: Sequence[int], sampling: SamplingParams | None = None
     ) -> Request:
         with self._lock:
+            if self._closed:
+                # After the shutdown cancel sweep nothing steps the engine
+                # again; admitting would strand the waiter forever.
+                raise RuntimeError("engine runner is shut down")
             req = self.engine.add_request(prompt, sampling)
         self._wake.set()
         return req
@@ -318,10 +336,10 @@ class ServingFrontend:
         self._thread.start()
         self.log.info("serving frontend on %s:%d", host, self.port)
 
-    def close(self) -> None:
+    def close(self, drain_s: float = 5.0) -> None:
         self._server.shutdown()
         self._server.server_close()
-        self.runner.close()
+        self.runner.close(drain_s=drain_s)
 
 
 class RouterFrontend:

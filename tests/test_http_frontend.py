@@ -260,3 +260,34 @@ class TestProfileEndpoint:
             assert ei.value.code == 400
         finally:
             f.close()
+
+
+class TestGracefulShutdown:
+    def test_close_cancels_stragglers(self):
+        """Waiters must not hang on requests the stopped scheduler will
+        never step again: close() cancels them, so wait() returns with
+        partial output and the cancelled flag set."""
+        import time
+
+        from radixmesh_tpu.engine.request import RequestState, SamplingParams
+
+        cfg = ModelConfig.tiny()
+        eng = Engine(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                     num_slots=256, page_size=4, max_batch=1, name="http-drain")
+        f = ServingFrontend(eng, port=0)
+        req = f.runner.submit([1, 2, 3], SamplingParams(max_new_tokens=500))
+        time.sleep(2.0)  # let some decoding happen
+        f.close(drain_s=0.1)  # too short to finish 500 tokens
+        assert len(req.generated) < 500, (
+            "host decoded 500 tokens in 2s; raise max_new_tokens"
+        )
+        assert req.state is RequestState.FINISHED
+        assert req.cancelled
+        # wait() must return promptly instead of hanging on a request the
+        # stopped scheduler would never finish.
+        f.runner.wait(req, timeout=1.0)
+        # And late submits are refused rather than stranded.
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            f.runner.submit([4, 5], SamplingParams(max_new_tokens=2))
