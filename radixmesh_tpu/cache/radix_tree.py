@@ -376,6 +376,48 @@ class RadixTree:
             return 0
         return self._insert_helper(self.root, key, value, on_conflict)
 
+    def peek_continuation(self, key: Sequence[int], max_tokens: int) -> np.ndarray:
+        """Tokens the cache holds BEYOND ``key`` — the speculative drafter's
+        best guess: if this exact sequence was served before, the published
+        continuation is what the model said last time. Token-wise read-only
+        walk (no splits, no paging truncation — nothing is mutated); at
+        branch points it follows the most recently touched child. Empty
+        when ``key`` diverges from or exhausts the tree."""
+        key = as_key(key)
+        node = self.root
+        i = 0
+        out: list[int] = []
+        while i < len(key):
+            child = node.children.get(self._child_key(key[i:]))
+            if child is None:
+                # Paged child keys bucket by the first FULL page, so only a
+                # ragged tail shorter than one page can still match some
+                # child's edge; with page_size == 1 (or a full-page tail) a
+                # dict miss is definitive — skip the O(children) scan.
+                if self.page_size > 1 and len(key) - i < self.page_size:
+                    child = next(
+                        (
+                            c
+                            for c in node.children.values()
+                            if match_len(c.key, key[i:]) == len(key) - i
+                        ),
+                        None,
+                    )
+                if child is None:
+                    return np.empty(0, dtype=np.int32)
+            m = match_len(child.key, key[i:])
+            if m < len(child.key):
+                if i + m < len(key):
+                    return np.empty(0, dtype=np.int32)  # diverged mid-edge
+                out.extend(int(t) for t in child.key[m : m + max_tokens])
+            i += m
+            node = child
+        cur = node
+        while len(out) < max_tokens and cur.children:
+            cur = max(cur.children.values(), key=lambda c: c.last_access_time)
+            out.extend(int(t) for t in cur.key[: max_tokens - len(out)])
+        return np.asarray(out[:max_tokens], dtype=np.int32)
+
     def evict(
         self,
         num_tokens: int,

@@ -1060,6 +1060,23 @@ class Engine:
 
     def _draft_for(self, req: Request) -> np.ndarray:
         hist = self._sequence_key(req, req.kv_len + 1)
+        # Best drafter first: the radix tree itself. A replayed
+        # conversation (same prompt served before) finds the PREVIOUS
+        # generation's published tokens cached beyond its history — a
+        # near-perfect draft for greedy replays, and the mechanism that
+        # makes speculation a property of the prefix cache rather than of
+        # the request's own text. The walk is O(context), so it only runs
+        # for requests that admitted as near-full prefix hits (replay
+        # candidates) and stops the first time it comes back empty —
+        # novel generations never pay it per launch (_SPEC_WINDOW bounds
+        # their n-gram scan instead).
+        if req.tree_draft_ok and req.prefix_len >= max(
+            0, len(req.prompt) - self.page_size
+        ):
+            cont = self.tree.peek_continuation(hist, self.spec_decode_tokens)
+            if len(cont):
+                return cont
+            req.tree_draft_ok = False
         return self._ngram_draft(
             hist[-self._SPEC_WINDOW :], self.spec_decode_tokens, self.spec_ngram
         )

@@ -52,6 +52,11 @@ class Request:
     )
     lock_node: object = None  # TreeNode protected while RUNNING
     cancelled: bool = False  # aborted by Engine.cancel (output is partial)
+    # Tree-based speculative drafting stays enabled only while it pays:
+    # cleared the first time the tree has no continuation for this
+    # request, so novel generations never re-walk the whole history
+    # every launch (the walk is O(context)).
+    tree_draft_ok: bool = True
     submit_time: float = 0.0
     first_token_time: float = 0.0
 

@@ -283,6 +283,9 @@ class ServingFrontend:
                 except ValueError as e:  # e.g. prompt too long
                     _json_response(self, 400, {"error": str(e)})
                     return
+                except RuntimeError as e:  # submit raced shutdown
+                    _json_response(self, 503, {"error": str(e)})
+                    return
                 if body.get("stream"):
                     self._stream(req)
                     return

@@ -199,3 +199,40 @@ class TestRejectionSamplingExactness:
             tv = 0.5 * np.abs(freq - target).sum()
             assert tv < 0.02, (draft_tok, tv, freq, target)
 
+
+
+class TestTreeDrafts:
+    """The radix cache doubles as the drafter: a replayed request finds
+    the previous generation's published tokens cached beyond its history
+    and accepts them wholesale under greedy decode."""
+
+    def test_peek_continuation_basics(self, model):
+        from radixmesh_tpu.cache.radix_tree import RadixTree
+
+        tree = RadixTree(page_size=1)
+        tree.insert([1, 2, 3, 4, 5, 6], np.arange(6, dtype=np.int32))
+        assert tree.peek_continuation([1, 2, 3], 2).tolist() == [4, 5]
+        assert tree.peek_continuation([1, 2, 3], 10).tolist() == [4, 5, 6]
+        assert tree.peek_continuation([1, 9], 4).size == 0  # diverged
+        assert tree.peek_continuation([1, 2, 3, 4, 5, 6], 4).size == 0  # exhausted
+
+    def test_replay_accepts_heavily_and_matches(self, model):
+        cfg, params = model
+        vanilla = make_engine(model)
+        spec = make_engine(model, spec_decode_tokens=4)
+        prompt = prompts_rng().integers(1, cfg.vocab_size, 13).tolist()
+        sp = SamplingParams(temperature=0.0, max_new_tokens=16)
+        want = vanilla.generate([prompt], sp)
+        first = spec.generate([prompt], sp)
+        assert first == want
+        steps_first = spec.stats.decode_steps
+        # Replay: the tree now holds the full previous sequence, so the
+        # drafter proposes the real continuation every launch.
+        second = spec.generate([prompt], sp)
+        assert second == want
+        assert spec.stats.spec_accepted >= 8, spec.stats
+        assert (spec.stats.decode_steps - steps_first) < steps_first, (
+            "replay did not speed up",
+            spec.stats.decode_steps,
+            steps_first,
+        )
