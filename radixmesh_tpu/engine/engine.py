@@ -1252,4 +1252,8 @@ class Engine:
         req.kv_len = 0
         req.prefix_len = 0
         req.token_slots = np.empty(0, dtype=np.int32)
+        # The retry re-admits against its own just-published generation —
+        # the ideal tree-draft replay — so re-enable tree drafting even if
+        # the first life gave up on it.
+        req.tree_draft_ok = True
         self.waiting.insert(0, req)

@@ -30,6 +30,7 @@ def test_request_storm_drains_and_balances(model, seed):
         max_batch=3,
         spec_decode_tokens=3 if seed % 2 else 0,
         decode_steps_per_launch=2 if seed == 21 else 1,
+        kv_quant="int8" if seed % 3 == 2 else None,
     )
     live: list = []
     done: list = []
