@@ -291,3 +291,82 @@ class TestGracefulShutdown:
 
         with _pytest.raises(RuntimeError):
             f.runner.submit([4, 5], SamplingParams(max_new_tokens=2))
+
+
+class TestConcurrentClients:
+    def test_parallel_submit_cancel_storm(self):
+        """Concurrent clients through the REAL HTTP layer — /generate
+        (some streaming via SSE) racing /cancel: every request must get a
+        response (no stranded handler, no dropped connection) and the
+        engine must drain."""
+        import threading
+        import time as _time
+
+        cfg = ModelConfig.tiny()
+        eng = Engine(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                     num_slots=512, page_size=4, max_batch=3, name="http-conc")
+        f = ServingFrontend(eng, port=0)
+        errors: list = []
+        rids: list = []
+
+        def client(i):
+            rng = np.random.default_rng(100 + i)  # per-thread: race-free + replayable
+            try:
+                n = int(rng.integers(3, 12))
+                prompt = rng.integers(1, cfg.vocab_size, n).tolist()
+                body = {
+                    "input_ids": prompt,
+                    "max_tokens": int(rng.integers(2, 10)),
+                }
+                if i % 4 == 1:
+                    body["stream"] = True
+                if i % 3 == 0:
+                    # Race a cancel against the in-flight generate from a
+                    # second connection (rids are assigned sequentially).
+                    def late_cancel():
+                        _time.sleep(0.05)
+                        try:
+                            _post(
+                                f"http://127.0.0.1:{f.port}/cancel",
+                                {"rid": i},
+                            )
+                        except Exception:  # noqa: BLE001 — unknown rid etc.
+                            pass
+
+                    threading.Thread(target=late_cancel).start()
+                if body.get("stream"):
+                    import urllib.request
+
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{f.port}/generate",
+                        data=json.dumps(body).encode(),
+                        method="POST",
+                    )
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        data = r.read().decode()  # consume the SSE stream
+                    assert "done" in data
+                    rids.append(i)
+                else:
+                    code, resp = _post(
+                        f"http://127.0.0.1:{f.port}/generate", body, timeout=120
+                    )
+                    assert code == 200
+                    rids.append(resp["rid"])
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        try:
+            assert not errors, errors
+            assert len(rids) == 12
+            assert not any(t.is_alive() for t in threads), "stranded client"
+            deadline = _time.monotonic() + 30
+            while eng.has_work() and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            assert not eng.has_work()
+        finally:
+            f.close(drain_s=0.5)
