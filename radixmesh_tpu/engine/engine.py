@@ -154,15 +154,15 @@ class Engine:
         # host round trip per k tokens (decode_multi). 1 = classic
         # step-at-a-time.
         self.decode_steps_per_launch = decode_steps_per_launch
-        # Speculative decoding by prompt lookup (n-gram drafting): propose
-        # the γ tokens that followed the last occurrence of the current
-        # tail n-gram in prompt+output, verify all of them in ONE chunked
-        # forward (``prefill_chunk_paged``, C=γ+1), accept the longest
-        # correct prefix. Decode latency is weight-streaming-bound, so a
-        # verified draft turns γ sequential steps into one matmul-dense
-        # pass — the classic serving win on repetitive continuations
-        # (quotes, code, multi-turn restatements). Greedy rows only;
-        # rejected tail KV is overwritten by later positional writes.
+        # Speculative decoding: draft γ tokens (radix-tree continuation
+        # first — a replayed conversation's cached generation — then
+        # prompt-lookup n-grams), verify all of them in ONE chunked
+        # forward (``prefill_chunk_paged``, C=γ+1), and accept per row —
+        # greedy rows by longest argmax match, stochastic rows by exact
+        # rejection sampling. Decode latency is weight-streaming-bound,
+        # so a verified draft turns γ sequential steps into one
+        # matmul-dense pass; rejected tail KV is overwritten by later
+        # positional writes.
         self.spec_decode_tokens = spec_decode_tokens
         self.spec_ngram = max(2, spec_ngram)
         self.log = get_logger("engine")
@@ -1129,8 +1129,7 @@ class Engine:
         longest argmax-matching draft prefix, stochastic rows accept each
         draft token with its target probability (exact rejection sampling)
         — and emit one bonus token. Fed positions' K/V is written by the
-        verify pass
-        itself, so accepted tokens cost no extra work; rejected positions
+        verify pass itself, so accepted tokens cost no extra work; rejected positions
         hold stale K/V that the next launch overwrites (slots are purely
         positional) and that attention never reads (masked by length)."""
         C = g + 1
@@ -1146,6 +1145,7 @@ class Engine:
             max((r.kv_len + g) // ps + 1 for _, r in active), floor=kv_block
         )
         toks = np.zeros((B, C), dtype=np.int32)
+        draft_len = np.zeros((B,), dtype=np.int32)
         sl = np.full((B, C), self._scratch_slot, dtype=np.int32)
         poss = np.zeros((B, C), dtype=np.int32)
         kvlen = np.zeros((B,), dtype=np.int32)
@@ -1161,6 +1161,7 @@ class Engine:
             pt[row, :n_pages] = self._page_table[row, :n_pages]
             sl[row] = pt[row, pos // ps] * ps + pos % ps
             kvlen[row] = req.kv_len + C
+            draft_len[row] = len(draft)
             self.stats.spec_proposed += len(draft)
             self._m_spec_proposed.inc(len(draft))
 
@@ -1178,9 +1179,6 @@ class Engine:
             kv_scale=self.pool.kv_scale,
         )
         logits = self._commit_pool_update(res)
-        draft_len = np.zeros((B,), dtype=np.int32)
-        for row, _ in active:
-            draft_len[row] = len(drafts[row])
         self._rng, key = jax.random.split(self._rng)
         accept_len, bonus = spec_verify_sample(
             logits,
