@@ -283,3 +283,26 @@ class TestTopKHandoff:
         )
         dw.run_until_drained()
         assert req.generated == want[0]
+
+
+class TestSpecOnDecodeWorker:
+    def test_decode_worker_with_spec_matches_plain(self, model):
+        """Speculative decoding on the decode side of a disaggregated
+        deployment: greedy outputs must match a spec-off decode worker."""
+        prompt = [5, 1, 5, 1, 5, 1, 5, 1]
+        pw = make_prefill(model)
+        dw_plain = make_decode(model)
+        want = dw_plain.submit(
+            pw.prefill_handoff(prompt, SamplingParams(max_new_tokens=10))
+        )
+        dw_plain.run_until_drained()
+
+        pw2 = make_prefill(model)
+        dw_spec = make_decode(model, spec_decode_tokens=3)
+        got = dw_spec.submit(
+            pw2.prefill_handoff(prompt, SamplingParams(max_new_tokens=10))
+        )
+        dw_spec.run_until_drained()
+        assert got.generated == want.generated
+        # The equality must not be vacuous: speculation actually engaged.
+        assert dw_spec.engine.stats.spec_proposed > 0
