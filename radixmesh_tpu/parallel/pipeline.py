@@ -33,7 +33,6 @@ deployment-ready and covered by ``tests/test_pipeline.py``.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any
 
 import jax
 import jax.numpy as jnp
