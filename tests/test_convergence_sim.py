@@ -261,6 +261,38 @@ class TestDupSlotSafety:
                     )
 
 
+def make_storm_cluster(n_prefill=3, n_decode=2, num_slots=512):
+    """Start a full in-proc cluster (P/D ring + router), wait for the
+    startup barrier, and return ``(all_nodes, ring_nodes, router)``."""
+    prefill = [f"p{i}" for i in range(n_prefill)]
+    decode = [f"d{i}" for i in range(n_decode)]
+    nodes: list[MeshCache] = []
+    for addr in prefill + decode + ["r0"]:
+        cfg = MeshConfig(
+            prefill_nodes=prefill,
+            decode_nodes=decode,
+            router_nodes=["r0"],
+            local_addr=addr,
+            protocol="inproc",
+            tick_interval_s=0.05,
+            gc_interval_s=30.0,
+        )
+        pool = (
+            None
+            if cfg.local_role is NodeRole.ROUTER
+            else PagedKVPool(
+                num_slots=num_slots, num_layers=1, num_kv_heads=1, head_dim=2
+            )
+        )
+        nodes.append(MeshCache(cfg, pool=pool))
+    for n in nodes:
+        n.start()
+    for n in nodes:
+        assert n.wait_ready(timeout=10)
+    ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+    return nodes, ring, nodes[-1]
+
+
 @pytest.fixture(autouse=True)
 def fresh_hub():
     InprocHub.reset_default()
@@ -272,33 +304,9 @@ class TestRandomStorm:
     @pytest.mark.parametrize("seed", [11, 23])
     def test_storm_converges_everywhere(self, seed):
         rng = np.random.default_rng(seed)
-        prefill = [f"p{i}" for i in range(3)]
-        decode = [f"d{i}" for i in range(2)]
-        nodes: list[MeshCache] = []
-        for addr in prefill + decode + ["r0"]:
-            cfg = MeshConfig(
-                prefill_nodes=prefill,
-                decode_nodes=decode,
-                router_nodes=["r0"],
-                local_addr=addr,
-                protocol="inproc",
-                tick_interval_s=0.05,
-                gc_interval_s=30.0,
-            )
-            pool = (
-                None
-                if cfg.local_role is NodeRole.ROUTER
-                else PagedKVPool(num_slots=512, num_layers=1, num_kv_heads=1, head_dim=2)
-            )
-            nodes.append(MeshCache(cfg, pool=pool))
+        nodes, ring, router = make_storm_cluster()
         try:
-            for n in nodes:
-                n.start()
-            for n in nodes:
-                assert n.wait_ready(timeout=10)
-            ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
-            router = nodes[-1]
-
+            prefill = [f"p{i}" for i in range(3)]
             ops = random_ops(rng, n_ops=25, n_writers=len(ring))
             for key, rank, _ in ops:
                 writer = ring[rank]
@@ -338,6 +346,75 @@ class TestRandomStorm:
                 }
                 if prefill_ranks:
                     assert route.prefill_rank in prefill_ranks
+        finally:
+            for n in nodes:
+                n.close()
+
+
+class TestDeleteResetStorm:
+    """DELETE/RESET racing INSERT across the ring. Cross-origin
+    delete/insert races are deliberately tolerated (cache semantics — see
+    mesh_cache.py module docstring), so the invariants here are safety,
+    not convergence: no node crashes, allocators stay consistent, and the
+    ring still replicates fresh inserts afterwards."""
+
+    @pytest.mark.parametrize("seed", [5, 17])
+    def test_mixed_op_storm_stays_safe(self, seed):
+        rng = np.random.default_rng(seed)
+        nodes, ring, router = make_storm_cluster()
+        try:
+            keys: list[np.ndarray] = []
+            for _ in range(50):
+                node = ring[rng.integers(0, len(ring))]
+                roll = rng.random()
+                if roll < 0.55 or not keys:
+                    key = rng.integers(0, 9, size=rng.integers(2, 6)).astype(
+                        np.int32
+                    )
+                    slots = node.pool.alloc(len(key))
+                    if slots is not None:
+                        node.insert(key, slots)
+                        keys.append(key)
+                elif roll < 0.85:
+                    node.delete(keys[rng.integers(0, len(keys))])
+                else:
+                    node.reset_all()
+                    keys.clear()
+                if rng.random() < 0.3:
+                    time.sleep(0.01)
+            time.sleep(1.0)
+
+            # Safety: fresh insert still replicates everywhere + routes.
+            writer = ring[0]
+            key = np.array([7, seed % 9, 7], dtype=np.int32)
+            slots = writer.pool.alloc(len(key))
+            assert slots is not None
+            writer.insert(key, slots)
+            assert wait_for(
+                lambda: all(
+                    n.tree.match_prefix(key, split_partial=False).length
+                    == len(key)
+                    for n in ring
+                )
+            ), "post-storm insert did not replicate"
+            assert wait_for(
+                lambda: router.match_prefix(key).match_len == len(key)
+            ), "router replica wedged after DELETE/RESET storm"
+            # Allocator safety on every node: self-rank tree values must
+            # reference live slots (DELETE/RESET freed correctly, never
+            # slots the tree still holds).
+            for n in ring:
+                n.run_gc_round()
+            time.sleep(1.0)
+            for n in ring:
+                for tn in n.tree._all_nodes():
+                    v = tn.value
+                    if (
+                        isinstance(v, PrefillValue)
+                        and v.rank == n.rank
+                        and len(v)
+                    ):
+                        assert n.pool.allocator.is_allocated(v.indices).all()
         finally:
             for n in nodes:
                 n.close()
