@@ -315,3 +315,24 @@ class TestTopK:
         assert multi.generate([prompt], sp)[0] == ref
         spec = make_engine(model, spec_decode_tokens=3)
         assert spec.generate([prompt], sp)[0] == ref
+
+
+class TestSeqLenBoundary:
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"spec_decode_tokens": 3},
+        {"decode_steps_per_launch": 3},
+    ])
+    def test_generation_truncates_at_max_seq_len(self, model, kw):
+        """A budget larger than the remaining context must truncate at
+        max_seq_len on every decode path (plain, speculative, fused) —
+        spec/fused decline near the cap and the plain path finishes."""
+        cfg = model[0]
+        eng = make_engine(model, max_seq_len=32, max_batch=1, **kw)
+        prompt = prompts_rng().integers(1, cfg.vocab_size, 25).tolist()
+        out = eng.generate([prompt], SamplingParams(max_new_tokens=64))[0]
+        assert len(prompt) + len(out) == 32
+        # The (only) row is genuinely released and the engine still serves.
+        assert all(r is None for r in eng._rows)
+        out2 = eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=2))[0]
+        assert len(out2) == 2
