@@ -124,6 +124,13 @@ class Engine:
         # unchanged — only array placement differs. Qwen2-72B cannot serve
         # on one chip by definition; this is its path.
         self.device_mesh = device_mesh
+        # Pipeline-parallel serving (parallel/pp_serving.py): a "pp" mesh
+        # axis shards the LAYER axis of the unchanged param/pool pytrees;
+        # prefill chunks and decode steps route through pp_forward_chunk
+        # while every host-side structure stays identical.
+        self._pp = (
+            device_mesh is not None and device_mesh.shape.get("pp", 1) > 1
+        )
         if device_mesh is not None:
             tp = device_mesh.shape.get("tp", 1)
             if cfg.n_kv_heads % tp or cfg.n_heads % tp:
@@ -131,10 +138,26 @@ class Engine:
                     f"n_heads={cfg.n_heads}/n_kv_heads={cfg.n_kv_heads} must "
                     f"divide tp={tp}"
                 )
-            from radixmesh_tpu.models.llama import param_logical_axes
-            from radixmesh_tpu.parallel.sharding import shard_params
+            if self._pp:
+                if kv_quant is not None:
+                    raise ValueError(
+                        "pp serving does not support a quantized pool yet"
+                    )
+                if cfg.n_layers % device_mesh.shape["pp"]:
+                    raise ValueError(
+                        f"n_layers={cfg.n_layers} is not divisible by "
+                        f"pp={device_mesh.shape['pp']}"
+                    )
+                from radixmesh_tpu.parallel.pp_serving import shard_params_pp
 
-            params = shard_params(params, param_logical_axes(cfg), device_mesh)
+                params = shard_params_pp(params, cfg, device_mesh)
+            else:
+                from radixmesh_tpu.models.llama import param_logical_axes
+                from radixmesh_tpu.parallel.sharding import shard_params
+
+                params = shard_params(
+                    params, param_logical_axes(cfg), device_mesh
+                )
         self.params = params
         self.page_size = page_size
         self.max_batch = max_batch
@@ -194,11 +217,19 @@ class Engine:
             if device_mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
 
-                # [2, L, Hkv, slots, D]: each chip holds its kv-head shard
-                # of every page (kv_pool.py's head-major layout rationale).
-                pool_sharding = NamedSharding(
-                    device_mesh, PartitionSpec(None, None, "tp", None, None)
-                )
+                if self._pp:
+                    from radixmesh_tpu.parallel.pp_serving import pp_pool_spec
+
+                    # Each pipeline stage holds only its own layers' KV,
+                    # each tp chip its kv-head shard.
+                    pool_sharding = NamedSharding(device_mesh, pp_pool_spec())
+                else:
+                    # [2, L, Hkv, slots, D]: each chip holds its kv-head
+                    # shard of every page (kv_pool.py's head-major layout
+                    # rationale).
+                    pool_sharding = NamedSharding(
+                        device_mesh, PartitionSpec(None, None, "tp", None, None)
+                    )
             self.pool = PagedKVPool(
                 num_slots=num_slots,
                 num_layers=cfg.n_layers,
@@ -495,12 +526,18 @@ class Engine:
                     # dense/sp paths attend full-precision and only
                     # quantize at pool.write — fine for bf16 pools, an
                     # invariant break for int8.
-                    if self.pool.quant is None and (
-                        len(sub) == 1 and self._sp_capable(sub[0])
+                    # pp engines prefill exclusively through the chunked
+                    # paged path: it is the pipeline-scheduled one (the
+                    # dense/sp paths would all-gather stage weights).
+                    if (
+                        self.pool.quant is None
+                        and not self._pp
+                        and (len(sub) == 1 and self._sp_capable(sub[0]))
                     ):
                         pending = [self._prefill_sp(*sub[0])]
                     elif (
                         self.pool.quant is None
+                        and not self._pp
                         and len(sub) == 1
                         and len(sub[0][0].prompt) - sub[0][2]
                         <= self.long_prefill_threshold
@@ -677,6 +714,13 @@ class Engine:
         self._install_prefilled(req, row, reuse)
         return (req, logits[0, n_new - 1])
 
+    def _pp_n_micro(self, batch: int) -> int:
+        """GPipe microbatch count for a pp launch: fill every stage when
+        the batch divides, otherwise fall back to one wave (correct, just
+        bubble-bound — batches are pow2-padded so pp=2/4 always divides)."""
+        pp = self.device_mesh.shape["pp"]
+        return pp if batch % pp == 0 else 1
+
     def _sp_capable(self, member: tuple) -> bool:
         """A fresh (no cached prefix) long prompt on a mesh with an sp
         axis prefills sequence-sharded — ring attention over ICI."""
@@ -780,19 +824,37 @@ class Engine:
                         lastpos[i] = nv - 1  # this chunk holds the last token
                 else:
                     kvlen[i] = totals[i]
-            res = prefill_chunk_paged(
-                self.params,
-                self.cfg,
-                jnp.asarray(toks),
-                jnp.asarray(poss),
-                self.pool.kv,
-                jnp.asarray(sl),
-                pt_dev,
-                jnp.asarray(kvlen),
-                page_size=ps,
-                kv_block_pages=kv_block,
-                kv_scale=self.pool.kv_scale,
-            )
+            if self._pp:
+                from radixmesh_tpu.parallel.pp_serving import pp_forward_chunk
+
+                res = pp_forward_chunk(
+                    self.params,
+                    self.cfg,
+                    jnp.asarray(toks),
+                    jnp.asarray(poss),
+                    self.pool.kv,
+                    jnp.asarray(sl),
+                    pt_dev,
+                    jnp.asarray(kvlen),
+                    page_size=ps,
+                    kv_block_pages=kv_block,
+                    mesh=self.device_mesh,
+                    n_micro=self._pp_n_micro(B),
+                )
+            else:
+                res = prefill_chunk_paged(
+                    self.params,
+                    self.cfg,
+                    jnp.asarray(toks),
+                    jnp.asarray(poss),
+                    self.pool.kv,
+                    jnp.asarray(sl),
+                    pt_dev,
+                    jnp.asarray(kvlen),
+                    page_size=ps,
+                    kv_block_pages=kv_block,
+                    kv_scale=self.pool.kv_scale,
+                )
             logits = self._commit_pool_update(res)
             for i in range(N):
                 if lastpos[i] >= 0:
@@ -902,7 +964,11 @@ class Engine:
             # row's history repeats its tail there is nothing to verify,
             # and the plain/fused path emits the same tokens cheaper.
             drafts = {
-                row: self._draft_for(req)
+                row: (
+                    self._draft_for(req)
+                    if self._spec_row_ok(req, g)
+                    else req.prompt[:0]
+                )
                 for row, req in enumerate(self._rows)
                 if req is not None
             }
@@ -942,19 +1008,40 @@ class Engine:
             return
         step_t0 = time.monotonic()
         self._rng, key = jax.random.split(self._rng)
-        res = decode_step(
-            self.params,
-            self.cfg,
-            jnp.asarray(self._tokens),
-            self.pool.kv,
-            jnp.asarray(slots),
-            jnp.asarray(self._page_table),
-            jnp.asarray(lengths),
-            self.page_size,
-            mesh=self.device_mesh,
-            kv_scale=self.pool.kv_scale,
-        )
-        logits = self._commit_pool_update(res)
+        if self._pp:
+            # A decode step is a C=1 chunk through the layer pipeline
+            # (parallel/pp_serving.py) — same page-table attention, same
+            # pool scatter, stage weights never move.
+            from radixmesh_tpu.parallel.pp_serving import pp_forward_chunk
+
+            res = pp_forward_chunk(
+                self.params,
+                self.cfg,
+                jnp.asarray(self._tokens)[:, None],
+                jnp.asarray(lengths - 1)[:, None],
+                self.pool.kv,
+                jnp.asarray(slots)[:, None],
+                jnp.asarray(self._page_table),
+                jnp.asarray(lengths),
+                page_size=self.page_size,
+                mesh=self.device_mesh,
+                n_micro=self._pp_n_micro(self.max_batch),
+            )
+            logits = self._commit_pool_update(res)[:, 0]
+        else:
+            res = decode_step(
+                self.params,
+                self.cfg,
+                jnp.asarray(self._tokens),
+                self.pool.kv,
+                jnp.asarray(slots),
+                jnp.asarray(self._page_table),
+                jnp.asarray(lengths),
+                self.page_size,
+                mesh=self.device_mesh,
+                kv_scale=self.pool.kv_scale,
+            )
+            logits = self._commit_pool_update(res)
         sampled = np.asarray(
             sample_tokens(
                 logits, key, temperature=jnp.asarray(self._temps),
@@ -976,7 +1063,7 @@ class Engine:
         of page-table headroom; prefer single steps while requests wait
         (admission happens between launches, so k steps of lockstep decode
         would delay a queued request's prefill)."""
-        if self.waiting:
+        if self.waiting or self._pp:
             return False
         for req in self._rows:
             if req is None:
@@ -1038,28 +1125,34 @@ class Engine:
                     break  # finished mid-launch: surplus tokens discarded
 
     def _spec_ok(self, g: int) -> bool:
-        """Speculative verification needs page-table headroom for the γ+1
-        verify positions on every active row. Stochastic rows verify by
-        exact rejection sampling (``ops/sampling.py::spec_verify_sample``),
-        so temperature does not disable the path. Like the fused path,
-        plain steps are preferred while requests wait for admission, and
-        rows within one token of their output budget decline (the verify
-        launch's surplus would be discarded — the same bubble
-        ``_multi_step_ok`` avoids)."""
-        if self.waiting:
+        """Speculative decoding is considered whenever rows are active and
+        no request waits for admission (admission happens between
+        launches, so a wide verify launch would delay a queued prefill).
+        Stochastic rows verify by exact rejection sampling
+        (``ops/sampling.py::spec_verify_sample``), so temperature does not
+        disable the path. Budget and headroom limits are per-row
+        (``_spec_row_ok``): a nearly-finished request rides the launch
+        with an empty draft — exactly a plain step for that row — instead
+        of switching speculation off for the whole batch. pp engines
+        decode through the pipeline schedule only (fused/spec launches
+        aren't pp-scheduled yet)."""
+        if self.waiting or self._pp:
             return False
-        any_active = False
-        for row, req in enumerate(self._rows):
-            if req is None:
-                continue
-            any_active = True
-            if req.kv_len + g + 1 > self.max_seq_len:
-                return False
-            if (req.kv_len + g) // self.page_size >= self.max_pages:
-                return False
-            if req.sampling.max_new_tokens - len(req.output_tokens) < 2:
-                return False
-        return any_active
+        return any(req is not None for req in self._rows)
+
+    def _spec_row_ok(self, req: Request, g: int) -> bool:
+        """Per-row speculation gate: the verify window needs γ+1 positions
+        of sequence and page-table headroom, and a row within one token of
+        its output budget gains nothing from a draft (the surplus would be
+        discarded — the same bubble ``_multi_step_ok`` avoids). Failing
+        rows decode normally inside the launch via an empty draft."""
+        if req.kv_len + g + 1 > self.max_seq_len:
+            return False
+        if (req.kv_len + g) // self.page_size >= self.max_pages:
+            return False
+        if req.sampling.max_new_tokens - len(req.output_tokens) < 2:
+            return False
+        return True
 
     # Draft lookup scans at most this many trailing history tokens: the
     # match quality of prompt lookup lives in the recent context, and an
@@ -1107,18 +1200,24 @@ class Engine:
                 return hist[j : j + gamma]
         return hist[:0]
 
-    def _provision_rows(self, extra: int) -> list[tuple[int, "Request"]]:
+    def _provision_rows(
+        self, extra: int, extras: dict[int, int] | None = None
+    ) -> list[tuple[int, "Request"]]:
         """Ensure every active row's page table covers positions
         ``kv_len .. kv_len+extra``; preempt rows the pool can't cover.
         Returns the surviving (row, request) pairs. Shared by the fused
         multi-step and speculative paths (their only difference was the
-        bound)."""
+        bound). ``extras`` overrides the bound per row — the speculative
+        path provisions only each row's actual draft window, so a row that
+        opted out (empty draft) cannot be preempted for headroom it will
+        never write."""
         ps = self.page_size
         preempted: list[Request] = []
         for row, req in enumerate(self._rows):
             if req is None:
                 continue
-            for p_idx in range(req.kv_len // ps, (req.kv_len + extra) // ps + 1):
+            row_extra = extra if extras is None else extras.get(row, extra)
+            for p_idx in range(req.kv_len // ps, (req.kv_len + row_extra) // ps + 1):
                 if self._page_table[row, p_idx] != self._scratch_page:
                     continue  # page already provisioned
                 new = self._alloc_pages(1)
@@ -1143,7 +1242,13 @@ class Engine:
         positional) and that attention never reads (masked by length)."""
         C = g + 1
         ps = self.page_size
-        active = self._provision_rows(g)
+        # Provision only each row's actual verify window (draft + bonus):
+        # an opted-out row (empty draft) needs exactly the one position a
+        # plain step would, so γ positions of headroom it lacks must not
+        # preempt it.
+        active = self._provision_rows(
+            g, extras={row: len(d) for row, d in drafts.items()}
+        )
         if not active:
             return
         step_t0 = time.monotonic()
@@ -1151,7 +1256,11 @@ class Engine:
         B = self.max_batch
         kv_block = 32
         maxp = _pow2_at_least(
-            max((r.kv_len + g) // ps + 1 for _, r in active), floor=kv_block
+            max(
+                (r.kv_len + len(drafts.get(row, r.prompt[:0]))) // ps + 1
+                for row, r in active
+            ),
+            floor=kv_block,
         )
         toks = np.zeros((B, C), dtype=np.int32)
         draft_len = np.zeros((B,), dtype=np.int32)
@@ -1162,14 +1271,18 @@ class Engine:
         for row, req in active:
             draft = drafts.get(row, req.prompt[:0])
             drafts[row] = draft
+            w = len(draft) + 1  # this row's live verify window
             toks[row, 0] = self._tokens[row]
             toks[row, 1 : 1 + len(draft)] = draft
             pos = req.kv_len + np.arange(C, dtype=np.int32)
             poss[row] = np.minimum(pos, self.max_seq_len - 1)
-            n_pages = min((req.kv_len + g) // ps + 1, self.max_pages)
+            n_pages = min((req.kv_len + len(draft)) // ps + 1, self.max_pages)
             pt[row, :n_pages] = self._page_table[row, :n_pages]
-            sl[row] = pt[row, pos // ps] * ps + pos % ps
-            kvlen[row] = req.kv_len + C
+            # Positions past the row's window write their (garbage) K/V to
+            # the scratch slot; causal masking keeps them out of every
+            # logit the verify actually uses.
+            sl[row, :w] = pt[row, pos[:w] // ps] * ps + pos[:w] % ps
+            kvlen[row] = req.kv_len + w
             draft_len[row] = len(draft)
             self.stats.spec_proposed += len(draft)
             self._m_spec_proposed.inc(len(draft))

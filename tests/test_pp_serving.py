@@ -1,0 +1,116 @@
+"""Pipeline-parallel SERVING (VERDICT round-2 weak #4 / next-step #5:
+"PP is a shelf module ... nothing in engine/ or launch.py can serve
+through it"). An Engine on a (pp, tp) mesh must produce the same tokens
+as a single-device engine — prefill chunks and decode steps both run
+through the GPipe schedule in ``parallel/pp_serving.py`` while the
+scheduler/tree/publish machinery stays byte-identical. Runs on the
+8-device virtual CPU mesh (conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.engine.engine import Engine
+from radixmesh_tpu.engine.request import SamplingParams
+from radixmesh_tpu.models.llama import (
+    ModelConfig,
+    init_params,
+    prefill_chunk_paged,
+)
+from radixmesh_tpu.parallel.pp_serving import (
+    make_pp_serving_mesh,
+    pp_forward_chunk,
+    pp_pool_spec,
+    shard_params_pp,
+)
+
+# fp32 so pipeline-vs-single parity is exact-token, not bf16-luck.
+CFG = ModelConfig.tiny().replace(dtype=jnp.float32)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+GREEDY = SamplingParams(temperature=0.0, max_new_tokens=6)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # pp=2 stages x tp=2 chips per stage.
+    return make_pp_serving_mesh(pp=2, tp=2)
+
+
+def test_pp_chunk_matches_reference(mesh):
+    """pp_forward_chunk == prefill_chunk_paged numerics: ragged prior
+    contexts, microbatched schedule, deferred KV scatter."""
+    from jax.sharding import NamedSharding
+
+    B, C, ps, maxp, num_slots = 4, 8, 4, 8, 256
+    rng = np.random.default_rng(0)
+    toks = rng.integers(1, CFG.vocab_size, (B, C)).astype(np.int32)
+    prior = np.array([0, 4, 8, 12], np.int32)
+    pos = prior[:, None] + np.arange(C, dtype=np.int32)[None]
+    kvlen = prior + C
+    pt = np.arange(B * maxp, dtype=np.int32).reshape(B, maxp)
+    slots = pt[np.arange(B)[:, None], pos // ps] * ps + pos % ps
+    pool0 = np.asarray(
+        rng.normal(size=(2, CFG.n_layers, CFG.n_kv_heads, num_slots,
+                         CFG.head_dim)),
+        np.float32,
+    )
+    want_logits, want_pool = prefill_chunk_paged(
+        PARAMS, CFG, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(pool0),
+        jnp.asarray(slots), jnp.asarray(pt), jnp.asarray(kvlen),
+        page_size=ps, kv_block_pages=4,
+    )
+    pparams = shard_params_pp(PARAMS, CFG, mesh)
+    pool_sh = jax.device_put(
+        jnp.asarray(pool0), NamedSharding(mesh, pp_pool_spec())
+    )
+    got_logits, got_pool = pp_forward_chunk(
+        pparams, CFG, jnp.asarray(toks), jnp.asarray(pos), pool_sh,
+        jnp.asarray(slots), jnp.asarray(pt), jnp.asarray(kvlen),
+        page_size=ps, kv_block_pages=4, mesh=mesh, n_micro=2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_pool), np.asarray(want_pool), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pp_engine_matches_single_device(mesh):
+    """Same greedy tokens through a pp=2 x tp=2 engine as single-device:
+    the pipeline changes placement and schedule, not semantics."""
+    prompts = [
+        np.random.default_rng(0).integers(1, CFG.vocab_size, 24).tolist(),
+        np.random.default_rng(1).integers(1, CFG.vocab_size, 17).tolist(),
+    ]
+    single = Engine(CFG, PARAMS, num_slots=1024, page_size=4, max_batch=4)
+    want = single.generate(prompts, GREEDY)
+    pp_eng = Engine(
+        CFG, PARAMS, num_slots=1024, page_size=4, max_batch=4,
+        device_mesh=mesh,
+    )
+    got = pp_eng.generate(prompts, GREEDY)
+    assert want == got
+
+
+def test_pp_engine_prefix_hit(mesh):
+    """Publish + prefix reuse work against the layer-sharded pool."""
+    engine = Engine(
+        CFG, PARAMS, num_slots=1024, page_size=4, max_batch=4,
+        device_mesh=mesh,
+    )
+    prompt = list(range(1, 25))
+    engine.generate([prompt], GREEDY)
+    cached_before = engine.stats.cached_tokens
+    out = engine.generate([prompt + [100, 101]], GREEDY)[0]
+    assert len(out) == 6
+    assert engine.stats.cached_tokens - cached_before >= 20
+
+
+def test_pp_validations(mesh):
+    with pytest.raises(ValueError, match="quantized pool"):
+        Engine(CFG, PARAMS, device_mesh=mesh, kv_quant="int8")
+    bad = CFG.replace(n_layers=3)  # 3 layers, pp=2
+    with pytest.raises(ValueError, match="not divisible by"):
+        Engine(bad, init_params(bad, jax.random.PRNGKey(0)), device_mesh=mesh)
