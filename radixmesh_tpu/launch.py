@@ -97,6 +97,16 @@ def _run_node(args: argparse.Namespace) -> int:
         return 1
     log.info("ring verified (view epoch=%d)", node.view.epoch)
 
+    # Text seam for both frontends: --tokenizer wins, else the config's
+    # model.tokenizer key (must be the SAME spec on router and serving
+    # nodes, or routed text prefixes won't line up with cached ones).
+    tokenizer = None
+    tok_spec = args.tokenizer or (cfg.model or {}).get("tokenizer")
+    if tok_spec:
+        from radixmesh_tpu.server.tokenizer import load_tokenizer
+
+        tokenizer = load_tokenizer(tok_spec)
+
     frontend = None
     if role is NodeRole.ROUTER:
         router = CacheAwareRouter(node, cfg)
@@ -104,7 +114,9 @@ def _run_node(args: argparse.Namespace) -> int:
         if not args.warm_up:
             router.finish_warm_up()
         host = parse_addr(cfg.local_addr)[0] or "127.0.0.1"
-        frontend = RouterFrontend(router, host=host, port=args.http_port)
+        frontend = RouterFrontend(
+            router, host=host, port=args.http_port, tokenizer=tokenizer
+        )
         log.info("routing API on port %d", frontend.port)
     elif serving:
         from radixmesh_tpu.engine.engine import Engine
@@ -129,7 +141,8 @@ def _run_node(args: argparse.Namespace) -> int:
         )
         host, port = parse_addr(cfg.local_addr)
         frontend = ServingFrontend(
-            engine, host=host or "127.0.0.1", port=port + cfg.serve_port_offset
+            engine, host=host or "127.0.0.1",
+            port=port + cfg.serve_port_offset, tokenizer=tokenizer,
         )
         log.info("serving API on port %d", frontend.port)
 
@@ -158,7 +171,18 @@ def _run_serve(args: argparse.Namespace) -> int:
     log = get_logger("launch")
     cfg = get_config(args.model)
     log.info("initializing %s (%d layers)...", args.model, cfg.n_layers)
-    params = init_params(cfg, jax.random.PRNGKey(args.seed))
+    if args.weights:
+        from radixmesh_tpu.models.hf_io import load_hf_checkpoint
+
+        log.info("loading HF checkpoint from %s", args.weights)
+        params = load_hf_checkpoint(args.weights, cfg)
+    else:
+        params = init_params(cfg, jax.random.PRNGKey(args.seed))
+    tokenizer = None
+    if args.tokenizer:
+        from radixmesh_tpu.server.tokenizer import load_tokenizer
+
+        tokenizer = load_tokenizer(args.tokenizer)
     engine = Engine(
         cfg,
         params,
@@ -172,7 +196,7 @@ def _run_serve(args: argparse.Namespace) -> int:
     )
     frontend = ServingFrontend(
         engine, host=args.host, port=args.http_port,
-        profile_dir=args.profile_dir,
+        profile_dir=args.profile_dir, tokenizer=tokenizer,
     )
     print(f"serving {args.model} on http://{args.host}:{frontend.port}", flush=True)
 
@@ -227,6 +251,11 @@ def main(argv: list[str] | None = None) -> int:
     node.add_argument("--http-port", type=int, default=0, help="router API port")
     node.add_argument("--ready-timeout", type=float, default=120.0)
     node.add_argument(
+        "--tokenizer", default=None,
+        help="'byte' or a local HF tokenizer dir; enables text on this "
+        "node's API (same spec on every node; overrides model.tokenizer)",
+    )
+    node.add_argument(
         "--warm-up",
         action="store_true",
         help="start the router in warm-up (spread) mode",
@@ -235,6 +264,16 @@ def main(argv: list[str] | None = None) -> int:
 
     serve = sub.add_parser("serve", help="run a single-node serving engine")
     serve.add_argument("--model", default="llama3-tiny")
+    serve.add_argument(
+        "--weights", default=None,
+        help="HF-format safetensors checkpoint directory (models/hf_io.py); "
+        "default: random init",
+    )
+    serve.add_argument(
+        "--tokenizer", default=None,
+        help="'byte' (lossless UTF-8 fallback) or a local HF tokenizer "
+        "directory; enables text in/out on /generate",
+    )
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--http-port", type=int, default=8000)
     serve.add_argument("--kv-slots", type=int, default=4096)
@@ -257,7 +296,8 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument(
         "--spec-decode-tokens", type=int, default=0,
         help="speculative decoding: draft up to N tokens by prompt lookup "
-        "and verify them in one chunked pass (greedy rows only)",
+        "and verify them in one chunked pass (greedy rows by argmax-prefix, "
+        "sampled rows by exact rejection sampling)",
     )
     serve.set_defaults(fn=_run_serve)
 

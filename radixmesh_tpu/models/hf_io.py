@@ -1,0 +1,122 @@
+"""HuggingFace checkpoint IO: load real Llama/Qwen2 weights for serving.
+
+The reference has no model at all (SURVEY §0 "What it is NOT"); its
+north-star serving stack (``BASELINE.json`` "north_star": serve
+Llama-3-8B) needs a path from the HF-format checkpoints those models ship
+as — a directory of ``*.safetensors`` shards plus
+``model.safetensors.index.json`` — into this framework's stacked-layer
+param pytree (``models/llama.py::convert_hf_state_dict``).
+
+Pure numpy + safetensors: no torch in the loading path, tensors go
+host-numpy → ``jnp`` in the converter (one cast to the model dtype, which
+on TPU is the HBM copy).
+
+``save_hf_state_dict`` writes the same layout back (sharded, with index)
+— used by the golden round-trip test and by operators exporting
+checkpoints this framework trained/edited.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from radixmesh_tpu.models.llama import ModelConfig
+
+__all__ = ["load_hf_checkpoint", "load_hf_state_dict", "save_hf_state_dict"]
+
+_INDEX = "model.safetensors.index.json"
+_SINGLE = "model.safetensors"
+
+
+def load_hf_state_dict(ckpt_dir: str) -> dict[str, np.ndarray]:
+    """Read every tensor from an HF-format checkpoint directory.
+
+    Handles both layouts HF emits: one ``model.safetensors`` file, or
+    N shards named by ``model.safetensors.index.json``'s ``weight_map``.
+    Returns plain numpy arrays keyed by HF names
+    (``model.layers.3.self_attn.q_proj.weight`` …).
+    """
+    from safetensors.numpy import load_file
+
+    index_path = os.path.join(ckpt_dir, _INDEX)
+    single_path = os.path.join(ckpt_dir, _SINGLE)
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        shards = sorted(set(index["weight_map"].values()))
+        state: dict[str, np.ndarray] = {}
+        for shard in shards:
+            state.update(load_file(os.path.join(ckpt_dir, shard)))
+        missing = set(index["weight_map"]) - set(state)
+        if missing:
+            raise ValueError(
+                f"checkpoint index names {len(missing)} tensors its shards "
+                f"don't contain (e.g. {sorted(missing)[:3]})"
+            )
+        return state
+    if os.path.exists(single_path):
+        return dict(load_file(single_path))
+    # Fall back to any stray .safetensors files (some exports skip the
+    # index when there is exactly one shard with a non-standard name).
+    parts = sorted(
+        f for f in os.listdir(ckpt_dir) if f.endswith(".safetensors")
+    )
+    if not parts:
+        raise FileNotFoundError(
+            f"no {_SINGLE}, {_INDEX}, or *.safetensors in {ckpt_dir}"
+        )
+    state = {}
+    for part in parts:
+        state.update(load_file(os.path.join(ckpt_dir, part)))
+    return state
+
+
+def load_hf_checkpoint(ckpt_dir: str, cfg: "ModelConfig") -> dict:
+    """HF checkpoint directory → this framework's param pytree."""
+    from radixmesh_tpu.models.llama import convert_hf_state_dict
+
+    return convert_hf_state_dict(cfg, load_hf_state_dict(ckpt_dir))
+
+
+def save_hf_state_dict(
+    state: dict[str, np.ndarray],
+    ckpt_dir: str,
+    max_shard_bytes: int = 4 << 30,
+) -> None:
+    """Write an HF-layout safetensors checkpoint (shards + index).
+
+    Greedy sharding by insertion order, mirroring HF's writer closely
+    enough that HF loaders (and :func:`load_hf_state_dict`) accept it.
+    """
+    from safetensors.numpy import save_file
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    shards: list[dict[str, np.ndarray]] = [{}]
+    sizes = [0]
+    for name, arr in state.items():
+        nbytes = int(np.asarray(arr).nbytes)
+        if sizes[-1] and sizes[-1] + nbytes > max_shard_bytes:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][name] = np.ascontiguousarray(arr)
+        sizes[-1] += nbytes
+    if len(shards) == 1:
+        save_file(shards[0], os.path.join(ckpt_dir, _SINGLE))
+        return
+    n = len(shards)
+    weight_map = {}
+    for i, shard in enumerate(shards):
+        fname = f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+        save_file(shard, os.path.join(ckpt_dir, fname))
+        for name in shard:
+            weight_map[name] = fname
+    with open(os.path.join(ckpt_dir, _INDEX), "w") as f:
+        json.dump(
+            {"metadata": {"total_size": sum(sizes)}, "weight_map": weight_map},
+            f,
+        )

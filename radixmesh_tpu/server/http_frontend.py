@@ -142,6 +142,28 @@ def _read_json(handler: BaseHTTPRequestHandler) -> dict:
     return obj
 
 
+def _ids_from_body(body: dict, tokenizer, who: str) -> list[int]:
+    """Token ids from a request body: ``text`` (tokenized server-side)
+    or raw ``input_ids``. One implementation for the serving and router
+    frontends so validation cannot drift between them."""
+    if "text" in body:
+        if tokenizer is None:
+            raise ValueError(
+                f"{who} has no tokenizer (start with --tokenizer); "
+                "send input_ids instead"
+            )
+        if not isinstance(body["text"], str):
+            raise ValueError("text must be a string")
+        ids = tokenizer.encode(body["text"])
+    else:
+        ids = body["input_ids"]
+    if not isinstance(ids, list) or not all(
+        isinstance(t, int) and not isinstance(t, bool) for t in ids
+    ):
+        raise ValueError("input_ids must be a list of ints")
+    return ids
+
+
 class _FrontendServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
@@ -156,9 +178,15 @@ class ServingFrontend:
         host: str = "127.0.0.1",
         port: int = 0,
         profile_dir: str | None = None,
+        tokenizer=None,
     ):
         self.runner = EngineRunner(engine).start()
         self.log = get_logger("http.serve")
+        # Pluggable text seam (server/tokenizer.py): with a tokenizer,
+        # /generate accepts {"text": ...} and answers with decoded
+        # "text"; raw "input_ids" stay first-class either way (the
+        # reference's keys are id lists, radix_mesh.py:193).
+        self.tokenizer = tokenizer
         # /profile writes ONLY under this operator-configured directory
         # (None = endpoint disabled): a network peer must never choose
         # filesystem paths for the server.
@@ -263,17 +291,22 @@ class ServingFrontend:
                     return
                 try:
                     body = _read_json(self)
-                    ids = body["input_ids"]
-                    if not isinstance(ids, list) or not all(
-                        isinstance(t, int) for t in ids
+                    ids = _ids_from_body(body, frontend.tokenizer, "server")
+                    stop_ids = tuple(body.get("stop_token_ids", ()))
+                    if (
+                        "text" in body
+                        and not stop_ids
+                        and frontend.tokenizer.eos_id is not None
                     ):
-                        raise ValueError("input_ids must be a list of ints")
+                        # Text callers reasonably expect generation to end
+                        # at EOS without knowing the id space.
+                        stop_ids = (frontend.tokenizer.eos_id,)
                     sampling = SamplingParams(
                         temperature=float(body.get("temperature", 0.0)),
                         top_p=float(body.get("top_p", 1.0)),
                         top_k=int(body.get("top_k", 0)),
                         max_new_tokens=int(body.get("max_tokens", 16)),
-                        stop_token_ids=tuple(body.get("stop_token_ids", ())),
+                        stop_token_ids=stop_ids,
                     )
                 except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
                     _json_response(self, 400, {"error": str(e)})
@@ -299,6 +332,11 @@ class ServingFrontend:
                         "output_ids": tokens,
                         "cached_tokens": req.prefix_len,
                         "rid": req.rid,
+                        **(
+                            {"text": frontend.tokenizer.decode(tokens)}
+                            if frontend.tokenizer is not None
+                            else {}
+                        ),
                         **({"cancelled": True} if req.cancelled else {}),
                     },
                 )
@@ -324,8 +362,13 @@ class ServingFrontend:
                             self.wfile.write(
                                 f"data: {json.dumps({'token': t})}\n\n".encode()
                             )
+                        done_evt = {"done": True, "output_ids": final}
+                        if frontend.tokenizer is not None:
+                            done_evt["text"] = frontend.tokenizer.decode(final)
+                        if req.cancelled:
+                            done_evt["cancelled"] = True
                         self.wfile.write(
-                            f"data: {json.dumps({'done': True, 'output_ids': final, **({'cancelled': True} if req.cancelled else {})})}\n\n".encode()
+                            f"data: {json.dumps(done_evt)}\n\n".encode()
                         )
                         self.wfile.flush()
                         return
@@ -349,10 +392,20 @@ class RouterFrontend:
     """HTTP API over a router node's cache-aware router."""
 
     def __init__(
-        self, router: CacheAwareRouter, host: str = "127.0.0.1", port: int = 0
+        self,
+        router: CacheAwareRouter,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tokenizer=None,
     ):
         self.router = router
         self.log = get_logger("http.route")
+        # Routing keys are token ids (the tree's key space, the
+        # reference's List[int] contract); with a tokenizer, text clients
+        # can route without running tokenization themselves. MUST be the
+        # same tokenizer the serving nodes use, or routed prefixes won't
+        # line up with cached ones.
+        self.tokenizer = tokenizer
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -378,11 +431,7 @@ class RouterFrontend:
                     return
                 try:
                     body = _read_json(self)
-                    ids = body["input_ids"]
-                    if not isinstance(ids, list) or not all(
-                        isinstance(t, int) for t in ids
-                    ):
-                        raise ValueError("input_ids must be a list of ints")
+                    ids = _ids_from_body(body, frontend.tokenizer, "router")
                 except (KeyError, ValueError, json.JSONDecodeError) as e:
                     _json_response(self, 400, {"error": str(e)})
                     return

@@ -1,0 +1,145 @@
+"""Golden HF-checkpoint tests (VERDICT round-2 next-step #4).
+
+The north star serves Llama-3-8B from its HF checkpoint
+(``BASELINE.json`` "north_star"); until this file, ``convert_hf_state_dict``
+had never met HF-formatted bytes. Three layers of proof:
+
+- safetensors shard/index round-trip is bitwise lossless (``models/hf_io.py``)
+- a REAL ``transformers`` Llama/Qwen2 model saved with ``save_pretrained``
+  loads through ``load_hf_checkpoint`` and our forward matches the HF
+  torch forward's logits (fp32)
+- greedy generation through our Engine is token-exact vs HF ``generate``
+  — serving parity, not just one forward
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from radixmesh_tpu.models.hf_io import (  # noqa: E402
+    load_hf_checkpoint,
+    load_hf_state_dict,
+    save_hf_state_dict,
+)
+from radixmesh_tpu.models.llama import ModelConfig, prefill_forward  # noqa: E402
+
+_TINY_DIMS = dict(
+    vocab_size=512,
+    hidden=128,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=32,
+    intermediate=256,
+    rope_theta=10000.0,
+    rope_scaling=None,
+    max_seq_len=512,
+    dtype=jnp.float32,  # fp32 end to end: parity must not hide in bf16 noise
+)
+
+
+def _hf_llama(tmp_path, qkv_bias: bool):
+    """Build + save a REAL transformers checkpoint; return (model, dir)."""
+    torch = pytest.importorskip("torch")
+    if qkv_bias:
+        from transformers import Qwen2Config, Qwen2ForCausalLM as Model
+
+        hf_cfg = Qwen2Config(
+            vocab_size=512, hidden_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=256, rope_theta=10000.0,
+            rms_norm_eps=1e-5, max_position_embeddings=512,
+            tie_word_embeddings=False, use_cache=False,
+        )
+    else:
+        from transformers import LlamaConfig, LlamaForCausalLM as Model
+
+        hf_cfg = LlamaConfig(
+            vocab_size=512, hidden_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=256, rope_theta=10000.0,
+            rms_norm_eps=1e-5, max_position_embeddings=512,
+            tie_word_embeddings=False, attention_bias=False,
+            use_cache=False,
+        )
+    torch.manual_seed(7)
+    model = Model(hf_cfg).to(torch.float32).eval()
+    ckpt = tmp_path / ("qwen2" if qkv_bias else "llama")
+    model.save_pretrained(ckpt, safe_serialization=True)
+    return model, str(ckpt)
+
+
+def _our_logits(cfg, params, ids: list[int]) -> np.ndarray:
+    toks = jnp.asarray([ids], jnp.int32)
+    pos = jnp.arange(len(ids), dtype=jnp.int32)[None, :]
+    L, B = cfg.n_layers, 1
+    empty = jnp.zeros((L, B, 0, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+    logits, _, _ = prefill_forward(
+        params, cfg, toks, pos, empty, empty, jnp.zeros((B,), jnp.int32)
+    )
+    return np.asarray(logits[0], np.float32)
+
+
+def test_shard_roundtrip_bitexact(tmp_path):
+    rng = np.random.default_rng(0)
+    state = {
+        f"model.layers.{i}.weight_{j}": rng.normal(
+            size=(64, 48)
+        ).astype(np.float32)
+        for i in range(4)
+        for j in range(3)
+    }
+    # Tiny shard cap forces the index+multi-shard layout.
+    save_hf_state_dict(state, str(tmp_path / "ck"), max_shard_bytes=40000)
+    files = list((tmp_path / "ck").iterdir())
+    assert any(f.name.endswith("index.json") for f in files)
+    assert sum(f.name.endswith(".safetensors") for f in files) > 1
+    back = load_hf_state_dict(str(tmp_path / "ck"))
+    assert set(back) == set(state)
+    for k in state:
+        assert back[k].dtype == state[k].dtype
+        np.testing.assert_array_equal(back[k], state[k])
+
+
+@pytest.mark.parametrize("qkv_bias", [False, True], ids=["llama", "qwen2"])
+def test_hf_checkpoint_logits_parity(tmp_path, qkv_bias):
+    torch = pytest.importorskip("torch")
+    hf_model, ckpt = _hf_llama(tmp_path, qkv_bias)
+    cfg = ModelConfig(qkv_bias=qkv_bias, **_TINY_DIMS)
+    params = load_hf_checkpoint(ckpt, cfg)
+
+    ids = [3, 141, 59, 26, 250, 8, 99, 400, 77, 12]
+    ours = _our_logits(cfg, params, ids)
+    with torch.no_grad():
+        theirs = (
+            hf_model(torch.tensor([ids])).logits[0].float().numpy()
+        )
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_checkpoint_greedy_generation_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.engine.request import SamplingParams
+
+    hf_model, ckpt = _hf_llama(tmp_path, qkv_bias=False)
+    cfg = ModelConfig(**_TINY_DIMS)
+    params = load_hf_checkpoint(ckpt, cfg)
+
+    prompt = [3, 141, 59, 26, 250, 8, 99, 400]
+    n_new = 8
+    with torch.no_grad():
+        hf_out = hf_model.generate(
+            torch.tensor([prompt]), max_new_tokens=n_new, do_sample=False,
+            use_cache=True,
+        )[0, len(prompt):].tolist()
+
+    engine = Engine(cfg, params, num_slots=1024, page_size=16, max_batch=2)
+    ours = engine.generate(
+        [prompt], SamplingParams(temperature=0.0, max_new_tokens=n_new)
+    )[0]
+    assert ours == hf_out, (
+        f"greedy generation diverged: ours={ours} hf={hf_out}"
+    )

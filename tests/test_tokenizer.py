@@ -1,0 +1,123 @@
+"""Tokenizer seam + text-in/text-out serving (VERDICT round-2 next-step
+#4: "no tokenizer exists anywhere — /generate takes raw token ids only").
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from radixmesh_tpu.server.tokenizer import (  # noqa: E402
+    ByteTokenizer,
+    Tokenizer,
+    load_tokenizer,
+)
+
+
+def _post(url: str, obj: dict, timeout=60):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestByteTokenizer:
+    def test_roundtrip_ascii_and_unicode(self):
+        tok = ByteTokenizer()
+        for text in ["hello world", "héllo — ünïcode ✓", "", "\n\t"]:
+            ids = tok.encode(text)
+            assert all(3 <= i < tok.vocab_size for i in ids)
+            assert tok.decode(ids) == text
+
+    def test_specials_never_emitted_and_skipped_on_decode(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("ab")
+        assert tok.eos_id not in ids
+        assert tok.decode([tok.BOS, *ids, tok.EOS]) == "ab"
+
+    def test_satisfies_protocol(self):
+        assert isinstance(ByteTokenizer(), Tokenizer)
+
+    def test_load_tokenizer(self, tmp_path):
+        assert isinstance(load_tokenizer("byte"), ByteTokenizer)
+        with pytest.raises(ValueError, match="unknown tokenizer"):
+            load_tokenizer("nonexistent-spec")
+
+
+@pytest.fixture(scope="module")
+def text_frontend():
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.server.http_frontend import ServingFrontend
+
+    cfg = ModelConfig.tiny()
+    eng = Engine(
+        cfg,
+        init_params(cfg, jax.random.PRNGKey(0)),
+        num_slots=512,
+        page_size=4,
+        max_batch=2,
+        name="tok-test",
+    )
+    f = ServingFrontend(eng, port=0, tokenizer=ByteTokenizer())
+    yield f
+    f.close()
+
+
+class TestTextServing:
+    def test_text_in_text_out(self, text_frontend):
+        status, out = _post(
+            f"http://127.0.0.1:{text_frontend.port}/generate",
+            {"text": "The quick brown fox", "max_tokens": 6},
+        )
+        assert status == 200
+        assert isinstance(out["text"], str)
+        # tiny vocab (512) > byte vocab (259): every sampled id decodes
+        assert out["output_ids"]
+        tok = ByteTokenizer()
+        assert out["text"] == tok.decode(out["output_ids"])
+
+    def test_text_revisit_hits_prefix_cache(self, text_frontend):
+        prompt = {"text": "shared prefix for the cache hit", "max_tokens": 4}
+        _post(f"http://127.0.0.1:{text_frontend.port}/generate", prompt)
+        status, out = _post(
+            f"http://127.0.0.1:{text_frontend.port}/generate", prompt
+        )
+        assert status == 200
+        assert out["cached_tokens"] > 0
+
+    def test_ids_still_first_class(self, text_frontend):
+        status, out = _post(
+            f"http://127.0.0.1:{text_frontend.port}/generate",
+            {"input_ids": [5, 6, 7, 8], "max_tokens": 4},
+        )
+        assert status == 200
+        assert out["output_ids"]
+
+    def test_text_without_tokenizer_is_400(self):
+        from radixmesh_tpu.engine.engine import Engine
+        from radixmesh_tpu.models.llama import ModelConfig, init_params
+        from radixmesh_tpu.server.http_frontend import ServingFrontend
+
+        cfg = ModelConfig.tiny()
+        eng = Engine(
+            cfg, init_params(cfg, jax.random.PRNGKey(0)),
+            num_slots=256, page_size=4, max_batch=2, name="tok-none",
+        )
+        f = ServingFrontend(eng, port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(
+                    f"http://127.0.0.1:{f.port}/generate",
+                    {"text": "hi", "max_tokens": 2},
+                )
+            assert ei.value.code == 400
+        finally:
+            f.close()
