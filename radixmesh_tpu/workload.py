@@ -93,28 +93,57 @@ def run_engine_workload(engine, workload: MultiTurnWorkload) -> dict:
     """Drive the workload through an :class:`Engine` turn-round by
     turn-round (each round's requests run concurrently through the
     continuous batcher, like simultaneous users) and report the
-    north-star metrics from the engine's own counters."""
+    north-star metrics from the engine's own counters.
+
+    ``ceiling_hit_rate`` is what an INFINITE, never-evicting cache would
+    score on the same traffic (page-aligned like real admission): turn
+    k > 0 can reuse at most the conversation's full prior context, turn 0
+    at most the shared system prefix. Workload shapes differ wildly in
+    how much of their traffic is reusable at all — ``hit_rate /
+    ceiling_hit_rate`` (``reuse_efficiency``) is the cache-quality signal
+    that is comparable ACROSS shapes."""
     from radixmesh_tpu.engine.request import SamplingParams
 
     sampling = SamplingParams(
         temperature=0.0, max_new_tokens=workload.gen_len
     )
+    page = getattr(engine, "page_size", 1)
     start_prompt = engine.stats.prompt_tokens
     start_cached = engine.stats.cached_tokens
     start_ttft = len(engine.stats.ttft_s)
+    ceiling = 0
+    total_prompt = 0
+    served_system = False
     for turn in range(workload.n_turns):
         pairs = workload.round_prompts(turn)
+        for conv, prompt in pairs:
+            reusable = len(conv.context) if turn > 0 else (
+                len(workload.system) if served_system else 0
+            )
+            # Admission reuse is page-floored and capped below the full
+            # prompt (the final token always recomputes its logits).
+            ceiling += min(reusable, len(prompt) - 1) // page * page
+            total_prompt += len(prompt)
+            served_system = True
         replies = engine.generate([p for _, p in pairs], sampling)
         for (conv, prompt), reply in zip(pairs, replies):
             workload.record_reply(conv, prompt, reply)
     prompt_tokens = engine.stats.prompt_tokens - start_prompt
     cached_tokens = engine.stats.cached_tokens - start_cached
     ttft = engine.stats.ttft_s[start_ttft:]
+    hit_rate = cached_tokens / prompt_tokens if prompt_tokens else 0.0
+    ceiling_rate = ceiling / total_prompt if total_prompt else 0.0
     return {
         "requests": workload.n_conversations * workload.n_turns,
         "prompt_tokens": prompt_tokens,
         "cached_tokens": cached_tokens,
-        "hit_rate": cached_tokens / prompt_tokens if prompt_tokens else 0.0,
+        "hit_rate": hit_rate,
+        "ceiling_hit_rate": ceiling_rate,
+        "reuse_efficiency": hit_rate / ceiling_rate if ceiling_rate else 0.0,
         "p50_ttft_s": float(np.median(ttft)) if ttft else 0.0,
         "p99_ttft_s": float(np.quantile(ttft, 0.99)) if ttft else 0.0,
+        # The exact per-request samples for this run (preemption retries
+        # append extra entries to the engine's global list, so callers
+        # must NOT slice that by request count).
+        "ttft_s": list(ttft),
     }

@@ -62,3 +62,18 @@ def test_first_turns_are_cold(engine_factory):
     report = run_engine_workload(engine, wl)
     # At most the 32-token system prefix per request can ever hit.
     assert report["hit_rate"] <= 32 / (32 + 16)
+
+
+def test_ceiling_and_efficiency(engine_factory):
+    """The ceiling is what an infinite cache could reuse: measured hit
+    rate can't (meaningfully) exceed it, and a warm multi-turn run should
+    capture most of it."""
+    engine = engine_factory()
+    wl = MultiTurnWorkload(
+        n_conversations=4, n_turns=4, system_len=32, user_len=16,
+        gen_len=8, vocab_size=512, seed=0,
+    )
+    report = run_engine_workload(engine, wl)
+    assert 0.0 < report["ceiling_hit_rate"] <= 1.0
+    assert report["hit_rate"] <= report["ceiling_hit_rate"] + 0.02
+    assert report["reuse_efficiency"] >= 0.85, report
