@@ -141,6 +141,11 @@ class MeshCache:
         # rank was declared dead — nobody routes to it, so no message can
         # tell it). It re-asserts itself with a JOIN.
         self._last_rx = time.monotonic()
+        # Instrumentation seam: called (with the oplog, under the tree
+        # lock) when this node's OWN oplog returns after a full ring lap —
+        # the lap-latency probe for ``scripts/ringbench.py``. The
+        # reference's benchmark has no timers at all (``benchmark.py:24-31``).
+        self.on_lap_complete = None
         self._last_self_join = 0.0
         self._succ_rank: int | None = None
         self._pending_retarget: str | None = None
@@ -479,7 +484,12 @@ class MeshCache:
                 self._handle_join(op)
                 return
             if op.origin_rank == self.rank:
-                return  # lap complete (radix_mesh.py:401-402)
+                # Lap complete (radix_mesh.py:401-402). Fire the
+                # instrumentation seam before dropping.
+                cb = self.on_lap_complete
+                if cb is not None:
+                    cb(op)
+                return
             # Apply BEFORE any TTL-based drop: with elastic membership an
             # oplog can carry a TTL computed from a stale (smaller) view,
             # reaching the last ring member with ttl 0 — dropping it
