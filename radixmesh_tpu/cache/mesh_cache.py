@@ -70,7 +70,15 @@ import numpy as np
 
 from radixmesh_tpu.cache.kv_pool import PagedKVPool
 from radixmesh_tpu.cache.mesh_values import PrefillValue, RouterValue
-from radixmesh_tpu.cache.oplog import GCEntry, NodeKey, Oplog, OplogType, deserialize, serialize
+from radixmesh_tpu.cache.oplog import (
+    GCEntry,
+    NodeKey,
+    Oplog,
+    OplogType,
+    deserialize,
+    patched_ttl,
+    serialize,
+)
 from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode, as_key
 from radixmesh_tpu.comm.communicator import Communicator, create_communicator
 from radixmesh_tpu.config import MeshConfig, NodeRole
@@ -472,7 +480,9 @@ class MeshCache:
                 )
                 self._gossip_view_from_tick(op)
                 if op.ttl > 0:
-                    self._forward(op)
+                    # Forward the ORIGINAL frame with only its TTL patched
+                    # — per-hop re-serialization is pure overhead.
+                    self._send_bytes(patched_ttl(data, op.ttl))
                 return
             if op.op_type in (OplogType.GC_QUERY, OplogType.GC_EXEC):
                 self._gc_handle(op)
@@ -509,7 +519,12 @@ class MeshCache:
             elif op.op_type is OplogType.RESET:
                 self._apply_reset()
             if op.ttl > 0:
-                self._forward(op)
+                # Hot replication path: patch the TTL in the received
+                # frame and enqueue it as-is. The key/value payload is
+                # immutable in flight, so bytes are authoritative — and a
+                # 5-node ring re-serializing every insert 4x was the
+                # dominant per-hop CPU cost.
+                self._send_bytes(patched_ttl(data, op.ttl))
 
     # ------------------------------------------------------------------
     # elastic membership (policy/topology.py; reference roadmap README.md:49-50)

@@ -36,6 +36,7 @@ __all__ = [
     "NodeKey",
     "serialize",
     "deserialize",
+    "patched_ttl",
     "set_emit_version",
 ]
 
@@ -186,6 +187,27 @@ def serialize(op: Oplog) -> bytes:
         parts.append(struct.pack("<iiI", e.agree, e.value_rank, len(ek)))
         parts.append(ek.tobytes())
     return b"".join(parts)
+
+
+# The int32 TTL lives at a fixed offset shared by BOTH wire versions
+# (the v1 header is a strict prefix of v2 up to and including ttl). Ring
+# forwarding rewrites ONLY this field, so hops patch the original frame
+# instead of paying a full re-serialization of the key/value payload.
+_TTL_OFFSET = struct.calcsize("<BBBxiq")  # magic, ver, type, origin, logic
+
+
+def patched_ttl(data: bytes, ttl: int) -> bytes:
+    """The same wire frame with only its TTL replaced.
+
+    Guards the header version: a future v3 that rearranges fields must
+    fail loudly here rather than silently corrupt forwarded frames."""
+    if data[1] not in (1, 2):
+        raise ValueError(
+            f"patched_ttl knows wire versions 1-2, got v{data[1]}"
+        )
+    buf = bytearray(data)
+    struct.pack_into("<i", buf, _TTL_OFFSET, ttl)
+    return bytes(buf)
 
 
 def deserialize(buf: bytes | memoryview) -> Oplog:

@@ -50,6 +50,11 @@
 namespace {
 
 constexpr uint64_t kMaxQueueBytes = 64ull * 1024 * 1024;
+// Frames already queued are coalesced into one wire write up to this
+// many bytes: under replication load (every ring hop re-sends every
+// oplog) this collapses N send() syscalls into one without changing the
+// wire format — the stream stays a sequence of length-prefixed frames.
+constexpr uint64_t kCoalesceBytes = 256ull * 1024;
 constexpr int kConnectRetryMs = 100;
 constexpr int kConnectTimeoutMs = 5000;
 
@@ -288,27 +293,36 @@ struct RmSender {
   void run() {
     while (true) {
       if (stopping.load() && queue.empty()) { done.store(true); return; }
-      std::vector<uint8_t> msg;
+      // Drain EVERY already-queued frame (bounded by kCoalesceBytes)
+      // into one contiguous wire buffer of [len][payload] frames: one
+      // send() per burst instead of one per oplog.
+      std::vector<uint8_t> wire;
       {
         std::unique_lock<std::mutex> lk(mu);
         cv_pop.wait(lk, [this] { return stopping.load() || !queue.empty(); });
         if (stopping.load() && queue.empty()) { lk.unlock(); done.store(true); return; }
-        msg = std::move(queue.front());
-        queue.pop_front();
-        queued_bytes -= msg.size();
+        while (!queue.empty() && wire.size() < kCoalesceBytes) {
+          const std::vector<uint8_t>& msg = queue.front();
+          uint8_t hdr[4] = {static_cast<uint8_t>(msg.size() >> 24),
+                            static_cast<uint8_t>(msg.size() >> 16),
+                            static_cast<uint8_t>(msg.size() >> 8),
+                            static_cast<uint8_t>(msg.size())};
+          wire.insert(wire.end(), hdr, hdr + 4);
+          wire.insert(wire.end(), msg.begin(), msg.end());
+          queued_bytes -= msg.size();
+          queue.pop_front();
+        }
         cv_push.notify_all();
       }
-      uint8_t hdr[4] = {static_cast<uint8_t>(msg.size() >> 24),
-                        static_cast<uint8_t>(msg.size() >> 16),
-                        static_cast<uint8_t>(msg.size() >> 8),
-                        static_cast<uint8_t>(msg.size())};
       // Retry (reconnecting) until delivered or the sender is closed.
       // Silently dropping a frame after bounded retries — what the
       // reference does (communicator.py:192-208) — diverges the ring
       // unrecoverably, since receivers have no gap detection. At-least-once
       // + per-link FIFO keeps replicas convergent; a permanently dead peer
       // back-pressures this queue, which failure detection (topology epoch
-      // changes) is the cure for, not frame loss.
+      // changes) is the cure for, not frame loss. A reconnect mid-burst
+      // re-sends the WHOLE burst: frames the peer already applied re-apply
+      // idempotently (the ring's at-least-once model).
       while (!stopping.load()) {
         while (!ensure_connected()) {
           if (stopping.load()) { done.store(true); return; }
@@ -320,7 +334,7 @@ struct RmSender {
           std::lock_guard<std::mutex> lk(fd_mu);
           f = fd;
         }
-        if (f >= 0 && send_all(f, hdr, 4) && send_all(f, msg.data(), msg.size()))
+        if (f >= 0 && send_all(f, wire.data(), wire.size()))
           break;
         drop_connection();
       }

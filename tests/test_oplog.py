@@ -142,3 +142,39 @@ class TestWireVersionCompat:
         assert got.origin_rank == 2 and got.value_rank == 2 and got.ttl == 4
         np.testing.assert_array_equal(got.key, op.key)
         np.testing.assert_array_equal(got.value, op.value)
+
+
+class TestPatchedTtl:
+    """Ring forwarding patches the TTL in the received frame instead of
+    re-serializing the payload; the patch must be position-exact for both
+    wire versions."""
+
+    def test_patch_preserves_everything_but_ttl(self):
+        from radixmesh_tpu.cache.oplog import patched_ttl
+
+        op = Oplog(
+            op_type=OplogType.INSERT, origin_rank=3, logic_id=77,
+            ttl=5, value_rank=2, key=np.arange(9, dtype=np.int32),
+            value=np.arange(9, dtype=np.int32) * 10, ts=123.5,
+        )
+        data = serialize(op)
+        back = deserialize(patched_ttl(data, 4))
+        assert back.ttl == 4
+        expect = deserialize(data)
+        expect.ttl = 4
+        assert back == expect
+
+    def test_patch_v1_frames(self):
+        from radixmesh_tpu.cache.oplog import patched_ttl, set_emit_version
+
+        set_emit_version(1)
+        try:
+            op = Oplog(
+                op_type=OplogType.TICK, origin_rank=1, logic_id=5, ttl=8,
+            )
+            data = serialize(op)
+        finally:
+            set_emit_version(2)
+        back = deserialize(patched_ttl(data, 7))
+        assert back.ttl == 7
+        assert back.origin_rank == 1 and back.logic_id == 5
