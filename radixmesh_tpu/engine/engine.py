@@ -1062,8 +1062,12 @@ class Engine:
         """Fused k-step decode is safe when every active row has k tokens
         of page-table headroom; prefer single steps while requests wait
         (admission happens between launches, so k steps of lockstep decode
-        would delay a queued request's prefill)."""
-        if self.waiting or self._pp:
+        would delay a queued request's prefill). pp engines fuse through
+        ``pp_decode_multi``'s rotating schedule, which needs the batch to
+        split into pp microbatches."""
+        if self.waiting:
+            return False
+        if self._pp and self.max_batch % self.device_mesh.shape["pp"]:
             return False
         for req in self._rows:
             if req is None:
@@ -1090,22 +1094,41 @@ class Engine:
             lengths[row] = req.kv_len + 1
         step_t0 = time.monotonic()
         self._rng, key = jax.random.split(self._rng)
-        res = decode_multi(
-            self.params,
-            self.cfg,
-            jnp.asarray(self._tokens),
-            self.pool.kv,
-            jnp.asarray(self._page_table),
-            jnp.asarray(lengths),
-            key,
-            jnp.asarray(self._temps),
-            jnp.asarray(self._top_ps),
-            self.page_size,
-            k_steps=k,
-            mesh=self.device_mesh,
-            kv_scale=self.pool.kv_scale,
-            top_ks=jnp.asarray(self._top_ks),
-        )
+        if self._pp:
+            from radixmesh_tpu.parallel.pp_serving import pp_decode_multi
+
+            res = pp_decode_multi(
+                self.params,
+                self.cfg,
+                jnp.asarray(self._tokens),
+                self.pool.kv,
+                jnp.asarray(self._page_table),
+                jnp.asarray(lengths),
+                key,
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ps),
+                jnp.asarray(self._top_ks),
+                page_size=self.page_size,
+                k_steps=k,
+                mesh=self.device_mesh,
+            )
+        else:
+            res = decode_multi(
+                self.params,
+                self.cfg,
+                jnp.asarray(self._tokens),
+                self.pool.kv,
+                jnp.asarray(self._page_table),
+                jnp.asarray(lengths),
+                key,
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ps),
+                self.page_size,
+                k_steps=k,
+                mesh=self.device_mesh,
+                kv_scale=self.pool.kv_scale,
+                top_ks=jnp.asarray(self._top_ks),
+            )
         sampled = self._commit_pool_update(res)
         sampled = np.asarray(sampled)  # [k, B] — the ONE round trip
         self.stats.decode_steps += k

@@ -50,6 +50,7 @@ __all__ = [
     "pp_pool_spec",
     "shard_params_pp",
     "pp_forward_chunk",
+    "pp_decode_multi",
 ]
 
 
@@ -288,3 +289,232 @@ def pp_forward_chunk(
     )
     logits = _logits(params, cfg, hidden.reshape(B, C, cfg.hidden))
     return logits, kv_pool
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "page_size", "k_steps", "mesh"),
+    donate_argnames=("kv_pool",),
+)
+def pp_decode_multi(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] current token per sequence
+    kv_pool: jnp.ndarray,  # [2, L, Hkv, slots, D] sharded pp_pool_spec()
+    page_table: jnp.ndarray,  # [B, max_pages] — pages preallocated k ahead
+    lengths: jnp.ndarray,  # [B] context length incl. the first fed token
+    key: jax.Array,
+    temperatures: jnp.ndarray,  # [B]
+    top_ps: jnp.ndarray,  # [B]
+    top_ks: jnp.ndarray,  # [B] (0 = off)
+    *,
+    page_size: int = 16,
+    k_steps: int = 8,
+    mesh: Mesh,
+):
+    """``k_steps`` fused decode iterations through the layer PIPELINE:
+    one host round trip per k tokens per batch, under pp×tp.
+
+    Schedule: a rotating token-level pipeline with ``n_micro = pp``
+    microbatches of rows. At tick ``t`` stage ``idx`` processes
+    ``v = t - idx``: microbatch ``v mod pp`` at decode step ``v div pp``.
+    Activations ``ppermute`` forward (stage i → i+1); the LAST stage
+    norms + head-projects (column-parallel, all-gathered over tp),
+    samples on device, and the sampled token ``ppermute``s back to stage
+    0 (pp-1 → 0), which embeds it next tick — so every stage is busy
+    every tick and the wrap IS the step boundary. Total ticks
+    ``k·pp + pp - 1``; warm-up/drain ticks compute garbage whose KV
+    writes are masked to re-write existing values.
+
+    The pool shard rides the tick scan (step s+1 reads step s's KV, so
+    the deferred-scatter trick of ``pp_forward_chunk`` cannot apply);
+    on-TPU this is the spot a fused stage kernel would optimize (the
+    single-chip path's ``paged_decode_fused`` rationale, SURVEY §7(c)).
+
+    Returns ``(sampled [k, B], kv_pool)`` — the single-chip
+    ``decode_multi`` contract, so the engine's bookkeeping is shared.
+    """
+    pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
+    L = cfg.n_layers
+    B = tokens.shape[0]
+    if B % pp:
+        raise ValueError(f"batch {B} must divide into n_micro=pp={pp}")
+    mb = B // pp
+    n_micro = pp
+    n_ticks = k_steps * pp + pp - 1
+    hq_loc = cfg.n_heads // tp
+    hkv_loc = cfg.n_kv_heads // tp
+    D = cfg.head_dim
+    num_slots = kv_pool.shape[3]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+
+    toks_all = tokens.reshape(n_micro, mb)
+    pt_all = page_table.reshape(n_micro, mb, -1)
+    len_all = lengths.reshape(n_micro, mb)
+    temp_all = temperatures.reshape(n_micro, mb)
+    topp_all = top_ps.reshape(n_micro, mb)
+    topk_all = top_ks.reshape(n_micro, mb)
+
+    layer_specs = {
+        k: v for k, v in pp_layer_specs().items() if k in params["layers"]
+    }
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head_spec = P() if cfg.tie_embeddings else P(None, "tp")
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            layer_specs, pp_pool_spec(), P(), P(), head_spec,
+            P(), P(), P(), P(), P(), P(), P(),
+        ),
+        out_specs=(P(), pp_pool_spec()),
+        check_vma=False,
+    )
+    def run(layers, pool, embed, final_norm, head_local, toks_all, pt_all,
+            len_all, temp_all, topp_all, topk_all, key):
+        from radixmesh_tpu.ops.attention import attend_decode_ref
+        from radixmesh_tpu.ops.sampling import sample_tokens
+
+        idx = jax.lax.axis_index("pp")
+        last = pp - 1
+        l_loc = pool.shape[1]
+        rows = jnp.arange(mb)
+
+        def stage(pool, x, pt, kvlen, slot, valid):
+            """This stage's layers over one microbatch's single token.
+            ``x`` [mb, H]; KV write at ``slot`` masked by ``valid``."""
+            pos = (kvlen - 1)[:, None]  # [mb, 1] absolute position
+
+            def body(carry, xs):
+                pool, h = carry
+                l_idx, lp = xs
+                hn = rms_norm(h[:, None, :], lp["attn_norm"], cfg.rms_eps)
+                q = jnp.einsum("bsh,hd->bsd", hn, lp["wq"], precision=_PREC)
+                k_ = jnp.einsum("bsh,hd->bsd", hn, lp["wk"], precision=_PREC)
+                v_ = jnp.einsum("bsh,hd->bsd", hn, lp["wv"], precision=_PREC)
+                if cfg.qkv_bias:
+                    q, k_, v_ = q + lp["bq"], k_ + lp["bk"], v_ + lp["bv"]
+                q = apply_rope(q.reshape(mb, 1, hq_loc, D), pos, inv_freq)
+                k_ = apply_rope(k_.reshape(mb, 1, hkv_loc, D), pos, inv_freq)
+                v_ = v_.reshape(mb, 1, hkv_loc, D)
+                # Masked in-place write at this layer's slot column;
+                # invalid (warm-up/drain) ticks re-write old values. The
+                # mixed scalar+array index puts the advanced axes FIRST:
+                # target shape is [mb, 2, Hkv/tp, D].
+                new_kv = jnp.stack(
+                    [k_[:, 0], v_[:, 0]], axis=1
+                ).astype(pool.dtype)
+                old = pool[:, l_idx, :, slot]
+                pool = pool.at[:, l_idx, :, slot].set(
+                    jnp.where(valid, new_kv, old)
+                )
+                pages = jax.lax.dynamic_index_in_dim(
+                    pool, l_idx, 1, keepdims=False
+                ).reshape(2, hkv_loc, num_slots // page_size, page_size, D)
+                attn = attend_decode_ref(
+                    q[:, 0], pages[0], pages[1], pt, kvlen
+                )
+                o = jnp.einsum(
+                    "bqd,qdh->bh",
+                    attn.reshape(mb, hq_loc, D),
+                    lp["wo"].reshape(hq_loc, D, cfg.hidden),
+                    precision=_PREC,
+                )
+                h = h + jax.lax.psum(o, "tp")
+                h2 = rms_norm(h[:, None, :], lp["mlp_norm"], cfg.rms_eps)
+                gate = jax.nn.silu(
+                    jnp.einsum("bsh,hi->bsi", h2, lp["w_gate"], precision=_PREC)
+                )
+                up = jnp.einsum("bsh,hi->bsi", h2, lp["w_up"], precision=_PREC)
+                down = jnp.einsum(
+                    "bsi,ih->bsh", gate * up, lp["w_down"], precision=_PREC
+                )[:, 0]
+                h = h + jax.lax.psum(down, "tp")
+                return (pool, h), None
+
+            (pool, h), _ = jax.lax.scan(
+                body, (pool, x), (jnp.arange(l_loc), layers)
+            )
+            return pool, h
+
+        def tick(carry, t):
+            pool, act_buf, tok_buf, outs = carry
+            v = t - idx
+            s = jnp.clip(v // pp, 0, k_steps - 1)
+            m = jnp.clip(v, 0, None) % pp
+            valid = jnp.logical_and(v >= 0, v // pp < k_steps)
+            pt = jax.lax.dynamic_index_in_dim(pt_all, m, 0, keepdims=False)
+            base_len = jax.lax.dynamic_index_in_dim(
+                len_all, m, 0, keepdims=False
+            )
+            kvlen = base_len + s
+            pos = kvlen - 1
+            slot = (
+                pt[rows, pos // page_size] * page_size + pos % page_size
+            )
+            # Stage 0's input token: the first step feeds the caller's
+            # token, later steps the sample that wrapped around.
+            first = jax.lax.dynamic_index_in_dim(
+                toks_all, m, 0, keepdims=False
+            )
+            tok_in = jnp.where(s == 0, first, tok_buf)
+            x0 = embed[tok_in]
+            x = jnp.where(idx == 0, x0, act_buf)
+            pool, y = stage(pool, x, pt, kvlen, slot, valid)
+
+            # Last stage: head + on-device sampling for (m, s).
+            hn = rms_norm(y[:, None, :], final_norm, cfg.rms_eps)[:, 0]
+            logits_part = jnp.einsum(
+                "bh,hv->bv", hn, head_local,
+                preferred_element_type=jnp.float32, precision=_PREC,
+            )
+            if tp > 1 and not cfg.tie_embeddings:
+                logits = jax.lax.all_gather(
+                    logits_part, "tp", axis=1, tiled=True
+                )
+            else:
+                logits = logits_part
+            sampled = sample_tokens(
+                logits,
+                jax.random.fold_in(key, jnp.clip(v, 0, None)),
+                temperature=jax.lax.dynamic_index_in_dim(
+                    temp_all, m, 0, keepdims=False
+                ),
+                top_p=jax.lax.dynamic_index_in_dim(
+                    topp_all, m, 0, keepdims=False
+                ),
+                top_k=jax.lax.dynamic_index_in_dim(
+                    topk_all, m, 0, keepdims=False
+                ),
+            ).astype(jnp.int32)
+            keep = jnp.logical_and(idx == last, valid)
+            cur = outs[m, :, s]
+            outs = outs.at[m, :, s].set(jnp.where(keep, sampled, cur))
+            act_buf = jax.lax.ppermute(
+                y, "pp", [(i, i + 1) for i in range(pp - 1)]
+            )
+            tok_buf = jax.lax.ppermute(sampled, "pp", [(last, 0)])
+            return (pool, act_buf, tok_buf, outs), None
+
+        act0 = jnp.zeros((mb, cfg.hidden), embed.dtype)
+        tok0 = jnp.zeros((mb,), jnp.int32)
+        outs0 = jnp.zeros((n_micro, mb, k_steps), jnp.int32)
+        (pool, _, _, outs), _ = jax.lax.scan(
+            tick, (pool, act0, tok0, outs0), jnp.arange(n_ticks)
+        )
+        # Sampled tokens live on the last stage; psum replicates (other
+        # stages hold zeros). tp already uniform: the gathered logits and
+        # the folded key are identical on every tp peer.
+        outs = jax.lax.psum(jnp.where(idx == last, outs, 0), "pp")
+        return outs, pool
+
+    outs, kv_pool = run(
+        params["layers"], kv_pool, params["embed"], params["final_norm"],
+        head, toks_all, pt_all, len_all, temp_all, topp_all, topk_all, key,
+    )
+    # [n_micro, mb, k] → the decode_multi contract [k, B] (row-major
+    # microbatch grouping mirrors every other reshape in this module).
+    sampled = outs.reshape(B, k_steps).T
+    return sampled, kv_pool
