@@ -10,9 +10,16 @@ from radixmesh_tpu.models import qwen2  # noqa: F401  (registers presets)
 
 _PRESETS = {
     "llama3-8b": ModelConfig.llama3_8b,
+    "llama3-70b": ModelConfig.llama3_70b,
+    "llama3.1-8b": ModelConfig.llama31_8b,
+    "llama3.1-70b": ModelConfig.llama31_70b,
+    "llama3.2-1b": ModelConfig.llama32_1b,
+    "llama3.2-3b": ModelConfig.llama32_3b,
     "llama3-tiny": ModelConfig.tiny,
     "qwen2-72b": qwen2.qwen2_72b,
     "qwen2-7b": qwen2.qwen2_7b,
+    "qwen2.5-14b": qwen2.qwen25_14b,
+    "qwen2.5-32b": qwen2.qwen25_32b,
     "qwen2-tiny": qwen2.qwen2_tiny,
 }
 

@@ -88,6 +88,69 @@ class ModelConfig:
         )
 
     @classmethod
+    def llama3_70b(cls) -> "ModelConfig":
+        """Meta-Llama-3-70B: NO rope scaling (the HF config's
+        rope_scaling is null at this generation; scaling arrives with
+        3.1) and the 8k window."""
+        return cls(
+            hidden=8192,
+            n_layers=80,
+            n_heads=64,
+            n_kv_heads=8,
+            head_dim=128,
+            intermediate=28672,
+            rope_scaling=None,
+        )
+
+    @classmethod
+    def llama31_8b(cls) -> "ModelConfig":
+        """Llama-3.1: the 3.0-8B architecture (whose preset already
+        carries the llama3-scaled rope) with the 128k window; serving
+        length stays pool-bounded."""
+        return cls.llama3_8b().replace(max_seq_len=131072)
+
+    @classmethod
+    def llama31_70b(cls) -> "ModelConfig":
+        """Llama-3.1-70B: the 70B dims plus the 3.1 rope scaling + 128k
+        window the base 3.0-70B preset deliberately lacks."""
+        return cls.llama3_70b().replace(
+            rope_scaling=(
+                ("factor", 8.0),
+                ("low_freq_factor", 1.0),
+                ("high_freq_factor", 4.0),
+                ("original_max_position_embeddings", 8192),
+            ),
+            max_seq_len=131072,
+        )
+
+    @classmethod
+    def llama32_1b(cls) -> "ModelConfig":
+        return cls(
+            vocab_size=128256,
+            hidden=2048,
+            n_layers=16,
+            n_heads=32,
+            n_kv_heads=8,
+            head_dim=64,
+            intermediate=8192,
+            tie_embeddings=True,
+            rope_scaling=(
+                ("factor", 32.0),
+                ("low_freq_factor", 1.0),
+                ("high_freq_factor", 4.0),
+                ("original_max_position_embeddings", 8192),
+            ),
+            max_seq_len=131072,
+        )
+
+    @classmethod
+    def llama32_3b(cls) -> "ModelConfig":
+        return cls.llama32_1b().replace(
+            hidden=3072, n_layers=28, n_heads=24, n_kv_heads=8,
+            head_dim=128, intermediate=8192,
+        )
+
+    @classmethod
     def tiny(cls) -> "ModelConfig":
         """Test/bench config: same architecture, toy dims."""
         return cls(

@@ -40,6 +40,29 @@ def qwen2_7b() -> ModelConfig:
     )
 
 
+def qwen25_14b() -> ModelConfig:
+    """Qwen2.5 shares the Qwen2 architecture (qkv biases, 1e6 rope).
+    NB the 14B/32B sizes use rms_norm_eps=1e-5 in their HF configs —
+    unlike the 7B/72B sizes' 1e-6."""
+    return ModelConfig(
+        vocab_size=152064,
+        hidden=5120,
+        n_layers=48,
+        n_heads=40,
+        n_kv_heads=8,
+        head_dim=128,
+        intermediate=13824,
+        rope_theta=1000000.0,
+        rms_eps=1e-5,
+        qkv_bias=True,
+        max_seq_len=32768,
+    )
+
+
+def qwen25_32b() -> ModelConfig:
+    return qwen25_14b().replace(n_layers=64, intermediate=27648)
+
+
 def qwen2_tiny() -> ModelConfig:
     return ModelConfig(
         vocab_size=512,

@@ -1,0 +1,110 @@
+"""Model registry: every preset the serving CLI exposes must build a
+well-formed config whose abstract parameter tree matches its family's
+published size class (no 72B of RAM needed — ``jax.eval_shape``), and
+the tied-embeddings variants (Llama-3.2) must match real ``transformers``
+numerics like the untied families do."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.models import get_config, init_params
+from radixmesh_tpu.models.llama import ModelConfig, prefill_forward
+
+# preset -> (min, max) expected parameter count, in billions.
+_SIZES = {
+    "llama3-8b": (7.5, 8.5),
+    "llama3-70b": (69, 72),
+    "llama3.1-8b": (7.5, 8.5),
+    "llama3.1-70b": (69, 72),
+    "llama3.2-1b": (1.1, 1.4),
+    "llama3.2-3b": (3.0, 3.5),
+    "qwen2-7b": (7.2, 8.0),
+    "qwen2-72b": (71, 74),
+    "qwen2.5-14b": (14, 15.5),
+    "qwen2.5-32b": (31, 34),
+}
+
+
+def test_hf_config_parity_facts():
+    """Config-level facts that diverge between sibling checkpoints and
+    silently corrupt numerics if copy-pasted (the eval_shape size checks
+    can't see them): rope scaling is a 3.1-generation feature, and
+    Qwen2.5's mid sizes use a different rms eps than 7B/72B."""
+    assert get_config("llama3-70b").rope_scaling is None
+    assert get_config("llama3-70b").max_seq_len == 8192
+    assert get_config("llama3.1-70b").rope_scaling is not None
+    assert get_config("llama3.1-70b").max_seq_len == 131072
+    assert get_config("qwen2-7b").rms_eps == 1e-6
+    assert get_config("qwen2.5-14b").rms_eps == 1e-5
+    assert get_config("qwen2.5-32b").rms_eps == 1e-5
+    # Tied embeddings are a 3.2 feature only.
+    assert get_config("llama3.2-1b").tie_embeddings
+    assert not get_config("llama3-8b").tie_embeddings
+
+
+@pytest.mark.parametrize("preset", sorted(_SIZES))
+def test_preset_param_count(preset):
+    cfg = get_config(preset)
+    abstract = jax.eval_shape(
+        lambda k: init_params(cfg, k), jax.random.PRNGKey(0)
+    )
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(abstract))
+    lo, hi = _SIZES[preset]
+    assert lo * 1e9 < n < hi * 1e9, f"{preset}: {n/1e9:.2f}B params"
+    if cfg.tie_embeddings:
+        assert "lm_head" not in abstract
+
+
+def test_unknown_preset_lists_known():
+    with pytest.raises(ValueError, match="unknown model"):
+        get_config("gpt-5")
+
+
+def test_overrides_apply():
+    cfg = get_config("llama3-8b", n_layers=2, max_seq_len=1024)
+    assert cfg.n_layers == 2 and cfg.max_seq_len == 1024
+
+
+def test_tied_embeddings_matches_transformers(tmp_path):
+    """Llama-3.2's tie_word_embeddings path: a real HF checkpoint with
+    tied weights loads through hf_io and our logits match HF's."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from radixmesh_tpu.models.hf_io import load_hf_checkpoint
+
+    hf_cfg = LlamaConfig(
+        vocab_size=512, hidden_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=256,
+        rope_theta=10000.0, rms_norm_eps=1e-5, max_position_embeddings=512,
+        tie_word_embeddings=True, attention_bias=False, use_cache=False,
+    )
+    torch.manual_seed(11)
+    model = LlamaForCausalLM(hf_cfg).to(torch.float32).eval()
+    ckpt = tmp_path / "tied"
+    model.save_pretrained(ckpt, safe_serialization=True)
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=32, intermediate=256, rope_theta=10000.0,
+        rope_scaling=None, max_seq_len=512, tie_embeddings=True,
+        dtype=jnp.float32,
+    )
+    params = load_hf_checkpoint(str(ckpt), cfg)
+    assert "lm_head" not in params
+
+    ids = [3, 141, 59, 26, 250, 8]
+    toks = jnp.asarray([ids], jnp.int32)
+    pos = jnp.arange(len(ids), dtype=jnp.int32)[None]
+    empty = jnp.zeros((cfg.n_layers, 1, 0, cfg.n_kv_heads, cfg.head_dim),
+                      cfg.dtype)
+    ours, _, _ = prefill_forward(
+        params, cfg, toks, pos, empty, empty, jnp.zeros((1,), jnp.int32)
+    )
+    with torch.no_grad():
+        theirs = model(torch.tensor([ids])).logits[0].float().numpy()
+    np.testing.assert_allclose(
+        np.asarray(ours[0], np.float32), theirs, rtol=2e-4, atol=2e-4
+    )
