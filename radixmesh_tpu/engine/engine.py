@@ -721,6 +721,45 @@ class Engine:
         pp = self.device_mesh.shape["pp"]
         return pp if batch % pp == 0 else 1
 
+    def _forward_chunk(
+        self, toks, poss, sl, pt, kvlen, kv_block: int
+    ):
+        """One chunk forward through the right backend: the pipeline
+        schedule under pp, ``prefill_chunk_paged`` otherwise. Shared by
+        group prefill and the speculative verify pass so the dispatch
+        cannot drift between them (``kv_scale`` rides only the non-pp
+        path — pp engines reject quantized pools at construction)."""
+        if self._pp:
+            from radixmesh_tpu.parallel.pp_serving import pp_forward_chunk
+
+            return pp_forward_chunk(
+                self.params,
+                self.cfg,
+                toks,
+                poss,
+                self.pool.kv,
+                sl,
+                pt,
+                kvlen,
+                page_size=self.page_size,
+                kv_block_pages=kv_block,
+                mesh=self.device_mesh,
+                n_micro=self._pp_n_micro(toks.shape[0]),
+            )
+        return prefill_chunk_paged(
+            self.params,
+            self.cfg,
+            toks,
+            poss,
+            self.pool.kv,
+            sl,
+            pt,
+            kvlen,
+            page_size=self.page_size,
+            kv_block_pages=kv_block,
+            kv_scale=self.pool.kv_scale,
+        )
+
     def _sp_capable(self, member: tuple) -> bool:
         """A fresh (no cached prefix) long prompt on a mesh with an sp
         axis prefills sequence-sharded — ring attention over ICI."""
@@ -824,37 +863,14 @@ class Engine:
                         lastpos[i] = nv - 1  # this chunk holds the last token
                 else:
                     kvlen[i] = totals[i]
-            if self._pp:
-                from radixmesh_tpu.parallel.pp_serving import pp_forward_chunk
-
-                res = pp_forward_chunk(
-                    self.params,
-                    self.cfg,
-                    jnp.asarray(toks),
-                    jnp.asarray(poss),
-                    self.pool.kv,
-                    jnp.asarray(sl),
-                    pt_dev,
-                    jnp.asarray(kvlen),
-                    page_size=ps,
-                    kv_block_pages=kv_block,
-                    mesh=self.device_mesh,
-                    n_micro=self._pp_n_micro(B),
-                )
-            else:
-                res = prefill_chunk_paged(
-                    self.params,
-                    self.cfg,
-                    jnp.asarray(toks),
-                    jnp.asarray(poss),
-                    self.pool.kv,
-                    jnp.asarray(sl),
-                    pt_dev,
-                    jnp.asarray(kvlen),
-                    page_size=ps,
-                    kv_block_pages=kv_block,
-                    kv_scale=self.pool.kv_scale,
-                )
+            res = self._forward_chunk(
+                jnp.asarray(toks),
+                jnp.asarray(poss),
+                jnp.asarray(sl),
+                pt_dev,
+                jnp.asarray(kvlen),
+                kv_block,
+            )
             logits = self._commit_pool_update(res)
             for i in range(N):
                 if lastpos[i] >= 0:
@@ -1156,10 +1172,12 @@ class Engine:
         disable the path. Budget and headroom limits are per-row
         (``_spec_row_ok``): a nearly-finished request rides the launch
         with an empty draft — exactly a plain step for that row — instead
-        of switching speculation off for the whole batch. pp engines
-        decode through the pipeline schedule only (fused/spec launches
-        aren't pp-scheduled yet)."""
-        if self.waiting or self._pp:
+        of switching speculation off for the whole batch. Under pp the
+        verify chunk rides the pipeline schedule for ANY batch size
+        (``_pp_n_micro`` falls back to one wave when the batch doesn't
+        split into pp microbatches — single-stream serving, speculation's
+        prime latency case, must not lose it)."""
+        if self.waiting:
             return False
         return any(req is not None for req in self._rows)
 
@@ -1310,18 +1328,15 @@ class Engine:
             self.stats.spec_proposed += len(draft)
             self._m_spec_proposed.inc(len(draft))
 
-        res = prefill_chunk_paged(
-            self.params,
-            self.cfg,
+        # The verify pass is just a C=γ+1 chunk; _forward_chunk picks the
+        # pipeline schedule under pp (parallel/pp_serving.py).
+        res = self._forward_chunk(
             jnp.asarray(toks),
             jnp.asarray(poss),
-            self.pool.kv,
             jnp.asarray(sl),
             jnp.asarray(pt),
             jnp.asarray(kvlen),
-            page_size=ps,
-            kv_block_pages=kv_block,
-            kv_scale=self.pool.kv_scale,
+            kv_block,
         )
         logits = self._commit_pool_update(res)
         self._rng, key = jax.random.split(self._rng)
