@@ -65,6 +65,13 @@ def _pow2_at_least(n: int, floor: int = 8) -> int:
     return 1 << (n - 1).bit_length()
 
 
+# Pages per attention KV block in every chunked launch (prefill, spec
+# verify, pp decode). Page tables are pow2-padded with this as the floor,
+# and attend_chunk_hybrid requires max_pages to divide by it — one
+# constant so the padding and the kernels can't drift apart.
+_KV_BLOCK_PAGES = 32
+
+
 @dataclass
 class EngineStats:
     """Hit-rate + throughput counters (the reference never increments its
@@ -139,10 +146,6 @@ class Engine:
                     f"divide tp={tp}"
                 )
             if self._pp:
-                if kv_quant is not None:
-                    raise ValueError(
-                        "pp serving does not support a quantized pool yet"
-                    )
                 if cfg.n_layers % device_mesh.shape["pp"]:
                     raise ValueError(
                         f"n_layers={cfg.n_layers} is not divisible by "
@@ -727,8 +730,8 @@ class Engine:
         """One chunk forward through the right backend: the pipeline
         schedule under pp, ``prefill_chunk_paged`` otherwise. Shared by
         group prefill and the speculative verify pass so the dispatch
-        cannot drift between them (``kv_scale`` rides only the non-pp
-        path — pp engines reject quantized pools at construction)."""
+        cannot drift between them; quantized pools thread their scales
+        through either path."""
         if self._pp:
             from radixmesh_tpu.parallel.pp_serving import pp_forward_chunk
 
@@ -745,6 +748,7 @@ class Engine:
                 kv_block_pages=kv_block,
                 mesh=self.device_mesh,
                 n_micro=self._pp_n_micro(toks.shape[0]),
+                kv_scale=self.pool.kv_scale,
             )
         return prefill_chunk_paged(
             self.params,
@@ -821,7 +825,7 @@ class Engine:
         batched sample at the end → one host sync for the whole group."""
         N = len(group)
         ps = self.page_size
-        kv_block = 32
+        kv_block = _KV_BLOCK_PAGES
         prompts = [g[0].prompt for g in group]
         reuses = [g[2] for g in group]
         totals = [len(p) for p in prompts]
@@ -1028,20 +1032,13 @@ class Engine:
             # A decode step is a C=1 chunk through the layer pipeline
             # (parallel/pp_serving.py) — same page-table attention, same
             # pool scatter, stage weights never move.
-            from radixmesh_tpu.parallel.pp_serving import pp_forward_chunk
-
-            res = pp_forward_chunk(
-                self.params,
-                self.cfg,
+            res = self._forward_chunk(
                 jnp.asarray(self._tokens)[:, None],
                 jnp.asarray(lengths - 1)[:, None],
-                self.pool.kv,
                 jnp.asarray(slots)[:, None],
                 jnp.asarray(self._page_table),
                 jnp.asarray(lengths),
-                page_size=self.page_size,
-                mesh=self.device_mesh,
-                n_micro=self._pp_n_micro(self.max_batch),
+                _KV_BLOCK_PAGES,
             )
             logits = self._commit_pool_update(res)[:, 0]
         else:
@@ -1127,6 +1124,7 @@ class Engine:
                 page_size=self.page_size,
                 k_steps=k,
                 mesh=self.device_mesh,
+                kv_scale=self.pool.kv_scale,
             )
         else:
             res = decode_multi(
@@ -1295,7 +1293,7 @@ class Engine:
         step_t0 = time.monotonic()
 
         B = self.max_batch
-        kv_block = 32
+        kv_block = _KV_BLOCK_PAGES
         maxp = _pow2_at_least(
             max(
                 (r.kv_len + len(drafts.get(row, r.prompt[:0]))) // ps + 1

@@ -449,12 +449,9 @@ def prefill_chunk_paged(
             # sees exactly what any later pool read will see (the fused
             # decode kernel keeps the same invariant) — otherwise logits
             # drift between a speculative verify pass and plain decode.
-            from radixmesh_tpu.ops.quant import quantize_kv
+            from radixmesh_tpu.ops.quant import quantize_for_store
 
-            k_int, k_sc = quantize_kv(k, axis=-1)  # int8 [B,C,H,D], f32 [B,C,H]
-            v_int, v_sc = quantize_kv(v, axis=-1)
-            k = k_int.astype(jnp.float32) * k_sc[..., None]
-            v = v_int.astype(jnp.float32) * v_sc[..., None]
+            k_int, v_int, k_sc, v_sc, k, v = quantize_for_store(k, v)
         attn = attend_chunk_hybrid(
             q,
             k,

@@ -20,7 +20,12 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["quantize_kv", "dequantize_kv", "KV_QUANT_DTYPES"]
+__all__ = [
+    "quantize_kv",
+    "dequantize_kv",
+    "quantize_for_store",
+    "KV_QUANT_DTYPES",
+]
 
 KV_QUANT_DTYPES = {"int8": jnp.int8}
 
@@ -48,3 +53,21 @@ def quantize_kv(x: jnp.ndarray, axis: int = -1):
 def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, axis: int = -1):
     """Inverse of :func:`quantize_kv` (f32)."""
     return q.astype(jnp.float32) * jnp.expand_dims(scale, axis)
+
+
+def quantize_for_store(k: jnp.ndarray, v: jnp.ndarray):
+    """The see-what-you-store step every quantized producer shares: new
+    K/V is quantized NOW and the layer attends the DEQUANTIZED copy, so
+    logits are identical between this pass and any later pool read (a
+    speculative verify, a prefix hit, a plain decode). One implementation
+    — the single-chip chunk path and both pipeline paths call it — so the
+    invariant cannot drift per call site.
+
+    Returns ``(k_int, v_int, k_scale, v_scale, k_deq, v_deq)``.
+    """
+    k_int, k_sc = quantize_kv(k, axis=-1)
+    v_int, v_sc = quantize_kv(v, axis=-1)
+    return (
+        k_int, v_int, k_sc, v_sc,
+        dequantize_kv(k_int, k_sc), dequantize_kv(v_int, v_sc),
+    )

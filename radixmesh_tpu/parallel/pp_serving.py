@@ -110,10 +110,15 @@ def shard_params_pp(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     return out
 
 
+def pp_scale_spec() -> P:
+    """int8-pool scales ``[2, L, Hkv, slots]``: shard with their data."""
+    return P(None, "pp", "tp", None)
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "page_size", "kv_block_pages", "mesh", "n_micro"),
-    donate_argnames=("kv_pool",),
+    donate_argnames=("kv_pool", "kv_scale"),
 )
 def pp_forward_chunk(
     params: dict,
@@ -129,11 +134,15 @@ def pp_forward_chunk(
     kv_block_pages: int = 32,
     mesh: Mesh,
     n_micro: int = 1,
+    kv_scale: jnp.ndarray | None = None,  # [2, L, Hkv, slots] int8 pool
 ):
     """Logits + updated pool for one chunk through the layer pipeline.
 
     ``B`` must divide into ``n_micro`` microbatches. Returns
-    ``(logits [B, C, V], kv_pool)`` with logits replicated.
+    ``(logits [B, C, V], kv_pool)`` with logits replicated — plus the
+    updated ``kv_scale`` when the pool is int8-quantized (the chunk is
+    quantized in-layer and attended dequantized, the same
+    see-what-you-store invariant ``prefill_chunk_paged`` keeps).
     """
     pp = mesh.shape["pp"]
     tp = mesh.shape.get("tp", 1)
@@ -164,27 +173,40 @@ def pp_forward_chunk(
     layer_specs = {
         k: v for k, v in pp_layer_specs().items() if k in params["layers"]
     }
+    quant = kv_scale is not None
+    scale_in_spec = pp_scale_spec() if quant else P()
+    scale_arg = kv_scale if quant else jnp.zeros((), jnp.float32)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(layer_specs, pp_pool_spec(), P(), P(), P(), P(), P()),
-        out_specs=(P(), pp_pool_spec()),
+        in_specs=(
+            layer_specs, pp_pool_spec(), scale_in_spec,
+            P(), P(), P(), P(), P(),
+        ),
+        out_specs=(P(), pp_pool_spec(), scale_in_spec),
         check_vma=False,
     )
-    def run(layers, pool, x_all, pos_all, slots_all, pt_all, kvlen_all):
+    def run(layers, pool, scale, x_all, pos_all, slots_all, pt_all, kvlen_all):
         # Per-device views: layers leaves [L/pp, ...] head-sliced; pool
-        # [2, L/pp, Hkv/tp, slots, D].
+        # [2, L/pp, Hkv/tp, slots, D]; scale [2, L/pp, Hkv/tp, slots].
         idx = jax.lax.axis_index("pp")
         l_loc = pool.shape[1]
         pages = pool.reshape(
             2, l_loc, hkv_loc, num_slots // page_size, page_size, D
         )
+        scale_pages = (
+            scale.reshape(
+                2, l_loc, hkv_loc, num_slots // page_size, page_size
+            )
+            if quant
+            else None
+        )
 
         def stage(h, pos, pt, kvlen):
             """This stage's L/pp layers over one microbatch's chunk.
-            Returns (h, (k_stack, v_stack)) with the chunk K/V of every
-            local layer — scattered into the pool AFTER the tick scan."""
+            Returns (h, per-layer chunk K/V payloads) — scattered into the
+            pool AFTER the tick scan."""
             prior = jnp.minimum(pos[:, 0], kvlen)
 
             def body(h, xs):
@@ -200,9 +222,16 @@ def pp_forward_chunk(
                 v = v.reshape(mb, C, hkv_loc, D)
                 q = apply_rope(q, pos, inv_freq)
                 k = apply_rope(k, pos, inv_freq)
+                if quant:
+                    # Quantize NOW, attend the dequantized copy — the
+                    # shared see-what-you-store step (ops/quant.py).
+                    from radixmesh_tpu.ops.quant import quantize_for_store
+
+                    k_int, v_int, k_sc, v_sc, k, v = quantize_for_store(k, v)
                 attn = attend_chunk_hybrid(
                     q, k, v, pages, pt, pos, prior, kvlen, l_idx,
                     kv_block_pages=kv_block_pages,
+                    kv_scales=scale_pages,
                 )
                 o = jnp.einsum(
                     "bsqd,qdh->bsh",
@@ -220,6 +249,8 @@ def pp_forward_chunk(
                     "bsi,ih->bsh", gate * up, lp["w_down"], precision=_PREC
                 )
                 h = h + jax.lax.psum(down, "tp")
+                if quant:
+                    return h, (k_int, v_int, k_sc, v_sc)
                 return h, (k.astype(pool.dtype), v.astype(pool.dtype))
 
             return jax.lax.scan(
@@ -259,12 +290,12 @@ def pp_forward_chunk(
 
         buf0 = jnp.zeros((mb, C, cfg.hidden), x_all.dtype)
         outs0 = jnp.zeros((n_micro, mb, C, cfg.hidden), x_all.dtype)
-        (_, outs), (k_ticks, v_ticks) = jax.lax.scan(
+        (_, outs), kv_ticks = jax.lax.scan(
             tick, (buf0, outs0), jnp.arange(n_ticks)
         )
-        # k_ticks/v_ticks: [ticks, L/pp, mb, C, Hkv/tp, D]. Scatter each
-        # valid tick's microbatch-KV into the local pool shard; invalid
-        # (warm-up/drain) ticks re-write the existing values (no-op).
+        # kv_ticks leaves: [ticks, L/pp, mb, C, Hkv/tp(, D)]. Scatter each
+        # valid tick's microbatch payloads into the local pool shard;
+        # invalid (warm-up/drain) ticks re-write the existing values.
         for t in range(n_ticks):
             m = t - idx
             safe_m = jnp.clip(m, 0, n_micro - 1)
@@ -273,28 +304,41 @@ def pp_forward_chunk(
                 slots_all, safe_m, 0, keepdims=False
             )  # [mb, C]
             # [L/pp, mb, C, Hkv/tp, D] → pool target [2, L/pp, Hkv/tp, mb, C, D]
-            new = jnp.stack([k_ticks[t], v_ticks[t]]).transpose(0, 1, 4, 2, 3, 5)
+            new = jnp.stack(
+                [kv_ticks[0][t], kv_ticks[1][t]]
+            ).transpose(0, 1, 4, 2, 3, 5)
             old = pool[:, :, :, sl]
             pool = pool.at[:, :, :, sl].set(jnp.where(valid, new, old))
+            if quant:
+                new_s = jnp.stack(
+                    [kv_ticks[2][t], kv_ticks[3][t]]
+                ).transpose(0, 1, 4, 2, 3)
+                old_s = scale[:, :, :, sl]
+                scale = scale.at[:, :, :, sl].set(
+                    jnp.where(valid, new_s, old_s)
+                )
         # Finished activations live on the last stage; psum replicates
         # them over pp (other stages contribute zeros). tp is already
         # uniform (both block psums precede every write into `outs`).
         hidden = jax.lax.psum(
             jnp.where(idx == last, outs.astype(jnp.float32), 0.0), "pp"
         ).astype(x_all.dtype)
-        return hidden, pool
+        return hidden, pool, scale
 
-    hidden, kv_pool = run(
-        params["layers"], kv_pool, x_all, pos_all, slots_all, pt_all, kvlen_all
+    hidden, kv_pool, kv_scale_out = run(
+        params["layers"], kv_pool, scale_arg, x_all, pos_all, slots_all,
+        pt_all, kvlen_all,
     )
     logits = _logits(params, cfg, hidden.reshape(B, C, cfg.hidden))
+    if quant:
+        return logits, kv_pool, kv_scale_out
     return logits, kv_pool
 
 
 @partial(
     jax.jit,
     static_argnames=("cfg", "page_size", "k_steps", "mesh"),
-    donate_argnames=("kv_pool",),
+    donate_argnames=("kv_pool", "kv_scale"),
 )
 def pp_decode_multi(
     params: dict,
@@ -311,6 +355,7 @@ def pp_decode_multi(
     page_size: int = 16,
     k_steps: int = 8,
     mesh: Mesh,
+    kv_scale: jnp.ndarray | None = None,  # [2, L, Hkv, slots] int8 pool
 ):
     """``k_steps`` fused decode iterations through the layer PIPELINE:
     one host round trip per k tokens per batch, under pp×tp.
@@ -361,19 +406,22 @@ def pp_decode_multi(
     }
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     head_spec = P() if cfg.tie_embeddings else P(None, "tp")
+    quant = kv_scale is not None
+    scale_in_spec = pp_scale_spec() if quant else P()
+    scale_arg = kv_scale if quant else jnp.zeros((), jnp.float32)
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(
-            layer_specs, pp_pool_spec(), P(), P(), head_spec,
+            layer_specs, pp_pool_spec(), scale_in_spec, P(), P(), head_spec,
             P(), P(), P(), P(), P(), P(), P(),
         ),
-        out_specs=(P(), pp_pool_spec()),
+        out_specs=(P(), pp_pool_spec(), scale_in_spec),
         check_vma=False,
     )
-    def run(layers, pool, embed, final_norm, head_local, toks_all, pt_all,
-            len_all, temp_all, topp_all, topk_all, key):
+    def run(layers, pool, scale, embed, final_norm, head_local, toks_all,
+            pt_all, len_all, temp_all, topp_all, topk_all, key):
         from radixmesh_tpu.ops.attention import attend_decode_ref
         from radixmesh_tpu.ops.sampling import sample_tokens
 
@@ -382,13 +430,13 @@ def pp_decode_multi(
         l_loc = pool.shape[1]
         rows = jnp.arange(mb)
 
-        def stage(pool, x, pt, kvlen, slot, valid):
+        def stage(pool, scale, x, pt, kvlen, slot, valid):
             """This stage's layers over one microbatch's single token.
             ``x`` [mb, H]; KV write at ``slot`` masked by ``valid``."""
             pos = (kvlen - 1)[:, None]  # [mb, 1] absolute position
 
             def body(carry, xs):
-                pool, h = carry
+                pool, scale, h = carry
                 l_idx, lp = xs
                 hn = rms_norm(h[:, None, :], lp["attn_norm"], cfg.rms_eps)
                 q = jnp.einsum("bsh,hd->bsd", hn, lp["wq"], precision=_PREC)
@@ -403,9 +451,24 @@ def pp_decode_multi(
                 # invalid (warm-up/drain) ticks re-write old values. The
                 # mixed scalar+array index puts the advanced axes FIRST:
                 # target shape is [mb, 2, Hkv/tp, D].
-                new_kv = jnp.stack(
-                    [k_[:, 0], v_[:, 0]], axis=1
-                ).astype(pool.dtype)
+                if quant:
+                    from radixmesh_tpu.ops.quant import quantize_for_store
+
+                    k_int, v_int, k_sc, v_sc, _, _ = quantize_for_store(
+                        k_, v_
+                    )
+                    new_kv = jnp.stack(
+                        [k_int[:, 0], v_int[:, 0]], axis=1
+                    ).astype(pool.dtype)
+                    new_sc = jnp.stack([k_sc[:, 0], v_sc[:, 0]], axis=1)
+                    old_s = scale[:, l_idx, :, slot]
+                    scale = scale.at[:, l_idx, :, slot].set(
+                        jnp.where(valid, new_sc, old_s)
+                    )
+                else:
+                    new_kv = jnp.stack(
+                        [k_[:, 0], v_[:, 0]], axis=1
+                    ).astype(pool.dtype)
                 old = pool[:, l_idx, :, slot]
                 pool = pool.at[:, l_idx, :, slot].set(
                     jnp.where(valid, new_kv, old)
@@ -413,9 +476,18 @@ def pp_decode_multi(
                 pages = jax.lax.dynamic_index_in_dim(
                     pool, l_idx, 1, keepdims=False
                 ).reshape(2, hkv_loc, num_slots // page_size, page_size, D)
-                attn = attend_decode_ref(
-                    q[:, 0], pages[0], pages[1], pt, kvlen
-                )
+                if quant:
+                    sc_pages = jax.lax.dynamic_index_in_dim(
+                        scale, l_idx, 1, keepdims=False
+                    ).reshape(2, hkv_loc, num_slots // page_size, page_size)
+                    attn = attend_decode_ref(
+                        q[:, 0], pages[0], pages[1], pt, kvlen,
+                        k_scales=sc_pages[0], v_scales=sc_pages[1],
+                    )
+                else:
+                    attn = attend_decode_ref(
+                        q[:, 0], pages[0], pages[1], pt, kvlen
+                    )
                 o = jnp.einsum(
                     "bqd,qdh->bh",
                     attn.reshape(mb, hq_loc, D),
@@ -432,15 +504,15 @@ def pp_decode_multi(
                     "bsi,ih->bsh", gate * up, lp["w_down"], precision=_PREC
                 )[:, 0]
                 h = h + jax.lax.psum(down, "tp")
-                return (pool, h), None
+                return (pool, scale, h), None
 
-            (pool, h), _ = jax.lax.scan(
-                body, (pool, x), (jnp.arange(l_loc), layers)
+            (pool, scale, h), _ = jax.lax.scan(
+                body, (pool, scale, x), (jnp.arange(l_loc), layers)
             )
-            return pool, h
+            return pool, scale, h
 
         def tick(carry, t):
-            pool, act_buf, tok_buf, outs = carry
+            pool, scale, act_buf, tok_buf, outs = carry
             v = t - idx
             s = jnp.clip(v // pp, 0, k_steps - 1)
             m = jnp.clip(v, 0, None) % pp
@@ -462,7 +534,7 @@ def pp_decode_multi(
             tok_in = jnp.where(s == 0, first, tok_buf)
             x0 = embed[tok_in]
             x = jnp.where(idx == 0, x0, act_buf)
-            pool, y = stage(pool, x, pt, kvlen, slot, valid)
+            pool, scale, y = stage(pool, scale, x, pt, kvlen, slot, valid)
 
             # Last stage: head + on-device sampling for (m, s).
             hn = rms_norm(y[:, None, :], final_norm, cfg.rms_eps)[:, 0]
@@ -496,25 +568,28 @@ def pp_decode_multi(
                 y, "pp", [(i, i + 1) for i in range(pp - 1)]
             )
             tok_buf = jax.lax.ppermute(sampled, "pp", [(last, 0)])
-            return (pool, act_buf, tok_buf, outs), None
+            return (pool, scale, act_buf, tok_buf, outs), None
 
         act0 = jnp.zeros((mb, cfg.hidden), embed.dtype)
         tok0 = jnp.zeros((mb,), jnp.int32)
         outs0 = jnp.zeros((n_micro, mb, k_steps), jnp.int32)
-        (pool, _, _, outs), _ = jax.lax.scan(
-            tick, (pool, act0, tok0, outs0), jnp.arange(n_ticks)
+        (pool, scale, _, _, outs), _ = jax.lax.scan(
+            tick, (pool, scale, act0, tok0, outs0), jnp.arange(n_ticks)
         )
         # Sampled tokens live on the last stage; psum replicates (other
         # stages hold zeros). tp already uniform: the gathered logits and
         # the folded key are identical on every tp peer.
         outs = jax.lax.psum(jnp.where(idx == last, outs, 0), "pp")
-        return outs, pool
+        return outs, pool, scale
 
-    outs, kv_pool = run(
-        params["layers"], kv_pool, params["embed"], params["final_norm"],
-        head, toks_all, pt_all, len_all, temp_all, topp_all, topk_all, key,
+    outs, kv_pool, kv_scale_out = run(
+        params["layers"], kv_pool, scale_arg, params["embed"],
+        params["final_norm"], head, toks_all, pt_all, len_all, temp_all,
+        topp_all, topk_all, key,
     )
     # [n_micro, mb, k] → the decode_multi contract [k, B] (row-major
     # microbatch grouping mirrors every other reshape in this module).
     sampled = outs.reshape(B, k_steps).T
+    if quant:
+        return sampled, kv_pool, kv_scale_out
     return sampled, kv_pool

@@ -109,11 +109,52 @@ def test_pp_engine_prefix_hit(mesh):
 
 
 def test_pp_validations(mesh):
-    with pytest.raises(ValueError, match="quantized pool"):
-        Engine(CFG, PARAMS, device_mesh=mesh, kv_quant="int8")
     bad = CFG.replace(n_layers=3)  # 3 layers, pp=2
     with pytest.raises(ValueError, match="not divisible by"):
         Engine(bad, init_params(bad, jax.random.PRNGKey(0)), device_mesh=mesh)
+
+
+def test_pp_int8_matches_single_device_int8(mesh):
+    """int8 KV under pp: scales shard with their layers/heads
+    (pp_scale_spec) and both prefill chunks and decode steps quantize
+    in-layer exactly like the single-chip quantized paths — greedy tokens
+    must match a single-device int8 engine."""
+    prompts = [
+        np.random.default_rng(7).integers(1, CFG.vocab_size, 22).tolist(),
+        np.random.default_rng(8).integers(1, CFG.vocab_size, 15).tolist(),
+    ]
+    single = Engine(
+        CFG, PARAMS, num_slots=1024, page_size=4, max_batch=4,
+        kv_quant="int8",
+    )
+    want = single.generate(prompts, GREEDY)
+    pp_eng = Engine(
+        CFG, PARAMS, num_slots=1024, page_size=4, max_batch=4,
+        device_mesh=mesh, kv_quant="int8",
+    )
+    got = pp_eng.generate(prompts, GREEDY)
+    assert want == got
+    # Prefix reuse against the quantized layer-sharded pool.
+    cached0 = pp_eng.stats.cached_tokens
+    out2 = pp_eng.generate([prompts[0] + [9, 8]], GREEDY)[0]
+    assert len(out2) == 6
+    assert pp_eng.stats.cached_tokens - cached0 >= 20
+
+
+def test_pp_int8_fused_decode(mesh):
+    """int8 + fused k-step pipeline decode compose."""
+    prompt = list(range(1, 21))
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
+    single = Engine(
+        CFG, PARAMS, num_slots=1024, page_size=4, max_batch=4,
+        kv_quant="int8",
+    )
+    want = single.generate([prompt], sampling)[0]
+    pp_eng = Engine(
+        CFG, PARAMS, num_slots=1024, page_size=4, max_batch=4,
+        device_mesh=mesh, kv_quant="int8", decode_steps_per_launch=4,
+    )
+    assert pp_eng.generate([prompt], sampling)[0] == want
 
 
 class TestPPFusedDecode:
@@ -237,6 +278,25 @@ class TestPPSpecDecode:
         assert spec.generate([prompt], sampling)[0] == want
         replay = spec.generate([prompt], sampling)[0]
         assert replay == want
+        assert spec.stats.spec_accepted > 0
+
+    def test_pp_spec_int8(self, mesh):
+        """pp + int8 + speculation compose: the verify chunk quantizes
+        in-layer (the see-what-you-store invariant) so a replay through a
+        quantized pipeline pool matches a single-device int8 engine."""
+        prompt = list(range(5, 32))
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=6)
+        plain = Engine(
+            CFG, PARAMS, num_slots=1024, page_size=4, max_batch=2,
+            kv_quant="int8",
+        )
+        want = plain.generate([prompt], sampling)[0]
+        spec = Engine(
+            CFG, PARAMS, num_slots=1024, page_size=4, max_batch=2,
+            device_mesh=mesh, kv_quant="int8", spec_decode_tokens=3,
+        )
+        assert spec.generate([prompt], sampling)[0] == want
+        assert spec.generate([prompt], sampling)[0] == want  # replay
         assert spec.stats.spec_accepted > 0
 
 
