@@ -275,9 +275,16 @@ class Engine:
         self._pressure = False
         self._rows: list[Request | None] = [None] * max_batch
         self._tokens = np.zeros(max_batch, dtype=np.int32)
-        self._page_table = np.full(
-            (max_batch, self.max_pages), self._scratch_page, dtype=np.int32
+        # One backing buffer, width padded to the KV block (the chunked
+        # launches' blockwise attention requires it); _page_table is the
+        # live [:, :max_pages] view every in-place write flows through,
+        # so pp decode can pass the padded buffer without per-step copies
+        # (the scratch tail never changes).
+        maxp_b = _pow2_at_least(self.max_pages, floor=_KV_BLOCK_PAGES)
+        self._page_table_padded = np.full(
+            (max_batch, maxp_b), self._scratch_page, dtype=np.int32
         )
+        self._page_table = self._page_table_padded[:, : self.max_pages]
         self._temps = np.zeros(max_batch, dtype=np.float32)
         self._top_ps = np.ones(max_batch, dtype=np.float32)
         self._top_ks = np.zeros(max_batch, dtype=np.int32)
@@ -1031,12 +1038,14 @@ class Engine:
         if self._pp:
             # A decode step is a C=1 chunk through the layer pipeline
             # (parallel/pp_serving.py) — same page-table attention, same
-            # pool scatter, stage weights never move.
+            # pool scatter, stage weights never move. The chunk path's
+            # blockwise attention needs the KV-block-padded table width —
+            # the padded backing buffer, no per-step copy.
             res = self._forward_chunk(
                 jnp.asarray(self._tokens)[:, None],
                 jnp.asarray(lengths - 1)[:, None],
                 jnp.asarray(slots)[:, None],
-                jnp.asarray(self._page_table),
+                jnp.asarray(self._page_table_padded),
                 jnp.asarray(lengths),
                 _KV_BLOCK_PAGES,
             )
