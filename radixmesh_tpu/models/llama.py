@@ -78,14 +78,10 @@ class ModelConfig:
 
     @classmethod
     def llama3_8b(cls) -> "ModelConfig":
-        return cls(
-            rope_scaling=(
-                ("factor", 8.0),
-                ("low_freq_factor", 1.0),
-                ("high_freq_factor", 4.0),
-                ("original_max_position_embeddings", 8192),
-            )
-        )
+        """Meta-Llama-3-8B: NO rope scaling (the HF config's
+        rope_scaling is null at this generation, same as the 70B;
+        scaling arrives with 3.1) and the 8k window."""
+        return cls(rope_scaling=None)
 
     @classmethod
     def llama3_70b(cls) -> "ModelConfig":
@@ -104,10 +100,19 @@ class ModelConfig:
 
     @classmethod
     def llama31_8b(cls) -> "ModelConfig":
-        """Llama-3.1: the 3.0-8B architecture (whose preset already
-        carries the llama3-scaled rope) with the 128k window; serving
-        length stays pool-bounded."""
-        return cls.llama3_8b().replace(max_seq_len=131072)
+        """Llama-3.1-8B: the 3.0-8B dims plus the 3.1 llama3-style rope
+        scaling + 128k window the base 3.0-8B preset deliberately lacks
+        (mirrors the 70B/3.1-70B split); serving length stays
+        pool-bounded."""
+        return cls.llama3_8b().replace(
+            rope_scaling=(
+                ("factor", 8.0),
+                ("low_freq_factor", 1.0),
+                ("high_freq_factor", 4.0),
+                ("original_max_position_embeddings", 8192),
+            ),
+            max_seq_len=131072,
+        )
 
     @classmethod
     def llama31_70b(cls) -> "ModelConfig":

@@ -295,11 +295,12 @@ class ServingFrontend:
                     stop_ids = tuple(body.get("stop_token_ids", ()))
                     if (
                         "text" in body
-                        and not stop_ids
+                        and "stop_token_ids" not in body
                         and frontend.tokenizer.eos_id is not None
                     ):
                         # Text callers reasonably expect generation to end
-                        # at EOS without knowing the id space.
+                        # at EOS without knowing the id space; an explicit
+                        # (even empty) stop_token_ids opts out.
                         stop_ids = (frontend.tokenizer.eos_id,)
                     sampling = SamplingParams(
                         temperature=float(body.get("temperature", 0.0)),

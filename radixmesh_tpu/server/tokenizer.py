@@ -70,7 +70,10 @@ class HFTokenizer:
         # at a real token).
         eos = self._tok.eos_token_id
         self.eos_id = None if eos is None else int(eos)
-        self.vocab_size = int(self._tok.vocab_size)
+        # len(tokenizer) includes added special tokens; `.vocab_size`
+        # does not (Llama-3 reports 128000 vs the 128256 ids it can
+        # emit), and callers size embedding checks off this field.
+        self.vocab_size = int(len(self._tok))
 
     def encode(self, text: str) -> list[int]:
         return list(self._tok.encode(text, add_special_tokens=False))

@@ -101,7 +101,15 @@ def run_engine_workload(engine, workload: MultiTurnWorkload) -> dict:
     at most the shared system prefix. Workload shapes differ wildly in
     how much of their traffic is reusable at all — ``hit_rate /
     ceiling_hit_rate`` (``reuse_efficiency``) is the cache-quality signal
-    that is comparable ACROSS shapes."""
+    that is comparable ACROSS shapes.
+
+    Caveat on turn-0-heavy shapes: the ceiling credits every turn-0
+    request after the very first with full system-prefix reuse, but all
+    of a round's turn-0 requests run concurrently in ONE generate()
+    batch, where admission order may publish the system prefix too late
+    for siblings in the same wave to reuse it. ``reuse_efficiency`` can
+    therefore structurally read < 1 on wide shapes even with a perfect
+    cache — it is an upper-bound denominator, not an achievable one."""
     from radixmesh_tpu.engine.request import SamplingParams
 
     sampling = SamplingParams(

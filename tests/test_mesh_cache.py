@@ -313,3 +313,86 @@ class TestIdempotence:
         assert node.tree.total_size() == size_after_first
         assert node.match_prefix([6, 6]).length == 2
         assert node.metrics["conflicts"] == 0
+
+    def test_duplicate_gc_exec_does_not_double_free(self, cluster):
+        """The native transport re-sends a coalesced burst after a
+        mid-burst reconnect, so duplicate frame delivery is routine — and
+        GC_EXEC is the op whose re-application would be catastrophic (a
+        double slot free corrupts the pool). Deliver the same GC_EXEC
+        frame twice to the slot owner and assert pool accounting moves
+        exactly once."""
+        from radixmesh_tpu.cache.oplog import GCEntry, Oplog, OplogType, serialize
+
+        key = [3, 1, 4]
+        winner, loser = cluster.node(0), cluster.node(2)
+        insert_with_pool(winner, key)
+        insert_with_pool(loser, key)
+        nk = NodeKey(key, loser.rank)
+        assert wait_for(lambda: nk in loser.dup_nodes)
+        free_before = loser.pool.free_slots
+        exec_op = Oplog(
+            op_type=OplogType.GC_EXEC,
+            origin_rank=winner.rank,
+            logic_id=777,
+            ttl=1,  # applied here, not forwarded
+            gc=[GCEntry(np.asarray(key, dtype=np.int32), loser.rank, 5)],
+        )
+        data = serialize(exec_op)
+        loser.oplog_received(data)
+        assert loser.pool.free_slots == free_before + len(key)
+        assert nk not in loser.dup_nodes
+        freed_once = loser.metrics["gc_freed_slots"]
+        loser.oplog_received(data)  # duplicate delivery
+        assert loser.pool.free_slots == free_before + len(key), (
+            "duplicate GC_EXEC freed slots twice"
+        )
+        assert loser.metrics["gc_freed_slots"] == freed_once
+
+    def test_duplicate_delete_reset_topo_join_are_idempotent(self, cluster):
+        """Every other re-sendable op type applied twice: DELETE and
+        RESET move pool accounting exactly once; a duplicate TOPO is
+        epoch-guarded and a duplicate JOIN for an already-included member
+        changes no view."""
+        from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
+        from radixmesh_tpu.policy.topology import encode_view
+
+        node = cluster.node(1)
+        insert_with_pool(node, [7, 7, 7])
+        free_before = node.pool.free_slots
+
+        delete_op = serialize(Oplog(
+            op_type=OplogType.DELETE, origin_rank=0, logic_id=801, ttl=1,
+            key=np.array([7, 7, 7], dtype=np.int32),
+        ))
+        node.oplog_received(delete_op)
+        freed = node.pool.free_slots
+        assert freed == free_before + 3
+        node.oplog_received(delete_op)
+        assert node.pool.free_slots == freed
+
+        insert_with_pool(node, [8, 8])
+        reset_op = serialize(Oplog(
+            op_type=OplogType.RESET, origin_rank=0, logic_id=802, ttl=1,
+        ))
+        node.oplog_received(reset_op)
+        after_reset = node.pool.free_slots
+        assert node.tree.total_size() == 0
+        node.oplog_received(reset_op)
+        assert node.pool.free_slots == after_reset
+
+        view_before = node.view
+        topo_op = serialize(Oplog(
+            op_type=OplogType.TOPO, origin_rank=0, logic_id=803, ttl=1,
+            value=encode_view(view_before),
+        ))
+        node.oplog_received(topo_op)
+        node.oplog_received(topo_op)
+        assert node.view.epoch == view_before.epoch
+        assert node.view.alive == view_before.alive
+
+        join_op = serialize(Oplog(
+            op_type=OplogType.JOIN, origin_rank=0, logic_id=804, ttl=1,
+        ))
+        node.oplog_received(join_op)
+        node.oplog_received(join_op)
+        assert node.view.epoch == view_before.epoch

@@ -32,6 +32,10 @@ def test_hf_config_parity_facts():
     silently corrupt numerics if copy-pasted (the eval_shape size checks
     can't see them): rope scaling is a 3.1-generation feature, and
     Qwen2.5's mid sizes use a different rms eps than 7B/72B."""
+    assert get_config("llama3-8b").rope_scaling is None
+    assert get_config("llama3-8b").max_seq_len == 8192
+    assert get_config("llama3.1-8b").rope_scaling is not None
+    assert get_config("llama3.1-8b").max_seq_len == 131072
     assert get_config("llama3-70b").rope_scaling is None
     assert get_config("llama3-70b").max_seq_len == 8192
     assert get_config("llama3.1-70b").rope_scaling is not None
