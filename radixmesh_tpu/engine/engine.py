@@ -196,6 +196,15 @@ class Engine:
         # this node's prefixes around the ring so the router can send
         # shared-prefix requests back here (radix_mesh.py:193-238).
         self.mesh = mesh
+        mesh_page = getattr(mesh, "page", 1) if mesh is not None else 1
+        if mesh_page > 1 and page_size % mesh_page:
+            # Page-granular replication ships pool page ids; engine
+            # publishes are aligned (and contiguous) at ENGINE pages, so
+            # the mesh page must divide it.
+            raise ValueError(
+                f"mesh page_size {mesh_page} must divide engine "
+                f"page_size {page_size}"
+            )
 
         if pool is not None:
             expected = dict(
@@ -769,6 +778,7 @@ class Engine:
             page_size=self.page_size,
             kv_block_pages=kv_block,
             kv_scale=self.pool.kv_scale,
+            mesh=self.device_mesh,
         )
 
     def _sp_capable(self, member: tuple) -> bool:
@@ -1134,6 +1144,7 @@ class Engine:
                 k_steps=k,
                 mesh=self.device_mesh,
                 kv_scale=self.pool.kv_scale,
+                scratch_slot=self._scratch_slot,
             )
         else:
             res = decode_multi(

@@ -306,6 +306,16 @@ def attend_chunk_hybrid(
     return out.transpose(0, 3, 1, 2, 4).reshape(B, C, Hq, D).astype(q.dtype)
 
 
+def default_use_kernel(head_dim: int) -> bool:
+    """THE backend/shape policy for dispatching to the Pallas kernels,
+    shared by every paged-attention entry (single-chip, sharded, and the
+    pp stage bodies — policy drift between them silently changes which
+    backend runs the kernels): TPU-ish backends only, and ``head_dim``
+    must be a lane multiple of 128 for the DMA tiling (production models
+    are all D=128)."""
+    return jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
+
+
 def paged_attention(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,
@@ -319,8 +329,7 @@ def paged_attention(
     the TPU DMA can't tile: head_dim must be a lane multiple of 128 —
     production models are all D=128)."""
     if use_kernel is None:
-        head_dim = q.shape[-1]
-        use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
+        use_kernel = default_use_kernel(q.shape[-1])
     if use_kernel:
         from radixmesh_tpu.ops.paged_attention import paged_attention_kernel
 
@@ -396,8 +405,7 @@ def paged_attention_pool(
     TPU kernel runs tensor-parallel via ``shard_map`` (heads sharded); the
     jnp path needs no wrapper — GSPMD partitions it from input shardings."""
     if use_kernel is None:
-        head_dim = q.shape[-1]
-        use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
+        use_kernel = default_use_kernel(q.shape[-1])
     if use_kernel:
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             return paged_attention_pool_kernel_sharded(
@@ -416,6 +424,110 @@ def paged_attention_pool(
             kv_scales[0, layer], kv_scales[1, layer],
         )
     return attend_decode_ref(q, k_pages, v_pages, page_table, lengths)
+
+
+def paged_chunk_attention_kernel_sharded(
+    q: jnp.ndarray,  # [B, C, Hq, D] — Hq sharded over tp
+    k_cur: jnp.ndarray,  # [B, C, Hkv, D] — Hkv sharded over tp
+    v_cur: jnp.ndarray,
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] — Hkv sharded over tp
+    page_table: jnp.ndarray,
+    prior_lengths: jnp.ndarray,
+    kv_lengths: jnp.ndarray,
+    layer: jnp.ndarray | int,
+    mesh,
+    tp_axis: str = "tp",
+    interpret: bool = False,
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] — Hkv sharded
+) -> jnp.ndarray:
+    """Tensor-parallel chunk-prefill kernel: heads are embarrassingly
+    parallel, so each chip runs the Pallas chunk kernel on its local head
+    shard of every page (same shape of wrapper as
+    ``paged_attention_pool_kernel_sharded`` — a ``pallas_call`` can't be
+    auto-partitioned by GSPMD)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from radixmesh_tpu.ops.paged_attention import paged_chunk_attention_kernel
+
+    layer_arr = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
+    in_specs = [
+        P(None, None, tp_axis, None),
+        P(None, None, tp_axis, None),
+        P(None, None, tp_axis, None),
+        P(None, None, tp_axis, None, None, None),
+        P(None, None),
+        P(None),
+        P(None),
+        P(None),
+    ]
+    args = [q, k_cur, v_cur, kv_pages, page_table, prior_lengths,
+            kv_lengths, layer_arr]
+    if kv_scales is not None:
+        in_specs.append(P(None, None, tp_axis, None, None))
+        args.append(kv_scales)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P(None, None, tp_axis, None),
+        check_vma=False,
+    )
+    def local(q, kc, vc, kv, pt, pr, ln, l, *maybe_scales):
+        sc = maybe_scales[0] if maybe_scales else None
+        return paged_chunk_attention_kernel(
+            q, kc, vc, kv, pt, pr, ln, l[0], interpret=interpret,
+            kv_scales=sc,
+        )
+
+    return local(*args)
+
+
+def paged_chunk_attention(
+    q: jnp.ndarray,  # [B, C, Hq, D]
+    k_cur: jnp.ndarray,  # [B, C, Hkv, D] this chunk's K (post-rope)
+    v_cur: jnp.ndarray,  # [B, C, Hkv, D]
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D]
+    page_table: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, C] — canonical: prior + arange(C)
+    prior_lengths: jnp.ndarray,  # [B]
+    kv_lengths: jnp.ndarray,  # [B]
+    layer: jnp.ndarray | int,
+    kv_block_pages: int = 32,
+    use_kernel: bool | None = None,
+    mesh=None,
+    interpret: bool = False,
+    kv_scales: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Chunk-prefill attention with backend dispatch, mirroring
+    ``paged_decode_attention``: the Pallas chunk kernel on TPU backends
+    (lane-aligned heads), the jnp ``attend_chunk_hybrid`` elsewhere. The
+    kernel derives causal masks from ``prior_lengths`` + chunk offsets,
+    which is exact for the canonical ``q_positions`` every serving path
+    produces (chunked prefill AND the speculative verify chunk); the jnp
+    path masks against ``q_positions`` directly. With ``mesh`` carrying a
+    tp axis the kernel runs per-chip on its head shard via shard_map."""
+    if use_kernel is None:
+        use_kernel = default_use_kernel(q.shape[-1])
+    if use_kernel:
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            return paged_chunk_attention_kernel_sharded(
+                q, k_cur, v_cur, kv_pages, page_table, prior_lengths,
+                kv_lengths, layer, mesh, interpret=interpret,
+                kv_scales=kv_scales,
+            )
+        from radixmesh_tpu.ops.paged_attention import paged_chunk_attention_kernel
+
+        return paged_chunk_attention_kernel(
+            q, k_cur, v_cur, kv_pages, page_table, prior_lengths,
+            kv_lengths, layer, interpret=interpret, kv_scales=kv_scales,
+        )
+    return attend_chunk_hybrid(
+        q, k_cur, v_cur, kv_pages, page_table, q_positions, prior_lengths,
+        kv_lengths, layer, kv_block_pages=kv_block_pages,
+        kv_scales=kv_scales,
+    )
 
 
 def paged_decode_fused_sharded(
@@ -501,8 +613,7 @@ def paged_decode_attention(
     Returns ``(attn [B, Hq, D], kv_pages)``.
     """
     if use_kernel is None:
-        head_dim = q.shape[-1]
-        use_kernel = jax.default_backend() not in ("cpu",) and head_dim % 128 == 0
+        use_kernel = default_use_kernel(q.shape[-1])
     if use_kernel:
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             return paged_decode_fused_sharded(

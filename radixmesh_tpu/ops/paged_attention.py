@@ -52,6 +52,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = [
     "paged_attention_kernel",
     "paged_attention_pool_kernel",
+    "paged_chunk_attention_kernel",
     "paged_decode_fused_kernel",
 ]
 
@@ -663,6 +664,286 @@ def paged_decode_fused_kernel(
         return out.reshape(B, Hq, D).astype(q.dtype), kv_out, scales_out
     kv_out, out = res
     return out.reshape(B, Hq, D).astype(q.dtype), kv_out
+
+
+def _chunk_kernel(
+    # scalar prefetch
+    prior_ref,  # SMEM [B] pool-context tokens per row (page-part bound)
+    kvlen_ref,  # SMEM [B] valid context incl. this chunk
+    page_table_ref,  # SMEM [B * padded] flattened
+    layer_ref,  # SMEM [1]
+    *refs,
+    page: int,
+    pages_per_block: int,
+    pages_per_seq: int,
+    chunk: int,  # C — dense keys per program
+    c_block: int,  # Cblk — queries per program
+    group: int,  # G — q heads per kv head
+    quantized: bool,
+):
+    """Chunk-prefill attention program for one ``(b, h, c-block)``: stream
+    the row's PRIOR context from pool pages through the online softmax
+    (double-buffered DMA within the program), then fold the current chunk
+    in as one dense causal block from VMEM. Query positions are canonical
+    (``prior + chunk offset`` — see the wrapper's contract), so masks
+    derive from scalars: prior bound for the page part, intra-chunk
+    causality + ``kvlen`` bound for the dense part."""
+    if quantized:
+        (q_ref, kc_ref, vc_ref, kv_hbm, scales_hbm, o_ref,
+         m_scr, l_scr, acc_scr, k_buf, v_buf, ks_buf, vs_buf,
+         sems, s_sems) = refs
+    else:
+        (q_ref, kc_ref, vc_ref, kv_hbm, o_ref,
+         m_scr, l_scr, acc_scr, k_buf, v_buf, sems) = refs
+        scales_hbm = ks_buf = vs_buf = s_sems = None
+    b, h, cb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    layer = layer_ref[0]
+    prior = prior_ref[b]
+    kvlen = kvlen_ref[b]
+    bk = page * pages_per_block
+    q_rows = c_block * group
+
+    def block_copies(i, slot):
+        off = b * pages_per_seq + i * pages_per_block
+        copies = [
+            _BlockCopy(kv_hbm, 0, layer, h, k_buf.at[slot], sems.at[slot, 0],
+                       page_table_ref, off, pages_per_block),
+            _BlockCopy(kv_hbm, 1, layer, h, v_buf.at[slot], sems.at[slot, 1],
+                       page_table_ref, off, pages_per_block),
+        ]
+        if quantized:
+            copies.append(
+                _BlockCopy(scales_hbm, 0, layer, h, ks_buf.at[slot],
+                           s_sems.at[slot, 0], page_table_ref, off,
+                           pages_per_block)
+            )
+            copies.append(
+                _BlockCopy(scales_hbm, 1, layer, h, vs_buf.at[slot],
+                           s_sems.at[slot, 1], page_table_ref, off,
+                           pages_per_block)
+            )
+        return copies
+
+    q = q_ref[...].astype(jnp.float32).reshape(q_rows, -1)  # pre-scaled
+    m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+    n_blocks = pl.cdiv(prior, bk)
+
+    @pl.when(n_blocks > 0)
+    def _cold_start():
+        for c in block_copies(0, 0):
+            c.start()
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_blocks)
+        def _prefetch_next():
+            for c in block_copies(i + 1, 1 - slot):
+                c.start()
+
+        cs = block_copies(i, slot)
+        cs[0].wait()
+        if quantized:
+            cs[2].wait()
+        k = k_buf[slot].astype(jnp.float32).reshape(bk, -1)
+        s = jax.lax.dot_general(  # [q_rows, bk]
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if quantized:
+            s = s * ks_buf[slot].reshape(bk)[None, :]
+        kv_pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # Canonical query positions sit at/after ``prior``, so the page
+        # part needs only the prior bound (strictly causal already).
+        s = jnp.where(kv_pos < prior, s, _MASK)
+
+        m_prev = m_scr[...]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new[:, :1])
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[...] = m_new
+
+        cs[1].wait()
+        if quantized:
+            cs[3].wait()
+            p = p * vs_buf[slot].reshape(bk)[None, :]
+        v = v_buf[slot].astype(jnp.float32).reshape(bk, -1)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr + pv
+        return ()
+
+    jax.lax.fori_loop(0, n_blocks, body, ())
+
+    # Dense block: the chunk itself, causal in chunk coordinates. Key
+    # c_k's absolute position is prior + c_k; query row r (= c*G + g of
+    # this c-block) sits at prior + cb*Cblk + c.
+    kc = kc_ref[...].astype(jnp.float32)  # [C, D]
+    vc = vc_ref[...].astype(jnp.float32)
+    s2 = jax.lax.dot_general(  # [q_rows, C]
+        q, kc,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    c_q = (
+        cb * c_block
+        + jax.lax.broadcasted_iota(jnp.int32, s2.shape, 0) // group
+    )
+    c_k = jax.lax.broadcasted_iota(jnp.int32, s2.shape, 1)
+    ok = (c_k <= c_q) & (prior + c_k < kvlen)
+    s2 = jnp.where(ok, s2, _MASK)
+    m_prev = m_scr[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s2, axis=-1, keepdims=True))
+    p2 = jnp.exp(s2 - m_new[:, :1])
+    corr = jnp.exp(m_prev - m_new)
+    l_fin = l_scr[...] * corr + jnp.sum(p2, axis=-1, keepdims=True)
+    acc_fin = acc_scr[...] * corr + jax.lax.dot_general(
+        p2, vc,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    out = jnp.where(l_fin > 0, acc_fin / jnp.maximum(l_fin, 1e-30), 0.0)
+    o_ref[...] = out.reshape(c_block, group, -1).astype(o_ref.dtype)
+
+
+def _chunk_block(chunk: int, group: int, max_rows: int = 1024) -> int:
+    """Largest power-of-two divisor of ``chunk`` whose query-row count
+    (``Cblk * G``) stays within the VMEM scratch budget."""
+    cblk = 1
+    while (
+        chunk % (cblk * 2) == 0 and cblk * 2 * group <= max_rows
+    ):
+        cblk *= 2
+    return cblk
+
+
+@functools.partial(
+    jax.jit, static_argnames=("pages_per_block", "q_block", "interpret")
+)
+def paged_chunk_attention_kernel(
+    q: jnp.ndarray,  # [B, C, Hq, D] — pre-rope'd chunk queries
+    k_cur: jnp.ndarray,  # [B, C, Hkv, D] this chunk's K (post-rope, dequantized)
+    v_cur: jnp.ndarray,  # [B, C, Hkv, D]
+    kv_pages: jnp.ndarray,  # [2, L, Hkv, P, page, D] full pool pages view
+    page_table: jnp.ndarray,  # [B, max_pages] int32
+    prior_lengths: jnp.ndarray,  # [B] pool tokens BEFORE this chunk
+    kv_lengths: jnp.ndarray,  # [B] valid context incl. this chunk
+    layer: jnp.ndarray | int,
+    pages_per_block: int | None = None,
+    q_block: int | None = None,
+    interpret: bool = False,
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] int8 pool
+) -> jnp.ndarray:
+    """Pallas chunk-prefill attention: SURVEY §7 hard part (a) for the
+    PREFILL side (VERDICT round-3 next-step #3 "pool-page chunk
+    attention"). The jnp oracle is ``ops/attention.py::attend_chunk_hybrid``
+    — same online-softmax merge of prior pool pages + the dense causal
+    chunk, but pages stream HBM→VMEM per (sequence, kv-head, query-block)
+    program instead of gathering [B, Hkv, bk, D] copies through XLA.
+
+    CONTRACT: query positions are canonical —
+    ``q_positions == prior_lengths[:, None] + arange(C)`` (the only form
+    the serving stack produces; both chunked prefill and the speculative
+    verify chunk satisfy it) — so causal masks derive from
+    ``prior_lengths``/``kv_lengths`` and the chunk offset alone, and the
+    chunk's K/V arrive dense from the layer activations (``k_cur``
+    already dequantized when the pool is int8, preserving the
+    see-what-you-store invariant).
+
+    Returns ``[B, C, Hq, D]``.
+    """
+    B, C, Hq, D = q.shape
+    _, _, Hkv, _, page, _ = kv_pages.shape
+    if Hq % Hkv:
+        raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
+    G = Hq // Hkv
+    quantized = kv_scales is not None
+    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+    cblk = q_block if q_block is not None else _chunk_block(C, G)
+    if C % cblk:
+        raise ValueError(f"q_block={cblk} must divide chunk C={C}")
+
+    scale = 1.0 / (D ** 0.5)
+    # [B, Hkv, C, G, D]: kv-head-major so each program's q block is one
+    # contiguous [Cblk, G, D] tile.
+    q5 = (q.astype(jnp.float32) * scale).reshape(B, C, Hkv, G, D).transpose(
+        0, 2, 1, 3, 4
+    )
+    kc = k_cur.transpose(0, 2, 1, 3)  # [B, Hkv, C, D]
+    vc = v_cur.transpose(0, 2, 1, 3)
+    q_spec = pl.BlockSpec(
+        (None, None, cblk, G, D), lambda b, h, cb, *_: (b, h, cb, 0, 0)
+    )
+    kc_spec = pl.BlockSpec(
+        (None, None, C, D), lambda b, h, cb, *_: (b, h, 0, 0)
+    )
+
+    kernel = functools.partial(
+        _chunk_kernel,
+        page=page,
+        pages_per_block=ppb,
+        pages_per_seq=padded,
+        chunk=C,
+        c_block=cblk,
+        group=G,
+        quantized=quantized,
+    )
+    in_specs = [q_spec, kc_spec, kc_spec, pl.BlockSpec(memory_space=pl.ANY)]
+    if quantized:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+    scratch = [
+        pltpu.VMEM((cblk * G, D), jnp.float32),
+        pltpu.VMEM((cblk * G, D), jnp.float32),
+        pltpu.VMEM((cblk * G, D), jnp.float32),
+        pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+        pltpu.VMEM((2, ppb, page, D), kv_pages.dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((2, ppb, page), jnp.float32),
+            pltpu.VMEM((2, ppb, page), jnp.float32),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
+    if quantized:
+        scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, Hkv, C // cblk),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=scratch,
+    )
+    args = [
+        jnp.asarray(prior_lengths, dtype=jnp.int32),
+        jnp.asarray(kv_lengths, dtype=jnp.int32),
+        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
+        q5,
+        kc,
+        vc,
+        kv_pages,
+    ]
+    if quantized:
+        args.append(kv_scales)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, C, G, D), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*args)
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, C, Hq, D).astype(q.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

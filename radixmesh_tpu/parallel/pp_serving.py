@@ -40,7 +40,10 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from radixmesh_tpu.models.llama import ModelConfig, _logits, _PREC
-from radixmesh_tpu.ops.attention import attend_chunk_hybrid
+from radixmesh_tpu.ops.attention import (
+    default_use_kernel,
+    paged_chunk_attention,
+)
 from radixmesh_tpu.ops.norm import rms_norm
 from radixmesh_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -117,7 +120,10 @@ def pp_scale_spec() -> P:
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "page_size", "kv_block_pages", "mesh", "n_micro"),
+    static_argnames=(
+        "cfg", "page_size", "kv_block_pages", "mesh", "n_micro",
+        "use_kernel", "interpret",
+    ),
     donate_argnames=("kv_pool", "kv_scale"),
 )
 def pp_forward_chunk(
@@ -135,6 +141,8 @@ def pp_forward_chunk(
     mesh: Mesh,
     n_micro: int = 1,
     kv_scale: jnp.ndarray | None = None,  # [2, L, Hkv, slots] int8 pool
+    use_kernel: bool | None = None,
+    interpret: bool = False,
 ):
     """Logits + updated pool for one chunk through the layer pipeline.
 
@@ -143,9 +151,16 @@ def pp_forward_chunk(
     updated ``kv_scale`` when the pool is int8-quantized (the chunk is
     quantized in-layer and attended dequantized, the same
     see-what-you-store invariant ``prefill_chunk_paged`` keeps).
+
+    Stage bodies dispatch chunk attention by backend exactly like the
+    single-chip path (``ops/attention.py::paged_chunk_attention``): the
+    Pallas chunk kernel on TPU (heads already local inside the
+    shard_map), the jnp hybrid elsewhere (VERDICT round-3 next-step #3).
     """
     pp = mesh.shape["pp"]
     tp = mesh.shape.get("tp", 1)
+    if use_kernel is None:
+        use_kernel = default_use_kernel(cfg.head_dim)
     L = cfg.n_layers
     if L % pp:
         raise ValueError(f"n_layers={L} not divisible by pp={pp}")
@@ -228,10 +243,12 @@ def pp_forward_chunk(
                     from radixmesh_tpu.ops.quant import quantize_for_store
 
                     k_int, v_int, k_sc, v_sc, k, v = quantize_for_store(k, v)
-                attn = attend_chunk_hybrid(
+                attn = paged_chunk_attention(
                     q, k, v, pages, pt, pos, prior, kvlen, l_idx,
                     kv_block_pages=kv_block_pages,
                     kv_scales=scale_pages,
+                    use_kernel=use_kernel,
+                    interpret=interpret,
                 )
                 o = jnp.einsum(
                     "bsqd,qdh->bsh",
@@ -337,7 +354,9 @@ def pp_forward_chunk(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "page_size", "k_steps", "mesh"),
+    static_argnames=(
+        "cfg", "page_size", "k_steps", "mesh", "use_kernel", "interpret"
+    ),
     donate_argnames=("kv_pool", "kv_scale"),
 )
 def pp_decode_multi(
@@ -356,6 +375,9 @@ def pp_decode_multi(
     k_steps: int = 8,
     mesh: Mesh,
     kv_scale: jnp.ndarray | None = None,  # [2, L, Hkv, slots] int8 pool
+    use_kernel: bool | None = None,
+    interpret: bool = False,
+    scratch_slot: jnp.ndarray | int | None = None,
 ):
     """``k_steps`` fused decode iterations through the layer PIPELINE:
     one host round trip per k tokens per batch, under pp×tp.
@@ -371,10 +393,19 @@ def pp_decode_multi(
     ``k·pp + pp - 1``; warm-up/drain ticks compute garbage whose KV
     writes are masked to re-write existing values.
 
-    The pool shard rides the tick scan (step s+1 reads step s's KV, so
-    the deferred-scatter trick of ``pp_forward_chunk`` cannot apply);
-    on-TPU this is the spot a fused stage kernel would optimize (the
-    single-chip path's ``paged_decode_fused`` rationale, SURVEY §7(c)).
+    The pool shard rides the tick scan in PAGES layout (step s+1 reads
+    step s's KV, so the deferred-scatter trick of ``pp_forward_chunk``
+    cannot apply). On TPU backends each stage's per-layer write+attend is
+    the aliased Pallas ``paged_decode_fused_kernel`` — the pool buffer
+    flows through the layer scan in place (``input_output_aliases``), so
+    no stage ever materializes a pool copy (VERDICT round-3 weak #3; the
+    single-chip ``paged_decode_attention`` rationale,
+    ``ops/attention.py:503-505``). Backend selection matches that path:
+    kernel on non-CPU with lane-aligned heads, jnp reference elsewhere
+    (or when ``use_kernel=False`` is forced). Warm-up/drain ticks can't
+    mask a kernel's in-place write, so their writes are REDIRECTED to
+    ``scratch_slot`` (the engine's reserved scratch page — required when
+    the kernel is engaged); the jnp path keeps the masked-where write.
 
     Returns ``(sampled [k, B], kv_pool)`` — the single-chip
     ``decode_multi`` contract, so the engine's bookkeeping is shared.
@@ -385,6 +416,18 @@ def pp_decode_multi(
     B = tokens.shape[0]
     if B % pp:
         raise ValueError(f"batch {B} must divide into n_micro=pp={pp}")
+    if use_kernel is None:
+        use_kernel = default_use_kernel(cfg.head_dim)
+    if use_kernel and scratch_slot is None:
+        raise ValueError(
+            "pp_decode_multi with the fused kernel engaged needs "
+            "scratch_slot (warm-up/drain writes are redirected, not masked)"
+        )
+    scratch_arr = (
+        jnp.asarray(scratch_slot, dtype=jnp.int32)
+        if scratch_slot is not None
+        else jnp.zeros((), jnp.int32)
+    )
     mb = B // pp
     n_micro = pp
     n_ticks = k_steps * pp + pp - 1
@@ -415,13 +458,13 @@ def pp_decode_multi(
         mesh=mesh,
         in_specs=(
             layer_specs, pp_pool_spec(), scale_in_spec, P(), P(), head_spec,
-            P(), P(), P(), P(), P(), P(), P(),
+            P(), P(), P(), P(), P(), P(), P(), P(),
         ),
         out_specs=(P(), pp_pool_spec(), scale_in_spec),
         check_vma=False,
     )
     def run(layers, pool, scale, embed, final_norm, head_local, toks_all,
-            pt_all, len_all, temp_all, topp_all, topk_all, key):
+            pt_all, len_all, temp_all, topp_all, topk_all, key, scratch):
         from radixmesh_tpu.ops.attention import attend_decode_ref
         from radixmesh_tpu.ops.sampling import sample_tokens
 
@@ -429,11 +472,19 @@ def pp_decode_multi(
         last = pp - 1
         l_loc = pool.shape[1]
         rows = jnp.arange(mb)
+        n_pages = num_slots // page_size
+        # The tick/layer scans carry the pool in PAGES layout (the fused
+        # kernel's native view; contiguous reshape = metadata only).
+        pool = pool.reshape(2, l_loc, hkv_loc, n_pages, page_size, D)
+        if quant:
+            scale = scale.reshape(2, l_loc, hkv_loc, n_pages, page_size)
 
         def stage(pool, scale, x, pt, kvlen, slot, valid):
             """This stage's layers over one microbatch's single token.
-            ``x`` [mb, H]; KV write at ``slot`` masked by ``valid``."""
+            ``x`` [mb, H]; KV lands at ``slot`` — masked (jnp) or
+            scratch-redirected (kernel) on invalid ticks."""
             pos = (kvlen - 1)[:, None]  # [mb, 1] absolute position
+            slot_eff = jnp.where(valid, slot, jnp.full_like(slot, scratch))
 
             def body(carry, xs):
                 pool, scale, h = carry
@@ -447,47 +498,67 @@ def pp_decode_multi(
                 q = apply_rope(q.reshape(mb, 1, hq_loc, D), pos, inv_freq)
                 k_ = apply_rope(k_.reshape(mb, 1, hkv_loc, D), pos, inv_freq)
                 v_ = v_.reshape(mb, 1, hkv_loc, D)
-                # Masked in-place write at this layer's slot column;
-                # invalid (warm-up/drain) ticks re-write old values. The
-                # mixed scalar+array index puts the advanced axes FIRST:
-                # target shape is [mb, 2, Hkv/tp, D].
-                if quant:
-                    from radixmesh_tpu.ops.quant import quantize_for_store
+                if use_kernel:
+                    # Aliased write+attend in one pallas_call: the pool
+                    # buffer flows through the layer scan in place.
+                    from radixmesh_tpu.ops.paged_attention import (
+                        paged_decode_fused_kernel,
+                    )
 
-                    k_int, v_int, k_sc, v_sc, _, _ = quantize_for_store(
-                        k_, v_
-                    )
-                    new_kv = jnp.stack(
-                        [k_int[:, 0], v_int[:, 0]], axis=1
-                    ).astype(pool.dtype)
-                    new_sc = jnp.stack([k_sc[:, 0], v_sc[:, 0]], axis=1)
-                    old_s = scale[:, l_idx, :, slot]
-                    scale = scale.at[:, l_idx, :, slot].set(
-                        jnp.where(valid, new_sc, old_s)
-                    )
+                    if quant:
+                        attn, pool, scale = paged_decode_fused_kernel(
+                            q[:, 0], k_[:, 0], v_[:, 0], pool, slot_eff,
+                            pt, kvlen, l_idx, interpret=interpret,
+                            kv_scales=scale,
+                        )
+                    else:
+                        attn, pool = paged_decode_fused_kernel(
+                            q[:, 0], k_[:, 0], v_[:, 0], pool, slot_eff,
+                            pt, kvlen, l_idx, interpret=interpret,
+                        )
                 else:
-                    new_kv = jnp.stack(
-                        [k_[:, 0], v_[:, 0]], axis=1
-                    ).astype(pool.dtype)
-                old = pool[:, l_idx, :, slot]
-                pool = pool.at[:, l_idx, :, slot].set(
-                    jnp.where(valid, new_kv, old)
-                )
-                pages = jax.lax.dynamic_index_in_dim(
-                    pool, l_idx, 1, keepdims=False
-                ).reshape(2, hkv_loc, num_slots // page_size, page_size, D)
-                if quant:
-                    sc_pages = jax.lax.dynamic_index_in_dim(
-                        scale, l_idx, 1, keepdims=False
-                    ).reshape(2, hkv_loc, num_slots // page_size, page_size)
-                    attn = attend_decode_ref(
-                        q[:, 0], pages[0], pages[1], pt, kvlen,
-                        k_scales=sc_pages[0], v_scales=sc_pages[1],
+                    pg, off = slot // page_size, slot % page_size
+                    # Masked in-place write at this layer's slot column;
+                    # invalid (warm-up/drain) ticks re-write old values.
+                    # The mixed scalar+array index puts the advanced axes
+                    # FIRST: target shape is [mb, 2, Hkv/tp, D].
+                    if quant:
+                        from radixmesh_tpu.ops.quant import quantize_for_store
+
+                        k_int, v_int, k_sc, v_sc, _, _ = quantize_for_store(
+                            k_, v_
+                        )
+                        new_kv = jnp.stack(
+                            [k_int[:, 0], v_int[:, 0]], axis=1
+                        ).astype(pool.dtype)
+                        new_sc = jnp.stack([k_sc[:, 0], v_sc[:, 0]], axis=1)
+                        old_s = scale[:, l_idx, :, pg, off]
+                        scale = scale.at[:, l_idx, :, pg, off].set(
+                            jnp.where(valid, new_sc, old_s)
+                        )
+                    else:
+                        new_kv = jnp.stack(
+                            [k_[:, 0], v_[:, 0]], axis=1
+                        ).astype(pool.dtype)
+                    old = pool[:, l_idx, :, pg, off]
+                    pool = pool.at[:, l_idx, :, pg, off].set(
+                        jnp.where(valid, new_kv, old)
                     )
-                else:
-                    attn = attend_decode_ref(
-                        q[:, 0], pages[0], pages[1], pt, kvlen
+                    pages = jax.lax.dynamic_index_in_dim(
+                        pool, l_idx, 1, keepdims=False
                     )
+                    if quant:
+                        sc_pages = jax.lax.dynamic_index_in_dim(
+                            scale, l_idx, 1, keepdims=False
+                        )
+                        attn = attend_decode_ref(
+                            q[:, 0], pages[0], pages[1], pt, kvlen,
+                            k_scales=sc_pages[0], v_scales=sc_pages[1],
+                        )
+                    else:
+                        attn = attend_decode_ref(
+                            q[:, 0], pages[0], pages[1], pt, kvlen
+                        )
                 o = jnp.einsum(
                     "bqd,qdh->bh",
                     attn.reshape(mb, hq_loc, D),
@@ -580,12 +651,15 @@ def pp_decode_multi(
         # stages hold zeros). tp already uniform: the gathered logits and
         # the folded key are identical on every tp peer.
         outs = jax.lax.psum(jnp.where(idx == last, outs, 0), "pp")
+        pool = pool.reshape(2, l_loc, hkv_loc, num_slots, D)
+        if quant:
+            scale = scale.reshape(2, l_loc, hkv_loc, num_slots)
         return outs, pool, scale
 
     outs, kv_pool, kv_scale_out = run(
         params["layers"], kv_pool, scale_arg, params["embed"],
         params["final_norm"], head, toks_all, pt_all, len_all, temp_all,
-        topp_all, topk_all, key,
+        topp_all, topk_all, key, scratch_arr,
     )
     # [n_micro, mb, k] → the decode_multi contract [k, B] (row-major
     # microbatch grouping mirrors every other reshape in this module).

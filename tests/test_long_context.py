@@ -106,3 +106,47 @@ def test_32k_prompt_prefills():
     )[0]
     assert len(out2) == 2
     assert engine.stats.cached_tokens >= S - 16  # page-aligned reuse
+
+
+def test_chunked_kernel_engaged_matches_dense_prefill():
+    """The same chunk-by-chunk walk with the Pallas chunk kernel forced
+    (interpret mode executes the kernel program on CPU): logits must
+    match the dense path exactly like the jnp hybrid does (VERDICT
+    round-3 next-step #3 — prefill-side kernelization)."""
+    rng = np.random.default_rng(2)
+    S, C, page = 40, 16, 4
+    prompt = rng.integers(1, CFG.vocab_size, size=S).astype(np.int32)
+
+    tok = jnp.asarray(prompt)[None]
+    pos = jnp.arange(S, dtype=jnp.int32)[None]
+    empty = jnp.zeros((CFG.n_layers, 1, 0, CFG.n_kv_heads, CFG.head_dim), CFG.dtype)
+    want, _, _ = prefill_forward(
+        PARAMS, CFG, tok, pos, empty, empty, jnp.zeros((1,), jnp.int32)
+    )
+
+    num_slots = 256
+    pool = jnp.zeros(
+        (2, CFG.n_layers, CFG.n_kv_heads, num_slots, CFG.head_dim), CFG.dtype
+    )
+    maxp = 16
+    pt = jnp.asarray((np.arange(maxp) + 3).astype(np.int32))[None]
+    slots_all = (np.asarray(pt[0])[:, None] * page + np.arange(page)).reshape(-1)
+    outs = []
+    for start in range(0, S, C):
+        n = min(C, S - start)
+        toks = np.zeros((1, C), np.int32)
+        toks[0, :n] = prompt[start : start + n]
+        poss = (start + np.arange(C, dtype=np.int32))[None]
+        sl = np.zeros((1, C), np.int32)
+        sl[0, :n] = slots_all[start : start + n]
+        logits, pool = prefill_chunk_paged(
+            PARAMS, CFG, jnp.asarray(toks), jnp.asarray(poss), pool,
+            jnp.asarray(sl), pt, jnp.asarray([start + n], jnp.int32),
+            page_size=page, kv_block_pages=4,
+            use_kernel=True, interpret=True,
+        )
+        outs.append(np.asarray(logits[0, :n], np.float32))
+    got = np.concatenate(outs)
+    np.testing.assert_allclose(
+        got, np.asarray(want[0], np.float32), rtol=2e-2, atol=2e-2
+    )

@@ -348,3 +348,130 @@ class TestChunkHybrid:
             np.asarray(got)[valid_mask], np.asarray(want)[valid_mask],
             rtol=2e-5, atol=2e-5,
         )
+
+
+class TestPagedChunkKernel:
+    """``paged_chunk_attention_kernel`` (Pallas, interpret mode) vs the
+    jnp ``attend_chunk_hybrid`` oracle: SURVEY §7 hard part (a) for the
+    prefill side. Canonical query positions (prior + arange(C)) are the
+    kernel's contract — the only form any serving path produces."""
+
+    def _setup(self, seed, B=3, C=8, Hq=4, Hkv=2, D=32, page=4, maxp=8, L=2):
+        rng = np.random.default_rng(seed)
+        P = B * maxp + 2
+        q = jnp.asarray(rng.normal(size=(B, C, Hq, D)), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(B, C, Hkv, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(B, C, Hkv, D)), jnp.float32)
+        kv = jnp.asarray(rng.normal(size=(2, L, Hkv, P, page, D)), jnp.float32)
+        pt = jnp.asarray(
+            rng.permutation(P)[: B * maxp].reshape(B, maxp).astype(np.int32)
+        )
+        return q, kc, vc, kv, pt
+
+    @pytest.mark.parametrize("layer", [0, 1])
+    def test_matches_hybrid(self, layer):
+        from radixmesh_tpu.ops.attention import attend_chunk_hybrid
+        from radixmesh_tpu.ops.paged_attention import (
+            paged_chunk_attention_kernel,
+        )
+
+        q, kc, vc, kv, pt = self._setup(layer)
+        C = q.shape[1]
+        # Row 0: no prior (cold prefill); row 1: mid-page prior; row 2:
+        # long prior + PARTIAL chunk (3 valid of 8).
+        prior = jnp.asarray([0, 5, 17], jnp.int32)
+        kvlen = prior + jnp.asarray([C, C, 3], jnp.int32)
+        pos = prior[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+        want = attend_chunk_hybrid(
+            q, kc, vc, kv, pt, pos, prior, kvlen, layer, kv_block_pages=4
+        )
+        got = paged_chunk_attention_kernel(
+            q, kc, vc, kv, pt, prior, kvlen, layer, interpret=True
+        )
+        valid = np.arange(C)[None, :] < np.asarray(kvlen - prior)[:, None]
+        np.testing.assert_allclose(
+            np.asarray(got)[valid], np.asarray(want)[valid],
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_query_blocking_invariant(self):
+        """Splitting the chunk into query blocks must not change results
+        (each block re-streams the prior pages independently)."""
+        from radixmesh_tpu.ops.paged_attention import (
+            paged_chunk_attention_kernel,
+        )
+
+        q, kc, vc, kv, pt = self._setup(7, C=16)
+        prior = jnp.asarray([9, 0, 33], jnp.int32)
+        kvlen = prior + 16
+        base = paged_chunk_attention_kernel(
+            q, kc, vc, kv, pt, prior, kvlen, 0, interpret=True, q_block=16
+        )
+        for qb in (1, 4, 8):
+            blocked = paged_chunk_attention_kernel(
+                q, kc, vc, kv, pt, prior, kvlen, 0, interpret=True, q_block=qb
+            )
+            np.testing.assert_allclose(
+                np.asarray(blocked), np.asarray(base), rtol=2e-5, atol=2e-5
+            )
+
+    def test_int8_pool_matches_hybrid(self):
+        from radixmesh_tpu.ops.attention import attend_chunk_hybrid
+        from radixmesh_tpu.ops.paged_attention import (
+            paged_chunk_attention_kernel,
+        )
+
+        rng = np.random.default_rng(3)
+        B, C, Hq, Hkv, D, page, maxp, L = 2, 16, 8, 2, 32, 4, 16, 1
+        P = B * maxp
+        q = jnp.asarray(rng.normal(size=(B, C, Hq, D)), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(B, C, Hkv, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(B, C, Hkv, D)), jnp.float32)
+        kv8 = jnp.asarray(
+            rng.integers(-127, 128, (2, L, Hkv, P, page, D)), jnp.int8
+        )
+        sc = jnp.asarray(
+            np.abs(rng.normal(size=(2, L, Hkv, P, page))) * 0.02, jnp.float32
+        )
+        pt = jnp.asarray(rng.permutation(P).reshape(B, maxp).astype(np.int32))
+        prior = jnp.asarray([33, 7], jnp.int32)
+        kvlen = prior + C
+        pos = prior[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+        want = attend_chunk_hybrid(
+            q, kc, vc, kv8, pt, pos, prior, kvlen, 0, kv_block_pages=4,
+            kv_scales=sc,
+        )
+        got = paged_chunk_attention_kernel(
+            q, kc, vc, kv8, pt, prior, kvlen, 0, q_block=4, interpret=True,
+            kv_scales=sc,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_page_table_permutation_invariant(self):
+        """Page indirection is honored: permuting a row's pages together
+        with its table entries must not change the output."""
+        from radixmesh_tpu.ops.paged_attention import (
+            paged_chunk_attention_kernel,
+        )
+
+        q, kc, vc, kv, pt = self._setup(11)
+        prior = jnp.asarray([8, 20, 12], jnp.int32)
+        kvlen = prior + q.shape[1]
+        base = paged_chunk_attention_kernel(
+            q, kc, vc, kv, pt, prior, kvlen, 0, interpret=True
+        )
+        # Swap two of row 1's prior pages in the table AND in the pool.
+        pt2 = np.asarray(pt).copy()
+        pt2[1, 0], pt2[1, 1] = pt2[1, 1], pt2[1, 0]
+        kv2 = np.asarray(kv).copy()
+        a, b = int(pt[1, 0]), int(pt[1, 1])
+        kv2[:, :, :, [a, b]] = kv2[:, :, :, [b, a]]
+        perm = paged_chunk_attention_kernel(
+            q, kc, vc, jnp.asarray(kv2), jnp.asarray(pt2), prior, kvlen, 0,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(perm), np.asarray(base), rtol=2e-5, atol=2e-5
+        )
