@@ -119,10 +119,35 @@ class MeshCache:
         self.resolver = resolver
         self.log = get_logger(f"mesh.{self.role.value}@{self.rank}")
 
-        # The mesh replicates at token granularity like the reference
-        # (radix_mesh.py:87-89 pins page_size=1); engine-level trees may use
-        # larger pages locally.
-        self.tree = RadixTree(page_size=1)
+        # Replication granularity (cfg.page_size). The reference pins
+        # token granularity (radix_mesh.py:87-89, page_size=1) and that
+        # stays the compatibility default; with page_size = N > 1 the
+        # mesh tree aligns node boundaries to N-token pages and INSERT
+        # oplogs ship ONE page id per N tokens (the engine's paged
+        # allocator guarantees within-page slot contiguity), cutting
+        # wire value bytes and apply-side index work by N (VERDICT
+        # round-3 next-step #4).
+        self.page = cfg.page_size
+        if self.page > 1:
+            # Refuse page granularity ATOMICALLY at construction: if it
+            # only surfaced inside insert()'s serialize() (after
+            # _mesh_insert already applied), the origin's tree would
+            # silently diverge from the ring on every publish.
+            from radixmesh_tpu.cache.oplog import emit_version
+
+            if emit_version() < 3:
+                raise ValueError(
+                    f"page_size={self.page} needs wire v3 oplogs; the "
+                    f"emit version is pinned to {emit_version()} "
+                    "(rolling upgrade?) — finish the roll or use "
+                    "page_size=1"
+                )
+            if self.page > 255:
+                raise ValueError(
+                    f"page_size={self.page} exceeds the wire's u8 "
+                    "page field (max 255)"
+                )
+        self.tree = RadixTree(page_size=self.page)
         self._lock = threading.RLock()
         self._logic_op = AtomicCounter()
         self.dup_nodes: dict[NodeKey, PrefillValue | RouterValue] = {}
@@ -355,9 +380,35 @@ class MeshCache:
         if self.role is NodeRole.ROUTER:
             raise RuntimeError("router nodes hold no KV; insert is P/D-only")
         key = as_key(key)
-        value = PrefillValue(slot_indices, self.rank)
-        if len(value) != len(key):
+        slot_indices = np.asarray(slot_indices, dtype=np.int32)
+        if len(slot_indices) != len(key):
             raise ValueError("slot_indices length must equal key length")
+        wire_value = slot_indices
+        if self.page > 1:
+            # Page-granular replication: publish only whole pages (the
+            # engine already page-floors published prefixes) and ship one
+            # page id per page. Requires within-page slot contiguity —
+            # the paged allocator's invariant; checked here so a
+            # misaligned caller fails at the source, not as silent
+            # corruption on every replica.
+            n = len(key) - len(key) % self.page
+            if n == 0:
+                return 0
+            key = key[:n]
+            slot_indices = slot_indices[:n]
+            by_page = slot_indices.reshape(-1, self.page)
+            page_ids = by_page[:, 0] // self.page
+            expected = (
+                page_ids[:, None] * self.page
+                + np.arange(self.page, dtype=np.int32)[None, :]
+            )
+            if not np.array_equal(by_page, expected):
+                raise ValueError(
+                    "slot_indices are not page-contiguous at mesh "
+                    f"page_size={self.page}"
+                )
+            wire_value = page_ids.astype(np.int32)
+        value = PrefillValue(slot_indices, self.rank)
         with self._lock:
             prefix_len = self._mesh_insert(key, value)
             # Enqueued under the lock: wire order == application order.
@@ -368,8 +419,9 @@ class MeshCache:
                     logic_id=self._logic_op.next(),
                     ttl=self._data_ttl(),
                     key=key,
-                    value=np.asarray(slot_indices, dtype=np.int32),
+                    value=wire_value,
                     value_rank=self.rank,
+                    page=self.page,
                 )
             )
         return prefix_len
@@ -512,7 +564,15 @@ class MeshCache:
                 if self.role is NodeRole.ROUTER:
                     value = RouterValue(op.value_rank, len(op.key))
                 else:
-                    value = PrefillValue(op.value, op.value_rank)
+                    indices = op.value
+                    if op.page > 1:
+                        # Expand page ids back to per-token slots (the
+                        # origin's allocator guarantees contiguity).
+                        indices = (
+                            indices[:, None].astype(np.int32) * op.page
+                            + np.arange(op.page, dtype=np.int32)[None, :]
+                        ).reshape(-1)
+                    value = PrefillValue(indices, op.value_rank)
                 self._mesh_insert(op.key, value)
             elif op.op_type is OplogType.DELETE:
                 self._apply_delete(op.key)

@@ -41,27 +41,42 @@ __all__ = [
 ]
 
 _MAGIC = 0x52  # 'R'
-_VERSION = 2  # v2 added ts (origin wall-clock, for replication-lag metrics)
-_HEADER = struct.Struct(
+_VERSION = 3  # v3 added page (page-granular INSERT values)
+# v3 header: the v2 header plus a trailing page byte (+pad). Earlier
+# headers are strict prefixes, so the TTL patch offset is shared.
+_HEADER_V3 = struct.Struct(
+    "<BBBxiqiidBxxx"
+)  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts, page
+_HEADER_V2 = struct.Struct(
     "<BBBxiqiid"
 )  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts
 # v1 header (no ts). Rolling-restart compatibility is two-sided:
-# - RECEIVE: v1 frames are always accepted (ts = 0.0 → lag not recorded).
-# - EMIT: v1 peers reject v2 frames, so while any v1 node remains in the
-#   ring, upgraded nodes must emit v1 — set RADIXMESH_WIRE_VERSION=1 (or
-#   set_emit_version(1)) for the duration of the roll, then flip to 2.
+# - RECEIVE: older frames are always accepted (ts = 0.0 / page = 1 where
+#   the frame predates the field).
+# - EMIT: older peers reject newer frames, so while any old node remains
+#   in the ring, upgraded nodes must emit the old version — set
+#   RADIXMESH_WIRE_VERSION (or set_emit_version) for the duration of the
+#   roll, then flip to the current version. Page-granular replication
+#   (page > 1) requires v3 and raises under an older emit version.
 _HEADER_V1 = struct.Struct("<BBBxiqii")
 
 _emit_version = int(os.environ.get("RADIXMESH_WIRE_VERSION", _VERSION))
 
 
 def set_emit_version(version: int) -> None:
-    """Select the wire version ``serialize`` emits (1 during a rolling
-    upgrade from v1 nodes, 2 — the default — otherwise)."""
+    """Select the wire version ``serialize`` emits (an older version
+    during a rolling upgrade, the current one — the default —
+    otherwise)."""
     global _emit_version
-    if version not in (1, _VERSION):
+    if version not in (1, 2, _VERSION):
         raise ValueError(f"unsupported wire version {version}")
     _emit_version = version
+
+
+def emit_version() -> int:
+    """The wire version ``serialize`` currently emits (page-granular
+    callers check compatibility up front — see ``MeshCache``)."""
+    return _emit_version
 
 
 class OplogType(enum.IntEnum):
@@ -115,6 +130,11 @@ class Oplog:
     # replication-lag histogram, so clock skew degrades telemetry, never
     # correctness. 0.0 = unset.
     ts: float = 0.0
+    # INSERT value granularity: 1 = one slot index per token (the
+    # reference's convention, radix_mesh.py:87-89); N > 1 = one PAGE id
+    # per N tokens (receivers expand to slots ``page_id*N + 0..N-1`` —
+    # the paged allocator guarantees within-page contiguity).
+    page: int = 1
 
     def __eq__(self, other) -> bool:
         return (
@@ -124,6 +144,7 @@ class Oplog:
             and self.logic_id == other.logic_id
             and self.ttl == other.ttl
             and self.value_rank == other.value_rank
+            and self.page == other.page
             and np.array_equal(self.key, other.key)
             and np.array_equal(self.value, other.value)
             and self.gc == other.gc
@@ -166,15 +187,28 @@ def serialize(op: Oplog) -> bytes:
     """Oplog → bytes. Every field — including GC payloads — round-trips
     (fixing the reference's ``to_dict`` omission, ``cache_oplog.py:58-66``)."""
     key, value = _arr(op.key), _arr(op.value)
+    if op.page > 1 and _emit_version < 3:
+        raise ValueError(
+            f"page-granular oplogs (page={op.page}) need wire v3; "
+            f"emit version is {_emit_version}"
+        )
+    if not 1 <= op.page <= 255:
+        raise ValueError(f"oplog page {op.page} out of the wire's u8 range")
     if _emit_version == 1:
         header = _HEADER_V1.pack(
             _MAGIC, 1, int(op.op_type),
             op.origin_rank, op.logic_id, op.ttl, op.value_rank,
         )
+    elif _emit_version == 2:
+        header = _HEADER_V2.pack(
+            _MAGIC, 2, int(op.op_type),
+            op.origin_rank, op.logic_id, op.ttl, op.value_rank, op.ts,
+        )
     else:
-        header = _HEADER.pack(
+        header = _HEADER_V3.pack(
             _MAGIC, _VERSION, int(op.op_type),
             op.origin_rank, op.logic_id, op.ttl, op.value_rank, op.ts,
+            op.page,
         )
     parts = [
         header,
@@ -199,11 +233,12 @@ _TTL_OFFSET = struct.calcsize("<BBBxiq")  # magic, ver, type, origin, logic
 def patched_ttl(data: bytes, ttl: int) -> bytes:
     """The same wire frame with only its TTL replaced.
 
-    Guards the header version: a future v3 that rearranges fields must
-    fail loudly here rather than silently corrupt forwarded frames."""
-    if data[1] not in (1, 2):
+    Guards the header version: a future version that rearranges fields
+    must fail loudly here rather than silently corrupt forwarded
+    frames. (v1 ⊂ v2 ⊂ v3 headers share the TTL offset.)"""
+    if data[1] not in (1, 2, 3):
         raise ValueError(
-            f"patched_ttl knows wire versions 1-2, got v{data[1]}"
+            f"patched_ttl knows wire versions 1-3, got v{data[1]}"
         )
     buf = bytearray(data)
     struct.pack_into("<i", buf, _TTL_OFFSET, ttl)
@@ -215,9 +250,16 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
     magic, ver = buf[0], buf[1]
     if magic != _MAGIC:
         raise ValueError(f"bad oplog magic {magic:#x}")
+    page = 1
     if ver == _VERSION:
-        _, _, op_type, origin, logic, ttl, value_rank, ts = _HEADER.unpack_from(buf, 0)
-        off = _HEADER.size
+        (_, _, op_type, origin, logic, ttl, value_rank, ts,
+         page) = _HEADER_V3.unpack_from(buf, 0)
+        off = _HEADER_V3.size
+    elif ver == 2:
+        _, _, op_type, origin, logic, ttl, value_rank, ts = (
+            _HEADER_V2.unpack_from(buf, 0)
+        )
+        off = _HEADER_V2.size
     elif ver == 1:
         _, _, op_type, origin, logic, ttl, value_rank = _HEADER_V1.unpack_from(buf, 0)
         ts = 0.0
@@ -247,4 +289,5 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
         value_rank=value_rank,
         gc=gc,
         ts=ts,
+        page=page,
     )

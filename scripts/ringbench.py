@@ -43,8 +43,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-KEY_LEN = 16  # tokens per inserted key (a short ShareGPT-turn tail)
-VALUE_LEN = 16  # KV slot indices per key
+KEY_LEN = 16  # default tokens per key (a short ShareGPT-turn tail)
 
 
 def _free_ports(n: int) -> list[int]:
@@ -59,11 +58,11 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
-def _rank_keys(rank: int, n: int, vocab: int = 50000) -> np.ndarray:
+def _rank_keys(rank: int, n: int, key_len: int, vocab: int = 50000) -> np.ndarray:
     """The n keys node ``rank`` inserts — deterministic, so every node can
     enumerate the full expected key set and detect its own convergence."""
     rng = np.random.default_rng(1000 + rank)
-    return rng.integers(1, vocab, size=(n, KEY_LEN)).astype(np.int64)
+    return rng.integers(1, vocab, size=(n, key_len)).astype(np.int64)
 
 
 def _percentiles(samples: list[float]) -> dict:
@@ -77,7 +76,7 @@ def _percentiles(samples: list[float]) -> dict:
 
 
 def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
-            n_routes, barrier, resq, errq):
+            n_routes, key_len, page, barrier, resq, errq):
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         # The deployment's sitecustomize re-pins a TPU tunnel platform at
@@ -98,6 +97,7 @@ def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
             protocol="tcp",  # the native C++ transport
             tick_interval_s=1.0,
             gc_interval_s=600.0,  # GC off the wire during the timed run
+            page_size=page,
             # 6 CPU-contended processes flat-out: a starved transport
             # thread must not read as a dead peer mid-benchmark.
             failure_timeout_s=120.0,
@@ -112,11 +112,13 @@ def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
         # --- phase A: replication throughput --------------------------
         t0 = time.monotonic()
         if node.role is not NodeRole.ROUTER:
-            keys = _rank_keys(node.rank, n_inserts)
+            keys = _rank_keys(node.rank, n_inserts, key_len)
             for i, key in enumerate(keys):
+                # Contiguous page-aligned runs (key_len is a page
+                # multiple), the paged allocator's shape.
                 node.insert(
                     key.tolist(),
-                    np.arange(i * VALUE_LEN, (i + 1) * VALUE_LEN,
+                    np.arange(i * key_len, (i + 1) * key_len,
                               dtype=np.int32),
                 )
             out["ingest_s"] = time.monotonic() - t0
@@ -126,12 +128,12 @@ def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
             # verify the full set once (no hot polling loop starving the
             # transport threads of the GIL).
             expected = [
-                _rank_keys(r, n_inserts) for r in range(n_writers)
+                _rank_keys(r, n_inserts, key_len) for r in range(n_writers)
             ]
             deadline = time.monotonic() + 300
             for rank_keys in expected:
                 last = rank_keys[-1].tolist()
-                while node.match_prefix(last).length < KEY_LEN:
+                while node.match_prefix(last).length < key_len:
                     if time.monotonic() > deadline:
                         raise TimeoutError(
                             f"rank {node.rank} never converged"
@@ -141,9 +143,9 @@ def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
             for rank_keys in expected:
                 for key in rank_keys:
                     got = node.match_prefix(key.tolist()).length
-                    assert got == KEY_LEN, (
+                    assert got == key_len, (
                         f"rank {node.rank}: converged marker present but "
-                        f"a key is missing ({got}/{KEY_LEN} tokens)"
+                        f"a key is missing ({got}/{key_len} tokens)"
                     )
         barrier.wait(timeout=600)
 
@@ -161,10 +163,11 @@ def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
             )
             rng = np.random.default_rng(9)
             for i in range(n_laps):
-                key = rng.integers(1, 50000, size=KEY_LEN).tolist()
+                key = rng.integers(1, 50000, size=key_len).tolist()
                 t = time.monotonic()
                 node.insert(
-                    key, np.arange(VALUE_LEN, dtype=np.int32) + i
+                    key,
+                    np.arange(key_len, dtype=np.int32) + i * key_len,
                 )
                 want = tuple(key)
                 deadline = time.monotonic() + 30
@@ -183,7 +186,7 @@ def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
         if node.role is NodeRole.ROUTER:
             r = CacheAwareRouter(node, cfg)
             r.finish_warm_up()
-            known = _rank_keys(0, n_inserts)
+            known = _rank_keys(0, n_inserts, key_len)
             rng = np.random.default_rng(5)
             # Half hits (known keys + a fresh suffix, the serving shape),
             # half misses (novel keys -> consistent-hash fallback path).
@@ -198,7 +201,7 @@ def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
                     )
                 else:
                     probes.append(
-                        rng.integers(1, 50000, size=KEY_LEN + 8).tolist()
+                        rng.integers(1, 50000, size=key_len + 8).tolist()
                     )
             lat: list[float] = []
             t0 = time.monotonic()
@@ -219,7 +222,28 @@ def _worker(local_addr, prefill, decode, router, n_inserts, n_laps,
         sys.exit(1)
 
 
-def run(n_inserts: int, n_laps: int, n_routes: int) -> dict:
+def _wire_bytes_per_insert(key_len: int, page: int) -> int:
+    """Serialized INSERT frame size at this granularity (what each ring
+    hop actually ships)."""
+    from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
+
+    key = np.arange(key_len, dtype=np.int32)
+    value = (
+        np.arange(key_len // page, dtype=np.int32)
+        if page > 1
+        else np.arange(key_len, dtype=np.int32)
+    )
+    return len(serialize(Oplog(
+        op_type=OplogType.INSERT, origin_rank=0, logic_id=1, ttl=5,
+        key=key, value=value, value_rank=0, page=page,
+    )))
+
+
+def run(n_inserts: int, n_laps: int, n_routes: int, key_len: int = KEY_LEN,
+        page: int = 1) -> dict:
+    if key_len % max(page, 1):
+        raise SystemExit(f"--key-len {key_len} must be a multiple of "
+                         f"--page-size {page}")
     ports = _free_ports(6)
     prefill = [f"127.0.0.1:{p}" for p in ports[:3]]
     decode = [f"127.0.0.1:{p}" for p in ports[3:5]]
@@ -232,7 +256,7 @@ def run(n_inserts: int, n_laps: int, n_routes: int) -> dict:
         ctx.Process(
             target=_worker,
             args=(addr, prefill, decode, router, n_inserts, n_laps,
-                  n_routes, barrier, resq, errq),
+                  n_routes, key_len, page, barrier, resq, errq),
         )
         for addr in prefill + decode + router
     ]
@@ -273,7 +297,9 @@ def run(n_inserts: int, n_laps: int, n_routes: int) -> dict:
         "transport": "native-cpp-tcp",
         "topology": "3 prefill + 2 decode + 1 router (localhost)",
         "inserts_per_writer": n_inserts,
-        "key_len_tokens": KEY_LEN,
+        "key_len_tokens": key_len,
+        "page_size": page,
+        "wire_bytes_per_insert": _wire_bytes_per_insert(key_len, page),
         "ingest_s_max": round(max(r["ingest_s"] for r in writers), 3),
         "converge_s_max": round(converge, 3),
         # Each insert is applied on every other ring node + the router.
@@ -294,9 +320,15 @@ def main() -> int:
                     help="lap-latency samples")
     ap.add_argument("--routes", type=int, default=5000,
                     help="router route() calls")
+    ap.add_argument("--key-len", type=int, default=KEY_LEN,
+                    help="tokens per inserted key")
+    ap.add_argument("--page-size", type=int, default=1,
+                    help="mesh replication granularity (1 = reference-"
+                         "compatible token granularity)")
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args()
-    report = run(args.inserts, args.laps, args.routes)
+    report = run(args.inserts, args.laps, args.routes, args.key_len,
+                 args.page_size)
     line = json.dumps(report)
     print(line, flush=True)
     if args.out:

@@ -64,7 +64,39 @@ def random_ops(rng: np.random.Generator, n_ops: int, n_writers: int):
     return ops
 
 
-def make_unwired_node(rank: int = 0, pool: PagedKVPool | None = None) -> MeshCache:
+def random_paged_ops(rng: np.random.Generator, n_ops: int, n_writers: int,
+                     page: int):
+    """``random_ops`` at page granularity: keys are page-multiples built
+    from unit chains (each unit expands to ``page`` tokens) and indices
+    are page-aligned contiguous runs — the engine's paged-allocator
+    invariant that page-granular replication requires."""
+    chains = [
+        rng.integers(0, 8, size=rng.integers(2, 6)).astype(np.int32)
+        for _ in range(3)
+    ]
+    ops = []
+    for _ in range(n_ops):
+        chain = chains[rng.integers(0, len(chains))]
+        cut = int(rng.integers(1, len(chain) + 1))
+        units = chain[:cut]
+        if rng.random() < 0.3:
+            units = np.concatenate(
+                [units, rng.integers(8, 16, size=rng.integers(1, 3)).astype(np.int32)]
+            )
+        key = np.repeat(units, page).astype(np.int32)
+        # Unit u's page token i gets token id units[u] — page-multiples by
+        # construction. Indices: deterministic page-aligned run per
+        # (key, rank), as a node re-advertising the same prefix.
+        rank = int(rng.integers(0, n_writers))
+        base = (rank * 10_000 + int(units[0]) * 100) // page * page
+        indices = (base + np.arange(len(key))).astype(np.int32)
+        ops.append((key, rank, indices))
+    return ops
+
+
+def make_unwired_node(
+    rank: int = 0, pool: PagedKVPool | None = None, page: int = 1
+) -> MeshCache:
     """A MeshCache with transports never opened: ``_mesh_insert`` and the
     conflict/dup machinery are fully functional without ``start()``."""
     prefill = [f"p{i}" for i in range(3)]
@@ -74,6 +106,7 @@ def make_unwired_node(rank: int = 0, pool: PagedKVPool | None = None) -> MeshCac
         router_nodes=[],
         local_addr=prefill[rank],
         protocol="inproc",
+        page_size=page,
     )
     return MeshCache(cfg, pool=pool)
 
@@ -261,7 +294,7 @@ class TestDupSlotSafety:
                     )
 
 
-def make_storm_cluster(n_prefill=3, n_decode=2, num_slots=512):
+def make_storm_cluster(n_prefill=3, n_decode=2, num_slots=512, page=1):
     """Start a full in-proc cluster (P/D ring + router), wait for the
     startup barrier, and return ``(all_nodes, ring_nodes, router)``."""
     prefill = [f"p{i}" for i in range(n_prefill)]
@@ -276,12 +309,14 @@ def make_storm_cluster(n_prefill=3, n_decode=2, num_slots=512):
             protocol="inproc",
             tick_interval_s=0.05,
             gc_interval_s=30.0,
+            page_size=page,
         )
         pool = (
             None
             if cfg.local_role is NodeRole.ROUTER
             else PagedKVPool(
-                num_slots=num_slots, num_layers=1, num_kv_heads=1, head_dim=2
+                num_slots=num_slots, num_layers=1, num_kv_heads=1, head_dim=2,
+                page_size=page,
             )
         )
         nodes.append(MeshCache(cfg, pool=pool))
@@ -415,6 +450,160 @@ class TestDeleteResetStorm:
                         and len(v)
                     ):
                         assert n.pool.allocator.is_allocated(v.indices).all()
+        finally:
+            for n in nodes:
+                n.close()
+
+
+class TestPageGranular:
+    """Page-granular replication (VERDICT round-3 next-step #4): the mesh
+    tree at page_size=16, INSERT oplogs shipping one page id per 16
+    tokens, expanded back to slots on receive. The convergence properties
+    must be exactly the token-granularity ones."""
+
+    PAGE = 16
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_any_delivery_order_converges(self, seed):
+        rng = np.random.default_rng(seed)
+        ops = random_paged_ops(rng, n_ops=40, n_writers=3, page=self.PAGE)
+        probe_keys = [key for key, _, _ in ops]
+
+        reference_snap = None
+        for perm_i in range(6):
+            order = rng.permutation(len(ops))
+            node = make_unwired_node(page=self.PAGE)
+            with node._lock:
+                for j in order:
+                    key, rank, indices = ops[j]
+                    node._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+            snap = snapshot(node, probe_keys)
+            if reference_snap is None:
+                reference_snap = snap
+            else:
+                assert snap == reference_snap, (
+                    f"seed={seed}: delivery order {perm_i} diverged at "
+                    f"page={self.PAGE}"
+                )
+
+    @pytest.mark.parametrize("seed", [7, 8])
+    def test_redelivery_is_idempotent(self, seed):
+        rng = np.random.default_rng(seed)
+        ops = random_paged_ops(rng, n_ops=30, n_writers=3, page=self.PAGE)
+        probe_keys = [key for key, _, _ in ops]
+        node = make_unwired_node(page=self.PAGE)
+        with node._lock:
+            for key, rank, indices in ops:
+                node._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+        once = snapshot(node, probe_keys)
+        with node._lock:
+            for j in rng.permutation(len(ops)):
+                key, rank, indices = ops[j]
+                node._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+        assert snapshot(node, probe_keys) == once
+
+    def test_insert_validates_contiguity_and_floors(self):
+        """Origin-side guards: non-page-contiguous slots fail loudly; a
+        sub-page tail is floored off the publish."""
+        page = 4
+        pool = PagedKVPool(
+            num_slots=64, num_layers=1, num_kv_heads=1, head_dim=2,
+            page_size=page,
+        )
+        node = make_unwired_node(pool=pool, page=page)
+        scattered = np.asarray([0, 1, 2, 5], np.int32)  # breaks page 0
+        with pytest.raises(ValueError, match="page-contiguous"):
+            node.insert(np.arange(4, dtype=np.int32), scattered)
+        # 6 tokens at page 4 → only the first page publishes.
+        slots = pool.alloc(6)
+        got = node.insert(np.asarray([1, 1, 1, 1, 2, 2], np.int32), slots)
+        assert got == 0  # nothing previously cached
+        assert node.match_prefix([1, 1, 1, 1, 2, 2]).length == page
+
+    @pytest.mark.parametrize("seed", [31, 47])
+    def test_storm_converges_over_the_wire(self, seed):
+        """Live in-proc cluster at page=16: oplogs serialize page ids
+        (wire v3) and every replica expands them back to the SAME slot
+        runs the writer advertised — convergence including indices, not
+        just lengths/ranks."""
+        rng = np.random.default_rng(seed)
+        nodes, ring, router = make_storm_cluster(
+            num_slots=2048, page=self.PAGE
+        )
+        try:
+            ops = []
+            chains = [
+                rng.integers(0, 6, size=rng.integers(1, 4)).astype(np.int32)
+                for _ in range(3)
+            ]
+            chain_slots: dict[tuple, np.ndarray] = {}
+            for _ in range(20):
+                ci = int(rng.integers(0, len(chains)))
+                cut = int(rng.integers(1, len(chains[ci]) + 1))
+                rank = int(rng.integers(0, len(ring)))
+                key = np.repeat(chains[ci][:cut], self.PAGE).astype(np.int32)
+                ck = (rank, ci, cut)
+                if ck not in chain_slots:
+                    slots = ring[rank].pool.alloc(len(key))
+                    assert slots is not None
+                    chain_slots[ck] = slots
+                ring[rank].insert(key, chain_slots[ck])
+                ops.append((key, rank, chain_slots[ck]))
+
+            probe_keys = [key for key, _, _ in ops]
+
+            def converged():
+                snaps = [snapshot(n, probe_keys) for n in ring]
+                return all(s == snaps[0] for s in snaps[1:])
+
+            assert wait_for(converged), f"seed={seed}: replicas diverged"
+            # Router sees lengths (RouterValues carry no indices).
+            for key, _, _ in ops:
+                assert router.match_prefix(key).match_len == len(key)
+            # Every replica's matched indices expand to real slot runs of
+            # the winning writer — page expansion reproduced the origin's
+            # advertisement bit-for-bit.
+            res = ring[1].tree.match_prefix(probe_keys[0], split_partial=False)
+            assert res.length == len(probe_keys[0])
+            for v in res.values:
+                assert len(v) % self.PAGE == 0
+                run = np.asarray(v.indices)
+                by_page = run.reshape(-1, self.PAGE)
+                assert (
+                    by_page
+                    == by_page[:, :1] + np.arange(self.PAGE, dtype=np.int32)
+                ).all()
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_gc_frees_loser_slots_at_page_granularity(self):
+        """Conflicting page-aligned writes: the losing writer's whole
+        page run returns to its pool after a unanimous GC round."""
+        page = self.PAGE
+        nodes, ring, router = make_storm_cluster(num_slots=2048, page=page)
+        try:
+            key = np.repeat(np.asarray([9, 8], np.int32), page)
+            winner, loser = ring[0], ring[2]
+            ws = winner.pool.alloc(len(key))
+            winner.insert(key, ws)
+            ls = loser.pool.alloc(len(key))
+            loser.insert(key, ls)
+            from radixmesh_tpu.cache.oplog import NodeKey
+
+            nk = NodeKey(key, loser.rank)
+            assert wait_for(
+                lambda: all(nk in n.dup_nodes for n in ring)
+            ), "duplicate never recorded everywhere"
+            free_before = loser.pool.free_slots
+            loser.run_gc_round()
+            assert wait_for(
+                lambda: loser.pool.free_slots == free_before + len(key)
+            ), "loser's page-granular duplicate slots never freed"
+            assert all(
+                v.rank == winner.rank
+                for v in loser.match_prefix(key).values
+            )
         finally:
             for n in nodes:
                 n.close()

@@ -137,11 +137,64 @@ class TestWireVersionCompat:
             assert buf[1] == 1  # version byte
             got = deserialize(buf)
         finally:
-            set_emit_version(2)
+            set_emit_version(3)
         assert got.ts == 0.0
         assert got.origin_rank == 2 and got.value_rank == 2 and got.ttl == 4
         np.testing.assert_array_equal(got.key, op.key)
         np.testing.assert_array_equal(got.value, op.value)
+
+    def test_v2_frames_still_accepted_page_defaults_1(self):
+        """A v2 frame (pre-page header) parses with page=1."""
+        import struct
+
+        key = np.array([5, 6], dtype=np.int32)
+        value = np.array([20, 21], dtype=np.int32)
+        v2 = b"".join(
+            [
+                struct.pack(
+                    "<BBBxiqiid", 0x52, 2, int(OplogType.INSERT),
+                    1, 7, 3, 1, 12.5,
+                ),
+                struct.pack("<III", len(key), len(value), 0),
+                key.tobytes(),
+                value.tobytes(),
+            ]
+        )
+        op = deserialize(v2)
+        assert op.page == 1
+        assert op.ts == 12.5
+        np.testing.assert_array_equal(op.value, value)
+
+    def test_v3_page_round_trips(self):
+        op = Oplog(
+            OplogType.INSERT, 1, 2, 3,
+            key=np.arange(32, dtype=np.int32),
+            value=np.array([4, 9], dtype=np.int32),  # two page ids
+            value_rank=1, ts=5.0, page=16,
+        )
+        buf = serialize(op)
+        assert buf[1] == 3
+        got = deserialize(buf)
+        assert got.page == 16
+        assert got == op
+
+    def test_page_granular_requires_v3_emit(self):
+        """A rolling upgrade pinned to an older emit version cannot
+        silently drop the page field — it must refuse."""
+        from radixmesh_tpu.cache.oplog import set_emit_version
+
+        op = Oplog(
+            OplogType.INSERT, 0, 1, 2,
+            key=np.arange(16, dtype=np.int32),
+            value=np.array([0], dtype=np.int32),
+            value_rank=0, page=16,
+        )
+        set_emit_version(2)
+        try:
+            with pytest.raises(ValueError, match="wire v3"):
+                serialize(op)
+        finally:
+            set_emit_version(3)
 
 
 class TestPatchedTtl:
@@ -174,7 +227,7 @@ class TestPatchedTtl:
             )
             data = serialize(op)
         finally:
-            set_emit_version(2)
+            set_emit_version(3)
         back = deserialize(patched_ttl(data, 7))
         assert back.ttl == 7
         assert back.origin_rank == 1 and back.logic_id == 5
