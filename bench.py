@@ -1,8 +1,11 @@
 """Headline benchmark: paged-decode throughput on one chip.
 
-Prints ONE JSON line:
-``{"metric": "decode_tokens_per_sec_per_chip", "value": N, "unit": "tok/s",
-"vs_baseline": N}``.
+Prints ONE **compact** JSON line (headline metric, backend, gates, AOT
+verdict — kept well under the driver's 2,000-char tail capture; round 3's
+full-report-on-stdout outgrew it and the round lost its perf record,
+VERDICT round-3 missing #2) and writes the FULL report to
+``BENCH_FULL_r{N}.json`` in-repo. The compact line carries
+``full_report`` naming that file.
 
 The reference publishes no numbers (SURVEY §6: ``README.md:58`` unchecked,
 ``BASELINE.json`` ``published: {}``; its ``src.test.benchmark`` has no
@@ -37,6 +40,21 @@ import time
 from functools import partial
 
 _CHILD_ENV = "_RADIXMESH_BENCH_CHILD"
+_AOT_ENV = "_RADIXMESH_BENCH_AOT"
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def current_round() -> int:
+    """The round in progress = 1 + the highest recorded ``BENCH_r{N}``
+    artifact (the driver writes one at the END of each round)."""
+    import re
+
+    rounds = [0]
+    for name in os.listdir(_REPO):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if m:
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
 
 if os.environ.get(_CHILD_ENV):  # only the measuring child touches jax
     import jax
@@ -58,86 +76,264 @@ def _error_json(msg: str) -> str:
     })
 
 
-def _probe_tpu() -> tuple[bool, list[dict]]:
-    """Try to init the TPU backend in THROWAWAY processes under a
-    watchdog — the init itself is what hangs when the TPU tunnel is down
-    (round-1: >25 min inside ``make_c_api_client``; round-2: silent hang),
-    so it must happen where a timeout can kill it.
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp\n"
+    "d = jax.devices()\n"
+    "x = jnp.ones((8, 128), jnp.bfloat16)\n"
+    "(x @ x.T).block_until_ready()\n"
+    "print('PLAT=' + jax.default_backend())\n"
+    "print('KIND=' + d[0].device_kind)\n"
+)
 
-    Three spaced attempts (round-1's failure was ``UNAVAILABLE``, the
+
+def probe_attempt(platform: str | None, timeout: int) -> dict:
+    """One bounded TPU-init attempt in a THROWAWAY process — the init
+    itself is what hangs when the TPU tunnel is down (round-1: >25 min
+    inside ``make_c_api_client``; round-2: silent hang), so it must
+    happen where a timeout can kill it. A backend of "tpu" OR "axon"
+    counts as up (here the chip is tunneled through a PJRT plugin
+    registered as platform "axon" with TPU lowering rules —
+    ``JAX_PLATFORMS=tpu`` would MISS it). Shared by the end-of-round
+    probe below and the mid-round ``scripts/tpu_probe.py`` windows."""
+    env = dict(os.environ)
+    env.pop(_CHILD_ENV, None)
+    env.pop(_AOT_ENV, None)
+    env.pop("JAX_PLATFORMS", None)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    t0 = time.monotonic()
+    entry: dict = {
+        "jax_platforms": platform or "(default)",
+        "timeout_s": timeout,
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=timeout,
+        )
+        entry["elapsed_s"] = round(time.monotonic() - t0, 1)
+        entry["stderr_tail"] = proc.stderr.decode(errors="replace")[-2000:]
+        plat = kind = None
+        for line in proc.stdout.decode(errors="replace").splitlines():
+            if line.startswith("PLAT="):
+                plat = line[5:].strip()
+            if line.startswith("KIND="):
+                kind = line[5:].strip()
+        if plat in ("tpu", "axon"):
+            entry["outcome"] = "ok"
+            entry["device_kind"] = kind
+        else:
+            entry["outcome"] = f"rc={proc.returncode}, backend={plat or 'none'}"
+    except subprocess.TimeoutExpired as exc:
+        entry["elapsed_s"] = round(time.monotonic() - t0, 1)
+        stderr = exc.stderr or b""
+        entry["stderr_tail"] = stderr.decode(errors="replace")[-2000:]
+        entry["outcome"] = f"hang: killed after {timeout}s with no backend"
+    return entry
+
+
+def _probe_tpu() -> tuple[bool, list[dict]]:
+    """Three spaced attempts (round-1's failure was ``UNAVAILABLE``, the
     classic transient): twice on the environment's own platform selection
-    (here the TPU chip is tunneled through a PJRT plugin registered as
-    platform "axon" with TPU lowering rules — ``JAX_PLATFORMS=tpu`` would
-    MISS it, so the inherited env is the honest attempt), then once with
-    ``JAX_PLATFORMS=tpu`` forced for the plain-TPU-VM case. A backend of
-    "tpu" OR "axon" counts as the TPU being up. Every attempt's outcome
-    AND stderr tail is returned for the benchmark artifact — round 2
-    recorded only "backend = None", which made the failure undiagnosable
-    (VERDICT round-2 weak #2)."""
-    code = (
-        "import jax, jax.numpy as jnp\n"
-        "d = jax.devices()\n"
-        "x = jnp.ones((8, 128), jnp.bfloat16)\n"
-        "(x @ x.T).block_until_ready()\n"
-        "print('PLAT=' + jax.default_backend())\n"
-        "print('KIND=' + d[0].device_kind)\n"
-    )
+    (the honest attempt — see :func:`probe_attempt`), then once with
+    ``JAX_PLATFORMS=tpu`` forced for the plain-TPU-VM case. Every
+    attempt's outcome AND stderr tail is returned for the benchmark
+    artifact — round 2 recorded only "backend = None", which made the
+    failure undiagnosable (VERDICT round-2 weak #2)."""
     inherited = os.environ.get("JAX_PLATFORMS")
     attempts = [(inherited, 180), (inherited, 180), ("tpu", 120)]
     diags: list[dict] = []
     for i, (platform, timeout) in enumerate(attempts):
         if i > 0:
             time.sleep(25)  # spaced: give a transient UNAVAILABLE room
-        env = dict(os.environ)
-        env.pop(_CHILD_ENV, None)
-        env.pop("JAX_PLATFORMS", None)
-        if platform:
-            env["JAX_PLATFORMS"] = platform
-        t0 = time.monotonic()
-        entry = {
-            "attempt": i,
-            "jax_platforms": platform or "(default)",
-            "timeout_s": timeout,
-        }
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", code], env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                timeout=timeout,
-            )
-            entry["elapsed_s"] = round(time.monotonic() - t0, 1)
-            out = proc.stdout.decode(errors="replace")
-            entry["stderr_tail"] = proc.stderr.decode(errors="replace")[-2000:]
-            plat = kind = None
-            for line in out.splitlines():
-                if line.startswith("PLAT="):
-                    plat = line[5:].strip()
-                if line.startswith("KIND="):
-                    kind = line[5:].strip()
-            if plat in ("tpu", "axon"):
-                entry["outcome"] = "ok"
-                entry["device_kind"] = kind
-                diags.append(entry)
-                log(f"bench[parent]: probe attempt {i}: TPU up "
-                    f"(platform={plat}, kind={kind})")
-                return True, diags
-            entry["outcome"] = (
-                f"rc={proc.returncode}, backend={plat or 'none'}"
-            )
-        except subprocess.TimeoutExpired as exc:
-            entry["elapsed_s"] = round(time.monotonic() - t0, 1)
-            stderr = exc.stderr or b""
-            entry["stderr_tail"] = stderr.decode(errors="replace")[-2000:]
-            entry["outcome"] = (
-                f"hang: killed after {timeout}s with no backend"
-            )
+        entry = probe_attempt(platform, timeout)
+        entry["attempt"] = i
         diags.append(entry)
+        if entry["outcome"] == "ok":
+            log(f"bench[parent]: probe attempt {i}: TPU up "
+                f"(platform={entry['jax_platforms']}, "
+                f"kind={entry.get('device_kind')})")
+            return True, diags
         log(
             f"bench[parent]: probe attempt {i} "
             f"({entry['jax_platforms']}): {entry['outcome']}; "
             f"stderr tail: {entry['stderr_tail'][-200:]!r}"
         )
     return False, diags
+
+
+def _probe_windows() -> list[dict]:
+    """Mid-round probe history accumulated by ``scripts/tpu_probe.py``
+    (VERDICT round-3 missing #1: one early window decided all three
+    rounds — the artifact must show the tunnel was tried at several
+    wall-clock points, not just at bench time)."""
+    path = os.path.join(_REPO, f"TPU_PROBES_r{current_round():02d}.json")
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (json.JSONDecodeError, OSError):
+        return [{"error": f"unreadable {os.path.basename(path)}"}]
+
+
+def _aot_lowering_check(timeout: int = 600) -> dict:
+    """Compile-only Pallas→Mosaic lowering for a TPU target, run on the
+    CPU backend via ``jax.export`` cross-platform lowering — so a Mosaic
+    lowering bug in the kernels cannot hide behind a dead tunnel (VERDICT
+    round-3 missing #1). Runs in a subprocess like everything else here;
+    records per-kernel success-or-error."""
+    env = dict(os.environ, **{_AOT_ENV: "1"})
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"timed out after {timeout}s"}
+    for line in reversed(proc.stdout.decode(errors="replace").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {
+        "ok": False,
+        "error": f"rc={proc.returncode}, no JSON line",
+        "stderr_tail": proc.stderr.decode(errors="replace")[-1000:],
+    }
+
+
+def aot_main() -> None:
+    """Child for :func:`_aot_lowering_check`: export each Pallas kernel
+    for ``platforms=["tpu"]`` at serving-like shapes and report
+    per-kernel verdicts plus the StableHLO module size (evidence the
+    Mosaic payload was actually emitted, not skipped)."""
+    import jax
+    from jax import export
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from radixmesh_tpu.ops.paged_attention import (
+        paged_attention_pool_kernel,
+        paged_chunk_attention_kernel,
+        paged_decode_fused_kernel,
+    )
+
+    B, Hq, Hkv, D, page, P, L = 8, 16, 8, 128, 16, 256, 4
+    max_pages = 64
+    C = 256  # prefill chunk length for the chunk kernel
+    q = jnp.zeros((B, Hq, D), jnp.bfloat16)
+    kv = jnp.zeros((2, L, Hkv, P, page, D), jnp.bfloat16)
+    kn = jnp.zeros((B, Hkv, D), jnp.bfloat16)
+    pt = jnp.zeros((B, max_pages), jnp.int32)
+    slots = jnp.zeros((B,), jnp.int32)
+    lens = jnp.full((B,), 512, jnp.int32)
+    scales = jnp.ones((2, L, Hkv, P, page), jnp.float32)
+    kv8 = jnp.zeros((2, L, Hkv, P, page, D), jnp.int8)
+    qc = jnp.zeros((B, C, Hq, D), jnp.bfloat16)
+    kc = jnp.zeros((B, C, Hkv, D), jnp.bfloat16)
+
+    cases = {
+        "pool_kernel": lambda: paged_attention_pool_kernel(q, kv, pt, lens, 0),
+        "pool_kernel_int8": lambda: paged_attention_pool_kernel(
+            q, kv8, pt, lens, 0, kv_scales=scales
+        ),
+        "fused_decode": lambda: paged_decode_fused_kernel(
+            q, kn, kn, kv, slots, pt, lens, 0
+        ),
+        "fused_decode_int8": lambda: paged_decode_fused_kernel(
+            q, kn, kn, kv8, slots, pt, lens, 0, kv_scales=scales
+        ),
+        "chunk_prefill": lambda: paged_chunk_attention_kernel(
+            qc, kc, kc, kv, pt, lens, lens + C, 0
+        ),
+        "chunk_prefill_int8": lambda: paged_chunk_attention_kernel(
+            qc, kc, kc, kv8, pt, lens, lens + C, 0, kv_scales=scales
+        ),
+    }
+    out: dict = {"ok": True, "target": "tpu", "kernels": {}}
+    for name, thunk in cases.items():
+        try:
+            exp = export.export(jax.jit(thunk), platforms=["tpu"])()
+            out["kernels"][name] = {
+                "ok": True,
+                "stablehlo_bytes": len(exp.mlir_module_serialized),
+            }
+        except Exception as exc:  # noqa: BLE001 — verdict must not crash
+            out["ok"] = False
+            out["kernels"][name] = {
+                "ok": False,
+                "error": f"{type(exc).__name__}: {exc}"[:600],
+            }
+    print(json.dumps(out), flush=True)
+
+
+def _probe_summary(probe_diags: list[dict], windows: list[dict]) -> dict:
+    """Compact probe record for the final stdout line: outcomes only —
+    the full per-attempt stderr tails live in the FULL report."""
+    return {
+        "end_of_round": [d.get("outcome", "?") for d in probe_diags],
+        "windows": [
+            {"ts": w.get("ts"), "label": w.get("label"), "up": w.get("up")}
+            for w in windows
+        ],
+    }
+
+
+def _emit(full: dict, aot: dict, probe_diags: list[dict],
+          windows: list[dict]) -> None:
+    """Write the FULL report to ``BENCH_FULL_r{N}.json`` and print the
+    compact summary as the final stdout line (the driver records only the
+    last 2,000 chars of stdout — round 3's full JSON outgrew that and the
+    round lost its perf record, VERDICT round-3 missing #2)."""
+    rnd = current_round()
+    full["tpu_probe"] = probe_diags
+    full["probe_windows"] = windows
+    full["aot_lowering"] = aot
+    full_path = os.path.join(_REPO, f"BENCH_FULL_r{rnd:02d}.json")
+    with open(full_path, "w") as fh:
+        json.dump(full, fh, indent=1)
+    north = full.get("north_star") or {}
+    shapes = north.get("shapes") or {}
+    compact = {
+        "metric": full.get("metric"),
+        "value": full.get("value"),
+        "unit": full.get("unit"),
+        "backend": full.get("backend"),
+        "vs_baseline": full.get("vs_baseline"),
+        "vs_dense_same_shape": full.get("vs_dense_same_shape"),
+        "int8_vs_bf16": (full.get("int8") or {}).get("vs_bf16"),
+        "mfu": (full.get("roofline") or {}).get("mfu"),
+        "north_star": {
+            "hit_rate": north.get("hit_rate"),
+            "aggregate_hit_rate": north.get("aggregate_hit_rate"),
+            "p50_ttft_ms": north.get("p50_ttft_ms"),
+            "p99_ttft_ms": north.get("p99_ttft_ms"),
+            "wide_p50_ttft_ms": (shapes.get("wide") or {}).get("p50_ttft_ms"),
+        },
+        "aot_lowering": {
+            "ok": aot.get("ok"),
+            "kernels": {
+                k: v.get("ok") for k, v in (aot.get("kernels") or {}).items()
+            },
+            **({"error": aot["error"][:200]} if aot.get("error") else {}),
+        },
+        "tpu_probe": _probe_summary(probe_diags, windows),
+        "full_report": os.path.basename(full_path),
+    }
+    if full.get("error"):
+        compact["error"] = str(full["error"])[:300]
+    line = json.dumps(compact)
+    if len(line) > 1900:  # hard ceiling: never outgrow the tail capture
+        compact.pop("tpu_probe", None)
+        line = json.dumps(compact)
+    print(line, flush=True)
 
 
 def supervise() -> int:
@@ -148,11 +344,15 @@ def supervise() -> int:
     parent never imports a backend. A bounded probe decides whether the
     TPU is reachable at all; only then is the long TPU budget spent —
     otherwise fall back to CPU immediately so an honest number is
-    recorded within the driver's patience. The probe's per-attempt
-    diagnostics ride along in the final JSON either way. Total failure
-    prints a parseable error JSON instead of a traceback.
+    recorded within the driver's patience. The AOT lowering check runs
+    regardless of the tunnel's state. Total failure prints a parseable
+    compact error JSON instead of a traceback.
     """
     tpu_up, probe_diags = _probe_tpu()
+    windows = _probe_windows()
+    aot = _aot_lowering_check()
+    log(f"bench[parent]: aot_lowering ok={aot.get('ok')} "
+        f"kernels={ {k: v.get('ok') for k, v in (aot.get('kernels') or {}).items()} }")
     if tpu_up:
         # Re-use exactly the platform selection the probe succeeded with
         # ("(default)" = inherit the environment's own, e.g. axon).
@@ -186,17 +386,14 @@ def supervise() -> int:
                 except json.JSONDecodeError:
                     continue
                 if parsed.get("value") is not None:
-                    parsed["tpu_probe"] = probe_diags
-                    print(json.dumps(parsed), flush=True)
+                    _emit(parsed, aot, probe_diags, windows)
                     return 0
                 last_err = parsed.get("error", f"backend={platform}: null value")
                 break
         else:
             last_err = f"backend={platform}: rc={proc.returncode}, no JSON line"
         log(f"bench[parent]: {last_err}")
-    parsed = json.loads(_error_json(last_err))
-    parsed["tpu_probe"] = probe_diags
-    print(json.dumps(parsed), flush=True)
+    _emit(json.loads(_error_json(last_err)), aot, probe_diags, windows)
     return 0  # parseable-JSON contract kept even on failure
 
 
@@ -799,7 +996,9 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
 
 
 if __name__ == "__main__":
-    if os.environ.get(_CHILD_ENV):
+    if os.environ.get(_AOT_ENV):
+        aot_main()
+    elif os.environ.get(_CHILD_ENV):
         try:
             main()
         except Exception as exc:  # child must still emit a parseable line
