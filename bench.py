@@ -313,6 +313,9 @@ def _emit(full: dict, aot: dict, probe_diags: list[dict],
         "north_star": {
             "hit_rate": north.get("hit_rate"),
             "aggregate_hit_rate": north.get("aggregate_hit_rate"),
+            "aggregate_reuse_efficiency": north.get(
+                "aggregate_reuse_efficiency"
+            ),
             "p50_ttft_ms": north.get("p50_ttft_ms"),
             "p99_ttft_ms": north.get("p99_ttft_ms"),
             "wide_p50_ttft_ms": (shapes.get("wide") or {}).get("p50_ttft_ms"),
@@ -931,10 +934,13 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
         cfg, params, num_slots=eng_slots, page_size=page_size,
         max_batch=max_batch, name="bench",
         # One host round trip per 8 tokens: on the RPC-tunneled chip a
-        # round trip costs ~67 ms, which would otherwise BE the TPOT.
-        decode_steps_per_launch=8 if on_tpu else 1,
+        # round trip costs ~67 ms, which would otherwise BE the TPOT —
+        # and on CPU each launch pays a whole-pool donation-copy, so
+        # fewer launches is the wide-shape TTFT lever there too.
+        decode_steps_per_launch=8,
     )
     per_shape = {}
+    shape_tokens: dict[str, int] = {}
     tot_prompt = tot_cached = tot_req = 0
     all_ttft: list[float] = []
     for shape_idx, (name, sizes) in enumerate(shapes.items()):
@@ -963,6 +969,7 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
         tot_prompt += ns["prompt_tokens"]
         tot_cached += ns["cached_tokens"]
         tot_req += ns["requests"]
+        shape_tokens[name] = ns["prompt_tokens"]
         all_ttft.extend(ns["ttft_s"])
         log(
             f"north-star[{name}]: {ns['requests']} reqs, "
@@ -972,6 +979,19 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
             f"p50_ttft={ns['p50_ttft_s']*1e3:.1f} ms"
         )
     hit_rate = tot_cached / tot_prompt if tot_prompt else 0.0
+    # Aggregate ceiling: token-weighted over the shapes' own ceilings —
+    # the wide shape's traffic is mostly unreusable BY CONSTRUCTION, so
+    # the aggregate's first-class gate is reuse efficiency (how close to
+    # an infinite cache), not the raw rate (VERDICT round-3 weak #2).
+    agg_ceiling = (
+        sum(
+            per_shape[n]["ceiling_hit_rate"] * shape_tokens[n]
+            for n in per_shape
+        ) / tot_prompt
+        if tot_prompt
+        else 0.0
+    )
+    agg_eff = hit_rate / agg_ceiling if agg_ceiling else 0.0
     p50 = float(np.median(all_ttft)) if all_ttft else 0.0
     p99 = float(np.quantile(all_ttft, 0.99)) if all_ttft else 0.0
     log(
@@ -987,11 +1007,20 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
         # whose ceilings differ; per-shape efficiency tells cache quality.
         "hit_rate": round(per_shape["base"]["hit_rate"], 4),
         "aggregate_hit_rate": round(hit_rate, 4),
+        "aggregate_ceiling_hit_rate": round(agg_ceiling, 4),
+        "aggregate_reuse_efficiency": round(agg_eff, 4),
         "p50_ttft_ms": round(p50 * 1e3, 2),
         "p99_ttft_ms": round(p99 * 1e3, 2),
         "requests": tot_req,
         "shapes": per_shape,
-        "targets": {"hit_rate": 0.70, "p50_ttft_ms": 200.0},
+        # First-class gates: base-shape raw rate (the ShareGPT-like
+        # BASELINE target) AND aggregate reuse efficiency (raw aggregate
+        # is ceiling-bound by the adversarial wide shape).
+        "targets": {
+            "hit_rate": 0.70,
+            "aggregate_reuse_efficiency": 0.90,
+            "p50_ttft_ms": 200.0,
+        },
     }
 
 
