@@ -41,12 +41,17 @@ __all__ = [
 ]
 
 _MAGIC = 0x52  # 'R'
-_VERSION = 3  # v3 added page (page-granular INSERT values)
-# v3 header: the v2 header plus a trailing page byte (+pad). Earlier
-# headers are strict prefixes, so the TTL patch offset is shared.
+_VERSION = 3  # v3 added page (page-granular INSERT values) + u24 arrays
+# v3 header: the v2 header plus trailing page and flags bytes (+pad).
+# Earlier headers are strict prefixes, so the TTL patch offset is shared.
 _HEADER_V3 = struct.Struct(
-    "<BBBxiqiidBxxx"
-)  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts, page
+    "<BBBxiqiidBBxx"
+)  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts, page, flags
+# v3 flags: the key/value arrays are packed 3 bytes per element (token
+# ids fit 24 bits for every real vocabulary; slot/page ids for every
+# real pool size — serialize() checks and falls back to int32 per array).
+_FLAG_KEY_U24 = 1
+_FLAG_VALUE_U24 = 2
 _HEADER_V2 = struct.Struct(
     "<BBBxiqiid"
 )  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts
@@ -183,6 +188,23 @@ def _arr(a: np.ndarray | None) -> np.ndarray:
     )
 
 
+def _fits_u24(a: np.ndarray) -> bool:
+    return a.size > 0 and 0 <= int(a.min()) and int(a.max()) < (1 << 24)
+
+
+def _pack_u24(a: np.ndarray) -> bytes:
+    """int32 array → 3 little-endian bytes per element (drop the high
+    byte — caller guarantees ``_fits_u24``)."""
+    return a.view(np.uint8).reshape(-1, 4)[:, :3].tobytes()
+
+
+def _unpack_u24(buf: memoryview, count: int, offset: int) -> np.ndarray:
+    raw = np.frombuffer(buf, dtype=np.uint8, count=3 * count, offset=offset)
+    out = np.zeros((count, 4), dtype=np.uint8)
+    out[:, :3] = raw.reshape(count, 3)
+    return out.view(np.int32).reshape(count)
+
+
 def serialize(op: Oplog) -> bytes:
     """Oplog → bytes. Every field — including GC payloads — round-trips
     (fixing the reference's ``to_dict`` omission, ``cache_oplog.py:58-66``)."""
@@ -194,6 +216,7 @@ def serialize(op: Oplog) -> bytes:
         )
     if not 1 <= op.page <= 255:
         raise ValueError(f"oplog page {op.page} out of the wire's u8 range")
+    key_bytes, value_bytes = key.tobytes(), value.tobytes()
     if _emit_version == 1:
         header = _HEADER_V1.pack(
             _MAGIC, 1, int(op.op_type),
@@ -205,16 +228,23 @@ def serialize(op: Oplog) -> bytes:
             op.origin_rank, op.logic_id, op.ttl, op.value_rank, op.ts,
         )
     else:
+        flags = 0
+        if _fits_u24(key):
+            flags |= _FLAG_KEY_U24
+            key_bytes = _pack_u24(key)
+        if _fits_u24(value):
+            flags |= _FLAG_VALUE_U24
+            value_bytes = _pack_u24(value)
         header = _HEADER_V3.pack(
             _MAGIC, _VERSION, int(op.op_type),
             op.origin_rank, op.logic_id, op.ttl, op.value_rank, op.ts,
-            op.page,
+            op.page, flags,
         )
     parts = [
         header,
         struct.pack("<III", len(key), len(value), len(op.gc)),
-        key.tobytes(),
-        value.tobytes(),
+        key_bytes,
+        value_bytes,
     ]
     for e in op.gc:
         ek = _arr(e.key)
@@ -250,10 +280,10 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
     magic, ver = buf[0], buf[1]
     if magic != _MAGIC:
         raise ValueError(f"bad oplog magic {magic:#x}")
-    page = 1
+    page, flags = 1, 0
     if ver == _VERSION:
         (_, _, op_type, origin, logic, ttl, value_rank, ts,
-         page) = _HEADER_V3.unpack_from(buf, 0)
+         page, flags) = _HEADER_V3.unpack_from(buf, 0)
         off = _HEADER_V3.size
     elif ver == 2:
         _, _, op_type, origin, logic, ttl, value_rank, ts = (
@@ -268,10 +298,18 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
         raise ValueError(f"unsupported oplog version {ver}")
     key_len, val_len, n_gc = struct.unpack_from("<III", buf, off)
     off += 12
-    key = np.frombuffer(buf, dtype=np.int32, count=key_len, offset=off).copy()
-    off += 4 * key_len
-    value = np.frombuffer(buf, dtype=np.int32, count=val_len, offset=off).copy()
-    off += 4 * val_len
+    if flags & _FLAG_KEY_U24:
+        key = _unpack_u24(buf, key_len, off)
+        off += 3 * key_len
+    else:
+        key = np.frombuffer(buf, dtype=np.int32, count=key_len, offset=off).copy()
+        off += 4 * key_len
+    if flags & _FLAG_VALUE_U24:
+        value = _unpack_u24(buf, val_len, off)
+        off += 3 * val_len
+    else:
+        value = np.frombuffer(buf, dtype=np.int32, count=val_len, offset=off).copy()
+        off += 4 * val_len
     gc: list[GCEntry] = []
     for _ in range(n_gc):
         agree, vrank, eklen = struct.unpack_from("<iiI", buf, off)

@@ -232,7 +232,9 @@ class RadixTree:
     def _child_key(self, key: np.ndarray) -> Any:
         if self.page_size == 1:
             return int(key[0])
-        return tuple(int(t) for t in key[: self.page_size])
+        # tolist() is one C call; a per-token genexpr was the dominant
+        # cost of paged-tree inserts (2.5x slower than page_size=1).
+        return tuple(key[: self.page_size].tolist())
 
     def _aligned_len(self, n: int) -> int:
         return n - (n % self.page_size)

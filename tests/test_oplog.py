@@ -231,3 +231,63 @@ class TestPatchedTtl:
         back = deserialize(patched_ttl(data, 7))
         assert back.ttl == 7
         assert back.origin_rank == 1 and back.logic_id == 5
+
+
+class TestU24Packing:
+    """v3 packs key/value arrays 3 bytes per element when they fit 24
+    bits (every real vocabulary and pool does); out-of-range arrays fall
+    back to int32 per array, signalled by header flags."""
+
+    def test_round_trip_and_size(self):
+        op = Oplog(
+            OplogType.INSERT, 0, 1, 5,
+            key=np.arange(256, dtype=np.int32),
+            value=np.arange(16, dtype=np.int32),
+            value_rank=0, page=16,
+        )
+        buf = serialize(op)
+        got = deserialize(buf)
+        assert got == op
+        from radixmesh_tpu.cache.oplog import _HEADER_V3
+
+        assert len(buf) == _HEADER_V3.size + 12 + 3 * (256 + 16)
+
+    def test_out_of_range_values_fall_back_to_int32(self):
+        for bad in (np.array([1 << 24], np.int32), np.array([-5], np.int32)):
+            op = Oplog(
+                OplogType.INSERT, 0, 1, 5,
+                key=bad, value=np.array([3], np.int32), value_rank=0,
+            )
+            got = deserialize(serialize(op))
+            np.testing.assert_array_equal(got.key, bad)
+            np.testing.assert_array_equal(got.value, [3])
+
+    def test_boundary_values(self):
+        key = np.array([0, (1 << 24) - 1, 12345], np.int32)
+        op = Oplog(OplogType.INSERT, 0, 1, 5, key=key,
+                   value=key.copy(), value_rank=0)
+        got = deserialize(serialize(op))
+        np.testing.assert_array_equal(got.key, key)
+        np.testing.assert_array_equal(got.value, key)
+
+    def test_mixed_flags(self):
+        """Key fits u24, value does not: each array chooses its own
+        encoding."""
+        op = Oplog(
+            OplogType.INSERT, 0, 1, 5,
+            key=np.array([7, 8], np.int32),
+            value=np.array([1 << 25, 4], np.int32),
+            value_rank=0,
+        )
+        got = deserialize(serialize(op))
+        assert got == op
+
+    def test_patched_ttl_still_works(self):
+        op = Oplog(OplogType.INSERT, 2, 9, 6,
+                   key=np.arange(32, dtype=np.int32),
+                   value=np.arange(2, dtype=np.int32), value_rank=2, page=16)
+        from radixmesh_tpu.cache.oplog import patched_ttl
+
+        back = deserialize(patched_ttl(serialize(op), 3))
+        assert back.ttl == 3
+        np.testing.assert_array_equal(back.key, op.key)
