@@ -1048,6 +1048,12 @@ class Engine:
             if k_eff > 1:
                 self._decode_multi_once(k_eff)
                 return
+        if not self._pp and not default_use_kernel(self.cfg.head_dim):
+            # Kernel-less single step: the same compact working-set path
+            # with k=1 — a decode_step launch would otherwise pay the
+            # whole-pool donation-copy for one token.
+            self._decode_multi_once(1)
+            return
         slots = np.full(self.max_batch, self._scratch_slot, dtype=np.int32)
         lengths = np.ones(self.max_batch, dtype=np.int32)
         preempted: list[Request] = []
