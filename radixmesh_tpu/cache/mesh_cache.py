@@ -76,7 +76,8 @@ from radixmesh_tpu.cache.oplog import (
     Oplog,
     OplogType,
     deserialize,
-    patched_ttl,
+    emit_version,
+    patched_frame,
     serialize,
 )
 from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode, as_key
@@ -84,6 +85,7 @@ from radixmesh_tpu.comm.communicator import Communicator, create_communicator
 from radixmesh_tpu.config import MeshConfig, NodeRole
 from radixmesh_tpu.obs.metrics import get_registry
 from radixmesh_tpu.policy.conflict import NodeRankConflictResolver
+from radixmesh_tpu.policy.hierarchy import HierPlan, auto_group_size
 from radixmesh_tpu.policy.sync_algo import BaseSyncAlgo, get_sync_algo
 from radixmesh_tpu.policy.topology import TopologyView, decode_view, encode_view
 from radixmesh_tpu.utils.logging import get_logger
@@ -133,8 +135,6 @@ class MeshCache:
             # only surfaced inside insert()'s serialize() (after
             # _mesh_insert already applied), the origin's tree would
             # silently diverge from the ring on every publish.
-            from radixmesh_tpu.cache.oplog import emit_version
-
             if emit_version() < 3:
                 raise ValueError(
                     f"page_size={self.page} needs wire v3 oplogs; the "
@@ -147,6 +147,21 @@ class MeshCache:
                     f"page_size={self.page} exceeds the wire's u8 "
                     "page field (max 255)"
                 )
+        # Two-level hierarchical replication (policy/hierarchy.py; the
+        # reference's >50-node roadmap question, README.md:57). None =
+        # the flat ring. The scope flag lives in the v3 flags byte, so a
+        # rolling upgrade pinned below v3 must finish before enabling.
+        self.hier: HierPlan | None = None
+        if cfg.topology == "hier":
+            if emit_version() < 3:
+                raise ValueError(
+                    "topology=hier needs wire v3 oplogs (spine scope "
+                    f"flag); the emit version is pinned to {emit_version()}"
+                )
+            self.hier = HierPlan(
+                ring_size=cfg.num_ring,
+                group_size=cfg.group_size or auto_group_size(cfg.num_ring),
+            )
         self.tree = RadixTree(page_size=self.page)
         self._lock = threading.RLock()
         self._logic_op = AtomicCounter()
@@ -181,12 +196,30 @@ class MeshCache:
         self.on_lap_complete = None
         self._last_self_join = 0.0
         self._succ_rank: int | None = None
-        self._pending_retarget: str | None = None
-        self._retarget_flag = threading.Event()
+        # Channel retargets requested by view changes, applied by each
+        # channel's OWN sender thread (serialized with its sends):
+        # dest ("ring" | "spine") → new target address.
+        self._pending_retargets: dict[str, str | None] = {}
+        self._retarget_flags = {
+            "ring": threading.Event(),
+            "spine": threading.Event(),
+        }
         # A successor is "established" once its channel has been seen
         # connected; until then sends block with unbounded patience (slow
         # startup must not read as death). Reset on retarget.
         self._succ_established = False
+        # Hierarchical mode: the leader-spine channel (send-only, idle on
+        # non-leaders — same pattern as the router fan-out channels) and
+        # the current spine successor rank. The spine gets its OWN sender
+        # thread + queues: a leader bridges every inter-group op, and
+        # spine sends serializing behind its group forwards would halve
+        # the hierarchy's throughput at exactly the nodes it hinges on.
+        self._spine_comm: Communicator | None = None
+        self._spine_rank: int | None = None
+        self._spine_established = False
+        # Hier GC: pending vote-aggregation rounds at this (query-origin)
+        # node, keyed by the query's logic id (see run_gc_round).
+        self._gc_pending: dict[int, dict] = {}
         self._router_state: dict[int, dict] = {}
         # Fired (under the mesh lock) as (old_view, new_view) after a view
         # change is adopted; the router uses this to retire/restore hash-
@@ -213,6 +246,11 @@ class MeshCache:
         self._m_dropped = reg.counter(
             "mesh_oplogs_dropped_total",
             "oplogs dropped on outbound-queue overflow",
+            ("node",),
+        ).labels(node=node)
+        self._m_bridged = reg.counter(
+            "mesh_spine_bridges_total",
+            "oplogs bridged group→spine by this leader (hier topology)",
             ("node",),
         ).labels(node=node)
         self._m_conflicts = reg.counter(
@@ -253,6 +291,10 @@ class MeshCache:
         # idempotent), so overtaking is safe.
         self._ctl_q: queue.Queue[bytes] = queue.Queue(maxsize=4096)
         self._send_evt = threading.Event()
+        # The spine channel's lanes (hier leaders only; idle otherwise).
+        self._spine_out_q: queue.Queue[bytes] = queue.Queue(maxsize=65536)
+        self._spine_ctl_q: queue.Queue[bytes] = queue.Queue(maxsize=4096)
+        self._spine_evt = threading.Event()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -277,15 +319,40 @@ class MeshCache:
                         self.cfg.protocol, None, router_addr, self.cfg.max_msg_bytes
                     )
                 )
+        next_addr = topo.next_node
+        if self.hier is not None and self.role is not NodeRole.ROUTER:
+            # Hier mode: the data channel targets the GROUP successor, not
+            # the flat-ring successor the sync algo names.
+            succ = self.hier.group_successor(self.rank, self._my_alive())
+            next_addr = None if succ is None else self.cfg.addr_of_rank(succ)
         self._comm = create_communicator(
             self.cfg.protocol,
             topo.bind_addr,
-            topo.next_node,
+            next_addr,
             self.cfg.max_msg_bytes,
         )
         self._comm.register_rcv_callback(self.oplog_received)
         if self.role is not NodeRole.ROUTER:
-            self._succ_rank = self.view.successor_of(self.rank)
+            if self.hier is not None:
+                alive = self._my_alive()
+                self._succ_rank = self.hier.group_successor(self.rank, alive)
+                sp = (
+                    self.hier.spine_successor(self.rank, alive)
+                    if self.hier.is_leader(self.rank, alive)
+                    else None
+                )
+                self._spine_rank = sp
+                # Every ring node opens the spine channel (idle unless it
+                # is currently a leader) so leadership can move to it on a
+                # view change without opening transports mid-failover.
+                self._spine_comm = create_communicator(
+                    self.cfg.protocol,
+                    None,
+                    None if sp is None else self.cfg.addr_of_rank(sp),
+                    self.cfg.max_msg_bytes,
+                )
+            else:
+                self._succ_rank = self.view.successor_of(self.rank)
         # Mark started before spawning threads: the ticker's first tick must
         # not be dropped by the _started gate in _send_bytes.
         self._started = True
@@ -308,6 +375,12 @@ class MeshCache:
             t = threading.Thread(target=self._sender, daemon=True, name="mesh-sender")
             t.start()
             self._threads.append(t)
+            if self.hier is not None:
+                t = threading.Thread(
+                    target=self._spine_sender, daemon=True, name="mesh-spine-sender"
+                )
+                t.start()
+                self._threads.append(t)
             # Every ring node runs the ticker thread; only the CURRENT
             # view's tick origin broadcasts (see _view_tick_origin) —
             # heartbeats must survive the death of the static origin.
@@ -355,18 +428,30 @@ class MeshCache:
         ):
             with self._lock:
                 leave = self.view.without(self.rank)
-                data = serialize(
-                    Oplog(
-                        op_type=OplogType.TOPO,
-                        origin_rank=self.rank,
-                        logic_id=self._logic_op.next(),
-                        ttl=max(1, leave.ring_size),
-                        value=encode_view(leave),
-                        ts=time.time(),
-                    )
+                op = Oplog(
+                    op_type=OplogType.TOPO,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self._data_ttl(),
+                    value=encode_view(leave),
+                    ts=time.time(),
                 )
+                data = serialize(op)
+                spine_data = None
+                if (
+                    self.hier is not None
+                    and self._spine_comm is not None
+                    and self._spine_rank is not None
+                ):
+                    # A leaving LEADER must tell the other groups directly —
+                    # its own bridge is about to disappear with it.
+                    op.spine = True
+                    op.ttl = self.hier.spine_ttl(self._my_alive())
+                    spine_data = serialize(op)
             try:  # best-effort: the ring may already be gone
                 self._comm.try_send(data, 1.0)
+                if spine_data is not None:
+                    self._spine_comm.try_send(spine_data, 1.0)
                 if self.rank == self.view.master_rank():
                     for rc in self._router_comms:
                         rc.try_send(data, 1.0)
@@ -377,6 +462,8 @@ class MeshCache:
             t.join(timeout=2)
         if self._comm is not None:
             self._comm.close()
+        if self._spine_comm is not None:
+            self._spine_comm.close()
         for c in self._router_comms:
             c.close()
 
@@ -542,25 +629,26 @@ class MeshCache:
                     self.tick_counts.get(op.origin_rank, 0) + 1
                 )
                 self._gossip_view_from_tick(op)
-                if op.ttl > 0:
-                    # Forward the ORIGINAL frame with only its TTL patched
-                    # — per-hop re-serialization is pure overhead.
-                    self._send_bytes(patched_ttl(data, op.ttl), control=True)
+                # Scope-aware forward: the frame is immutable, so hops
+                # patch the original bytes instead of re-serializing.
+                self._circulate(op, data, control=True)
                 return
-            if op.op_type in (OplogType.GC_QUERY, OplogType.GC_EXEC):
-                self._gc_handle(op)
+            if op.op_type in (OplogType.GC_QUERY, OplogType.GC_EXEC, OplogType.GC_VOTE):
+                self._gc_handle(op, data)
                 return
             if op.op_type is OplogType.TOPO:
-                self._handle_topo(op)
+                self._handle_topo(op, data)
                 return
             if op.op_type is OplogType.JOIN:
-                self._handle_join(op)
+                self._handle_join(op, data)
                 return
             if op.origin_rank == self.rank:
                 # Lap complete (radix_mesh.py:401-402). Fire the
-                # instrumentation seam before dropping.
+                # instrumentation seam before dropping. In hier mode the
+                # seam fires on the GROUP lap's return; a leader-origin's
+                # returning SPINE copy is just dropped.
                 cb = self.on_lap_complete
-                if cb is not None:
+                if cb is not None and not op.spine:
                     cb(op)
                 return
             # Apply BEFORE any TTL-based drop: with elastic membership an
@@ -589,30 +677,178 @@ class MeshCache:
                 self._apply_delete(op.key)
             elif op.op_type is OplogType.RESET:
                 self._apply_reset()
-            if op.ttl > 0:
-                # Hot replication path: patch the TTL in the received
-                # frame and enqueue it as-is. The key/value payload is
-                # immutable in flight, so bytes are authoritative — and a
-                # 5-node ring re-serializing every insert 4x was the
-                # dominant per-hop CPU cost.
-                self._send_bytes(patched_ttl(data, op.ttl))
+            # Hot replication path: _circulate patches the TTL (and, in
+            # hier mode, the scope) in the received frame and enqueues it
+            # as-is. The key/value payload is immutable in flight, so
+            # bytes are authoritative — and a 5-node ring re-serializing
+            # every insert 4x was the dominant per-hop CPU cost.
+            self._circulate(op, data)
 
     # ------------------------------------------------------------------
     # elastic membership (policy/topology.py; reference roadmap README.md:49-50)
     # ------------------------------------------------------------------
 
+    def _my_alive(self) -> tuple[int, ...]:
+        """The current view's alive set, always including this node: a
+        node excluded from the view (reborn after being declared dead)
+        must still be able to compute successors to deliver its JOIN."""
+        a = self.view.alive
+        if self.role is NodeRole.ROUTER or self.rank in a:
+            return a
+        return tuple(sorted((*a, self.rank)))
+
     def _data_ttl(self) -> int:
-        """One lap of the CURRENT ring (generalizes sync_algo's static
-        ``cfg.num_ring`` TTLs to elastic membership)."""
+        """One lap of the CURRENT ring — the local group's ring in hier
+        mode (generalizes sync_algo's static ``cfg.num_ring`` TTLs to
+        elastic membership)."""
+        if self.hier is not None and self.role is not NodeRole.ROUTER:
+            return self.hier.group_ttl(self.rank, self._my_alive())
         return max(1, self.view.ring_size)
 
     def _tick_ttl(self) -> int:
-        return 2 * max(1, self.view.ring_size)
+        # Two laps at every level ("two-round verification",
+        # sync_algo.py:103-104): in hier mode the doubling is applied
+        # per-scope by _level_ttl, so a single origination still proves
+        # ring connectivity twice to every member.
+        return 2 * self._data_ttl()
 
     def _gc_ttl(self) -> int:
-        return max(1, self.view.ring_size)
+        return self._data_ttl()
 
-    def _handle_topo(self, op: Oplog) -> None:
+    # ------------------------------------------------------------------
+    # scope-aware circulation (flat ring + hier groups/spine)
+    # ------------------------------------------------------------------
+
+    def _frame(
+        self,
+        op: Oplog,
+        data: bytes,
+        *,
+        ttl: int,
+        spine: bool | None = None,
+        value_rank: int | None = None,
+        mutated: bool = False,
+    ) -> bytes:
+        """The outgoing frame for ``op``: patch the received bytes when
+        the payload is unchanged (the hot path), re-serialize when a
+        handler mutated the payload (GC vote counters) or the frame
+        predates the fields being patched (possible only mid-roll)."""
+        if not mutated:
+            try:
+                return patched_frame(data, ttl=ttl, spine=spine, value_rank=value_rank)
+            except ValueError:
+                pass
+        op.ttl = ttl
+        if spine is not None:
+            op.spine = spine
+        if value_rank is not None:
+            op.value_rank = value_rank
+        return serialize(op)
+
+    def _circulate(
+        self, op: Oplog, data: bytes, *, mutated: bool = False, control: bool = False
+    ) -> None:
+        """Post-apply propagation (caller holds the lock; ``op.ttl``
+        already decremented). Flat ring: forward to the successor while
+        TTL remains. Hier (policy/hierarchy.py): forward at the frame's
+        scope; the origin group's leader bridges GROUP→SPINE; remote
+        leaders inject SPINE→GROUP copies that die back at the injector
+        by TTL (the injector is not the origin, so the origin-drop rule
+        cannot terminate them)."""
+        if self.role is NodeRole.ROUTER:
+            return  # routers never send (sync_algo.py:80-96)
+        if self.hier is None:
+            if op.ttl > 0:
+                self._send_bytes(self._frame(op, data, ttl=op.ttl, mutated=mutated),
+                                 control=control)
+            return
+        plan = self.hier
+        alive = self._my_alive()
+        if op.spine:
+            if plan.same_group(op.origin_rank, self.rank):
+                return  # spine lap complete (back at the origin's group)
+            if op.ttl > 0:
+                self._send_bytes(
+                    self._frame(op, data, ttl=op.ttl, mutated=mutated),
+                    control=control,
+                    dest="spine",
+                )
+            # Inject into my group ring. GC_QUERY injections are tagged
+            # with the injector's rank (value_rank is unused for them) so
+            # the returning copy is recognizably ours (_gc_handle emits
+            # this group's aggregated GC_VOTE from it). A sole-member
+            # group still enqueues the copy: the ring sender drops
+            # targetless frames but the view master's router fan-out
+            # rides that same path (sender break-then-fanout).
+            inject_ttl = plan.group_ttl(self.rank, alive)
+            tag = self.rank if op.op_type is OplogType.GC_QUERY else None
+            self._send_bytes(
+                self._frame(
+                    op, data, ttl=inject_ttl, spine=False,
+                    value_rank=tag, mutated=mutated,
+                ),
+                control=control,
+            )
+            if self._succ_rank is None and op.op_type is OplogType.GC_QUERY:
+                # Sole alive member of this group: nobody to poll — emit
+                # the group's (one-vote) tally immediately.
+                self._emit_gc_vote(op)
+            return
+        # Group scope (or the flat frame of a mid-roll peer).
+        if op.ttl > 0:
+            self._send_bytes(
+                self._frame(op, data, ttl=op.ttl, mutated=mutated), control=control
+            )
+        if (
+            op.origin_rank != self.rank
+            and 0 <= op.origin_rank < plan.ring_size
+            and plan.same_group(op.origin_rank, self.rank)
+            and plan.is_leader(self.rank, alive)
+        ):
+            self._bridge_to_spine(op, data, mutated=mutated, control=control)
+
+    def _bridge_to_spine(
+        self, op: Oplog, data: bytes, *, mutated: bool = False, control: bool = False
+    ) -> None:
+        """Re-emit a group-originated op onto the leader spine. GC_QUERY
+        bridges carry ZEROED vote counters: the origin group's votes
+        return to the origin on its own lap, and each remote group's
+        votes return as that group's GC_VOTE — a bridge carrying partial
+        tallies would double-count them."""
+        if self._spine_rank is None:
+            return  # degenerate: single nonempty group (flat semantics)
+        # One spine lap per bridge (the same_group rule ends it at the
+        # origin group's leader). TICKs originate with a TWO-lap group
+        # TTL (_tick_ttl), so a non-leader origin's tick passes its
+        # leader — and bridges — twice; with one-lap spine copies and
+        # one-lap injections that delivers the startup barrier's two
+        # ticks to every member of every group, with no doubling at the
+        # lower levels.
+        ttl = self.hier.spine_ttl(self._my_alive())
+        self._m_bridged.inc()
+        if op.op_type is OplogType.GC_QUERY:
+            sp = Oplog(
+                op_type=op.op_type,
+                origin_rank=op.origin_rank,
+                logic_id=op.logic_id,
+                ttl=ttl,
+                key=op.key,
+                value=op.value,
+                value_rank=-1,
+                gc=[GCEntry(e.key, e.value_rank, 0) for e in op.gc],
+                ts=op.ts,
+                page=op.page,
+                spine=True,
+            )
+            self._send_bytes(serialize(sp), control=control, dest="spine")
+            return
+        self._send_bytes(
+            self._frame(op, data, ttl=ttl, spine=True, mutated=mutated),
+            control=control,
+            dest="spine",
+        )
+
+    def _handle_topo(self, op: Oplog, data: bytes) -> None:
         """Caller holds the lock; ttl already decremented."""
         try:
             view = decode_view(op.value)
@@ -620,10 +856,10 @@ class MeshCache:
             self.log.error("malformed TOPO payload from rank %d", op.origin_rank)
             return
         self._adopt_view(view)
-        if op.origin_rank != self.rank and op.ttl > 0:
-            self._forward(op)
+        if op.origin_rank != self.rank:
+            self._circulate(op, data, control=True)
 
-    def _handle_join(self, op: Oplog) -> None:
+    def _handle_join(self, op: Oplog, data: bytes) -> None:
         """A node announced it is (re)joining. The current view master
         answers with a view that re-includes it; everyone forwards so the
         JOIN reaches the master wherever it sits. Caller holds the lock."""
@@ -638,8 +874,7 @@ class MeshCache:
             )
             self._adopt_view(new_view)
             self._announce_view(new_view)
-        if op.ttl > 0:
-            self._forward(op)
+        self._circulate(op, data, control=True)
 
     def _adopt_view(self, view: TopologyView) -> bool:
         """Adopt ``view`` if it supersedes the current one (higher epoch
@@ -682,13 +917,30 @@ class MeshCache:
             view.epoch, view.alive, old.epoch, old.alive,
         )
         if self.role is not NodeRole.ROUTER:
-            new_succ = view.successor_of(self.rank)
+            if self.hier is not None:
+                alive = self._my_alive()
+                new_succ = self.hier.group_successor(self.rank, alive)
+                new_spine = (
+                    self.hier.spine_successor(self.rank, alive)
+                    if self.hier.is_leader(self.rank, alive)
+                    else None
+                )
+                if new_spine != self._spine_rank:
+                    self._spine_rank = new_spine
+                    self._pending_retargets["spine"] = (
+                        None if new_spine is None else self.cfg.addr_of_rank(new_spine)
+                    )
+                    self._retarget_flags["spine"].set()
+                    self._spine_evt.set()
+            else:
+                new_succ = view.successor_of(self.rank)
             if new_succ != self._succ_rank:
                 self._succ_rank = new_succ
-                self._pending_retarget = (
+                self._pending_retargets["ring"] = (
                     None if new_succ is None else self.cfg.addr_of_rank(new_succ)
                 )
-                self._retarget_flag.set()
+                self._retarget_flags["ring"].set()
+                self._send_evt.set()
             if not view.contains(self.rank):
                 # Falsely declared dead (we're alive enough to receive
                 # this): ask to be re-included.
@@ -707,18 +959,19 @@ class MeshCache:
             except Exception:  # noqa: BLE001 — listener bugs must not break adoption
                 self.log.exception("view-change listener failed")
 
-    def _declare_successor_dead(self) -> None:
-        """Sender-side failure detection fired: the current successor has
-        been unreachable for ``failure_timeout_s``. Adopt a view without
-        it and announce the new view around the re-formed ring."""
+    def _declare_successor_dead(self, dest: str = "ring") -> None:
+        """Sender-side failure detection fired: the current successor on
+        ``dest`` ("ring" = group/flat successor, "spine" = next leader)
+        has been unreachable for ``failure_timeout_s``. Adopt a view
+        without it and announce the new view around the re-formed ring."""
         with self._lock:
-            dead = self._succ_rank
+            dead = self._spine_rank if dest == "spine" else self._succ_rank
             if dead is None:
                 return
             self.log.warning(
-                "ring successor rank %d unreachable for %.1fs — declaring it "
+                "%s successor rank %d unreachable for %.1fs — declaring it "
                 "dead and re-forming the ring",
-                dead, self.cfg.failure_timeout_s,
+                dest, dead, self.cfg.failure_timeout_s,
             )
             old = self.view
             new_view = old.without(dead)
@@ -726,24 +979,36 @@ class MeshCache:
             self._after_view_change(old)
             self._announce_view(new_view)
 
-    def _apply_pending_retarget(self) -> None:
-        """Runs on the sender thread only (serialized with sends)."""
-        if not self._retarget_flag.is_set():
+    def _apply_pending_retarget(self, dest: str) -> None:
+        """Runs on ``dest``'s sender thread only (serialized with its
+        sends)."""
+        flag = self._retarget_flags[dest]
+        if not flag.is_set():
             return
         with self._lock:
-            target = self._pending_retarget
-            self._retarget_flag.clear()
+            if dest not in self._pending_retargets:
+                flag.clear()
+                return
+            target = self._pending_retargets.pop(dest)
+            flag.clear()
+        comm = self._spine_comm if dest == "spine" else self._comm
+        if comm is None:
+            return
         try:
-            self._comm.retarget(target)
-            # A retarget destination is a current view member (it was alive
-            # enough to be in an adopted view / send JOIN), so it gets the
-            # failure deadline, NOT first-contact unbounded patience — a
-            # double failure must fire detection again, not wedge the
-            # sender in a blocking send to a second dead peer. A slow
-            # rejoiner spuriously re-declared dead simply rejoins again.
-            self._succ_established = True
+            comm.retarget(target)
+            # A retarget destination is a current view member (it was
+            # alive enough to be in an adopted view / send JOIN), so it
+            # gets the failure deadline, NOT first-contact unbounded
+            # patience — a double failure must fire detection again, not
+            # wedge the sender in a blocking send to a second dead peer.
+            # A slow rejoiner spuriously re-declared dead simply rejoins
+            # again.
+            if dest == "spine":
+                self._spine_established = target is not None
+            else:
+                self._succ_established = True
         except Exception:  # noqa: BLE001
-            self.log.exception("failed to retarget ring successor to %s", target)
+            self.log.exception("failed to retarget %s successor to %s", dest, target)
 
     # ------------------------------------------------------------------
     # replication: send path
@@ -753,29 +1018,46 @@ class MeshCache:
 
     def _broadcast(self, op: Oplog) -> None:
         """First transmission of a locally-originated oplog
-        (reference ``radix_mesh.py:325-347``)."""
+        (reference ``radix_mesh.py:325-347``). A leader-origin in hier
+        mode emits both scopes directly: its group never delivers the op
+        *to* it, so the group-lap bridge rule can't fire."""
         op.ts = time.time()
-        self._send_bytes(
-            serialize(op), control=op.op_type in self._CONTROL_TYPES
-        )
+        control = op.op_type in self._CONTROL_TYPES
+        data = serialize(op)
+        self._send_bytes(data, control=control)
+        if (
+            self.hier is not None
+            and self.role is not NodeRole.ROUTER
+            and self.hier.is_leader(self.rank, self._my_alive())
+        ):
+            self._bridge_to_spine(op, data, control=control)
+            if op.op_type is OplogType.TICK:
+                # A NON-leader origin's two-lap tick passes its leader —
+                # and bridges — twice; a leader-origin never receives its
+                # own tick, so emit the second spine copy here to deliver
+                # the same two ticks per origination to remote groups.
+                self._bridge_to_spine(op, data, control=control)
 
-    def _forward(self, op: Oplog) -> None:
-        """Ring-forward a received oplog with its decremented TTL."""
-        self._send_bytes(
-            serialize(op), control=op.op_type in self._CONTROL_TYPES
-        )
-
-    def _send_bytes(self, data: bytes, control: bool = False) -> None:
+    def _send_bytes(self, data: bytes, control: bool = False, dest: str = "ring") -> None:
         """Enqueue for transmission. Called under the lock by receive-path
         forwards and after local application by the public API — the data
         lane's FIFO makes wire order equal application order; control
-        frames take the priority lane (drained first by the sender)."""
+        frames take the priority lane (drained first by the sender).
+        The ring and spine channels have independent lanes + sender
+        threads so a leader's bridge traffic never queues behind its
+        group forwards (or vice versa)."""
         if not self._started or not self.sync.can_send(self.cfg):
             return
+        if dest == "spine":
+            q = self._spine_ctl_q if control else self._spine_out_q
+            evt = self._spine_evt
+        else:
+            q = self._ctl_q if control else self._out_q
+            evt = self._send_evt
         try:
-            (self._ctl_q if control else self._out_q).put_nowait(data)
+            q.put_nowait(data)
             self._m_sent.inc()
-            self._send_evt.set()
+            evt.set()
         except queue.Full:
             self._m_dropped.inc()
             dropped = int(self._m_dropped.value)
@@ -801,30 +1083,58 @@ class MeshCache:
         ``communicator.py:162-178``); established successors get
         ``failure_timeout_s`` before being declared dead and ringed around
         (``_declare_successor_dead``)."""
+        self._sender_loop("ring", self._ctl_q, self._out_q, self._send_evt)
+
+    def _spine_sender(self) -> None:
+        """The spine channel's dedicated transmit thread (hier leaders):
+        bridge traffic must not serialize behind group forwards — the
+        leader is exactly the node whose send bandwidth the hierarchy
+        hinges on."""
+        self._sender_loop("spine", self._spine_ctl_q, self._spine_out_q, self._spine_evt)
+
+    def _sender_loop(
+        self,
+        dest: str,
+        ctl_q: "queue.Queue[bytes]",
+        out_q: "queue.Queue[bytes]",
+        evt: threading.Event,
+    ) -> None:
         while not self._stop.is_set():
-            self._apply_pending_retarget()
+            self._apply_pending_retarget(dest)
             # Wait for ANY lane to fill; drain control first, then one
             # data frame per pass (so a control frame arriving mid-bulk
             # overtakes the rest of the backlog at the next pass).
             try:
-                data = self._ctl_q.get_nowait()
+                data = ctl_q.get_nowait()
             except queue.Empty:
                 try:
-                    data = self._out_q.get_nowait()
+                    data = out_q.get_nowait()
                 except queue.Empty:
-                    self._send_evt.wait(timeout=0.2)
-                    self._send_evt.clear()
+                    evt.wait(timeout=0.2)
+                    evt.clear()
                     continue
             while not self._stop.is_set():
-                with self._lock:
-                    has_succ = self._succ_rank is not None
-                if self._retarget_flag.is_set():
-                    self._apply_pending_retarget()
+                if self._retarget_flags[dest].is_set():
+                    self._apply_pending_retarget(dest)
                     continue
-                if not has_succ:
-                    break  # sole survivor: nothing to ring (fan-out below)
+                if dest == "spine":
+                    comm = self._spine_comm
+                    with self._lock:
+                        target = self._spine_rank
+                    if comm is None or target is None:
+                        # Demoted (or degenerate single group) since the
+                        # frame was queued — nothing to bridge to.
+                        break
+                    established = self._spine_established
+                else:
+                    comm = self._comm
+                    with self._lock:
+                        target = self._succ_rank
+                    if target is None:
+                        break  # sole survivor: nothing to ring (fan-out below)
+                    established = self._succ_established
                 try:
-                    if not self._succ_established:
+                    if not established:
                         # Never-seen-alive successors get startup-grace
                         # patience (cluster boot: the peer may still be
                         # binding, like the reference's connect-retry
@@ -832,21 +1142,27 @@ class MeshCache:
                         # restarts while its static successor is also dead
                         # must eventually ring around it or it can never
                         # deliver its JOIN.
-                        if self._comm.try_send(
-                            data, self.cfg.effective_startup_grace_s
-                        ):
-                            self._succ_established = self._comm.connected()
+                        if comm.try_send(data, self.cfg.effective_startup_grace_s):
+                            if dest == "spine":
+                                self._spine_established = comm.connected()
+                            else:
+                                self._succ_established = comm.connected()
                             break
-                    elif self._comm.try_send(data, self.cfg.failure_timeout_s):
+                    elif comm.try_send(data, self.cfg.failure_timeout_s):
                         break
                 except Exception:  # noqa: BLE001 — transport errors must not kill the sender
                     if not self._stop.is_set():
                         self.log.exception("failed to transmit oplog")
                     break
-                self._declare_successor_dead()
+                self._declare_successor_dead(dest)
             # The CURRENT view master fans out to routers (generalizes the
             # reference's static rank-0 fan-out, radix_mesh.py:344-347, so
-            # routers keep learning the tree after rank 0 dies).
+            # routers keep learning the tree after rank 0 dies). Ring
+            # frames only: every op the master transmits passes its ring
+            # channel at least once, so fanning spine copies too would
+            # just duplicate the router's stream.
+            if dest != "ring":
+                continue
             with self._lock:
                 is_master = self.rank == self.view.master_rank()
             if is_master:
@@ -1199,7 +1515,15 @@ class MeshCache:
 
     def run_gc_round(self) -> None:
         """Originate one GC_QUERY lap for locally-unlocked duplicates.
-        Public so tests (and operators) can trigger a round on demand."""
+        Public so tests (and operators) can trigger a round on demand.
+
+        Flat ring: unanimity is counted on the single frame as it laps.
+        Hier: the origin's group votes on the origin's own lap; every
+        remote group's leader returns its group's tally as a GC_VOTE
+        (see ``_gc_handle``); the origin folds tallies until every
+        nonempty group reported, then checks unanimity. Rounds that a
+        view change strands (a group died mid-poll) expire and re-run
+        on the next GC interval."""
         with self._lock:
             entries = [
                 GCEntry(
@@ -1213,15 +1537,41 @@ class MeshCache:
             if not entries:
                 return
             self._m_gc_rounds.inc()
+            logic_id = self._logic_op.next()
+            if self.hier is not None:
+                now = time.monotonic()
+                horizon = max(2.0 * self.cfg.gc_interval_s, 1.0)
+                self._gc_pending = {
+                    lid: r
+                    for lid, r in self._gc_pending.items()
+                    if now - r["created"] < horizon
+                }
+                round_ = {
+                    "entries": {
+                        NodeKey(e.key, e.value_rank): 0 for e in entries
+                    },
+                    "groups": set(),
+                    "expect": set(self.hier.nonempty_groups(self._my_alive())),
+                    "created": now,
+                }
+                self._gc_pending[logic_id] = round_
             self._broadcast(
                 Oplog(
                     op_type=OplogType.GC_QUERY,
                     origin_rank=self.rank,
-                    logic_id=self._logic_op.next(),
+                    logic_id=logic_id,
                     ttl=self._gc_ttl(),
                     gc=entries,
                 )
             )
+            if self.hier is not None and self._succ_rank is None:
+                # Sole member of my group: the group "lap" can't return —
+                # fold my own (already-counted) vote immediately.
+                g = self.hier.group_of(self.rank)
+                round_["groups"].add(g)
+                for e in entries:
+                    round_["entries"][NodeKey(e.key, e.value_rank)] += e.agree
+                self._maybe_finish_gc_round(logic_id)
 
     def _gc_agrees(self, key: np.ndarray) -> bool:
         """A node agrees to collect a duplicate iff the key's path is not
@@ -1234,40 +1584,150 @@ class MeshCache:
             node = node.parent
         return True
 
-    def _gc_handle(self, op: Oplog) -> None:
+    def _gc_handle(self, op: Oplog, data: bytes) -> None:
         """Caller holds the lock; op.ttl already decremented."""
+        if op.op_type is OplogType.GC_VOTE:
+            # A remote group's aggregated tally (hier only). Addressed by
+            # value_rank; everyone else just circulates it.
+            if op.value_rank == self.rank:
+                self._fold_gc_vote(op)
+                return
+            if op.origin_rank == self.rank:
+                return  # lap complete (our own vote came back around)
+            self._circulate(op, data)
+            return
         if op.op_type is OplogType.GC_QUERY:
             if op.origin_rank == self.rank:
-                # Query completed its lap: unanimity = every ring member
-                # agreed (radix_mesh.py:368-384).
+                if op.spine:
+                    # A leader-origin's ZEROED spine template completed its
+                    # spine lap — drop it. Folding it would burn the own-
+                    # group slot with zero votes before the real group lap
+                    # returns.
+                    return
+                if self.hier is not None:
+                    # Origin-group lap complete: fold this group's tally.
+                    round_ = self._gc_pending.get(op.logic_id)
+                    if round_ is not None:
+                        g = self.hier.group_of(self.rank)
+                        if g not in round_["groups"]:
+                            round_["groups"].add(g)
+                            for e in op.gc:
+                                nk = NodeKey(e.key, e.value_rank)
+                                if nk in round_["entries"]:
+                                    round_["entries"][nk] += e.agree
+                            self._maybe_finish_gc_round(op.logic_id)
+                    return
+                # Flat ring: the single lap IS the whole poll — unanimity
+                # = every ring member agreed (radix_mesh.py:368-384).
                 unanimous = [e for e in op.gc if e.agree >= self.view.ring_size]
                 if not unanimous:
                     return
-                for e in unanimous:
-                    self._gc_collect(e)
-                self._broadcast(
-                    Oplog(
-                        op_type=OplogType.GC_EXEC,
-                        origin_rank=self.rank,
-                        logic_id=self._logic_op.next(),
-                        ttl=self._gc_ttl(),
-                        gc=[GCEntry(e.key, e.value_rank, e.agree) for e in unanimous],
-                    )
-                )
+                self._gc_finish(unanimous)
                 return
-            for e in op.gc:
-                if self._gc_agrees(e.key):
-                    e.agree += 1
-            if op.ttl > 0:
-                self._forward(op)
+            if (
+                self.hier is not None
+                and not op.spine
+                and op.value_rank == self.rank
+            ):
+                # My INJECTED copy returned with my group's votes: report
+                # them (plus my own vote) to the query origin.
+                self._emit_gc_vote(op)
+                return
+            if not op.spine:
+                # Vote only on group-scope (or flat) frames: a spine frame
+                # is the zeroed TEMPLATE every remote group's injection is
+                # patched from — votes on it would be inherited by every
+                # group downstream and double-counted in their GC_VOTEs.
+                for e in op.gc:
+                    if self._gc_agrees(e.key):
+                        e.agree += 1
+                self._circulate(op, data, mutated=True)
+            else:
+                self._circulate(op, data)
             return
         # GC_EXEC: everyone retires the duplicate; the slot owner frees
         # (radix_mesh.py:363-366).
         if op.origin_rank != self.rank:
             for e in op.gc:
                 self._gc_collect(e)
-            if op.ttl > 0:
-                self._forward(op)
+            self._circulate(op, data)
+
+    def _gc_finish(self, unanimous: list[GCEntry]) -> None:
+        """Unanimity reached: collect locally and ring GC_EXEC. Caller
+        holds the lock."""
+        for e in unanimous:
+            self._gc_collect(e)
+        self._broadcast(
+            Oplog(
+                op_type=OplogType.GC_EXEC,
+                origin_rank=self.rank,
+                logic_id=self._logic_op.next(),
+                ttl=self._gc_ttl(),
+                gc=[GCEntry(e.key, e.value_rank, e.agree) for e in unanimous],
+            )
+        )
+
+    def _emit_gc_vote(self, op: Oplog) -> None:
+        """This group's aggregated GC_QUERY tally (injected-copy votes
+        plus this leader's own), addressed to the query origin. Caller
+        holds the lock; hier only."""
+        g = self.hier.group_of(self.rank)
+        self._broadcast(
+            Oplog(
+                op_type=OplogType.GC_VOTE,
+                origin_rank=self.rank,
+                logic_id=op.logic_id,  # the QUERY's id names the round
+                ttl=self._data_ttl(),
+                value=np.asarray([g], dtype=np.int32),
+                value_rank=op.origin_rank,  # addressee
+                gc=[
+                    GCEntry(
+                        e.key,
+                        e.value_rank,
+                        e.agree + (1 if self._gc_agrees(e.key) else 0),
+                    )
+                    for e in op.gc
+                ],
+            )
+        )
+
+    def _fold_gc_vote(self, op: Oplog) -> None:
+        """Fold a remote group's tally into the pending round (idempotent
+        per group — duplicate deliveries are expected). Caller holds the
+        lock; hier only."""
+        round_ = self._gc_pending.get(op.logic_id)
+        if round_ is None:
+            return  # expired / unknown round
+        g = int(op.value[0]) if len(op.value) else -1
+        if g in round_["groups"]:
+            return
+        round_["groups"].add(g)
+        for e in op.gc:
+            nk = NodeKey(e.key, e.value_rank)
+            if nk in round_["entries"]:
+                round_["entries"][nk] += e.agree
+        self._maybe_finish_gc_round(op.logic_id)
+
+    def _maybe_finish_gc_round(self, logic_id: int) -> None:
+        """Check a pending hier GC round for completion: every nonempty
+        group reported → unanimity check against the CURRENT alive count.
+        Caller holds the lock."""
+        round_ = self._gc_pending.get(logic_id)
+        if round_ is None or not round_["groups"] >= round_["expect"]:
+            return
+        del self._gc_pending[logic_id]
+        n_alive = max(1, len(self.view.alive))
+        unanimous = [
+            GCEntry(
+                key=np.asarray(nk.tokens, dtype=np.int32),
+                value_rank=nk.value_rank,
+                agree=votes,
+            )
+            for nk, votes in round_["entries"].items()
+            if votes >= n_alive
+        ]
+        if unanimous:
+            self._gc_finish(unanimous)
 
     def _gc_collect(self, e: GCEntry) -> None:
         nk = NodeKey(e.key, e.value_rank)

@@ -37,6 +37,7 @@ __all__ = [
     "serialize",
     "deserialize",
     "patched_ttl",
+    "patched_frame",
     "set_emit_version",
 ]
 
@@ -52,6 +53,11 @@ _HEADER_V3 = struct.Struct(
 # real pool size — serialize() checks and falls back to int32 per array).
 _FLAG_KEY_U24 = 1
 _FLAG_VALUE_U24 = 2
+# Hierarchical-topology scope (policy/hierarchy.py): set = the frame is
+# circulating on the leader SPINE; clear = on a group ring (or the flat
+# ring — flat mode never sets it). Pre-v3 peers cannot carry the bit, so
+# hier mode requires the v3 emit version (enforced by MeshCache).
+_FLAG_SPINE = 4
 _HEADER_V2 = struct.Struct(
     "<BBBxiqiid"
 )  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts
@@ -96,6 +102,12 @@ class OplogType(enum.IntEnum):
     # and dynamic add/remove as roadmap, README.md:49-50):
     TOPO = 6  # value = [epoch, *alive_ranks] — a membership view
     JOIN = 7  # origin_rank is (re)joining; view master answers with TOPO
+    # Hierarchical-GC extension (policy/hierarchy.py): a group leader's
+    # aggregated vote tally for a GC_QUERY round, addressed to the query
+    # origin (value_rank = query origin, logic_id = query logic id,
+    # value = [voting group index]). Circulates like data; consumed by
+    # the addressee, a no-op everywhere else.
+    GC_VOTE = 8
     TICK = 10
 
 
@@ -140,6 +152,9 @@ class Oplog:
     # per N tokens (receivers expand to slots ``page_id*N + 0..N-1`` —
     # the paged allocator guarantees within-page contiguity).
     page: int = 1
+    # Hierarchical scope: True while the frame rides the leader spine
+    # (policy/hierarchy.py). Always False in flat-ring mode.
+    spine: bool = False
 
     def __eq__(self, other) -> bool:
         return (
@@ -150,6 +165,7 @@ class Oplog:
             and self.ttl == other.ttl
             and self.value_rank == other.value_rank
             and self.page == other.page
+            and self.spine == other.spine
             and np.array_equal(self.key, other.key)
             and np.array_equal(self.value, other.value)
             and self.gc == other.gc
@@ -216,6 +232,10 @@ def serialize(op: Oplog) -> bytes:
         )
     if not 1 <= op.page <= 255:
         raise ValueError(f"oplog page {op.page} out of the wire's u8 range")
+    if op.spine and _emit_version < 3:
+        raise ValueError(
+            f"spine-scoped oplogs need wire v3; emit version is {_emit_version}"
+        )
     key_bytes, value_bytes = key.tobytes(), value.tobytes()
     if _emit_version == 1:
         header = _HEADER_V1.pack(
@@ -228,7 +248,7 @@ def serialize(op: Oplog) -> bytes:
             op.origin_rank, op.logic_id, op.ttl, op.value_rank, op.ts,
         )
     else:
-        flags = 0
+        flags = _FLAG_SPINE if op.spine else 0
         if _fits_u24(key):
             flags |= _FLAG_KEY_U24
             key_bytes = _pack_u24(key)
@@ -272,6 +292,38 @@ def patched_ttl(data: bytes, ttl: int) -> bytes:
         )
     buf = bytearray(data)
     struct.pack_into("<i", buf, _TTL_OFFSET, ttl)
+    return bytes(buf)
+
+
+# v3-only fixed offsets for the hierarchical-circulation patcher.
+_VALUE_RANK_OFFSET = struct.calcsize("<BBBxiqi")  # ..., ttl
+_FLAGS_OFFSET = struct.calcsize("<BBBxiqiidB")  # ..., ts, page
+
+
+def patched_frame(
+    data: bytes,
+    ttl: int | None = None,
+    spine: bool | None = None,
+    value_rank: int | None = None,
+) -> bytes:
+    """A wire frame with TTL and/or spine scope and/or value_rank
+    replaced in place — the hierarchical bridge/inject primitive
+    (re-scoping must not pay a full re-serialization of the payload).
+    Scope and value_rank patches require a v3 frame; callers fall back
+    to ``serialize`` for older frames (possible only mid-roll, since
+    hier mode itself requires the v3 emit version)."""
+    if (spine is not None or value_rank is not None) and data[1] != 3:
+        raise ValueError(f"scope/value_rank patch needs a v3 frame, got v{data[1]}")
+    if data[1] not in (1, 2, 3):
+        raise ValueError(f"patched_frame knows wire versions 1-3, got v{data[1]}")
+    buf = bytearray(data)
+    if ttl is not None:
+        struct.pack_into("<i", buf, _TTL_OFFSET, ttl)
+    if value_rank is not None:
+        struct.pack_into("<i", buf, _VALUE_RANK_OFFSET, value_rank)
+    if spine is not None:
+        flags = buf[_FLAGS_OFFSET]
+        buf[_FLAGS_OFFSET] = (flags | _FLAG_SPINE) if spine else (flags & ~_FLAG_SPINE)
     return bytes(buf)
 
 
@@ -328,4 +380,5 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
         gc=gc,
         ts=ts,
         page=page,
+        spine=bool(flags & _FLAG_SPINE),
     )

@@ -50,6 +50,12 @@ class MeshConfig:
     max_msg_bytes: int = DEFAULT_MAX_MSG_BYTES
     protocol: str = "tcp"  # "tcp" (C++ native) | "tcp-py" | "inproc"
     page_size: int = 1
+    # Replication topology: "ring" (the reference's flat ring) or "hier"
+    # (two-level groups + leader spine, policy/hierarchy.py — the
+    # reference's open roadmap item for >50-node meshes, README.md:57).
+    topology: str = "ring"
+    # Group size for topology="hier"; 0 = auto (~sqrt(ring size)).
+    group_size: int = 0
     # Cache sizing: number of KV slots (tokens) the paged pool holds.
     num_kv_slots: int = 65536
     # Replica-size bound (tokens) for the mesh tree. Serving inserts every
@@ -184,6 +190,18 @@ class MeshConfig:
             raise ValueError("at most one router node is supported")
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if self.topology not in ("ring", "hier"):
+            raise ValueError(
+                f"unknown topology {self.topology!r}; known: ring, hier"
+            )
+        if self.group_size < 0:
+            raise ValueError("group_size must be >= 0 (0 = auto)")
+        if self.topology == "ring" and self.group_size:
+            raise ValueError("group_size is only meaningful with topology: hier")
+        if self.topology == "hier" and self.group_size == 1:
+            # HierPlan requires >= 2; reject at config load like the other
+            # topology constraints, not later at MeshCache construction.
+            raise ValueError("group_size must be >= 2 (or 0 = auto) for hier")
         all_nodes = self.prefill_nodes + self.decode_nodes + self.router_nodes
         if len(set(all_nodes)) != len(all_nodes):
             raise ValueError("node addresses must be unique across roles")
@@ -227,6 +245,8 @@ def load_config(path: str) -> MeshConfig:
         "max_msg_bytes",
         "protocol",
         "page_size",
+        "topology",
+        "group_size",
         "num_kv_slots",
         "mesh_max_tokens",
         "gc_interval_s",
@@ -251,6 +271,8 @@ def load_config(path: str) -> MeshConfig:
         max_msg_bytes=int(raw.get("max_msg_bytes", DEFAULT_MAX_MSG_BYTES)),
         protocol=raw.get("protocol", "tcp"),
         page_size=int(raw.get("page_size", 1)),
+        topology=raw.get("topology", "ring"),
+        group_size=int(raw.get("group_size", 0)),
         num_kv_slots=int(raw.get("num_kv_slots", 65536)),
         mesh_max_tokens=int(raw.get("mesh_max_tokens", 1 << 20)),
         gc_interval_s=float(raw.get("gc_interval_s", 10.0)),

@@ -1,20 +1,24 @@
-"""Ring-scale simulation: how the oplog ring behaves as N grows.
+"""Ring-scale sweep: flat ring vs hierarchical (groups + spine) as N grows.
 
 The reference's open question (``/root/reference/README.md:57``: "better
-topo if nodes over some number (like 50?)") — VERDICT round-3 missing #4
-asked for numbers, even simulated. This drives LIVE in-process rings
-(real MeshCache nodes, real oplog serialization, inproc transport) at
-N ∈ {6, 12, 25, 50} and measures:
+topo if nodes over some number (like 50?)") — answered with a LIVE
+implementation (``policy/hierarchy.py``, ``topology: hier``) rather than
+analysis alone. This drives real MeshCache nodes over the threaded
+``tcp-py`` loopback transport (per-link sockets + per-connection reader
+threads, so group rings progress concurrently — the single-worker inproc
+hub would serialize exactly the parallelism the hierarchy exists to
+create) and measures, for each N and each topology:
 
-- **lap latency** p50/p99: one oplog's full circle back to its origin
-  (the replication-visible-everywhere bound) — O(N) hops by design;
-- **convergence time** for a fixed insert load from one writer;
-- **ring bytes per insert**: every frame is forwarded N-1 times, so
-  bytes scale O(N) per insert — at page granularity the per-hop frame is
-  ~2.4× smaller (see RINGBENCH_r04), which moves the wall, not the curve.
+- **propagation latency** p50/p99: one insert → visible on EVERY node
+  (the metric both topologies can be compared on; the flat ring's origin
+  lap ≈ propagation, the hierarchy's group lap is not);
+- **convergence time / throughput** for a flood of inserts from one
+  writer;
+- **ring bytes per insert** (total frames × frame size): the hierarchy
+  trades a slightly higher frame count (leaders see spine + group
+  copies) for an O(sqrt N) serial critical path.
 
-Writes ``RINGSCALE_r{N}.json``; the accompanying analysis (crossover
-where the flat ring should become a hierarchy) lives in
+Writes ``RINGSCALE_r{N}.json``; the crossover analysis lives in
 ARCHITECTURE.md §ring-scale.
 
 Usage: python scripts/ringscale.py [--sizes 6,12,25,50] [--inserts 40]
@@ -24,7 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import queue as queue_mod
+import socket
 import sys
 import time
 
@@ -38,18 +42,36 @@ KEY_LEN = 64
 PAGE = 16
 
 
-def run_ring(n_nodes: int, n_inserts: int, n_laps: int) -> dict:
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_ring(
+    n_nodes: int,
+    n_inserts: int,
+    n_probes: int,
+    topology: str,
+    hop_delay_ms: float = 0.0,
+) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     from radixmesh_tpu.cache.mesh_cache import MeshCache
     from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
-    from radixmesh_tpu.comm.inproc import InprocHub
     from radixmesh_tpu.config import MeshConfig
+    from radixmesh_tpu.policy.hierarchy import auto_group_size
 
-    InprocHub.reset_default()
-    prefill = [f"p{i}" for i in range(n_nodes)]
+    prefill = [f"127.0.0.1:{p}" for p in _free_ports(n_nodes)]
     nodes: list[MeshCache] = []
+    group_size = auto_group_size(n_nodes) if topology == "hier" else 0
     try:
         for addr in prefill:
             cfg = MeshConfig(
@@ -57,60 +79,79 @@ def run_ring(n_nodes: int, n_inserts: int, n_laps: int) -> dict:
                 decode_nodes=[],
                 router_nodes=[],
                 local_addr=addr,
-                protocol="inproc",
+                protocol="tcp-py",
+                topology=topology,
+                group_size=group_size,
                 tick_interval_s=5.0,
                 gc_interval_s=600.0,
-                failure_timeout_s=600.0,  # 4·N threads contend; no false deaths
+                failure_timeout_s=600.0,  # many threads contend; no false deaths
                 page_size=PAGE,
             )
-            nodes.append(MeshCache(cfg, pool=None))
+            node = MeshCache(cfg, pool=None)
+            if hop_delay_ms > 0:
+                # Emulate DCN store-and-forward wire latency: delay each
+                # link's delivery on its per-connection reader thread
+                # (sleeps release the GIL, so independent links — and
+                # therefore the hierarchy's concurrent group laps — truly
+                # overlap, which loopback's ~50 µs hops would mask).
+                def delayed(data, _n=node, _d=hop_delay_ms / 1e3):
+                    time.sleep(_d)
+                    return MeshCache.oplog_received(_n, data)
+
+                node.oplog_received = delayed
+            nodes.append(node)
         t0 = time.monotonic()
         for n in nodes:
             n.start()
         for n in nodes:
-            assert n.wait_ready(timeout=120), f"N={n_nodes}: startup barrier"
+            assert n.wait_ready(timeout=120), f"N={n_nodes}/{topology}: barrier"
         startup_s = time.monotonic() - t0
 
-        writer = nodes[0]
+        # Writer = the worst-placed node: the LAST member of group 0 in
+        # hier mode (its op must walk to the leader before the spine), a
+        # plain member in flat mode — same rank either way for fairness.
+        writer = nodes[min(group_size, n_nodes) - 1 if topology == "hier" else 0]
         rng = np.random.default_rng(7)
 
-        # Lap latency: paired by key like ringbench (stale completions
-        # from other phases discarded).
-        lapq: "queue_mod.Queue[tuple[float, tuple]]" = queue_mod.Queue()
-        writer.on_lap_complete = lambda op: lapq.put(
-            (time.monotonic(), tuple(int(x) for x in op.key[:4]))
-        )
-        laps: list[float] = []
-        for i in range(n_laps):
+        # Propagation latency: insert one key, spin until EVERY node
+        # holds it. Nodes are dropped from the poll set as they converge.
+        probes: list[float] = []
+        for i in range(n_probes):
             key = rng.integers(1, 50000, size=KEY_LEN).tolist()
             t = time.monotonic()
             writer.insert(key, np.arange(KEY_LEN, dtype=np.int32) + i * KEY_LEN)
-            want = tuple(key[:4])
-            deadline = time.monotonic() + 60
-            while True:
-                done_t, done_key = lapq.get(
-                    timeout=max(0.0, deadline - time.monotonic())
-                )
-                if done_key == want:
-                    laps.append(done_t - t)
+            waiting = [n for n in nodes if n is not writer]
+            deadline = t + 60
+            while waiting:
+                waiting = [
+                    n for n in waiting if n.match_prefix(key).length < KEY_LEN
+                ]
+                if not waiting:
                     break
-        writer.on_lap_complete = None
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"N={n_nodes}/{topology}: probe {i} never propagated"
+                    )
+                time.sleep(0.0002)
+            probes.append(time.monotonic() - t)
 
         # Convergence: one writer floods, clock stops when the LAST node
-        # holds the last key (FIFO per origin ⇒ holding the last ⇒ all).
+        # holds the last key (FIFO per path ⇒ holding the last ⇒ all).
         keys = rng.integers(1, 50000, size=(n_inserts, KEY_LEN))
         t0 = time.monotonic()
         for i, key in enumerate(keys):
             writer.insert(
                 key.tolist(),
-                np.arange(KEY_LEN, dtype=np.int32) + (n_laps + i) * KEY_LEN,
+                np.arange(KEY_LEN, dtype=np.int32) + (n_probes + i) * KEY_LEN,
             )
         last = keys[-1].tolist()
         deadline = time.monotonic() + 300
-        for node in nodes[1:]:
-            while node.match_prefix(last).length < KEY_LEN:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(f"N={n_nodes} never converged")
+        pending = [n for n in nodes if n is not writer]
+        while pending:
+            pending = [n for n in pending if n.match_prefix(last).length < KEY_LEN]
+            if pending and time.monotonic() > deadline:
+                raise TimeoutError(f"N={n_nodes}/{topology} never converged")
+            if pending:
                 time.sleep(0.005)
         converge_s = time.monotonic() - t0
 
@@ -120,19 +161,33 @@ def run_ring(n_nodes: int, n_inserts: int, n_laps: int) -> dict:
             value=np.arange(KEY_LEN // PAGE, dtype=np.int32), value_rank=0,
             page=PAGE,
         )))
-        a = np.asarray(laps)
+        # Frame count per insert: flat = N-1 forwards. Hier = group laps
+        # in every group + one spine lap (each group's injected copy dies
+        # at its injector, having covered that group).
+        if topology == "hier":
+            plan = nodes[0].hier
+            alive = range(n_nodes)
+            frames = sum(
+                len(plan.group_alive(g, alive))
+                for g in plan.nonempty_groups(alive)
+            ) + plan.spine_ttl(alive)
+        else:
+            frames = n_nodes - 1
+        a = np.asarray(probes)
         return {
             "n_nodes": n_nodes,
+            "topology": topology,
+            "hop_delay_ms": hop_delay_ms,
+            "group_size": group_size or None,
             "startup_s": round(startup_s, 2),
-            "lap_p50_ms": round(float(np.percentile(a, 50)) * 1e3, 2),
-            "lap_p99_ms": round(float(np.percentile(a, 99)) * 1e3, 2),
+            "prop_p50_ms": round(float(np.percentile(a, 50)) * 1e3, 2),
+            "prop_p99_ms": round(float(np.percentile(a, 99)) * 1e3, 2),
             "converge_s": round(converge_s, 3),
             "inserts": n_inserts,
             "inserts_per_s": round(n_inserts / converge_s, 1),
             "frame_bytes": frame,
-            # Every insert is forwarded N-1 times around the ring.
-            "ring_bytes_per_insert": frame * (n_nodes - 1),
-            "applies_per_insert": n_nodes - 1,
+            "frames_per_insert": frames,
+            "ring_bytes_per_insert": frame * frames,
         }
     finally:
         for n in nodes:
@@ -140,37 +195,56 @@ def run_ring(n_nodes: int, n_inserts: int, n_laps: int) -> dict:
                 n.close()
             except Exception:  # noqa: BLE001 — teardown must not mask results
                 pass
-        InprocHub.reset_default()
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes", default="6,12,25,50")
     ap.add_argument("--inserts", type=int, default=40)
-    ap.add_argument("--laps", type=int, default=30)
+    ap.add_argument("--probes", type=int, default=30)
+    ap.add_argument(
+        "--hop-delays", default="0,1",
+        help="comma-separated per-hop wire latencies (ms) to emulate; 0 = raw loopback",
+    )
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     sizes = [int(s) for s in args.sizes.split(",")]
+    delays = [float(d) for d in args.hop_delays.split(",")]
     results = []
-    for n in sizes:
-        r = run_ring(n, args.inserts, args.laps)
-        print(json.dumps(r), file=sys.stderr, flush=True)
-        results.append(r)
-    base = results[0]
+    for delay in delays:
+        for topology in ("ring", "hier"):
+            for n in sizes:
+                r = run_ring(n, args.inserts, args.probes, topology, delay)
+                print(json.dumps(r), file=sys.stderr, flush=True)
+                results.append(r)
+    ratios = {}
+    for delay in delays:
+        flat = {
+            r["n_nodes"]: r for r in results
+            if r["topology"] == "ring" and r["hop_delay_ms"] == delay
+        }
+        hier = {
+            r["n_nodes"]: r for r in results
+            if r["topology"] == "hier" and r["hop_delay_ms"] == delay
+        }
+        ratios[f"hop{delay:g}ms"] = {
+            f"N{n}": round(flat[n]["prop_p50_ms"] / hier[n]["prop_p50_ms"], 2)
+            for n in sizes
+            if n in hier
+        }
     report = {
         "metric": "ring_scale_sweep",
         "sizes": sizes,
+        "hop_delays_ms": delays,
         "results": results,
-        "lap_scaling": {
-            f"N{r['n_nodes']}_vs_N{base['n_nodes']}": round(
-                r["lap_p50_ms"] / base["lap_p50_ms"], 2
-            )
-            for r in results[1:]
-        },
+        "hier_vs_flat_prop_p50": ratios,
         "note": (
-            "lap latency and ring bytes both scale O(N) on the flat "
-            "ring; see ARCHITECTURE.md ring-scale section for the "
-            "hierarchy crossover analysis"
+            "flat-ring propagation scales O(N) serial hops; topology=hier "
+            "(policy/hierarchy.py) cuts the critical path to "
+            "O(group+spine). hop0 = raw loopback (per-hop software cost "
+            "dominates, GIL-serialized); hop1ms emulates DCN "
+            "store-and-forward latency, where the critical path is the "
+            "whole story — see ARCHITECTURE.md ring-scale"
         ),
     }
     line = json.dumps(report)

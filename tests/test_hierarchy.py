@@ -1,0 +1,455 @@
+"""Hierarchical (groups + leader spine) replication topology tests.
+
+The reference's open roadmap item — "better topo if nodes over some
+number (like 50?)" (``/root/reference/README.md:57``) — implemented in
+``policy/hierarchy.py`` + ``MeshCache._circulate``. These tests prove the
+same correctness properties the flat ring's suite proves (convergence,
+conflict resolution, router attribution, distributed GC, DELETE/RESET,
+elastic failover) hold when oplogs propagate group-lap → spine →
+injected group laps instead of one O(N) lap.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.kv_pool import PagedKVPool
+from radixmesh_tpu.cache.mesh_cache import MeshCache
+from radixmesh_tpu.cache.oplog import (
+    NodeKey,
+    Oplog,
+    OplogType,
+    deserialize,
+    patched_frame,
+    serialize,
+)
+from radixmesh_tpu.comm.inproc import InprocHub
+from radixmesh_tpu.config import MeshConfig, NodeRole
+from radixmesh_tpu.policy.hierarchy import HierPlan, auto_group_size
+
+
+def wait_for(pred, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    InprocHub.reset_default()
+    yield
+    InprocHub.reset_default()
+
+
+# ----------------------------------------------------------------------
+# pure partition math
+# ----------------------------------------------------------------------
+
+
+class TestHierPlan:
+    def test_static_partition(self):
+        p = HierPlan(ring_size=9, group_size=3)
+        assert p.n_static_groups == 3
+        assert [p.group_of(r) for r in range(9)] == [0, 0, 0, 1, 1, 1, 2, 2, 2]
+        assert list(p.group_ranks(2)) == [6, 7, 8]
+        assert p.same_group(3, 5) and not p.same_group(2, 3)
+
+    def test_ragged_tail_group(self):
+        p = HierPlan(ring_size=7, group_size=3)
+        assert p.n_static_groups == 3
+        assert list(p.group_ranks(2)) == [6]
+
+    def test_leaders_and_successors_full_view(self):
+        p = HierPlan(ring_size=9, group_size=3)
+        alive = range(9)
+        assert [p.leader_of(g, alive) for g in range(3)] == [0, 3, 6]
+        assert p.is_leader(0, alive) and not p.is_leader(1, alive)
+        assert p.group_successor(0, alive) == 1
+        assert p.group_successor(2, alive) == 0  # wraps within the group
+        assert p.group_successor(8, alive) == 6
+        assert p.spine_successor(0, alive) == 3
+        assert p.spine_successor(6, alive) == 0  # spine wraps over groups
+        assert p.group_ttl(4, alive) == 3
+        assert p.spine_ttl(alive) == 3
+
+    def test_holes_shrink_but_never_repartition(self):
+        p = HierPlan(ring_size=9, group_size=3)
+        alive = [0, 2, 4, 5, 8]  # 1,3,6,7 dead
+        # Leadership moves to the lowest ALIVE rank of the static group.
+        assert p.leader_of(0, alive) == 0
+        assert p.leader_of(1, alive) == 4
+        assert p.leader_of(2, alive) == 8
+        assert p.is_leader(4, alive) and not p.is_leader(5, alive)
+        assert p.group_successor(0, alive) == 2
+        assert p.group_successor(2, alive) == 0
+        assert p.group_successor(8, alive) is None  # alone in its group
+        assert p.spine_successor(8, alive) == 0
+        assert p.group_ttl(4, alive) == 2
+        assert p.spine_ttl(alive) == 3
+
+    def test_dead_group_skipped_on_spine(self):
+        p = HierPlan(ring_size=9, group_size=3)
+        alive = [0, 1, 2, 6, 7, 8]  # group 1 entirely dead
+        assert p.nonempty_groups(alive) == [0, 2]
+        assert p.spine_successor(0, alive) == 6
+        assert p.spine_successor(6, alive) == 0
+        assert p.spine_ttl(alive) == 2
+
+    def test_degenerate_single_group(self):
+        p = HierPlan(ring_size=4, group_size=8)
+        alive = range(4)
+        assert p.spine_successor(0, alive) is None
+        assert p.group_successor(1, alive) == 2
+
+    def test_auto_group_size(self):
+        assert auto_group_size(50) == 7
+        assert auto_group_size(9) == 3
+        assert auto_group_size(2) == 2  # floor at 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            HierPlan(ring_size=9, group_size=1)
+        p = HierPlan(ring_size=9, group_size=3)
+        with pytest.raises(ValueError):
+            p.group_of(9)
+
+
+# ----------------------------------------------------------------------
+# wire scope flag
+# ----------------------------------------------------------------------
+
+
+class TestSpineWire:
+    def test_spine_flag_round_trips(self):
+        op = Oplog(
+            op_type=OplogType.INSERT,
+            origin_rank=4,
+            logic_id=7,
+            ttl=3,
+            key=np.asarray([1, 2, 3], dtype=np.int32),
+            value=np.asarray([10, 11, 12], dtype=np.int32),
+            value_rank=4,
+            spine=True,
+        )
+        back = deserialize(serialize(op))
+        assert back.spine is True
+        assert back == op
+
+    def test_patched_frame_rescopes_in_place(self):
+        op = Oplog(
+            op_type=OplogType.INSERT,
+            origin_rank=2,
+            logic_id=5,
+            ttl=9,
+            key=np.asarray([5, 6], dtype=np.int32),
+            value=np.asarray([1, 2], dtype=np.int32),
+            value_rank=2,
+            spine=True,
+        )
+        data = serialize(op)
+        back = deserialize(patched_frame(data, ttl=4, spine=False, value_rank=8))
+        assert back.ttl == 4
+        assert back.spine is False
+        assert back.value_rank == 8
+        # Untouched fields (including u24-packed arrays) survive the patch.
+        np.testing.assert_array_equal(back.key, op.key)
+        np.testing.assert_array_equal(back.value, op.value)
+        assert back.logic_id == 5 and back.origin_rank == 2
+
+    def test_patched_frame_rejects_pre_v3_scope_patch(self):
+        from radixmesh_tpu.cache.oplog import set_emit_version
+
+        op = Oplog(op_type=OplogType.TICK, origin_rank=0, logic_id=1, ttl=2)
+        set_emit_version(2)
+        try:
+            data = serialize(op)
+        finally:
+            set_emit_version(3)
+        with pytest.raises(ValueError):
+            patched_frame(data, spine=True)
+        # TTL-only patches still work on old frames.
+        assert deserialize(patched_frame(data, ttl=1)).ttl == 1
+
+
+# ----------------------------------------------------------------------
+# live hier cluster
+# ----------------------------------------------------------------------
+
+
+class HierCluster:
+    """6 prefill + 3 decode ring members (3 groups of 3) + 1 router."""
+
+    def __init__(
+        self,
+        n_prefill=6,
+        n_decode=3,
+        group_size=3,
+        num_slots=256,
+        failure_timeout_s=10.0,
+    ):
+        prefill = [f"hp{i}" for i in range(n_prefill)]
+        decode = [f"hd{i}" for i in range(n_decode)]
+        router = ["hr0"]
+        self.nodes: list[MeshCache] = []
+        for addr in prefill + decode + router:
+            cfg = MeshConfig(
+                prefill_nodes=prefill,
+                decode_nodes=decode,
+                router_nodes=router,
+                local_addr=addr,
+                protocol="inproc",
+                topology="hier",
+                group_size=group_size,
+                tick_interval_s=0.05,
+                gc_interval_s=30.0,  # tests drive GC explicitly
+                failure_timeout_s=failure_timeout_s,
+                startup_grace_s=failure_timeout_s,
+            )
+            pool = (
+                None
+                if cfg.local_role is NodeRole.ROUTER
+                else PagedKVPool(
+                    num_slots=num_slots, num_layers=1, num_kv_heads=1, head_dim=2
+                )
+            )
+            self.nodes.append(MeshCache(cfg, pool=pool))
+        for n in self.nodes:
+            n.start()
+
+    @property
+    def ring_nodes(self):
+        return [n for n in self.nodes if n.role is not NodeRole.ROUTER]
+
+    @property
+    def router(self):
+        return next(n for n in self.nodes if n.role is NodeRole.ROUTER)
+
+    def node(self, rank):
+        return self.nodes[rank]
+
+    def wait_ready(self):
+        for n in self.nodes:
+            assert n.wait_ready(timeout=10), f"node {n.rank} never became ready"
+
+    def close(self):
+        for n in self.nodes:
+            n.close()
+
+
+@pytest.fixture
+def hier_cluster():
+    c = HierCluster()
+    c.wait_ready()
+    yield c
+    c.close()
+
+
+def insert_with_pool(node: MeshCache, key) -> np.ndarray:
+    slots = node.pool.alloc(len(key))
+    assert slots is not None
+    node.insert(key, slots)
+    return slots
+
+
+class TestHierStartup:
+    def test_all_nodes_ready_including_router(self, hier_cluster):
+        # wait_ready in the fixture is the real assertion; spot-check the
+        # plan wiring: 3 groups, leaders 0/3/6, spine targets set.
+        n0 = hier_cluster.node(0)
+        assert n0.hier is not None and n0._spine_rank == 3
+        assert hier_cluster.node(3)._spine_rank == 6
+        assert hier_cluster.node(6)._spine_rank == 0
+        assert hier_cluster.node(1)._spine_rank is None  # not a leader
+        assert hier_cluster.node(1)._succ_rank == 2
+        assert hier_cluster.node(2)._succ_rank == 0  # wraps within group
+
+
+class TestHierReplication:
+    @pytest.mark.parametrize("writer_rank", [0, 1, 4, 8])
+    def test_insert_reaches_every_group_and_the_router(
+        self, hier_cluster, writer_rank
+    ):
+        # Leader origins (0), plain members (1, 4), and the tail group's
+        # last member (8) must all reach all 9 ring nodes + the router.
+        key = [writer_rank + 1, 2, 3]
+        writer = hier_cluster.node(writer_rank)
+        insert_with_pool(writer, key)
+        for n in hier_cluster.ring_nodes:
+            assert wait_for(lambda n=n: n.match_prefix(key).length == 3), (
+                f"rank {n.rank} never converged (writer {writer_rank})"
+            )
+            assert all(v.rank == writer_rank for v in n.match_prefix(key).values)
+        route = None
+
+        def routed():
+            nonlocal route
+            route = hier_cluster.router.match_prefix(key)
+            want = writer_rank if writer_rank < 6 else -1
+            dwant = writer_rank if writer_rank >= 6 else -1
+            return route.prefill_rank == want and route.decode_rank == dwant
+
+        assert wait_for(routed), f"router never attributed: {route}"
+
+    def test_leaders_bridge_once_per_op(self, hier_cluster):
+        writer = hier_cluster.node(1)  # group 0, non-leader
+        before = hier_cluster.node(0).metrics.get("oplogs_sent", 0)
+        bridged0 = hier_cluster.node(0)._m_bridged.value
+        insert_with_pool(writer, [7, 7, 7])
+        assert wait_for(
+            lambda: hier_cluster.node(8).match_prefix([7, 7, 7]).length == 3
+        )
+        # Group 0's leader bridged exactly this one INSERT (ticks also
+        # bridge, so allow the heartbeat's contribution but require at
+        # least one new bridge).
+        assert hier_cluster.node(0)._m_bridged.value > bridged0
+        del before
+
+    def test_multi_writer_conflict_converges_to_lowest_rank_across_groups(
+        self, hier_cluster
+    ):
+        key = [5, 5, 5]
+        # Writers in three different groups race on the same key.
+        for rank in (7, 4, 0):
+            insert_with_pool(hier_cluster.node(rank), key)
+        for n in hier_cluster.ring_nodes:
+            assert wait_for(
+                lambda n=n: n.match_prefix(key).length == 3
+                and all(v.rank == 0 for v in n.match_prefix(key).values)
+            ), f"rank {n.rank} did not converge to rank 0's value"
+
+    def test_delete_and_reset_replicate(self, hier_cluster):
+        key = [6, 6, 6]
+        writer = hier_cluster.node(4)
+        insert_with_pool(writer, key)
+        for n in hier_cluster.ring_nodes:
+            assert wait_for(lambda n=n: n.match_prefix(key).length == 3)
+        assert writer.delete(key)
+        for n in hier_cluster.ring_nodes:
+            assert wait_for(lambda n=n: n.match_prefix(key).length == 0), (
+                f"rank {n.rank} kept the deleted key"
+            )
+        insert_with_pool(hier_cluster.node(2), [1, 2])
+        assert wait_for(
+            lambda: hier_cluster.node(8).match_prefix([1, 2]).length == 2
+        )
+        hier_cluster.node(2).reset_all()
+        for n in hier_cluster.ring_nodes:
+            assert wait_for(lambda n=n: n.match_prefix([1, 2]).length == 0)
+
+
+class TestHierGC:
+    def test_cross_group_gc_aggregates_votes_and_frees(self, hier_cluster):
+        key = [9, 8, 7]
+        winner = hier_cluster.node(0)  # group 0
+        loser = hier_cluster.node(5)  # group 1
+        insert_with_pool(winner, key)
+        loser_slots = insert_with_pool(loser, key)
+        nk = NodeKey(key, loser.rank)
+        assert wait_for(
+            lambda: all(nk in n.dup_nodes for n in hier_cluster.ring_nodes)
+        ), "duplicate never recorded everywhere"
+        free_before = loser.pool.free_slots
+        loser.run_gc_round()
+        assert wait_for(
+            lambda: loser.pool.free_slots == free_before + len(key), timeout=15
+        ), "loser's duplicate slots never freed (vote aggregation broke?)"
+        assert wait_for(
+            lambda: all(nk not in n.dup_nodes for n in hier_cluster.ring_nodes)
+        ), "GC_EXEC did not retire the duplicate everywhere"
+        assert all(v.rank == 0 for v in loser.match_prefix(key).values)
+        del loser_slots
+
+    def test_gc_refused_while_a_remote_group_holds_a_lock(self, hier_cluster):
+        key = [4, 4, 4]
+        winner, loser = hier_cluster.node(0), hier_cluster.node(3)
+        insert_with_pool(winner, key)
+        insert_with_pool(loser, key)
+        nk = NodeKey(key, loser.rank)
+        assert wait_for(
+            lambda: all(nk in n.dup_nodes for n in hier_cluster.ring_nodes)
+        )
+        # A reader in a THIRD group locks the path: its group's tally must
+        # come back short and block unanimity.
+        reader = hier_cluster.node(7)
+        res = reader.match_prefix(key)
+        reader.inc_lock_ref(res.last_node)
+        free_before = loser.pool.free_slots
+        loser.run_gc_round()
+        time.sleep(1.0)
+        assert loser.pool.free_slots == free_before, "GC freed despite a lock"
+        assert nk in loser.dup_nodes
+        reader.dec_lock_ref(res.last_node)
+        loser.run_gc_round()
+        assert wait_for(
+            lambda: loser.pool.free_slots == free_before + len(key), timeout=15
+        )
+
+
+class TestHierFailover:
+    def test_leader_death_promotes_and_replication_continues(self):
+        c = HierCluster(failure_timeout_s=0.6)
+        try:
+            c.wait_ready()
+            # Kill group 1's leader (rank 3) like a crash.
+            c.node(3).close()
+            survivors = [n for n in c.ring_nodes if n.rank != 3]
+            assert wait_for(
+                lambda: all(not n.view.contains(3) for n in survivors), timeout=20
+            ), "rank 3 never declared dead everywhere"
+            # Rank 4 is group 1's new leader and must bridge.
+            assert wait_for(lambda: c.node(4)._spine_rank == 6, timeout=10)
+            # Writes from the shrunken group still reach the other groups…
+            insert_with_pool(c.node(4), [3, 1, 4])
+            for n in survivors:
+                assert wait_for(lambda n=n: n.match_prefix([3, 1, 4]).length == 3), (
+                    f"rank {n.rank} missed the post-failover insert"
+                )
+            # …and writes from other groups still reach the shrunken group.
+            insert_with_pool(c.node(8), [2, 7, 1])
+            assert wait_for(lambda: c.node(5).match_prefix([2, 7, 1]).length == 3)
+        finally:
+            c.close()
+
+    def test_whole_group_death_is_skipped_on_the_spine(self):
+        c = HierCluster(failure_timeout_s=0.6)
+        try:
+            c.wait_ready()
+            for r in (3, 4, 5):  # kill all of group 1
+                c.node(r).close()
+            survivors = [n for n in c.ring_nodes if n.rank not in (3, 4, 5)]
+            assert wait_for(
+                lambda: all(
+                    not any(n.view.contains(d) for d in (3, 4, 5)) for n in survivors
+                ),
+                timeout=25,
+            ), "group 1 never fully declared dead"
+            assert wait_for(lambda: c.node(0)._spine_rank == 6, timeout=10), (
+                "spine did not skip the dead group"
+            )
+            insert_with_pool(c.node(1), [8, 8, 8])
+            for n in survivors:
+                assert wait_for(lambda n=n: n.match_prefix([8, 8, 8]).length == 3)
+        finally:
+            c.close()
+
+
+class TestHierConfig:
+    def test_ring_mode_rejects_group_size(self):
+        with pytest.raises(ValueError, match="group_size"):
+            MeshConfig(
+                prefill_nodes=["a"], local_addr="a", group_size=4
+            ).validate()
+
+    def test_auto_group_size_applied(self):
+        cfg = MeshConfig(
+            prefill_nodes=[f"n{i}" for i in range(9)],
+            local_addr="n0",
+            protocol="inproc",
+            topology="hier",
+        )
+        m = MeshCache(cfg)
+        assert m.hier is not None and m.hier.group_size == 3
