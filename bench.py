@@ -919,7 +919,12 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
             "wide": dict(n_conversations=48, n_turns=2, system_len=128,
                          user_len=192, gen_len=32),
         }
-        eng_slots, max_batch = 131072, 16
+        # 64k slots (4.3 GB bf16 pool), not more: the axon tunnel's AOT
+        # compile path drops donation/aliasing hints, so every pool
+        # scatter is budgeted at 2x pool bytes — 128k slots OOMs a 16 GB
+        # v5e chip ("Used 16.03G of 15.75G hbm") even though the runtime
+        # path would alias in place.
+        eng_slots, max_batch = 65536, 16
     else:
         shapes = {
             "base": dict(n_conversations=24, n_turns=4, system_len=32,

@@ -83,6 +83,91 @@ class _BlockCopy:
             c.wait()
 
 
+def _rpp(page: int) -> int:
+    """Pages per 128-slot scale row (quantized kernels require the page
+    size to divide 128 so scale rows tile exactly)."""
+    if 128 % page:
+        raise ValueError(
+            f"int8 paged kernels need a page_size dividing 128, got {page}"
+        )
+    return 128 // page
+
+
+def _scale_rows(kv_scales: jnp.ndarray) -> jnp.ndarray:
+    """Per-token scales ``[2, L, Hkv, P, page]`` → rows of 128 consecutive
+    SLOTS ``[2, L, Hkv, R, 128]`` (a pure reshape when the slot count is a
+    multiple of 128, else a zero pad).
+
+    Real-Mosaic constraint, found the first time the int8 kernels met a
+    chip: HBM DMA slices must move whole 128-lane rows — the paged
+    ``[..., page]`` view's 16-wide minor dim is tiling-misaligned and
+    un-DMA-able ("Slice shape along dimension 4 must be aligned to tiling
+    (128)"), and a ``(ppb, page) → (bk,)`` staging reshape inside the
+    kernel is an unsupported lane-expanding shape cast. Interpret mode
+    and StableHLO-level AOT lowering both accept either, which is why
+    only on-chip compilation could surface this."""
+    two, L, Hkv = kv_scales.shape[:3]
+    flat = kv_scales.reshape(two, L, Hkv, -1)
+    S = flat.shape[-1]
+    R = -(-S // 128)
+    if R * 128 != S:
+        flat = jnp.pad(flat, ((0, 0), (0, 0), (0, 0), (0, R * 128 - S)))
+    return flat.reshape(two, L, Hkv, R, 128)
+
+
+class _ScaleCopy:
+    """Async HBM→VMEM fetch of the 128-slot scale ROW containing one
+    page's per-token scales (see ``_scale_rows``). Page ``i`` of a block
+    stages its whole row; ``_lane_scales`` then compacts the staged rows
+    into the ``(1, bk)`` per-token lane vector with dynamic lane
+    rotations — every transfer and vector op stays 128-lane-aligned."""
+
+    def __init__(self, scale_rows, which, layer, head, buf, sem,
+                 page_table_ref, flat_offset, n_pages, page):
+        src = scale_rows.at[which, layer, head]
+        rpp = 128 // page
+        self._copies = [
+            pltpu.make_async_copy(
+                src.at[pl.ds(page_table_ref[flat_offset + i] // rpp, 1)],
+                buf.at[pl.ds(i, 1)],
+                sem,
+            )
+            for i in range(n_pages)
+        ]
+
+    def start(self):
+        for c in self._copies:
+            c.start()
+
+    def wait(self):
+        for c in self._copies:
+            c.wait()
+
+
+def _lane_scales(rows, page_table_ref, off, page: int, ppb: int):
+    """``(1, ppb·page)`` per-token scale lane vector from the staged
+    128-slot rows (one per block page, ``_ScaleCopy``). All vector ops
+    are ``(1, 128)``-shaped: row extraction is a static sublane slice,
+    placement is a dynamic lane rotation + iota select — Mosaic has no
+    lane-granular slicing, no lane-expanding reshape, and rejects 1-D
+    dynamic rotates, so this is the shape everything must stay in."""
+    rpp = 128 // page
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+    chunks = []
+    for c in range(ppb // rpp):
+        acc = jnp.zeros((1, 128), jnp.float32)
+        for j in range(rpp):
+            i = c * rpp + j
+            pid = page_table_ref[off + i]
+            src_off = jax.lax.rem(pid, rpp) * page
+            dst = j * page
+            r = jax.lax.slice_in_dim(rows, i, i + 1, axis=0)  # (1, 128)
+            r = pltpu.roll(r, jnp.mod(dst - src_off, 128), 1)
+            acc = jnp.where((lane >= dst) & (lane < dst + page), r, acc)
+        chunks.append(acc)
+    return chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks, axis=1)
+
+
 def _run_block_loop(
     *,
     b,
@@ -107,8 +192,8 @@ def _run_block_loop(
     batch_size: int,
     num_kv_heads: int,
     min_length: int,  # lengths_ref value below which a row has no HBM work
-    scales_hbm=None,  # ANY [2, L, Hkv, P, page] — int8-pool scales
-    ks_buf=None,  # VMEM [2, ppb, page] f32
+    scales_hbm=None,  # ANY [2, L, Hkv, R, 128] — int8 scale ROWS (_scale_rows)
+    ks_buf=None,  # VMEM [2, ppb, 128] f32 staged rows (see _ScaleCopy)
     vs_buf=None,
     s_sems=None,  # DMA [2, 2]
 ):
@@ -133,14 +218,14 @@ def _run_block_loop(
         ]
         if quantized:
             copies.append(
-                _BlockCopy(scales_hbm, 0, layer, hh, ks_buf.at[slot],
+                _ScaleCopy(scales_hbm, 0, layer, hh, ks_buf.at[slot],
                            s_sems.at[slot, 0], page_table_ref, off,
-                           pages_per_block)
+                           pages_per_block, page)
             )
             copies.append(
-                _BlockCopy(scales_hbm, 1, layer, hh, vs_buf.at[slot],
+                _ScaleCopy(scales_hbm, 1, layer, hh, vs_buf.at[slot],
                            s_sems.at[slot, 1], page_table_ref, off,
-                           pages_per_block)
+                           pages_per_block, page)
             )
         return copies
 
@@ -205,7 +290,10 @@ def _run_block_loop(
             preferred_element_type=jnp.float32,
         )
         if quantized:
-            s = s * ks_buf[slot].reshape(bk)[None, :]
+            soff = b * pages_per_seq + i * pages_per_block
+            s = s * _lane_scales(
+                ks_buf[slot], page_table_ref, soff, page, pages_per_block
+            )
         pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(pos < hbm_len, s, _MASK)
 
@@ -221,7 +309,9 @@ def _run_block_loop(
         cs[1].wait()
         if quantized:
             cs[3].wait()
-            p = p * vs_buf[slot].reshape(bk)[None, :]
+            p = p * _lane_scales(
+                vs_buf[slot], page_table_ref, soff, page, pages_per_block
+            )
         v = v_buf[slot].astype(jnp.float32).reshape(bk, -1)  # [bk, D]
         pv = jax.lax.dot_general(  # [G, D]
             p, v,
@@ -343,8 +433,13 @@ def _fused_kernel(
     wk = pltpu.make_async_copy(row_scr.at[0], page_window(0), w_sem)
     wv = pltpu.make_async_copy(row_scr.at[1], page_window(1), w_sem)
     if quantized:
+        # Scale pool rides in the _scale_rows layout: RMW the (1, 128)
+        # row of 128 consecutive slots containing this token's slot.
+        srow = slot // 128
+        s_off = jax.lax.rem(slot, 128)
+
         def scale_window(which):
-            return scales_out.at[which, layer, h, pg]  # [page] row
+            return scales_out.at[which, layer, h, pl.ds(srow, 1)]  # (1, 128)
 
         # Own semaphore: these RMWs overlap the (much larger) wk/wv page
         # writes, and a shared semaphore would let a page write's
@@ -395,7 +490,10 @@ def _fused_kernel(
             rvs.start()
             rks.wait()
             rvs.wait()
-            smask = jax.lax.broadcasted_iota(jnp.int32, srow_scr.shape[1:], 0) == off
+            smask = (
+                jax.lax.broadcasted_iota(jnp.int32, srow_scr.shape[1:], 1)
+                == s_off
+            )
             srow_scr[0] = jnp.where(smask, k_sc, srow_scr[0])
             srow_scr[1] = jnp.where(smask, v_sc, srow_scr[1])
             wks.start()
@@ -437,14 +535,20 @@ def _fused_kernel(
             wvs.wait()
 
 
-def _block_geometry(page_table, page: int, pages_per_block: int | None):
-    """(padded page table, ppb): pad max_pages up to a block multiple."""
+def _block_geometry(page_table, page: int, pages_per_block: int | None,
+                    multiple: int = 1):
+    """(padded page table, ppb): pad max_pages up to a block multiple.
+    ``multiple`` rounds ppb up so a block is a whole number of scale
+    rows (quantized kernels pass ``_rpp(page)``; the pad entries index
+    page 0, whose reads are masked by the length bound like every other
+    table pad)."""
     max_pages = page_table.shape[1]
     if pages_per_block is None:
         # ~256 tokens per compute block: large enough to amortize per-block
         # overhead, small enough that double-buffered K+V fits VMEM easily.
         pages_per_block = max(1, min(max_pages, -(-256 // page)))
     ppb = min(pages_per_block, max_pages)
+    ppb = -(-ppb // multiple) * multiple
     blocks = -(-max_pages // ppb)
     padded = blocks * ppb
     if padded != max_pages:
@@ -477,7 +581,10 @@ def paged_attention_pool_kernel(
         raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
     G = Hq // Hkv
     quantized = kv_scales is not None
-    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+    page_table, ppb, padded = _block_geometry(
+        page_table, page, pages_per_block,
+        multiple=_rpp(page) if quantized else 1,
+    )
 
     scale = 1.0 / (D ** 0.5)
     # [B, Hq, 1, D] + a [G, D] f32 block: hints a <1x128>-friendly layout
@@ -505,8 +612,8 @@ def paged_attention_pool_kernel(
     if quantized:
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         scratch += [
-            pltpu.VMEM((2, ppb, page), jnp.float32),
-            pltpu.VMEM((2, ppb, page), jnp.float32),
+            pltpu.VMEM((2, ppb, 128), jnp.float32),
+            pltpu.VMEM((2, ppb, 128), jnp.float32),
         ]
     scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
     if quantized:
@@ -528,7 +635,7 @@ def paged_attention_pool_kernel(
         kv_pages,
     ]
     if quantized:
-        args.append(kv_scales)
+        args.append(_scale_rows(kv_scales))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -567,7 +674,11 @@ def paged_decode_fused_kernel(
         raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
     G = Hq // Hkv
     quantized = kv_scales is not None
-    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+    page_table, ppb, padded = _block_geometry(
+        page_table, page, pages_per_block,
+        multiple=_rpp(page) if quantized else 1,
+    )
+    scale_rows = _scale_rows(kv_scales) if quantized else None
 
     scale = 1.0 / (D ** 0.5)
     q4 = (q.astype(jnp.float32) * scale).reshape(B, Hq, 1, D)
@@ -601,7 +712,9 @@ def paged_decode_fused_kernel(
     if quantized:
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-        out_shape.append(jax.ShapeDtypeStruct(kv_scales.shape, kv_scales.dtype))
+        out_shape.append(
+            jax.ShapeDtypeStruct(scale_rows.shape, scale_rows.dtype)
+        )
         aliases[10] = 1
     out_specs.append(q_spec)
     out_shape.append(jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32))
@@ -615,12 +728,14 @@ def paged_decode_fused_kernel(
     ]
     if quantized:
         scratch += [
-            pltpu.VMEM((2, ppb, page), jnp.float32),
-            pltpu.VMEM((2, ppb, page), jnp.float32),
+            pltpu.VMEM((2, ppb, 128), jnp.float32),
+            pltpu.VMEM((2, ppb, 128), jnp.float32),
         ]
     scratch.append(pltpu.VMEM((2, page, D), kv_pages.dtype))
     if quantized:
-        scratch.append(pltpu.VMEM((2, page), jnp.float32))
+        # Staging for the current token's scale-row RMW: (1, 128) rows
+        # of the _scale_rows layout.
+        scratch.append(pltpu.VMEM((2, 1, 128), jnp.float32))
     scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
     if quantized:
         scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
@@ -648,7 +763,7 @@ def paged_decode_fused_kernel(
         kv_pages,
     ]
     if quantized:
-        args.append(kv_scales)
+        args.append(scale_rows)
     res = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -660,8 +775,19 @@ def paged_decode_fused_kernel(
         interpret=interpret,
     )(*args)
     if quantized:
-        kv_out, scales_out, out = res
-        return out.reshape(B, Hq, D).astype(q.dtype), kv_out, scales_out
+        kv_out, scale_rows_out, out = res
+        # Rows → the caller's paged view. When the slot count is a
+        # multiple of 128 (every real pool) this is a pure reshape and
+        # the in-place aliasing chain stays copy-free.
+        S = kv_scales.shape[3] * kv_scales.shape[4]
+        scales_out = scale_rows_out.reshape(*kv_scales.shape[:3], -1)
+        if scales_out.shape[-1] != S:
+            scales_out = scales_out[..., :S]
+        return (
+            out.reshape(B, Hq, D).astype(q.dtype),
+            kv_out,
+            scales_out.reshape(kv_scales.shape),
+        )
     kv_out, out = res
     return out.reshape(B, Hq, D).astype(q.dtype), kv_out
 
@@ -713,14 +839,14 @@ def _chunk_kernel(
         ]
         if quantized:
             copies.append(
-                _BlockCopy(scales_hbm, 0, layer, h, ks_buf.at[slot],
+                _ScaleCopy(scales_hbm, 0, layer, h, ks_buf.at[slot],
                            s_sems.at[slot, 0], page_table_ref, off,
-                           pages_per_block)
+                           pages_per_block, page)
             )
             copies.append(
-                _BlockCopy(scales_hbm, 1, layer, h, vs_buf.at[slot],
+                _ScaleCopy(scales_hbm, 1, layer, h, vs_buf.at[slot],
                            s_sems.at[slot, 1], page_table_ref, off,
-                           pages_per_block)
+                           pages_per_block, page)
             )
         return copies
 
@@ -754,7 +880,10 @@ def _chunk_kernel(
             preferred_element_type=jnp.float32,
         )
         if quantized:
-            s = s * ks_buf[slot].reshape(bk)[None, :]
+            soff = b * pages_per_seq + i * pages_per_block
+            s = s * _lane_scales(
+                ks_buf[slot], page_table_ref, soff, page, pages_per_block
+            )
         kv_pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         # Canonical query positions sit at/after ``prior``, so the page
         # part needs only the prior bound (strictly causal already).
@@ -771,7 +900,9 @@ def _chunk_kernel(
         cs[1].wait()
         if quantized:
             cs[3].wait()
-            p = p * vs_buf[slot].reshape(bk)[None, :]
+            p = p * _lane_scales(
+                vs_buf[slot], page_table_ref, soff, page, pages_per_block
+            )
         v = v_buf[slot].astype(jnp.float32).reshape(bk, -1)
         pv = jax.lax.dot_general(
             p, v,
@@ -866,7 +997,10 @@ def paged_chunk_attention_kernel(
         raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
     G = Hq // Hkv
     quantized = kv_scales is not None
-    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+    page_table, ppb, padded = _block_geometry(
+        page_table, page, pages_per_block,
+        multiple=_rpp(page) if quantized else 1,
+    )
     cblk = q_block if q_block is not None else _chunk_block(C, G)
     if C % cblk:
         raise ValueError(f"q_block={cblk} must divide chunk C={C}")
@@ -908,8 +1042,8 @@ def paged_chunk_attention_kernel(
     ]
     if quantized:
         scratch += [
-            pltpu.VMEM((2, ppb, page), jnp.float32),
-            pltpu.VMEM((2, ppb, page), jnp.float32),
+            pltpu.VMEM((2, ppb, 128), jnp.float32),
+            pltpu.VMEM((2, ppb, 128), jnp.float32),
         ]
     scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
     if quantized:
@@ -933,7 +1067,7 @@ def paged_chunk_attention_kernel(
         kv_pages,
     ]
     if quantized:
-        args.append(kv_scales)
+        args.append(_scale_rows(kv_scales))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
