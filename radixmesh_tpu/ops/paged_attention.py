@@ -383,9 +383,10 @@ def _fused_kernel(
     layer_ref,  # SMEM [1]
     buffer_index_ref,  # SMEM [1]
     init_flag_ref,  # SMEM [1]
-    # then: inputs (q, k_new, v_new, kv_hbm[, scales_hbm]), outputs
-    # (kv_out[, scales_out], o_ref) and scratch — unpacked by flag like
-    # ``_kernel``.
+    # then (quantized only): ksc_ref/vsc_ref — SMEM [B * Hkv] f32
+    # per-(row, head) scales of the incoming token; then inputs
+    # (q, k_new, v_new, kv_hbm[, scales_hbm]), outputs (kv_out, o_ref)
+    # and scratch — unpacked by flag like ``_kernel``.
     *refs,
     page: int,
     pages_per_block: int,
@@ -398,20 +399,25 @@ def _fused_kernel(
     (replacing the XLA scatter — the pool is aliased through the call, so
     the scan carry never copies) and attend over all ``length`` tokens,
     the current one folded in from VMEM (see module docstring). Quantized
-    pools quantize the incoming row IN-KERNEL (identically to
-    ``ops/quant.py``: symmetric amax/127 over head_dim, round-to-even)
-    and fold the current token DEQUANTIZED, so the attention output
-    matches exactly what any later read of the pool will see."""
+    pools receive the row ALREADY quantized (the wrapper runs the same
+    ``ops/quant.py`` quantizer) plus its per-(b, h) scale via scalar
+    prefetch; the current token is folded in DEQUANTIZED, so the
+    attention output matches exactly what any later read of the pool
+    will see. The scale POOL is updated by the wrapper with one XLA
+    scatter — an in-kernel scale-row RMW costs four extra serialized
+    DMAs per program, which measured out to a 1.75x slowdown of the
+    whole fused step on chip."""
     if quantized:
-        (q_ref, k_new_ref, v_new_ref, kv_hbm, scales_hbm,
-         kv_out, scales_out, o_ref,
+        (ksc_ref, vsc_ref,
+         q_ref, k_new_ref, v_new_ref, kv_hbm, scales_hbm,
+         kv_out, o_ref,
          m_scr, l_scr, acc_scr, k_buf, v_buf, ks_buf, vs_buf,
-         row_scr, srow_scr, sems, s_sems, w_sem, sw_sem) = refs
+         row_scr, sems, s_sems, w_sem) = refs
     else:
         (q_ref, k_new_ref, v_new_ref, kv_hbm,
          kv_out, o_ref,
          m_scr, l_scr, acc_scr, k_buf, v_buf, row_scr, sems, w_sem) = refs
-        scales_hbm = scales_out = ks_buf = vs_buf = srow_scr = s_sems = None
+        scales_hbm = ks_buf = vs_buf = s_sems = None
     b, h = pl.program_id(0), pl.program_id(1)
     layer = layer_ref[0]
     length = lengths_ref[b]
@@ -432,39 +438,14 @@ def _fused_kernel(
     rv = pltpu.make_async_copy(page_window(1), row_scr.at[1], w_sem)
     wk = pltpu.make_async_copy(row_scr.at[0], page_window(0), w_sem)
     wv = pltpu.make_async_copy(row_scr.at[1], page_window(1), w_sem)
-    if quantized:
-        # Scale pool rides in the _scale_rows layout: RMW the (1, 128)
-        # row of 128 consecutive slots containing this token's slot.
-        srow = slot // 128
-        s_off = jax.lax.rem(slot, 128)
 
-        def scale_window(which):
-            return scales_out.at[which, layer, h, pl.ds(srow, 1)]  # (1, 128)
-
-        # Own semaphore: these RMWs overlap the (much larger) wk/wv page
-        # writes, and a shared semaphore would let a page write's
-        # completion satisfy a scale read's wait before the scale row has
-        # actually landed (a hardware-only race — interpret mode runs
-        # copies synchronously).
-        rks = pltpu.make_async_copy(scale_window(0), srow_scr.at[0], sw_sem)
-        rvs = pltpu.make_async_copy(scale_window(1), srow_scr.at[1], sw_sem)
-        wks = pltpu.make_async_copy(srow_scr.at[0], scale_window(0), sw_sem)
-        wvs = pltpu.make_async_copy(srow_scr.at[1], scale_window(1), sw_sem)
-
-    # Current token, possibly quantize→dequantize so attention sees the
-    # pool's eventual contents bit-exactly.
+    # Current token, dequantized where the pool is int8 so attention sees
+    # the pool's eventual contents bit-exactly.
     k_cur = k_new_ref[...].astype(jnp.float32)  # [1, D]
     v_cur = v_new_ref[...].astype(jnp.float32)
     if quantized:
-        from radixmesh_tpu.ops.quant import quantize_kv
-
-        # The SAME quantizer the pool's host write path uses — attention
-        # must see the pool's eventual contents bit-exactly.
-        k_q, k_sc = quantize_kv(k_cur, axis=-1)  # int8 [1, D], f32 [1]
-        v_q, v_sc = quantize_kv(v_cur, axis=-1)
-        k_sc, v_sc = k_sc[0], v_sc[0]
-        k_cur = k_q.astype(jnp.float32) * k_sc
-        v_cur = v_q.astype(jnp.float32) * v_sc
+        k_cur = k_cur * ksc_ref[b * num_kv_heads + h]
+        v_cur = v_cur * vsc_ref[b * num_kv_heads + h]
 
     o_ref[...] = jnp.zeros_like(o_ref)  # deterministic for length==0 rows
 
@@ -475,29 +456,12 @@ def _fused_kernel(
         rk.wait()
         rv.wait()
         mask = jax.lax.broadcasted_iota(jnp.int32, row_scr.shape[1:], 0) == off
-        if quantized:
-            new_k_row = jnp.broadcast_to(k_q, row_scr.shape[1:])
-            new_v_row = jnp.broadcast_to(v_q, row_scr.shape[1:])
-        else:
-            new_k_row = jnp.broadcast_to(k_new_ref[...], row_scr.shape[1:])
-            new_v_row = jnp.broadcast_to(v_new_ref[...], row_scr.shape[1:])
+        new_k_row = jnp.broadcast_to(k_new_ref[...], row_scr.shape[1:])
+        new_v_row = jnp.broadcast_to(v_new_ref[...], row_scr.shape[1:])
         row_scr[0] = jnp.where(mask, new_k_row, row_scr[0])
         row_scr[1] = jnp.where(mask, new_v_row, row_scr[1])
         wk.start()
         wv.start()
-        if quantized:
-            rks.start()
-            rvs.start()
-            rks.wait()
-            rvs.wait()
-            smask = (
-                jax.lax.broadcasted_iota(jnp.int32, srow_scr.shape[1:], 1)
-                == s_off
-            )
-            srow_scr[0] = jnp.where(smask, k_sc, srow_scr[0])
-            srow_scr[1] = jnp.where(smask, v_sc, srow_scr[1])
-            wks.start()
-            wvs.start()
 
     @pl.when(length > 0)
     def _program():
@@ -530,9 +494,6 @@ def _fused_kernel(
         o_ref[...] = (acc_fin / l_fin).astype(o_ref.dtype)
         wk.wait()
         wv.wait()
-        if quantized:
-            wks.wait()
-            wvs.wait()
 
 
 def _block_geometry(page_table, page: int, pages_per_block: int | None,
@@ -662,7 +623,7 @@ def paged_decode_fused_kernel(
     layer: jnp.ndarray | int,
     pages_per_block: int | None = None,
     interpret: bool = False,
-    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] — aliased
+    kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] int8 pool
 ):
     """Fused decode step attention: returns ``(attn_out [B, Hq, D],
     kv_pages)`` — plus the updated ``kv_scales`` when quantized — where
@@ -679,14 +640,25 @@ def paged_decode_fused_kernel(
         multiple=_rpp(page) if quantized else 1,
     )
     scale_rows = _scale_rows(kv_scales) if quantized else None
+    if quantized:
+        from radixmesh_tpu.ops.quant import quantize_kv
+
+        # Quantize the incoming row OUTSIDE the kernel (the SAME
+        # quantizer the pool's host write path uses, so attention and
+        # later reads agree bit-exactly); the kernel gets the int8 row
+        # plus its per-(b, h) scale via scalar prefetch, and the scale
+        # POOL is updated below with one XLA scatter. An in-kernel
+        # scale-row RMW costs four extra serialized DMAs per program —
+        # measured at 1.75x the whole fused step on chip.
+        k_q, k_sc = quantize_kv(k_new.astype(jnp.float32), axis=-1)
+        v_q, v_sc = quantize_kv(v_new.astype(jnp.float32), axis=-1)
+        k_new, v_new = k_q, v_q
 
     scale = 1.0 / (D ** 0.5)
     q4 = (q.astype(jnp.float32) * scale).reshape(B, Hq, 1, D)
     q_spec = pl.BlockSpec((None, G, None, D), lambda b, h, *_: (b, h, 0, 0))
     kv_new_spec = pl.BlockSpec((None, None, 1, D), lambda b, h, *_: (b, h, 0, 0))
-    # Quantized pools receive the raw (f32) row and quantize in-kernel;
-    # bf16 pools store the row as-is.
-    new_dtype = jnp.float32 if quantized else kv_pages.dtype
+    new_dtype = kv_pages.dtype
 
     kernel = functools.partial(
         _fused_kernel,
@@ -705,17 +677,14 @@ def paged_decode_fused_kernel(
     ]
     out_specs = [pl.BlockSpec(memory_space=pl.ANY)]
     out_shape = [jax.ShapeDtypeStruct(kv_pages.shape, kv_pages.dtype)]
-    # Flat arg order: 6 scalar-prefetch args, then q (6), k_new (7),
-    # v_new (8), kv_pages (9) → alias kv_pages onto output 0 (and the
-    # scale pool (10) onto output 1 when quantized).
-    aliases = {9: 0}
+    # Flat arg order: the scalar-prefetch args (6, +2 scale vectors when
+    # quantized), then q, k_new, v_new, kv_pages[, scale_rows] → alias
+    # kv_pages onto output 0. The scale pool is read-only inside the
+    # kernel; its update happens by XLA scatter below.
+    n_scalars = 8 if quantized else 6
+    aliases = {n_scalars + 3: 0}
     if quantized:
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-        out_shape.append(
-            jax.ShapeDtypeStruct(scale_rows.shape, scale_rows.dtype)
-        )
-        aliases[10] = 1
     out_specs.append(q_spec)
     out_shape.append(jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32))
 
@@ -732,19 +701,13 @@ def paged_decode_fused_kernel(
             pltpu.VMEM((2, ppb, 128), jnp.float32),
         ]
     scratch.append(pltpu.VMEM((2, page, D), kv_pages.dtype))
-    if quantized:
-        # Staging for the current token's scale-row RMW: (1, 128) rows
-        # of the _scale_rows layout.
-        scratch.append(pltpu.VMEM((2, 1, 128), jnp.float32))
     scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
     if quantized:
         scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
     scratch.append(pltpu.SemaphoreType.DMA)
-    if quantized:
-        scratch.append(pltpu.SemaphoreType.DMA)  # scale-row RMW (sw_sem)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=6,
+        num_scalar_prefetch=n_scalars,
         grid=(B, Hkv),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -757,6 +720,13 @@ def paged_decode_fused_kernel(
         jnp.asarray(layer, dtype=jnp.int32).reshape(1),
         jnp.zeros((1,), jnp.int32),  # double-buffer slot
         jnp.ones((1,), jnp.int32),  # cold-start flag
+    ]
+    if quantized:
+        args += [
+            k_sc.astype(jnp.float32).reshape(-1),  # SMEM [B * Hkv]
+            v_sc.astype(jnp.float32).reshape(-1),
+        ]
+    args += [
         q4,
         k_new.astype(new_dtype).reshape(B, Hkv, 1, D),
         v_new.astype(new_dtype).reshape(B, Hkv, 1, D),
@@ -774,22 +744,29 @@ def paged_decode_fused_kernel(
         ),
         interpret=interpret,
     )(*args)
-    if quantized:
-        kv_out, scale_rows_out, out = res
-        # Rows → the caller's paged view. When the slot count is a
-        # multiple of 128 (every real pool) this is a pure reshape and
-        # the in-place aliasing chain stays copy-free.
-        S = kv_scales.shape[3] * kv_scales.shape[4]
-        scales_out = scale_rows_out.reshape(*kv_scales.shape[:3], -1)
-        if scales_out.shape[-1] != S:
-            scales_out = scales_out[..., :S]
-        return (
-            out.reshape(B, Hq, D).astype(q.dtype),
-            kv_out,
-            scales_out.reshape(kv_scales.shape),
-        )
     kv_out, out = res
-    return out.reshape(B, Hq, D).astype(q.dtype), kv_out
+    attn = out.reshape(B, Hq, D).astype(q.dtype)
+    if quantized:
+        # Scale-pool update by XLA scatter (same convention as the jnp
+        # fallback: an ARRAY layer index makes the advanced indices
+        # non-adjacent, so the batch axis lands first → [B, Hkv]),
+        # masked so inactive (length == 0) rows leave their target
+        # slot's scales untouched.
+        slots = jnp.asarray(slots, dtype=jnp.int32)
+        lengths = jnp.asarray(lengths, dtype=jnp.int32)
+        layer_ix = jnp.asarray(layer)
+        pg_b, off_b = slots // page, slots % page
+        valid = (lengths > 0)[:, None]  # [B, 1] vs [B, Hkv] gathers
+        cur_k = kv_scales[0, layer_ix, :, pg_b, off_b]
+        cur_v = kv_scales[1, layer_ix, :, pg_b, off_b]
+        scales_out = kv_scales.at[0, layer_ix, :, pg_b, off_b].set(
+            jnp.where(valid, k_sc, cur_k)
+        )
+        scales_out = scales_out.at[1, layer_ix, :, pg_b, off_b].set(
+            jnp.where(valid, v_sc, cur_v)
+        )
+        return attn, kv_out, scales_out
+    return attn, kv_out
 
 
 def _chunk_kernel(

@@ -82,7 +82,10 @@ def run_ring(
                 protocol="tcp-py",
                 topology=topology,
                 group_size=group_size,
-                tick_interval_s=5.0,
+                # One tick origination (the ticker's immediate first tick
+                # satisfies the barrier), then none during the measured
+                # phases — so the send counters observe only data frames.
+                tick_interval_s=120.0,
                 gc_interval_s=600.0,
                 failure_timeout_s=600.0,  # many threads contend; no false deaths
                 page_size=PAGE,
@@ -137,6 +140,9 @@ def run_ring(
 
         # Convergence: one writer floods, clock stops when the LAST node
         # holds the last key (FIFO per path ⇒ holding the last ⇒ all).
+        # Send counters are sampled around this phase so frames-per-insert
+        # is MEASURED wire traffic, not the analytic model restated.
+        sent0 = sum(n.metrics["oplogs_sent"] for n in nodes)
         keys = rng.integers(1, 50000, size=(n_inserts, KEY_LEN))
         t0 = time.monotonic()
         for i, key in enumerate(keys):
@@ -154,6 +160,7 @@ def run_ring(
             if pending:
                 time.sleep(0.005)
         converge_s = time.monotonic() - t0
+        sent = sum(n.metrics["oplogs_sent"] for n in nodes) - sent0
 
         frame = len(serialize(Oplog(
             op_type=OplogType.INSERT, origin_rank=0, logic_id=1,
@@ -161,9 +168,11 @@ def run_ring(
             value=np.arange(KEY_LEN // PAGE, dtype=np.int32), value_rank=0,
             page=PAGE,
         )))
-        # Frame count per insert: flat = N-1 forwards. Hier = group laps
-        # in every group + one spine lap (each group's injected copy dies
-        # at its injector, having covered that group).
+        # Frame model per insert (checked against the MEASURED counters by
+        # tests/test_ringscale.py): flat = N sends — the lap-RETURN hop to
+        # the origin is a real frame. Hier = one full lap per group (each
+        # lap's return hop included; injected copies die at their
+        # injector) + one spine lap.
         if topology == "hier":
             plan = nodes[0].hier
             alive = range(n_nodes)
@@ -172,7 +181,7 @@ def run_ring(
                 for g in plan.nonempty_groups(alive)
             ) + plan.spine_ttl(alive)
         else:
-            frames = n_nodes - 1
+            frames = n_nodes
         a = np.asarray(probes)
         return {
             "n_nodes": n_nodes,
@@ -187,6 +196,7 @@ def run_ring(
             "inserts_per_s": round(n_inserts / converge_s, 1),
             "frame_bytes": frame,
             "frames_per_insert": frames,
+            "measured_frames_per_insert": round(sent / n_inserts, 2),
             "ring_bytes_per_insert": frame * frames,
         }
     finally:

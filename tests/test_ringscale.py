@@ -18,15 +18,22 @@ def test_large_flat_ring_converges_and_props_scale():
     # Convergence is exact (run_ring raises on timeout); scaling is the
     # property: a 4x ring must not blow propagation latency up
     # superlinearly (generous 3x-per-2x bound — thread-scheduling noise
-    # at 24 in-proc nodes is real) and per-insert traffic is exactly O(N).
+    # at 24 in-proc nodes is real) and per-insert traffic is exactly O(N):
+    # N frames counting the lap-return hop to the origin. The MEASURED
+    # send counters must match the model exactly — a forwarding bug that
+    # duplicates or re-floods frames shows up here, not in the model.
     assert big["prop_p50_ms"] < small["prop_p50_ms"] * 12
-    assert big["ring_bytes_per_insert"] == small["frame_bytes"] * 23
-    assert big["frames_per_insert"] == 23
+    assert small["measured_frames_per_insert"] == small["frames_per_insert"] == 6
+    assert big["measured_frames_per_insert"] == big["frames_per_insert"] == 24
+    assert big["ring_bytes_per_insert"] == big["frame_bytes"] * 24
 
 
 def test_large_hier_ring_converges_with_expected_traffic():
     r = run_ring(24, n_inserts=15, n_probes=8, topology="hier")
     # auto group size at N=24 is 5 → 5 groups (4 of 5, 1 of 4): frames =
-    # one full lap per group (24) + one spine lap (5).
+    # one full lap per group (24, return hops included) + one spine lap
+    # (5). Measured sends must agree — circulation regressions
+    # (double-bridge, spine re-flood) land here.
     assert r["group_size"] == 5
     assert r["frames_per_insert"] == 24 + 5
+    assert r["measured_frames_per_insert"] == 29
