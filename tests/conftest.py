@@ -27,6 +27,22 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_residency():
+    """Free compiled executables at module boundaries.
+
+    The full suite JIT-compiles thousands of program variants; keeping
+    every executable alive for the whole run exhausts a per-process
+    resource (the crash signature is a deterministic XLA:CPU
+    ``backend_compile_and_load`` segfault at ~91% of the suite — LLVM
+    JIT code mappings against ``vm.max_map_count``, not Python memory:
+    the machine has >100 GB free when it dies). Clearing per module
+    bounds live executables at one module's worth; cross-module cache
+    hits were minimal anyway because engines differ in shape."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(autouse=True)
 def _fresh_metrics_registry():
     """Process-global metric counters must not leak between tests."""

@@ -2,19 +2,47 @@
 implementation): a LARGE ring must still converge in BOTH topologies,
 and per-insert ring traffic must match the topology's frame model — the
 measured basis for ARCHITECTURE.md's hierarchy-crossover section (the
-reference's open question, README.md:57)."""
+reference's open question, README.md:57).
 
+Each sweep runs in a SUBPROCESS: a 24-node tcp-py ring is ~120 threads
+and ~50 sockets, and carrying that churn inside the pytest process
+destabilized later XLA compiles (segfault at ~91% of the suite, twice).
+"""
+
+import json
 import os
+import subprocess
 import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-from ringscale import run_ring  # noqa: E402
+_DRIVER = """
+import json, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {scripts!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ringscale import run_ring
+print(json.dumps(run_ring({n}, n_inserts=15, n_probes=8, topology={topo!r})))
+"""
+
+
+def run_ring_isolated(n: int, topology: str) -> dict:
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRIVER.format(
+            repo=_REPO, scripts=os.path.join(_REPO, "scripts"),
+            n=n, topo=topology,
+        )],
+        stdout=subprocess.PIPE, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"sweep N={n}/{topology} failed"
+    return json.loads(proc.stdout.decode().strip().splitlines()[-1])
 
 
 def test_large_flat_ring_converges_and_props_scale():
-    small = run_ring(6, n_inserts=15, n_probes=8, topology="ring")
-    big = run_ring(24, n_inserts=15, n_probes=8, topology="ring")
+    small = run_ring_isolated(6, "ring")
+    big = run_ring_isolated(24, "ring")
     # Convergence is exact (run_ring raises on timeout); scaling is the
     # property: a 4x ring must not blow propagation latency up
     # superlinearly (generous 3x-per-2x bound — thread-scheduling noise
@@ -29,7 +57,7 @@ def test_large_flat_ring_converges_and_props_scale():
 
 
 def test_large_hier_ring_converges_with_expected_traffic():
-    r = run_ring(24, n_inserts=15, n_probes=8, topology="hier")
+    r = run_ring_isolated(24, "hier")
     # auto group size at N=24 is 5 → 5 groups (4 of 5, 1 of 4): frames =
     # one full lap per group (24, return hops included) + one spine lap
     # (5). Measured sends must agree — circulation regressions
