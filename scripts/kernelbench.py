@@ -1,0 +1,130 @@
+"""Kernel-level micro-benchmark: the Pallas paged-attention entry points
+timed in isolation (no model around them), bf16 and int8, pool + fused.
+
+Exists because whole-step numbers hide where kernel time goes: the int8
+fused-decode regression (0.57x bf16) was invisible until the pool kernel
+measured at parity (0.95x) while the fused kernel didn't — the delta was
+the in-kernel scale-row RMW, removed in favor of a wrapper-side scatter.
+Run this FIRST when a tunnel window opens; it answers in ~2 minutes
+whether a kernel change helped, where bench.py needs ~15.
+
+Prints one JSON line; ``--out FILE`` also writes it (suggested:
+``KERNELBENCH_r{N}.json``). CPU runs use interpret mode implicitly via
+the kernels' backend dispatch being bypassed — this script calls the
+kernels DIRECTLY, so on CPU pass ``--interpret`` (slow, numerics only).
+
+Usage: python scripts/kernelbench.py [--batch 64] [--ctx 1024] [--iters 10]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--ctx", type=int, default=1024)
+    ap.add_argument("--hq", type=int, default=16)
+    ap.add_argument("--hkv", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--page", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from radixmesh_tpu.utils.platform import pin_platform
+
+    pin_platform()  # honor JAX_PLATFORMS despite startup-pinned plugins
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from radixmesh_tpu.ops.paged_attention import (
+        paged_attention_pool_kernel,
+        paged_decode_fused_kernel,
+    )
+    from radixmesh_tpu.ops.quant import quantize_kv
+
+    B, Hq, Hkv, D, page = args.batch, args.hq, args.hkv, args.head_dim, args.page
+    ctx, L = args.ctx, 1
+    if ctx % page:
+        ap.error(f"--ctx ({ctx}) must be a multiple of --page ({page})")
+    P = B * ctx // page
+    rng = np.random.default_rng(0)
+    kv = rng.standard_normal((2, L, Hkv, P * page, D)).astype(np.float32)
+    q8, s8 = quantize_kv(jnp.asarray(kv), axis=-1)
+    kv8 = jnp.asarray(np.asarray(q8).reshape(2, L, Hkv, P, page, D), jnp.int8)
+    scales = jnp.asarray(np.asarray(s8).reshape(2, L, Hkv, P, page))
+    kv16 = jnp.asarray(kv.reshape(2, L, Hkv, P, page, D), jnp.bfloat16)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.bfloat16)
+    kn = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.bfloat16)
+    # Permuted tables = the radix-cache worst case (no page adjacency).
+    ptb_np = rng.permutation(P).reshape(B, ctx // page).astype(np.int32)
+    ptb = jnp.asarray(ptb_np)
+    lens = jnp.full((B,), ctx, jnp.int32)
+    # Each row's current token lives in its LAST table page (the fused
+    # kernel writes k_new/v_new there — slots must follow the permuted
+    # table or the write lands in another row's page).
+    slots = jnp.asarray(ptb_np[:, -1] * page + (page - 1))
+    interp = args.interpret
+
+    def bench(fn, n=args.iters):
+        r = fn()
+        jax.block_until_ready(r)
+        del r
+        t = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = fn()
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t) / n * 1e3
+
+    out = {
+        "backend": jax.default_backend(),
+        "shape": {"batch": B, "ctx": ctx, "hq": Hq, "hkv": Hkv,
+                  "head_dim": D, "page": page},
+        "ms": {},
+    }
+    out["ms"]["pool_bf16"] = round(bench(
+        lambda: paged_attention_pool_kernel(q, kv16, ptb, lens, 0,
+                                            interpret=interp)), 3)
+    out["ms"]["pool_int8"] = round(bench(
+        lambda: paged_attention_pool_kernel(q, kv8, ptb, lens, 0,
+                                            kv_scales=scales,
+                                            interpret=interp)), 3)
+    out["ms"]["fused_bf16"] = round(bench(
+        lambda: paged_decode_fused_kernel(q, kn, kn, kv16, slots, ptb, lens,
+                                          0, interpret=interp)), 3)
+    out["ms"]["fused_int8"] = round(bench(
+        lambda: paged_decode_fused_kernel(q, kn, kn, kv8, slots, ptb, lens,
+                                          0, kv_scales=scales,
+                                          interpret=interp)), 3)
+    out["int8_vs_bf16"] = {
+        "pool": round(out["ms"]["pool_bf16"] / out["ms"]["pool_int8"], 3),
+        "fused": round(out["ms"]["fused_bf16"] / out["ms"]["fused_int8"], 3),
+    }
+    # HBM bytes the bf16 pool kernel must move per launch (K+V context
+    # reads) — the bandwidth-bound lower bound for decode attention.
+    ctx_bytes = B * ctx * Hkv * 2 * D * 2
+    out["pool_bf16_gbps"] = round(
+        ctx_bytes / (out["ms"]["pool_bf16"] / 1e3) / 1e9, 1
+    )
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

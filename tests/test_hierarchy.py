@@ -437,6 +437,89 @@ class TestHierFailover:
             c.close()
 
 
+class TestHierServing:
+    def test_engines_publish_across_groups_and_router_attributes(self):
+        """The full serving stack composes with topology=hier: an engine
+        on a group-0 prefill node and one on a group-1 decode node both
+        publish; advertisements cross the spine to every replica and the
+        router (fed by master fan-out) attributes both roles."""
+        import jax
+
+        from radixmesh_tpu.cache.kv_pool import PagedKVPool
+        from radixmesh_tpu.engine.engine import Engine
+        from radixmesh_tpu.engine.request import SamplingParams
+        from radixmesh_tpu.models.llama import ModelConfig, init_params
+        from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+        prefill = ["sp0", "sp1", "sp2", "sp3"]
+        decode = ["sd0", "sd1"]
+        router = ["sr0"]
+        cfg = ModelConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        meshes, engines = [], {}
+        page = 4
+        for addr in prefill + decode + router:
+            mcfg = MeshConfig(
+                prefill_nodes=prefill,
+                decode_nodes=decode,
+                router_nodes=router,
+                local_addr=addr,
+                protocol="inproc",
+                topology="hier",
+                group_size=3,  # groups {0,1,2} and {3,4,5}
+                tick_interval_s=0.05,
+                gc_interval_s=30.0,
+            )
+            mesh = MeshCache(mcfg, pool=None).start()
+            meshes.append(mesh)
+            if addr in ("sp0", "sd1"):  # one engine per group
+                pool = PagedKVPool(
+                    num_slots=512, num_layers=cfg.n_layers,
+                    num_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
+                    page_size=page, dtype=cfg.dtype,
+                )
+                engines[addr] = Engine(
+                    cfg, params, pool=pool, page_size=page, max_batch=2,
+                    mesh=mesh, name=addr,
+                )
+        try:
+            for m in meshes:
+                assert m.wait_ready(timeout=10), f"rank {m.rank} never ready"
+            router_mesh = next(m for m in meshes if m.role is NodeRole.ROUTER)
+            car = CacheAwareRouter(router_mesh, router_mesh.cfg)
+            car.finish_warm_up()
+            greedy = SamplingParams(temperature=0.0, max_new_tokens=3)
+
+            prompt_a = list(range(40, 52))  # served by sp0 (rank 0, group 0)
+            engines["sp0"].generate([prompt_a], greedy)
+            prompt_b = list(range(60, 72))  # served by sd1 (rank 5, group 1)
+            engines["sd1"].generate([prompt_b], greedy)
+
+            # Advertisements cross the spine to a non-engine replica in
+            # the OTHER group (rank 3 is group 1's leader).
+            assert wait_for(
+                lambda: meshes[3].match_prefix(prompt_a).length >= page
+            ), "group-1 replica never saw group-0's advertisement"
+            assert wait_for(
+                lambda: meshes[1].match_prefix(prompt_b).length >= page
+            ), "group-0 replica never saw group-1's advertisement"
+
+            # Router attribution for both roles, across groups.
+            def routed_a():
+                r = car.cache_aware_route(prompt_a)
+                return r.prefill_addr == "sp0"
+
+            def routed_b():
+                r = car.cache_aware_route(prompt_b)
+                return r.decode_addr == "sd1"
+
+            assert wait_for(routed_a), car.cache_aware_route(prompt_a)
+            assert wait_for(routed_b), car.cache_aware_route(prompt_b)
+        finally:
+            for m in meshes:
+                m.close()
+
+
 class TestHierConfig:
     def test_ring_mode_rejects_group_size(self):
         with pytest.raises(ValueError, match="group_size"):
