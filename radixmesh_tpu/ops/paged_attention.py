@@ -375,6 +375,167 @@ def _kernel(
         o_ref[...] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
 
 
+class _MhBlockCopy:
+    """Async HBM→VMEM gather of one compute block with ALL kv heads per
+    DMA: each page copy moves the strided ``(Hkv, page, D)`` slab instead
+    of one head's ``(page, D)`` tile. The per-head-program kernel issues
+    ``B × Hkv × blocks × ppb × 2`` small DMAs per launch — on-chip that
+    issue count, not bytes, bounds decode attention (23% HBM utilization
+    measured at the headline shape); fetching all heads per descriptor
+    divides it by ``Hkv``."""
+
+    def __init__(self, kv_hbm, which, layer, buf, sem, page_table_ref,
+                 flat_offset, n_pages):
+        src = kv_hbm.at[which, layer]  # [Hkv, P, page, D]
+        self._copies = [
+            pltpu.make_async_copy(
+                src.at[:, page_table_ref[flat_offset + i]],  # (Hkv, page, D)
+                buf.at[:, i],
+                sem,
+            )
+            for i in range(n_pages)
+        ]
+
+    def start(self):
+        for c in self._copies:
+            c.start()
+
+    def wait(self):
+        for c in self._copies:
+            c.wait()
+
+
+def _mh_kernel(
+    # scalar prefetch
+    lengths_ref,  # SMEM [B]
+    page_table_ref,  # SMEM [B * blocks_padded * ppb] flattened
+    layer_ref,  # SMEM [1]
+    buffer_index_ref,  # SMEM [1]
+    init_flag_ref,  # SMEM [1]
+    *refs,  # q_ref, kv_hbm, o_ref, m/l/acc scratch, k/v bufs, sems
+    page: int,
+    pages_per_block: int,
+    pages_per_seq: int,
+    batch_size: int,
+    num_kv_heads: int,
+    group: int,
+):
+    """Heads-fused read-only pool attention: grid ``(B,)``, one program
+    per sequence computing EVERY kv head from heads-batched MXU
+    contractions over ``(Hkv, bk, D)`` staged blocks (``_MhBlockCopy``).
+    Opt-in via ``fuse_heads=True`` until Mosaic-verified on hardware —
+    the 3D batched-dot shapes are exactly the kind interpret mode and
+    StableHLO AOT accept but real lowering may not (see _scale_rows).
+
+    DELIBERATE duplication of ``_run_block_loop``'s prefetch/softmax
+    machinery (parity pinned by tests/test_ops.py::TestPoolKernelFusedHeads):
+    merging a head axis into the proven per-head path before the chip
+    has judged this candidate would risk the production kernel for an
+    experiment. If on-chip numbers keep it, fold both into one
+    parameterized loop; if not, delete this."""
+    q_ref, kv_hbm, o_ref, m_scr, l_scr, acc_scr, k_buf, v_buf, sems = refs
+    b = pl.program_id(0)
+    layer = layer_ref[0]
+    length = lengths_ref[b]
+    bk = page * pages_per_block
+    Hkv, G = num_kv_heads, group
+
+    def block_copies(bb, ii, slot):
+        off = bb * pages_per_seq + ii * pages_per_block
+        return [
+            _MhBlockCopy(kv_hbm, 0, layer, k_buf.at[slot], sems.at[slot, 0],
+                         page_table_ref, off, pages_per_block),
+            _MhBlockCopy(kv_hbm, 1, layer, v_buf.at[slot], sems.at[slot, 1],
+                         page_table_ref, off, pages_per_block),
+        ]
+
+    def next_indices(i):
+        """Grid-order successor of block ``i`` of program ``b``, skipping
+        empty sequences (mirrors ``_run_block_loop.next_indices`` minus
+        the head axis)."""
+
+        def advance_b():
+            nb = jax.lax.fori_loop(
+                b + 1,
+                batch_size,
+                lambda _, x: jnp.where(
+                    jnp.logical_and(
+                        x < batch_size,
+                        lengths_ref[jax.lax.clamp(0, x, batch_size - 1)] < 1,
+                    ),
+                    x + 1,
+                    x,
+                ),
+                b + 1,
+            )
+            return (nb, 0)
+
+        return jax.lax.cond(i * bk < length, lambda: (b, i), advance_b)
+
+    o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(length > 0)
+    def _program():
+        q = q_ref[...].astype(jnp.float32).reshape(Hkv, G, -1)  # pre-scaled
+
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        def body(i, _):
+            init_flag = init_flag_ref[0]
+            init_flag_ref[0] = 0
+            slot = buffer_index_ref[0]
+            nb, ni = next_indices(i + 1)
+
+            @pl.when(init_flag)
+            def _cold_start():
+                for c in block_copies(b, i, slot):
+                    c.start()
+
+            @pl.when(nb < batch_size)
+            def _prefetch_next():
+                nslot = jnp.where(slot == 0, 1, 0)
+                for c in block_copies(nb, ni, nslot):
+                    c.start()
+                buffer_index_ref[0] = nslot
+
+            cs = block_copies(b, i, slot)
+            cs[0].wait()
+            # (Hkv, ppb, page, D) → (Hkv, bk, D): middle collapse, minor
+            # dim untouched — a supported relayout-free reshape.
+            k = k_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
+            s = jax.lax.dot_general(  # (Hkv, G, bk), heads-batched MXU
+                q, k,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(pos < length, s, _MASK)
+
+            m_prev = m_scr[...]
+            m_blk = jnp.max(s, axis=-1, keepdims=True)  # (Hkv, G, 1)
+            m_new = jnp.maximum(m_prev, m_blk)  # lane-replicated (Hkv, G, D)
+            p = jnp.exp(s - m_new[:, :, :1])  # (Hkv, G, bk)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            m_scr[...] = m_new
+
+            cs[1].wait()
+            v = v_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
+            pv = jax.lax.dot_general(  # (Hkv, G, D)
+                p, v,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[...] = acc_scr[...] * corr + pv
+            return ()
+
+        jax.lax.fori_loop(0, pl.cdiv(length, bk), body, ())
+        out = acc_scr[...] / l_scr[...]
+        o_ref[...] = out.reshape(Hkv * G, -1).astype(o_ref.dtype)
+
+
 def _fused_kernel(
     # scalar prefetch
     lengths_ref,  # SMEM [B] context length INCLUDING the current token
@@ -518,7 +679,7 @@ def _block_geometry(page_table, page: int, pages_per_block: int | None,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("pages_per_block", "interpret")
+    jax.jit, static_argnames=("pages_per_block", "interpret", "fuse_heads")
 )
 def paged_attention_pool_kernel(
     q: jnp.ndarray,  # [B, Hq, D]
@@ -529,6 +690,7 @@ def paged_attention_pool_kernel(
     pages_per_block: int | None = None,
     interpret: bool = False,
     kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] (int8 pool)
+    fuse_heads: bool = False,  # heads-batched variant (_mh_kernel); bf16 only
 ) -> jnp.ndarray:
     """Read-only entry: the whole (multi-layer) pool rides in HBM untouched
     and the kernel DMAs only ``layer``'s pages — so a scan-over-layers
@@ -542,6 +704,15 @@ def paged_attention_pool_kernel(
         raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
     G = Hq // Hkv
     quantized = kv_scales is not None
+    if fuse_heads:
+        if quantized:
+            raise NotImplementedError(
+                "fuse_heads does not support int8 pools yet"
+            )
+        return _pool_kernel_mh(
+            q, kv_pages, page_table, lengths, layer,
+            pages_per_block=pages_per_block, interpret=interpret,
+        )
     page_table, ppb, padded = _block_geometry(
         page_table, page, pages_per_block,
         multiple=_rpp(page) if quantized else 1,
@@ -606,6 +777,68 @@ def paged_attention_pool_kernel(
         ),
         interpret=interpret,
     )(*args)
+    return out.reshape(B, Hq, D).astype(q.dtype)
+
+
+def _pool_kernel_mh(
+    q, kv_pages, page_table, lengths, layer,
+    pages_per_block: int | None = None, interpret: bool = False,
+):
+    """Heads-batched pool attention wrapper (see ``_mh_kernel``). Smaller
+    default blocks than the per-head kernel: each staged block is
+    ``Hkv ×`` bigger, so bk=128 keeps the double buffers ≤ ~16 MB VMEM
+    at Hkv=8/D=128 bf16."""
+    B, Hq, D = q.shape
+    _, _, Hkv, _, page, _ = kv_pages.shape
+    G = Hq // Hkv
+    if pages_per_block is None:
+        pages_per_block = max(1, -(-128 // page))
+    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+
+    scale = 1.0 / (D ** 0.5)
+    q4 = (q.astype(jnp.float32) * scale).reshape(B, Hq, 1, D)
+    q_spec = pl.BlockSpec((None, Hq, None, D), lambda b, *_: (b, 0, 0, 0))
+
+    kernel = functools.partial(
+        _mh_kernel,
+        page=page,
+        pages_per_block=ppb,
+        pages_per_seq=padded,
+        batch_size=B,
+        num_kv_heads=Hkv,
+        group=G,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(B,),
+        in_specs=[q_spec, pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+            pltpu.VMEM((2, Hkv, ppb, page, D), kv_pages.dtype),
+            pltpu.VMEM((2, Hkv, ppb, page, D), kv_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(
+        jnp.asarray(lengths, dtype=jnp.int32),
+        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
+        jnp.zeros((1,), jnp.int32),
+        jnp.ones((1,), jnp.int32),
+        q4,
+        kv_pages,
+    )
     return out.reshape(B, Hq, D).astype(q.dtype)
 
 

@@ -94,30 +94,47 @@ def main() -> int:
                   "head_dim": D, "page": page},
         "ms": {},
     }
-    out["ms"]["pool_bf16"] = round(bench(
-        lambda: paged_attention_pool_kernel(q, kv16, ptb, lens, 0,
-                                            interpret=interp)), 3)
-    out["ms"]["pool_int8"] = round(bench(
-        lambda: paged_attention_pool_kernel(q, kv8, ptb, lens, 0,
-                                            kv_scales=scales,
-                                            interpret=interp)), 3)
-    out["ms"]["fused_bf16"] = round(bench(
-        lambda: paged_decode_fused_kernel(q, kn, kn, kv16, slots, ptb, lens,
-                                          0, interpret=interp)), 3)
-    out["ms"]["fused_int8"] = round(bench(
-        lambda: paged_decode_fused_kernel(q, kn, kn, kv8, slots, ptb, lens,
-                                          0, kv_scales=scales,
-                                          interpret=interp)), 3)
-    out["int8_vs_bf16"] = {
-        "pool": round(out["ms"]["pool_bf16"] / out["ms"]["pool_int8"], 3),
-        "fused": round(out["ms"]["fused_bf16"] / out["ms"]["fused_int8"], 3),
+    # EVERY kernel timing is exception-guarded and partial results are
+    # always printed/written: tunnel windows are scarce, and this repo's
+    # history shows kernels that fail ONLY at on-chip Mosaic compile —
+    # one such failure must not discard the numbers already measured.
+    cases = {
+        "pool_bf16": lambda: paged_attention_pool_kernel(
+            q, kv16, ptb, lens, 0, interpret=interp),
+        # Heads-batched candidate: 1/Hkv the DMA issue count (opt-in
+        # until Mosaic-verified; measure FIRST when a window opens).
+        "pool_bf16_mh": lambda: paged_attention_pool_kernel(
+            q, kv16, ptb, lens, 0, interpret=interp, fuse_heads=True),
+        "pool_int8": lambda: paged_attention_pool_kernel(
+            q, kv8, ptb, lens, 0, kv_scales=scales, interpret=interp),
+        "fused_bf16": lambda: paged_decode_fused_kernel(
+            q, kn, kn, kv16, slots, ptb, lens, 0, interpret=interp),
+        "fused_int8": lambda: paged_decode_fused_kernel(
+            q, kn, kn, kv8, slots, ptb, lens, 0, kv_scales=scales,
+            interpret=interp),
     }
+    for name, thunk in cases.items():
+        try:
+            out["ms"][name] = round(bench(thunk), 3)
+        except Exception as e:  # noqa: BLE001 — record, keep measuring
+            out.setdefault("errors", {})[name] = str(e)[:300]
+    ms = out["ms"]
+    out["int8_vs_bf16"] = {
+        k: round(ms[f"{k}_bf16"] / ms[f"{k}_int8"], 3)
+        for k in ("pool", "fused")
+        if f"{k}_bf16" in ms and f"{k}_int8" in ms
+    }
+    if "pool_bf16_mh" in ms and "pool_bf16" in ms:
+        out["mh_vs_per_head"] = round(
+            ms["pool_bf16"] / ms["pool_bf16_mh"], 3
+        )
     # HBM bytes the bf16 pool kernel must move per launch (K+V context
     # reads) — the bandwidth-bound lower bound for decode attention.
-    ctx_bytes = B * ctx * Hkv * 2 * D * 2
-    out["pool_bf16_gbps"] = round(
-        ctx_bytes / (out["ms"]["pool_bf16"] / 1e3) / 1e9, 1
-    )
+    if "pool_bf16" in ms:
+        ctx_bytes = B * ctx * Hkv * 2 * D * 2
+        out["pool_bf16_gbps"] = round(
+            ctx_bytes / (ms["pool_bf16"] / 1e3) / 1e9, 1
+        )
     line = json.dumps(out)
     print(line, flush=True)
     if args.out:

@@ -475,3 +475,67 @@ class TestPagedChunkKernel:
         np.testing.assert_allclose(
             np.asarray(perm), np.asarray(base), rtol=2e-5, atol=2e-5
         )
+
+
+class TestPoolKernelFusedHeads:
+    """Heads-batched pool-kernel variant (``fuse_heads=True``): one
+    program per sequence, one strided DMA per page for ALL kv heads —
+    must be numerically identical to the per-head-program kernel."""
+
+    def _setup(self, key, B=4, Hq=8, Hkv=2, D=32, page=8, n_pages=32, maxp=4,
+               L=2):
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (B, Hq, D), dtype=jnp.float32)
+        kv = jax.random.normal(
+            ks[1], (2, L, Hkv, n_pages, page, D), dtype=jnp.float32
+        )
+        pt = jax.random.permutation(ks[2], n_pages)[: B * maxp].reshape(B, maxp)
+        # Ragged: empty row, single token, mid-page, full.
+        lengths = jnp.array([0, 1, page + 3, page * maxp])[:B]
+        return q, kv, pt.astype(jnp.int32), lengths.astype(jnp.int32)
+
+    @pytest.mark.parametrize("layer", [0, 1])
+    def test_matches_per_head_kernel(self, layer):
+        from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+
+        q, kv, pt, lengths = self._setup(jax.random.PRNGKey(9))
+        want = paged_attention_pool_kernel(
+            q, kv, pt, lengths, layer, interpret=True
+        )
+        got = paged_attention_pool_kernel(
+            q, kv, pt, lengths, layer, interpret=True, fuse_heads=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_bf16_and_multiblock(self):
+        from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+
+        q, kv, pt, lengths = self._setup(
+            jax.random.PRNGKey(4), B=2, Hq=4, Hkv=4, maxp=6, n_pages=16
+        )
+        lengths = jnp.array([8 * 6, 13], jnp.int32)
+        want = paged_attention_pool_kernel(
+            q.astype(jnp.bfloat16), kv.astype(jnp.bfloat16), pt, lengths, 0,
+            interpret=True, pages_per_block=2,
+        )
+        got = paged_attention_pool_kernel(
+            q.astype(jnp.bfloat16), kv.astype(jnp.bfloat16), pt, lengths, 0,
+            interpret=True, pages_per_block=2, fuse_heads=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+    def test_int8_refused(self):
+        from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+
+        q, kv, pt, lengths = self._setup(jax.random.PRNGKey(1))
+        with pytest.raises(NotImplementedError):
+            paged_attention_pool_kernel(
+                q, kv.astype(jnp.int8), pt, lengths, 0, interpret=True,
+                fuse_heads=True,
+                kv_scales=jnp.ones(kv.shape[:-1], jnp.float32),
+            )
