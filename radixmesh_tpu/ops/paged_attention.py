@@ -405,6 +405,134 @@ class _MhBlockCopy:
             c.wait()
 
 
+def _mh_block_loop(
+    *,
+    b,
+    layer,
+    hbm_len,  # tokens resident in HBM pages for THIS program's sequence
+    q,  # (Hkv, G, D) f32, pre-scaled
+    lengths_ref,
+    page_table_ref,
+    buffer_index_ref,
+    init_flag_ref,
+    kv_hbm,
+    k_buf,
+    v_buf,
+    sems,
+    m_scr,
+    l_scr,
+    acc_scr,
+    page: int,
+    pages_per_block: int,
+    pages_per_seq: int,
+    batch_size: int,
+    num_kv_heads: int,
+    min_length: int,  # lengths_ref value below which a row has no HBM work
+):
+    """The heads-batched analog of ``_run_block_loop``: one program per
+    SEQUENCE, ``(Hkv, G, ·)`` batched MXU contractions, chain-prefetched
+    ``_MhBlockCopy`` DMAs. Shared by the read-only and fused mh kernels
+    (min_length 1 / 2, exactly like the per-head pair).
+
+    DELIBERATE duplication of ``_run_block_loop``'s machinery (parity
+    pinned by tests/test_ops.py::TestPoolKernelFusedHeads and
+    TestFusedHeadsDecode): merging a head axis into the proven per-head
+    path before the chip has judged this candidate would risk the
+    production kernel for an experiment. If on-chip numbers keep it,
+    fold both into one parameterized loop; if not, delete this. (The
+    GQA group axis rides implicitly in ``q``'s shape.)"""
+    bk = page * pages_per_block
+    Hkv = num_kv_heads
+
+    def block_copies(bb, ii, slot):
+        off = bb * pages_per_seq + ii * pages_per_block
+        return [
+            _MhBlockCopy(kv_hbm, 0, layer, k_buf.at[slot], sems.at[slot, 0],
+                         page_table_ref, off, pages_per_block),
+            _MhBlockCopy(kv_hbm, 1, layer, v_buf.at[slot], sems.at[slot, 1],
+                         page_table_ref, off, pages_per_block),
+        ]
+
+    def next_indices(i):
+        """Grid-order successor of block ``i`` of program ``b``, skipping
+        sequences with no HBM work (mirrors ``_run_block_loop`` minus the
+        head axis)."""
+
+        def advance_b():
+            nb = jax.lax.fori_loop(
+                b + 1,
+                batch_size,
+                lambda _, x: jnp.where(
+                    jnp.logical_and(
+                        x < batch_size,
+                        lengths_ref[jax.lax.clamp(0, x, batch_size - 1)]
+                        < min_length,
+                    ),
+                    x + 1,
+                    x,
+                ),
+                b + 1,
+            )
+            return (nb, 0)
+
+        return jax.lax.cond(i * bk < hbm_len, lambda: (b, i), advance_b)
+
+    m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def body(i, _):
+        init_flag = init_flag_ref[0]
+        init_flag_ref[0] = 0
+        slot = buffer_index_ref[0]
+        nb, ni = next_indices(i + 1)
+
+        @pl.when(init_flag)
+        def _cold_start():
+            for c in block_copies(b, i, slot):
+                c.start()
+
+        @pl.when(nb < batch_size)
+        def _prefetch_next():
+            nslot = jnp.where(slot == 0, 1, 0)
+            for c in block_copies(nb, ni, nslot):
+                c.start()
+            buffer_index_ref[0] = nslot
+
+        cs = block_copies(b, i, slot)
+        cs[0].wait()
+        # (Hkv, ppb, page, D) → (Hkv, bk, D): middle collapse, minor
+        # dim untouched — a supported relayout-free reshape.
+        k = k_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
+        s = jax.lax.dot_general(  # (Hkv, G, bk), heads-batched MXU
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos < hbm_len, s, _MASK)
+
+        m_prev = m_scr[...]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)  # (Hkv, G, 1)
+        m_new = jnp.maximum(m_prev, m_blk)  # lane-replicated (Hkv, G, D)
+        p = jnp.exp(s - m_new[:, :, :1])  # (Hkv, G, bk)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[...] = m_new
+
+        cs[1].wait()
+        v = v_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
+        pv = jax.lax.dot_general(  # (Hkv, G, D)
+            p, v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr + pv
+        return ()
+
+    jax.lax.fori_loop(0, pl.cdiv(hbm_len, bk), body, ())
+
+
 def _mh_kernel(
     # scalar prefetch
     lengths_ref,  # SMEM [B]
@@ -420,120 +548,125 @@ def _mh_kernel(
     num_kv_heads: int,
     group: int,
 ):
-    """Heads-fused read-only pool attention: grid ``(B,)``, one program
-    per sequence computing EVERY kv head from heads-batched MXU
-    contractions over ``(Hkv, bk, D)`` staged blocks (``_MhBlockCopy``).
-    Opt-in via ``fuse_heads=True`` until Mosaic-verified on hardware —
-    the 3D batched-dot shapes are exactly the kind interpret mode and
-    StableHLO AOT accept but real lowering may not (see _scale_rows).
-
-    DELIBERATE duplication of ``_run_block_loop``'s prefetch/softmax
-    machinery (parity pinned by tests/test_ops.py::TestPoolKernelFusedHeads):
-    merging a head axis into the proven per-head path before the chip
-    has judged this candidate would risk the production kernel for an
-    experiment. If on-chip numbers keep it, fold both into one
-    parameterized loop; if not, delete this."""
+    """Heads-fused read-only pool attention: grid ``(B,)`` (see
+    ``_mh_block_loop``). Opt-in via ``fuse_heads=True`` until
+    Mosaic-verified on hardware — the 3D batched-dot shapes are exactly
+    the kind interpret mode and StableHLO AOT accept but real lowering
+    may not (see _scale_rows)."""
     q_ref, kv_hbm, o_ref, m_scr, l_scr, acc_scr, k_buf, v_buf, sems = refs
     b = pl.program_id(0)
     layer = layer_ref[0]
     length = lengths_ref[b]
-    bk = page * pages_per_block
     Hkv, G = num_kv_heads, group
-
-    def block_copies(bb, ii, slot):
-        off = bb * pages_per_seq + ii * pages_per_block
-        return [
-            _MhBlockCopy(kv_hbm, 0, layer, k_buf.at[slot], sems.at[slot, 0],
-                         page_table_ref, off, pages_per_block),
-            _MhBlockCopy(kv_hbm, 1, layer, v_buf.at[slot], sems.at[slot, 1],
-                         page_table_ref, off, pages_per_block),
-        ]
-
-    def next_indices(i):
-        """Grid-order successor of block ``i`` of program ``b``, skipping
-        empty sequences (mirrors ``_run_block_loop.next_indices`` minus
-        the head axis)."""
-
-        def advance_b():
-            nb = jax.lax.fori_loop(
-                b + 1,
-                batch_size,
-                lambda _, x: jnp.where(
-                    jnp.logical_and(
-                        x < batch_size,
-                        lengths_ref[jax.lax.clamp(0, x, batch_size - 1)] < 1,
-                    ),
-                    x + 1,
-                    x,
-                ),
-                b + 1,
-            )
-            return (nb, 0)
-
-        return jax.lax.cond(i * bk < length, lambda: (b, i), advance_b)
 
     o_ref[...] = jnp.zeros_like(o_ref)
 
     @pl.when(length > 0)
     def _program():
         q = q_ref[...].astype(jnp.float32).reshape(Hkv, G, -1)  # pre-scaled
-
-        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
-
-        def body(i, _):
-            init_flag = init_flag_ref[0]
-            init_flag_ref[0] = 0
-            slot = buffer_index_ref[0]
-            nb, ni = next_indices(i + 1)
-
-            @pl.when(init_flag)
-            def _cold_start():
-                for c in block_copies(b, i, slot):
-                    c.start()
-
-            @pl.when(nb < batch_size)
-            def _prefetch_next():
-                nslot = jnp.where(slot == 0, 1, 0)
-                for c in block_copies(nb, ni, nslot):
-                    c.start()
-                buffer_index_ref[0] = nslot
-
-            cs = block_copies(b, i, slot)
-            cs[0].wait()
-            # (Hkv, ppb, page, D) → (Hkv, bk, D): middle collapse, minor
-            # dim untouched — a supported relayout-free reshape.
-            k = k_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
-            s = jax.lax.dot_general(  # (Hkv, G, bk), heads-batched MXU
-                q, k,
-                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            )
-            pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-            s = jnp.where(pos < length, s, _MASK)
-
-            m_prev = m_scr[...]
-            m_blk = jnp.max(s, axis=-1, keepdims=True)  # (Hkv, G, 1)
-            m_new = jnp.maximum(m_prev, m_blk)  # lane-replicated (Hkv, G, D)
-            p = jnp.exp(s - m_new[:, :, :1])  # (Hkv, G, bk)
-            corr = jnp.exp(m_prev - m_new)
-            l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
-            m_scr[...] = m_new
-
-            cs[1].wait()
-            v = v_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
-            pv = jax.lax.dot_general(  # (Hkv, G, D)
-                p, v,
-                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            )
-            acc_scr[...] = acc_scr[...] * corr + pv
-            return ()
-
-        jax.lax.fori_loop(0, pl.cdiv(length, bk), body, ())
+        _mh_block_loop(
+            b=b, layer=layer, hbm_len=length, q=q,
+            lengths_ref=lengths_ref, page_table_ref=page_table_ref,
+            buffer_index_ref=buffer_index_ref, init_flag_ref=init_flag_ref,
+            kv_hbm=kv_hbm, k_buf=k_buf, v_buf=v_buf, sems=sems,
+            m_scr=m_scr, l_scr=l_scr, acc_scr=acc_scr,
+            page=page, pages_per_block=pages_per_block,
+            pages_per_seq=pages_per_seq, batch_size=batch_size,
+            num_kv_heads=num_kv_heads, min_length=1,
+        )
         out = acc_scr[...] / l_scr[...]
         o_ref[...] = out.reshape(Hkv * G, -1).astype(o_ref.dtype)
+
+
+def _mh_fused_kernel(
+    # scalar prefetch
+    lengths_ref,  # SMEM [B] context length INCLUDING the current token
+    page_table_ref,  # SMEM [B * blocks_padded * ppb] flattened
+    slots_ref,  # SMEM [B] pool slot receiving this token's K/V
+    layer_ref,  # SMEM [1]
+    buffer_index_ref,  # SMEM [1]
+    init_flag_ref,  # SMEM [1]
+    *refs,
+    page: int,
+    pages_per_block: int,
+    pages_per_seq: int,
+    batch_size: int,
+    num_kv_heads: int,
+    group: int,
+):
+    """Heads-fused decode step: the ``_fused_kernel`` contract (write the
+    current token's K/V row through the aliased pool output, fold it in
+    from VMEM) at grid ``(B,)`` — the page-row RMW also moves all heads
+    per DMA (2 reads + 2 writes per SEQUENCE instead of per (b, h))."""
+    (q_ref, k_new_ref, v_new_ref, kv_hbm,
+     kv_out, o_ref,
+     m_scr, l_scr, acc_scr, k_buf, v_buf, row_scr, sems, w_sem) = refs
+    b = pl.program_id(0)
+    layer = layer_ref[0]
+    length = lengths_ref[b]
+    hbm_len = length - 1
+    Hkv, G = num_kv_heads, group
+
+    slot = slots_ref[b]
+    pg, off = slot // page, slot % page
+
+    def page_window(which):
+        return kv_out.at[which, layer, :, pg]  # (Hkv, page, D) strided
+
+    rk = pltpu.make_async_copy(page_window(0), row_scr.at[0], w_sem)
+    rv = pltpu.make_async_copy(page_window(1), row_scr.at[1], w_sem)
+    wk = pltpu.make_async_copy(row_scr.at[0], page_window(0), w_sem)
+    wv = pltpu.make_async_copy(row_scr.at[1], page_window(1), w_sem)
+
+    k_cur = k_new_ref[...].astype(jnp.float32)  # (Hkv, 1, D)
+    v_cur = v_new_ref[...].astype(jnp.float32)
+
+    o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(length > 0)
+    def _write():
+        rk.start()
+        rv.start()
+        rk.wait()
+        rv.wait()
+        mask = jax.lax.broadcasted_iota(jnp.int32, row_scr.shape[1:], 1) == off
+        row_scr[0] = jnp.where(
+            mask, jnp.broadcast_to(k_new_ref[...], row_scr.shape[1:]), row_scr[0]
+        )
+        row_scr[1] = jnp.where(
+            mask, jnp.broadcast_to(v_new_ref[...], row_scr.shape[1:]), row_scr[1]
+        )
+        wk.start()
+        wv.start()
+
+    @pl.when(length > 0)
+    def _program():
+        q = q_ref[...].astype(jnp.float32).reshape(Hkv, G, -1)  # pre-scaled
+        _mh_block_loop(
+            b=b, layer=layer, hbm_len=hbm_len, q=q,
+            lengths_ref=lengths_ref, page_table_ref=page_table_ref,
+            buffer_index_ref=buffer_index_ref, init_flag_ref=init_flag_ref,
+            kv_hbm=kv_hbm, k_buf=k_buf, v_buf=v_buf, sems=sems,
+            m_scr=m_scr, l_scr=l_scr, acc_scr=acc_scr,
+            page=page, pages_per_block=pages_per_block,
+            pages_per_seq=pages_per_seq, batch_size=batch_size,
+            num_kv_heads=num_kv_heads, min_length=2,
+        )
+        s_cur = jax.lax.dot_general(  # (Hkv, G, 1)
+            q, k_cur,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, s_cur)
+        p_cur = jnp.exp(s_cur - m_new[:, :, :1])  # (Hkv, G, 1)
+        corr = jnp.exp(m_prev - m_new)
+        l_fin = l_scr[...] * corr + p_cur
+        acc_fin = acc_scr[...] * corr + p_cur * v_cur
+        out = acc_fin / l_fin
+        o_ref[...] = out.reshape(Hkv * G, -1).astype(o_ref.dtype)
+        wk.wait()
+        wv.wait()
 
 
 def _fused_kernel(
@@ -842,8 +975,82 @@ def _pool_kernel_mh(
     return out.reshape(B, Hq, D).astype(q.dtype)
 
 
+def _fused_decode_mh(
+    q, k_new, v_new, kv_pages, slots, page_table, lengths, layer,
+    pages_per_block: int | None = None, interpret: bool = False,
+):
+    """Heads-batched fused decode wrapper (see ``_mh_fused_kernel``)."""
+    B, Hq, D = q.shape
+    _, _, Hkv, _, page, _ = kv_pages.shape
+    G = Hq // Hkv
+    if pages_per_block is None:
+        pages_per_block = max(1, -(-128 // page))
+    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+
+    scale = 1.0 / (D ** 0.5)
+    q4 = (q.astype(jnp.float32) * scale).reshape(B, Hq, 1, D)
+    q_spec = pl.BlockSpec((None, Hq, None, D), lambda b, *_: (b, 0, 0, 0))
+    kv_new_spec = pl.BlockSpec((None, Hkv, 1, D), lambda b, *_: (b, 0, 0, 0))
+
+    kernel = functools.partial(
+        _mh_fused_kernel,
+        page=page,
+        pages_per_block=ppb,
+        pages_per_seq=padded,
+        batch_size=B,
+        num_kv_heads=Hkv,
+        group=G,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(B,),
+        in_specs=[
+            q_spec, kv_new_spec, kv_new_spec,
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY), q_spec],
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+            pltpu.VMEM((Hkv, G, D), jnp.float32),
+            pltpu.VMEM((2, Hkv, ppb, page, D), kv_pages.dtype),
+            pltpu.VMEM((2, Hkv, ppb, page, D), kv_pages.dtype),
+            pltpu.VMEM((2, Hkv, page, D), kv_pages.dtype),  # row RMW
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    # Args: 6 scalars, q (6), k_new (7), v_new (8), kv_pages (9) → alias
+    # kv_pages onto output 0.
+    kv_out, out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(kv_pages.shape, kv_pages.dtype),
+            jax.ShapeDtypeStruct((B, Hq, 1, D), jnp.float32),
+        ],
+        input_output_aliases={9: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(
+        jnp.asarray(lengths, dtype=jnp.int32),
+        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
+        jnp.asarray(slots, dtype=jnp.int32),
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
+        jnp.zeros((1,), jnp.int32),
+        jnp.ones((1,), jnp.int32),
+        q4,
+        k_new.astype(kv_pages.dtype).reshape(B, Hkv, 1, D),
+        v_new.astype(kv_pages.dtype).reshape(B, Hkv, 1, D),
+        kv_pages,
+    )
+    return out.reshape(B, Hq, D).astype(q.dtype), kv_out
+
+
 @functools.partial(
-    jax.jit, static_argnames=("pages_per_block", "interpret")
+    jax.jit, static_argnames=("pages_per_block", "interpret", "fuse_heads")
 )
 def paged_decode_fused_kernel(
     q: jnp.ndarray,  # [B, Hq, D]
@@ -857,6 +1064,7 @@ def paged_decode_fused_kernel(
     pages_per_block: int | None = None,
     interpret: bool = False,
     kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] int8 pool
+    fuse_heads: bool = False,  # heads-batched variant; bf16 only
 ):
     """Fused decode step attention: returns ``(attn_out [B, Hq, D],
     kv_pages)`` — plus the updated ``kv_scales`` when quantized — where
@@ -868,6 +1076,15 @@ def paged_decode_fused_kernel(
         raise ValueError(f"Hq={Hq} must divide by Hkv={Hkv}")
     G = Hq // Hkv
     quantized = kv_scales is not None
+    if fuse_heads:
+        if quantized:
+            raise NotImplementedError(
+                "fuse_heads does not support int8 pools yet"
+            )
+        return _fused_decode_mh(
+            q, k_new, v_new, kv_pages, slots, page_table, lengths, layer,
+            pages_per_block=pages_per_block, interpret=interpret,
+        )
     page_table, ppb, padded = _block_geometry(
         page_table, page, pages_per_block,
         multiple=_rpp(page) if quantized else 1,

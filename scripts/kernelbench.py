@@ -112,6 +112,9 @@ def main() -> int:
         "fused_int8": lambda: paged_decode_fused_kernel(
             q, kn, kn, kv8, slots, ptb, lens, 0, kv_scales=scales,
             interpret=interp),
+        "fused_bf16_mh": lambda: paged_decode_fused_kernel(
+            q, kn, kn, kv16, slots, ptb, lens, 0, interpret=interp,
+            fuse_heads=True),
     }
     for name, thunk in cases.items():
         try:
@@ -124,10 +127,11 @@ def main() -> int:
         for k in ("pool", "fused")
         if f"{k}_bf16" in ms and f"{k}_int8" in ms
     }
-    if "pool_bf16_mh" in ms and "pool_bf16" in ms:
-        out["mh_vs_per_head"] = round(
-            ms["pool_bf16"] / ms["pool_bf16_mh"], 3
-        )
+    out["mh_vs_per_head"] = {
+        k: round(ms[f"{k}_bf16"] / ms[f"{k}_bf16_mh"], 3)
+        for k in ("pool", "fused")
+        if f"{k}_bf16" in ms and f"{k}_bf16_mh" in ms
+    }
     # HBM bytes the bf16 pool kernel must move per launch (K+V context
     # reads) — the bandwidth-bound lower bound for decode attention.
     if "pool_bf16" in ms:

@@ -539,3 +539,32 @@ class TestPoolKernelFusedHeads:
                 fuse_heads=True,
                 kv_scales=jnp.ones(kv.shape[:-1], jnp.float32),
             )
+
+
+class TestFusedHeadsDecode:
+    """Heads-batched fused decode (``fuse_heads=True``): the write+attend
+    contract must match the per-head fused kernel exactly — pool row
+    writes included."""
+
+    @pytest.mark.parametrize("layer", [0, 1])
+    def test_matches_per_head_fused(self, layer):
+        from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
+
+        helper = TestPagedDecodeFused()
+        args = helper._setup(jax.random.PRNGKey(7), B=3, Hq=8, Hkv=2, maxp=4)
+        # Zero the MIDDLE row: the batch then covers inactive (no write,
+        # zero output), length==1 (zero-iteration block loop — the whole
+        # context is the current token), and multi-block rows at once.
+        q, k_new, v_new, kv, slots, pt, lengths = args
+        lengths = lengths.at[1].set(0)
+        args = (q, k_new, v_new, kv, slots, pt, lengths)
+        want_attn, want_kv = paged_decode_fused_kernel(
+            *args, layer, interpret=True
+        )
+        got_attn, got_kv = paged_decode_fused_kernel(
+            *args, layer, interpret=True, fuse_heads=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_attn), np.asarray(want_attn), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_array_equal(np.asarray(got_kv), np.asarray(want_kv))
