@@ -405,6 +405,59 @@ class _MhBlockCopy:
             c.wait()
 
 
+class _MhScaleCopy:
+    """All-heads analog of ``_ScaleCopy``: one strided DMA per page moves
+    the ``(Hkv, 1, 128)`` scale-row slab for every head."""
+
+    def __init__(self, scale_rows, which, layer, buf, sem, page_table_ref,
+                 flat_offset, n_pages, page):
+        src = scale_rows.at[which, layer]  # [Hkv, R, 128]
+        rpp = 128 // page
+        self._copies = [
+            pltpu.make_async_copy(
+                src.at[:, pl.ds(page_table_ref[flat_offset + i] // rpp, 1)],
+                buf.at[:, pl.ds(i, 1)],
+                sem,
+            )
+            for i in range(n_pages)
+        ]
+
+    def start(self):
+        for c in self._copies:
+            c.start()
+
+    def wait(self):
+        for c in self._copies:
+            c.wait()
+
+
+def _mh_lane_scales(rows, page_table_ref, off, page: int, ppb: int):
+    """``(Hkv, 1, ppb·page)`` per-token scales from staged all-heads rows
+    ``(Hkv, ppb, 128)``. Identical rotation/select scheme to
+    ``_lane_scales`` but vector shapes keep the head axis OUTER and the
+    sliced axis in the MIDDLE — ``(Hkv, 1, 128)`` slices avoid every
+    relayout class the single-head path had to dodge, and all heads
+    share one rotation (their rows have identical lane offsets)."""
+    rpp = 128 // page
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 128), 2)
+    chunks = []
+    for c in range(ppb // rpp):
+        acc = None
+        for j in range(rpp):
+            i = c * rpp + j
+            pid = page_table_ref[off + i]
+            src_off = jax.lax.rem(pid, rpp) * page
+            dst = j * page
+            r = jax.lax.slice_in_dim(rows, i, i + 1, axis=1)  # (Hkv, 1, 128)
+            r = pltpu.roll(r, jnp.mod(dst - src_off, 128), 2)
+            sel = (lane >= dst) & (lane < dst + page)
+            acc = jnp.where(sel, r, acc) if acc is not None else jnp.where(
+                sel, r, 0.0
+            )
+        chunks.append(acc)
+    return chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks, axis=2)
+
+
 def _mh_block_loop(
     *,
     b,
@@ -428,6 +481,10 @@ def _mh_block_loop(
     batch_size: int,
     num_kv_heads: int,
     min_length: int,  # lengths_ref value below which a row has no HBM work
+    scales_hbm=None,  # ANY [2, L, Hkv, R, 128] rows (_scale_rows); int8 pools
+    ks_buf=None,  # VMEM [2, Hkv, ppb, 128] f32 staged all-heads rows
+    vs_buf=None,
+    s_sems=None,  # DMA [2, 2]
 ):
     """The heads-batched analog of ``_run_block_loop``: one program per
     SEQUENCE, ``(Hkv, G, ·)`` batched MXU contractions, chain-prefetched
@@ -443,15 +500,28 @@ def _mh_block_loop(
     GQA group axis rides implicitly in ``q``'s shape.)"""
     bk = page * pages_per_block
     Hkv = num_kv_heads
+    quantized = scales_hbm is not None
 
     def block_copies(bb, ii, slot):
         off = bb * pages_per_seq + ii * pages_per_block
-        return [
+        copies = [
             _MhBlockCopy(kv_hbm, 0, layer, k_buf.at[slot], sems.at[slot, 0],
                          page_table_ref, off, pages_per_block),
             _MhBlockCopy(kv_hbm, 1, layer, v_buf.at[slot], sems.at[slot, 1],
                          page_table_ref, off, pages_per_block),
         ]
+        if quantized:
+            copies.append(
+                _MhScaleCopy(scales_hbm, 0, layer, ks_buf.at[slot],
+                             s_sems.at[slot, 0], page_table_ref, off,
+                             pages_per_block, page)
+            )
+            copies.append(
+                _MhScaleCopy(scales_hbm, 1, layer, vs_buf.at[slot],
+                             s_sems.at[slot, 1], page_table_ref, off,
+                             pages_per_block, page)
+            )
+        return copies
 
     def next_indices(i):
         """Grid-order successor of block ``i`` of program ``b``, skipping
@@ -501,6 +571,8 @@ def _mh_block_loop(
 
         cs = block_copies(b, i, slot)
         cs[0].wait()
+        if quantized:
+            cs[2].wait()
         # (Hkv, ppb, page, D) → (Hkv, bk, D): middle collapse, minor
         # dim untouched — a supported relayout-free reshape.
         k = k_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
@@ -509,6 +581,11 @@ def _mh_block_loop(
             dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
+        if quantized:
+            soff = b * pages_per_seq + i * pages_per_block
+            s = s * _mh_lane_scales(
+                ks_buf[slot], page_table_ref, soff, page, pages_per_block
+            )
         pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
         s = jnp.where(pos < hbm_len, s, _MASK)
 
@@ -521,6 +598,11 @@ def _mh_block_loop(
         m_scr[...] = m_new
 
         cs[1].wait()
+        if quantized:
+            cs[3].wait()
+            p = p * _mh_lane_scales(
+                vs_buf[slot], page_table_ref, soff, page, pages_per_block
+            )
         v = v_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
         pv = jax.lax.dot_general(  # (Hkv, G, D)
             p, v,
@@ -540,20 +622,27 @@ def _mh_kernel(
     layer_ref,  # SMEM [1]
     buffer_index_ref,  # SMEM [1]
     init_flag_ref,  # SMEM [1]
-    *refs,  # q_ref, kv_hbm, o_ref, m/l/acc scratch, k/v bufs, sems
+    *refs,  # q_ref, kv_hbm[, scale_rows], o_ref, scratch — unpacked by flag
     page: int,
     pages_per_block: int,
     pages_per_seq: int,
     batch_size: int,
     num_kv_heads: int,
     group: int,
+    quantized: bool,
 ):
     """Heads-fused read-only pool attention: grid ``(B,)`` (see
     ``_mh_block_loop``). Opt-in via ``fuse_heads=True`` until
     Mosaic-verified on hardware — the 3D batched-dot shapes are exactly
     the kind interpret mode and StableHLO AOT accept but real lowering
     may not (see _scale_rows)."""
-    q_ref, kv_hbm, o_ref, m_scr, l_scr, acc_scr, k_buf, v_buf, sems = refs
+    if quantized:
+        (q_ref, kv_hbm, scales_hbm, o_ref,
+         m_scr, l_scr, acc_scr, k_buf, v_buf, ks_buf, vs_buf, sems,
+         s_sems) = refs
+    else:
+        q_ref, kv_hbm, o_ref, m_scr, l_scr, acc_scr, k_buf, v_buf, sems = refs
+        scales_hbm = ks_buf = vs_buf = s_sems = None
     b = pl.program_id(0)
     layer = layer_ref[0]
     length = lengths_ref[b]
@@ -573,6 +662,8 @@ def _mh_kernel(
             page=page, pages_per_block=pages_per_block,
             pages_per_seq=pages_per_seq, batch_size=batch_size,
             num_kv_heads=num_kv_heads, min_length=1,
+            scales_hbm=scales_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
+            s_sems=s_sems,
         )
         out = acc_scr[...] / l_scr[...]
         o_ref[...] = out.reshape(Hkv * G, -1).astype(o_ref.dtype)
@@ -838,13 +929,10 @@ def paged_attention_pool_kernel(
     G = Hq // Hkv
     quantized = kv_scales is not None
     if fuse_heads:
-        if quantized:
-            raise NotImplementedError(
-                "fuse_heads does not support int8 pools yet"
-            )
         return _pool_kernel_mh(
             q, kv_pages, page_table, lengths, layer,
             pages_per_block=pages_per_block, interpret=interpret,
+            kv_scales=kv_scales,
         )
     page_table, ppb, padded = _block_geometry(
         page_table, page, pages_per_block,
@@ -916,6 +1004,7 @@ def paged_attention_pool_kernel(
 def _pool_kernel_mh(
     q, kv_pages, page_table, lengths, layer,
     pages_per_block: int | None = None, interpret: bool = False,
+    kv_scales=None,
 ):
     """Heads-batched pool attention wrapper (see ``_mh_kernel``). Smaller
     default blocks than the per-head kernel: each staged block is
@@ -924,9 +1013,13 @@ def _pool_kernel_mh(
     B, Hq, D = q.shape
     _, _, Hkv, _, page, _ = kv_pages.shape
     G = Hq // Hkv
+    quantized = kv_scales is not None
     if pages_per_block is None:
         pages_per_block = max(1, -(-128 // page))
-    page_table, ppb, padded = _block_geometry(page_table, page, pages_per_block)
+    page_table, ppb, padded = _block_geometry(
+        page_table, page, pages_per_block,
+        multiple=_rpp(page) if quantized else 1,
+    )
 
     scale = 1.0 / (D ** 0.5)
     q4 = (q.astype(jnp.float32) * scale).reshape(B, Hq, 1, D)
@@ -940,21 +1033,43 @@ def _pool_kernel_mh(
         batch_size=B,
         num_kv_heads=Hkv,
         group=G,
+        quantized=quantized,
     )
+    in_specs = [q_spec, pl.BlockSpec(memory_space=pl.ANY)]
+    scratch = [
+        pltpu.VMEM((Hkv, G, D), jnp.float32),
+        pltpu.VMEM((Hkv, G, D), jnp.float32),
+        pltpu.VMEM((Hkv, G, D), jnp.float32),
+        pltpu.VMEM((2, Hkv, ppb, page, D), kv_pages.dtype),
+        pltpu.VMEM((2, Hkv, ppb, page, D), kv_pages.dtype),
+    ]
+    if quantized:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        scratch += [
+            pltpu.VMEM((2, Hkv, ppb, 128), jnp.float32),
+            pltpu.VMEM((2, Hkv, ppb, 128), jnp.float32),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
+    if quantized:
+        scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(B,),
-        in_specs=[q_spec, pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=in_specs,
         out_specs=q_spec,
-        scratch_shapes=[
-            pltpu.VMEM((Hkv, G, D), jnp.float32),
-            pltpu.VMEM((Hkv, G, D), jnp.float32),
-            pltpu.VMEM((Hkv, G, D), jnp.float32),
-            pltpu.VMEM((2, Hkv, ppb, page, D), kv_pages.dtype),
-            pltpu.VMEM((2, Hkv, ppb, page, D), kv_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
+    args = [
+        jnp.asarray(lengths, dtype=jnp.int32),
+        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
+        jnp.zeros((1,), jnp.int32),
+        jnp.ones((1,), jnp.int32),
+        q4,
+        kv_pages,
+    ]
+    if quantized:
+        args.append(_scale_rows(kv_scales))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -963,15 +1078,7 @@ def _pool_kernel_mh(
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,
-    )(
-        jnp.asarray(lengths, dtype=jnp.int32),
-        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1),
-        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
-        jnp.zeros((1,), jnp.int32),
-        jnp.ones((1,), jnp.int32),
-        q4,
-        kv_pages,
-    )
+    )(*args)
     return out.reshape(B, Hq, D).astype(q.dtype)
 
 

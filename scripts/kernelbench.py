@@ -115,6 +115,9 @@ def main() -> int:
         "fused_bf16_mh": lambda: paged_decode_fused_kernel(
             q, kn, kn, kv16, slots, ptb, lens, 0, interpret=interp,
             fuse_heads=True),
+        "pool_int8_mh": lambda: paged_attention_pool_kernel(
+            q, kv8, ptb, lens, 0, kv_scales=scales, interpret=interp,
+            fuse_heads=True),
     }
     for name, thunk in cases.items():
         try:

@@ -529,14 +529,38 @@ class TestPoolKernelFusedHeads:
             rtol=2e-2, atol=2e-2,
         )
 
-    def test_int8_refused(self):
+    @pytest.mark.parametrize("layer", [0, 1])
+    def test_int8_matches_per_head_kernel(self, layer):
         from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+        from radixmesh_tpu.ops.quant import quantize_kv
 
-        q, kv, pt, lengths = self._setup(jax.random.PRNGKey(1))
+        q, kv, pt, lengths = self._setup(jax.random.PRNGKey(5))
+        kv8, scales = quantize_kv(
+            kv.reshape(*kv.shape[:3], -1, kv.shape[-1]), axis=-1
+        )
+        kv8 = kv8.reshape(kv.shape).astype(jnp.int8)
+        scales = scales.reshape(kv.shape[:-1])
+        want = paged_attention_pool_kernel(
+            q, kv8, pt, lengths, layer, interpret=True, kv_scales=scales
+        )
+        got = paged_attention_pool_kernel(
+            q, kv8, pt, lengths, layer, interpret=True, kv_scales=scales,
+            fuse_heads=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_fused_int8_refused(self):
+        from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
+
+        q, kv, pt, lengths = self._setup(jax.random.PRNGKey(1), B=3)
+        kn = jnp.zeros((3, 2, 32), jnp.float32)
+        slots = jnp.zeros((3,), jnp.int32)
         with pytest.raises(NotImplementedError):
-            paged_attention_pool_kernel(
-                q, kv.astype(jnp.int8), pt, lengths, 0, interpret=True,
-                fuse_heads=True,
+            paged_decode_fused_kernel(
+                q[:3], kn, kn, kv.astype(jnp.int8), slots, pt[:3],
+                lengths[:3], 0, interpret=True, fuse_heads=True,
                 kv_scales=jnp.ones(kv.shape[:-1], jnp.float32),
             )
 
