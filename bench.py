@@ -320,7 +320,10 @@ def _emit(full: dict, aot: dict, probe_diags: list[dict],
                           "vs_dense_same_shape", "error")
                 if full.get(k) is not None
             }
-            prior["tpu_probe"] = probe_diags
+            # Keep the RECORDING run's probe evidence (the attempts that
+            # actually reached the chip) and append the fresh failures
+            # separately — the artifact's probe history is append-only.
+            prior["tpu_probe_latest"] = probe_diags
             prior["probe_windows"] = windows
             full = prior
     with open(full_path, "w") as fh:

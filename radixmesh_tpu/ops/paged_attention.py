@@ -914,7 +914,7 @@ def paged_attention_pool_kernel(
     pages_per_block: int | None = None,
     interpret: bool = False,
     kv_scales: jnp.ndarray | None = None,  # [2, L, Hkv, P, page] (int8 pool)
-    fuse_heads: bool = False,  # heads-batched variant (_mh_kernel); bf16 only
+    fuse_heads: bool = False,  # heads-batched variant (_mh_kernel); bf16 + int8
 ) -> jnp.ndarray:
     """Read-only entry: the whole (multi-layer) pool rides in HBM untouched
     and the kernel DMAs only ``layer``'s pages — so a scan-over-layers
