@@ -1553,6 +1553,13 @@ class MeshCache:
                     "groups": set(),
                     "expect": set(self.hier.nonempty_groups(self._my_alive())),
                     "created": now,
+                    # A round is only valid for the membership it polled: a
+                    # voter that dies mid-round could numerically substitute
+                    # for a live node that refused (its votes persist while
+                    # the alive count shrinks), so a view-epoch change
+                    # discards the round instead of finishing it — the next
+                    # GC interval re-polls the surviving membership.
+                    "epoch": self.view.epoch,
                 }
                 self._gc_pending[logic_id] = round_
             self._broadcast(
@@ -1713,7 +1720,14 @@ class MeshCache:
         group reported → unanimity check against the CURRENT alive count.
         Caller holds the lock."""
         round_ = self._gc_pending.get(logic_id)
-        if round_ is None or not round_["groups"] >= round_["expect"]:
+        if round_ is None:
+            return
+        if round_["epoch"] != self.view.epoch:
+            # Membership changed since the poll went out: the tally mixes
+            # votes from a dead membership — discard, re-poll next interval.
+            del self._gc_pending[logic_id]
+            return
+        if not round_["groups"] >= round_["expect"]:
             return
         del self._gc_pending[logic_id]
         n_alive = max(1, len(self.view.alive))

@@ -85,7 +85,12 @@ def run_ring(
                 # One tick origination (the ticker's immediate first tick
                 # satisfies the barrier), then none during the measured
                 # phases — so the send counters observe only data frames.
-                tick_interval_s=120.0,
+                # The interval must exceed the WHOLE sweep budget (300 s
+                # subprocess timeout + startup), not just the expected
+                # runtime: a slow CI run crossing a tick boundary would add
+                # TICK sends to the counters and flake the exact-equality
+                # assertions in tests/test_ringscale.py.
+                tick_interval_s=3600.0,
                 gc_interval_s=600.0,
                 failure_timeout_s=600.0,  # many threads contend; no false deaths
                 page_size=PAGE,
