@@ -256,6 +256,21 @@ def aot_main() -> None:
         "chunk_prefill_int8": lambda: paged_chunk_attention_kernel(
             qc, kc, kc, kv8, pt, lens, lens + C, 0, kv_scales=scales
         ),
+        # Per-head-grid fallbacks (fuse_heads=False): still a production
+        # path for huge-Hkv configs, so their lowering stays checked too.
+        "pool_kernel_per_head": lambda: paged_attention_pool_kernel(
+            q, kv, pt, lens, 0, fuse_heads=False
+        ),
+        "pool_kernel_int8_per_head": lambda: paged_attention_pool_kernel(
+            q, kv8, pt, lens, 0, kv_scales=scales, fuse_heads=False
+        ),
+        "fused_decode_per_head": lambda: paged_decode_fused_kernel(
+            q, kn, kn, kv, slots, pt, lens, 0, fuse_heads=False
+        ),
+        "fused_decode_int8_per_head": lambda: paged_decode_fused_kernel(
+            q, kn, kn, kv8, slots, pt, lens, 0, kv_scales=scales,
+            fuse_heads=False,
+        ),
     }
     out: dict = {"ok": True, "target": "tpu", "kernels": {}}
     for name, thunk in cases.items():

@@ -222,18 +222,9 @@ class PagedKVPool:
 
             if quant not in KV_QUANT_DTYPES:
                 raise ValueError(f"unknown kv quantization {quant!r}")
-            if 128 % page_size:
-                # The quantized Pallas kernels stage per-token scales as
-                # 128-slot lane rows (ops/paged_attention.py::_scale_rows),
-                # which requires the page size to divide 128. Fail here at
-                # pool construction — not inside a jit trace — so a
-                # misconfigured deployment dies at setup with a clear
-                # message.
-                raise ValueError(
-                    f"quantized pools need a page_size dividing 128, got "
-                    f"{page_size} (the int8 paged-attention kernels tile "
-                    f"per-token scales as 128-slot rows)"
-                )
+            # No page-size constraint: the round-5 kernels gather the page
+            # table's scales in XLA (_prep_scales) instead of staging
+            # 128-slot scale rows in-kernel, so any page size works.
             dtype = KV_QUANT_DTYPES[quant]
         self.dtype = dtype
         self.allocator = SlotAllocator(num_slots, page_size)

@@ -209,10 +209,12 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     return params
 
 
-def param_logical_axes(cfg: ModelConfig) -> dict:
+def param_logical_axes(cfg: ModelConfig, params: dict | None = None) -> dict:
     """Logical axis names per parameter, mapped to mesh axes by
     ``parallel/sharding.py`` (tp shards "q_heads"/"kv_heads"/"ffn"/"vocab",
-    everything else replicates)."""
+    everything else replicates). Pass ``params`` to also cover the
+    ``<name>_s`` scale leaves of W8A16-quantized weights (``ops/wquant.py``
+    — each scale shards like its weight's OUTPUT axis)."""
     axes = {
         "embed": ("vocab", "embed"),
         "final_norm": ("embed",),
@@ -234,6 +236,17 @@ def param_logical_axes(cfg: ModelConfig) -> dict:
         axes["layers"]["bv"] = ("layer", "kv_heads")
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
+    if params is not None:
+        # Each W8A16 scale shards like its weight's OUTPUT (last) axis —
+        # derived from the weight's own entry so a layout change can't
+        # drift the two apart.
+        for name in list(axes["layers"]):
+            if name + "_s" in params.get("layers", {}):
+                axes["layers"][name + "_s"] = ("layer", axes["layers"][name][-1])
+        if "embed_s" in params:
+            axes["embed_s"] = ("vocab",)
+        if "lm_head_s" in params:
+            axes["lm_head_s"] = ("vocab",)
     return axes
 
 
@@ -242,11 +255,45 @@ def param_logical_axes(cfg: ModelConfig) -> dict:
 _PREC = jax.lax.Precision.HIGHEST
 
 
+def _wmm(lp: dict, name: str, eq: str, x: jnp.ndarray, reshape=None,
+         **einsum_kw):
+    """Dense matmul honoring W8A16 storage (``ops/wquant.py``): int8
+    weights feed the MXU as bf16 (only HBM *streaming* shrinks — compute
+    precision is unchanged) and the per-out-channel scale applies to the
+    output, which is exact for per-out-channel quantization."""
+    w = lp[name]
+    if w.dtype == jnp.int8:
+        wm = w.astype(x.dtype)
+        if reshape is not None:
+            wm = wm.reshape(reshape)
+        y = jnp.einsum(eq, x, wm, precision=_PREC, **einsum_kw)
+        y = y * lp[name + "_s"]
+        # The f32 scale would otherwise promote the whole activation
+        # stream to f32 from the first quantized layer on — cast back
+        # unless the caller asked for a widened output (the logits head).
+        if "preferred_element_type" not in einsum_kw:
+            y = y.astype(x.dtype)
+        return y
+    if reshape is not None:
+        w = w.reshape(reshape)
+    return jnp.einsum(eq, x, w, precision=_PREC, **einsum_kw)
+
+
+def _embed_lookup(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Embedding gather honoring W8A16 storage: int8 rows dequantize by
+    their per-row scale right after the (int8-narrow) gather."""
+    e = params["embed"]
+    if e.dtype == jnp.int8:
+        x = e[tokens].astype(jnp.float32) * params["embed_s"][tokens][..., None]
+        return x.astype(params["final_norm"].dtype)
+    return e[tokens]
+
+
 def _qkv(lp: dict, x: jnp.ndarray, cfg: ModelConfig):
     """x: [B, S, H] → q [B,S,Hq,D], k/v [B,S,Hkv,D]."""
-    q = jnp.einsum("bsh,hd->bsd", x, lp["wq"], precision=_PREC)
-    k = jnp.einsum("bsh,hd->bsd", x, lp["wk"], precision=_PREC)
-    v = jnp.einsum("bsh,hd->bsd", x, lp["wv"], precision=_PREC)
+    q = _wmm(lp, "wq", "bsh,hd->bsd", x)
+    k = _wmm(lp, "wk", "bsh,hd->bsd", x)
+    v = _wmm(lp, "wv", "bsh,hd->bsd", x)
     if cfg.qkv_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -260,16 +307,30 @@ def _qkv(lp: dict, x: jnp.ndarray, cfg: ModelConfig):
 
 
 def _mlp(lp: dict, x: jnp.ndarray) -> jnp.ndarray:
-    gate = jax.nn.silu(jnp.einsum("bsh,hi->bsi", x, lp["w_gate"], precision=_PREC))
-    up = jnp.einsum("bsh,hi->bsi", x, lp["w_up"], precision=_PREC)
-    return jnp.einsum("bsi,ih->bsh", gate * up, lp["w_down"], precision=_PREC)
+    gate = jax.nn.silu(_wmm(lp, "w_gate", "bsh,hi->bsi", x))
+    up = _wmm(lp, "w_up", "bsh,hi->bsi", x)
+    return _wmm(lp, "w_down", "bsi,ih->bsh", gate * up)
+
+
+def _attn_out(lp: dict, attn: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """The wo projection shared by every forward variant: attn is
+    [B, S, Hq·D] (or already [B, S, Hq, D])."""
+    B, S = attn.shape[:2]
+    return _wmm(
+        lp, "wo", "bsqd,qdh->bsh",
+        attn.reshape(B, S, cfg.n_heads, cfg.head_dim),
+        reshape=(cfg.n_heads, cfg.head_dim, cfg.hidden),
+    )
 
 
 def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.einsum(
-        "bsh,hv->bsv", x, head, preferred_element_type=jnp.float32, precision=_PREC
+    if cfg.tie_embeddings:
+        name, eq = "embed", "bsh,vh->bsv"
+    else:
+        name, eq = "lm_head", "bsh,hv->bsv"
+    return _wmm(
+        params, name, eq, x, preferred_element_type=jnp.float32
     )
 
 
@@ -297,7 +358,7 @@ def prefill_forward(
     sketch, ``radix_cache.py:439-519``).
     """
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-    x = params["embed"][tokens]
+    x = _embed_lookup(params, tokens)
     p_max = cached_k.shape[2]
     s_new = tokens.shape[1]
     pad = p_max - prefix_lengths  # [B] front padding per row
@@ -315,12 +376,7 @@ def prefill_forward(
         k_ctx = jnp.concatenate([ck, k], axis=1)  # [B, P_max + S, Hkv, D]
         v_ctx = jnp.concatenate([cv, v], axis=1)
         attn = attend_prefill(q, k_ctx, v_ctx, attn_pos, kv_end, kv_start=pad)
-        x = x + jnp.einsum(
-            "bsqd,qdh->bsh",
-            attn.reshape(attn.shape[0], attn.shape[1], cfg.n_heads, cfg.head_dim),
-            lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
-            precision=_PREC,
-        )
+        x = x + _attn_out(lp, attn, cfg)
         h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(lp, h2)
         return x, (k, v)
@@ -366,7 +422,7 @@ def prefill_forward_sp(
 
     seq_sharded = NamedSharding(mesh, PartitionSpec(None, axis))
     tokens = jax.lax.with_sharding_constraint(tokens, seq_sharded)
-    x = params["embed"][tokens]
+    x = _embed_lookup(params, tokens)
 
     def layer(x, xs):
         lp = xs
@@ -375,12 +431,7 @@ def prefill_forward_sp(
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         attn = ring_self_attention(q, k, v, mesh, axis=axis)
-        x = x + jnp.einsum(
-            "bsqd,qdh->bsh",
-            attn.reshape(attn.shape[0], attn.shape[1], cfg.n_heads, cfg.head_dim),
-            lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
-            precision=_PREC,
-        )
+        x = x + _attn_out(lp, attn, cfg)
         h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(lp, h2)
         return x, (k, v)
@@ -432,7 +483,7 @@ def prefill_chunk_paged(
     ``kv_scale`` when the pool is int8-quantized.
     """
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-    x = params["embed"][tokens]  # [B, C, H]
+    x = _embed_lookup(params, tokens)  # [B, C, H]
     num_slots = kv_pool.shape[3]
     pages_shape = (
         2, cfg.n_layers, cfg.n_kv_heads,
@@ -481,12 +532,7 @@ def prefill_chunk_paged(
             mesh=mesh,
             interpret=interpret,
         )
-        x = x + jnp.einsum(
-            "bsqd,qdh->bsh",
-            attn.reshape(attn.shape[0], attn.shape[1], cfg.n_heads, cfg.head_dim),
-            lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
-            precision=_PREC,
-        )
+        x = x + _attn_out(lp, attn, cfg)
         h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(lp, h2)
         if kv_scale is not None:
@@ -705,7 +751,7 @@ def _decode_core(
 ):
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     positions = lengths - 1  # [B]
-    x = params["embed"][tokens][:, None, :]  # [B, 1, H]
+    x = _embed_lookup(params, tokens)[:, None, :]  # [B, 1, H]
     B = tokens.shape[0]
     num_slots = kv_pool.shape[3]
     pages_shape = (
@@ -761,11 +807,10 @@ def _decode_core(
             )
         kv_pool = kv_pages.reshape(2, cfg.n_layers, cfg.n_kv_heads, num_slots,
                                    cfg.head_dim)
-        x = x + jnp.einsum(
-            "bqd,qdh->bh",
+        x = x + _wmm(
+            lp, "wo", "bqd,qdh->bh",
             attn.reshape(B, cfg.n_heads, cfg.head_dim),
-            lp["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.hidden),
-            precision=_PREC,
+            reshape=(cfg.n_heads, cfg.head_dim, cfg.hidden),
         )[:, None, :]
         h2 = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(lp, h2)

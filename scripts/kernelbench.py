@@ -1,5 +1,6 @@
 """Kernel-level micro-benchmark: the Pallas paged-attention entry points
-timed in isolation (no model around them), bf16 and int8, pool + fused.
+timed in isolation (no model around them), bf16 and int8, pool + fused +
+chunk-prefill.
 
 Exists because whole-step numbers hide where kernel time goes: the int8
 fused-decode regression (0.57x bf16) was invisible until the pool kernel
@@ -7,6 +8,13 @@ measured at parity (0.95x) while the fused kernel didn't — the delta was
 the in-kernel scale-row RMW, removed in favor of a wrapper-side scatter.
 Run this FIRST when a tunnel window opens; it answers in ~2 minutes
 whether a kernel change helped, where bench.py needs ~15.
+
+Round-5 axes (VERDICT r4 next-steps #1-#3): every decode case runs with
+BOTH page-table layouts — ``run`` (consecutive page runs, the common
+radix-allocator case, takes the coalesced one-descriptor-per-block DMA
+path) and ``perm`` (fully permuted, per-page fallback) — and with both
+grids (heads-batched default vs per-head), bf16 and int8 (prepared
+scales). The chunk-prefill kernel gets its first on-chip timing.
 
 Prints one JSON line; ``--out FILE`` also writes it (suggested:
 ``KERNELBENCH_r{N}.json``). CPU runs use interpret mode implicitly via
@@ -37,6 +45,10 @@ def main() -> int:
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--page", type=int, default=16)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--chunk", type=int, default=512)
+    ap.add_argument("--chunk-batch", type=int, default=8)
+    ap.add_argument("--skip-per-head", action="store_true",
+                    help="decode cases: heads-batched grid only")
     ap.add_argument("--interpret", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
@@ -50,6 +62,7 @@ def main() -> int:
 
     from radixmesh_tpu.ops.paged_attention import (
         paged_attention_pool_kernel,
+        paged_chunk_attention_kernel,
         paged_decode_fused_kernel,
     )
     from radixmesh_tpu.ops.quant import quantize_kv
@@ -67,14 +80,24 @@ def main() -> int:
     kv16 = jnp.asarray(kv.reshape(2, L, Hkv, P, page, D), jnp.bfloat16)
     q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.bfloat16)
     kn = jnp.asarray(rng.standard_normal((B, Hkv, D)), jnp.bfloat16)
-    # Permuted tables = the radix-cache worst case (no page adjacency).
-    ptb_np = rng.permutation(P).reshape(B, ctx // page).astype(np.int32)
-    ptb = jnp.asarray(ptb_np)
+    maxp = ctx // page
+    # Two table layouts. ``run``: each row owns one consecutive page run
+    # (rows themselves shuffled) — what the page-granular slot allocator
+    # produces for a freshly prefilled sequence; every block coalesces to
+    # one descriptor. ``perm``: fully permuted — the adversarial radix
+    # fragmentation case, per-page fallback path.
+    row_order = rng.permutation(B)
+    pt_run_np = np.stack(
+        [np.arange(r * maxp, (r + 1) * maxp, dtype=np.int32) for r in row_order]
+    )
+    pt_perm_np = rng.permutation(P).reshape(B, maxp).astype(np.int32)
+    tables = {
+        "run": (jnp.asarray(pt_run_np), jnp.asarray(
+            pt_run_np[:, -1] * page + (page - 1))),
+        "perm": (jnp.asarray(pt_perm_np), jnp.asarray(
+            pt_perm_np[:, -1] * page + (page - 1))),
+    }
     lens = jnp.full((B,), ctx, jnp.int32)
-    # Each row's current token lives in its LAST table page (the fused
-    # kernel writes k_new/v_new there — slots must follow the permuted
-    # table or the write lands in another row's page).
-    slots = jnp.asarray(ptb_np[:, -1] * page + (page - 1))
     interp = args.interpret
 
     def bench(fn, n=args.iters):
@@ -91,57 +114,84 @@ def main() -> int:
     out = {
         "backend": jax.default_backend(),
         "shape": {"batch": B, "ctx": ctx, "hq": Hq, "hkv": Hkv,
-                  "head_dim": D, "page": page},
+                  "head_dim": D, "page": page, "chunk": args.chunk},
         "ms": {},
     }
+    cases = {}
+    grids = [("mh", True)] if args.skip_per_head else [
+        ("mh", True), ("ph", False)]
+    for tname, (ptb, slots) in tables.items():
+        for gname, fuse in grids:
+            cases[f"pool_bf16_{gname}_{tname}"] = (
+                lambda ptb=ptb, fuse=fuse: paged_attention_pool_kernel(
+                    q, kv16, ptb, lens, 0, interpret=interp, fuse_heads=fuse)
+            )
+            cases[f"pool_int8_{gname}_{tname}"] = (
+                lambda ptb=ptb, fuse=fuse: paged_attention_pool_kernel(
+                    q, kv8, ptb, lens, 0, kv_scales=scales, interpret=interp,
+                    fuse_heads=fuse)
+            )
+            cases[f"fused_bf16_{gname}_{tname}"] = (
+                lambda ptb=ptb, slots=slots, fuse=fuse:
+                paged_decode_fused_kernel(
+                    q, kn, kn, kv16, slots, ptb, lens, 0, interpret=interp,
+                    fuse_heads=fuse)
+            )
+            cases[f"fused_int8_{gname}_{tname}"] = (
+                lambda ptb=ptb, slots=slots, fuse=fuse:
+                paged_decode_fused_kernel(
+                    q, kn, kn, kv8, slots, ptb, lens, 0, kv_scales=scales,
+                    interpret=interp, fuse_heads=fuse)
+            )
+
+    # Chunk-prefill (first on-chip timing — VERDICT r4 missing #2): Bc
+    # rows each attending `ctx` prior pool tokens + a dense causal chunk.
+    Bc, C = args.chunk_batch, args.chunk
+    qc = jnp.asarray(rng.standard_normal((Bc, C, Hq, D)), jnp.bfloat16)
+    kc = jnp.asarray(rng.standard_normal((Bc, C, Hkv, D)), jnp.bfloat16)
+    prior = jnp.full((Bc,), ctx, jnp.int32)
+    for tname in tables:
+        ptb = tables[tname][0][:Bc]
+        cases[f"chunk_bf16_{tname}"] = (
+            lambda ptb=ptb: paged_chunk_attention_kernel(
+                qc, kc, kc, kv16, ptb, prior, prior + C, 0, interpret=interp)
+        )
+        cases[f"chunk_int8_{tname}"] = (
+            lambda ptb=ptb: paged_chunk_attention_kernel(
+                qc, kc, kc, kv8, ptb, prior, prior + C, 0,
+                kv_scales=scales, interpret=interp)
+        )
+
     # EVERY kernel timing is exception-guarded and partial results are
     # always printed/written: tunnel windows are scarce, and this repo's
     # history shows kernels that fail ONLY at on-chip Mosaic compile —
     # one such failure must not discard the numbers already measured.
-    cases = {
-        "pool_bf16": lambda: paged_attention_pool_kernel(
-            q, kv16, ptb, lens, 0, interpret=interp),
-        # Heads-batched candidate: 1/Hkv the DMA issue count (opt-in
-        # until Mosaic-verified; measure FIRST when a window opens).
-        "pool_bf16_mh": lambda: paged_attention_pool_kernel(
-            q, kv16, ptb, lens, 0, interpret=interp, fuse_heads=True),
-        "pool_int8": lambda: paged_attention_pool_kernel(
-            q, kv8, ptb, lens, 0, kv_scales=scales, interpret=interp),
-        "fused_bf16": lambda: paged_decode_fused_kernel(
-            q, kn, kn, kv16, slots, ptb, lens, 0, interpret=interp),
-        "fused_int8": lambda: paged_decode_fused_kernel(
-            q, kn, kn, kv8, slots, ptb, lens, 0, kv_scales=scales,
-            interpret=interp),
-        "fused_bf16_mh": lambda: paged_decode_fused_kernel(
-            q, kn, kn, kv16, slots, ptb, lens, 0, interpret=interp,
-            fuse_heads=True),
-        "pool_int8_mh": lambda: paged_attention_pool_kernel(
-            q, kv8, ptb, lens, 0, kv_scales=scales, interpret=interp,
-            fuse_heads=True),
-    }
     for name, thunk in cases.items():
         try:
             out["ms"][name] = round(bench(thunk), 3)
         except Exception as e:  # noqa: BLE001 — record, keep measuring
             out.setdefault("errors", {})[name] = str(e)[:300]
     ms = out["ms"]
-    out["int8_vs_bf16"] = {
-        k: round(ms[f"{k}_bf16"] / ms[f"{k}_int8"], 3)
-        for k in ("pool", "fused")
-        if f"{k}_bf16" in ms and f"{k}_int8" in ms
+
+    def ratio(a, b):
+        return round(ms[a] / ms[b], 3) if a in ms and b in ms else None
+
+    out["summary"] = {
+        # >1.0 means the second (new/cheaper) case is faster.
+        "coalesce_gain_pool": ratio("pool_bf16_mh_perm", "pool_bf16_mh_run"),
+        "coalesce_gain_fused": ratio("fused_bf16_mh_perm", "fused_bf16_mh_run"),
+        "mh_gain_pool": ratio("pool_bf16_ph_run", "pool_bf16_mh_run"),
+        "mh_gain_fused": ratio("fused_bf16_ph_run", "fused_bf16_mh_run"),
+        "int8_vs_bf16_pool": ratio("pool_bf16_mh_run", "pool_int8_mh_run"),
+        "int8_vs_bf16_fused": ratio("fused_bf16_mh_run", "fused_int8_mh_run"),
+        "int8_vs_bf16_chunk": ratio("chunk_bf16_run", "chunk_int8_run"),
     }
-    out["mh_vs_per_head"] = {
-        k: round(ms[f"{k}_bf16"] / ms[f"{k}_bf16_mh"], 3)
-        for k in ("pool", "fused")
-        if f"{k}_bf16" in ms and f"{k}_bf16_mh" in ms
-    }
-    # HBM bytes the bf16 pool kernel must move per launch (K+V context
-    # reads) — the bandwidth-bound lower bound for decode attention.
-    if "pool_bf16" in ms:
-        ctx_bytes = B * ctx * Hkv * 2 * D * 2
-        out["pool_bf16_gbps"] = round(
-            ctx_bytes / (ms["pool_bf16"] / 1e3) / 1e9, 1
-        )
+    # Achieved HBM read bandwidth of the best bf16 decode case (K+V
+    # context bytes / time) — the roofline-facing number.
+    ctx_bytes = B * ctx * Hkv * 2 * D * 2
+    for key in ("fused_bf16_mh_run", "pool_bf16_mh_run"):
+        if key in ms:
+            out[f"{key}_gbps"] = round(ctx_bytes / (ms[key] / 1e3) / 1e9, 1)
     line = json.dumps(out)
     print(line, flush=True)
     if args.out:

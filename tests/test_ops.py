@@ -500,7 +500,7 @@ class TestPoolKernelFusedHeads:
 
         q, kv, pt, lengths = self._setup(jax.random.PRNGKey(9))
         want = paged_attention_pool_kernel(
-            q, kv, pt, lengths, layer, interpret=True
+            q, kv, pt, lengths, layer, interpret=True, fuse_heads=False
         )
         got = paged_attention_pool_kernel(
             q, kv, pt, lengths, layer, interpret=True, fuse_heads=True
@@ -518,7 +518,7 @@ class TestPoolKernelFusedHeads:
         lengths = jnp.array([8 * 6, 13], jnp.int32)
         want = paged_attention_pool_kernel(
             q.astype(jnp.bfloat16), kv.astype(jnp.bfloat16), pt, lengths, 0,
-            interpret=True, pages_per_block=2,
+            interpret=True, pages_per_block=2, fuse_heads=False,
         )
         got = paged_attention_pool_kernel(
             q.astype(jnp.bfloat16), kv.astype(jnp.bfloat16), pt, lengths, 0,
@@ -541,7 +541,8 @@ class TestPoolKernelFusedHeads:
         kv8 = kv8.reshape(kv.shape).astype(jnp.int8)
         scales = scales.reshape(kv.shape[:-1])
         want = paged_attention_pool_kernel(
-            q, kv8, pt, lengths, layer, interpret=True, kv_scales=scales
+            q, kv8, pt, lengths, layer, interpret=True, kv_scales=scales,
+            fuse_heads=False,
         )
         got = paged_attention_pool_kernel(
             q, kv8, pt, lengths, layer, interpret=True, kv_scales=scales,
@@ -551,18 +552,44 @@ class TestPoolKernelFusedHeads:
             np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
         )
 
-    def test_fused_int8_refused(self):
+    def test_fused_int8_matches_per_head(self):
+        """Round 5: heads-batched fused decode now supports int8 pools
+        (round 4 raised NotImplementedError) — attn, pool rows, AND scale
+        pool must match the per-head fused kernel bit-for-bit."""
         from radixmesh_tpu.ops.paged_attention import paged_decode_fused_kernel
+        from radixmesh_tpu.ops.quant import quantize_kv
 
-        q, kv, pt, lengths = self._setup(jax.random.PRNGKey(1), B=3)
-        kn = jnp.zeros((3, 2, 32), jnp.float32)
-        slots = jnp.zeros((3,), jnp.int32)
-        with pytest.raises(NotImplementedError):
-            paged_decode_fused_kernel(
-                q[:3], kn, kn, kv.astype(jnp.int8), slots, pt[:3],
-                lengths[:3], 0, interpret=True, fuse_heads=True,
-                kv_scales=jnp.ones(kv.shape[:-1], jnp.float32),
+        rng = np.random.default_rng(17)
+        B, Hq, Hkv, D, page, n_pages, maxp, L = 3, 8, 2, 32, 8, 32, 4, 2
+        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+        k_new = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        v_new = jnp.asarray(rng.normal(size=(B, Hkv, D)), jnp.float32)
+        kvf = rng.normal(size=(2, L, Hkv, n_pages, page, D))
+        kv8, scales = quantize_kv(
+            jnp.asarray(kvf.reshape(2, L, Hkv, -1, D), jnp.float32), axis=-1
+        )
+        kv8 = kv8.reshape(2, L, Hkv, n_pages, page, D).astype(jnp.int8)
+        scales = scales.reshape(2, L, Hkv, n_pages, page)
+        pt = jnp.asarray(
+            rng.permutation(n_pages)[: B * maxp].reshape(B, maxp), jnp.int32
+        )
+        # Inactive row, single-token row, multi-block row.
+        lengths = jnp.asarray([0, 1, page * 2 + 3], jnp.int32)
+        slots = (pt[:, 0] * page).astype(jnp.int32)
+        for layer in range(L):
+            want = paged_decode_fused_kernel(
+                q, k_new, v_new, kv8, slots, pt, lengths, layer,
+                interpret=True, kv_scales=scales, fuse_heads=False,
             )
+            got = paged_decode_fused_kernel(
+                q, k_new, v_new, kv8, slots, pt, lengths, layer,
+                interpret=True, kv_scales=scales, fuse_heads=True,
+            )
+            np.testing.assert_allclose(
+                np.asarray(got[0]), np.asarray(want[0]), rtol=2e-5, atol=2e-5
+            )
+            np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+            np.testing.assert_array_equal(np.asarray(got[2]), np.asarray(want[2]))
 
 
 class TestFusedHeadsDecode:
@@ -583,7 +610,7 @@ class TestFusedHeadsDecode:
         lengths = lengths.at[1].set(0)
         args = (q, k_new, v_new, kv, slots, pt, lengths)
         want_attn, want_kv = paged_decode_fused_kernel(
-            *args, layer, interpret=True
+            *args, layer, interpret=True, fuse_heads=False
         )
         got_attn, got_kv = paged_decode_fused_kernel(
             *args, layer, interpret=True, fuse_heads=True
@@ -592,3 +619,97 @@ class TestFusedHeadsDecode:
             np.asarray(got_attn), np.asarray(want_attn), rtol=2e-5, atol=2e-5
         )
         np.testing.assert_array_equal(np.asarray(got_kv), np.asarray(want_kv))
+
+
+class TestContigCoalescing:
+    """Round-5 run-coalesced block DMAs: the wrapper's per-(row, block)
+    flags (``_contig_flags``) choose between one contiguous descriptor and
+    per-page copies — both paths must produce identical attention, and the
+    flag logic itself is pinned here (a wrong flag on hardware is a silent
+    wrong-data fetch, so the rules get exact-value coverage)."""
+
+    def test_flag_rules(self):
+        from radixmesh_tpu.ops.paged_attention import _contig_flags
+
+        page, ppb, P = 4, 2, 64
+        pt = jnp.asarray(
+            [
+                [10, 11, 12, 13],  # fully consecutive → both blocks flagged
+                [10, 12, 20, 22],  # neither block consecutive
+                [5, 6, 0, 0],      # valid prefix consecutive, pad entries 0
+                [62, 63, 0, 0],    # consecutive but next run out of bounds
+            ],
+            jnp.int32,
+        )
+        # Row 2: only 1.5 pages valid (6 tokens) → block 0's two entries
+        # are both valid-and-consecutive, block 1 is all pad (flagged:
+        # its fetch is masked). Row 3: block 0's run [63, 64) overflows P
+        # at ppb=2? first=62, 62+2=64 <= 64 → in bounds, flagged.
+        lengths = jnp.asarray([16, 16, 6, 8], jnp.int32)
+        flags = np.asarray(
+            _contig_flags(pt, lengths, page, ppb, P)
+        ).reshape(4, 2)
+        np.testing.assert_array_equal(flags[0], [1, 1])
+        np.testing.assert_array_equal(flags[1], [0, 0])
+        # Row 2 block 0: entries (5, 6) consecutive → 1. Block 1: zero
+        # valid entries → every position is pad → flagged (first=0,
+        # 0+2<=64).
+        np.testing.assert_array_equal(flags[2], [1, 1])
+        np.testing.assert_array_equal(flags[3], [1, 1])
+        # Out-of-bounds veto: first + ppb > P must clear the flag even
+        # when entries are consecutive.
+        pt_oob = jnp.asarray([[63, 64, 0, 0]], jnp.int32)
+        flags_oob = np.asarray(
+            _contig_flags(pt_oob, jnp.asarray([8], jnp.int32), page, ppb, P)
+        )
+        np.testing.assert_array_equal(flags_oob, [0, 1])
+
+    @pytest.mark.parametrize("fuse_heads", [False, True])
+    def test_coalesced_matches_fragmented(self, fuse_heads):
+        """Same pool contents reachable through a consecutive table (all
+        blocks coalesce) and a permuted table (no block coalesces) must
+        attend identically — and both must match the jnp oracle."""
+        from radixmesh_tpu.ops.paged_attention import (
+            paged_attention_pool_kernel,
+        )
+
+        rng = np.random.default_rng(23)
+        B, Hq, Hkv, D, page, maxp = 2, 4, 2, 32, 4, 8
+        P = 64
+        L = 1
+        lengths = jnp.asarray([maxp * page, 13], jnp.int32)
+        # Consecutive layout: row 0 pages 8..15, row 1 pages 30..37.
+        pt_run = jnp.asarray(
+            [np.arange(8, 8 + maxp), np.arange(30, 30 + maxp)], jnp.int32
+        )
+        kv = jnp.asarray(rng.normal(size=(2, L, Hkv, P, page, D)), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(B, Hq, D)), jnp.float32)
+        base = paged_attention_pool_kernel(
+            q, kv, pt_run, lengths, 0, interpret=True, fuse_heads=fuse_heads
+        )
+        # Fragmented layout: permute each row's pages and move the data.
+        perm0 = rng.permutation(maxp)
+        perm1 = rng.permutation(maxp)
+        pt_frag = np.zeros((B, maxp), np.int32)
+        kv_frag = np.array(kv)
+        scatter = rng.permutation(np.arange(40, 40 + 2 * maxp))
+        for r, perm in enumerate([perm0, perm1]):
+            for j, src_j in enumerate(perm):
+                dst = scatter[r * maxp + j]
+                pt_frag[r, src_j] = dst
+                kv_frag[:, :, :, dst] = np.asarray(
+                    kv[:, :, :, int(pt_run[r, src_j])]
+                )
+        frag = paged_attention_pool_kernel(
+            q, jnp.asarray(kv_frag), jnp.asarray(pt_frag), lengths, 0,
+            interpret=True, fuse_heads=fuse_heads,
+        )
+        np.testing.assert_allclose(
+            np.asarray(frag), np.asarray(base), rtol=2e-5, atol=2e-5
+        )
+        want = attend_decode_ref(
+            q, kv[0, 0], kv[1, 0], pt_run, lengths
+        )
+        np.testing.assert_allclose(
+            np.asarray(base), np.asarray(want), rtol=2e-5, atol=2e-5
+        )

@@ -1,0 +1,132 @@
+"""W8A16 weight quantization (ops/wquant.py): storage halves, logits stay
+within per-out-channel int8 error, and the serving engine runs end-to-end
+on quantized weights (VERDICT round-4 next-step #7 — the path that puts
+Llama-3-8B on one 16 GB v5e)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.engine import Engine, SamplingParams
+from radixmesh_tpu.models.llama import (
+    ModelConfig,
+    init_params,
+    param_logical_axes,
+    prefill_forward,
+)
+from radixmesh_tpu.ops.wquant import (
+    LAYER_QUANT_WEIGHTS,
+    quantize_params,
+    quantize_weight,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny().replace(dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(11))
+    return cfg, params
+
+
+class TestQuantizeWeight:
+    def test_round_trip_error_bounded(self):
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(64, 96)), jnp.float32)
+        q, s = quantize_weight(w, axis=0)
+        assert q.dtype == jnp.int8
+        assert s.shape == (96,)
+        deq = np.asarray(q, np.float32) * np.asarray(s)[None, :]
+        # Symmetric int8 per-channel: error ≤ scale/2 per element.
+        err = np.abs(deq - np.asarray(w))
+        assert np.all(err <= np.asarray(s)[None, :] * 0.5 + 1e-7)
+
+    def test_outlier_channel_isolated(self):
+        """One huge output channel must not inflate the others' scales."""
+        w = np.ones((8, 4), np.float32)
+        w[:, 2] = 1000.0
+        q, s = quantize_weight(jnp.asarray(w), axis=0)
+        s = np.asarray(s)
+        assert s[2] > 5.0 and np.all(s[[0, 1, 3]] < 0.01)
+
+
+class TestQuantizeParams:
+    def test_leaves_and_scales(self, model):
+        cfg, params = model
+        qp = quantize_params(params)
+        for name in LAYER_QUANT_WEIGHTS:
+            w = qp["layers"][name]
+            assert w.dtype == jnp.int8, name
+            s = qp["layers"][name + "_s"]
+            assert s.shape == w.shape[:1] + w.shape[2:], name
+        assert qp["embed"].dtype == jnp.int8
+        assert qp["embed_s"].shape == (cfg.vocab_size,)
+        assert qp["lm_head"].dtype == jnp.int8
+        assert qp["lm_head_s"].shape == (cfg.vocab_size,)
+        # Norms stay full precision.
+        assert qp["final_norm"].dtype == params["final_norm"].dtype
+        # Idempotent.
+        qp2 = quantize_params(qp)
+        assert qp2["layers"]["wq"] is qp["layers"]["wq"]
+
+    def test_axes_cover_scales(self, model):
+        cfg, params = model
+        qp = quantize_params(params)
+        axes = param_logical_axes(cfg, qp)
+        for name in LAYER_QUANT_WEIGHTS:
+            assert name + "_s" in axes["layers"], name
+        assert axes["lm_head_s"] == ("vocab",)
+        flat_p = jax.tree.leaves(qp)
+        flat_a = jax.tree.leaves(axes, is_leaf=lambda x: isinstance(x, tuple))
+        assert len(flat_p) == len(flat_a)
+
+    def test_logits_close_to_full_precision(self, model):
+        cfg, params = model
+        qp = quantize_params(params)
+        rng = np.random.default_rng(5)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)), jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(12)[None], (2, 12))
+        ck = jnp.zeros((cfg.n_layers, 2, 0, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+        want, _, _ = prefill_forward(
+            params, cfg, tokens, positions, ck, ck, jnp.zeros((2,), jnp.int32)
+        )
+        got, _, _ = prefill_forward(
+            qp, cfg, tokens, positions, ck, ck, jnp.zeros((2,), jnp.int32)
+        )
+        w, g = np.asarray(want), np.asarray(got)
+        # Per-channel int8 weights: logits track within a small fraction
+        # of the logit RANGE (quantization noise accumulates over layers).
+        span = np.abs(w).max()
+        assert np.abs(g - w).max() < 0.05 * span
+        # Greedy decisions overwhelmingly agree.
+        agree = (w.argmax(-1) == g.argmax(-1)).mean()
+        assert agree >= 0.9
+
+
+class TestEngineWeightQuant:
+    def test_generate_runs_and_tracks_bf16(self, model):
+        cfg, params = model
+        prompts = [
+            np.random.default_rng(7).integers(0, cfg.vocab_size, 10).tolist()
+            for _ in range(2)
+        ]
+        eng = Engine(
+            cfg, params, num_slots=256, page_size=4, max_batch=2,
+            max_seq_len=64, weight_quant="int8",
+        )
+        out = eng.generate(prompts, SamplingParams(max_new_tokens=6))
+        assert all(len(o) == 6 for o in out)
+        assert all(0 <= t < cfg.vocab_size for o in out for t in o)
+
+    def test_pp_combo_rejected(self, model):
+        cfg, params = model
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >=2 devices for a pp>1 mesh")
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:2]).reshape(2, 1), ("pp", "tp")
+        )
+        with pytest.raises(ValueError, match="pipeline"):
+            Engine(
+                cfg, params, num_slots=64, page_size=4, max_batch=1,
+                weight_quant="int8", device_mesh=mesh,
+            )
