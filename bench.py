@@ -357,6 +357,34 @@ def _emit(full: dict, aot: dict, probe_diags: list[dict],
             "int8_vs_bf16_equal_hbm"
         ),
         "mfu": (full.get("roofline") or {}).get("mfu"),
+        "hbm_bw_util": (full.get("roofline") or {}).get("hbm_bw_util"),
+        # Round-5 sections, compacted: the 8B W8A16 decode and the
+        # real-weights gate (full detail in the FULL report).
+        "llama3_8b_int8_tok_s": (full.get("llama3_8b_int8") or {}).get(
+            "tok_s",
+            (full.get("llama3_8b_int8") or {}).get("error"),
+        ),
+        "real_weights": (
+            None
+            if not isinstance(full.get("north_star_real_weights"), dict)
+            else (
+                full["north_star_real_weights"].get("skipped")
+                or full["north_star_real_weights"].get("error")
+                or {
+                    "model": full["north_star_real_weights"].get("model"),
+                    "base_hit_rate": (
+                        (full["north_star_real_weights"].get("shapes") or {})
+                        .get("base", {})
+                        .get("hit_rate")
+                    ),
+                    "base_p50_ttft_ms": (
+                        (full["north_star_real_weights"].get("shapes") or {})
+                        .get("base", {})
+                        .get("p50_ttft_ms")
+                    ),
+                }
+            )
+        ),
         "north_star": {
             "hit_rate": north.get("hit_rate"),
             "aggregate_hit_rate": north.get("aggregate_hit_rate"),
@@ -951,6 +979,8 @@ def main() -> None:
     )
 
     north = _north_star(cfg, params, page_size, on_tpu)
+    real = _real_weights_north_star(on_tpu)
+    m8b = _bench_8b_int8(on_tpu)
 
     print(json.dumps({
         "metric": "decode_tokens_per_sec_per_chip",
@@ -969,7 +999,145 @@ def main() -> None:
         },
         "roofline": roof,
         "north_star": north,
+        "north_star_real_weights": real,
+        "llama3_8b_int8": m8b,
     }))
+
+
+_REAL_CKPT = os.path.join(_REPO, "artifacts", "real_ckpt")
+
+
+def _real_weights_north_star(on_tpu: bool) -> dict | None:
+    """The serving gate with REAL machinery end to end (VERDICT round-4
+    missing #1): a registry model loaded from an HF-format sharded
+    safetensors checkpoint through ``models/hf_io.py``, a trained BPE
+    tokenizer through ``server/tokenizer.py``, and a TEXT workload — not
+    generated token ids. The checkpoint is produced in-environment by
+    ``scripts/make_real_ckpt.py`` (random weights, declared — no
+    checkpoint is fetchable with zero egress); hit-rate/TTFT mechanics
+    are weight-value-independent, so the gate numbers are real."""
+    if not os.path.isdir(_REAL_CKPT):
+        return {"skipped": f"{_REAL_CKPT} missing — run "
+                           f"scripts/make_real_ckpt.py first"}
+    if not on_tpu:
+        return {"skipped": "cpu fallback (1B real-weights serve is "
+                           "TPU-only; the seam is covered at tiny scale "
+                           "by tests/test_real_ckpt.py)"}
+    try:
+        with open(os.path.join(_REAL_CKPT, "provenance.json")) as fh:
+            provenance = json.load(fh)
+        if provenance.get("tiny"):
+            # A --tiny artifact's shards don't match the preset's dims; a
+            # scarce TPU window must get a clear skip, not a shape error.
+            return {"skipped": f"{_REAL_CKPT} holds a --tiny checkpoint — "
+                               f"regenerate with scripts/make_real_ckpt.py "
+                               f"(no --tiny)"}
+        from radixmesh_tpu.engine.engine import Engine
+        from radixmesh_tpu.models import get_config
+        from radixmesh_tpu.models.hf_io import load_hf_checkpoint
+        from radixmesh_tpu.server.tokenizer import load_tokenizer
+        from radixmesh_tpu.workload import (
+            TextMultiTurnWorkload,
+            run_engine_workload,
+        )
+
+        preset = provenance["model"]
+        cfg = get_config(preset)
+        t0 = time.monotonic()
+        params = load_hf_checkpoint(_REAL_CKPT, cfg)
+        tokenizer = load_tokenizer(_REAL_CKPT)
+        load_s = time.monotonic() - t0
+        log(f"real-weights: loaded {preset} from {_REAL_CKPT} in "
+            f"{load_s:.0f}s (tokenizer vocab {tokenizer.vocab_size})")
+        engine = Engine(
+            cfg, params, num_slots=32768, page_size=16, max_batch=16,
+            name="bench-real", decode_steps_per_launch=8,
+        )
+        shapes = {
+            "base": dict(n_conversations=16, n_turns=4, system_sentences=10,
+                         user_sentences=5, gen_len=16),
+            "wide": dict(n_conversations=32, n_turns=2, system_sentences=10,
+                         user_sentences=14, gen_len=16),
+        }
+        out_shapes = {}
+        for i, (name, sizes) in enumerate(shapes.items()):
+            warm = TextMultiTurnWorkload(tokenizer, seed=i + 1000, **sizes)
+            run_engine_workload(engine, warm)
+            wl = TextMultiTurnWorkload(tokenizer, seed=i, **sizes)
+            ns = run_engine_workload(engine, wl)
+            out_shapes[name] = {
+                "requests": ns["requests"],
+                "hit_rate": round(ns["hit_rate"], 4),
+                "ceiling_hit_rate": round(ns["ceiling_hit_rate"], 4),
+                "reuse_efficiency": round(ns["reuse_efficiency"], 4),
+                "p50_ttft_ms": round(ns["p50_ttft_s"] * 1e3, 2),
+                "p99_ttft_ms": round(ns["p99_ttft_s"] * 1e3, 2),
+            }
+            log(f"real-weights[{name}]: hit_rate={ns['hit_rate']:.3f} "
+                f"p50_ttft={ns['p50_ttft_s']*1e3:.1f} ms")
+        return {
+            "model": preset,
+            "weights_source": provenance["weights"],
+            "tokenizer": provenance["tokenizer"],
+            "checkpoint_format": "HF sharded safetensors via models/hf_io.py",
+            "load_s": round(load_s, 1),
+            "shapes": out_shapes,
+            "targets": {"hit_rate": 0.70, "p50_ttft_ms": 200.0},
+        }
+    except Exception as exc:  # noqa: BLE001 — partial rounds must survive
+        log(f"real-weights: FAILED {type(exc).__name__}: {exc}")
+        return {"error": f"{type(exc).__name__}: {exc}"[:400]}
+
+
+def _bench_8b_int8(on_tpu: bool) -> dict | None:
+    """Decode the ACTUAL north-star model class on the one real chip
+    (VERDICT round-4 next-step #7): llama3-8b with W8A16 weights + int8
+    KV — ~8.1 GB weights + ~1.1 GB pool fit a 16 GB v5e that bf16 weights
+    alone (16 GB) cannot. Weights are random-init (zero-egress
+    environment — no checkpoint is fetchable; ops/wquant.py builds the
+    int8 pytree host-side so the bf16 8B never materializes anywhere).
+    Random weights don't change decode throughput: the step streams the
+    same bytes through the same kernels regardless of values. Guarded:
+    any failure reports instead of discarding the rest of the round."""
+    if not on_tpu:
+        return None
+    from radixmesh_tpu.models import get_config
+    from radixmesh_tpu.ops.wquant import random_w8_params
+
+    cfg = get_config("llama3-8b")
+    batch, ctx, page_size, iters = 16, 1024, 16, 8
+    try:
+        t0 = time.monotonic()
+        params = random_w8_params(cfg, seed=0)
+        init_s = time.monotonic() - t0
+        log(f"8b-int8: host init+quant {init_s:.0f}s; measuring decode "
+            f"(batch={batch}, ctx={ctx}, int8 KV)")
+        t0 = time.monotonic()
+        sec, pool_slots = _measure_paged(
+            cfg, params, page_size, [[ctx] * batch], iters, quant=True
+        )
+        log(f"8b-int8: {sec*1e3:.1f} ms/step, {batch/sec:.1f} tok/s "
+            f"({pool_slots} pool slots)")
+        return {
+            "model": "llama3-8b",
+            "weights_source": "random-init W8A16 (no checkpoint fetchable "
+                              "in this zero-egress environment)",
+            "weight_quant": "int8",
+            "kv_quant": "int8",
+            "batch": batch,
+            "ctx": ctx,
+            "ms_per_step": round(sec * 1e3, 2),
+            "tok_s": round(batch / sec, 1),
+            "host_init_s": round(init_s, 1),
+            "measure_s": round(time.monotonic() - t0, 1),
+        }
+    except Exception as exc:  # noqa: BLE001 — partial rounds must survive
+        log(f"8b-int8: FAILED {type(exc).__name__}: {exc}")
+        return {
+            "model": "llama3-8b",
+            "weight_quant": "int8",
+            "error": f"{type(exc).__name__}: {exc}"[:400],
+        }
 
 
 def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:

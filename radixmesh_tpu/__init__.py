@@ -14,7 +14,18 @@ __version__ = "0.1.0"
 
 from radixmesh_tpu.config import MeshConfig, NodeRole, load_config
 from radixmesh_tpu.cache.radix_tree import RadixTree, TreeNode, MatchResult
-from radixmesh_tpu.cache.kv_pool import PagedKVPool, SlotAllocator
+
+
+def __getattr__(name: str):
+    # PEP 562 lazy exports: kv_pool imports jax (~5 s cold), which the
+    # pure cache/mesh/router surface never needs — a 50-process ringscale
+    # sweep on one core must not pay 50 jax imports (scripts/ringscale.py
+    # --procs).
+    if name in ("PagedKVPool", "SlotAllocator"):
+        from radixmesh_tpu.cache import kv_pool
+
+        return getattr(kv_pool, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "MeshConfig",

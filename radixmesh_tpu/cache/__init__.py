@@ -1,4 +1,13 @@
 from radixmesh_tpu.cache.radix_tree import RadixTree, TreeNode, MatchResult
-from radixmesh_tpu.cache.kv_pool import PagedKVPool, SlotAllocator
 
 __all__ = ["RadixTree", "TreeNode", "MatchResult", "PagedKVPool", "SlotAllocator"]
+
+
+def __getattr__(name: str):
+    # Lazy: kv_pool imports jax, which cache-only mesh nodes never need
+    # (see radixmesh_tpu/__init__.py).
+    if name in ("PagedKVPool", "SlotAllocator"):
+        from radixmesh_tpu.cache import kv_pool
+
+        return getattr(kv_pool, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

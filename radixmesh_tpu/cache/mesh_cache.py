@@ -68,7 +68,10 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from radixmesh_tpu.cache.kv_pool import PagedKVPool
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # kv_pool imports jax; a cache-only node never needs it
+    from radixmesh_tpu.cache.kv_pool import PagedKVPool
 from radixmesh_tpu.cache.mesh_values import PrefillValue, RouterValue
 from radixmesh_tpu.cache.oplog import (
     GCEntry,

@@ -136,6 +136,7 @@ def _run_node(args: argparse.Namespace) -> int:
             decode_steps_per_launch=int(model.get("decode_steps_per_launch", 1)),
             spec_decode_tokens=int(model.get("spec_decode_tokens", 0)),
             kv_quant=model.get("kv_quant"),
+            weight_quant=model.get("weight_quant"),
             mesh=node,
             name=f"{role.value}{rank}",
         )
@@ -193,6 +194,7 @@ def _run_serve(args: argparse.Namespace) -> int:
         decode_steps_per_launch=args.decode_steps_per_launch,
         spec_decode_tokens=args.spec_decode_tokens,
         kv_quant=args.kv_quant,
+        weight_quant=args.weight_quant,
     )
     frontend = ServingFrontend(
         engine, host=args.host, port=args.http_port,
@@ -292,6 +294,12 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument(
         "--kv-quant", choices=["int8"], default=None,
         help="store the KV pool quantized (halves decode HBM traffic)",
+    )
+    serve.add_argument(
+        "--weight-quant", choices=["int8"], default=None,
+        help="W8A16 weights: int8 storage + per-out-channel scales "
+             "(halves the decode weight stream; llama3-8b fits one 16 GB "
+             "v5e)",
     )
     serve.add_argument(
         "--spec-decode-tokens", type=int, default=0,

@@ -54,6 +54,33 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
+def _frame_model(topology: str, n_nodes: int, group_size: int) -> tuple[int, int]:
+    """(frame_bytes, frames_per_insert) — the analytic wire model BOTH
+    sweep modes report and tests/test_ringscale.py pins against the
+    measured send counters. Flat = N sends (the lap-RETURN hop to the
+    origin is a real frame); hier = one full lap per group (return hops
+    included; injected copies die at their injector) + one spine lap."""
+    from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
+    from radixmesh_tpu.policy.hierarchy import HierPlan
+
+    frame = len(serialize(Oplog(
+        op_type=OplogType.INSERT, origin_rank=0, logic_id=1,
+        ttl=n_nodes, key=np.arange(KEY_LEN, dtype=np.int32),
+        value=np.arange(KEY_LEN // PAGE, dtype=np.int32), value_rank=0,
+        page=PAGE,
+    )))
+    if topology == "hier":
+        plan = HierPlan(n_nodes, group_size)
+        alive = range(n_nodes)
+        frames = sum(
+            len(plan.group_alive(g, alive))
+            for g in plan.nonempty_groups(alive)
+        ) + plan.spine_ttl(alive)
+    else:
+        frames = n_nodes
+    return frame, frames
+
+
 def run_ring(
     n_nodes: int,
     n_inserts: int,
@@ -167,26 +194,7 @@ def run_ring(
         converge_s = time.monotonic() - t0
         sent = sum(n.metrics["oplogs_sent"] for n in nodes) - sent0
 
-        frame = len(serialize(Oplog(
-            op_type=OplogType.INSERT, origin_rank=0, logic_id=1,
-            ttl=n_nodes, key=np.arange(KEY_LEN, dtype=np.int32),
-            value=np.arange(KEY_LEN // PAGE, dtype=np.int32), value_rank=0,
-            page=PAGE,
-        )))
-        # Frame model per insert (checked against the MEASURED counters by
-        # tests/test_ringscale.py): flat = N sends — the lap-RETURN hop to
-        # the origin is a real frame. Hier = one full lap per group (each
-        # lap's return hop included; injected copies die at their
-        # injector) + one spine lap.
-        if topology == "hier":
-            plan = nodes[0].hier
-            alive = range(n_nodes)
-            frames = sum(
-                len(plan.group_alive(g, alive))
-                for g in plan.nonempty_groups(alive)
-            ) + plan.spine_ttl(alive)
-        else:
-            frames = n_nodes
+        frame, frames = _frame_model(topology, n_nodes, group_size)
         a = np.asarray(probes)
         return {
             "n_nodes": n_nodes,
@@ -212,6 +220,248 @@ def run_ring(
                 pass
 
 
+# ---------------------------------------------------------------------------
+# OS-process mode (VERDICT round-4 missing #5): every node its own python
+# PROCESS over the NATIVE C++ transport (protocol "tcp") — the threaded
+# in-process sweep above is GIL-confounded at N=50, so the hierarchy
+# answer to the reference's README.md:57 question needs process-isolated
+# confirmation. The parent drives nodes over per-node control sockets
+# (JSON lines): insert / probe / metrics / quit. Children strip the
+# environment's axon site hook from PYTHONPATH — it force-imports jax
+# (~4 s) into every interpreter, which 50 single-core spawns can't pay.
+# ---------------------------------------------------------------------------
+
+
+def _node_main(argv: list[str]) -> int:
+    """Child entry: one MeshCache node + a control socket."""
+    spec = json.loads(argv[0])
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.config import MeshConfig
+
+    cfg = MeshConfig(
+        prefill_nodes=spec["addrs"],
+        decode_nodes=[],
+        router_nodes=[],
+        local_addr=spec["addrs"][spec["rank"]],
+        protocol="tcp",  # the native C++ transport
+        topology=spec["topology"],
+        group_size=spec["group_size"],
+        tick_interval_s=3600.0,  # above the whole sweep budget (see above)
+        gc_interval_s=3600.0,
+        failure_timeout_s=3600.0,
+        page_size=PAGE,
+    )
+    node = MeshCache(cfg, pool=None)
+    delay = spec["hop_delay_ms"] / 1e3
+    if delay > 0:
+        # Emulate DCN store-and-forward latency on each link's delivery
+        # (the native reader thread sleeps, exactly like the threaded
+        # sweep's per-connection wrapper — comparable numbers).
+        orig = node.oplog_received
+
+        def delayed(data):
+            time.sleep(delay)
+            return orig(data)
+
+        node.oplog_received = delayed
+    node.start()
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", spec["control_port"]))
+    srv.listen(1)
+    conn, _ = srv.accept()
+    fh = conn.makefile("rw")
+    for line in fh:
+        req = json.loads(line)
+        cmd = req["cmd"]
+        if cmd == "quit":
+            fh.write("{}\n")
+            fh.flush()
+            break
+        if cmd == "insert":
+            base = int(req["value_base"])
+            node.insert(
+                req["key"],
+                np.arange(len(req["key"]), dtype=np.int32) + base,
+            )
+            resp = {}
+        elif cmd == "probe":
+            resp = {"len": int(node.match_prefix(req["key"]).length)}
+        elif cmd == "metrics":
+            resp = {"sent": int(node.metrics["oplogs_sent"])}
+        else:
+            resp = {"error": f"unknown cmd {cmd}"}
+        fh.write(json.dumps(resp) + "\n")
+        fh.flush()
+    try:
+        node.close()
+    finally:
+        conn.close()
+        srv.close()
+    return 0
+
+
+class _NodeProc:
+    """Parent-side handle: spawned child + its control channel."""
+
+    def __init__(self, spec: dict, env: dict):
+        import subprocess
+
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--node",
+             json.dumps(spec)],
+            env=env,
+        )
+        self.port = spec["control_port"]
+        self._fh = None
+
+    def connect(self, deadline: float) -> None:
+        while True:
+            try:
+                s = socket.create_connection(("127.0.0.1", self.port), 1.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"node :{self.port} never accepted")
+                if self.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"node :{self.port} exited rc={self.proc.returncode}"
+                    )
+                time.sleep(0.1)
+        self._fh = s.makefile("rw")
+
+    def rpc(self, **req) -> dict:
+        self._fh.write(json.dumps(req) + "\n")
+        self._fh.flush()
+        return json.loads(self._fh.readline())
+
+    def stop(self) -> None:
+        try:
+            if self._fh is not None:
+                self.rpc(cmd="quit")
+        except Exception:  # noqa: BLE001 — teardown must not mask results
+            pass
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            self.proc.kill()
+
+
+def _child_env() -> dict:
+    """Child environment without the axon site hook (jax import tax)."""
+    env = dict(os.environ)
+    parts = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    ]
+    parts.insert(0, _REPO_ROOT)
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env
+
+
+def run_ring_procs(
+    n_nodes: int,
+    n_inserts: int,
+    n_probes: int,
+    topology: str,
+    hop_delay_ms: float = 1.0,
+) -> dict:
+    from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
+    from radixmesh_tpu.comm.tcp_native import load_native_lib
+    from radixmesh_tpu.policy.hierarchy import HierPlan, auto_group_size
+
+    load_native_lib()  # build the .so once; children must not race g++
+    group_size = auto_group_size(n_nodes) if topology == "hier" else 0
+    ports = _free_ports(2 * n_nodes)
+    addrs = [f"127.0.0.1:{p}" for p in ports[:n_nodes]]
+    env = _child_env()
+    t0 = time.monotonic()
+    nodes = [
+        _NodeProc(
+            {
+                "rank": r,
+                "addrs": addrs,
+                "topology": topology,
+                "group_size": group_size,
+                "control_port": ports[n_nodes + r],
+                "hop_delay_ms": hop_delay_ms,
+            },
+            env,
+        )
+        for r in range(n_nodes)
+    ]
+    rng = np.random.default_rng(1234 + n_nodes)
+    try:
+        deadline = time.monotonic() + 60 + 3 * n_nodes
+        for nd in nodes:
+            nd.connect(deadline)
+        startup_s = time.monotonic() - t0
+
+        def wait_propagated(key: list[int], budget: float) -> None:
+            waiting = list(range(1, n_nodes))
+            end = time.monotonic() + budget
+            while waiting:
+                waiting = [
+                    r for r in waiting
+                    if nodes[r].rpc(cmd="probe", key=key)["len"] < KEY_LEN
+                ]
+                if waiting and time.monotonic() > end:
+                    raise TimeoutError(
+                        f"N={n_nodes}/{topology}/procs: key never propagated "
+                        f"to {waiting[:5]}"
+                    )
+                # Yield the (single) core between poll rounds: a poll storm
+                # of N sequential RPCs would otherwise preempt the very
+                # forwarding it is trying to observe.
+                if waiting:
+                    time.sleep(0.002)
+
+        probes: list[float] = []
+        for i in range(n_probes):
+            key = rng.integers(1, 50000, size=KEY_LEN).tolist()
+            t = time.monotonic()
+            nodes[0].rpc(cmd="insert", key=key, value_base=i * KEY_LEN)
+            wait_propagated(key, 120)
+            probes.append(time.monotonic() - t)
+
+        sent0 = sum(nd.rpc(cmd="metrics")["sent"] for nd in nodes)
+        keys = rng.integers(1, 50000, size=(n_inserts, KEY_LEN))
+        t0 = time.monotonic()
+        for i, key in enumerate(keys):
+            nodes[0].rpc(
+                cmd="insert", key=key.tolist(),
+                value_base=(n_probes + i) * KEY_LEN,
+            )
+        wait_propagated(keys[-1].tolist(), 300)
+        converge_s = time.monotonic() - t0
+        sent = sum(nd.rpc(cmd="metrics")["sent"] for nd in nodes) - sent0
+
+        frame, frames = _frame_model(topology, n_nodes, group_size)
+        a = np.asarray(probes)
+        return {
+            "n_nodes": n_nodes,
+            "topology": topology,
+            "mode": "procs+native",
+            "hop_delay_ms": hop_delay_ms,
+            "group_size": group_size or None,
+            "startup_s": round(startup_s, 2),
+            "prop_p50_ms": round(float(np.percentile(a, 50)) * 1e3, 2),
+            "prop_p99_ms": round(float(np.percentile(a, 99)) * 1e3, 2),
+            "converge_s": round(converge_s, 3),
+            "inserts": n_inserts,
+            "inserts_per_s": round(n_inserts / converge_s, 1),
+            "frame_bytes": frame,
+            "frames_per_insert": frames,
+            "measured_frames_per_insert": round(sent / n_inserts, 2),
+            "ring_bytes_per_insert": frame * frames,
+        }
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes", default="6,12,25,50")
@@ -221,15 +471,23 @@ def main() -> int:
         "--hop-delays", default="0,1",
         help="comma-separated per-hop wire latencies (ms) to emulate; 0 = raw loopback",
     )
+    ap.add_argument(
+        "--procs", action="store_true",
+        help="one OS process per node over the native C++ transport",
+    )
+    ap.add_argument("--node", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    if args.node is not None:
+        return _node_main([args.node])
     sizes = [int(s) for s in args.sizes.split(",")]
     delays = [float(d) for d in args.hop_delays.split(",")]
+    runner = run_ring_procs if args.procs else run_ring
     results = []
     for delay in delays:
         for topology in ("ring", "hier"):
             for n in sizes:
-                r = run_ring(n, args.inserts, args.probes, topology, delay)
+                r = runner(n, args.inserts, args.probes, topology, delay)
                 print(json.dumps(r), file=sys.stderr, flush=True)
                 results.append(r)
     ratios = {}
@@ -249,6 +507,7 @@ def main() -> int:
         }
     report = {
         "metric": "ring_scale_sweep",
+        "mode": "procs+native" if args.procs else "threads+tcp-py",
         "sizes": sizes,
         "hop_delays_ms": delays,
         "results": results,
@@ -264,7 +523,12 @@ def main() -> int:
     }
     line = json.dumps(report)
     print(line, flush=True)
-    out = args.out or os.path.join(_REPO_ROOT, "RINGSCALE_r04.json")
+    if args.out:
+        out = args.out
+    else:
+        from bench import current_round
+
+        out = os.path.join(_REPO_ROOT, f"RINGSCALE_r{current_round():02d}.json")
     with open(out, "w") as fh:
         fh.write(line + "\n")
     return 0

@@ -1,0 +1,15 @@
+#!/bin/bash
+# TPU-window runbook, ordered by information density (VERDICT r4 #3):
+# kernel micro-bench first (~2 min answers whether the round-5 kernel
+# redesign helped), then the full bench (serving gates, int8-equal-HBM,
+# the 8B W8A16 decode, the real-weights gate). Run from the repo root
+# the moment a probe reports the tunnel up; safe to re-run.
+set -o pipefail
+cd "$(dirname "$0")/.."
+R=$(python -c "from bench import current_round; print('%02d' % current_round())")
+echo "=== tpu window: round $R $(date -u +%FT%TZ) ==="
+timeout 900 python scripts/kernelbench.py --out "KERNELBENCH_r$R.json" \
+  && echo "kernelbench done" || echo "kernelbench FAILED rc=$?"
+timeout 3600 python bench.py || echo "bench FAILED rc=$?"
+python scripts/tpu_probe.py "window-end" --timeout 60
+echo "=== window run complete $(date -u +%FT%TZ) ==="
