@@ -1104,14 +1104,23 @@ def _bench_8b_int8(on_tpu: bool) -> dict | None:
     from radixmesh_tpu.models import get_config
     from radixmesh_tpu.ops.wquant import random_w8_params
 
+    import jax
+    import jax.numpy as jnp
+
     cfg = get_config("llama3-8b")
     batch, ctx, page_size, iters = 16, 1024, 16, 8
     try:
         t0 = time.monotonic()
         params = random_w8_params(cfg, seed=0)
+        # Transfer ONCE and block: numpy leaves passed into a jitted call
+        # re-upload on EVERY invocation — the timed loop would measure
+        # ~8 GB of H2D per step (and async dispatch could hold two weight
+        # copies and OOM the 16 GB chip this bench exists to fit).
+        params = jax.tree.map(jnp.asarray, params)
+        jax.block_until_ready(params)
         init_s = time.monotonic() - t0
-        log(f"8b-int8: host init+quant {init_s:.0f}s; measuring decode "
-            f"(batch={batch}, ctx={ctx}, int8 KV)")
+        log(f"8b-int8: host init+quant+transfer {init_s:.0f}s; measuring "
+            f"decode (batch={batch}, ctx={ctx}, int8 KV)")
         t0 = time.monotonic()
         sec, pool_slots = _measure_paged(
             cfg, params, page_size, [[ctx] * batch], iters, quant=True

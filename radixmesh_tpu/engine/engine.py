@@ -149,12 +149,6 @@ class Engine:
             # weights.
             if weight_quant != "int8":
                 raise ValueError(f"unknown weight quantization {weight_quant!r}")
-            if self._pp:
-                raise ValueError(
-                    "weight_quant is not supported under pipeline "
-                    "parallelism yet (pp stage specs don't cover the "
-                    "scale leaves); use tp/dp or single-chip"
-                )
             from radixmesh_tpu.ops.wquant import quantize_params
 
             params = quantize_params(params)

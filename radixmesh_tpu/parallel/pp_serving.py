@@ -39,7 +39,13 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from radixmesh_tpu.models.llama import ModelConfig, _logits, _PREC
+from radixmesh_tpu.models.llama import (
+    ModelConfig,
+    _embed_lookup,
+    _logits,
+    _wmm,
+    _PREC,
+)
 from radixmesh_tpu.ops.attention import (
     default_use_kernel,
     paged_chunk_attention,
@@ -86,6 +92,16 @@ def pp_layer_specs() -> dict:
         "bq": P("pp", "tp"),
         "bk": P("pp", "tp"),
         "bv": P("pp", "tp"),
+        # W8A16 scale leaves (ops/wquant.py): per-out-channel, so they
+        # shard like their weight's OUTPUT axis — column-split weights'
+        # scales over tp, row-split weights' (wo, w_down) replicated.
+        "wq_s": P("pp", "tp"),
+        "wk_s": P("pp", "tp"),
+        "wv_s": P("pp", "tp"),
+        "wo_s": P("pp", None),
+        "w_gate_s": P("pp", "tp"),
+        "w_up_s": P("pp", "tp"),
+        "w_down_s": P("pp", None),
     }
 
 
@@ -106,9 +122,15 @@ def shard_params_pp(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     repl = NamedSharding(mesh, P())
     out["embed"] = jax.device_put(params["embed"], repl)
     out["final_norm"] = jax.device_put(params["final_norm"], repl)
+    if "embed_s" in params:
+        out["embed_s"] = jax.device_put(params["embed_s"], repl)
     if "lm_head" in params:
         out["lm_head"] = jax.device_put(
             params["lm_head"], NamedSharding(mesh, P(None, "tp"))
+        )
+    if "lm_head_s" in params:
+        out["lm_head_s"] = jax.device_put(
+            params["lm_head_s"], NamedSharding(mesh, P("tp"))
         )
     return out
 
@@ -179,7 +201,9 @@ def pp_forward_chunk(
 
     # Embed outside the shard_map (table replicated); group rows into
     # microbatches. Aux arrays get the same [n_micro, mb, ...] grouping.
-    x_all = params["embed"][tokens].reshape(n_micro, mb, C, cfg.hidden)
+    from radixmesh_tpu.models.llama import _embed_lookup
+
+    x_all = _embed_lookup(params, tokens).reshape(n_micro, mb, C, cfg.hidden)
     pos_all = positions.reshape(n_micro, mb, C)
     slots_all = slots.reshape(n_micro, mb, C)
     pt_all = page_table.reshape(n_micro, mb, -1)
@@ -227,9 +251,9 @@ def pp_forward_chunk(
             def body(h, xs):
                 l_idx, lp = xs
                 hn = rms_norm(h, lp["attn_norm"], cfg.rms_eps)
-                q = jnp.einsum("bsh,hd->bsd", hn, lp["wq"], precision=_PREC)
-                k = jnp.einsum("bsh,hd->bsd", hn, lp["wk"], precision=_PREC)
-                v = jnp.einsum("bsh,hd->bsd", hn, lp["wv"], precision=_PREC)
+                q = _wmm(lp, "wq", "bsh,hd->bsd", hn)
+                k = _wmm(lp, "wk", "bsh,hd->bsd", hn)
+                v = _wmm(lp, "wv", "bsh,hd->bsd", hn)
                 if cfg.qkv_bias:
                     q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
                 q = q.reshape(mb, C, hq_loc, D)
@@ -250,21 +274,19 @@ def pp_forward_chunk(
                     use_kernel=use_kernel,
                     interpret=interpret,
                 )
-                o = jnp.einsum(
-                    "bsqd,qdh->bsh",
+                # Row-split projections: the per-out-channel W8A16
+                # scale is constant across tp shards, so applying it to
+                # the partial sums before the psum is exact.
+                o = _wmm(
+                    lp, "wo", "bsqd,qdh->bsh",
                     attn.reshape(mb, C, hq_loc, D),
-                    lp["wo"].reshape(hq_loc, D, cfg.hidden),
-                    precision=_PREC,
+                    reshape=(hq_loc, D, cfg.hidden),
                 )
                 h = h + jax.lax.psum(o, "tp")
                 h2 = rms_norm(h, lp["mlp_norm"], cfg.rms_eps)
-                gate = jax.nn.silu(
-                    jnp.einsum("bsh,hi->bsi", h2, lp["w_gate"], precision=_PREC)
-                )
-                up = jnp.einsum("bsh,hi->bsi", h2, lp["w_up"], precision=_PREC)
-                down = jnp.einsum(
-                    "bsi,ih->bsh", gate * up, lp["w_down"], precision=_PREC
-                )
+                gate = jax.nn.silu(_wmm(lp, "w_gate", "bsh,hi->bsi", h2))
+                up = _wmm(lp, "w_up", "bsh,hi->bsi", h2)
+                down = _wmm(lp, "w_down", "bsi,ih->bsh", gate * up)
                 h = h + jax.lax.psum(down, "tp")
                 if quant:
                     return h, (k_int, v_int, k_sc, v_sc)
@@ -449,6 +471,19 @@ def pp_decode_multi(
     }
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     head_spec = P() if cfg.tie_embeddings else P(None, "tp")
+    # W8A16 (ops/wquant.py): int8 embed/head ride with their scale
+    # vectors; zeros stand in when full-precision so the shard_map
+    # signature is static.
+    w8_embed = params.get("embed_s")
+    w8_head = (
+        params.get("embed_s") if cfg.tie_embeddings
+        else params.get("lm_head_s")
+    )
+    embed_s_arg = w8_embed if w8_embed is not None else jnp.zeros((), jnp.float32)
+    head_s_arg = w8_head if w8_head is not None else jnp.zeros((), jnp.float32)
+    head_s_spec = (
+        P() if (w8_head is None or cfg.tie_embeddings) else P("tp")
+    )
     quant = kv_scale is not None
     scale_in_spec = pp_scale_spec() if quant else P()
     scale_arg = kv_scale if quant else jnp.zeros((), jnp.float32)
@@ -458,12 +493,14 @@ def pp_decode_multi(
         mesh=mesh,
         in_specs=(
             layer_specs, pp_pool_spec(), scale_in_spec, P(), P(), head_spec,
+            P(), head_s_spec,
             P(), P(), P(), P(), P(), P(), P(), P(),
         ),
         out_specs=(P(), pp_pool_spec(), scale_in_spec),
         check_vma=False,
     )
-    def run(layers, pool, scale, embed, final_norm, head_local, toks_all,
+    def run(layers, pool, scale, embed, final_norm, head_local,
+            embed_s, head_s, toks_all,
             pt_all, len_all, temp_all, topp_all, topk_all, key, scratch):
         from radixmesh_tpu.ops.attention import attend_decode_ref
         from radixmesh_tpu.ops.sampling import sample_tokens
@@ -490,9 +527,9 @@ def pp_decode_multi(
                 pool, scale, h = carry
                 l_idx, lp = xs
                 hn = rms_norm(h[:, None, :], lp["attn_norm"], cfg.rms_eps)
-                q = jnp.einsum("bsh,hd->bsd", hn, lp["wq"], precision=_PREC)
-                k_ = jnp.einsum("bsh,hd->bsd", hn, lp["wk"], precision=_PREC)
-                v_ = jnp.einsum("bsh,hd->bsd", hn, lp["wv"], precision=_PREC)
+                q = _wmm(lp, "wq", "bsh,hd->bsd", hn)
+                k_ = _wmm(lp, "wk", "bsh,hd->bsd", hn)
+                v_ = _wmm(lp, "wv", "bsh,hd->bsd", hn)
                 if cfg.qkv_bias:
                     q, k_, v_ = q + lp["bq"], k_ + lp["bk"], v_ + lp["bv"]
                 q = apply_rope(q.reshape(mb, 1, hq_loc, D), pos, inv_freq)
@@ -559,21 +596,18 @@ def pp_decode_multi(
                         attn = attend_decode_ref(
                             q[:, 0], pages[0], pages[1], pt, kvlen
                         )
-                o = jnp.einsum(
-                    "bqd,qdh->bh",
+                # Per-out-channel W8A16 scales are shard-constant, so
+                # scaling the partial sums before the psum is exact.
+                o = _wmm(
+                    lp, "wo", "bqd,qdh->bh",
                     attn.reshape(mb, hq_loc, D),
-                    lp["wo"].reshape(hq_loc, D, cfg.hidden),
-                    precision=_PREC,
+                    reshape=(hq_loc, D, cfg.hidden),
                 )
                 h = h + jax.lax.psum(o, "tp")
                 h2 = rms_norm(h[:, None, :], lp["mlp_norm"], cfg.rms_eps)
-                gate = jax.nn.silu(
-                    jnp.einsum("bsh,hi->bsi", h2, lp["w_gate"], precision=_PREC)
-                )
-                up = jnp.einsum("bsh,hi->bsi", h2, lp["w_up"], precision=_PREC)
-                down = jnp.einsum(
-                    "bsi,ih->bsh", gate * up, lp["w_down"], precision=_PREC
-                )[:, 0]
+                gate = jax.nn.silu(_wmm(lp, "w_gate", "bsh,hi->bsi", h2))
+                up = _wmm(lp, "w_up", "bsh,hi->bsi", h2)
+                down = _wmm(lp, "w_down", "bsi,ih->bsh", gate * up)[:, 0]
                 h = h + jax.lax.psum(down, "tp")
                 return (pool, scale, h), None
 
@@ -603,16 +637,26 @@ def pp_decode_multi(
                 toks_all, m, 0, keepdims=False
             )
             tok_in = jnp.where(s == 0, first, tok_buf)
-            x0 = embed[tok_in]
+            # One dequant rule for the whole stack: route through
+            # _embed_lookup so the pp path can never drift from the
+            # single-device embedding math.
+            x0 = _embed_lookup(
+                {"embed": embed, "embed_s": embed_s,
+                 "final_norm": final_norm},
+                tok_in,
+            )
             x = jnp.where(idx == 0, x0, act_buf)
             pool, scale, y = stage(pool, scale, x, pt, kvlen, slot, valid)
 
             # Last stage: head + on-device sampling for (m, s).
             hn = rms_norm(y[:, None, :], final_norm, cfg.rms_eps)[:, 0]
             logits_part = jnp.einsum(
-                "bh,hv->bv", hn, head_local,
+                "bh,hv->bv", hn, head_local.astype(hn.dtype)
+                if w8_head is not None else head_local,
                 preferred_element_type=jnp.float32, precision=_PREC,
             )
+            if w8_head is not None:
+                logits_part = logits_part * head_s
             if tp > 1 and not cfg.tie_embeddings:
                 logits = jax.lax.all_gather(
                     logits_part, "tp", axis=1, tiled=True
@@ -641,7 +685,9 @@ def pp_decode_multi(
             tok_buf = jax.lax.ppermute(sampled, "pp", [(last, 0)])
             return (pool, scale, act_buf, tok_buf, outs), None
 
-        act0 = jnp.zeros((mb, cfg.hidden), embed.dtype)
+        # Activation dtype follows the norms, NOT the embedding table —
+        # an int8 (W8A16) table must not make the pipeline buffer int8.
+        act0 = jnp.zeros((mb, cfg.hidden), final_norm.dtype)
         tok0 = jnp.zeros((mb,), jnp.int32)
         outs0 = jnp.zeros((n_micro, mb, k_steps), jnp.int32)
         (pool, scale, _, _, outs), _ = jax.lax.scan(
@@ -658,7 +704,8 @@ def pp_decode_multi(
 
     outs, kv_pool, kv_scale_out = run(
         params["layers"], kv_pool, scale_arg, params["embed"],
-        params["final_norm"], head, toks_all, pt_all, len_all, temp_all,
+        params["final_norm"], head, embed_s_arg, head_s_arg,
+        toks_all, pt_all, len_all, temp_all,
         topp_all, topk_all, key, scratch_arr,
     )
     # [n_micro, mb, k] → the decode_multi contract [k, B] (row-major

@@ -92,7 +92,6 @@ def run_ring(
 
     jax.config.update("jax_platforms", "cpu")
     from radixmesh_tpu.cache.mesh_cache import MeshCache
-    from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
     from radixmesh_tpu.config import MeshConfig
     from radixmesh_tpu.policy.hierarchy import auto_group_size
 
@@ -368,9 +367,8 @@ def run_ring_procs(
     topology: str,
     hop_delay_ms: float = 1.0,
 ) -> dict:
-    from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
     from radixmesh_tpu.comm.tcp_native import load_native_lib
-    from radixmesh_tpu.policy.hierarchy import HierPlan, auto_group_size
+    from radixmesh_tpu.policy.hierarchy import auto_group_size
 
     load_native_lib()  # build the .so once; children must not race g++
     group_size = auto_group_size(n_nodes) if topology == "hier" else 0
