@@ -201,8 +201,6 @@ def pp_forward_chunk(
 
     # Embed outside the shard_map (table replicated); group rows into
     # microbatches. Aux arrays get the same [n_micro, mb, ...] grouping.
-    from radixmesh_tpu.models.llama import _embed_lookup
-
     x_all = _embed_lookup(params, tokens).reshape(n_micro, mb, C, cfg.hidden)
     pos_all = positions.reshape(n_micro, mb, C)
     slots_all = slots.reshape(n_micro, mb, C)

@@ -162,6 +162,23 @@ def main() -> int:
                 kv_scales=scales, interpret=interp)
         )
 
+    # Block-size sweep on the headline path: with run-coalesced DMAs the
+    # descriptor count per sequence is blocks-per-ctx, so bigger blocks
+    # trade fewer/larger descriptors against VMEM and tail waste — an
+    # on-chip question (CPU numbers are meaningless here).
+    ptb_run, slots_run = tables["run"]
+    default_ppb = max(1, -(-128 // page))
+    for ppb in (8, 16, 32):
+        if ppb * page > ctx or ppb == default_ppb:
+            # The resolved default is already timed as fused_bf16_mh_run —
+            # don't burn scarce window time re-measuring it.
+            continue
+        cases[f"fused_bf16_mh_run_ppb{ppb}"] = (
+            lambda ppb=ppb: paged_decode_fused_kernel(
+                q, kn, kn, kv16, slots_run, ptb_run, lens, 0,
+                pages_per_block=ppb, interpret=interp, fuse_heads=True)
+        )
+
     # EVERY kernel timing is exception-guarded and partial results are
     # always printed/written: tunnel windows are scarce, and this repo's
     # history shows kernels that fail ONLY at on-chip Mosaic compile —

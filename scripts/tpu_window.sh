@@ -8,8 +8,17 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 R=$(python -c "from bench import current_round; print('%02d' % current_round())")
 echo "=== tpu window: round $R $(date -u +%FT%TZ) ==="
-timeout 900 python scripts/kernelbench.py --out "KERNELBENCH_r$R.json" \
+timeout 1500 python scripts/kernelbench.py --out "KERNELBENCH_r$R.json" \
   && echo "kernelbench done" || echo "kernelbench FAILED rc=$?"
 timeout 3600 python bench.py || echo "bench FAILED rc=$?"
 python scripts/tpu_probe.py "window-end" --timeout 60
+# Commit whatever the window produced — a tunnel that dies before the
+# operator returns must not cost the round its on-chip record. One add
+# per file: `git add a b c` is atomic and a single missing artifact
+# (e.g. kernelbench killed by its timeout before writing --out) would
+# abort staging of the ones that DO exist.
+for f in "KERNELBENCH_r$R.json" "BENCH_FULL_r$R.json" "TPU_PROBES_r$R.json"; do
+  [ -f "$f" ] && git add "$f"
+done
+git diff --cached --quiet || git commit -m "Record round-$R TPU window artifacts (kernelbench + bench)"
 echo "=== window run complete $(date -u +%FT%TZ) ==="
