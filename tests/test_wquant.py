@@ -183,3 +183,60 @@ class TestRandomW8Params:
         assert np.abs(deq).std() > 0  # non-degenerate init
         # int8 payload actually saturates the range somewhere.
         assert p["layers"]["wq"].max() == 127 or p["layers"]["wq"].min() == -127
+
+
+class TestW8Compositions:
+    def test_spec_decode_compose(self, model):
+        """Speculative decoding over W8A16 weights: greedy output must be
+        bit-identical to the non-speculative int8-weight engine (spec
+        verify and plain decode share the same quantized forward)."""
+        cfg, params = model
+        prompt = np.random.default_rng(3).integers(
+            0, cfg.vocab_size, 12
+        ).tolist()
+        # Force a repeated n-gram so prompt-lookup drafting has material.
+        prompt = prompt + prompt[:6]
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
+        plain = Engine(
+            cfg, params, num_slots=256, page_size=4, max_batch=1,
+            max_seq_len=96, weight_quant="int8",
+        )
+        want = plain.generate([prompt], sampling)[0]
+        spec = Engine(
+            cfg, params, num_slots=256, page_size=4, max_batch=1,
+            max_seq_len=96, weight_quant="int8", spec_decode_tokens=3,
+        )
+        got = spec.generate([prompt], sampling)[0]
+        assert got == want
+
+    def test_qwen2_bias_compose(self):
+        """Qwen2's qkv biases stay full-precision and add AFTER the
+        per-out-channel scale — logits must track the bf16 engine."""
+        from radixmesh_tpu.models import get_config
+        from radixmesh_tpu.models.llama import init_params
+
+        cfg = get_config("qwen2-tiny", dtype=jnp.float32)
+        assert cfg.qkv_bias
+        params = init_params(cfg, jax.random.PRNGKey(4))
+        # Give ALL the biases real values (zeros would hide an
+        # add-before-scale ordering bug in any of the three projections).
+        for i, name in enumerate(("bq", "bk", "bv")):
+            params["layers"][name] = (
+                jax.random.normal(jax.random.PRNGKey(5 + i),
+                                  params["layers"][name].shape) * 0.1
+            )
+        prompt = np.random.default_rng(6).integers(
+            0, cfg.vocab_size, 10
+        ).tolist()
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=6)
+        base = Engine(cfg, params, num_slots=256, page_size=4, max_batch=1,
+                      max_seq_len=64)
+        w8 = Engine(cfg, params, num_slots=256, page_size=4, max_batch=1,
+                    max_seq_len=64, weight_quant="int8")
+        out_base = base.generate([prompt], sampling)[0]
+        out_w8 = w8.generate([prompt], sampling)[0]
+        assert len(out_w8) == 6
+        # Quantization may flip a rare argmax; prefixes overwhelmingly
+        # agree on a tiny model.
+        agree = sum(a == b for a, b in zip(out_base, out_w8))
+        assert agree >= 4, (out_base, out_w8)
