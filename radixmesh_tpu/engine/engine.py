@@ -301,6 +301,10 @@ class Engine:
         self._scratch_page = self._scratch_slot // page_size
 
         self.waiting: list[Request] = []
+        # SLO seam (radixmesh_tpu/slo/runner.py): invoked with the request
+        # right after its first token is recorded — the control plane's
+        # prefill service-rate feedback. None = no control plane.
+        self.on_first_token = None
         # Pressure latch: set on preemption, cleared when a request finishes
         # (or the batch drains). While set, admission pauses so the
         # surviving rows run to completion instead of the preempted request
@@ -373,18 +377,42 @@ class Engine:
     # public API
     # ------------------------------------------------------------------
 
-    def add_request(
-        self, prompt: Sequence[int], sampling: SamplingParams | None = None
+    def make_request(
+        self,
+        prompt: Sequence[int],
+        sampling: SamplingParams | None = None,
+        *,
+        tenant: str = "default",
+        ttft_deadline_s: float | None = None,
+        e2e_deadline_s: float | None = None,
     ) -> Request:
+        """Build + validate a request WITHOUT queueing it — the admission
+        seam the SLO control plane (``radixmesh_tpu/slo/``) holds requests
+        behind before deciding to :meth:`enqueue` or shed them."""
         req = Request(
             prompt=np.asarray(prompt, dtype=np.int32),
             sampling=sampling or SamplingParams(),
+            tenant=tenant,
+            ttft_deadline_s=ttft_deadline_s,
+            e2e_deadline_s=e2e_deadline_s,
         )
         if not (0 < len(req.prompt) < self.max_seq_len):
             raise ValueError(f"prompt length {len(req.prompt)} out of range")
         req.submit_time = time.monotonic()
+        return req
+
+    def enqueue(self, req: Request) -> Request:
+        """Hand a built request to the scheduler queue."""
         self.waiting.append(req)
         return req
+
+    def add_request(
+        self,
+        prompt: Sequence[int],
+        sampling: SamplingParams | None = None,
+        **kw,
+    ) -> Request:
+        return self.enqueue(self.make_request(prompt, sampling, **kw))
 
     def cancel(self, rid: int) -> bool:
         """Abort a queued or running request. Running requests release
@@ -693,6 +721,8 @@ class Engine:
     def _record_first_token(self, req: Request) -> None:
         self.stats.ttft_s.append(req.first_token_time - req.submit_time)
         self._m_ttft.observe(req.first_token_time - req.submit_time)
+        if self.on_first_token is not None:
+            self.on_first_token(req)
 
     def _finalize_first_tokens(self, pending: list[tuple]) -> None:
         """ONE batched sample + ONE device→host copy for every request

@@ -52,6 +52,19 @@ class Request:
     )
     lock_node: object = None  # TreeNode protected while RUNNING
     cancelled: bool = False  # aborted by Engine.cancel (output is partial)
+
+    # -- SLO control plane (radixmesh_tpu/slo/) --
+    tenant: str = "default"  # rate-limit / fair-share accounting key
+    ttft_deadline_s: float | None = None  # relative to submit_time
+    e2e_deadline_s: float | None = None  # relative to submit_time
+    admit_time: float = 0.0  # SLO queue → engine dispatch instant
+    shed: bool = False  # refused or dropped by the control plane
+    shed_reason: str = ""
+    degradation_tier: int = 0  # tier in force when dispatched
+    # Backlog cost retired from the controller (first token OR cancel
+    # before one) — whichever side runs first flips it; see
+    # OverloadController.note_retired.
+    slo_retired: bool = False
     # Tree-based speculative drafting stays enabled only while it pays:
     # cleared the first time the tree has no continuation for this
     # request, so novel generations never re-walk the whole history

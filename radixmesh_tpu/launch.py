@@ -196,9 +196,33 @@ def _run_serve(args: argparse.Namespace) -> int:
         kv_quant=args.kv_quant,
         weight_quant=args.weight_quant,
     )
+    slo_cfg = None
+    if args.slo or args.slo_ttft_ms is not None or args.slo_tenant:
+        from radixmesh_tpu.slo import SLOConfig, TenantConfig
+
+        tenants = {}
+        for spec in args.slo_tenant:
+            # NAME=WEIGHT[:RATE_TOKENS_PER_S]
+            name, _, rest = spec.partition("=")
+            if not name or not rest:
+                raise SystemExit(f"--slo-tenant {spec!r}: want NAME=W[:RATE]")
+            weight, _, rate = rest.partition(":")
+            tenants[name] = TenantConfig(
+                weight=float(weight),
+                rate_tokens_per_s=float(rate) if rate else 0.0,
+            )
+        slo_cfg = SLOConfig(
+            tenants=tenants,
+            default_ttft_slo_s=(
+                args.slo_ttft_ms / 1e3
+                if args.slo_ttft_ms is not None
+                else None
+            ),
+        )
+        log.info("SLO control plane enabled (%d tenants)", len(tenants))
     frontend = ServingFrontend(
         engine, host=args.host, port=args.http_port,
-        profile_dir=args.profile_dir, tokenizer=tokenizer,
+        profile_dir=args.profile_dir, tokenizer=tokenizer, slo=slo_cfg,
     )
     print(f"serving {args.model} on http://{args.host}:{frontend.port}", flush=True)
 
@@ -306,6 +330,24 @@ def main(argv: list[str] | None = None) -> int:
         help="speculative decoding: draft up to N tokens by prompt lookup "
         "and verify them in one chunked pass (greedy rows by argmax-prefix, "
         "sampled rows by exact rejection sampling)",
+    )
+    serve.add_argument(
+        "--slo", action="store_true",
+        help="enable the overload control plane (radixmesh_tpu/slo/): "
+        "per-tenant rate limits + weighted-fair admission, deadline "
+        "shedding (429/503 + Retry-After), graceful degradation tiers; "
+        "/generate accepts tenant / ttft_deadline_ms / deadline_ms",
+    )
+    serve.add_argument(
+        "--slo-ttft-ms", type=float, default=None,
+        help="default TTFT SLO applied to requests carrying no explicit "
+        "deadline (requires --slo)",
+    )
+    serve.add_argument(
+        "--slo-tenant", action="append", default=[], metavar="NAME=W[:RATE]",
+        help="tenant entitlement: fair-share weight W and optional "
+        "sustained prompt-token rate limit RATE tok/s (repeatable; "
+        "requires --slo)",
     )
     serve.set_defaults(fn=_run_serve)
 

@@ -88,6 +88,11 @@ __all__ = [
 # exp(finite - MASK) == 0 without the NaN risk of -inf - -inf.
 _MASK = -0.7 * float(np.finfo(np.float32).max)
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.5 ships the same dataclass as TPUCompilerParams; alias it so
+    # the kernels (written against the current name) import either way.
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 
 def _contig_flags(
     page_table: jnp.ndarray,  # [B, padded] int32 (already block-padded)
@@ -105,7 +110,11 @@ def _contig_flags(
     veto coalescing nor make the coalesced fetch unsafe (any byte that
     could differ from the table's pad target is masked — including
     another sequence's in-flight RMW page, whose rewritten bytes are
-    identical except the masked row)."""
+    identical except the masked row). Masking is total, not just
+    score-level: the block loops zero BOTH factors of the p·v
+    contraction at masked positions, so even NaN/Inf resident in a
+    fetched-but-unreferenced pool page (or its scale rows) contributes
+    an exact 0 — there is no finite-pool invariant to uphold."""
     B, padded = page_table.shape
     nblocks = padded // ppb
     pt = page_table.reshape(B, nblocks, ppb)
@@ -366,6 +375,18 @@ def _run_block_loop(
         if quantized:
             p = p * prep_ref[1, pl.ds(i, 1), :]  # (1, bk) v-scales
         v = v_buf[slot].astype(jnp.float32).reshape(bk, -1)  # [bk, D]
+        # Masked columns must contribute EXACT zeros to p·v: coalesced
+        # pad fetches can stage pages no table entry references, and if
+        # one ever holds NaN/Inf, 0·NaN = NaN would poison the
+        # accumulator (ADVICE round-5 #1). Zero BOTH factors — p (pad
+        # v-scale rows may be non-finite) and v (pad pool bytes may be).
+        p = jnp.where(pos < hbm_len, p, 0.0)
+        v = jnp.where(
+            i * bk + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+            < hbm_len,
+            v,
+            0.0,
+        )
         pv = jax.lax.dot_general(  # [G, D]
             p, v,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -553,6 +574,16 @@ def _mh_block_loop(
         if quantized:
             p = p * prep_ref[1, :, pl.ds(i, 1), :]  # (Hkv, 1, bk) v-scales
         v = v_buf[slot].astype(jnp.float32).reshape(Hkv, bk, -1)
+        # Exact zeros at masked positions (see _run_block_loop): pad
+        # fetches may stage unreferenced pages; NaN/Inf there (or in pad
+        # scale rows) must not ride 0·NaN into the accumulator.
+        p = jnp.where(pos < hbm_len, p, 0.0)
+        v = jnp.where(
+            i * bk + jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+            < hbm_len,
+            v,
+            0.0,
+        )
         pv = jax.lax.dot_general(  # (Hkv, G, D)
             p, v,
             dimension_numbers=(((2,), (1,)), ((0,), (0,))),
@@ -1447,6 +1478,16 @@ def _chunk_kernel(
         if quantized:
             p = p * prep_ref[1, pl.ds(i, 1), :]
         v = v_buf[slot].astype(jnp.float32).reshape(bk, -1)
+        # Exact zeros at masked positions (see _run_block_loop): pad
+        # fetches may stage unreferenced pages; NaN/Inf there (or in pad
+        # scale rows) must not ride 0·NaN into the accumulator.
+        p = jnp.where(kv_pos < prior, p, 0.0)
+        v = jnp.where(
+            i * bk + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+            < prior,
+            v,
+            0.0,
+        )
         pv = jax.lax.dot_general(
             p, v,
             dimension_numbers=(((1,), (0,)), ((), ())),

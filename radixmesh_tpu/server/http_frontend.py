@@ -35,6 +35,7 @@ from typing import Sequence
 from radixmesh_tpu.engine.engine import Engine
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 from radixmesh_tpu.obs.metrics import get_registry
+from radixmesh_tpu.slo.control import RequestShed
 from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
 from radixmesh_tpu.utils.logging import get_logger
 
@@ -76,7 +77,8 @@ class EngineRunner:
             self.engine.cancel_all()
         self._stop.set()
         self._wake.set()
-        self._thread.join(timeout=5)
+        if self._thread.ident is not None:  # never-started runners skip join
+            self._thread.join(timeout=5)
 
     def submit(
         self, prompt: Sequence[int], sampling: SamplingParams | None = None
@@ -109,9 +111,15 @@ class EngineRunner:
         # staleness, not corruption (CPython list append is atomic).
         return list(req.output_tokens)
 
+    def _pre_step(self) -> None:
+        """Subclass hook run each scheduler iteration with the runner
+        lock held, before the has-work check (the SLO runner pumps its
+        admission queue here)."""
+
     def _run(self) -> None:
         while not self._stop.is_set():
             with self._lock:
+                self._pre_step()
                 has_work = self.engine.has_work()
                 if has_work:
                     try:
@@ -179,8 +187,20 @@ class ServingFrontend:
         port: int = 0,
         profile_dir: str | None = None,
         tokenizer=None,
+        slo=None,
     ):
-        self.runner = EngineRunner(engine).start()
+        # With an SLOConfig, the overload control plane owns admission:
+        # /generate grows `tenant`, `ttft_deadline_ms`, `deadline_ms`
+        # fields, and overload answers 429/503 + Retry-After instead of
+        # unbounded queueing (radixmesh_tpu/slo/). Imported lazily —
+        # slo.runner imports this module for EngineRunner.
+        if slo is not None:
+            from radixmesh_tpu.slo.runner import SLORunner
+
+            self.runner = SLORunner(engine, slo).start()
+        else:
+            self.runner = EngineRunner(engine).start()
+        self.slo_enabled = slo is not None
         self.log = get_logger("http.serve")
         # Pluggable text seam (server/tokenizer.py): with a tokenizer,
         # /generate accepts {"text": ...} and answers with decoded
@@ -224,6 +244,11 @@ class ServingFrontend:
                             "preemptions": s.preemptions,
                             "spec_proposed": s.spec_proposed,
                             "spec_accepted": s.spec_accepted,
+                            **(
+                                {"slo": frontend.runner.ctl.snapshot()}
+                                if frontend.slo_enabled
+                                else {}
+                            ),
                         },
                     )
                 else:
@@ -309,11 +334,53 @@ class ServingFrontend:
                         max_new_tokens=int(body.get("max_tokens", 16)),
                         stop_token_ids=stop_ids,
                     )
+                    slo_kw = {}
+                    if frontend.slo_enabled:
+                        # SLO fields (ignored without a control plane —
+                        # plain runners have neither tenants nor
+                        # deadlines to enforce them with).
+                        slo_kw["tenant"] = str(body.get("tenant", "default"))
+                        if "ttft_deadline_ms" in body:
+                            slo_kw["ttft_deadline_s"] = (
+                                float(body["ttft_deadline_ms"]) / 1e3
+                            )
+                        if "deadline_ms" in body:
+                            slo_kw["e2e_deadline_s"] = (
+                                float(body["deadline_ms"]) / 1e3
+                            )
                 except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
                     _json_response(self, 400, {"error": str(e)})
                     return
                 try:
-                    req = frontend.runner.submit(ids, sampling)
+                    req = frontend.runner.submit(ids, sampling, **slo_kw)
+                except RequestShed as e:  # overload control plane refusal
+                    if e.retry_after_s is not None:
+                        # Retry-After must precede end_headers; build the
+                        # response by hand rather than teach
+                        # _json_response about extra headers.
+                        body_b = json.dumps(
+                            {
+                                "error": str(e),
+                                "shed": True,
+                                "reason": e.reason,
+                                "retry_after_s": round(e.retry_after_s, 4),
+                            }
+                        ).encode()
+                        self.send_response(e.http_status)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header(
+                            "Retry-After", str(max(1, int(e.retry_after_s)))
+                        )
+                        self.send_header("Content-Length", str(len(body_b)))
+                        self.end_headers()
+                        self.wfile.write(body_b)
+                    else:
+                        _json_response(
+                            self,
+                            e.http_status,
+                            {"error": str(e), "shed": True, "reason": e.reason},
+                        )
+                    return
                 except ValueError as e:  # e.g. prompt too long
                     _json_response(self, 400, {"error": str(e)})
                     return
@@ -326,6 +393,19 @@ class ServingFrontend:
                 tokens = frontend.runner.wait(
                     req, timeout=float(body.get("timeout", 300.0))
                 )
+                if req.shed and not tokens:
+                    # Dropped from the SLO queue before any work ran
+                    # (dispatch-time deadline check or shutdown flush).
+                    _json_response(
+                        self,
+                        503,
+                        {
+                            "error": f"request shed ({req.shed_reason})",
+                            "shed": True,
+                            "reason": req.shed_reason,
+                        },
+                    )
+                    return
                 _json_response(
                     self,
                     200,
@@ -339,6 +419,11 @@ class ServingFrontend:
                             else {}
                         ),
                         **({"cancelled": True} if req.cancelled else {}),
+                        **(
+                            {"shed": True, "reason": req.shed_reason}
+                            if req.shed
+                            else {}
+                        ),
                     },
                 )
 

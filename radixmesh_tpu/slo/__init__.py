@@ -1,0 +1,39 @@
+"""Overload control plane: SLO-aware admission, per-tenant weighted
+fairness, deadline shedding, and graceful degradation — the layer between
+``server/http_frontend.py`` and ``engine/engine.py`` that turns sustained
+overload from unbounded TTFT into bounded, observable behavior.
+
+- :mod:`radixmesh_tpu.slo.control` — the policy state machine
+  (engine-agnostic, deterministic under an injected clock).
+- :mod:`radixmesh_tpu.slo.runner` — :class:`SLORunner`, the control plane
+  wired around the engine scheduler thread.
+"""
+
+from radixmesh_tpu.slo.control import (
+    AdmissionDecision,
+    OverloadController,
+    RequestShed,
+    SLOConfig,
+    TenantConfig,
+)
+
+
+def __getattr__(name):
+    # SLORunner imports server.http_frontend (for EngineRunner), which
+    # itself imports slo.control — loading the runner lazily keeps this
+    # package importable from either side of that seam.
+    if name == "SLORunner":
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        return SLORunner
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "AdmissionDecision",
+    "OverloadController",
+    "RequestShed",
+    "SLOConfig",
+    "SLORunner",
+    "TenantConfig",
+]

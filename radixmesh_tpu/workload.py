@@ -25,8 +25,10 @@ import numpy as np
 
 __all__ = [
     "MultiTurnWorkload",
+    "OverloadWorkload",
     "TextMultiTurnWorkload",
     "run_engine_workload",
+    "run_overload_workload",
     "synth_text",
 ]
 
@@ -156,15 +158,20 @@ class TextMultiTurnWorkload(MultiTurnWorkload):
         user_sentences: int = 4,
         gen_len: int = 8,
         seed: int = 0,
+        system_prefix: str = "You are a helpful assistant. ",
     ):
         self.tokenizer = tokenizer
         self.n_conversations = n_conversations
         self.n_turns = n_turns
         self.gen_len = gen_len
         rng = np.random.default_rng(seed)
-        self.system_text = (
-            "You are a helpful assistant. " + synth_text(rng, system_sentences)
-        )
+        # ``system_prefix`` is part of the cache key space: two workloads
+        # share cross-workload prefix hits iff their prefixes tokenize to
+        # the same head. Warm-up passes must pass a DISTINCT prefix so a
+        # measured run's hit_rate credits only traffic its own ceiling
+        # model accounts for (ADVICE round-5: the shared default head let
+        # warm-up reuse inflate reuse_efficiency past its upper bound).
+        self.system_text = system_prefix + synth_text(rng, system_sentences)
         self.system = tokenizer.encode(self.system_text)
         self._user_turns = [
             [
@@ -244,4 +251,154 @@ def run_engine_workload(engine, workload: MultiTurnWorkload) -> dict:
         # append extra entries to the engine's global list, so callers
         # must NOT slice that by request count).
         "ttft_s": list(ttft),
+    }
+
+
+class OverloadWorkload:
+    """Open-loop multi-tenant overload shape for the SLO control plane
+    (``radixmesh_tpu/slo/``): requests ARRIVE on their own clock at
+    ``offered_tokens_per_s`` of prompt tokens regardless of how fast the
+    engine drains them — the regime where admission control, fairness,
+    and shedding are decidable at all (the closed-loop multi-turn shapes
+    above can never oversubscribe: each round waits for the last).
+
+    Tenants are drawn weight-proportionally; each tenant's prompts share
+    a ``shared_frac`` system head (so the cache sees realistic reuse)
+    with fresh per-request tails. Inter-arrival gaps are exponential
+    (Poisson process), deterministic under ``seed``."""
+
+    def __init__(
+        self,
+        tenants: dict[str, float] | None = None,
+        duration_s: float = 4.0,
+        offered_tokens_per_s: float = 2000.0,
+        prompt_len: int = 48,
+        shared_frac: float = 0.5,
+        gen_len: int = 8,
+        vocab_size: int = 512,
+        seed: int = 0,
+    ):
+        self.tenants = tenants or {"free": 1.0, "pro": 2.0}
+        self.duration_s = duration_s
+        self.offered_tokens_per_s = offered_tokens_per_s
+        self.prompt_len = prompt_len
+        self.gen_len = gen_len
+        rng = np.random.default_rng(seed)
+        names = sorted(self.tenants)
+        weights = np.asarray([self.tenants[n] for n in names], dtype=float)
+        weights /= weights.sum()
+        shared = max(0, min(int(prompt_len * shared_frac), prompt_len - 1))
+        heads = {
+            n: rng.integers(1, vocab_size, size=shared).tolist() for n in names
+        }
+        rate = offered_tokens_per_s / prompt_len  # arrivals per second
+        self.arrivals: list[tuple[float, str, list[int]]] = []
+        t = 0.0
+        while True:
+            t += float(rng.exponential(1.0 / rate))
+            if t >= duration_s:
+                break
+            tenant = names[int(rng.choice(len(names), p=weights))]
+            tail = rng.integers(
+                1, vocab_size, size=prompt_len - shared
+            ).tolist()
+            self.arrivals.append((t, tenant, heads[tenant] + tail))
+
+    @property
+    def offered_requests(self) -> int:
+        return len(self.arrivals)
+
+
+def run_overload_workload(
+    runner,
+    workload: OverloadWorkload,
+    ttft_deadline_s: float | None = None,
+    e2e_deadline_s: float | None = None,
+    wait_timeout_s: float = 120.0,
+) -> dict:
+    """Drive an :class:`OverloadWorkload` open-loop against an
+    :class:`~radixmesh_tpu.slo.runner.SLORunner` (wall-clock paced: the
+    submitting thread sleeps to each arrival instant, so offered load is
+    independent of service rate) and report the overload scorecard:
+    goodput (tokens of deadline-met requests per second), shed counts by
+    reason, per-tenant admitted shares, and TTFT percentiles over
+    admitted requests."""
+    from radixmesh_tpu.engine.request import SamplingParams
+    from radixmesh_tpu.slo.control import RequestShed
+
+    import time as _time
+
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=workload.gen_len)
+    t0 = _time.monotonic()
+    inflight: list[tuple[str, object]] = []
+    shed: dict[str, int] = {}
+    submitted = 0
+    for t_arr, tenant, prompt in workload.arrivals:
+        delay = t0 + t_arr - _time.monotonic()
+        if delay > 0:
+            _time.sleep(delay)
+        submitted += 1
+        try:
+            req = runner.submit(
+                prompt,
+                sampling,
+                tenant=tenant,
+                ttft_deadline_s=ttft_deadline_s,
+                e2e_deadline_s=e2e_deadline_s,
+            )
+        except RequestShed as e:
+            shed[e.reason] = shed.get(e.reason, 0) + 1
+            continue
+        inflight.append((tenant, req))
+    deadline = _time.monotonic() + wait_timeout_s
+    ttft: list[float] = []
+    met = 0
+    good_tokens = 0  # prompt+generated tokens of deadline-met requests
+    served_tokens = 0  # prompt+generated tokens of ALL served requests
+    admitted_tokens: dict[str, int] = {}
+    timed_out = 0
+    for tenant, req in inflight:
+        try:
+            runner.wait(req, timeout=max(0.0, deadline - _time.monotonic()))
+        except TimeoutError:
+            # One stalled request must cost ONE scorecard row, not the
+            # whole report (and, from the bench sweep, the whole round's
+            # curve): count it unserved and keep collecting.
+            timed_out += 1
+            continue
+        if req.shed and not req.output_tokens:
+            # Dropped from the SLO queue at dispatch time.
+            shed[req.shed_reason] = shed.get(req.shed_reason, 0) + 1
+            continue
+        admitted_tokens[tenant] = admitted_tokens.get(tenant, 0) + len(
+            req.prompt
+        )
+        n_tok = len(req.prompt) + len(req.output_tokens)
+        served_tokens += n_tok
+        t_first = req.first_token_time - req.submit_time
+        ttft.append(t_first)
+        if ttft_deadline_s is None or t_first <= ttft_deadline_s:
+            met += 1
+            good_tokens += n_tok
+    elapsed = _time.monotonic() - t0
+    n_adm = len(ttft)
+    return {
+        "offered_requests": submitted,
+        "admitted_requests": n_adm,
+        "served_requests": n_adm,
+        "shed_requests": sum(shed.values()),
+        "shed_by_reason": shed,
+        "timed_out_requests": timed_out,
+        "deadline_met": met,
+        "deadline_met_frac": met / n_adm if n_adm else 0.0,
+        # Token rates are prompt+generated per wall second (submission
+        # window + drain): goodput counts only deadline-met requests,
+        # served_tok_s counts everything that ran to completion — under
+        # deadline-free saturation it IS the admission path's capacity.
+        "goodput_tok_s": good_tokens / elapsed if elapsed > 0 else 0.0,
+        "served_tok_s": served_tokens / elapsed if elapsed > 0 else 0.0,
+        "admitted_tokens_by_tenant": admitted_tokens,
+        "p50_ttft_s": float(np.median(ttft)) if ttft else 0.0,
+        "p99_ttft_s": float(np.quantile(ttft, 0.99)) if ttft else 0.0,
+        "elapsed_s": elapsed,
     }

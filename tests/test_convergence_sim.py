@@ -27,6 +27,8 @@ from radixmesh_tpu.cache.mesh_values import PrefillValue
 from radixmesh_tpu.comm.inproc import InprocHub
 from radixmesh_tpu.config import MeshConfig, NodeRole
 
+pytestmark = pytest.mark.quick
+
 
 def wait_for(pred, timeout=15.0, interval=0.02):
     deadline = time.monotonic() + timeout

@@ -9,6 +9,8 @@ import pytest
 from radixmesh_tpu.engine import Engine, RequestState, SamplingParams
 from radixmesh_tpu.models.llama import ModelConfig, init_params, prefill_forward
 
+pytestmark = pytest.mark.quick
+
 PAGE = 4
 
 

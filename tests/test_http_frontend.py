@@ -370,3 +370,77 @@ class TestConcurrentClients:
             assert not eng.has_work()
         finally:
             f.close(drain_s=0.5)
+
+
+class TestSLOFrontend:
+    """The overload control plane surfaced over HTTP: tenant field,
+    429 + Retry-After on rate limit, /stats slo section."""
+
+    def _frontend(self, **tenant_kw):
+        from radixmesh_tpu.slo import SLOConfig, TenantConfig
+
+        cfg = ModelConfig.tiny()
+        eng = Engine(
+            cfg,
+            init_params(cfg, jax.random.PRNGKey(2)),
+            num_slots=512,
+            page_size=4,
+            max_batch=2,
+            name="http-slo-test",
+        )
+        slo = SLOConfig(
+            tenants={"free": TenantConfig(**tenant_kw)} if tenant_kw else {}
+        )
+        return ServingFrontend(eng, port=0, slo=slo)
+
+    def test_generate_with_tenant_and_stats(self):
+        f = self._frontend()
+        try:
+            status, out = _post(
+                f"http://127.0.0.1:{f.port}/generate",
+                {"input_ids": list(range(1, 16)), "max_tokens": 4,
+                 "tenant": "pro", "ttft_deadline_ms": 60_000},
+            )
+            assert status == 200
+            assert len(out["output_ids"]) >= 1
+            status, body = _get(f"http://127.0.0.1:{f.port}/stats")
+            slo = json.loads(body)["slo"]
+            assert slo["total_admitted"] == 1 and slo["total_shed"] == 0
+            assert "pro" in slo["tenants"]
+        finally:
+            f.close(drain_s=0.5)
+
+    def test_rate_limit_answers_429_with_retry_after(self):
+        # Bucket covers one 15-token prompt; near-zero refill.
+        f = self._frontend(rate_tokens_per_s=0.1, burst_tokens=16)
+        try:
+            status, _ = _post(
+                f"http://127.0.0.1:{f.port}/generate",
+                {"input_ids": list(range(1, 16)), "max_tokens": 2,
+                 "tenant": "free"},
+            )
+            assert status == 200
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(
+                    f"http://127.0.0.1:{f.port}/generate",
+                    {"input_ids": list(range(1, 16)), "max_tokens": 2,
+                     "tenant": "free"},
+                )
+            err = exc.value
+            assert err.code == 429
+            assert int(err.headers["Retry-After"]) >= 1
+            payload = json.loads(err.read())
+            assert payload["shed"] and payload["reason"] == "rate_limited"
+        finally:
+            f.close(drain_s=0.5)
+
+    def test_plain_frontend_ignores_slo_fields(self, frontend):
+        # No control plane: tenant/deadline fields are accepted and
+        # ignored (no tenants exist to enforce them against).
+        status, out = _post(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            {"input_ids": list(range(200, 220)), "max_tokens": 2,
+             "tenant": "whoever", "ttft_deadline_ms": 1},
+        )
+        assert status == 200
+        assert len(out["output_ids"]) >= 1

@@ -713,3 +713,125 @@ class TestContigCoalescing:
         np.testing.assert_allclose(
             np.asarray(base), np.asarray(want), rtol=2e-5, atol=2e-5
         )
+
+
+class TestMaskedPadNaNIsolation:
+    """ADVICE round-5 #1: a coalesced all-pad block fetches the contiguous
+    page range implied by a row's FIRST table entry — which can stage pool
+    pages NO table entry references. NaN/Inf resident in such a page (or
+    in its scale rows, for int8 pools) must never reach a masked row's
+    output: the block loops zero both factors of the p·v contraction at
+    masked positions, so there is no finite-pool invariant to uphold."""
+
+    def _case(self, seed=0, Hq=8, Hkv=2, D=32, page=8, n_pages=16):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+        q = jax.random.normal(ks[0], (1, Hq, D), dtype=jnp.float32)
+        kv = jax.random.normal(
+            ks[1], (2, 1, Hkv, n_pages, page, D), dtype=jnp.float32
+        )
+        return q, kv, page
+
+    @pytest.mark.parametrize("fuse_heads", [False, True])
+    def test_coalesced_pad_fetch_of_unreferenced_nan_page(self, fuse_heads):
+        from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+
+        q, kv, page = self._case()
+        # Valid entries (0, 1) are consecutive, so the block coalesces —
+        # the pad entries (7, 9) don't veto it — and the single
+        # ``pl.ds(0, 4)`` descriptor stages pages 2 and 3, which no table
+        # entry references at all.
+        pt = jnp.array([[0, 1, 7, 9]], dtype=jnp.int32)
+        ln = jnp.array([page + 3], dtype=jnp.int32)  # 2 valid pages
+        clean = paged_attention_pool_kernel(
+            q, kv, pt, ln, 0, interpret=True, fuse_heads=fuse_heads
+        )
+        poisoned = kv.at[:, :, :, 2:4].set(jnp.nan)
+        got = paged_attention_pool_kernel(
+            q, poisoned, pt, ln, 0, interpret=True, fuse_heads=fuse_heads
+        )
+        assert np.all(np.isfinite(np.asarray(got)))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(clean), rtol=1e-6, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("fuse_heads", [False, True])
+    def test_fragmented_pad_fetch_of_nan_page(self, fuse_heads):
+        from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+
+        q, kv, page = self._case(seed=1)
+        # Non-consecutive valid entries: the per-page fallback path
+        # fetches the pad entries' pages (9, 2) directly.
+        pt = jnp.array([[0, 5, 9, 2]], dtype=jnp.int32)
+        ln = jnp.array([page + 3], dtype=jnp.int32)
+        clean = paged_attention_pool_kernel(
+            q, kv, pt, ln, 0, interpret=True, fuse_heads=fuse_heads
+        )
+        poisoned = kv.at[:, :, :, 9].set(jnp.nan).at[:, :, :, 2].set(jnp.inf)
+        got = paged_attention_pool_kernel(
+            q, poisoned, pt, ln, 0, interpret=True, fuse_heads=fuse_heads
+        )
+        assert np.all(np.isfinite(np.asarray(got)))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(clean), rtol=1e-6, atol=1e-6
+        )
+
+    @pytest.mark.parametrize("fuse_heads", [False, True])
+    def test_int8_nan_scales_on_pad_pages(self, fuse_heads):
+        from radixmesh_tpu.ops.paged_attention import paged_attention_pool_kernel
+        from radixmesh_tpu.ops.quant import quantize_kv
+
+        q, kv, page = self._case(seed=2)
+        kv8, scales = quantize_kv(
+            kv.reshape(*kv.shape[:3], -1, kv.shape[-1]), axis=-1
+        )
+        kv8 = kv8.reshape(kv.shape).astype(jnp.int8)
+        scales = scales.reshape(kv.shape[:-1])
+        pt = jnp.array([[0, 1, 7, 9]], dtype=jnp.int32)
+        ln = jnp.array([page + 3], dtype=jnp.int32)
+        clean = paged_attention_pool_kernel(
+            q, kv8, pt, ln, 0, interpret=True, kv_scales=scales,
+            fuse_heads=fuse_heads,
+        )
+        # int8 pages can't hold NaN, but their SCALE rows can: poison the
+        # scales of every page the coalesced pad fetch touches or the pad
+        # entries name.
+        bad = scales.at[:, :, :, 2:4].set(jnp.nan).at[:, :, :, 7].set(
+            jnp.nan
+        ).at[:, :, :, 9].set(jnp.nan)
+        got = paged_attention_pool_kernel(
+            q, kv8, pt, ln, 0, interpret=True, kv_scales=bad,
+            fuse_heads=fuse_heads,
+        )
+        assert np.all(np.isfinite(np.asarray(got)))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(clean), rtol=1e-6, atol=1e-6
+        )
+
+    def test_chunk_kernel_nan_beyond_prior(self):
+        from radixmesh_tpu.ops.attention import attend_chunk_hybrid
+        from radixmesh_tpu.ops.paged_attention import (
+            paged_chunk_attention_kernel,
+        )
+
+        C, Hq, Hkv, D, page = 8, 4, 2, 32, 8
+        ks = jax.random.split(jax.random.PRNGKey(3), 4)
+        q = jax.random.normal(ks[0], (1, C, Hq, D), dtype=jnp.float32)
+        kc = jax.random.normal(ks[1], (1, C, Hkv, D), dtype=jnp.float32)
+        vc = jax.random.normal(ks[2], (1, C, Hkv, D), dtype=jnp.float32)
+        kv = jax.random.normal(
+            ks[3], (2, 1, Hkv, 16, page, D), dtype=jnp.float32
+        )
+        pt = jnp.array([[0, 1, 7, 9]], dtype=jnp.int32)
+        prior = jnp.array([page + 3], dtype=jnp.int32)
+        kvlen = prior + C
+        clean = paged_chunk_attention_kernel(
+            q, kc, vc, kv, pt, prior, kvlen, 0, interpret=True
+        )
+        poisoned = kv.at[:, :, :, 2:4].set(jnp.nan)
+        got = paged_chunk_attention_kernel(
+            q, kc, vc, poisoned, pt, prior, kvlen, 0, interpret=True
+        )
+        assert np.all(np.isfinite(np.asarray(got)))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(clean), rtol=1e-6, atol=1e-6
+        )

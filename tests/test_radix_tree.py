@@ -17,6 +17,8 @@ from radixmesh_tpu.cache.radix_tree import (
     match_len,
 )
 
+pytestmark = pytest.mark.quick
+
 
 def ids(n, start=0):
     return np.arange(start, start + n, dtype=np.int32)

@@ -1,0 +1,534 @@
+"""SLO control plane (``radixmesh_tpu/slo/``): admission, fairness,
+deadline shedding, degradation tiers — the policy state machine under a
+virtual clock, plus the :class:`SLORunner` wired around a real engine.
+
+Every controller test drives :class:`OverloadController` with an injected
+clock, so behavior is exactly reproducible; the runner tests use the tiny
+fp32 model from ``test_engine.py`` on CPU."""
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
+from radixmesh_tpu.obs.metrics import get_registry
+from radixmesh_tpu.slo.control import (
+    SHED_DEADLINE,
+    SHED_DISPATCH_DEADLINE,
+    SHED_OVER_BURST,
+    SHED_QUEUE_FULL,
+    SHED_RATE_LIMITED,
+    OverloadController,
+    RequestShed,
+    SLOConfig,
+    TenantConfig,
+)
+
+pytestmark = pytest.mark.quick
+
+
+class Clock:
+    """Manually-advanced monotonic clock."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def make_req(tenant: str, n_tokens: int, submit_time: float,
+             ttft_deadline_s=None, max_new_tokens=8) -> Request:
+    req = Request(
+        prompt=np.arange(1, n_tokens + 1, dtype=np.int32),
+        sampling=SamplingParams(max_new_tokens=max_new_tokens),
+        tenant=tenant,
+        ttft_deadline_s=ttft_deadline_s,
+    )
+    req.submit_time = submit_time
+    return req
+
+
+def offer_and_enqueue(ctl, clock, tenant, n_tokens, ttft_deadline_s=None):
+    dec = ctl.offer(tenant, n_tokens, ttft_deadline_s, now=clock())
+    if dec.admitted:
+        req = make_req(tenant, n_tokens, clock(), ttft_deadline_s)
+        ctl.enqueue(req, now=clock())
+        return req
+    return None
+
+
+class TestTokenBucket:
+    def test_burst_then_rate_limits(self):
+        clock = Clock()
+        cfg = SLOConfig(
+            tenants={
+                "t": TenantConfig(rate_tokens_per_s=100, burst_tokens=250)
+            }
+        )
+        ctl = OverloadController(cfg, clock=clock)
+        # Burst depth covers two 100-token requests; the third sheds.
+        assert ctl.offer("t", 100, now=clock()).admitted
+        assert ctl.offer("t", 100, now=clock()).admitted
+        dec = ctl.offer("t", 100, now=clock())
+        assert not dec.admitted
+        assert dec.reason == SHED_RATE_LIMITED
+        # retry_after covers the deficit: 50 tokens short at 100 tok/s.
+        assert dec.retry_after_s == pytest.approx(0.5)
+        # Refill at the provisioned rate re-admits.
+        clock.advance(0.6)
+        assert ctl.offer("t", 100, now=clock()).admitted
+
+    def test_over_burst_prompt_is_nonretriable_413(self):
+        """A prompt the bucket can NEVER hold must not get a retriable
+        429 (the client would loop forever) — and must not spend any
+        rate budget on the way out."""
+        clock = Clock()
+        cfg = SLOConfig(
+            tenants={"t": TenantConfig(rate_tokens_per_s=100)}
+        )  # burst defaults to one second of rate = 100 tokens
+        ctl = OverloadController(cfg, clock=clock)
+        dec = ctl.offer("t", 150, now=clock())
+        assert not dec.admitted and dec.reason == SHED_OVER_BURST
+        assert dec.retry_after_s is None
+        assert RequestShed(dec.reason, None, "t").http_status == 413
+        # The refusal spent nothing: a full-burst prompt still admits.
+        assert ctl.offer("t", 100, now=clock()).admitted
+
+    def test_unlimited_tenant_never_rate_sheds(self):
+        clock = Clock()
+        ctl = OverloadController(SLOConfig(), clock=clock)
+        for _ in range(100):
+            assert ctl.offer("anyone", 10_000, now=clock()).admitted
+
+    def test_queue_full_sheds(self):
+        clock = Clock()
+        ctl = OverloadController(
+            SLOConfig(max_queue_requests=2), clock=clock
+        )
+        assert offer_and_enqueue(ctl, clock, "a", 8) is not None
+        assert offer_and_enqueue(ctl, clock, "a", 8) is not None
+        dec = ctl.offer("a", 8, now=clock())
+        assert not dec.admitted and dec.reason == SHED_QUEUE_FULL
+
+
+class TestWeightedFairQueue:
+    def test_dispatch_order_tracks_weights(self):
+        """With both tenants backlogged, dispatched token shares follow
+        the 3:1 weight ratio — start-time fair queueing's guarantee."""
+        clock = Clock()
+        cfg = SLOConfig(
+            tenants={
+                "heavy": TenantConfig(weight=3.0),
+                "light": TenantConfig(weight=1.0),
+            }
+        )
+        ctl = OverloadController(cfg, clock=clock)
+        for _ in range(40):
+            offer_and_enqueue(ctl, clock, "heavy", 10)
+            offer_and_enqueue(ctl, clock, "light", 10)
+        served = {"heavy": 0, "light": 0}
+        for _ in range(20):  # drain only half: the backlogged regime
+            req = ctl.pop_ready(now=clock())
+            served[req.tenant] += len(req.prompt)
+        assert served["heavy"] + served["light"] == 200
+        # 3:1 entitlement → heavy gets 150 of 200 (±1 request of rounding).
+        assert abs(served["heavy"] - 150) <= 10
+
+    def test_fifo_within_tenant(self):
+        clock = Clock()
+        ctl = OverloadController(SLOConfig(), clock=clock)
+        reqs = [offer_and_enqueue(ctl, clock, "a", 8) for _ in range(5)]
+        popped = [ctl.pop_ready(now=clock()) for _ in range(5)]
+        assert [r.rid for r in popped] == [r.rid for r in reqs]
+
+    def test_bursty_tenant_cannot_convoy_steady_one(self):
+        """A 100-request burst queued FIRST must not serialize ahead of a
+        single later arrival from an equal-weight tenant: virtual finish
+        times interleave the steady tenant near the front."""
+        clock = Clock()
+        ctl = OverloadController(SLOConfig(), clock=clock)
+        for _ in range(100):
+            offer_and_enqueue(ctl, clock, "bursty", 10)
+        late = offer_and_enqueue(ctl, clock, "steady", 10)
+        position = None
+        for i in range(101):
+            if ctl.pop_ready(now=clock()) is late:
+                position = i
+                break
+        assert position is not None and position <= 2
+
+
+class TestDeadlineAdmission:
+    def test_uncalibrated_admits_everything(self):
+        clock = Clock()
+        ctl = OverloadController(SLOConfig(), clock=clock)
+        # No EWMA yet: no wait estimate exists, so nothing deadline-sheds.
+        assert ctl.offer("a", 10_000, ttft_deadline_s=0.001, now=clock()).admitted
+
+    def test_sheds_when_backlog_exceeds_deadline(self):
+        clock = Clock()
+        ctl = OverloadController(SLOConfig(), clock=clock)
+        ctl.observe_service(1000, 1.0)  # 1000 tok/s
+        # 2000 backlogged tokens ≈ 2 s of queue ahead.
+        for _ in range(20):
+            offer_and_enqueue(ctl, clock, "a", 100)
+        dec = ctl.offer("a", 100, ttft_deadline_s=0.5, now=clock())
+        assert not dec.admitted and dec.reason == SHED_DEADLINE
+        assert dec.retry_after_s > 0
+        # A deadline generous enough for the backlog still admits.
+        assert ctl.offer("a", 100, ttft_deadline_s=10.0, now=clock()).admitted
+
+    def test_dispatch_time_recheck_drops_stale_requests(self):
+        """A request that waited past its deadline in queue is dropped at
+        pop time — it never occupies a batch row."""
+        clock = Clock()
+        ctl = OverloadController(SLOConfig(), clock=clock)
+        ctl.observe_service(1000, 1.0)
+        stale = offer_and_enqueue(ctl, clock, "a", 100, ttft_deadline_s=0.5)
+        fresh = offer_and_enqueue(ctl, clock, "a", 100)  # no deadline
+        clock.advance(1.0)  # stale's deadline has passed
+        got = ctl.pop_ready(now=clock())
+        assert got is fresh
+        assert stale.shed and stale.shed_reason == SHED_DISPATCH_DEADLINE
+        assert ctl.drain_shed() == [stale]
+
+    def test_deadline_shed_does_not_spend_rate_budget(self):
+        """A deadline refusal happens BEFORE the bucket take: work that
+        was never admitted must not drain the tenant's rate budget into
+        spurious 429s once the backlog clears."""
+        clock = Clock()
+        cfg = SLOConfig(
+            tenants={
+                "t": TenantConfig(rate_tokens_per_s=100, burst_tokens=200)
+            }
+        )
+        ctl = OverloadController(cfg, clock=clock)
+        ctl.observe_service(1000, 1.0)
+        # 3 s of dispatched-but-unserved work ahead of any arrival.
+        for _ in range(30):
+            offer_and_enqueue(ctl, clock, "other", 100)
+        while ctl.pop_ready(now=clock()) is not None:
+            pass
+        for _ in range(5):
+            dec = ctl.offer("t", 100, ttft_deadline_s=0.5, now=clock())
+            assert not dec.admitted and dec.reason == SHED_DEADLINE
+        # Full burst (200 tokens) survived all five refusals.
+        assert ctl.offer("t", 100, now=clock()).admitted
+        assert ctl.offer("t", 100, now=clock()).admitted
+
+    def test_cancel_before_first_token_retires_backlog(self):
+        """note_retired/note_first_token are idempotent per request in
+        either order, so a cancel can never leak dispatched tokens into
+        the backlog estimate (a leak would inflate est_wait forever and
+        disarm the idle-probe escape)."""
+        clock = Clock()
+        ctl = OverloadController(SLOConfig(), clock=clock)
+        ctl.observe_service(1000, 1.0)
+        req = offer_and_enqueue(ctl, clock, "a", 500)
+        assert ctl.pop_ready(now=clock()) is req
+        assert ctl.est_wait_s() == pytest.approx(0.5)
+        req.admit_time = clock()
+        ctl.note_retired(req, now=clock())
+        assert ctl.est_wait_s() == 0.0
+        ctl.note_first_token(req, now=clock.advance(0.1))  # late: no-op
+        assert ctl._dispatched_tokens == 0
+        # Reverse order: first token wins, the retire is a no-op.
+        req2 = offer_and_enqueue(ctl, clock, "a", 500)
+        assert ctl.pop_ready(now=clock()) is req2
+        req2.admit_time = clock()
+        ctl.note_first_token(req2, now=clock.advance(0.1))
+        ctl.note_retired(req2, now=clock())
+        assert ctl._dispatched_tokens == 0
+
+    def test_default_ttft_slo_applies(self):
+        clock = Clock()
+        ctl = OverloadController(
+            SLOConfig(default_ttft_slo_s=0.5), clock=clock
+        )
+        ctl.observe_service(1000, 1.0)
+        for _ in range(20):
+            offer_and_enqueue(ctl, clock, "a", 100)
+        dec = ctl.offer("a", 100, now=clock())  # carries no deadline
+        assert not dec.admitted and dec.reason == SHED_DEADLINE
+
+
+class TestDegradationTiers:
+    def cfg(self):
+        return SLOConfig(
+            tier_backlog_s=(0.5, 1.5, 3.0),
+            tier_up_hold_s=0.1,
+            tier_down_hold_s=1.0,
+        )
+
+    def test_tier_ladder_up_and_down_with_hysteresis(self):
+        clock = Clock()
+        ctl = OverloadController(self.cfg(), clock=clock)
+        ctl.observe_service(1000, 1.0)
+        assert ctl.update_tier(now=clock()) == 0
+        # 4 s of backlog: past every threshold, but not yet sustained.
+        for _ in range(40):
+            offer_and_enqueue(ctl, clock, "a", 100)
+        assert ctl.update_tier(now=clock()) == 0
+        clock.advance(0.2)  # > tier_up_hold_s
+        assert ctl.update_tier(now=clock()) == 3
+        # Drain the queue: backlog empties, but the tier holds until the
+        # recovery is sustained (tier_down_hold_s).
+        drained = 0
+        while ctl.pop_ready(now=clock()) is not None:
+            drained += 1
+        assert drained == 40
+        for _ in range(40):  # first tokens retire the backlog tokens
+            req = make_req("a", 100, clock())
+            req.admit_time = clock()
+            ctl.note_first_token(req, now=clock.advance(0.001))
+        assert ctl.update_tier(now=clock()) == 3
+        clock.advance(1.1)
+        assert ctl.update_tier(now=clock()) == 0
+        events = ctl.tier_events
+        assert [(old, new) for _, old, new, _ in events] == [(0, 3), (3, 0)]
+
+    def test_transient_spike_does_not_flap(self):
+        clock = Clock()
+        ctl = OverloadController(self.cfg(), clock=clock)
+        ctl.observe_service(1000, 1.0)
+        reqs = [offer_and_enqueue(ctl, clock, "a", 100) for _ in range(40)]
+        # Spike visible for less than tier_up_hold_s, then drained.
+        assert ctl.update_tier(now=clock()) == 0
+        clock.advance(0.05)
+        while ctl.pop_ready(now=clock()) is not None:
+            pass
+        for r in reqs:
+            r.admit_time = clock()
+            ctl.note_first_token(r, now=clock())
+        clock.advance(0.2)
+        assert ctl.update_tier(now=clock()) == 0
+        assert ctl.tier_events == []
+
+
+class TestObservability:
+    def test_metrics_exported(self):
+        clock = Clock()
+        cfg = SLOConfig(
+            tenants={"t": TenantConfig(rate_tokens_per_s=10, burst_tokens=10)}
+        )
+        ctl = OverloadController(cfg, clock=clock)
+        req = offer_and_enqueue(ctl, clock, "t", 8)
+        assert req is not None
+        assert not ctl.offer("t", 8, now=clock()).admitted  # bucket empty
+        ctl.pop_ready(now=clock())
+        snap = get_registry().snapshot()
+        assert snap['slo_admitted_requests_total{tenant="t"}'] == 1
+        assert (
+            snap['slo_shed_requests_total{reason="rate_limited",tenant="t"}']
+            == 1
+        )
+        assert 'slo_degradation_tier' in snap
+        # The exposition endpoint renders the same series.
+        text = get_registry().render()
+        assert "slo_queue_depth_requests" in text
+        assert "slo_admission_wait_seconds_bucket" in text
+
+    def test_snapshot_shape(self):
+        ctl = OverloadController(SLOConfig(), clock=Clock())
+        snap = ctl.snapshot()
+        for key in ("tier", "backlog_tokens", "est_wait_s", "tenants",
+                    "total_admitted", "total_shed"):
+            assert key in snap
+
+
+class TestConfigValidation:
+    def test_bad_weight(self):
+        with pytest.raises(ValueError):
+            TenantConfig(weight=0)
+
+    def test_bad_thresholds(self):
+        with pytest.raises(ValueError):
+            SLOConfig(tier_backlog_s=(3.0, 1.0, 2.0))
+
+    def test_shed_error_http_mapping(self):
+        assert RequestShed(SHED_RATE_LIMITED).http_status == 429
+        assert RequestShed(SHED_DEADLINE).http_status == 503
+
+
+# ----------------------------------------------------------------------
+# SLORunner over a real engine (tiny fp32 model, CPU)
+# ----------------------------------------------------------------------
+
+from tests.test_engine import make_engine, model, oracle_generate  # noqa: F401,E402
+
+
+class TestSLORunner:
+    def test_light_load_is_transparent(self, model):
+        """At ≤1× load the SLO layer must change NOTHING: outputs match
+        the oracle, nothing sheds, tier stays 0."""
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        cfg, params = model
+        eng = make_engine(model)
+        runner = SLORunner(eng, SLOConfig()).start()
+        try:
+            rng = np.random.default_rng(5)
+            prompts = [
+                rng.integers(1, cfg.vocab_size, n).tolist() for n in (7, 13, 19)
+            ]
+            reqs = [
+                runner.submit(p, SamplingParams(max_new_tokens=5), tenant=t)
+                for p, t in zip(prompts, ("a", "b", "a"))
+            ]
+            outs = [runner.wait(r, timeout=120) for r in reqs]
+            for p, o in zip(prompts, outs):
+                assert o == oracle_generate(cfg, params, p, 5)
+            snap = runner.ctl.snapshot()
+            assert snap["total_shed"] == 0
+            assert snap["tier"] == 0
+            assert snap["total_admitted"] == 3
+        finally:
+            runner.close()
+
+    def test_rate_limited_tenant_sheds_with_retry_after(self, model):
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        cfg, _ = model
+        eng = make_engine(model)
+        # Near-zero refill rate: the bucket must stay empty across however
+        # long the first generation takes on a real clock.
+        slo = SLOConfig(
+            tenants={
+                "free": TenantConfig(rate_tokens_per_s=0.1, burst_tokens=24)
+            }
+        )
+        runner = SLORunner(eng, slo).start()
+        try:
+            rng = np.random.default_rng(6)
+            ok = runner.submit(
+                rng.integers(1, cfg.vocab_size, 20).tolist(),
+                SamplingParams(max_new_tokens=3),
+                tenant="free",
+            )
+            runner.wait(ok, timeout=120)
+            with pytest.raises(RequestShed) as exc:
+                runner.submit(
+                    rng.integers(1, cfg.vocab_size, 20).tolist(),
+                    SamplingParams(max_new_tokens=3),
+                    tenant="free",
+                )
+            assert exc.value.http_status == 429
+            assert exc.value.retry_after_s > 0
+        finally:
+            runner.close()
+
+    def test_tier_knobs_apply_and_restore(self, model):
+        """Force the controller through the ladder and check the runner
+        actually turns engine knobs (spec decode, wave width) and caps
+        max_new_tokens — then restores on recovery."""
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        cfg, _ = model
+        eng = make_engine(model, spec_decode_tokens=3)
+        base_wave = eng.prefill_wave_tokens
+        clock = Clock()
+        slo = SLOConfig(
+            tier_backlog_s=(0.5, 1.5, 3.0),
+            tier_up_hold_s=0.0,
+            tier_down_hold_s=0.5,
+            tier2_max_new_tokens=2,
+        )
+        runner = SLORunner(eng, slo, clock=clock)
+        ctl = runner.ctl
+        ctl.observe_service(1000, 1.0)
+        # 4 s of estimated backlog → tier 3 (hold 0 ⇒ immediate).
+        queued = []
+        for _ in range(40):
+            req = make_req("a", 100, clock(), max_new_tokens=50)
+            ctl.enqueue(req, now=clock())
+            queued.append(req)
+        runner._pump()
+        assert runner._applied_tier == 3
+        assert eng.spec_decode_tokens == 0
+        assert eng.prefill_wave_tokens < base_wave
+        # Dispatched requests got the tier-2 output cap. (Identity, not
+        # ==: dataclass equality would compare prompt arrays.)
+        dispatched = [r for r in queued if any(r is w for w in eng.waiting)]
+        assert dispatched and all(
+            r.sampling.max_new_tokens == 2 and r.degradation_tier == 3
+            for r in dispatched
+        )
+        # Recovery: drain queues + backlog, hold past tier_down_hold_s
+        # (one pump starts the below-threshold timer, the next — after
+        # the hold — steps down).
+        while ctl.pop_ready(now=clock()) is not None:
+            pass
+        for r in queued:
+            r.admit_time = clock()
+            ctl.note_first_token(r, now=clock())
+        runner._pump()
+        assert runner._applied_tier == 3
+        clock.advance(0.6)
+        runner._pump()
+        assert runner._applied_tier == 0
+        assert eng.spec_decode_tokens == 3
+        assert eng.prefill_wave_tokens == base_wave
+        eng.waiting.clear()  # never stepped; drop the fabricated requests
+
+    def test_e2e_deadline_cancels_running_request(self, model):
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        cfg, _ = model
+        eng = make_engine(model)
+        runner = SLORunner(eng, SLOConfig()).start()
+        try:
+            rng = np.random.default_rng(8)
+            req = runner.submit(
+                rng.integers(1, cfg.vocab_size, 10).tolist(),
+                SamplingParams(max_new_tokens=10_000_000),
+                tenant="a",
+                e2e_deadline_s=0.3,
+            )
+            out = runner.wait(req, timeout=120)
+            assert req.cancelled and req.shed_reason == "e2e_deadline"
+            assert len(out) < 10_000_000
+        finally:
+            runner.close()
+
+    def test_close_flushes_queued_requests(self, model):
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        cfg, _ = model
+        eng = make_engine(model)
+        runner = SLORunner(eng, SLOConfig())  # NOT started: nothing drains
+        rng = np.random.default_rng(9)
+        req = runner.submit(
+            rng.integers(1, cfg.vocab_size, 10).tolist(),
+            SamplingParams(max_new_tokens=4),
+        )
+        runner.close()
+        assert req.state is RequestState.FINISHED
+        assert req.shed and req.shed_reason == "shutdown"
+
+    def test_cancel_retires_dispatched_backlog(self, model):
+        """Cancelling a dispatched request before its first token retires
+        its cost from the controller backlog (review finding: the leak
+        would otherwise pin est_wait high forever)."""
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        cfg, _ = model
+        eng = make_engine(model)
+        runner = SLORunner(eng, SLOConfig())  # NOT started: manual pump
+        runner.ctl.observe_service(1000, 1.0)
+        rng = np.random.default_rng(11)
+        req = runner.submit(
+            rng.integers(1, cfg.vocab_size, 10).tolist(),
+            SamplingParams(max_new_tokens=4),
+        )
+        with runner._lock:
+            runner._pump()  # dispatch into engine.waiting, admit_time set
+        assert req.admit_time > 0
+        assert runner.ctl.snapshot()["backlog_tokens"] == 10
+        assert runner.cancel(req.rid)
+        assert req.cancelled
+        assert runner.ctl.snapshot()["backlog_tokens"] == 0
+        runner.close()
