@@ -124,6 +124,46 @@ def validate_ringbench(report: dict) -> list[str]:
     return missing
 
 
+def validate_trace(obj) -> list[str]:
+    """Schema violations of a Chrome trace-event artifact emitted by the
+    flight recorder (``radixmesh_tpu/obs/trace_plane.py``) — empty list =
+    valid. Pinned contract: a JSON object with a ``traceEvents`` list;
+    every complete event (``ph == "X"``) carries numeric non-negative
+    ``ts``/``dur`` and a ``tid``; within each tid lane the ``ts`` values
+    are non-decreasing (Perfetto renders out-of-order lanes, but a
+    regression here means the exporter's sort broke). Import-safe from
+    artifact tests (no jax at module scope)."""
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return ["artifact is not a JSON object"]
+    events = obj.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    last_ts: dict = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"traceEvents[{i}] is not an object")
+            continue
+        if ev.get("ph") != "X":
+            continue  # metadata / instant events carry no duration
+        ts, dur, tid = ev.get("ts"), ev.get("dur"), ev.get("tid")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"traceEvents[{i}].ts invalid: {ts!r}")
+            continue
+        if not isinstance(dur, (int, float)) or dur < 0:
+            problems.append(f"traceEvents[{i}].dur invalid: {dur!r}")
+        if tid is None:
+            problems.append(f"traceEvents[{i}].tid missing")
+            continue
+        if ts < last_ts.get(tid, 0.0):
+            problems.append(
+                f"traceEvents[{i}].ts={ts} regresses within tid={tid} "
+                f"(prev {last_ts[tid]})"
+            )
+        last_ts[tid] = ts
+    return problems
+
+
 def _error_json(msg: str) -> str:
     return json.dumps({
         "metric": "decode_tokens_per_sec_per_chip",
@@ -1495,6 +1535,7 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
     shape_tokens: dict[str, int] = {}
     tot_prompt = tot_cached = tot_req = 0
     all_ttft: list[float] = []
+    trace_artifact: dict = {}
     for shape_idx, (name, sizes) in enumerate(shapes.items()):
         # Warmup must mirror the measured run's SHAPES (same conversation
         # count → same batched-prefill buckets), or the group-prefill
@@ -1530,6 +1571,39 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
             f"efficiency {ns['reuse_efficiency']:.3f}), "
             f"p50_ttft={ns['p50_ttft_s']*1e3:.1f} ms"
         )
+    # Request-flight trace artifact (TRACE_r{N}.json — load in Perfetto):
+    # captured in a SEPARATE, UNTIMED pass after every measured shape, so
+    # the gated rates above never include flight-recorder overhead and
+    # stay comparable with pre-tracing rounds. The traced pass reuses the
+    # base sizes under a fresh seed; its numbers fold into nothing.
+    from radixmesh_tpu.obs.trace_plane import (
+        FlightRecorder,
+        configure,
+        set_recorder,
+    )
+
+    try:
+        configure(capacity=1 << 16, sample=1.0)
+        trace_path = os.path.join(_REPO, f"TRACE_r{current_round():02d}.json")
+        traced = run_engine_workload(
+            engine,
+            MultiTurnWorkload(
+                vocab_size=cfg.vocab_size, seed=2000, **shapes["base"]
+            ),
+            trace_path=trace_path,
+        )
+        trace_artifact = {
+            "trace_artifact": os.path.basename(trace_path),
+            "trace_spans": traced.get("trace_spans", 0),
+        }
+        log(f"trace: {trace_artifact['trace_spans']} spans -> "
+            f"{trace_artifact['trace_artifact']} (untimed pass)")
+    except Exception as exc:  # noqa: BLE001 — the artifact must not cost the round
+        log(f"trace capture: FAILED {type(exc).__name__}: {exc}")
+        trace_artifact = {"trace_error": f"{type(exc).__name__}: {exc}"[:200]}
+    finally:
+        set_recorder(FlightRecorder())  # back to the disabled default
+
     hit_rate = tot_cached / tot_prompt if tot_prompt else 0.0
     # Aggregate ceiling: token-weighted over the shapes' own ceilings —
     # the wide shape's traffic is mostly unreusable BY CONSTRUCTION, so
@@ -1565,6 +1639,7 @@ def _north_star(cfg, params, page_size: int, on_tpu: bool) -> dict:
         "p99_ttft_ms": round(p99 * 1e3, 2),
         "requests": tot_req,
         "shapes": per_shape,
+        **trace_artifact,
         # First-class gates: base-shape raw rate (the ShareGPT-like
         # BASELINE target) AND aggregate reuse efficiency (raw aggregate
         # is ceiling-bound by the adversarial wide shape).

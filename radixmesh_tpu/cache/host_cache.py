@@ -154,17 +154,17 @@ class HierarchicalCache(RadixTree):
         self.log = get_logger("hicache")
         reg = get_registry()
         self._m_backup = reg.counter(
-            "hicache_backup_tokens_total", "tokens written back HBM → host RAM"
+            "radixmesh_hicache_backup_tokens_total", "tokens written back HBM → host RAM"
         )
         self._m_restore = reg.counter(
-            "hicache_restore_tokens_total", "tokens restored host RAM → HBM"
+            "radixmesh_hicache_restore_tokens_total", "tokens restored host RAM → HBM"
         )
         self._m_host_evicted = reg.counter(
-            "hicache_host_evicted_tokens_total",
+            "radixmesh_hicache_host_evicted_tokens_total",
             "host-resident tokens dropped when the host arena filled",
         )
         self._m_restore_stall = reg.histogram(
-            "hicache_restore_stall_seconds",
+            "radixmesh_hicache_restore_stall_seconds",
             "host-side time spent reading the arena + dispatching "
             "restore writes per match_and_load (device execution "
             "overlaps later admission work; this is the blocking part)",

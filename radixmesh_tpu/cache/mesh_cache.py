@@ -87,10 +87,17 @@ from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode, as_
 from radixmesh_tpu.comm.communicator import Communicator, create_communicator
 from radixmesh_tpu.config import MeshConfig, NodeRole
 from radixmesh_tpu.obs.metrics import get_registry
+from radixmesh_tpu.obs.trace_plane import get_recorder
+from radixmesh_tpu.obs.tracing import recorded
 from radixmesh_tpu.policy.conflict import NodeRankConflictResolver
 from radixmesh_tpu.policy.hierarchy import HierPlan, auto_group_size
 from radixmesh_tpu.policy.sync_algo import BaseSyncAlgo, get_sync_algo
-from radixmesh_tpu.policy.topology import TopologyView, decode_view, encode_view
+from radixmesh_tpu.policy.topology import (
+    TopologyView,
+    decode_view,
+    encode_view,
+    membership_gauges,
+)
 from radixmesh_tpu.utils.logging import get_logger
 from radixmesh_tpu.utils.sync import AtomicCounter
 
@@ -232,11 +239,12 @@ class MeshCache:
         # process (the inproc test harness runs whole rings in-process).
         reg = get_registry()
         node = f"{self.role.value}@{self.rank}"
+        self._node_label = node
         self._m_sent = reg.counter(
-            "mesh_oplogs_sent_total", "oplogs enqueued for ring transmission", ("node",)
+            "radixmesh_mesh_oplogs_sent_total", "oplogs enqueued for ring transmission", ("node",)
         ).labels(node=node)
         received = reg.counter(
-            "mesh_oplogs_received_total",
+            "radixmesh_mesh_oplogs_received_total",
             "oplogs received from the ring",
             ("node", "type"),
         )
@@ -247,30 +255,62 @@ class MeshCache:
             t: received.labels(node=node, type=t.name) for t in OplogType
         }
         self._m_dropped = reg.counter(
-            "mesh_oplogs_dropped_total",
+            "radixmesh_mesh_oplogs_dropped_total",
             "oplogs dropped on outbound-queue overflow",
             ("node",),
         ).labels(node=node)
         self._m_bridged = reg.counter(
-            "mesh_spine_bridges_total",
+            "radixmesh_mesh_spine_bridges_total",
             "oplogs bridged group→spine by this leader (hier topology)",
             ("node",),
         ).labels(node=node)
         self._m_conflicts = reg.counter(
-            "mesh_conflicts_total", "multi-writer value conflicts resolved", ("node",)
+            "radixmesh_mesh_conflicts_total", "multi-writer value conflicts resolved", ("node",)
         ).labels(node=node)
         self._m_gc_rounds = reg.counter(
-            "mesh_gc_rounds_total", "distributed GC query laps originated", ("node",)
+            "radixmesh_mesh_gc_rounds_total", "distributed GC query laps originated", ("node",)
         ).labels(node=node)
         self._m_gc_freed = reg.counter(
-            "mesh_gc_freed_slots_total", "KV slots reclaimed by distributed GC", ("node",)
+            "radixmesh_mesh_gc_freed_slots_total", "KV slots reclaimed by distributed GC", ("node",)
         ).labels(node=node)
         self._m_lag = reg.histogram(
-            "mesh_oplog_lag_seconds",
+            "radixmesh_mesh_oplog_lag_seconds",
             "origin-to-apply replication lag (origin wall clock; skew degrades "
             "telemetry only)",
             ("node",),
         ).labels(node=node)
+        # Membership/topology gauges (failover + hier re-election were
+        # visible only in logs before): updated on every adopted view
+        # change and successor recompute.
+        self._g_membership = {
+            "view_epoch": reg.gauge(
+                "radixmesh_mesh_view_epoch",
+                "epoch of the currently adopted topology view",
+                ("node",),
+            ).labels(node=node),
+            "alive_nodes": reg.gauge(
+                "radixmesh_mesh_alive_nodes",
+                "ring members alive in the current view",
+                ("node",),
+            ).labels(node=node),
+            "leader_flag": reg.gauge(
+                "radixmesh_mesh_leader_flag",
+                "1 when this node is its group's leader (hier) or the "
+                "view master (flat ring)",
+                ("node",),
+            ).labels(node=node),
+            "spine_nodes": reg.gauge(
+                "radixmesh_mesh_spine_nodes",
+                "leader-spine members in the current view (0 = flat ring)",
+                ("node",),
+            ).labels(node=node),
+            "successor_rank": reg.gauge(
+                "radixmesh_mesh_successor_rank",
+                "this node's current ring successor rank (-1 = none)",
+                ("node",),
+            ).labels(node=node),
+        }
+        self._update_membership_gauges()
 
         self._comm: Communicator | None = None
         self._router_comms: list[Communicator] = []
@@ -358,6 +398,7 @@ class MeshCache:
                 self._succ_rank = self.view.successor_of(self.rank)
         # Mark started before spawning threads: the ticker's first tick must
         # not be dropped by the _started gate in _send_bytes.
+        self._update_membership_gauges()
         self._started = True
         # Silence is only meaningful once the node participates in the
         # ring; counting the construct-to-start gap would fire a spurious
@@ -621,7 +662,26 @@ class MeshCache:
         # be a full ring lap (the systematically largest value) with no
         # apply behind it, inflating p99 for operators alerting on lag.
         if op.ts and op.origin_rank != self.rank:
-            self._m_lag.observe(max(0.0, time.time() - op.ts))
+            lag = max(0.0, time.time() - op.ts)
+            self._m_lag.observe(lag)
+            rec = get_recorder()
+            if rec.enabled:
+                # Flight-recorder lag span on this node's ring lane,
+                # ending "now": the origin stamped wall-clock at enqueue
+                # (existing per-origin lag bookkeeping — NO wire-format
+                # change), so t0 is back-derived into the local monotonic
+                # base the request spans use. Correlation with a request
+                # is by time overlap in the timeline viewer; no trace id
+                # crosses the wire.
+                rec.event(
+                    f"ring:{self._node_label}",
+                    "replication_lag",
+                    time.monotonic() - lag,
+                    lag,
+                    cat="ring",
+                    origin_rank=int(op.origin_rank),
+                    op_type=op.op_type.name,
+                )
         self._last_rx = time.monotonic()
         with self._lock:
             op.ttl -= 1
@@ -956,11 +1016,31 @@ class MeshCache:
                         ttl=self._data_ttl(),
                     )
                 )
+        self._update_membership_gauges()
         for fn in self.on_view_change:
             try:
                 fn(old, view)
             except Exception:  # noqa: BLE001 — listener bugs must not break adoption
                 self.log.exception("view-change listener failed")
+
+    def _update_membership_gauges(self) -> None:
+        """Refresh the membership gauges from the current view (called
+        under the lock on view change; from __init__/start before threads
+        exist). Values come from ``policy/topology.py::membership_gauges``
+        so the flat/hier semantics live next to the view logic."""
+        vals = membership_gauges(
+            self.view,
+            self.rank,
+            alive=(
+                self._my_alive()
+                if self.role is not NodeRole.ROUTER
+                else self.view.alive
+            ),
+            hier=self.hier if self.role is not NodeRole.ROUTER else None,
+            succ_rank=self._succ_rank,
+        )
+        for key, g in self._g_membership.items():
+            g.set(vals[key])
 
     def _declare_successor_dead(self, dest: str = "ring") -> None:
         """Sender-side failure detection fired: the current successor on
@@ -1526,8 +1606,12 @@ class MeshCache:
         (see ``_gc_handle``); the origin folds tallies until every
         nonempty group reported, then checks unanimity. Rounds that a
         view change strands (a group died mid-poll) expire and re-run
-        on the next GC interval."""
-        with self._lock:
+        on the next GC interval.
+
+        ``recorded``: one span per origination on this node's ring lane
+        (profiler annotation + flight recorder) — GC stalls show up next
+        to the request timelines they starve."""
+        with recorded(f"ring:{self._node_label}", "gc_round"), self._lock:
             entries = [
                 GCEntry(
                     key=np.asarray(nk.tokens, dtype=np.int32),

@@ -45,6 +45,7 @@ import numpy as np
 from radixmesh_tpu.comm.communicator import Communicator
 from radixmesh_tpu.engine.engine import Engine, _pow2_at_least
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
+from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.utils.logging import get_logger
 
 __all__ = [
@@ -75,6 +76,11 @@ class HandoffPacket:
     # ([2, L, n - kv_start, Hkv]); int8 + scales is 4x smaller on the wire
     # than the dequantized f32 a plain gather would ship.
     kv_scale: np.ndarray | jax.Array | None = None
+    # The prefill leg won the tracing coin flip: the decode side follows
+    # this bit instead of flipping its own, so under fractional sampling
+    # a traced request's timeline spans BOTH nodes or neither — never an
+    # orphan half (trace ids themselves stay node-local).
+    traced: bool = False
 
 
 class PrefillWorker(Engine):
@@ -109,10 +115,19 @@ class PrefillWorker(Engine):
             raise RuntimeError("prefill pool exhausted; could not admit request")
         # Gather before release: release publishes the page-aligned prefix
         # to the tree but frees the tail partial page.
+        tr = req.trace
+        t_pack = time.monotonic() if tr is not None else 0.0
         kv, kv_scale = self.pool.gather_raw(req.token_slots[skip_prefix:])
         if not device_kv:
             kv = np.asarray(kv)
             kv_scale = None if kv_scale is None else np.asarray(kv_scale)
+        if tr is not None:
+            tr.add(
+                "disagg_handoff_pack", t_pack,
+                time.monotonic() - t_pack, cat="disagg",
+                kv_tokens=int(len(req.token_slots) - skip_prefix),
+                skip_prefix=int(skip_prefix),
+            )
         pkt = HandoffPacket(
             prompt=req.prompt,
             first_token=req.output_tokens[0],
@@ -123,6 +138,7 @@ class PrefillWorker(Engine):
             first_token_time=req.first_token_time,
             kv_start=skip_prefix,
             kv_scale=kv_scale,
+            traced=req.trace is not None,
         )
         req.state = RequestState.FINISHED
         self._release(req)
@@ -167,6 +183,20 @@ class DecodeWorker:
         req.output_tokens = [int(pkt.first_token)]
         req.submit_time = pkt.submit_time or time.monotonic()
         req.first_token_time = pkt.first_token_time or time.monotonic()
+        # The decode-side leg of the flight, gated on the PACKET's traced
+        # bit (not a fresh coin flip — see HandoffPacket.traced), tied
+        # back to the prefill side by the handoff rid on the receive span.
+        if pkt.traced:
+            # force=True: the prefill node already flipped the coin —
+            # re-flipping here would orphan half the cross-node timelines
+            # at fractional sampling rates.
+            req.trace = get_recorder().trace(f"req:{req.rid}", force=True)
+        if req.trace is not None:
+            req.trace.add(
+                "disagg_handoff_receive", time.monotonic(), 0.0,
+                cat="disagg", handoff_rid=int(pkt.rid),
+                kv_start=int(pkt.kv_start),
+            )
         with self._lock:
             # KV stays whatever it arrived as: np.ndarray off the wire
             # (DCN path), jax.Array off a ppermute (ICI path — forcing it
@@ -260,6 +290,8 @@ class DecodeWorker:
             self.dropped += 1
             return True  # consumed (not re-queued)
         n_new = n - reuse
+        tr = req.trace
+        t_write = time.monotonic() if tr is not None else 0.0
         lo, hi = reuse - kv_start, n - kv_start
         tail = self._colocate(jnp.asarray(kv[:, :, lo:hi]))
         scale = kv_scale
@@ -282,6 +314,12 @@ class DecodeWorker:
         req.kv_len = n
         req.token_slots = np.concatenate([prefix_slots, own[:n_new]])
         req.own_slots = own
+        if tr is not None:
+            tr.add(
+                "disagg_kv_write", t_write,
+                time.monotonic() - t_write, cat="disagg",
+                kv_tokens=int(n_new), reused_tokens=int(reuse),
+            )
         eng._install_running(req, row, reuse)
         return True
 
@@ -424,6 +462,7 @@ def pack_handoff(pkt: HandoffPacket) -> bytes:
             "kv_shape": list(kv.shape),
             "kv_dtype": jnp.dtype(kv.dtype).name,
             "kv_start": int(pkt.kv_start),
+            "traced": bool(pkt.traced),
             "scale_shape": None if scale is None else list(scale.shape),
             "sampling": {
                 "temperature": pkt.sampling.temperature,
@@ -469,4 +508,5 @@ def unpack_handoff(data: bytes) -> HandoffPacket:
         first_token_time=h["first_token_time"],
         kv_start=h.get("kv_start", 0),
         kv_scale=scale,
+        traced=bool(h.get("traced", False)),  # absent in pre-tracing packets
     )

@@ -52,6 +52,7 @@ from radixmesh_tpu.models.llama import (
 )
 from radixmesh_tpu.ops.attention import default_use_kernel
 from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
+from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.ops.sampling import sample_tokens, spec_verify_sample
 from radixmesh_tpu.utils.logging import get_logger
 
@@ -332,46 +333,55 @@ class Engine:
         self.name = name or f"engine{next(_engine_seq)}"
         lbl = {"engine": self.name}
         self._m_prompt = reg.counter(
-            "engine_prompt_tokens_total", "prompt tokens admitted", ("engine",)
+            "radixmesh_engine_prompt_tokens_total",
+            "prompt tokens admitted",
+            ("engine",),
         ).labels(**lbl)
         self._m_cached = reg.counter(
-            "engine_cached_tokens_total",
+            "radixmesh_engine_cached_tokens_total",
             "prompt tokens served from the radix cache",
             ("engine",),
         ).labels(**lbl)
         self._m_generated = reg.counter(
-            "engine_generated_tokens_total", "tokens produced by decode", ("engine",)
+            "radixmesh_engine_generated_tokens_total",
+            "tokens produced by decode",
+            ("engine",),
         ).labels(**lbl)
         self._m_preempt = reg.counter(
-            "engine_preemptions_total",
+            "radixmesh_engine_preemptions_total",
             "requests preempted under pool pressure",
             ("engine",),
         ).labels(**lbl)
         self._m_spec_proposed = reg.counter(
-            "engine_spec_proposed_tokens_total",
+            "radixmesh_engine_spec_proposed_tokens_total",
             "draft tokens offered to speculative verification",
             ("engine",),
         ).labels(**lbl)
         self._m_spec_accepted = reg.counter(
-            "engine_spec_accepted_tokens_total",
+            "radixmesh_engine_spec_accepted_tokens_total",
             "draft tokens accepted by speculative verification",
             ("engine",),
         ).labels(**lbl)
         self._m_ttft = reg.histogram(
-            "engine_ttft_seconds", "submit-to-first-token latency", ("engine",)
+            "radixmesh_engine_ttft_seconds",
+            "submit-to-first-token latency",
+            ("engine",),
         ).labels(**lbl)
         self._m_tpot = reg.histogram(
-            "engine_tpot_seconds",
+            "radixmesh_engine_tpot_seconds",
             "batched decode step latency (== per-token latency for each "
             "active request)",
             ("engine",),
         ).labels(**lbl)
         self._m_hit_len = reg.histogram(
-            "engine_prefix_hit_tokens",
+            "radixmesh_engine_prefix_hit_tokens",
             "prefix-cache hit length per admitted request (tokens)",
             ("engine",),
             buckets=TOKEN_LEN_BUCKETS,
         ).labels(**lbl)
+        # Request-flight tracing lane for engine-scope (not per-request)
+        # events: evictions, preemption sweeps (obs/trace_plane.py).
+        self._trace_lane = f"engine:{self.name}"
 
     # ------------------------------------------------------------------
     # public API
@@ -399,6 +409,10 @@ class Engine:
         if not (0 < len(req.prompt) < self.max_seq_len):
             raise ValueError(f"prompt length {len(req.prompt)} out of range")
         req.submit_time = time.monotonic()
+        # Request-flight tracing (obs/trace_plane.py): returns None when
+        # tracing is off or the request lost the sampling coin flip —
+        # every downstream span site is then one `is not None` branch.
+        req.trace = get_recorder().trace(f"req:{req.rid}")
         return req
 
     def enqueue(self, req: Request) -> Request:
@@ -496,6 +510,8 @@ class Engine:
         n = n_pages * self.page_size
         slots = self.pool.alloc(n)
         if slots is None:
+            rec = get_recorder()
+            t_ev = time.monotonic() if rec.enabled else 0.0
             if self.mesh is not None:
                 # Eviction that DESTROYS KV must un-advertise the prefix
                 # ring-wide — otherwise the router keeps routing
@@ -510,6 +526,12 @@ class Engine:
             else:
                 self.tree.evict(n - self.pool.free_slots)
             slots = self.pool.alloc(n)
+            if rec.enabled:
+                rec.event(
+                    self._trace_lane, "evict", t_ev,
+                    time.monotonic() - t_ev, cat="cache",
+                    need_slots=int(n), satisfied=bool(slots is not None),
+                )
         return slots
 
     def _unadvertise(self, node) -> None:
@@ -547,10 +569,20 @@ class Engine:
                 # One tree walk serves both the defer check and acquisition
                 # (match_and_load also restores host-tier KV, so a
                 # restorable prefix never triggers a needless deferral).
+                tr = req.trace
+                t_match = time.monotonic() if tr is not None else 0.0
                 if hasattr(self.tree, "match_and_load"):
                     match = self.tree.match_and_load(req.prompt)
                 else:
                     match = self.tree.match_prefix(req.prompt)
+                if tr is not None:
+                    tr.add(
+                        "prefix_match",
+                        t_match,
+                        time.monotonic() - t_match,
+                        cached_tokens=int(match.length),
+                        prompt_tokens=len(req.prompt),
+                    )
                 if self._defer_for_prefix_wave(req, match.length, group):
                     # Admitting this request NOW would recompute a prefix a
                     # groupmate is about to publish; next wave it's a cache
@@ -562,6 +594,20 @@ class Engine:
                 if acquired is None:
                     break  # pool exhausted even after evict: wait for finishes
                 self.waiting.pop(idx)
+                if tr is not None:
+                    # Queue wait: preemption requeue, SLO dispatch, or
+                    # submission — whichever happened LAST — up to the
+                    # instant a batch row was secured (a preempted
+                    # request's first life must not render as queueing).
+                    t_start = max(
+                        req.requeue_time, req.admit_time, req.submit_time
+                    )
+                    tr.add(
+                        "admission_wait",
+                        t_start,
+                        time.monotonic() - t_start,
+                        cat="queue",
+                    )
                 reuse, prefix_slots, own = acquired
                 self._rows[row] = req  # reserve the row; re-set on install
                 group.append((req, row, reuse, prefix_slots, own))
@@ -611,6 +657,8 @@ class Engine:
                 # pp engines prefill exclusively through the chunked
                 # paged path: it is the pipeline-scheduled one (the
                 # dense/sp paths would all-gather stage weights).
+                traced = [m[0].trace for m in sub if m[0].trace is not None]
+                t_wave = time.monotonic() if traced else 0.0
                 if (
                     self.pool.quant is None
                     and not self._pp
@@ -628,6 +676,21 @@ class Engine:
                 else:
                     pending = self._prefill_group(sub)
                 self._finalize_first_tokens(pending)
+                if traced:
+                    # One prefill-wave span per traced member (covers the
+                    # whole sub-wave through first-token finalize, so each
+                    # request's lane shows the convoy it rode in).
+                    dur = time.monotonic() - t_wave
+                    new_tok = sum(len(m[0].prompt) - m[2] for m in sub)
+                    for tr in traced:
+                        tr.add(
+                            "prefill_wave",
+                            t_wave,
+                            dur,
+                            cat="prefill",
+                            wave_rows=len(sub),
+                            wave_new_tokens=int(new_tok),
+                        )
 
     def _defer_for_prefix_wave(
         self, req: Request, cached: int, group: list[tuple]
@@ -721,6 +784,12 @@ class Engine:
     def _record_first_token(self, req: Request) -> None:
         self.stats.ttft_s.append(req.first_token_time - req.submit_time)
         self._m_ttft.observe(req.first_token_time - req.submit_time)
+        tr = req.trace
+        if tr is not None:
+            tr.add(
+                "first_token", req.first_token_time, 0.0, cat="scheduler",
+                ttft_s=round(req.first_token_time - req.submit_time, 6),
+            )
         if self.on_first_token is not None:
             self.on_first_token(req)
 
@@ -992,6 +1061,8 @@ class Engine:
         """Insert the first ``key_len`` tokens (whose KV is in the pool)
         into the tree; canonicalize shared prefixes; move the lock to the
         deepest published node."""
+        tr = req.trace
+        t_pub = time.monotonic() if tr is not None else 0.0
         key = self._sequence_key(req, key_len)
         matched = self.tree.insert(key, req.token_slots[:key_len].copy())
         m2 = self.tree.match_prefix(key)
@@ -1028,6 +1099,15 @@ class Engine:
             # them would map tokens to recycled slots ring-wide, and the
             # router would promise hits the node cannot serve.
             self.mesh.insert(key[:aligned], req.token_slots[:aligned])
+        if tr is not None:
+            tr.add(
+                "publish",
+                t_pub,
+                time.monotonic() - t_pub,
+                cat="cache",
+                tokens=int(key_len),
+                ring_advertised=bool(self.mesh is not None and aligned > 0),
+            )
 
     def _release(self, req: Request) -> None:
         """cache_finished_req (radix_cache.py:439-486): publish the full
@@ -1186,7 +1266,15 @@ class Engine:
         # sample_tokens materialized on host above, so this spans the full
         # dispatch+device time of the step — the per-token latency (TPOT)
         # seen by every active request.
-        self._m_tpot.observe(time.monotonic() - step_t0)
+        elapsed = time.monotonic() - step_t0
+        self._m_tpot.observe(elapsed)
+        for _, req in active:
+            tr = req.trace
+            if tr is not None:
+                tr.add(
+                    "decode_chunk", step_t0, elapsed, cat="decode",
+                    k_steps=1, batch_rows=len(active),
+                )
 
         for row, req in active:
             self._consume_token(req, row, int(slots[row]), int(sampled[row]))
@@ -1330,6 +1418,13 @@ class Engine:
         elapsed = time.monotonic() - step_t0
         for _ in range(k):
             self._m_tpot.observe(elapsed / k)
+        for _, req in active:
+            tr = req.trace
+            if tr is not None:
+                tr.add(
+                    "decode_chunk", step_t0, elapsed, cat="decode",
+                    k_steps=k, batch_rows=len(active),
+                )
 
         ps = self.page_size
         for row, req in active:
@@ -1549,6 +1644,15 @@ class Engine:
         elapsed = time.monotonic() - step_t0
         for _ in range(max(emitted_total, 1)):
             self._m_tpot.observe(elapsed / max(emitted_total, 1))
+        for row, req in active:
+            tr = req.trace
+            if tr is not None:
+                tr.add(
+                    "decode_chunk", step_t0, elapsed, cat="decode",
+                    k_steps=1, batch_rows=len(active), speculative=True,
+                    draft_tokens=int(draft_len[row]),
+                    accepted_tokens=int(accept_len[row]),
+                )
 
     def _consume_token(self, req: Request, row: int, slot: int, token: int) -> bool:
         """Account one decode iteration for ``req``: the fed token's KV
@@ -1583,6 +1687,14 @@ class Engine:
         self.stats.preemptions += 1
         self._m_preempt.inc()
         self._pressure = True
+        req.requeue_time = time.monotonic()
+        tr = req.trace
+        if tr is not None:
+            tr.add(
+                "preempt", req.requeue_time, 0.0, cat="scheduler",
+                kv_len=int(req.kv_len),
+                output_tokens=len(req.output_tokens),
+            )
         self._release(req)
         req.state = RequestState.QUEUED
         req.output_tokens = []

@@ -73,6 +73,15 @@ class Request:
     submit_time: float = 0.0
     first_token_time: float = 0.0
 
+    # -- request-flight tracing (radixmesh_tpu/obs/trace_plane.py) --
+    # TraceContext when this request won the sampling coin flip, else
+    # None; every span site guards with one `is not None` branch.
+    trace: object = None
+    # Stamped by Engine._preempt on requeue: the second admission's
+    # queue-wait span starts HERE, not at the original submit — the
+    # first life's prefill+decode must not render as queue wait.
+    requeue_time: float = 0.0
+
     @property
     def next_token(self) -> int:
         """Token to feed on the next decode step."""

@@ -33,6 +33,46 @@ def _apply_platform_env() -> None:
     pin_platform()
 
 
+def _configure_tracing(args: argparse.Namespace) -> None:
+    """Enable the request-flight tracing plane (``obs/trace_plane.py``)
+    when asked: ``--trace-sample`` gates recording; ``--trace-dir`` with
+    the sample UNSET implies sample=1.0 (asking for a dump of nothing is
+    never intended), but an EXPLICIT ``--trace-sample 0`` wins — the
+    operator said off, so off (None default distinguishes the two)."""
+    sample = args.trace_sample
+    if sample is None:
+        sample = 1.0 if args.trace_dir else 0.0
+    if sample > 0:
+        from radixmesh_tpu.obs.trace_plane import configure
+
+        configure(capacity=args.trace_capacity, sample=sample)
+
+
+def _dump_trace(args: argparse.Namespace, log) -> None:
+    """Exit-time flight-recorder dump: one Chrome trace-event artifact
+    under ``--trace-dir`` (the post-mortem a wedged node leaves behind)."""
+    if not args.trace_dir:
+        return
+    import os
+    import time
+
+    from radixmesh_tpu.obs.trace_plane import get_recorder, write_trace
+
+    if not get_recorder().enabled:
+        # Explicit --trace-sample 0 beat the dir (see _configure_tracing):
+        # don't litter the directory with empty artifacts that read as
+        # "a trace was captured".
+        return
+
+    try:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        path = os.path.join(args.trace_dir, f"trace-{int(time.time())}.json")
+        n = write_trace(path)
+        log.info("wrote %d trace spans to %s", n, path)
+    except OSError:
+        log.exception("trace dump failed")
+
+
 def _run_node(args: argparse.Namespace) -> int:
     _apply_platform_env()
     import jax
@@ -47,6 +87,7 @@ def _run_node(args: argparse.Namespace) -> int:
     role, rank, _ = cfg.local_identity()
     configure_logger(f"{role.value}@{rank}")
     log = get_logger("launch")
+    _configure_tracing(args)
 
     # A P/D node with a ``model:`` section is a SERVING node: one shared KV
     # pool, an Engine that owns slot lifetime, and an advertisement-only
@@ -157,6 +198,7 @@ def _run_node(args: argparse.Namespace) -> int:
         if frontend is not None:
             frontend.close()
         node.close(graceful=True)
+        _dump_trace(args, log)
     return 0
 
 
@@ -170,6 +212,7 @@ def _run_serve(args: argparse.Namespace) -> int:
 
     configure_logger("serve")
     log = get_logger("launch")
+    _configure_tracing(args)
     cfg = get_config(args.model)
     log.info("initializing %s (%d layers)...", args.model, cfg.n_layers)
     if args.weights:
@@ -234,6 +277,7 @@ def _run_serve(args: argparse.Namespace) -> int:
             stop.wait(1.0)
     finally:
         frontend.close()
+        _dump_trace(args, log)
     return 0
 
 
@@ -268,6 +312,24 @@ def _run_multihost_dryrun(args: argparse.Namespace) -> int:
     return 0 if math.isfinite(loss) else 1
 
 
+def _add_trace_args(sub: argparse.ArgumentParser) -> None:
+    """Request-flight tracing flags, shared by node + serve."""
+    sub.add_argument(
+        "--trace-capacity", type=int, default=8192,
+        help="flight-recorder span bound (drop-oldest past it)",
+    )
+    sub.add_argument(
+        "--trace-sample", type=float, default=None,
+        help="fraction of requests to trace (0 disables — the default; "
+        "spans surface on GET /debug/trace as Perfetto-loadable JSON)",
+    )
+    sub.add_argument(
+        "--trace-dir", default=None,
+        help="also dump the flight recorder to this directory on exit "
+        "(implies --trace-sample 1.0 unless set explicitly)",
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="radixmesh-tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -286,6 +348,7 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="start the router in warm-up (spread) mode",
     )
+    _add_trace_args(node)
     node.set_defaults(fn=_run_node)
 
     serve = sub.add_parser("serve", help="run a single-node serving engine")
@@ -349,6 +412,7 @@ def main(argv: list[str] | None = None) -> int:
         "sustained prompt-token rate limit RATE tok/s (repeatable; "
         "requires --slo)",
     )
+    _add_trace_args(serve)
     serve.set_defaults(fn=_run_serve)
 
     mh = sub.add_parser(

@@ -1,4 +1,5 @@
-"""Observability: metrics registry + profiler tracing (SURVEY §5)."""
+"""Observability: metrics registry + profiler tracing + the request-flight
+tracing plane (SURVEY §5)."""
 
 from radixmesh_tpu.obs.metrics import (
     Counter,
@@ -8,7 +9,16 @@ from radixmesh_tpu.obs.metrics import (
     get_registry,
     set_registry,
 )
-from radixmesh_tpu.obs.tracing import annotate, profile, timed
+from radixmesh_tpu.obs.trace_plane import (
+    FlightRecorder,
+    Span,
+    TraceContext,
+    configure,
+    get_recorder,
+    set_recorder,
+    write_trace,
+)
+from radixmesh_tpu.obs.tracing import annotate, profile, recorded, timed
 
 __all__ = [
     "Counter",
@@ -17,7 +27,15 @@ __all__ = [
     "Registry",
     "get_registry",
     "set_registry",
+    "FlightRecorder",
+    "Span",
+    "TraceContext",
+    "configure",
+    "get_recorder",
+    "set_recorder",
+    "write_trace",
     "annotate",
     "profile",
+    "recorded",
     "timed",
 ]

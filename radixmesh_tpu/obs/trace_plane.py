@@ -1,0 +1,323 @@
+"""Request-flight tracing plane: span trees + bounded flight recorder.
+
+``obs/metrics.py`` answers "how is the fleet doing" in aggregate;
+nothing in the repo could answer "where did THIS request's 400 ms go?".
+This module supplies the per-request causal timeline:
+
+- A :class:`TraceContext` is created per request at the serving edge
+  (``Engine.make_request`` / the HTTP frontend) and carried on
+  ``Request.trace``; every layer the request crosses — SLO admission,
+  routing, prefill waves, decode launches, publish, disagg handoff —
+  records completed :class:`Span`\\ s against it.
+- Spans land in a :class:`FlightRecorder`: a bounded in-memory ring
+  (drop-oldest under pressure, counted) that costs one lock + one deque
+  append per span, and exactly ONE branch per call site when tracing is
+  off (``trace()``/``event()`` return before touching any span state).
+- :meth:`FlightRecorder.chrome_trace` exports Chrome trace-event JSON
+  ("traceEvents") loadable in Perfetto / ``chrome://tracing``; lanes map
+  to Perfetto threads (one per request, per ring node, per engine), so
+  a request's admission wait / prefill wave / decode chunks / publish
+  read as one horizontal story, with ring replication-lag spans on the
+  mesh lanes below it.
+
+Ring replication lag carries NO trace id across the wire (no wire-format
+change): lag spans are derived receiver-side from the oplog's existing
+origin wall-clock timestamp and recorded on per-node lanes; correlation
+with a request is by time overlap, which is what a timeline viewer shows
+anyway.
+
+Overhead model: sampling off (the default) short-circuits at the first
+``if`` in :meth:`FlightRecorder.trace` — no allocation, no lock, no
+clock read at any instrumentation site (call sites are all shaped
+``tr = req.trace; if tr is not None: ...``). Sampling on costs ~one
+dict + one deque append per span under a short lock; the recorder is
+bounded, so a trace storm degrades to dropped-oldest spans, never to
+unbounded heap growth.
+
+This module is import-light on purpose (stdlib only — no jax): router
+nodes and artifact tests use it without pulling in a backend.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+__all__ = [
+    "Span",
+    "TraceContext",
+    "FlightRecorder",
+    "get_recorder",
+    "set_recorder",
+    "configure",
+    "write_trace",
+]
+
+
+@dataclass
+class Span:
+    """One completed span: monotonic start + duration, on a named lane."""
+
+    name: str
+    lane: str  # Perfetto thread lane, e.g. "req:17", "ring:prefill@0"
+    t0: float  # time.monotonic() seconds at span start
+    dur: float  # seconds
+    trace_id: int  # 0 = not tied to a request trace (node-scope events)
+    cat: str = "serving"
+    args: dict | None = None
+
+
+class TraceContext:
+    """Per-request handle: a trace id + the lane its spans land on.
+
+    Intentionally tiny — it is carried on every ``Request`` and tested
+    for ``None`` on hot paths; all recording funnels through the owning
+    recorder so swap-for-isolation (tests) keeps working.
+    """
+
+    __slots__ = ("trace_id", "lane", "_rec")
+
+    def __init__(self, trace_id: int, lane: str, rec: "FlightRecorder"):
+        self.trace_id = trace_id
+        self.lane = lane
+        self._rec = rec
+
+    def add(
+        self,
+        name: str,
+        t0: float,
+        dur: float,
+        cat: str = "serving",
+        **args,
+    ) -> None:
+        """Record a completed span from explicit timestamps (most engine
+        spans derive from bookkeeping the scheduler already stamps —
+        submit/admit/first-token — so no extra clock reads)."""
+        self._rec._record(
+            Span(name, self.lane, t0, max(0.0, dur), self.trace_id, cat,
+                 args or None)
+        )
+
+    def span(self, name: str, cat: str = "serving", **args) -> "_SpanTimer":
+        """``with ctx.span("publish"): ...`` — wall-times the block."""
+        return _SpanTimer(self, name, cat, args)
+
+
+class _SpanTimer:
+    __slots__ = ("_ctx", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, ctx: TraceContext, name: str, cat: str, args: dict):
+        self._ctx = ctx
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self) -> "_SpanTimer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._ctx.add(
+            self._name,
+            self._t0,
+            time.monotonic() - self._t0,
+            cat=self._cat,
+            **self._args,
+        )
+        return False
+
+
+class FlightRecorder:
+    """Bounded in-memory span ring with drop-oldest semantics.
+
+    ``sample`` gates everything: 0.0 (default) disables tracing with a
+    one-branch fast path; 1.0 traces every request; in between, each
+    request (or node-scope event) flips an independent coin. Capacity
+    bounds post-mortem memory — a storm past it drops the OLDEST spans
+    (the fresh ones are the ones a live debugger wants) and counts the
+    drops.
+    """
+
+    def __init__(self, capacity: int = 8192, sample: float = 0.0):
+        if capacity <= 0:
+            raise ValueError("trace capacity must be positive")
+        self.capacity = int(capacity)
+        self.sample = float(sample)
+        self._lock = threading.Lock()
+        self._buf: deque[Span] = deque(maxlen=self.capacity)
+        self._ids = itertools.count(1)
+        self._rng = random.Random(0xF117)  # deterministic sampling sequence
+        self.recorded = 0  # spans accepted (lifetime)
+        self.dropped = 0  # spans evicted by the ring bound (lifetime)
+
+    # -- the hot-path gates -------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample > 0.0
+
+    def trace(self, lane: str, force: bool = False) -> TraceContext | None:
+        """New per-request trace context, or None when tracing is off /
+        this request lost the sampling coin flip. THE no-op guard: the
+        disabled path is one float compare + return. ``force`` skips the
+        coin flip (NOT the off switch) — used when an upstream node
+        already decided this request is traced (disagg handoff), so a
+        fractional sample yields whole cross-node timelines, not halves."""
+        if self.sample <= 0.0:
+            return None
+        if (
+            not force
+            and self.sample < 1.0
+            and self._rng.random() >= self.sample
+        ):
+            return None
+        return TraceContext(next(self._ids), lane, self)
+
+    def event(
+        self,
+        lane: str,
+        name: str,
+        t0: float,
+        dur: float,
+        cat: str = "serving",
+        **args,
+    ) -> None:
+        """Node-scope span not tied to a request trace (ring replication
+        lag, eviction sweeps, route decisions). Same one-branch guard."""
+        if self.sample <= 0.0:
+            return
+        if self.sample < 1.0 and self._rng.random() >= self.sample:
+            return
+        self._record(Span(name, lane, t0, max(0.0, dur), 0, cat, args or None))
+
+    # -- storage -------------------------------------------------------
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self.dropped += 1  # deque(maxlen) evicts the oldest
+            self._buf.append(span)
+            self.recorded += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def snapshot(self) -> list[Span]:
+        with self._lock:
+            return list(self._buf)
+
+    def drain(self) -> list[Span]:
+        with self._lock:
+            out = list(self._buf)
+            self._buf.clear()
+            return out
+
+    # -- export --------------------------------------------------------
+
+    def chrome_trace(self, spans: list[Span] | None = None, drain: bool = False) -> dict:
+        """Chrome trace-event JSON (the ``traceEvents`` array format) —
+        load in Perfetto (ui.perfetto.dev) or ``chrome://tracing``.
+
+        Lanes become threads of one process, named via ``thread_name``
+        metadata events; complete-event (``ph: "X"``) timestamps are
+        microseconds from the earliest span, emitted non-decreasing
+        within each lane."""
+        if spans is None:
+            spans = self.drain() if drain else self.snapshot()
+        base = min((s.t0 for s in spans), default=0.0)
+        lanes: dict[str, int] = {}
+        events: list[dict] = []
+        # Sort by (lane, t0): within-lane ts monotonicity is part of the
+        # artifact contract (bench.validate_trace checks it).
+        for s in sorted(spans, key=lambda s: (s.lane, s.t0)):
+            tid = lanes.setdefault(s.lane, len(lanes) + 1)
+            ev = {
+                "name": s.name,
+                "cat": s.cat,
+                "ph": "X",
+                "ts": round((s.t0 - base) * 1e6, 3),
+                "dur": round(s.dur * 1e6, 3),
+                "pid": 1,
+                "tid": tid,
+            }
+            args = dict(s.args or {})
+            if s.trace_id:
+                args["trace_id"] = s.trace_id
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": lane},
+            }
+            for lane, tid in lanes.items()
+        ]
+        return {
+            "displayTimeUnit": "ms",
+            "traceEvents": meta + events,
+            "otherData": {
+                "recorder": {
+                    "capacity": self.capacity,
+                    "sample": self.sample,
+                    "recorded": self.recorded,
+                    "dropped": self.dropped,
+                },
+            },
+        }
+
+    def stats(self) -> dict:
+        """Programmatic recorder state for ``/debug/state``."""
+        with self._lock:
+            buffered = len(self._buf)
+        return {
+            "capacity": self.capacity,
+            "sample": self.sample,
+            "enabled": self.enabled,
+            "buffered_spans": buffered,
+            "recorded_spans": self.recorded,
+            "dropped_spans": self.dropped,
+        }
+
+
+_default = FlightRecorder()
+_default_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    """Process-wide default recorder (disabled until configured)."""
+    return _default
+
+
+def set_recorder(rec: FlightRecorder) -> FlightRecorder:
+    """Swap the process-wide default (tests use this for isolation)."""
+    global _default
+    with _default_lock:
+        _default = rec
+    return rec
+
+
+def configure(capacity: int = 8192, sample: float = 1.0) -> FlightRecorder:
+    """Enable tracing process-wide: install a fresh recorder with the
+    given bound + sampling rate (``launch.py --trace-capacity/-sample``)."""
+    return set_recorder(FlightRecorder(capacity=capacity, sample=sample))
+
+
+def write_trace(path: str, drain: bool = True) -> int:
+    """Dump the default recorder as a Chrome trace-event artifact.
+    Returns the number of spans written."""
+    rec = get_recorder()
+    spans = rec.drain() if drain else rec.snapshot()
+    obj = rec.chrome_trace(spans=spans)
+    with open(path, "w") as fh:
+        json.dump(obj, fh)
+    return len(spans)

@@ -20,8 +20,9 @@ import time
 from typing import Iterator
 
 from radixmesh_tpu.obs.metrics import Histogram
+from radixmesh_tpu.obs.trace_plane import get_recorder
 
-__all__ = ["annotate", "profile", "timed"]
+__all__ = ["annotate", "profile", "timed", "recorded"]
 
 
 @contextlib.contextmanager
@@ -61,3 +62,23 @@ def timed(hist: Histogram, name: str | None = None) -> Iterator[None]:
             yield
         finally:
             hist.observe(time.monotonic() - t0)
+
+
+@contextlib.contextmanager
+def recorded(lane: str, name: str, **args) -> Iterator[None]:
+    """Both observability planes in one block: an xplane annotation for
+    profiler captures AND a flight-recorder span (``obs/trace_plane.py``)
+    on ``lane`` for the request-flight timeline. One branch when the
+    recorder is disabled (it still annotates — that is already a no-op
+    without a live profiler trace)."""
+    rec = get_recorder()
+    if not rec.enabled:
+        with annotate(name):
+            yield
+        return
+    t0 = time.monotonic()
+    with annotate(name):
+        try:
+            yield
+        finally:
+            rec.event(lane, name, t0, time.monotonic() - t0, **args)

@@ -32,7 +32,7 @@ import numpy as np
 
 from radixmesh_tpu.config import MeshConfig
 
-__all__ = ["TopologyView", "encode_view", "decode_view"]
+__all__ = ["TopologyView", "encode_view", "decode_view", "membership_gauges"]
 
 
 @dataclass(frozen=True)
@@ -101,3 +101,33 @@ def decode_view(value: np.ndarray) -> TopologyView:
     if a.size < 1:
         raise ValueError("empty TOPO payload")
     return TopologyView(epoch=int(a[0]), alive=tuple(int(r) for r in a[1:]))
+
+
+def membership_gauges(
+    view: TopologyView,
+    rank: int,
+    *,
+    alive: tuple[int, ...] | None = None,
+    hier=None,
+    succ_rank: int | None = None,
+) -> dict[str, float]:
+    """Gauge values for this node's membership state — failover and hier
+    re-election were previously visible only in logs; ``MeshCache``
+    exports these on ``/metrics`` (suffix-matched to the metric names it
+    registers). ``hier`` is the node's :class:`~radixmesh_tpu.policy.
+    hierarchy.HierPlan` (None = flat ring, where "leader" means the view
+    master); ``alive`` defaults to the view's alive set."""
+    a = view.alive if alive is None else alive
+    if hier is not None:
+        leader = bool(hier.is_leader(rank, a))
+        spine = len(hier.nonempty_groups(a))
+    else:
+        leader = view.master_rank() == rank
+        spine = 0
+    return {
+        "view_epoch": float(view.epoch),
+        "alive_nodes": float(len(view.alive)),
+        "leader_flag": 1.0 if leader else 0.0,
+        "spine_nodes": float(spine),
+        "successor_rank": float(-1 if succ_rank is None else succ_rank),
+    }

@@ -27,6 +27,7 @@ from typing import Sequence
 from radixmesh_tpu.cache.mesh_cache import MeshCache, RouterMatchResult
 from radixmesh_tpu.config import MeshConfig
 from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
+from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.router.consistent_hash import ConsistentHash
 
 __all__ = ["CacheAwareRouter", "RouteResult"]
@@ -116,7 +117,7 @@ class CacheAwareRouter:
         }
         reg = get_registry()
         routed = reg.counter(
-            "router_requests_total",
+            "radixmesh_router_requests_total",
             "routing decisions by role and outcome",
             ("role", "outcome"),
         )
@@ -128,10 +129,10 @@ class CacheAwareRouter:
             for outcome in ("hit", "fallback", "shed")
         }
         self._m_route_latency = reg.histogram(
-            "router_route_seconds", "cache-aware routing decision latency"
+            "radixmesh_router_route_seconds", "cache-aware routing decision latency"
         )
         self._m_match_len = reg.histogram(
-            "router_match_len_tokens",
+            "radixmesh_router_match_len_tokens",
             "matched prefix length per routed request (tokens)",
             buckets=TOKEN_LEN_BUCKETS,
         )
@@ -194,8 +195,24 @@ class CacheAwareRouter:
 
     def cache_aware_route(self, key: Sequence[int]) -> RouteResult:
         """Route one request's token ids (reference ``:23-39``)."""
-        with self._m_route_latency.time():
-            return self._route(key)
+        t0 = time.monotonic()
+        try:
+            res = self._route(key)
+        finally:
+            dur = time.monotonic() - t0
+            self._m_route_latency.observe(dur)
+        rec = get_recorder()
+        if rec.enabled:
+            # Routing leg of the request-flight timeline: the router is
+            # its own node, so these land on a "router" lane correlated
+            # with engine lanes by wall-clock overlap.
+            rec.event(
+                "router", "route", t0, dur, cat="router",
+                match_len=int(res.match_len),
+                prefill_hit=bool(res.prefill_cache_hit),
+                decode_hit=bool(res.decode_cache_hit),
+            )
+        return res
 
     def _route(self, key: Sequence[int]) -> RouteResult:
         if self._warm_up:

@@ -11,6 +11,13 @@ that frontend with stdlib-only HTTP (no framework dependency):
 - :class:`RouterFrontend` (router node): ``POST /route`` → the prefill +
   decode addresses holding the longest cached prefix
   (``router/cache_aware_router.py``), plus the same health/metrics.
+- Debug surfaces on BOTH frontends (``obs/trace_plane.py``):
+  ``GET /debug/trace`` serves the flight recorder as Chrome trace-event
+  JSON (load in Perfetto; read-only — ``?drain=1`` consumes the buffer),
+  ``GET /debug/requests`` is the in-flight
+  request table with per-phase elapsed times, ``GET /debug/state`` is a
+  point-in-time node snapshot (batch occupancy, pool/cache/host-tier
+  fill, membership view, SLO tier, recorder stats).
 
 Threading model: the engine is single-threaded by design (host-side tree
 mutation between device steps, SURVEY §7 hard part (c)); an
@@ -35,6 +42,7 @@ from typing import Sequence
 from radixmesh_tpu.engine.engine import Engine
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 from radixmesh_tpu.obs.metrics import get_registry
+from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.slo.control import RequestShed
 from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
 from radixmesh_tpu.utils.logging import get_logger
@@ -177,6 +185,63 @@ class _FrontendServer(ThreadingHTTPServer):
     allow_reuse_address = True
 
 
+def _request_row(req: Request, now: float) -> dict:
+    """One /debug/requests table row: identity + per-phase elapsed times
+    derived from the timestamps the scheduler already stamps."""
+    ft = req.first_token_time
+    return {
+        "rid": req.rid,
+        "state": req.state.value,
+        "tenant": req.tenant,
+        "prompt_tokens": len(req.prompt),
+        "output_tokens": len(req.output_tokens),
+        "kv_len": req.kv_len,
+        "prefix_hit_tokens": req.prefix_len,
+        "row": req.row,
+        "trace_id": getattr(req.trace, "trace_id", None),
+        "elapsed_s": {
+            "total": round(now - req.submit_time, 6) if req.submit_time else None,
+            "slo_queue": (
+                round(req.admit_time - req.submit_time, 6)
+                if req.admit_time
+                else None
+            ),
+            "to_first_token": round(ft - req.submit_time, 6) if ft else None,
+            "decoding": (
+                round(now - ft, 6)
+                if ft and req.state is RequestState.RUNNING
+                else None
+            ),
+        },
+    }
+
+
+def _membership_state(mesh) -> dict:
+    """Membership/topology block shared by both frontends' /debug/state."""
+    return {
+        "role": mesh.role.value,
+        "rank": mesh.rank,
+        "view_epoch": mesh.view.epoch,
+        "alive": list(mesh.view.alive),
+        "master_rank": mesh.view.master_rank(),
+        "successor_rank": mesh._succ_rank,
+    }
+
+
+def _debug_trace_response(handler: BaseHTTPRequestHandler) -> None:
+    """Serve the flight recorder as Chrome trace-event JSON. Read-only by
+    default — a GET must not destroy the post-mortem a later reader (or
+    the --trace-dir exit dump) depends on; ``?drain=1`` opts into
+    consuming the buffer (e.g. a collector that polls and archives)."""
+    from urllib.parse import parse_qs, urlsplit
+
+    query = parse_qs(urlsplit(handler.path).query)
+    # Opt-in must be deliberate: only recognized truthy spellings drain —
+    # anything else (?drain=False, typos) stays read-only.
+    drain = query.get("drain", ["0"])[-1].lower() in ("1", "true", "yes")
+    _json_response(handler, 200, get_recorder().chrome_trace(drain=drain))
+
+
 class ServingFrontend:
     """HTTP API over one serving engine."""
 
@@ -215,6 +280,78 @@ class ServingFrontend:
         self._profile_seq = 0
         frontend = self
 
+        # -- /debug surfaces (flight-recorder + live state) ------------
+        # Snapshots are LOCK-FREE on purpose: the runner lock is held
+        # across whole engine steps (a jit compile can take seconds), and
+        # a debug endpoint that blocks behind it is useless exactly when
+        # the node is wedged. list() under the GIL is an atomic snapshot;
+        # a torn read costs one request of staleness, not corruption.
+
+        def _debug_requests() -> dict:
+            eng = self.runner.engine
+            now = time.monotonic()
+            waiting = list(eng.waiting)
+            running = [r for r in list(eng._rows) if r is not None]
+            # Counts derive from the SAME snapshots as the rows, so one
+            # response is always internally consistent (the snapshot
+            # itself may trail the scheduler by a beat — by design).
+            return {
+                "requests": [_request_row(r, now) for r in waiting + running],
+                "waiting": len(waiting),
+                "running": len(running),
+            }
+
+        def _debug_state() -> dict:
+            eng = self.runner.engine
+            tree = eng.tree
+            state = {
+                "engine": {
+                    "name": eng.name,
+                    "batch_rows_active": sum(
+                        1 for r in eng._rows if r is not None
+                    ),
+                    "max_batch": eng.max_batch,
+                    "waiting": len(eng.waiting),
+                    "pressure": eng._pressure,
+                    "prefills": eng.stats.prefills,
+                    "decode_steps": eng.stats.decode_steps,
+                    "finished": eng.stats.finished,
+                    "preemptions": eng.stats.preemptions,
+                    "hit_rate": round(eng.stats.hit_rate, 4),
+                    # Histogram-derived (interpolated within buckets):
+                    # bounded-memory estimates over the process lifetime,
+                    # unlike the raw per-request sample lists.
+                    "p50_ttft_s": round(eng._m_ttft.quantile(0.5), 6),
+                    "p99_ttft_s": round(eng._m_ttft.quantile(0.99), 6),
+                    "p50_tpot_s": round(eng._m_tpot.quantile(0.5), 6),
+                },
+                "pool": {
+                    "num_slots": eng.pool.num_slots,
+                    "free_slots": eng.pool.free_slots,
+                    "page_size": eng.pool.page_size,
+                    "quant": eng.pool.quant,
+                },
+                "cache": {
+                    "evictable_tokens": getattr(tree, "evictable_size_", None),
+                    "protected_tokens": getattr(tree, "protected_size_", None),
+                },
+                "trace": get_recorder().stats(),
+            }
+            host = getattr(tree, "host", None)
+            if host is not None:
+                state["host_tier"] = {
+                    "num_slots": getattr(host, "num_slots", None),
+                    "free_slots": getattr(host, "free_slots", None),
+                }
+            if eng.mesh is not None:
+                state["membership"] = _membership_state(eng.mesh)
+            if self.slo_enabled:
+                state["slo"] = self.runner.ctl.snapshot()
+            return state
+
+        self._debug_requests = _debug_requests
+        self._debug_state = _debug_state
+
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route through our logger
                 frontend.log.debug(fmt, *args)
@@ -251,6 +388,13 @@ class ServingFrontend:
                             ),
                         },
                     )
+                elif self.path.split("?", 1)[0] == "/debug/trace":
+                    # Load the body in Perfetto (ui.perfetto.dev).
+                    _debug_trace_response(self)
+                elif self.path == "/debug/requests":
+                    _json_response(self, 200, frontend._debug_requests())
+                elif self.path == "/debug/state":
+                    _json_response(self, 200, frontend._debug_state())
                 else:
                     _json_response(self, 404, {"error": "not found"})
 
@@ -393,6 +537,16 @@ class ServingFrontend:
                 tokens = frontend.runner.wait(
                     req, timeout=float(body.get("timeout", 300.0))
                 )
+                tr = req.trace
+                if tr is not None:
+                    # The outermost span of the request's flight: HTTP
+                    # submit → response ready (streams record theirs when
+                    # the SSE done event flushes).
+                    tr.add(
+                        "http_request", req.submit_time,
+                        time.monotonic() - req.submit_time, cat="http",
+                        output_tokens=len(tokens),
+                    )
                 if req.shed and not tokens:
                     # Dropped from the SLO queue before any work ran
                     # (dispatch-time deadline check or shutdown flush).
@@ -457,6 +611,14 @@ class ServingFrontend:
                             f"data: {json.dumps(done_evt)}\n\n".encode()
                         )
                         self.wfile.flush()
+                        tr = req.trace
+                        if tr is not None:
+                            tr.add(
+                                "http_request", req.submit_time,
+                                time.monotonic() - req.submit_time,
+                                cat="http", stream=True,
+                                output_tokens=len(final),
+                            )
                         return
                     time.sleep(0.005)
 
@@ -494,6 +656,26 @@ class RouterFrontend:
         self.tokenizer = tokenizer
         frontend = self
 
+        def _debug_state() -> dict:
+            r = self.router
+            with r._alive_lock:
+                alive = {k: sorted(v) for k, v in r._alive.items()}
+            return {
+                "router": {
+                    "warm_up": r._warm_up,
+                    "alive": alive,
+                    "estimated_load": {
+                        addr: round(r._loads.load(addr), 3)
+                        for role_addrs in alive.values()
+                        for addr in role_addrs
+                    },
+                },
+                "membership": _membership_state(r.mesh_cache),
+                "trace": get_recorder().stats(),
+            }
+
+        self._debug_state = _debug_state
+
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 frontend.log.debug(fmt, *args)
@@ -508,6 +690,22 @@ class RouterFrontend:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.split("?", 1)[0] == "/debug/trace":
+                    _debug_trace_response(self)
+                elif self.path == "/debug/requests":
+                    # Router nodes hold no request state: routing is one
+                    # stateless tree walk per call. The empty table (vs a
+                    # 404) keeps fleet-wide debug tooling uniform.
+                    _json_response(
+                        self, 200,
+                        {
+                            "requests": [],
+                            "note": "router node — see a serving node's "
+                            "/debug/requests for in-flight requests",
+                        },
+                    )
+                elif self.path == "/debug/state":
+                    _json_response(self, 200, frontend._debug_state())
                 else:
                     _json_response(self, 404, {"error": "not found"})
 

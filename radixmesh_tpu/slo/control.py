@@ -294,46 +294,46 @@ class OverloadController:
 
         reg = get_registry()
         self._m_admitted = reg.counter(
-            "slo_admitted_requests_total",
+            "radixmesh_slo_admitted_requests_total",
             "requests admitted past the SLO control plane",
             ("tenant",),
         )
         self._m_admitted_tokens = reg.counter(
-            "slo_admitted_tokens_total",
+            "radixmesh_slo_admitted_tokens_total",
             "prompt tokens dispatched to the engine per tenant "
             "(the weighted-fair-share currency)",
             ("tenant",),
         )
         self._m_shed = reg.counter(
-            "slo_shed_requests_total",
+            "radixmesh_slo_shed_requests_total",
             "requests shed by the SLO control plane",
             ("tenant", "reason"),
         )
         self._m_depth = reg.gauge(
-            "slo_queue_depth_requests",
+            "radixmesh_slo_queue_depth_requests",
             "requests waiting in the SLO admission queue",
             ("tenant",),
         )
         self._m_backlog = reg.gauge(
-            "slo_backlog_tokens",
+            "radixmesh_slo_backlog_tokens",
             "prompt tokens queued or dispatched-awaiting-first-token",
         )
         self._m_tier = reg.gauge(
-            "slo_degradation_tier",
+            "radixmesh_slo_degradation_tier",
             "current graceful-degradation tier (0 = normal)",
         )
         self._m_transitions = reg.counter(
-            "slo_degradation_transitions_total",
+            "radixmesh_slo_degradation_transitions_total",
             "degradation tier changes",
             ("direction",),
         )
         self._m_wait = reg.histogram(
-            "slo_admission_wait_seconds",
+            "radixmesh_slo_admission_wait_seconds",
             "submit-to-dispatch wait inside the SLO queue",
             ("tenant",),
         )
         self._m_ewma = reg.gauge(
-            "slo_prefill_tokens_per_s_ewma",
+            "radixmesh_slo_prefill_rate_tokens_per_second",
             "EWMA of observed prefill service rate",
         )
 

@@ -145,6 +145,16 @@ class SLORunner(EngineRunner):
                         )
                 req.degradation_tier = tier
                 req.admit_time = now
+                tr = req.trace
+                if tr is not None:
+                    # The control-plane leg of the timeline: submit →
+                    # WFQ dispatch (engine queue wait is its own span,
+                    # recorded at admission).
+                    tr.add(
+                        "slo_queue", req.submit_time,
+                        now - req.submit_time, cat="queue",
+                        tenant=req.tenant, tier=tier,
+                    )
                 self.engine.enqueue(req)
         for req in self.ctl.drain_shed():
             self._finalize_shed(req)
@@ -191,6 +201,13 @@ class SLORunner(EngineRunner):
         exactly like a cancel (FINISHED, no output, flagged)."""
         req.cancelled = True
         req.state = RequestState.FINISHED
+        tr = req.trace
+        if tr is not None:
+            tr.add(
+                "slo_shed", req.submit_time,
+                self._clock() - req.submit_time, cat="queue",
+                tenant=req.tenant, reason=req.shed_reason,
+            )
 
     def cancel(self, rid: int) -> bool:
         with self._lock:

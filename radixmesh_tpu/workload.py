@@ -186,11 +186,18 @@ class TextMultiTurnWorkload(MultiTurnWorkload):
         ]
 
 
-def run_engine_workload(engine, workload: MultiTurnWorkload) -> dict:
+def run_engine_workload(
+    engine, workload: MultiTurnWorkload, trace_path: str | None = None
+) -> dict:
     """Drive the workload through an :class:`Engine` turn-round by
     turn-round (each round's requests run concurrently through the
     continuous batcher, like simultaneous users) and report the
     north-star metrics from the engine's own counters.
+
+    With ``trace_path`` (and the flight recorder enabled — see
+    ``obs/trace_plane.configure``), the run's spans are drained into a
+    Chrome trace-event artifact next to the numeric report, so every
+    bench number comes with the timeline that produced it.
 
     ``ceiling_hit_rate`` is what an INFINITE, never-evicting cache would
     score on the same traffic (page-aligned like real admission): turn
@@ -238,7 +245,16 @@ def run_engine_workload(engine, workload: MultiTurnWorkload) -> dict:
     ttft = engine.stats.ttft_s[start_ttft:]
     hit_rate = cached_tokens / prompt_tokens if prompt_tokens else 0.0
     ceiling_rate = ceiling / total_prompt if total_prompt else 0.0
+    trace_extra = {}
+    if trace_path is not None:
+        from radixmesh_tpu.obs.trace_plane import write_trace
+
+        trace_extra = {
+            "trace_artifact": trace_path,
+            "trace_spans": write_trace(trace_path),
+        }
     return {
+        **trace_extra,
         "requests": workload.n_conversations * workload.n_turns,
         "prompt_tokens": prompt_tokens,
         "cached_tokens": cached_tokens,

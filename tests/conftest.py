@@ -52,3 +52,20 @@ def _fresh_metrics_registry():
     set_registry(Registry())
     yield
     set_registry(old)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace_recorder():
+    """Process-global flight recorder must not leak between tests (a test
+    that enables sampling would otherwise leave every later engine test
+    allocating spans)."""
+    from radixmesh_tpu.obs.trace_plane import (
+        FlightRecorder,
+        get_recorder,
+        set_recorder,
+    )
+
+    old = get_recorder()
+    set_recorder(FlightRecorder())
+    yield
+    set_recorder(old)

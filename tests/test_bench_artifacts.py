@@ -109,3 +109,52 @@ class TestNonEvidentialMarking:
     def test_tpu_rounds_are_not(self, monkeypatch, tmp_path, capsys):
         compact = self._emit(monkeypatch, tmp_path, capsys, "tpu")
         assert "non_evidential" not in compact
+
+
+class TestTraceArtifactSchema:
+    """The flight-recorder trace artifact (TRACE_r{N}.json / workload
+    trace_path emissions) stays machine-loadable: valid JSON object, a
+    traceEvents list, numeric ts/dur, monotonic ts within each lane."""
+
+    def _trace(self) -> dict:
+        from radixmesh_tpu.obs.trace_plane import FlightRecorder
+
+        rec = FlightRecorder(capacity=256, sample=1.0)
+        ctx = rec.trace("req:1")
+        ctx.add("admission_wait", 1.0, 0.01)
+        ctx.add("prefill_wave", 1.01, 0.2, wave_rows=2)
+        ctx.add("decode_chunk", 1.21, 0.05, k_steps=8)
+        ctx.add("publish", 1.26, 0.002)
+        rec.event("ring:decode@1", "replication_lag", 1.27, 0.003,
+                  origin_rank=0)
+        return json.loads(json.dumps(rec.chrome_trace()))
+
+    def test_recorder_export_validates(self):
+        assert bench.validate_trace(self._trace()) == []
+
+    def test_violations_are_named(self):
+        obj = self._trace()
+        xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        xs[0]["ts"] = -5.0           # negative timestamp
+        xs[1]["dur"] = "fast"        # non-numeric duration
+        del xs[2]["tid"]             # no lane
+        problems = "\n".join(bench.validate_trace(obj))
+        assert "ts invalid" in problems
+        assert "dur invalid" in problems
+        assert "tid missing" in problems
+
+    def test_ts_regression_within_lane_is_flagged(self):
+        obj = self._trace()
+        xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        same_lane = [e for e in xs if e["tid"] == xs[0]["tid"]]
+        assert len(same_lane) >= 2
+        same_lane[-1]["ts"] = 0.0  # jump backwards in its lane
+        assert any(
+            "regresses within tid" in p for p in bench.validate_trace(obj)
+        )
+
+    def test_not_an_object_and_missing_events(self):
+        assert bench.validate_trace([1, 2]) == ["artifact is not a JSON object"]
+        assert bench.validate_trace({"x": 1}) == [
+            "traceEvents missing or not a list"
+        ]

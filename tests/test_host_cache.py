@@ -206,8 +206,8 @@ class TestEngineWithHostTier:
         from radixmesh_tpu.obs.metrics import get_registry
 
         snap = get_registry().snapshot()
-        assert snap.get("hicache_backup_tokens_total", 0) > 0
-        assert snap.get("hicache_restore_tokens_total", 0) > 0
+        assert snap.get("radixmesh_hicache_backup_tokens_total", 0) > 0
+        assert snap.get("radixmesh_hicache_restore_tokens_total", 0) > 0
 
 
 class TestDeviceClosureInvariant:
@@ -381,10 +381,10 @@ class TestRestoreOverlap:
         snap = reg.snapshot()
         stall_counts = [
             v for k, v in snap.items()
-            if k.startswith("hicache_restore_stall_seconds")
+            if k.startswith("radixmesh_hicache_restore_stall_seconds")
             and k.endswith("_count")
         ]
         assert stall_counts and sum(stall_counts) >= 1, sorted(
-            k for k in snap if k.startswith("hicache")
+            k for k in snap if k.startswith("radixmesh_hicache")
         )
-        assert "hicache_restore_stall_seconds" in reg.render()
+        assert "radixmesh_hicache_restore_stall_seconds" in reg.render()

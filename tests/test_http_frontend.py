@@ -444,3 +444,175 @@ class TestSLOFrontend:
         )
         assert status == 200
         assert len(out["output_ids"]) >= 1
+
+
+class TestDebugEndpoints:
+    """The tracing plane's HTTP surfaces: /debug/trace (flight-recorder
+    drain as Chrome trace JSON), /debug/requests (in-flight table),
+    /debug/state (node snapshot) — well-formed JSON on both frontend
+    variants, including under concurrent load."""
+
+    def test_debug_state_shape(self, frontend):
+        status, body = _get(f"http://127.0.0.1:{frontend.port}/debug/state")
+        assert status == 200
+        state = json.loads(body)
+        assert state["engine"]["max_batch"] == 2
+        assert state["pool"]["num_slots"] == 512
+        assert state["pool"]["free_slots"] <= state["pool"]["num_slots"]
+        assert "trace" in state and state["trace"]["capacity"] > 0
+
+    def test_debug_requests_table(self, frontend):
+        _post(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            {"input_ids": list(range(300, 320)), "max_tokens": 2},
+        )
+        status, body = _get(f"http://127.0.0.1:{frontend.port}/debug/requests")
+        assert status == 200
+        table = json.loads(body)
+        assert "requests" in table and isinstance(table["requests"], list)
+        # Finished requests leave the table; it reports only live state.
+        assert table["waiting"] == 0
+
+    def test_debug_trace_drains_chrome_json(self, frontend):
+        import bench
+        from radixmesh_tpu.obs.trace_plane import (
+            FlightRecorder,
+            set_recorder,
+        )
+
+        set_recorder(FlightRecorder(capacity=4096, sample=1.0))
+        status, out = _post(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            {"input_ids": list(range(400, 430)), "max_tokens": 3},
+        )
+        assert status == 200
+        status, body = _get(f"http://127.0.0.1:{frontend.port}/debug/trace")
+        assert status == 200
+        obj = json.loads(body)
+        assert bench.validate_trace(obj) == []
+        names = {
+            ev["name"] for ev in obj["traceEvents"] if ev.get("ph") == "X"
+        }
+        assert {"admission_wait", "prefill_wave", "decode_chunk",
+                "publish", "http_request"} <= names
+        # Default GET is a read-only snapshot (a peek must not destroy
+        # the post-mortem); ?drain=1 opts into consuming the buffer.
+        status, body2 = _get(f"http://127.0.0.1:{frontend.port}/debug/trace")
+        assert status == 200
+        obj2 = json.loads(body2)
+        assert len(obj2["traceEvents"]) >= len(obj["traceEvents"])
+        status, _ = _get(
+            f"http://127.0.0.1:{frontend.port}/debug/trace?drain=1"
+        )
+        assert status == 200
+        status, body3 = _get(f"http://127.0.0.1:{frontend.port}/debug/trace")
+        obj3 = json.loads(body3)
+        assert len(obj3["traceEvents"]) < len(obj["traceEvents"])
+
+    def test_debug_endpoints_under_concurrent_load(self, frontend):
+        import concurrent.futures as cf
+
+        from radixmesh_tpu.obs.trace_plane import (
+            FlightRecorder,
+            set_recorder,
+        )
+
+        set_recorder(FlightRecorder(capacity=2048, sample=1.0))
+        paths = ("/debug/trace", "/debug/requests", "/debug/state")
+
+        def gen(i):
+            return _post(
+                f"http://127.0.0.1:{frontend.port}/generate",
+                {"input_ids": list(range(i, i + 10)), "max_tokens": 3},
+                timeout=120,
+            )[0]
+
+        def dbg(i):
+            status, body = _get(
+                f"http://127.0.0.1:{frontend.port}{paths[i % 3]}"
+            )
+            json.loads(body)  # must be well-formed under racing drains
+            return status
+
+        with cf.ThreadPoolExecutor(8) as ex:
+            gens = [ex.submit(gen, 500 + 16 * i) for i in range(4)]
+            dbgs = [ex.submit(dbg, i) for i in range(12)]
+            assert all(f.result() == 200 for f in gens + dbgs)
+
+    def test_router_debug_endpoints_concurrent(self):
+        import concurrent.futures as cf
+
+        import bench
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.comm.inproc import InprocHub
+        from radixmesh_tpu.config import MeshConfig, NodeRole
+        from radixmesh_tpu.cache.kv_pool import PagedKVPool
+        from radixmesh_tpu.obs.trace_plane import (
+            FlightRecorder,
+            set_recorder,
+        )
+        from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+        set_recorder(FlightRecorder(capacity=4096, sample=1.0))
+        InprocHub.reset_default()
+        prefill, decode, router = ["p0"], ["d0"], ["r0"]
+        nodes = []
+        try:
+            for addr in prefill + decode + router:
+                cfg = MeshConfig(
+                    prefill_nodes=prefill,
+                    decode_nodes=decode,
+                    router_nodes=router,
+                    local_addr=addr,
+                    protocol="inproc",
+                    tick_interval_s=0.05,
+                    gc_interval_s=30.0,
+                )
+                pool = (
+                    None
+                    if cfg.local_role is NodeRole.ROUTER
+                    else PagedKVPool(
+                        num_slots=64, num_layers=1, num_kv_heads=1, head_dim=2
+                    )
+                )
+                nodes.append(MeshCache(cfg, pool=pool).start())
+            for n in nodes:
+                assert n.wait_ready(timeout=10)
+            car = CacheAwareRouter(nodes[2], nodes[2].cfg)
+            car.finish_warm_up()
+            f = RouterFrontend(car, port=0)
+            try:
+                def route(i):
+                    return _post(
+                        f"http://127.0.0.1:{f.port}/route",
+                        {"input_ids": [i, i + 1, i + 2]},
+                    )[0]
+
+                def dbg(path):
+                    status, body = _get(f"http://127.0.0.1:{f.port}{path}")
+                    return status, json.loads(body)
+
+                with cf.ThreadPoolExecutor(6) as ex:
+                    routes = [ex.submit(route, i) for i in range(8)]
+                    assert all(r.result() == 200 for r in routes)
+                status, state = dbg("/debug/state")
+                assert status == 200
+                assert state["router"]["warm_up"] is False
+                assert state["membership"]["role"] == "router"
+                assert sorted(state["router"]["alive"]["prefill"]) == ["p0"]
+                status, table = dbg("/debug/requests")
+                assert status == 200 and table["requests"] == []
+                status, trace = dbg("/debug/trace")
+                assert status == 200
+                assert bench.validate_trace(trace) == []
+                route_spans = [
+                    ev for ev in trace["traceEvents"]
+                    if ev.get("ph") == "X" and ev["name"] == "route"
+                ]
+                assert len(route_spans) >= 8
+            finally:
+                f.close()
+        finally:
+            for n in nodes:
+                n.close()
+            InprocHub.reset_default()

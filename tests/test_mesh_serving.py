@@ -145,7 +145,7 @@ def test_serve_publish_route_hit(cluster):
     cached_before = eng.stats.cached_tokens
     reg = get_registry()
     m_cached = reg.counter(
-        "engine_cached_tokens_total",
+        "radixmesh_engine_cached_tokens_total",
         "prompt tokens served from the radix cache",
         ("engine",),
     ).labels(engine="p0")

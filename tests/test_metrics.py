@@ -17,6 +17,8 @@ from radixmesh_tpu.obs.metrics import (
 )
 from radixmesh_tpu.obs.tracing import annotate, timed
 
+pytestmark = pytest.mark.quick
+
 
 @pytest.fixture(autouse=True)
 def fresh_registry():
@@ -71,12 +73,37 @@ class TestHistogram:
         text = Registry().render()  # empty registry renders fine
         assert text == "\n"
 
-    def test_quantile(self):
+    def test_quantile_interpolates_within_bucket(self):
+        # p50 used to snap to the bucket's upper bound (2.0 here); the
+        # interpolated estimate assumes uniform mass within the bucket.
         h = Histogram("h", buckets=(1.0, 2.0, 4.0))
         for v in (0.5, 1.5, 1.7, 3.0):
             h.observe(v)
+        # target = 2 of 4 samples; bucket (1, 2] holds samples #2-3, so
+        # the estimate is 1 + (2-1) * (2-1)/2.
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        assert h.quantile(1.0) == pytest.approx(4.0)
+
+    def test_quantile_first_bucket_interpolates_from_zero(self):
+        h = Histogram("h", buckets=(10.0, 20.0))
+        for _ in range(4):
+            h.observe(5.0)
+        # All mass in (0, 10]: the p50 estimate is 10 * 0.5, not the
+        # bucket edge.
+        assert h.quantile(0.5) == pytest.approx(5.0)
+
+    def test_quantile_overflow_returns_largest_finite_bound(self):
+        h = Histogram("h", buckets=(1.0, 2.0))
+        h.observe(100.0)  # lands in +Inf
         assert h.quantile(0.5) == 2.0
-        assert h.quantile(1.0) == 4.0
+
+    def test_observe_bucket_edges_match_cumulative_semantics(self):
+        # value == upper bound must land IN that bucket (<= semantics);
+        # the bisect rewrite must not flip edges to the next bucket.
+        h = Histogram("h", buckets=(1.0, 2.0, 4.0))
+        for v in (1.0, 2.0, 4.0, 4.1):
+            h.observe(v)
+        assert h._counts == [1, 1, 1, 1]
 
     def test_timer(self):
         h = Histogram("h")
@@ -179,13 +206,13 @@ class TestEngineMetrics:
         eng.generate([prompt], max_steps=30)  # second pass hits the cache
         snap = fresh_registry.snapshot()
         k = '{engine="e0"}'
-        assert snap[f"engine_prompt_tokens_total{k}"] == 2 * len(prompt)
-        assert snap[f"engine_cached_tokens_total{k}"] > 0
-        assert snap[f"engine_generated_tokens_total{k}"] > 0
-        assert snap[f"engine_ttft_seconds{k}_count"] == 2
-        assert snap[f"engine_tpot_seconds{k}_count"] >= 1
+        assert snap[f"radixmesh_engine_prompt_tokens_total{k}"] == 2 * len(prompt)
+        assert snap[f"radixmesh_engine_cached_tokens_total{k}"] > 0
+        assert snap[f"radixmesh_engine_generated_tokens_total{k}"] > 0
+        assert snap[f"radixmesh_engine_ttft_seconds{k}_count"] == 2
+        assert snap[f"radixmesh_engine_tpot_seconds{k}_count"] >= 1
         # counter == stats (the stop-token path must not diverge)
-        assert snap[f"engine_generated_tokens_total{k}"] == eng.stats.generated_tokens
+        assert snap[f"radixmesh_engine_generated_tokens_total{k}"] == eng.stats.generated_tokens
 
 
 class TestMeshMetrics:
@@ -208,18 +235,144 @@ class TestMeshMetrics:
             lag = [
                 v
                 for k, v in snap.items()
-                if k.startswith("mesh_oplog_lag_seconds") and k.endswith("_count")
+                if k.startswith("radixmesh_mesh_oplog_lag_seconds") and k.endswith("_count")
             ]
             assert sum(lag) > 0
-            sent = [v for k, v in snap.items() if k.startswith("mesh_oplogs_sent")]
+            sent = [v for k, v in snap.items() if k.startswith("radixmesh_mesh_oplogs_sent")]
             assert sum(sent) > 0
             assert prefill.metrics["oplogs_sent"] > 0
             received = [
                 k
                 for k in snap
-                if k.startswith("mesh_oplogs_received_total") and "INSERT" in k
+                if k.startswith("radixmesh_mesh_oplogs_received_total") and "INSERT" in k
             ]
             assert received
         finally:
             c.close()
             InprocHub.reset_default()
+
+
+class TestExpositionStrictParse:
+    """Strict parse of ``Registry.render()``: a Prometheus scrape is
+    all-or-nothing — ONE malformed line poisons every series in the
+    exposition — so the format contract is pinned here line by line
+    (escaping round-trip, ``le`` ordering, cumulative monotonicity,
+    ``_sum``/``_count`` consistency)."""
+
+    import re as _re
+
+    _SAMPLE = _re.compile(
+        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?:\{(?P<labels>.*)\})?"
+        r" (?P<value>[^ ]+)$"
+    )
+
+    @staticmethod
+    def _parse_labels(raw: str) -> dict:
+        """Char-by-char label parser honoring the exposition escapes
+        (\\\\, \\", \\n) — a regex split would tear on escaped quotes."""
+        labels: dict[str, str] = {}
+        i = 0
+        while i < len(raw):
+            eq = raw.index("=", i)
+            key = raw[i:eq]
+            assert raw[eq + 1] == '"', raw
+            j = eq + 2
+            val: list[str] = []
+            while raw[j] != '"':
+                if raw[j] == "\\":
+                    val.append({"\\": "\\", '"': '"', "n": "\n"}[raw[j + 1]])
+                    j += 2
+                else:
+                    val.append(raw[j])
+                    j += 1
+            labels[key] = "".join(val)
+            i = j + 1
+            if i < len(raw):
+                assert raw[i] == ",", raw
+                i += 1
+        return labels
+
+    def _parse(self, text: str) -> list[tuple[str, dict, float]]:
+        """Every non-comment line must match the sample grammar."""
+        samples = []
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("#"):
+                assert line.startswith(("# HELP ", "# TYPE ")), line
+                continue
+            m = self._SAMPLE.match(line)
+            assert m is not None, f"unparseable sample line: {line!r}"
+            labels = self._parse_labels(m.group("labels") or "")
+            raw_v = m.group("value")
+            value = float("inf") if raw_v == "+Inf" else float(raw_v)
+            samples.append((m.group("name"), labels, value))
+        return samples
+
+    def test_label_escaping_round_trips(self, fresh_registry):
+        reg = fresh_registry
+        nasty = 'he said "hi\\there"\nand left'
+        reg.counter("x_total", "t", ("who",)).labels(who=nasty).inc(3)
+        samples = self._parse(reg.render())
+        assert samples == [("x_total", {"who": nasty}, 3.0)]
+
+    def test_histogram_le_ordering_and_monotonicity(self, fresh_registry):
+        reg = fresh_registry
+        h = reg.histogram(
+            "lat_seconds", "t", ("op",), buckets=(0.1, 1.0, 10.0)
+        )
+        for op, values in (
+            ("read", (0.05, 0.5, 0.5, 5.0, 50.0)),
+            ("write", (0.01, 20.0)),
+        ):
+            child = h.labels(op=op)
+            for v in values:
+                child.observe(v)
+        samples = self._parse(reg.render())
+        by_series: dict[str, list[tuple[float, float]]] = {}
+        for name, labels, value in samples:
+            if name != "lat_seconds_bucket":
+                continue
+            le = labels.pop("le")
+            key = repr(sorted(labels.items()))
+            by_series.setdefault(key, []).append(
+                (float("inf") if le == "+Inf" else float(le), value)
+            )
+        assert len(by_series) == 2
+        for series in by_series.values():
+            les = [le for le, _ in series]
+            counts = [c for _, c in series]
+            # le values rendered in ascending order, +Inf last...
+            assert les == sorted(les) and les[-1] == float("inf")
+            # ...and cumulative counts never decrease along them.
+            assert counts == sorted(counts)
+
+    def test_sum_count_consistency(self, fresh_registry):
+        reg = fresh_registry
+        h = reg.histogram("lat_seconds", "t", buckets=(1.0, 2.0))
+        values = (0.5, 1.5, 7.0)
+        for v in values:
+            h.observe(v)
+        samples = {
+            (name, tuple(sorted(labels.items()))): value
+            for name, labels, value in self._parse(reg.render())
+        }
+        count = samples[("lat_seconds_count", ())]
+        total = samples[("lat_seconds_sum", ())]
+        inf_bucket = samples[("lat_seconds_bucket", (("le", "+Inf"),))]
+        assert count == len(values)
+        assert count == inf_bucket  # +Inf bucket IS the count
+        assert total == pytest.approx(sum(values))
+
+    def test_every_kind_renders_parseable(self, fresh_registry):
+        reg = fresh_registry
+        reg.counter("a_total", "help text", ("x",)).labels(x="1").inc()
+        reg.gauge("b_bytes", "gauge").set(-2.5)
+        reg.histogram("c_seconds", "hist").observe(0.3)
+        samples = self._parse(reg.render())  # asserts per line
+        names = {name for name, _, _ in samples}
+        assert {
+            "a_total", "b_bytes", "c_seconds_bucket",
+            "c_seconds_sum", "c_seconds_count",
+        } <= names

@@ -1,0 +1,117 @@
+"""Metric hygiene lint: every family the serving stack registers must be
+``radixmesh_``-prefixed (one grep finds the fleet's series; no collision
+with other exporters on a shared scrape) and unit-suffixed so dashboards
+never guess units. Families register at construction time, so the lint
+builds one of each instrumented component and walks what landed in the
+default registry."""
+
+import jax
+import pytest
+
+from radixmesh_tpu.obs.metrics import get_registry
+
+pytestmark = pytest.mark.quick
+
+# Base units (counters are ``_total``; histograms observe seconds/bytes/
+# tokens). Gauges may additionally be counts of a named thing or one of
+# the declared dimensionless states — a new suffix here is a conscious
+# vocabulary decision, not a typo that slips through.
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_tokens")
+GAUGE_SUFFIXES = UNIT_SUFFIXES + (
+    "_requests", "_slots", "_nodes", "_rows",
+    "_epoch", "_rank", "_flag", "_tier", "_tokens_per_second",
+)
+
+
+def _register_all_instrumented_families() -> None:
+    """Construct one of every metric-registering component (engine incl.
+    host tier, mesh node, router, SLO controller) against the default
+    registry. Nothing is started — registration happens in __init__."""
+    from radixmesh_tpu.cache.kv_pool import PagedKVPool
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.config import MeshConfig
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+    from radixmesh_tpu.slo import SLOConfig
+    from radixmesh_tpu.slo.control import OverloadController
+
+    cfg = ModelConfig.tiny()
+    Engine(
+        cfg,
+        init_params(cfg, jax.random.PRNGKey(0)),
+        num_slots=64,
+        page_size=4,
+        max_batch=1,
+        host_cache_slots=64,  # registers the hicache families too
+        name="lint",
+    )
+    OverloadController(SLOConfig())
+    prefill, decode, router = ["p0"], ["d0"], ["r0"]
+
+    def mesh_cfg(addr):
+        return MeshConfig(
+            prefill_nodes=prefill,
+            decode_nodes=decode,
+            router_nodes=router,
+            local_addr=addr,
+            protocol="inproc",
+        )
+
+    MeshCache(
+        mesh_cfg("p0"),
+        pool=PagedKVPool(num_slots=16, num_layers=1, num_kv_heads=1, head_dim=2),
+    )
+    router_mesh = MeshCache(mesh_cfg("r0"))
+    CacheAwareRouter(router_mesh, router_mesh.cfg)
+
+
+def _registered_families() -> dict[str, str]:
+    """name → kind, parsed from the # TYPE lines of the exposition (the
+    same surface a scraper sees)."""
+    out = {}
+    for line in get_registry().render().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            out[name] = kind
+    return out
+
+
+class TestMetricHygiene:
+    def test_all_families_prefixed_and_unit_suffixed(self):
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        assert len(fams) >= 30, f"lint saw too few families: {sorted(fams)}"
+        offenders = []
+        for name, kind in fams.items():
+            if not name.startswith("radixmesh_"):
+                offenders.append(f"{name}: missing radixmesh_ prefix")
+                continue
+            if kind == "counter" and not name.endswith("_total"):
+                offenders.append(f"{name}: counter without _total")
+            elif kind == "histogram" and not name.endswith(
+                ("_seconds", "_bytes", "_tokens")
+            ):
+                offenders.append(f"{name}: histogram without a unit suffix")
+            elif kind == "gauge" and not name.endswith(GAUGE_SUFFIXES):
+                offenders.append(f"{name}: gauge without a declared unit")
+        assert not offenders, "\n".join(sorted(offenders))
+
+    def test_membership_gauges_exported(self):
+        """Satellite: failover/hier re-election state is on /metrics, not
+        only in logs."""
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        for name in (
+            "radixmesh_mesh_view_epoch",
+            "radixmesh_mesh_alive_nodes",
+            "radixmesh_mesh_leader_flag",
+            "radixmesh_mesh_spine_nodes",
+            "radixmesh_mesh_successor_rank",
+        ):
+            assert fams.get(name) == "gauge", (name, sorted(fams))
+        snap = get_registry().snapshot()
+        # The P/D node constructed by the lint holds the initial view:
+        # epoch 0, both ring members alive.
+        assert snap['radixmesh_mesh_alive_nodes{node="prefill@0"}'] == 2.0
+        assert snap['radixmesh_mesh_view_epoch{node="prefill@0"}'] == 0.0

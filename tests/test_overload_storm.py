@@ -156,7 +156,7 @@ class TestStormScenarios:
         metric_shed = sum(
             v
             for k, v in snap.items()
-            if k.startswith("slo_shed_requests_total")
+            if k.startswith("radixmesh_slo_shed_requests_total")
         )
         assert metric_shed == ctl.total_shed >= shed_storm
 

@@ -531,8 +531,8 @@ class TestPPComposition:
         eng.generate([a], max_steps=30)  # must hit via host restore
         assert eng.stats.cached_tokens > 0
         snap = get_registry().snapshot()
-        assert snap.get("hicache_backup_tokens_total", 0) > 0
-        assert snap.get("hicache_restore_tokens_total", 0) > 0
+        assert snap.get("radixmesh_hicache_backup_tokens_total", 0) > 0
+        assert snap.get("radixmesh_hicache_restore_tokens_total", 0) > 0
 
     def test_pp_engine_tree_snapshot_restore(self, mesh, tmp_path):
         """Serve → snapshot the tree+pool → restore into a FRESH pp

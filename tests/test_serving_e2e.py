@@ -166,6 +166,6 @@ def test_route_then_serve_hits_cache(cluster):
     metrics = _get(serve_url + "/metrics")
     cached = [
         l for l in metrics.splitlines()
-        if l.startswith("engine_cached_tokens_total") and not l.startswith("#")
+        if l.startswith("radixmesh_engine_cached_tokens_total") and not l.startswith("#")
     ]
     assert cached and any(float(l.rsplit(" ", 1)[1]) >= 24 for l in cached)

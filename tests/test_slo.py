@@ -321,16 +321,16 @@ class TestObservability:
         assert not ctl.offer("t", 8, now=clock()).admitted  # bucket empty
         ctl.pop_ready(now=clock())
         snap = get_registry().snapshot()
-        assert snap['slo_admitted_requests_total{tenant="t"}'] == 1
+        assert snap['radixmesh_slo_admitted_requests_total{tenant="t"}'] == 1
         assert (
-            snap['slo_shed_requests_total{reason="rate_limited",tenant="t"}']
+            snap['radixmesh_slo_shed_requests_total{reason="rate_limited",tenant="t"}']
             == 1
         )
-        assert 'slo_degradation_tier' in snap
+        assert 'radixmesh_slo_degradation_tier' in snap
         # The exposition endpoint renders the same series.
         text = get_registry().render()
-        assert "slo_queue_depth_requests" in text
-        assert "slo_admission_wait_seconds_bucket" in text
+        assert "radixmesh_slo_queue_depth_requests" in text
+        assert "radixmesh_slo_admission_wait_seconds_bucket" in text
 
     def test_snapshot_shape(self):
         ctl = OverloadController(SLOConfig(), clock=Clock())

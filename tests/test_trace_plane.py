@@ -1,0 +1,321 @@
+"""Request-flight tracing plane (``obs/trace_plane.py``): flight-recorder
+semantics (bounded, drop-oldest, one-branch no-op when off), the span
+instrumentation threaded through the serving stack, and the Chrome
+trace-event artifact contract (``bench.validate_trace``)."""
+
+import json
+import time
+
+import jax
+import pytest
+
+import bench
+from radixmesh_tpu.engine.engine import Engine
+from radixmesh_tpu.models.llama import ModelConfig, init_params
+from radixmesh_tpu.obs.trace_plane import (
+    FlightRecorder,
+    get_recorder,
+    set_recorder,
+    write_trace,
+)
+from radixmesh_tpu.workload import MultiTurnWorkload, run_engine_workload
+
+pytestmark = pytest.mark.quick
+
+
+def _tiny_engine(name: str, mesh=None, **kw) -> Engine:
+    cfg = ModelConfig.tiny()
+    return Engine(
+        cfg,
+        init_params(cfg, jax.random.PRNGKey(0)),
+        num_slots=512,
+        page_size=4,
+        max_batch=2,
+        name=name,
+        mesh=mesh,
+        **kw,
+    )
+
+
+class TestFlightRecorder:
+    def test_capacity_bound_and_drop_oldest(self):
+        rec = FlightRecorder(capacity=16, sample=1.0)
+        for i in range(100):
+            rec.event("lane", f"e{i}", float(i), 0.5)
+        assert len(rec) == 16
+        assert rec.dropped == 84
+        assert rec.recorded == 100
+        # Drop-OLDEST: the survivors are the freshest spans.
+        names = [s.name for s in rec.snapshot()]
+        assert names == [f"e{i}" for i in range(84, 100)]
+        assert len(rec.drain()) == 16
+        assert len(rec) == 0
+
+    def test_disabled_recorder_returns_none_and_records_nothing(self):
+        rec = FlightRecorder(capacity=16, sample=0.0)
+        assert rec.trace("req:1") is None
+        rec.event("lane", "e", 0.0, 1.0)
+        assert len(rec) == 0 and rec.recorded == 0
+
+    def test_partial_sampling_mixes_traced_and_untraced(self):
+        rec = FlightRecorder(capacity=1024, sample=0.5)
+        got = [rec.trace("req") is not None for _ in range(200)]
+        assert any(got) and not all(got)
+
+    def test_span_context_manager_measures(self):
+        rec = FlightRecorder(capacity=8, sample=1.0)
+        ctx = rec.trace("req:1")
+        with ctx.span("work", x=1):
+            time.sleep(0.01)
+        (span,) = rec.snapshot()
+        assert span.name == "work" and span.dur >= 0.01
+        assert span.trace_id == ctx.trace_id and span.args == {"x": 1}
+
+    def test_chrome_trace_schema_validates(self):
+        rec = FlightRecorder(capacity=64, sample=1.0)
+        for i in range(10):
+            rec.event(f"lane{i % 3}", "e", float(10 - i), 0.25, k=i)
+        obj = rec.chrome_trace()
+        assert bench.validate_trace(obj) == []
+        # Round-trips through JSON (the /debug/trace body).
+        assert bench.validate_trace(json.loads(json.dumps(obj))) == []
+        names = {
+            ev["args"]["name"]
+            for ev in obj["traceEvents"]
+            if ev["ph"] == "M"
+        }
+        assert names == {"lane0", "lane1", "lane2"}
+
+
+class TestNoOpGuard:
+    def test_disabled_tracing_allocates_no_spans(self, monkeypatch):
+        """Acceptance: with sampling off, the per-step hot path takes the
+        no-op branch — zero Span allocations, zero recorder writes — for
+        a full serve (admission, prefill, decode, publish)."""
+        calls = {"record": 0}
+        orig = FlightRecorder._record
+
+        def spy(self, span):
+            calls["record"] += 1
+            return orig(self, span)
+
+        monkeypatch.setattr(FlightRecorder, "_record", spy)
+        eng = _tiny_engine("trace-off")
+        reqs = [eng.add_request(list(range(1, 16))) for _ in range(3)]
+        for _ in range(200):
+            if not eng.has_work():
+                break
+            eng.step()
+        assert all(r.trace is None for r in reqs)
+        assert calls["record"] == 0
+
+    def test_enabled_tracing_attaches_context(self):
+        set_recorder(FlightRecorder(capacity=4096, sample=1.0))
+        eng = _tiny_engine("trace-on")
+        req = eng.add_request(list(range(1, 16)))
+        assert req.trace is not None
+        for _ in range(200):
+            if not eng.has_work():
+                break
+            eng.step()
+        names = {s.name for s in get_recorder().snapshot()}
+        assert {"prefix_match", "admission_wait", "prefill_wave",
+                "decode_chunk", "publish", "first_token"} <= names
+
+
+class TestEngineWorkloadTrace:
+    def test_workload_trace_has_request_span_tree_and_ring_lag(self, tmp_path):
+        """Acceptance: a CPU engine workload run with tracing enabled
+        produces Chrome trace JSON containing, for at least one request,
+        spans for admission wait, prefill wave, decode chunk, publish —
+        and ring replication-lag spans from the mesh leg."""
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.comm.inproc import InprocHub
+        from radixmesh_tpu.config import MeshConfig
+
+        set_recorder(FlightRecorder(capacity=1 << 15, sample=1.0))
+        InprocHub.reset_default()
+        prefill, decode = ["p0"], ["d0"]
+        nodes = []
+        try:
+            for addr in prefill + decode:
+                cfg = MeshConfig(
+                    prefill_nodes=prefill,
+                    decode_nodes=decode,
+                    router_nodes=[],
+                    local_addr=addr,
+                    protocol="inproc",
+                    tick_interval_s=0.05,
+                    gc_interval_s=30.0,
+                )
+                nodes.append(MeshCache(cfg, pool=None).start())
+            for n in nodes:
+                assert n.wait_ready(timeout=10)
+            eng = _tiny_engine("trace-mesh", mesh=nodes[0])
+            wl = MultiTurnWorkload(
+                n_conversations=2, n_turns=2, system_len=8,
+                user_len=4, gen_len=4, vocab_size=256,
+            )
+            report = run_engine_workload(eng, wl)
+            assert report["requests"] == 4
+            # Replication lag is recorded on d0's receive path; give the
+            # ring a moment to lap.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if any(
+                    s.name == "replication_lag"
+                    for s in get_recorder().snapshot()
+                ):
+                    break
+                time.sleep(0.02)
+            path = str(tmp_path / "trace.json")
+            assert write_trace(path) > 0
+        finally:
+            for n in nodes:
+                n.close()
+            InprocHub.reset_default()
+
+        with open(path) as fh:
+            obj = json.load(fh)
+        assert bench.validate_trace(obj) == []
+        by_trace: dict[int, set] = {}
+        lag_spans = []
+        for ev in obj["traceEvents"]:
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args") or {}
+            tid = args.get("trace_id")
+            if tid:
+                by_trace.setdefault(tid, set()).add(ev["name"])
+            if ev["name"] == "replication_lag":
+                lag_spans.append(ev)
+        want = {"admission_wait", "prefill_wave", "decode_chunk", "publish"}
+        assert any(want <= names for names in by_trace.values()), (
+            "no request carried the full span tree",
+            {t: sorted(n) for t, n in by_trace.items()},
+        )
+        assert lag_spans, "no ring replication-lag spans recorded"
+        assert all(ev["dur"] >= 0 for ev in lag_spans)
+
+    def test_workload_emits_trace_artifact_inline(self, tmp_path):
+        set_recorder(FlightRecorder(capacity=4096, sample=1.0))
+        eng = _tiny_engine("trace-artifact")
+        wl = MultiTurnWorkload(
+            n_conversations=1, n_turns=2, system_len=8,
+            user_len=4, gen_len=4, vocab_size=256,
+        )
+        path = str(tmp_path / "wl_trace.json")
+        report = run_engine_workload(eng, wl, trace_path=path)
+        assert report["trace_artifact"] == path
+        assert report["trace_spans"] > 0
+        with open(path) as fh:
+            assert bench.validate_trace(json.load(fh)) == []
+
+
+class TestSLOQueueSpan:
+    def test_slo_dispatch_records_queue_span(self):
+        from radixmesh_tpu.slo import SLOConfig
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        set_recorder(FlightRecorder(capacity=4096, sample=1.0))
+        eng = _tiny_engine("trace-slo")
+        runner = SLORunner(eng, SLOConfig()).start()
+        try:
+            req = runner.submit(list(range(1, 12)))
+            runner.wait(req, timeout=60)
+            names = {s.name for s in get_recorder().snapshot()}
+            assert "slo_queue" in names
+        finally:
+            runner.close()
+
+
+class TestDisaggSpans:
+    def test_handoff_records_pack_and_write_spans(self):
+        from radixmesh_tpu.engine.disagg import DecodeWorker, PrefillWorker
+
+        set_recorder(FlightRecorder(capacity=4096, sample=1.0))
+        cfg = ModelConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        pw = PrefillWorker(
+            cfg, params, num_slots=256, page_size=4, max_batch=1,
+            name="trace-pw",
+        )
+        dw = DecodeWorker(
+            Engine(cfg, params, num_slots=256, page_size=4, max_batch=1,
+                   name="trace-dw")
+        )
+        pkt = pw.prefill_handoff(list(range(1, 14)))
+        dw.submit(pkt)
+        dw.run_until_drained()
+        names = {s.name for s in get_recorder().snapshot()}
+        assert {"disagg_handoff_pack", "disagg_handoff_receive",
+                "disagg_kv_write"} <= names
+
+    def test_fractional_sampling_follows_packet_traced_bit(self):
+        """Under 0<sample<1 the decode side must follow the prefill
+        node's coin flip (HandoffPacket.traced + force), not flip its
+        own — else cross-node timelines come apart probabilistically."""
+        from radixmesh_tpu.engine.disagg import (
+            HandoffPacket,
+            pack_handoff,
+            unpack_handoff,
+        )
+        import numpy as np
+
+        pkt = HandoffPacket(
+            prompt=np.arange(1, 9, dtype=np.int32),
+            first_token=3,
+            kv=np.zeros((2, 1, 8, 1, 2), dtype=np.float32),
+            traced=True,
+        )
+        rt = unpack_handoff(pack_handoff(pkt))
+        assert rt.traced is True  # the bit survives the wire
+        # force=True skips the coin (a ~0 sample would lose every flip)
+        # but NOT the off switch.
+        rec = FlightRecorder(capacity=8, sample=1e-9)
+        assert rec.trace("req:1", force=True) is not None
+        assert FlightRecorder(capacity=8, sample=0.0).trace(
+            "req:1", force=True
+        ) is None
+
+
+class TestLaunchTraceFlags:
+    def _args(self, **kw):
+        import argparse
+
+        base = dict(trace_capacity=64, trace_sample=None, trace_dir=None)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    def test_trace_dir_alone_implies_full_sampling(self):
+        from radixmesh_tpu.launch import _configure_tracing
+
+        _configure_tracing(self._args(trace_dir="/tmp/x"))
+        assert get_recorder().sample == 1.0
+
+    def test_explicit_zero_sample_wins_over_trace_dir(self):
+        from radixmesh_tpu.launch import _configure_tracing
+
+        before = get_recorder()
+        _configure_tracing(self._args(trace_dir="/tmp/x", trace_sample=0.0))
+        # Recorder untouched: the operator said off, so off.
+        assert get_recorder() is before and not get_recorder().enabled
+
+    def test_unset_everything_stays_disabled(self):
+        from radixmesh_tpu.launch import _configure_tracing
+
+        before = get_recorder()
+        _configure_tracing(self._args())
+        assert get_recorder() is before and not get_recorder().enabled
+
+    def test_dump_skipped_when_tracing_explicitly_off(self, tmp_path):
+        import logging
+        import os
+
+        from radixmesh_tpu.launch import _configure_tracing, _dump_trace
+
+        args = self._args(trace_dir=str(tmp_path / "t"), trace_sample=0.0)
+        _configure_tracing(args)
+        _dump_trace(args, logging.getLogger("t"))
+        # No empty junk artifact that reads as "a trace was captured".
+        assert not os.path.exists(args.trace_dir)
