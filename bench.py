@@ -164,6 +164,111 @@ def validate_trace(obj) -> list[str]:
     return problems
 
 
+# ----------------------------------------------------------------------
+# FLEET stable schema (PR 3, fleet telemetry plane): one artifact per
+# round recording digest fan-in, fingerprint-convergence behavior under
+# churn/divergence, and health-score reaction to an injected stall
+# (radixmesh_tpu/obs/fleet_plane.py + workload.run_fleet_churn_workload).
+# Bump the version ONLY when adding fields (never remove or rename).
+# ----------------------------------------------------------------------
+
+FLEET_SCHEMA_VERSION = 1
+
+FLEET_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload", "nodes",
+    "topology", "digest_interval_s", "digest_bytes", "digest_byte_budget",
+    "fan_in", "convergence", "stall_reaction", "health_aware_demotion",
+    "digests_published", "digest_frames_per_publish", "wall_s",
+)
+FLEET_FAN_IN_FIELDS = ("rounds", "p50_s", "max_s")
+FLEET_CONVERGENCE_FIELDS = (
+    "inserts", "writers", "churn_s", "max_age_during_churn_s",
+    "quiesce_to_converged_s", "converged", "injected_divergence_detected",
+    "age_while_diverged_s", "healed", "heal_s",
+)
+FLEET_STALL_FIELDS = (
+    "injected", "detected", "reaction_s", "score_after", "threshold",
+)
+
+
+def validate_fleet(report) -> list[str]:
+    """Schema violations of a FLEET artifact vs the pinned contract
+    (empty = valid): all top/section fields present, the serialized
+    digest within its pinned byte budget, and digest ring overhead at
+    most one frame per origination. Import-safe from artifact tests (no
+    jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in FLEET_TOP_FIELDS if f not in report]
+    for section, fields in (
+        ("fan_in", FLEET_FAN_IN_FIELDS),
+        ("convergence", FLEET_CONVERGENCE_FIELDS),
+        ("stall_reaction", FLEET_STALL_FIELDS),
+    ):
+        sec = report.get(section)
+        if isinstance(sec, dict):
+            problems += [f"{section}.{f}" for f in fields if f not in sec]
+    db, budget = report.get("digest_bytes"), report.get("digest_byte_budget")
+    if isinstance(db, (int, float)) and isinstance(budget, (int, float)):
+        if db > budget:
+            problems.append(
+                f"digest_bytes {db} exceeds digest_byte_budget {budget}"
+            )
+    frames = report.get("digest_frames_per_publish")
+    if isinstance(frames, (int, float)) and frames > 1.0 + 1e-9:
+        problems.append(
+            f"digest_frames_per_publish {frames} > 1 (piggyback contract)"
+        )
+    return problems
+
+
+def build_fleet_report(res: dict) -> dict:
+    """Assemble a schema-complete FLEET artifact from
+    ``workload.run_fleet_churn_workload``'s result."""
+    from radixmesh_tpu.obs.fleet_plane import DIGEST_BYTE_BUDGET
+
+    conv = res.get("convergence", {})
+    return {
+        "schema_version": FLEET_SCHEMA_VERSION,
+        "metric": "fleet_digest_fan_in_p50_s",
+        "value": round(res["fan_in"]["p50_s"], 6),
+        "unit": "s (one digest round visible on every node incl. router)",
+        "workload": (
+            f"{conv.get('inserts', 0)} inserts over "
+            f"{conv.get('writers', 0)} writers + injected divergence + "
+            "injected stall (inproc ring)"
+        ),
+        "digest_byte_budget": DIGEST_BYTE_BUDGET,
+        **res,
+    }
+
+
+def _fleet_pass() -> dict:
+    """The fleet telemetry bench: run the churn/stall workload and write
+    the round's ``FLEET_r{N}.json`` (validated against the pinned
+    schema before writing — a violation is recorded in the artifact, not
+    silently shipped)."""
+    from radixmesh_tpu.workload import run_fleet_churn_workload
+
+    res = run_fleet_churn_workload()
+    report = build_fleet_report(res)
+    problems = validate_fleet(report)
+    if problems:
+        report["schema_violation"] = problems
+        log(f"fleet pass: SCHEMA VIOLATION {problems}")
+    path = os.path.join(_REPO, f"FLEET_r{current_round():02d}.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1)
+    log(
+        f"fleet pass: wrote {os.path.basename(path)} "
+        f"(fan_in_p50={report['value']}s, "
+        f"converged={report['convergence']['converged']}, "
+        f"stall_reaction={report['stall_reaction']['reaction_s']}s)"
+    )
+    report["artifact"] = os.path.basename(path)
+    return report
+
+
 def _error_json(msg: str) -> str:
     return json.dumps({
         "metric": "decode_tokens_per_sec_per_chip",
@@ -1293,6 +1398,11 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — partial rounds must survive
         log(f"slo sweep: FAILED {type(exc).__name__}: {exc}")
         slo = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    try:
+        fleet = _fleet_pass()
+    except Exception as exc:  # noqa: BLE001 — partial rounds must survive
+        log(f"fleet pass: FAILED {type(exc).__name__}: {exc}")
+        fleet = {"error": f"{type(exc).__name__}: {exc}"[:400]}
 
     print(json.dumps({
         "metric": "decode_tokens_per_sec_per_chip",
@@ -1321,6 +1431,7 @@ def main() -> None:
         "north_star_real_weights": real,
         "llama3_8b_int8": m8b,
         "slo_overload": slo,
+        "fleet": fleet,
     }))
 
 

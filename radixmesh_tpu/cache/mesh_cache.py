@@ -86,6 +86,7 @@ from radixmesh_tpu.cache.oplog import (
 from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode, as_key
 from radixmesh_tpu.comm.communicator import Communicator, create_communicator
 from radixmesh_tpu.config import MeshConfig, NodeRole
+from radixmesh_tpu.obs.fleet_plane import FleetView, NodeDigest, eviction_counters
 from radixmesh_tpu.obs.metrics import get_registry
 from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.obs.tracing import recorded
@@ -98,7 +99,7 @@ from radixmesh_tpu.policy.topology import (
     encode_view,
     membership_gauges,
 )
-from radixmesh_tpu.utils.logging import get_logger
+from radixmesh_tpu.utils.logging import get_logger, throttled
 from radixmesh_tpu.utils.sync import AtomicCounter
 
 __all__ = ["MeshCache", "RouterMatchResult"]
@@ -235,6 +236,14 @@ class MeshCache:
         # change is adopted; the router uses this to retire/restore hash-
         # ring members. Keep callbacks cheap and non-blocking.
         self.on_view_change: list = []
+        # Fleet telemetry plane (obs/fleet_plane.py): every node — router
+        # included — folds received DIGEST ops into this view; a
+        # FleetPlane (launch.py --fleet-digest-interval) originates this
+        # node's own digests through broadcast_digest().
+        self.fleet = FleetView()
+        # Recent origin→apply replication lag EWMA (the digest's
+        # replication_lag_s field; the histogram keeps the distribution).
+        self.lag_ewma_s = 0.0
         # Per-node label keeps series distinct when several nodes share a
         # process (the inproc test harness runs whole rings in-process).
         reg = get_registry()
@@ -273,6 +282,10 @@ class MeshCache:
         self._m_gc_freed = reg.counter(
             "radixmesh_mesh_gc_freed_slots_total", "KV slots reclaimed by distributed GC", ("node",)
         ).labels(node=node)
+        # Replica evictions by cause (obs/fleet_plane.py registration
+        # point): this node increments ttl (housekeeper sweep) and
+        # mesh_trim (budget trim); engines own capacity/preempt.
+        self._m_evicted = eviction_counters(node)
         self._m_lag = reg.histogram(
             "radixmesh_mesh_oplog_lag_seconds",
             "origin-to-apply replication lag (origin wall clock; skew degrades "
@@ -664,6 +677,10 @@ class MeshCache:
         if op.ts and op.origin_rank != self.rank:
             lag = max(0.0, time.time() - op.ts)
             self._m_lag.observe(lag)
+            # Cheap EWMA for the fleet digest (no lock: a torn float read
+            # costs one sample of staleness, and writes happen only here
+            # on the transport reader thread).
+            self.lag_ewma_s += 0.2 * (lag - self.lag_ewma_s)
             rec = get_recorder()
             if rec.enabled:
                 # Flight-recorder lag span on this node's ring lane,
@@ -704,6 +721,9 @@ class MeshCache:
                 return
             if op.op_type is OplogType.JOIN:
                 self._handle_join(op, data)
+                return
+            if op.op_type is OplogType.DIGEST:
+                self._handle_digest(op, data)
                 return
             if op.origin_rank == self.rank:
                 # Lap complete (radix_mesh.py:401-402). Fire the
@@ -939,6 +959,52 @@ class MeshCache:
             self._announce_view(new_view)
         self._circulate(op, data, control=True)
 
+    # ------------------------------------------------------------------
+    # fleet telemetry (obs/fleet_plane.py)
+    # ------------------------------------------------------------------
+
+    def broadcast_digest(self, digest: NodeDigest) -> None:
+        """Fold this node's own digest locally and ring it as ONE
+        idempotent DIGEST oplog (the fleet plane's per-interval cost).
+        P/D nodes only — routers never send (sync_algo.py:80-96)."""
+        if self.role is NodeRole.ROUTER:
+            raise RuntimeError("router nodes never originate ring traffic")
+        arr = digest.encode()
+        with self._lock:
+            self.fleet.fold(digest)
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.DIGEST,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self._data_ttl(),
+                    value=arr,
+                    value_rank=self.rank,
+                )
+            )
+
+    def _handle_digest(self, op: Oplog, data: bytes) -> None:
+        """Caller holds the lock; ttl already decremented. Folding before
+        forwarding means every hop's fleet view is as fresh as its ring
+        position allows; idempotent re-delivery is a no-op fold."""
+        if op.origin_rank == self.rank:
+            return  # lap complete
+        try:
+            self.fleet.fold(NodeDigest.decode(op.value))
+        except ValueError:
+            if throttled(("bad_digest", self.rank), self.cfg.tick_interval_s):
+                self.log.warning(
+                    "malformed DIGEST payload from rank %d", op.origin_rank
+                )
+        self._circulate(op, data)
+
+    def eviction_totals(self) -> dict[str, int]:
+        """This replica's policy-eviction counters (digest input)."""
+        return {
+            "ttl": int(self._m_evicted["ttl"].value),
+            "mesh_trim": int(self._m_evicted["mesh_trim"].value),
+        }
+
     def _adopt_view(self, view: TopologyView) -> bool:
         """Adopt ``view`` if it supersedes the current one (higher epoch
         wins; equal-epoch conflicts merge by intersection one epoch up —
@@ -1016,6 +1082,11 @@ class MeshCache:
                         ttl=self._data_ttl(),
                     )
                 )
+        # Departed nodes leave the fleet view with the membership: their
+        # last digest must not pin min_score at the stale cap or hold
+        # convergence pairs diverged forever (rejoiners re-fold fresh).
+        self.fleet.retain(self._my_alive() if self.role is not NodeRole.ROUTER
+                          else view.alive)
         self._update_membership_gauges()
         for fn in self.on_view_change:
             try:
@@ -1051,11 +1122,14 @@ class MeshCache:
             dead = self._spine_rank if dest == "spine" else self._succ_rank
             if dead is None:
                 return
-            self.log.warning(
-                "%s successor rank %d unreachable for %.1fs — declaring it "
-                "dead and re-forming the ring",
-                dest, dead, self.cfg.failure_timeout_s,
-            )
+            if throttled(
+                ("succ_dead", self.rank, dest, dead), self.cfg.failure_timeout_s
+            ):
+                self.log.warning(
+                    "%s successor rank %d unreachable for %.1fs — declaring it "
+                    "dead and re-forming the ring",
+                    dest, dead, self.cfg.failure_timeout_s,
+                )
             old = self.view
             new_view = old.without(dead)
             self.view = new_view
@@ -1234,7 +1308,9 @@ class MeshCache:
                     elif comm.try_send(data, self.cfg.failure_timeout_s):
                         break
                 except Exception:  # noqa: BLE001 — transport errors must not kill the sender
-                    if not self._stop.is_set():
+                    if not self._stop.is_set() and throttled(
+                        ("tx_fail", self.rank, dest), self.cfg.failure_timeout_s
+                    ):
                         self.log.exception("failed to transmit oplog")
                     break
                 self._declare_successor_dead(dest)
@@ -1284,7 +1360,10 @@ class MeshCache:
                         if st["established"]
                         else min(1.0, self.cfg.failure_timeout_s)
                     )
-                    if st["established"]:
+                    if st["established"] and throttled(
+                        ("router_down", self.rank, rc.target_address()),
+                        self.cfg.failure_timeout_s,
+                    ):
                         self.log.error(
                             "router %s unreachable; backing off fan-out",
                             rc.target_address(),
@@ -1324,7 +1403,11 @@ class MeshCache:
             return
         excess = self.tree.evictable_size_ + self.tree.protected_size_ - budget
         if excess > 0:
-            self.tree.evict(excess, on_evict=lambda n: self._free_local(n.value))
+            freed = self.tree.evict(
+                excess, on_evict=lambda n: self._free_local(n.value)
+            )
+            if freed:
+                self._m_evicted["mesh_trim"].inc(freed)
 
     def _resolve_conflict(self, child: TreeNode, new_seg):
         """Called by the tree for each matched node whose value differs
@@ -1435,6 +1518,7 @@ class MeshCache:
         ):
             return False
         del node.parent.children[self.tree._child_key(node.key)]
+        self.tree._fp_detach(node)  # direct removal bypasses _remove_node
         self.tree.evictable_size_ -= len(node.key)
         self._free_local(node.value)
         return True
@@ -1532,14 +1616,16 @@ class MeshCache:
             self._stop.wait(self.cfg.tick_interval_s)
             if self._stop.is_set():
                 return
+            self._ttl_sweep()
             now = time.monotonic()
             if now - self._last_rx < timeout or now - self._last_self_join < timeout:
                 continue
             self._last_self_join = now
-            self.log.warning(
-                "no inbound traffic for %.1fs — re-asserting ring membership",
-                now - self._last_rx,
-            )
+            if throttled(("rejoin", self.rank), timeout):
+                self.log.warning(
+                    "no inbound traffic for %.1fs — re-asserting ring membership",
+                    now - self._last_rx,
+                )
             self._broadcast(
                 Oplog(
                     op_type=OplogType.JOIN,
@@ -1548,6 +1634,48 @@ class MeshCache:
                     ttl=self._data_ttl(),
                 )
             )
+
+    def _ttl_sweep(self) -> None:
+        """Expire replica entries untouched for ``mesh_ttl_s`` (0 = off),
+        REPLICATING each expiry as a DELETE (best-effort: peers apply
+        only exact unlocked leaves, like the engine's eviction
+        retraction). Replication keeps the fleet plane's fingerprint
+        audit honest — a local-only sweep would read as permanent
+        divergence on /cluster/health; with it, an entry a peer still
+        serves hot simply re-misses there and re-replicates on its next
+        publish (cache semantics). Freed tokens count under the "ttl"
+        eviction cause so dashboards can tell policy from pressure.
+        (The mesh_max_tokens budget trim stays deliberately local —
+        see _trim_to_budget — so replicas near their size bound CAN
+        report fingerprint divergence until re-publication heals it.)"""
+        ttl = self.cfg.mesh_ttl_s
+        if ttl <= 0:
+            return
+        cutoff = time.monotonic() - ttl
+        expired_keys: list[np.ndarray] = []
+
+        def _expire(node) -> None:
+            expired_keys.append(self._full_key(node))
+            self._free_local(node.value)
+
+        with self._lock:
+            freed = self.tree.evict(
+                self.tree.evictable_size_ or 1,
+                on_evict=_expire,
+                older_than=cutoff,
+            )
+            for key in expired_keys:
+                self._broadcast(
+                    Oplog(
+                        op_type=OplogType.DELETE,
+                        origin_rank=self.rank,
+                        logic_id=self._logic_op.next(),
+                        ttl=self._data_ttl(),
+                        key=key,
+                    )
+                )
+        if freed:
+            self._m_evicted["ttl"].inc(freed)
 
     def _view_tick_origin(self) -> int:
         """Tick origination follows the VIEW, not static config — a dead

@@ -108,6 +108,12 @@ class OplogType(enum.IntEnum):
     # value = [voting group index]). Circulates like data; consumed by
     # the addressee, a no-op everywhere else.
     GC_VOTE = 8
+    # Fleet-telemetry extension (obs/fleet_plane.py): a node's periodic
+    # NodeDigest (cache fill, health signals, tree fingerprint) packed
+    # into ``value`` as an int32 array (value_rank = origin). Idempotent
+    # (receivers fold newest-by-seq) and rides the existing ring frames —
+    # no wire-format change for older op kinds.
+    DIGEST = 9
     TICK = 10
 
 

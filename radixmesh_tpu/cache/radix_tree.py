@@ -41,6 +41,61 @@ __all__ = [
 
 _node_ids = itertools.count()
 
+# ---------------------------------------------------------------------------
+# Tree fingerprint (fleet convergence audit, ``obs/fleet_plane.py``).
+#
+# Every token position present in the tree contributes one well-mixed
+# 64-bit word to an XOR accumulator. The word is a *chained* hash of the
+# whole root→token path (``c_i = c_{i-1}·M + (t_i+1) mod 2^64``, then a
+# splitmix64 finalizer), so two trees have equal fingerprints iff they
+# hold the same SET of token paths — regardless of insert order (XOR is
+# commutative) and regardless of node boundaries (a split just partitions
+# a node's chain array between the two halves; the contribution set is
+# unchanged). Values (slot indices / origin ranks) are deliberately NOT
+# hashed: replicas store different value types per role (PrefillValue vs
+# RouterValue), and the convergence question is "do we cache the same
+# keys", which is exactly what eventual consistency promises.
+#
+# The chain is computed vectorized: with ``Minv = M^-1 mod 2^64``,
+# ``c_i = M^i·(c_0 + Σ_{j<=i}(t_j+1)·M^-j)`` — two cumprods, one cumsum,
+# all wrapping naturally in uint64.
+# ---------------------------------------------------------------------------
+
+_FP_MULT = np.uint64(0x9E3779B97F4A7C15)  # odd → invertible mod 2^64
+_FP_MULT_INV = np.uint64(pow(0x9E3779B97F4A7C15, -1, 1 << 64))
+_FP_SEED = np.uint64(0x243F6A8885A308D3)  # root chain value
+
+
+def _chain_hashes(start: np.uint64, tokens: np.ndarray) -> np.ndarray:
+    """Per-token chain values for ``tokens`` continuing a path whose last
+    chain value is ``start`` (uint64 array, same length as ``tokens``)."""
+    n = len(tokens)
+    if n == 0:
+        return np.empty(0, dtype=np.uint64)
+    t = tokens.astype(np.int64).astype(np.uint64) + np.uint64(1)
+    pw = np.cumprod(np.full(n, _FP_MULT, dtype=np.uint64))
+    pw_inv = np.cumprod(np.full(n, _FP_MULT_INV, dtype=np.uint64))
+    s = np.cumsum(t * pw_inv)
+    return pw * (np.uint64(start) + s)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer, vectorized — decorrelates the polynomial
+    chain values before they meet the XOR accumulator."""
+    x = x.astype(np.uint64, copy=True)
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def _node_contribution(chain: np.ndarray) -> int:
+    if len(chain) == 0:
+        return 0
+    return int(np.bitwise_xor.reduce(_mix64(chain)))
+
 
 def match_len(a: np.ndarray, b: np.ndarray) -> int:
     """Length of the common prefix of two int arrays (vectorized analog of
@@ -103,6 +158,7 @@ class TreeNode:
         "last_access_time",
         "hit_count",
         "block_hashes",
+        "chain",
         "id",
     )
 
@@ -123,6 +179,11 @@ class TreeNode:
         # Chained per-page hashes of the path down to (and including) this
         # node's key, used by the event journal for parent-hash chaining.
         self.block_hashes: tuple[int, ...] | None = None
+        # Per-token chain-hash values of this node's key segment (uint64,
+        # len == len(key)) — the tree-fingerprint contribution source
+        # (see module comment above ``_chain_hashes``). Attached by
+        # ``RadixTree._fp_attach``; empty on the root.
+        self.chain: np.ndarray = np.empty(0, dtype=np.uint64)
         self.id = next(_node_ids)
 
     @property
@@ -269,6 +330,10 @@ class RadixTree:
         self.root.last_access_time = self._time()
         self.evictable_size_ = 0
         self.protected_size_ = 0
+        # Order-independent fingerprint of the SET of token paths in the
+        # tree (see module comment): XOR of every node's per-token mixed
+        # chain hashes, maintained incrementally on insert/delete/evict.
+        self.fingerprint_ = 0
         if self.enable_events:
             self._events.append(AllBlocksCleared())
 
@@ -424,6 +489,7 @@ class RadixTree:
         self,
         num_tokens: int,
         on_evict: Callable[["TreeNode"], None] | None = None,
+        older_than: float | None = None,
     ) -> int:
         """Evict LRU unlocked leaves until ``num_tokens`` device slots are
         freed (reference ``radix_cache.py:179-202,366-377``). Returns slots
@@ -431,14 +497,21 @@ class RadixTree:
         evicted KV is copied to host RAM and the node *stays in the tree*
         host-resident instead of vanishing. ``on_evict`` (mesh replicas,
         whose values are rank-tagged objects rather than slot arrays)
-        receives each evicted node instead of the ``on_free`` slot batch."""
-        return self._evict_impl(num_tokens, writeback=None, on_evict=on_evict)
+        receives each evicted node instead of the ``on_free`` slot batch.
+        ``older_than`` restricts eviction to nodes last touched BEFORE
+        that monotonic instant — the TTL-sweep mode (``mesh_ttl_s``):
+        the LRU heap pops oldest-first, so the sweep stops at the first
+        fresh-enough candidate."""
+        return self._evict_impl(
+            num_tokens, writeback=None, on_evict=on_evict, older_than=older_than
+        )
 
     def _evict_impl(
         self,
         num_tokens: int,
         writeback: Callable[["TreeNode"], bool] | None,
         on_evict: Callable[["TreeNode"], None] | None = None,
+        older_than: float | None = None,
     ) -> int:
         # Candidates are "device leaves": unlocked nodes holding device KV
         # with no device KV anywhere below them (host-resident descendants
@@ -472,6 +545,8 @@ class RadixTree:
         freed_host: list[np.ndarray] = []
         while leaves and freed < num_tokens:
             node = heapq.heappop(leaves)
+            if older_than is not None and node.last_access_time >= older_than:
+                break  # heap pops LRU-first: everything left is fresher
             if node is self.root or node.lock_ref > 0 or node.value is None:
                 continue
             freed += len(node.key)
@@ -524,6 +599,7 @@ class RadixTree:
         stack = [node]
         while stack:
             n = stack.pop()
+            self._fp_detach(n)
             if n.value is not None:
                 self.evictable_size_ -= len(n.key)
             if n.host_value is not None:
@@ -557,6 +633,33 @@ class RadixTree:
             if node.lock_ref > 0:
                 node.lock_ref -= 1
             node = node.parent
+
+    # ---- fingerprint maintenance (obs/fleet_plane.py convergence audit) ----
+
+    @property
+    def fingerprint(self) -> int:
+        """64-bit order-independent digest of the token paths this tree
+        holds. Two replicas that converged on the same key set report the
+        same value; any divergent leaf flips it (w.h.p.)."""
+        return self.fingerprint_
+
+    def _fp_attach(self, node: TreeNode) -> None:
+        """Compute ``node.chain`` from its parent's path and fold the
+        node's contribution into the fingerprint. Called exactly once per
+        node entering the tree (new leaves, checkpoint restore)."""
+        parent = node.parent
+        start = (
+            parent.chain[-1]
+            if parent is not None and len(parent.chain)
+            else _FP_SEED
+        )
+        node.chain = _chain_hashes(start, node.key)
+        self.fingerprint_ ^= _node_contribution(node.chain)
+
+    def _fp_detach(self, node: TreeNode) -> None:
+        """Remove ``node``'s contribution (it is leaving the tree)."""
+        self.fingerprint_ ^= _node_contribution(node.chain)
+        node.chain = np.empty(0, dtype=np.uint64)
 
     # ---- introspection (reference radix_cache.py:172-177,232-248,354-364) ----
 
@@ -620,6 +723,10 @@ class RadixTree:
         node.host_value = (
             None if node.host_value is None else node.host_value[split_len:]
         )
+        # Chain hashes are a pure function of the root path, so a split
+        # partitions them between the halves — zero fingerprint delta.
+        new_node.chain = node.chain[:split_len]
+        node.chain = node.chain[split_len:]
         node.parent = new_node
         if node.block_hashes is not None:
             # Page-chained hashes are a pure function of the root path, so a
@@ -647,6 +754,7 @@ class RadixTree:
                 leaf.last_access_time = self._time()
                 node.children[self._child_key(key)] = leaf
                 self.evictable_size_ += len(key)
+                self._fp_attach(leaf)
                 self._record_store_event(leaf)
                 return total_prefix
             m = self._match(child.key, key)

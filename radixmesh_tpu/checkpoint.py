@@ -194,6 +194,9 @@ def tree_restore(
         node.hit_count = rec["hit_count"]
         parent.children[tree._child_key(node.key)] = node
         tree.evictable_size_ += len(node.key)
+        # Rebuild the convergence fingerprint (parents precede children in
+        # preorder, so each node's chain base is already attached).
+        tree._fp_attach(node)
         if pool is not None and node.value is not None:
             pool.reserve(node.value)
             kv = kv_arrays.get(str(nid))

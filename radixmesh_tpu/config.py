@@ -78,6 +78,17 @@ class MeshConfig:
     # already-dead successor, which must eventually be ringed around).
     # None → max(30s, 3 × failure_timeout_s).
     startup_grace_s: float | None = None
+    # Fleet telemetry plane (obs/fleet_plane.py): how often each ring
+    # node gossips its NodeDigest (tree fingerprint, fill, health
+    # signals) as one oplog frame. 0 disables digest origination;
+    # receive-side folding is always on. launch.py
+    # --fleet-digest-interval overrides.
+    digest_interval_s: float = 0.0
+    # Replica-entry TTL (seconds): mesh-tree entries untouched this long
+    # are swept by the housekeeper (cause "ttl" on the eviction
+    # counters). 0 disables — cache semantics tolerate either choice;
+    # TTL bounds staleness rather than size (mesh_max_tokens does that).
+    mesh_ttl_s: float = 0.0
 
     @property
     def effective_startup_grace_s(self) -> float:

@@ -51,6 +51,7 @@ from radixmesh_tpu.models.llama import (
     prefill_forward,
 )
 from radixmesh_tpu.ops.attention import default_use_kernel
+from radixmesh_tpu.obs.fleet_plane import eviction_counters
 from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
 from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.ops.sampling import sample_tokens, spec_verify_sample
@@ -379,6 +380,13 @@ class Engine:
             ("engine",),
             buckets=TOKEN_LEN_BUCKETS,
         ).labels(**lbl)
+        # Evictions by cause (obs/fleet_plane.py registration point): the
+        # engine owns capacity (admission pressure) and preempt
+        # (mid-decode pressure); the mesh replica owns ttl/mesh_trim.
+        self._m_evicted = eviction_counters(self.name)
+        # Decode step-time EWMA (seconds per token) — the fleet digest's
+        # latency signal; the histogram keeps the full distribution.
+        self._decode_ewma = 0.0
         # Request-flight tracing lane for engine-scope (not per-request)
         # events: evictions, preemption sweeps (obs/trace_plane.py).
         self._trace_lane = f"engine:{self.name}"
@@ -471,6 +479,40 @@ class Engine:
     def has_work(self) -> bool:
         return bool(self.waiting) or any(r is not None for r in self._rows)
 
+    def _note_decode_time(self, per_token_s: float) -> None:
+        """Funnel for every decode-latency sample: the TPOT histogram
+        keeps the distribution; the EWMA is the fleet digest's compact
+        latency signal (obs/fleet_plane.py)."""
+        self._m_tpot.observe(per_token_s)
+        if self._decode_ewma == 0.0:
+            self._decode_ewma = per_token_s
+        else:
+            self._decode_ewma += 0.2 * (per_token_s - self._decode_ewma)
+
+    def telemetry(self) -> dict:
+        """Point-in-time engine signals for the fleet digest
+        (``obs/fleet_plane.py::FleetPlane.build_digest``). Lock-free
+        snapshot reads, same rationale as the /debug endpoints: a wedged
+        engine must still be describable — that is exactly when the
+        stall watchdog needs this data."""
+        rows = sum(1 for r in self._rows if r is not None)
+        host = getattr(self.tree, "host", None)
+        host_fill = 0.0
+        if host is not None and getattr(host, "num_slots", 0):
+            host_fill = 1.0 - host.free_slots / host.num_slots
+        return {
+            "batch_occupancy": rows / max(1, self.max_batch),
+            "waiting": len(self.waiting),
+            "decode_steps": self.stats.decode_steps,
+            "decode_ewma_s": self._decode_ewma,
+            "cache_hit_rate": self.stats.hit_rate,
+            "pool_fill": 1.0 - self.pool.fill_free_fraction(),
+            "host_fill": host_fill,
+            "evictions": {
+                c: int(m.value) for c, m in self._m_evicted.items()
+            },
+        }
+
     def generate(
         self,
         prompts: Iterable[Sequence[int]],
@@ -504,9 +546,12 @@ class Engine:
                 return i
         return -1
 
-    def _alloc_pages(self, n_pages: int) -> np.ndarray | None:
+    def _alloc_pages(self, n_pages: int, cause: str = "capacity") -> np.ndarray | None:
         """Whole-page allocation with evict-under-pressure retry (the
-        reference's evict-then-insert flow, ``radix_cache.py:179-202``)."""
+        reference's evict-then-insert flow, ``radix_cache.py:179-202``).
+        ``cause`` labels any eviction this allocation forces ("capacity"
+        = admission pressure, "preempt" = mid-decode page growth — the
+        storm detector and dashboards tell them apart)."""
         n = n_pages * self.page_size
         slots = self.pool.alloc(n)
         if slots is None:
@@ -520,11 +565,13 @@ class Engine:
                 # trees invoke it just when write-back fails (a written-back
                 # prefix stays servable via restore, so it stays
                 # advertised).
-                self.tree.evict(
+                freed = self.tree.evict(
                     n - self.pool.free_slots, on_evict=self._unadvertise
                 )
             else:
-                self.tree.evict(n - self.pool.free_slots)
+                freed = self.tree.evict(n - self.pool.free_slots)
+            if freed:
+                self._m_evicted[cause].inc(freed)
             slots = self.pool.alloc(n)
             if rec.enabled:
                 rec.event(
@@ -1205,7 +1252,7 @@ class Engine:
                 continue
             page_idx, offset = divmod(req.kv_len, self.page_size)
             if offset == 0:  # crossing into a fresh page
-                new = self._alloc_pages(1)
+                new = self._alloc_pages(1, cause="preempt")
                 if new is None:
                     preempted.append(req)
                     continue
@@ -1267,7 +1314,7 @@ class Engine:
         # dispatch+device time of the step — the per-token latency (TPOT)
         # seen by every active request.
         elapsed = time.monotonic() - step_t0
-        self._m_tpot.observe(elapsed)
+        self._note_decode_time(elapsed)
         for _, req in active:
             tr = req.trace
             if tr is not None:
@@ -1417,7 +1464,7 @@ class Engine:
         self.stats.decode_steps += k
         elapsed = time.monotonic() - step_t0
         for _ in range(k):
-            self._m_tpot.observe(elapsed / k)
+            self._note_decode_time(elapsed / k)
         for _, req in active:
             tr = req.trace
             if tr is not None:
@@ -1535,7 +1582,7 @@ class Engine:
             for p_idx in range(req.kv_len // ps, (req.kv_len + row_extra) // ps + 1):
                 if self._page_table[row, p_idx] != self._scratch_page:
                     continue  # page already provisioned
-                new = self._alloc_pages(1)
+                new = self._alloc_pages(1, cause="preempt")
                 if new is None:
                     preempted.append(req)
                     break
@@ -1643,7 +1690,7 @@ class Engine:
                     break
         elapsed = time.monotonic() - step_t0
         for _ in range(max(emitted_total, 1)):
-            self._m_tpot.observe(elapsed / max(emitted_total, 1))
+            self._note_decode_time(elapsed / max(emitted_total, 1))
         for row, req in active:
             tr = req.trace
             if tr is not None:

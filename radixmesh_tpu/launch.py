@@ -149,8 +149,12 @@ def _run_node(args: argparse.Namespace) -> int:
         tokenizer = load_tokenizer(tok_spec)
 
     frontend = None
+    fleet_plane = None
+    engine = None
     if role is NodeRole.ROUTER:
-        router = CacheAwareRouter(node, cfg)
+        router = CacheAwareRouter(
+            node, cfg, health_aware=args.health_aware_routing
+        )
         router.watch_topology()
         if not args.warm_up:
             router.finish_warm_up()
@@ -188,6 +192,29 @@ def _run_node(args: argparse.Namespace) -> int:
         )
         log.info("serving API on port %d", frontend.port)
 
+    # Fleet telemetry plane: ring nodes gossip a NodeDigest per interval
+    # (serving nodes include engine occupancy/latency; cache-only nodes
+    # publish mesh-only digests). Routers never send — their fleet view
+    # fills from the master's fan-out.
+    digest_interval = (
+        args.fleet_digest_interval
+        if args.fleet_digest_interval is not None
+        else cfg.digest_interval_s
+    )
+    if role is not NodeRole.ROUTER and digest_interval > 0:
+        from radixmesh_tpu.obs.fleet_plane import FleetPlane
+
+        fleet_plane = FleetPlane(
+            node,
+            engine=engine,
+            # The digest's slo_tier field follows the node's overload
+            # controller when one exists (SLO-enabled frontends expose
+            # it as runner.ctl; plain runners have no tier to report).
+            slo=getattr(getattr(frontend, "runner", None), "ctl", None),
+            interval_s=digest_interval,
+        ).start()
+        log.info("fleet digests every %.1fs", digest_interval)
+
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -195,6 +222,8 @@ def _run_node(args: argparse.Namespace) -> int:
         while not stop.is_set():
             stop.wait(1.0)
     finally:
+        if fleet_plane is not None:
+            fleet_plane.close()
         if frontend is not None:
             frontend.close()
         node.close(graceful=True)
@@ -347,6 +376,19 @@ def main(argv: list[str] | None = None) -> int:
         "--warm-up",
         action="store_true",
         help="start the router in warm-up (spread) mode",
+    )
+    node.add_argument(
+        "--fleet-digest-interval", type=float, default=None, metavar="SECONDS",
+        help="gossip this node's fleet NodeDigest (tree fingerprint, fill, "
+        "health signals) every N seconds as one oplog frame "
+        "(obs/fleet_plane.py); overrides the config's digest_interval_s; "
+        "0 disables origination (folding received digests stays on)",
+    )
+    node.add_argument(
+        "--health-aware-routing", action="store_true",
+        help="router role: demote nodes whose gossiped health score drops "
+        "below 0.5 (stall watchdog, replication lag, eviction storm) — "
+        "cache hits shed past them and the hash-ring fallback skips them",
     )
     _add_trace_args(node)
     node.set_defaults(fn=_run_node)

@@ -1,6 +1,13 @@
 """Observability: metrics registry + profiler tracing + the request-flight
-tracing plane (SURVEY §5)."""
+tracing plane (SURVEY §5) + the fleet telemetry plane (gossiped node
+digests, radix-tree convergence audit, health scoring)."""
 
+from radixmesh_tpu.obs.fleet_plane import (
+    FleetConfig,
+    FleetPlane,
+    FleetView,
+    NodeDigest,
+)
 from radixmesh_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -21,6 +28,10 @@ from radixmesh_tpu.obs.trace_plane import (
 from radixmesh_tpu.obs.tracing import annotate, profile, recorded, timed
 
 __all__ = [
+    "FleetConfig",
+    "FleetPlane",
+    "FleetView",
+    "NodeDigest",
     "Counter",
     "Gauge",
     "Histogram",

@@ -89,6 +89,8 @@ class CacheAwareRouter:
         overload_factor: float | None = 3.0,
         overload_floor: float = 8.0,
         load_tau_s: float = 10.0,
+        health_aware: bool = False,
+        health_threshold: float = 0.5,
     ):
         if not config.prefill_nodes or not config.decode_nodes:
             raise ValueError("router needs at least one prefill and one decode node")
@@ -97,6 +99,24 @@ class CacheAwareRouter:
         self._warm_up = True
         self._prefill_ring = ConsistentHash(config.prefill_nodes)
         self._decode_ring = ConsistentHash(config.decode_nodes)
+        # Health-aware demotion (obs/fleet_plane.py; --health-aware-routing):
+        # a node whose gossiped health score drops below the threshold —
+        # stall watchdog fired, replication badly lagged, eviction storm —
+        # is treated as overloaded (cache hits shed past it) AND excluded
+        # from the hash-ring fallback, so the router stops selecting it
+        # until its digests recover. Scores come from the router replica's
+        # FleetView, fed by the ring's DIGEST gossip; unknown ranks score
+        # 1.0, so a booting fleet routes normally.
+        self.health_aware = health_aware
+        self.health_threshold = health_threshold
+        self.fleet = mesh_cache.fleet
+        self._rank_of_addr = {
+            **{a: r for r, a in enumerate(config.prefill_nodes)},
+            **{
+                a: config.num_prefill + r
+                for r, a in enumerate(config.decode_nodes)
+            },
+        }
         # Hot-prefix overload protection (net-new; the reference always
         # follows the cache): when a cache hit points at a node whose
         # estimated in-flight load exceeds ``overload_factor`` x the
@@ -174,7 +194,24 @@ class CacheAwareRouter:
                 self.config.addr_of_rank(rank),
             )
 
-    def _overloaded(self, role: str, addr: str) -> bool:
+    def _sick_addrs(self) -> set[str]:
+        """Addresses currently below the health threshold — the demotion
+        + hash-ring exclusion set. ONE FleetView.health computation per
+        route call (per-address health_score lookups would rebuild the
+        whole O(nodes) dict per candidate on the request hot path)."""
+        if not self.health_aware:
+            return set()
+        sick = self.fleet.sick_ranks(self.health_threshold)
+        if not sick:
+            return set()
+        return {a for a, r in self._rank_of_addr.items() if r in sick}
+
+    def _overloaded(self, role: str, addr: str, sick: set[str]) -> bool:
+        # Health demotion first: a stalled node must shed even when its
+        # load estimate looks light (a wedged engine stops completing,
+        # so decayed load is exactly the number that lies here).
+        if addr in sick:
+            return True
         if self.overload_factor is None:
             return False
         with self._alive_lock:
@@ -224,25 +261,37 @@ class CacheAwareRouter:
             )
 
         p_out = d_out = None
+        sick = self._sick_addrs()
         if match.prefill_rank >= 0:
             prefill_addr = self.config.prefill_addr(match.prefill_rank)
             p_hit = True
-            if self._overloaded("prefill", prefill_addr):
-                shed = self._prefill_ring.get_node(key, exclude={prefill_addr})
+            if self._overloaded("prefill", prefill_addr, sick):
+                shed = self._prefill_ring.get_node(
+                    key, exclude={prefill_addr} | sick
+                )
                 if shed is not None:
                     prefill_addr, p_hit, p_out = shed, False, "shed"
         else:
-            prefill_addr = self._prefill_ring.get_node(key)
+            # Cache miss: hash-ring fallback, skipping health-demoted
+            # nodes. If EVERY node of the role is sick, route anyway
+            # (degraded service beats no service) — sickness is advisory.
+            prefill_addr = self._prefill_ring.get_node(
+                key, exclude=sick or None
+            ) or self._prefill_ring.get_node(key)
             p_hit = False
         if match.decode_rank >= 0:
             decode_addr = self.config.decode_addr(match.decode_rank)
             d_hit = True
-            if self._overloaded("decode", decode_addr):
-                shed = self._decode_ring.get_node(key, exclude={decode_addr})
+            if self._overloaded("decode", decode_addr, sick):
+                shed = self._decode_ring.get_node(
+                    key, exclude={decode_addr} | sick
+                )
                 if shed is not None:
                     decode_addr, d_hit, d_out = shed, False, "shed"
         else:
-            decode_addr = self._decode_ring.get_node(key)
+            decode_addr = self._decode_ring.get_node(
+                key, exclude=sick or None
+            ) or self._decode_ring.get_node(key)
             d_hit = False
         if prefill_addr is not None:
             self._loads.note(prefill_addr)

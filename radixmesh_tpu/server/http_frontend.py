@@ -228,6 +228,33 @@ def _membership_state(mesh) -> dict:
     }
 
 
+def _cluster_telemetry(mesh) -> dict:
+    """``GET /cluster/telemetry``: every gossiped NodeDigest plus the
+    pairwise fingerprint-convergence audit (``obs/fleet_plane.py``).
+    Shared by both frontends so fleet tooling can scrape any node."""
+    if mesh is None:
+        return {"nodes": {}, "note": "no cache mesh attached to this node"}
+    snap = mesh.fleet.snapshot()
+    snap["self"] = _membership_state(mesh)
+    return snap
+
+
+def _cluster_health(mesh) -> dict:
+    """``GET /cluster/health``: per-node 0..1 health scores with the
+    detector reasons that capped them, plus the fleet-wide convergence
+    summary — the page an operator (or a probe) reads first."""
+    if mesh is None:
+        return {"nodes": {}, "note": "no cache mesh attached to this node"}
+    health = mesh.fleet.health()
+    scores = [h["score"] for h in health.values()]
+    return {
+        "nodes": {str(r): h for r, h in sorted(health.items())},
+        "min_score": min(scores, default=1.0),
+        "convergence": mesh.fleet.convergence(),
+        "self": _membership_state(mesh),
+    }
+
+
 def _debug_trace_response(handler: BaseHTTPRequestHandler) -> None:
     """Serve the flight recorder as Chrome trace-event JSON. Read-only by
     default — a GET must not destroy the post-mortem a later reader (or
@@ -395,6 +422,15 @@ class ServingFrontend:
                     _json_response(self, 200, frontend._debug_requests())
                 elif self.path == "/debug/state":
                     _json_response(self, 200, frontend._debug_state())
+                elif self.path == "/cluster/telemetry":
+                    _json_response(
+                        self, 200,
+                        _cluster_telemetry(frontend.runner.engine.mesh),
+                    )
+                elif self.path == "/cluster/health":
+                    _json_response(
+                        self, 200, _cluster_health(frontend.runner.engine.mesh)
+                    )
                 else:
                     _json_response(self, 404, {"error": "not found"})
 
@@ -706,6 +742,15 @@ class RouterFrontend:
                     )
                 elif self.path == "/debug/state":
                     _json_response(self, 200, frontend._debug_state())
+                elif self.path == "/cluster/telemetry":
+                    _json_response(
+                        self, 200,
+                        _cluster_telemetry(frontend.router.mesh_cache),
+                    )
+                elif self.path == "/cluster/health":
+                    _json_response(
+                        self, 200, _cluster_health(frontend.router.mesh_cache)
+                    )
                 else:
                     _json_response(self, 404, {"error": "not found"})
 

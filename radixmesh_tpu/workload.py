@@ -28,6 +28,7 @@ __all__ = [
     "OverloadWorkload",
     "TextMultiTurnWorkload",
     "run_engine_workload",
+    "run_fleet_churn_workload",
     "run_overload_workload",
     "synth_text",
 ]
@@ -418,3 +419,261 @@ def run_overload_workload(
         "p99_ttft_s": float(np.quantile(ttft, 0.99)) if ttft else 0.0,
         "elapsed_s": elapsed,
     }
+
+
+class _StallableStats:
+    """Engine stand-in for fleet-bench stall injection: reports a full
+    batch whose ``decode_steps`` counter advances only while healthy —
+    exactly the signature the stall watchdog keys on, without needing a
+    (jax-heavy) real engine to actually wedge."""
+
+    def __init__(self):
+        self.healthy = True
+        self._steps = 0
+
+    def telemetry(self) -> dict:
+        if self.healthy:
+            self._steps += 7
+        return {
+            "batch_occupancy": 1.0,
+            "waiting": 3,
+            "decode_steps": self._steps,
+            "decode_ewma_s": 0.01,
+            "cache_hit_rate": 0.5,
+            "pool_fill": 0.5,
+            "host_fill": 0.0,
+            "evictions": {},
+        }
+
+
+def run_fleet_churn_workload(
+    n_inserts: int = 120,
+    key_len: int = 24,
+    fan_in_rounds: int = 5,
+    digest_interval_s: float = 0.1,
+    seed: int = 0,
+    timeout_s: float = 20.0,
+    health_threshold: float = 0.5,
+) -> dict:
+    """Drive the fleet telemetry plane (``obs/fleet_plane.py``) through
+    its three claims on an in-proc 2-prefill + 1-decode + router mesh and
+    measure each:
+
+    1. **Digest fan-in** — per publish round, seconds from the slowest
+       node's origination until every node (router included) holds all
+       three fresh digests.
+    2. **Convergence audit under churn** — seeded multi-writer inserts
+       while digests gossip; the max pairwise ``convergence_age_seconds``
+       observed during churn, and the time from quiescence to all four
+       replicas reporting one fingerprint. Then an injected divergence
+       (a key applied to ONE replica only — a stand-in partition): the
+       age must rise while diverged and return to ~0 after the heal.
+    3. **Health reaction** — a stall injected into one node's telemetry
+       (batch full, decode frozen); seconds until the router's fleet
+       view scores it below ``health_threshold``, and whether a
+       health-aware router actually stops selecting it.
+
+    Transport-light by design (no jax, no sockets): the phenomena under
+    test live in the gossip/fold/score layer, which is identical over
+    the inproc hub and TCP."""
+    import time as _time
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.comm.inproc import InprocHub
+    from radixmesh_tpu.config import MeshConfig, NodeRole
+    from radixmesh_tpu.obs.fleet_plane import FleetPlane
+    from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+    def wait_for(pred, timeout=timeout_s, interval=0.005):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(interval)
+        return pred()
+
+    rng = np.random.default_rng(seed)
+    t_start = _time.monotonic()
+    InprocHub.reset_default()
+    prefill, decode, router = ["fp0", "fp1"], ["fd0"], ["fr0"]
+    nodes: list = []
+    for addr in prefill + decode + router:
+        cfg = MeshConfig(
+            prefill_nodes=prefill,
+            decode_nodes=decode,
+            router_nodes=router,
+            local_addr=addr,
+            protocol="inproc",
+            tick_interval_s=0.05,
+            gc_interval_s=30.0,
+        )
+        nodes.append(MeshCache(cfg, pool=None).start())
+    planes = []
+    try:
+        for n in nodes:
+            if not n.wait_ready(timeout=timeout_s):
+                raise RuntimeError(f"node {n.rank} never passed the barrier")
+        ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+        router_mesh = nodes[-1]
+        stall = _StallableStats()
+        planes = [
+            FleetPlane(
+                n,
+                engine=stall if i == 1 else None,
+                interval_s=digest_interval_s,
+            )
+            for i, n in enumerate(ring)
+        ]
+        digest_bytes = max(p.build_digest().encoded_size() for p in planes)
+
+        # -- 1. digest fan-in ------------------------------------------
+        fan_in: list[float] = []
+        for _ in range(fan_in_rounds):
+            want = {}
+            t0 = _time.monotonic()
+            for p in planes:
+                want[p.mesh.rank] = p.publish_once().seq
+            assert wait_for(
+                lambda: all(
+                    (d := n.fleet.digests()).keys() >= want.keys()
+                    and all(d[r].seq >= s for r, s in want.items())
+                    for n in nodes
+                )
+            ), "digest fan-in never completed"
+            fan_in.append(_time.monotonic() - t0)
+
+        # -- 2. convergence under churn --------------------------------
+        churn_t0 = _time.monotonic()
+        max_age_churn = 0.0
+        for i in range(n_inserts):
+            writer = ring[int(rng.integers(0, len(ring)))]
+            key = rng.integers(0, 512, size=key_len).astype(np.int32)
+            writer.insert(key, np.arange(key_len, dtype=np.int32))
+            if i % 10 == 0:
+                for p in planes:
+                    p.publish_once()
+                max_age_churn = max(
+                    max_age_churn,
+                    router_mesh.fleet.convergence()["max_convergence_age_s"],
+                )
+        churn_s = _time.monotonic() - churn_t0
+        quiesce_t0 = _time.monotonic()
+
+        def _converged() -> bool:
+            for p in planes:
+                p.publish_once()
+            fps = {n.tree.fingerprint_ for n in nodes}
+            return (
+                len(fps) == 1
+                and router_mesh.fleet.convergence()["converged"]
+            )
+
+        converged = wait_for(_converged, interval=digest_interval_s)
+        quiesce_s = _time.monotonic() - quiesce_t0
+
+        # Injected divergence: one replica learns a key the others never
+        # see (partition stand-in); heal by replicating it for real.
+        rogue = ring[0]
+        key = rng.integers(600, 900, size=key_len).astype(np.int32)
+        idx = np.arange(key_len, dtype=np.int32)
+        from radixmesh_tpu.cache.mesh_values import PrefillValue
+
+        with rogue._lock:
+            rogue._mesh_insert(key, PrefillValue(idx, rogue.rank))
+        for p in planes:
+            p.publish_once()
+        diverged = wait_for(
+            lambda: not router_mesh.fleet.convergence()["converged"]
+        )
+        age_t0 = _time.monotonic()
+        _time.sleep(3 * digest_interval_s)
+        for p in planes:
+            p.publish_once()
+        age_while_diverged = router_mesh.fleet.convergence()[
+            "max_convergence_age_s"
+        ]
+        rogue.insert(key, idx)  # heal: replicate the divergent key
+        healed = wait_for(_converged, interval=digest_interval_s)
+        heal_s = _time.monotonic() - age_t0
+
+        # -- 3. stall injection + health-aware demotion ----------------
+        sick = planes[1].mesh  # the plane wired to the stallable stats
+        cr = CacheAwareRouter(
+            router_mesh,
+            router_mesh.cfg,
+            health_aware=True,
+            health_threshold=health_threshold,
+        )
+        cr.finish_warm_up()
+        planes[1].publish_once()  # healthy baseline digest
+        stall.healthy = False
+        stall_t0 = _time.monotonic()
+
+        def _scored_sick() -> bool:
+            planes[1].publish_once()
+            return (
+                router_mesh.fleet.health_score(sick.rank) < health_threshold
+            )
+
+        reacted = wait_for(_scored_sick, interval=digest_interval_s)
+        reaction_s = _time.monotonic() - stall_t0
+        sick_addr = sick.cfg.addr_of_rank(sick.rank)
+        routed = {
+            cr.cache_aware_route(
+                rng.integers(0, 512, size=8).astype(np.int32)
+            ).prefill_addr
+            for _ in range(32)
+        }
+        demoted = reacted and sick_addr not in routed
+
+        # Frame discipline: each DIGEST origination is exactly one ring
+        # frame, and the router receives each exactly once (master
+        # fan-out) — ratio ~1.0 proves one-frame-per-interval-per-node.
+        from radixmesh_tpu.cache.oplog import OplogType
+
+        total_published = sum(p.published for p in planes)
+        router_digests = int(
+            router_mesh._m_received[OplogType.DIGEST].value
+        )
+        frames_per_publish = router_digests / max(1, total_published)
+
+        return {
+            "nodes": len(nodes),
+            "topology": "2 prefill + 1 decode + 1 router (inproc)",
+            "digest_interval_s": digest_interval_s,
+            "digest_bytes": int(digest_bytes),
+            "fan_in": {
+                "rounds": fan_in_rounds,
+                "p50_s": float(np.median(fan_in)),
+                "max_s": float(max(fan_in)),
+            },
+            "convergence": {
+                "inserts": n_inserts,
+                "writers": len(ring),
+                "churn_s": round(churn_s, 3),
+                "max_age_during_churn_s": round(max_age_churn, 3),
+                "quiesce_to_converged_s": round(quiesce_s, 3),
+                "converged": bool(converged),
+                "injected_divergence_detected": bool(diverged),
+                "age_while_diverged_s": round(age_while_diverged, 3),
+                "healed": bool(healed),
+                "heal_s": round(heal_s, 3),
+            },
+            "stall_reaction": {
+                "injected": True,
+                "detected": bool(reacted),
+                "reaction_s": round(reaction_s, 3),
+                "score_after": router_mesh.fleet.health_score(sick.rank),
+                "threshold": health_threshold,
+            },
+            "health_aware_demotion": bool(demoted),
+            "digests_published": total_published,
+            "digest_frames_per_publish": round(frames_per_publish, 3),
+            "wall_s": round(_time.monotonic() - t_start, 3),
+        }
+    finally:
+        for p in planes:
+            p.close()
+        for n in nodes:
+            n.close()
+        InprocHub.reset_default()

@@ -1,0 +1,66 @@
+"""Standalone fleet-telemetry bench (the FLEET artifact's paired CLI
+emitter, like ``scripts/ringbench.py`` is for RINGBENCH).
+
+Runs ``workload.run_fleet_churn_workload`` — digest fan-in over the
+oplog ring, fingerprint convergence under multi-writer churn and an
+injected divergence, and health-score reaction to an injected decode
+stall — on an in-proc 2-prefill + 1-decode + router mesh, then prints
+ONE JSON line validated against the schema ``bench.validate_fleet``
+pins. No jax, no sockets: the gossip/fold/score layer under test is
+transport-independent.
+
+Usage::
+
+    python scripts/fleetbench.py [--inserts 120] [--interval 0.1] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+from radixmesh_tpu.workload import run_fleet_churn_workload  # noqa: E402
+
+
+def run(
+    inserts: int, interval_s: float, fan_in_rounds: int, seed: int
+) -> dict:
+    res = run_fleet_churn_workload(
+        n_inserts=inserts,
+        digest_interval_s=interval_s,
+        fan_in_rounds=fan_in_rounds,
+        seed=seed,
+    )
+    report = bench.build_fleet_report(res)
+    problems = bench.validate_fleet(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="fleetbench")
+    ap.add_argument("--inserts", type=int, default=120)
+    ap.add_argument("--interval", type=float, default=0.1)
+    ap.add_argument("--fan-in-rounds", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    args = ap.parse_args()
+    report = run(args.inserts, args.interval, args.fan_in_rounds, args.seed)
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

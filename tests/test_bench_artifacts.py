@@ -158,3 +158,84 @@ class TestTraceArtifactSchema:
         assert bench.validate_trace({"x": 1}) == [
             "traceEvents missing or not a list"
         ]
+
+
+class TestFleetArtifactSchema:
+    """The FLEET artifact (fleet telemetry plane, PR 3) stays machine-
+    comparable across rounds: pinned top/section fields, the digest byte
+    budget respected, and the one-frame-per-publish piggyback contract."""
+
+    def _report(self) -> dict:
+        return {
+            "schema_version": bench.FLEET_SCHEMA_VERSION,
+            "metric": "fleet_digest_fan_in_p50_s",
+            "value": 0.006,
+            "unit": "s (one digest round visible on every node incl. router)",
+            "workload": "120 inserts over 3 writers + injected divergence "
+                        "+ injected stall (inproc ring)",
+            "nodes": 4,
+            "topology": "2 prefill + 1 decode + 1 router (inproc)",
+            "digest_interval_s": 0.1,
+            "digest_bytes": 112,
+            "digest_byte_budget": 160,
+            "fan_in": {"rounds": 5, "p50_s": 0.006, "max_s": 0.009},
+            "convergence": {
+                "inserts": 120, "writers": 3, "churn_s": 0.05,
+                "max_age_during_churn_s": 0.02,
+                "quiesce_to_converged_s": 0.1, "converged": True,
+                "injected_divergence_detected": True,
+                "age_while_diverged_s": 0.16, "healed": True, "heal_s": 0.3,
+            },
+            "stall_reaction": {
+                "injected": True, "detected": True, "reaction_s": 0.05,
+                "score_after": 0.0, "threshold": 0.5,
+            },
+            "health_aware_demotion": True,
+            "digests_published": 54,
+            "digest_frames_per_publish": 0.98,
+            "wall_s": 0.5,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_fleet(self._report()) == []
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["health_aware_demotion"]
+        del report["convergence"]["heal_s"]
+        del report["stall_reaction"]["reaction_s"]
+        missing = bench.validate_fleet(report)
+        assert "health_aware_demotion" in missing
+        assert "convergence.heal_s" in missing
+        assert "stall_reaction.reaction_s" in missing
+
+    def test_budget_and_frame_contracts_enforced(self):
+        report = self._report()
+        report["digest_bytes"] = 900  # over the pinned budget
+        report["digest_frames_per_publish"] = 1.4  # piggyback broken
+        problems = "\n".join(bench.validate_fleet(report))
+        assert "exceeds digest_byte_budget" in problems
+        assert "piggyback contract" in problems
+        assert bench.validate_fleet([1]) == ["artifact is not a JSON object"]
+
+    def test_emitter_output_matches_schema(self):
+        """The workload's real output assembled by build_fleet_report
+        passes the validator — emitter and schema cannot drift."""
+        res = {
+            "nodes": 4,
+            "topology": "2 prefill + 1 decode + 1 router (inproc)",
+            "digest_interval_s": 0.1,
+            "digest_bytes": 112,
+            "fan_in": self._report()["fan_in"],
+            "convergence": self._report()["convergence"],
+            "stall_reaction": self._report()["stall_reaction"],
+            "health_aware_demotion": True,
+            "digests_published": 54,
+            "digest_frames_per_publish": 0.98,
+            "wall_s": 0.5,
+        }
+        report = bench.build_fleet_report(res)
+        assert bench.validate_fleet(report) == []
+        from radixmesh_tpu.obs.fleet_plane import DIGEST_BYTE_BUDGET
+
+        assert report["digest_byte_budget"] == DIGEST_BYTE_BUDGET

@@ -207,6 +207,96 @@ class TestOrderPermutation:
                     pos += 1
 
 
+class TestFingerprintConvergence:
+    """The fleet-plane convergence audit's core claim, checked at the
+    ``_mesh_insert`` layer: the same op MULTISET in any delivery order
+    yields the same tree fingerprint on every replica (conflict
+    resolution swaps values, never keys — and the fingerprint digests
+    the key set); a replica that misses one op fingerprints differently."""
+
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_permuted_delivery_equal_fingerprints(self, seed):
+        rng = np.random.default_rng(seed)
+        ops = random_ops(rng, n_ops=40, n_writers=3)
+        fps = set()
+        for _ in range(5):
+            node = make_unwired_node()
+            with node._lock:
+                for j in rng.permutation(len(ops)):
+                    key, rank, indices = ops[j]
+                    node._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+            fps.add(node.tree.fingerprint_)
+        assert len(fps) == 1
+        assert fps.pop() != 0
+
+    def test_missing_one_op_diverges_and_redelivery_heals(self):
+        rng = np.random.default_rng(9)
+        ops = random_ops(rng, n_ops=30, n_writers=3)
+        full = make_unwired_node()
+        partial = make_unwired_node(rank=1)
+        # The dropped op must carry a token path no other op covers, or
+        # the fingerprint (a key-SET digest) legitimately matches.
+        dropped_key = np.array([77, 78, 79], np.int32)
+        dropped = (dropped_key, 0, np.arange(3, dtype=np.int32))
+        with full._lock, partial._lock:
+            for key, rank, indices in ops + [dropped]:
+                full._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+            for key, rank, indices in ops:
+                partial._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+        assert full.tree.fingerprint_ != partial.tree.fingerprint_
+        # Late (re)delivery of the missing op heals the divergence.
+        with partial._lock:
+            key, rank, indices = dropped
+            partial._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+        assert full.tree.fingerprint_ == partial.tree.fingerprint_
+
+    def test_replicated_delete_keeps_fingerprints_equal(self):
+        """DELETE removes leaves through a direct-detach path (not
+        _remove_node) — the fingerprint must follow on every replica,
+        and land exactly on a tree that never saw the key."""
+        k1 = np.arange(8, dtype=np.int32)
+        k2 = np.arange(50, 58, dtype=np.int32)
+        a, b, never = (
+            make_unwired_node(0), make_unwired_node(1), make_unwired_node(2)
+        )
+        for n in (a, b):
+            with n._lock:
+                n._mesh_insert(k1.copy(), PrefillValue(np.arange(8, dtype=np.int32), 0))
+                n._mesh_insert(k2.copy(), PrefillValue(np.arange(8, dtype=np.int32), 0))
+        with never._lock:
+            never._mesh_insert(k1.copy(), PrefillValue(np.arange(8, dtype=np.int32), 0))
+        with a._lock:
+            assert a._apply_delete(k2)
+        assert a.tree.fingerprint_ != b.tree.fingerprint_
+        with b._lock:
+            assert b._apply_delete(k2)
+        assert a.tree.fingerprint_ == b.tree.fingerprint_
+        assert a.tree.fingerprint_ == never.tree.fingerprint_
+
+    def test_router_replica_fingerprint_matches_pd(self):
+        """Router replicas store RouterValues, not slot arrays — the
+        fingerprint must still compare equal (it digests keys only)."""
+        from radixmesh_tpu.cache.mesh_values import RouterValue
+
+        rng = np.random.default_rng(21)
+        ops = random_ops(rng, n_ops=25, n_writers=2)
+        pd = make_unwired_node()
+        router = MeshCache(
+            MeshConfig(
+                prefill_nodes=["p0", "p1", "p2"],
+                decode_nodes=["d0"],
+                router_nodes=["r0"],
+                local_addr="r0",
+                protocol="inproc",
+            )
+        )
+        with pd._lock, router._lock:
+            for key, rank, indices in ops:
+                pd._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+                router._mesh_insert(key.copy(), RouterValue(rank, len(key)))
+        assert pd.tree.fingerprint_ == router.tree.fingerprint_
+
+
 class TestDupSlotSafety:
     """The dup-GC slot ledger under granularity drift.
 

@@ -616,3 +616,87 @@ class TestDebugEndpoints:
             for n in nodes:
                 n.close()
             InprocHub.reset_default()
+
+
+@pytest.mark.quick
+class TestClusterEndpoints:
+    """Fleet telemetry surfaces (PR 3): /cluster/health and
+    /cluster/telemetry on BOTH frontends."""
+
+    def test_serving_frontend_without_mesh(self, frontend):
+        for path in ("/cluster/health", "/cluster/telemetry"):
+            status, body = _get(f"http://127.0.0.1:{frontend.port}{path}")
+            assert status == 200
+            out = json.loads(body)
+            assert out["nodes"] == {} and "note" in out
+
+    def test_router_frontend_serves_fleet_view(self):
+        import time
+
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.comm.inproc import InprocHub
+        from radixmesh_tpu.config import MeshConfig, NodeRole
+        from radixmesh_tpu.obs.fleet_plane import FleetPlane
+        from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+        InprocHub.reset_default()
+        prefill, decode, router = ["p0"], ["d0"], ["r0"]
+        nodes = []
+        try:
+            for addr in prefill + decode + router:
+                cfg = MeshConfig(
+                    prefill_nodes=prefill,
+                    decode_nodes=decode,
+                    router_nodes=router,
+                    local_addr=addr,
+                    protocol="inproc",
+                    tick_interval_s=0.05,
+                    gc_interval_s=30.0,
+                )
+                nodes.append(MeshCache(cfg, pool=None).start())
+            for n in nodes:
+                assert n.wait_ready(timeout=10)
+            planes = [
+                FleetPlane(n, interval_s=0.1)
+                for n in nodes
+                if n.role is not NodeRole.ROUTER
+            ]
+            for p in planes:
+                p.publish_once()
+            rnode = nodes[2]
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(rnode.fleet.digests()) == 2:
+                    break
+                time.sleep(0.01)
+            car = CacheAwareRouter(rnode, rnode.cfg, health_aware=True)
+            car.finish_warm_up()
+            f = RouterFrontend(car, port=0)
+            try:
+                status, body = _get(
+                    f"http://127.0.0.1:{f.port}/cluster/telemetry"
+                )
+                assert status == 200
+                tel = json.loads(body)
+                assert set(tel["nodes"]) == {"0", "1"}
+                assert tel["convergence"]["converged"] is True
+                d = tel["nodes"]["0"]
+                assert d["role"] == "prefill"
+                assert len(d["fingerprint"]) == 16  # hex-encoded 64-bit
+                assert tel["self"]["role"] == "router"
+
+                status, body = _get(
+                    f"http://127.0.0.1:{f.port}/cluster/health"
+                )
+                assert status == 200
+                health = json.loads(body)
+                assert health["min_score"] == 1.0
+                assert set(health["nodes"]) == {"0", "1"}
+                assert health["nodes"]["0"]["score"] == 1.0
+                assert health["convergence"]["max_convergence_age_s"] == 0.0
+            finally:
+                f.close()
+        finally:
+            for n in nodes:
+                n.close()
+            InprocHub.reset_default()

@@ -115,3 +115,22 @@ class TestMetricHygiene:
         # epoch 0, both ring members alive.
         assert snap['radixmesh_mesh_alive_nodes{node="prefill@0"}'] == 2.0
         assert snap['radixmesh_mesh_view_epoch{node="prefill@0"}'] == 0.0
+
+    def test_eviction_counters_labeled_by_cause(self):
+        """Satellite (PR 3): eviction counters carry a cause label —
+        capacity/preempt (pressure) vs ttl/mesh_trim (policy) — and all
+        four children exist from construction so dashboards and the
+        eviction-storm detector never see series gaps."""
+        from radixmesh_tpu.obs.fleet_plane import EVICTION_CAUSES
+
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        assert fams.get("radixmesh_cache_evicted_tokens_total") == "counter"
+        snap = get_registry().snapshot()
+        for node in ("lint", "prefill@0"):
+            for cause in EVICTION_CAUSES:
+                key = (
+                    'radixmesh_cache_evicted_tokens_total'
+                    f'{{cause="{cause}",node="{node}"}}'
+                )
+                assert key in snap, (key, sorted(snap))

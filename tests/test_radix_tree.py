@@ -270,3 +270,107 @@ class TestEventsAndReset:
         tree.insert([1, 2], np.array([10, 11], dtype=np.int32))
         tree.insert([5], np.array([30], dtype=np.int32))
         assert sorted(tree.all_values_flatten().tolist()) == [10, 11, 30]
+
+
+class TestFingerprint:
+    """Order-independent tree fingerprint (the fleet convergence audit's
+    foundation, ``obs/fleet_plane.py``): equal key SETS must fingerprint
+    equal regardless of insert order or node-split structure; any
+    divergent leaf must flip it."""
+
+    def _random_ops(self, rng, n):
+        chains = [
+            rng.integers(0, 6, size=rng.integers(3, 10)).astype(np.int32)
+            for _ in range(3)
+        ]
+        ops = []
+        for _ in range(n):
+            chain = chains[rng.integers(0, len(chains))]
+            key = chain[: rng.integers(1, len(chain) + 1)].copy()
+            if rng.random() < 0.4:
+                key = np.concatenate(
+                    [key, rng.integers(6, 12, size=rng.integers(1, 4)).astype(np.int32)]
+                )
+            ops.append(key)
+        return ops
+
+    def test_any_permutation_same_fingerprint(self):
+        """Property: every permutation of the same insert sequence on two
+        trees yields equal fingerprints (XOR commutes; chains are pure
+        path functions)."""
+        rng = np.random.default_rng(7)
+        for trial in range(8):
+            ops = self._random_ops(rng, 20)
+            ref = make_tree()
+            for key in ops:
+                ref.insert(key, np.arange(len(key), dtype=np.int32))
+            for _ in range(3):
+                perm = [ops[i] for i in rng.permutation(len(ops))]
+                t = make_tree()
+                for key in perm:
+                    t.insert(key, np.arange(len(key), dtype=np.int32))
+                assert t.fingerprint == ref.fingerprint, f"trial {trial}"
+            assert ref.fingerprint != 0
+
+    def test_single_divergent_leaf_differs(self):
+        rng = np.random.default_rng(11)
+        ops = self._random_ops(rng, 15)
+        a, b = make_tree(), make_tree()
+        for key in ops:
+            a.insert(key, np.arange(len(key), dtype=np.int32))
+            b.insert(key, np.arange(len(key), dtype=np.int32))
+        assert a.fingerprint == b.fingerprint
+        b.insert(np.array([99, 98, 97], dtype=np.int32), ids(3))
+        assert a.fingerprint != b.fingerprint
+
+    def test_match_split_does_not_change_fingerprint(self):
+        """match_prefix's in-place node splits change structure but not
+        the key set — the fingerprint must be structure-blind."""
+        t = make_tree()
+        t.insert(ids(10), ids(10))
+        before = t.fingerprint
+        t.match_prefix(ids(4))  # splits the 10-node at 4
+        assert t.fingerprint == before
+        t.insert(ids(7), ids(7))  # fully-contained prefix: no new tokens
+        assert t.fingerprint == before
+
+    def test_evict_and_delete_remove_contribution(self):
+        t = make_tree()
+        t.insert(ids(8), ids(8))
+        empty_after_insert = t.fingerprint
+        t.insert(ids(8, start=100), ids(8))
+        t.evict(8)  # LRU: the first insert goes
+        assert t.fingerprint != empty_after_insert
+        t.evict(8)  # the second goes too
+        assert t.fingerprint == 0
+        # Re-inserting the same keys restores the exact fingerprint.
+        t.insert(ids(8), ids(8))
+        assert t.fingerprint == empty_after_insert
+
+    def test_reset_zeroes(self):
+        t = make_tree()
+        t.insert(ids(6), ids(6))
+        assert t.fingerprint != 0
+        t.reset()
+        assert t.fingerprint == 0
+
+    def test_paged_tree_fingerprints_compare(self):
+        a, b = make_tree(page_size=4), make_tree(page_size=4)
+        a.insert(ids(8), ids(8))
+        b.insert(ids(8), ids(8))
+        assert a.fingerprint == b.fingerprint
+        b.insert(ids(8, start=50), ids(8))
+        assert a.fingerprint != b.fingerprint
+
+    def test_older_than_evicts_only_stale(self):
+        """TTL-sweep mode: only nodes last touched before the cutoff go."""
+        t = make_tree()  # injected clock ticks 1.0 per call
+        t.insert(ids(4), ids(4))
+        t.insert(ids(4, start=100), ids(4))
+        # Touch the second key so it is fresher than the cutoff.
+        t.match_prefix(ids(4, start=100))
+        cutoff = t.root.children[100].last_access_time
+        freed = t.evict(10**9, older_than=cutoff)
+        assert freed == 4
+        assert t.match_prefix(ids(4)).length == 0
+        assert t.match_prefix(ids(4, start=100)).length == 4
