@@ -269,6 +269,127 @@ def _fleet_pass() -> dict:
     return report
 
 
+# ----------------------------------------------------------------------
+# KVFLOW stable schema (PR 4, async KV-movement plane): one artifact per
+# round recording restore-stall vs overlapped TTFT, write-back gather
+# fusion, and prefetch hit-ahead rate (radixmesh_tpu/cache/kv_transfer.py
+# + workload.run_kvflow_workload). Bump the version ONLY when adding
+# fields (never remove or rename).
+# ----------------------------------------------------------------------
+
+KVFLOW_SCHEMA_VERSION = 1
+
+KVFLOW_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload",
+    "restore", "writeback", "prefetch", "chunk_tokens",
+    "ttft_chunk_tokens", "page_size", "wall_s",
+)
+KVFLOW_RESTORE_FIELDS = (
+    "requests", "repeats", "sync_ttft_s", "overlapped_ttft_s",
+    "overlap_ratio", "overlap_wins", "sync_ttft_trials_s",
+    "overlapped_ttft_trials_s", "sync_restore_ttft_s",
+    "overlapped_restore_ttft_s", "sync_fresh_ttft_s",
+    "overlapped_fresh_ttft_s", "restored_tokens", "parked_requests",
+    "decode_steps_during_restore", "sync_decode_steps_during_restore",
+    "max_decode_gap_s", "sync_max_decode_gap_s",
+)
+KVFLOW_WRITEBACK_FIELDS = (
+    "tokens_written_back", "sweeps", "gathers", "gathers_per_sweep",
+    "sync_gathers_per_sweep", "evict_stall_s", "sync_evict_stall_s",
+)
+KVFLOW_PREFETCH_FIELDS = ("hints_sent", "hints_joined", "hit_ahead_rate")
+
+
+def validate_kvflow(report) -> list[str]:
+    """Schema violations of a KVFLOW artifact vs the pinned contract
+    (empty = valid): all top/section fields present, plus the two
+    deterministic structural contracts — write-back gathers fused to at
+    most one per eviction sweep, and decode progress strictly greater
+    than the synchronous path's zero while a restore is in flight. The
+    TTFT comparison is REPORTED (``overlap_wins``), not schema-gated:
+    on CPU it measures scheduling structure against ms-scale noise.
+    Import-safe from artifact tests (no jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in KVFLOW_TOP_FIELDS if f not in report]
+    for section, fields in (
+        ("restore", KVFLOW_RESTORE_FIELDS),
+        ("writeback", KVFLOW_WRITEBACK_FIELDS),
+        ("prefetch", KVFLOW_PREFETCH_FIELDS),
+    ):
+        sec = report.get(section)
+        if isinstance(sec, dict):
+            problems += [f"{section}.{f}" for f in fields if f not in sec]
+    wb = report.get("writeback")
+    if isinstance(wb, dict):
+        for key in ("gathers_per_sweep", "sync_gathers_per_sweep"):
+            g = wb.get(key)
+            if isinstance(g, (int, float)) and g > 1.0 + 1e-9:
+                problems.append(
+                    f"writeback.{key} {g} > 1 (fused-gather contract)"
+                )
+    rs = report.get("restore")
+    if isinstance(rs, dict):
+        a = rs.get("decode_steps_during_restore")
+        s = rs.get("sync_decode_steps_during_restore")
+        if isinstance(a, (int, float)) and isinstance(s, (int, float)):
+            if not a > s:
+                problems.append(
+                    f"restore.decode_steps_during_restore {a} must exceed "
+                    f"the synchronous path's {s} (decode-never-blocks "
+                    "contract)"
+                )
+    return problems
+
+
+def build_kvflow_report(res: dict) -> dict:
+    """Assemble a schema-complete KVFLOW artifact from
+    ``workload.run_kvflow_workload``'s result."""
+    rs = res.get("restore", {})
+    return {
+        "schema_version": KVFLOW_SCHEMA_VERSION,
+        "metric": "kv_restore_overlapped_ttft_ratio",
+        "value": rs.get("overlap_ratio"),
+        "unit": "overlapped/sync mean TTFT of a mixed restore+fresh burst "
+        "(<= 1: staging restores off the scheduling thread stops fresh "
+        "admissions convoying behind inline KV copies)",
+        "workload": (
+            f"{rs.get('requests', 0)} host-tier restore requests "
+            f"interleaved with {rs.get('requests', 0)} fresh requests x "
+            f"{rs.get('repeats', 0)} interleaved trials + background-"
+            "decode overlap phase + prefetch hit-ahead phase (CPU-sized "
+            "engine; see workload.run_kvflow_workload)"
+        ),
+        **res,
+    }
+
+
+def _kvflow_pass() -> dict:
+    """The KV-movement bench: run the kvflow workload and write the
+    round's ``KVFLOW_r{N}.json`` (validated against the pinned schema
+    before writing — a violation is recorded in the artifact, not
+    silently shipped)."""
+    from radixmesh_tpu.workload import run_kvflow_workload
+
+    res = run_kvflow_workload()
+    report = build_kvflow_report(res)
+    problems = validate_kvflow(report)
+    if problems:
+        report["schema_violation"] = problems
+        log(f"kvflow pass: SCHEMA VIOLATION {problems}")
+    path = os.path.join(_REPO, f"KVFLOW_r{current_round():02d}.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1)
+    log(
+        f"kvflow pass: wrote {os.path.basename(path)} "
+        f"(overlap_ratio={report['value']}, "
+        f"overlap_wins={report['restore']['overlap_wins']}, "
+        f"hit_ahead={report['prefetch']['hit_ahead_rate']})"
+    )
+    report["artifact"] = os.path.basename(path)
+    return report
+
+
 def _error_json(msg: str) -> str:
     return json.dumps({
         "metric": "decode_tokens_per_sec_per_chip",
@@ -1403,6 +1524,11 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — partial rounds must survive
         log(f"fleet pass: FAILED {type(exc).__name__}: {exc}")
         fleet = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    try:
+        kvflow = _kvflow_pass()
+    except Exception as exc:  # noqa: BLE001 — partial rounds must survive
+        log(f"kvflow pass: FAILED {type(exc).__name__}: {exc}")
+        kvflow = {"error": f"{type(exc).__name__}: {exc}"[:400]}
 
     print(json.dumps({
         "metric": "decode_tokens_per_sec_per_chip",
@@ -1432,6 +1558,7 @@ def main() -> None:
         "llama3_8b_int8": m8b,
         "slo_overload": slo,
         "fleet": fleet,
+        "kvflow": kvflow,
     }))
 
 

@@ -152,6 +152,17 @@ class HierarchicalCache(RadixTree):
         self.pool = pool
         self.host = host_store
         self.log = get_logger("hicache")
+        # Async KV-movement plane (cache/kv_transfer.py). None = every
+        # copy is synchronous (the seed behavior, still the test
+        # default); the owning engine installs a plane to move arena
+        # reads/writes off its scheduling thread.
+        self.plane = None
+        # Current eviction sweep's write-back batch [(node, dev_slots,
+        # host_slots)] + lifetime sweep/gather counters (the fused-gather
+        # contract KVFLOW pins: gathers/sweep <= 1).
+        self._wb_batch: list[tuple[TreeNode, np.ndarray, np.ndarray]] = []
+        self.wb_sweeps = 0
+        self.wb_gathers = 0
         reg = get_registry()
         self._m_backup = reg.counter(
             "radixmesh_hicache_backup_tokens_total", "tokens written back HBM → host RAM"
@@ -183,14 +194,36 @@ class HierarchicalCache(RadixTree):
         the host tier could NOT absorb (arena full → KV destroyed) — the
         hook owns their slot release and any external retraction (e.g. a
         mesh advertisement); written-back nodes stay matchable and
-        advertised."""
-        return self._evict_impl(
-            num_tokens, writeback=self._writeback, on_evict=on_evict
-        )
+        advertised.
+
+        Write-back is SWEEP-BATCHED: each node only reserves arena slots
+        during the sweep, and the whole sweep pays ONE fused device
+        gather at the end (``_flush_writeback_batch``) instead of one
+        per node — O(1) device syncs per sweep rather than O(nodes),
+        whether or not the async plane is installed. Safe because the
+        sweep's freed device slots cannot be reallocated (and hence
+        overwritten) before this same engine-thread call returns."""
+        self._wb_batch = []
+        try:
+            freed = self._evict_impl(
+                num_tokens, writeback=self._writeback, on_evict=on_evict
+            )
+        finally:
+            self._flush_writeback_batch()
+        return freed
+
+    def evict_no_writeback(self, num_tokens: int) -> int:
+        """Plain-drop eviction (no host write-back): the staged-restore
+        allocator's room-maker — writing back here could free the very
+        host slots an in-flight restore is reading (the same hazard the
+        synchronous path's restore loop documents)."""
+        return self._evict_impl(num_tokens, writeback=None)
 
     def _writeback(self, node: TreeNode) -> bool:
-        """Copy ``node``'s device KV into the host tier. Returns False (→
-        plain eviction) only if the host arena can't make room."""
+        """Reserve arena room for ``node`` and record it in the sweep
+        batch (the data moves in ``_flush_writeback_batch``). Returns
+        False (→ plain eviction) only if the host arena can't make
+        room."""
         if node.host_value is not None:
             return True  # already backed up: re-eviction is free
         slots = np.asarray(node.value, dtype=np.int32)
@@ -201,14 +234,36 @@ class HierarchicalCache(RadixTree):
             if host_slots is None:
                 return False
         host_slots = host_slots[: len(slots)]
-        self.host.write(host_slots, *gather_padded(self.pool, slots))
         node.host_value = host_slots
+        self._wb_batch.append((node, slots, host_slots))
         self._m_backup.inc(len(slots))
         return True
+
+    def _flush_writeback_batch(self) -> None:
+        """One fused device→host copy for the whole eviction sweep.
+        Duplicate host-slot ids are possible when ``_evict_host`` dropped
+        a just-written-back node mid-sweep and its slots were re-reserved
+        — numpy's last-write-wins assignment resolves them in batch
+        order, and the dropped node is out of the tree, so nobody reads
+        its stale mapping."""
+        batch, self._wb_batch = self._wb_batch, []
+        if not batch:
+            return
+        self.wb_sweeps += 1
+        self.wb_gathers += 1
+        all_slots = np.concatenate([s for _, s, _ in batch])
+        all_host = np.concatenate([h for _, _, h in batch])
+        if self.plane is not None:
+            # Gather dispatched here (engine thread, against the current
+            # pool buffer); materialization + arena write on the worker.
+            self.plane.submit_writeback(self.pool, self.host, all_slots, all_host)
+        else:
+            self.host.write(all_host, *gather_padded(self.pool, all_slots))
 
     def _evict_host(self, num_tokens: int) -> int:
         """LRU-drop host-ONLY nodes (never nodes that still hold device KV
         — their host copy is just a free re-eviction) to make arena room."""
+        plane = self.plane
         candidates = [
             n
             for n in self._all_nodes()
@@ -217,6 +272,10 @@ class HierarchicalCache(RadixTree):
             and n.host_value is not None
             and n.lock_ref == 0
             and not n.children  # leaves only: keep paths connected
+            # A node mid-restore must keep its arena slots until the
+            # staged copy lands (the plane's pending map is the host-tier
+            # analog of lock_ref).
+            and (plane is None or not plane.is_pending(n))
         ]
         heapq.heapify(candidates)
         freed = 0
@@ -239,17 +298,45 @@ class HierarchicalCache(RadixTree):
             self.host.free(np.concatenate(freed_host))
         return freed
 
+    def _drop_poisoned_host(self, node: TreeNode) -> None:
+        """Retire a host copy whose write-back never landed (plane
+        worker failure): free the arena slots and leave the node
+        host-empty — structurally valid (``match_prefix`` stops at a
+        no-tier node) and strictly safer than serving unwritten bytes."""
+        self.log.warning(
+            "dropping %d-token host copy whose write-back failed",
+            len(node.host_value),
+        )
+        self.host.free(np.asarray(node.host_value, dtype=np.int32))
+        node.host_value = None
+
     # ---- host → device restore ----
 
-    def match_and_load(self, key) -> MatchResult:
+    def match_and_load(self, key, match: MatchResult | None = None) -> MatchResult:
         """``match_prefix`` + restore: if the match extends into the host
         tier, allocate device slots, copy the host KV back into the pool,
         and reinstate each node's device value — the returned result's
         ``values``/``last_node`` then cover the full two-tier hit. Nodes
         that can't be restored (device pool exhausted even after eviction)
-        stay host-resident; the hit is simply shorter."""
-        res = self.match_prefix(key)
+        stay host-resident; the hit is simply shorter.
+
+        ``match`` may carry a just-computed splitting ``match_prefix``
+        result to skip the second walk — ONLY valid if the tree has not
+        been mutated since (same engine thread, no evictions between)."""
+        res = self.match_prefix(key) if match is None else match
         if not res.host_nodes:
+            return res
+        if self.plane is not None and not self.plane.wait_host_ready():
+            # Read barrier for the synchronous fallback: arena writes for
+            # this sweep's write-backs may still be on the plane worker.
+            # (The staged restore path gets this ordering for free from
+            # the worker's FIFO + write-back priority.) A failed/timed-out
+            # barrier means the arena bytes cannot be trusted — serve the
+            # shorter device-only hit instead of restoring garbage.
+            self.log.warning(
+                "host-tier read barrier failed; skipping restore of a "
+                "%d-token host extension", res.host_length,
+            )
             return res
         stall_t0 = time.monotonic()
         # Lock the device prefix while restoring: the room-making evictions
@@ -266,6 +353,15 @@ class HierarchicalCache(RadixTree):
             for node in res.host_nodes:
                 if node.host_value is None or node.value is not None:
                     break  # raced/partial (shouldn't happen single-threaded)
+                if self.plane is not None and not self.plane.host_slots_ok(
+                    node.host_value
+                ):
+                    # This node's write-back failed on the worker: the
+                    # arena bytes were never written. Drop the host copy
+                    # (the prefix degrades to a recompute) instead of
+                    # restoring garbage.
+                    self._drop_poisoned_host(node)
+                    break
                 n = len(node.host_value)
                 partial = False
                 dev = self.pool.alloc(n)

@@ -185,6 +185,58 @@ def _scatter_kv_raw(
     )
 
 
+# Layer-ranged scatters for the staged disagg handoff (engine/disagg.py
+# + cache/kv_transfer.py): a handoff packet staged per layer-block can
+# land block-by-block, so the pool update for block 0 overlaps block 1's
+# host→device transfer instead of waiting for the whole packet. ``layer0``
+# is static — one compile per (block shape, position), bounded by
+# L / block variants.
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+def _scatter_kv_layers(
+    kv: jax.Array,  # [2, L, H, S, D]
+    slots: jax.Array,  # [n]
+    new_kv: jax.Array,  # head-major [2, nL, H, n, D]
+    layer0: int,
+) -> jax.Array:
+    return kv.at[:, layer0 : layer0 + new_kv.shape[1], :, slots].set(new_kv)
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5,))
+def _scatter_kv_raw_layers(
+    kv: jax.Array,  # int8 [2, L, H, S, D]
+    kv_scale: jax.Array,  # f32 [2, L, H, S]
+    slots: jax.Array,  # [n]
+    new_kv: jax.Array,  # int8 head-major [2, nL, H, n, D]
+    new_scale: jax.Array,  # f32 head-major [2, nL, H, n]
+    layer0: int,
+):
+    nl = new_kv.shape[1]
+    return (
+        kv.at[:, layer0 : layer0 + nl, :, slots].set(new_kv),
+        kv_scale.at[:, layer0 : layer0 + nl, :, slots].set(new_scale),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4,))
+def _scatter_kv_quant_layers(
+    kv: jax.Array,  # int8 [2, L, H, S, D]
+    kv_scale: jax.Array,  # f32 [2, L, H, S]
+    slots: jax.Array,  # [n]
+    new_kv: jax.Array,  # float head-major [2, nL, H, n, D]
+    layer0: int,
+):
+    from radixmesh_tpu.ops.quant import quantize_kv
+
+    q, scale = quantize_kv(new_kv, axis=-1)
+    nl = new_kv.shape[1]
+    return (
+        kv.at[:, layer0 : layer0 + nl, :, slots].set(q),
+        kv_scale.at[:, layer0 : layer0 + nl, :, slots].set(scale),
+    )
+
+
 @jax.jit
 def _gather_kv_dequant(
     kv: jax.Array, kv_scale: jax.Array, slots: jax.Array
@@ -348,6 +400,60 @@ class PagedKVPool:
         self.kv, self.kv_scale = _scatter_kv_raw(
             self.kv, self.kv_scale, jnp.asarray(slots, jnp.int32), kv, scales
         )
+
+    def write_block(
+        self,
+        slots: np.ndarray,
+        kv,
+        layer0: int = 0,
+        scales=None,
+    ) -> None:
+        """Store a token-major ``[2, nL, n, H, D]`` block covering layers
+        ``[layer0, layer0 + nL)`` at ``slots`` — the staged-handoff write
+        (``engine/disagg.py``): layer-blocked packets land block-by-block
+        so early blocks' scatters overlap later blocks' transfers.
+
+        Dtype dispatch mirrors the full-layer writers: ``scales`` given →
+        raw quantized store (quantized pools only); quantized pool
+        without scales → quantize-on-store; plain pool → cast + store.
+        Full-layer blocks delegate to the existing writers so the common
+        whole-packet path adds no new compile variants."""
+        slots = np.asarray(slots, dtype=np.int32)
+        n = len(slots)
+        if n == 0:
+            return
+        nl = kv.shape[1]
+        full = layer0 == 0 and nl == self.num_layers
+        if full:
+            if scales is not None:
+                self.write_raw(slots, kv, scales)
+            else:
+                kv = jnp.asarray(kv)
+                self.write(slots, kv[0], kv[1])
+            return
+        if scales is not None and self.quant is None:
+            raise ValueError("raw quantized blocks target quantized pools")
+        arrays = [jnp.asarray(kv, self.dtype if scales is not None else None)]
+        axes = [2]
+        if scales is not None:
+            arrays.append(jnp.asarray(scales, jnp.float32))
+            axes.append(2)
+        slots, arrays = _pad_to_bucket(slots, arrays, axes)
+        sl = jnp.asarray(slots, dtype=jnp.int32)
+        new_kv = arrays[0].transpose(0, 1, 3, 2, 4)  # token- → head-major
+        if scales is not None:
+            self.kv, self.kv_scale = _scatter_kv_raw_layers(
+                self.kv, self.kv_scale, sl, new_kv,
+                arrays[1].transpose(0, 1, 3, 2), layer0,
+            )
+        elif self.quant is not None:
+            self.kv, self.kv_scale = _scatter_kv_quant_layers(
+                self.kv, self.kv_scale, sl, new_kv, layer0
+            )
+        else:
+            self.kv = _scatter_kv_layers(
+                self.kv, sl, new_kv.astype(self.dtype), layer0
+            )
 
     def gather(self, slots: np.ndarray | jax.Array) -> jax.Array:
         """Gather ``[2, L, n, kv_heads, head_dim]`` for the given slots,

@@ -236,6 +236,14 @@ class MeshCache:
         # change is adopted; the router uses this to retire/restore hash-
         # ring members. Keep callbacks cheap and non-blocking.
         self.on_view_change: list = []
+        # Predictive-restore hints (cache/kv_transfer.py): a received
+        # PREFETCH oplog addressed to this node is funneled here (set to
+        # the serving engine's ``plane.note_hint`` by launch.py). Must be
+        # cheap + non-blocking — it runs on the transport reader thread.
+        self.on_prefetch = None
+        # Router-originated hints go over dedicated fire-and-forget
+        # channels (routers never send on the ring, sync_algo.py:80-96).
+        self._prefetch_comms: dict[int, Communicator] = {}
         # Fleet telemetry plane (obs/fleet_plane.py): every node — router
         # included — folds received DIGEST ops into this view; a
         # FleetPlane (launch.py --fleet-digest-interval) originates this
@@ -266,6 +274,11 @@ class MeshCache:
         self._m_dropped = reg.counter(
             "radixmesh_mesh_oplogs_dropped_total",
             "oplogs dropped on outbound-queue overflow",
+            ("node",),
+        ).labels(node=node)
+        self._m_prefetch_sent = reg.counter(
+            "radixmesh_mesh_prefetch_sent_total",
+            "PREFETCH restore hints originated by this node",
             ("node",),
         ).labels(node=node)
         self._m_bridged = reg.counter(
@@ -523,6 +536,8 @@ class MeshCache:
             self._spine_comm.close()
         for c in self._router_comms:
             c.close()
+        for c in self._prefetch_comms.values():
+            c.close()
 
     # ------------------------------------------------------------------
     # public cache API
@@ -670,7 +685,9 @@ class MeshCache:
     def oplog_received(self, data: bytes) -> None:
         """Transport callback (reference ``radix_mesh.py:391-420``)."""
         op = deserialize(data)
-        self._m_received[op.op_type].inc()
+        counter = self._m_received.get(op.op_type)
+        if counter is not None:
+            counter.inc()
         # Don't record lag for our own returning oplogs: that sample would
         # be a full ring lap (the systematically largest value) with no
         # apply behind it, inflating p99 for operators alerting on lag.
@@ -702,6 +719,26 @@ class MeshCache:
         self._last_rx = time.monotonic()
         with self._lock:
             op.ttl -= 1
+            if not isinstance(op.op_type, OplogType):
+                # A newer peer's op kind (deserialize kept the raw int):
+                # not ours to interpret — forward so the rest of the ring
+                # (which may understand it) still sees it, and move on.
+                # This tolerance ships WITH PREFETCH: nodes from this
+                # build on coexist with senders of future kinds; builds
+                # predating it raise on unknown kinds, so new-kind
+                # emission follows the finish-the-roll discipline.
+                if throttled(("unknown_op", self.rank, int(op.op_type)),
+                             self.cfg.tick_interval_s):
+                    self.log.warning(
+                        "ignoring unknown oplog kind %d from rank %d",
+                        int(op.op_type), op.origin_rank,
+                    )
+                if op.origin_rank != self.rank:
+                    self._circulate(op, data)
+                return
+            if op.op_type is OplogType.PREFETCH:
+                self._handle_prefetch(op, data)
+                return
             if op.op_type is OplogType.TICK:
                 # Counted before the origin-drop so the originator observes
                 # its own tick completing each lap (radix_mesh.py:356-360).
@@ -997,6 +1034,103 @@ class MeshCache:
                     "malformed DIGEST payload from rank %d", op.origin_rank
                 )
         self._circulate(op, data)
+
+    # ------------------------------------------------------------------
+    # predictive restore hints (cache/kv_transfer.py)
+    # ------------------------------------------------------------------
+
+    def send_prefetch(self, key, target_rank: int) -> bool:
+        """Fire a PREFETCH hint at ``target_rank``: "requests for this
+        prefix are heading your way — if it's host-tier, start restoring
+        now". Best-effort by contract: the hint may be dropped at any
+        point (queue overflow, dead channel, unknown kind on an older
+        peer) and the receiver treats duplicates as no-ops, so there is
+        nothing to retry and no acknowledgement. P/D origins ride the
+        ring like any oplog; ROUTER origins — which never send on the
+        ring — use a dedicated fire-and-forget channel to the target's
+        cache address. Returns whether the hint was handed to a
+        transport."""
+        key = as_key(key)
+        if len(key) == 0:
+            return False
+        op = Oplog(
+            op_type=OplogType.PREFETCH,
+            origin_rank=self.rank,
+            logic_id=self._logic_op.next(),
+            # Direct router hints are addressed point-to-point: one hop.
+            ttl=1 if self.role is NodeRole.ROUTER else self._data_ttl(),
+            key=key,
+            value_rank=target_rank,
+            ts=time.time(),
+        )
+        if self.role is not NodeRole.ROUTER:
+            with self._lock:
+                self._broadcast(op)
+            self._m_prefetch_sent.inc()
+            return True
+        comm = self._prefetch_channel(target_rank)
+        if comm is None:
+            return False
+        try:
+            ok = bool(comm.try_send(serialize(op), 0.05))
+        except Exception:  # noqa: BLE001 — hints are droppable by contract
+            ok = False
+        if ok:
+            self._m_prefetch_sent.inc()
+        return ok
+
+    def _prefetch_channel(self, target_rank: int) -> Communicator | None:
+        """Lazily-opened send-only channel to a P/D node's cache address
+        (router role only — the same pattern as the master's router
+        fan-out channels, pointed the other way). The dial happens
+        OUTSIDE the mesh lock: the transport reader thread needs that
+        lock to apply oplogs, and a slow first connection must not stall
+        ring processing (a racing duplicate dial just closes the loser)."""
+        if not 0 <= target_rank < self.cfg.num_ring:
+            return None
+        with self._lock:
+            comm = self._prefetch_comms.get(target_rank)
+        if comm is not None:
+            return comm
+        try:
+            comm = create_communicator(
+                self.cfg.protocol,
+                None,
+                self.cfg.addr_of_rank(target_rank),
+                self.cfg.max_msg_bytes,
+            )
+        except Exception:  # noqa: BLE001
+            self.log.exception(
+                "prefetch channel to rank %d failed", target_rank
+            )
+            return None
+        with self._lock:
+            existing = self._prefetch_comms.setdefault(target_rank, comm)
+        if existing is not comm:
+            comm.close()
+        return existing
+
+    def _handle_prefetch(self, op: Oplog, data: bytes) -> None:
+        """Caller holds the lock; ttl already decremented. The hint sink
+        (``on_prefetch`` → the engine plane's bounded queue) must stay
+        cheap: this runs on the transport reader thread. The tree here is
+        the MESH replica — hints never touch it; only the serving
+        engine's hierarchical tree acts on them, at its next pump."""
+        if op.origin_rank == self.rank:
+            return  # lap complete
+        addressed_here = op.value_rank in (-1, self.rank)
+        if (
+            addressed_here
+            and self.role is not NodeRole.ROUTER
+            and self.on_prefetch is not None
+        ):
+            try:
+                self.on_prefetch(op.key)
+            except Exception:  # noqa: BLE001 — a sink bug must not kill the reader
+                self.log.exception("prefetch sink failed")
+        if op.value_rank != self.rank:
+            # Not (exclusively) ours: keep it moving toward its target.
+            self._circulate(op, data)
 
     def eviction_totals(self) -> dict[str, int]:
         """This replica's policy-eviction counters (digest input)."""

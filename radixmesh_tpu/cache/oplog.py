@@ -115,6 +115,19 @@ class OplogType(enum.IntEnum):
     # no wire-format change for older op kinds.
     DIGEST = 9
     TICK = 10
+    # KV-movement extension (cache/kv_transfer.py): a fire-and-forget
+    # restore hint — "node ``value_rank``: requests for ``key`` are
+    # heading your way; if that prefix is host-tier, start restoring it
+    # now". Semantics are strictly advisory: idempotent (in-flight
+    # restores are joined, completed ones no-op), droppable at any hop,
+    # and NEVER mutates tree structure on the receiver (read-only match,
+    # no splits, no evictions). Receivers carrying ``deserialize``'s
+    # unknown-kind tolerance (added alongside this kind) ignore FUTURE
+    # kinds without error; builds that predate the tolerance raise on
+    # any unknown kind, so enable hint emission only after the whole
+    # fleet carries it (the same finish-the-roll discipline as the v3
+    # wire features above).
+    PREFETCH = 11
 
 
 @dataclass
@@ -139,9 +152,15 @@ class GCEntry:
 @dataclass
 class Oplog:
     """One replicated tree operation (reference ``CacheOplog``,
-    ``cache_oplog.py:48-56``)."""
+    ``cache_oplog.py:48-56``).
 
-    op_type: OplogType
+    ``op_type`` stays a raw ``int`` when the frame carries a kind this
+    build doesn't know (a newer peer's extension op): receivers forward
+    such frames untouched and otherwise ignore them — the forward-compat
+    contract that let PREFETCH (and DIGEST before it) ride the existing
+    ring without a wire break."""
+
+    op_type: OplogType | int
     origin_rank: int  # node that created the oplog
     logic_id: int  # per-origin monotonic id (radix_mesh.py:431-433)
     ttl: int  # remaining ring hops
@@ -375,8 +394,12 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
         ek = np.frombuffer(buf, dtype=np.int32, count=eklen, offset=off).copy()
         off += 4 * eklen
         gc.append(GCEntry(key=ek, value_rank=vrank, agree=agree))
+    try:
+        op_type = OplogType(op_type)
+    except ValueError:
+        pass  # a newer peer's op kind: keep the raw int (see Oplog docs)
     return Oplog(
-        op_type=OplogType(op_type),
+        op_type=op_type,
         origin_rank=origin,
         logic_id=logic,
         ttl=ttl,

@@ -89,6 +89,18 @@ class MeshConfig:
     # counters). 0 disables — cache semantics tolerate either choice;
     # TTL bounds staleness rather than size (mesh_max_tokens does that).
     mesh_ttl_s: float = 0.0
+    # Async KV-movement plane (cache/kv_transfer.py): serving nodes
+    # stage host-tier restores / eviction write-backs / disagg handoff
+    # placement off the scheduling thread. Off = the synchronous seed
+    # behavior. launch.py --kv-transfer-async overrides.
+    kv_transfer_async: bool = False
+    # Restore staging granularity (tokens per chunk): smaller chunks
+    # interleave with decode more finely at more dispatch overhead.
+    kv_transfer_chunk_tokens: int = 512
+    # Restores shorter than this take the synchronous in-admission path
+    # (parking a tiny restore costs more than it hides). 0 = always
+    # staged when the plane is on.
+    kv_transfer_min_restore_tokens: int = 0
 
     @property
     def effective_startup_grace_s(self) -> float:
@@ -264,6 +276,9 @@ def load_config(path: str) -> MeshConfig:
         "tick_interval_s",
         "failure_timeout_s",
         "startup_grace_s",
+        "kv_transfer_async",
+        "kv_transfer_chunk_tokens",
+        "kv_transfer_min_restore_tokens",
         "model",
         "mesh_axes",
         "serve_port_offset",
@@ -293,6 +308,11 @@ def load_config(path: str) -> MeshConfig:
             None
             if raw.get("startup_grace_s") is None
             else float(raw["startup_grace_s"])
+        ),
+        kv_transfer_async=bool(raw.get("kv_transfer_async", False)),
+        kv_transfer_chunk_tokens=int(raw.get("kv_transfer_chunk_tokens", 512)),
+        kv_transfer_min_restore_tokens=int(
+            raw.get("kv_transfer_min_restore_tokens", 0)
         ),
         model=dict(raw.get("model", {})),
         mesh_axes=dict(raw.get("mesh_axes", {})),

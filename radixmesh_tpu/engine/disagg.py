@@ -81,6 +81,33 @@ class HandoffPacket:
     # a traced request's timeline spans BOTH nodes or neither — never an
     # orphan half (trace ids themselves stay node-local).
     traced: bool = False
+    # Streamed handoff (cache/kv_transfer.py handoff lane): when
+    # ``chunk_of`` > 0 this packet carries tokens
+    # ``prompt[kv_start : kv_start + kv.shape[2])`` of a ``chunk_of``-way
+    # split — the prefill side transmits completed chunks while later
+    # gathers are still materializing, and the decode side stages each
+    # chunk onto its devices as it lands (placement overlaps the rest of
+    # the wire receive). ``chunk_seq`` orders them; the request admits
+    # when all ``chunk_of`` chunks have arrived.
+    chunk_seq: int = -1
+    chunk_of: int = 0
+
+
+@dataclass
+class _StagedKV:
+    """One staged block of handoff KV: covers layers
+    ``[layer0, layer0 + kv.shape[1])`` and prompt tokens
+    ``[tok0, tok0 + kv.shape[2])``. Whole legacy packets are the
+    degenerate single block."""
+
+    kv: object  # np.ndarray | jax.Array, token-major [2, nL, n, H, D]
+    scale: object | None  # [2, nL, n, H] when quantized
+    layer0: int
+    tok0: int
+
+    @property
+    def n_tokens(self) -> int:
+        return int(self.kv.shape[2])
 
 
 class PrefillWorker(Engine):
@@ -144,6 +171,87 @@ class PrefillWorker(Engine):
         self._release(req)
         return pkt
 
+    def prefill_handoff_stream(
+        self,
+        prompt: Sequence[int],
+        sampling: SamplingParams | None = None,
+        *,
+        send,
+        chunk_tokens: int = 1024,
+        skip_prefix: int = 0,
+        plane=None,
+    ) -> int:
+        """Prefill ``prompt`` and STREAM its handoff as ``chunk_of``
+        chunked packets through ``send(bytes)`` instead of one monolithic
+        packet: each chunk's device gather is dispatched here (engine
+        thread) and its materialization + pack + send run on the KV
+        plane's handoff lane (``plane.submit_task``) — so chunk i's wire
+        transmit overlaps chunk i+1's device→host gather, and the decode
+        side starts placing early chunks while late ones are still in
+        flight. With ``plane=None`` the pipeline degrades to an inline
+        loop (same packets, no overlap). Returns the number of chunks
+        sent/queued."""
+        req = self.add_request(prompt, sampling)
+        self._admit()
+        if req.state is not RequestState.RUNNING:
+            self.waiting.remove(req)
+            raise RuntimeError("prefill pool exhausted; could not admit request")
+        tr = req.trace
+        n = len(req.token_slots)
+        spans = [
+            (lo, min(n, lo + chunk_tokens))
+            for lo in range(skip_prefix, n, chunk_tokens)
+        ]
+        if not spans:
+            # Fully tail-skipped handoff: the receiver caches the whole
+            # prompt. Ship ONE empty-KV chunk — the request (and its
+            # first token) must still arrive, exactly like the
+            # monolithic path's empty-KV packet.
+            spans = [(skip_prefix, skip_prefix)]
+        chunk_of = len(spans)
+        for seq, (lo, hi) in enumerate(spans):
+            t_pack = time.monotonic()
+            # Gather dispatched against the CURRENT pool buffer — the
+            # release below only returns slots to the allocator; nothing
+            # rewrites them before a later engine-thread scatter, which
+            # the device sequences after this gather.
+            kv, kv_scale = self.pool.gather_raw(req.token_slots[lo:hi])
+
+            def _ship(kv=kv, kv_scale=kv_scale, lo=lo, seq=seq, t0=t_pack):
+                pkt = HandoffPacket(
+                    prompt=req.prompt,
+                    first_token=req.output_tokens[0],
+                    kv=np.asarray(kv),
+                    sampling=req.sampling,
+                    rid=req.rid,
+                    submit_time=req.submit_time,
+                    first_token_time=req.first_token_time,
+                    kv_start=lo,
+                    kv_scale=None if kv_scale is None else np.asarray(kv_scale),
+                    traced=req.trace is not None,
+                    chunk_seq=seq,
+                    chunk_of=chunk_of,
+                )
+                send(pack_handoff(pkt))
+                if plane is not None:
+                    plane.note_handoff(
+                        pkt.kv.shape[2], self.pool, time.monotonic() - t0
+                    )
+
+            if plane is not None:
+                plane.submit_task(_ship)
+            else:
+                _ship()
+        if tr is not None:
+            tr.add(
+                "disagg_handoff_pack", time.monotonic(), 0.0, cat="disagg",
+                kv_tokens=int(n - skip_prefix), skip_prefix=int(skip_prefix),
+                chunks=chunk_of, streamed=True,
+            )
+        req.state = RequestState.FINISHED
+        self._release(req)
+        return chunk_of
+
 
 class DecodeWorker:
     """A DECODE-role node: receives handoff packets (directly or via a
@@ -155,12 +263,35 @@ class DecodeWorker:
     them on the scheduler thread.
     """
 
-    def __init__(self, engine: Engine, comm: Communicator | None = None):
+    def __init__(
+        self,
+        engine: Engine,
+        comm: Communicator | None = None,
+        plane=None,
+        stage_layers: int = 0,
+    ):
         self.engine = engine
         self.log = get_logger("disagg.decode")
-        self._pending: list[tuple[Request, np.ndarray, int, np.ndarray | None]] = []
+        self._pending: list[tuple[Request, list[_StagedKV], int]] = []
         self._lock = threading.Lock()
         self.dropped = 0  # tail-only handoffs whose advertised prefix vanished
+        # Staged placement (cache/kv_transfer.py handoff lane): with
+        # ``stage_layers`` > 0, an incoming DCN packet's KV is split into
+        # layer-blocks and each block's host→device transfer starts ON
+        # THE TRANSPORT READER THREAD — placement overlaps both the
+        # remaining wire receive and the engine's queue-drain latency,
+        # and _admit_one's per-block scatters interleave with later
+        # blocks' transfers instead of waiting for the whole packet.
+        self.plane = plane
+        self.stage_layers = int(stage_layers)
+        # Streamed-handoff reassembly: (rid, origin submit stamp) →
+        # {"t": first-seen, "parts": {seq: (packet, staged block)}}.
+        # rids are per-PROCESS counters, so the submit stamp keeps two
+        # prefill nodes' rid=N streams apart; stale partial streams (a
+        # sender that died mid-handoff) expire so their staged device
+        # arrays can't pin HBM forever.
+        self._chunks: dict[tuple, dict] = {}
+        self.chunk_ttl_s = 120.0
         self._comm = comm
         if comm is not None:
             comm.register_rcv_callback(self._on_packet)
@@ -168,9 +299,42 @@ class DecodeWorker:
     # -- ingestion ------------------------------------------------------
 
     def _on_packet(self, data: bytes) -> None:
-        self.submit(unpack_handoff(data))
+        pkt = unpack_handoff(data)
+        if pkt.chunk_of > 0:
+            self._submit_chunk(pkt)
+        else:
+            self.submit(pkt)
 
-    def submit(self, pkt: HandoffPacket) -> Request:
+    def _stage_blocks(self, pkt: HandoffPacket) -> list[_StagedKV]:
+        """Split a packet into staged blocks. Device arrays (ICI path)
+        and unstaged configs pass through as one block; DCN numpy
+        payloads stage per layer-block when enabled."""
+        kv, scale = pkt.kv, pkt.kv_scale
+        if (
+            self.stage_layers <= 0
+            or isinstance(kv, jax.Array)
+            or kv.shape[1] <= self.stage_layers
+        ):
+            return [_StagedKV(kv, scale, 0, int(pkt.kv_start))]
+        t0 = time.monotonic()
+        blocks = []
+        for l0 in range(0, kv.shape[1], self.stage_layers):
+            l1 = min(kv.shape[1], l0 + self.stage_layers)
+            blocks.append(
+                _StagedKV(
+                    jnp.asarray(kv[:, l0:l1]),  # H2D starts now
+                    None if scale is None else jnp.asarray(scale[:, l0:l1]),
+                    l0,
+                    int(pkt.kv_start),
+                )
+            )
+        if self.plane is not None:
+            self.plane.note_handoff(
+                int(kv.shape[2]), self.engine.pool, time.monotonic() - t0
+            )
+        return blocks
+
+    def _make_request(self, pkt: HandoffPacket) -> Request:
         # Same admission bound Engine.add_request enforces: a prompt longer
         # than this node's max_seq_len would overflow its page table
         # mid-admission, after state was already mutated.
@@ -197,18 +361,50 @@ class DecodeWorker:
                 cat="disagg", handoff_rid=int(pkt.rid),
                 kv_start=int(pkt.kv_start),
             )
+        return req
+
+    def submit(self, pkt: HandoffPacket) -> Request:
+        req = self._make_request(pkt)
+        blocks = self._stage_blocks(pkt)
         with self._lock:
             # KV stays whatever it arrived as: np.ndarray off the wire
-            # (DCN path), jax.Array off a ppermute (ICI path — forcing it
-            # to numpy here would defeat the host-bypass).
-            self._pending.append(
-                (
-                    req,
-                    pkt.kv,
-                    int(pkt.kv_start),
-                    pkt.kv_scale,
-                )
+            # (DCN path, unstaged), jax.Array off a ppermute (ICI path)
+            # or a staged layer-block transfer.
+            self._pending.append((req, blocks, int(pkt.kv_start)))
+        return req
+
+    def _submit_chunk(self, pkt: HandoffPacket) -> Request | None:
+        """One chunk of a streamed handoff: stage its placement NOW
+        (reader thread — overlapping the chunks still on the wire) and
+        admit the request when the set completes. Returns the Request on
+        completion, None while chunks are outstanding."""
+        t0 = time.monotonic()
+        staged = _StagedKV(
+            jnp.asarray(pkt.kv) if isinstance(pkt.kv, np.ndarray) else pkt.kv,
+            None if pkt.kv_scale is None else jnp.asarray(pkt.kv_scale),
+            0,
+            int(pkt.kv_start),
+        )
+        if self.plane is not None:
+            self.plane.note_handoff(
+                staged.n_tokens, self.engine.pool, time.monotonic() - t0
             )
+        key = (int(pkt.rid), float(pkt.submit_time))
+        now = time.monotonic()
+        with self._lock:
+            self._prune_stale_chunks_locked(now)
+            entry = self._chunks.setdefault(key, {"t": now, "parts": {}})
+            got = entry["parts"]
+            got[int(pkt.chunk_seq)] = (pkt, staged)
+            if len(got) < pkt.chunk_of:
+                return None
+            del self._chunks[key]
+        ordered = [got[i] for i in sorted(got)]
+        first_pkt = ordered[0][0]
+        req = self._make_request(first_pkt)
+        blocks = [st for _, st in ordered]
+        with self._lock:
+            self._pending.append((req, blocks, int(first_pkt.kv_start)))
         return req
 
     def cached_prefix_len(self, prompt: Sequence[int]) -> int:
@@ -241,11 +437,26 @@ class DecodeWorker:
             self.step()
         raise RuntimeError("step budget exhausted with work remaining")
 
+    def _prune_stale_chunks_locked(self, now: float) -> None:
+        """Expire abandoned partial streams (dead sender mid-handoff) so
+        their staged device arrays can't pin HBM forever. Caller holds
+        the lock. Runs from BOTH the chunk receive path and the per-step
+        scheduler drain — a stream stranded when chunked traffic stops
+        must still expire."""
+        for stale in [
+            k for k, e in self._chunks.items()
+            if now - e["t"] > self.chunk_ttl_s
+        ]:
+            self.log.error("dropping stale partial handoff stream %s", stale)
+            del self._chunks[stale]
+
     def _admit_pending(self) -> None:
         with self._lock:
+            if self._chunks:
+                self._prune_stale_chunks_locked(time.monotonic())
             pending, self._pending = self._pending, []
-        for i, (req, kv, kv_start, kv_scale) in enumerate(pending):
-            if not self._admit_one(req, kv, kv_start, kv_scale):
+        for i, (req, blocks, kv_start) in enumerate(pending):
+            if not self._admit_one(req, blocks, kv_start):
                 # Re-queue the failed packet AND everything after it —
                 # admission stops at the first failure (row/pool pressure),
                 # it must not drop the rest of the drained batch.
@@ -256,9 +467,8 @@ class DecodeWorker:
     def _admit_one(
         self,
         req: Request,
-        kv: np.ndarray,
+        blocks: list[_StagedKV],
         kv_start: int,
-        kv_scale: np.ndarray | None = None,
     ) -> bool:
         eng = self.engine
         row = eng._free_row()
@@ -292,24 +502,34 @@ class DecodeWorker:
         n_new = n - reuse
         tr = req.trace
         t_write = time.monotonic() if tr is not None else 0.0
-        lo, hi = reuse - kv_start, n - kv_start
-        tail = self._colocate(jnp.asarray(kv[:, :, lo:hi]))
-        scale = kv_scale
-        if scale is not None and isinstance(scale, jax.Array):
-            scale = self._colocate(scale)
-        if scale is not None and eng.pool.quant is not None:
-            # Quantized end-to-end: store the shipped ints verbatim.
-            eng.pool.write_raw(own[:n_new], tail, jnp.asarray(scale[:, :, lo:hi]))
-        elif scale is not None:
-            # Quantized sender, full-precision receiver: dequantize here.
-            deq = tail.astype(jnp.float32) * jnp.asarray(
-                scale[:, :, lo:hi], jnp.float32
-            )[..., None]
-            eng.pool.write(own[:n_new], deq[0], deq[1])
-        else:
-            # Full-precision packet; a quantized receiver's write()
-            # quantizes on store.
-            eng.pool.write(own[:n_new], tail[0], tail[1])
+        # One pool scatter per staged block: a layer-block's write can
+        # run while the NEXT block's host→device transfer (started on the
+        # reader thread) is still streaming; a token-chunk block landed
+        # (and started placing) before its successors even hit the wire.
+        for b in blocks:
+            lo = max(reuse, b.tok0)
+            hi = min(n, b.tok0 + b.n_tokens)
+            if hi <= lo:
+                continue  # fully covered by local reuse
+            sl, sh = lo - b.tok0, hi - b.tok0
+            tail = self._colocate(jnp.asarray(b.kv[:, :, sl:sh]))
+            scale = b.scale
+            if scale is not None:
+                scale = scale[:, :, sl:sh]
+                if isinstance(scale, jax.Array):
+                    scale = self._colocate(scale)
+            dst = own[lo - reuse : hi - reuse]
+            if scale is not None and eng.pool.quant is None:
+                # Quantized sender, full-precision receiver: dequantize.
+                deq = tail.astype(jnp.float32) * jnp.asarray(
+                    scale, jnp.float32
+                )[..., None]
+                eng.pool.write_block(dst, deq, b.layer0)
+            else:
+                # Quantized end-to-end stores the shipped ints verbatim;
+                # a quantized receiver of a float packet quantizes on
+                # store; plain pools cast + store (write_block dispatch).
+                eng.pool.write_block(dst, tail, b.layer0, scales=scale)
 
         req.kv_len = n
         req.token_slots = np.concatenate([prefix_slots, own[:n_new]])
@@ -319,6 +539,7 @@ class DecodeWorker:
                 "disagg_kv_write", t_write,
                 time.monotonic() - t_write, cat="disagg",
                 kv_tokens=int(n_new), reused_tokens=int(reuse),
+                blocks=len(blocks),
             )
         eng._install_running(req, row, reuse)
         return True
@@ -463,6 +684,8 @@ def pack_handoff(pkt: HandoffPacket) -> bytes:
             "kv_dtype": jnp.dtype(kv.dtype).name,
             "kv_start": int(pkt.kv_start),
             "traced": bool(pkt.traced),
+            "chunk_seq": int(pkt.chunk_seq),
+            "chunk_of": int(pkt.chunk_of),
             "scale_shape": None if scale is None else list(scale.shape),
             "sampling": {
                 "temperature": pkt.sampling.temperature,
@@ -509,4 +732,6 @@ def unpack_handoff(data: bytes) -> HandoffPacket:
         kv_start=h.get("kv_start", 0),
         kv_scale=scale,
         traced=bool(h.get("traced", False)),  # absent in pre-tracing packets
+        chunk_seq=int(h.get("chunk_seq", -1)),  # absent in pre-stream packets
+        chunk_of=int(h.get("chunk_of", 0)),
     )

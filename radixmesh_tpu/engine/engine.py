@@ -128,6 +128,9 @@ class Engine:
         kv_quant: str | None = None,
         weight_quant: str | None = None,
         device_mesh=None,
+        kv_transfer_async: bool = False,
+        kv_transfer_chunk_tokens: int = 512,
+        kv_transfer_min_restore_tokens: int = 0,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
@@ -218,6 +221,9 @@ class Engine:
         self.spec_decode_tokens = spec_decode_tokens
         self.spec_ngram = max(2, spec_ngram)
         self.log = get_logger("engine")
+        # Resolved early: the KV plane (below) and the metric labels
+        # (further down) both key their series on it.
+        self.name = name or f"engine{next(_engine_seq)}"
         # Distributed replica (cache/mesh_cache.py): publishes advertise
         # this node's prefixes around the ring so the router can send
         # shared-prefix requests back here (radix_mesh.py:193-238).
@@ -296,6 +302,24 @@ class Engine:
             self.tree: RadixTree = HierarchicalCache(self.pool, host_store)
         else:
             self.tree = RadixTree(page_size=page_size, on_free=self.pool.free)
+        # Async KV-movement plane (cache/kv_transfer.py): host-tier
+        # restores stage off the scheduling thread (requests park in
+        # RESTORING while decode keeps stepping), eviction write-backs
+        # materialize on the plane worker, and PREFETCH hints start
+        # restores before their request arrives. Off by default — the
+        # synchronous paths remain the behavior every existing test pins.
+        self.kv_transfer = None
+        self._kv_min_restore = max(0, kv_transfer_min_restore_tokens)
+        self._restoring: list[tuple[Request, object]] = []
+        if kv_transfer_async:
+            from radixmesh_tpu.cache.kv_transfer import KVTransferPlane
+
+            self.kv_transfer = KVTransferPlane(
+                chunk_tokens=kv_transfer_chunk_tokens,
+                name=self.name,
+            )
+            if hasattr(self.tree, "host"):
+                self.tree.plane = self.kv_transfer
         # Reserved scratch page: inactive decode rows write/read here.
         scratch = self.pool.alloc(page_size)
         assert scratch is not None
@@ -331,7 +355,6 @@ class Engine:
         self.stats = EngineStats()
 
         reg = get_registry()
-        self.name = name or f"engine{next(_engine_seq)}"
         lbl = {"engine": self.name}
         self._m_prompt = reg.counter(
             "radixmesh_engine_prompt_tokens_total",
@@ -459,14 +482,28 @@ class Engine:
                 self._release(req)
                 self._pressure = False  # freed a row: resume admission
                 return True
+        for i, (req, ticket) in enumerate(self._restoring):
+            if req.rid == rid:
+                # Cancel mid-restore: unlink the request; the ticket runs
+                # to completion (the landed KV is a valid warm cache
+                # entry) and the pump auto-releases its eviction shields,
+                # so the protected pages become evictable again.
+                req.cancelled = True
+                req.state = RequestState.FINISHED
+                self.stats.finished += 1
+                ticket.auto_release = True
+                self._restoring.pop(i)
+                return True
         return False
 
     def cancel_all(self) -> int:
         """Abort every queued and running request (shutdown sweep).
         Returns the number cancelled."""
-        rids = [r.rid for r in self.waiting] + [
-            r.rid for r in self._rows if r is not None
-        ]
+        rids = (
+            [r.rid for r in self.waiting]
+            + [r.rid for r in self._rows if r is not None]
+            + [r.rid for r, _ in self._restoring]
+        )
         return sum(1 for rid in rids if self.cancel(rid))
 
     def step(self) -> None:
@@ -475,9 +512,29 @@ class Engine:
         self._admit()
         if any(r is not None for r in self._rows):
             self._decode_once()
+        elif not self.waiting and (
+            self._restoring
+            or (
+                self.kv_transfer is not None
+                and self.kv_transfer.has_engine_work()
+            )
+        ):
+            # Nothing to decode and nothing admittable: the only live
+            # work is an in-flight restore (a parked request's or a
+            # prefetch hint's) — yield to the plane worker instead of
+            # busy-spinning the scheduler loop against it.
+            self.kv_transfer.wait_progress()
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(r is not None for r in self._rows)
+        return (
+            bool(self.waiting)
+            or bool(self._restoring)
+            or any(r is not None for r in self._rows)
+            or (
+                self.kv_transfer is not None
+                and self.kv_transfer.has_engine_work()
+            )
+        )
 
     def _note_decode_time(self, per_token_s: float) -> None:
         """Funnel for every decode-latency sample: the TPOT histogram
@@ -502,7 +559,10 @@ class Engine:
             host_fill = 1.0 - host.free_slots / host.num_slots
         return {
             "batch_occupancy": rows / max(1, self.max_batch),
-            "waiting": len(self.waiting),
+            # Parked-for-restore requests count as waiting: they are
+            # queued demand the fleet plane should see, just queued on a
+            # KV transfer instead of a batch row.
+            "waiting": len(self.waiting) + len(self._restoring),
             "decode_steps": self.stats.decode_steps,
             "decode_ewma_s": self._decode_ewma,
             "cache_hit_rate": self.stats.hit_rate,
@@ -601,6 +661,7 @@ class Engine:
         (VERDICT round-1 weak #5: per-request serial prefill made TTFT
         degrade linearly with queue depth); a lone short request keeps the
         dense single-request path (smallest-latency compile variant)."""
+        self._pump_kv_transfer()
         if self._pressure and any(r is not None for r in self._rows):
             return
         self._pressure = False  # batch drained: safe to admit again
@@ -619,7 +680,27 @@ class Engine:
                 tr = req.trace
                 t_match = time.monotonic() if tr is not None else 0.0
                 if hasattr(self.tree, "match_and_load"):
-                    match = self.tree.match_and_load(req.prompt)
+                    match = None
+                    if self.kv_transfer is not None:
+                        match = self.tree.match_prefix(req.prompt)
+                        if match.host_nodes:
+                            if match.host_length >= self._kv_min_restore:
+                                if self._park_for_restore(req, match):
+                                    self.waiting.pop(idx)
+                                    continue  # parked; don't advance idx
+                                # Park failed: begin_restore may have
+                                # EVICTED for room, so the walked match
+                                # can hold stale slots — re-walk.
+                                match = None
+                            else:
+                                # Small restore: tree untouched since the
+                                # walk — hand the match to the sync path
+                                # (one walk total, not two).
+                                match = self.tree.match_and_load(
+                                    req.prompt, match=match
+                                )
+                    if match is None:
+                        match = self.tree.match_and_load(req.prompt)
                 else:
                     match = self.tree.match_prefix(req.prompt)
                 if tr is not None:
@@ -738,6 +819,86 @@ class Engine:
                             wave_rows=len(sub),
                             wave_new_tokens=int(new_tok),
                         )
+
+    # ------------------------------------------------------------------
+    # async KV-movement plane seams (cache/kv_transfer.py)
+    # ------------------------------------------------------------------
+
+    def _pump_kv_transfer(self) -> None:
+        """Engine-thread service point for the plane, run at the top of
+        every admission pass: apply staged restore scatters (the only
+        place the plane touches the donated pool buffer), re-queue parked
+        requests whose pages landed, and convert prefetch hints into
+        no-request restores."""
+        plane = self.kv_transfer
+        if plane is None:
+            return
+        plane.pump(self.tree)
+        for key in plane.take_hints():
+            self._apply_prefetch_hint(key)
+        if not self._restoring:
+            return
+        still: list[tuple[Request, object]] = []
+        for req, ticket in self._restoring:
+            if not ticket.done:
+                still.append((req, ticket))
+                continue
+            plane.finish_ticket(self.tree, ticket)
+            req.state = RequestState.QUEUED
+            self.waiting.insert(0, req)
+            tr = req.trace
+            if tr is not None:
+                tr.add(
+                    "kv_restore", ticket.t0,
+                    time.monotonic() - ticket.t0, cat="kv",
+                    tokens=int(ticket.tokens),
+                )
+        self._restoring = still
+
+    def _restore_alloc(self, n_tokens: int) -> np.ndarray | None:
+        """Device slots for a staged restore, evicting (plain drop, no
+        write-back — see ``evict_no_writeback``) under pressure."""
+        dev = self.pool.alloc(n_tokens)
+        if dev is None:
+            freed = self.tree.evict_no_writeback(
+                n_tokens - self.pool.free_slots
+            )
+            if freed:
+                self._m_evicted["capacity"].inc(freed)
+            dev = self.pool.alloc(n_tokens)
+        return dev
+
+    def _park_for_restore(self, req: Request, match) -> bool:
+        """Move ``req`` into the RESTORING state behind a staged-restore
+        ticket. Returns False when nothing could be restored (pool
+        exhausted even after eviction) — the caller falls back to the
+        synchronous path, which degrades to a shorter hit."""
+        ticket = self.kv_transfer.begin_restore(
+            self.tree, match, alloc=self._restore_alloc
+        )
+        if ticket is None:
+            return False
+        req.state = RequestState.RESTORING
+        self._restoring.append((req, ticket))
+        return True
+
+    def _apply_prefetch_hint(self, key: np.ndarray) -> None:
+        """Start a no-request restore for a routed-ahead prefix. Hints
+        are strictly weaker than admissions: read-only match (no node
+        splits), allocation straight from the free list (never evicts),
+        joined with any in-flight restore of the same nodes — so a
+        duplicate, stale, or raced hint degrades to a no-op."""
+        plane = self.kv_transfer
+        if plane is None or not hasattr(self.tree, "match_and_load"):
+            return
+        match = self.tree.match_prefix(key, split_partial=False)
+        if not match.host_nodes:
+            plane.count_hint("noop")
+            return
+        ticket = plane.begin_restore(
+            self.tree, match, alloc=self.pool.alloc, auto_release=True
+        )
+        plane.count_hint("started" if ticket is not None else "noop")
 
     def _defer_for_prefix_wave(
         self, req: Request, cached: int, group: list[tuple]

@@ -24,6 +24,10 @@ class SamplingParams:
 
 class RequestState(enum.Enum):
     QUEUED = "queued"
+    # Parked while the async KV plane (cache/kv_transfer.py) restores the
+    # request's host-tier prefix into HBM; the engine keeps decoding and
+    # re-queues the request when its pages land.
+    RESTORING = "restoring"
     RUNNING = "running"
     FINISHED = "finished"
 

@@ -153,7 +153,9 @@ def _run_node(args: argparse.Namespace) -> int:
     engine = None
     if role is NodeRole.ROUTER:
         router = CacheAwareRouter(
-            node, cfg, health_aware=args.health_aware_routing
+            node, cfg,
+            health_aware=args.health_aware_routing,
+            prefetch_hints=args.kv_prefetch_hints,
         )
         router.watch_topology()
         if not args.warm_up:
@@ -184,7 +186,26 @@ def _run_node(args: argparse.Namespace) -> int:
             weight_quant=model.get("weight_quant"),
             mesh=node,
             name=f"{role.value}{rank}",
+            kv_transfer_async=(
+                args.kv_transfer_async or cfg.kv_transfer_async
+            ),
+            kv_transfer_chunk_tokens=(
+                args.kv_transfer_chunk
+                if args.kv_transfer_chunk is not None
+                else cfg.kv_transfer_chunk_tokens
+            ),
+            kv_transfer_min_restore_tokens=(
+                args.kv_transfer_min_restore
+                if args.kv_transfer_min_restore is not None
+                else cfg.kv_transfer_min_restore_tokens
+            ),
         )
+        if engine.kv_transfer is not None:
+            # Predictive restores: PREFETCH hints received off the wire
+            # land in the plane's bounded hint queue; the engine converts
+            # them to no-request restores at its next pump.
+            node.on_prefetch = engine.kv_transfer.note_hint
+            log.info("async KV-movement plane enabled")
         host, port = parse_addr(cfg.local_addr)
         frontend = ServingFrontend(
             engine, host=host or "127.0.0.1",
@@ -267,6 +288,9 @@ def _run_serve(args: argparse.Namespace) -> int:
         spec_decode_tokens=args.spec_decode_tokens,
         kv_quant=args.kv_quant,
         weight_quant=args.weight_quant,
+        kv_transfer_async=args.kv_transfer_async,
+        kv_transfer_chunk_tokens=args.kv_transfer_chunk or 512,
+        kv_transfer_min_restore_tokens=args.kv_transfer_min_restore or 0,
     )
     slo_cfg = None
     if args.slo or args.slo_ttft_ms is not None or args.slo_tenant:
@@ -341,6 +365,27 @@ def _run_multihost_dryrun(args: argparse.Namespace) -> int:
     return 0 if math.isfinite(loss) else 1
 
 
+def _add_kv_transfer_args(sub: argparse.ArgumentParser) -> None:
+    """Async KV-movement plane flags (``cache/kv_transfer.py``), shared
+    by node + serve."""
+    sub.add_argument(
+        "--kv-transfer-async", action="store_true",
+        help="stage host-tier restores / eviction write-backs / disagg "
+        "placement off the scheduling thread (requests with host-tier "
+        "prefixes park in RESTORING while decode keeps stepping)",
+    )
+    sub.add_argument(
+        "--kv-transfer-chunk", type=int, default=None, metavar="TOKENS",
+        help="restore staging chunk size in tokens (default 512): smaller "
+        "chunks interleave with decode more finely",
+    )
+    sub.add_argument(
+        "--kv-transfer-min-restore", type=int, default=None, metavar="TOKENS",
+        help="restores shorter than this stay on the synchronous "
+        "in-admission path (default 0 = always staged)",
+    )
+
+
 def _add_trace_args(sub: argparse.ArgumentParser) -> None:
     """Request-flight tracing flags, shared by node + serve."""
     sub.add_argument(
@@ -390,6 +435,13 @@ def main(argv: list[str] | None = None) -> int:
         "below 0.5 (stall watchdog, replication lag, eviction storm) — "
         "cache hits shed past them and the hash-ring fallback skips them",
     )
+    node.add_argument(
+        "--kv-prefetch-hints", action="store_true",
+        help="router role: fire an idempotent PREFETCH oplog at the node a "
+        "cache hit routes to, so a host-tier prefix starts restoring to "
+        "HBM before the request arrives (cache/kv_transfer.py)",
+    )
+    _add_kv_transfer_args(node)
     _add_trace_args(node)
     node.set_defaults(fn=_run_node)
 
@@ -454,6 +506,7 @@ def main(argv: list[str] | None = None) -> int:
         "sustained prompt-token rate limit RATE tok/s (repeatable; "
         "requires --slo)",
     )
+    _add_kv_transfer_args(serve)
     _add_trace_args(serve)
     serve.set_defaults(fn=_run_serve)
 

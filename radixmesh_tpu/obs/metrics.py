@@ -32,12 +32,23 @@ __all__ = [
     "set_registry",
     "DEFAULT_BUCKETS",
     "TOKEN_LEN_BUCKETS",
+    "TRANSFER_SECONDS_BUCKETS",
 ]
 
 # Latency-oriented default buckets (seconds): 1ms .. 60s.
 DEFAULT_BUCKETS: tuple[float, ...] = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+# KV-movement buckets (seconds): the async transfer plane's per-op
+# blocking costs (arena memcopies, staged chunk reads, handoff packs —
+# cache/kv_transfer.py) live in the 10µs–10ms band, below
+# DEFAULT_BUCKETS' 1ms floor; a histogram on those buckets would read
+# as all-zeros. Shared so every kv_transfer lane bins identically.
+TRANSFER_SECONDS_BUCKETS: tuple[float, ...] = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 0.001,
+    0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 2.0,
 )
 
 # Token-count buckets (powers of two through the 32k long-context config,

@@ -19,6 +19,13 @@ This module supplies the per-request causal timeline:
   a request's admission wait / prefill wave / decode chunks / publish
   read as one horizontal story, with ring replication-lag spans on the
   mesh lanes below it.
+- The async KV-movement plane (``cache/kv_transfer.py``) records its
+  lanes here too: ``kv_restore`` (on the request's lane when a parked
+  restore completes, and per-node on the plane's ``kv:`` lane),
+  ``kv_writeback`` (fused eviction-sweep copies on the worker), and
+  ``kv_handoff_stage`` (disagg placement staged off the reader thread)
+  — so a KV copy that DOES stall something shows up next to the decode
+  chunks it delayed.
 
 Ring replication lag carries NO trace id across the wire (no wire-format
 change): lag spans are derived receiver-side from the oplog's existing

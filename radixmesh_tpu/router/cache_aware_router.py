@@ -21,8 +21,11 @@ from __future__ import annotations
 import math
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Sequence
+
+import numpy as np
 
 from radixmesh_tpu.cache.mesh_cache import MeshCache, RouterMatchResult
 from radixmesh_tpu.config import MeshConfig
@@ -91,6 +94,8 @@ class CacheAwareRouter:
         load_tau_s: float = 10.0,
         health_aware: bool = False,
         health_threshold: float = 0.5,
+        prefetch_hints: bool = False,
+        prefetch_window_s: float = 2.0,
     ):
         if not config.prefill_nodes or not config.decode_nodes:
             raise ValueError("router needs at least one prefill and one decode node")
@@ -127,6 +132,32 @@ class CacheAwareRouter:
         self.overload_factor = overload_factor
         self.overload_floor = overload_floor
         self._loads = _LoadTracker(load_tau_s)
+        # Predictive restore hints (cache/kv_transfer.py; launch.py
+        # --kv-prefetch-hints): when a cache hit routes to a node, fire a
+        # PREFETCH oplog at it so a host-tier prefix starts restoring
+        # BEFORE the request arrives. The router cannot see tiers (its
+        # replica is rank-only), so it over-approximates — hinting every
+        # hit — and the receiver no-ops when the prefix is already in
+        # HBM; idempotence makes the over-approximation free. A per-
+        # (rank, prefix) dedupe window keeps a hot prefix from spraying
+        # one hint per request.
+        self.prefetch_hints = prefetch_hints
+        self.prefetch_window_s = prefetch_window_s
+        self._prefetch_sent: dict[tuple[int, int], float] = {}
+        self._prefetch_lock = threading.Lock()
+        # Hints leave the ROUTE HOT PATH through this bounded queue and
+        # a single daemon sender: the wire send (channel dial, bounded
+        # try_send) must never add to a /route response, and drop-on-
+        # overflow is exactly the fire-and-forget contract.
+        self._prefetch_q: deque = deque(maxlen=256)
+        self._prefetch_evt = threading.Event()
+        self._prefetch_thread: threading.Thread | None = None
+        if prefetch_hints:
+            self._prefetch_thread = threading.Thread(
+                target=self._prefetch_sender, daemon=True,
+                name="router-prefetch",
+            )
+            self._prefetch_thread.start()
         # Mutated by _on_view_change on the mesh transport-reader thread
         # while /route handler threads read it: guard with a lock (the
         # hash rings guard their own state the same way).
@@ -230,6 +261,42 @@ class CacheAwareRouter:
         others_mean = sum(others) / len(others)
         return target > self.overload_factor * others_mean
 
+    def _maybe_prefetch(self, key: Sequence[int], match_len: int, rank: int) -> None:
+        """Queue one deduped PREFETCH hint for ``key``'s matched prefix.
+        Fire-and-forget: the wire send happens on the background sender,
+        and failures / dedupe skips / queue overflow cost an overlap
+        opportunity, never a routing decision (or a route's latency)."""
+        prefix = np.asarray(key[:match_len], dtype=np.int32)
+        dedupe = (rank, hash(prefix.tobytes()))
+        now = time.monotonic()
+        with self._prefetch_lock:
+            last = self._prefetch_sent.get(dedupe, 0.0)
+            if now - last < self.prefetch_window_s:
+                return
+            self._prefetch_sent[dedupe] = now
+            if len(self._prefetch_sent) > 4096:  # bounded memory
+                cutoff = now - self.prefetch_window_s
+                self._prefetch_sent = {
+                    k: t for k, t in self._prefetch_sent.items() if t >= cutoff
+                }
+            self._prefetch_q.append((prefix, rank))
+        self._prefetch_evt.set()
+
+    def _prefetch_sender(self) -> None:
+        """Daemon drain of the hint queue — the only place router
+        prefetches touch a transport."""
+        while True:
+            with self._prefetch_lock:
+                item = self._prefetch_q.popleft() if self._prefetch_q else None
+            if item is None:
+                self._prefetch_evt.wait(timeout=0.2)
+                self._prefetch_evt.clear()
+                continue
+            try:
+                self.mesh_cache.send_prefetch(item[0], item[1])
+            except Exception:  # noqa: BLE001 — hints are droppable by contract
+                pass
+
     def cache_aware_route(self, key: Sequence[int]) -> RouteResult:
         """Route one request's token ids (reference ``:23-39``)."""
         t0 = time.monotonic()
@@ -293,6 +360,14 @@ class CacheAwareRouter:
                 key, exclude=sick or None
             ) or self._decode_ring.get_node(key)
             d_hit = False
+        if self.prefetch_hints and match.match_len > 0:
+            # Hint only ranks the request will actually LAND on (a shed
+            # hit routes elsewhere — warming the hot node would restore
+            # KV nobody is coming for).
+            if p_hit and match.prefill_rank >= 0:
+                self._maybe_prefetch(key, match.match_len, match.prefill_rank)
+            if d_hit and match.decode_rank >= 0:
+                self._maybe_prefetch(key, match.match_len, match.decode_rank)
         if prefill_addr is not None:
             self._loads.note(prefill_addr)
         if decode_addr is not None:

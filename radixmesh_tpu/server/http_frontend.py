@@ -318,13 +318,18 @@ class ServingFrontend:
             eng = self.runner.engine
             now = time.monotonic()
             waiting = list(eng.waiting)
+            restoring = [r for r, _ in list(getattr(eng, "_restoring", ()))]
             running = [r for r in list(eng._rows) if r is not None]
             # Counts derive from the SAME snapshots as the rows, so one
             # response is always internally consistent (the snapshot
             # itself may trail the scheduler by a beat — by design).
             return {
-                "requests": [_request_row(r, now) for r in waiting + running],
+                "requests": [
+                    _request_row(r, now)
+                    for r in waiting + restoring + running
+                ],
                 "waiting": len(waiting),
+                "restoring": len(restoring),
                 "running": len(running),
             }
 
@@ -369,6 +374,19 @@ class ServingFrontend:
                 state["host_tier"] = {
                     "num_slots": getattr(host, "num_slots", None),
                     "free_slots": getattr(host, "free_slots", None),
+                    "writeback_sweeps": getattr(tree, "wb_sweeps", 0),
+                    "writeback_gathers": getattr(tree, "wb_gathers", 0),
+                }
+            plane = getattr(eng, "kv_transfer", None)
+            if plane is not None:
+                # Async KV-movement plane (cache/kv_transfer.py): lane
+                # queue depths + restore-park state, same lock-free
+                # snapshot discipline as the rest of this endpoint.
+                state["kv_transfer"] = {
+                    **plane.stats(),
+                    "restoring_requests": len(
+                        getattr(eng, "_restoring", ())
+                    ),
                 }
             if eng.mesh is not None:
                 state["membership"] = _membership_state(eng.mesh)

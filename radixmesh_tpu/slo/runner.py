@@ -180,9 +180,13 @@ class SLORunner(EngineRunner):
         """Cancel running/queued requests past their end-to-end deadline:
         partial output returns immediately (flagged shed) instead of the
         request holding a batch row past the point anyone is waiting."""
+        # Parked-for-restore requests (RESTORING, cache/kv_transfer.py)
+        # are deadline-subject like any queued request: a restore that
+        # outlives the deadline must not resurrect the request later.
+        restoring = [r for r, _ in getattr(self.engine, "_restoring", ())]
         expired = [
             r
-            for r in list(self.engine.waiting) + self.engine._rows
+            for r in list(self.engine.waiting) + restoring + self.engine._rows
             if r is not None
             and r.e2e_deadline_s is not None
             and now - r.submit_time > r.e2e_deadline_s
@@ -223,6 +227,13 @@ class SLORunner(EngineRunner):
                     r
                     for r in self.engine._rows
                     if r is not None and r.rid == rid
+                ),
+                None,
+            ) or next(
+                (
+                    r
+                    for r, _ in getattr(self.engine, "_restoring", ())
+                    if r.rid == rid
                 ),
                 None,
             )

@@ -1,0 +1,71 @@
+"""Standalone KV-movement bench (the KVFLOW artifact's paired CLI
+emitter, like ``scripts/fleetbench.py`` is for FLEET).
+
+Runs ``workload.run_kvflow_workload`` — restore-stall vs overlapped TTFT
+on a host-tier restore burst, write-back gather fusion per eviction
+sweep, decode progress while a restore is in flight, and prefetch
+hit-ahead rate — then prints ONE JSON line validated against the schema
+``bench.validate_kvflow`` pins.
+
+Usage::
+
+    python scripts/kvflowbench.py [--requests 4] [--prompt-tokens 768]
+                                  [--repeats 3] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+
+
+def run(
+    requests: int, prompt_tokens: int, chunk_tokens: int, repeats: int, seed: int
+) -> dict:
+    from radixmesh_tpu.workload import run_kvflow_workload
+
+    res = run_kvflow_workload(
+        n_restore_requests=requests,
+        prompt_tokens=prompt_tokens,
+        chunk_tokens=chunk_tokens,
+        repeats=repeats,
+        seed=seed,
+    )
+    report = bench.build_kvflow_report(res)
+    problems = bench.validate_kvflow(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="kvflowbench")
+    ap.add_argument("--requests", type=int, default=4)
+    ap.add_argument("--prompt-tokens", type=int, default=768)
+    ap.add_argument("--chunk-tokens", type=int, default=512)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    args = ap.parse_args()
+    report = run(
+        args.requests, args.prompt_tokens, args.chunk_tokens,
+        args.repeats, args.seed,
+    )
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -239,3 +239,88 @@ class TestFleetArtifactSchema:
         from radixmesh_tpu.obs.fleet_plane import DIGEST_BYTE_BUDGET
 
         assert report["digest_byte_budget"] == DIGEST_BYTE_BUDGET
+
+
+class TestKvflowArtifactSchema:
+    """The KVFLOW artifact (async KV-movement plane, PR 4) stays
+    machine-comparable across rounds: pinned top/section fields plus the
+    two deterministic structural contracts — write-back gathers fused to
+    at most one per sweep, and decode progress while a restore is in
+    flight strictly above the synchronous path's zero."""
+
+    def _report(self) -> dict:
+        return {
+            "schema_version": bench.KVFLOW_SCHEMA_VERSION,
+            "metric": "kv_restore_overlapped_ttft_ratio",
+            "value": 0.94,
+            "unit": "overlapped/sync mean TTFT of a host-tier restore burst",
+            "workload": "4 host-tier restore requests x 3 interleaved trials",
+            "restore": {
+                "requests": 4, "repeats": 3,
+                "sync_ttft_s": 0.236, "overlapped_ttft_s": 0.222,
+                "overlap_ratio": 0.94, "overlap_wins": True,
+                "sync_ttft_trials_s": [0.23, 0.22, 0.25],
+                "overlapped_ttft_trials_s": [0.19, 0.23, 0.24],
+                "sync_restore_ttft_s": 0.7, "overlapped_restore_ttft_s": 0.95,
+                "sync_fresh_ttft_s": 0.8, "overlapped_fresh_ttft_s": 0.15,
+                "restored_tokens": 3072, "parked_requests": 4,
+                "decode_steps_during_restore": 1,
+                "sync_decode_steps_during_restore": 0,
+                "max_decode_gap_s": 0.29, "sync_max_decode_gap_s": 0.33,
+            },
+            "writeback": {
+                "tokens_written_back": 3072, "sweeps": 1, "gathers": 1,
+                "gathers_per_sweep": 1.0, "sync_gathers_per_sweep": 1.0,
+                "evict_stall_s": 0.003, "sync_evict_stall_s": 0.04,
+            },
+            "prefetch": {
+                "hints_sent": 8, "hints_joined": 4, "hit_ahead_rate": 1.0,
+            },
+            "chunk_tokens": 512,
+            "ttft_chunk_tokens": 1536,
+            "page_size": 4,
+            "wall_s": 18.9,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_kvflow(self._report()) == []
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["chunk_tokens"]
+        del report["restore"]["overlap_wins"]
+        del report["prefetch"]["hit_ahead_rate"]
+        missing = bench.validate_kvflow(report)
+        assert "chunk_tokens" in missing
+        assert "restore.overlap_wins" in missing
+        assert "prefetch.hit_ahead_rate" in missing
+
+    def test_structural_contracts_enforced(self):
+        report = self._report()
+        report["writeback"]["gathers_per_sweep"] = 3.0  # unfused
+        report["restore"]["decode_steps_during_restore"] = 0  # blocked
+        problems = "\n".join(bench.validate_kvflow(report))
+        assert "fused-gather contract" in problems
+        assert "decode-never-blocks contract" in problems
+        assert bench.validate_kvflow([1]) == ["artifact is not a JSON object"]
+
+    def test_build_report_matches_schema(self):
+        """build_kvflow_report over a workload-shaped result passes the
+        validator — emitter and schema cannot drift."""
+        res = self._report()
+        for k in ("schema_version", "metric", "value", "unit", "workload"):
+            res.pop(k)
+        assert bench.validate_kvflow(bench.build_kvflow_report(res)) == []
+
+    def test_checked_in_artifact_validates(self):
+        """The round artifact shipped with this PR passes its own
+        schema (guards hand-edits and emitter drift alike)."""
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "KVFLOW_r*.json")))
+        assert paths, "no KVFLOW artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_kvflow(report) == []

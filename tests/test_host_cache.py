@@ -388,3 +388,81 @@ class TestRestoreOverlap:
             k for k in snap if k.startswith("radixmesh_hicache")
         )
         assert "radixmesh_hicache_restore_stall_seconds" in reg.render()
+
+
+@pytest.mark.quick
+class TestBatchedWritebackSweep:
+    """PR 4 satellite: eviction write-back is SWEEP-batched — one fused
+    device gather per sweep regardless of how many nodes it absorbs
+    (the seed paid one gather_padded, and one device sync, per node)."""
+
+    def _tree_with_chains(self, n_chains=4, chain_len=8, quant=None):
+        pool = PagedKVPool(num_slots=256, num_layers=L, num_kv_heads=H,
+                           head_dim=D, page_size=PAGE,
+                           dtype=jnp.float32, quant=quant)
+        host = HostKVStore(num_slots=256, num_layers=L, num_kv_heads=H,
+                           head_dim=D, page_size=PAGE,
+                           dtype=jnp.float32, quant=quant)
+        tree = HierarchicalCache(pool, host)
+        keys, raws = [], []
+        rng = np.random.default_rng(9)
+        for i in range(n_chains):
+            key = list(range(100 * i, 100 * i + chain_len))
+            slots = pool.alloc(chain_len)
+            k = jnp.asarray(rng.normal(size=(L, chain_len, H, D)), jnp.float32)
+            v = jnp.asarray(rng.normal(size=(L, chain_len, H, D)), jnp.float32)
+            pool.write(slots, k, v)
+            raw_kv, raw_sc = pool.gather_raw(slots)
+            raws.append((np.asarray(raw_kv),
+                         None if raw_sc is None else np.asarray(raw_sc)))
+            tree.insert(key, slots)
+            keys.append(key)
+        return tree, keys, raws
+
+    def test_one_gather_per_sweep_many_nodes(self):
+        tree, keys, _ = self._tree_with_chains(n_chains=5)
+        freed = tree.evict(1000)
+        assert freed == 5 * 8
+        assert tree.wb_sweeps == 1
+        assert tree.wb_gathers == 1  # fused: NOT one per node
+        for key in keys:
+            assert tree.match_prefix(key).host_length == 8
+
+    @pytest.mark.parametrize("quant", [None, "int8"])
+    def test_sweep_round_trip_bitwise_equivalence(self, quant):
+        """Property: evict (fused sweep) → host → restore → the pool
+        holds the exact stored representation again, fp and int8 raw
+        paths — identical attention inputs, hence identical outputs."""
+        tree, keys, raws = self._tree_with_chains(n_chains=4, quant=quant)
+        tree.evict(1000)
+        assert tree.wb_gathers == 1
+        for key, (raw_kv, raw_sc) in zip(keys, raws):
+            res = tree.match_and_load(key)
+            assert res.length == len(key)
+            back_kv, back_sc = tree.pool.gather_raw(res.indices())
+            np.testing.assert_array_equal(np.asarray(back_kv), raw_kv)
+            if quant is not None:
+                np.testing.assert_array_equal(np.asarray(back_sc), raw_sc)
+
+    @pytest.mark.parametrize("quant", [None, "int8"])
+    def test_async_plane_sweep_round_trip(self, quant):
+        """The same property with the write-back materialized on the
+        plane worker: wait_host_ready is the arena read barrier."""
+        from radixmesh_tpu.cache.kv_transfer import KVTransferPlane
+
+        tree, keys, raws = self._tree_with_chains(n_chains=3, quant=quant)
+        plane = KVTransferPlane(name=f"wbtest-{quant}")
+        tree.plane = plane
+        try:
+            tree.evict(1000)
+            assert tree.wb_gathers == 1
+            assert plane.wait_host_ready()
+            for key, (raw_kv, raw_sc) in zip(keys, raws):
+                res = tree.match_and_load(key)
+                assert res.length == len(key)
+                back_kv, back_sc = tree.pool.gather_raw(res.indices())
+                np.testing.assert_array_equal(np.asarray(back_kv), raw_kv)
+                if quant is not None:
+                    np.testing.assert_array_equal(np.asarray(back_sc), raw_sc)
+        finally:
+            plane.close()

@@ -1,0 +1,307 @@
+"""Async KV-movement plane (``cache/kv_transfer.py``): staged restores
+that never block the decode loop, fused write-back off the engine
+thread, PREFETCH hint safety (idempotent / droppable / structure-
+preserving), and the streamed disagg handoff."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.host_cache import HierarchicalCache, HostKVStore
+from radixmesh_tpu.cache.kv_pool import PagedKVPool
+from radixmesh_tpu.cache.kv_transfer import KVTransferPlane
+from radixmesh_tpu.engine.engine import Engine
+from radixmesh_tpu.engine.request import RequestState, SamplingParams
+from radixmesh_tpu.models.llama import ModelConfig, init_params
+
+pytestmark = pytest.mark.quick
+
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = ModelConfig.tiny()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def make_engine(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("num_slots", 512)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("host_cache_slots", 1024)
+    kw.setdefault("kv_transfer_async", True)
+    kw.setdefault("kv_transfer_chunk_tokens", 16)
+    return Engine(cfg, params, **kw)
+
+
+def drive(eng, reqs, max_steps=5000):
+    for _ in range(max_steps):
+        if not eng.has_work():
+            break
+        eng.step()
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+
+
+def close(eng):
+    if eng.kv_transfer is not None:
+        eng.kv_transfer.close()
+
+
+PROMPT = list(range(1, 120))
+SAMP = SamplingParams(max_new_tokens=4)
+
+
+def seed_and_evict(eng, prompt=PROMPT):
+    out = eng.generate([prompt], SAMP)
+    assert eng.tree.evict(100_000) > 0
+    if eng.kv_transfer is not None:
+        assert eng.kv_transfer.wait_host_ready()
+    return out
+
+
+class TestStagedRestore:
+    def test_restore_round_trip_identical_output(self, tiny):
+        """evict → host tier → staged restore → identical generation
+        (the engine-level equivalence the property tests below pin at
+        the pool level)."""
+        eng = make_engine(tiny)
+        try:
+            out1 = seed_and_evict(eng)
+            req = eng.add_request(PROMPT, SAMP)
+            drive(eng, [req])
+            assert req.generated == out1[0]
+            # The retry was a (restored) cache hit, not a recompute.
+            assert eng.stats.cached_tokens >= 100
+        finally:
+            close(eng)
+
+    def test_decode_steps_complete_while_restore_in_flight(self, tiny):
+        """THE acceptance property: a host-tier admission never blocks
+        the decode loop. The stage barrier holds the restore open for a
+        deterministic window; the running request must keep producing
+        tokens through it."""
+        eng = make_engine(tiny)
+        try:
+            seed_and_evict(eng)
+            # A running request decoding while the restore is in flight.
+            bg = eng.add_request(
+                list(range(200, 240)), SamplingParams(max_new_tokens=64)
+            )
+            eng.step()
+            assert bg.state is RequestState.RUNNING
+            barrier = threading.Event()
+            eng.kv_transfer.stage_barrier = barrier
+            req = eng.add_request(PROMPT, SAMP)
+            steps_at_park = None
+            for _ in range(8):
+                eng.step()
+                if req.state is RequestState.RESTORING and steps_at_park is None:
+                    steps_at_park = eng.stats.decode_steps
+            assert req.state is RequestState.RESTORING
+            assert steps_at_park is not None
+            # Decode progressed while the restore was held in flight.
+            assert eng.stats.decode_steps > steps_at_park
+            barrier.set()
+            eng.kv_transfer.stage_barrier = None
+            drive(eng, [req, bg])
+            assert eng.kv_transfer.idle()
+        finally:
+            close(eng)
+
+    def test_cancel_mid_restore_releases_pages(self, tiny):
+        eng = make_engine(tiny)
+        try:
+            seed_and_evict(eng)
+            barrier = threading.Event()
+            eng.kv_transfer.stage_barrier = barrier
+            req = eng.add_request(PROMPT, SAMP)
+            for _ in range(3):
+                eng.step()
+            assert req.state is RequestState.RESTORING
+            assert eng.cancel(req.rid)
+            assert req.state is RequestState.FINISHED
+            assert req.cancelled
+            barrier.set()
+            eng.kv_transfer.stage_barrier = None
+            # The ticket drains to completion and releases its eviction
+            # shields: nothing stays protected, nothing leaks.
+            deadline = time.monotonic() + 10
+            while not eng.kv_transfer.idle() and time.monotonic() < deadline:
+                eng.step()
+            assert eng.kv_transfer.idle()
+            assert eng.tree.protected_size_ == 0
+        finally:
+            close(eng)
+
+    def test_sync_fallback_below_min_restore_threshold(self, tiny):
+        eng = make_engine(tiny, kv_transfer_min_restore_tokens=10_000)
+        try:
+            out1 = seed_and_evict(eng)
+            req = eng.add_request(PROMPT, SAMP)
+            states = set()
+            for _ in range(5000):
+                if not eng.has_work():
+                    break
+                eng.step()
+                states.add(req.state)
+            # Below the threshold the synchronous path serves the hit —
+            # the request never parks.
+            assert RequestState.RESTORING not in states
+            assert req.generated == out1[0]
+        finally:
+            close(eng)
+
+
+class TestPrefetchHints:
+    def test_hint_restores_ahead_and_is_idempotent(self, tiny):
+        eng = make_engine(tiny)
+        try:
+            seed_and_evict(eng)
+            fp_before = eng.tree.fingerprint
+            nodes_before = sum(1 for _ in eng.tree._all_nodes())
+            key = np.asarray(PROMPT, np.int32)
+            for _ in range(3):  # duplicate delivery must be a no-op join
+                eng.kv_transfer.note_hint(key)
+            deadline = time.monotonic() + 10
+            while not eng.kv_transfer.idle() and time.monotonic() < deadline:
+                eng.step()
+            assert eng.kv_transfer.idle()
+            m = eng.tree.match_prefix(key)
+            assert m.length >= 116  # page-aligned full prompt, device tier
+            assert m.host_length == 0
+            # Structure preserved: hints never split nodes or evict —
+            # same token-path set, no node-count churn beyond restores.
+            assert eng.tree.fingerprint == fp_before
+            assert sum(1 for _ in eng.tree._all_nodes()) == nodes_before
+            assert eng.tree.protected_size_ == 0
+            # A hint for an already-device-resident prefix is a no-op.
+            eng.kv_transfer.note_hint(key)
+            eng.step()
+            assert eng.kv_transfer.idle()
+        finally:
+            close(eng)
+
+    def test_hint_for_evicted_prefix_is_safe(self, tiny):
+        """A stale hint whose prefix left BOTH tiers must no-op."""
+        eng = make_engine(tiny)
+        try:
+            seed_and_evict(eng)
+            # Destroy the host copies too (arena pressure stand-in).
+            eng.tree._evict_host(100_000)
+            key = np.asarray(PROMPT, np.int32)
+            eng.kv_transfer.note_hint(key)
+            eng.step()
+            assert eng.kv_transfer.idle()
+            assert eng.tree.protected_size_ == 0
+        finally:
+            close(eng)
+
+    def test_hint_racing_real_admission_joins(self, tiny):
+        """Hint then immediate admission: the admission must JOIN the
+        hint's in-flight restore (no double restore, no double free),
+        and the request still serves the full hit."""
+        eng = make_engine(tiny)
+        try:
+            out1 = seed_and_evict(eng)
+            barrier = threading.Event()
+            eng.kv_transfer.stage_barrier = barrier
+            eng.kv_transfer.note_hint(np.asarray(PROMPT, np.int32))
+            eng.step()  # hint converts to a held-open restore ticket
+            req = eng.add_request(PROMPT, SAMP)
+            for _ in range(3):
+                eng.step()
+            assert req.state is RequestState.RESTORING
+            assert eng.kv_transfer.hints_joined >= 1
+            barrier.set()
+            eng.kv_transfer.stage_barrier = None
+            drive(eng, [req])
+            assert req.generated == out1[0]
+            assert eng.kv_transfer.idle()
+            assert eng.tree.protected_size_ == 0
+        finally:
+            close(eng)
+
+
+class TestWritebackLane:
+    def test_fused_gather_per_sweep_and_arena_ordering(self, tiny):
+        """One device gather per eviction sweep; a sync restore right
+        behind the async write-back reads the arena only after the
+        worker's write landed (wait_host_ready barrier)."""
+        eng = make_engine(tiny)
+        try:
+            out1 = eng.generate([PROMPT], SAMP)
+            assert eng.tree.evict(100_000) > 0
+            assert eng.tree.wb_sweeps == 1
+            assert eng.tree.wb_gathers == 1
+            # Immediately re-serve through the SYNC fallback (threshold
+            # forces it) — correctness depends on the read barrier.
+            eng._kv_min_restore = 10_000
+            req = eng.add_request(PROMPT, SAMP)
+            drive(eng, [req])
+            assert req.generated == out1[0]
+        finally:
+            close(eng)
+
+
+class TestPlaneMetricsAndState:
+    def test_stats_shape_and_counters(self, tiny):
+        eng = make_engine(tiny)
+        try:
+            seed_and_evict(eng)
+            req = eng.add_request(PROMPT, SAMP)
+            drive(eng, [req])
+            st = eng.kv_transfer.stats()
+            for key in (
+                "chunk_tokens", "writebacks_queued", "restores_queued",
+                "staged_chunks", "pending_restore_nodes", "active_tickets",
+                "hints_queued", "hints_seen", "hints_joined",
+            ):
+                assert key in st
+            from radixmesh_tpu.obs.metrics import get_registry
+
+            snap = get_registry().snapshot()
+            restored = [
+                v for k, v in snap.items()
+                if k.startswith("radixmesh_kv_transfer_restored_tokens_total")
+                and f'plane="{eng.name}"' in k
+            ]
+            assert restored and restored[0] > 0
+        finally:
+            close(eng)
+
+
+class TestFailedWritebackDegradation:
+    def test_poisoned_host_slots_degrade_without_deadlock(self, tiny):
+        """A failed write-back poisons its arena slots; the next staged
+        restore attempt must DROP the host copy (no garbage restore) and
+        must not deadlock on the plane lock (regression: host_slots_ok
+        re-acquired the non-reentrant lock inside begin_restore)."""
+        eng = make_engine(tiny)
+        try:
+            seed_and_evict(eng)
+            # Simulate a worker-side materialization failure for every
+            # written-back slot.
+            host_ids = [
+                int(s)
+                for n in eng.tree._all_nodes()
+                if n.host_value is not None
+                for s in n.host_value
+            ]
+            with eng.kv_transfer._lock:
+                eng.kv_transfer._poisoned_host.update(host_ids)
+            req = eng.add_request(PROMPT, SAMP)
+            drive(eng, [req])  # hangs here if the lock re-entered
+            # The prefix recomputed (host copy dropped, not restored).
+            assert req.state is RequestState.FINISHED
+            assert eng.kv_transfer.idle()
+            assert eng.tree.protected_size_ == 0
+            m = eng.tree.match_prefix(np.asarray(PROMPT, np.int32))
+            assert m.host_length == 0  # poisoned copies are gone
+        finally:
+            close(eng)

@@ -469,3 +469,66 @@ class TestControlPlanePriority:
         finally:
             for n in nodes:
                 n.close()
+
+
+@pytest.mark.quick
+class TestPrefetchHints:
+    """PR 4: PREFETCH rides the ring (P/D origin) or a router-direct
+    channel, is delivered exactly to its addressee's sink, never touches
+    the mesh replica tree, and unknown future kinds pass through the
+    receive path without error."""
+
+    def _wait(self, pred, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return pred()
+
+    def test_router_direct_hint_reaches_target_sink(self, cluster):
+        target = cluster.node(0)
+        got: list[np.ndarray] = []
+        target.on_prefetch = lambda key: got.append(np.asarray(key).copy())
+        key = np.arange(16, dtype=np.int32)
+        assert cluster.router.send_prefetch(key, 0)
+        assert self._wait(lambda: len(got) == 1)
+        np.testing.assert_array_equal(got[0], key)
+
+    def test_ring_hint_addressed_delivery_and_tree_untouched(self, cluster):
+        target = cluster.node(1)
+        bystander = cluster.node(2)
+        got, other = [], []
+        target.on_prefetch = lambda key: got.append(1)
+        bystander.on_prefetch = lambda key: other.append(1)
+        fp_before = [n.tree.fingerprint for n in cluster.nodes]
+        # Duplicate delivery: both hints arrive, both are safe no-ops at
+        # the mesh layer (the ENGINE's plane dedupes restores).
+        cluster.node(3).send_prefetch(np.arange(8, dtype=np.int32), 1)
+        cluster.node(3).send_prefetch(np.arange(8, dtype=np.int32), 1)
+        assert self._wait(lambda: len(got) == 2)
+        assert not other  # addressed hints fire only the target's sink
+        # A hint NEVER mutates any replica's tree (structure audit).
+        assert [n.tree.fingerprint for n in cluster.nodes] == fp_before
+
+    def test_unknown_kind_circulates_without_error(self, cluster):
+        from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
+
+        frame = bytearray(serialize(Oplog(
+            op_type=OplogType.PREFETCH, origin_rank=0,
+            logic_id=99, ttl=cluster.node(1)._data_ttl(),
+            key=np.arange(4, dtype=np.int32),
+        )))
+        frame[2] = 177  # future kind
+        cluster.node(1).oplog_received(bytes(frame))
+        # The ring stays healthy: a data op still replicates everywhere.
+        key = np.arange(40, 48, dtype=np.int32)
+        insert_with_pool(cluster.node(0), key)
+        assert self._wait(
+            lambda: all(
+                n.tree.match_prefix(key).length == len(key)
+                if n.role is not NodeRole.ROUTER
+                else True
+                for n in cluster.ring_nodes
+            )
+        )

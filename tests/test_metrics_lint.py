@@ -37,15 +37,17 @@ def _register_all_instrumented_families() -> None:
     from radixmesh_tpu.slo.control import OverloadController
 
     cfg = ModelConfig.tiny()
-    Engine(
+    eng = Engine(
         cfg,
         init_params(cfg, jax.random.PRNGKey(0)),
         num_slots=64,
         page_size=4,
         max_batch=1,
         host_cache_slots=64,  # registers the hicache families too
+        kv_transfer_async=True,  # registers the kv_transfer lane families
         name="lint",
     )
+    eng.kv_transfer.close()
     OverloadController(SLOConfig())
     prefill, decode, router = ["p0"], ["d0"], ["r0"]
 

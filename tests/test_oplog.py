@@ -10,6 +10,7 @@ from radixmesh_tpu.cache.oplog import (
     Oplog,
     OplogType,
     deserialize,
+    patched_ttl,
     serialize,
 )
 
@@ -291,3 +292,39 @@ class TestU24Packing:
         back = deserialize(patched_ttl(serialize(op), 3))
         assert back.ttl == 3
         np.testing.assert_array_equal(back.key, op.key)
+
+
+@pytest.mark.quick
+class TestPrefetchOp:
+    """PR 4: the PREFETCH hint kind rides the existing wire unchanged,
+    and UNKNOWN kinds (a newer peer's extension) deserialize to their
+    raw int instead of raising — the forward-compat contract that lets
+    pre-PREFETCH nodes coexist with hint-senders."""
+
+    def test_prefetch_round_trips(self):
+        op = Oplog(
+            op_type=OplogType.PREFETCH,
+            origin_rank=3,
+            logic_id=11,
+            ttl=1,
+            key=np.arange(32, dtype=np.int32),
+            value_rank=0,
+            ts=123.5,
+        )
+        back = deserialize(serialize(op))
+        assert back == op
+        assert back.op_type is OplogType.PREFETCH
+
+    def test_unknown_kind_deserializes_to_raw_int(self):
+        op = Oplog(
+            op_type=OplogType.PREFETCH, origin_rank=1, logic_id=2, ttl=1,
+            key=np.arange(4, dtype=np.int32),
+        )
+        frame = bytearray(serialize(op))
+        frame[2] = 213  # a kind from the future
+        back = deserialize(bytes(frame))
+        assert back.op_type == 213
+        assert not isinstance(back.op_type, OplogType)
+        # ...and such frames can still be TTL-patched for forwarding.
+        patched = deserialize(patched_ttl(bytes(frame), 0))
+        assert patched.ttl == 0 and patched.op_type == 213
