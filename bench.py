@@ -270,6 +270,135 @@ def _fleet_pass() -> dict:
 
 
 # ----------------------------------------------------------------------
+# CHAOS stable schema (PR 5, self-healing mesh): one artifact per round
+# recording the chaos acceptance scenario — seeded frame loss + a
+# scheduled partition (comm/faults.py) diverge replicas; the
+# anti-entropy repair plane (cache/repair_plane.py) must converge every
+# replica (router included) within a bounded number of repair rounds
+# while requests keep being served, then go quiet. Bump the version
+# ONLY when adding fields (never remove or rename).
+# ----------------------------------------------------------------------
+
+CHAOS_SCHEMA_VERSION = 1
+
+CHAOS_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload", "nodes",
+    "topology", "round_budget", "fault_plan", "served", "divergence",
+    "repair", "quiescence", "wall_s",
+)
+CHAOS_FAULT_FIELDS = (
+    "seed", "drop_p", "drop_window_s", "partition_s", "partitioned_node",
+    "frames_dropped", "frames_delivered",
+)
+CHAOS_SERVED_FIELDS = ("attempted", "ok", "ok_rate_during_fault")
+CHAOS_DIVERGENCE_FIELDS = ("detected", "peak_diverged_pairs", "max_age_s")
+CHAOS_REPAIR_FIELDS = (
+    "converged", "converge_s", "max_episode_rounds", "within_round_budget",
+    "probes_sent", "summaries_sent", "keys_pushed", "oplogs_reemitted",
+    "heals",
+)
+CHAOS_QUIESCENCE_FIELDS = (
+    "window_s", "traffic_before", "traffic_after", "quiet",
+)
+
+
+def validate_chaos(report) -> list[str]:
+    """Schema violations of a CHAOS artifact vs the pinned contract
+    (empty = valid): all top/section fields present, plus the three
+    structural acceptance gates — every replica converged, within the
+    repair-round budget, and ZERO repair traffic once converged
+    (quiescence). Import-safe from artifact tests and
+    ``scripts/chaosbench.py`` (no jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in CHAOS_TOP_FIELDS if f not in report]
+    for section, fields in (
+        ("fault_plan", CHAOS_FAULT_FIELDS),
+        ("served", CHAOS_SERVED_FIELDS),
+        ("divergence", CHAOS_DIVERGENCE_FIELDS),
+        ("repair", CHAOS_REPAIR_FIELDS),
+        ("quiescence", CHAOS_QUIESCENCE_FIELDS),
+    ):
+        sec = report.get(section)
+        if isinstance(sec, dict):
+            problems += [f"{section}.{f}" for f in fields if f not in sec]
+    rep = report.get("repair")
+    if isinstance(rep, dict):
+        if rep.get("converged") is not True:
+            problems.append(
+                "repair.converged is not True (replicas never healed)"
+            )
+        if rep.get("within_round_budget") is not True:
+            problems.append(
+                f"repair.max_episode_rounds {rep.get('max_episode_rounds')} "
+                f"exceeded round_budget {report.get('round_budget')}"
+            )
+    div = report.get("divergence")
+    if isinstance(div, dict) and div.get("detected") is not True:
+        problems.append(
+            "divergence.detected is not True (the fault injected nothing — "
+            "the heal proves nothing)"
+        )
+    q = report.get("quiescence")
+    if isinstance(q, dict) and q.get("quiet") is not True:
+        problems.append(
+            f"quiescence: repair traffic kept flowing after convergence "
+            f"({q.get('traffic_before')} → {q.get('traffic_after')})"
+        )
+    return problems
+
+
+def build_chaos_report(res: dict) -> dict:
+    """Assemble a schema-complete CHAOS artifact from
+    ``workload.run_chaos_workload``'s result."""
+    fp = res.get("fault_plan", {})
+    rep = res.get("repair", {})
+    return {
+        "schema_version": CHAOS_SCHEMA_VERSION,
+        "metric": "chaos_heal_converge_s",
+        "value": rep.get("converge_s"),
+        "unit": "s from fault-window close to ALL replicas (P/D/router) "
+        "pairwise fingerprint-equal via anti-entropy repair",
+        "workload": (
+            f"{int(100 * fp.get('drop_p', 0))}% seeded frame loss for "
+            f"{fp.get('drop_window_s', 0)}s + {fp.get('partition_s', 0)}s "
+            f"symmetric partition of {fp.get('partitioned_node')} while "
+            "routed requests keep flowing (inproc ring; see "
+            "workload.run_chaos_workload)"
+        ),
+        **res,
+    }
+
+
+def _chaos_pass() -> dict:
+    """The self-healing bench: run the chaos acceptance scenario and
+    write the round's ``CHAOS_r{N}.json`` (validated against the pinned
+    schema before writing — a violation is recorded in the artifact,
+    not silently shipped)."""
+    from radixmesh_tpu.workload import run_chaos_workload
+
+    res = run_chaos_workload()
+    report = build_chaos_report(res)
+    problems = validate_chaos(report)
+    if problems:
+        report["schema_violation"] = problems
+        log(f"chaos pass: SCHEMA VIOLATION {problems}")
+    path = os.path.join(_REPO, f"CHAOS_r{current_round():02d}.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1)
+    log(
+        f"chaos pass: wrote {os.path.basename(path)} "
+        f"(converged={report['repair']['converged']} in "
+        f"{report['repair']['converge_s']}s / "
+        f"{report['repair']['max_episode_rounds']} rounds, "
+        f"served_ok={report['served']['ok_rate_during_fault']}, "
+        f"quiet={report['quiescence']['quiet']})"
+    )
+    report["artifact"] = os.path.basename(path)
+    return report
+
+
+# ----------------------------------------------------------------------
 # KVFLOW stable schema (PR 4, async KV-movement plane): one artifact per
 # round recording restore-stall vs overlapped TTFT, write-back gather
 # fusion, and prefetch hit-ahead rate (radixmesh_tpu/cache/kv_transfer.py
@@ -1529,6 +1658,11 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — partial rounds must survive
         log(f"kvflow pass: FAILED {type(exc).__name__}: {exc}")
         kvflow = {"error": f"{type(exc).__name__}: {exc}"[:400]}
+    try:
+        chaos = _chaos_pass()
+    except Exception as exc:  # noqa: BLE001 — partial rounds must survive
+        log(f"chaos pass: FAILED {type(exc).__name__}: {exc}")
+        chaos = {"error": f"{type(exc).__name__}: {exc}"[:400]}
 
     print(json.dumps({
         "metric": "decode_tokens_per_sec_per_chip",
@@ -1559,6 +1693,7 @@ def main() -> None:
         "slo_overload": slo,
         "fleet": fleet,
         "kvflow": kvflow,
+        "chaos": chaos,
     }))
 
 

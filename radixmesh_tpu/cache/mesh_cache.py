@@ -244,6 +244,20 @@ class MeshCache:
         # Router-originated hints go over dedicated fire-and-forget
         # channels (routers never send on the ring, sync_algo.py:80-96).
         self._prefetch_comms: dict[int, Communicator] = {}
+        # Anti-entropy repair (cache/repair_plane.py): received
+        # REPAIR_PROBE/REPAIR_SUMMARY frames addressed to this node are
+        # funneled here (set to the plane's ``note_frame`` — must be
+        # cheap, it runs on the transport reader thread under the lock);
+        # sessions go over dedicated point-to-point channels, one per
+        # peer rank, dialed lazily (the prefetch-channel pattern,
+        # available to EVERY role — a router probes peers the same way).
+        self.on_repair = None
+        self._repair_comms: dict[int, Communicator] = {}
+        # Dropped-frame accounting hook: called (cause, kind_int) when a
+        # frame is lost on the outbound path (queue overflow or transmit
+        # failure). The repair plane arms an early probe from data-kind
+        # losses instead of waiting out the staleness threshold.
+        self.on_oplog_dropped = None
         # Fleet telemetry plane (obs/fleet_plane.py): every node — router
         # included — folds received DIGEST ops into this view; a
         # FleetPlane (launch.py --fleet-digest-interval) originates this
@@ -276,6 +290,16 @@ class MeshCache:
             "oplogs dropped on outbound-queue overflow",
             ("node",),
         ).labels(node=node)
+        # Loss accounting with the failure mode attached: WHAT was lost
+        # (op kind) and WHY (queue_full = backlogged successor; transmit
+        # = the sender-loop exception path). Children resolve lazily —
+        # drops are the cold path by definition.
+        self._m_dropped_by = reg.counter(
+            "radixmesh_oplog_dropped_total",
+            "oplog frames lost on the outbound path, by cause and kind "
+            "(data-kind losses arm an early anti-entropy repair probe)",
+            ("node", "cause", "kind"),
+        )
         self._m_prefetch_sent = reg.counter(
             "radixmesh_mesh_prefetch_sent_total",
             "PREFETCH restore hints originated by this node",
@@ -385,7 +409,9 @@ class MeshCache:
             for router_addr in self.cfg.router_nodes:
                 self._router_comms.append(
                     create_communicator(
-                        self.cfg.protocol, None, router_addr, self.cfg.max_msg_bytes
+                        self.cfg.protocol, None, router_addr,
+                        self.cfg.max_msg_bytes,
+                        src_hint=self.cfg.local_addr,
                     )
                 )
         next_addr = topo.next_node
@@ -419,6 +445,7 @@ class MeshCache:
                     None,
                     None if sp is None else self.cfg.addr_of_rank(sp),
                     self.cfg.max_msg_bytes,
+                    src_hint=self.cfg.local_addr,
                 )
             else:
                 self._succ_rank = self.view.successor_of(self.rank)
@@ -538,6 +565,8 @@ class MeshCache:
             c.close()
         for c in self._prefetch_comms.values():
             c.close()
+        for c in self._repair_comms.values():
+            c.close()
 
     # ------------------------------------------------------------------
     # public cache API
@@ -566,18 +595,7 @@ class MeshCache:
                 return 0
             key = key[:n]
             slot_indices = slot_indices[:n]
-            by_page = slot_indices.reshape(-1, self.page)
-            page_ids = by_page[:, 0] // self.page
-            expected = (
-                page_ids[:, None] * self.page
-                + np.arange(self.page, dtype=np.int32)[None, :]
-            )
-            if not np.array_equal(by_page, expected):
-                raise ValueError(
-                    "slot_indices are not page-contiguous at mesh "
-                    f"page_size={self.page}"
-                )
-            wire_value = page_ids.astype(np.int32)
+            wire_value = self._page_wire_value(slot_indices)
         value = PrefillValue(slot_indices, self.rank)
         with self._lock:
             prefix_len = self._mesh_insert(key, value)
@@ -738,6 +756,11 @@ class MeshCache:
                 return
             if op.op_type is OplogType.PREFETCH:
                 self._handle_prefetch(op, data)
+                return
+            if op.op_type in (
+                OplogType.REPAIR_PROBE, OplogType.REPAIR_SUMMARY,
+            ):
+                self._handle_repair(op)
                 return
             if op.op_type is OplogType.TICK:
                 # Counted before the origin-drop so the originator observes
@@ -1098,6 +1121,7 @@ class MeshCache:
                 None,
                 self.cfg.addr_of_rank(target_rank),
                 self.cfg.max_msg_bytes,
+                src_hint=self.cfg.local_addr,
             )
         except Exception:  # noqa: BLE001
             self.log.exception(
@@ -1138,6 +1162,173 @@ class MeshCache:
             "ttl": int(self._m_evicted["ttl"].value),
             "mesh_trim": int(self._m_evicted["mesh_trim"].value),
         }
+
+    # ------------------------------------------------------------------
+    # anti-entropy repair (cache/repair_plane.py)
+    # ------------------------------------------------------------------
+
+    def _handle_repair(self, op: Oplog) -> None:
+        """Caller holds the lock; ttl already decremented. REPAIR frames
+        are point-to-point (dedicated channels, one hop) — never
+        circulated. The sink only enqueues; the repair plane's worker
+        does the tree walks and replies off this thread."""
+        if op.value_rank not in (-1, self.rank):
+            if throttled(("repair_misaddressed", self.rank),
+                         self.cfg.tick_interval_s):
+                self.log.warning(
+                    "repair frame for rank %d landed on rank %d — dropping",
+                    op.value_rank, self.rank,
+                )
+            return
+        if self.on_repair is not None:
+            try:
+                self.on_repair(op)
+            except Exception:  # noqa: BLE001 — a sink bug must not kill the reader
+                self.log.exception("repair sink failed")
+
+    def send_repair(self, target_rank: int, op_type: OplogType,
+                    value: np.ndarray) -> bool:
+        """Fire one repair frame at ``target_rank``'s cache address over
+        a dedicated channel. Best-effort by contract: a lost frame just
+        means another probe after backoff, so the send is short-deadline
+        and unacknowledged. Returns whether a transport took it."""
+        comm = self._repair_channel(target_rank)
+        if comm is None:
+            return False
+        op = Oplog(
+            op_type=op_type,
+            origin_rank=self.rank,
+            logic_id=self._logic_op.next(),
+            ttl=1,  # point-to-point: one hop
+            value=np.asarray(value, dtype=np.int32),
+            value_rank=target_rank,
+            ts=time.time(),
+        )
+        try:
+            return bool(comm.try_send(serialize(op), 0.25))
+        except Exception:  # noqa: BLE001 — repair frames are droppable by contract
+            if throttled(("repair_tx", self.rank, target_rank),
+                         self.cfg.failure_timeout_s):
+                self.log.warning(
+                    "repair channel to rank %d failed", target_rank
+                )
+            return False
+
+    def _repair_channel(self, target_rank: int) -> Communicator | None:
+        """Lazily-opened send-only channel to ``target_rank``'s cache
+        address — the prefetch-channel pattern, but role-agnostic (a
+        router probes peers; a P/D node answers a router's probe at the
+        router's bind address). Dialed OUTSIDE the mesh lock: the
+        transport reader thread needs that lock to apply oplogs."""
+        n_total = self.cfg.num_ring + len(self.cfg.router_nodes)
+        if not 0 <= target_rank < n_total or target_rank == self.rank:
+            return None
+        with self._lock:
+            comm = self._repair_comms.get(target_rank)
+        if comm is not None:
+            return comm
+        try:
+            comm = create_communicator(
+                self.cfg.protocol,
+                None,
+                self.cfg.addr_of_rank(target_rank),
+                self.cfg.max_msg_bytes,
+                src_hint=self.cfg.local_addr,
+            )
+        except Exception:  # noqa: BLE001
+            self.log.exception(
+                "repair channel to rank %d failed to dial", target_rank
+            )
+            return None
+        with self._lock:
+            existing = self._repair_comms.setdefault(target_rank, comm)
+        if existing is not comm:
+            comm.close()
+        return existing
+
+    def repair_push_keys(
+        self, buckets, exclude_hashes: set[int], budget: int
+    ) -> tuple[int, int]:
+        """Re-replicate this replica's entries touching ``buckets``
+        whose path hash is NOT in ``exclude_hashes`` (= the peer's side
+        of the summary exchange) as ORDINARY idempotent INSERT oplogs on
+        the ring — the existing conflict-resolution path applies them,
+        and the master's fan-out carries them to the router, so one
+        push heals every replica. Bounded by ``budget`` entries.
+        Returns (entries pushed, oplogs enqueued). Routers hold no
+        indices and never ring-send: always (0, 0) there."""
+        if self.role is NodeRole.ROUTER or not buckets:
+            return 0, 0
+        keys = oplogs = 0
+        with self._lock:
+            for node in self.tree.nodes_touching_buckets(buckets):
+                if keys >= budget:
+                    break
+                if self.tree.path_hash(node) in exclude_hashes:
+                    continue
+                n_ops = self._reemit_entry(node)
+                if n_ops:
+                    keys += 1
+                    oplogs += n_ops
+        return keys, oplogs
+
+    def _reemit_entry(self, node: TreeNode) -> int:
+        """Re-broadcast the full root→``node`` path as INSERT oplogs,
+        one per maximal same-rank run of path segments, emitted
+        root-first (caller holds the lock, so the data lane preserves
+        that order end-to-end). Root-first matters: value ranks along a
+        path are non-decreasing with depth (a deeper position's owner is
+        the min over a SUBSET of the prefix's writers), so by the time a
+        run's frame applies anywhere, its prefix positions already hold
+        values of strictly lower rank — the run's value can only land on
+        its own span, with its own correct indices. Returns oplogs
+        enqueued (0 when the path isn't re-emittable)."""
+        path: list[TreeNode] = []
+        n = node
+        while n is not None and n is not self.tree.root:
+            path.append(n)
+            n = n.parent
+        path.reverse()
+        if not path or any(
+            not isinstance(p.value, PrefillValue) for p in path
+        ):
+            return 0  # router values / evicted spans carry no indices
+        full_key = np.concatenate([p.key for p in path])
+        full_idx = np.concatenate([p.value.indices for p in path])
+        # Maximal same-rank runs over the path's segments.
+        run_ends: list[tuple[int, int]] = []  # (end position, rank)
+        end = 0
+        for p in path:
+            end += len(p.key)
+            rank = p.value.rank
+            if run_ends and run_ends[-1][1] == rank:
+                run_ends[-1] = (end, rank)
+            else:
+                run_ends.append((end, rank))
+        sent = 0
+        for end, rank in run_ends:
+            wire_value = full_idx[:end]
+            if self.page > 1:
+                try:
+                    wire_value = self._page_wire_value(full_idx[:end])
+                except ValueError:
+                    # A pre-v3 token-granular stray: not representable on
+                    # this wire — skip the entry rather than corrupt it.
+                    return sent
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.INSERT,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self._data_ttl(),
+                    key=full_key[:end],
+                    value=wire_value,
+                    value_rank=rank,
+                    page=self.page,
+                )
+            )
+            sent += 1
+        return sent
 
     def _adopt_view(self, view: TopologyView) -> bool:
         """Adopt ``view`` if it supersedes the current one (higher epoch
@@ -1351,6 +1542,7 @@ class MeshCache:
             evt.set()
         except queue.Full:
             self._m_dropped.inc()
+            self._note_drop(data, "queue_full")
             dropped = int(self._m_dropped.value)
             if dropped % 1000 == 1:
                 self.log.error(
@@ -1358,6 +1550,28 @@ class MeshCache:
                     "unreachable for an extended period?",
                     dropped,
                 )
+
+    def _note_drop(self, data: bytes, cause: str) -> None:
+        """Account a lost outbound frame by cause AND op kind (the kind
+        byte sits at a fixed wire offset, so no deserialize on this
+        path), then fire the recovery hook: a DATA-kind loss means some
+        downstream replica is now known-diverged, so the repair plane
+        arms an early probe instead of waiting out the fingerprint
+        staleness threshold."""
+        kind_int = data[2] if len(data) > 2 else -1
+        try:
+            kind = OplogType(kind_int).name
+        except ValueError:
+            kind = str(kind_int)
+        self._m_dropped_by.labels(
+            node=self._node_label, cause=cause, kind=kind
+        ).inc()
+        cb = self.on_oplog_dropped
+        if cb is not None:
+            try:
+                cb(cause, kind_int)
+            except Exception:  # noqa: BLE001 — a hook bug must not lose more frames
+                self.log.exception("oplog-dropped hook failed")
 
     def _sender(self) -> None:
         """Dedicated transmit thread: the only place the control plane
@@ -1446,6 +1660,11 @@ class MeshCache:
                         ("tx_fail", self.rank, dest), self.cfg.failure_timeout_s
                     ):
                         self.log.exception("failed to transmit oplog")
+                    # The frame is LOST (this break abandons it): account
+                    # the loss with its op kind and let the repair plane
+                    # arm an early probe for data-kind frames.
+                    if not self._stop.is_set():
+                        self._note_drop(data, "transmit")
                     break
                 self._declare_successor_dead(dest)
             # The CURRENT view master fans out to routers (generalizes the
@@ -1510,6 +1729,24 @@ class MeshCache:
     # ------------------------------------------------------------------
     # tree mutation with conflict resolution
     # ------------------------------------------------------------------
+
+    def _page_wire_value(self, slot_indices: np.ndarray) -> np.ndarray:
+        """Compress per-token slot indices to one page id per
+        ``self.page`` tokens for the v3 wire (requires within-page slot
+        contiguity — the paged allocator's invariant; raises on a
+        violation so a misaligned caller fails at the source)."""
+        by_page = np.asarray(slot_indices, dtype=np.int32).reshape(-1, self.page)
+        page_ids = by_page[:, 0] // self.page
+        expected = (
+            page_ids[:, None] * self.page
+            + np.arange(self.page, dtype=np.int32)[None, :]
+        )
+        if not np.array_equal(by_page, expected):
+            raise ValueError(
+                "slot_indices are not page-contiguous at mesh "
+                f"page_size={self.page}"
+            )
+        return page_ids.astype(np.int32)
 
     def _mesh_insert(self, key: np.ndarray, value) -> int:
         """Insert with rank-conflict resolution via the tree's conflict

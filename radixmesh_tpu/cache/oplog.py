@@ -31,6 +31,8 @@ import numpy as np
 
 __all__ = [
     "OplogType",
+    "EXTENSION_KINDS",
+    "DATA_KINDS",
     "GCEntry",
     "Oplog",
     "NodeKey",
@@ -128,6 +130,43 @@ class OplogType(enum.IntEnum):
     # fleet carries it (the same finish-the-roll discipline as the v3
     # wire features above).
     PREFETCH = 11
+    # Anti-entropy repair extension (cache/repair_plane.py): a node that
+    # observes a stale fingerprint divergence with a peer opens a
+    # bounded repair session over a dedicated point-to-point channel
+    # (the PREFETCH router-channel pattern). PROBE carries the
+    # initiator's 64-bucket fingerprint vector; SUMMARY answers with the
+    # responder's vector plus key-hash summaries for the diverged
+    # buckets, letting each side re-replicate ONLY its one-sided entries
+    # as ordinary idempotent INSERT oplogs on the ring (existing
+    # conflict-resolution path — repair introduces no new apply
+    # semantics). Both are droppable by contract: a lost frame just
+    # means another probe after backoff. value_rank addresses the
+    # target; old wires see unknown ints and forward/ignore
+    # (EXTENSION_KINDS below).
+    REPAIR_PROBE = 12
+    REPAIR_SUMMARY = 13
+
+
+# Kinds added AFTER the unknown-kind pass-through tolerance shipped:
+# a peer running any post-PREFETCH build deserializes these to raw ints
+# when it predates them, forwards them untouched, and never breaks —
+# the forward-compat contract every new kind must register under
+# (lint-pinned by tests/test_mesh_lint.py). Kinds NOT listed here
+# predate the tolerance and are safe on every wire.
+EXTENSION_KINDS = frozenset(
+    {
+        OplogType.PREFETCH,
+        OplogType.REPAIR_PROBE,
+        OplogType.REPAIR_SUMMARY,
+    }
+)
+# Kinds that carry replicated cache DATA: losing one of these frames
+# diverges a replica until repair (or a lucky re-insert) heals it.
+# The dropped-frame accounting (``mesh_cache._send_bytes`` /
+# ``_sender_loop``) arms an early repair probe exactly for these.
+DATA_KINDS = frozenset(
+    {OplogType.INSERT, OplogType.DELETE, OplogType.RESET}
+)
 
 
 @dataclass

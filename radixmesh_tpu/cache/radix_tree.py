@@ -37,6 +37,7 @@ __all__ = [
     "BlockStored",
     "BlockRemoved",
     "AllBlocksCleared",
+    "FP_BUCKETS",
 ]
 
 _node_ids = itertools.count()
@@ -64,6 +65,20 @@ _node_ids = itertools.count()
 _FP_MULT = np.uint64(0x9E3779B97F4A7C15)  # odd → invertible mod 2^64
 _FP_MULT_INV = np.uint64(pow(0x9E3779B97F4A7C15, -1, 1 << 64))
 _FP_SEED = np.uint64(0x243F6A8885A308D3)  # root chain value
+
+# Anti-entropy bucket count (cache/repair_plane.py): every mixed
+# contribution word ALSO XOR-folds into bucket ``word mod FP_BUCKETS``
+# of a fixed-width vector, so two diverged replicas can localize their
+# difference to a handful of buckets instead of re-walking whole trees
+# (Merkle-style level-1 partition, DeCandia et al. 2007 §4.7). 64
+# buckets × 8 bytes = 512 B — the wire ceiling the repair PROBE frame
+# budgets for. The assignment uses the splitmix64-mixed word (not the
+# raw chain value), so buckets inherit the chain hash's diffusion, stay
+# insert-order-independent (XOR), and stay split-invariant (a split
+# partitions a node's chain array; the contribution multiset — and thus
+# every bucket — is unchanged). The scalar ``fingerprint_`` is always
+# the XOR-reduce of the bucket vector (both maintained incrementally).
+FP_BUCKETS = 64
 
 
 def _chain_hashes(start: np.uint64, tokens: np.ndarray) -> np.ndarray:
@@ -334,6 +349,9 @@ class RadixTree:
         # tree (see module comment): XOR of every node's per-token mixed
         # chain hashes, maintained incrementally on insert/delete/evict.
         self.fingerprint_ = 0
+        # Per-bucket partition of the same contributions (FP_BUCKETS
+        # module comment): fingerprint_ == XOR-reduce(fp_buckets_).
+        self.fp_buckets_ = np.zeros(FP_BUCKETS, dtype=np.uint64)
         if self.enable_events:
             self._events.append(AllBlocksCleared())
 
@@ -643,6 +661,20 @@ class RadixTree:
         same value; any divergent leaf flips it (w.h.p.)."""
         return self.fingerprint_
 
+    def _fp_fold(self, chain: np.ndarray) -> None:
+        """XOR ``chain``'s mixed contributions into both the scalar
+        fingerprint and the bucket vector (self-inverse: attach and
+        detach are the same fold)."""
+        if len(chain) == 0:
+            return
+        mixed = _mix64(chain)
+        self.fingerprint_ ^= int(np.bitwise_xor.reduce(mixed))
+        np.bitwise_xor.at(
+            self.fp_buckets_,
+            (mixed % np.uint64(FP_BUCKETS)).astype(np.int64),
+            mixed,
+        )
+
     def _fp_attach(self, node: TreeNode) -> None:
         """Compute ``node.chain`` from its parent's path and fold the
         node's contribution into the fingerprint. Called exactly once per
@@ -654,12 +686,54 @@ class RadixTree:
             else _FP_SEED
         )
         node.chain = _chain_hashes(start, node.key)
-        self.fingerprint_ ^= _node_contribution(node.chain)
+        self._fp_fold(node.chain)
 
     def _fp_detach(self, node: TreeNode) -> None:
         """Remove ``node``'s contribution (it is leaving the tree)."""
-        self.fingerprint_ ^= _node_contribution(node.chain)
+        self._fp_fold(node.chain)
         node.chain = np.empty(0, dtype=np.uint64)
+
+    def fingerprint_buckets(self) -> np.ndarray:
+        """Copy of the 64-entry bucket vector (uint64) — the repair
+        plane's PROBE payload. Pairwise-equal vectors ⇔ (w.h.p.) equal
+        key sets; a diverged pair localizes the difference to the
+        unequal buckets."""
+        return self.fp_buckets_.copy()
+
+    @staticmethod
+    def path_hash(node: TreeNode) -> int:
+        """Order-stable 64-bit identity of the full root→``node`` token
+        path — equal across replicas REGARDLESS of how each replica's
+        node boundaries fell (the chain value is a pure function of the
+        path). The repair-plane key-summary currency."""
+        if len(node.chain) == 0:
+            return 0
+        return int(_mix64(node.chain[-1:])[0])
+
+    def nodes_touching_buckets(self, buckets) -> list[TreeNode]:
+        """Tree nodes (root excluded) whose fingerprint contributions
+        land in any of ``buckets`` — the candidates a repair session
+        summarizes/re-replicates for those diverged buckets. A node
+        whose KEY differs between replicas necessarily contributes to a
+        diverged bucket, so this enumeration cannot miss the defect; it
+        may include converged bystanders sharing a bucket (harmless:
+        their summaries match and nothing is pushed)."""
+        want = np.zeros(FP_BUCKETS, dtype=bool)
+        for b in buckets:
+            if 0 <= int(b) < FP_BUCKETS:
+                want[int(b)] = True
+        if not want.any():
+            # The converged-probe steady state: an empty diff must cost
+            # O(1), not a full-tree rehash under the caller's mesh lock.
+            return []
+        out = []
+        for n in self._all_nodes():
+            if n is self.root or len(n.chain) == 0:
+                continue
+            idx = (_mix64(n.chain) % np.uint64(FP_BUCKETS)).astype(np.int64)
+            if want[idx].any():
+                out.append(n)
+        return out
 
     # ---- introspection (reference radix_cache.py:172-177,232-248,354-364) ----
 

@@ -79,19 +79,35 @@ def create_communicator(
     bind_addr: str | None,
     target_addr: str | None,
     max_msg_bytes: int = DEFAULT_MAX_MSG_BYTES,
+    src_hint: str | None = None,
 ) -> Communicator:
     """Build a transport endpoint. ``bind_addr=None`` → send-only;
-    ``target_addr=None`` → listen-only."""
+    ``target_addr=None`` → listen-only.
+
+    This factory is ALSO the chaos seam (``comm/faults.py``): when a
+    :class:`~radixmesh_tpu.comm.faults.FaultPlan` is installed, the
+    returned endpoint is wrapped in a ``FaultyCommunicator`` that drops,
+    delays, duplicates, reorders, partitions, or crashes sends per the
+    plan's seeded schedule — product code above this seam never knows.
+    ``src_hint`` names the owning node for send-only channels (whose
+    ``bind_addr`` is None), so symmetric partitions cut their outbound
+    traffic too; it has no effect without an armed plan."""
     if protocol == "inproc":
         from radixmesh_tpu.comm.inproc import InprocCommunicator
 
-        return InprocCommunicator(bind_addr, target_addr)
-    if protocol == "tcp-py":
+        comm: Communicator = InprocCommunicator(bind_addr, target_addr)
+    elif protocol == "tcp-py":
         from radixmesh_tpu.comm.tcp_py import PyTcpCommunicator
 
-        return PyTcpCommunicator(bind_addr, target_addr, max_msg_bytes)
-    if protocol == "tcp":
+        comm = PyTcpCommunicator(bind_addr, target_addr, max_msg_bytes)
+    elif protocol == "tcp":
         from radixmesh_tpu.comm.tcp_native import NativeTcpCommunicator
 
-        return NativeTcpCommunicator(bind_addr, target_addr, max_msg_bytes)
-    raise ValueError(f"unknown protocol {protocol!r}; known: inproc, tcp, tcp-py")
+        comm = NativeTcpCommunicator(bind_addr, target_addr, max_msg_bytes)
+    else:
+        raise ValueError(
+            f"unknown protocol {protocol!r}; known: inproc, tcp, tcp-py"
+        )
+    from radixmesh_tpu.comm import faults
+
+    return faults.maybe_wrap(comm, src=bind_addr or src_hint, dst=target_addr)

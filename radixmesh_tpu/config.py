@@ -89,6 +89,27 @@ class MeshConfig:
     # counters). 0 disables — cache semantics tolerate either choice;
     # TTL bounds staleness rather than size (mesh_max_tokens does that).
     mesh_ttl_s: float = 0.0
+    # Anti-entropy repair plane (cache/repair_plane.py): scan cadence
+    # for comparing this node's tree fingerprint against the fleet's
+    # gossiped digests and opening bounded repair sessions with stale-
+    # diverged peers. 0 disables the plane (divergence is then only
+    # DETECTED, the PR 3 behavior). Requires digest gossip
+    # (digest_interval_s / --fleet-digest-interval) to see peers.
+    repair_interval_s: float = 0.0
+    # How long a pairwise divergence must persist before a probe fires
+    # (transients heal via live replication; probing them is waste).
+    repair_age_threshold_s: float = 10.0
+    # Per-session storm-control bounds: entries re-replicated per
+    # summary, and the exponential-backoff base between rounds against
+    # one peer (doubles per round, capped at 30x the base).
+    repair_key_budget: int = 256
+    repair_backoff_s: float = 2.0
+    # Chaos/fault-injection plane (comm/faults.py): a FaultPlan spec
+    # (``FaultPlan.from_dict`` schema) installed at the transport seam
+    # before this node opens any channel. Empty = no faults — the only
+    # sane production value; populated ONLY by tests, soaks, and
+    # chaos drills. launch.py --chaos-plan FILE overrides.
+    chaos: dict[str, Any] = field(default_factory=dict)
     # Async KV-movement plane (cache/kv_transfer.py): serving nodes
     # stage host-tier restores / eviction write-backs / disagg handoff
     # placement off the scheduling thread. Off = the synchronous seed
@@ -228,6 +249,15 @@ class MeshConfig:
         all_nodes = self.prefill_nodes + self.decode_nodes + self.router_nodes
         if len(set(all_nodes)) != len(all_nodes):
             raise ValueError("node addresses must be unique across roles")
+        if self.repair_interval_s < 0 or self.repair_age_threshold_s < 0:
+            raise ValueError("repair timers must be >= 0")
+        if self.repair_key_budget < 1:
+            raise ValueError("repair_key_budget must be >= 1")
+        if self.repair_backoff_s <= 0:
+            # A non-positive backoff disables the exponential round
+            # pacing entirely — the probe storm the plane's storm-control
+            # invariants exist to prevent.
+            raise ValueError("repair_backoff_s must be > 0")
         if self.model:
             # Serving deployments derive each P/D node's HTTP port as
             # cache port + offset: both must be bindable and disjoint
@@ -276,6 +306,11 @@ def load_config(path: str) -> MeshConfig:
         "tick_interval_s",
         "failure_timeout_s",
         "startup_grace_s",
+        "repair_interval_s",
+        "repair_age_threshold_s",
+        "repair_key_budget",
+        "repair_backoff_s",
+        "chaos",
         "kv_transfer_async",
         "kv_transfer_chunk_tokens",
         "kv_transfer_min_restore_tokens",
@@ -309,6 +344,11 @@ def load_config(path: str) -> MeshConfig:
             if raw.get("startup_grace_s") is None
             else float(raw["startup_grace_s"])
         ),
+        repair_interval_s=float(raw.get("repair_interval_s", 0.0)),
+        repair_age_threshold_s=float(raw.get("repair_age_threshold_s", 10.0)),
+        repair_key_budget=int(raw.get("repair_key_budget", 256)),
+        repair_backoff_s=float(raw.get("repair_backoff_s", 2.0)),
+        chaos=dict(raw.get("chaos", {}) or {}),
         kv_transfer_async=bool(raw.get("kv_transfer_async", False)),
         kv_transfer_chunk_tokens=int(raw.get("kv_transfer_chunk_tokens", 512)),
         kv_transfer_min_restore_tokens=int(

@@ -1031,6 +1031,10 @@ class Engine:
             req.output_tokens = [int(tok)]
             self._tokens[req.row] = int(tok)
             self._record_first_token(req)
+            # Wake streamers parked on the request condition: this is
+            # THE first-token site, and the next _consume_token notify
+            # may be a whole decode wave away.
+            req.note_progress()
 
     def _prefill_dense(
         self,
@@ -1886,6 +1890,10 @@ class Engine:
             return True
         self._m_generated.inc()
         self._tokens[row] = token
+        # Streaming waiters block on the request condition instead of
+        # polling (server/http_frontend.py) — wake them per token so
+        # first-token latency isn't quantized by a poll interval.
+        req.note_progress()
         return False
 
     def _preempt(self, req: Request) -> None:

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import enum
 import itertools
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -85,6 +86,31 @@ class Request:
     # queue-wait span starts HERE, not at the original submit — the
     # first life's prefill+decode must not render as queue wait.
     requeue_time: float = 0.0
+    # Per-request progress wake (server/http_frontend.py): waiters block
+    # on this instead of polling, so streamed first-token latency is not
+    # quantized by a poll interval and idle waiters don't spin. Notified
+    # by note_progress() on each appended token and — via __setattr__ —
+    # on ANY transition to FINISHED, so no finish site can strand a
+    # waiter.
+    cond: threading.Condition = field(
+        default_factory=threading.Condition, repr=False, compare=False
+    )
+
+    def note_progress(self) -> None:
+        """Wake every thread blocked on this request (new token landed /
+        state advanced). Cheap: one uncontended lock round-trip."""
+        with self.cond:
+            self.cond.notify_all()
+
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if name == "state" and value is RequestState.FINISHED:
+            # dict lookup, not attribute access: during dataclass
+            # __init__ the state field is assigned before cond exists.
+            cond = self.__dict__.get("cond")
+            if cond is not None:
+                with cond:
+                    cond.notify_all()
 
     @property
     def next_token(self) -> int:

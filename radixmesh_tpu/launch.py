@@ -89,6 +89,29 @@ def _run_node(args: argparse.Namespace) -> int:
     log = get_logger("launch")
     _configure_tracing(args)
 
+    # Chaos/fault-injection plane (comm/faults.py): installed BEFORE the
+    # node opens any transport so every channel — ring, spine, router
+    # fan-out, prefetch, repair — passes the seam. Drill/soak tooling
+    # only; production configs leave it empty.
+    chaos_spec = None
+    if args.chaos_plan:
+        import json as _json
+
+        with open(args.chaos_plan) as fh:
+            chaos_spec = _json.load(fh)
+    elif cfg.chaos:
+        chaos_spec = cfg.chaos
+    if chaos_spec:
+        from radixmesh_tpu.comm.faults import FaultPlan, install
+
+        plan = FaultPlan.from_dict(chaos_spec)
+        install(plan)
+        log.warning(
+            "CHAOS PLAN ARMED (seed=%d, drop_p=%.2f, %d partitions) — "
+            "transports on this node will misbehave on schedule",
+            plan.seed, plan.drop_p, len(plan.partitions),
+        )
+
     # A P/D node with a ``model:`` section is a SERVING node: one shared KV
     # pool, an Engine that owns slot lifetime, and an advertisement-only
     # MeshCache (pool=None — the engine frees slots, the mesh must not)
@@ -236,6 +259,37 @@ def _run_node(args: argparse.Namespace) -> int:
         ).start()
         log.info("fleet digests every %.1fs", digest_interval)
 
+    # Anti-entropy repair plane: every role runs one (routers probe and
+    # pull; they never push) — it closes the detect→repair loop the
+    # fleet digests open. Needs digest gossip to see peers: a P/D node
+    # that doesn't publish still folds received digests, so repair works
+    # as long as SOMEONE gossips.
+    repair_plane = None
+    repair_interval = (
+        args.repair_interval
+        if args.repair_interval is not None
+        else cfg.repair_interval_s
+    )
+    if repair_interval > 0:
+        from radixmesh_tpu.cache.repair_plane import RepairConfig, RepairPlane
+
+        repair_plane = RepairPlane(
+            node,
+            RepairConfig(
+                interval_s=repair_interval,
+                age_threshold_s=cfg.repair_age_threshold_s,
+                key_budget=cfg.repair_key_budget,
+                backoff_base_s=cfg.repair_backoff_s,
+                backoff_max_s=max(
+                    cfg.repair_backoff_s * 30.0, cfg.repair_backoff_s
+                ),
+            ),
+        ).start()
+        log.info(
+            "anti-entropy repair armed (scan %.1fs, stale after %.1fs)",
+            repair_interval, cfg.repair_age_threshold_s,
+        )
+
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -243,6 +297,8 @@ def _run_node(args: argparse.Namespace) -> int:
         while not stop.is_set():
             stop.wait(1.0)
     finally:
+        if repair_plane is not None:
+            repair_plane.close()
         if fleet_plane is not None:
             fleet_plane.close()
         if frontend is not None:
@@ -434,6 +490,21 @@ def main(argv: list[str] | None = None) -> int:
         help="router role: demote nodes whose gossiped health score drops "
         "below 0.5 (stall watchdog, replication lag, eviction storm) — "
         "cache hits shed past them and the hash-ring fallback skips them",
+    )
+    node.add_argument(
+        "--repair-interval", type=float, default=None, metavar="SECONDS",
+        help="anti-entropy repair scan cadence (cache/repair_plane.py): "
+        "compare this node's tree fingerprint against gossiped digests "
+        "and open bounded repair sessions with stale-diverged peers; "
+        "overrides the config's repair_interval_s; 0 disables (detect-"
+        "only). Needs --fleet-digest-interval somewhere in the fleet",
+    )
+    node.add_argument(
+        "--chaos-plan", default=None, metavar="FILE",
+        help="ARM FAULT INJECTION from a FaultPlan JSON file "
+        "(comm/faults.py): seeded frame drops, delays, duplicates, "
+        "reordering, scheduled partitions, channel crashes — applied to "
+        "every transport this node opens. Drills and soak runs only",
     )
     node.add_argument(
         "--kv-prefetch-hints", action="store_true",

@@ -347,6 +347,28 @@ class FleetView:
         with self._lock:
             return dict(self._digests)
 
+    def fingerprints(self) -> dict[int, int]:
+        """rank → last-gossiped tree fingerprint: the anti-entropy
+        repair plane's scan input (one lock hold, no digest copies —
+        the scan runs every repair interval on every node)."""
+        with self._lock:
+            return {r: d.fingerprint for r, d in self._digests.items()}
+
+    def diverged_with(self, rank: int) -> dict[int, float]:
+        """Peers currently fingerprint-diverged from ``rank``, with
+        seconds since each pair was first seen unequal — the per-node
+        slice of :meth:`convergence` a repair operator (or /debug
+        tooling) asks for when ONE node is under suspicion."""
+        now = self._now()
+        out: dict[int, float] = {}
+        with self._lock:
+            for (a, b), since in self._diverged_at.items():
+                if rank == a:
+                    out[b] = max(0.0, now - since)
+                elif rank == b:
+                    out[a] = max(0.0, now - since)
+        return out
+
     def convergence(self) -> dict:
         """Pairwise ``convergence_age_seconds``: 0.0 for agreeing pairs,
         else seconds since their fingerprints were first seen unequal."""

@@ -33,6 +33,7 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "TOKEN_LEN_BUCKETS",
     "TRANSFER_SECONDS_BUCKETS",
+    "REPAIR_SECONDS_BUCKETS",
 ]
 
 # Latency-oriented default buckets (seconds): 1ms .. 60s.
@@ -55,6 +56,17 @@ TRANSFER_SECONDS_BUCKETS: tuple[float, ...] = (
 # BASELINE.json "configs") — shared by every hit-length/match-length
 # histogram so dashboards can compare them bucket-for-bucket.
 TOKEN_LEN_BUCKETS: tuple[float, ...] = tuple(float(1 << i) for i in range(16))
+
+# Anti-entropy repair buckets (seconds): a repair round spans probe →
+# summary exchange → ring re-publication, so its latency rides the ring
+# (ms on inproc/loopback) plus the peer's backoff schedule (seconds to
+# a minute) — a wider band than DEFAULT_BUCKETS resolves at the top end
+# and than TRANSFER_SECONDS_BUCKETS covers at all. Shared by
+# cache/repair_plane.py so every node bins rounds identically.
+REPAIR_SECONDS_BUCKETS: tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
 
 
 def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:

@@ -26,6 +26,12 @@ This module supplies the per-request causal timeline:
   ``kv_handoff_stage`` (disagg placement staged off the reader thread)
   — so a KV copy that DOES stall something shows up next to the decode
   chunks it delayed.
+- The anti-entropy repair plane (``cache/repair_plane.py``) records one
+  ``repair_round`` span per completed session on its ``repair:<node>``
+  lane (cat ``repair``: probe → answering summary, with the peer rank,
+  bucket count, and keys pushed as args) — so a repair storm, if one
+  ever got past the backoff limits, would be visible interleaved with
+  the request timelines it competes with.
 
 Ring replication lag carries NO trace id across the wire (no wire-format
 change): lag spans are derived receiver-side from the oplog's existing

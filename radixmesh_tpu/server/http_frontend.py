@@ -105,12 +105,26 @@ class EngineRunner:
             return self.engine.cancel(rid)
 
     def wait(self, req: Request, timeout: float | None = None) -> list[int]:
-        """Block until ``req`` finishes; returns its generated tokens."""
+        """Block until ``req`` finishes; returns its generated tokens.
+
+        Event-driven, not polled: the waiter parks on the request's
+        condition (``Request.cond``), which every finish transition
+        notifies via ``Request.__setattr__`` — so wake-up latency is the
+        notify cost, not a poll quantum, and idle waiters don't spin.
+        A coarse 1 s fallback re-check guards against a waiter racing in
+        between the state write and the notify."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        while req.state is not RequestState.FINISHED:
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"request {req.rid} not finished in time")
-            time.sleep(0.002)
+        with req.cond:
+            while req.state is not RequestState.FINISHED:
+                if deadline is None:
+                    remaining = 1.0
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"request {req.rid} not finished in time"
+                        )
+                req.cond.wait(timeout=min(remaining, 1.0))
         return req.generated
 
     def tokens_so_far(self, req: Request) -> list[int]:
@@ -674,7 +688,17 @@ class ServingFrontend:
                                 output_tokens=len(final),
                             )
                         return
-                    time.sleep(0.005)
+                    # Park until the next token lands (or the request
+                    # finishes) — the engine notifies per consumed token,
+                    # so first-token latency is not quantized by a poll
+                    # interval. The 0.5 s fallback re-check covers a
+                    # notify racing the length read above.
+                    with req.cond:
+                        if (
+                            len(req.output_tokens) <= sent
+                            and req.state is not RequestState.FINISHED
+                        ):
+                            req.cond.wait(timeout=0.5)
 
         self._server = _FrontendServer((host, port), Handler)
         self.port = self._server.server_address[1]

@@ -680,6 +680,263 @@ def run_fleet_churn_workload(
         InprocHub.reset_default()
 
 
+def run_chaos_workload(
+    drop_p: float = 0.2,
+    partition_s: float = 10.0,
+    partition_delay_s: float = 1.0,
+    digest_interval_s: float = 0.25,
+    repair_interval_s: float = 0.2,
+    age_threshold_s: float = 0.5,
+    n_requests: int = 150,
+    key_len: int = 16,
+    seed: int = 0,
+    round_budget: int = 8,
+    quiesce_window_s: float = 2.0,
+    timeout_s: float = 90.0,
+) -> dict:
+    """The chaos acceptance scenario (``bench.validate_chaos`` pins its
+    artifact): a seeded FaultPlan injects ``drop_p`` frame loss across
+    the whole fault window plus a symmetric ``partition_s`` partition of
+    one prefill node, while routed requests keep flowing —
+
+    1. **Serve through the fault.** Each simulated request routes at the
+       cache-aware router and inserts+matches at the routed node; the
+       success rate during the fault window is recorded (the partition
+       impairs *replication*, never local serving).
+    2. **Diverge.** Dropped INSERT frames permanently diverge replicas;
+       the gossiped fingerprints detect it (peak diverged pairs + max
+       convergence age recorded).
+    3. **Repair.** After the partition heals, the anti-entropy repair
+       plane (``cache/repair_plane.py``) must converge ALL replicas —
+       both prefills, the decode node, and the router — to pairwise
+       equal fingerprints within ``round_budget`` repair rounds.
+    4. **Quiesce.** Once converged, a ``quiesce_window_s`` observation
+       window must record ZERO further repair traffic (probes and
+       summaries frozen) — repair can never storm a healthy ring.
+
+    Deterministic by seeding: the FaultPlan's per-edge RNGs and the
+    request stream derive from ``seed``; waits are deadline-bounded
+    polls, never bare sleeps asserting timing."""
+    import time as _time
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.cache.repair_plane import RepairConfig, RepairPlane
+    from radixmesh_tpu.comm import faults
+    from radixmesh_tpu.comm.inproc import InprocHub
+    from radixmesh_tpu.config import MeshConfig, NodeRole
+    from radixmesh_tpu.obs.fleet_plane import FleetPlane
+    from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+    def wait_for(pred, timeout=timeout_s, interval=0.02):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(interval)
+        return pred()
+
+    rng = np.random.default_rng(seed)
+    t_start = _time.monotonic()
+    InprocHub.reset_default()
+    prefill, decode, router_addrs = ["cp0", "cp1"], ["cd0"], ["cr0"]
+    partitioned = prefill[1]
+    fault_end_s = partition_delay_s + partition_s
+    plan = faults.FaultPlan(
+        seed=seed,
+        drop_p=drop_p,
+        drop_end_s=fault_end_s,
+        partitions=(
+            faults.PartitionSpec(
+                start_s=partition_delay_s,
+                end_s=fault_end_s,
+                addrs=(partitioned,),
+            ),
+        ),
+    )
+    nodes: list = []
+    fleet_planes: list = []
+    repair_planes: list = []
+    try:
+        with faults.injected(plan):
+            for addr in prefill + decode + router_addrs:
+                cfg = MeshConfig(
+                    prefill_nodes=prefill,
+                    decode_nodes=decode,
+                    router_nodes=router_addrs,
+                    local_addr=addr,
+                    protocol="inproc",
+                    tick_interval_s=0.1,
+                    gc_interval_s=60.0,
+                    # The partition must read as replication loss, not
+                    # membership churn: keep failure detection out of
+                    # the fault window.
+                    failure_timeout_s=max(60.0, 4.0 * fault_end_s),
+                )
+                nodes.append(MeshCache(cfg, pool=None).start())
+            for n in nodes:
+                if not n.wait_ready(timeout=timeout_s):
+                    raise RuntimeError(f"node {n.rank} never passed the barrier")
+            ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+            router_mesh = nodes[-1]
+            by_addr = {n.cfg.local_addr: n for n in ring}
+            fleet_planes = [
+                FleetPlane(n, interval_s=digest_interval_s).start()
+                for n in ring
+            ]
+            repair_planes = [
+                RepairPlane(
+                    n,
+                    RepairConfig(
+                        interval_s=repair_interval_s,
+                        age_threshold_s=age_threshold_s,
+                        backoff_base_s=max(0.25, repair_interval_s),
+                        backoff_max_s=5.0,
+                        round_budget=round_budget,
+                    ),
+                    seed=seed,
+                ).start()
+                for n in nodes
+            ]
+            cr = CacheAwareRouter(router_mesh, router_mesh.cfg)
+            cr.finish_warm_up()
+
+            # -- 1+2: serve routed requests THROUGH the fault window ---
+            # The plan's schedule restarts NOW: cluster startup (barrier
+            # ticks, channel dials) must not consume the fault window.
+            faults.rebase()
+            attempted = ok = 0
+            peak_diverged = 0
+            max_age = 0.0
+            pace = fault_end_s / max(1, n_requests)
+            window_t0 = _time.monotonic()
+            for i in range(n_requests):
+                key = rng.integers(0, 600, size=key_len).astype(np.int32)
+                attempted += 1
+                try:
+                    res = cr.cache_aware_route(key)
+                    target = by_addr.get(res.prefill_addr)
+                    if target is None:
+                        raise RuntimeError("router returned no prefill node")
+                    target.insert(key, np.arange(key_len, dtype=np.int32))
+                    if target.match_prefix(key).length != key_len:
+                        raise RuntimeError("local match missed a local insert")
+                    ok += 1
+                except Exception:  # noqa: BLE001 — failures are the measurement
+                    pass
+                conv = router_mesh.fleet.convergence()
+                peak_diverged = max(
+                    peak_diverged,
+                    sum(1 for v in conv["pairs"].values() if v > 0.0),
+                )
+                max_age = max(max_age, conv["max_convergence_age_s"])
+                sleep_left = window_t0 + (i + 1) * pace - _time.monotonic()
+                if sleep_left > 0:
+                    _time.sleep(sleep_left)
+            # Let the fault window fully close (drops + partition off).
+            tail = window_t0 + fault_end_s + 0.1 - _time.monotonic()
+            if tail > 0:
+                _time.sleep(tail)
+            diverged_detected = (
+                peak_diverged > 0
+                or len({n.tree.fingerprint_ for n in nodes}) > 1
+            )
+
+            # -- 3: repair converges every replica ---------------------
+            heal_t0 = _time.monotonic()
+
+            def _converged() -> bool:
+                if len({n.tree.fingerprint_ for n in nodes}) != 1:
+                    return False
+                return bool(router_mesh.fleet.convergence()["converged"])
+
+            converged = wait_for(_converged)
+            converge_s = _time.monotonic() - heal_t0
+            # max_inflight_rounds covers peers still marked diverged
+            # (episodes that never completed), so a non-heal can't
+            # under-report its round spend.
+            max_rounds = max(
+                (
+                    max(s["max_episode_rounds"], s["max_inflight_rounds"])
+                    for s in (r.stats() for r in repair_planes)
+                ),
+                default=0,
+            )
+
+            # -- 4: quiescence -----------------------------------------
+            def _repair_traffic() -> int:
+                return sum(
+                    s["probes_sent"] + s["summaries_sent"]
+                    for s in (r.stats() for r in repair_planes)
+                )
+
+            # Settle: let every node's fleet view fold the CONVERGED
+            # digests (a peer reading a stale pre-heal fingerprint would
+            # legitimately probe once more) before opening the
+            # zero-traffic observation window.
+            _time.sleep(3.0 * digest_interval_s + repair_interval_s)
+            traffic_before = _repair_traffic()
+            q_deadline = _time.monotonic() + quiesce_window_s
+            while _time.monotonic() < q_deadline:
+                _time.sleep(repair_interval_s)
+            traffic_after = _repair_traffic()
+
+            repair_totals = {
+                k: sum(r.stats()[k] for r in repair_planes)
+                for k in (
+                    "probes_sent", "summaries_sent", "keys_pushed",
+                    "oplogs_reemitted", "heals",
+                )
+            }
+            return {
+                "nodes": len(nodes),
+                "topology": "2 prefill + 1 decode + 1 router (inproc)",
+                "round_budget": round_budget,
+                "fault_plan": {
+                    "seed": seed,
+                    "drop_p": drop_p,
+                    "drop_window_s": fault_end_s,
+                    "partition_s": partition_s,
+                    "partitioned_node": partitioned,
+                    "frames_dropped": int(plan.counters.get("dropped", 0)),
+                    "frames_delivered": int(plan.counters.get("delivered", 0)),
+                },
+                "served": {
+                    "attempted": attempted,
+                    "ok": ok,
+                    "ok_rate_during_fault": round(ok / max(1, attempted), 4),
+                },
+                "divergence": {
+                    "detected": bool(diverged_detected),
+                    "peak_diverged_pairs": peak_diverged,
+                    "max_age_s": round(max_age, 3),
+                },
+                "repair": {
+                    "converged": bool(converged),
+                    "converge_s": round(converge_s, 3),
+                    "max_episode_rounds": int(max_rounds),
+                    "within_round_budget": bool(
+                        converged and max_rounds <= round_budget
+                    ),
+                    **repair_totals,
+                },
+                "quiescence": {
+                    "window_s": quiesce_window_s,
+                    "traffic_before": traffic_before,
+                    "traffic_after": traffic_after,
+                    "quiet": traffic_after == traffic_before,
+                },
+                "wall_s": round(_time.monotonic() - t_start, 3),
+            }
+    finally:
+        for r in repair_planes:
+            r.close()
+        for p in fleet_planes:
+            p.close()
+        for n in nodes:
+            n.close()
+        InprocHub.reset_default()
+
+
 def run_kvflow_workload(
     n_restore_requests: int = 3,
     prompt_tokens: int = 1536,

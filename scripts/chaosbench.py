@@ -1,0 +1,76 @@
+"""Standalone self-healing chaos bench (the CHAOS artifact's paired CLI
+emitter, like ``scripts/fleetbench.py`` is for FLEET).
+
+Runs ``workload.run_chaos_workload`` — a seeded FaultPlan injects frame
+loss plus a scheduled partition of one prefill node while routed
+requests keep flowing; gossiped fingerprints detect the divergence; the
+anti-entropy repair plane must converge every replica (router included)
+within the round budget and then go quiet — and prints ONE JSON line
+validated against the schema ``bench.validate_chaos`` pins. No jax, no
+sockets: the fault/repair layer under test is transport-independent.
+
+Usage::
+
+    python scripts/chaosbench.py [--drop-p 0.2] [--partition 10] \
+        [--seed 0] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+from radixmesh_tpu.workload import run_chaos_workload  # noqa: E402
+
+
+def run(
+    drop_p: float,
+    partition_s: float,
+    requests: int,
+    round_budget: int,
+    seed: int,
+) -> dict:
+    res = run_chaos_workload(
+        drop_p=drop_p,
+        partition_s=partition_s,
+        n_requests=requests,
+        round_budget=round_budget,
+        seed=seed,
+    )
+    report = bench.build_chaos_report(res)
+    problems = bench.validate_chaos(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="chaosbench")
+    ap.add_argument("--drop-p", type=float, default=0.2)
+    ap.add_argument("--partition", type=float, default=10.0, metavar="SECONDS")
+    ap.add_argument("--requests", type=int, default=150)
+    ap.add_argument("--round-budget", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    args = ap.parse_args()
+    report = run(
+        args.drop_p, args.partition, args.requests, args.round_budget,
+        args.seed,
+    )
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -324,3 +324,95 @@ class TestKvflowArtifactSchema:
         with open(paths[-1]) as fh:
             report = json.load(fh)
         assert bench.validate_kvflow(report) == []
+
+
+class TestChaosArtifactSchema:
+    """The CHAOS artifact (self-healing mesh, PR 5) stays machine-
+    comparable across rounds: pinned top/section fields plus the three
+    structural acceptance gates — converged, within the repair-round
+    budget, quiescent after convergence."""
+
+    def _report(self) -> dict:
+        return {
+            "schema_version": bench.CHAOS_SCHEMA_VERSION,
+            "metric": "chaos_heal_converge_s",
+            "value": 0.2,
+            "unit": "s from fault-window close to ALL replicas pairwise "
+                    "fingerprint-equal via anti-entropy repair",
+            "workload": "20% seeded frame loss + 10s partition of cp1",
+            "nodes": 4,
+            "topology": "2 prefill + 1 decode + 1 router (inproc)",
+            "round_budget": 8,
+            "fault_plan": {
+                "seed": 0, "drop_p": 0.2, "drop_window_s": 11.0,
+                "partition_s": 10.0, "partitioned_node": "cp1",
+                "frames_dropped": 88, "frames_delivered": 3486,
+            },
+            "served": {
+                "attempted": 150, "ok": 150, "ok_rate_during_fault": 1.0,
+            },
+            "divergence": {
+                "detected": True, "peak_diverged_pairs": 3,
+                "max_age_s": 10.7,
+            },
+            "repair": {
+                "converged": True, "converge_s": 0.2,
+                "max_episode_rounds": 6, "within_round_budget": True,
+                "probes_sent": 34, "summaries_sent": 52,
+                "keys_pushed": 328, "oplogs_reemitted": 328, "heals": 12,
+            },
+            "quiescence": {
+                "window_s": 2.0, "traffic_before": 86,
+                "traffic_after": 86, "quiet": True,
+            },
+            "wall_s": 14.7,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_chaos(self._report()) == []
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["round_budget"]
+        del report["repair"]["converge_s"]
+        del report["quiescence"]["quiet"]
+        missing = bench.validate_chaos(report)
+        assert "round_budget" in missing
+        assert "repair.converge_s" in missing
+        assert "quiescence.quiet" in missing
+
+    def test_acceptance_gates_enforced(self):
+        report = self._report()
+        report["repair"]["converged"] = False
+        report["repair"]["within_round_budget"] = False
+        report["divergence"]["detected"] = False
+        report["quiescence"]["quiet"] = False
+        problems = "\n".join(bench.validate_chaos(report))
+        assert "never healed" in problems
+        assert "exceeded round_budget" in problems
+        assert "injected nothing" in problems
+        assert "kept flowing" in problems
+        assert bench.validate_chaos(7) == ["artifact is not a JSON object"]
+
+    def test_build_report_matches_schema(self):
+        res = {
+            k: self._report()[k]
+            for k in (
+                "nodes", "topology", "round_budget", "fault_plan", "served",
+                "divergence", "repair", "quiescence", "wall_s",
+            )
+        }
+        report = bench.build_chaos_report(res)
+        assert bench.validate_chaos(report) == []
+        assert report["value"] == res["repair"]["converge_s"]
+
+    def test_checked_in_artifact_validates(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "CHAOS_r*.json")))
+        assert paths, "no CHAOS artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_chaos(report) == []

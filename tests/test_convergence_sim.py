@@ -297,6 +297,185 @@ class TestFingerprintConvergence:
         assert pd.tree.fingerprint_ == router.tree.fingerprint_
 
 
+class TestRepairSessionSim:
+    """The anti-entropy repair protocol at the ``_mesh_insert`` layer
+    (``cache/repair_plane.py`` / ``MeshCache.repair_push_keys``), with
+    the ring replaced by a captured-oplog pipe: fully deterministic, no
+    threads, no clocks. The live-cluster variants are in
+    ``tests/test_repair_plane.py``; these pin the *semantics* — what a
+    session pushes and what applying it yields."""
+
+    @staticmethod
+    def _pipe(src: MeshCache, buckets, exclude_hashes, budget=10_000):
+        """Run ``src``'s repair push with ``_broadcast`` captured, and
+        return the re-emitted oplogs as WIRE frames (serialize → bytes),
+        i.e. exactly what peers would receive."""
+        from radixmesh_tpu.cache.oplog import serialize
+
+        captured = []
+        orig = src._broadcast
+        src._broadcast = lambda op: captured.append(serialize(op))
+        try:
+            src.repair_push_keys(buckets, exclude_hashes, budget)
+        finally:
+            src._broadcast = orig
+        return captured
+
+    @staticmethod
+    def _diff(a: MeshCache, b: MeshCache) -> list[int]:
+        return [
+            int(i)
+            for i in np.nonzero(a.tree.fp_buckets_ != b.tree.fp_buckets_)[0]
+        ]
+
+    @staticmethod
+    def _hashes(node: MeshCache, buckets) -> set[int]:
+        with node._lock:
+            return {
+                node.tree.path_hash(n)
+                for n in node.tree.nodes_touching_buckets(buckets)
+            }
+
+    def _session(self, a: MeshCache, b: MeshCache) -> None:
+        """One full symmetric repair session a↔b: bucket diff → key
+        summaries → each side applies the other's one-sided pushes
+        through the REAL receive path (deserialize → oplog_received)."""
+        buckets = self._diff(a, b)
+        ha, hb = self._hashes(a, buckets), self._hashes(b, buckets)
+        for frame in self._pipe(a, buckets, hb):
+            b.oplog_received(frame)
+        for frame in self._pipe(b, buckets, ha):
+            a.oplog_received(frame)
+
+    def test_dropped_insert_healed(self):
+        rng = np.random.default_rng(3)
+        ops = random_ops(rng, n_ops=25, n_writers=3)
+        full, partial = make_unwired_node(0), make_unwired_node(1)
+        dropped = (np.array([88, 89], np.int32), 2,
+                   np.arange(2, dtype=np.int32))
+        with full._lock, partial._lock:
+            for key, rank, indices in ops + [dropped]:
+                full._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+            for key, rank, indices in ops:
+                partial._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+        assert full.tree.fingerprint_ != partial.tree.fingerprint_
+        self._session(full, partial)
+        assert full.tree.fingerprint_ == partial.tree.fingerprint_
+        assert (full.tree.fp_buckets_ == partial.tree.fp_buckets_).all()
+        res = partial.tree.match_prefix(dropped[0], split_partial=False)
+        assert res.length == 2 and all(v.rank == 2 for v in res.values)
+
+    def test_dropped_delete_healed_by_resurrection(self):
+        """DELETE lost to one replica: the session converges the pair on
+        the union (the keeper re-replicates; tombstone-free heal)."""
+        k1, k2 = np.arange(6, dtype=np.int32), np.arange(30, 36, dtype=np.int32)
+        a, b = make_unwired_node(0), make_unwired_node(1)
+        for n in (a, b):
+            with n._lock:
+                n._mesh_insert(k1.copy(), PrefillValue(np.arange(6, dtype=np.int32), 0))
+                n._mesh_insert(k2.copy(), PrefillValue(np.arange(6, dtype=np.int32), 0))
+        with a._lock:
+            assert a._apply_delete(k2)  # b's copy of the DELETE dropped
+        assert a.tree.fingerprint_ != b.tree.fingerprint_
+        self._session(a, b)
+        assert a.tree.fingerprint_ == b.tree.fingerprint_
+        assert a.tree.match_prefix(k2, split_partial=False).length == len(k2)
+
+    def test_asymmetric_partition_healed(self):
+        """Each side missed a DIFFERENT slice of the op stream (the
+        one-way-partition outcome): one symmetric session converges both
+        to the union with correct per-position owners."""
+        rng = np.random.default_rng(17)
+        ops = random_ops(rng, n_ops=40, n_writers=3)
+        third = len(ops) // 3
+        a, b = make_unwired_node(0), make_unwired_node(1)
+        with a._lock, b._lock:
+            for key, rank, indices in ops[: 2 * third]:  # a missed the tail
+                a._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+            for key, rank, indices in ops[third:]:  # b missed the head
+                b._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+        assert a.tree.fingerprint_ != b.tree.fingerprint_
+        self._session(a, b)
+        # The repair contract is KEY-SET convergence (the fingerprint is
+        # deliberately value-blind): both sides hold the union and match
+        # every op's full key. Per-position value OWNERS may still
+        # differ on paths both sides already held (each resolved against
+        # the multiset it actually saw) — the same tolerated zone as
+        # live cross-origin races (mesh_cache.py consistency model).
+        assert a.tree.fingerprint_ == b.tree.fingerprint_
+        assert (a.tree.fp_buckets_ == b.tree.fp_buckets_).all()
+        writers: dict[tuple, set] = {}
+        for key, rank, _ in ops:
+            for d in range(1, len(key) + 1):
+                writers.setdefault(tuple(key[:d].tolist()), set()).add(rank)
+        for node in (a, b):
+            for key, _, _ in ops:
+                res = node.tree.match_prefix(key, split_partial=False)
+                assert res.length == len(key), "union key missing post-repair"
+                pos = 0
+                for v in res.values:
+                    for _ in range(len(v)):
+                        p = tuple(key[: pos + 1].tolist())
+                        # Every owner is a REAL writer of that position —
+                        # repair can never fabricate ownership.
+                        assert v.rank in writers[p]
+                        pos += 1
+
+    def test_conflict_winners_unchanged_post_repair(self):
+        """Repair pushes ride the normal conflict-resolution path, so
+        the lowest-writing-rank-wins oracle must hold pointwise AFTER a
+        heal exactly as it does after live replication."""
+        rng = np.random.default_rng(29)
+        ops = random_ops(rng, n_ops=40, n_writers=4)
+        a, b = make_unwired_node(0), make_unwired_node(1)
+        drop_at_b = {5, 11, 23, 31}  # b missed these (conflict-heavy set)
+        with a._lock, b._lock:
+            for i, (key, rank, indices) in enumerate(ops):
+                a._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+                if i not in drop_at_b:
+                    b._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+        self._session(a, b)
+        assert a.tree.fingerprint_ == b.tree.fingerprint_
+        min_rank: dict[tuple, int] = {}
+        for key, rank, _ in ops:
+            for d in range(1, len(key) + 1):
+                p = tuple(key[:d].tolist())
+                min_rank[p] = min(min_rank.get(p, rank), rank)
+        for node in (a, b):
+            for key, _, _ in ops:
+                res = node.tree.match_prefix(key, split_partial=False)
+                assert res.length == len(key)
+                pos = 0
+                for v in res.values:
+                    for _ in range(len(v)):
+                        p = tuple(key[: pos + 1].tolist())
+                        assert v.rank == min_rank[p], (
+                            f"post-repair owner drift at {p}: "
+                            f"{v.rank} != {min_rank[p]}"
+                        )
+                        pos += 1
+
+    def test_session_is_idempotent(self):
+        """Re-running a session against converged replicas pushes
+        nothing and changes nothing (quiescence at the protocol layer)."""
+        rng = np.random.default_rng(41)
+        ops = random_ops(rng, n_ops=20, n_writers=2)
+        a, b = make_unwired_node(0), make_unwired_node(1)
+        with a._lock, b._lock:
+            for key, rank, indices in ops:
+                a._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+            for key, rank, indices in ops[:-1]:
+                b._mesh_insert(key.copy(), PrefillValue(indices.copy(), rank))
+        self._session(a, b)
+        assert a.tree.fingerprint_ == b.tree.fingerprint_
+        fp = a.tree.fingerprint_
+        buckets = self._diff(a, b)
+        assert buckets == []
+        assert self._pipe(a, buckets, set()) == []
+        self._session(a, b)  # full re-run: still a no-op
+        assert a.tree.fingerprint_ == fp == b.tree.fingerprint_
+
+
 class TestDupSlotSafety:
     """The dup-GC slot ledger under granularity drift.
 

@@ -1,0 +1,297 @@
+"""Chaos/fault-injection plane (``comm/faults.py``): determinism,
+fault semantics, and the ``create_communicator`` seam.
+
+Schedules are driven through an injected virtual clock wherever timing
+matters, and every probabilistic assertion derives from a fixed seed —
+the plane exists to make chaos testing deterministic, so its own tests
+must be."""
+
+import time
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.comm import faults
+from radixmesh_tpu.comm.communicator import create_communicator
+from radixmesh_tpu.comm.faults import FaultPlan, PartitionSpec
+from radixmesh_tpu.comm.inproc import InprocHub
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub_and_plan():
+    InprocHub.reset_default()
+    faults.uninstall()
+    yield
+    faults.uninstall()
+    InprocHub.reset_default()
+
+
+def wait_for(pred, timeout=5.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def make_edge(plan, src="a", dst="b", now_fn=None):
+    """A faulted inproc edge src→dst plus the receiver's inbox list."""
+    faults.install(plan, now_fn)
+    rx: list[bytes] = []
+    listener = create_communicator("inproc", dst, None)
+    listener.register_rcv_callback(rx.append)
+    sender = create_communicator("inproc", None, dst, src_hint=src)
+    return sender, listener, rx
+
+
+class TestSeam:
+    def test_no_plan_returns_bare_transport(self):
+        comm = create_communicator("inproc", None, "x")
+        assert not isinstance(comm, faults.FaultyCommunicator)
+        comm.close()
+
+    def test_armed_plan_wraps_and_uninstall_stops(self):
+        faults.install(FaultPlan())
+        comm = create_communicator("inproc", None, "x")
+        assert isinstance(comm, faults.FaultyCommunicator)
+        comm.close()
+        faults.uninstall()
+        comm2 = create_communicator("inproc", None, "x")
+        assert not isinstance(comm2, faults.FaultyCommunicator)
+        comm2.close()
+
+    def test_injected_scope(self):
+        with faults.injected(FaultPlan()) as plan:
+            comm = create_communicator("inproc", None, "x")
+            assert isinstance(comm, faults.FaultyCommunicator)
+            assert faults.active_plan() is plan
+            comm.close()
+        assert faults.active_plan() is None
+
+    def test_zero_plan_is_transparent(self):
+        sender, listener, rx = make_edge(FaultPlan())
+        assert sender.try_send(b"hello", 1.0)
+        assert wait_for(lambda: rx == [b"hello"])
+        sender.close()
+        listener.close()
+
+    def test_plan_json_round_trip(self):
+        plan = FaultPlan(
+            seed=7, drop_p=0.2, drop_end_s=12.0, delay_s=0.01,
+            jitter_s=0.005, dup_p=0.1, reorder_p=0.05,
+            partitions=(PartitionSpec(2.0, 12.0, ("n1",), one_way=True),),
+            crash_after_sends={"n2": 5}, targets=("n1", "n2"),
+        )
+        back = FaultPlan.from_dict(plan.to_dict())
+        assert back.to_dict() == plan.to_dict()
+
+
+class TestDrop:
+    def test_seeded_drops_are_deterministic(self):
+        """Same seed + same edge + same send sequence → the same frames
+        are lost, run after run."""
+        outcomes = []
+        for _ in range(2):
+            InprocHub.reset_default()
+            plan = FaultPlan(seed=42, drop_p=0.5)
+            sender, listener, rx = make_edge(plan)
+            for i in range(40):
+                assert sender.try_send(bytes([i]), 1.0)
+            assert wait_for(
+                lambda: len(rx) == plan.counters.get("delivered", 0)
+            )
+            outcomes.append([b[0] for b in rx])
+            sender.close()
+            listener.close()
+            faults.uninstall()
+        assert outcomes[0] == outcomes[1]
+        assert 0 < len(outcomes[0]) < 40  # some dropped, some delivered
+
+    def test_drop_window_closes(self):
+        """Virtual clock: drops stop dead at drop_end_s."""
+        now = [0.0]
+        plan = FaultPlan(seed=1, drop_p=1.0, drop_end_s=10.0)
+        sender, listener, rx = make_edge(plan, now_fn=lambda: now[0])
+        assert sender.try_send(b"lost", 1.0)
+        now[0] = 11.0  # window over
+        assert sender.try_send(b"kept", 1.0)
+        assert wait_for(lambda: rx == [b"kept"])
+        assert plan.counters["dropped"] == 1
+        sender.close()
+        listener.close()
+
+
+class TestPartition:
+    def test_symmetric_partition_blocks_then_heals(self):
+        now = [0.0]
+        plan = FaultPlan(
+            seed=0,
+            partitions=(PartitionSpec(0.0, 5.0, ("b",)),),
+        )
+        sender, listener, rx = make_edge(plan, now_fn=lambda: now[0])
+        # In-window: try_send must time out (the blackhole signal).
+        assert sender.try_send(b"x", 0.05) is False
+        assert rx == []
+        now[0] = 6.0  # heal
+        assert sender.try_send(b"x", 1.0)
+        assert wait_for(lambda: rx == [b"x"])
+        sender.close()
+        listener.close()
+
+    def test_symmetric_partition_cuts_outbound_via_src_hint(self):
+        """A send-only channel owned by the isolated node (bind=None,
+        src_hint set) is cut too — one-way plans are not."""
+        now = [0.0]
+        sym = FaultPlan(seed=0, partitions=(PartitionSpec(0.0, 5.0, ("a",)),))
+        sender, listener, rx = make_edge(sym, src="a", dst="b",
+                                         now_fn=lambda: now[0])
+        assert sender.try_send(b"x", 0.05) is False
+        sender.close()
+        listener.close()
+        faults.uninstall()
+        InprocHub.reset_default()
+        one_way = FaultPlan(
+            seed=0,
+            partitions=(PartitionSpec(0.0, 5.0, ("a",), one_way=True),),
+        )
+        sender, listener, rx = make_edge(one_way, src="a", dst="b",
+                                         now_fn=lambda: now[0])
+        # One-way INTO "a": a's outbound traffic flows.
+        assert sender.try_send(b"x", 1.0)
+        assert wait_for(lambda: rx == [b"x"])
+        sender.close()
+        listener.close()
+
+    def test_partition_blocks_until_heal_within_timeout(self):
+        """A try_send whose deadline outlives the window delivers after
+        the heal — the frame was delayed, not lost (queue semantics)."""
+        t0 = time.monotonic()
+        plan = FaultPlan(
+            seed=0, partitions=(PartitionSpec(0.0, 0.15, ("b",)),),
+        )
+        sender, listener, rx = make_edge(plan)
+        assert sender.try_send(b"x", 5.0)
+        assert time.monotonic() - t0 >= 0.1  # actually blocked
+        assert wait_for(lambda: rx == [b"x"])
+        sender.close()
+        listener.close()
+
+
+class TestDelayDupReorder:
+    def test_duplicate_delivers_twice(self):
+        plan = FaultPlan(seed=3, dup_p=1.0)
+        sender, listener, rx = make_edge(plan)
+        assert sender.try_send(b"x", 1.0)
+        assert wait_for(lambda: len(rx) == 2)
+        assert rx == [b"x", b"x"]
+        sender.close()
+        listener.close()
+
+    def test_delay_defers_delivery(self):
+        plan = FaultPlan(seed=3, delay_s=0.15)
+        sender, listener, rx = make_edge(plan)
+        t0 = time.monotonic()
+        assert sender.try_send(b"x", 1.0)
+        assert rx == []  # not yet
+        assert wait_for(lambda: rx == [b"x"])
+        assert time.monotonic() - t0 >= 0.1
+        sender.close()
+        listener.close()
+
+    def test_reorder_overtakes(self):
+        """With reorder_p=1 on the first frame only (seeded), a held
+        frame is overtaken by a later one."""
+        # Deterministic: every frame gets +reorder_delay_s, so instead
+        # hold frame 1 long and send frame 2 with a fresh plan edge —
+        # simplest observable: 100% reorder + zero base delay means
+        # FIFO inversion whenever a later send beats the hold timer.
+        plan = FaultPlan(seed=9, reorder_p=0.5, reorder_delay_s=0.2)
+        sender, listener, rx = make_edge(plan)
+        for i in range(10):
+            assert sender.try_send(bytes([i]), 1.0)
+        assert wait_for(lambda: len(rx) == 10)
+        order = [b[0] for b in rx]
+        assert sorted(order) == list(range(10))
+        assert order != list(range(10)), "nothing was reordered"
+        sender.close()
+        listener.close()
+
+
+class TestCrash:
+    def test_crash_after_nth_send(self):
+        plan = FaultPlan(seed=0, crash_after_sends={"b": 3})
+        sender, listener, rx = make_edge(plan)
+        for i in range(3):
+            assert sender.try_send(bytes([i]), 1.0)
+        with pytest.raises(RuntimeError, match="chaos"):
+            sender.try_send(b"dead", 1.0)
+        with pytest.raises(RuntimeError, match="chaos"):
+            sender.try_send(b"still dead", 1.0)
+        assert plan.counters["crashes"] == 1
+        assert wait_for(lambda: len(rx) == 3)
+        sender.close()
+        listener.close()
+
+
+class TestMeshUnderChaos:
+    def test_ring_survives_drops_and_reports_losses(self):
+        """A live inproc ring under 100% loss on one edge: the mesh must
+        keep running (honest degradation), and — the dropped-frame
+        accounting satellite — data losses must surface in the
+        radixmesh_oplog_dropped_total{cause,kind} family and arm the
+        repair plane's early-probe hook."""
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.config import MeshConfig
+
+        prefill, decode = ["fa0", "fa1"], ["fd0"]
+        plan = FaultPlan(seed=0)  # no faults; we force the drop directly
+        nodes = []
+        with faults.injected(plan):
+            for addr in prefill + decode:
+                cfg = MeshConfig(
+                    prefill_nodes=prefill, decode_nodes=decode,
+                    router_nodes=[], local_addr=addr, protocol="inproc",
+                    tick_interval_s=0.05, gc_interval_s=30.0,
+                )
+                nodes.append(MeshCache(cfg, pool=None).start())
+            try:
+                for n in nodes:
+                    assert n.wait_ready(timeout=10)
+                losses = []
+                nodes[0].on_oplog_dropped = lambda cause, kind: losses.append(
+                    (cause, kind)
+                )
+                # Overflow the data queue artificially: queue_full drops
+                # must be tagged with the op kind.
+                from radixmesh_tpu.cache.oplog import (
+                    Oplog, OplogType, serialize,
+                )
+
+                frame = serialize(
+                    Oplog(OplogType.INSERT, 0, 1, 3,
+                          key=np.arange(4, dtype=np.int32),
+                          value=np.arange(4, dtype=np.int32), value_rank=0)
+                )
+                import queue as _q
+
+                full = nodes[0]._out_q
+                # Fill to capacity, then one more send must drop+tag.
+                while True:
+                    try:
+                        full.put_nowait(b"pad")
+                    except _q.Full:
+                        break
+                nodes[0]._send_bytes(frame)
+                assert losses == [("queue_full", int(OplogType.INSERT))]
+                from radixmesh_tpu.obs.metrics import get_registry
+
+                rendered = get_registry().render()
+                assert "radixmesh_oplog_dropped_total" in rendered
+                assert 'cause="queue_full"' in rendered
+                assert 'kind="INSERT"' in rendered
+            finally:
+                for n in nodes:
+                    n.close()

@@ -1,0 +1,135 @@
+"""Mesh wire-discipline lint (pattern of ``test_hotpath_lint.py``):
+source greps that pin two contracts new code silently erodes.
+
+1. **One send seam.** Every mesh network write must go through the
+   sender-loop / bounded ``try_send`` seam — a raw ``.send(`` anywhere
+   in ``mesh_cache.py`` is a blocking, failure-detection-blind network
+   touch that can stall whatever thread it runs on (the bug class the
+   dedicated sender threads exist to prevent).
+2. **Extension-kind registration.** Every op kind added AFTER the
+   unknown-kind pass-through tolerance (``PREFETCH`` and everything
+   newer, e.g. the ``REPAIR_*`` kinds) must be registered in
+   ``oplog.EXTENSION_KINDS`` and explicitly handled in the receive
+   path — so an old wire seeing the kind forwards/ignores it and a new
+   wire never falls through to the data-apply default."""
+
+import inspect
+import re
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+
+class TestSendSeamLint:
+    # The ONLY methods allowed to touch a transport's try_send: the two
+    # sender-thread loops, the (sender-thread-only) router fan-out, the
+    # best-effort graceful-close announcement, and the two dedicated
+    # fire-and-forget channels (prefetch hints, repair frames) — each
+    # short-deadline and droppable by contract.
+    ALLOWED_TRY_SEND = (
+        "_sender_loop",
+        "_fan_out_to_routers",
+        "close",
+        "send_prefetch",
+        "send_repair",
+    )
+
+    def test_no_raw_send_anywhere_in_mesh_cache(self):
+        from radixmesh_tpu.cache import mesh_cache
+
+        src = inspect.getsource(mesh_cache)
+        raw = [
+            f"line ~{src[: m.start()].count(chr(10)) + 1}: {m.group(0)!r}"
+            for m in re.finditer(r"(?<!try_)\.send\(", src)
+        ]
+        assert not raw, (
+            "raw .send( calls in mesh_cache.py (must use the bounded "
+            "try_send seam): " + "; ".join(raw)
+        )
+
+    def test_try_send_confined_to_the_seam(self):
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.cache import mesh_cache
+
+        module_hits = len(
+            re.findall(r"\.try_send\(", inspect.getsource(mesh_cache))
+        )
+        allowed_hits = sum(
+            len(re.findall(
+                r"\.try_send\(", inspect.getsource(getattr(MeshCache, name))
+            ))
+            for name in self.ALLOWED_TRY_SEND
+        )
+        assert module_hits == allowed_hits, (
+            f"{module_hits - allowed_hits} try_send call(s) outside the "
+            f"allowed seam methods {self.ALLOWED_TRY_SEND} — route new "
+            "network writes through the sender loop or a documented "
+            "dedicated-channel method"
+        )
+
+    def test_positive_control_seam_methods_do_send(self):
+        """The lint greps for real patterns: the sender loop DOES call
+        try_send."""
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+
+        assert re.search(
+            r"\.try_send\(", inspect.getsource(MeshCache._sender_loop)
+        )
+
+
+class TestExtensionKindRegistration:
+    def test_every_repair_kind_is_registered(self):
+        from radixmesh_tpu.cache.oplog import EXTENSION_KINDS, OplogType
+
+        repair_kinds = [
+            t for t in OplogType if t.name.startswith("REPAIR_")
+        ]
+        assert repair_kinds, "REPAIR_* kinds vanished from OplogType"
+        for t in repair_kinds:
+            assert t in EXTENSION_KINDS, (
+                f"{t.name} missing from EXTENSION_KINDS — an old wire "
+                "would raise on it instead of forwarding"
+            )
+
+    def test_every_extension_kind_has_a_receive_branch(self):
+        """Each extension kind must be explicitly dispatched in
+        ``oplog_received`` BEFORE the data-apply default — falling
+        through would corrupt the tree with a non-data payload."""
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.cache.oplog import EXTENSION_KINDS
+
+        src = inspect.getsource(MeshCache.oplog_received)
+        for t in EXTENSION_KINDS:
+            assert f"OplogType.{t.name}" in src, (
+                f"oplog_received has no explicit branch for {t.name}"
+            )
+
+    def test_unknown_kind_passes_through_old_and_new(self):
+        """A kind this build does NOT know must deserialize to a raw int
+        (never raise) — the forward-compat contract every entry in
+        EXTENSION_KINDS relies on."""
+        import numpy as np
+
+        from radixmesh_tpu.cache.oplog import (
+            Oplog, OplogType, deserialize, serialize,
+        )
+
+        future_kind = max(int(t) for t in OplogType) + 7
+        frame = bytearray(serialize(
+            Oplog(OplogType.REPAIR_PROBE, 0, 1, 1,
+                  value=np.arange(4, dtype=np.int32), value_rank=2)
+        ))
+        frame[2] = future_kind  # the wire's kind byte
+        back = deserialize(bytes(frame))
+        assert back.op_type == future_kind
+        assert not isinstance(back.op_type, OplogType)
+
+    def test_data_kinds_are_exactly_the_replicated_tree_ops(self):
+        """DATA_KINDS drives the early-probe arming: it must cover the
+        kinds whose loss diverges a replica, and nothing else."""
+        from radixmesh_tpu.cache.oplog import DATA_KINDS, OplogType
+
+        assert DATA_KINDS == {
+            OplogType.INSERT, OplogType.DELETE, OplogType.RESET,
+        }

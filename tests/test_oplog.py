@@ -328,3 +328,34 @@ class TestPrefetchOp:
         # ...and such frames can still be TTL-patched for forwarding.
         patched = deserialize(patched_ttl(bytes(frame), 0))
         assert patched.ttl == 0 and patched.op_type == 213
+
+
+@pytest.mark.quick
+class TestRepairOps:
+    """PR 5: the anti-entropy REPAIR_PROBE/REPAIR_SUMMARY kinds ride the
+    existing wire unchanged (value = packed payload, value_rank = the
+    addressed peer) and are registered as extension kinds, so an old
+    wire sees an unknown int and forwards instead of raising."""
+
+    @pytest.mark.parametrize(
+        "kind", [OplogType.REPAIR_PROBE, OplogType.REPAIR_SUMMARY]
+    )
+    def test_repair_round_trips(self, kind):
+        op = Oplog(
+            op_type=kind,
+            origin_rank=2,
+            logic_id=41,
+            ttl=1,
+            value=np.arange(132, dtype=np.int32),  # a packed bucket vector
+            value_rank=0,
+            ts=77.25,
+        )
+        back = deserialize(serialize(op))
+        assert back == op
+        assert back.op_type is kind
+
+    def test_repair_kinds_are_extension_registered(self):
+        from radixmesh_tpu.cache.oplog import EXTENSION_KINDS
+
+        assert OplogType.REPAIR_PROBE in EXTENSION_KINDS
+        assert OplogType.REPAIR_SUMMARY in EXTENSION_KINDS

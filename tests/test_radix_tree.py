@@ -374,3 +374,133 @@ class TestFingerprint:
         assert freed == 4
         assert t.match_prefix(ids(4)).length == 0
         assert t.match_prefix(ids(4, start=100)).length == 4
+
+
+class TestFingerprintBuckets:
+    """Bucketed fingerprint vector (anti-entropy repair,
+    ``cache/repair_plane.py``): the same order-independence and
+    split-invariance properties as the scalar, per bucket — plus the
+    repair plane's two derived contracts: the scalar is always the XOR
+    of the buckets, and a divergent key is always reachable through the
+    buckets it diverges."""
+
+    def _random_ops(self, rng, n):
+        chains = [
+            rng.integers(0, 6, size=rng.integers(3, 10)).astype(np.int32)
+            for _ in range(3)
+        ]
+        ops = []
+        for _ in range(n):
+            chain = chains[rng.integers(0, len(chains))]
+            key = chain[: rng.integers(1, len(chain) + 1)].copy()
+            if rng.random() < 0.4:
+                key = np.concatenate(
+                    [key, rng.integers(6, 12, size=rng.integers(1, 4)).astype(np.int32)]
+                )
+            ops.append(key)
+        return ops
+
+    def _xor_of(self, vec):
+        out = 0
+        for w in vec:
+            out ^= int(w)
+        return out
+
+    def test_permutation_equality(self):
+        rng = np.random.default_rng(31)
+        for trial in range(6):
+            ops = self._random_ops(rng, 20)
+            ref = make_tree()
+            for key in ops:
+                ref.insert(key, np.arange(len(key), dtype=np.int32))
+            for _ in range(3):
+                t = make_tree()
+                for i in rng.permutation(len(ops)):
+                    t.insert(ops[i], np.arange(len(ops[i]), dtype=np.int32))
+                assert (
+                    t.fingerprint_buckets() == ref.fingerprint_buckets()
+                ).all(), f"trial {trial}"
+            assert self._xor_of(ref.fingerprint_buckets()) == ref.fingerprint
+
+    def test_split_invariance(self):
+        """Node splits repartition a chain array between two nodes; no
+        bucket may move (the repair protocol compares vectors across
+        replicas whose split structures differ)."""
+        t = make_tree()
+        t.insert(ids(12), ids(12))
+        before = t.fingerprint_buckets()
+        t.match_prefix(ids(5))  # splits the 12-node at 5
+        assert (t.fingerprint_buckets() == before).all()
+        # A replica that INSERTED the two spans separately (different
+        # structure, same key set) must agree bucket-for-bucket.
+        u = make_tree()
+        u.insert(ids(5), ids(5))
+        u.insert(ids(12), ids(12))
+        assert (u.fingerprint_buckets() == before).all()
+
+    def test_bucket_stability_under_eviction(self):
+        """Evicting a key restores the exact pre-insert vector; an empty
+        tree's vector is all-zero (XOR self-inverse, per bucket)."""
+        t = make_tree()
+        t.insert(ids(8), ids(8))
+        only_first = t.fingerprint_buckets()
+        t.insert(ids(6, start=200), ids(6))
+        assert (t.fingerprint_buckets() != only_first).any()
+        t.match_prefix(ids(6, start=200))  # freshen the second key
+        t.evict(8, older_than=t.root.children[200].last_access_time)
+        assert t.match_prefix(ids(8)).length == 0  # first key evicted
+        # What remains must vector-match a fresh tree holding only the
+        # surviving key (eviction removed EXACTLY the evictee's words).
+        u = make_tree()
+        u.insert(ids(6, start=200), ids(6))
+        assert (t.fingerprint_buckets() == u.fingerprint_buckets()).all()
+        # Evict everything: vector must return to zero.
+        t.evict(10**9)
+        assert (t.fingerprint_buckets() == 0).all()
+        assert t.fingerprint == 0
+        # Reinsert: bit-identical vector again.
+        t.insert(ids(8), ids(8))
+        assert (t.fingerprint_buckets() == only_first).all()
+
+    def test_divergent_key_lands_in_diverged_buckets(self):
+        """The repair-plane invariant: whatever key two trees disagree
+        on, enumerating the DIVERGED buckets on the richer tree finds a
+        node whose path covers that key."""
+        rng = np.random.default_rng(5)
+        for trial in range(5):
+            ops = self._random_ops(rng, 15)
+            a, b = make_tree(), make_tree()
+            for key in ops:
+                a.insert(key, np.arange(len(key), dtype=np.int32))
+                b.insert(key, np.arange(len(key), dtype=np.int32))
+            extra = rng.integers(50, 90, size=4).astype(np.int32)
+            a.insert(extra, np.arange(4, dtype=np.int32))
+            diff = [
+                int(i)
+                for i in np.nonzero(
+                    a.fingerprint_buckets() != b.fingerprint_buckets()
+                )[0]
+            ]
+            assert diff, f"trial {trial}: divergence invisible in buckets"
+            touched = a.nodes_touching_buckets(diff)
+            assert any(
+                len(n.key) and n.key[-1] == extra[-1] for n in touched
+            ), f"trial {trial}: divergent leaf not enumerated"
+
+    def test_path_hash_stable_across_split_structure(self):
+        """Key-summary identity must match across replicas regardless of
+        node boundaries: the same full path hashes equal whether stored
+        as one node or split."""
+        a, b = make_tree(), make_tree()
+        a.insert(ids(10), ids(10))
+        b.insert(ids(10), ids(10))
+        b.match_prefix(ids(4))  # split b's node
+        ha = {a.path_hash(n) for n in a._all_nodes() if n is not a.root}
+        hb = {b.path_hash(n) for n in b._all_nodes() if n is not b.root}
+        # b's extra interior node adds a PREFIX hash; the full-leaf hash
+        # must be present and equal in both.
+        assert ha <= hb
+        assert a.path_hash(max(
+            (n for n in a._all_nodes() if n is not a.root),
+            key=lambda n: len(n.chain),
+        )) in hb
