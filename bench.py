@@ -270,16 +270,23 @@ def _fleet_pass() -> dict:
 
 
 # ----------------------------------------------------------------------
-# CHAOS stable schema (PR 5, self-healing mesh): one artifact per round
-# recording the chaos acceptance scenario — seeded frame loss + a
-# scheduled partition (comm/faults.py) diverge replicas; the
-# anti-entropy repair plane (cache/repair_plane.py) must converge every
-# replica (router included) within a bounded number of repair rounds
-# while requests keep being served, then go quiet. Bump the version
-# ONLY when adding fields (never remove or rename).
+# CHAOS stable schema (PR 5, self-healing mesh; v2 in PR 6, membership
+# lifecycle): one artifact per round recording the chaos acceptance
+# scenario — seeded frame loss + a scheduled partition (comm/faults.py)
+# diverge replicas; the anti-entropy repair plane (cache/repair_plane.py)
+# must converge every replica (router included) within a bounded number
+# of repair rounds while requests keep being served, then go quiet.
+# v2 adds the elastic-membership phases (policy/lifecycle.py): a
+# graceful drain under sustained loss (zero failed requests, in-flight
+# requeued-and-served, hot tokens written back, departure via LEAVE —
+# never failure detection) and a cold rejoin during an active partition
+# (bulk-bootstrap from a donor within the round budget, router
+# withholding cache hits until convergence). Bump the version ONLY when
+# adding fields (never remove or rename); v1 artifacts — which predate
+# the join/drain sections — stay valid.
 # ----------------------------------------------------------------------
 
-CHAOS_SCHEMA_VERSION = 1
+CHAOS_SCHEMA_VERSION = 2
 
 CHAOS_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -299,6 +306,22 @@ CHAOS_REPAIR_FIELDS = (
 )
 CHAOS_QUIESCENCE_FIELDS = (
     "window_s", "traffic_before", "traffic_after", "quiet",
+)
+# v2 membership-lifecycle sections. Required when the section reports
+# performed=True (a run that skipped the phase ships {"performed":
+# false} and is schema-valid but gate-exempt).
+CHAOS_DRAIN_FIELDS = (
+    "performed", "node", "drop_p", "requeued", "requeued_served",
+    "attempted_during_drain", "ok_during_drain", "zero_failed",
+    "left_without_failure_detection", "writeback_tokens",
+    "writeback_flushed", "drain_s",
+)
+CHAOS_JOIN_FIELDS = (
+    "performed", "joiner", "donor_rank", "partition_active_at_join",
+    "partition_s", "bootstrap_converge_s", "bootstrap_rounds",
+    "round_budget", "within_round_budget", "converged_with_donor",
+    "withheld_hits", "hits_to_bootstrapping",
+    "fleet_converged_after_join",
 )
 
 
@@ -345,6 +368,66 @@ def validate_chaos(report) -> list[str]:
             f"quiescence: repair traffic kept flowing after convergence "
             f"({q.get('traffic_before')} → {q.get('traffic_after')})"
         )
+    # v2 membership-lifecycle sections + gates (v1 artifacts predate
+    # them and stay valid without).
+    v2 = int(report.get("schema_version", 0) or 0) >= 2
+    drain = report.get("drain")
+    if v2 and not isinstance(drain, dict):
+        problems.append("drain section missing (schema v2)")
+    if isinstance(drain, dict) and drain.get("performed"):
+        problems += [
+            f"drain.{f}" for f in CHAOS_DRAIN_FIELDS if f not in drain
+        ]
+        if drain.get("zero_failed") is not True:
+            problems.append(
+                "drain: requests failed during the graceful drain "
+                f"({drain.get('ok_during_drain')}/"
+                f"{drain.get('attempted_during_drain')} ok, "
+                f"{drain.get('requeued_served')}/{drain.get('requeued')} "
+                "requeued-and-served)"
+            )
+        if drain.get("requeued_served") != drain.get("requeued"):
+            problems.append(
+                "drain: parked requests were requeued but not all served "
+                f"({drain.get('requeued_served')}/{drain.get('requeued')})"
+            )
+        if drain.get("left_without_failure_detection") is not True:
+            problems.append(
+                "drain: the planned departure tripped failure detection "
+                "(a 'dead'-cause successor transition fired)"
+            )
+        if drain.get("writeback_flushed") is not True:
+            problems.append(
+                "drain: hot prefixes were not written back before LEAVE"
+            )
+    join = report.get("join")
+    if v2 and not isinstance(join, dict):
+        problems.append("join section missing (schema v2)")
+    if isinstance(join, dict) and join.get("performed"):
+        problems += [
+            f"join.{f}" for f in CHAOS_JOIN_FIELDS if f not in join
+        ]
+        if join.get("converged_with_donor") is not True:
+            problems.append(
+                "join: the bootstrapping node never converged with its "
+                "donor"
+            )
+        if join.get("within_round_budget") is not True:
+            problems.append(
+                f"join: bootstrap took {join.get('bootstrap_rounds')} "
+                f"rounds, over the budget of {join.get('round_budget')}"
+            )
+        if join.get("hits_to_bootstrapping", 0) != 0:
+            problems.append(
+                "join: the router routed cache hits to a BOOTSTRAPPING "
+                f"node ({join.get('hits_to_bootstrapping')} times)"
+            )
+        if not join.get("withheld_hits", 0):
+            problems.append(
+                "join: the router never withheld a hit during bootstrap "
+                "(the withhold path went unexercised — the gate proves "
+                "nothing)"
+            )
     return problems
 
 
@@ -363,8 +446,9 @@ def build_chaos_report(res: dict) -> dict:
             f"{int(100 * fp.get('drop_p', 0))}% seeded frame loss for "
             f"{fp.get('drop_window_s', 0)}s + {fp.get('partition_s', 0)}s "
             f"symmetric partition of {fp.get('partitioned_node')} while "
-            "routed requests keep flowing (inproc ring; see "
-            "workload.run_chaos_workload)"
+            "routed requests keep flowing, then a graceful drain under "
+            "re-opened loss and a cold rejoin during a fresh partition "
+            "(inproc ring; see workload.run_chaos_workload)"
         ),
         **res,
     }

@@ -217,12 +217,13 @@ class KVTransferPlane:
             "prefetch hints by outcome (started = restore launched, "
             "noop = already device-resident/unknown, joined = an "
             "admission found the hinted restore already in flight, "
-            "dropped = hint queue overflow)",
+            "dropped = hint queue overflow, draining = discarded "
+            "because the node is mid-drain)",
             ("plane", "outcome"),
         )
         self._m_hint = {
             o: self._m_hints.labels(outcome=o, **lbl)
-            for o in ("started", "noop", "joined", "dropped")
+            for o in ("started", "noop", "joined", "dropped", "draining")
         }
         self._trace_lane = f"kv:{name}"
         self._worker = threading.Thread(

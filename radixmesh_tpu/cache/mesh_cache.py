@@ -253,6 +253,19 @@ class MeshCache:
         # available to EVERY role — a router probes peers the same way).
         self.on_repair = None
         self._repair_comms: dict[int, Communicator] = {}
+        # Bootstrap-repair channels (policy/lifecycle.py warm join): a
+        # BOOTSTRAPPING node's bulk sessions get their OWN point-to-point
+        # channels so a full-replica transfer never queues behind (or
+        # delays) steady-state anti-entropy frames on the regular repair
+        # channel. Same lazy-dial pattern, separate map.
+        self._bootstrap_comms: dict[int, Communicator] = {}
+        # Membership lifecycle plane (policy/lifecycle.py), when one is
+        # attached. READ-ONLY here: the receive path consults
+        # ``is_departing`` (a draining node must not auto-rejoin on
+        # seeing its own planned exclusion) and the fleet plane folds
+        # ``state`` into the digest. Only policy/lifecycle.py assigns
+        # lifecycle state (lint-pinned).
+        self.lifecycle = None
         # Dropped-frame accounting hook: called (cause, kind_int) when a
         # frame is lost on the outbound path (queue overflow or transmit
         # failure). The repair plane arms an early probe from data-kind
@@ -359,6 +372,25 @@ class MeshCache:
                 "this node's current ring successor rank (-1 = none)",
                 ("node",),
             ).labels(node=node),
+        }
+        # Successor-rank TRANSITIONS by cause: ``dead`` = sender-side
+        # failure detection fired (_declare_successor_dead), ``left`` = a
+        # peer's planned LEAVE (policy/lifecycle.py), ``view_change`` =
+        # any other adopted view (JOIN re-inclusion, merge, TOPO gossip).
+        # Dashboards separate planned churn from real failure with this;
+        # the drain chaos gate asserts zero ``dead`` transitions during a
+        # graceful departure. All three children materialize eagerly so
+        # the series exist at 0 from process start.
+        succ_trans = reg.counter(
+            "radixmesh_mesh_successor_rank_transitions_total",
+            "ring-successor retargets, by cause (dead = failure "
+            "detection; left = graceful LEAVE; view_change = other "
+            "adopted views)",
+            ("node", "cause"),
+        )
+        self._m_succ_trans = {
+            c: succ_trans.labels(node=node, cause=c)
+            for c in ("dead", "left", "view_change")
         }
         self._update_membership_gauges()
 
@@ -566,6 +598,8 @@ class MeshCache:
         for c in self._prefetch_comms.values():
             c.close()
         for c in self._repair_comms.values():
+            c.close()
+        for c in self._bootstrap_comms.values():
             c.close()
 
     # ------------------------------------------------------------------
@@ -781,6 +815,9 @@ class MeshCache:
                 return
             if op.op_type is OplogType.JOIN:
                 self._handle_join(op, data)
+                return
+            if op.op_type is OplogType.LEAVE:
+                self._handle_leave(op, data)
                 return
             if op.op_type is OplogType.DIGEST:
                 self._handle_digest(op, data)
@@ -1019,6 +1056,37 @@ class MeshCache:
             self._announce_view(new_view)
         self._circulate(op, data, control=True)
 
+    def _handle_leave(self, op: Oplog, data: bytes) -> None:
+        """A peer announced a PLANNED departure (graceful drain,
+        ``policy/lifecycle.py``). Unlike failure detection, nothing here
+        is a failure: the leaver's FleetView telemetry is FORGOTTEN (its
+        frozen fingerprint must not poison convergence or pin min_score;
+        a later rejoin re-folds fresh — no inherited lag EWMA), it is
+        marked "left" for routing, and the carried view (the leaver's
+        view without itself) is adopted with cause="left" — so this
+        node's channel retargets BEFORE its sender could ever time out
+        into ``_declare_successor_dead``. Caller holds the lock."""
+        if op.origin_rank == self.rank:
+            return  # lap complete (our own LEAVE came back around)
+        leaver = op.origin_rank
+        try:
+            view = decode_view(op.value)
+        except ValueError:
+            self.log.error("malformed LEAVE payload from rank %d", leaver)
+            return
+        self.fleet.forget(leaver)
+        self.fleet.mark_left(leaver)
+        adopted = self._adopt_view(view, cause="left")
+        if not adopted and self.view.contains(leaver):
+            # The leaver's view was stale (a concurrent change raced its
+            # drain): still honor the departure — drop it from OUR view
+            # one epoch up and gossip the result.
+            old = self.view
+            self.view = old.without(leaver)
+            self._after_view_change(old, cause="left")
+            self._announce_view(self.view)
+        self._circulate(op, data, control=True)
+
     # ------------------------------------------------------------------
     # fleet telemetry (obs/fleet_plane.py)
     # ------------------------------------------------------------------
@@ -1187,12 +1255,15 @@ class MeshCache:
                 self.log.exception("repair sink failed")
 
     def send_repair(self, target_rank: int, op_type: OplogType,
-                    value: np.ndarray) -> bool:
+                    value: np.ndarray, bootstrap: bool = False) -> bool:
         """Fire one repair frame at ``target_rank``'s cache address over
         a dedicated channel. Best-effort by contract: a lost frame just
         means another probe after backoff, so the send is short-deadline
-        and unacknowledged. Returns whether a transport took it."""
-        comm = self._repair_channel(target_rank)
+        and unacknowledged. ``bootstrap`` selects the bulk-session
+        channel (policy/lifecycle.py warm join) so a full-replica
+        transfer never contends with steady-state anti-entropy frames.
+        Returns whether a transport took it."""
+        comm = self._repair_channel(target_rank, bootstrap=bootstrap)
         if comm is None:
             return False
         op = Oplog(
@@ -1214,17 +1285,22 @@ class MeshCache:
                 )
             return False
 
-    def _repair_channel(self, target_rank: int) -> Communicator | None:
+    def _repair_channel(
+        self, target_rank: int, bootstrap: bool = False
+    ) -> Communicator | None:
         """Lazily-opened send-only channel to ``target_rank``'s cache
         address — the prefetch-channel pattern, but role-agnostic (a
         router probes peers; a P/D node answers a router's probe at the
-        router's bind address). Dialed OUTSIDE the mesh lock: the
-        transport reader thread needs that lock to apply oplogs."""
+        router's bind address). ``bootstrap`` keys a SEPARATE channel
+        map so warm-join bulk sessions ride their own connection. Dialed
+        OUTSIDE the mesh lock: the transport reader thread needs that
+        lock to apply oplogs."""
         n_total = self.cfg.num_ring + len(self.cfg.router_nodes)
         if not 0 <= target_rank < n_total or target_rank == self.rank:
             return None
+        comms = self._bootstrap_comms if bootstrap else self._repair_comms
         with self._lock:
-            comm = self._repair_comms.get(target_rank)
+            comm = comms.get(target_rank)
         if comm is not None:
             return comm
         try:
@@ -1241,7 +1317,7 @@ class MeshCache:
             )
             return None
         with self._lock:
-            existing = self._repair_comms.setdefault(target_rank, comm)
+            existing = comms.setdefault(target_rank, comm)
         if existing is not comm:
             comm.close()
         return existing
@@ -1330,10 +1406,13 @@ class MeshCache:
             sent += 1
         return sent
 
-    def _adopt_view(self, view: TopologyView) -> bool:
+    def _adopt_view(self, view: TopologyView, cause: str = "view_change") -> bool:
         """Adopt ``view`` if it supersedes the current one (higher epoch
         wins; equal-epoch conflicts merge by intersection one epoch up —
-        both detectors' removals take effect). Caller holds the lock."""
+        both detectors' removals take effect). ``cause`` tags any
+        successor retarget this adoption forces ("dead" / "left" /
+        "view_change" — see the transitions counter). Caller holds the
+        lock."""
         cur = self.view
         if view.epoch < cur.epoch:
             return False
@@ -1342,12 +1421,52 @@ class MeshCache:
                 return False
             view = cur.merged_with(view)
             self.view = view
-            self._after_view_change(cur)
+            self._after_view_change(cur, cause=cause)
             self._announce_view(view)  # peers must learn the merge result
             return True
         self.view = view
-        self._after_view_change(cur)
+        self._after_view_change(cur, cause=cause)
         return True
+
+    def broadcast_leave(self) -> None:
+        """Announce this node's PLANNED departure (the graceful-drain
+        endgame, ``policy/lifecycle.py``): one LEAVE oplog carrying our
+        view WITHOUT us. Receivers adopt it with cause="left" — channel
+        retargets happen proactively, failure detection never fires, and
+        FleetView state is forgotten rather than left to rot. Droppable
+        like any frame: the lifecycle plane re-announces until it
+        observes its own exclusion (the view is epoch-guarded, so
+        duplicates are harmless). P/D only — routers never ring-send."""
+        if self.role is NodeRole.ROUTER:
+            raise RuntimeError("router nodes never originate ring traffic")
+        with self._lock:
+            leave = self.view.without(self.rank)
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.LEAVE,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self._data_ttl(),
+                    value=encode_view(leave),
+                )
+            )
+
+    def flush_outbound(self, timeout_s: float = 2.0) -> bool:
+        """Wait (bounded) for the outbound lanes to drain — the leaver's
+        LEAVE must actually reach the wire before the process exits.
+        Empty queues mean the sender threads have picked everything up;
+        the last in-flight send completes under close()'s thread join."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if (
+                self._ctl_q.empty()
+                and self._out_q.empty()
+                and self._spine_ctl_q.empty()
+                and self._spine_out_q.empty()
+            ):
+                return True
+            time.sleep(0.01)
+        return False
 
     def _announce_view(self, view: TopologyView) -> None:
         self._broadcast(
@@ -1360,7 +1479,7 @@ class MeshCache:
             )
         )
 
-    def _after_view_change(self, old: TopologyView) -> None:
+    def _after_view_change(self, old: TopologyView, cause: str = "view_change") -> None:
         """Recompute the ring successor and notify listeners. Caller holds
         the lock. The actual transport retarget happens on the sender
         thread (``_apply_pending_retarget``) so the receive path never
@@ -1390,23 +1509,35 @@ class MeshCache:
                 new_succ = view.successor_of(self.rank)
             if new_succ != self._succ_rank:
                 self._succ_rank = new_succ
+                self._m_succ_trans[cause].inc()
                 self._pending_retargets["ring"] = (
                     None if new_succ is None else self.cfg.addr_of_rank(new_succ)
                 )
                 self._retarget_flags["ring"].set()
                 self._send_evt.set()
             if not view.contains(self.rank):
-                # Falsely declared dead (we're alive enough to receive
-                # this): ask to be re-included.
-                self.log.warning("this node was removed from the view; rejoining")
-                self._broadcast(
-                    Oplog(
-                        op_type=OplogType.JOIN,
-                        origin_rank=self.rank,
-                        logic_id=self._logic_op.next(),
-                        ttl=self._data_ttl(),
+                lc = self.lifecycle
+                if lc is not None and lc.is_departing:
+                    # PLANNED exclusion (our own LEAVE coming back, or a
+                    # peer reacting to it): rejoining would undo the
+                    # drain (policy/lifecycle.py).
+                    self.log.info(
+                        "removed from the view during drain — expected"
                     )
-                )
+                else:
+                    # Falsely declared dead (we're alive enough to
+                    # receive this): ask to be re-included.
+                    self.log.warning(
+                        "this node was removed from the view; rejoining"
+                    )
+                    self._broadcast(
+                        Oplog(
+                            op_type=OplogType.JOIN,
+                            origin_rank=self.rank,
+                            logic_id=self._logic_op.next(),
+                            ttl=self._data_ttl(),
+                        )
+                    )
         # Departed nodes leave the fleet view with the membership: their
         # last digest must not pin min_score at the stale cap or hold
         # convergence pairs diverged forever (rejoiners re-fold fresh).
@@ -1458,7 +1589,10 @@ class MeshCache:
             old = self.view
             new_view = old.without(dead)
             self.view = new_view
-            self._after_view_change(old)
+            # cause="dead": this is the UNPLANNED path — a peer's
+            # graceful LEAVE retargets with cause="left" instead, so
+            # dashboards can tell churn from failure.
+            self._after_view_change(old, cause="dead")
             self._announce_view(new_view)
 
     def _apply_pending_retarget(self, dest: str) -> None:
@@ -1496,7 +1630,9 @@ class MeshCache:
     # replication: send path
     # ------------------------------------------------------------------
 
-    _CONTROL_TYPES = (OplogType.TICK, OplogType.TOPO, OplogType.JOIN)
+    _CONTROL_TYPES = (
+        OplogType.TICK, OplogType.TOPO, OplogType.JOIN, OplogType.LEAVE,
+    )
 
     def _broadcast(self, op: Oplog) -> None:
         """First transmission of a locally-originated oplog
@@ -1990,6 +2126,12 @@ class MeshCache:
             self._ttl_sweep()
             now = time.monotonic()
             if now - self._last_rx < timeout or now - self._last_self_join < timeout:
+                continue
+            lc = self.lifecycle
+            if lc is not None and lc.is_departing:
+                # Silence is EXPECTED while draining/left: peers stopped
+                # routing to us on purpose; a self-assertion JOIN would
+                # claw the node back into the view mid-drain.
                 continue
             self._last_self_join = now
             if throttled(("rejoin", self.rank), timeout):

@@ -145,6 +145,18 @@ class OplogType(enum.IntEnum):
     # (EXTENSION_KINDS below).
     REPAIR_PROBE = 12
     REPAIR_SUMMARY = 13
+    # Membership-lifecycle extension (policy/lifecycle.py): the origin
+    # announces a PLANNED departure at the end of a graceful drain.
+    # value = [epoch, *alive] — the origin's view WITHOUT itself (the
+    # same payload as TOPO), so receivers adopt it through the ordinary
+    # epoch-guarded view machinery; beyond TOPO semantics they also tag
+    # the successor retarget cause="left" (dashboards separate churn
+    # from failure), forget the leaver's FleetView telemetry (a frozen
+    # fingerprint must not poison convergence/min-score), and mark it
+    # "left" so routers refuse it new work even under a stale view.
+    # Droppable by contract: the leaver re-announces until it observes
+    # its own exclusion, and failure detection remains the backstop.
+    LEAVE = 14
 
 
 # Kinds added AFTER the unknown-kind pass-through tolerance shipped:
@@ -158,6 +170,7 @@ EXTENSION_KINDS = frozenset(
         OplogType.PREFETCH,
         OplogType.REPAIR_PROBE,
         OplogType.REPAIR_SUMMARY,
+        OplogType.LEAVE,
     }
 )
 # Kinds that carry replicated cache DATA: losing one of these frames

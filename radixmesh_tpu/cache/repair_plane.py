@@ -113,6 +113,14 @@ class RepairConfig:
     # Accounting bound: the bench/acceptance scenario asserts an episode
     # (divergence detected → converged) heals within this many rounds.
     round_budget: int = 8
+    # Warm-join bulk sessions (policy/lifecycle.py): a BOOTSTRAPPING
+    # peer is trying to ingest a WHOLE replica, not heal a few dropped
+    # frames — so bootstrap sessions summarize every bucket and push an
+    # order of magnitude more entries per round, over the dedicated
+    # bootstrap channel (MeshCache._bootstrap_comms). Steady-state
+    # sessions keep the tight budgets above.
+    bootstrap_bucket_budget: int = FP_BUCKETS
+    bootstrap_key_budget: int = 2048
 
 
 # ---------------------------------------------------------------------------
@@ -389,15 +397,61 @@ class RepairPlane:
                 )
         return probes
 
-    def _send_probe(self, rank: int) -> bool:
+    def _send_probe(self, rank: int, bootstrap: bool = False) -> bool:
         with self.mesh._lock:
             vec = self.mesh.tree.fingerprint_buckets()
         ok = self.mesh.send_repair(
-            rank, OplogType.REPAIR_PROBE, encode_probe(vec)
+            rank, OplogType.REPAIR_PROBE, encode_probe(vec),
+            bootstrap=bootstrap,
         )
         if ok:
             self._m_probes_sent.inc()
         return ok
+
+    def bootstrap_probe(self, rank: int) -> bool:
+        """One warm-join bulk-session round against donor ``rank``
+        (driven by the lifecycle plane's bootstrap pacing — no age
+        threshold, no backoff: the joiner KNOWS it is cold). Raised
+        budgets apply on both sides: this side marks the peer state
+        bootstrap; the donor recognizes the joiner's gossiped
+        BOOTSTRAPPING lifecycle. Frames ride the dedicated bootstrap
+        channel so bulk traffic never queues behind steady-state
+        repair."""
+        st = self._peers.setdefault(
+            rank,
+            {
+                "since": time.monotonic(),
+                "next_probe_at": 0.0,
+                "backoff_s": self.cfg.backoff_base_s,
+                "rounds": 0,
+                "probe_sent_at": 0.0,
+            },
+        )
+        st["bootstrap"] = True
+        if self._send_probe(rank, bootstrap=True):
+            now = time.monotonic()
+            st["probe_sent_at"] = now
+            st["rounds"] += 1
+            # Hold the regular scan off this peer for a backoff window:
+            # the lifecycle plane owns bootstrap pacing, and a scan-path
+            # probe racing it would just double the round count.
+            st["next_probe_at"] = now + self.cfg.backoff_base_s
+            return True
+        return False
+
+    def _is_bootstrap_session(self, rank: int) -> bool:
+        """True when the session with ``rank`` should use bulk budgets:
+        either WE are bootstrapping from it (peer state marked by
+        ``bootstrap_probe``) or IT gossips a BOOTSTRAPPING lifecycle (we
+        are its donor). Gossip lag degrades this to an ordinary
+        steady-state session — slower, never wrong."""
+        st = self._peers.get(rank)
+        if st is not None and st.get("bootstrap"):
+            return True
+        try:
+            return self.mesh.fleet.lifecycle_of(rank) == "bootstrapping"
+        except Exception:  # noqa: BLE001 — telemetry must not break repair
+            return False
 
     # -- inbound session handling (worker thread) -----------------------
 
@@ -407,9 +461,11 @@ class RepairPlane:
         elif op.op_type is OplogType.REPAIR_SUMMARY:
             self._handle_summary(op)
 
-    def _diff_buckets(self, mine: np.ndarray, theirs: np.ndarray) -> list[int]:
+    def _diff_buckets(
+        self, mine: np.ndarray, theirs: np.ndarray, budget: int | None = None
+    ) -> list[int]:
         diff = [int(i) for i in np.nonzero(mine != theirs)[0]]
-        return diff[: self.cfg.bucket_budget]
+        return diff[: self.cfg.bucket_budget if budget is None else budget]
 
     def _summary_for(self, buckets) -> tuple[np.ndarray, list[int]]:
         """(my bucket vector, path hashes of my entries touching
@@ -430,6 +486,12 @@ class RepairPlane:
         except ValueError:
             self.log.warning("malformed repair probe from rank %d", op.origin_rank)
             return
+        # Bulk budgets + dedicated channel when the peer is a warm-join
+        # bootstrapper (this node is its donor) — see RepairConfig.
+        bootstrap = self._is_bootstrap_session(op.origin_rank)
+        bucket_budget = (
+            self.cfg.bootstrap_bucket_budget if bootstrap else None
+        )
         # One lock hold for vector + diff + summaries; a converged-probe
         # race (empty diff — the steady-state case) costs O(buckets),
         # never a tree walk, and still answers so the initiator's round
@@ -437,7 +499,7 @@ class RepairPlane:
         mesh = self.mesh
         with mesh._lock:
             vec = mesh.tree.fingerprint_buckets()
-            buckets = self._diff_buckets(vec, their_vec)
+            buckets = self._diff_buckets(vec, their_vec, budget=bucket_budget)
             hashes = [
                 mesh.tree.path_hash(n)
                 for n in mesh.tree.nodes_touching_buckets(buckets)
@@ -446,6 +508,7 @@ class RepairPlane:
             op.origin_rank,
             OplogType.REPAIR_SUMMARY,
             encode_summary(vec, buckets, hashes, reply=False),
+            bootstrap=bootstrap,
         ):
             self._m_summaries.inc()
 
@@ -458,11 +521,14 @@ class RepairPlane:
             )
             return
         t0 = time.monotonic()
+        bootstrap = self._is_bootstrap_session(op.origin_rank)
         # Push MY one-sided entries for the session's buckets as ordinary
         # ring INSERTs (no-op on routers: they hold no indices and never
-        # ring-send).
+        # ring-send). A donor answering a bootstrapper pushes with the
+        # raised bulk budget.
         keys, oplogs = self.mesh.repair_push_keys(
-            buckets, their_hashes, self.cfg.key_budget
+            buckets, their_hashes,
+            self.cfg.bootstrap_key_budget if bootstrap else self.cfg.key_budget,
         )
         if keys:
             self._m_keys.inc(keys)
@@ -475,6 +541,7 @@ class RepairPlane:
                 op.origin_rank,
                 OplogType.REPAIR_SUMMARY,
                 encode_summary(vec, buckets, hashes, reply=True),
+                bootstrap=bootstrap,
             ):
                 self._m_summaries.inc()
             self._m_rounds.inc()
@@ -525,6 +592,9 @@ class RepairPlane:
                 (st.get("rounds", 0) for _, st in peer_states), default=0
             ),
             "diverged_peers": sorted(r for r, _ in peer_states),
+            "bootstrap_peers": sorted(
+                r for r, st in peer_states if st.get("bootstrap")
+            ),
         }
 
 

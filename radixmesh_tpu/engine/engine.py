@@ -311,6 +311,11 @@ class Engine:
         self.kv_transfer = None
         self._kv_min_restore = max(0, kv_transfer_min_restore_tokens)
         self._restoring: list[tuple[Request, object]] = []
+        # Graceful drain (policy/lifecycle.py, set via the runner's
+        # begin_drain): PREFETCH hints stop converting to restores — a
+        # warm-up nobody will be routed here to use must not open
+        # tickets on a departing node.
+        self.draining = False
         if kv_transfer_async:
             from radixmesh_tpu.cache.kv_transfer import KVTransferPlane
 
@@ -505,6 +510,51 @@ class Engine:
             + [r.rid for r, _ in self._restoring]
         )
         return sum(1 for rid in rids if self.cancel(rid))
+
+    # ------------------------------------------------------------------
+    # graceful drain (policy/lifecycle.py, serialized via the runner)
+    # ------------------------------------------------------------------
+
+    def drain_requeue_waiting(self) -> int:
+        """Cancel-and-flag every QUEUED and parked-RESTORING request for
+        requeue at the router: they have produced nothing, so bouncing
+        them to a surviving node loses no work — while RUNNING rows are
+        deliberately left alone to finish under the drain deadline.
+        The ``drain_requeue`` shed reason tells the client (and the
+        chaos workload) to resubmit via the router, not give up.
+        Restore tickets flip to auto-release (the existing cancel path),
+        so no eviction shield outlives the departing request."""
+        victims = list(self.waiting) + [r for r, _ in self._restoring]
+        n = 0
+        for req in victims:
+            req.shed = True
+            req.shed_reason = "drain_requeue"
+            if self.cancel(req.rid):
+                n += 1
+        return n
+
+    def drain_flush_hot(self) -> int:
+        """Write every unlocked device-resident prefix back to the host
+        tier — the PR 4 fused write-back lane does the moving (one
+        gather per sweep; arena writes land on the plane worker) — so a
+        warm rejoin, or a sibling's restore, finds the working set
+        instead of recomputing it. Returns tokens written back; 0
+        without a host tier. Run AFTER in-flight decodes finish: evict
+        only touches unlocked entries, so flushing early would silently
+        skip everything a running request still pins."""
+        tree = self.tree
+        if getattr(tree, "host", None) is None:
+            return 0
+        total = 0
+        while True:
+            n = tree.evictable_size_
+            if n <= 0:
+                break
+            freed = tree.evict(n)
+            if freed <= 0:
+                break
+            total += freed
+        return total
 
     def step(self) -> None:
         """One scheduler iteration: admit+prefill queued requests into free
@@ -890,6 +940,13 @@ class Engine:
         duplicate, stale, or raced hint degrades to a no-op."""
         plane = self.kv_transfer
         if plane is None or not hasattr(self.tree, "match_and_load"):
+            return
+        if self.draining:
+            # Drain races a router hint: the router stops hinting once
+            # the DRAINING state gossips, but frames already in flight
+            # land here — drop them (counted) instead of opening a
+            # restore ticket nothing will ever be routed here to use.
+            plane.count_hint("draining")
             return
         match = self.tree.match_prefix(key, split_partial=False)
         if not match.host_nodes:

@@ -239,7 +239,9 @@ def _run_node(args: argparse.Namespace) -> int:
     # Fleet telemetry plane: ring nodes gossip a NodeDigest per interval
     # (serving nodes include engine occupancy/latency; cache-only nodes
     # publish mesh-only digests). Routers never send — their fleet view
-    # fills from the master's fan-out.
+    # fills from the master's fan-out. Constructed here but STARTED after
+    # the lifecycle plane attaches, so the very first digest already
+    # carries the node's true lifecycle state.
     digest_interval = (
         args.fleet_digest_interval
         if args.fleet_digest_interval is not None
@@ -256,8 +258,7 @@ def _run_node(args: argparse.Namespace) -> int:
             # it as runner.ctl; plain runners have no tier to report).
             slo=getattr(getattr(frontend, "runner", None), "ctl", None),
             interval_s=digest_interval,
-        ).start()
-        log.info("fleet digests every %.1fs", digest_interval)
+        )
 
     # Anti-entropy repair plane: every role runs one (routers probe and
     # pull; they never push) — it closes the detect→repair loop the
@@ -290,6 +291,40 @@ def _run_node(args: argparse.Namespace) -> int:
             repair_interval, cfg.repair_age_threshold_s,
         )
 
+    # Membership lifecycle plane (policy/lifecycle.py): ring nodes get
+    # the BOOTSTRAPPING → ACTIVE → DRAINING → LEFT state machine. Warm
+    # bootstrap (bulk repair from a donor + router hit-withholding) only
+    # engages when the machinery it rides exists — digest gossip to see
+    # donors and a repair plane to pull through; otherwise the node
+    # starts ACTIVE, exactly the pre-lifecycle behavior. POST
+    # /admin/drain (serving nodes) and SIGTERM both drain through it.
+    lifecycle_plane = None
+    if role is not NodeRole.ROUTER:
+        from radixmesh_tpu.policy.lifecycle import (
+            LifecycleConfig,
+            LifecyclePlane,
+        )
+
+        lifecycle_plane = LifecyclePlane(
+            node,
+            repair=repair_plane,
+            runner=getattr(frontend, "runner", None),
+            fleet_plane=fleet_plane,
+            cfg=LifecycleConfig(drain_timeout_s=args.drain_timeout),
+            bootstrap=(repair_plane is not None and digest_interval > 0),
+        )
+        if frontend is not None:
+            frontend.lifecycle = lifecycle_plane
+    if fleet_plane is not None:
+        fleet_plane.start()
+        log.info("fleet digests every %.1fs", digest_interval)
+    if lifecycle_plane is not None:
+        lifecycle_plane.start()
+        log.info(
+            "membership lifecycle plane armed (state=%s, drain timeout %.0fs)",
+            lifecycle_plane.state.value, args.drain_timeout,
+        )
+
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -297,6 +332,16 @@ def _run_node(args: argparse.Namespace) -> int:
         while not stop.is_set():
             stop.wait(1.0)
     finally:
+        if lifecycle_plane is not None:
+            # Drain on the way out when we can still talk to the ring:
+            # requeue parked work, flush hot prefixes, announce LEAVE —
+            # the graceful path SIGTERM is supposed to take. Already-
+            # drained (POST /admin/drain) nodes fall through instantly.
+            try:
+                lifecycle_plane.drain(deadline_s=args.drain_timeout)
+            except Exception:  # noqa: BLE001 — drain failure must not block exit
+                log.exception("exit drain failed")
+            lifecycle_plane.close()
         if repair_plane is not None:
             repair_plane.close()
         if fleet_plane is not None:
@@ -505,6 +550,14 @@ def main(argv: list[str] | None = None) -> int:
         "(comm/faults.py): seeded frame drops, delays, duplicates, "
         "reordering, scheduled partitions, channel crashes — applied to "
         "every transport this node opens. Drills and soak runs only",
+    )
+    node.add_argument(
+        "--drain-timeout", type=float, default=30.0, metavar="SECONDS",
+        help="graceful-drain deadline (policy/lifecycle.py): on POST "
+        "/admin/drain or SIGTERM, in-flight decodes get this long to "
+        "finish while new work sheds retriably (503 + Retry-After at "
+        "the router), parked restores are requeued, hot prefixes are "
+        "written back to the host tier, and the node announces LEAVE",
     )
     node.add_argument(
         "--kv-prefetch-hints", action="store_true",

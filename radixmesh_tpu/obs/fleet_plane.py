@@ -52,6 +52,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from radixmesh_tpu.obs.metrics import get_registry
+from radixmesh_tpu.policy.lifecycle import lifecycle_code, lifecycle_from_code
 from radixmesh_tpu.utils.logging import get_logger
 
 __all__ = [
@@ -93,7 +94,14 @@ def eviction_counters(node: str):
 # NodeDigest: the fixed-layout gossip payload
 # ---------------------------------------------------------------------------
 
-_DIGEST_VERSION = 1
+# v2: the tier byte's high nibble carries the membership-lifecycle code
+# (policy/lifecycle.py) — same layout, new INTERPRETATION of that byte.
+# The version bump exists for the rolling-upgrade window: a v1 decoder
+# reading a v2 digest would misparse BOOTSTRAPPING as slo_tier=16, so it
+# must reject-and-log (its version check does) rather than misread;
+# v2 decoders still accept v1 digests (full-byte tier, lifecycle
+# "active" — factually what a pre-lifecycle node is in).
+_DIGEST_VERSION = 2
 # magic+version+role+tier, rank, epoch, waiting, seq, decode_steps,
 # ts, fingerprint, tree_tokens, 5 floats, 4 eviction counters.
 _DIGEST_FMT = "<BBBBiiiqqdQq5f4q"
@@ -133,6 +141,15 @@ class NodeDigest:
     # The origin's publish cadence: receivers size their staleness window
     # from it (a router must not mark a 60s-interval fleet stale at 15s).
     interval_s: float = 0.0
+    # Membership lifecycle state (policy/lifecycle.py): the router
+    # withholds cache-hit routing from "bootstrapping" nodes and all new
+    # work from "draining"/"left" ones. Travels in the HIGH NIBBLE of
+    # the existing tier byte (tiers are 0-3, lifecycle codes are 0-3) —
+    # same layout and size, but a v2 digest version so a pre-lifecycle
+    # decoder rejects-and-logs instead of misreading the nibble as
+    # slo_tier=16/32 during a rolling upgrade (v1 digests still decode
+    # here: full-byte tier, lifecycle "active").
+    lifecycle: str = "active"
 
     def encode(self) -> np.ndarray:
         """Pack into an int32 array — the shape the oplog wire already
@@ -142,7 +159,7 @@ class NodeDigest:
             _DIGEST_MAGIC,
             _DIGEST_VERSION,
             _ROLE_CODES.get(self.role, 2),
-            self.slo_tier & 0xFF,
+            (lifecycle_code(self.lifecycle) << 4) | (self.slo_tier & 0x0F),
             self.rank,
             self.epoch,
             self.waiting,
@@ -177,8 +194,14 @@ class NodeDigest:
         ) = struct.unpack_from(_DIGEST_FMT, raw, 0)
         if magic != _DIGEST_MAGIC:
             raise ValueError(f"bad digest magic {magic:#x}")
-        if version != _DIGEST_VERSION:
+        if version not in (1, _DIGEST_VERSION):
             raise ValueError(f"unsupported digest version {version}")
+        if version == 1:
+            # Pre-lifecycle digest: the whole byte is the tier, and the
+            # node factually has no lifecycle machinery → "active".
+            slo_tier, lifecycle = tier, "active"
+        else:
+            slo_tier, lifecycle = tier & 0x0F, lifecycle_from_code(tier >> 4)
         lag, interval = struct.unpack_from("<ff", raw, base)
         return cls(
             rank=rank,
@@ -196,9 +219,10 @@ class NodeDigest:
             waiting=waiting,
             decode_steps=decode_steps,
             replication_lag_s=lag,
-            slo_tier=tier,
+            slo_tier=slo_tier,
             evictions=(ev0, ev1, ev2, ev3),
             interval_s=interval,
+            lifecycle=lifecycle,
         )
 
     def encoded_size(self) -> int:
@@ -224,6 +248,7 @@ class NodeDigest:
             "slo_tier": self.slo_tier,
             "evictions": dict(zip(EVICTION_CAUSES, self.evictions)),
             "interval_s": round(self.interval_s, 3),
+            "lifecycle": self.lifecycle,
         }
 
 
@@ -268,6 +293,12 @@ class FleetView:
         # (lo, hi) rank pair → wall time their fingerprints were first
         # seen unequal; absent = currently equal (or a side unknown).
         self._diverged_at: dict[tuple[int, int], float] = {}
+        # Ranks that announced a PLANNED departure (LEAVE oplog): their
+        # straggler digests are refused so a frozen fingerprint cannot
+        # re-enter the convergence audit or pin min_score after the
+        # membership dropped them. A rejoiner's fresh digests (state
+        # bootstrapping/active) clear the mark.
+        self._left: set[int] = set()
         self.folds = 0  # digests accepted (lifetime)
 
     # -- fold ----------------------------------------------------------
@@ -283,6 +314,13 @@ class FleetView:
         advanced the view."""
         now = self._now()
         with self._lock:
+            if d.rank in self._left:
+                if d.lifecycle in ("draining", "left"):
+                    # A straggler from a departed node (the LEAVE beat
+                    # its final data-lane digests): refuse the fold.
+                    return False
+                # Fresh state from a rejoiner: the mark is stale.
+                self._left.discard(d.rank)
             cur = self._digests.get(d.rank)
             if cur is not None and (d.ts, d.seq) <= (cur.ts, cur.seq):
                 return False
@@ -331,15 +369,33 @@ class FleetView:
         simply folds fresh digests again."""
         keep = set(ranks)
         with self._lock:
-            for store in (self._digests, self._prev, self._stalled,
-                          self._storm_rate):
-                for r in [r for r in store if r not in keep]:
-                    del store[r]
-            for pair in [
-                p for p in self._diverged_at
-                if p[0] not in keep or p[1] not in keep
-            ]:
-                del self._diverged_at[pair]
+            for r in [r for r in self._digests if r not in keep]:
+                self._forget_locked(r)
+
+    def forget(self, rank: int) -> None:
+        """Drop ONE rank's state — the single-rank mirror of
+        :meth:`retain`, called when a peer announces a planned LEAVE
+        (``policy/lifecycle.py``). Beyond what the view-change retain
+        would eventually do, forgetting on the LEAVE itself guarantees a
+        later REJOIN starts from a clean slate: the old replication-lag
+        EWMA, stall flag, storm rate, and fingerprint all die with the
+        departure instead of being inherited by the reincarnation."""
+        with self._lock:
+            self._forget_locked(rank)
+
+    def _forget_locked(self, rank: int) -> None:
+        for store in (self._digests, self._prev, self._stalled,
+                      self._storm_rate):
+            store.pop(rank, None)
+        for pair in [p for p in self._diverged_at if rank in p]:
+            del self._diverged_at[pair]
+
+    def mark_left(self, rank: int) -> None:
+        """Record a planned departure: ``lifecycle_of`` answers "left"
+        (the router refuses the node new work even if a stale view still
+        lists it) and straggler digests are refused (see ``fold``)."""
+        with self._lock:
+            self._left.add(rank)
 
     # -- reads ---------------------------------------------------------
 
@@ -353,6 +409,24 @@ class FleetView:
         the scan runs every repair interval on every node)."""
         with self._lock:
             return {r: d.fingerprint for r, d in self._digests.items()}
+
+    def lifecycle_of(self, rank: int) -> str:
+        """One rank's gossiped membership-lifecycle state ("active" for
+        unknown ranks — normal routing is the safe default)."""
+        with self._lock:
+            if rank in self._left:
+                return "left"
+            d = self._digests.get(rank)
+            return d.lifecycle if d is not None else "active"
+
+    def lifecycles(self) -> dict[int, str]:
+        """rank → lifecycle state, one lock hold (the router's per-route
+        withhold/exclude computation)."""
+        with self._lock:
+            out = {r: d.lifecycle for r, d in self._digests.items()}
+            for r in self._left:
+                out[r] = "left"
+            return out
 
     def diverged_with(self, rank: int) -> dict[int, float]:
         """Peers currently fingerprint-diverged from ``rank``, with
@@ -429,6 +503,7 @@ class FleetView:
                     "reasons": reasons,
                     "age_s": round(age, 3),
                     "role": d.role,
+                    "lifecycle": d.lifecycle,
                 }
         return out
 
@@ -507,6 +582,11 @@ class FleetPlane:
         tier = 0
         if self.slo is not None:
             tier = int(getattr(self.slo, "_tier", 0))
+        # Membership lifecycle (policy/lifecycle.py): the plane, when one
+        # is attached to the mesh, is the single source of truth — this
+        # is a READ; only policy/lifecycle.py ever assigns the state.
+        lc = getattr(mesh, "lifecycle", None)
+        lifecycle = lc.state.value if lc is not None else "active"
         return NodeDigest(
             rank=mesh.rank,
             role=mesh.role.value,
@@ -526,6 +606,7 @@ class FleetPlane:
             slo_tier=tier,
             evictions=evictions,
             interval_s=self.cfg.interval_s,
+            lifecycle=lifecycle,
         )
 
     def publish_once(self) -> NodeDigest:

@@ -1,8 +1,16 @@
 from radixmesh_tpu.policy.conflict import NodeRankConflictResolver
+from radixmesh_tpu.policy.lifecycle import (
+    AutoscalePolicy,
+    LifecyclePlane,
+    LifecycleState,
+)
 from radixmesh_tpu.policy.sync_algo import BaseSyncAlgo, RingSyncAlgo, TopoResult, get_sync_algo
 
 __all__ = [
     "NodeRankConflictResolver",
+    "AutoscalePolicy",
+    "LifecyclePlane",
+    "LifecycleState",
     "BaseSyncAlgo",
     "RingSyncAlgo",
     "TopoResult",

@@ -122,6 +122,9 @@ class CacheAwareRouter:
                 for r, a in enumerate(config.decode_nodes)
             },
         }
+        # Static inverse, precomputed once: _lifecycle_sets runs on the
+        # routing hot path and must not rebuild this per request.
+        self._addr_of_rank = {r: a for a, r in self._rank_of_addr.items()}
         # Hot-prefix overload protection (net-new; the reference always
         # follows the cache): when a cache hit points at a node whose
         # estimated in-flight load exceeds ``overload_factor`` x the
@@ -177,8 +180,16 @@ class CacheAwareRouter:
         self._m_routed = {
             (role, outcome): routed.labels(role=role, outcome=outcome)
             for role in ("prefill", "decode")
-            for outcome in ("hit", "fallback", "shed")
+            for outcome in ("hit", "fallback", "shed", "withheld")
         }
+        # Membership-lifecycle withholding (policy/lifecycle.py): a
+        # BOOTSTRAPPING node's replica is still cold — a cache hit
+        # pointing at it would miss on arrival, so hits are withheld
+        # (hash-ring fallback serves instead) until its fingerprint
+        # converges with its donor and it gossips ACTIVE. DRAINING/LEFT
+        # nodes get no new work at all. Always on: lifecycle states only
+        # exist when a LifecyclePlane gossips them.
+        self.withheld_hits = 0  # lifetime count (chaos-gate telemetry)
         self._m_route_latency = reg.histogram(
             "radixmesh_router_route_seconds", "cache-aware routing decision latency"
         )
@@ -236,6 +247,28 @@ class CacheAwareRouter:
         if not sick:
             return set()
         return {a for a, r in self._rank_of_addr.items() if r in sick}
+
+    def _lifecycle_sets(self) -> tuple[set[int], set[str]]:
+        """(withheld hit ranks, excluded addrs) from gossiped lifecycle
+        states — one FleetView lock hold per route call. BOOTSTRAPPING
+        ranks lose only their cache-hit preference (they still take
+        hash-ring fallback traffic: the warm-up they are running exists
+        to serve exactly that); DRAINING/LEFT nodes are excluded from
+        hits AND the fallback rings (no new work on a departing node)."""
+        lifecycles = self.fleet.lifecycles()
+        withhold: set[int] = set()
+        excluded: set[str] = set()
+        for rank, state in lifecycles.items():
+            if state == "active":
+                continue  # the steady-state hot path: no sets built
+            if state == "bootstrapping":
+                withhold.add(rank)
+            elif state in ("draining", "left"):
+                withhold.add(rank)
+                addr = self._addr_of_rank.get(rank)
+                if addr is not None:
+                    excluded.add(addr)
+        return withhold, excluded
 
     def _overloaded(self, role: str, addr: str, sick: set[str]) -> bool:
         # Health demotion first: a stalled node must shed even when its
@@ -329,36 +362,62 @@ class CacheAwareRouter:
 
         p_out = d_out = None
         sick = self._sick_addrs()
+        withhold, lc_excluded = self._lifecycle_sets()
+        avoid = sick | lc_excluded  # never a fallback target either
         if match.prefill_rank >= 0:
             prefill_addr = self.config.prefill_addr(match.prefill_rank)
             p_hit = True
-            if self._overloaded("prefill", prefill_addr, sick):
+            if match.prefill_rank in withhold:
+                # Cold (bootstrapping) or departing replica: the hit is
+                # not servable there — hash-ring fallback instead.
+                self.withheld_hits += 1
+                alt = self._prefill_ring.get_node(
+                    key, exclude={prefill_addr} | avoid
+                ) or self._prefill_ring.get_node(key, exclude=lc_excluded or None)
+                if alt is not None:
+                    prefill_addr = alt
+                p_hit, p_out = False, "withheld"
+            elif self._overloaded("prefill", prefill_addr, sick):
                 shed = self._prefill_ring.get_node(
-                    key, exclude={prefill_addr} | sick
+                    key, exclude={prefill_addr} | avoid
                 )
                 if shed is not None:
                     prefill_addr, p_hit, p_out = shed, False, "shed"
         else:
             # Cache miss: hash-ring fallback, skipping health-demoted
-            # nodes. If EVERY node of the role is sick, route anyway
-            # (degraded service beats no service) — sickness is advisory.
-            prefill_addr = self._prefill_ring.get_node(
-                key, exclude=sick or None
-            ) or self._prefill_ring.get_node(key)
+            # and departing nodes. If EVERY node of the role is sick,
+            # route anyway (degraded service beats no service) —
+            # sickness is advisory; departure exclusion yields only when
+            # literally nothing else exists.
+            prefill_addr = (
+                self._prefill_ring.get_node(key, exclude=avoid or None)
+                or self._prefill_ring.get_node(key, exclude=lc_excluded or None)
+                or self._prefill_ring.get_node(key)
+            )
             p_hit = False
         if match.decode_rank >= 0:
             decode_addr = self.config.decode_addr(match.decode_rank)
             d_hit = True
-            if self._overloaded("decode", decode_addr, sick):
+            if match.decode_rank in withhold:
+                self.withheld_hits += 1
+                alt = self._decode_ring.get_node(
+                    key, exclude={decode_addr} | avoid
+                ) or self._decode_ring.get_node(key, exclude=lc_excluded or None)
+                if alt is not None:
+                    decode_addr = alt
+                d_hit, d_out = False, "withheld"
+            elif self._overloaded("decode", decode_addr, sick):
                 shed = self._decode_ring.get_node(
-                    key, exclude={decode_addr} | sick
+                    key, exclude={decode_addr} | avoid
                 )
                 if shed is not None:
                     decode_addr, d_hit, d_out = shed, False, "shed"
         else:
-            decode_addr = self._decode_ring.get_node(
-                key, exclude=sick or None
-            ) or self._decode_ring.get_node(key)
+            decode_addr = (
+                self._decode_ring.get_node(key, exclude=avoid or None)
+                or self._decode_ring.get_node(key, exclude=lc_excluded or None)
+                or self._decode_ring.get_node(key)
+            )
             d_hit = False
         if self.prefetch_hints and match.match_len > 0:
             # Hint only ranks the request will actually LAND on (a shed

@@ -60,6 +60,10 @@ class EngineRunner:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._closed = False
+        # Graceful drain (policy/lifecycle.py): once set, new submits are
+        # refused retriably while in-flight work runs to completion.
+        self._draining = False
+        self._drain_retry_after_s = 1.0
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="engine-runner"
         )
@@ -96,6 +100,10 @@ class EngineRunner:
                 # After the shutdown cancel sweep nothing steps the engine
                 # again; admitting would strand the waiter forever.
                 raise RuntimeError("engine runner is shut down")
+            if self._draining:
+                raise RuntimeError(
+                    "node is draining — retry via the router"
+                )
             req = self.engine.add_request(prompt, sampling)
         self._wake.set()
         return req
@@ -103,6 +111,66 @@ class EngineRunner:
     def cancel(self, rid: int) -> bool:
         with self._lock:
             return self.engine.cancel(rid)
+
+    # -- graceful drain (driven by policy/lifecycle.py) ----------------
+
+    def begin_drain(self, retry_after_s: float = 1.0) -> None:
+        """Close the admission window: new submits are refused retriably
+        (clients re-route via the router) while in-flight work keeps
+        stepping. The engine also stops converting PREFETCH hints — a
+        restore nobody will be routed here to use must not open tickets
+        on a departing node."""
+        with self._lock:
+            self._draining = True
+            self._drain_retry_after_s = retry_after_s
+            self.engine.draining = True
+
+    def drain_requeue(self) -> int:
+        """Cancel-and-flag every queued and parked-RESTORING request for
+        requeue at the router (they have produced nothing; bouncing them
+        loses no work). Returns the number flagged."""
+        with self._lock:
+            return self.engine.drain_requeue_waiting()
+
+    def drain_wait_idle(self, deadline_s: float, poll_s: float = 0.02) -> bool:
+        """Let in-flight decodes run to completion, bounded by
+        ``deadline_s``; stragglers past it are cancelled (partial output
+        returns, flagged). True = everything finished on its own."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self.engine.has_work():
+                    return True
+            time.sleep(poll_s)
+        with self._lock:
+            n = self.engine.cancel_all()
+        if n:
+            self.log.warning(
+                "drain deadline (%.1fs): cancelled %d straggler(s)",
+                deadline_s, n,
+            )
+        return False
+
+    def drain_flush(self) -> tuple[int, bool]:
+        """Write hot prefixes back to the host tier (fused write-back
+        lane) and wait for the arena writes to land — the last step
+        before LEAVE, so a warm rejoin finds its working set. Returns
+        ``(tokens written back, landed)``: ``landed`` is False when the
+        write barrier timed out or an awaited write-back FAILED (its
+        arena bytes are untrusted), so the drain must not report a
+        durable flush it never got."""
+        with self._lock:
+            n = self.engine.drain_flush_hot()
+        plane = self.engine.kv_transfer
+        landed = True
+        if plane is not None:
+            landed = plane.wait_host_ready()
+            if not landed:
+                self.log.warning(
+                    "drain write-back barrier failed/timed out — hot "
+                    "prefixes may not have landed in the host tier"
+                )
+        return n, landed
 
     def wait(self, req: Request, timeout: float | None = None) -> list[int]:
         """Block until ``req`` finishes; returns its generated tokens.
@@ -255,16 +323,23 @@ def _cluster_telemetry(mesh) -> dict:
 
 def _cluster_health(mesh) -> dict:
     """``GET /cluster/health``: per-node 0..1 health scores with the
-    detector reasons that capped them, plus the fleet-wide convergence
-    summary — the page an operator (or a probe) reads first."""
+    detector reasons that capped them, the fleet-wide convergence
+    summary, and the autoscale recommendation (pure policy over the
+    same gossiped signals — ``policy/lifecycle.py``) — the page an
+    operator (or a workload driver) reads first."""
     if mesh is None:
         return {"nodes": {}, "note": "no cache mesh attached to this node"}
+    from radixmesh_tpu.policy.lifecycle import AutoscalePolicy
+
     health = mesh.fleet.health()
     scores = [h["score"] for h in health.values()]
     return {
         "nodes": {str(r): h for r, h in sorted(health.items())},
         "min_score": min(scores, default=1.0),
         "convergence": mesh.fleet.convergence(),
+        "autoscale": AutoscalePolicy().recommend(
+            mesh.fleet, alive_ring=len(mesh.view.alive)
+        ),
         "self": _membership_state(mesh),
     }
 
@@ -294,7 +369,15 @@ class ServingFrontend:
         profile_dir: str | None = None,
         tokenizer=None,
         slo=None,
+        lifecycle=None,
     ):
+        # Membership lifecycle plane (policy/lifecycle.py). With one
+        # attached, POST /admin/drain moves the node through DRAINING →
+        # LEFT, and drain sheds carry a "router" field pointing clients
+        # at the retry path. launch.py wires it after construction (the
+        # plane needs this frontend's runner), so handlers read the
+        # attribute dynamically.
+        self.lifecycle = lifecycle
         # With an SLOConfig, the overload control plane owns admission:
         # /generate grows `tenant`, `ttft_deadline_ms`, `deadline_ms`
         # fields, and overload answers 429/503 + Retry-After instead of
@@ -406,6 +489,9 @@ class ServingFrontend:
                 state["membership"] = _membership_state(eng.mesh)
             if self.slo_enabled:
                 state["slo"] = self.runner.ctl.snapshot()
+            lc = self.lifecycle
+            if lc is not None:
+                state["lifecycle"] = lc.status()
             return state
 
         self._debug_requests = _debug_requests
@@ -467,6 +553,43 @@ class ServingFrontend:
                     _json_response(self, 404, {"error": "not found"})
 
             def do_POST(self):
+                if self.path == "/admin/drain":
+                    # Graceful drain (policy/lifecycle.py): kick the
+                    # DRAINING → LEFT sequence asynchronously — the
+                    # handler must not block for the full drain deadline.
+                    lc = frontend.lifecycle
+                    if lc is None:
+                        _json_response(
+                            self, 404,
+                            {"error": "no lifecycle plane attached to "
+                             "this node (start via launch.py node)"},
+                        )
+                        return
+                    try:
+                        length = int(self.headers.get("Content-Length", 0) or 0)
+                        body = _read_json(self) if length > 0 else {}
+                        deadline = body.get("deadline_s")
+                        deadline = None if deadline is None else float(deadline)
+                    except (TypeError, ValueError, json.JSONDecodeError) as e:
+                        _json_response(self, 400, {"error": str(e)})
+                        return
+                    accepted = lc.request_drain(deadline_s=deadline)
+                    _json_response(
+                        self,
+                        202 if accepted else 200,
+                        {
+                            "accepted": accepted,
+                            "state": lc.state.value,
+                            # Where shed clients should retry.
+                            "router": lc.router_hint(),
+                            "deadline_s": (
+                                deadline
+                                if deadline is not None
+                                else lc.cfg.drain_timeout_s
+                            ),
+                        },
+                    )
+                    return
                 if self.path == "/profile":
                     # Capture a device+host trace of live serving into a
                     # server-configured logdir (obs/tracing.py::profile —
@@ -566,6 +689,14 @@ class ServingFrontend:
                 try:
                     req = frontend.runner.submit(ids, sampling, **slo_kw)
                 except RequestShed as e:  # overload control plane refusal
+                    # A drain shed points the client at the router: the
+                    # fleet still has capacity — just not HERE.
+                    drain_hint = (
+                        {"router": frontend.lifecycle.router_hint()}
+                        if e.reason == "draining"
+                        and frontend.lifecycle is not None
+                        else {}
+                    )
                     if e.retry_after_s is not None:
                         # Retry-After must precede end_headers; build the
                         # response by hand rather than teach
@@ -576,6 +707,7 @@ class ServingFrontend:
                                 "shed": True,
                                 "reason": e.reason,
                                 "retry_after_s": round(e.retry_after_s, 4),
+                                **drain_hint,
                             }
                         ).encode()
                         self.send_response(e.http_status)
@@ -590,14 +722,19 @@ class ServingFrontend:
                         _json_response(
                             self,
                             e.http_status,
-                            {"error": str(e), "shed": True, "reason": e.reason},
+                            {"error": str(e), "shed": True, "reason": e.reason,
+                             **drain_hint},
                         )
                     return
                 except ValueError as e:  # e.g. prompt too long
                     _json_response(self, 400, {"error": str(e)})
                     return
-                except RuntimeError as e:  # submit raced shutdown
-                    _json_response(self, 503, {"error": str(e)})
+                except RuntimeError as e:  # submit raced shutdown/drain
+                    extra = {}
+                    lc = frontend.lifecycle
+                    if lc is not None and "draining" in str(e):
+                        extra["router"] = lc.router_hint()
+                    _json_response(self, 503, {"error": str(e), **extra})
                     return
                 if body.get("stream"):
                     self._stream(req)

@@ -41,6 +41,7 @@ from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 from radixmesh_tpu.obs.tracing import annotate
 from radixmesh_tpu.server.http_frontend import EngineRunner
 from radixmesh_tpu.slo.control import (
+    SHED_DRAINING,
     SHED_SHUTDOWN,
     OverloadController,
     RequestShed,
@@ -96,6 +97,13 @@ class SLORunner(EngineRunner):
         with self._lock:
             if self._closed:
                 raise RuntimeError("engine runner is shut down")
+            if self._draining:
+                # Graceful drain (policy/lifecycle.py): retriable 503 +
+                # Retry-After; the frontend's shed body names the router
+                # the client should re-route through.
+                raise RequestShed(
+                    SHED_DRAINING, self._drain_retry_after_s, tenant
+                )
             # Validation (length bounds) before admission accounting, so
             # a malformed request can't spend bucket tokens.
             req = self.engine.make_request(
@@ -241,6 +249,16 @@ class SLORunner(EngineRunner):
             if ok and req is not None and req.admit_time > 0:
                 self.ctl.note_retired(req)
             return ok
+
+    def begin_drain(self, retry_after_s: float = 1.0) -> None:
+        """Graceful drain with the control plane in the path: close
+        admission (new submits shed ``draining``, retriable 503), then
+        bounce every WFQ-queued-but-undispatched request back to its
+        client the same way — queued work has produced nothing, so the
+        router re-places it on a surviving node with zero loss."""
+        super().begin_drain(retry_after_s)
+        for req in self.ctl.flush(SHED_DRAINING):
+            self._finalize_shed(req)
 
     def close(self, drain_s: float = 0.0) -> None:
         # Close the submit window BEFORE flushing: a submit racing into

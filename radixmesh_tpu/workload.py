@@ -680,6 +680,312 @@ def run_fleet_churn_workload(
         InprocHub.reset_default()
 
 
+def _chaos_join_drain_phases(
+    *,
+    nodes,
+    ring,
+    router_mesh,
+    by_addr,
+    cr,
+    fleet_planes,
+    repair_planes,
+    lifecycle_planes,
+    plan,
+    faults,
+    prefill,
+    partitioned,
+    rng,
+    wait_for,
+    key_len,
+    seed,
+    drop_p,
+    drain_requests,
+    drain_inflight,
+    join_partition_s,
+    digest_interval_s,
+    repair_interval_s,
+    age_threshold_s,
+    bootstrap_probe_interval_s,
+    bootstrap_round_budget,
+    timeout_s,
+) -> tuple[dict, dict]:
+    """Phases 5+6 of ``run_chaos_workload`` (membership lifecycle,
+    ``policy/lifecycle.py``): graceful drain of cp2 under re-opened
+    seeded loss, then a COLD rejoin of the same address while cp1 sits
+    behind a partition. Mutates the armed ``plan`` between phases (every
+    wrapped edge shares the object, and the per-edge RNG streams stay
+    seeded) and returns ``(drain_report, join_report)``."""
+    import time as _time
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.cache.repair_plane import RepairConfig, RepairPlane
+    from radixmesh_tpu.config import MeshConfig
+    from radixmesh_tpu.obs.fleet_plane import FleetPlane
+    from radixmesh_tpu.policy.lifecycle import (
+        LifecycleConfig,
+        LifecyclePlane,
+        LifecycleState,
+    )
+
+    target_addr = prefill[2]
+    target = by_addr[target_addr]
+    t_rank = target.rank
+    t_node_idx = nodes.index(target)
+    t_ring_idx = ring.index(target)
+
+    # A replicated warm set owned by the drain target: after the rejoin
+    # these keys are exactly the hits the router must WITHHOLD while the
+    # reincarnation bootstraps (its replica is cold; the router's rank-2
+    # values are not).
+    joiner_keys = [
+        rng.integers(0, 600, size=key_len).astype(np.int32)
+        for _ in range(6)
+    ]
+    for k in joiner_keys:
+        target.insert(k, np.arange(key_len, dtype=np.int32))
+    live = [n for n in nodes]
+    wait_for(lambda: len({n.tree.fingerprint_ for n in live}) == 1)
+
+    # ---- phase 5: drain under sustained seeded loss -------------------
+    plan.partitions = ()
+    plan.drop_start_s, plan.drop_end_s = 0.0, float("inf")
+    faults.rebase()
+    # Simulated in-flight work parked at the target when the drain hits.
+    inflight_keys = [
+        rng.integers(0, 600, size=key_len).astype(np.int32)
+        for _ in range(drain_inflight)
+    ]
+    for k in inflight_keys:
+        target.insert(k, np.arange(key_len, dtype=np.int32))
+    dead_before = sum(
+        int(n._m_succ_trans["dead"].value) for n in nodes
+    )
+    requeue_state = {"served": 0}
+
+    def _requeue_inflight() -> int:
+        # The router must refuse the DRAINING node new work before
+        # anything is re-placed (the state digest re-publishes every
+        # interval, so a seeded drop of one frame only delays this).
+        wait_for(
+            lambda: router_mesh.fleet.lifecycle_of(t_rank)
+            in ("draining", "left"),
+            timeout=timeout_s,
+        )
+        served = 0
+        for k in inflight_keys:
+            res = cr.cache_aware_route(k)
+            alt = by_addr.get(res.prefill_addr)
+            if alt is None or alt is target:
+                continue
+            alt.insert(k, np.arange(key_len, dtype=np.int32))
+            if alt.match_prefix(k).length == key_len:
+                served += 1
+        requeue_state["served"] = served
+        return len(inflight_keys)
+
+    def _writeback_stub() -> int:
+        # Mesh-level stand-in for the engine's hot-prefix flush (the
+        # real path — HierarchicalCache.evict through the PR 4 fused
+        # write-back lane — is exercised by the engine-level lifecycle
+        # tests): count the hot tokens the replica holds at drain time.
+        with target._lock:
+            return int(
+                target.tree.evictable_size_ + target.tree.protected_size_
+            )
+
+    tlc = LifecyclePlane(
+        target,
+        repair=repair_planes[t_node_idx],
+        fleet_plane=fleet_planes[t_ring_idx],
+        cfg=LifecycleConfig(
+            drain_timeout_s=10.0, leave_confirm_s=0.25, leave_retries=3,
+        ),
+        requeue_fn=_requeue_inflight,
+        writeback_fn=_writeback_stub,
+    )
+    lifecycle_planes.append(tlc)
+    dstats = tlc.drain(deadline_s=10.0)
+    survivors = [n for n in nodes if n is not target]
+    left_everywhere = wait_for(
+        lambda: all(not n.view.contains(t_rank) for n in survivors),
+        timeout=timeout_s,
+    )
+    # Serve a stream through the still-open loss window: ZERO failures
+    # allowed, and nothing may land on the drained node.
+    d_attempted = d_ok = 0
+    for _ in range(drain_requests):
+        key = rng.integers(0, 600, size=key_len).astype(np.int32)
+        d_attempted += 1
+        try:
+            res = cr.cache_aware_route(key)
+            alt = by_addr.get(res.prefill_addr)
+            if alt is None or alt is target:
+                raise RuntimeError(
+                    f"routed to {res.prefill_addr} mid-drain"
+                )
+            alt.insert(key, np.arange(key_len, dtype=np.int32))
+            if alt.match_prefix(key).length != key_len:
+                raise RuntimeError("local match missed a local insert")
+            d_ok += 1
+        except Exception:  # noqa: BLE001 — failures are the measurement
+            pass
+        _time.sleep(0.01)
+    dead_after = sum(int(n._m_succ_trans["dead"].value) for n in nodes)
+    left_transitions = sum(
+        int(n._m_succ_trans["left"].value) for n in survivors
+    )
+    # The drained process exits: stop its planes and close its mesh.
+    fleet_planes[t_ring_idx].close()
+    repair_planes[t_node_idx].close()
+    target.close()
+    del by_addr[target_addr]
+    drain_report = {
+        "performed": True,
+        "node": target_addr,
+        "drop_p": drop_p,
+        "requeued": int(dstats["requeued"]),
+        "requeued_served": int(requeue_state["served"]),
+        "attempted_during_drain": d_attempted,
+        "ok_during_drain": d_ok,
+        "zero_failed": bool(
+            d_ok == d_attempted
+            and requeue_state["served"] == dstats["requeued"]
+        ),
+        "left_without_failure_detection": bool(
+            left_everywhere and dead_after == dead_before
+        ),
+        "left_cause_transitions": left_transitions,
+        "writeback_tokens": int(dstats["writeback_tokens"]),
+        "writeback_flushed": bool(dstats["writeback_flushed"]),
+        "drain_s": round(float(dstats["drain_s"]), 3),
+    }
+
+    # ---- phase 6: cold rejoin during an active partition --------------
+    plan.drop_p = 0.0
+    plan.partitions = (
+        faults.PartitionSpec(
+            start_s=0.0, end_s=join_partition_s, addrs=(partitioned,)
+        ),
+    )
+    faults.rebase()
+    t_join0 = _time.monotonic()
+    base_cfg = target.cfg
+    jcfg = MeshConfig(
+        prefill_nodes=list(base_cfg.prefill_nodes),
+        decode_nodes=list(base_cfg.decode_nodes),
+        router_nodes=list(base_cfg.router_nodes),
+        local_addr=target_addr,
+        protocol="inproc",
+        tick_interval_s=base_cfg.tick_interval_s,
+        gc_interval_s=base_cfg.gc_interval_s,
+        failure_timeout_s=base_cfg.failure_timeout_s,
+    )
+    joiner = MeshCache(jcfg, pool=None).start()
+    nodes.append(joiner)
+    by_addr[target_addr] = joiner
+    jrepair = RepairPlane(
+        joiner,
+        RepairConfig(
+            interval_s=repair_interval_s,
+            age_threshold_s=age_threshold_s,
+            backoff_base_s=max(0.25, repair_interval_s),
+            backoff_max_s=5.0,
+            round_budget=bootstrap_round_budget,
+        ),
+        seed=seed,
+    ).start()
+    repair_planes.append(jrepair)
+    jlc = LifecyclePlane(
+        joiner,
+        repair=jrepair,
+        cfg=LifecycleConfig(
+            bootstrap_grace_s=max(10.0, 6.0 * join_partition_s),
+            bootstrap_deadline_s=timeout_s,
+            bootstrap_probe_interval_s=bootstrap_probe_interval_s,
+            bootstrap_round_budget=bootstrap_round_budget,
+            tick_interval_s=min(0.05, repair_interval_s),
+        ),
+        bootstrap=True,
+    )
+    lifecycle_planes.append(jlc)
+    jfleet = FleetPlane(joiner, interval_s=digest_interval_s).start()
+    jlc.fleet_plane = jfleet
+    fleet_planes.append(jfleet)
+    jlc.start()
+    joiner.wait_ready(timeout=timeout_s)
+    # While the reincarnation bootstraps, the router must withhold every
+    # cache hit pointing at it (the warm set routes by rank-2 values the
+    # router still holds) — hash-ring fallback serves instead.
+    wh0 = cr.withheld_hits
+    hits_to_bootstrapping = 0
+    probe_deadline = _time.monotonic() + timeout_s
+    while (
+        jlc.state is LifecycleState.BOOTSTRAPPING
+        and _time.monotonic() < probe_deadline
+    ):
+        for k in joiner_keys:
+            res = cr.cache_aware_route(k)
+            if res.prefill_addr == target_addr and res.prefill_cache_hit:
+                hits_to_bootstrapping += 1
+        _time.sleep(0.05)
+    became_active = wait_for(
+        lambda: jlc.state is LifecycleState.ACTIVE, timeout=timeout_s
+    )
+    donor_rank = jlc.bootstrap_donor
+    donor_node = next(
+        (n for n in nodes if n is not joiner and n.rank == donor_rank),
+        None,
+    )
+    converged_with_donor = bool(
+        became_active
+        and donor_node is not None
+        and joiner.tree.fingerprint_ == donor_node.tree.fingerprint_
+    )
+    # Partition off; the whole surviving fleet must converge again.
+    plan.partitions = ()
+    live = [n for n in nodes if n is not target]
+    fleet_converged = wait_for(
+        lambda: len({n.tree.fingerprint_ for n in live}) == 1,
+        timeout=timeout_s,
+    )
+    # Hits to the joiner resume once it is ACTIVE.
+    wait_for(
+        lambda: router_mesh.fleet.lifecycle_of(t_rank) == "active",
+        timeout=timeout_s,
+    )
+    post_hits = 0
+    for k in joiner_keys:
+        res = cr.cache_aware_route(k)
+        if res.prefill_addr == target_addr and res.prefill_cache_hit:
+            post_hits += 1
+    join_report = {
+        "performed": True,
+        "joiner": target_addr,
+        "donor_rank": donor_rank,
+        "partition_active_at_join": True,
+        "partition_s": join_partition_s,
+        "partitioned_node": partitioned,
+        "bootstrap_converge_s": (
+            None
+            if jlc.bootstrap_converge_s is None
+            else round(jlc.bootstrap_converge_s, 3)
+        ),
+        "bootstrap_rounds": int(jlc.bootstrap_rounds),
+        "round_budget": bootstrap_round_budget,
+        "within_round_budget": bool(
+            became_active
+            and jlc.bootstrap_rounds <= bootstrap_round_budget
+        ),
+        "converged_with_donor": converged_with_donor,
+        "withheld_hits": int(cr.withheld_hits - wh0),
+        "hits_to_bootstrapping": hits_to_bootstrapping,
+        "post_bootstrap_hits": post_hits,
+        "fleet_converged_after_join": bool(fleet_converged),
+        "join_s": round(_time.monotonic() - t_join0, 3),
+    }
+    return drain_report, join_report
+
+
 def run_chaos_workload(
     drop_p: float = 0.2,
     partition_s: float = 10.0,
@@ -693,6 +999,12 @@ def run_chaos_workload(
     round_budget: int = 8,
     quiesce_window_s: float = 2.0,
     timeout_s: float = 90.0,
+    join_drain: bool = True,
+    drain_requests: int = 40,
+    drain_inflight: int = 6,
+    join_partition_s: float = 1.5,
+    bootstrap_probe_interval_s: float = 0.25,
+    bootstrap_round_budget: int = 16,
 ) -> dict:
     """The chaos acceptance scenario (``bench.validate_chaos`` pins its
     artifact): a seeded FaultPlan injects ``drop_p`` frame loss across
@@ -708,11 +1020,27 @@ def run_chaos_workload(
        convergence age recorded).
     3. **Repair.** After the partition heals, the anti-entropy repair
        plane (``cache/repair_plane.py``) must converge ALL replicas —
-       both prefills, the decode node, and the router — to pairwise
+       the prefills, the decode node, and the router — to pairwise
        equal fingerprints within ``round_budget`` repair rounds.
     4. **Quiesce.** Once converged, a ``quiesce_window_s`` observation
        window must record ZERO further repair traffic (probes and
        summaries frozen) — repair can never storm a healthy ring.
+
+    With ``join_drain`` (the PR 6 membership-lifecycle gates,
+    ``policy/lifecycle.py``) two scale-in/scale-out phases follow:
+
+    5. **Drain under loss.** The seeded ``drop_p`` loss window re-opens
+       and one prefill node drains gracefully: the router refuses it
+       new work once DRAINING gossips, its simulated in-flight requests
+       are requeued-and-served elsewhere, hot tokens are written back,
+       and a LEAVE drops it from every view with ZERO failed requests
+       and ZERO failure-detection ("dead") successor transitions.
+    6. **Join during a partition.** The drained node rejoins COLD while
+       a partition isolates a different prefill. It enters
+       BOOTSTRAPPING, picks a healthy donor from the fleet view, pulls
+       a bulk repair session, and the router withholds cache hits from
+       it (hash-ring fallback only) until its fingerprint converges
+       with the donor — within the bootstrap round budget.
 
     Deterministic by seeding: the FaultPlan's per-edge RNGs and the
     request stream derive from ``seed``; waits are deadline-bounded
@@ -738,7 +1066,10 @@ def run_chaos_workload(
     rng = np.random.default_rng(seed)
     t_start = _time.monotonic()
     InprocHub.reset_default()
-    prefill, decode, router_addrs = ["cp0", "cp1"], ["cd0"], ["cr0"]
+    # Three prefills: cp1 takes the phase-1 (and phase-6) partition;
+    # cp2 is the drain/rejoin subject — its ring paths to the master
+    # and its donor avoid cp1, so a join can START under the partition.
+    prefill, decode, router_addrs = ["cp0", "cp1", "cp2"], ["cd0"], ["cr0"]
     partitioned = prefill[1]
     fault_end_s = partition_delay_s + partition_s
     plan = faults.FaultPlan(
@@ -756,6 +1087,7 @@ def run_chaos_workload(
     nodes: list = []
     fleet_planes: list = []
     repair_planes: list = []
+    lifecycle_planes: list = []
     try:
         with faults.injected(plan):
             for addr in prefill + decode + router_addrs:
@@ -798,6 +1130,7 @@ def run_chaos_workload(
                 for n in nodes
             ]
             cr = CacheAwareRouter(router_mesh, router_mesh.cfg)
+            cr.watch_topology()
             cr.finish_warm_up()
 
             # -- 1+2: serve routed requests THROUGH the fault window ---
@@ -880,6 +1213,39 @@ def run_chaos_workload(
                 _time.sleep(repair_interval_s)
             traffic_after = _repair_traffic()
 
+            # -- 5: graceful drain of cp2 under re-opened seeded loss --
+            join_report: dict = {"performed": False}
+            drain_report: dict = {"performed": False}
+            if join_drain:
+                drain_report, join_report = _chaos_join_drain_phases(
+                    nodes=nodes,
+                    ring=ring,
+                    router_mesh=router_mesh,
+                    by_addr=by_addr,
+                    cr=cr,
+                    fleet_planes=fleet_planes,
+                    repair_planes=repair_planes,
+                    lifecycle_planes=lifecycle_planes,
+                    plan=plan,
+                    faults=faults,
+                    prefill=prefill,
+                    partitioned=partitioned,
+                    rng=rng,
+                    wait_for=wait_for,
+                    key_len=key_len,
+                    seed=seed,
+                    drop_p=drop_p,
+                    drain_requests=drain_requests,
+                    drain_inflight=drain_inflight,
+                    join_partition_s=join_partition_s,
+                    digest_interval_s=digest_interval_s,
+                    repair_interval_s=repair_interval_s,
+                    age_threshold_s=age_threshold_s,
+                    bootstrap_probe_interval_s=bootstrap_probe_interval_s,
+                    bootstrap_round_budget=bootstrap_round_budget,
+                    timeout_s=timeout_s,
+                )
+
             repair_totals = {
                 k: sum(r.stats()[k] for r in repair_planes)
                 for k in (
@@ -888,8 +1254,8 @@ def run_chaos_workload(
                 )
             }
             return {
-                "nodes": len(nodes),
-                "topology": "2 prefill + 1 decode + 1 router (inproc)",
+                "nodes": len({n.cfg.local_addr for n in nodes}),
+                "topology": "3 prefill + 1 decode + 1 router (inproc)",
                 "round_budget": round_budget,
                 "fault_plan": {
                     "seed": seed,
@@ -925,9 +1291,13 @@ def run_chaos_workload(
                     "traffic_after": traffic_after,
                     "quiet": traffic_after == traffic_before,
                 },
+                "drain": drain_report,
+                "join": join_report,
                 "wall_s": round(_time.monotonic() - t_start, 3),
             }
     finally:
+        for lc in lifecycle_planes:
+            lc.close()
         for r in repair_planes:
             r.close()
         for p in fleet_planes:

@@ -36,6 +36,8 @@ def run(
     requests: int,
     round_budget: int,
     seed: int,
+    join_drain: bool = True,
+    join_partition_s: float = 1.5,
 ) -> dict:
     res = run_chaos_workload(
         drop_p=drop_p,
@@ -43,6 +45,8 @@ def run(
         n_requests=requests,
         round_budget=round_budget,
         seed=seed,
+        join_drain=join_drain,
+        join_partition_s=join_partition_s,
     )
     report = bench.build_chaos_report(res)
     problems = bench.validate_chaos(report)
@@ -58,11 +62,21 @@ def main() -> int:
     ap.add_argument("--requests", type=int, default=150)
     ap.add_argument("--round-budget", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--no-join-drain", action="store_true",
+        help="skip the membership-lifecycle phases (graceful drain "
+        "under loss + cold rejoin during a partition)",
+    )
+    ap.add_argument(
+        "--join-partition", type=float, default=1.5, metavar="SECONDS",
+        help="partition window the rejoin starts under",
+    )
     ap.add_argument("--out", default=None, help="also write the JSON here")
     args = ap.parse_args()
     report = run(
         args.drop_p, args.partition, args.requests, args.round_budget,
-        args.seed,
+        args.seed, join_drain=not args.no_join_drain,
+        join_partition_s=args.join_partition,
     )
     line = json.dumps(report)
     print(line)

@@ -365,6 +365,26 @@ class TestChaosArtifactSchema:
                 "window_s": 2.0, "traffic_before": 86,
                 "traffic_after": 86, "quiet": True,
             },
+            "drain": {
+                "performed": True, "node": "cp2", "drop_p": 0.2,
+                "requeued": 6, "requeued_served": 6,
+                "attempted_during_drain": 40, "ok_during_drain": 40,
+                "zero_failed": True,
+                "left_without_failure_detection": True,
+                "left_cause_transitions": 1,
+                "writeback_tokens": 1150, "writeback_flushed": True,
+                "drain_s": 0.6,
+            },
+            "join": {
+                "performed": True, "joiner": "cp2", "donor_rank": 0,
+                "partition_active_at_join": True, "partition_s": 1.5,
+                "partitioned_node": "cp1",
+                "bootstrap_converge_s": 1.8, "bootstrap_rounds": 2,
+                "round_budget": 16, "within_round_budget": True,
+                "converged_with_donor": True, "withheld_hits": 30,
+                "hits_to_bootstrapping": 0, "post_bootstrap_hits": 6,
+                "fleet_converged_after_join": True, "join_s": 2.0,
+            },
             "wall_s": 14.7,
         }
 
@@ -376,10 +396,14 @@ class TestChaosArtifactSchema:
         del report["round_budget"]
         del report["repair"]["converge_s"]
         del report["quiescence"]["quiet"]
+        del report["drain"]["writeback_tokens"]
+        del report["join"]["bootstrap_rounds"]
         missing = bench.validate_chaos(report)
         assert "round_budget" in missing
         assert "repair.converge_s" in missing
         assert "quiescence.quiet" in missing
+        assert "drain.writeback_tokens" in missing
+        assert "join.bootstrap_rounds" in missing
 
     def test_acceptance_gates_enforced(self):
         report = self._report()
@@ -394,12 +418,51 @@ class TestChaosArtifactSchema:
         assert "kept flowing" in problems
         assert bench.validate_chaos(7) == ["artifact is not a JSON object"]
 
+    def test_lifecycle_gates_enforced(self):
+        """The PR 6 membership gates: a drain that failed requests or
+        tripped failure detection, or a join the router kept hit-routing
+        to (or that never converged), must be named violations."""
+        report = self._report()
+        report["drain"]["zero_failed"] = False
+        report["drain"]["left_without_failure_detection"] = False
+        report["drain"]["writeback_flushed"] = False
+        report["drain"]["requeued_served"] = 3
+        report["join"]["converged_with_donor"] = False
+        report["join"]["within_round_budget"] = False
+        report["join"]["hits_to_bootstrapping"] = 4
+        report["join"]["withheld_hits"] = 0
+        problems = "\n".join(bench.validate_chaos(report))
+        assert "requests failed during the graceful drain" in problems
+        assert "requeued but not all served" in problems
+        assert "tripped failure detection" in problems
+        assert "not written back" in problems
+        assert "never converged with its donor" in problems
+        assert "over the budget" in problems
+        assert "routed cache hits to a BOOTSTRAPPING node" in problems
+        assert "never withheld a hit" in problems
+
+    def test_v1_artifact_without_lifecycle_sections_stays_valid(self):
+        """CHAOS_r06 predates the join/drain sections: v1 artifacts must
+        keep validating (version bumps add, never break)."""
+        report = self._report()
+        del report["drain"]
+        del report["join"]
+        report["schema_version"] = 1
+        assert bench.validate_chaos(report) == []
+
+    def test_skipped_phase_is_schema_valid_but_gate_exempt(self):
+        report = self._report()
+        report["drain"] = {"performed": False}
+        report["join"] = {"performed": False}
+        assert bench.validate_chaos(report) == []
+
     def test_build_report_matches_schema(self):
         res = {
             k: self._report()[k]
             for k in (
                 "nodes", "topology", "round_budget", "fault_plan", "served",
-                "divergence", "repair", "quiescence", "wall_s",
+                "divergence", "repair", "quiescence", "drain", "join",
+                "wall_s",
             )
         }
         report = bench.build_chaos_report(res)

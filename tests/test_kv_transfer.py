@@ -228,6 +228,44 @@ class TestPrefetchHints:
             close(eng)
 
 
+class TestDrainRacesPrefetch:
+    def test_hint_during_drain_is_dropped_without_ticket_leak(self, tiny):
+        """Drain-under-chaos edge case (PR 6): a router PREFETCH hint
+        lands while the node is mid-drain (the router stops hinting once
+        DRAINING gossips, but in-flight frames still arrive). The hint
+        must be DROPPED — counted under the "draining" outcome — and no
+        restore ticket, eviction shield, or staged chunk may leak."""
+        eng = make_engine(tiny)
+        try:
+            seed_and_evict(eng)  # host-tier prefix a hint WOULD restore
+            from radixmesh_tpu.server.http_frontend import EngineRunner
+
+            runner = EngineRunner(eng)  # not started: we drive directly
+            runner.begin_drain()
+            assert eng.draining
+            eng.kv_transfer.note_hint(np.asarray(PROMPT, np.int32))
+            for _ in range(3):
+                eng.step()  # the pump sees the hint and must discard it
+            assert eng.kv_transfer.idle(), "hint opened plane work mid-drain"
+            assert eng.kv_transfer.stats()["active_tickets"] == 0
+            assert eng.tree.protected_size_ == 0
+            # The prefix is still host-tier (nothing restored it).
+            m = eng.tree.match_prefix(np.asarray(PROMPT, np.int32))
+            assert m.host_length > 0
+            from radixmesh_tpu.obs.metrics import get_registry
+
+            snap = get_registry().snapshot()
+            drained = [
+                v for k, v in snap.items()
+                if k.startswith("radixmesh_kv_transfer_prefetch_hints_total")
+                and 'outcome="draining"' in k
+                and f'plane="{eng.name}"' in k
+            ]
+            assert drained and drained[0] >= 1
+        finally:
+            close(eng)
+
+
 class TestWritebackLane:
     def test_fused_gather_per_sweep_and_arena_ordering(self, tiny):
         """One device gather per eviction sweep; a sync restore right

@@ -1,0 +1,709 @@
+"""Membership lifecycle plane (``policy/lifecycle.py``): the state
+machine, digest gossip of lifecycle state, ``FleetView.forget`` /
+left-marking, LEAVE wire + live-cluster semantics (cause-tagged
+successor transitions, no failure detection, no auto-rejoin), warm
+bootstrap with router hit-withholding, engine-level drain requeue, and
+the pure autoscale recommender.
+
+Deflake contract: lifecycle timers run on an injectable clock + wait
+seam, so the state-machine tests here drive bootstrap in VIRTUAL time
+(zero real sleeps); every live-cluster wait is a deadline-bounded poll.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.mesh_cache import MeshCache
+from radixmesh_tpu.cache.oplog import EXTENSION_KINDS, Oplog, OplogType, deserialize, serialize
+from radixmesh_tpu.cache.repair_plane import RepairConfig, RepairPlane
+from radixmesh_tpu.comm.inproc import InprocHub
+from radixmesh_tpu.config import MeshConfig, NodeRole
+from radixmesh_tpu.obs.fleet_plane import FleetPlane, FleetView, NodeDigest
+from radixmesh_tpu.policy.lifecycle import (
+    AutoscaleConfig,
+    AutoscalePolicy,
+    LifecycleConfig,
+    LifecycleError,
+    LifecyclePlane,
+    LifecycleState,
+    lifecycle_code,
+    lifecycle_from_code,
+)
+from radixmesh_tpu.policy.topology import TopologyView, decode_view, encode_view
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    InprocHub.reset_default()
+    yield
+    InprocHub.reset_default()
+
+
+def wait_for(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def make_cluster(n_prefill=3, tick=0.05, digest=0.05, repair=True):
+    prefill = [f"lp{i}" for i in range(n_prefill)]
+    decode, router = ["ld0"], ["lr0"]
+    nodes = []
+    for addr in prefill + decode + router:
+        cfg = MeshConfig(
+            prefill_nodes=prefill, decode_nodes=decode, router_nodes=router,
+            local_addr=addr, protocol="inproc", tick_interval_s=tick,
+            gc_interval_s=60.0, failure_timeout_s=60.0,
+        )
+        nodes.append(MeshCache(cfg, pool=None).start())
+    for n in nodes:
+        assert n.wait_ready(timeout=10)
+    ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+    planes = [FleetPlane(n, interval_s=digest).start() for n in ring]
+    repairs = []
+    if repair:
+        repairs = [
+            RepairPlane(
+                n,
+                RepairConfig(
+                    interval_s=0.05, age_threshold_s=0.2,
+                    backoff_base_s=0.2, backoff_max_s=2.0,
+                ),
+                seed=0,
+            ).start()
+            for n in nodes
+        ]
+    return nodes, ring, nodes[-1], planes, repairs
+
+
+def close_all(nodes, planes, repairs, lifecycles=()):
+    for lc in lifecycles:
+        lc.close()
+    for r in repairs:
+        r.close()
+    for p in planes:
+        p.close()
+    for n in nodes:
+        n.close()
+
+
+def solo_mesh(addr="solo0"):
+    """An UNSTARTED single-member mesh: enough MeshCache surface for a
+    LifecyclePlane (label, fleet view, no-op broadcasts) without any
+    transport — the state-machine and engine-drain tests need no ring."""
+    cfg = MeshConfig(
+        prefill_nodes=[addr], decode_nodes=[], router_nodes=[],
+        local_addr=addr, protocol="inproc",
+    )
+    return MeshCache(cfg, pool=None)
+
+
+class VirtualClock:
+    """Deflake seam: lifecycle timers in virtual time, zero real sleeps."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def wait(self, dt: float) -> None:
+        self.t += dt
+
+
+class TestStateMachine:
+    def test_legal_path_bootstrap_to_left(self):
+        mesh = solo_mesh()
+        lc = LifecyclePlane(mesh, bootstrap=True, cfg=LifecycleConfig(
+            leave_retries=1, leave_confirm_s=0.0))
+        assert lc.state is LifecycleState.BOOTSTRAPPING
+        assert mesh.lifecycle is lc  # registered as the mesh's source
+        lc._transition(LifecycleState.ACTIVE)
+        assert not lc.is_departing
+        stats = lc.drain(deadline_s=0.1)
+        assert lc.state is LifecycleState.LEFT
+        assert lc.is_departing
+        assert stats["writeback_flushed"] is False  # no seam attached
+        # Idempotent once LEFT.
+        assert lc.drain(deadline_s=0.1) == stats
+
+    def test_failed_drain_releases_claim_for_retry(self):
+        """A drain step that raises must not wedge the node in DRAINING
+        forever: the claim releases so a retry can finish the exit
+        (state stays DRAINING — nothing un-drains — and the retried
+        sequence resumes from there)."""
+        mesh = solo_mesh()
+        calls = {"n": 0}
+
+        def flaky_writeback():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("arena down")
+            return 5
+
+        lc = LifecyclePlane(
+            mesh, writeback_fn=flaky_writeback,
+            cfg=LifecycleConfig(leave_retries=1, leave_confirm_s=0.0),
+        )
+        with pytest.raises(RuntimeError, match="arena down"):
+            lc.drain(deadline_s=0.1)
+        assert lc.state is LifecycleState.DRAINING
+        stats = lc.drain(deadline_s=0.1)  # retry completes the exit
+        assert lc.state is LifecycleState.LEFT
+        assert stats["writeback_tokens"] == 5
+
+    def test_close_keeps_departing_guard_attached(self):
+        """close() after a drain must NOT detach the plane from the
+        mesh: the mesh keeps receiving for a beat on the exit path, and
+        losing the is_departing guard would let a straggling exclusion
+        view re-trigger the auto-rejoin JOIN."""
+        mesh = solo_mesh()
+        lc = LifecyclePlane(mesh, cfg=LifecycleConfig(
+            leave_retries=1, leave_confirm_s=0.0))
+        lc.drain(deadline_s=0.1)
+        lc.close()
+        assert mesh.lifecycle is lc and lc.is_departing
+        # An un-drained plane detaches normally.
+        mesh2 = solo_mesh("solo1")
+        lc2 = LifecyclePlane(mesh2)
+        lc2.close()
+        assert mesh2.lifecycle is None
+
+    def test_illegal_transitions_raise(self):
+        lc = LifecyclePlane(solo_mesh())
+        assert lc.state is LifecycleState.ACTIVE
+        with pytest.raises(LifecycleError):
+            lc._transition(LifecycleState.BOOTSTRAPPING)  # nothing un-joins
+        with pytest.raises(LifecycleError):
+            lc._transition(LifecycleState.ACTIVE)  # self-loop
+        lc._transition(LifecycleState.DRAINING)
+        with pytest.raises(LifecycleError):
+            lc._transition(LifecycleState.ACTIVE)  # nothing un-drains
+
+    def test_bootstrap_grace_expires_in_virtual_time(self):
+        """No donor ever appears (cold boot): the node goes ACTIVE after
+        the grace window — driven entirely on the injected clock."""
+        clock = VirtualClock()
+        lc = LifecyclePlane(
+            solo_mesh(), bootstrap=True,
+            cfg=LifecycleConfig(bootstrap_grace_s=5.0),
+            clock=clock, wait=clock.wait,
+        )
+        for _ in range(4):
+            lc.tick()
+            assert lc.state is LifecycleState.BOOTSTRAPPING
+            clock.wait(1.0)
+        clock.wait(2.0)  # past the grace window
+        lc.tick()
+        assert lc.state is LifecycleState.ACTIVE
+        assert lc.bootstrap_converge_s == pytest.approx(6.0)
+
+    def test_cold_boot_with_converged_peers_skips_grace(self):
+        """Cold cluster boot: every node starts BOOTSTRAPPING, so no
+        ACTIVE donor exists — but every known peer replica already
+        equals ours (empty == empty), so waiting out the grace window
+        would withhold an empty fleet's (nonexistent) hits for nothing.
+        Found by the end-to-end launch drive; virtual time."""
+        clock = VirtualClock()
+        mesh = solo_mesh()
+        lc = LifecyclePlane(
+            mesh, bootstrap=True,
+            cfg=LifecycleConfig(bootstrap_grace_s=15.0),
+            clock=clock, wait=clock.wait,
+        )
+        peer = NodeDigest(
+            rank=5, role="prefill", seq=1, ts=1.0, epoch=0,
+            fingerprint=mesh.tree.fingerprint_, tree_tokens=0,
+            cache_hit_rate=0, pool_fill=0, host_fill=0, batch_occupancy=0,
+            decode_ewma_s=0, waiting=0, decode_steps=0,
+            lifecycle="bootstrapping",  # NOT donor-eligible
+        )
+        mesh.fleet.fold(peer)
+        lc.tick()
+        assert lc.state is LifecycleState.ACTIVE
+        assert clock.t < 1.0  # no grace wait
+
+    def test_bootstrap_converges_when_donor_fp_matches(self):
+        """A donor digest with our exact fingerprint → ACTIVE on the
+        next tick (virtual time; no probes needed)."""
+        clock = VirtualClock()
+        mesh = solo_mesh()
+        lc = LifecyclePlane(
+            mesh, bootstrap=True, cfg=LifecycleConfig(),
+            clock=clock, wait=clock.wait,
+        )
+        donor = NodeDigest(
+            rank=99, role="prefill", seq=1, ts=1.0, epoch=0,
+            fingerprint=mesh.tree.fingerprint_, tree_tokens=0,
+            cache_hit_rate=0, pool_fill=0, host_fill=0, batch_occupancy=0,
+            decode_ewma_s=0, waiting=0, decode_steps=0, lifecycle="active",
+        )
+        mesh.fleet.fold(donor)
+        lc.tick()
+        assert lc.state is LifecycleState.ACTIVE
+        assert lc.bootstrap_donor == 99
+
+    def test_donor_choice_prefers_healthy_active_peers(self):
+        mesh = solo_mesh()
+        lc = LifecyclePlane(mesh, bootstrap=True)
+        now = time.time()
+
+        def digest(rank, lifecycle="active", ts=None):
+            return NodeDigest(
+                rank=rank, role="prefill", seq=1,
+                ts=now if ts is None else ts, epoch=0,
+                fingerprint=123 + rank, tree_tokens=0, cache_hit_rate=0,
+                pool_fill=0, host_fill=0, batch_occupancy=0,
+                decode_ewma_s=0, waiting=0, decode_steps=0,
+                lifecycle=lifecycle, interval_s=5.0,
+            )
+
+        mesh.fleet.fold(digest(1, ts=now - 120.0))  # stale → sick
+        mesh.fleet.fold(digest(2))                  # healthy ACTIVE
+        mesh.fleet.fold(digest(3, lifecycle="bootstrapping"))  # not a donor
+        mesh.fleet.fold(digest(4, lifecycle="draining"))       # not a donor
+        assert lc.choose_donor() == 2
+
+
+class TestDigestLifecycle:
+    def test_tier_byte_packs_lifecycle_and_tier(self):
+        for state in ("active", "bootstrapping", "draining", "left"):
+            assert lifecycle_from_code(lifecycle_code(state)) == state
+        d = NodeDigest(
+            rank=7, role="decode", seq=2, ts=5.0, epoch=1, fingerprint=9,
+            tree_tokens=1, cache_hit_rate=0.1, pool_fill=0.2, host_fill=0.0,
+            batch_occupancy=0.3, decode_ewma_s=0.01, waiting=2,
+            decode_steps=3, slo_tier=3, lifecycle="draining",
+        )
+        back = NodeDigest.decode(d.encode())
+        assert back.lifecycle == "draining"
+        assert back.slo_tier == 3
+
+    def test_pre_lifecycle_v1_digest_decodes_full_byte_tier(self):
+        """Rolling-upgrade compat, old→new direction: a v1 digest (full
+        tier byte, no lifecycle nibble) decodes with its whole tier and
+        lifecycle "active" — the state a pre-lifecycle node factually
+        is in. (New→old is handled by the version bump: a v1 decoder
+        rejects v2 instead of misreading the nibble as slo_tier=16.)"""
+        assert lifecycle_from_code(0) == "active"
+        d = NodeDigest(
+            rank=1, role="prefill", seq=1, ts=1.0, epoch=0, fingerprint=0,
+            tree_tokens=0, cache_hit_rate=0, pool_fill=0, host_fill=0,
+            batch_occupancy=0, decode_ewma_s=0, waiting=0, decode_steps=0,
+            slo_tier=3,
+        )
+        raw = bytearray(d.encode().tobytes())
+        raw[1] = 1  # rewrite the version byte: a genuine v1 frame
+        v1 = NodeDigest.decode(np.frombuffer(bytes(raw), dtype=np.int32))
+        assert v1.lifecycle == "active"
+        assert v1.slo_tier == 3
+
+    def test_unknown_digest_version_rejected(self):
+        d = NodeDigest(
+            rank=1, role="prefill", seq=1, ts=1.0, epoch=0, fingerprint=0,
+            tree_tokens=0, cache_hit_rate=0, pool_fill=0, host_fill=0,
+            batch_occupancy=0, decode_ewma_s=0, waiting=0, decode_steps=0,
+        )
+        raw = bytearray(d.encode().tobytes())
+        raw[1] = 9
+        with pytest.raises(ValueError):
+            NodeDigest.decode(np.frombuffer(bytes(raw), dtype=np.int32))
+
+    def test_unknown_code_degrades_to_active(self):
+        assert lifecycle_from_code(9) == "active"
+
+
+class TestFleetViewForget:
+    def _digest(self, rank, lifecycle="active", lag=0.0, fp=1, ts=10.0, seq=1):
+        return NodeDigest(
+            rank=rank, role="prefill", seq=seq, ts=ts, epoch=0,
+            fingerprint=fp, tree_tokens=0, cache_hit_rate=0, pool_fill=0,
+            host_fill=0, batch_occupancy=0, decode_ewma_s=0, waiting=0,
+            decode_steps=0, replication_lag_s=lag, lifecycle=lifecycle,
+        )
+
+    def test_forget_drops_all_state_for_one_rank(self):
+        fv = FleetView(now=lambda: 20.0)
+        fv.fold(self._digest(1, lag=4.5, fp=111))
+        fv.fold(self._digest(2, fp=222))
+        assert 1 in fv.digests() and ("1-2" in fv.convergence()["pairs"])
+        fv.forget(1)
+        assert 1 not in fv.digests()
+        assert "1-2" not in fv.convergence()["pairs"]
+        assert fv.health().get(1) is None  # can't pin min_score anymore
+
+    def test_rejoiner_does_not_inherit_old_lag_ewma(self):
+        """The rejoin/decommission asymmetry fix: after forget-on-LEAVE,
+        a reincarnation's first digest stands alone — the old
+        replication-lag EWMA (which would have scored the fresh node
+        sick) is gone, and its fingerprint folds fresh."""
+        fv = FleetView(now=lambda: 20.0)
+        fv.fold(self._digest(1, lag=99.0, fp=111, ts=10.0, seq=50))
+        assert "replication_lag" in fv.health()[1]["reasons"]
+        fv.forget(1)
+        fv.mark_left(1)
+        # The reincarnation restarts seq at 1 with a fresh clock.
+        fv.fold(self._digest(1, lag=0.0, fp=0, ts=19.0, seq=1,
+                             lifecycle="bootstrapping"))
+        h = fv.health()[1]
+        assert "replication_lag" not in h["reasons"]
+        assert fv.lifecycle_of(1) == "bootstrapping"
+        assert fv.digests()[1].fingerprint == 0  # folded fresh
+
+    def test_left_mark_refuses_stragglers_until_rejoin(self):
+        fv = FleetView(now=lambda: 20.0)
+        fv.fold(self._digest(1, ts=10.0))
+        fv.forget(1)
+        fv.mark_left(1)
+        assert fv.lifecycle_of(1) == "left"
+        # A straggler from the departed incarnation is refused.
+        assert not fv.fold(self._digest(1, lifecycle="draining", ts=11.0))
+        assert 1 not in fv.digests()
+        # A rejoiner's fresh state clears the mark.
+        assert fv.fold(self._digest(1, lifecycle="bootstrapping", ts=12.0))
+        assert fv.lifecycle_of(1) == "bootstrapping"
+        assert fv.lifecycles()[1] == "bootstrapping"
+
+
+class TestLeaveWire:
+    def test_leave_round_trip_and_registration(self):
+        assert OplogType.LEAVE in EXTENSION_KINDS
+        view = TopologyView(epoch=7, alive=(0, 1, 3))
+        op = Oplog(
+            op_type=OplogType.LEAVE, origin_rank=2, logic_id=11, ttl=4,
+            value=encode_view(view),
+        )
+        back = deserialize(serialize(op))
+        assert back.op_type is OplogType.LEAVE
+        assert back.origin_rank == 2
+        assert decode_view(back.value) == view
+
+    def test_live_leave_drops_node_without_failure_detection(self):
+        """LEAVE on a live ring: every peer (router too) drops the
+        leaver, the predecessor's successor transition is tagged
+        cause=left (never dead), FleetView forgets it, and the leaver —
+        being mid-drain — does NOT auto-rejoin when it sees its own
+        exclusion."""
+        nodes, ring, router_mesh, planes, repairs = make_cluster(repair=False)
+        lifecycles = []
+        try:
+            target = ring[2]  # rank 2: its predecessor is ring[1]
+            t_rank = target.rank
+            wait_for(lambda: len(router_mesh.fleet.digests()) == len(ring))
+            lc = LifecyclePlane(
+                target, fleet_plane=planes[2],
+                cfg=LifecycleConfig(leave_retries=2, leave_confirm_s=0.1),
+            )
+            lifecycles.append(lc)
+            dead_before = sum(
+                int(n._m_succ_trans["dead"].value) for n in nodes
+            )
+            lc.drain(deadline_s=1.0)
+            assert lc.state is LifecycleState.LEFT
+            survivors = [n for n in nodes if n is not target]
+            assert wait_for(
+                lambda: all(not n.view.contains(t_rank) for n in survivors)
+            ), "peers never dropped the leaver"
+            assert sum(
+                int(n._m_succ_trans["dead"].value) for n in nodes
+            ) == dead_before, "failure detection fired on a planned LEAVE"
+            assert int(ring[1]._m_succ_trans["left"].value) >= 1, (
+                "predecessor retarget not tagged cause=left"
+            )
+            assert router_mesh.fleet.lifecycle_of(t_rank) == "left"
+            assert t_rank not in router_mesh.fleet.digests()
+            # The leaver must NOT claw itself back in (auto-rejoin guard).
+            time.sleep(0.3)
+            assert all(
+                not n.view.contains(t_rank) for n in survivors
+            ), "drained node rejoined the view"
+        finally:
+            close_all(nodes, planes, repairs, lifecycles)
+
+
+class TestWarmBootstrapLive:
+    def test_rejoin_bootstraps_from_donor_and_router_withholds(self):
+        """The full scale-in/scale-out cycle at test scale: drain rank 2,
+        rejoin it cold, verify BOOTSTRAPPING gossip makes the router
+        withhold hits while the bulk repair session fills the replica
+        from a donor, then hits resume on convergence."""
+        from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+        nodes, ring, router_mesh, planes, repairs = make_cluster()
+        lifecycles = []
+        joiner = jfleet = jrepair = None
+        try:
+            cr = CacheAwareRouter(router_mesh, router_mesh.cfg)
+            cr.watch_topology()
+            cr.finish_warm_up()
+            target = ring[2]
+            t_rank, t_addr = target.rank, target.cfg.local_addr
+            rng = np.random.default_rng(0)
+            keys = [
+                rng.integers(0, 500, size=8).astype(np.int32)
+                for _ in range(4)
+            ]
+            for k in keys:
+                target.insert(k, np.arange(8, dtype=np.int32))
+            assert wait_for(
+                lambda: len({n.tree.fingerprint_ for n in nodes}) == 1
+            )
+            lc = LifecyclePlane(
+                target, repair=repairs[2], fleet_plane=planes[2],
+                cfg=LifecycleConfig(leave_retries=2, leave_confirm_s=0.1),
+            )
+            lifecycles.append(lc)
+            lc.drain(deadline_s=1.0)
+            survivors = [n for n in nodes if n is not target]
+            assert wait_for(
+                lambda: all(not n.view.contains(t_rank) for n in survivors)
+            )
+            planes[2].close()
+            target.close()
+            # -- cold rejoin -------------------------------------------
+            joiner = MeshCache(target.cfg, pool=None).start()
+            jrepair = RepairPlane(
+                joiner,
+                RepairConfig(
+                    interval_s=0.05, age_threshold_s=0.2,
+                    backoff_base_s=0.2, backoff_max_s=2.0,
+                ),
+                seed=0,
+            ).start()
+            jlc = LifecyclePlane(
+                joiner, repair=jrepair,
+                cfg=LifecycleConfig(
+                    bootstrap_grace_s=10.0,
+                    bootstrap_probe_interval_s=0.1,
+                    bootstrap_round_budget=16,
+                    tick_interval_s=0.05,
+                ),
+                bootstrap=True,
+            )
+            lifecycles.append(jlc)
+            jfleet = FleetPlane(joiner, interval_s=0.05).start()
+            jlc.fleet_plane = jfleet
+            jlc.start()
+            assert joiner.wait_ready(timeout=10)
+            assert wait_for(lambda: router_mesh.view.contains(t_rank)), (
+                "joiner never re-included"
+            )
+            # Router withholds hits while the replica bootstraps: the
+            # rank-2 values it still holds must not route-hit to the
+            # cold joiner.
+            wh0 = cr.withheld_hits
+            hits_cold = 0
+            deadline = time.monotonic() + 20.0
+            while (
+                jlc.state is LifecycleState.BOOTSTRAPPING
+                and time.monotonic() < deadline
+            ):
+                for k in keys:
+                    res = cr.cache_aware_route(k)
+                    if res.prefill_addr == t_addr and res.prefill_cache_hit:
+                        hits_cold += 1
+                time.sleep(0.02)
+            assert wait_for(
+                lambda: jlc.state is LifecycleState.ACTIVE, timeout=20.0
+            ), "bootstrap never converged"
+            assert hits_cold == 0, (
+                f"{hits_cold} cache hits routed to a BOOTSTRAPPING node"
+            )
+            assert cr.withheld_hits > wh0, "withhold path never exercised"
+            assert jlc.bootstrap_donor is not None
+            assert jlc.bootstrap_rounds <= 16
+            # The bulk session actually filled the replica.
+            live = survivors + [joiner]
+            assert wait_for(
+                lambda: len({n.tree.fingerprint_ for n in live}) == 1
+            ), "joiner never converged with the fleet"
+            for k in keys:
+                assert (
+                    joiner.tree.match_prefix(k, split_partial=False).length
+                    == len(k)
+                )
+            # Hits resume once ACTIVE gossips.
+            assert wait_for(
+                lambda: router_mesh.fleet.lifecycle_of(t_rank) == "active"
+            )
+            res = cr.cache_aware_route(keys[0])
+            assert res.prefill_addr == t_addr and res.prefill_cache_hit
+        finally:
+            extra_nodes = [joiner] if joiner is not None else []
+            close_all(
+                [n for n in nodes if n is not nodes[2]] + extra_nodes,
+                [p for i, p in enumerate(planes) if i != 2]
+                + ([jfleet] if jfleet is not None else []),
+                repairs + ([jrepair] if jrepair is not None else []),
+                lifecycles,
+            )
+
+
+class TestEngineDrain:
+    """Engine-level drain mechanics through the runner seams (the mesh
+    LEAVE legs are covered above; here: admission closes retriably,
+    queued + parked work requeues, decodes finish, hot prefixes flush
+    through the PR 4 write-back lane)."""
+
+    @pytest.fixture(scope="class")
+    def tiny(self):
+        import jax
+
+        from radixmesh_tpu.models.llama import ModelConfig, init_params
+
+        cfg = ModelConfig.tiny()
+        return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+    def _engine(self, tiny, **kw):
+        from radixmesh_tpu.engine.engine import Engine
+
+        cfg, params = tiny
+        kw.setdefault("num_slots", 512)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("host_cache_slots", 1024)
+        kw.setdefault("kv_transfer_async", True)
+        kw.setdefault("kv_transfer_chunk_tokens", 16)
+        return Engine(cfg, params, **kw)
+
+    def test_drain_requeues_queued_and_restoring_then_flushes(self, tiny):
+        import threading
+
+        from radixmesh_tpu.engine.request import RequestState, SamplingParams
+        from radixmesh_tpu.server.http_frontend import EngineRunner
+
+        eng = self._engine(tiny)
+        prompt = list(range(1, 120))
+        samp = SamplingParams(max_new_tokens=4)
+        try:
+            # Seed the host tier, then park a request mid-restore.
+            eng.generate([prompt], samp)
+            assert eng.tree.evict(100_000) > 0
+            assert eng.kv_transfer.wait_host_ready()
+            barrier = threading.Event()
+            eng.kv_transfer.stage_barrier = barrier
+            parked = eng.add_request(prompt, samp)
+            for _ in range(3):
+                eng.step()
+            assert parked.state is RequestState.RESTORING
+            queued = eng.add_request(list(range(300, 340)), samp)
+
+            runner = EngineRunner(eng)  # not started: we drive directly
+            runner.begin_drain()
+            with pytest.raises(RuntimeError, match="draining"):
+                runner.submit(list(range(400, 420)), samp)
+            n = runner.drain_requeue()
+            assert n == 2
+            for req in (parked, queued):
+                assert req.state is RequestState.FINISHED
+                assert req.shed and req.shed_reason == "drain_requeue"
+            barrier.set()
+            eng.kv_transfer.stage_barrier = None
+            # In-flight work (the cancelled ticket's staged chunks) runs
+            # out under the deadline; then hot prefixes flush to host.
+            deadline = time.monotonic() + 10
+            while eng.has_work() and time.monotonic() < deadline:
+                eng.step()
+            flushed = eng.drain_flush_hot()
+            assert flushed > 0
+            assert eng.kv_transfer.wait_host_ready()
+            assert eng.tree.evictable_size_ == 0  # nothing left hot
+            assert eng.tree.protected_size_ == 0  # no leaked shields
+        finally:
+            eng.kv_transfer.close()
+
+    def test_slo_runner_sheds_draining_with_retry_after(self, tiny):
+        from radixmesh_tpu.slo import SLOConfig
+        from radixmesh_tpu.slo.control import RequestShed
+        from radixmesh_tpu.slo.runner import SLORunner
+
+        eng = self._engine(tiny, kv_transfer_async=False)
+        runner = SLORunner(eng, SLOConfig())
+        runner.begin_drain(retry_after_s=2.5)
+        with pytest.raises(RequestShed) as exc:
+            runner.submit(list(range(10)), tenant="t0")
+        assert exc.value.reason == "draining"
+        assert exc.value.http_status == 503
+        assert exc.value.retry_after_s == 2.5
+
+
+class TestAutoscalePolicy:
+    def _digest(self, rank, waiting=0, occ=0.0, tier=0, lifecycle="active",
+                role="prefill", ts=100.0):
+        return NodeDigest(
+            rank=rank, role=role, seq=1, ts=ts, epoch=0, fingerprint=0,
+            tree_tokens=0, cache_hit_rate=0, pool_fill=0, host_fill=0,
+            batch_occupancy=occ, decode_ewma_s=0, waiting=waiting,
+            decode_steps=0, slo_tier=tier, lifecycle=lifecycle,
+            interval_s=5.0,
+        )
+
+    def _fleet(self, digests):
+        fv = FleetView(now=lambda: 101.0)
+        for d in digests:
+            fv.fold(d)
+        return fv
+
+    def test_deep_queues_recommend_add(self):
+        fv = self._fleet([self._digest(r, waiting=20, occ=0.9) for r in range(3)])
+        rec = AutoscalePolicy().recommend(fv)
+        assert rec["action"] == "add" and rec["reason"] == "queue_depth"
+
+    def test_slo_degradation_recommends_add(self):
+        fv = self._fleet([self._digest(r, waiting=1, tier=2) for r in range(3)])
+        rec = AutoscalePolicy().recommend(fv)
+        assert rec["action"] == "add" and rec["reason"] == "slo_degraded"
+
+    def test_idle_fleet_recommends_remove_with_candidate(self):
+        fv = self._fleet([
+            self._digest(0, waiting=1, occ=0.2),
+            self._digest(1, waiting=0, occ=0.1),
+            self._digest(2, waiting=0, occ=0.0),
+        ])
+        rec = AutoscalePolicy().recommend(fv)
+        assert rec["action"] == "remove"
+        assert rec["remove_candidate"] == 2  # least loaded, highest rank
+
+    def test_steady_fleet_holds(self):
+        fv = self._fleet([self._digest(r, waiting=4, occ=0.5) for r in range(3)])
+        assert AutoscalePolicy().recommend(fv)["action"] == "hold"
+
+    def test_below_min_nodes_recommends_add(self):
+        fv = self._fleet([self._digest(0)])
+        rec = AutoscalePolicy(AutoscaleConfig(min_nodes=2)).recommend(fv)
+        assert rec["action"] == "add" and rec["reason"] == "below_min_nodes"
+
+    def test_no_telemetry_holds(self):
+        """No serving digests = no signal: the policy must HOLD, not
+        recommend scaling a healthy-but-quiet (gossip-disabled) fleet
+        on noise. alive_ring alone is membership, not health."""
+        fv = FleetView(now=lambda: 101.0)
+        rec = AutoscalePolicy().recommend(fv, alive_ring=4)
+        assert rec["action"] == "hold" and rec["reason"] == "no_telemetry"
+
+    def test_bootstrapping_node_counts_as_capacity_routers_do_not(self):
+        fv = self._fleet([
+            self._digest(0, waiting=0, occ=0.0),
+            self._digest(1, waiting=0, occ=0.0, lifecycle="bootstrapping"),
+            self._digest(2, waiting=0, occ=0.0),
+            self._digest(9, role="router"),
+        ])
+        rec = AutoscalePolicy().recommend(fv)
+        assert rec["signals"]["serving_nodes"] == 3
+
+    def test_pure_policy_no_side_effects(self):
+        """The recommender is PURE: same view in, same verdict out, and
+        the fleet view is untouched."""
+        fv = self._fleet([self._digest(r, waiting=20) for r in range(3)])
+        before = {r: d.seq for r, d in fv.digests().items()}
+        r1 = AutoscalePolicy().recommend(fv)
+        r2 = AutoscalePolicy().recommend(fv)
+        assert r1 == r2
+        assert {r: d.seq for r, d in fv.digests().items()} == before

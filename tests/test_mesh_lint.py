@@ -133,3 +133,58 @@ class TestExtensionKindRegistration:
         assert DATA_KINDS == {
             OplogType.INSERT, OplogType.DELETE, OplogType.RESET,
         }
+
+    def test_every_lifecycle_kind_is_registered(self):
+        """Membership-lifecycle op kinds (LEAVE — policy/lifecycle.py)
+        post-date the pass-through tolerance, so each must be in
+        EXTENSION_KINDS (old wires forward, never raise) AND carry an
+        explicit oplog_received branch (the EXTENSION_KINDS receive-
+        branch test covers the latter for every registered kind)."""
+        from radixmesh_tpu.cache.oplog import EXTENSION_KINDS, OplogType
+
+        assert OplogType.LEAVE in EXTENSION_KINDS, (
+            "LEAVE missing from EXTENSION_KINDS — an old wire would "
+            "raise on a graceful departure instead of forwarding it"
+        )
+
+
+class TestLifecycleStateOwnership:
+    """Satellite lint: lifecycle state has ONE writer. A module that
+    could flip a node to ACTIVE mid-bootstrap (or un-drain it) would
+    silently re-enable cold hit-routing — so every assignment of a
+    LifecycleState value lives in policy/lifecycle.py; everything else
+    only reads (plane.state / the gossiped digest string)."""
+
+    # Assignments of a LifecycleState member (augmented or plain),
+    # excluding comparisons (==, !=, <=, >=) via the look-behind.
+    _ASSIGN = re.compile(r"(?<![=!<>])=\s*\(?\s*\n?\s*LifecycleState\.")
+
+    def _product_sources(self):
+        import pathlib
+
+        import radixmesh_tpu
+
+        pkg = pathlib.Path(radixmesh_tpu.__file__).parent
+        for path in sorted(pkg.rglob("*.py")):
+            yield path, path.read_text()
+
+    def test_no_module_outside_lifecycle_assigns_state(self):
+        offenders = []
+        for path, src in self._product_sources():
+            if path.name == "lifecycle.py" and path.parent.name == "policy":
+                continue
+            if self._ASSIGN.search(src):
+                offenders.append(str(path))
+        assert not offenders, (
+            "lifecycle state assigned outside policy/lifecycle.py "
+            f"(single-writer contract): {offenders}"
+        )
+
+    def test_positive_control_lifecycle_module_does_assign(self):
+        """The lint greps for a real pattern: the owner module DOES
+        assign LifecycleState values."""
+        import inspect
+
+        from radixmesh_tpu.policy import lifecycle
+
+        assert self._ASSIGN.search(inspect.getsource(lifecycle))

@@ -20,6 +20,7 @@ UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_tokens")
 GAUGE_SUFFIXES = UNIT_SUFFIXES + (
     "_requests", "_slots", "_nodes", "_rows",
     "_epoch", "_rank", "_flag", "_tier", "_tokens_per_second",
+    "_state",  # lifecycle state code (policy/lifecycle.py)
 )
 
 
@@ -60,10 +61,13 @@ def _register_all_instrumented_families() -> None:
             protocol="inproc",
         )
 
-    MeshCache(
+    from radixmesh_tpu.policy.lifecycle import LifecyclePlane
+
+    pd_mesh = MeshCache(
         mesh_cfg("p0"),
         pool=PagedKVPool(num_slots=16, num_layers=1, num_kv_heads=1, head_dim=2),
     )
+    LifecyclePlane(pd_mesh)  # registers the lifecycle state/transition families
     router_mesh = MeshCache(mesh_cfg("r0"))
     CacheAwareRouter(router_mesh, router_mesh.cfg)
 

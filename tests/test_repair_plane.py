@@ -418,13 +418,85 @@ class TestStormControl:
             close_all(nodes, planes, repairs)
 
 
+class TestDrainDuringSession:
+    def test_peer_drain_aborts_session_cleanly(self):
+        """Drain-under-chaos edge case (PR 6): a node drains while it is
+        the PEER of an open repair session. The initiator's session must
+        abort cleanly — the LEAVE drops the peer from its fleet view, the
+        next scan prunes the peer state (no wedged budget, no probes at a
+        ghost), and repair with the remaining fleet is unaffected."""
+        from radixmesh_tpu.policy.lifecycle import LifecycleConfig, LifecyclePlane
+
+        nodes, ring, router, planes, repairs = make_cluster(
+            repair_cfg=RepairConfig(
+                interval_s=10.0,  # scans driven by hand
+                age_threshold_s=0.0, backoff_base_s=0.5, backoff_max_s=5.0,
+                jitter_frac=0.0,
+            )
+        )
+        lc = None
+        try:
+            # Diverge ring[0] from everyone; ring[1] (the future drainer)
+            # never answers — its repair plane stays unstarted, so the
+            # session against it hangs open mid-exchange.
+            with ring[0]._lock:
+                ring[0]._mesh_insert(
+                    np.array([6, 6, 6], np.int32),
+                    PrefillValue(np.arange(3, dtype=np.int32), ring[0].rank),
+                )
+            for p in planes:
+                p.publish_once()
+            plane = repairs[0]
+            assert wait_for(
+                lambda: len(ring[0].fleet.digests()) == len(ring)
+            )
+            assert plane.scan_once() > 0  # probes out, incl. to ring[1]
+            assert ring[1].rank in plane._peers
+            st_before = dict(plane._peers[ring[1].rank])
+            assert st_before["rounds"] >= 1
+            # ring[1] drains mid-session (its own plane closes first —
+            # drain quiesces repair before LEAVE).
+            lc = LifecyclePlane(
+                ring[1], repair=repairs[1], fleet_plane=planes[1],
+                cfg=LifecycleConfig(leave_retries=2, leave_confirm_s=0.1),
+            )
+            lc.drain(deadline_s=1.0)
+            survivors = [n for n in nodes if n is not ring[1]]
+            assert wait_for(
+                lambda: all(
+                    not n.view.contains(ring[1].rank) for n in survivors
+                )
+            )
+            # The initiator's next scan prunes the departed peer: no
+            # wedged session state, no further probes at it.
+            sent_before = plane.stats()["probes_sent"]
+            assert wait_for(
+                lambda: (plane.scan_once(), ring[1].rank not in plane._peers)[1]
+            ), "session state against the drained peer never pruned"
+            for _ in range(3):
+                plane.scan_once()
+            assert ring[1].rank not in plane._peers
+            assert ring[1].rank not in plane.stats()["diverged_peers"]
+            # Probes may still flow to OTHER diverged peers — just never
+            # to the drained one (its channel would be a ghost).
+            assert plane.stats()["probes_sent"] >= sent_before
+        finally:
+            if lc is not None:
+                lc.close()
+            close_all(nodes, planes, repairs)
+
+
 class TestChaosAcceptance:
     def test_chaos_scenario_converges_and_quiesces(self):
         """The acceptance criterion at test scale: seeded 20% loss + a
         partition of one prefill → divergence detected → repair
         converges P, D, AND router within the round budget — with
         requests served throughout and zero repair traffic once
-        converged. The full 10 s version is scripts/chaosbench.py."""
+        converged — then the PR 6 membership phases: a graceful drain
+        under re-opened loss (zero failed, requeued-and-served, no
+        failure detection) and a cold rejoin during a fresh partition
+        (bootstrap within budget, router withholds hits until
+        convergence). The full 10 s version is scripts/chaosbench.py."""
         import bench
         from radixmesh_tpu.workload import run_chaos_workload
 
@@ -439,6 +511,8 @@ class TestChaosAcceptance:
             n_requests=60,
             quiesce_window_s=0.8,
             timeout_s=45.0,
+            join_partition_s=1.0,
+            drain_requests=25,
         )
         report = bench.build_chaos_report(res)
         assert bench.validate_chaos(report) == []
@@ -447,3 +521,16 @@ class TestChaosAcceptance:
         assert res["repair"]["within_round_budget"]
         assert res["quiescence"]["quiet"]
         assert res["served"]["ok_rate_during_fault"] >= 0.9
+        # Membership-lifecycle gates (validate_chaos enforces them too;
+        # asserted directly so a failure names the exact phase).
+        drain = res["drain"]
+        assert drain["performed"] and drain["zero_failed"]
+        assert drain["left_without_failure_detection"]
+        assert drain["requeued_served"] == drain["requeued"]
+        assert drain["writeback_flushed"]
+        join = res["join"]
+        assert join["performed"] and join["converged_with_donor"]
+        assert join["within_round_budget"]
+        assert join["hits_to_bootstrapping"] == 0
+        assert join["withheld_hits"] > 0
+        assert join["fleet_converged_after_join"]
