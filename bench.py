@@ -271,22 +271,28 @@ def _fleet_pass() -> dict:
 
 # ----------------------------------------------------------------------
 # CHAOS stable schema (PR 5, self-healing mesh; v2 in PR 6, membership
-# lifecycle): one artifact per round recording the chaos acceptance
-# scenario — seeded frame loss + a scheduled partition (comm/faults.py)
-# diverge replicas; the anti-entropy repair plane (cache/repair_plane.py)
-# must converge every replica (router included) within a bounded number
-# of repair rounds while requests keep being served, then go quiet.
+# lifecycle; v3 in PR 7, request recovery): one artifact per round
+# recording the chaos acceptance scenario — seeded frame loss + a
+# scheduled partition (comm/faults.py) diverge replicas; the
+# anti-entropy repair plane (cache/repair_plane.py) must converge every
+# replica (router included) within a bounded number of repair rounds
+# while requests keep being served, then go quiet.
 # v2 adds the elastic-membership phases (policy/lifecycle.py): a
 # graceful drain under sustained loss (zero failed requests, in-flight
 # requeued-and-served, hot tokens written back, departure via LEAVE —
 # never failure detection) and a cold rejoin during an active partition
 # (bulk-bootstrap from a donor within the round budget, router
-# withholding cache hits until convergence). Bump the version ONLY when
-# adding fields (never remove or rename); v1 artifacts — which predate
-# the join/drain sections — stay valid.
+# withholding cache hits until convergence).
+# v3 adds the crash phase (server/recovery.py): an UNCLEAN decode-node
+# kill mid-stream under loss — zero failed requests, every interrupted
+# stream resumed with a byte-identical delivered prefix, resurrection
+# served ≥ 0.8 from the replicated cache, every recovery hop bounded by
+# the admission deadline budget, hedged prefill first-writer-wins.
+# Bump the version ONLY when adding fields (never remove or rename);
+# v1/v2 artifacts — which predate the newer sections — stay valid.
 # ----------------------------------------------------------------------
 
-CHAOS_SCHEMA_VERSION = 2
+CHAOS_SCHEMA_VERSION = 3
 
 CHAOS_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -323,6 +329,18 @@ CHAOS_JOIN_FIELDS = (
     "withheld_hits", "hits_to_bootstrapping",
     "fleet_converged_after_join",
 )
+# v3 request-recovery section (crash-mid-decode). Required when the
+# section reports performed=True; {"performed": false} is schema-valid
+# and gate-exempt, like the v2 sections.
+CHAOS_CRASH_FIELDS = (
+    "performed", "node", "drop_p", "streams", "tokens_per_stream",
+    "killed_at_token", "interrupted", "resumed", "failed",
+    "prefix_identical", "replayed_tokens", "replayed_cached_tokens",
+    "resurrection_hit_ratio", "retries", "resurrections",
+    "failover_routes", "detection", "budget", "hedge", "crash_s",
+)
+# The structural acceptance floor the resurrection claim rides on.
+CHAOS_CRASH_MIN_HIT_RATIO = 0.8
 
 
 def validate_chaos(report) -> list[str]:
@@ -428,6 +446,69 @@ def validate_chaos(report) -> list[str]:
                 "(the withhold path went unexercised — the gate proves "
                 "nothing)"
             )
+    # v3 request-recovery section + gates (v1/v2 artifacts predate it
+    # and stay valid without).
+    v3 = int(report.get("schema_version", 0) or 0) >= 3
+    crash = report.get("crash")
+    if v3 and not isinstance(crash, dict):
+        problems.append("crash section missing (schema v3)")
+    if isinstance(crash, dict) and crash.get("performed"):
+        problems += [
+            f"crash.{f}" for f in CHAOS_CRASH_FIELDS if f not in crash
+        ]
+        if crash.get("failed") != 0:
+            problems.append(
+                f"crash: {crash.get('failed')} request(s) LOST to the "
+                "unclean kill — a node death must be a latency blip, "
+                "never a request loss"
+            )
+        if not crash.get("interrupted", 0):
+            problems.append(
+                "crash: the kill interrupted zero live streams (the "
+                "resurrection path went unexercised — the gate proves "
+                "nothing)"
+            )
+        if crash.get("resumed") != crash.get("interrupted"):
+            problems.append(
+                "crash: interrupted streams were not all resurrected "
+                f"({crash.get('resumed')}/{crash.get('interrupted')})"
+            )
+        if crash.get("prefix_identical") is not True:
+            problems.append(
+                "crash: a resumed stream re-emitted, skipped, or "
+                "corrupted already-delivered tokens (prefix not "
+                "byte-identical)"
+            )
+        ratio = crash.get("resurrection_hit_ratio")
+        if not isinstance(ratio, (int, float)) or (
+            ratio < CHAOS_CRASH_MIN_HIT_RATIO
+        ):
+            problems.append(
+                f"crash: resurrection cache-hit ratio {ratio} below "
+                f"{CHAOS_CRASH_MIN_HIT_RATIO} — replay recomputed what "
+                "the replicated tree should have served"
+            )
+        budget = crash.get("budget")
+        if not isinstance(budget, dict) or (
+            budget.get("within_one_backoff") is not True
+        ):
+            problems.append(
+                "crash: a recovered request overran its admission "
+                "deadline by more than one retry backoff (the budget "
+                "was not threaded through every hop)"
+            )
+        hedge = crash.get("hedge")
+        if isinstance(hedge, dict) and hedge.get("fired"):
+            if hedge.get("first_writer_wins") is not True:
+                problems.append(
+                    "crash: the hedge's first successful writer did "
+                    "not win"
+                )
+            if hedge.get("loser_cancelled") is not True:
+                problems.append(
+                    "crash: the hedge loser was not cancelled (its "
+                    "pages would leak)"
+                )
     return problems
 
 
@@ -447,7 +528,9 @@ def build_chaos_report(res: dict) -> dict:
             f"{fp.get('drop_window_s', 0)}s + {fp.get('partition_s', 0)}s "
             f"symmetric partition of {fp.get('partitioned_node')} while "
             "routed requests keep flowing, then a graceful drain under "
-            "re-opened loss and a cold rejoin during a fresh partition "
+            "re-opened loss, a cold rejoin during a fresh partition, "
+            "and an unclean decode-node kill mid-stream with "
+            "request resurrection from the replicated prefix cache "
             "(inproc ring; see workload.run_chaos_workload)"
         ),
         **res,

@@ -35,6 +35,14 @@ Fault semantics:
   thread and land late / twice / out of order.
 - *crash_after_sends*: the edge dies permanently after its Nth send
   (subsequent sends raise), simulating a connection torn mid-stream.
+- *kill (process-level)*: :meth:`FaultPlan.kill` declares a NODE dead —
+  not one channel. Every edge INTO the killed address blackholes
+  (``try_send`` blocks out its timeout, like a peer whose process
+  stopped acking), and every edge OUT of it raises (a dead process
+  sends nothing) — permanently, with no scheduled end. This is the
+  unclean-death mode the request-recovery plane
+  (``server/recovery.py``) exists to survive; a partition ends, a kill
+  does not.
 """
 
 from __future__ import annotations
@@ -116,6 +124,10 @@ class FaultPlan:
     partitions: tuple[PartitionSpec, ...] = ()
     # dst addr → edge dies permanently after this many sends to it.
     crash_after_sends: dict = field(default_factory=dict)
+    # Addresses whose PROCESS is dead (``kill``): inbound edges
+    # blackhole, outbound edges raise, forever. A set so a workload can
+    # kill mid-run; every wrapped edge shares this object.
+    killed: set = field(default_factory=set)
     targets: tuple[str, ...] | None = None
     # Observability for tests/workloads (not serialized): per-outcome
     # frame counts across every wrapped edge.
@@ -123,6 +135,17 @@ class FaultPlan:
 
     def count(self, what: str, n: int = 1) -> None:
         self.counters[what] = self.counters.get(what, 0) + n
+
+    def kill(self, addr: str) -> None:
+        """Process-level kill: ``addr`` stops serving AND stops acking
+        from this instant — permanent, unscheduled, unlike a partition.
+        Takes effect immediately on every already-wrapped edge (they all
+        share this plan object)."""
+        self.killed.add(addr)
+        self.count("kills")
+
+    def is_killed(self, addr: str | None) -> bool:
+        return addr is not None and addr in self.killed
 
     def to_dict(self) -> dict:
         return {
@@ -139,6 +162,7 @@ class FaultPlan:
             "reorder_delay_s": self.reorder_delay_s,
             "partitions": [p.to_dict() for p in self.partitions],
             "crash_after_sends": dict(self.crash_after_sends),
+            "killed": sorted(self.killed),
             "targets": None if self.targets is None else list(self.targets),
         }
 
@@ -159,6 +183,7 @@ class FaultPlan:
                 PartitionSpec.from_dict(p) for p in d.get("partitions", ())
             ),
             crash_after_sends=dict(d.get("crash_after_sends", {})),
+            killed=set(d.get("killed", ())),
             targets=(
                 None
                 if d.get("targets") is None
@@ -353,6 +378,10 @@ class FaultyCommunicator(Communicator):
     def _check_crash(self) -> None:
         if self._crashed:
             raise RuntimeError("chaos: channel crashed")
+        if self._plan.is_killed(self._src):
+            # A dead process sends nothing: outbound edges raise.
+            self._plan.count("killed_send")
+            raise RuntimeError(f"chaos: process {self._src} is killed")
         dst = self._dst_now()
         n = self._plan.crash_after_sends.get(dst)
         if n is not None and self._sent >= int(n):
@@ -391,6 +420,9 @@ class FaultyCommunicator(Communicator):
         self._check_crash()
         rel = self._rel()
         self._sent += 1
+        if self._plan.is_killed(self._dst_now()):
+            self._plan.count("killed_blocked")
+            raise RuntimeError("chaos: peer process is killed")
         if self._partitioned(rel):
             self._plan.count("partition_blocked")
             raise RuntimeError("chaos: partitioned")
@@ -402,13 +434,20 @@ class FaultyCommunicator(Communicator):
         self._check_crash()
         self._sent += 1
         deadline = time.monotonic() + timeout_s
-        # A partition behaves like a blackholed peer: the send BLOCKS
-        # (bounded by the caller's timeout) — the same signal real
-        # failure detection keys on — and succeeds iff the window closes
-        # before the deadline.
-        while self._partitioned(self._rel()):
+        # A partition — or a KILLED peer — behaves like a blackholed
+        # process that stopped acking: the send BLOCKS (bounded by the
+        # caller's timeout) — the same signal real failure detection
+        # keys on — and succeeds iff the window closes before the
+        # deadline. A kill never closes.
+        while self._partitioned(self._rel()) or self._plan.is_killed(
+            self._dst_now()
+        ):
             if time.monotonic() >= deadline:
-                self._plan.count("partition_blocked")
+                self._plan.count(
+                    "killed_blocked"
+                    if self._plan.is_killed(self._dst_now())
+                    else "partition_blocked"
+                )
                 return False
             time.sleep(0.002)
         if self._should_drop(self._rel()):

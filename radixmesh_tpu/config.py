@@ -122,6 +122,11 @@ class MeshConfig:
     # (parking a tiny restore costs more than it hides). 0 = always
     # staged when the plane is on.
     kv_transfer_min_restore_tokens: int = 0
+    # Mid-decode publish cadence (crash recovery, server/recovery.py):
+    # every N generated tokens a request's grown prefix publishes to the
+    # tree AND the ring, so a node death costs a resurrected request at
+    # most N tokens of cache hit. 0 = publish only at finish/preempt.
+    stream_publish_tokens: int = 0
 
     @property
     def effective_startup_grace_s(self) -> float:
@@ -314,6 +319,7 @@ def load_config(path: str) -> MeshConfig:
         "kv_transfer_async",
         "kv_transfer_chunk_tokens",
         "kv_transfer_min_restore_tokens",
+        "stream_publish_tokens",
         "model",
         "mesh_axes",
         "serve_port_offset",
@@ -354,6 +360,7 @@ def load_config(path: str) -> MeshConfig:
         kv_transfer_min_restore_tokens=int(
             raw.get("kv_transfer_min_restore_tokens", 0)
         ),
+        stream_publish_tokens=int(raw.get("stream_publish_tokens", 0)),
         model=dict(raw.get("model", {})),
         mesh_axes=dict(raw.get("mesh_axes", {})),
         serve_port_offset=int(raw.get("serve_port_offset", 1000)),

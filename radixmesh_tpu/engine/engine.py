@@ -33,6 +33,7 @@ from __future__ import annotations
 import itertools
 import time
 from dataclasses import dataclass, field
+from dataclasses import replace as dataclass_replace
 from typing import Iterable, Sequence
 
 import jax
@@ -64,6 +65,19 @@ _engine_seq = itertools.count()
 __all__ = ["Engine", "EngineStats"]
 
 
+_M64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer — the same mixing family the tree
+    fingerprints use; here it turns (seed, position) into sampling-key
+    material."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
 def _pow2_at_least(n: int, floor: int = 8) -> int:
     n = max(n, floor)
     return 1 << (n - 1).bit_length()
@@ -90,6 +104,9 @@ class EngineStats:
     preemptions: int = 0
     spec_proposed: int = 0  # draft tokens offered for verification
     spec_accepted: int = 0  # draft tokens accepted (KV kept, step skipped)
+    resurrections: int = 0  # resume-mode admissions (crash recovery)
+    replayed_tokens: int = 0  # already-delivered tokens re-prefilled
+    replayed_cached_tokens: int = 0  # ... of which the cache served
     ttft_s: list[float] = field(default_factory=list)
 
     @property
@@ -131,6 +148,7 @@ class Engine:
         kv_transfer_async: bool = False,
         kv_transfer_chunk_tokens: int = 512,
         kv_transfer_min_restore_tokens: int = 0,
+        stream_publish_tokens: int = 0,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
@@ -357,6 +375,13 @@ class Engine:
         self._top_ps = np.ones(max_batch, dtype=np.float32)
         self._top_ks = np.zeros(max_batch, dtype=np.int32)
         self._rng = jax.random.PRNGKey(rng_seed)
+        # Mid-decode publish cadence (crash recovery, server/recovery.py):
+        # every N generated tokens the request's grown prefix publishes
+        # to the tree AND the mesh — so surviving replicas hold
+        # prompt+generated-so-far and a resurrected request's re-prefill
+        # is a near-pure cache hit instead of a full recompute. 0 = only
+        # publish at finish/preempt (the pre-recovery behavior).
+        self.stream_publish_tokens = stream_publish_tokens
         self.stats = EngineStats()
 
         reg = get_registry()
@@ -431,19 +456,60 @@ class Engine:
         tenant: str = "default",
         ttft_deadline_s: float | None = None,
         e2e_deadline_s: float | None = None,
+        resume_tokens: Sequence[int] | None = None,
     ) -> Request:
         """Build + validate a request WITHOUT queueing it — the admission
         seam the SLO control plane (``radixmesh_tpu/slo/``) holds requests
-        behind before deciding to :meth:`enqueue` or shed them."""
+        behind before deciding to :meth:`enqueue` or shed them.
+
+        ``resume_tokens`` switches on **resume admission** (crash
+        recovery, ``server/recovery.py``): the tokens are output a prior
+        life of this request already delivered to its client. They are
+        appended to the prompt — so prefill replays them against the
+        radix cache (a near-pure hit when the crashed node's publishes
+        replicated) and the first sampled token is the CONTINUATION at
+        position ``len(prompt)+len(resume_tokens)`` — but they are never
+        re-emitted: ``output_tokens`` starts empty and
+        ``sampling.max_new_tokens`` is debited by the tokens already
+        delivered, so the request's total output budget is unchanged
+        across lives."""
+        sampling = sampling or SamplingParams()
+        prompt = np.asarray(prompt, dtype=np.int32)
+        resume_offset = 0
+        if resume_tokens is not None and len(resume_tokens) > 0:
+            resume = np.asarray(resume_tokens, dtype=np.int32)
+            resume_offset = len(resume)
+            if resume_offset >= sampling.max_new_tokens:
+                # The delivered tokens already cover the whole output
+                # budget: there is NOTHING to resume, and admitting
+                # would sample a token the first life never would have
+                # (output past the requested cap). The edge holds the
+                # complete stream — refuse loudly instead.
+                raise ValueError(
+                    f"resume_tokens ({resume_offset}) cover the full "
+                    f"max_new_tokens budget ({sampling.max_new_tokens}) "
+                    "— the stream is already complete"
+                )
+            prompt = np.concatenate([prompt, resume])
+            sampling = dataclass_replace(
+                sampling,
+                max_new_tokens=sampling.max_new_tokens - resume_offset,
+            )
         req = Request(
-            prompt=np.asarray(prompt, dtype=np.int32),
-            sampling=sampling or SamplingParams(),
+            prompt=prompt,
+            sampling=sampling,
             tenant=tenant,
             ttft_deadline_s=ttft_deadline_s,
             e2e_deadline_s=e2e_deadline_s,
+            resume_offset=resume_offset,
         )
         if not (0 < len(req.prompt) < self.max_seq_len):
             raise ValueError(f"prompt length {len(req.prompt)} out of range")
+        if resume_offset:
+            self.stats.resurrections += 1
+            # The whole resumed prompt is replay: the original prompt AND
+            # the delivered tokens all re-prefill on the new node.
+            self.stats.replayed_tokens += len(prompt)
         req.submit_time = time.monotonic()
         # Request-flight tracing (obs/trace_plane.py): returns None when
         # tracing is off or the request lost the sampling coin flip —
@@ -1031,6 +1097,12 @@ class Engine:
         self._m_prompt.inc(len(req.prompt))
         self._m_cached.inc(reuse)
         self._m_hit_len.observe(reuse)
+        if req.resume_offset:
+            # Resurrection hit accounting: the whole resumed prompt
+            # (original prompt + delivered tokens) is replay; the cache
+            # served ``reuse`` of it. The chaos gate pins the fleet-wide
+            # ratio ≥ 0.8 — replay must be a hit, not a recompute.
+            self.stats.replayed_cached_tokens += reuse
 
         self._publish(req, len(req.prompt))
 
@@ -1045,6 +1117,55 @@ class Engine:
         self._page_table[row, :n_pages] = (
             req.token_slots[:: self.page_size] // self.page_size
         )
+
+    @staticmethod
+    def _seeded_launch(rows: Iterable[Request]) -> bool:
+        """True when EVERY row is seeded — the replay-determinism
+        contract's scope. A mixed batch samples from the global stream
+        (documented best-effort): determinism is a per-launch contract,
+        never a cross-request entanglement."""
+        rows = [r for r in rows if r is not None]
+        return bool(rows) and all(
+            r.sampling.seed is not None for r in rows
+        )
+
+    def _seed_key(self, req: Request) -> jax.Array:
+        """Canonical per-row sampling key: a pure function of (seed,
+        absolute token position). ``req.num_tokens`` IS the position of
+        the token about to be drawn — and for a resumed request
+        (``resume_offset``) the delivered tokens ride in the prompt, so
+        positions line up exactly across lives."""
+        # Mix the seed BEFORE combining with the position: a shift-then-
+        # mask would throw away the seed's top bits, silently colliding
+        # distinct user-supplied seeds.
+        acc = _mix64(_mix64(int(req.sampling.seed) & _M64) ^ req.num_tokens)
+        # A raw uint32[2] array IS a legacy threefry key — no jax
+        # dispatch on the host path to build it.
+        return jnp.asarray(
+            np.array(
+                [(acc >> 32) & 0xFFFFFFFF, acc & 0xFFFFFFFF],
+                dtype=np.uint32,
+            )
+        )
+
+    def _sample_seeded_row(self, req: Request, logit_row) -> int:
+        """THE canonical seeded draw: one [1, V] ``sample_tokens`` call
+        keyed by (seed, position). Every seeded sampling site — first
+        token after prefill, every decode step, on any node — uses this
+        exact shape and key schedule, so a request resurrected on
+        another node redraws the same continuation its first life would
+        have drawn (the categorical draw depends on the batch SHAPE, so
+        shape-stability here is what makes cross-life replay exact)."""
+        tok = sample_tokens(
+            logit_row[None, :],
+            self._seed_key(req),
+            temperature=jnp.asarray(
+                [req.sampling.temperature], jnp.float32
+            ),
+            top_p=jnp.asarray([req.sampling.top_p], jnp.float32),
+            top_k=jnp.asarray([req.sampling.top_k], jnp.int32),
+        )
+        return int(np.asarray(tok)[0])
 
     def _record_first_token(self, req: Request) -> None:
         self.stats.ttft_s.append(req.first_token_time - req.submit_time)
@@ -1063,6 +1184,20 @@ class Engine:
         admitted this round (each copy costs a full RPC round trip on
         remote-tunneled devices — per-request syncs made TTFT scale with
         queue depth)."""
+        if self._seeded_launch(r for r, _ in pending):
+            # Seeded replay: each row draws through the canonical
+            # shape-stable (seed, position) path instead of the batched
+            # sample — a resumed request's first token is exactly the
+            # token its first life drew at that position.
+            now = time.monotonic()
+            for req, logit in pending:
+                tok = self._sample_seeded_row(req, logit)
+                req.first_token_time = now
+                req.output_tokens = [tok]
+                self._tokens[req.row] = tok
+                self._record_first_token(req)
+                req.note_progress()
+            return
         self._rng, key = jax.random.split(self._rng)
         # Pad to a power-of-two batch (repeating row 0) so serving queue
         # depths don't each compile a fresh sample_tokens variant.
@@ -1460,10 +1595,17 @@ class Engine:
             if k_eff > 1:
                 self._decode_multi_once(k_eff)
                 return
-        if not self._pp and not default_use_kernel(self.cfg.head_dim):
+        seeded = self._seeded_launch(self._rows)
+        if (
+            not self._pp
+            and not default_use_kernel(self.cfg.head_dim)
+            and not seeded
+        ):
             # Kernel-less single step: the same compact working-set path
             # with k=1 — a decode_step launch would otherwise pay the
-            # whole-pool donation-copy for one token.
+            # whole-pool donation-copy for one token. Seeded launches
+            # skip it: its device-side draw is batch-shaped, and replay
+            # needs the canonical per-row (seed, position) draw below.
             self._decode_multi_once(1)
             return
         slots = np.full(self.max_batch, self._scratch_slot, dtype=np.int32)
@@ -1494,13 +1636,14 @@ class Engine:
         if not active:
             return
         step_t0 = time.monotonic()
-        self._rng, key = jax.random.split(self._rng)
-        if self._pp:
+        if self._pp or seeded:
             # A decode step is a C=1 chunk through the layer pipeline
             # (parallel/pp_serving.py) — same page-table attention, same
             # pool scatter, stage weights never move. The chunk path's
             # blockwise attention needs a KV-block-multiple table width,
-            # which the bucket keeps (floor = block).
+            # which the bucket keeps (floor = block). Seeded launches
+            # ride it on every backend: it returns LOGITS, and the
+            # replay contract needs the canonical host-side draw.
             res = self._forward_chunk(
                 jnp.asarray(self._tokens)[:, None],
                 jnp.asarray(lengths - 1)[:, None],
@@ -1524,13 +1667,19 @@ class Engine:
                 kv_scale=self.pool.kv_scale,
             )
             logits = self._commit_pool_update(res)
-        sampled = np.asarray(
-            sample_tokens(
-                logits, key, temperature=jnp.asarray(self._temps),
-                top_p=jnp.asarray(self._top_ps),
-                top_k=jnp.asarray(self._top_ks),
+        if seeded:
+            sampled = np.zeros(self.max_batch, dtype=np.int64)
+            for row, req in active:
+                sampled[row] = self._sample_seeded_row(req, logits[row])
+        else:
+            self._rng, key = jax.random.split(self._rng)
+            sampled = np.asarray(
+                sample_tokens(
+                    logits, key, temperature=jnp.asarray(self._temps),
+                    top_p=jnp.asarray(self._top_ps),
+                    top_k=jnp.asarray(self._top_ks),
+                )
             )
-        )
         self.stats.decode_steps += 1
         # sample_tokens materialized on host above, so this spans the full
         # dispatch+device time of the step — the per-token latency (TPOT)
@@ -1567,6 +1716,13 @@ class Engine:
         for req in self._rows:
             if req is None:
                 continue
+            if req.sampling.seed is not None:
+                # Seeded replay (crash recovery): the fused launch draws
+                # its intermediate tokens from one in-scan key schedule,
+                # which would tie each draw to the LAUNCH rather than
+                # the (seed, position) pair — single-step so every draw
+                # goes through the position-keyed path.
+                return 1
             k = min(k, self.max_seq_len - req.kv_len)
             k = min(k, self.max_pages * self.page_size - req.kv_len)
             # A row past its output budget would discard the tail of the
@@ -1721,6 +1877,15 @@ class Engine:
         split into pp microbatches — single-stream serving, speculation's
         prime latency case, must not lose it)."""
         if self.waiting:
+            return False
+        if any(
+            r is not None and r.sampling.seed is not None
+            for r in self._rows
+        ):
+            # Seeded replay (crash recovery): the spec verify resample
+            # draws from the launch-wide key, which would decouple a
+            # seeded row's tokens from its (seed, position) schedule —
+            # seeded batches take the position-keyed single-step path.
             return False
         return any(req is not None for req in self._rows)
 
@@ -1947,6 +2112,18 @@ class Engine:
             return True
         self._m_generated.inc()
         self._tokens[row] = token
+        if (
+            self.stream_publish_tokens > 0
+            and len(req.output_tokens) % self.stream_publish_tokens == 0
+        ):
+            # Mid-decode publish (crash recovery): the grown prefix
+            # (prompt + generated-so-far) lands in the tree AND
+            # replicates around the ring every N tokens, so a node death
+            # loses at most N tokens of resurrection cache hit — the
+            # re-prefill on a surviving replica is near-pure hit. Same
+            # call _preempt makes; idempotent for already-published
+            # prefixes.
+            self._publish(req, req.kv_len)
         # Streaming waiters block on the request condition instead of
         # polling (server/http_frontend.py) — wake them per token so
         # first-token latency isn't quantized by a poll interval.

@@ -21,6 +21,13 @@ class SamplingParams:
     top_k: int = 0  # 0 = disabled
     max_new_tokens: int = 16
     stop_token_ids: tuple[int, ...] = ()
+    # Per-request sampling seed (None = the engine's global stream).
+    # With a seed, a sampled token depends only on (seed, absolute
+    # position) whenever every row in the launch is seeded — so a
+    # request resurrected on another node after a crash redraws exactly
+    # the continuation its first life would have drawn (the recovery
+    # plane's replay-determinism contract, server/recovery.py).
+    seed: int | None = None
 
 
 class RequestState(enum.Enum):
@@ -57,6 +64,13 @@ class Request:
     )
     lock_node: object = None  # TreeNode protected while RUNNING
     cancelled: bool = False  # aborted by Engine.cancel (output is partial)
+    # Resume-admission (crash recovery, server/recovery.py): the last
+    # ``resume_offset`` tokens of ``prompt`` are output the FIRST life of
+    # this request already delivered to the client — replayed through
+    # prefill (a near-pure cache hit against the replicated tree) but
+    # never re-emitted: ``output_tokens`` holds only post-resume tokens,
+    # so an SSE stream continues seamlessly from token k.
+    resume_offset: int = 0
 
     # -- SLO control plane (radixmesh_tpu/slo/) --
     tenant: str = "default"  # rate-limit / fair-share accounting key

@@ -222,6 +222,11 @@ def _run_node(args: argparse.Namespace) -> int:
                 if args.kv_transfer_min_restore is not None
                 else cfg.kv_transfer_min_restore_tokens
             ),
+            stream_publish_tokens=(
+                args.stream_publish
+                if args.stream_publish is not None
+                else cfg.stream_publish_tokens
+            ),
         )
         if engine.kv_transfer is not None:
             # Predictive restores: PREFETCH hints received off the wire
@@ -392,6 +397,7 @@ def _run_serve(args: argparse.Namespace) -> int:
         kv_transfer_async=args.kv_transfer_async,
         kv_transfer_chunk_tokens=args.kv_transfer_chunk or 512,
         kv_transfer_min_restore_tokens=args.kv_transfer_min_restore or 0,
+        stream_publish_tokens=args.stream_publish or 0,
     )
     slo_cfg = None
     if args.slo or args.slo_ttft_ms is not None or args.slo_tenant:
@@ -484,6 +490,13 @@ def _add_kv_transfer_args(sub: argparse.ArgumentParser) -> None:
         "--kv-transfer-min-restore", type=int, default=None, metavar="TOKENS",
         help="restores shorter than this stay on the synchronous "
         "in-admission path (default 0 = always staged)",
+    )
+    sub.add_argument(
+        "--stream-publish", type=int, default=None, metavar="TOKENS",
+        help="publish a request's grown prefix to the tree + ring every "
+        "N generated tokens (crash recovery: bounds a resurrected "
+        "request's cache-hit loss to N tokens; default 0 = publish only "
+        "at finish/preempt)",
     )
 
 

@@ -34,6 +34,7 @@ __all__ = [
     "TOKEN_LEN_BUCKETS",
     "TRANSFER_SECONDS_BUCKETS",
     "REPAIR_SECONDS_BUCKETS",
+    "RECOVERY_SECONDS_BUCKETS",
 ]
 
 # Latency-oriented default buckets (seconds): 1ms .. 60s.
@@ -66,6 +67,16 @@ TOKEN_LEN_BUCKETS: tuple[float, ...] = tuple(float(1 << i) for i in range(16))
 REPAIR_SECONDS_BUCKETS: tuple[float, ...] = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
     2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+# Request-recovery buckets (seconds): a recovery episode spans hop
+# timeout (tens of ms to seconds) + jittered backoff + re-route +
+# re-prefill — the death-to-first-resumed-token blip the recovery plane
+# (server/recovery.py) exists to keep small. Shared so every edge bins
+# resurrection latency identically.
+RECOVERY_SECONDS_BUCKETS: tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.0, 5.0, 10.0, 30.0, 60.0,
 )
 
 

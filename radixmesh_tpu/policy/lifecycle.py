@@ -59,10 +59,6 @@ import threading
 import time
 from dataclasses import dataclass
 
-from radixmesh_tpu.obs.metrics import get_registry
-from radixmesh_tpu.obs.trace_plane import get_recorder
-from radixmesh_tpu.utils.logging import get_logger
-
 __all__ = [
     "LifecycleState",
     "LifecycleError",
@@ -114,6 +110,15 @@ def lifecycle_from_code(code: int) -> str:
     peer's state must degrade to normal routing, not an error)."""
     return _CODE_STATES.get(int(code), LifecycleState.ACTIVE).value
 
+
+# Imported AFTER the state enum + wire-code helpers on purpose: obs
+# (fleet_plane) imports those helpers back from this module, so they
+# must exist before this import re-enters us mid-initialization —
+# otherwise the first import of radixmesh_tpu.policy.* from a cold
+# process dies on the cycle.
+from radixmesh_tpu.obs.metrics import get_registry  # noqa: E402
+from radixmesh_tpu.obs.trace_plane import get_recorder  # noqa: E402
+from radixmesh_tpu.utils.logging import get_logger  # noqa: E402
 
 # The legal transition edges. Anything else is a bug in the caller —
 # e.g. LEFT is terminal (a rejoin is a NEW plane on a NEW MeshCache),

@@ -51,6 +51,13 @@ class RouteResult:
     prefill_cache_hit: bool = False
     decode_cache_hit: bool = False
     match_len: int = 0
+    # Crash failover (server/recovery.py): the longest-prefix match
+    # pointed at a node the caller excluded (declared dead) — the
+    # request was re-placed on a surviving node. ``match_len`` is KEPT
+    # on failover: every ring member replicates the prefix, so the
+    # survivor serves the same cached tokens the dead writer would have.
+    prefill_failover: bool = False
+    decode_failover: bool = False
 
 
 class _LoadTracker:
@@ -180,7 +187,7 @@ class CacheAwareRouter:
         self._m_routed = {
             (role, outcome): routed.labels(role=role, outcome=outcome)
             for role in ("prefill", "decode")
-            for outcome in ("hit", "fallback", "shed", "withheld")
+            for outcome in ("hit", "fallback", "shed", "withheld", "failover")
         }
         # Membership-lifecycle withholding (policy/lifecycle.py): a
         # BOOTSTRAPPING node's replica is still cold — a cache hit
@@ -330,11 +337,19 @@ class CacheAwareRouter:
             except Exception:  # noqa: BLE001 — hints are droppable by contract
                 pass
 
-    def cache_aware_route(self, key: Sequence[int]) -> RouteResult:
-        """Route one request's token ids (reference ``:23-39``)."""
+    def cache_aware_route(
+        self, key: Sequence[int], exclude: Sequence[str] | None = None
+    ) -> RouteResult:
+        """Route one request's token ids (reference ``:23-39``).
+
+        ``exclude`` (crash failover, ``server/recovery.py``): addresses
+        the caller has declared dead — never routed to, as hit or
+        fallback. A longest-prefix match pointing at one re-places on a
+        surviving node with ``match_len`` preserved (replication means
+        the survivor holds the prefix), flagged ``*_failover``."""
         t0 = time.monotonic()
         try:
-            res = self._route(key)
+            res = self._route(key, frozenset(exclude or ()))
         finally:
             dur = time.monotonic() - t0
             self._m_route_latency.observe(dur)
@@ -351,7 +366,9 @@ class CacheAwareRouter:
             )
         return res
 
-    def _route(self, key: Sequence[int]) -> RouteResult:
+    def _route(
+        self, key: Sequence[int], exclude: frozenset = frozenset()
+    ) -> RouteResult:
         if self._warm_up:
             match = RouterMatchResult(-1, -1)
         else:
@@ -361,13 +378,33 @@ class CacheAwareRouter:
             )
 
         p_out = d_out = None
+        p_fo = d_fo = False
         sick = self._sick_addrs()
         withhold, lc_excluded = self._lifecycle_sets()
+        # Dead-declared addresses (crash failover) are excluded HARD —
+        # unlike sickness, which is advisory, a dead node must never be
+        # returned even when it is the only ring member left.
+        lc_excluded = lc_excluded | exclude
         avoid = sick | lc_excluded  # never a fallback target either
         if match.prefill_rank >= 0:
             prefill_addr = self.config.prefill_addr(match.prefill_rank)
             p_hit = True
-            if match.prefill_rank in withhold:
+            if prefill_addr in exclude:
+                # The longest-prefix writer is DEAD: re-place on a
+                # surviving node. match_len is kept — replication means
+                # the survivor holds the prefix, which is exactly what
+                # makes a resurrected request's re-prefill nearly free.
+                # No survivor at all is NOT a failover (nothing was
+                # re-placed): plain fallback-to-None, no preserved match.
+                alt = self._prefill_ring.get_node(
+                    key, exclude={prefill_addr} | avoid
+                ) or self._prefill_ring.get_node(key, exclude=exclude)
+                p_hit = False
+                if alt is not None:
+                    prefill_addr, p_out, p_fo = alt, "failover", True
+                else:
+                    prefill_addr = None
+            elif match.prefill_rank in withhold:
                 # Cold (bootstrapping) or departing replica: the hit is
                 # not servable there — hash-ring fallback instead.
                 self.withheld_hits += 1
@@ -387,18 +424,28 @@ class CacheAwareRouter:
             # Cache miss: hash-ring fallback, skipping health-demoted
             # and departing nodes. If EVERY node of the role is sick,
             # route anyway (degraded service beats no service) —
-            # sickness is advisory; departure exclusion yields only when
-            # literally nothing else exists.
+            # sickness is advisory; departure/death exclusion yields
+            # only when literally nothing else exists (dead addresses
+            # stay excluded even then: None means "no capacity").
             prefill_addr = (
                 self._prefill_ring.get_node(key, exclude=avoid or None)
                 or self._prefill_ring.get_node(key, exclude=lc_excluded or None)
-                or self._prefill_ring.get_node(key)
+                or self._prefill_ring.get_node(key, exclude=exclude or None)
             )
             p_hit = False
         if match.decode_rank >= 0:
             decode_addr = self.config.decode_addr(match.decode_rank)
             d_hit = True
-            if match.decode_rank in withhold:
+            if decode_addr in exclude:
+                alt = self._decode_ring.get_node(
+                    key, exclude={decode_addr} | avoid
+                ) or self._decode_ring.get_node(key, exclude=exclude)
+                d_hit = False
+                if alt is not None:
+                    decode_addr, d_out, d_fo = alt, "failover", True
+                else:
+                    decode_addr = None
+            elif match.decode_rank in withhold:
                 self.withheld_hits += 1
                 alt = self._decode_ring.get_node(
                     key, exclude={decode_addr} | avoid
@@ -416,7 +463,7 @@ class CacheAwareRouter:
             decode_addr = (
                 self._decode_ring.get_node(key, exclude=avoid or None)
                 or self._decode_ring.get_node(key, exclude=lc_excluded or None)
-                or self._decode_ring.get_node(key)
+                or self._decode_ring.get_node(key, exclude=exclude or None)
             )
             d_hit = False
         if self.prefetch_hints and match.match_len > 0:
@@ -433,15 +480,20 @@ class CacheAwareRouter:
             self._loads.note(decode_addr)
         self._m_routed[("prefill", p_out or ("hit" if p_hit else "fallback"))].inc()
         self._m_routed[("decode", d_out or ("hit" if d_hit else "fallback"))].inc()
-        self._m_match_len.observe(match.match_len if (p_hit or d_hit) else 0)
+        self._m_match_len.observe(
+            match.match_len if (p_hit or d_hit or p_fo or d_fo) else 0
+        )
         # match_len only counts when a ROUTED address actually holds the
         # match (post-shedding): a shed request lands on a node without
         # the prefix, and reporting cached tokens there would inflate the
-        # hit-rate the north-star metric watches.
+        # hit-rate the north-star metric watches. Failover is the
+        # exception — replication puts the prefix on the survivor too.
         return RouteResult(
             prefill_addr=prefill_addr,
             decode_addr=decode_addr,
             prefill_cache_hit=p_hit,
             decode_cache_hit=d_hit,
-            match_len=match.match_len if (p_hit or d_hit) else 0,
+            match_len=match.match_len if (p_hit or d_hit or p_fo or d_fo) else 0,
+            prefill_failover=p_fo,
+            decode_failover=d_fo,
         )

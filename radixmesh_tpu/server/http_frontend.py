@@ -43,6 +43,7 @@ from radixmesh_tpu.engine.engine import Engine
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 from radixmesh_tpu.obs.metrics import get_registry
 from radixmesh_tpu.obs.trace_plane import get_recorder
+from radixmesh_tpu.policy.retry import jittered_retry_after
 from radixmesh_tpu.slo.control import RequestShed
 from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
 from radixmesh_tpu.utils.logging import get_logger
@@ -93,7 +94,10 @@ class EngineRunner:
             self._thread.join(timeout=5)
 
     def submit(
-        self, prompt: Sequence[int], sampling: SamplingParams | None = None
+        self,
+        prompt: Sequence[int],
+        sampling: SamplingParams | None = None,
+        resume_tokens: Sequence[int] | None = None,
     ) -> Request:
         with self._lock:
             if self._closed:
@@ -104,7 +108,9 @@ class EngineRunner:
                 raise RuntimeError(
                     "node is draining — retry via the router"
                 )
-            req = self.engine.add_request(prompt, sampling)
+            req = self.engine.add_request(
+                prompt, sampling, resume_tokens=resume_tokens
+            )
         self._wake.set()
         return req
 
@@ -662,13 +668,29 @@ class ServingFrontend:
                         # at EOS without knowing the id space; an explicit
                         # (even empty) stop_token_ids opts out.
                         stop_ids = (frontend.tokenizer.eos_id,)
+                    seed = body.get("seed")
                     sampling = SamplingParams(
                         temperature=float(body.get("temperature", 0.0)),
                         top_p=float(body.get("top_p", 1.0)),
                         top_k=int(body.get("top_k", 0)),
                         max_new_tokens=int(body.get("max_tokens", 16)),
                         stop_token_ids=stop_ids,
+                        seed=None if seed is None else int(seed),
                     )
+                    # Resume admission (crash recovery): output a prior
+                    # life already delivered — replayed through prefill
+                    # (near-pure cache hit), never re-emitted.
+                    resume_tokens = body.get("resume_tokens")
+                    if resume_tokens is not None and (
+                        not isinstance(resume_tokens, list)
+                        or not all(
+                            isinstance(t, int) and not isinstance(t, bool)
+                            for t in resume_tokens
+                        )
+                    ):
+                        raise ValueError(
+                            "resume_tokens must be a list of ints"
+                        )
                     slo_kw = {}
                     if frontend.slo_enabled:
                         # SLO fields (ignored without a control plane —
@@ -687,7 +709,9 @@ class ServingFrontend:
                     _json_response(self, 400, {"error": str(e)})
                     return
                 try:
-                    req = frontend.runner.submit(ids, sampling, **slo_kw)
+                    req = frontend.runner.submit(
+                        ids, sampling, resume_tokens=resume_tokens, **slo_kw
+                    )
                 except RequestShed as e:  # overload control plane refusal
                     # A drain shed points the client at the router: the
                     # fleet still has capacity — just not HERE.
@@ -700,20 +724,25 @@ class ServingFrontend:
                     if e.retry_after_s is not None:
                         # Retry-After must precede end_headers; build the
                         # response by hand rather than teach
-                        # _json_response about extra headers.
+                        # _json_response about extra headers. The
+                        # advertised value carries bounded jitter
+                        # (policy/retry.py): a thundering herd shed in
+                        # one instant must not come back in one instant
+                        # against a recovering fleet.
+                        retry_s = jittered_retry_after(e.retry_after_s)
                         body_b = json.dumps(
                             {
                                 "error": str(e),
                                 "shed": True,
                                 "reason": e.reason,
-                                "retry_after_s": round(e.retry_after_s, 4),
+                                "retry_after_s": round(retry_s, 4),
                                 **drain_hint,
                             }
                         ).encode()
                         self.send_response(e.http_status)
                         self.send_header("Content-Type", "application/json")
                         self.send_header(
-                            "Retry-After", str(max(1, int(e.retry_after_s)))
+                            "Retry-After", str(max(1, int(round(retry_s))))
                         )
                         self.send_header("Content-Length", str(len(body_b)))
                         self.end_headers()
@@ -772,6 +801,15 @@ class ServingFrontend:
                         "output_ids": tokens,
                         "cached_tokens": req.prefix_len,
                         "rid": req.rid,
+                        # Resumed requests: the stream continues from
+                        # token k — output_ids holds ONLY post-resume
+                        # tokens, never a re-emission of the delivered
+                        # prefix.
+                        **(
+                            {"resumed_from": req.resume_offset}
+                            if req.resume_offset
+                            else {}
+                        ),
                         **(
                             {"text": frontend.tokenizer.decode(tokens)}
                             if frontend.tokenizer is not None
@@ -808,6 +846,8 @@ class ServingFrontend:
                                 f"data: {json.dumps({'token': t})}\n\n".encode()
                             )
                         done_evt = {"done": True, "output_ids": final}
+                        if req.resume_offset:
+                            done_evt["resumed_from"] = req.resume_offset
                         if frontend.tokenizer is not None:
                             done_evt["text"] = frontend.tokenizer.decode(final)
                         if req.cancelled:

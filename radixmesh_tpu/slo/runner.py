@@ -87,6 +87,7 @@ class SLORunner(EngineRunner):
         tenant: str = "default",
         ttft_deadline_s: float | None = None,
         e2e_deadline_s: float | None = None,
+        resume_tokens: Sequence[int] | None = None,
     ) -> Request:
         # Arrival is STAMPED BEFORE the lock: engine.step() runs under
         # self._lock, so a submit landing mid-step (or mid-jit-compile)
@@ -106,12 +107,18 @@ class SLORunner(EngineRunner):
                 )
             # Validation (length bounds) before admission accounting, so
             # a malformed request can't spend bucket tokens.
+            # A resumed request (crash recovery, server/recovery.py)
+            # threads its REMAINING deadline budget in as
+            # e2e_deadline_s: the edge computed it from the original
+            # admission instant, so the second life cannot spend time
+            # the first life already used.
             req = self.engine.make_request(
                 prompt,
                 sampling,
                 tenant=tenant,
                 ttft_deadline_s=ttft_deadline_s,
                 e2e_deadline_s=e2e_deadline_s,
+                resume_tokens=resume_tokens,
             )
             req.submit_time = t_arrival
             decision = self.ctl.offer(

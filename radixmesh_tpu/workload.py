@@ -986,6 +986,254 @@ def _chaos_join_drain_phases(
     return drain_report, join_report
 
 
+def _chaos_crash_phase(
+    *,
+    by_addr,
+    cr,
+    plan,
+    faults,
+    prefill,
+    decode,
+    rng,
+    seed,
+    drop_p,
+    crash_streams,
+    crash_tokens,
+    crash_deadline_s,
+    kill_planes=lambda node: (),
+) -> dict:
+    """Phase 7 of ``run_chaos_workload`` (request recovery,
+    ``server/recovery.py``): an UNCLEAN decode-node kill mid-stream
+    under re-opened seeded loss.
+
+    ``crash_streams`` live streams decode round-robin (each emitted
+    token grows the stream's replicated prefix, the engine's
+    ``stream_publish_tokens`` behavior at mesh scale). Halfway through,
+    one decode node is process-killed (``FaultPlan.kill`` — stops
+    serving AND stops acking). The serving edge's recovery plane must
+    then deliver the acceptance gates the CHAOS v3 schema pins:
+
+    - ``failed == 0`` — every stream completes; an unclean death is a
+      latency blip, not a request loss.
+    - Every interrupted stream resumes with a **byte-identical**
+      already-delivered prefix (final streams equal the deterministic
+      per-stream expectation — a resume that re-emitted or skipped a
+      token breaks equality).
+    - Resurrection is a cache hit: the surviving node's match over
+      ``prompt+delivered`` covers ≥ 0.8 of the replayed tokens (the
+      replicated tree is what makes recovery nearly free).
+    - Deadline budgets bound every hop: no stream overruns its
+      admission deadline by more than one retry backoff.
+
+    Failure detection here is the EDGE's per-hop timeout (a killed
+    process stops acking; the edge's timer is the fast trigger — the
+    mesh's ``cause=dead`` ring detection is deliberately out of window,
+    exactly like production where failure_timeout >> hop timeout). A
+    hedged-prefill drill (straggler duplicated, first-writer-wins,
+    loser cancelled) runs under the same loss window."""
+    import time as _time
+
+    from radixmesh_tpu.policy.retry import RetryPolicy
+    from radixmesh_tpu.server.recovery import (
+        HopTimeout,
+        NodeDied,
+        RecoveryCoordinator,
+    )
+
+    t_phase = _time.monotonic()
+    # Re-open the seeded loss window for the whole phase; no partitions.
+    plan.partitions = ()
+    plan.drop_p = drop_p
+    plan.drop_start_s, plan.drop_end_s = 0.0, float("inf")
+    faults.rebase()
+
+    policy = RetryPolicy(
+        hop_timeout_s=0.4,
+        max_retries=4,
+        backoff_base_s=0.05,
+        backoff_max_s=0.4,
+        jitter_frac=0.25,
+        hedge_after_s=0.15,
+    )
+    coord = RecoveryCoordinator(policy, name="chaos-edge", seed=seed)
+    detect_t = {"first": None}
+    coord.on_node_dead.append(
+        lambda addr, cause: detect_t.__setitem__(
+            "first", detect_t["first"] or _time.monotonic()
+        )
+    )
+
+    def token_of(stream_seed: int, i: int) -> int:
+        # Deterministic continuation per (stream, position): byte-exact
+        # resume verification needs the expected stream to be computable
+        # independently of which node served which token.
+        return int((stream_seed * 7919 + i * 104729 + 13) % 600)
+
+    # -- admit streams and decode the first half (all live at the kill) --
+    streams = []
+    for s in range(crash_streams):
+        prompt = rng.integers(0, 600, size=len(prefill) * 5 + 1).astype(
+            np.int32
+        )
+        rec = coord.admit(
+            prompt, deadline_s=crash_deadline_s, seed=seed * 1009 + s
+        )
+        res = cr.cache_aware_route(prompt)
+        rec.addr = res.decode_addr
+        streams.append(rec)
+
+    def emit_one(rec) -> None:
+        node = by_addr[rec.addr]
+        i = len(rec.delivered)
+        tok = token_of(rec.seed, i)
+        key = np.concatenate(
+            [rec.resume_key(), np.asarray([tok], dtype=np.int32)]
+        )
+        node.insert(key, np.arange(len(key), dtype=np.int32))
+        rec.deliver(tok)
+
+    half = crash_tokens // 2
+    for i in range(half):
+        for rec in streams:
+            emit_one(rec)
+
+    # -- process-level kill of the busiest decode node ------------------
+    per_addr: dict = {}
+    for rec in streams:
+        per_addr[rec.addr] = per_addr.get(rec.addr, 0) + 1
+    victim = max(decode, key=lambda a: per_addr.get(a, 0))
+    interrupted = [r for r in streams if r.addr == victim]
+    plan.kill(victim)
+    victim_node = by_addr[victim]
+    for plane in kill_planes(victim_node):
+        plane.close()  # the whole process dies: its planes die with it
+    victim_node.close()
+    t_kill = _time.monotonic()
+
+    # -- the recovery plane drives every stream to completion -----------
+    hit_acct = {"replayed": 0, "cached": 0, "measured": set()}
+    route_stats = {"failover": 0}
+
+    def route_fn(key, exclude):
+        res = cr.cache_aware_route(key, exclude=exclude)
+        if res.decode_failover:
+            route_stats["failover"] += 1
+        return res.decode_addr
+
+    def serve_fn(addr, rec, hop_deadline_s):
+        deadline = _time.monotonic() + hop_deadline_s
+        while len(rec.delivered) < crash_tokens:
+            if plan.is_killed(addr):
+                # A killed process stops acking: the edge sees silence
+                # until its per-hop timer fires — THE fast trigger.
+                wait = deadline - _time.monotonic()
+                if wait > 0:
+                    _time.sleep(wait)
+                raise HopTimeout(f"no progress from {addr}")
+            if rec.resurrections and rec.rid not in hit_acct["measured"]:
+                # Resume prefill: measure the surviving replica's cached
+                # coverage of prompt+delivered BEFORE re-inserting it.
+                hit_acct["measured"].add(rec.rid)
+                rkey = rec.resume_key()
+                hit_acct["replayed"] += len(rkey)
+                hit_acct["cached"] += int(
+                    by_addr[addr].match_prefix(rkey).length
+                )
+            emit_one(rec)
+
+    failed = 0
+    reports = []
+    for rec in streams:
+        try:
+            reports.append(coord.run_to_completion(rec, route_fn, serve_fn))
+        except Exception:  # noqa: BLE001 — failures are the measurement
+            failed += 1
+    detect_s = (
+        None
+        if detect_t["first"] is None
+        else round(detect_t["first"] - t_kill, 3)
+    )
+
+    # Byte-identical resume: every final stream must equal the
+    # deterministic expectation token-for-token — a resumed stream that
+    # re-emitted, skipped, or reordered a token breaks this.
+    prefix_identical = all(
+        rec.delivered == [token_of(rec.seed, i) for i in range(crash_tokens)]
+        for rec in streams
+        if not rec.failed
+    )
+    resumed = sum(1 for r in interrupted if r.done and r.resurrections)
+    max_overrun = max((r.budget.overrun_s() for r in streams), default=0.0)
+    max_backoff = max((r.max_backoff_s for r in streams), default=0.0)
+    within_budget = all(r.overrun_within_one_backoff() for r in streams)
+
+    # -- hedged-prefill drill: straggler duplicated, first-writer-wins --
+    h_prompt = rng.integers(0, 600, size=16).astype(np.int32)
+    h_rec = coord.admit(h_prompt, deadline_s=crash_deadline_s)
+    survivors_p = [a for a in prefill if a in by_addr and not plan.is_killed(a)]
+    straggler, backup = survivors_p[0], survivors_p[1]
+    cancelled = []
+
+    def slow_leg():
+        # A straggling prefill: well past the hedge threshold.
+        _time.sleep(4 * policy.hedge_after_s)
+        by_addr[straggler].insert(
+            h_prompt, np.arange(len(h_prompt), dtype=np.int32)
+        )
+        return straggler
+
+    def fast_leg():
+        by_addr[backup].insert(
+            h_prompt, np.arange(len(h_prompt), dtype=np.int32)
+        )
+        return backup
+
+    hedge_out = coord.hedged(
+        h_rec,
+        (straggler, slow_leg, lambda: cancelled.append(straggler)),
+        (backup, fast_leg, lambda: cancelled.append(backup)),
+    )
+    coord.finish(h_rec)
+
+    replayed = max(1, hit_acct["replayed"])
+    return {
+        "performed": True,
+        "node": victim,
+        "drop_p": drop_p,
+        "streams": crash_streams,
+        "tokens_per_stream": crash_tokens,
+        "killed_at_token": half,
+        "interrupted": len(interrupted),
+        "resumed": resumed,
+        "failed": failed,
+        "prefix_identical": bool(prefix_identical),
+        "replayed_tokens": int(hit_acct["replayed"]),
+        "replayed_cached_tokens": int(hit_acct["cached"]),
+        "resurrection_hit_ratio": round(hit_acct["cached"] / replayed, 4),
+        "retries": int(sum(r["retries"] for r in reports)),
+        "resurrections": int(sum(r["resurrections"] for r in reports)),
+        "failover_routes": int(route_stats["failover"]),
+        "detection": {
+            "trigger": "hop_timeout",
+            "hop_timeout_s": policy.hop_timeout_s,
+            "detect_s": detect_s,
+        },
+        "budget": {
+            "deadline_s": crash_deadline_s,
+            "max_overrun_s": round(max_overrun, 4),
+            "max_backoff_s": round(max_backoff, 4),
+            "within_one_backoff": bool(within_budget),
+        },
+        "hedge": {
+            "fired": bool(hedge_out["hedged"]),
+            "winner": hedge_out["winner"],
+            "first_writer_wins": hedge_out["winner"] == backup,
+            "loser_cancelled": bool(hedge_out["loser_cancelled"]),
+        },
+        "crash_s": round(_time.monotonic() - t_phase, 3),
+    }
+
+
 def run_chaos_workload(
     drop_p: float = 0.2,
     partition_s: float = 10.0,
@@ -1005,6 +1253,10 @@ def run_chaos_workload(
     join_partition_s: float = 1.5,
     bootstrap_probe_interval_s: float = 0.25,
     bootstrap_round_budget: int = 16,
+    crash: bool = True,
+    crash_streams: int = 12,
+    crash_tokens: int = 24,
+    crash_deadline_s: float = 20.0,
 ) -> dict:
     """The chaos acceptance scenario (``bench.validate_chaos`` pins its
     artifact): a seeded FaultPlan injects ``drop_p`` frame loss across
@@ -1042,6 +1294,20 @@ def run_chaos_workload(
        it (hash-ring fallback only) until its fingerprint converges
        with the donor — within the bootstrap round budget.
 
+    With ``crash`` (the request-recovery gates, ``server/recovery.py``)
+    a final unclean-death phase follows:
+
+    7. **Crash mid-decode.** Live streams decode on both decode nodes
+       under re-opened 20% loss; one decode node is process-KILLED
+       (stops serving AND acking — ``FaultPlan.kill``). The edge's
+       per-hop timeout detects it, every interrupted stream resurrects
+       on the surviving node via the router's failover path (longest
+       cached prefix over prompt+delivered), resumes byte-identically
+       with ≥ 0.8 of replayed tokens served from cache, zero failures,
+       and every recovery hop bounded by the admission deadline budget;
+       a hedged-prefill drill (first-writer-wins, loser cancelled) runs
+       in the same window.
+
     Deterministic by seeding: the FaultPlan's per-edge RNGs and the
     request stream derive from ``seed``; waits are deadline-bounded
     polls, never bare sleeps asserting timing."""
@@ -1069,7 +1335,12 @@ def run_chaos_workload(
     # Three prefills: cp1 takes the phase-1 (and phase-6) partition;
     # cp2 is the drain/rejoin subject — its ring paths to the master
     # and its donor avoid cp1, so a join can START under the partition.
-    prefill, decode, router_addrs = ["cp0", "cp1", "cp2"], ["cd0"], ["cr0"]
+    # TWO decodes: cd1 (or whichever serves more live streams) is the
+    # phase-7 unclean-kill victim, and its sibling is the survivor the
+    # recovery plane resurrects interrupted streams onto.
+    prefill, decode, router_addrs = (
+        ["cp0", "cp1", "cp2"], ["cd0", "cd1"], ["cr0"],
+    )
     partitioned = prefill[1]
     fault_end_s = partition_delay_s + partition_s
     plan = faults.FaultPlan(
@@ -1246,6 +1517,34 @@ def run_chaos_workload(
                     timeout_s=timeout_s,
                 )
 
+            # -- 7: unclean decode-node kill mid-stream ----------------
+            crash_report: dict = {"performed": False}
+            if crash:
+
+                def _kill_planes(node):
+                    planes = []
+                    if node in nodes:
+                        planes.append(repair_planes[nodes.index(node)])
+                    if node in ring:
+                        planes.append(fleet_planes[ring.index(node)])
+                    return planes
+
+                crash_report = _chaos_crash_phase(
+                    by_addr=by_addr,
+                    cr=cr,
+                    plan=plan,
+                    faults=faults,
+                    prefill=prefill,
+                    decode=decode,
+                    rng=rng,
+                    seed=seed,
+                    drop_p=drop_p,
+                    crash_streams=crash_streams,
+                    crash_tokens=crash_tokens,
+                    crash_deadline_s=crash_deadline_s,
+                    kill_planes=_kill_planes,
+                )
+
             repair_totals = {
                 k: sum(r.stats()[k] for r in repair_planes)
                 for k in (
@@ -1255,7 +1554,7 @@ def run_chaos_workload(
             }
             return {
                 "nodes": len({n.cfg.local_addr for n in nodes}),
-                "topology": "3 prefill + 1 decode + 1 router (inproc)",
+                "topology": "3 prefill + 2 decode + 1 router (inproc)",
                 "round_budget": round_budget,
                 "fault_plan": {
                     "seed": seed,
@@ -1293,6 +1592,7 @@ def run_chaos_workload(
                 },
                 "drain": drain_report,
                 "join": join_report,
+                "crash": crash_report,
                 "wall_s": round(_time.monotonic() - t_start, 3),
             }
     finally:

@@ -38,6 +38,8 @@ def run(
     seed: int,
     join_drain: bool = True,
     join_partition_s: float = 1.5,
+    crash: bool = True,
+    crash_streams: int = 12,
 ) -> dict:
     res = run_chaos_workload(
         drop_p=drop_p,
@@ -47,6 +49,8 @@ def run(
         seed=seed,
         join_drain=join_drain,
         join_partition_s=join_partition_s,
+        crash=crash,
+        crash_streams=crash_streams,
     )
     report = bench.build_chaos_report(res)
     problems = bench.validate_chaos(report)
@@ -71,12 +75,27 @@ def main() -> int:
         "--join-partition", type=float, default=1.5, metavar="SECONDS",
         help="partition window the rejoin starts under",
     )
+    crash_group = ap.add_mutually_exclusive_group()
+    crash_group.add_argument(
+        "--crash", dest="crash", action="store_true", default=True,
+        help="run the unclean decode-node kill phase (request "
+        "resurrection from the replicated cache; default on)",
+    )
+    crash_group.add_argument(
+        "--no-crash", dest="crash", action="store_false",
+        help="skip the crash phase",
+    )
+    ap.add_argument(
+        "--crash-streams", type=int, default=12,
+        help="live streams decoding when the kill lands",
+    )
     ap.add_argument("--out", default=None, help="also write the JSON here")
     args = ap.parse_args()
     report = run(
         args.drop_p, args.partition, args.requests, args.round_budget,
         args.seed, join_drain=not args.no_join_drain,
         join_partition_s=args.join_partition,
+        crash=args.crash, crash_streams=args.crash_streams,
     )
     line = json.dumps(report)
     print(line)

@@ -385,6 +385,28 @@ class TestChaosArtifactSchema:
                 "hits_to_bootstrapping": 0, "post_bootstrap_hits": 6,
                 "fleet_converged_after_join": True, "join_s": 2.0,
             },
+            "crash": {
+                "performed": True, "node": "cd0", "drop_p": 0.2,
+                "streams": 12, "tokens_per_stream": 24,
+                "killed_at_token": 12, "interrupted": 10, "resumed": 10,
+                "failed": 0, "prefix_identical": True,
+                "replayed_tokens": 280, "replayed_cached_tokens": 268,
+                "resurrection_hit_ratio": 0.957, "retries": 10,
+                "resurrections": 10, "failover_routes": 10,
+                "detection": {
+                    "trigger": "hop_timeout", "hop_timeout_s": 0.4,
+                    "detect_s": 0.4,
+                },
+                "budget": {
+                    "deadline_s": 20.0, "max_overrun_s": 0.0,
+                    "max_backoff_s": 0.06, "within_one_backoff": True,
+                },
+                "hedge": {
+                    "fired": True, "winner": "cp1",
+                    "first_writer_wins": True, "loser_cancelled": True,
+                },
+                "crash_s": 9.2,
+            },
             "wall_s": 14.7,
         }
 
@@ -398,12 +420,14 @@ class TestChaosArtifactSchema:
         del report["quiescence"]["quiet"]
         del report["drain"]["writeback_tokens"]
         del report["join"]["bootstrap_rounds"]
+        del report["crash"]["resurrection_hit_ratio"]
         missing = bench.validate_chaos(report)
         assert "round_budget" in missing
         assert "repair.converge_s" in missing
         assert "quiescence.quiet" in missing
         assert "drain.writeback_tokens" in missing
         assert "join.bootstrap_rounds" in missing
+        assert "crash.resurrection_hit_ratio" in missing
 
     def test_acceptance_gates_enforced(self):
         report = self._report()
@@ -441,19 +465,60 @@ class TestChaosArtifactSchema:
         assert "routed cache hits to a BOOTSTRAPPING node" in problems
         assert "never withheld a hit" in problems
 
+    def test_crash_gates_enforced(self):
+        """The PR 7 request-recovery gates: a kill that lost requests,
+        a resume that corrupted the delivered prefix, a replay the cache
+        didn't serve, a budget overrun past one backoff, or a hedge that
+        broke first-writer-wins must all be named violations."""
+        report = self._report()
+        report["crash"]["failed"] = 2
+        report["crash"]["resumed"] = 8
+        report["crash"]["prefix_identical"] = False
+        report["crash"]["resurrection_hit_ratio"] = 0.5
+        report["crash"]["budget"]["within_one_backoff"] = False
+        report["crash"]["hedge"]["first_writer_wins"] = False
+        report["crash"]["hedge"]["loser_cancelled"] = False
+        problems = "\n".join(bench.validate_chaos(report))
+        assert "LOST to the unclean kill" in problems
+        assert "not all resurrected" in problems
+        assert "prefix not byte-identical" in problems
+        assert "below 0.8" in problems
+        assert "more than one retry backoff" in problems
+        assert "first successful writer did not win" in problems
+        assert "loser was not cancelled" in problems
+
+    def test_crash_must_interrupt_something(self):
+        """A kill that interrupted zero live streams proves nothing —
+        the gate refuses vacuous passes."""
+        report = self._report()
+        report["crash"]["interrupted"] = 0
+        report["crash"]["resumed"] = 0
+        problems = "\n".join(bench.validate_chaos(report))
+        assert "interrupted zero live streams" in problems
+
     def test_v1_artifact_without_lifecycle_sections_stays_valid(self):
         """CHAOS_r06 predates the join/drain sections: v1 artifacts must
         keep validating (version bumps add, never break)."""
         report = self._report()
         del report["drain"]
         del report["join"]
+        del report["crash"]
         report["schema_version"] = 1
+        assert bench.validate_chaos(report) == []
+
+    def test_v2_artifact_without_crash_section_stays_valid(self):
+        """CHAOS_r07 predates the crash section: v2 artifacts must keep
+        validating with the join/drain sections but no crash."""
+        report = self._report()
+        del report["crash"]
+        report["schema_version"] = 2
         assert bench.validate_chaos(report) == []
 
     def test_skipped_phase_is_schema_valid_but_gate_exempt(self):
         report = self._report()
         report["drain"] = {"performed": False}
         report["join"] = {"performed": False}
+        report["crash"] = {"performed": False}
         assert bench.validate_chaos(report) == []
 
     def test_build_report_matches_schema(self):
@@ -462,7 +527,7 @@ class TestChaosArtifactSchema:
             for k in (
                 "nodes", "topology", "round_budget", "fault_plan", "served",
                 "divergence", "repair", "quiescence", "drain", "join",
-                "wall_s",
+                "crash", "wall_s",
             )
         }
         report = bench.build_chaos_report(res)

@@ -148,6 +148,69 @@ class TestExtensionKindRegistration:
         )
 
 
+class TestTimeoutAudit:
+    """Satellite lint (PR 7, crash tolerance): no product module may
+    park a thread on a blocking ``wait()``/``join()``/``get()`` WITHOUT
+    a timeout/deadline argument — unbounded waits are how a crashed
+    peer wedges a thread forever (the exact failure mode the recovery
+    plane's per-hop timeouts exist to bound). The few intentionally
+    unbounded seams are allowlisted BY FILE with the reason; an entry
+    that stops matching fails the positive control so the allowlist
+    can't rot."""
+
+    # file (relative to the package) → why an unbounded blocking call
+    # is legitimate THERE.
+    ALLOWLIST = {
+        # Pallas device semaphores/copy descriptors: `.wait()` here is a
+        # kernel DSL op completing an async device copy, not a thread
+        # parking on a peer.
+        "ops/paged_attention.py": "pallas device semaphore waits",
+        # The inproc hub's delivery pump blocks on its own queue and is
+        # woken by a None shutdown sentinel — no peer involved.
+        "comm/inproc.py": "sentinel-shutdown hub queue pump",
+        # The chaos scheduler's condition wait is notified by every
+        # submit and exists only under an armed fault plan.
+        "comm/faults.py": "chaos scheduler condition, notified per submit",
+    }
+
+    _BLOCKING = re.compile(r"\.(wait|join|get)\(\s*\)")
+
+    def _product_sources(self):
+        import pathlib
+
+        import radixmesh_tpu
+
+        pkg = pathlib.Path(radixmesh_tpu.__file__).parent
+        for path in sorted(pkg.rglob("*.py")):
+            yield path.relative_to(pkg).as_posix(), path.read_text()
+
+    def test_no_unbounded_blocking_calls_outside_allowlist(self):
+        offenders = []
+        for rel, src in self._product_sources():
+            if rel in self.ALLOWLIST:
+                continue
+            for m in self._BLOCKING.finditer(src):
+                line = src[: m.start()].count("\n") + 1
+                offenders.append(f"{rel}:{line}: {m.group(0)!r}")
+        assert not offenders, (
+            "blocking wait()/join()/get() without a timeout/deadline "
+            "argument (a dead peer wedges this thread forever — pass a "
+            "timeout or add a justified allowlist entry):\n"
+            + "\n".join(offenders)
+        )
+
+    def test_allowlist_entries_still_match(self):
+        """Positive control: every allowlisted file still contains the
+        pattern it is excused for — stale entries must be pruned."""
+        sources = dict(self._product_sources())
+        for rel in self.ALLOWLIST:
+            assert rel in sources, f"allowlisted file {rel} vanished"
+            assert self._BLOCKING.search(sources[rel]), (
+                f"allowlist entry {rel} no longer matches any unbounded "
+                "blocking call — remove it"
+            )
+
+
 class TestLifecycleStateOwnership:
     """Satellite lint: lifecycle state has ONE writer. A module that
     could flip a node to ACTIVE mid-bootstrap (or un-drain it) would

@@ -62,6 +62,7 @@ def _register_all_instrumented_families() -> None:
         )
 
     from radixmesh_tpu.policy.lifecycle import LifecyclePlane
+    from radixmesh_tpu.server.recovery import RecoveryCoordinator
 
     pd_mesh = MeshCache(
         mesh_cfg("p0"),
@@ -70,6 +71,9 @@ def _register_all_instrumented_families() -> None:
     LifecyclePlane(pd_mesh)  # registers the lifecycle state/transition families
     router_mesh = MeshCache(mesh_cfg("r0"))
     CacheAwareRouter(router_mesh, router_mesh.cfg)
+    # Request-recovery plane (server/recovery.py): registers the
+    # retries/resurrections/hedges counters + recovery histogram.
+    RecoveryCoordinator(name="lint-edge")
 
 
 def _registered_families() -> dict[str, str]:
@@ -121,6 +125,67 @@ class TestMetricHygiene:
         # epoch 0, both ring members alive.
         assert snap['radixmesh_mesh_alive_nodes{node="prefill@0"}'] == 2.0
         assert snap['radixmesh_mesh_view_epoch{node="prefill@0"}'] == 0.0
+
+    def test_request_recovery_families_registered(self):
+        """Satellite (PR 7): the request-recovery plane's counters and
+        its recovery-latency histogram are first-class metric families —
+        a crash drill leaves auditable series, not just logs."""
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        for name in (
+            "radixmesh_request_retries_total",
+            "radixmesh_request_resurrections_total",
+            "radixmesh_request_hedges_total",
+        ):
+            assert fams.get(name) == "counter", (name, sorted(fams))
+        assert (
+            fams.get("radixmesh_request_recovery_seconds") == "histogram"
+        )
+
+    def test_recovery_span_names_recorded(self):
+        """The ``resurrect`` and ``hedge`` spans land on the edge's
+        recorder lane — the flight recorder shows a crash drill's
+        timeline, same contract as every other plane's spans."""
+        import numpy as np
+
+        from radixmesh_tpu.obs.trace_plane import (
+            FlightRecorder,
+            get_recorder,
+            set_recorder,
+        )
+        from radixmesh_tpu.server.recovery import (
+            NodeDied,
+            RecoveryCoordinator,
+        )
+
+        prev = get_recorder()
+        set_recorder(FlightRecorder(capacity=256, sample=1.0))
+        try:
+            coord = RecoveryCoordinator(name="span-edge", seed=0)
+            rec = coord.admit(np.arange(4), deadline_s=5.0)
+
+            def route(key, exclude):
+                return "b" if "a" in exclude else "a"
+
+            def serve(addr, record, hop):
+                if addr == "a":
+                    record.deliver(1)
+                    raise NodeDied("chaos")
+                record.deliver(2)
+
+            coord.run_to_completion(rec, route, serve)
+            h = coord.admit(np.arange(3), deadline_s=5.0)
+            coord.hedged(
+                h,
+                ("a", lambda: (__import__("time").sleep(0.3), "p")[1],
+                 lambda: None),
+                ("b", lambda: "s", lambda: None),
+                hedge_after_s=0.05,
+            )
+            names = {s.name for s in get_recorder().snapshot()}
+            assert {"resurrect", "hedge"} <= names, names
+        finally:
+            set_recorder(prev)
 
     def test_eviction_counters_labeled_by_cause(self):
         """Satellite (PR 3): eviction counters carry a cause label —

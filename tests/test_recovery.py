@@ -1,0 +1,851 @@
+"""Crash-tolerant serving (PR 7): the request-recovery plane.
+
+Covers the resurrection edge cases the tentpole names — crash while a
+request is parked in RESTORING (the PR 4 ticket must release), crash of
+the hedged winner before the loser is cancelled, double-crash (the
+resurrected request's new node dies too), and resume-replay determinism
+(same seed ⇒ identical continuation) — plus the retry/budget policy
+math, the router's failover path, the faults plane's process kill, and
+the mesh ``cause=dead`` trigger.
+
+Deflake contract: every coordinator test injects its own clock/sleep or
+uses deadline-bounded waits; the seeded-replay tests derive everything
+from fixed seeds."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.policy.retry import (
+    DeadlineBudget,
+    RecoveryRecord,
+    RetryPolicy,
+    jittered_retry_after,
+)
+from radixmesh_tpu.server.recovery import (
+    BudgetExhausted,
+    HopTimeout,
+    NodeDied,
+    RecoveryCoordinator,
+)
+
+pytestmark = pytest.mark.quick
+
+
+class TestRetryPolicy:
+    def test_backoff_grows_and_caps(self):
+        p = RetryPolicy(
+            backoff_base_s=0.1, backoff_factor=2.0, backoff_max_s=0.5,
+            jitter_frac=0.0,
+        )
+        rng = np.random.default_rng(0)
+        backs = [p.backoff_s(a, rng) for a in range(1, 6)]
+        assert backs[:3] == [0.1, 0.2, 0.4]
+        assert backs[3] == backs[4] == 0.5  # capped
+
+    def test_jitter_is_bounded(self):
+        p = RetryPolicy(
+            backoff_base_s=1.0, backoff_factor=1.0, backoff_max_s=1.0,
+            jitter_frac=0.25,
+        )
+        rng = np.random.default_rng(7)
+        for _ in range(200):
+            b = p.backoff_s(1, rng)
+            assert 0.75 <= b <= 1.25
+
+    def test_jittered_retry_after_bounds_and_spread(self):
+        rng = np.random.default_rng(3)
+        vals = [jittered_retry_after(2.0, rng) for _ in range(100)]
+        assert all(1.5 <= v <= 2.5 for v in vals)
+        assert len({round(v, 6) for v in vals}) > 50  # actually spreads
+        assert jittered_retry_after(0.0, rng) == 0.0  # passthrough
+
+    def test_budget_clamps_every_hop(self):
+        t = {"now": 100.0}
+        b = DeadlineBudget(2.0, clock=lambda: t["now"])
+        assert b.clamp(5.0) == 2.0
+        t["now"] = 101.5
+        assert b.clamp(5.0) == pytest.approx(0.5)
+        assert not b.expired()
+        t["now"] = 102.5
+        assert b.expired()
+        assert b.clamp(5.0) == 0.0
+        assert b.overrun_s() == pytest.approx(0.5)
+
+    def test_no_deadline_means_infinite_budget(self):
+        b = DeadlineBudget(None)
+        assert b.remaining() == float("inf")
+        assert not b.expired()
+        assert b.clamp(3.0) == 3.0
+        assert b.overrun_s() == 0.0
+
+    def test_record_resume_key_is_prompt_plus_delivered(self):
+        r = RecoveryRecord(rid=1, prompt=np.arange(4, dtype=np.int32))
+        assert list(r.resume_key()) == [0, 1, 2, 3]
+        r.deliver(9)
+        r.deliver(8)
+        assert list(r.resume_key()) == [0, 1, 2, 3, 9, 8]
+
+    def test_overrun_within_one_backoff_gate(self):
+        t = {"now": 0.0}
+        r = RecoveryRecord(
+            rid=1,
+            prompt=np.arange(2, dtype=np.int32),
+            budget=DeadlineBudget(1.0, clock=lambda: t["now"]),
+        )
+        r.max_backoff_s = 0.2
+        t["now"] = 1.1  # 0.1 over: within the 0.2 backoff
+        assert r.overrun_within_one_backoff()
+        t["now"] = 1.5  # 0.5 over: past it
+        assert not r.overrun_within_one_backoff()
+
+
+def _coord(**kw):
+    kw.setdefault(
+        "policy",
+        RetryPolicy(
+            hop_timeout_s=0.5, max_retries=4, backoff_base_s=0.001,
+            backoff_max_s=0.005, hedge_after_s=0.05,
+        ),
+    )
+    kw.setdefault("sleep", lambda s: None)  # virtual backoff: no waits
+    return RecoveryCoordinator(name=kw.pop("name", "test-edge"), **kw)
+
+
+class TestResurrectionLoop:
+    def test_failover_resumes_with_delivered_prefix_intact(self):
+        coord = _coord()
+        rec = coord.admit([1, 2, 3], deadline_s=10.0)
+
+        def route(key, exclude):
+            return "b" if "a" in exclude else "a"
+
+        def serve(addr, record, hop):
+            if addr == "a":
+                record.deliver(7)
+                raise NodeDied("unclean death")
+            # The resumed hop sees the delivered prefix and extends it.
+            assert record.delivered == [7]
+            assert list(record.resume_key()) == [1, 2, 3, 7]
+            record.deliver(8)
+
+        rep = coord.run_to_completion(rec, route, serve)
+        assert rec.delivered == [7, 8]
+        assert rep["retries"] == 1 and rep["resurrections"] == 1
+        assert rep["addrs"] == ["a", "b"]
+        assert "a" in coord.dead_addrs
+        assert rec.done and not rec.failed
+        assert rec.rid not in coord.records  # finished records unregister
+
+    def test_double_crash_survives(self):
+        """The resurrected request's NEW node also dies: the loop must
+        resurrect a second time and still lose nothing."""
+        coord = _coord()
+        rec = coord.admit([1, 2], deadline_s=10.0)
+        order = iter(["a", "b", "c"])
+        plan = {"a": True, "b": True, "c": False}  # True = dies mid-hop
+
+        def route(key, exclude):
+            return next(order)
+
+        def serve(addr, record, hop):
+            record.deliver(len(record.delivered))
+            if plan[addr]:
+                raise NodeDied(f"{addr} died")
+
+        rep = coord.run_to_completion(rec, route, serve)
+        assert rep["resurrections"] == 2
+        assert coord.dead_addrs == {"a", "b"}
+        # One token per hop, each exactly once: no re-emission, no loss.
+        assert rec.delivered == [0, 1, 2]
+
+    def test_pinned_record_resurrects_without_own_timeout(self):
+        """Failure detection that fired elsewhere (view change, sibling
+        hop timeout) makes a pinned record resurrect immediately."""
+        coord = _coord()
+        rec = coord.admit([5], deadline_s=10.0)
+        rec.deliver(1)
+        rec.addr = "a"
+        coord.declare_dead("a", cause="view_dead")
+        served = []
+
+        def route(key, exclude):
+            assert "a" in exclude
+            return "b"
+
+        def serve(addr, record, hop):
+            served.append(addr)
+            record.deliver(2)
+
+        rep = coord.run_to_completion(rec, route, serve)
+        assert served == ["b"]
+        assert rep["resurrections"] == 1 and rec.delivered == [1, 2]
+
+    def test_budget_exhaustion_bounds_the_retry_tail(self):
+        t = {"now": 0.0}
+        coord = _coord(clock=lambda: t["now"])
+        rec = coord.admit([1], deadline_s=1.0)
+
+        def route(key, exclude):
+            return "x"
+
+        def serve(addr, record, hop):
+            t["now"] += 2.0  # the hop burns past the whole budget
+            raise NodeDied("dead")
+
+        with pytest.raises(BudgetExhausted):
+            coord.run_to_completion(rec, route, serve)
+        assert rec.failed
+        # The FAILED episode still lands in the recovery histogram (a
+        # death was detected before the budget ran out).
+        from radixmesh_tpu.obs.metrics import get_registry
+
+        snap = get_registry().snapshot()
+        assert (
+            snap.get(
+                'radixmesh_request_recovery_seconds{node="test-edge"}_count',
+                0,
+            )
+            >= 1
+        ), sorted(k for k in snap if "recovery_seconds" in k)
+
+    def test_retry_cap_bounds_the_tail_without_deadline(self):
+        coord = _coord()
+        rec = coord.admit([1])  # no deadline: the cap is the bound
+
+        def serve(addr, record, hop):
+            raise NodeDied("always")
+
+        addrs = iter("abcdefgh")
+        with pytest.raises(BudgetExhausted, match="retries exhausted"):
+            coord.run_to_completion(rec, lambda k, e: next(addrs), serve)
+
+    def test_no_surviving_node_is_a_bounded_failure(self):
+        coord = _coord()
+        rec = coord.admit([1], deadline_s=5.0)
+        with pytest.raises(BudgetExhausted, match="no surviving node"):
+            coord.run_to_completion(
+                rec, lambda k, e: None, lambda a, r, h: None
+            )
+        assert rec.failed
+
+    def test_hop_deadline_is_budget_clamped(self):
+        t = {"now": 0.0}
+        coord = _coord(clock=lambda: t["now"])
+        rec = coord.admit([1], deadline_s=0.3)
+        # hop_timeout 0.5 > remaining 0.3: the hop gets 0.3.
+        assert coord.hop_deadline_s(rec) == pytest.approx(0.3)
+        t["now"] = 0.2
+        assert coord.hop_deadline_s(rec) == pytest.approx(0.1)
+
+    def test_watch_mesh_declares_view_dead_ranks(self):
+        """The mesh's cause=dead successor transition (a view losing a
+        rank) is the ring-side resurrection trigger."""
+
+        class FakeView:
+            def __init__(self, alive):
+                self.alive = alive
+
+        class FakeMesh:
+            def __init__(self):
+                self.on_view_change = []
+
+        coord = _coord()
+        mesh = FakeMesh()
+        coord.watch_mesh(mesh, addr_of_rank=lambda r: f"node{r}")
+        rec = coord.admit([1], deadline_s=5.0)
+        rec.addr = "node2"
+        dead_events = []
+        coord.on_node_dead.append(lambda a, c: dead_events.append((a, c)))
+        mesh.on_view_change[0](FakeView({0, 1, 2}), FakeView({0, 1}))
+        assert "node2" in coord.dead_addrs
+        assert dead_events == [("node2", "view_dead")]
+        assert coord.pinned_to("node2") == [rec]
+        # Ring membership is reversible: the rank coming BACK into the
+        # view revives the address — dead_addrs must not accumulate
+        # across partition/heal cycles until a healthy fleet reads as
+        # "no surviving node".
+        mesh.on_view_change[0](FakeView({0, 1}), FakeView({0, 1, 2}))
+        assert "node2" not in coord.dead_addrs
+
+
+class TestHedging:
+    def test_straggler_hedged_first_writer_wins_loser_cancelled(self):
+        coord = _coord()
+        rec = coord.admit([1], deadline_s=10.0)
+        cancelled = []
+
+        def slow():
+            time.sleep(0.4)
+            return "slow"
+
+        out = coord.hedged(
+            rec,
+            ("n1", slow, lambda: cancelled.append("n1")),
+            ("n2", lambda: "fast", lambda: cancelled.append("n2")),
+            hedge_after_s=0.05,
+        )
+        assert out["result"] == "fast" and out["winner"] == "n2"
+        assert out["hedged"] and out["loser_cancelled"]
+        assert cancelled == ["n1"]
+        assert rec.hedges == 1
+
+    def test_fast_primary_never_hedges(self):
+        coord = _coord()
+        rec = coord.admit([1], deadline_s=10.0)
+        out = coord.hedged(
+            rec,
+            ("n1", lambda: "quick", lambda: None),
+            ("n2", lambda: "never", lambda: None),
+            hedge_after_s=0.5,
+        )
+        assert out["result"] == "quick" and not out["hedged"]
+        assert rec.hedges == 0
+
+    def test_hedged_winner_crashes_before_loser_cancelled(self):
+        """The edge case: the provisional leader (primary, ahead in the
+        race) CRASHES after the hedge fired but before any cancel — the
+        trailing leg must be adopted, not cancelled."""
+        coord = _coord()
+        rec = coord.admit([1], deadline_s=10.0)
+        cancelled = []
+
+        def crashing_leader():
+            time.sleep(0.1)  # past the hedge threshold, then dies
+            raise NodeDied("winner crashed mid-completion")
+
+        def trailing():
+            time.sleep(0.3)
+            return "adopted"
+
+        out = coord.hedged(
+            rec,
+            ("n1", crashing_leader, lambda: cancelled.append("n1")),
+            ("n2", trailing, lambda: cancelled.append("n2")),
+            hedge_after_s=0.05,
+        )
+        assert out["result"] == "adopted" and out["winner"] == "n2"
+        # The trailing (winning) leg was never cancelled.
+        assert "n2" not in cancelled
+
+    def test_primary_failure_fires_hedge_immediately(self):
+        coord = _coord()
+        rec = coord.admit([1], deadline_s=10.0)
+
+        def dead_primary():
+            raise NodeDied("instant death")
+
+        out = coord.hedged(
+            rec,
+            ("n1", dead_primary, lambda: None),
+            ("n2", lambda: "rescue", lambda: None),
+            hedge_after_s=5.0,  # would never fire on time alone
+        )
+        assert out["result"] == "rescue" and out["hedged"]
+
+    def test_hedge_deadline_cancels_both_legs(self):
+        """Abandoning a hedged hop at its deadline must cancel every
+        started leg — two slow prefills left running would hold batch
+        rows and pages for a request the edge gave up on."""
+        coord = _coord(
+            policy=RetryPolicy(
+                hop_timeout_s=0.05, max_retries=2, hedge_after_s=0.02
+            )
+        )
+        rec = coord.admit([1], deadline_s=10.0)
+        cancelled = []
+
+        def glacial():
+            time.sleep(2.0)
+            return "too late"
+
+        with pytest.raises(HopTimeout):
+            coord.hedged(
+                rec,
+                ("n1", glacial, lambda: cancelled.append("n1")),
+                ("n2", glacial, lambda: cancelled.append("n2")),
+                hedge_after_s=0.02,
+            )
+        assert sorted(cancelled) == ["n1", "n2"]
+
+    def test_all_legs_dead_raises(self):
+        coord = _coord()
+        rec = coord.admit([1], deadline_s=10.0)
+
+        def die():
+            raise NodeDied("dead")
+
+        with pytest.raises(NodeDied, match="all hedge legs failed"):
+            coord.hedged(
+                rec,
+                ("n1", die, lambda: None),
+                ("n2", die, lambda: None),
+                hedge_after_s=0.01,
+            )
+        assert rec.failed
+
+
+class TestFaultsProcessKill:
+    def test_kill_blackholes_inbound_and_raises_outbound(self):
+        from radixmesh_tpu.comm import faults as F
+
+        class Rec:
+            def __init__(self):
+                self.got = []
+
+            def send(self, d):
+                self.got.append(d)
+
+            def try_send(self, d, t):
+                self.got.append(d)
+                return True
+
+            def retarget(self, a): ...
+            def connected(self):
+                return True
+
+            def register_rcv_callback(self, fn): ...
+            def is_ordered(self):
+                return True
+
+            def target_address(self):
+                return self._t
+
+            def close(self): ...
+
+        plan = F.FaultPlan(seed=0)
+        clock = F._Clock(time.monotonic)
+        inner_ab, inner_ba = Rec(), Rec()
+        inner_ab._t, inner_ba._t = "b", "a"
+        ab = F.FaultyCommunicator(inner_ab, plan, src="a", dst="b", clock=clock)
+        ba = F.FaultyCommunicator(inner_ba, plan, src="b", dst="a", clock=clock)
+        ab.send(b"x")  # healthy both ways first
+        ba.send(b"y")
+        plan.kill("b")
+        # Inbound to the killed process: blackholed (try_send blocks out
+        # its timeout and fails — a peer that stopped acking).
+        t0 = time.monotonic()
+        assert ab.try_send(b"z", 0.05) is False
+        assert time.monotonic() - t0 >= 0.04
+        with pytest.raises(RuntimeError, match="killed"):
+            ab.send(b"z")
+        # Outbound FROM the killed process: a dead process sends nothing.
+        with pytest.raises(RuntimeError, match="killed"):
+            ba.send(b"w")
+        assert plan.counters.get("kills") == 1
+        assert plan.counters.get("killed_blocked", 0) >= 1
+        # The healthy deliveries landed before the kill, nothing after.
+        assert inner_ab.got == [b"x"]
+        assert inner_ba.got == [b"y"]
+
+    def test_kill_serializes_round_trip(self):
+        from radixmesh_tpu.comm.faults import FaultPlan
+
+        plan = FaultPlan(seed=3, drop_p=0.1)
+        plan.kill("cd1")
+        back = FaultPlan.from_dict(plan.to_dict())
+        assert back.is_killed("cd1") and not back.is_killed("cd0")
+
+
+class TestRouterFailover:
+    @pytest.fixture()
+    def cluster(self):
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.comm.inproc import InprocHub
+        from radixmesh_tpu.config import MeshConfig
+        from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+        InprocHub.reset_default()
+        prefill, decode, router = ["fp0", "fp1"], ["fd0", "fd1"], ["fr0"]
+        nodes = []
+        for addr in prefill + decode + router:
+            cfg = MeshConfig(
+                prefill_nodes=prefill,
+                decode_nodes=decode,
+                router_nodes=router,
+                local_addr=addr,
+                protocol="inproc",
+                tick_interval_s=0.1,
+                gc_interval_s=60.0,
+            )
+            nodes.append(MeshCache(cfg, pool=None).start())
+        for n in nodes:
+            assert n.wait_ready(timeout=15)
+        cr = CacheAwareRouter(nodes[-1], nodes[-1].cfg)
+        cr.finish_warm_up()
+        by_addr = {n.cfg.local_addr: n for n in nodes}
+        yield by_addr, cr
+        for n in nodes:
+            n.close()
+        InprocHub.reset_default()
+
+    def _wait_for_match(self, cr, key, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cr.cache_aware_route(key).match_len == len(key):
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_dead_writer_fails_over_with_match_len_kept(self, cluster):
+        by_addr, cr = cluster
+        key = np.arange(100, 116, dtype=np.int32)
+        by_addr["fd1"].insert(key, np.arange(16, dtype=np.int32))
+        assert self._wait_for_match(cr, key)
+        res = cr.cache_aware_route(key)
+        assert res.decode_addr == "fd1" and res.decode_cache_hit
+        # The writer dies: the same key must route AWAY with the match
+        # length preserved (the survivor replicates the prefix).
+        res = cr.cache_aware_route(key, exclude={"fd1"})
+        assert res.decode_addr == "fd0"
+        assert res.decode_failover and not res.decode_cache_hit
+        assert res.match_len == len(key)
+        # And the survivor really does hold it (replication).
+        assert by_addr["fd0"].match_prefix(key).length == len(key)
+
+    def test_excluded_addr_never_returned_even_as_fallback(self, cluster):
+        by_addr, cr = cluster
+        for _ in range(20):
+            key = np.random.default_rng(7).integers(
+                0, 500, size=8
+            ).astype(np.int32)
+            res = cr.cache_aware_route(key, exclude={"fd1"})
+            assert res.decode_addr != "fd1"
+
+    def test_everything_dead_returns_no_capacity(self, cluster):
+        _, cr = cluster
+        res = cr.cache_aware_route(
+            np.arange(8, dtype=np.int32), exclude={"fd0", "fd1"}
+        )
+        assert res.decode_addr is None  # caller surfaces "no capacity"
+
+    def test_matched_writer_dead_with_no_survivor_is_not_a_failover(
+        self, cluster
+    ):
+        """A failover that re-placed NOTHING must not read as one: no
+        failover flag, no preserved match_len — a total-outage window
+        must not dashboard as successful failovers."""
+        by_addr, cr = cluster
+        key = np.arange(300, 316, dtype=np.int32)
+        by_addr["fd1"].insert(key, np.arange(16, dtype=np.int32))
+        assert self._wait_for_match(cr, key)
+        res = cr.cache_aware_route(key, exclude={"fd0", "fd1"})
+        assert res.decode_addr is None
+        assert not res.decode_failover
+        assert res.match_len == 0
+
+
+class TestEngineResume:
+    @pytest.fixture(scope="class")
+    def tiny(self):
+        import jax
+
+        from radixmesh_tpu.models.llama import ModelConfig, init_params
+
+        cfg = ModelConfig.tiny()
+        return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+    def _engine(self, tiny, **kw):
+        from radixmesh_tpu.engine.engine import Engine
+
+        cfg, params = tiny
+        kw.setdefault("num_slots", 512)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("max_batch", 2)
+        return Engine(cfg, params, **kw)
+
+    def test_resume_admission_suppresses_reemission(self, tiny):
+        from radixmesh_tpu.engine.request import SamplingParams
+
+        eng = self._engine(tiny, name="resume-basic")
+        prompt = list(range(1, 30))
+        samp = SamplingParams(max_new_tokens=10)
+        first = eng.add_request(prompt, samp)
+        while eng.has_work():
+            eng.step()
+        full = first.generated
+        assert len(full) == 10
+        k = 4
+        resumed = eng.add_request(prompt, samp, resume_tokens=full[:k])
+        while eng.has_work():
+            eng.step()
+        # Only post-resume tokens emitted; the total output budget is
+        # conserved across lives.
+        assert resumed.resume_offset == k
+        assert len(resumed.generated) == 10 - k
+        # Greedy + same engine: the continuation replays exactly.
+        assert resumed.generated == full[k:]
+        assert eng.stats.resurrections == 1
+        # The first life published prompt+output: the replay is a hit.
+        assert eng.stats.replayed_tokens == len(prompt) + k
+        assert eng.stats.replayed_cached_tokens > 0
+
+    def test_seeded_resume_replay_determinism(self, tiny):
+        """Same seed ⇒ identical continuation, across crash points and
+        across ENGINES (the resurrected life runs on another node)."""
+        from radixmesh_tpu.engine.request import SamplingParams
+
+        prompt = list(range(1, 36))
+        samp = SamplingParams(
+            max_new_tokens=10, temperature=0.9, top_p=0.95, seed=4242
+        )
+        e1 = self._engine(tiny, name="replay-a")
+        first = e1.add_request(prompt, samp)
+        while e1.has_work():
+            e1.step()
+        full = first.generated
+        assert len(full) == 10
+        for k in (1, 5):
+            e2 = self._engine(tiny, name=f"replay-b{k}")
+            resumed = e2.add_request(prompt, samp, resume_tokens=full[:k])
+            while e2.has_work():
+                e2.step()
+            assert resumed.generated == full[k:], (
+                f"seeded continuation diverged at crash point {k}"
+            )
+
+    def test_resume_covering_full_budget_is_refused(self, tiny):
+        """resume_tokens that already cover max_new_tokens mean the
+        stream is complete: admitting would sample output past the
+        requested cap (the first life would never have drawn it)."""
+        from radixmesh_tpu.engine.request import SamplingParams
+
+        eng = self._engine(tiny, name="resume-full")
+        with pytest.raises(ValueError, match="already complete"):
+            eng.make_request(
+                list(range(1, 10)),
+                SamplingParams(max_new_tokens=4),
+                resume_tokens=[5, 6, 7, 8],
+            )
+
+    def test_high_seed_bits_matter(self, tiny):
+        """Seeds differing only above bit 43 must not collide (the key
+        derivation mixes the full 64-bit seed before folding in the
+        position)."""
+        from radixmesh_tpu.engine.request import SamplingParams
+
+        prompt = list(range(10, 40))
+        outs = []
+        for seed in (0, 1 << 44):
+            e = self._engine(tiny, name=f"hiseed-{seed}")
+            r = e.add_request(
+                prompt,
+                SamplingParams(
+                    max_new_tokens=12, temperature=1.0, seed=seed
+                ),
+            )
+            while e.has_work():
+                e.step()
+            outs.append(r.generated)
+        assert outs[0] != outs[1]
+
+    def test_different_seed_diverges(self, tiny):
+        """The determinism is the seed's, not an accident of greedy:
+        two seeds must (for a sampled temperature) draw differently."""
+        from radixmesh_tpu.engine.request import SamplingParams
+
+        prompt = list(range(50, 90))
+        outs = []
+        for seed in (1, 2):
+            e = self._engine(tiny, name=f"seed-{seed}")
+            r = e.add_request(
+                prompt,
+                SamplingParams(
+                    max_new_tokens=12, temperature=1.0, seed=seed
+                ),
+            )
+            while e.has_work():
+                e.step()
+            outs.append(r.generated)
+        assert outs[0] != outs[1]
+
+    def test_stream_publish_grows_prefix_mid_decode(self, tiny):
+        """``stream_publish_tokens``: the tree learns prompt+generated
+        WHILE the request decodes — what bounds a crash's resurrection
+        cost — not only at finish."""
+        from radixmesh_tpu.engine.request import SamplingParams
+
+        eng = self._engine(
+            tiny, name="stream-pub", stream_publish_tokens=2
+        )
+        prompt = list(range(1, 21))
+        req = eng.add_request(prompt, SamplingParams(max_new_tokens=8))
+        while len(req.output_tokens) < 5 and eng.has_work():
+            eng.step()
+        grown = np.concatenate(
+            [req.prompt, np.asarray(req.output_tokens[:2], np.int32)]
+        )
+        # The grown prefix is already matchable mid-stream (page
+        # alignment may truncate the tail token, never the prompt).
+        assert eng.tree.match_prefix(grown).length >= len(prompt)
+        while eng.has_work():
+            eng.step()
+
+    def test_crash_while_restoring_releases_ticket(self, tiny):
+        """A node 'crash' (teardown sweep) while a request is parked in
+        RESTORING: the PR 4 restore ticket must auto-release its
+        eviction shields — no leaked protection, and the record (zero
+        tokens delivered) retries cleanly elsewhere."""
+        from radixmesh_tpu.engine.request import RequestState, SamplingParams
+
+        eng = self._engine(
+            tiny,
+            name="restoring-crash",
+            host_cache_slots=1024,
+            kv_transfer_async=True,
+            kv_transfer_chunk_tokens=16,
+        )
+        try:
+            prompt = list(range(1, 120))
+            samp = SamplingParams(max_new_tokens=4)
+            eng.generate([prompt], samp)
+            assert eng.tree.evict(100_000) > 0
+            assert eng.kv_transfer.wait_host_ready()
+            barrier = threading.Event()
+            eng.kv_transfer.stage_barrier = barrier
+            parked = eng.add_request(prompt, samp)
+            for _ in range(3):
+                eng.step()
+            assert parked.state is RequestState.RESTORING
+            # The crash: the teardown sweep cancels everything in
+            # flight (what a dying process's last gasp — or the
+            # recovery plane's cancel-on-dead — does).
+            assert eng.cancel_all() == 1
+            assert parked.state is RequestState.FINISHED
+            barrier.set()
+            eng.kv_transfer.stage_barrier = None
+            deadline = time.monotonic() + 10
+            while eng.has_work() and time.monotonic() < deadline:
+                eng.step()
+            # The ticket drained and released its shields: nothing
+            # stays protected, nothing leaked.
+            assert eng.tree.protected_size_ == 0
+            # Edge side: zero tokens were delivered, so the re-run is a
+            # plain retry (not a resurrection) — and completes.
+            coord = _coord()
+            rec = coord.admit(prompt, deadline_s=30.0)
+            rec.addr = "restoring-crash"
+            coord.declare_dead("restoring-crash", cause="died")
+            e2 = self._engine(tiny, name="restoring-rescue")
+
+            def serve(addr, record, hop):
+                req = e2.add_request(record.prompt, samp)
+                while e2.has_work():
+                    e2.step()
+                for t in req.generated:
+                    record.deliver(t)
+
+            rep = coord.run_to_completion(
+                rec, lambda k, e: "rescue", serve
+            )
+            assert len(rec.delivered) == 4
+            assert rep["resurrections"] == 0  # nothing delivered: retry
+            assert rep["retries"] == 1
+        finally:
+            eng.kv_transfer.close()
+
+    def test_engine_level_double_crash(self, tiny):
+        """Belt-and-braces at the engine layer: two successive node
+        deaths mid-stream, each resume feeding the NEXT engine the
+        tokens delivered so far — the final stream is byte-identical to
+        the uninterrupted greedy run."""
+        from radixmesh_tpu.engine.request import SamplingParams
+
+        prompt = list(range(200, 240))
+        samp = SamplingParams(max_new_tokens=9)
+        ref_eng = self._engine(tiny, name="dc-ref")
+        ref = ref_eng.add_request(prompt, samp)
+        while ref_eng.has_work():
+            ref_eng.step()
+        expected = ref.generated
+
+        coord = _coord()
+        rec = coord.admit(prompt, deadline_s=60.0)
+        engines = {
+            "e1": self._engine(tiny, name="dc-1"),
+            "e2": self._engine(tiny, name="dc-2"),
+            "e3": self._engine(tiny, name="dc-3"),
+        }
+        crash_at = {"e1": 3, "e2": 6, "e3": None}
+        order = iter(["e1", "e2", "e3"])
+
+        def serve(addr, record, hop):
+            eng = engines[addr]
+            req = eng.add_request(
+                record.prompt, samp, resume_tokens=record.delivered
+            )
+            seen = 0
+            while eng.has_work():
+                eng.step()
+                new = req.generated[seen:]
+                for t in new:
+                    record.deliver(t)
+                    seen += 1
+                    if (
+                        crash_at[addr] is not None
+                        and len(record.delivered) >= crash_at[addr]
+                    ):
+                        raise NodeDied(f"{addr} died mid-decode")
+
+        rep = coord.run_to_completion(rec, lambda k, e: next(order), serve)
+        assert rep["resurrections"] == 2
+        assert rec.delivered == expected  # byte-identical, no loss
+
+
+class TestHttpResume:
+    @pytest.fixture(scope="class")
+    def frontend(self):
+        import jax
+
+        from radixmesh_tpu.engine.engine import Engine
+        from radixmesh_tpu.models.llama import ModelConfig, init_params
+        from radixmesh_tpu.server.http_frontend import ServingFrontend
+
+        cfg = ModelConfig.tiny()
+        eng = Engine(
+            cfg,
+            init_params(cfg, jax.random.PRNGKey(0)),
+            num_slots=512,
+            page_size=4,
+            max_batch=2,
+            name="resume-http",
+        )
+        f = ServingFrontend(eng, port=0)
+        yield f
+        f.close(drain_s=0.5)
+
+    def _post(self, frontend, obj):
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+
+    def test_generate_resumes_over_http(self, frontend):
+        prompt = list(range(1, 25))
+        _, full = self._post(
+            frontend, {"input_ids": prompt, "max_tokens": 8}
+        )
+        k = 3
+        status, out = self._post(
+            frontend,
+            {
+                "input_ids": prompt,
+                "max_tokens": 8,
+                "resume_tokens": full["output_ids"][:k],
+            },
+        )
+        assert status == 200
+        assert out["resumed_from"] == k
+        # Continues from token k, never re-emits the delivered prefix,
+        # and the replay was served from the cache.
+        assert out["output_ids"] == full["output_ids"][k:]
+        assert out["cached_tokens"] > 0

@@ -496,7 +496,10 @@ class TestChaosAcceptance:
         under re-opened loss (zero failed, requeued-and-served, no
         failure detection) and a cold rejoin during a fresh partition
         (bootstrap within budget, router withholds hits until
-        convergence). The full 10 s version is scripts/chaosbench.py."""
+        convergence) — then the PR 7 crash phase: an unclean decode-node
+        kill mid-stream (zero lost requests, byte-identical resumes,
+        resurrection ≥ 0.8 cache hit, budget-bounded recovery hops).
+        The full 10 s version is scripts/chaosbench.py."""
         import bench
         from radixmesh_tpu.workload import run_chaos_workload
 
@@ -513,6 +516,8 @@ class TestChaosAcceptance:
             timeout_s=45.0,
             join_partition_s=1.0,
             drain_requests=25,
+            crash_streams=8,
+            crash_tokens=16,
         )
         report = bench.build_chaos_report(res)
         assert bench.validate_chaos(report) == []
@@ -534,3 +539,15 @@ class TestChaosAcceptance:
         assert join["hits_to_bootstrapping"] == 0
         assert join["withheld_hits"] > 0
         assert join["fleet_converged_after_join"]
+        # Request-recovery gates (PR 7, server/recovery.py): the unclean
+        # kill loses nothing, resumes byte-identically from the
+        # replicated cache, and stays inside the deadline budget.
+        crash = res["crash"]
+        assert crash["performed"] and crash["failed"] == 0
+        assert crash["interrupted"] > 0
+        assert crash["resumed"] == crash["interrupted"]
+        assert crash["prefix_identical"]
+        assert crash["resurrection_hit_ratio"] >= 0.8
+        assert crash["budget"]["within_one_backoff"]
+        assert crash["hedge"]["first_writer_wins"]
+        assert crash["hedge"]["loser_cancelled"]
