@@ -124,6 +124,113 @@ def validate_ringbench(report: dict) -> list[str]:
     return missing
 
 
+# ----------------------------------------------------------------------
+# RINGSCALE v2 schema (scripts/ringscale.py): the wire-scaling sweep,
+# extended by prefix-ownership sharding (cache/sharding.py). v2 adds
+# per-row rf/mode (live threaded vs simulated transport — sizes above
+# the sim threshold run the real delivery/serialization code over an
+# in-memory pump with MODELED hop latency) and the structural gates the
+# sharding claim rides on:
+#   * FLATNESS — for every rf > 0 row group, bytes-per-insert at the
+#     largest N must stay within RINGSCALE_FLATNESS_MAX_RATIO of the
+#     smallest N (the O(N) wire wall is broken, not just bent);
+#   * PROPAGATION — sharded propagation-to-owners p99 must be no worse
+#     than the full-replica ring's p99 at the SMALLEST size, compared
+#     within the same hop delay and measurement mode.
+# v1 artifacts (no schema_version; full-replica rows only) stay valid.
+# ----------------------------------------------------------------------
+
+RINGSCALE_SCHEMA_VERSION = 2
+
+RINGSCALE_TOP_FIELDS = (
+    "schema_version", "metric", "mode", "sizes", "hop_delays_ms", "rfs",
+    "results", "bytes_per_insert_growth",
+)
+RINGSCALE_ROW_FIELDS = (
+    "n_nodes", "topology", "rf", "mode", "hop_delay_ms", "frame_bytes",
+    "frames_per_insert", "measured_frames_per_insert",
+    "ring_bytes_per_insert", "prop_p50_ms", "prop_p99_ms",
+)
+RINGSCALE_FLATNESS_MAX_RATIO = 1.5
+
+
+def validate_ringscale(report) -> list[str]:
+    """Schema violations of a RINGSCALE artifact (empty = valid).
+    v1 artifacts — ``metric == "ring_scale_sweep"`` with no
+    ``schema_version`` — predate sharding and stay valid as-is; v2
+    artifacts must carry the per-row fields plus the flatness and
+    propagation gates documented above. Import-safe from scripts (no
+    jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    if report.get("metric") != "ring_scale_sweep":
+        return ["metric is not ring_scale_sweep"]
+    if "schema_version" not in report:
+        # v1 (pre-sharding): full-replica rows only; minimal contract.
+        if not isinstance(report.get("results"), list) or not report["results"]:
+            return ["v1 artifact has no results rows"]
+        return []
+    problems = [f for f in RINGSCALE_TOP_FIELDS if f not in report]
+    rows = report.get("results") or []
+    if not rows:
+        problems.append("results is empty")
+    for i, row in enumerate(rows):
+        problems += [
+            f"results[{i}].{f}" for f in RINGSCALE_ROW_FIELDS if f not in row
+        ]
+    if problems:
+        return problems
+    # Flatness gate: sharded bytes-per-insert must be ~independent of N.
+    by_group: dict = {}
+    for row in rows:
+        if int(row.get("rf", 0)) > 0:
+            by_group.setdefault(
+                (row["rf"], row["hop_delay_ms"]), []
+            ).append(row)
+    for (rf, delay), group in by_group.items():
+        group = sorted(group, key=lambda r: r["n_nodes"])
+        if len(group) < 2:
+            continue
+        lo, hi = group[0], group[-1]
+        ratio = hi["ring_bytes_per_insert"] / max(
+            1, lo["ring_bytes_per_insert"]
+        )
+        if ratio > RINGSCALE_FLATNESS_MAX_RATIO:
+            problems.append(
+                f"flatness: rf={rf} bytes/insert grew {ratio:.2f}x from "
+                f"N={lo['n_nodes']} to N={hi['n_nodes']} (max "
+                f"{RINGSCALE_FLATNESS_MAX_RATIO}x) — the O(N) wall is back"
+            )
+    # Propagation gate: sharded owner-propagation p99 no worse than the
+    # full-replica ring at the smallest size (same delay + mode — live
+    # measurements and modeled sim rows are not comparable).
+    for (delay, mode) in {
+        (r["hop_delay_ms"], r["mode"]) for r in rows
+    }:
+        sub = [
+            r for r in rows
+            if r["hop_delay_ms"] == delay and r["mode"] == mode
+        ]
+        base = sorted(
+            (r for r in sub if int(r.get("rf", 0)) == 0
+             and r["topology"] == "ring"),
+            key=lambda r: r["n_nodes"],
+        )
+        sharded = [r for r in sub if int(r.get("rf", 0)) > 0]
+        if not base or not sharded:
+            continue
+        floor = base[0]
+        for row in sharded:
+            if row["prop_p99_ms"] > floor["prop_p99_ms"]:
+                problems.append(
+                    f"propagation: rf={row['rf']} N={row['n_nodes']} p99 "
+                    f"{row['prop_p99_ms']}ms exceeds the full-replica "
+                    f"N={floor['n_nodes']} ring's {floor['prop_p99_ms']}ms "
+                    f"(delay={delay}ms, mode={mode})"
+                )
+    return problems
+
+
 def validate_trace(obj) -> list[str]:
     """Schema violations of a Chrome trace-event artifact emitted by the
     flight recorder (``radixmesh_tpu/obs/trace_plane.py``) — empty list =
@@ -440,7 +547,14 @@ def validate_chaos(report) -> list[str]:
                 "join: the router routed cache hits to a BOOTSTRAPPING "
                 f"node ({join.get('hits_to_bootstrapping')} times)"
             )
-        if not join.get("withheld_hits", 0):
+        if not join.get("withheld_hits", 0) and not int(
+            report.get("replication_factor", 0) or 0
+        ):
+            # Sharded runs (replication_factor > 0) are exempt: the
+            # router routes from owner summaries there, and a COLD
+            # joiner advertises no warmth — there is never a hit to
+            # withhold, and hits_to_bootstrapping == 0 (gated above) is
+            # the whole invariant.
             problems.append(
                 "join: the router never withheld a hit during bootstrap "
                 "(the withhold path went unexercised — the gate proves "

@@ -83,7 +83,21 @@ from radixmesh_tpu.cache.oplog import (
     patched_frame,
     serialize,
 )
-from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode, as_key
+from radixmesh_tpu.cache.radix_tree import (
+    MatchResult,
+    RadixTree,
+    TreeNode,
+    as_key,
+    root_page_hash,
+)
+from radixmesh_tpu.cache.sharding import (
+    MAX_SUMMARY_ROOTS,
+    ShardSummaryTable,
+    build_ownership,
+    decode_shard_summary,
+    encode_shard_summary,
+    shard_of_tokens,
+)
 from radixmesh_tpu.comm.communicator import Communicator, create_communicator
 from radixmesh_tpu.config import MeshConfig, NodeRole
 from radixmesh_tpu.obs.fleet_plane import FleetView, NodeDigest, eviction_counters
@@ -173,7 +187,22 @@ class MeshCache:
                 ring_size=cfg.num_ring,
                 group_size=cfg.group_size or auto_group_size(cfg.num_ring),
             )
-        self.tree = RadixTree(page_size=self.page)
+        # Prefix-ownership sharding (cache/sharding.py): rf > 0 bounds
+        # each insert's delivery to the key's owner set instead of
+        # circulating the whole ring — bytes-per-insert O(RF), not O(N).
+        # rf == 0 is the full-replica compatibility mode: every wire
+        # behavior below is bit-for-bit the unsharded ring.
+        self.rf = cfg.replication_factor
+        self.sharded = self.rf > 0
+        _page = max(1, self.page)
+        self.tree = RadixTree(
+            page_size=self.page,
+            shard_fn=(
+                (lambda key, _p=_page: shard_of_tokens(key[:_p]))
+                if self.sharded
+                else None
+            ),
+        )
         self._lock = threading.RLock()
         self._logic_op = AtomicCounter()
         self.dup_nodes: dict[NodeKey, PrefillValue | RouterValue] = {}
@@ -191,6 +220,26 @@ class MeshCache:
         # Elastic membership (policy/topology.py): every TTL and GC
         # unanimity count derives from the CURRENT view, not static config.
         self.view = TopologyView.initial(cfg)
+        # View-epoch-consistent ownership map (cache/sharding.py is the
+        # SINGLE writer — this module only swaps whole immutable maps,
+        # re-derived from every adopted view). None when unsharded.
+        self.ownership = (
+            build_ownership(
+                self.view.alive, self.rf, self.view.epoch,
+                is_prefill=cfg.is_prefill_rank,
+            )
+            if self.sharded
+            else None
+        )
+        # Router-side compact replica substitute: per-rank per-shard
+        # (fingerprint, root summaries) folded from SHARD_SUMMARY gossip.
+        # Maintained on every role (cheap; P/D nodes use the fps for
+        # co-owner convergence too), read on the router's routing path.
+        self._shard_table = ShardSummaryTable() if self.sharded else None
+        self._last_shard_summary = 0.0
+        # EWMA of wire bytes each local insert cost (frame size × owner
+        # deliveries under sharding; frame × ring size unsharded).
+        self._bpi_ewma = 0.0
         # Rate limit for tick-triggered view re-announcements (see the
         # TICK receive branch): at most one per tick interval per node.
         self._last_view_gossip = 0.0
@@ -253,6 +302,12 @@ class MeshCache:
         # available to EVERY role — a router probes peers the same way).
         self.on_repair = None
         self._repair_comms: dict[int, Communicator] = {}
+        # Owner-addressed data channels (prefix-ownership sharding): one
+        # lazily-dialed point-to-point channel per owner rank, written
+        # ONLY by the dedicated owner-sender thread. Same pattern as the
+        # repair channels; separate map so bulk data never rides the
+        # repair/bootstrap connections.
+        self._owner_comms: dict[int, Communicator] = {}
         # Bootstrap-repair channels (policy/lifecycle.py warm join): a
         # BOOTSTRAPPING node's bulk sessions get their OWN point-to-point
         # channels so a full-replica transfer never queues behind (or
@@ -312,6 +367,29 @@ class MeshCache:
             "oplog frames lost on the outbound path, by cause and kind "
             "(data-kind losses arm an early anti-entropy repair probe)",
             ("node", "cause", "kind"),
+        )
+        # Prefix-ownership sharding telemetry. owned_shards tracks the
+        # RF-invariant's local share; bytes_per_insert is the EWMA the
+        # ringscale flatness gate watches live; pullthrough counts the
+        # non-owner cache-fill traffic by outcome (sent/send_failed on
+        # the requester, served/miss on the owner).
+        self._g_owned_shards = reg.gauge(
+            "radixmesh_mesh_owned_shards",
+            "shards this node owns under the current ownership map "
+            "(0 when unsharded or not an owner of anything)",
+            ("node",),
+        ).labels(node=node)
+        self._g_bytes_per_insert = reg.gauge(
+            "radixmesh_mesh_bytes_per_insert",
+            "EWMA of ring/owner wire bytes per locally-originated insert "
+            "(frame size x deliveries; O(RF) under sharding, O(N) full-replica)",
+            ("node",),
+        ).labels(node=node)
+        self._m_pullthrough = reg.counter(
+            "radixmesh_mesh_pullthrough_total",
+            "shard pull-through requests by outcome (sent/send_failed = "
+            "requester side; served/miss = owner side)",
+            ("node", "outcome"),
         )
         self._m_prefetch_sent = reg.counter(
             "radixmesh_mesh_prefetch_sent_total",
@@ -420,6 +498,13 @@ class MeshCache:
         self._spine_out_q: queue.Queue[bytes] = queue.Queue(maxsize=65536)
         self._spine_ctl_q: queue.Queue[bytes] = queue.Queue(maxsize=4096)
         self._spine_evt = threading.Event()
+        # Owner-addressed data lane (sharding): (target rank, frame)
+        # pairs drained by the dedicated owner-sender thread. FIFO per
+        # origin — wire order equals application order per target, same
+        # contract as the ring lane.
+        self._owner_q: queue.Queue[tuple[int, bytes]] = queue.Queue(maxsize=65536)
+        self._owner_evt = threading.Event()
+        self._refresh_owned_shards()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -504,6 +589,17 @@ class MeshCache:
             t = threading.Thread(target=self._sender, daemon=True, name="mesh-sender")
             t.start()
             self._threads.append(t)
+            if self.sharded:
+                t = threading.Thread(
+                    target=self._owner_sender, daemon=True,
+                    name="mesh-owner-sender",
+                )
+                t.start()
+                self._threads.append(t)
+                # Seed the fleet's routing/convergence tables without
+                # waiting out the first summary interval (an empty-tree
+                # summary still tells the router which shards are ours).
+                self.broadcast_shard_summary()
             if self.hier is not None:
                 t = threading.Thread(
                     target=self._spine_sender, daemon=True, name="mesh-spine-sender"
@@ -601,6 +697,8 @@ class MeshCache:
             c.close()
         for c in self._bootstrap_comms.values():
             c.close()
+        for c in self._owner_comms.values():
+            c.close()
 
     # ------------------------------------------------------------------
     # public cache API
@@ -634,7 +732,7 @@ class MeshCache:
         with self._lock:
             prefix_len = self._mesh_insert(key, value)
             # Enqueued under the lock: wire order == application order.
-            self._broadcast(
+            self._broadcast_data(
                 Oplog(
                     op_type=OplogType.INSERT,
                     origin_rank=self.rank,
@@ -685,7 +783,7 @@ class MeshCache:
                 # Only a successful local delete replicates — broadcasting a
                 # refused delete (locked/mid-node key) would desynchronize
                 # replicas that can apply it.
-                self._broadcast(
+                self._broadcast_data(
                     Oplog(
                         op_type=OplogType.DELETE,
                         origin_rank=self.rank,
@@ -795,6 +893,12 @@ class MeshCache:
                 OplogType.REPAIR_PROBE, OplogType.REPAIR_SUMMARY,
             ):
                 self._handle_repair(op)
+                return
+            if op.op_type is OplogType.SHARD_SUMMARY:
+                self._handle_shard_summary(op, data)
+                return
+            if op.op_type is OplogType.SHARD_PULL:
+                self._handle_shard_pull(op)
                 return
             if op.op_type is OplogType.TICK:
                 # Counted before the origin-drop so the originator observes
@@ -1292,13 +1396,23 @@ class MeshCache:
         address — the prefetch-channel pattern, but role-agnostic (a
         router probes peers; a P/D node answers a router's probe at the
         router's bind address). ``bootstrap`` keys a SEPARATE channel
-        map so warm-join bulk sessions ride their own connection. Dialed
-        OUTSIDE the mesh lock: the transport reader thread needs that
-        lock to apply oplogs."""
+        map so warm-join bulk sessions ride their own connection."""
+        return self._p2p_channel(
+            target_rank,
+            self._bootstrap_comms if bootstrap else self._repair_comms,
+        )
+
+    def _p2p_channel(
+        self, target_rank: int, comms: dict[int, "Communicator"]
+    ) -> Communicator | None:
+        """Shared lazy dialer for every dedicated point-to-point channel
+        map (repair, bootstrap, owner-addressed data). Dialed OUTSIDE
+        the mesh lock: the transport reader thread needs that lock to
+        apply oplogs, and a slow first connection must not stall ring
+        processing (a racing duplicate dial just closes the loser)."""
         n_total = self.cfg.num_ring + len(self.cfg.router_nodes)
         if not 0 <= target_rank < n_total or target_rank == self.rank:
             return None
-        comms = self._bootstrap_comms if bootstrap else self._repair_comms
         with self._lock:
             comm = comms.get(target_rank)
         if comm is not None:
@@ -1348,7 +1462,37 @@ class MeshCache:
                     oplogs += n_ops
         return keys, oplogs
 
-    def _reemit_entry(self, node: TreeNode) -> int:
+    def repair_push_shards(
+        self, sids, exclude_hashes: set[int], budget: int
+    ) -> tuple[int, int]:
+        """Owner-scoped repair push (the sharded counterpart of
+        :meth:`repair_push_keys`): re-replicate this replica's entries
+        in shards ``sids`` whose path hash is NOT in the peer's summary,
+        as sharded data re-emissions — delivered to the whole owner set,
+        so one push heals every co-owner. Bounded by ``budget`` entries.
+        Routers hold no indices: always (0, 0) there."""
+        if self.role is NodeRole.ROUTER or not sids:
+            return 0, 0
+        keys = oplogs = 0
+        with self._lock:
+            by_shard = self.tree.nodes_in_shards(sids)  # ONE tree walk
+            for sid in sids:
+                if keys >= budget:
+                    break
+                for node in by_shard.get(sid, ()):
+                    if keys >= budget:
+                        break
+                    if self.tree.path_hash(node) in exclude_hashes:
+                        continue
+                    n_ops = self._reemit_entry(node)
+                    if n_ops:
+                        keys += 1
+                        oplogs += n_ops
+        return keys, oplogs
+
+    def _reemit_entry(
+        self, node: TreeNode, target_rank: int | None = None
+    ) -> int:
         """Re-broadcast the full root→``node`` path as INSERT oplogs,
         one per maximal same-rank run of path segments, emitted
         root-first (caller holds the lock, so the data lane preserves
@@ -1357,7 +1501,11 @@ class MeshCache:
         the min over a SUBSET of the prefix's writers), so by the time a
         run's frame applies anywhere, its prefix positions already hold
         values of strictly lower rank — the run's value can only land on
-        its own span, with its own correct indices. Returns oplogs
+        its own span, with its own correct indices.
+
+        ``target_rank`` (sharding: pull-through fill, drain handoff)
+        redirects the frames point-to-point at ONE rank (ttl=1 on the
+        owner lane) instead of the data broadcast. Returns oplogs
         enqueued (0 when the path isn't re-emittable)."""
         path: list[TreeNode] = []
         n = node
@@ -1391,18 +1539,22 @@ class MeshCache:
                     # A pre-v3 token-granular stray: not representable on
                     # this wire — skip the entry rather than corrupt it.
                     return sent
-            self._broadcast(
-                Oplog(
-                    op_type=OplogType.INSERT,
-                    origin_rank=self.rank,
-                    logic_id=self._logic_op.next(),
-                    ttl=self._data_ttl(),
-                    key=full_key[:end],
-                    value=wire_value,
-                    value_rank=rank,
-                    page=self.page,
-                )
+            op = Oplog(
+                op_type=OplogType.INSERT,
+                origin_rank=self.rank,
+                logic_id=self._logic_op.next(),
+                ttl=self._data_ttl(),
+                key=full_key[:end],
+                value=wire_value,
+                value_rank=rank,
+                page=self.page,
             )
+            if target_rank is None:
+                self._broadcast_data(op)
+            else:
+                op.ts = time.time()
+                op.ttl = 1
+                self._enqueue_owner(target_rank, serialize(op))
             sent += 1
         return sent
 
@@ -1463,6 +1615,10 @@ class MeshCache:
                 and self._out_q.empty()
                 and self._spine_ctl_q.empty()
                 and self._spine_out_q.empty()
+                # The owner-addressed data lane too: a draining node's
+                # shard handoff (handoff_owned_shards) rides it, and
+                # LEAVE must not beat those frames out of the process.
+                and self._owner_q.empty()
             ):
                 return True
             time.sleep(0.01)
@@ -1489,6 +1645,21 @@ class MeshCache:
             "topology view epoch=%d alive=%s (was epoch=%d alive=%s)",
             view.epoch, view.alive, old.epoch, old.alive,
         )
+        if self.sharded:
+            # Re-derive the ownership map from the ADOPTED view (same
+            # pure derivation on every node — epoch-consistent, zero
+            # coordination; cache/sharding.py is the single writer of
+            # owner sets, this is a whole-map swap).
+            self.ownership = build_ownership(
+                view.alive, self.rf, view.epoch,
+                is_prefill=self.cfg.is_prefill_rank,
+            )
+            self._refresh_owned_shards()
+            if self._shard_table is not None:
+                # Departed ranks' summaries leave the routing table with
+                # the membership (their advertised warmth is unreachable;
+                # the FleetView's shard fps go with its retain below).
+                self._shard_table.retain(view.alive)
         if self.role is not NodeRole.ROUTER:
             if self.hier is not None:
                 alive = self._my_alive()
@@ -1708,6 +1879,393 @@ class MeshCache:
                 cb(cause, kind_int)
             except Exception:  # noqa: BLE001 — a hook bug must not lose more frames
                 self.log.exception("oplog-dropped hook failed")
+
+    # ------------------------------------------------------------------
+    # prefix-ownership sharding: owner-addressed delivery
+    # (cache/sharding.py; replication_factor > 0)
+    # ------------------------------------------------------------------
+
+    def _broadcast_data(self, op: Oplog) -> None:
+        """First transmission of a locally-originated DATA op. Full
+        replica (rf == 0): the ordinary ring broadcast, bit-for-bit.
+        Sharded: serialize once and enqueue point-to-point to the key's
+        owner set — the O(RF) wire cost that replaces the O(N) lap.
+        Caller holds the lock (wire order == application order per
+        target, exactly the ring lane's contract)."""
+        if not self.sharded or self.role is NodeRole.ROUTER:
+            self._broadcast(op)
+            if op.op_type is OplogType.INSERT and self._started:
+                # Fleet-wide wire cost of this insert: one frame per ring
+                # member (every hop forwards it once).
+                self._note_insert_bytes(
+                    len(serialize(op)) * max(1, self.view.ring_size)
+                )
+            return
+        if op.op_type is OplogType.RESET:
+            # Whole-tree op: shardless, rare — keep the ring lap.
+            self._broadcast(op)
+            return
+        op.ts = time.time()
+        op.ttl = 1  # point-to-point: one hop, never circulated
+        data = serialize(op)
+        sid = shard_of_tokens(op.key[: max(1, self.page)])
+        owners = self.ownership.owners_of(sid) if self.ownership else ()
+        targets = [r for r in owners if r != self.rank]
+        for rank in targets:
+            self._enqueue_owner(rank, data)
+        if op.op_type is OplogType.INSERT:
+            self._note_insert_bytes(len(data) * len(targets))
+
+    def _note_insert_bytes(self, nbytes: int) -> None:
+        self._bpi_ewma += 0.2 * (float(nbytes) - self._bpi_ewma)
+        self._g_bytes_per_insert.set(self._bpi_ewma)
+
+    def _enqueue_owner(self, rank: int, data: bytes) -> None:
+        """Queue one frame for the owner-sender thread (bounded; drops
+        count + arm the repair plane's early probe, same honest
+        degradation as the ring lane)."""
+        if not self._started or not self.sync.can_send(self.cfg):
+            return
+        try:
+            self._owner_q.put_nowait((rank, data))
+            self._m_sent.inc()
+            self._owner_evt.set()
+        except queue.Full:
+            self._m_dropped.inc()
+            self._note_drop(data, "queue_full")
+
+    def _owner_channel(self, target_rank: int) -> Communicator | None:
+        return self._p2p_channel(target_rank, self._owner_comms)
+
+    def _owner_sender(self) -> None:
+        """Dedicated transmit thread for owner-addressed data frames —
+        the sharded counterpart of the ring sender: a slow or dead owner
+        can never stall tree operations (bounded sends; a failed send
+        drops the frame, counted, and anti-entropy heals the gap)."""
+        while not self._stop.is_set():
+            try:
+                rank, data = self._owner_q.get_nowait()
+            except queue.Empty:
+                self._owner_evt.wait(timeout=0.2)
+                self._owner_evt.clear()
+                continue
+            comm = self._owner_channel(rank)
+            if comm is None:
+                self._note_drop(data, "transmit")
+                continue
+            try:
+                # SHORT bound, like the router fan-out — one dead/slow
+                # owner must cost ~1s per frame, never head-of-line-block
+                # every other owner behind the shared queue for a full
+                # failure timeout. A dropped frame is healed by the
+                # owner-scoped anti-entropy scan (the drop arms it).
+                if not comm.try_send(
+                    data, min(1.0, self.cfg.failure_timeout_s)
+                ):
+                    self._note_drop(data, "transmit")
+            except Exception:  # noqa: BLE001 — transport errors must not kill the sender
+                if not self._stop.is_set():
+                    if throttled(
+                        ("owner_tx", self.rank, rank), self.cfg.failure_timeout_s
+                    ):
+                        self.log.exception(
+                            "owner-addressed send to rank %d failed", rank
+                        )
+                    self._note_drop(data, "transmit")
+
+    def _refresh_owned_shards(self) -> None:
+        if self.ownership is not None and self.role is not NodeRole.ROUTER:
+            self._g_owned_shards.set(
+                len(self.ownership.owned_shards(self.rank))
+            )
+
+    def owner_ranks(self, key) -> tuple[int, ...]:
+        """The CURRENT owner set of ``key``'s shard, view-filtered — the
+        router's failover/fallback candidate list (the PR 7 invariant "a
+        survivor holds the prefix" holds within this set). Empty when
+        unsharded."""
+        if self.ownership is None:
+            return ()
+        key = as_key(key)
+        if len(key) == 0:
+            return ()
+        sid = shard_of_tokens(key[: max(1, self.page)])
+        return tuple(
+            r for r in self.ownership.owners_of(sid) if self.view.contains(r)
+        )
+
+    def diverged_shards_with(self, rank: int) -> list[int]:
+        """Shards this node CO-OWNS with ``rank`` whose fingerprints
+        disagree (mine from the tree, theirs from gossiped summaries) —
+        the owner-scoped convergence unit: whole-tree fingerprints
+        diverge BY DESIGN under sharding, so repair, bootstrap, and
+        convergence auditing all compare per shard, per owner pair.
+        A co-owned shard the peer has not yet summarized counts as
+        diverged (an empty joiner must not read as converged). Empty
+        list when unsharded or nothing is co-owned."""
+        if not self.sharded or self.ownership is None:
+            return []
+        mine = self.tree.shard_fingerprints()
+        theirs = self.fleet.shard_fps(rank)
+        out = []
+        for sid in self.ownership.owned_shards(self.rank):
+            if not self.ownership.is_owner(rank, sid):
+                continue
+            if theirs.get(sid) != (mine.get(sid, 0) & ((1 << 64) - 1)):
+                out.append(sid)
+        return out
+
+    def convergence_peers(self) -> list[int]:
+        """Peer ranks with enough gossiped state to be convergence-
+        compared (lifecycle cold-boot deadlock breaker): digest
+        fingerprints unsharded, shard-summary reporters sharded."""
+        if self.sharded:
+            peers = self.fleet.shard_fingerprints()
+        else:
+            peers = self.fleet.fingerprints()
+        return [r for r in peers if r != self.rank]
+
+    def bootstrap_converged_with(self, rank: int) -> bool:
+        """The lifecycle plane's warm-join convergence check against a
+        donor: full replica → scalar fingerprint equality (the PR 6
+        semantics); sharded → every co-owned shard agrees AND the peer
+        has summarized at least once (gossip silence is not
+        convergence)."""
+        if not self.sharded:
+            theirs = self.fleet.fingerprints().get(rank)
+            mask = (1 << 64) - 1
+            return (
+                theirs is not None
+                and (theirs & mask) == (self.tree.fingerprint_ & mask)
+            )
+        if not self.fleet.shard_fps(rank):
+            return False
+        return not self.diverged_shards_with(rank)
+
+    def broadcast_shard_summary(self) -> int:
+        """Ring one SHARD_SUMMARY frame: this node's per-owned-shard
+        fingerprints + bounded root summaries (the router's routing
+        table and the co-owner convergence currency). One frame per
+        interval per node — the control-plane cost that replaces the
+        per-insert lap. P/D only; returns the shard count published."""
+        if not self.sharded or self.role is NodeRole.ROUTER:
+            return 0
+        with self._lock:
+            owned = self.ownership.owned_shards(self.rank)
+            if not owned:
+                return 0
+            per_shard = max(4, MAX_SUMMARY_ROOTS // len(owned))
+            fps = self.tree.shard_fingerprints()
+            shards = {
+                sid: (
+                    fps.get(sid, 0),
+                    self.tree.shard_root_summaries(sid, per_shard),
+                )
+                for sid in owned
+            }
+            # Fold locally first (same contract as broadcast_digest):
+            # this node's own view is as fresh as anyone's.
+            self.fleet.fold_shard_fps(
+                self.rank, {sid: fp for sid, (fp, _) in shards.items()}
+            )
+            if self._shard_table is not None:
+                self._shard_table.fold(self.rank, shards)
+            self._broadcast(
+                Oplog(
+                    op_type=OplogType.SHARD_SUMMARY,
+                    origin_rank=self.rank,
+                    logic_id=self._logic_op.next(),
+                    ttl=self._data_ttl(),
+                    value=encode_shard_summary(self.rank, shards),
+                    value_rank=self.rank,
+                )
+            )
+        return len(shards)
+
+    def _handle_shard_summary(self, op: Oplog, data: bytes) -> None:
+        """Caller holds the lock; ttl already decremented. Fold-then-
+        forward like DIGEST; idempotent (whole-summary swap per rank)."""
+        if op.origin_rank == self.rank:
+            return  # lap complete
+        try:
+            origin, shards = decode_shard_summary(op.value)
+        except ValueError:
+            if throttled(("bad_shard_summary", self.rank),
+                         self.cfg.tick_interval_s):
+                self.log.warning(
+                    "malformed SHARD_SUMMARY from rank %d", op.origin_rank
+                )
+            self._circulate(op, data)
+            return
+        self.fleet.fold_shard_fps(
+            origin, {sid: fp for sid, (fp, _) in shards.items()}
+        )
+        if self._shard_table is not None:
+            self._shard_table.fold(origin, shards)
+        self._circulate(op, data)
+
+    def send_shard_pull(
+        self, key, owner_rank: int, target_rank: int
+    ) -> bool:
+        """Pull-through request: ask ``owner_rank`` to re-emit its
+        cached entries for ``key``'s prefix point-to-point to
+        ``target_rank`` (a non-owner about to serve that subtree).
+        Fire-and-forget and idempotent like PREFETCH — a lost pull
+        costs the target a cache miss, never correctness. Routers use
+        their dedicated fire-and-forget channels (they never ring-send);
+        P/D requesters ride the owner lane."""
+        key = as_key(key)
+        if not self.sharded or len(key) == 0 or owner_rank == target_rank:
+            return False
+        op = Oplog(
+            op_type=OplogType.SHARD_PULL,
+            origin_rank=self.rank,
+            logic_id=self._logic_op.next(),
+            ttl=1,
+            key=key,
+            value=np.asarray(
+                [shard_of_tokens(key[: max(1, self.page)])], dtype=np.int32
+            ),
+            value_rank=target_rank,
+            ts=time.time(),
+        )
+        if self.role is not NodeRole.ROUTER:
+            with self._lock:
+                self._enqueue_owner(owner_rank, serialize(op))
+            self._m_pullthrough.labels(
+                node=self._node_label, outcome="sent"
+            ).inc()
+            return True
+        comm = self._prefetch_channel(owner_rank)
+        ok = False
+        if comm is not None:
+            try:
+                ok = bool(comm.try_send(serialize(op), 0.05))
+            except Exception:  # noqa: BLE001 — pulls are droppable by contract
+                ok = False
+        self._m_pullthrough.labels(
+            node=self._node_label,
+            outcome="sent" if ok else "send_failed",
+        ).inc()
+        return ok
+
+    def _handle_shard_pull(self, op: Oplog) -> None:
+        """Caller holds the lock; point-to-point (never circulated). Re-
+        emit the matched entry's path to the beneficiary rank as ttl=1
+        INSERT frames — the pull-through fill. Cheap: one read-only tree
+        walk + bounded enqueues on the transport reader thread."""
+        if self.role is NodeRole.ROUTER:
+            return  # routers hold no indices to push
+        target = op.value_rank
+        n_total = self.cfg.num_ring + len(self.cfg.router_nodes)
+        if not 0 <= target < n_total or target == self.rank:
+            return
+        res = self.tree.match_prefix(op.key, split_partial=False)
+        node = res.last_node
+        if res.length == 0 or node is None or node is self.tree.root:
+            self._m_pullthrough.labels(
+                node=self._node_label, outcome="miss"
+            ).inc()
+            return
+        if self._reemit_entry(node, target_rank=target):
+            self._m_pullthrough.labels(
+                node=self._node_label, outcome="served"
+            ).inc()
+        else:
+            self._m_pullthrough.labels(
+                node=self._node_label, outcome="miss"
+            ).inc()
+
+    def shard_route(self, key) -> RouterMatchResult:
+        """Summary-based router match (the sharded replacement for the
+        router's tree replica): which owner ranks advertise ``key``'s
+        subtree as warm, and an estimated match length (min of the
+        request's aligned length and the advertised deepest cached
+        path — an upper bound; the serving node reports true hits)."""
+        key = as_key(key)
+        if len(key) == 0 or self._shard_table is None or self.ownership is None:
+            return RouterMatchResult(-1, -1)
+        page = max(1, self.page)
+        sid = shard_of_tokens(key[:page])
+        rh = root_page_hash(key, page)
+        aligned = len(key) - len(key) % page if self.page > 1 else len(key)
+        with self._lock:
+            warm = self._shard_table.lookup(sid, rh)
+            view = self.view
+        prefill_rank = decode_rank = -1
+        match_len = 0
+        # Deepest-first: the rank advertising the longest cached path
+        # wins its role slot (mirrors _route_from_values' deepest-writer
+        # rule).
+        for rank, depth in sorted(warm.items(), key=lambda kv: -kv[1]):
+            if not view.contains(rank):
+                continue
+            est = min(aligned, int(depth))
+            if prefill_rank == -1 and self.cfg.is_prefill_rank(rank):
+                prefill_rank = rank
+                match_len = max(match_len, est)
+            if decode_rank == -1 and self.cfg.is_decode_rank(rank):
+                decode_rank = rank
+                match_len = max(match_len, est)
+            if prefill_rank != -1 and decode_rank != -1:
+                break
+        return RouterMatchResult(
+            prefill_rank=prefill_rank,
+            decode_rank=decode_rank,
+            match_len=match_len,
+        )
+
+    def handoff_owned_shards(self) -> dict:
+        """Drain-time ownership transfer (policy/lifecycle.py): push
+        each owned shard's entries to the ranks that BECOME owners once
+        this node leaves, so the RF invariant survives the departure
+        without waiting out anti-entropy. One ``shard_transfer`` span
+        per shard on the recorder. Returns transfer stats."""
+        stats = {"shards": 0, "entries": 0, "targets": 0}
+        if not self.sharded or self.role is NodeRole.ROUTER:
+            return stats
+        rec = get_recorder()
+        with self._lock:
+            cur = self.ownership
+            survivors = [r for r in self.view.alive if r != self.rank]
+            if not survivors or cur is None:
+                return stats
+            future = build_ownership(
+                survivors, self.rf, self.view.epoch + 1,
+                is_prefill=self.cfg.is_prefill_rank,
+            )
+            owned = cur.owned_shards(self.rank)
+            by_shard = self.tree.nodes_in_shards(owned)  # ONE tree walk
+            for sid in owned:
+                gained = [
+                    r for r in future.owners_of(sid)
+                    if r not in cur.owners_of(sid)
+                ]
+                if not gained:
+                    continue
+                t0 = time.monotonic()
+                entries = 0
+                for n in by_shard.get(sid, ()):
+                    if n.children:
+                        continue  # a leaf's re-emit covers its ancestors
+                    for tgt in gained:
+                        if self._reemit_entry(n, target_rank=tgt):
+                            entries += 1
+                stats["shards"] += 1
+                stats["entries"] += entries
+                stats["targets"] += len(gained)
+                if rec.enabled:
+                    rec.event(
+                        f"ring:{self._node_label}",
+                        "shard_transfer",
+                        t0,
+                        time.monotonic() - t0,
+                        cat="ring",
+                        shard=int(sid),
+                        targets=len(gained),
+                        entries=int(entries),
+                    )
+        return stats
 
     def _sender(self) -> None:
         """Dedicated transmit thread: the only place the control plane
@@ -2125,6 +2683,21 @@ class MeshCache:
                 return
             self._ttl_sweep()
             now = time.monotonic()
+            if self.sharded:
+                # Per-interval shard-summary gossip: the router's routing
+                # table + the co-owner convergence feed (one bounded frame
+                # per interval — the control cost that replaced per-insert
+                # circulation).
+                interval = (
+                    self.cfg.shard_summary_interval_s
+                    or self.cfg.tick_interval_s
+                )
+                if now - self._last_shard_summary >= interval:
+                    self._last_shard_summary = now
+                    try:
+                        self.broadcast_shard_summary()
+                    except Exception:  # noqa: BLE001 — gossip must not kill housekeeping
+                        self.log.exception("shard summary publish failed")
             if now - self._last_rx < timeout or now - self._last_self_join < timeout:
                 continue
             lc = self.lifecycle
@@ -2178,7 +2751,7 @@ class MeshCache:
                 older_than=cutoff,
             )
             for key in expired_keys:
-                self._broadcast(
+                self._broadcast_data(
                     Oplog(
                         op_type=OplogType.DELETE,
                         origin_rank=self.rank,

@@ -157,6 +157,27 @@ class OplogType(enum.IntEnum):
     # Droppable by contract: the leaver re-announces until it observes
     # its own exclusion, and failure detection remains the backstop.
     LEAVE = 14
+    # Prefix-ownership sharding extensions (cache/sharding.py,
+    # replication_factor > 0):
+    #
+    # SHARD_SUMMARY — one frame per node per summary interval carrying,
+    # for every shard the origin OWNS, the shard's incremental
+    # fingerprint plus bounded (root-page hash, deepest length) entries
+    # (value = packed sharding.encode_shard_summary, value_rank =
+    # origin). Rides the ring like DIGEST (idempotent newest-wins fold;
+    # the master fan-out carries it to the router, whose routing table
+    # it IS — the router holds no tree replica under sharding). This is
+    # the control-plane cost that replaces per-insert O(N) circulation:
+    # bytes amortize to ~zero per insert under load.
+    SHARD_SUMMARY = 15
+    # SHARD_PULL — pull-through request: "owner, re-emit your entries
+    # for prefix ``key`` (shard ``value[0]``) point-to-point to rank
+    # ``value_rank``" (the beneficiary — usually a non-owner that is
+    # about to serve fallback traffic for a warm subtree). Fire-and-
+    # forget and idempotent like PREFETCH: the re-emitted INSERTs apply
+    # through the ordinary conflict-resolution path; a lost pull just
+    # costs the target a cache miss.
+    SHARD_PULL = 16
 
 
 # Kinds added AFTER the unknown-kind pass-through tolerance shipped:
@@ -171,6 +192,8 @@ EXTENSION_KINDS = frozenset(
         OplogType.REPAIR_PROBE,
         OplogType.REPAIR_SUMMARY,
         OplogType.LEAVE,
+        OplogType.SHARD_SUMMARY,
+        OplogType.SHARD_PULL,
     }
 )
 # Kinds that carry replicated cache DATA: losing one of these frames

@@ -38,6 +38,7 @@ __all__ = [
     "BlockRemoved",
     "AllBlocksCleared",
     "FP_BUCKETS",
+    "root_page_hash",
 ]
 
 _node_ids = itertools.count()
@@ -112,6 +113,19 @@ def _node_contribution(chain: np.ndarray) -> int:
     return int(np.bitwise_xor.reduce(_mix64(chain)))
 
 
+def root_page_hash(tokens: Sequence[int] | np.ndarray, page_size: int) -> int:
+    """Path hash of a key's first page — the subtree-root identity the
+    shard summaries (cache/sharding.py) publish and the router recomputes
+    from raw request tokens. A pure function of the tokens (same chain +
+    splitmix64 pipeline as :meth:`RadixTree.path_hash`), so both sides
+    agree regardless of how either replica's node boundaries fell."""
+    arr = np.asarray(tokens, dtype=np.int32)[: max(1, page_size)]
+    if len(arr) == 0:
+        return 0
+    chain = _chain_hashes(_FP_SEED, arr)
+    return int(_mix64(chain[-1:])[0])
+
+
 def match_len(a: np.ndarray, b: np.ndarray) -> int:
     """Length of the common prefix of two int arrays (vectorized analog of
     the reference's ``_key_match_page_size1``, ``radix_cache.py:14-20``)."""
@@ -174,6 +188,7 @@ class TreeNode:
         "hit_count",
         "block_hashes",
         "chain",
+        "shard",
         "id",
     )
 
@@ -199,6 +214,10 @@ class TreeNode:
         # (see module comment above ``_chain_hashes``). Attached by
         # ``RadixTree._fp_attach``; empty on the root.
         self.chain: np.ndarray = np.empty(0, dtype=np.uint64)
+        # Subtree shard id (prefix-ownership sharding, cache/sharding.py):
+        # constant down a subtree — a node inherits its parent's, top-level
+        # nodes hash their first page. -1 = shard tracking off.
+        self.shard = -1
         self.id = next(_node_ids)
 
     @property
@@ -293,6 +312,7 @@ class RadixTree:
         enable_events: bool = False,
         time_fn: Callable[[], float] = time.monotonic,
         on_free_host: Callable[[np.ndarray], None] | None = None,
+        shard_fn: Callable[[np.ndarray], int] | None = None,
     ):
         self.page_size = page_size
         self.on_free = on_free
@@ -300,6 +320,12 @@ class RadixTree:
         self.enable_events = enable_events
         self._time = time_fn
         self._events: list[Any] = []
+        # Prefix-ownership sharding (cache/sharding.py): when set, maps a
+        # TOP-LEVEL node's key segment to its shard id, and the tree
+        # maintains per-shard fingerprints next to the scalar/buckets —
+        # the owner-scoped convergence currency (whole-tree fingerprints
+        # diverge BY DESIGN under sharding). None = tracking off.
+        self.shard_fn = shard_fn
         # All remaining state (root, size counters) is established by reset().
         self.reset()
 
@@ -352,6 +378,11 @@ class RadixTree:
         # Per-bucket partition of the same contributions (FP_BUCKETS
         # module comment): fingerprint_ == XOR-reduce(fp_buckets_).
         self.fp_buckets_ = np.zeros(FP_BUCKETS, dtype=np.uint64)
+        # Per-SHARD partition (only when shard_fn is set): shard id →
+        # XOR of that subtree shard's contributions. Sparse dict — most
+        # nodes own a fraction of the shard space. The scalar equals the
+        # XOR of these values too (same contribution multiset).
+        self.fp_shards_: dict[int, int] = {}
         if self.enable_events:
             self._events.append(AllBlocksCleared())
 
@@ -661,19 +692,27 @@ class RadixTree:
         same value; any divergent leaf flips it (w.h.p.)."""
         return self.fingerprint_
 
-    def _fp_fold(self, chain: np.ndarray) -> None:
-        """XOR ``chain``'s mixed contributions into both the scalar
-        fingerprint and the bucket vector (self-inverse: attach and
-        detach are the same fold)."""
+    def _fp_fold(self, chain: np.ndarray, shard: int = -1) -> None:
+        """XOR ``chain``'s mixed contributions into the scalar
+        fingerprint, the bucket vector, and (when shard tracking is on)
+        the shard's slot (self-inverse: attach and detach are the same
+        fold)."""
         if len(chain) == 0:
             return
         mixed = _mix64(chain)
-        self.fingerprint_ ^= int(np.bitwise_xor.reduce(mixed))
+        word = int(np.bitwise_xor.reduce(mixed))
+        self.fingerprint_ ^= word
         np.bitwise_xor.at(
             self.fp_buckets_,
             (mixed % np.uint64(FP_BUCKETS)).astype(np.int64),
             mixed,
         )
+        if shard >= 0:
+            cur = self.fp_shards_.get(shard, 0) ^ word
+            if cur:
+                self.fp_shards_[shard] = cur
+            else:
+                self.fp_shards_.pop(shard, None)
 
     def _fp_attach(self, node: TreeNode) -> None:
         """Compute ``node.chain`` from its parent's path and fold the
@@ -686,11 +725,19 @@ class RadixTree:
             else _FP_SEED
         )
         node.chain = _chain_hashes(start, node.key)
-        self._fp_fold(node.chain)
+        if self.shard_fn is not None:
+            # Shard is constant down a subtree: top-level nodes hash
+            # their own segment; everything deeper inherits (O(1)).
+            node.shard = (
+                self.shard_fn(node.key)
+                if parent is None or parent is self.root
+                else parent.shard
+            )
+        self._fp_fold(node.chain, node.shard)
 
     def _fp_detach(self, node: TreeNode) -> None:
         """Remove ``node``'s contribution (it is leaving the tree)."""
-        self._fp_fold(node.chain)
+        self._fp_fold(node.chain, node.shard)
         node.chain = np.empty(0, dtype=np.uint64)
 
     def fingerprint_buckets(self) -> np.ndarray:
@@ -734,6 +781,64 @@ class RadixTree:
             if want[idx].any():
                 out.append(n)
         return out
+
+    # ---- prefix-ownership sharding (cache/sharding.py) ----
+
+    def shard_fingerprints(self) -> dict[int, int]:
+        """shard id → 64-bit fingerprint of that shard's contribution
+        set (only populated shards present; requires ``shard_fn``). The
+        owner-scoped convergence currency: two co-owners of a shard have
+        converged on it iff these values agree."""
+        return dict(self.fp_shards_)
+
+    def nodes_in_shard(self, sid: int) -> list[TreeNode]:
+        """Tree nodes (root excluded) belonging to subtree shard
+        ``sid`` — the enumeration a shard-scoped repair session (or a
+        drain-time ownership transfer) summarizes/re-emits."""
+        return self.nodes_in_shards([sid]).get(sid, [])
+
+    def nodes_in_shards(self, sids) -> dict[int, list[TreeNode]]:
+        """shard id → that shard's nodes, for every requested shard, in
+        ONE tree walk. Repair handlers and drain handoffs enumerate
+        many shards per exchange under the mesh lock — a walk per shard
+        would stall oplog application O(shards × tree)."""
+        want = {int(s) for s in sids}
+        out: dict[int, list[TreeNode]] = {s: [] for s in want}
+        if not want:
+            return out
+        for n in self._all_nodes():
+            if n is self.root or len(n.chain) == 0:
+                continue
+            if n.shard in want:
+                out[n.shard].append(n)
+        return out
+
+    def shard_root_summaries(
+        self, sid: int, max_roots: int = 256
+    ) -> list[tuple[int, int]]:
+        """Per-subtree routing entries for shard ``sid``: one
+        ``(root-page path hash, deepest cached token length)`` pair per
+        top-level subtree in the shard, deepest-first (truncation under
+        ``max_roots`` drops the shallowest — the least valuable hits).
+        The hash matches :func:`root_page_hash` of the subtree's first
+        page, so a router can recompute it from raw request tokens."""
+        out: list[tuple[int, int]] = []
+        for child in self.root.children.values():
+            if child.shard != sid or len(child.chain) == 0:
+                continue
+            idx = min(max(1, self.page_size), len(child.chain)) - 1
+            rh = int(_mix64(child.chain[idx : idx + 1])[0])
+            deepest = 0
+            stack: list[tuple[TreeNode, int]] = [(child, 0)]
+            while stack:
+                n, base = stack.pop()
+                d = base + len(n.key)
+                if d > deepest:
+                    deepest = d
+                stack.extend((c, d) for c in n.children.values())
+            out.append((rh, deepest))
+        out.sort(key=lambda t: -t[1])
+        return out[:max_roots]
 
     # ---- introspection (reference radix_cache.py:172-177,232-248,354-364) ----
 
@@ -799,8 +904,11 @@ class RadixTree:
         )
         # Chain hashes are a pure function of the root path, so a split
         # partitions them between the halves — zero fingerprint delta.
+        # Shard is a function of the path's FIRST page only, so both
+        # halves stay in the node's shard (zero shard-vector delta too).
         new_node.chain = node.chain[:split_len]
         node.chain = node.chain[split_len:]
+        new_node.shard = node.shard
         node.parent = new_node
         if node.block_hashes is not None:
             # Page-chained hashes are a pure function of the root path, so a

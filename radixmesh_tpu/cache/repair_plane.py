@@ -67,6 +67,7 @@ import numpy as np
 
 from radixmesh_tpu.cache.oplog import DATA_KINDS, Oplog, OplogType
 from radixmesh_tpu.cache.radix_tree import FP_BUCKETS
+from radixmesh_tpu.cache.sharding import _to_i32
 from radixmesh_tpu.obs.metrics import REPAIR_SECONDS_BUCKETS, get_registry
 from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.utils.logging import get_logger
@@ -78,6 +79,11 @@ __all__ = [
     "decode_probe",
     "encode_summary",
     "decode_summary",
+    "is_shard_frame",
+    "encode_shard_probe",
+    "decode_shard_probe",
+    "encode_shard_session_summary",
+    "decode_shard_session_summary",
 ]
 
 _FP_MASK = (1 << 64) - 1
@@ -132,11 +138,14 @@ _VERSION = 1
 _PROBE_HDR = struct.Struct("<BBBB")  # magic, version, flags, pad
 _SUMMARY_HDR = struct.Struct("<BBBBii")  # magic, version, flags, pad, n_buckets, n_hashes
 _FLAG_REPLY = 1
-
-
-def _to_i32(raw: bytes) -> np.ndarray:
-    pad = (-len(raw)) % 4
-    return np.frombuffer(raw + b"\x00" * pad, dtype=np.int32).copy()
+# Owner-scoped (sharded) session frames (cache/sharding.py): the
+# whole-tree bucket vector is meaningless when replicas legitimately
+# hold different shards, so sharded sessions carry (shard id,
+# fingerprint) pairs instead, and summaries list path hashes for the
+# diverged SHARDS rather than buckets. Same magic/version/flag byte —
+# decoders branch on this bit.
+_FLAG_SHARD = 2
+_SHARD_PAIR = struct.Struct("<iQ")  # shard id, fingerprint
 
 
 def encode_probe(vec: np.ndarray) -> np.ndarray:
@@ -210,6 +219,90 @@ def decode_summary(arr: np.ndarray) -> tuple[np.ndarray, list[int], set[int], bo
     return vec, [int(x) for x in buckets], {int(x) for x in hashes}, bool(
         flags & _FLAG_REPLY
     )
+
+
+def is_shard_frame(arr: np.ndarray) -> bool:
+    """True when a repair payload is an owner-scoped (sharded) frame."""
+    raw = np.ascontiguousarray(np.asarray(arr, dtype=np.int32)).tobytes()
+    return (
+        len(raw) >= _PROBE_HDR.size
+        and raw[0] == _MAGIC
+        and bool(raw[2] & _FLAG_SHARD)
+    )
+
+
+def encode_shard_probe(pairs) -> np.ndarray:
+    """Owner-scoped probe: the initiator's (shard id, fingerprint) for
+    the shards it sees diverged with the peer (≤ bucket budget)."""
+    pairs = sorted((int(s), int(f) & _FP_MASK) for s, f in pairs)
+    raw = _PROBE_HDR.pack(_MAGIC, _VERSION, _FLAG_SHARD, 0)
+    raw += struct.pack("<I", len(pairs))
+    for sid, fp in pairs:
+        raw += _SHARD_PAIR.pack(sid, fp)
+    return _to_i32(raw)
+
+
+def decode_shard_probe(arr: np.ndarray) -> list[tuple[int, int]]:
+    raw = np.ascontiguousarray(np.asarray(arr, dtype=np.int32)).tobytes()
+    if len(raw) < _PROBE_HDR.size + 4:
+        raise ValueError(f"shard probe too short ({len(raw)} bytes)")
+    magic, version, flags, _ = _PROBE_HDR.unpack_from(raw, 0)
+    if magic != _MAGIC or not flags & _FLAG_SHARD:
+        raise ValueError("not a shard-scoped repair probe")
+    if version != _VERSION:
+        raise ValueError(f"unsupported repair version {version}")
+    (n,) = struct.unpack_from("<I", raw, _PROBE_HDR.size)
+    off = _PROBE_HDR.size + 4
+    if len(raw) < off + n * _SHARD_PAIR.size:
+        raise ValueError("shard probe truncated")
+    out = []
+    for _ in range(n):
+        sid, fp = _SHARD_PAIR.unpack_from(raw, off)
+        off += _SHARD_PAIR.size
+        out.append((sid, fp))
+    return out
+
+
+def encode_shard_session_summary(pairs, hashes, reply: bool) -> np.ndarray:
+    """Owner-scoped summary: the responder's (shard id, fingerprint)
+    for the session's diverged shards + path hashes of its entries in
+    them (the exclude set for the peer's push)."""
+    pairs = sorted((int(s), int(f) & _FP_MASK) for s, f in pairs)
+    h = np.asarray(sorted(int(x) & _FP_MASK for x in hashes), dtype="<u8")
+    raw = _SUMMARY_HDR.pack(
+        _MAGIC, _VERSION,
+        _FLAG_SHARD | (_FLAG_REPLY if reply else 0), 0,
+        len(pairs), len(h),
+    )
+    for sid, fp in pairs:
+        raw += _SHARD_PAIR.pack(sid, fp)
+    raw += h.tobytes()
+    return _to_i32(raw)
+
+
+def decode_shard_session_summary(
+    arr: np.ndarray,
+) -> tuple[list[tuple[int, int]], set[int], bool]:
+    """→ ((shard id, fingerprint) pairs, path-hash set, is_reply)."""
+    raw = np.ascontiguousarray(np.asarray(arr, dtype=np.int32)).tobytes()
+    if len(raw) < _SUMMARY_HDR.size:
+        raise ValueError(f"shard summary too short ({len(raw)} bytes)")
+    magic, version, flags, _, n_p, n_h = _SUMMARY_HDR.unpack_from(raw, 0)
+    if magic != _MAGIC or not flags & _FLAG_SHARD:
+        raise ValueError("not a shard-scoped repair summary")
+    if version != _VERSION:
+        raise ValueError(f"unsupported repair version {version}")
+    off = _SUMMARY_HDR.size
+    need = off + n_p * _SHARD_PAIR.size + 8 * n_h
+    if len(raw) < need:
+        raise ValueError(f"shard summary truncated ({len(raw)} < {need})")
+    pairs = []
+    for _ in range(n_p):
+        sid, fp = _SHARD_PAIR.unpack_from(raw, off)
+        off += _SHARD_PAIR.size
+        pairs.append((sid, fp))
+    hashes = np.frombuffer(raw, dtype="<u8", count=n_h, offset=off)
+    return pairs, {int(x) for x in hashes}, bool(flags & _FLAG_REPLY)
 
 
 # ---------------------------------------------------------------------------
@@ -345,11 +438,26 @@ class RepairPlane:
 
     def scan_once(self) -> int:
         """One detector pass (tests drive this directly; the thread calls
-        it on its timer). Returns the number of probes sent."""
+        it on its timer). Returns the number of probes sent.
+
+        Full replica: compare scalar tree fingerprints (any pair of
+        replicas must converge). Sharded (``mesh.sharded``): whole-tree
+        fingerprints diverge BY DESIGN, so the pass compares per-shard
+        fingerprints between CO-OWNERS only (``diverged_shards_with``),
+        and probes are shard-scoped. Storm control (staleness threshold,
+        per-peer backoff, budgets, probe-only-while-diverged) is shared
+        by both modes."""
         mesh = self.mesh
         now = time.monotonic()
-        my_fp = mesh.tree.fingerprint_ & _FP_MASK
-        fps = mesh.fleet.fingerprints()
+        sharded = bool(getattr(mesh, "sharded", False))
+        if sharded:
+            # Reporters of shard summaries are the comparable peer set
+            # (a peer that never summarized cannot be audited yet).
+            fps = mesh.fleet.shard_fingerprints()
+            my_fp = 0  # unused in sharded mode
+        else:
+            my_fp = mesh.tree.fingerprint_ & _FP_MASK
+            fps = mesh.fleet.fingerprints()
         # Forget peers that left the fleet view (decommissioned or
         # retained-out); a rejoiner starts a fresh episode.
         for rank in [r for r in self._peers if r not in fps]:
@@ -358,7 +466,13 @@ class RepairPlane:
         for rank, fp in fps.items():
             if rank == mesh.rank:
                 continue
-            if (fp & _FP_MASK) == my_fp:
+            if sharded:
+                diverged_sids = mesh.diverged_shards_with(rank)
+                converged = not diverged_sids
+            else:
+                diverged_sids = []
+                converged = (fp & _FP_MASK) == my_fp
+            if converged:
                 st = self._peers.pop(rank, None)
                 if st is not None:
                     # Episode healed: record how many rounds it took.
@@ -384,7 +498,12 @@ class RepairPlane:
             )
             if age < threshold or now < st["next_probe_at"]:
                 continue
-            if self._send_probe(rank):
+            sent = (
+                self._send_shard_probe(rank, diverged_sids)
+                if sharded
+                else self._send_probe(rank)
+            )
+            if sent:
                 probes += 1
                 st["probe_sent_at"] = now
                 st["rounds"] += 1
@@ -402,6 +521,30 @@ class RepairPlane:
             vec = self.mesh.tree.fingerprint_buckets()
         ok = self.mesh.send_repair(
             rank, OplogType.REPAIR_PROBE, encode_probe(vec),
+            bootstrap=bootstrap,
+        )
+        if ok:
+            self._m_probes_sent.inc()
+        return ok
+
+    def _send_shard_probe(
+        self, rank: int, sids, bootstrap: bool = False
+    ) -> bool:
+        """Owner-scoped probe: my (shard, fingerprint) pairs for the
+        shards I see diverged with ``rank`` (≤ bucket budget — a wide
+        divergence heals over several backed-off rounds)."""
+        budget = (
+            self.cfg.bootstrap_bucket_budget if bootstrap
+            else self.cfg.bucket_budget
+        )
+        sids = list(sids)[:budget]
+        if not sids:
+            return False
+        with self.mesh._lock:
+            mine = self.mesh.tree.shard_fingerprints()
+        pairs = [(sid, mine.get(sid, 0)) for sid in sids]
+        ok = self.mesh.send_repair(
+            rank, OplogType.REPAIR_PROBE, encode_shard_probe(pairs),
             bootstrap=bootstrap,
         )
         if ok:
@@ -428,7 +571,22 @@ class RepairPlane:
             },
         )
         st["bootstrap"] = True
-        if self._send_probe(rank, bootstrap=True):
+        mesh = self.mesh
+        if getattr(mesh, "sharded", False):
+            # Owner-scoped bootstrap: probe the donor for every shard we
+            # BOTH own (the joiner bootstraps only ITS shards — the
+            # whole point of sharded membership). Shards the donor does
+            # not co-own are pulled from their own owners by the
+            # steady-state sharded scan.
+            own = mesh.ownership
+            sids = [
+                sid for sid in own.owned_shards(mesh.rank)
+                if own.is_owner(rank, sid)
+            ] if own is not None else []
+            sent = self._send_shard_probe(rank, sids, bootstrap=True)
+        else:
+            sent = self._send_probe(rank, bootstrap=True)
+        if sent:
             now = time.monotonic()
             st["probe_sent_at"] = now
             st["rounds"] += 1
@@ -481,6 +639,9 @@ class RepairPlane:
 
     def _handle_probe(self, op: Oplog) -> None:
         self._m_probes_rcvd.inc()
+        if is_shard_frame(op.value):
+            self._handle_shard_probe(op)
+            return
         try:
             their_vec = decode_probe(op.value)
         except ValueError:
@@ -512,7 +673,92 @@ class RepairPlane:
         ):
             self._m_summaries.inc()
 
+    def _handle_shard_probe(self, op: Oplog) -> None:
+        """Owner-scoped probe answer: for every probed shard whose
+        fingerprint disagrees with ours, summarize our entries (path
+        hashes) so the initiator can push its one-sided set — and
+        include our per-shard fingerprints so it can diff symmetrically."""
+        try:
+            pairs = decode_shard_probe(op.value)
+        except ValueError:
+            self.log.warning(
+                "malformed shard probe from rank %d", op.origin_rank
+            )
+            return
+        bootstrap = self._is_bootstrap_session(op.origin_rank)
+        mesh = self.mesh
+        with mesh._lock:
+            mine = mesh.tree.shard_fingerprints()
+            diverged = [
+                sid for sid, fp in pairs
+                if (mine.get(sid, 0) & _FP_MASK) != (fp & _FP_MASK)
+            ]
+            my_pairs = [(sid, mine.get(sid, 0)) for sid in diverged]
+            hashes = [
+                mesh.tree.path_hash(n)
+                for nodes in mesh.tree.nodes_in_shards(diverged).values()
+                for n in nodes
+            ]
+        if mesh.send_repair(
+            op.origin_rank,
+            OplogType.REPAIR_SUMMARY,
+            encode_shard_session_summary(my_pairs, hashes, reply=False),
+            bootstrap=bootstrap,
+        ):
+            self._m_summaries.inc()
+
+    def _handle_shard_summary_frame(self, op: Oplog) -> None:
+        """Owner-scoped summary: push my one-sided entries for the
+        session's shards as sharded data re-emissions (they land on the
+        whole owner set, healing every co-owner in one push), then close
+        the exchange if I initiated it."""
+        try:
+            pairs, their_hashes, is_reply = decode_shard_session_summary(
+                op.value
+            )
+        except ValueError:
+            self.log.warning(
+                "malformed shard summary from rank %d", op.origin_rank
+            )
+            return
+        bootstrap = self._is_bootstrap_session(op.origin_rank)
+        sids = [sid for sid, _ in pairs]
+        keys, oplogs = self.mesh.repair_push_shards(
+            sids, their_hashes,
+            self.cfg.bootstrap_key_budget if bootstrap else self.cfg.key_budget,
+        )
+        if keys:
+            self._m_keys.inc(keys)
+            self._m_oplogs.inc(oplogs)
+        if not is_reply:
+            mesh = self.mesh
+            with mesh._lock:
+                mine = mesh.tree.shard_fingerprints()
+                my_pairs = [(sid, mine.get(sid, 0)) for sid in sids]
+                hashes = [
+                    mesh.tree.path_hash(n)
+                    for nodes in mesh.tree.nodes_in_shards(sids).values()
+                    for n in nodes
+                ]
+            if mesh.send_repair(
+                op.origin_rank,
+                OplogType.REPAIR_SUMMARY,
+                encode_shard_session_summary(my_pairs, hashes, reply=True),
+                bootstrap=bootstrap,
+            ):
+                self._m_summaries.inc()
+            self._m_rounds.inc()
+            st = self._peers.get(op.origin_rank)
+            sent_at = st["probe_sent_at"] if st else 0.0
+            if sent_at:
+                self._m_round_s.observe(
+                    max(0.0, time.monotonic() - sent_at)
+                )
+
     def _handle_summary(self, op: Oplog) -> None:
+        if is_shard_frame(op.value):
+            self._handle_shard_summary_frame(op)
+            return
         try:
             their_vec, buckets, their_hashes, is_reply = decode_summary(op.value)
         except ValueError:

@@ -1,0 +1,305 @@
+"""Prefix-ownership sharding: bounded-replication-factor placement.
+
+Full replication circulates every INSERT around the whole ring — 12
+frames / ~3 KB per insert at just 12 nodes, growing linearly with N
+(RINGSCALE_r05.json), which cannot reach hundreds of nodes. This module
+breaks that wall: the token space is partitioned into :data:`NUM_SHARDS`
+**subtree shards** (a key's shard is a pure hash of its first page — the
+subtree ROOT segment, so every prefix of a request lands in one shard),
+and each shard is owned by a bounded set of ``replication_factor``
+nodes, derived by a deterministic RF-successor walk on the consistent
+hash ring (``router/consistent_hash.py::get_nodes``). An insert is then
+delivered point-to-point to its owner set only: **bytes-per-insert is
+O(RF), independent of N**.
+
+Invariants (ARCHITECTURE.md "Sharded replication"):
+
+- **Deterministic derivation.** The :class:`OwnershipMap` is a pure
+  function of (alive P/D ranks, replication factor) — every node,
+  router included, derives an identical map from the same membership
+  view with zero coordination; the map carries the view epoch it was
+  derived from so readers can detect cross-epoch races.
+- **Single writer.** Only this module constructs ownership maps
+  (``tests/test_mesh_lint.py`` pins it): ``MeshCache`` re-derives via
+  :func:`build_ownership` on every adopted view change and only ever
+  swaps whole immutable maps, so a half-updated owner set can never be
+  observed.
+- **RF invariant.** Every shard has ``min(RF, N)`` distinct owners;
+  with N <= RF every node owns every shard (the full-replica
+  degeneracy). The PR 7 failover invariant "a survivor holds the
+  prefix" holds WITHIN the owner set: routers must fail over onto
+  owner replicas.
+- **Pull-through.** Non-owners may hold cached copies (the insert
+  origin keeps its locally-computed KV; a ``SHARD_PULL`` re-emits an
+  owner's entries to a non-owner serving fallback traffic) — copies
+  serve hits but are nobody's responsibility: convergence auditing and
+  anti-entropy compare only co-owners, per shard.
+
+``replication_factor = 0`` (the config default) disables all of this:
+the wire behavior is bit-for-bit the PR 1-7 full-replica ring.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Iterable, Sequence
+
+import numpy as np
+
+__all__ = [
+    "NUM_SHARDS",
+    "shard_of_tokens",
+    "OwnershipMap",
+    "build_ownership",
+    "encode_shard_summary",
+    "decode_shard_summary",
+    "ShardSummaryTable",
+]
+
+# Fixed shard space: small enough that the full per-shard fingerprint
+# set of one node fits a single gossip frame, large enough that RF·S/N
+# shards per node stays balanced into the hundreds of nodes.
+NUM_SHARDS = 64
+
+# Virtual nodes per rank on the ownership ring: more points = better
+# shard balance per rank at slightly more map-rebuild cost (rebuilds
+# happen only on membership change).
+_OWNER_VNODES = 8
+
+
+def shard_of_tokens(tokens: Sequence[int] | np.ndarray) -> int:
+    """Shard id of a subtree-root segment (the first page of a key).
+    Pure, stable across processes and versions within a deploy: blake2b
+    over the little-endian int32 token bytes, mod :data:`NUM_SHARDS`."""
+    arr = np.ascontiguousarray(np.asarray(tokens, dtype="<i4"))
+    if arr.size == 0:
+        return 0
+    h = hashlib.blake2b(arr.tobytes(), digest_size=8).digest()
+    return int.from_bytes(h, "little") % NUM_SHARDS
+
+
+class OwnershipMap:
+    """Immutable shard → owner-set table, derived from one membership
+    view. Constructed ONLY by :func:`build_ownership` (single-writer
+    lint); everything else treats instances as read-only values."""
+
+    __slots__ = ("epoch", "rf", "ranks", "owners", "_owned_by")
+
+    def __init__(
+        self,
+        epoch: int,
+        rf: int,
+        ranks: tuple[int, ...],
+        owners: tuple[tuple[int, ...], ...],
+    ):
+        self.epoch = epoch
+        self.rf = rf
+        self.ranks = ranks
+        self.owners = owners  # len NUM_SHARDS, each a tuple of ranks
+        owned: dict[int, list[int]] = {r: [] for r in ranks}
+        for sid, os_ in enumerate(owners):
+            for r in os_:
+                owned.setdefault(r, []).append(sid)
+        self._owned_by = {r: tuple(s) for r, s in owned.items()}
+
+    def owners_of(self, shard: int) -> tuple[int, ...]:
+        return self.owners[shard % NUM_SHARDS]
+
+    def primary(self, shard: int) -> int | None:
+        os_ = self.owners[shard % NUM_SHARDS]
+        return os_[0] if os_ else None
+
+    def is_owner(self, rank: int, shard: int) -> bool:
+        return rank in self.owners[shard % NUM_SHARDS]
+
+    def owned_shards(self, rank: int) -> tuple[int, ...]:
+        return self._owned_by.get(rank, ())
+
+    def __repr__(self) -> str:
+        return (
+            f"OwnershipMap(epoch={self.epoch}, rf={self.rf}, "
+            f"ranks={len(self.ranks)})"
+        )
+
+
+def build_ownership(
+    alive_ranks: Iterable[int],
+    rf: int,
+    epoch: int,
+    is_prefill=None,
+) -> OwnershipMap:
+    """Derive the ownership map for one membership view: consistent-hash
+    the alive P/D ranks, then take the deterministic RF-successor walk
+    per shard. The sole constructor of :class:`OwnershipMap`.
+
+    ``is_prefill`` (rank → bool), when given, makes ownership
+    **role-aware**: each shard gets ``min(rf, role size)`` owners from
+    EACH serving role's ring (prefill owners listed first). Both roles
+    serve prefix KV for their half of a request, and the PR 7 failover
+    invariant — "a survivor holds the prefix" — must hold per role: a
+    joint walk could hand a shard three prefill owners and leave a
+    crashed decode node's streams with no owner replica to resurrect
+    on. ``None`` (role-blind) walks one joint ring — the cache-only /
+    single-role topologies."""
+    # Deferred import: the router PACKAGE pulls in cache_aware_router →
+    # mesh_cache → this module at import time; by the first map build
+    # (MeshCache construction) the cycle has resolved.
+    from radixmesh_tpu.router.consistent_hash import ConsistentHash
+
+    ranks = tuple(sorted(int(r) for r in alive_ranks))
+    groups: list[tuple[int, ...]]
+    if is_prefill is None:
+        groups = [ranks]
+    else:
+        pf = tuple(r for r in ranks if is_prefill(r))
+        dc = tuple(r for r in ranks if not is_prefill(r))
+        groups = [g for g in (pf, dc) if g]
+    rings = [
+        ConsistentHash(
+            (f"rank:{r}" for r in g), virtual_nodes=_OWNER_VNODES
+        )
+        for g in groups
+    ]
+    owners = tuple(
+        tuple(
+            int(name.split(":", 1)[1])
+            for ring in rings
+            for name in ring.get_nodes(f"shard:{sid}", max(1, rf))
+        )
+        for sid in range(NUM_SHARDS)
+    )
+    return OwnershipMap(epoch=epoch, rf=rf, ranks=ranks, owners=owners)
+
+
+# ---------------------------------------------------------------------------
+# SHARD_SUMMARY wire payload: the router's routing currency.
+#
+# One frame per node per summary interval, carrying for each shard the
+# node OWNS: the shard's incremental fingerprint (per-shard convergence
+# audit — whole-tree fingerprints diverge BY DESIGN under sharding) and
+# a bounded set of (root-page path hash, deepest cached token length)
+# entries — enough for a router holding NO replica to answer "is this
+# subtree warm, and roughly how deep". Rides ``Oplog.value`` as an int32
+# array, the same pattern as NodeDigest / the repair payloads.
+# ---------------------------------------------------------------------------
+
+_MAGIC = 0x5D
+_VERSION = 1
+_HDR = struct.Struct("<BBHi")  # magic, version, n_shards, origin_rank
+_SHARD_HDR = struct.Struct("<iQI")  # sid, fingerprint, n_roots
+_ROOT = struct.Struct("<QI")  # root-page path hash, deepest length (tokens)
+
+# Per-frame ceiling on root entries: a pathological shard summarizes its
+# deepest roots first and truncates — the router then under-reports
+# warmth (a miss-routed request re-misses; cache semantics), never
+# overflows the frame.
+MAX_SUMMARY_ROOTS = 256
+
+
+def _to_i32(raw: bytes) -> np.ndarray:
+    """Pad-to-4 + int32 view: the one definition of how byte payloads
+    ride ``Oplog.value`` (repair_plane imports this — two copies of the
+    padding rule could drift into frames one decoder rejects)."""
+    pad = (-len(raw)) % 4
+    return np.frombuffer(raw + b"\x00" * pad, dtype=np.int32).copy()
+
+
+def encode_shard_summary(
+    origin_rank: int,
+    shards: dict[int, tuple[int, list[tuple[int, int]]]],
+) -> np.ndarray:
+    """``shards``: sid → (fingerprint, [(root_hash, deepest_len), ...])."""
+    parts = [_HDR.pack(_MAGIC, _VERSION, len(shards), origin_rank)]
+    budget = MAX_SUMMARY_ROOTS
+    for sid in sorted(shards):
+        fp, roots = shards[sid]
+        take = roots[: max(0, budget)]
+        budget -= len(take)
+        parts.append(_SHARD_HDR.pack(int(sid), fp & ((1 << 64) - 1), len(take)))
+        for h, depth in take:
+            parts.append(_ROOT.pack(int(h) & ((1 << 64) - 1), int(depth)))
+    return _to_i32(b"".join(parts))
+
+
+def decode_shard_summary(
+    arr: np.ndarray,
+) -> tuple[int, dict[int, tuple[int, list[tuple[int, int]]]]]:
+    """→ (origin rank, sid → (fingerprint, [(root_hash, deepest_len)]))."""
+    raw = np.ascontiguousarray(np.asarray(arr, dtype=np.int32)).tobytes()
+    if len(raw) < _HDR.size:
+        raise ValueError(f"shard summary too short ({len(raw)} bytes)")
+    magic, version, n_shards, origin = _HDR.unpack_from(raw, 0)
+    if magic != _MAGIC:
+        raise ValueError(f"bad shard-summary magic {magic:#x}")
+    if version != _VERSION:
+        raise ValueError(f"unsupported shard-summary version {version}")
+    off = _HDR.size
+    out: dict[int, tuple[int, list[tuple[int, int]]]] = {}
+    for _ in range(n_shards):
+        if len(raw) < off + _SHARD_HDR.size:
+            raise ValueError("shard summary truncated (shard header)")
+        sid, fp, n_roots = _SHARD_HDR.unpack_from(raw, off)
+        off += _SHARD_HDR.size
+        if len(raw) < off + n_roots * _ROOT.size:
+            raise ValueError("shard summary truncated (roots)")
+        roots = []
+        for _ in range(n_roots):
+            h, depth = _ROOT.unpack_from(raw, off)
+            off += _ROOT.size
+            roots.append((h, depth))
+        out[sid] = (fp, roots)
+    return origin, out
+
+
+class ShardSummaryTable:
+    """Router-side fold of per-rank shard summaries: the compact replica
+    substitute. Reads run on the routing hot path; folds arrive on the
+    mesh transport reader thread — callers serialize with the mesh lock
+    (the table itself is swap-on-fold per rank, so torn reads cannot
+    observe a half-written summary)."""
+
+    def __init__(self):
+        # rank → sid → (fingerprint, {root_hash: deepest_len})
+        self._by_rank: dict[int, dict[int, tuple[int, dict[int, int]]]] = {}
+
+    def fold(
+        self,
+        rank: int,
+        shards: dict[int, tuple[int, list[tuple[int, int]]]],
+    ) -> None:
+        self._by_rank[rank] = {
+            sid: (fp, {h: d for h, d in roots})
+            for sid, (fp, roots) in shards.items()
+        }
+
+    def forget(self, rank: int) -> None:
+        self._by_rank.pop(rank, None)
+
+    def retain(self, ranks) -> None:
+        keep = set(ranks)
+        for r in [r for r in self._by_rank if r not in keep]:
+            del self._by_rank[r]
+
+    def lookup(self, sid: int, root_hash: int) -> dict[int, int]:
+        """rank → deepest cached length, over every rank whose summary
+        for ``sid`` contains ``root_hash`` (the warm set)."""
+        out: dict[int, int] = {}
+        for rank, shards in self._by_rank.items():
+            entry = shards.get(sid)
+            if entry is None:
+                continue
+            depth = entry[1].get(root_hash)
+            if depth is not None:
+                out[rank] = depth
+        return out
+
+    def shard_fp(self, rank: int, sid: int) -> int | None:
+        shards = self._by_rank.get(rank)
+        if shards is None:
+            return None
+        entry = shards.get(sid)
+        return entry[0] if entry is not None else None
+
+    def ranks(self) -> list[int]:
+        return sorted(self._by_rank)

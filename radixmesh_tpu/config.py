@@ -56,6 +56,16 @@ class MeshConfig:
     topology: str = "ring"
     # Group size for topology="hier"; 0 = auto (~sqrt(ring size)).
     group_size: int = 0
+    # Prefix-ownership sharding (cache/sharding.py): bounded replication
+    # factor. 0 = full replication (every insert circulates the whole
+    # ring — the documented compatibility mode, bit-for-bit the old
+    # wire); N >= 1 = each subtree shard is owned by min(N, ring size)
+    # consistent-hash successors and inserts are delivered point-to-
+    # point to the owner set only (bytes-per-insert O(RF), not O(N)).
+    replication_factor: int = 0
+    # Shard-summary gossip cadence under sharding (the router's routing
+    # table + co-owner convergence feed). 0 = the tick interval.
+    shard_summary_interval_s: float = 0.0
     # Cache sizing: number of KV slots (tokens) the paged pool holds.
     num_kv_slots: int = 65536
     # Replica-size bound (tokens) for the mesh tree. Serving inserts every
@@ -254,6 +264,18 @@ class MeshConfig:
         all_nodes = self.prefill_nodes + self.decode_nodes + self.router_nodes
         if len(set(all_nodes)) != len(all_nodes):
             raise ValueError("node addresses must be unique across roles")
+        if self.replication_factor < 0:
+            raise ValueError("replication_factor must be >= 0 (0 = full replica)")
+        if self.shard_summary_interval_s < 0:
+            raise ValueError("shard_summary_interval_s must be >= 0")
+        if self.replication_factor > 0 and self.topology == "hier":
+            # The hierarchy exists to shorten the full-replica lap; the
+            # owner-addressed plane replaces the lap entirely. Composing
+            # them would mean two delivery topologies for one insert.
+            raise ValueError(
+                "replication_factor > 0 requires topology: ring "
+                "(sharded delivery replaces the hier lap)"
+            )
         if self.repair_interval_s < 0 or self.repair_age_threshold_s < 0:
             raise ValueError("repair timers must be >= 0")
         if self.repair_key_budget < 1:
@@ -305,6 +327,8 @@ def load_config(path: str) -> MeshConfig:
         "page_size",
         "topology",
         "group_size",
+        "replication_factor",
+        "shard_summary_interval_s",
         "num_kv_slots",
         "mesh_max_tokens",
         "gc_interval_s",
@@ -340,6 +364,10 @@ def load_config(path: str) -> MeshConfig:
         page_size=int(raw.get("page_size", 1)),
         topology=raw.get("topology", "ring"),
         group_size=int(raw.get("group_size", 0)),
+        replication_factor=int(raw.get("replication_factor", 0)),
+        shard_summary_interval_s=float(
+            raw.get("shard_summary_interval_s", 0.0)
+        ),
         num_kv_slots=int(raw.get("num_kv_slots", 65536)),
         mesh_max_tokens=int(raw.get("mesh_max_tokens", 1 << 20)),
         gc_interval_s=float(raw.get("gc_interval_s", 10.0)),

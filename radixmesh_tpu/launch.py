@@ -84,10 +84,22 @@ def _run_node(args: argparse.Namespace) -> int:
     from radixmesh_tpu.server.http_frontend import RouterFrontend
 
     cfg = load_config(args.config_file)
+    if args.replication_factor is not None:
+        # Prefix-ownership sharding override (cache/sharding.py): must be
+        # IDENTICAL on every node of the cluster (the ownership map is a
+        # pure function of the shared view + this factor), same contract
+        # as every other config key. 0 = full replica.
+        cfg.replication_factor = int(args.replication_factor)
+        cfg.validate()
     role, rank, _ = cfg.local_identity()
     configure_logger(f"{role.value}@{rank}")
     log = get_logger("launch")
     _configure_tracing(args)
+    if cfg.replication_factor > 0:
+        log.info(
+            "prefix-ownership sharding ON (replication factor %d)",
+            cfg.replication_factor,
+        )
 
     # Chaos/fault-injection plane (comm/faults.py): installed BEFORE the
     # node opens any transport so every channel — ring, spine, router
@@ -556,6 +568,15 @@ def main(argv: list[str] | None = None) -> int:
         "and open bounded repair sessions with stale-diverged peers; "
         "overrides the config's repair_interval_s; 0 disables (detect-"
         "only). Needs --fleet-digest-interval somewhere in the fleet",
+    )
+    node.add_argument(
+        "--replication-factor", type=int, default=None, metavar="RF",
+        help="prefix-ownership sharding (cache/sharding.py): each subtree "
+        "shard is owned by RF consistent-hash successors and inserts are "
+        "delivered point-to-point to the owner set only — bytes-per-"
+        "insert O(RF) instead of O(ring size). Must be identical on every "
+        "node. 0 (the default) = full replication, bit-for-bit the old "
+        "ring wire",
     )
     node.add_argument(
         "--chaos-plan", default=None, metavar="FILE",

@@ -293,6 +293,16 @@ class FleetView:
         # (lo, hi) rank pair → wall time their fingerprints were first
         # seen unequal; absent = currently equal (or a side unknown).
         self._diverged_at: dict[tuple[int, int], float] = {}
+        # Per-rank per-shard fingerprints (prefix-ownership sharding,
+        # cache/sharding.py): folded whole-summary-at-a-time from
+        # SHARD_SUMMARY gossip. Under sharding whole-tree fingerprints
+        # diverge BY DESIGN, so convergence auditing compares these —
+        # per shard, across the ranks that own (and therefore report)
+        # it — instead of the scalar digest fingerprint.
+        self._shard_fps: dict[int, dict[int, int]] = {}
+        # (sid, lo rank, hi rank) → wall time the pair's fingerprints
+        # for that shard were first seen unequal.
+        self._shard_diverged_at: dict[tuple[int, int, int], float] = {}
         # Ranks that announced a PLANNED departure (LEAVE oplog): their
         # straggler digests are refused so a frozen fingerprint cannot
         # re-enter the convergence audit or pin min_score after the
@@ -385,10 +395,12 @@ class FleetView:
 
     def _forget_locked(self, rank: int) -> None:
         for store in (self._digests, self._prev, self._stalled,
-                      self._storm_rate):
+                      self._storm_rate, self._shard_fps):
             store.pop(rank, None)
         for pair in [p for p in self._diverged_at if rank in p]:
             del self._diverged_at[pair]
+        for key in [k for k in self._shard_diverged_at if rank in k[1:]]:
+            del self._shard_diverged_at[key]
 
     def mark_left(self, rank: int) -> None:
         """Record a planned departure: ``lifecycle_of`` answers "left"
@@ -409,6 +421,61 @@ class FleetView:
         the scan runs every repair interval on every node)."""
         with self._lock:
             return {r: d.fingerprint for r, d in self._digests.items()}
+
+    def fold_shard_fps(self, rank: int, fps: dict[int, int]) -> None:
+        """Fold one rank's per-owned-shard fingerprints (whole-summary
+        swap — a summary always carries the rank's complete owned set,
+        so stale shard entries cannot linger after an ownership change).
+        Updates the per-shard divergence clocks against every other
+        reporter of the same shard."""
+        now = self._now()
+        mask = (1 << 64) - 1
+        fps = {int(s): int(f) & mask for s, f in fps.items()}
+        with self._lock:
+            self._shard_fps[rank] = fps
+            for other_rank, other in self._shard_fps.items():
+                if other_rank == rank:
+                    continue
+                lo, hi = min(rank, other_rank), max(rank, other_rank)
+                for sid in set(fps) | set(other):
+                    key = (sid, lo, hi)
+                    a, b = fps.get(sid), other.get(sid)
+                    if a is None or b is None or a == b:
+                        # Not co-reported (owners report only owned
+                        # shards, so co-reporting ⇔ co-ownership) or
+                        # agreeing: the pair is not diverged on it.
+                        self._shard_diverged_at.pop(key, None)
+                    else:
+                        self._shard_diverged_at.setdefault(key, now)
+
+    def shard_fps(self, rank: int) -> dict[int, int]:
+        """One rank's last-summarized shard fingerprints ({} = none
+        seen) — the repair plane's owner-scoped scan input."""
+        with self._lock:
+            return dict(self._shard_fps.get(rank, {}))
+
+    def shard_fingerprints(self) -> dict[int, dict[int, int]]:
+        with self._lock:
+            return {r: dict(f) for r, f in self._shard_fps.items()}
+
+    def shard_convergence(self) -> dict:
+        """Owner-scoped convergence audit (the sharded counterpart of
+        :meth:`convergence`): a pair of ranks is compared ONLY on shards
+        both report (= both own); ``converged`` means no co-reported
+        shard currently disagrees anywhere in the fleet."""
+        now = self._now()
+        with self._lock:
+            diverged = {}
+            for (sid, a, b), since in self._shard_diverged_at.items():
+                diverged[f"s{sid}:{a}-{b}"] = max(0.0, now - since)
+            reporters = len(self._shard_fps)
+        max_age = max(diverged.values(), default=0.0)
+        return {
+            "diverged": diverged,
+            "max_convergence_age_s": round(max_age, 3),
+            "converged": not diverged,
+            "reporters": reporters,
+        }
 
     def lifecycle_of(self, rank: int) -> str:
         """One rank's gossiped membership-lifecycle state ("active" for
@@ -526,11 +593,18 @@ class FleetView:
     def snapshot(self) -> dict:
         """The ``/cluster/telemetry`` body."""
         digs = self.digests()
-        return {
+        out = {
             "nodes": {str(r): d.as_dict() for r, d in sorted(digs.items())},
             "convergence": self.convergence(),
             "folds": self.folds,
         }
+        with self._lock:
+            sharded = bool(self._shard_fps)
+        if sharded:
+            # Under sharding the scalar audit reads diverged by design;
+            # the owner-scoped one is the meaningful signal.
+            out["shard_convergence"] = self.shard_convergence()
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -70,7 +70,6 @@ __all__ = [
     "lifecycle_from_code",
 ]
 
-_FP_MASK = (1 << 64) - 1
 
 
 class LifecycleState(enum.Enum):
@@ -352,7 +351,6 @@ class LifecyclePlane:
             return
         now = self.clock()
         mesh = self.mesh
-        my_fp = mesh.tree.fingerprint_ & _FP_MASK
         donor = self.choose_donor()
         if donor is None:
             # No ACTIVE peer to learn from. If every KNOWN peer replica
@@ -362,17 +360,15 @@ class LifecyclePlane:
             # all into the full grace window for no benefit (an empty
             # fleet has no hits to withhold). Otherwise gossip may still
             # be in flight: wait out the grace window, then serve.
-            peer_fps = {
-                r: f
-                for r, f in mesh.fleet.fingerprints().items()
-                if r != mesh.rank
-            }
-            if peer_fps and all(
-                (f & _FP_MASK) == my_fp for f in peer_fps.values()
+            # (Convergence is the mesh's call — scalar fingerprints full
+            # replica, per-co-owned-shard under sharding.)
+            peers = mesh.convergence_peers()
+            if peers and all(
+                mesh.bootstrap_converged_with(r) for r in peers
             ):
                 self.log.info(
                     "bootstrap: all %d known peers already converged with "
-                    "this replica — going active", len(peer_fps),
+                    "this replica — going active", len(peers),
                 )
                 self._become_active(now)
                 return
@@ -384,8 +380,7 @@ class LifecyclePlane:
                 self._become_active(now)
             return
         self.bootstrap_donor = donor
-        donor_fp = mesh.fleet.fingerprints().get(donor)
-        if donor_fp is not None and (donor_fp & _FP_MASK) == my_fp:
+        if mesh.bootstrap_converged_with(donor):
             self.log.info(
                 "bootstrap: converged with donor rank %d after %d rounds",
                 donor, self.bootstrap_rounds,
@@ -524,6 +519,20 @@ class LifecyclePlane:
         elif self.writeback_fn is not None:
             stats["writeback_tokens"] = int(self.writeback_fn() or 0)
             stats["writeback_flushed"] = True
+        # 5b. Sharded ownership transfer (cache/sharding.py): hand each
+        #     owned shard's entries to the ranks that BECOME owners once
+        #     this node leaves — the RF invariant must survive the
+        #     departure without waiting out anti-entropy. No-op on a
+        #     full-replica mesh (everyone already has everything).
+        mesh = self.mesh
+        if getattr(mesh, "sharded", False):
+            try:
+                stats["shard_transfer"] = mesh.handoff_owned_shards()
+                mesh.flush_outbound(self.cfg.leave_confirm_s)
+            except Exception:  # noqa: BLE001 — a transfer bug must not wedge the drain
+                self.log.exception("shard handoff failed")
+                stats["shard_transfer"] = {"shards": 0, "entries": 0,
+                                           "targets": 0}
         # 6. LEAVE: peers drop this node from the view as a PLANNED
         #    departure (cause="left" — failure detection never fires,
         #    FleetView state is forgotten, not left to rot). The frame
@@ -535,7 +544,6 @@ class LifecyclePlane:
         #    no-ops on peers that already adopted it), so surviving any
         #    ONE of them suffices, and tick-piggybacked view gossip
         #    spreads it from there.
-        mesh = self.mesh
         retries = max(1, self.cfg.leave_retries)
         for i in range(retries):
             mesh.broadcast_leave()

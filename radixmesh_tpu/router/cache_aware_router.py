@@ -155,14 +155,22 @@ class CacheAwareRouter:
         self.prefetch_window_s = prefetch_window_s
         self._prefetch_sent: dict[tuple[int, int], float] = {}
         self._prefetch_lock = threading.Lock()
+        # Prefix-ownership sharding (cache/sharding.py): the mesh
+        # replica is summary-only, routing prefers OWNER replicas for
+        # hits, failover, and fallback (the PR 7 invariant "a survivor
+        # holds the prefix" holds within the owner set), and a warm hit
+        # landing on a non-owner fires a pull-through so the target's
+        # replica fills before the traffic pattern repeats.
+        self.sharded = bool(getattr(mesh_cache, "sharded", False))
         # Hints leave the ROUTE HOT PATH through this bounded queue and
         # a single daemon sender: the wire send (channel dial, bounded
         # try_send) must never add to a /route response, and drop-on-
-        # overflow is exactly the fire-and-forget contract.
+        # overflow is exactly the fire-and-forget contract. Pull-through
+        # requests ride the same queue (tagged tuples).
         self._prefetch_q: deque = deque(maxlen=256)
         self._prefetch_evt = threading.Event()
         self._prefetch_thread: threading.Thread | None = None
-        if prefetch_hints:
+        if prefetch_hints or self.sharded:
             self._prefetch_thread = threading.Thread(
                 target=self._prefetch_sender, daemon=True,
                 name="router-prefetch",
@@ -319,12 +327,12 @@ class CacheAwareRouter:
                 self._prefetch_sent = {
                     k: t for k, t in self._prefetch_sent.items() if t >= cutoff
                 }
-            self._prefetch_q.append((prefix, rank))
+            self._prefetch_q.append(("hint", prefix, rank))
         self._prefetch_evt.set()
 
     def _prefetch_sender(self) -> None:
         """Daemon drain of the hint queue — the only place router
-        prefetches touch a transport."""
+        prefetches (and sharded pull-throughs) touch a transport."""
         while True:
             with self._prefetch_lock:
                 item = self._prefetch_q.popleft() if self._prefetch_q else None
@@ -333,9 +341,67 @@ class CacheAwareRouter:
                 self._prefetch_evt.clear()
                 continue
             try:
-                self.mesh_cache.send_prefetch(item[0], item[1])
+                if item[0] == "pull":
+                    self.mesh_cache.send_shard_pull(item[1], item[2], item[3])
+                else:
+                    self.mesh_cache.send_prefetch(item[1], item[2])
             except Exception:  # noqa: BLE001 — hints are droppable by contract
                 pass
+
+    def _owner_addrs(self, key: Sequence[int], role: str) -> list[str]:
+        """Ordered owner-replica addresses of ``key``'s shard for one
+        role (empty when unsharded) — the preferred hit/failover/
+        fallback targets under sharding."""
+        if not self.sharded:
+            return []
+        out = []
+        for rank in self.mesh_cache.owner_ranks(key):
+            if (role == "prefill") != self.config.is_prefill_rank(rank):
+                continue
+            addr = self._addr_of_rank.get(rank)
+            if addr is not None:
+                out.append(addr)
+        return out
+
+    def _pick(self, role: str, key: Sequence[int], exclude) -> str | None:
+        """One fallback choice: owner replicas first (sharded — traffic
+        for a subtree concentrates where its inserts land, and failover
+        must land on a replica that HOLDS the prefix), then the role's
+        consistent-hash ring."""
+        exclude = exclude or set()
+        for addr in self._owner_addrs(key, role):
+            if addr not in exclude:
+                return addr
+        ring = self._prefill_ring if role == "prefill" else self._decode_ring
+        return ring.get_node(key, exclude=exclude or None)
+
+    def _maybe_pull_through(
+        self, key: Sequence[int], match_len: int, addr: str | None
+    ) -> None:
+        """A warm subtree is being served by a NON-owner (shed/withheld/
+        ring fallback): queue a pull-through so an owner re-emits the
+        prefix to that node before the pattern repeats. Deduped through
+        the same window as prefetch hints."""
+        if not self.sharded or addr is None or match_len <= 0:
+            return
+        target = self._rank_of_addr.get(addr)
+        if target is None:
+            return
+        owners = [
+            r for r in self.mesh_cache.owner_ranks(key) if r != target
+        ]
+        if not owners or target in self.mesh_cache.owner_ranks(key):
+            return
+        prefix = np.asarray(key[:match_len], dtype=np.int32)
+        dedupe = (target, hash(prefix.tobytes()))
+        now = time.monotonic()
+        with self._prefetch_lock:
+            last = self._prefetch_sent.get(dedupe, 0.0)
+            if now - last < self.prefetch_window_s:
+                return
+            self._prefetch_sent[dedupe] = now
+            self._prefetch_q.append(("pull", prefix, owners[0], target))
+        self._prefetch_evt.set()
 
     def cache_aware_route(
         self, key: Sequence[int], exclude: Sequence[str] | None = None
@@ -371,6 +437,11 @@ class CacheAwareRouter:
     ) -> RouteResult:
         if self._warm_up:
             match = RouterMatchResult(-1, -1)
+        elif self.sharded:
+            # Summary-based match: the router holds no tree replica
+            # under sharding — per-shard summaries (fingerprints + root
+            # depths) gossiped by the owners stand in for it.
+            match = self.mesh_cache.shard_route(key)
         else:
             match = self.mesh_cache.match_prefix(key)
             assert isinstance(match, RouterMatchResult), (
@@ -396,9 +467,11 @@ class CacheAwareRouter:
                 # makes a resurrected request's re-prefill nearly free.
                 # No survivor at all is NOT a failover (nothing was
                 # re-placed): plain fallback-to-None, no preserved match.
-                alt = self._prefill_ring.get_node(
-                    key, exclude={prefill_addr} | avoid
-                ) or self._prefill_ring.get_node(key, exclude=exclude)
+                # Sharded: owner replicas are tried first — they are the
+                # only nodes guaranteed to hold the prefix (RF invariant).
+                alt = self._pick(
+                    "prefill", key, {prefill_addr} | avoid
+                ) or self._pick("prefill", key, exclude)
                 p_hit = False
                 if alt is not None:
                     prefill_addr, p_out, p_fo = alt, "failover", True
@@ -408,16 +481,14 @@ class CacheAwareRouter:
                 # Cold (bootstrapping) or departing replica: the hit is
                 # not servable there — hash-ring fallback instead.
                 self.withheld_hits += 1
-                alt = self._prefill_ring.get_node(
-                    key, exclude={prefill_addr} | avoid
-                ) or self._prefill_ring.get_node(key, exclude=lc_excluded or None)
+                alt = self._pick(
+                    "prefill", key, {prefill_addr} | avoid
+                ) or self._pick("prefill", key, lc_excluded)
                 if alt is not None:
                     prefill_addr = alt
                 p_hit, p_out = False, "withheld"
             elif self._overloaded("prefill", prefill_addr, sick):
-                shed = self._prefill_ring.get_node(
-                    key, exclude={prefill_addr} | avoid
-                )
+                shed = self._pick("prefill", key, {prefill_addr} | avoid)
                 if shed is not None:
                     prefill_addr, p_hit, p_out = shed, False, "shed"
         else:
@@ -428,18 +499,18 @@ class CacheAwareRouter:
             # only when literally nothing else exists (dead addresses
             # stay excluded even then: None means "no capacity").
             prefill_addr = (
-                self._prefill_ring.get_node(key, exclude=avoid or None)
-                or self._prefill_ring.get_node(key, exclude=lc_excluded or None)
-                or self._prefill_ring.get_node(key, exclude=exclude or None)
+                self._pick("prefill", key, avoid)
+                or self._pick("prefill", key, lc_excluded)
+                or self._pick("prefill", key, exclude)
             )
             p_hit = False
         if match.decode_rank >= 0:
             decode_addr = self.config.decode_addr(match.decode_rank)
             d_hit = True
             if decode_addr in exclude:
-                alt = self._decode_ring.get_node(
-                    key, exclude={decode_addr} | avoid
-                ) or self._decode_ring.get_node(key, exclude=exclude)
+                alt = self._pick(
+                    "decode", key, {decode_addr} | avoid
+                ) or self._pick("decode", key, exclude)
                 d_hit = False
                 if alt is not None:
                     decode_addr, d_out, d_fo = alt, "failover", True
@@ -447,23 +518,21 @@ class CacheAwareRouter:
                     decode_addr = None
             elif match.decode_rank in withhold:
                 self.withheld_hits += 1
-                alt = self._decode_ring.get_node(
-                    key, exclude={decode_addr} | avoid
-                ) or self._decode_ring.get_node(key, exclude=lc_excluded or None)
+                alt = self._pick(
+                    "decode", key, {decode_addr} | avoid
+                ) or self._pick("decode", key, lc_excluded)
                 if alt is not None:
                     decode_addr = alt
                 d_hit, d_out = False, "withheld"
             elif self._overloaded("decode", decode_addr, sick):
-                shed = self._decode_ring.get_node(
-                    key, exclude={decode_addr} | avoid
-                )
+                shed = self._pick("decode", key, {decode_addr} | avoid)
                 if shed is not None:
                     decode_addr, d_hit, d_out = shed, False, "shed"
         else:
             decode_addr = (
-                self._decode_ring.get_node(key, exclude=avoid or None)
-                or self._decode_ring.get_node(key, exclude=lc_excluded or None)
-                or self._decode_ring.get_node(key, exclude=exclude or None)
+                self._pick("decode", key, avoid)
+                or self._pick("decode", key, lc_excluded)
+                or self._pick("decode", key, exclude)
             )
             d_hit = False
         if self.prefetch_hints and match.match_len > 0:
@@ -474,6 +543,13 @@ class CacheAwareRouter:
                 self._maybe_prefetch(key, match.match_len, match.prefill_rank)
             if d_hit and match.decode_rank >= 0:
                 self._maybe_prefetch(key, match.match_len, match.decode_rank)
+        if self.sharded and match.match_len > 0:
+            # A warm subtree landing on a NON-owner (shed, withheld,
+            # failover residue, or a role with no owner replica): fill
+            # that node's replica from an owner so the next request of
+            # this pattern hits locally.
+            self._maybe_pull_through(key, match.match_len, prefill_addr)
+            self._maybe_pull_through(key, match.match_len, decode_addr)
         if prefill_addr is not None:
             self._loads.note(prefill_addr)
         if decode_addr is not None:

@@ -56,6 +56,14 @@ class ConsistentHash:
                     self._ring.remove(h)
                     del self._owner[h]
 
+    @staticmethod
+    def _key_bytes(key: Sequence[int] | bytes | str) -> bytes:
+        if isinstance(key, str):
+            return key.encode()
+        if isinstance(key, bytes):
+            return key
+        return b",".join(str(int(t)).encode() for t in key)
+
     def get_node(
         self,
         key: Sequence[int] | bytes | str,
@@ -65,15 +73,7 @@ class ConsistentHash:
         whose owner is not in ``exclude`` (overload shedding needs the
         next-best owner when the natural one is the node being avoided);
         ``None`` when every owner is excluded."""
-        if not self._ring:
-            return None
-        if isinstance(key, str):
-            data = key.encode()
-        elif isinstance(key, bytes):
-            data = key
-        else:
-            data = b",".join(str(int(t)).encode() for t in key)
-        h = _hash32(data)
+        h = _hash32(self._key_bytes(key))
         with self._lock:
             if not self._ring:
                 return None
@@ -83,6 +83,39 @@ class ConsistentHash:
                 if not exclude or owner not in exclude:
                     return owner
             return None
+
+    def get_nodes(
+        self,
+        key: Sequence[int] | bytes | str,
+        n: int,
+        exclude: set[str] | None = None,
+    ) -> list[str]:
+        """The first ``n`` DISTINCT owners clockwise from hash(key) — the
+        replication-factor successor walk (cache/sharding.py): owner sets
+        are a deterministic pure function of (ring membership, key), so
+        every node derives the same set from the same view with no
+        coordination. Wraps around the ring; returns fewer than ``n``
+        when the ring holds fewer distinct nodes (the N < RF degeneracy —
+        every node owns everything). Walk order is preserved: the first
+        entry is the natural single owner (``get_node``'s answer)."""
+        if n <= 0:
+            return []
+        h = _hash32(self._key_bytes(key))
+        out: list[str] = []
+        seen: set[str] = set()
+        with self._lock:
+            if not self._ring:
+                return []
+            idx = bisect.bisect_right(self._ring, h)
+            for step in range(len(self._ring)):
+                owner = self._owner[self._ring[(idx + step) % len(self._ring)]]
+                if owner in seen or (exclude and owner in exclude):
+                    continue
+                seen.add(owner)
+                out.append(owner)
+                if len(out) == n:
+                    break
+        return out
 
     def __len__(self) -> int:
         with self._lock:

@@ -680,6 +680,57 @@ def run_fleet_churn_workload(
         InprocHub.reset_default()
 
 
+def _pair_converged(a, b) -> bool:
+    """Two replicas agree: scalar fingerprints on a full-replica mesh;
+    per CO-OWNED shard under sharding (whole-tree fingerprints diverge
+    by design there — cache/sharding.py)."""
+    if not getattr(a, "sharded", False):
+        return a.tree.fingerprint_ == b.tree.fingerprint_
+    afp, bfp = a.tree.shard_fingerprints(), b.tree.shard_fingerprints()
+    own = a.ownership
+    if own is None:
+        return True
+    return all(
+        afp.get(sid, 0) == bfp.get(sid, 0)
+        for sid in own.owned_shards(a.rank)
+        if own.is_owner(b.rank, sid)
+    )
+
+
+def _trees_converged(nodes, router_mesh=None) -> bool:
+    """Fleet-wide convergence predicate for the chaos gates. Full
+    replica: one fingerprint across every node (router included — its
+    rank-only replica hashes value-blind). Sharded: every shard's owner
+    set agrees on that shard's fingerprint, and (when given) the router
+    mesh's gossip-fed shard-convergence audit concurs."""
+    from radixmesh_tpu.config import NodeRole
+
+    sharded = any(getattr(n, "sharded", False) for n in nodes)
+    if not sharded:
+        if len({n.tree.fingerprint_ for n in nodes}) != 1:
+            return False
+        if router_mesh is not None:
+            return bool(router_mesh.fleet.convergence()["converged"])
+        return True
+    ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+    by_rank = {n.rank: n for n in ring}
+    for n in ring:
+        own = n.ownership
+        if own is None:
+            continue
+        for sid in own.owned_shards(n.rank):
+            fps = {
+                m.tree.shard_fingerprints().get(sid, 0)
+                for r, m in by_rank.items()
+                if own.is_owner(r, sid)
+            }
+            if len(fps) > 1:
+                return False
+    if router_mesh is not None:
+        return bool(router_mesh.fleet.shard_convergence()["converged"])
+    return True
+
+
 def _chaos_join_drain_phases(
     *,
     nodes,
@@ -744,7 +795,7 @@ def _chaos_join_drain_phases(
     for k in joiner_keys:
         target.insert(k, np.arange(key_len, dtype=np.int32))
     live = [n for n in nodes]
-    wait_for(lambda: len({n.tree.fingerprint_ for n in live}) == 1)
+    wait_for(lambda: _trees_converged(live))
 
     # ---- phase 5: drain under sustained seeded loss -------------------
     plan.partitions = ()
@@ -857,6 +908,7 @@ def _chaos_join_drain_phases(
         "left_cause_transitions": left_transitions,
         "writeback_tokens": int(dstats["writeback_tokens"]),
         "writeback_flushed": bool(dstats["writeback_flushed"]),
+        "shard_transfer": dstats.get("shard_transfer"),
         "drain_s": round(float(dstats["drain_s"]), 3),
     }
 
@@ -879,6 +931,8 @@ def _chaos_join_drain_phases(
         tick_interval_s=base_cfg.tick_interval_s,
         gc_interval_s=base_cfg.gc_interval_s,
         failure_timeout_s=base_cfg.failure_timeout_s,
+        replication_factor=base_cfg.replication_factor,
+        shard_summary_interval_s=base_cfg.shard_summary_interval_s,
     )
     joiner = MeshCache(jcfg, pool=None).start()
     nodes.append(joiner)
@@ -939,14 +993,13 @@ def _chaos_join_drain_phases(
     converged_with_donor = bool(
         became_active
         and donor_node is not None
-        and joiner.tree.fingerprint_ == donor_node.tree.fingerprint_
+        and _pair_converged(joiner, donor_node)
     )
     # Partition off; the whole surviving fleet must converge again.
     plan.partitions = ()
     live = [n for n in nodes if n is not target]
     fleet_converged = wait_for(
-        lambda: len({n.tree.fingerprint_ for n in live}) == 1,
-        timeout=timeout_s,
+        lambda: _trees_converged(live), timeout=timeout_s
     )
     # Hits to the joiner resume once it is ACTIVE.
     wait_for(
@@ -1114,11 +1167,25 @@ def _chaos_crash_phase(
     hit_acct = {"replayed": 0, "cached": 0, "measured": set()}
     route_stats = {"failover": 0}
 
-    def route_fn(key, exclude):
-        res = cr.cache_aware_route(key, exclude=exclude)
-        if res.decode_failover:
-            route_stats["failover"] += 1
-        return res.decode_addr
+    def make_route_fn(rec):
+        # Sticky per-stream routing, like a production SSE edge: a live
+        # stream keeps flowing to the node serving it and re-routes ONLY
+        # once failure detection clears rec.addr (the coordinator nulls
+        # it on HopTimeout/NodeDied). Re-consulting the router mid-
+        # stream would let a healthy replica silently adopt the stream
+        # (harmless, but it would bypass the recovery path this phase
+        # exists to prove — especially under sharding, where co-owners
+        # advertise depth ties).
+        def route_fn(key, exclude):
+            cur = rec.addr
+            if cur is not None and cur not in exclude:
+                return cur
+            res = cr.cache_aware_route(key, exclude=exclude)
+            if res.decode_failover:
+                route_stats["failover"] += 1
+            return res.decode_addr
+
+        return route_fn
 
     def serve_fn(addr, rec, hop_deadline_s):
         deadline = _time.monotonic() + hop_deadline_s
@@ -1145,7 +1212,9 @@ def _chaos_crash_phase(
     reports = []
     for rec in streams:
         try:
-            reports.append(coord.run_to_completion(rec, route_fn, serve_fn))
+            reports.append(
+                coord.run_to_completion(rec, make_route_fn(rec), serve_fn)
+            )
         except Exception:  # noqa: BLE001 — failures are the measurement
             failed += 1
     detect_s = (
@@ -1257,6 +1326,7 @@ def run_chaos_workload(
     crash_streams: int = 12,
     crash_tokens: int = 24,
     crash_deadline_s: float = 20.0,
+    replication_factor: int = 0,
 ) -> dict:
     """The chaos acceptance scenario (``bench.validate_chaos`` pins its
     artifact): a seeded FaultPlan injects ``drop_p`` frame loss across
@@ -1374,6 +1444,12 @@ def run_chaos_workload(
                     # membership churn: keep failure detection out of
                     # the fault window.
                     failure_timeout_s=max(60.0, 4.0 * fault_end_s),
+                    # Sharded rerun (cache/sharding.py): inserts deliver
+                    # to owner sets; convergence gates become per-shard.
+                    replication_factor=replication_factor,
+                    shard_summary_interval_s=min(
+                        digest_interval_s, repair_interval_s
+                    ),
                 )
                 nodes.append(MeshCache(cfg, pool=None).start())
             for n in nodes:
@@ -1427,11 +1503,15 @@ def run_chaos_workload(
                     ok += 1
                 except Exception:  # noqa: BLE001 — failures are the measurement
                     pass
-                conv = router_mesh.fleet.convergence()
-                peak_diverged = max(
-                    peak_diverged,
-                    sum(1 for v in conv["pairs"].values() if v > 0.0),
-                )
+                if replication_factor > 0:
+                    conv = router_mesh.fleet.shard_convergence()
+                    peak_diverged = max(peak_diverged, len(conv["diverged"]))
+                else:
+                    conv = router_mesh.fleet.convergence()
+                    peak_diverged = max(
+                        peak_diverged,
+                        sum(1 for v in conv["pairs"].values() if v > 0.0),
+                    )
                 max_age = max(max_age, conv["max_convergence_age_s"])
                 sleep_left = window_t0 + (i + 1) * pace - _time.monotonic()
                 if sleep_left > 0:
@@ -1442,18 +1522,15 @@ def run_chaos_workload(
                 _time.sleep(tail)
             diverged_detected = (
                 peak_diverged > 0
-                or len({n.tree.fingerprint_ for n in nodes}) > 1
+                or not _trees_converged(nodes)
             )
 
             # -- 3: repair converges every replica ---------------------
             heal_t0 = _time.monotonic()
 
-            def _converged() -> bool:
-                if len({n.tree.fingerprint_ for n in nodes}) != 1:
-                    return False
-                return bool(router_mesh.fleet.convergence()["converged"])
-
-            converged = wait_for(_converged)
+            converged = wait_for(
+                lambda: _trees_converged(nodes, router_mesh)
+            )
             converge_s = _time.monotonic() - heal_t0
             # max_inflight_rounds covers peers still marked diverged
             # (episodes that never completed), so a non-heal can't
@@ -1555,6 +1632,7 @@ def run_chaos_workload(
             return {
                 "nodes": len({n.cfg.local_addr for n in nodes}),
                 "topology": "3 prefill + 2 decode + 1 router (inproc)",
+                "replication_factor": replication_factor,
                 "round_budget": round_budget,
                 "fault_plan": {
                     "seed": seed,

@@ -40,6 +40,7 @@ def run(
     join_partition_s: float = 1.5,
     crash: bool = True,
     crash_streams: int = 12,
+    replication_factor: int = 0,
 ) -> dict:
     res = run_chaos_workload(
         drop_p=drop_p,
@@ -51,6 +52,7 @@ def run(
         join_partition_s=join_partition_s,
         crash=crash,
         crash_streams=crash_streams,
+        replication_factor=replication_factor,
     )
     report = bench.build_chaos_report(res)
     problems = bench.validate_chaos(report)
@@ -66,6 +68,13 @@ def main() -> int:
     ap.add_argument("--requests", type=int, default=150)
     ap.add_argument("--round-budget", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--replication-factor", type=int, default=0, metavar="RF",
+        help="rerun the whole scenario on a SHARDED mesh "
+        "(cache/sharding.py): inserts deliver to RF owner replicas "
+        "instead of circulating the ring, and every convergence gate "
+        "becomes per-shard/owner-scoped. 0 = full replica",
+    )
     ap.add_argument(
         "--no-join-drain", action="store_true",
         help="skip the membership-lifecycle phases (graceful drain "
@@ -96,6 +105,7 @@ def main() -> int:
         args.seed, join_drain=not args.no_join_drain,
         join_partition_s=args.join_partition,
         crash=args.crash, crash_streams=args.crash_streams,
+        replication_factor=args.replication_factor,
     )
     line = json.dumps(report)
     print(line)

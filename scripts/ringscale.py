@@ -54,12 +54,18 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
-def _frame_model(topology: str, n_nodes: int, group_size: int) -> tuple[int, int]:
+def _frame_model(
+    topology: str, n_nodes: int, group_size: int, rf: int = 0
+) -> tuple[int, int]:
     """(frame_bytes, frames_per_insert) — the analytic wire model BOTH
     sweep modes report and tests/test_ringscale.py pins against the
     measured send counters. Flat = N sends (the lap-RETURN hop to the
     origin is a real frame); hier = one full lap per group (return hops
-    included; injected copies die at their injector) + one spine lap."""
+    included; injected copies die at their injector) + one spine lap.
+    Sharded (rf > 0, cache/sharding.py): one point-to-point frame per
+    non-origin owner — ≤ rf regardless of N, the whole point (the
+    measured counter reports the exact per-key owner overlap with the
+    writer)."""
     from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
     from radixmesh_tpu.policy.hierarchy import HierPlan
 
@@ -69,7 +75,9 @@ def _frame_model(topology: str, n_nodes: int, group_size: int) -> tuple[int, int
         value=np.arange(KEY_LEN // PAGE, dtype=np.int32), value_rank=0,
         page=PAGE,
     )))
-    if topology == "hier":
+    if rf > 0:
+        frames = min(rf, n_nodes - 1)
+    elif topology == "hier":
         plan = HierPlan(n_nodes, group_size)
         alive = range(n_nodes)
         frames = sum(
@@ -87,6 +95,7 @@ def run_ring(
     n_probes: int,
     topology: str,
     hop_delay_ms: float = 0.0,
+    rf: int = 0,
 ) -> dict:
     import jax
 
@@ -108,6 +117,10 @@ def run_ring(
                 protocol="tcp-py",
                 topology=topology,
                 group_size=group_size,
+                replication_factor=rf,
+                # Out of the measured window (like the tick), so send
+                # counters observe only data frames.
+                shard_summary_interval_s=3600.0,
                 # One tick origination (the ticker's immediate first tick
                 # satisfies the barrier), then none during the measured
                 # phases — so the send counters observe only data frames.
@@ -147,14 +160,28 @@ def run_ring(
         writer = nodes[min(group_size, n_nodes) - 1 if topology == "hier" else 0]
         rng = np.random.default_rng(7)
 
-        # Propagation latency: insert one key, spin until EVERY node
-        # holds it. Nodes are dropped from the poll set as they converge.
+        def replicas_of(key) -> list[MeshCache]:
+            """The nodes that must end up holding ``key``: everyone on a
+            full replica; the key's owner set under sharding (delivery-
+            to-owners is the contract being measured)."""
+            if rf <= 0:
+                return [n for n in nodes if n is not writer]
+            return [
+                nodes[r]
+                for r in writer.owner_ranks(key)
+                if nodes[r] is not writer
+            ]
+
+        # Propagation latency: insert one key, spin until every replica
+        # that MUST hold it does (all nodes full-replica; the owner set
+        # sharded — "propagation to owners"). Converged nodes drop from
+        # the poll set.
         probes: list[float] = []
         for i in range(n_probes):
             key = rng.integers(1, 50000, size=KEY_LEN).tolist()
             t = time.monotonic()
             writer.insert(key, np.arange(KEY_LEN, dtype=np.int32) + i * KEY_LEN)
-            waiting = [n for n in nodes if n is not writer]
+            waiting = replicas_of(key)
             deadline = t + 60
             while waiting:
                 waiting = [
@@ -169,10 +196,12 @@ def run_ring(
                 time.sleep(0.0002)
             probes.append(time.monotonic() - t)
 
-        # Convergence: one writer floods, clock stops when the LAST node
-        # holds the last key (FIFO per path ⇒ holding the last ⇒ all).
-        # Send counters are sampled around this phase so frames-per-insert
-        # is MEASURED wire traffic, not the analytic model restated.
+        # Convergence: one writer floods, clock stops when every key's
+        # replica set holds it (full replica: FIFO per path ⇒ the last
+        # key covers all; sharded: owner sets differ per key, so every
+        # key is polled). Send counters are sampled around this phase so
+        # frames-per-insert is MEASURED wire traffic, not the analytic
+        # model restated.
         sent0 = sum(n.metrics["oplogs_sent"] for n in nodes)
         keys = rng.integers(1, 50000, size=(n_inserts, KEY_LEN))
         t0 = time.monotonic()
@@ -181,23 +210,35 @@ def run_ring(
                 key.tolist(),
                 np.arange(KEY_LEN, dtype=np.int32) + (n_probes + i) * KEY_LEN,
             )
-        last = keys[-1].tolist()
+        if rf <= 0:
+            pending = {-1: [n for n in nodes if n is not writer]}
+            check_keys = {-1: keys[-1].tolist()}
+        else:
+            check_keys = {i: k.tolist() for i, k in enumerate(keys)}
+            pending = {i: replicas_of(k) for i, k in check_keys.items()}
         deadline = time.monotonic() + 300
-        pending = [n for n in nodes if n is not writer]
-        while pending:
-            pending = [n for n in pending if n.match_prefix(last).length < KEY_LEN]
-            if pending and time.monotonic() > deadline:
-                raise TimeoutError(f"N={n_nodes}/{topology} never converged")
-            if pending:
+        while any(pending.values()):
+            for i, nds in list(pending.items()):
+                if nds:
+                    pending[i] = [
+                        n for n in nds
+                        if n.match_prefix(check_keys[i]).length < KEY_LEN
+                    ]
+            if any(pending.values()):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"N={n_nodes}/{topology} never converged")
                 time.sleep(0.005)
         converge_s = time.monotonic() - t0
         sent = sum(n.metrics["oplogs_sent"] for n in nodes) - sent0
 
-        frame, frames = _frame_model(topology, n_nodes, group_size)
+        frame, frames = _frame_model(topology, n_nodes, group_size, rf)
         a = np.asarray(probes)
+        measured = round(sent / n_inserts, 2)
         return {
             "n_nodes": n_nodes,
             "topology": topology,
+            "rf": rf,
+            "mode": "threads+tcp-py",
             "hop_delay_ms": hop_delay_ms,
             "group_size": group_size or None,
             "startup_s": round(startup_s, 2),
@@ -208,8 +249,10 @@ def run_ring(
             "inserts_per_s": round(n_inserts / converge_s, 1),
             "frame_bytes": frame,
             "frames_per_insert": frames,
-            "measured_frames_per_insert": round(sent / n_inserts, 2),
-            "ring_bytes_per_insert": frame * frames,
+            "measured_frames_per_insert": measured,
+            "ring_bytes_per_insert": (
+                round(frame * measured) if rf > 0 else frame * frames
+            ),
         }
     finally:
         for n in nodes:
@@ -217,6 +260,126 @@ def run_ring(
                 n.close()
             except Exception:  # noqa: BLE001 — teardown must not mask results
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Simulated-transport mode: the 100/200-node ceiling. A 200-node tcp-py
+# ring is ~1000 threads + ~400 sockets of pure GIL contention — the wire
+# NUMBERS it would produce (frames × real serialized frame bytes) are a
+# pure function of the delivery topology, so the top sweep sizes run the
+# REAL product code (MeshCache.insert → _broadcast_data → real oplog
+# serialization → real ownership walk → real oplog_received apply path)
+# over a direct in-memory delivery pump instead of sockets/threads.
+# Measured per-insert frames/bytes are exact; propagation is MODELED as
+# hop_delay × serial hop count (ring: N-1 store-and-forward hops to
+# reach the last replica; sharded: 1 — owner deliveries are parallel
+# point-to-point sends) and the rows say so ("mode": "sim").
+# ---------------------------------------------------------------------------
+
+
+def run_ring_sim(
+    n_nodes: int,
+    n_inserts: int,
+    topology: str = "ring",
+    hop_delay_ms: float = 1.0,
+    rf: int = 0,
+) -> dict:
+    from collections import deque
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.config import MeshConfig
+
+    if topology != "ring":
+        raise ValueError("sim mode models the flat ring and sharded modes")
+    prefill = [f"sim{i}" for i in range(n_nodes)]
+    stats = {"frames": 0, "bytes": 0}
+    pending: deque = deque()
+    nodes: list[MeshCache] = []
+    for addr in prefill:
+        cfg = MeshConfig(
+            prefill_nodes=prefill,
+            decode_nodes=[],
+            router_nodes=[],
+            local_addr=addr,
+            protocol="inproc",
+            replication_factor=rf,
+            page_size=PAGE,
+        )
+        nodes.append(MeshCache(cfg, pool=None))
+    t0 = time.monotonic()
+    # Never start()ed: no threads, no transports. Delivery is patched at
+    # the two product seams every frame passes — the ring sender enqueue
+    # (_send_bytes) and the owner-lane enqueue (_enqueue_owner) — so
+    # serialization, ownership walks, TTL patching, and the apply path
+    # all run the real code.
+    for idx, node in enumerate(nodes):
+
+        def ring_send(data, control=False, dest="ring", _i=idx):
+            stats["frames"] += 1
+            stats["bytes"] += len(data)
+            pending.append(((_i + 1) % n_nodes, data))
+
+        def owner_send(rank, data, _i=idx):
+            stats["frames"] += 1
+            stats["bytes"] += len(data)
+            pending.append((rank, data))
+
+        node._send_bytes = ring_send
+        node._enqueue_owner = owner_send
+
+    def pump() -> None:
+        while pending:
+            rank, data = pending.popleft()
+            nodes[rank].oplog_received(data)
+
+    rng = np.random.default_rng(7)
+    keys = rng.integers(1, 50000, size=(n_inserts, KEY_LEN))
+    writer = nodes[0]
+    for i, key in enumerate(keys):
+        writer.insert(
+            key.tolist(), np.arange(KEY_LEN, dtype=np.int32) + i * KEY_LEN
+        )
+    pump()
+    wall_s = time.monotonic() - t0
+    # Every replica that must hold every key does (real apply path).
+    for i, key in enumerate(keys):
+        k = key.tolist()
+        replicas = (
+            [n for n in nodes if n is not writer]
+            if rf <= 0
+            else [nodes[r] for r in writer.owner_ranks(k) if r != 0]
+        )
+        for n in replicas:
+            if n.match_prefix(k).length != KEY_LEN:
+                raise RuntimeError(
+                    f"sim N={n_nodes} rf={rf}: key {i} missing on rank {n.rank}"
+                )
+    for n in nodes:
+        n.close()
+    frame, frames = _frame_model("ring", n_nodes, 0, rf)
+    measured = round(stats["frames"] / n_inserts, 2)
+    # Modeled propagation: serial store-and-forward hops to the LAST
+    # replica (ring) vs one parallel point-to-point hop (sharded).
+    hops = 1 if rf > 0 else max(1, n_nodes - 1)
+    prop_ms = round(hop_delay_ms * hops, 2)
+    return {
+        "n_nodes": n_nodes,
+        "topology": "ring",
+        "rf": rf,
+        "mode": "sim",
+        "hop_delay_ms": hop_delay_ms,
+        "group_size": None,
+        "startup_s": 0.0,
+        "prop_p50_ms": prop_ms,
+        "prop_p99_ms": prop_ms,
+        "converge_s": round(wall_s, 3),
+        "inserts": n_inserts,
+        "inserts_per_s": round(n_inserts / max(wall_s, 1e-9), 1),
+        "frame_bytes": frame,
+        "frames_per_insert": frames,
+        "measured_frames_per_insert": measured,
+        "ring_bytes_per_insert": round(stats["bytes"] / n_inserts),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -462,16 +625,35 @@ def run_ring_procs(
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--sizes", default="6,12,25,50")
+    ap.add_argument("--sizes", default="12,50,100,200")
     ap.add_argument("--inserts", type=int, default=40)
     ap.add_argument("--probes", type=int, default=30)
     ap.add_argument(
-        "--hop-delays", default="0,1",
+        "--hop-delays", default="1",
         help="comma-separated per-hop wire latencies (ms) to emulate; 0 = raw loopback",
     )
     ap.add_argument(
+        "--rfs", default="0,3",
+        help="comma-separated replication factors to sweep; 0 = full "
+        "replica (the old ring), N = prefix-ownership sharding with N "
+        "owners per shard (cache/sharding.py)",
+    )
+    ap.add_argument(
+        "--sim-threshold", type=int, default=30,
+        help="sizes ABOVE this run in simulated-transport mode (real "
+        "product delivery/serialization code over an in-memory pump; "
+        "modeled propagation) — a 200-node tcp-py ring is ~1000 threads "
+        "of GIL contention, not a measurement",
+    )
+    ap.add_argument(
+        "--hier", action="store_true",
+        help="also sweep topology=hier at rf=0 (the PR-era comparison "
+        "rows; live sizes only)",
+    )
+    ap.add_argument(
         "--procs", action="store_true",
-        help="one OS process per node over the native C++ transport",
+        help="one OS process per node over the native C++ transport "
+        "(live sizes only, rf=0)",
     )
     ap.add_argument("--node", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--out", default=None)
@@ -480,43 +662,67 @@ def main() -> int:
         return _node_main([args.node])
     sizes = [int(s) for s in args.sizes.split(",")]
     delays = [float(d) for d in args.hop_delays.split(",")]
-    runner = run_ring_procs if args.procs else run_ring
+    rfs = [int(r) for r in args.rfs.split(",")]
     results = []
     for delay in delays:
-        for topology in ("ring", "hier"):
+        for rf in rfs:
             for n in sizes:
-                r = runner(n, args.inserts, args.probes, topology, delay)
+                if n > args.sim_threshold:
+                    r = run_ring_sim(
+                        n, args.inserts, hop_delay_ms=delay, rf=rf
+                    )
+                elif args.procs and rf == 0:
+                    r = run_ring_procs(n, args.inserts, args.probes, "ring", delay)
+                    r.setdefault("rf", 0)
+                else:
+                    r = run_ring(n, args.inserts, args.probes, "ring", delay, rf=rf)
                 print(json.dumps(r), file=sys.stderr, flush=True)
                 results.append(r)
-    ratios = {}
-    for delay in delays:
-        flat = {
-            r["n_nodes"]: r for r in results
-            if r["topology"] == "ring" and r["hop_delay_ms"] == delay
-        }
-        hier = {
-            r["n_nodes"]: r for r in results
-            if r["topology"] == "hier" and r["hop_delay_ms"] == delay
-        }
-        ratios[f"hop{delay:g}ms"] = {
-            f"N{n}": round(flat[n]["prop_p50_ms"] / hier[n]["prop_p50_ms"], 2)
-            for n in sizes
-            if n in hier
-        }
+        if args.hier:
+            for n in [s for s in sizes if s <= args.sim_threshold]:
+                r = run_ring(n, args.inserts, args.probes, "hier", delay)
+                print(json.dumps(r), file=sys.stderr, flush=True)
+                results.append(r)
+    # The headline the sharded plane exists for: bytes-per-insert vs N,
+    # per rf (flat under sharding; linear full-replica).
+    flatness = {}
+    for rf in rfs:
+        rows = sorted(
+            (r for r in results if r.get("rf", 0) == rf),
+            key=lambda r: r["n_nodes"],
+        )
+        if len(rows) >= 2:
+            lo, hi = rows[0], rows[-1]
+            flatness[f"rf{rf}"] = {
+                f"N{lo['n_nodes']}_bytes": lo["ring_bytes_per_insert"],
+                f"N{hi['n_nodes']}_bytes": hi["ring_bytes_per_insert"],
+                "growth": round(
+                    hi["ring_bytes_per_insert"]
+                    / max(1, lo["ring_bytes_per_insert"]),
+                    2,
+                ),
+            }
     report = {
+        "schema_version": 2,
         "metric": "ring_scale_sweep",
-        "mode": "procs+native" if args.procs else "threads+tcp-py",
+        "mode": "mixed:live+sim" if any(
+            r.get("mode") == "sim" for r in results
+        ) else ("procs+native" if args.procs else "threads+tcp-py"),
         "sizes": sizes,
         "hop_delays_ms": delays,
+        "rfs": rfs,
+        "sim_threshold": args.sim_threshold,
         "results": results,
-        "hier_vs_flat_prop_p50": ratios,
+        "bytes_per_insert_growth": flatness,
         "note": (
-            "flat-ring propagation scales O(N) serial hops; topology=hier "
-            "(policy/hierarchy.py) cuts the critical path to "
-            "O(group+spine). hop0 = raw loopback (per-hop software cost "
-            "dominates, GIL-serialized); hop1ms emulates DCN "
-            "store-and-forward latency, where the critical path is the "
-            "whole story — see ARCHITECTURE.md ring-scale"
+            "full replication (rf=0) pays O(N) frames per insert and "
+            "O(N)-hop propagation; prefix-ownership sharding "
+            "(cache/sharding.py, rf>0) delivers each insert point-to-"
+            "point to <= rf owners per serving role — bytes-per-insert "
+            "flat in N, propagation-to-owners one parallel hop. Sizes "
+            "above sim_threshold run the real delivery/serialization "
+            "code over an in-memory pump with MODELED hop latency "
+            "(mode: sim) — see ARCHITECTURE.md 'Sharded replication'"
         ),
     }
     line = json.dumps(report)

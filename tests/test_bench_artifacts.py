@@ -544,3 +544,120 @@ class TestChaosArtifactSchema:
         with open(paths[-1]) as fh:
             report = json.load(fh)
         assert bench.validate_chaos(report) == []
+
+
+class TestRingscaleArtifactSchema:
+    """RINGSCALE v2 (scripts/ringscale.py + prefix-ownership sharding,
+    cache/sharding.py): per-row rf/mode fields, the bytes-per-insert
+    FLATNESS gate for sharded rows, the owner-propagation gate, and v1
+    (pre-sharding, full-replica-only) artifacts staying valid."""
+
+    @staticmethod
+    def _row(n, rf, mode="sim", bytes_=None, p99=None):
+        return {
+            "n_nodes": n,
+            "topology": "ring",
+            "rf": rf,
+            "mode": mode,
+            "hop_delay_ms": 1.0,
+            "frame_bytes": 252,
+            "frames_per_insert": rf if rf else n,
+            "measured_frames_per_insert": float(rf if rf else n),
+            "ring_bytes_per_insert": (
+                bytes_ if bytes_ is not None else 252 * (rf if rf else n)
+            ),
+            "prop_p50_ms": p99 if p99 is not None else 1.0,
+            "prop_p99_ms": p99 if p99 is not None else 1.0,
+        }
+
+    def _report(self, rows):
+        return {
+            "schema_version": 2,
+            "metric": "ring_scale_sweep",
+            "mode": "mixed:live+sim",
+            "sizes": sorted({r["n_nodes"] for r in rows}),
+            "hop_delays_ms": [1.0],
+            "rfs": sorted({r["rf"] for r in rows}),
+            "results": rows,
+            "bytes_per_insert_growth": {},
+        }
+
+    def test_complete_report_validates(self):
+        rows = [
+            self._row(12, 0, p99=11.0),
+            self._row(200, 0, p99=199.0),
+            self._row(12, 3, p99=1.0),
+            self._row(200, 3, p99=1.0),
+        ]
+        assert bench.validate_ringscale(self._report(rows)) == []
+
+    def test_missing_row_fields_are_named(self):
+        rows = [self._row(12, 3)]
+        del rows[0]["ring_bytes_per_insert"]
+        problems = bench.validate_ringscale(self._report(rows))
+        assert "results[0].ring_bytes_per_insert" in problems
+
+    def test_flatness_gate_enforced(self):
+        """Sharded bytes-per-insert growing with N is exactly the O(N)
+        wall the plane exists to break — the gate must catch it."""
+        rows = [
+            self._row(12, 3, bytes_=756),
+            self._row(200, 3, bytes_=7560),  # 10x growth: the wall is back
+        ]
+        problems = bench.validate_ringscale(self._report(rows))
+        assert any("flatness" in p for p in problems), problems
+        # Within 1.5x passes.
+        rows = [self._row(12, 3, bytes_=700), self._row(200, 3, bytes_=756)]
+        assert bench.validate_ringscale(self._report(rows)) == []
+
+    def test_propagation_gate_enforced(self):
+        """Owner-propagation p99 must not exceed the full-replica ring
+        at the smallest size (same delay + mode)."""
+        rows = [
+            self._row(12, 0, mode="threads+tcp-py", p99=10.0),
+            self._row(12, 3, mode="threads+tcp-py", p99=50.0),
+        ]
+        problems = bench.validate_ringscale(self._report(rows))
+        assert any("propagation" in p for p in problems), problems
+        # Sim rows are not compared against live rows.
+        rows = [
+            self._row(12, 0, mode="threads+tcp-py", p99=10.0),
+            self._row(200, 3, mode="sim", p99=50.0),
+        ]
+        assert bench.validate_ringscale(self._report(rows)) == []
+
+    def test_v1_artifact_stays_valid(self):
+        """Pre-sharding artifacts (no schema_version; full-replica rows
+        without rf/mode fields) keep validating as-is."""
+        v1 = {
+            "metric": "ring_scale_sweep",
+            "mode": "procs+native",
+            "sizes": [12, 25],
+            "results": [
+                {"n_nodes": 12, "topology": "ring",
+                 "ring_bytes_per_insert": 3024},
+            ],
+        }
+        assert bench.validate_ringscale(v1) == []
+        assert bench.validate_ringscale({"metric": "other"}) != []
+
+    def test_checked_in_artifacts_validate(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "RINGSCALE_r*.json")))
+        assert paths, "no RINGSCALE artifact checked in"
+        for path in paths:
+            with open(path) as fh:
+                report = json.load(fh)
+            assert bench.validate_ringscale(report) == [], path
+        # The newest artifact must be v2 and actually demonstrate the
+        # flat sharded curve at the 200-node ceiling.
+        with open(paths[-1]) as fh:
+            newest = json.load(fh)
+        assert newest.get("schema_version") == 2
+        sharded = [
+            r for r in newest["results"] if int(r.get("rf", 0)) > 0
+        ]
+        assert any(r["n_nodes"] >= 200 for r in sharded)

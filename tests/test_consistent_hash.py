@@ -1,0 +1,132 @@
+"""Dedicated tests for ``router/consistent_hash.py`` (it carried zero
+before sharding made it load-bearing): virtual-node distribution
+balance, add/remove stability (bounded key movement — the property
+consistent hashing exists for), and the RF-successor walk
+(``get_nodes``) that prefix-ownership sharding derives owner sets from
+(distinct owners, wrap-around, N < RF degeneracy, exclusion)."""
+
+import pytest
+
+from radixmesh_tpu.router.consistent_hash import ConsistentHash
+
+pytestmark = pytest.mark.quick
+
+
+def _keys(n: int):
+    return [f"key-{i}" for i in range(n)]
+
+
+class TestDistribution:
+    def test_balance_across_virtual_nodes(self):
+        """With enough virtual nodes, no node owns a wildly outsized
+        share of a large key population (generous 4x bound — 32-bit
+        blake2b points are not a perfect partition, but an unbalanced
+        ring defeats the whole fallback-spread purpose)."""
+        nodes = [f"n{i}" for i in range(8)]
+        ring = ConsistentHash(nodes, virtual_nodes=32)
+        counts = {n: 0 for n in nodes}
+        for k in _keys(4000):
+            counts[ring.get_node(k)] += 1
+        expected = 4000 / len(nodes)
+        assert max(counts.values()) < 4 * expected
+        assert min(counts.values()) > expected / 4
+
+    def test_more_virtual_nodes_participate(self):
+        """Every node actually lands points on the ring (a node with no
+        points would silently take zero traffic)."""
+        nodes = [f"n{i}" for i in range(16)]
+        ring = ConsistentHash(nodes, virtual_nodes=8)
+        owners = {ring.get_node(k) for k in _keys(2000)}
+        assert owners == set(nodes)
+
+
+class TestStability:
+    def test_add_node_moves_bounded_keys(self):
+        """Adding one node to a 10-node ring re-maps roughly 1/11 of
+        keys (3x slack for point-placement variance) — never a full
+        reshuffle."""
+        nodes = [f"n{i}" for i in range(10)]
+        before = ConsistentHash(nodes, virtual_nodes=32)
+        after = ConsistentHash(nodes + ["n10"], virtual_nodes=32)
+        keys = _keys(3000)
+        moved = sum(
+            1 for k in keys if before.get_node(k) != after.get_node(k)
+        )
+        assert moved / len(keys) < 3.0 / 11.0
+        # Every moved key moved TO the new node (the defining property:
+        # existing nodes never trade keys among themselves on an add).
+        for k in keys:
+            if before.get_node(k) != after.get_node(k):
+                assert after.get_node(k) == "n10"
+
+    def test_remove_node_only_reassigns_its_keys(self):
+        nodes = [f"n{i}" for i in range(10)]
+        ring = ConsistentHash(nodes, virtual_nodes=32)
+        keys = _keys(3000)
+        before = {k: ring.get_node(k) for k in keys}
+        ring.remove_node("n3")
+        for k in keys:
+            if before[k] != "n3":
+                assert ring.get_node(k) == before[k]
+            else:
+                assert ring.get_node(k) != "n3"
+
+    def test_incremental_equals_rebuilt(self):
+        """Mutating a ring in place converges to the same assignment as
+        building it fresh (the router mutates on view changes)."""
+        a = ConsistentHash(["x", "y", "z"], virtual_nodes=16)
+        a.remove_node("y")
+        a.add_node("w")
+        b = ConsistentHash(["x", "z", "w"], virtual_nodes=16)
+        for k in _keys(500):
+            assert a.get_node(k) == b.get_node(k)
+
+
+class TestRFSuccessorWalk:
+    def test_distinct_owners(self):
+        ring = ConsistentHash([f"n{i}" for i in range(12)], virtual_nodes=8)
+        for k in _keys(200):
+            owners = ring.get_nodes(k, 3)
+            assert len(owners) == 3
+            assert len(set(owners)) == 3
+
+    def test_first_owner_matches_get_node(self):
+        """The walk's head is the natural single owner — sharding's
+        primary == the routing fallback's answer."""
+        ring = ConsistentHash([f"n{i}" for i in range(9)], virtual_nodes=8)
+        for k in _keys(200):
+            assert ring.get_nodes(k, 3)[0] == ring.get_node(k)
+
+    def test_wraparound_collects_all(self):
+        """A walk starting near the top of the hash space wraps to the
+        ring's start: asking for every node always returns every node,
+        wherever the key hashes."""
+        nodes = [f"n{i}" for i in range(5)]
+        ring = ConsistentHash(nodes, virtual_nodes=4)
+        for k in _keys(300):
+            assert set(ring.get_nodes(k, 5)) == set(nodes)
+
+    def test_n_below_rf_degeneracy(self):
+        """Fewer nodes than the requested factor: the walk returns every
+        distinct node (sharding's full-replica degeneracy) instead of
+        padding or raising."""
+        ring = ConsistentHash(["a", "b"], virtual_nodes=8)
+        owners = ring.get_nodes("some-key", 3)
+        assert sorted(owners) == ["a", "b"]
+        assert ConsistentHash([]).get_nodes("k", 3) == []
+
+    def test_exclusion_and_zero(self):
+        ring = ConsistentHash(["a", "b", "c"], virtual_nodes=8)
+        assert ring.get_nodes("k", 0) == []
+        owners = ring.get_nodes("k", 3, exclude={"b"})
+        assert "b" not in owners and len(owners) == 2
+
+    def test_deterministic_across_instances(self):
+        """Two independently built rings over the same membership agree
+        on every walk — the zero-coordination property ownership maps
+        (cache/sharding.py) are derived from."""
+        nodes = [f"rank:{i}" for i in range(20)]
+        r1 = ConsistentHash(nodes, virtual_nodes=8)
+        r2 = ConsistentHash(reversed(nodes), virtual_nodes=8)
+        for k in _keys(300):
+            assert r1.get_nodes(k, 3) == r2.get_nodes(k, 3)

@@ -33,6 +33,11 @@ class TestSendSeamLint:
         "close",
         "send_prefetch",
         "send_repair",
+        # Sharding (cache/sharding.py): the owner-addressed data lane's
+        # dedicated sender thread, and the router-side fire-and-forget
+        # pull-through request (same droppable contract as prefetch).
+        "_owner_sender",
+        "send_shard_pull",
     )
 
     def test_no_raw_send_anywhere_in_mesh_cache(self):
@@ -133,6 +138,23 @@ class TestExtensionKindRegistration:
         assert DATA_KINDS == {
             OplogType.INSERT, OplogType.DELETE, OplogType.RESET,
         }
+
+    def test_every_shard_kind_is_registered(self):
+        """Sharding op kinds (SHARD_SUMMARY/SHARD_PULL — cache/
+        sharding.py) post-date the pass-through tolerance, so each must
+        be in EXTENSION_KINDS (old wires forward, never raise) AND carry
+        an explicit oplog_received branch (the EXTENSION_KINDS receive-
+        branch test covers the latter for every registered kind) —
+        the PR 5 convention every new kind registers under."""
+        from radixmesh_tpu.cache.oplog import EXTENSION_KINDS, OplogType
+
+        shard_kinds = [t for t in OplogType if t.name.startswith("SHARD_")]
+        assert shard_kinds, "SHARD_* kinds vanished from OplogType"
+        for t in shard_kinds:
+            assert t in EXTENSION_KINDS, (
+                f"{t.name} missing from EXTENSION_KINDS — an old wire "
+                "would raise on it instead of forwarding"
+            )
 
     def test_every_lifecycle_kind_is_registered(self):
         """Membership-lifecycle op kinds (LEAVE — policy/lifecycle.py)
@@ -251,3 +273,64 @@ class TestLifecycleStateOwnership:
         from radixmesh_tpu.policy import lifecycle
 
         assert self._ASSIGN.search(inspect.getsource(lifecycle))
+
+
+class TestOwnershipSingleWriter:
+    """Sharding satellite lint: ownership maps have ONE writer. The map
+    is a pure function of (view, rf) that every node must derive
+    identically — a module that constructed its own OwnershipMap (or
+    poked an existing map's owner tuples) could silently hand two nodes
+    different owner sets for the same shard, which is a split-brain on
+    the delivery plane. Everything outside cache/sharding.py goes
+    through ``build_ownership`` and treats the result as an immutable
+    value."""
+
+    # Constructor calls + owner-set mutation on an existing map.
+    _CONSTRUCT = re.compile(r"OwnershipMap\(")
+    _MUTATE = re.compile(r"\.owners\s*(?:\[[^\]]*\]\s*)?=(?!=)")
+
+    def _product_sources(self):
+        import pathlib
+
+        import radixmesh_tpu
+
+        pkg = pathlib.Path(radixmesh_tpu.__file__).parent
+        for path in sorted(pkg.rglob("*.py")):
+            yield path, path.read_text()
+
+    def _is_owner_module(self, path) -> bool:
+        return path.name == "sharding.py" and path.parent.name == "cache"
+
+    def test_no_module_outside_sharding_constructs_or_mutates(self):
+        offenders = []
+        for path, src in self._product_sources():
+            if self._is_owner_module(path):
+                continue
+            for pat in (self._CONSTRUCT, self._MUTATE):
+                for m in pat.finditer(src):
+                    line = src[: m.start()].count("\n") + 1
+                    offenders.append(f"{path}:{line}: {m.group(0)!r}")
+        assert not offenders, (
+            "ownership maps constructed/mutated outside cache/sharding.py "
+            "(single-writer contract — use build_ownership and treat the "
+            "result as immutable): " + "; ".join(offenders)
+        )
+
+    def test_positive_control_sharding_module_does_construct(self):
+        import inspect
+
+        from radixmesh_tpu.cache import sharding
+
+        src = inspect.getsource(sharding)
+        assert self._CONSTRUCT.search(src)
+        assert self._MUTATE.search(src)  # __init__'s owner-set assignment
+
+    def test_mesh_rebuilds_via_build_ownership_on_view_change(self):
+        """The mesh's view-change path re-derives through the single
+        constructor (whole-map swap), not by editing owner sets."""
+        import inspect
+
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+
+        src = inspect.getsource(MeshCache._after_view_change)
+        assert "build_ownership(" in src

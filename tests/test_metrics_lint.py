@@ -21,6 +21,8 @@ GAUGE_SUFFIXES = UNIT_SUFFIXES + (
     "_requests", "_slots", "_nodes", "_rows",
     "_epoch", "_rank", "_flag", "_tier", "_tokens_per_second",
     "_state",  # lifecycle state code (policy/lifecycle.py)
+    "_shards",  # owned-shard count (cache/sharding.py)
+    "_bytes_per_insert",  # per-insert wire-cost EWMA (cache/sharding.py)
 )
 
 
@@ -184,6 +186,68 @@ class TestMetricHygiene:
             )
             names = {s.name for s in get_recorder().snapshot()}
             assert {"resurrect", "hedge"} <= names, names
+        finally:
+            set_recorder(prev)
+
+    def test_sharding_families_registered(self):
+        """Satellite (prefix-ownership sharding, cache/sharding.py):
+        the owned-shard gauge, the per-insert wire-cost EWMA gauge, and
+        the pull-through outcome counter are first-class families —
+        registered on every mesh node regardless of mode, so a fleet
+        rolling sharding on sees the series move from zero instead of
+        appearing from nowhere."""
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        assert fams.get("radixmesh_mesh_owned_shards") == "gauge", sorted(fams)
+        assert (
+            fams.get("radixmesh_mesh_bytes_per_insert") == "gauge"
+        ), sorted(fams)
+        assert (
+            fams.get("radixmesh_mesh_pullthrough_total") == "counter"
+        ), sorted(fams)
+
+    def test_shard_transfer_span_recorded(self):
+        """Drain-time ownership transfers land as ``shard_transfer``
+        spans on the node's ring recorder lane — the same flight-
+        recorder contract as every other plane's spans."""
+        import numpy as np
+
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.cache.mesh_values import PrefillValue
+        from radixmesh_tpu.cache.sharding import shard_of_tokens
+        from radixmesh_tpu.config import MeshConfig
+        from radixmesh_tpu.obs.trace_plane import (
+            FlightRecorder,
+            get_recorder,
+            set_recorder,
+        )
+
+        prev = get_recorder()
+        set_recorder(FlightRecorder(capacity=256, sample=1.0))
+        try:
+            # rf=1 on 4 ranks: removing a node MOVES its shards to new
+            # owners, so the handoff has real transfers to span.
+            prefill = [f"sp{i}" for i in range(4)]
+            mesh = MeshCache(MeshConfig(
+                prefill_nodes=prefill, decode_nodes=[], router_nodes=[],
+                local_addr="sp0", protocol="inproc", replication_factor=1,
+            ))
+            rng = np.random.default_rng(3)
+            inserted = 0
+            with mesh._lock:
+                for _ in range(64):
+                    key = rng.integers(1, 50000, size=8).astype(np.int32)
+                    if mesh.ownership.is_owner(0, shard_of_tokens(key[:1])):
+                        mesh._mesh_insert(
+                            key, PrefillValue(np.arange(8, dtype=np.int32), 0)
+                        )
+                        inserted += 1
+            assert inserted, "seeded keys never landed in an owned shard"
+            stats = mesh.handoff_owned_shards()
+            assert stats["shards"] > 0 and stats["entries"] > 0
+            names = {s.name for s in get_recorder().snapshot()}
+            assert "shard_transfer" in names, names
+            mesh.close()
         finally:
             set_recorder(prev)
 

@@ -1,0 +1,527 @@
+"""Prefix-ownership sharding (``cache/sharding.py``): ownership-map
+derivation, per-shard tree fingerprints, the shard-summary/pull wire,
+owner-addressed delivery on a live mesh, summary-based routing,
+pull-through fills, owner-scoped repair, drain-time shard handoff, and
+the ``replication_factor = 0`` full-replica compatibility contract."""
+
+import time
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.cache.radix_tree import RadixTree, root_page_hash
+from radixmesh_tpu.cache.sharding import (
+    NUM_SHARDS,
+    OwnershipMap,
+    ShardSummaryTable,
+    build_ownership,
+    decode_shard_summary,
+    encode_shard_summary,
+    shard_of_tokens,
+)
+
+# The lint (tests/test_mesh_lint.py::TestOwnershipSingleWriter) confines
+# OwnershipMap construction to cache/sharding.py; tests go through
+# build_ownership like every product module.
+assert OwnershipMap is not None
+
+
+def _shard_fn(page):
+    return lambda key, _p=max(1, page): shard_of_tokens(key[:_p])
+
+
+@pytest.mark.quick
+class TestShardOf:
+    def test_stable_and_in_range(self):
+        key = [5, 17, 123, 9]
+        assert shard_of_tokens(key) == shard_of_tokens(list(key))
+        assert 0 <= shard_of_tokens(key) < NUM_SHARDS
+        assert shard_of_tokens([]) == 0
+
+    def test_depends_only_on_given_tokens(self):
+        assert shard_of_tokens([1, 2]) == shard_of_tokens(
+            np.asarray([1, 2], dtype=np.int32)
+        )
+        # Different first page → (almost surely) reachable different
+        # shard: the space is actually partitioned.
+        shards = {shard_of_tokens([t]) for t in range(500)}
+        assert len(shards) == NUM_SHARDS
+
+
+@pytest.mark.quick
+class TestOwnershipMap:
+    def test_deterministic_and_epoch_carried(self):
+        a = build_ownership(range(10), 3, epoch=7)
+        b = build_ownership(reversed(range(10)), 3, epoch=7)
+        assert a.owners == b.owners
+        assert a.epoch == 7 and a.rf == 3
+
+    def test_rf_distinct_owners_every_shard(self):
+        m = build_ownership(range(12), 3, 0)
+        for sid in range(NUM_SHARDS):
+            owners = m.owners_of(sid)
+            assert len(owners) == 3
+            assert len(set(owners)) == 3
+
+    def test_n_below_rf_degeneracy(self):
+        m = build_ownership([4, 9], 3, 0)
+        for sid in range(NUM_SHARDS):
+            assert set(m.owners_of(sid)) == {4, 9}
+
+    def test_role_aware_owner_sets(self):
+        """With a role split, every shard gets min(rf, role size) owners
+        from EACH role (prefill listed first) — the per-role failover
+        invariant (a decode crash must leave a surviving decode owner)."""
+        is_prefill = lambda r: r < 3  # noqa: E731 — ranks 0-2 prefill, 3-6 decode
+        m = build_ownership(range(7), 2, 0, is_prefill=is_prefill)
+        for sid in range(NUM_SHARDS):
+            owners = m.owners_of(sid)
+            pf = [r for r in owners if is_prefill(r)]
+            dc = [r for r in owners if not is_prefill(r)]
+            assert len(pf) == 2 and len(dc) == 2
+            assert owners[: len(pf)] == tuple(pf)  # prefill-first order
+
+    def test_owned_shards_inverse(self):
+        m = build_ownership(range(8), 3, 0)
+        for rank in range(8):
+            for sid in m.owned_shards(rank):
+                assert m.is_owner(rank, sid)
+        total = sum(len(m.owned_shards(r)) for r in range(8))
+        assert total == 3 * NUM_SHARDS
+
+    def test_membership_change_moves_bounded_shards(self):
+        before = build_ownership(range(20), 3, 0)
+        after = build_ownership(range(21), 3, 1)
+        changed = sum(
+            1
+            for sid in range(NUM_SHARDS)
+            if set(before.owners_of(sid)) != set(after.owners_of(sid))
+        )
+        # One joiner must not reshuffle the shard space (bounded key
+        # movement is the consistent-hash property sharding rides).
+        assert changed <= NUM_SHARDS // 3
+
+
+@pytest.mark.quick
+class TestShardSummaryWire:
+    def test_round_trip(self):
+        shards = {
+            5: (0xDEADBEEF, [(123, 64), (456, 8)]),
+            61: (0, []),
+        }
+        origin, back = decode_shard_summary(encode_shard_summary(9, shards))
+        assert origin == 9
+        assert back == shards
+
+    def test_root_budget_truncates(self):
+        roots = [(i, 1000 - i) for i in range(1000)]
+        _, back = decode_shard_summary(
+            encode_shard_summary(0, {3: (1, roots)})
+        )
+        from radixmesh_tpu.cache.sharding import MAX_SUMMARY_ROOTS
+
+        assert len(back[3][1]) == MAX_SUMMARY_ROOTS
+
+    def test_malformed_raises(self):
+        with pytest.raises(ValueError):
+            decode_shard_summary(np.asarray([1, 2, 3], dtype=np.int32))
+
+    def test_table_lookup_and_retain(self):
+        t = ShardSummaryTable()
+        t.fold(1, {4: (11, [(99, 32)])})
+        t.fold(2, {4: (11, [(99, 16)]), 5: (7, [])})
+        assert t.lookup(4, 99) == {1: 32, 2: 16}
+        assert t.shard_fp(2, 5) == 7
+        t.retain([2])
+        assert t.lookup(4, 99) == {2: 16}
+        t.forget(2)
+        assert t.lookup(4, 99) == {}
+
+
+@pytest.mark.quick
+class TestTreeShardFingerprints:
+    def test_scalar_equals_xor_of_shards(self):
+        t = RadixTree(page_size=1, shard_fn=_shard_fn(1))
+        rng = np.random.default_rng(0)
+        for i in range(30):
+            key = rng.integers(1, 500, size=12)
+            t.insert(key, np.arange(12, dtype=np.int32) + i * 12)
+        acc = 0
+        for fp in t.shard_fingerprints().values():
+            acc ^= fp
+        assert acc == t.fingerprint_
+
+    def test_order_and_split_invariance(self):
+        keys = [
+            np.asarray([1, 2, 3, 4, 5, 6], dtype=np.int32),
+            np.asarray([1, 2, 3, 9, 9, 9], dtype=np.int32),
+            np.asarray([7, 7, 7, 7, 7, 7], dtype=np.int32),
+        ]
+        a = RadixTree(page_size=1, shard_fn=_shard_fn(1))
+        b = RadixTree(page_size=1, shard_fn=_shard_fn(1))
+        for k in keys:
+            a.insert(k, np.arange(len(k), dtype=np.int32))
+        for k in reversed(keys):
+            b.insert(k, np.arange(len(k), dtype=np.int32))
+        assert a.shard_fingerprints() == b.shard_fingerprints()
+
+    def test_evict_and_delete_fold_out(self):
+        t = RadixTree(page_size=1, shard_fn=_shard_fn(1))
+        key = np.asarray([3, 1, 4, 1, 5], dtype=np.int32)
+        t.insert(key, np.arange(5, dtype=np.int32))
+        assert t.shard_fingerprints()
+        t.evict(100)
+        assert t.shard_fingerprints() == {}
+        assert t.fingerprint_ == 0
+
+    def test_nodes_in_shard_and_root_summaries(self):
+        page = 4
+        t = RadixTree(page_size=page, shard_fn=_shard_fn(page))
+        key = np.arange(100, 116, dtype=np.int32)
+        ext = np.concatenate([key[:8], np.arange(200, 208, dtype=np.int32)])
+        t.insert(key, np.arange(16, dtype=np.int32))
+        t.insert(ext, np.arange(16, dtype=np.int32))
+        sid = shard_of_tokens(key[:page])
+        nodes = t.nodes_in_shard(sid)
+        assert nodes and all(n.shard == sid for n in nodes)
+        roots = t.shard_root_summaries(sid)
+        assert roots == [(root_page_hash(key, page), 16)]
+
+    def test_shard_constant_down_subtree_across_splits(self):
+        t = RadixTree(page_size=1, shard_fn=_shard_fn(1))
+        base = np.asarray([42, 1, 2, 3, 4, 5, 6, 7], dtype=np.int32)
+        t.insert(base, np.arange(8, dtype=np.int32))
+        fork = np.concatenate([base[:4], [9, 9]]).astype(np.int32)
+        t.insert(fork, np.arange(6, dtype=np.int32))  # splits mid-node
+        sid = shard_of_tokens(base[:1])
+        assert set(t.shard_fingerprints()) == {sid}
+        for n in t.nodes_in_shard(sid):
+            assert n.shard == sid
+
+
+@pytest.mark.quick
+class TestRepairShardWire:
+    def test_probe_round_trip_and_discrimination(self):
+        from radixmesh_tpu.cache.repair_plane import (
+            decode_shard_probe,
+            encode_probe,
+            encode_shard_probe,
+            is_shard_frame,
+        )
+
+        pairs = [(3, 0xAB), (17, 0)]
+        arr = encode_shard_probe(pairs)
+        assert is_shard_frame(arr)
+        assert decode_shard_probe(arr) == sorted(pairs)
+        vec = np.zeros(64, dtype="<u8")
+        assert not is_shard_frame(encode_probe(vec))
+
+    def test_session_summary_round_trip(self):
+        from radixmesh_tpu.cache.repair_plane import (
+            decode_shard_session_summary,
+            encode_shard_session_summary,
+            is_shard_frame,
+        )
+
+        pairs = [(5, 123), (6, 456)]
+        hashes = {111, 222}
+        arr = encode_shard_session_summary(pairs, hashes, reply=True)
+        assert is_shard_frame(arr)
+        back_pairs, back_hashes, reply = decode_shard_session_summary(arr)
+        assert back_pairs == pairs and back_hashes == hashes and reply
+
+
+def _mesh_cluster(rf, n_prefill=3, n_decode=2, router=True, **cfg_kw):
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.comm.inproc import InprocHub
+    from radixmesh_tpu.config import MeshConfig
+
+    InprocHub.reset_default()
+    prefill = [f"tp{i}" for i in range(n_prefill)]
+    decode = [f"td{i}" for i in range(n_decode)]
+    routers = ["tr0"] if router else []
+
+    def cfg(addr):
+        return MeshConfig(
+            prefill_nodes=prefill,
+            decode_nodes=decode,
+            router_nodes=routers,
+            local_addr=addr,
+            protocol="inproc",
+            replication_factor=rf,
+            tick_interval_s=0.05,
+            failure_timeout_s=30.0,
+            shard_summary_interval_s=0.05,
+            **cfg_kw,
+        )
+
+    nodes = [MeshCache(cfg(a)) for a in prefill + decode]
+    rm = MeshCache(cfg("tr0")) if router else None
+    all_nodes = nodes + ([rm] if rm else [])
+    for n in all_nodes:
+        n.start()
+    for n in all_nodes:
+        assert n.wait_ready(timeout=10)
+    return nodes, rm
+
+
+def _close_all(nodes, rm):
+    for n in nodes + ([rm] if rm else []):
+        n.close()
+
+
+def _wait(pred, timeout=8.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestShardedMeshLive:
+    def test_insert_delivered_to_owner_set_only(self):
+        nodes, rm = _mesh_cluster(rf=3)
+        try:
+            key = list(range(300, 332))
+            w = nodes[0]
+            w.insert(key, np.arange(32, dtype=np.int32))
+            owners = w.owner_ranks(key)
+            assert len(owners) >= 3  # role-aware: rf per serving role
+            assert _wait(
+                lambda: all(
+                    nodes[r].match_prefix(key).length == 32 for r in owners
+                )
+            )
+            for r in range(len(nodes)):
+                if r not in owners and r != w.rank:
+                    assert nodes[r].match_prefix(key).length == 0, (
+                        f"non-owner rank {r} received an owner-addressed insert"
+                    )
+            # Router holds NO tree replica under sharding.
+            assert rm.tree.evictable_size_ + rm.tree.protected_size_ == 0
+            # Telemetry: bytes-per-insert EWMA moved, owned shards gauge set.
+            assert w._bpi_ewma > 0
+            assert len(w.ownership.owned_shards(w.rank)) > 0
+        finally:
+            _close_all(nodes, rm)
+
+    def test_router_routes_from_summaries_to_owner(self):
+        nodes, rm = _mesh_cluster(rf=3)
+        try:
+            key = list(range(700, 732))
+            nodes[1].insert(key, np.arange(32, dtype=np.int32))
+            owners = set(nodes[1].owner_ranks(key)) | {nodes[1].rank}
+            assert _wait(lambda: rm.shard_route(key).match_len > 0)
+            m = rm.shard_route(key)
+            assert m.match_len == 32
+            assert m.prefill_rank in owners or m.decode_rank in owners
+        finally:
+            _close_all(nodes, rm)
+
+    def test_pull_through_fills_non_owner(self):
+        nodes, rm = _mesh_cluster(rf=2)
+        try:
+            key = list(range(40, 72))
+            w = nodes[0]
+            w.insert(key, np.arange(32, dtype=np.int32))
+            owners = w.owner_ranks(key)
+            non_owners = [
+                r for r in range(len(nodes))
+                if r not in owners and r != w.rank
+            ]
+            if not non_owners:
+                pytest.skip("rf=2 owner set covered every node")
+            tgt = non_owners[0]
+            donor = [r for r in owners if r != tgt][0]
+            assert _wait(
+                lambda: nodes[donor].match_prefix(key).length == 32
+            )
+            assert rm.send_shard_pull(key, donor, tgt)
+            assert _wait(
+                lambda: nodes[tgt].match_prefix(key).length == 32
+            ), "pull-through never filled the target replica"
+        finally:
+            _close_all(nodes, rm)
+
+    def test_owner_scoped_repair_heals_diverged_shard(self):
+        from radixmesh_tpu.cache.repair_plane import RepairConfig, RepairPlane
+
+        nodes, rm = _mesh_cluster(rf=2)
+        planes = [
+            RepairPlane(
+                n,
+                RepairConfig(
+                    interval_s=0.05, age_threshold_s=0.0,
+                    backoff_base_s=0.05,
+                ),
+            ).start()
+            for n in nodes
+        ]
+        try:
+            rng = np.random.default_rng(1)
+            keys = [rng.integers(1, 900, size=24).tolist() for _ in range(5)]
+            for i, k in enumerate(keys):
+                nodes[0].insert(k, np.arange(24, dtype=np.int32) + i * 24)
+            k = keys[0]
+            owners = nodes[0].owner_ranks(k)
+            victim = next((r for r in owners if r != 0), owners[0])
+            vn = nodes[victim]
+            assert _wait(lambda: vn.match_prefix(k).length == 24)
+            with vn._lock:
+                vn._apply_delete(np.asarray(k, dtype=np.int32))
+            assert vn.match_prefix(k).length == 0
+            assert _wait(
+                lambda: vn.match_prefix(k).length == 24, timeout=12.0
+            ), "owner-scoped repair never resurrected the dropped entry"
+            assert _wait(
+                lambda: nodes[0].fleet.shard_convergence()["converged"],
+                timeout=12.0,
+            )
+        finally:
+            for p in planes:
+                p.close()
+            _close_all(nodes, rm)
+
+    def test_drain_handoff_moves_owned_shards(self):
+        nodes, rm = _mesh_cluster(rf=1, n_prefill=4, n_decode=0, router=False)
+        try:
+            rng = np.random.default_rng(5)
+            w = nodes[0]
+            keys = []
+            # Keys OWNED by rank 0 (rf=1 per role: exactly one owner).
+            while len(keys) < 4:
+                k = rng.integers(1, 900, size=16).tolist()
+                if w.owner_ranks(k) == (0,):
+                    keys.append(k)
+                    w.insert(k, np.arange(16, dtype=np.int32))
+            stats = w.handoff_owned_shards()
+            assert stats["shards"] > 0 and stats["entries"] > 0
+            # The would-be successor owners receive the entries.
+            survivors = [r for r in range(1, 4)]
+            future = build_ownership(
+                survivors, 1, 99, is_prefill=w.cfg.is_prefill_rank
+            )
+            for k in keys:
+                sid = shard_of_tokens(np.asarray(k[:1], dtype=np.int32))
+                new_owner = future.owners_of(sid)[0]
+                assert _wait(
+                    lambda k=k, r=new_owner: nodes[r].match_prefix(k).length
+                    == 16
+                ), "handoff never reached the new owner"
+        finally:
+            _close_all(nodes, None)
+
+    def test_ownership_rebuilds_on_view_change(self):
+        nodes, rm = _mesh_cluster(rf=2, n_prefill=3, n_decode=2)
+        try:
+            w = nodes[0]
+            epoch0 = w.ownership.epoch
+            with w._lock:
+                old = w.view
+                w.view = old.without(nodes[-1].rank)
+                w._after_view_change(old)
+            assert w.ownership.epoch == w.view.epoch != epoch0
+            assert nodes[-1].rank not in w.ownership.ranks
+        finally:
+            _close_all(nodes, rm)
+
+
+class TestFullReplicaCompat:
+    """``--replication-factor 0``: bit-for-bit the old wire behavior."""
+
+    def test_rf0_mesh_is_unsharded(self):
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.config import MeshConfig
+
+        mesh = MeshCache(MeshConfig(
+            prefill_nodes=["a", "b"], decode_nodes=[], router_nodes=[],
+            local_addr="a", protocol="inproc",
+        ))
+        assert not mesh.sharded
+        assert mesh.ownership is None
+        assert mesh._shard_table is None
+        assert mesh.tree.shard_fn is None
+        assert mesh.owner_ranks([1, 2, 3]) == ()
+
+    def test_rf0_insert_rides_the_ring_to_everyone(self):
+        nodes, rm = _mesh_cluster(rf=0)
+        try:
+            key = list(range(10, 42))
+            nodes[0].insert(key, np.arange(32, dtype=np.int32))
+            assert _wait(
+                lambda: all(
+                    n.match_prefix(key).length == 32 for n in nodes
+                )
+            ), "full-replica insert did not reach every ring member"
+            # The router replica fills too (master fan-out), exactly the
+            # pre-sharding contract.
+            assert _wait(lambda: rm.match_prefix(key).match_len == 32)
+        finally:
+            _close_all(nodes, rm)
+
+    def test_rf0_emits_ring_ttl_frames(self):
+        """The wire frame of an rf=0 insert carries a FULL ring-lap TTL
+        (not the sharded point-to-point ttl=1): the frame bytes are the
+        pre-sharding wire, bit-for-bit."""
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.cache.oplog import deserialize
+        from radixmesh_tpu.config import MeshConfig
+
+        sent = []
+        mesh = MeshCache(MeshConfig(
+            prefill_nodes=["a", "b", "c"], decode_nodes=[],
+            router_nodes=[], local_addr="a", protocol="inproc",
+        ))
+        mesh._started = True
+        mesh._send_bytes = lambda data, control=False, dest="ring": sent.append(
+            data
+        )
+        mesh.insert([1, 2, 3], np.arange(3, dtype=np.int32))
+        assert len(sent) == 1
+        op = deserialize(sent[0])
+        assert op.ttl == 3  # one full lap of the 3-ring
+
+    def test_rf_requires_flat_ring(self):
+        from radixmesh_tpu.config import MeshConfig
+
+        with pytest.raises(ValueError, match="topology: ring"):
+            MeshConfig(
+                prefill_nodes=[f"h{i}" for i in range(6)],
+                decode_nodes=[], router_nodes=[], local_addr="h0",
+                topology="hier", replication_factor=3,
+            ).validate()
+
+
+@pytest.mark.quick
+class TestBootstrapConvergence:
+    def test_sharded_bootstrap_requires_summaries(self):
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.config import MeshConfig
+
+        def mk(addr):
+            return MeshCache(MeshConfig(
+                prefill_nodes=["ba", "bb"], decode_nodes=[],
+                router_nodes=[], local_addr=addr, protocol="inproc",
+                replication_factor=2,
+            ))
+
+        a, b = mk("ba"), mk("bb")
+        # No gossip from b yet: not converged (silence != convergence).
+        assert not a.bootstrap_converged_with(b.rank)
+        # Empty-tree summaries from b: both replicas empty → converged.
+        a.fleet.fold_shard_fps(
+            b.rank,
+            {sid: 0 for sid in b.ownership.owned_shards(b.rank)},
+        )
+        assert a.bootstrap_converged_with(b.rank)
+        # b advertises data a lacks in a co-owned shard → diverged.
+        sid = next(
+            s for s in a.ownership.owned_shards(a.rank)
+            if a.ownership.is_owner(b.rank, s)
+        )
+        fps = {s: 0 for s in b.ownership.owned_shards(b.rank)}
+        fps[sid] = 12345
+        a.fleet.fold_shard_fps(b.rank, fps)
+        assert not a.bootstrap_converged_with(b.rank)
+        assert a.diverged_shards_with(b.rank) == [sid]
