@@ -680,6 +680,176 @@ def _chaos_pass() -> dict:
 
 
 # ----------------------------------------------------------------------
+# OBS stable schema (PR 9, mesh-wide observability plane): one artifact
+# per round recording the three legs of workload.run_obs_workload —
+# (a) cross-node trace stitching (crash+resurrection under full tracing,
+# one Perfetto export, interrupted request on >= OBS_MIN_NODE_TRACKS
+# node tracks under a single trace id), (b) per-shard heat & skew (zipf
+# inserts drive the skew score; the router names the hot shard + owner
+# set from gossip alone), and (c) TPU step attribution (per-wave MFU +
+# pad fraction for prefill AND decode), plus the wire gate (traceless
+# frames bit-for-bit pre-PR-9). Bump the version ONLY when adding fields
+# (never remove or rename).
+# ----------------------------------------------------------------------
+
+OBS_SCHEMA_VERSION = 1
+
+OBS_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload", "nodes",
+    "topology", "replication_factor", "stitch", "heat", "steps", "wire",
+    "wall_s",
+)
+OBS_STITCH_FIELDS = (
+    "performed", "node", "streams", "tokens_per_stream", "interrupted",
+    "resumed", "failed", "trace_id", "node_tracks", "nodes_on_track",
+    "replication_edges", "publish_edges", "span_count", "stitched_events",
+)
+OBS_HEAT_FIELDS = (
+    "performed", "inserts", "distinct_keys", "zipf_alpha", "skew_score",
+    "hot_shard", "expected_hot_shard", "hot_owners", "expected_hot_owners",
+    "owner_set_correct", "reporters",
+)
+OBS_STEP_FIELDS = ("performed", "n_params", "peak_tflops", "prefill", "decode")
+OBS_WAVE_FIELDS = ("waves", "real_tokens", "padded_tokens", "mfu", "pad_fraction")
+OBS_WIRE_FIELDS = (
+    "rf0_traceless_unchanged", "trace_trailer_roundtrip", "trailer_bytes",
+)
+# Structural acceptance floors.
+OBS_MIN_NODE_TRACKS = 3
+OBS_MIN_SKEW_SCORE = 2.0
+
+
+def validate_obs(report) -> list[str]:
+    """Schema violations of an OBS artifact vs the pinned contract
+    (empty = valid). Gates: the stitched trace shows the interrupted
+    request on >= OBS_MIN_NODE_TRACKS node tracks under ONE trace id
+    with replication edges visible and zero lost streams; the zipf hot
+    shard is detected with the correct owner set and a skew score above
+    the floor; per-wave MFU + pad fraction are reported for BOTH
+    prefill and decode; and the traceless wire is bit-for-bit the
+    pre-trace encoding. Sections with performed=False are schema-valid
+    but gate-exempt (the CHAOS v2/v3 convention). Import-safe from
+    artifact tests and scripts/obsbench.py (no jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in OBS_TOP_FIELDS if f not in report]
+    stitch = report.get("stitch")
+    if isinstance(stitch, dict) and stitch.get("performed"):
+        problems += [
+            f"stitch.{f}" for f in OBS_STITCH_FIELDS if f not in stitch
+        ]
+        if stitch.get("failed") != 0:
+            problems.append(
+                f"stitch: {stitch.get('failed')} stream(s) LOST during the "
+                "traced crash drill"
+            )
+        if not stitch.get("interrupted", 0):
+            problems.append(
+                "stitch: the kill interrupted zero live streams (the "
+                "cross-node path went unexercised)"
+            )
+        if stitch.get("resumed") != stitch.get("interrupted"):
+            problems.append(
+                "stitch: interrupted streams were not all resurrected "
+                f"({stitch.get('resumed')}/{stitch.get('interrupted')})"
+            )
+        tracks = stitch.get("node_tracks")
+        if not isinstance(tracks, int) or tracks < OBS_MIN_NODE_TRACKS:
+            problems.append(
+                f"stitch: interrupted request spans only {tracks} node "
+                f"track(s) (< {OBS_MIN_NODE_TRACKS}) — the journey did "
+                "not stitch"
+            )
+        if not stitch.get("replication_edges", 0):
+            problems.append(
+                "stitch: no replication edges under the trace id (the "
+                "oplog trace trailer never landed receiver-side)"
+            )
+    heat = report.get("heat")
+    if isinstance(heat, dict) and heat.get("performed"):
+        problems += [f"heat.{f}" for f in OBS_HEAT_FIELDS if f not in heat]
+        skew = heat.get("skew_score")
+        if not isinstance(skew, (int, float)) or skew < OBS_MIN_SKEW_SCORE:
+            problems.append(
+                f"heat: skew score {skew} below {OBS_MIN_SKEW_SCORE} — the "
+                "zipf workload failed to drive (or the plane failed to "
+                "measure) a hot shard"
+            )
+        if heat.get("hot_shard") != heat.get("expected_hot_shard"):
+            problems.append(
+                f"heat: detected hot shard {heat.get('hot_shard')} != "
+                f"ground truth {heat.get('expected_hot_shard')}"
+            )
+        if heat.get("owner_set_correct") is not True:
+            problems.append(
+                "heat: the hot shard's owner set was not correctly named "
+                f"({heat.get('hot_owners')} vs "
+                f"{heat.get('expected_hot_owners')})"
+            )
+        if not heat.get("reporters", 0):
+            problems.append("heat: zero heat reporters (gossip never folded)")
+    steps = report.get("steps")
+    if isinstance(steps, dict) and steps.get("performed"):
+        problems += [f"steps.{f}" for f in OBS_STEP_FIELDS if f not in steps]
+        for kind in ("prefill", "decode"):
+            wave = steps.get(kind)
+            if not isinstance(wave, dict):
+                continue
+            problems += [
+                f"steps.{kind}.{f}" for f in OBS_WAVE_FIELDS if f not in wave
+            ]
+            if not wave.get("waves", 0):
+                problems.append(f"steps: zero {kind} waves accounted")
+            mfu = wave.get("mfu")
+            if not isinstance(mfu, (int, float)) or not (mfu > 0):
+                problems.append(
+                    f"steps: {kind} MFU {mfu!r} not a positive number"
+                )
+            pad = wave.get("pad_fraction")
+            if not isinstance(pad, (int, float)) or not (0.0 <= pad < 1.0):
+                problems.append(
+                    f"steps: {kind} pad fraction {pad!r} outside [0, 1)"
+                )
+    wire = report.get("wire")
+    if isinstance(wire, dict):
+        problems += [f"wire.{f}" for f in OBS_WIRE_FIELDS if f not in wire]
+        if wire.get("rf0_traceless_unchanged") is not True:
+            problems.append(
+                "wire: a traceless frame is NOT bit-for-bit the pre-trace "
+                "encoding (tracing off must cost zero wire bytes)"
+            )
+        if wire.get("trace_trailer_roundtrip") is not True:
+            problems.append("wire: the trace trailer did not round-trip")
+    return problems
+
+
+def build_obs_report(res: dict) -> dict:
+    """Assemble a schema-complete OBS artifact from
+    ``workload.run_obs_workload``'s result."""
+    stitch = res.get("stitch", {})
+    heat = res.get("heat", {})
+    return {
+        "schema_version": OBS_SCHEMA_VERSION,
+        "metric": "obs_stitched_node_tracks",
+        "value": stitch.get("node_tracks"),
+        "unit": (
+            "node tracks carrying the interrupted request's spans in ONE "
+            "stitched Perfetto trace under a single 64-bit trace id"
+        ),
+        "workload": (
+            f"{stitch.get('streams', 0)} traced streams, busiest decode "
+            "node killed mid-stream, resurrection on the survivor "
+            f"(rf={res.get('replication_factor')}); zipf(alpha="
+            f"{heat.get('zipf_alpha')}) inserts over "
+            f"{heat.get('distinct_keys')} subtree roots for the heat map; "
+            "tiny-engine burst for step attribution "
+            "(see workload.run_obs_workload)"
+        ),
+        **res,
+    }
+
+
+# ----------------------------------------------------------------------
 # KVFLOW stable schema (PR 4, async KV-movement plane): one artifact per
 # round recording restore-stall vs overlapped TTFT, write-back gather
 # fusion, and prefetch hit-ahead rate (radixmesh_tpu/cache/kv_transfer.py

@@ -92,6 +92,7 @@ from radixmesh_tpu.cache.radix_tree import (
 )
 from radixmesh_tpu.cache.sharding import (
     MAX_SUMMARY_ROOTS,
+    ShardHeat,
     ShardSummaryTable,
     build_ownership,
     decode_shard_summary,
@@ -237,6 +238,23 @@ class MeshCache:
         # co-owner convergence too), read on the router's routing path.
         self._shard_table = ShardSummaryTable() if self.sharded else None
         self._last_shard_summary = 0.0
+        # Per-shard heat telemetry (PR 9, cache/sharding.py::ShardHeat):
+        # decayed insert/hit/pull-through/byte counters for the shards
+        # this replica touches. THIS MODULE is the single writer (lint-
+        # pinned like ownership): the counting sites are insert origin
+        # (_broadcast_data), replica apply (oplog_received), prefix-hit
+        # (match_prefix), and pull-through serve (_handle_shard_pull).
+        # P/D + sharded only — routers measure nothing; they read the
+        # gossiped heat map.
+        self.heat = (
+            ShardHeat()
+            if self.sharded and self.role is not NodeRole.ROUTER
+            else None
+        )
+        # Shard ids whose heat gauge children hold a nonzero value from
+        # the LAST summary broadcast — zeroed when a shard cools off or
+        # leaves the owned set (a scraped gauge can't be swapped whole).
+        self._heat_gauge_sids: set[int] = set()
         # EWMA of wire bytes each local insert cost (frame size × owner
         # deliveries under sharding; frame × ring size unsharded).
         self._bpi_ewma = 0.0
@@ -394,6 +412,23 @@ class MeshCache:
         self._m_prefetch_sent = reg.counter(
             "radixmesh_mesh_prefetch_sent_total",
             "PREFETCH restore hints originated by this node",
+            ("node",),
+        ).labels(node=node)
+        # Per-shard heat & skew telemetry (PR 9 — the rebalancer's
+        # measurement substrate). Families register on every node so a
+        # fleet rolling sharding on sees series move from zero; values
+        # only flow on sharded P/D nodes (the summary broadcast updates
+        # them once per interval — never on the per-insert hot path).
+        self._g_shard_heat = reg.gauge(
+            "radixmesh_shard_heat_tokens_per_second",
+            "decayed per-owned-shard load (insert+hit tokens/s, "
+            "half-life-weighted — cache/sharding.py::ShardHeat)",
+            ("node", "shard"),
+        )
+        self._g_skew = reg.gauge(
+            "radixmesh_shard_skew_ratio",
+            "fleet heat-map skew: max/mean decayed load over reported "
+            "shards (1 = flat; the rebalancing trigger signal)",
             ("node",),
         ).labels(node=node)
         self._m_bridged = reg.counter(
@@ -704,10 +739,16 @@ class MeshCache:
     # public cache API
     # ------------------------------------------------------------------
 
-    def insert(self, key, slot_indices: np.ndarray) -> int:
+    def insert(self, key, slot_indices: np.ndarray, trace_id: int = 0) -> int:
         """Insert a locally-computed prefix (KV already written to the local
         pool at ``slot_indices``) and replicate it around the ring
-        (reference ``radix_mesh.py:193-201``). Prefill/decode only."""
+        (reference ``radix_mesh.py:193-201``). Prefill/decode only.
+
+        ``trace_id`` (cross-node stitching, obs/trace_plane.py) rides
+        the wire as the old-wire-tolerant trace trailer so every replica
+        records its apply/lag spans under the originating request's
+        timeline; 0 (tracing off) emits bit-for-bit the pre-trace
+        frame."""
         if self.role is NodeRole.ROUTER:
             raise RuntimeError("router nodes hold no KV; insert is P/D-only")
         key = as_key(key)
@@ -729,6 +770,7 @@ class MeshCache:
             slot_indices = slot_indices[:n]
             wire_value = self._page_wire_value(slot_indices)
         value = PrefillValue(slot_indices, self.rank)
+        t0 = time.monotonic()
         with self._lock:
             prefix_len = self._mesh_insert(key, value)
             # Enqueued under the lock: wire order == application order.
@@ -742,8 +784,28 @@ class MeshCache:
                     value=wire_value,
                     value_rank=self.rank,
                     page=self.page,
+                    trace_id=int(trace_id),
                 )
             )
+        if trace_id:
+            # Origin-side stitch anchor: the publish edge on THIS node's
+            # ring lane under the request's trace id — paired with the
+            # receivers' replication_lag spans, the replication fan-out
+            # reads as visible edges in the stitched flame view. Only on
+            # traced requests: tracing off (trace_id == 0) never reaches
+            # this branch.
+            rec = get_recorder()
+            if rec.enabled:
+                rec.event(
+                    f"ring:{self._node_label}",
+                    "mesh_publish",
+                    t0,
+                    time.monotonic() - t0,
+                    cat="ring",
+                    trace_id=trace_id,
+                    node=self._node_label,
+                    tokens=len(key),
+                )
         return prefix_len
 
     def match_prefix(self, key) -> MatchResult | RouterMatchResult:
@@ -754,7 +816,13 @@ class MeshCache:
             if self.role is NodeRole.ROUTER:
                 res = self.tree.match_prefix(key, split_partial=False)
                 return self._route_from_values(res.values)
-            return self.tree.match_prefix(key)
+            res = self.tree.match_prefix(key)
+            if self.heat is not None and res.length > 0:
+                key_arr = as_key(key)
+                self.heat.note_hit(
+                    shard_of_tokens(key_arr[: max(1, self.page)]), res.length
+                )
+            return res
 
     def local_prefix_indices(self, key) -> np.ndarray:
         """Longest *locally-usable* cached prefix: the leading run of
@@ -851,20 +919,28 @@ class MeshCache:
             rec = get_recorder()
             if rec.enabled:
                 # Flight-recorder lag span on this node's ring lane,
-                # ending "now": the origin stamped wall-clock at enqueue
-                # (existing per-origin lag bookkeeping — NO wire-format
-                # change), so t0 is back-derived into the local monotonic
-                # base the request spans use. Correlation with a request
-                # is by time overlap in the timeline viewer; no trace id
-                # crosses the wire.
+                # ending "now": the origin stamped wall-clock at enqueue,
+                # so t0 is back-derived into the local monotonic base the
+                # request spans use. When the frame carries the optional
+                # trace trailer (cross-node stitching, PR 9) the span
+                # lands UNDER the originating request's 64-bit trace id —
+                # the replication edge becomes part of that request's
+                # stitched timeline; traceless frames keep the PR 2
+                # behavior (correlation by time overlap only).
                 rec.event(
                     f"ring:{self._node_label}",
                     "replication_lag",
                     time.monotonic() - lag,
                     lag,
                     cat="ring",
+                    trace_id=op.trace_id,
+                    node=self._node_label,
                     origin_rank=int(op.origin_rank),
-                    op_type=op.op_type.name,
+                    op_type=(
+                        op.op_type.name
+                        if isinstance(op.op_type, OplogType)
+                        else int(op.op_type)
+                    ),
                 )
         self._last_rx = time.monotonic()
         with self._lock:
@@ -957,6 +1033,16 @@ class MeshCache:
                         ).reshape(-1)
                     value = PrefillValue(indices, op.value_rank)
                 self._mesh_insert(op.key, value)
+                if self.heat is not None:
+                    # Replica-side heat: the owner set's applies count the
+                    # same traffic the origin counted, decayed identically
+                    # — co-owners therefore gossip comparable loads and
+                    # the fleet map takes the MAX, not the sum.
+                    self.heat.note_insert(
+                        shard_of_tokens(op.key[: max(1, self.page)]),
+                        len(op.key),
+                        len(data),
+                    )
             elif op.op_type is OplogType.DELETE:
                 self._apply_delete(op.key)
             elif op.op_type is OplogType.RESET:
@@ -1915,6 +2001,10 @@ class MeshCache:
             self._enqueue_owner(rank, data)
         if op.op_type is OplogType.INSERT:
             self._note_insert_bytes(len(data) * len(targets))
+            if self.heat is not None:
+                self.heat.note_insert(
+                    sid, len(op.key), len(data) * max(1, len(targets))
+                )
 
     def _note_insert_bytes(self, nbytes: int) -> None:
         self._bpi_ewma += 0.2 * (float(nbytes) - self._bpi_ewma)
@@ -2063,11 +2153,35 @@ class MeshCache:
                 )
                 for sid in owned
             }
+            # Per-shard heat (PR 9): decayed loads for the OWNED shards
+            # ride the same frame as an old-wire-tolerant trailer — the
+            # cluster heat map costs zero extra frames.
+            loads = {}
+            if self.heat is not None:
+                all_loads = self.heat.loads()
+                loads = {
+                    sid: all_loads[sid] for sid in owned if sid in all_loads
+                }
+                for sid, load in loads.items():
+                    self._g_shard_heat.labels(
+                        node=self._node_label, shard=str(sid)
+                    ).set(load)
+                # Shards published last interval but silent now (cooled
+                # to zero, or no longer owned) must read 0, not their
+                # last hot value — a scraped gauge has no whole-summary
+                # swap to correct it.
+                for sid in self._heat_gauge_sids - set(loads):
+                    self._g_shard_heat.labels(
+                        node=self._node_label, shard=str(sid)
+                    ).set(0.0)
+                self._heat_gauge_sids = set(loads)
             # Fold locally first (same contract as broadcast_digest):
             # this node's own view is as fresh as anyone's.
             self.fleet.fold_shard_fps(
                 self.rank, {sid: fp for sid, (fp, _) in shards.items()}
             )
+            self.fleet.fold_shard_heat(self.rank, loads)
+            self._g_skew.set(self.fleet.shard_heat()["skew_score"])
             if self._shard_table is not None:
                 self._shard_table.fold(self.rank, shards)
             self._broadcast(
@@ -2076,7 +2190,7 @@ class MeshCache:
                     origin_rank=self.rank,
                     logic_id=self._logic_op.next(),
                     ttl=self._data_ttl(),
-                    value=encode_shard_summary(self.rank, shards),
+                    value=encode_shard_summary(self.rank, shards, loads),
                     value_rank=self.rank,
                 )
             )
@@ -2088,7 +2202,7 @@ class MeshCache:
         if op.origin_rank == self.rank:
             return  # lap complete
         try:
-            origin, shards = decode_shard_summary(op.value)
+            origin, shards, loads = decode_shard_summary(op.value)
         except ValueError:
             if throttled(("bad_shard_summary", self.rank),
                          self.cfg.tick_interval_s):
@@ -2100,6 +2214,7 @@ class MeshCache:
         self.fleet.fold_shard_fps(
             origin, {sid: fp for sid, (fp, _) in shards.items()}
         )
+        self.fleet.fold_shard_heat(origin, loads)
         if self._shard_table is not None:
             self._shard_table.fold(origin, shards)
         self._circulate(op, data)
@@ -2171,6 +2286,10 @@ class MeshCache:
             self._m_pullthrough.labels(
                 node=self._node_label, outcome="served"
             ).inc()
+            if self.heat is not None:
+                self.heat.note_pull(
+                    shard_of_tokens(op.key[: max(1, self.page)])
+                )
         else:
             self._m_pullthrough.labels(
                 node=self._node_label, outcome="miss"
@@ -2214,6 +2333,20 @@ class MeshCache:
             decode_rank=decode_rank,
             match_len=match_len,
         )
+
+    def shard_heat_report(self) -> dict:
+        """The fleet heat map (``FleetView.shard_heat``) enriched with
+        what only a node holding the ownership map can add: the HOT
+        shard's current owner set — the exact ranks a rebalancer would
+        move load off of. Served on ``/cluster/telemetry`` from every
+        role (the router folds the same gossip)."""
+        out = self.fleet.shard_heat()
+        hot = out.get("hot_shard")
+        if hot is not None and self.ownership is not None:
+            out["hot_owners"] = list(self.ownership.owners_of(int(hot)))
+        else:
+            out["hot_owners"] = []
+        return out
 
     def handoff_owned_shards(self) -> dict:
         """Drain-time ownership transfer (policy/lifecycle.py): push

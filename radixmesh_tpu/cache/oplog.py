@@ -60,6 +60,18 @@ _FLAG_VALUE_U24 = 2
 # ring — flat mode never sets it). Pre-v3 peers cannot carry the bit, so
 # hier mode requires the v3 emit version (enforced by MeshCache).
 _FLAG_SPINE = 4
+# Cross-node trace stitching (obs/trace_plane.py): set = an 8-byte
+# little-endian 64-bit trace id TRAILS the frame (after the GC entries),
+# tying this frame's receive-side spans to the originating request's
+# timeline. Old-wire tolerant BY CONSTRUCTION, the same contract the
+# EXTENSION_KINDS pass-through gave new op kinds: a pre-PR-9 decoder
+# ignores unknown flag bits, parses exactly the bytes its offsets name,
+# and never inspects trailing bytes — and since every hop forwards the
+# RAW frame (patched_ttl edits in place), the trailer survives transit
+# through old peers untouched. The edge timestamp of the hop is the
+# existing ``ts`` header field (v2+) — no second clock on the wire.
+_FLAG_TRACE = 8
+_TRACE_TRAILER = struct.Struct("<Q")
 _HEADER_V2 = struct.Struct(
     "<BBBxiqiid"
 )  # magic, ver, type, pad, origin, logic, ttl, value_rank, ts
@@ -255,6 +267,11 @@ class Oplog:
     # Hierarchical scope: True while the frame rides the leader spine
     # (policy/hierarchy.py). Always False in flat-ring mode.
     spine: bool = False
+    # Cross-node trace stitching (obs/trace_plane.py): the originating
+    # request's 64-bit trace id, carried as an optional old-wire-tolerant
+    # trailer (see _FLAG_TRACE). 0 = untraced — the frame's bytes are
+    # then bit-for-bit the pre-trace wire.
+    trace_id: int = 0
 
     def __eq__(self, other) -> bool:
         return (
@@ -266,6 +283,7 @@ class Oplog:
             and self.value_rank == other.value_rank
             and self.page == other.page
             and self.spine == other.spine
+            and self.trace_id == other.trace_id
             and np.array_equal(self.key, other.key)
             and np.array_equal(self.value, other.value)
             and self.gc == other.gc
@@ -349,6 +367,8 @@ def serialize(op: Oplog) -> bytes:
         )
     else:
         flags = _FLAG_SPINE if op.spine else 0
+        if op.trace_id:
+            flags |= _FLAG_TRACE
         if _fits_u24(key):
             flags |= _FLAG_KEY_U24
             key_bytes = _pack_u24(key)
@@ -370,6 +390,13 @@ def serialize(op: Oplog) -> bytes:
         ek = _arr(e.key)
         parts.append(struct.pack("<iiI", e.agree, e.value_rank, len(ek)))
         parts.append(ek.tobytes())
+    if op.trace_id and _emit_version >= 3:
+        # Optional trace trailer (see _FLAG_TRACE): appended LAST so a
+        # pre-trace decoder — which parses to its computed end offset and
+        # never inspects trailing bytes — stays byte-compatible. A
+        # pinned pre-v3 emit version silently drops the id (tracing
+        # degrades during a rolling upgrade; the wire never breaks).
+        parts.append(_TRACE_TRAILER.pack(op.trace_id & ((1 << 64) - 1)))
     return b"".join(parts)
 
 
@@ -469,6 +496,13 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
         ek = np.frombuffer(buf, dtype=np.int32, count=eklen, offset=off).copy()
         off += 4 * eklen
         gc.append(GCEntry(key=ek, value_rank=vrank, agree=agree))
+    trace_id = 0
+    if flags & _FLAG_TRACE and len(buf) >= off + _TRACE_TRAILER.size:
+        # Optional trace trailer (see _FLAG_TRACE). The length guard
+        # makes a flag-without-trailer frame decode as untraced instead
+        # of raising — a truncated trailer costs stitching, never a
+        # frame.
+        (trace_id,) = _TRACE_TRAILER.unpack_from(buf, off)
     try:
         op_type = OplogType(op_type)
     except ValueError:
@@ -485,4 +519,5 @@ def deserialize(buf: bytes | memoryview) -> Oplog:
         ts=ts,
         page=page,
         spine=bool(flags & _FLAG_SPINE),
+        trace_id=trace_id,
     )

@@ -42,7 +42,9 @@ the wire behavior is bit-for-bit the PR 1-7 full-replica ring.
 from __future__ import annotations
 
 import hashlib
+import math
 import struct
+import time
 from typing import Iterable, Sequence
 
 import numpy as np
@@ -55,6 +57,8 @@ __all__ = [
     "encode_shard_summary",
     "decode_shard_summary",
     "ShardSummaryTable",
+    "ShardHeat",
+    "HEAT_HALF_LIFE_S",
 ]
 
 # Fixed shard space: small enough that the full per-shard fingerprint
@@ -189,6 +193,15 @@ _VERSION = 1
 _HDR = struct.Struct("<BBHi")  # magic, version, n_shards, origin_rank
 _SHARD_HDR = struct.Struct("<iQI")  # sid, fingerprint, n_roots
 _ROOT = struct.Struct("<QI")  # root-page path hash, deepest length (tokens)
+# Per-shard heat trailer (PR 9 observability): appended AFTER the v1
+# payload so a pre-PR-9 decoder — which parses exactly ``n_shards``
+# sections and never inspects trailing bytes — keeps decoding v1
+# semantics from a heat-bearing frame, and a PR-9 decoder reads empty
+# loads from a trailerless (pre-PR-9) frame. Same old-wire-tolerant
+# trailer discipline as the oplog trace trailer.
+_HEAT_MAGIC = 0x5E
+_HEAT_HDR = struct.Struct("<BxH")  # magic, pad, n_entries
+_HEAT_ENTRY = struct.Struct("<if")  # sid, decayed load (tokens/s)
 
 # Per-frame ceiling on root entries: a pathological shard summarizes its
 # deepest roots first and truncates — the router then under-reports
@@ -208,8 +221,12 @@ def _to_i32(raw: bytes) -> np.ndarray:
 def encode_shard_summary(
     origin_rank: int,
     shards: dict[int, tuple[int, list[tuple[int, int]]]],
+    loads: dict[int, float] | None = None,
 ) -> np.ndarray:
-    """``shards``: sid → (fingerprint, [(root_hash, deepest_len), ...])."""
+    """``shards``: sid → (fingerprint, [(root_hash, deepest_len), ...]).
+    ``loads``: sid → decayed load (tokens/s, :class:`ShardHeat`), packed
+    as the old-wire-tolerant heat trailer — None/empty emits the exact
+    pre-PR-9 bytes."""
     parts = [_HDR.pack(_MAGIC, _VERSION, len(shards), origin_rank)]
     budget = MAX_SUMMARY_ROOTS
     for sid in sorted(shards):
@@ -219,13 +236,22 @@ def encode_shard_summary(
         parts.append(_SHARD_HDR.pack(int(sid), fp & ((1 << 64) - 1), len(take)))
         for h, depth in take:
             parts.append(_ROOT.pack(int(h) & ((1 << 64) - 1), int(depth)))
+    if loads:
+        entries = sorted(loads.items())
+        parts.append(_HEAT_HDR.pack(_HEAT_MAGIC, len(entries)))
+        for sid, load in entries:
+            parts.append(_HEAT_ENTRY.pack(int(sid), float(load)))
     return _to_i32(b"".join(parts))
 
 
 def decode_shard_summary(
     arr: np.ndarray,
-) -> tuple[int, dict[int, tuple[int, list[tuple[int, int]]]]]:
-    """→ (origin rank, sid → (fingerprint, [(root_hash, deepest_len)]))."""
+) -> tuple[
+    int, dict[int, tuple[int, list[tuple[int, int]]]], dict[int, float]
+]:
+    """→ (origin rank, sid → (fingerprint, [(root_hash, deepest_len)]),
+    sid → decayed load). The load dict is empty for pre-PR-9 frames
+    (no heat trailer)."""
     raw = np.ascontiguousarray(np.asarray(arr, dtype=np.int32)).tobytes()
     if len(raw) < _HDR.size:
         raise ValueError(f"shard summary too short ({len(raw)} bytes)")
@@ -249,7 +275,21 @@ def decode_shard_summary(
             off += _ROOT.size
             roots.append((h, depth))
         out[sid] = (fp, roots)
-    return origin, out
+    loads: dict[int, float] = {}
+    if len(raw) >= off + _HEAT_HDR.size:
+        hmagic, n_entries = _HEAT_HDR.unpack_from(raw, off)
+        if (
+            hmagic == _HEAT_MAGIC
+            and len(raw) >= off + _HEAT_HDR.size + n_entries * _HEAT_ENTRY.size
+        ):
+            off += _HEAT_HDR.size
+            for _ in range(n_entries):
+                sid, load = _HEAT_ENTRY.unpack_from(raw, off)
+                off += _HEAT_ENTRY.size
+                loads[int(sid)] = float(load)
+        # A non-matching magic is the _to_i32 pad (or an unknown future
+        # trailer): this decoder reads no loads — never raises.
+    return origin, out, loads
 
 
 class ShardSummaryTable:
@@ -303,3 +343,111 @@ class ShardSummaryTable:
 
     def ranks(self) -> list[int]:
         return sorted(self._by_rank)
+
+
+# ---------------------------------------------------------------------------
+# Per-shard heat: the rebalancer's measurement substrate (PR 9).
+#
+# Owner sets are load-blind today (ROADMAP item 1's named follow-up) —
+# nobody measures which shards are hot. ShardHeat counts per-shard
+# insert/hit/pull-through/byte traffic with exponential decay, so "load"
+# means RECENT tokens/s, not lifetime totals: a shard that was hot an
+# hour ago reads cold now, which is what a rebalancer must see. The
+# decayed scalar load rides the SHARD_SUMMARY gossip (heat trailer
+# above), folds into FleetView as the cluster heat map, and the skew
+# score (max/mean owned-shard load) is the trigger signal a future
+# shard REBALANCER consumes.
+#
+# Single-writer contract (lint-pinned like ownership maps): ShardHeat is
+# constructed and mutated ONLY by cache/mesh_cache.py — one module owns
+# the counting sites, so insert/hit/pull heat cannot be double-counted
+# by a second instrumentation layer drifting in elsewhere.
+# ---------------------------------------------------------------------------
+
+# Heat decay half-life: recent-enough that a traffic shift shows within
+# a minute, long enough that gossip intervals (seconds) sample a stable
+# value.
+HEAT_HALF_LIFE_S = 30.0
+
+
+class ShardHeat:
+    """Exponentially-decayed per-shard traffic counters.
+
+    Each (shard, kind) series is a decayed accumulator: ``note`` first
+    decays the stored value by ``0.5 ** (dt / half_life)`` then adds the
+    sample. Reads decay-to-now, so an idle shard's load asymptotes to
+    zero without any sweeper thread. The scalar ``loads()`` rate —
+    insert + hit tokens normalized by the half-life — is the gossip
+    currency; ``snapshot()`` keeps the per-kind breakdown for
+    /cluster/telemetry.
+
+    NOT thread-safe on its own: every call site runs under the mesh
+    lock (the same serialization the fp_shards_ bookkeeping rides)."""
+
+    KINDS = ("insert_tokens", "hit_tokens", "pull_throughs", "bytes")
+
+    def __init__(self, half_life_s: float = HEAT_HALF_LIFE_S, now=time.monotonic):
+        self.half_life_s = float(half_life_s)
+        self._now = now
+        # sid → kind → [decayed value, last-update monotonic stamp]
+        self._cells: dict[int, dict[str, list[float]]] = {}
+
+    def _bump(self, sid: int, kind: str, amount: float) -> None:
+        now = self._now()
+        cell = self._cells.setdefault(int(sid), {})
+        v = cell.get(kind)
+        if v is None:
+            cell[kind] = [float(amount), now]
+            return
+        v[0] = v[0] * math.pow(0.5, (now - v[1]) / self.half_life_s) + amount
+        v[1] = now
+
+    def note_insert(self, sid: int, tokens: int, nbytes: int = 0) -> None:
+        self._bump(sid, "insert_tokens", tokens)
+        if nbytes:
+            self._bump(sid, "bytes", nbytes)
+
+    def note_hit(self, sid: int, tokens: int) -> None:
+        self._bump(sid, "hit_tokens", tokens)
+
+    def note_pull(self, sid: int) -> None:
+        self._bump(sid, "pull_throughs", 1.0)
+
+    def _decayed(self, sid: int, kind: str, now: float) -> float:
+        v = self._cells.get(int(sid), {}).get(kind)
+        if v is None:
+            return 0.0
+        return v[0] * math.pow(0.5, (now - v[1]) / self.half_life_s)
+
+    # Below this rate (tokens/s) a shard is COLD: it leaves the gossip
+    # trailer and its gauge zeroes, instead of exponential decay keeping
+    # a denormal-sized residue on the wire forever.
+    MIN_LOAD = 1e-6
+
+    def loads(self) -> dict[int, float]:
+        """sid → decayed load (tokens/s): insert + hit tokens over the
+        half-life window — THE scalar the heat trailer gossips and the
+        skew score ranks. Shards below :data:`MIN_LOAD` are omitted
+        (cold, not merely quiet)."""
+        now = self._now()
+        out: dict[int, float] = {}
+        for sid in self._cells:
+            tok = self._decayed(sid, "insert_tokens", now) + self._decayed(
+                sid, "hit_tokens", now
+            )
+            rate = tok / self.half_life_s
+            if rate >= self.MIN_LOAD:
+                out[sid] = rate
+        return out
+
+    def snapshot(self) -> dict[int, dict[str, float]]:
+        """Per-kind decayed values for /cluster/telemetry."""
+        now = self._now()
+        return {
+            sid: {
+                k: round(self._decayed(sid, k, now), 3)
+                for k in self.KINDS
+                if self._decayed(sid, k, now) > 0.0
+            }
+            for sid in sorted(self._cells)
+        }

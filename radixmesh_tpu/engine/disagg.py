@@ -79,8 +79,15 @@ class HandoffPacket:
     # The prefill leg won the tracing coin flip: the decode side follows
     # this bit instead of flipping its own, so under fractional sampling
     # a traced request's timeline spans BOTH nodes or neither — never an
-    # orphan half (trace ids themselves stay node-local).
+    # orphan half.
     traced: bool = False
+    # Cross-node stitching (PR 9): the prefill leg's 64-bit trace id.
+    # The decode side ADOPTS it (instead of minting a node-local id), so
+    # the stitched export shows pack → receive → kv_write → decode as
+    # ONE timeline. 0 on packets from pre-stitching senders — the
+    # receiver then falls back to the PR 2 behavior (fresh id, traced
+    # bit only).
+    trace_id: int = 0
     # Streamed handoff (cache/kv_transfer.py handoff lane): when
     # ``chunk_of`` > 0 this packet carries tokens
     # ``prompt[kv_start : kv_start + kv.shape[2])`` of a ``chunk_of``-way
@@ -166,6 +173,7 @@ class PrefillWorker(Engine):
             kv_start=skip_prefix,
             kv_scale=kv_scale,
             traced=req.trace is not None,
+            trace_id=req.trace.trace_id if req.trace is not None else 0,
         )
         req.state = RequestState.FINISHED
         self._release(req)
@@ -229,6 +237,9 @@ class PrefillWorker(Engine):
                     kv_start=lo,
                     kv_scale=None if kv_scale is None else np.asarray(kv_scale),
                     traced=req.trace is not None,
+                    trace_id=(
+                        req.trace.trace_id if req.trace is not None else 0
+                    ),
                     chunk_seq=seq,
                     chunk_of=chunk_of,
                 )
@@ -353,8 +364,15 @@ class DecodeWorker:
         if pkt.traced:
             # force=True: the prefill node already flipped the coin —
             # re-flipping here would orphan half the cross-node timelines
-            # at fractional sampling rates.
-            req.trace = get_recorder().trace(f"req:{req.rid}", force=True)
+            # at fractional sampling rates. The packet's trace id (PR 9)
+            # is ADOPTED so both legs stitch into one timeline; packets
+            # from pre-stitching senders carry 0 and get a fresh id.
+            req.trace = get_recorder().trace(
+                f"req:{req.rid}",
+                force=True,
+                trace_id=pkt.trace_id or None,
+                node=self.engine.name,
+            )
         if req.trace is not None:
             req.trace.add(
                 "disagg_handoff_receive", time.monotonic(), 0.0,
@@ -684,6 +702,7 @@ def pack_handoff(pkt: HandoffPacket) -> bytes:
             "kv_dtype": jnp.dtype(kv.dtype).name,
             "kv_start": int(pkt.kv_start),
             "traced": bool(pkt.traced),
+            "trace_id": int(pkt.trace_id),
             "chunk_seq": int(pkt.chunk_seq),
             "chunk_of": int(pkt.chunk_of),
             "scale_shape": None if scale is None else list(scale.shape),
@@ -732,6 +751,7 @@ def unpack_handoff(data: bytes) -> HandoffPacket:
         kv_start=h.get("kv_start", 0),
         kv_scale=scale,
         traced=bool(h.get("traced", False)),  # absent in pre-tracing packets
+        trace_id=int(h.get("trace_id", 0)),  # absent in pre-stitching packets
         chunk_seq=int(h.get("chunk_seq", -1)),  # absent in pre-stream packets
         chunk_of=int(h.get("chunk_of", 0)),
     )

@@ -149,6 +149,8 @@ class Engine:
         kv_transfer_chunk_tokens: int = 512,
         kv_transfer_min_restore_tokens: int = 0,
         stream_publish_tokens: int = 0,
+        step_accounting: bool = False,
+        peak_tflops: float | None = None,
     ):
         if page_size & (page_size - 1):
             raise ValueError("page_size must be a power of two")
@@ -443,6 +445,27 @@ class Engine:
         # Request-flight tracing lane for engine-scope (not per-request)
         # events: evictions, preemption sweeps (obs/trace_plane.py).
         self._trace_lane = f"engine:{self.name}"
+        # TPU step attribution (obs/step_plane.py): per-wave tokens,
+        # pad fraction, and an analytic-FLOPs MFU estimate. OFF by
+        # default — the wave hot paths keep the one-branch-when-off
+        # contract (a single `is not None` test per wave).
+        self.step_acct = None
+        # Padded-token count of the LAST prefill launch, set by whichever
+        # prefill path ran (single scheduler thread): the launch SHAPE
+        # lives inside each path, so this is how the wave accounting in
+        # _admit learns it without re-deriving bucket math.
+        self._wave_padded = 0
+        if step_accounting:
+            from radixmesh_tpu.obs.step_plane import StepAccounting
+
+            n_params = sum(
+                int(np.prod(p.shape))
+                for p in jax.tree_util.tree_leaves(self.params)
+                if hasattr(p, "shape")
+            )
+            self.step_acct = StepAccounting(
+                self.name, n_params, peak_tflops=peak_tflops
+            )
 
     # ------------------------------------------------------------------
     # public API
@@ -457,6 +480,7 @@ class Engine:
         ttft_deadline_s: float | None = None,
         e2e_deadline_s: float | None = None,
         resume_tokens: Sequence[int] | None = None,
+        trace_id: int | None = None,
     ) -> Request:
         """Build + validate a request WITHOUT queueing it — the admission
         seam the SLO control plane (``radixmesh_tpu/slo/``) holds requests
@@ -514,7 +538,13 @@ class Engine:
         # Request-flight tracing (obs/trace_plane.py): returns None when
         # tracing is off or the request lost the sampling coin flip —
         # every downstream span site is then one `is not None` branch.
-        req.trace = get_recorder().trace(f"req:{req.rid}")
+        # ``trace_id`` ADOPTS an upstream node's 64-bit id (a resume or
+        # hedge re-route carries it in the /generate body, PR 9 cross-
+        # node stitching), so this node's spans land in the originating
+        # request's timeline instead of under a fresh id.
+        req.trace = get_recorder().trace(
+            f"req:{req.rid}", trace_id=trace_id, node=self.name
+        )
         return req
 
     def enqueue(self, req: Request) -> Request:
@@ -902,7 +932,9 @@ class Engine:
                 # paged path: it is the pipeline-scheduled one (the
                 # dense/sp paths would all-gather stage weights).
                 traced = [m[0].trace for m in sub if m[0].trace is not None]
-                t_wave = time.monotonic() if traced else 0.0
+                acct = self.step_acct
+                t_wave = time.monotonic() if traced or acct is not None else 0.0
+                self._wave_padded = 0
                 if (
                     self.pool.quant is None
                     and not self._pp
@@ -920,12 +952,23 @@ class Engine:
                 else:
                     pending = self._prefill_group(sub)
                 self._finalize_first_tokens(pending)
-                if traced:
+                if traced or acct is not None:
+                    dur = time.monotonic() - t_wave
+                    new_tok = sum(len(m[0].prompt) - m[2] for m in sub)
+                    if acct is not None:
+                        # Step attribution (obs/step_plane.py): the wave's
+                        # real vs launched-shape tokens — each prefill
+                        # path stamped its padded count (_wave_padded).
+                        acct.note_wave(
+                            "prefill",
+                            new_tok,
+                            self._wave_padded,
+                            dur,
+                            rows=len(sub),
+                        )
                     # One prefill-wave span per traced member (covers the
                     # whole sub-wave through first-token finalize, so each
                     # request's lane shows the convoy it rode in).
-                    dur = time.monotonic() - t_wave
-                    new_tok = sum(len(m[0].prompt) - m[2] for m in sub)
                     for tr in traced:
                         tr.add(
                             "prefill_wave",
@@ -1242,6 +1285,7 @@ class Engine:
         prompt = req.prompt
         n_new = len(prompt) - reuse
         s_b = _pow2_at_least(n_new)
+        self._wave_padded = s_b  # launch shape (step attribution)
         p_b = _pow2_at_least(reuse, floor=self.page_size) if reuse else 0
         tokens = np.zeros((1, s_b), dtype=np.int32)
         tokens[0, :n_new] = prompt[reuse:]
@@ -1349,6 +1393,7 @@ class Engine:
         sp = self.device_mesh.shape["sp"]
         s_b = _pow2_at_least(n, floor=max(16, sp))
         s_b = -(-s_b // sp) * sp  # shard_map needs S divisible by sp
+        self._wave_padded = s_b  # launch shape (step attribution)
         tokens = np.zeros((1, s_b), dtype=np.int32)
         tokens[0, :n] = prompt
         positions = np.arange(s_b, dtype=np.int32)[None]
@@ -1402,6 +1447,7 @@ class Engine:
 
         final_logits: list = [None] * N
         n_chunks = -(-(n_new_max) // C)
+        self._wave_padded = B * C * n_chunks  # launch shape (step attribution)
         for ci in range(n_chunks):
             toks = np.zeros((B, C), dtype=np.int32)
             sl = np.full((B, C), self._scratch_slot, dtype=np.int32)
@@ -1501,8 +1547,14 @@ class Engine:
             # local tree truncates inserts to page multiples, so residue
             # slots [aligned, key_len) are freed at release — advertising
             # them would map tokens to recycled slots ring-wide, and the
-            # router would promise hits the node cannot serve.
-            self.mesh.insert(key[:aligned], req.token_slots[:aligned])
+            # router would promise hits the node cannot serve. A traced
+            # request's trace id rides the frames (old-wire-tolerant
+            # trailer) so replicas stitch their apply/lag spans under it.
+            self.mesh.insert(
+                key[:aligned],
+                req.token_slots[:aligned],
+                trace_id=tr.trace_id if tr is not None else 0,
+            )
         if tr is not None:
             tr.add(
                 "publish",
@@ -1686,6 +1738,11 @@ class Engine:
         # seen by every active request.
         elapsed = time.monotonic() - step_t0
         self._note_decode_time(elapsed)
+        if self.step_acct is not None:
+            self.step_acct.note_wave(
+                "decode", len(active), self.max_batch, elapsed,
+                rows=len(active),
+            )
         for _, req in active:
             tr = req.trace
             if tr is not None:
@@ -1843,6 +1900,11 @@ class Engine:
         elapsed = time.monotonic() - step_t0
         for _ in range(k):
             self._note_decode_time(elapsed / k)
+        if self.step_acct is not None:
+            self.step_acct.note_wave(
+                "decode", k * len(active), k * self.max_batch, elapsed,
+                rows=len(active),
+            )
         for _, req in active:
             tr = req.trace
             if tr is not None:
@@ -2078,6 +2140,12 @@ class Engine:
         elapsed = time.monotonic() - step_t0
         for _ in range(max(emitted_total, 1)):
             self._note_decode_time(elapsed / max(emitted_total, 1))
+        if self.step_acct is not None:
+            # The verify launch processes B·C positions; the USEFUL
+            # output is the accepted+bonus tokens actually emitted.
+            self.step_acct.note_wave(
+                "decode", emitted_total, B * C, elapsed, rows=len(active),
+            )
         for row, req in active:
             tr = req.trace
             if tr is not None:

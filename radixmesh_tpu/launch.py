@@ -33,19 +33,22 @@ def _apply_platform_env() -> None:
     pin_platform()
 
 
-def _configure_tracing(args: argparse.Namespace) -> None:
+def _configure_tracing(args: argparse.Namespace, node: str = "") -> None:
     """Enable the request-flight tracing plane (``obs/trace_plane.py``)
     when asked: ``--trace-sample`` gates recording; ``--trace-dir`` with
     the sample UNSET implies sample=1.0 (asking for a dump of nothing is
     never intended), but an EXPLICIT ``--trace-sample 0`` wins — the
-    operator said off, so off (None default distinguishes the two)."""
+    operator said off, so off (None default distinguishes the two).
+    ``node`` labels this process's spans so the cross-node stitcher
+    (``trace_plane.stitch_traces``) can give it its own Perfetto
+    process-track."""
     sample = args.trace_sample
     if sample is None:
         sample = 1.0 if args.trace_dir else 0.0
     if sample > 0:
         from radixmesh_tpu.obs.trace_plane import configure
 
-        configure(capacity=args.trace_capacity, sample=sample)
+        configure(capacity=args.trace_capacity, sample=sample, node=node)
 
 
 def _dump_trace(args: argparse.Namespace, log) -> None:
@@ -94,7 +97,7 @@ def _run_node(args: argparse.Namespace) -> int:
     role, rank, _ = cfg.local_identity()
     configure_logger(f"{role.value}@{rank}")
     log = get_logger("launch")
-    _configure_tracing(args)
+    _configure_tracing(args, node=f"{role.value}@{rank}")
     if cfg.replication_factor > 0:
         log.info(
             "prefix-ownership sharding ON (replication factor %d)",
@@ -239,6 +242,11 @@ def _run_node(args: argparse.Namespace) -> int:
                 if args.stream_publish is not None
                 else cfg.stream_publish_tokens
             ),
+            # TPU step attribution (obs/step_plane.py): per-wave MFU +
+            # pad-fraction accounting, opt-in via the model config (the
+            # node subcommand is config-file-driven).
+            step_accounting=bool(model.get("step_accounting", False)),
+            peak_tflops=model.get("peak_tflops"),
         )
         if engine.kv_transfer is not None:
             # Predictive restores: PREFETCH hints received off the wire
@@ -380,7 +388,7 @@ def _run_serve(args: argparse.Namespace) -> int:
 
     configure_logger("serve")
     log = get_logger("launch")
-    _configure_tracing(args)
+    _configure_tracing(args, node="serve")
     cfg = get_config(args.model)
     log.info("initializing %s (%d layers)...", args.model, cfg.n_layers)
     if args.weights:
@@ -410,6 +418,8 @@ def _run_serve(args: argparse.Namespace) -> int:
         kv_transfer_chunk_tokens=args.kv_transfer_chunk or 512,
         kv_transfer_min_restore_tokens=args.kv_transfer_min_restore or 0,
         stream_publish_tokens=args.stream_publish or 0,
+        step_accounting=args.step_accounting,
+        peak_tflops=args.peak_tflops,
     )
     slo_cfg = None
     if args.slo or args.slo_ttft_ms is not None or args.slo_tenant:
@@ -628,7 +638,20 @@ def main(argv: list[str] | None = None) -> int:
     )
     serve.add_argument(
         "--profile-dir", default=None,
-        help="enable POST /profile captures into this directory",
+        help="enable POST /profile + GET /debug/profile?seconds=N "
+        "captures into this directory",
+    )
+    serve.add_argument(
+        "--step-accounting", action="store_true",
+        help="TPU step attribution (obs/step_plane.py): per-wave token/"
+        "padding accounting + analytic-FLOPs MFU estimate, exported as "
+        "radixmesh_step_mfu / radixmesh_wave_pad_fraction and on "
+        "/debug/state",
+    )
+    serve.add_argument(
+        "--peak-tflops", type=float, default=None,
+        help="nominal accelerator peak for the MFU estimate (default: "
+        "detected from the jax device kind; 1.0 off-accelerator)",
     )
     serve.add_argument(
         "--kv-quant", choices=["int8"], default=None,

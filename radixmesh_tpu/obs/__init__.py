@@ -1,6 +1,8 @@
 """Observability: metrics registry + profiler tracing + the request-flight
 tracing plane (SURVEY §5) + the fleet telemetry plane (gossiped node
-digests, radix-tree convergence audit, health scoring)."""
+digests, radix-tree convergence audit, health scoring) + the mesh-wide
+plane (PR 9: cross-node trace stitching, per-shard heat/skew, TPU step
+attribution)."""
 
 from radixmesh_tpu.obs.fleet_plane import (
     FleetConfig,
@@ -16,13 +18,16 @@ from radixmesh_tpu.obs.metrics import (
     get_registry,
     set_registry,
 )
+from radixmesh_tpu.obs.step_plane import StepAccounting
 from radixmesh_tpu.obs.trace_plane import (
     FlightRecorder,
     Span,
     TraceContext,
     configure,
     get_recorder,
+    new_trace_id,
     set_recorder,
+    stitch_traces,
     write_trace,
 )
 from radixmesh_tpu.obs.tracing import annotate, profile, recorded, timed
@@ -45,6 +50,9 @@ __all__ = [
     "get_recorder",
     "set_recorder",
     "write_trace",
+    "new_trace_id",
+    "stitch_traces",
+    "StepAccounting",
     "annotate",
     "profile",
     "recorded",

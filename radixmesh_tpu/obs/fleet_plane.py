@@ -303,6 +303,18 @@ class FleetView:
         # (sid, lo rank, hi rank) → wall time the pair's fingerprints
         # for that shard were first seen unequal.
         self._shard_diverged_at: dict[tuple[int, int, int], float] = {}
+        # Per-rank per-shard decayed load (tokens/s) from the heat
+        # trailer on SHARD_SUMMARY gossip (cache/sharding.py::ShardHeat)
+        # — the cluster heat map + skew score the future rebalancer
+        # consumes (PR 9 observability).
+        self._shard_heat: dict[int, dict[int, float]] = {}
+        # Per-rank wall-clock skew estimate: min over recent folds of
+        # (local wall at fold - digest origin ts). The minimum tracks
+        # (skew + fastest observed transit), so it over-estimates skew
+        # by at most the best one-way gossip latency — good enough to
+        # align trace timelines (obs/trace_plane.py::stitch_traces);
+        # never used for correctness.
+        self._clock_skew: dict[int, float] = {}
         # Ranks that announced a PLANNED departure (LEAVE oplog): their
         # straggler digests are refused so a frozen fingerprint cannot
         # re-enter the convergence audit or pin min_score after the
@@ -340,6 +352,12 @@ class FleetView:
             self.folds += 1
             self._update_detectors(d, self._prev.get(d.rank))
             self._update_divergence(d, now)
+            if d.ts:
+                skew = now - d.ts
+                prev_skew = self._clock_skew.get(d.rank)
+                self._clock_skew[d.rank] = (
+                    skew if prev_skew is None else min(prev_skew, skew)
+                )
             return True
 
     def _update_detectors(self, d: NodeDigest, prev: NodeDigest | None) -> None:
@@ -379,7 +397,12 @@ class FleetView:
         simply folds fresh digests again."""
         keep = set(ranks)
         with self._lock:
-            for r in [r for r in self._digests if r not in keep]:
+            known = (
+                set(self._digests)
+                | set(self._shard_fps)
+                | set(self._shard_heat)
+            )
+            for r in [r for r in known if r not in keep]:
                 self._forget_locked(r)
 
     def forget(self, rank: int) -> None:
@@ -395,7 +418,8 @@ class FleetView:
 
     def _forget_locked(self, rank: int) -> None:
         for store in (self._digests, self._prev, self._stalled,
-                      self._storm_rate, self._shard_fps):
+                      self._storm_rate, self._shard_fps,
+                      self._shard_heat, self._clock_skew):
             store.pop(rank, None)
         for pair in [p for p in self._diverged_at if rank in p]:
             del self._diverged_at[pair]
@@ -476,6 +500,60 @@ class FleetView:
             "converged": not diverged,
             "reporters": reporters,
         }
+
+    def fold_shard_heat(self, rank: int, loads: dict[int, float]) -> None:
+        """Fold one rank's per-owned-shard decayed loads (whole-summary
+        swap, like :meth:`fold_shard_fps` — stale shard entries cannot
+        linger past an ownership change). Empty folds CLEAR the rank
+        (an owner reporting no traffic is cold, not unknown)."""
+        with self._lock:
+            if loads:
+                self._shard_heat[rank] = {
+                    int(s): max(0.0, float(v)) for s, v in loads.items()
+                }
+            else:
+                self._shard_heat.pop(rank, None)
+
+    def shard_heat(self) -> dict:
+        """The cluster heat map + skew score.
+
+        Per-shard fleet load = MAX over reporting owners (co-owners see
+        the same inserts, so max — not sum — avoids counting one
+        insert RF times; pull-through copies on non-owners never report,
+        by construction). ``skew_score`` = max/mean over reported
+        shards — the load-imbalance trigger the future shard rebalancer
+        gates on (ROADMAP item 1's named follow-up); 1.0 = perfectly
+        flat, >> 1 = one shard soaking the fleet."""
+        with self._lock:
+            by_rank = {r: dict(h) for r, h in self._shard_heat.items()}
+        shards: dict[int, float] = {}
+        for h in by_rank.values():
+            for sid, load in h.items():
+                shards[sid] = max(shards.get(sid, 0.0), load)
+        skew = 0.0
+        hot_shard = None
+        if shards:
+            mean = sum(shards.values()) / len(shards)
+            hot_shard = max(shards, key=shards.get)
+            skew = (shards[hot_shard] / mean) if mean > 0 else 0.0
+        return {
+            "shards": {str(s): round(v, 4) for s, v in sorted(shards.items())},
+            "by_rank": {
+                str(r): {str(s): round(v, 4) for s, v in sorted(h.items())}
+                for r, h in sorted(by_rank.items())
+            },
+            "skew_score": round(skew, 4),
+            "hot_shard": hot_shard,
+            "reporters": len(by_rank),
+        }
+
+    def clock_offsets(self) -> dict[int, float]:
+        """rank → estimated wall-clock skew seconds (min-tracked digest
+        transit; see the ``_clock_skew`` comment). The stitcher's
+        per-node correction input — telemetry-grade, never used for
+        correctness."""
+        with self._lock:
+            return dict(self._clock_skew)
 
     def lifecycle_of(self, rank: int) -> str:
         """One rank's gossiped membership-lifecycle state ("active" for
@@ -600,10 +678,13 @@ class FleetView:
         }
         with self._lock:
             sharded = bool(self._shard_fps)
+            heated = bool(self._shard_heat)
         if sharded:
             # Under sharding the scalar audit reads diverged by design;
             # the owner-scoped one is the meaningful signal.
             out["shard_convergence"] = self.shard_convergence()
+        if heated:
+            out["shard_heat"] = self.shard_heat()
         return out
 
 

@@ -33,11 +33,25 @@ This module supplies the per-request causal timeline:
   ever got past the backoff limits, would be visible interleaved with
   the request timelines it competes with.
 
-Ring replication lag carries NO trace id across the wire (no wire-format
-change): lag spans are derived receiver-side from the oplog's existing
-origin wall-clock timestamp and recorded on per-node lanes; correlation
-with a request is by time overlap, which is what a timeline viewer shows
-anyway.
+Cross-node stitching (PR 9): trace ids are 64-bit and globally unique
+(splitmix64 over a process-scoped counter mixed with the pid), so the id
+itself can cross the wire. Every inter-node hop now carries it — the
+``/generate`` body (resume/hedge re-routes), the disagg handoff packet
+header, and data-kind oplog frames (an optional, old-wire-tolerant
+trailer — ``cache/oplog.py``) — and receivers open their spans under the
+ORIGINATING id instead of minting a new one. Each span additionally
+carries the ``node`` label of the process/role that recorded it, and
+:meth:`FlightRecorder.merge` folds many nodes' span exports
+(``export_spans`` / ``GET /debug/trace?format=spans``) into ONE Perfetto
+document with one process-track per node, correcting clock offsets from
+each export's wall-vs-monotonic base (plus optional per-node skew
+estimates from the fleet plane's digest timestamps) — a resurrected
+request's router → prefill → handoff → decode → resurrection journey
+reads as a single flame view. Ring replication-lag spans are still
+derived receiver-side from the oplog's origin wall-clock timestamp, but
+when the frame carries a trace id the lag span lands UNDER it — the
+replication edge is part of the request's timeline, not just time
+overlap.
 
 Overhead model: sampling off (the default) short-circuits at the first
 ``if`` in :meth:`FlightRecorder.trace` — no allocation, no lock, no
@@ -55,6 +69,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import random
 import threading
 import time
@@ -69,7 +84,32 @@ __all__ = [
     "set_recorder",
     "configure",
     "write_trace",
+    "new_trace_id",
+    "stitch_traces",
 ]
+
+_M64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer (the tree-fingerprint mixing family)."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+_id_counter = itertools.count(1)
+
+
+def new_trace_id() -> int:
+    """A fresh 64-bit trace id, unique within the process (global
+    counter) and collision-resistant across processes (pid mixed in) —
+    the id that crosses the wire so receivers stitch their spans under
+    the originating request instead of minting node-local ids that
+    collide at merge time. Never 0 (0 = "no trace" on every wire)."""
+    tid = _mix64(((os.getpid() & 0xFFFFF) << 40) ^ next(_id_counter))
+    return tid or 1
 
 
 @dataclass
@@ -83,6 +123,11 @@ class Span:
     trace_id: int  # 0 = not tied to a request trace (node-scope events)
     cat: str = "serving"
     args: dict | None = None
+    # Node that recorded the span ("" = the recorder's default). The
+    # stitched export groups spans into one Perfetto process-track per
+    # node — in-process multi-node harnesses share ONE recorder, so the
+    # node must ride the span, not the recorder.
+    node: str = ""
 
 
 class TraceContext:
@@ -93,11 +138,18 @@ class TraceContext:
     recorder so swap-for-isolation (tests) keeps working.
     """
 
-    __slots__ = ("trace_id", "lane", "_rec")
+    __slots__ = ("trace_id", "lane", "node", "_rec")
 
-    def __init__(self, trace_id: int, lane: str, rec: "FlightRecorder"):
+    def __init__(
+        self,
+        trace_id: int,
+        lane: str,
+        rec: "FlightRecorder",
+        node: str = "",
+    ):
         self.trace_id = trace_id
         self.lane = lane
+        self.node = node
         self._rec = rec
 
     def add(
@@ -113,7 +165,7 @@ class TraceContext:
         submit/admit/first-token — so no extra clock reads)."""
         self._rec._record(
             Span(name, self.lane, t0, max(0.0, dur), self.trace_id, cat,
-                 args or None)
+                 args or None, self.node)
         )
 
     def span(self, name: str, cat: str = "serving", **args) -> "_SpanTimer":
@@ -156,14 +208,22 @@ class FlightRecorder:
     drops.
     """
 
-    def __init__(self, capacity: int = 8192, sample: float = 0.0):
+    def __init__(
+        self, capacity: int = 8192, sample: float = 0.0, node: str = ""
+    ):
         if capacity <= 0:
             raise ValueError("trace capacity must be positive")
         self.capacity = int(capacity)
         self.sample = float(sample)
+        # Default node label for contexts/events that don't name one
+        # (single-node processes set it once via configure(node=...)).
+        self.node = node
+        # This process's monotonic→wall conversion, captured once: the
+        # stitcher shifts every export into a shared wall-clock base
+        # with it (per-node clock skew is corrected separately).
+        self.wall_offset = time.time() - time.monotonic()
         self._lock = threading.Lock()
         self._buf: deque[Span] = deque(maxlen=self.capacity)
-        self._ids = itertools.count(1)
         self._rng = random.Random(0xF117)  # deterministic sampling sequence
         self.recorded = 0  # spans accepted (lifetime)
         self.dropped = 0  # spans evicted by the ring bound (lifetime)
@@ -174,22 +234,38 @@ class FlightRecorder:
     def enabled(self) -> bool:
         return self.sample > 0.0
 
-    def trace(self, lane: str, force: bool = False) -> TraceContext | None:
+    def trace(
+        self,
+        lane: str,
+        force: bool = False,
+        trace_id: int | None = None,
+        node: str | None = None,
+    ) -> TraceContext | None:
         """New per-request trace context, or None when tracing is off /
         this request lost the sampling coin flip. THE no-op guard: the
         disabled path is one float compare + return. ``force`` skips the
         coin flip (NOT the off switch) — used when an upstream node
         already decided this request is traced (disagg handoff), so a
-        fractional sample yields whole cross-node timelines, not halves."""
+        fractional sample yields whole cross-node timelines, not halves.
+        ``trace_id`` ADOPTS an upstream node's 64-bit id (implies
+        ``force`` — the id's existence IS the upstream decision), so the
+        receiver's spans stitch under the originating request; None
+        mints a fresh globally-unique id (``new_trace_id``)."""
         if self.sample <= 0.0:
             return None
         if (
             not force
+            and trace_id is None
             and self.sample < 1.0
             and self._rng.random() >= self.sample
         ):
             return None
-        return TraceContext(next(self._ids), lane, self)
+        return TraceContext(
+            new_trace_id() if not trace_id else int(trace_id) & _M64,
+            lane,
+            self,
+            self.node if node is None else node,
+        )
 
     def event(
         self,
@@ -198,15 +274,30 @@ class FlightRecorder:
         t0: float,
         dur: float,
         cat: str = "serving",
+        trace_id: int = 0,
+        node: str | None = None,
         **args,
     ) -> None:
-        """Node-scope span not tied to a request trace (ring replication
-        lag, eviction sweeps, route decisions). Same one-branch guard."""
+        """Node-scope span (ring replication lag, eviction sweeps, route
+        decisions). Same one-branch guard. A nonzero ``trace_id`` ties
+        the span to an (upstream-originated) request trace AND skips the
+        sampling coin flip — the sender already decided this request is
+        traced, and a receiver flipping its own coin would shear
+        cross-node timelines apart at fractional sampling rates."""
         if self.sample <= 0.0:
             return
-        if self.sample < 1.0 and self._rng.random() >= self.sample:
+        if (
+            not trace_id
+            and self.sample < 1.0
+            and self._rng.random() >= self.sample
+        ):
             return
-        self._record(Span(name, lane, t0, max(0.0, dur), 0, cat, args or None))
+        self._record(
+            Span(
+                name, lane, t0, max(0.0, dur), int(trace_id) & _M64, cat,
+                args or None, self.node if node is None else node,
+            )
+        )
 
     # -- storage -------------------------------------------------------
 
@@ -261,7 +352,11 @@ class FlightRecorder:
             }
             args = dict(s.args or {})
             if s.trace_id:
-                args["trace_id"] = s.trace_id
+                # Hex string: 64-bit ids exceed the 2^53 integer range a
+                # JS-based viewer (Perfetto) reads losslessly.
+                args["trace_id"] = f"{s.trace_id:#018x}"
+            if s.node:
+                args["node"] = s.node
             if args:
                 ev["args"] = args
             events.append(ev)
@@ -285,6 +380,113 @@ class FlightRecorder:
                     "recorded": self.recorded,
                     "dropped": self.dropped,
                 },
+            },
+        }
+
+    def export_spans(self, drain: bool = False) -> dict:
+        """Raw-span export for the cross-node stitcher: the recorder's
+        spans as plain dicts plus this process's node label and
+        monotonic→wall offset (``GET /debug/trace?format=spans`` serves
+        exactly this body; a collector pulls one per node and hands the
+        set to :meth:`merge`)."""
+        spans = self.drain() if drain else self.snapshot()
+        return {
+            "node": self.node,
+            "wall_offset": self.wall_offset,
+            "spans": [
+                {
+                    "name": s.name,
+                    "lane": s.lane,
+                    "t0": s.t0,
+                    "dur": s.dur,
+                    "trace_id": f"{s.trace_id:#018x}" if s.trace_id else "",
+                    "cat": s.cat,
+                    "args": s.args or {},
+                    "node": s.node or self.node,
+                }
+                for s in spans
+            ],
+        }
+
+    @staticmethod
+    def merge(
+        exports: list[dict], clock_offsets: dict[str, float] | None = None
+    ) -> dict:
+        """Stitch many nodes' span exports into ONE Perfetto document:
+        one process-track (pid) per node, one thread per (node, lane),
+        every timestamp shifted into a shared wall-clock base.
+
+        Per-export correction: ``t_wall = t0 + wall_offset`` (the
+        export's own monotonic→wall conversion). Per-NODE correction:
+        ``clock_offsets[node]`` seconds are subtracted — the caller's
+        estimate of that node's wall-clock skew vs the collector, e.g.
+        ``FleetView.clock_offsets()`` derived from the digest timestamps
+        every node already gossips. Skew bends telemetry, never
+        correctness — exactly the oplog-lag contract.
+
+        In-process multi-node harnesses produce ONE export whose spans
+        carry distinct ``node`` labels; the grouping below handles both
+        shapes identically."""
+        offsets = clock_offsets or {}
+        rows: list[tuple[str, str, float, dict]] = []
+        for ex in exports:
+            base_node = ex.get("node") or "node"
+            wall = float(ex.get("wall_offset", 0.0))
+            for s in ex.get("spans", ()):
+                node = s.get("node") or base_node
+                t_wall = (
+                    float(s["t0"]) + wall - float(offsets.get(node, 0.0))
+                )
+                rows.append((node, s.get("lane", "lane"), t_wall, s))
+        base = min((t for _, _, t, _ in rows), default=0.0)
+        pids: dict[str, int] = {}
+        tids: dict[tuple[str, str], int] = {}
+        events: list[dict] = []
+        for node, lane, t_wall, s in sorted(
+            rows, key=lambda r: (r[0], r[1], r[2])
+        ):
+            pid = pids.setdefault(node, len(pids) + 1)
+            tid = tids.setdefault((node, lane), len(tids) + 1)
+            ev = {
+                "name": s.get("name", "span"),
+                "cat": s.get("cat", "serving"),
+                "ph": "X",
+                "ts": round((t_wall - base) * 1e6, 3),
+                "dur": round(float(s.get("dur", 0.0)) * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+            }
+            args = dict(s.get("args") or {})
+            if s.get("trace_id"):
+                args["trace_id"] = s["trace_id"]
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": node},
+            }
+            for node, pid in pids.items()
+        ] + [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pids[node],
+                "tid": tid,
+                "args": {"name": lane},
+            }
+            for (node, lane), tid in tids.items()
+        ]
+        return {
+            "displayTimeUnit": "ms",
+            "traceEvents": meta + events,
+            "otherData": {
+                "stitched": True,
+                "nodes": sorted(pids),
+                "clock_offsets": {k: round(v, 6) for k, v in offsets.items()},
             },
         }
 
@@ -319,10 +521,23 @@ def set_recorder(rec: FlightRecorder) -> FlightRecorder:
     return rec
 
 
-def configure(capacity: int = 8192, sample: float = 1.0) -> FlightRecorder:
+def configure(
+    capacity: int = 8192, sample: float = 1.0, node: str = ""
+) -> FlightRecorder:
     """Enable tracing process-wide: install a fresh recorder with the
-    given bound + sampling rate (``launch.py --trace-capacity/-sample``)."""
-    return set_recorder(FlightRecorder(capacity=capacity, sample=sample))
+    given bound + sampling rate (``launch.py --trace-capacity/-sample``).
+    ``node`` labels this process's spans for the cross-node stitcher."""
+    return set_recorder(
+        FlightRecorder(capacity=capacity, sample=sample, node=node)
+    )
+
+
+def stitch_traces(
+    exports: list[dict], clock_offsets: dict[str, float] | None = None
+) -> dict:
+    """Module-level alias of :meth:`FlightRecorder.merge` (collectors
+    import the function without touching a recorder instance)."""
+    return FlightRecorder.merge(exports, clock_offsets)
 
 
 def write_trace(path: str, drain: bool = True) -> int:

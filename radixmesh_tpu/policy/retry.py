@@ -186,6 +186,11 @@ class RecoveryRecord:
     )
     delivered: list[int] = field(default_factory=list)
     addr: str | None = None  # node currently serving this request
+    # Cross-node trace stitching (PR 9, obs/trace_plane.py): the 64-bit
+    # trace id every hop of this request — including resume/hedge
+    # re-routes — carries, so the whole multi-node journey lands under
+    # ONE id in the stitched Perfetto view. 0 = tracing off.
+    trace_id: int = 0
     # -- recovery telemetry (the chaos gates read these) --
     retries: int = 0
     resurrections: int = 0

@@ -98,6 +98,7 @@ class EngineRunner:
         prompt: Sequence[int],
         sampling: SamplingParams | None = None,
         resume_tokens: Sequence[int] | None = None,
+        trace_id: int | None = None,
     ) -> Request:
         with self._lock:
             if self._closed:
@@ -109,7 +110,8 @@ class EngineRunner:
                     "node is draining — retry via the router"
                 )
             req = self.engine.add_request(
-                prompt, sampling, resume_tokens=resume_tokens
+                prompt, sampling, resume_tokens=resume_tokens,
+                trace_id=trace_id,
             )
         self._wake.set()
         return req
@@ -323,6 +325,11 @@ def _cluster_telemetry(mesh) -> dict:
     if mesh is None:
         return {"nodes": {}, "note": "no cache mesh attached to this node"}
     snap = mesh.fleet.snapshot()
+    if "shard_heat" in snap:
+        # Replace the bare fleet heat map with the ownership-enriched
+        # report: the hot shard's OWNER SET is the piece only a node
+        # holding the ownership map can add (PR 9 heat telemetry).
+        snap["shard_heat"] = mesh.shard_heat_report()
     snap["self"] = _membership_state(mesh)
     return snap
 
@@ -354,13 +361,20 @@ def _debug_trace_response(handler: BaseHTTPRequestHandler) -> None:
     """Serve the flight recorder as Chrome trace-event JSON. Read-only by
     default — a GET must not destroy the post-mortem a later reader (or
     the --trace-dir exit dump) depends on; ``?drain=1`` opts into
-    consuming the buffer (e.g. a collector that polls and archives)."""
+    consuming the buffer (e.g. a collector that polls and archives).
+    ``?format=spans`` serves the RAW span export (node label, wall
+    offset, span dicts) instead — the per-node body the cross-node
+    stitcher (``trace_plane.stitch_traces``) collects from every peer
+    to emit one merged Perfetto file."""
     from urllib.parse import parse_qs, urlsplit
 
     query = parse_qs(urlsplit(handler.path).query)
     # Opt-in must be deliberate: only recognized truthy spellings drain —
     # anything else (?drain=False, typos) stays read-only.
     drain = query.get("drain", ["0"])[-1].lower() in ("1", "true", "yes")
+    if query.get("format", [""])[-1].lower() == "spans":
+        _json_response(handler, 200, get_recorder().export_spans(drain=drain))
+        return
     _json_response(handler, 200, get_recorder().chrome_trace(drain=drain))
 
 
@@ -491,6 +505,11 @@ class ServingFrontend:
                         getattr(eng, "_restoring", ())
                     ),
                 }
+            acct = getattr(eng, "step_acct", None)
+            if acct is not None:
+                # TPU step attribution (obs/step_plane.py): per-wave MFU
+                # estimate + pad fraction aggregates.
+                state["step_accounting"] = acct.report()
             if eng.mesh is not None:
                 state["membership"] = _membership_state(eng.mesh)
             if self.slo_enabled:
@@ -502,6 +521,40 @@ class ServingFrontend:
 
         self._debug_requests = _debug_requests
         self._debug_state = _debug_state
+
+        def _run_profile(seconds: float) -> tuple[int, dict]:
+            """One ``jax.profiler`` capture window into a fresh numbered
+            subdirectory of the operator-configured base dir. Shared by
+            POST /profile and GET /debug/profile?seconds=N (the step-
+            attribution quickstart's one-liner) so the path policy —
+            clients never choose filesystem paths — cannot drift."""
+            if frontend.profile_dir is None:
+                return 403, {"error": "profiling disabled (no --profile-dir)"}
+            if not (0.0 < seconds <= 60.0):
+                return 400, {"error": "seconds must be in (0, 60]"}
+            if not frontend._profile_lock.acquire(blocking=False):
+                return 409, {"error": "profile already running"}
+            try:
+                from radixmesh_tpu.obs.tracing import profile as _profile
+
+                # _profile_lock is held: the seq needs no lock of its
+                # own. The timestamp keeps directories unique across
+                # server restarts into the same base dir.
+                frontend._profile_seq += 1
+                logdir = os.path.join(
+                    frontend.profile_dir,
+                    f"capture-{int(time.time())}-"
+                    f"{frontend._profile_seq:04d}",
+                )
+                with _profile(logdir):
+                    time.sleep(seconds)
+            except Exception as e:  # noqa: BLE001 — report, don't kill the handler
+                return 500, {"error": str(e)}
+            finally:
+                frontend._profile_lock.release()
+            return 200, {"profiled_s": seconds, "logdir": logdir}
+
+        self._run_profile = _run_profile
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route through our logger
@@ -542,6 +595,24 @@ class ServingFrontend:
                 elif self.path.split("?", 1)[0] == "/debug/trace":
                     # Load the body in Perfetto (ui.perfetto.dev).
                     _debug_trace_response(self)
+                elif self.path.split("?", 1)[0] == "/debug/profile":
+                    # TPU step attribution leg (c): a bounded
+                    # jax.profiler capture window as a one-line GET —
+                    # ?seconds=N, default 3 (POST /profile is the
+                    # original body-carrying form; both share
+                    # _run_profile).
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        seconds = float(q.get("seconds", ["3.0"])[-1])
+                    except ValueError:
+                        _json_response(
+                            self, 400, {"error": "seconds must be a number"}
+                        )
+                        return
+                    code, obj = frontend._run_profile(seconds)
+                    _json_response(self, code, obj)
                 elif self.path == "/debug/requests":
                     _json_response(self, 200, frontend._debug_requests())
                 elif self.path == "/debug/state":
@@ -601,46 +672,17 @@ class ServingFrontend:
                     # server-configured logdir (obs/tracing.py::profile —
                     # exception-safe stop; SURVEY §5: the reference has no
                     # tracing at all). Clients never supply paths; each
-                    # capture lands in a fresh numbered subdirectory.
-                    if frontend.profile_dir is None:
-                        _json_response(
-                            self, 403,
-                            {"error": "profiling disabled (no --profile-dir)"},
-                        )
-                        return
+                    # capture lands in a fresh numbered subdirectory
+                    # (shared _run_profile — GET /debug/profile is the
+                    # query-param form of the same capture).
                     try:
                         body = _read_json(self)
                         seconds = float(body.get("seconds", 3.0))
-                        if not (0.0 < seconds <= 60.0):
-                            raise ValueError("seconds must be in (0, 60]")
                     except (TypeError, ValueError, json.JSONDecodeError) as e:
                         _json_response(self, 400, {"error": str(e)})
                         return
-                    if not frontend._profile_lock.acquire(blocking=False):
-                        _json_response(self, 409, {"error": "profile already running"})
-                        return
-                    try:
-                        from radixmesh_tpu.obs.tracing import profile as _profile
-
-                        # _profile_lock is held: the seq needs no lock of
-                        # its own. The timestamp keeps directories unique
-                        # across server restarts into the same base dir.
-                        frontend._profile_seq += 1
-                        logdir = os.path.join(
-                            frontend.profile_dir,
-                            f"capture-{int(time.time())}-"
-                            f"{frontend._profile_seq:04d}",
-                        )
-                        with _profile(logdir):
-                            time.sleep(seconds)
-                    except Exception as e:  # noqa: BLE001 — report, don't kill the handler
-                        _json_response(self, 500, {"error": str(e)})
-                        return
-                    finally:
-                        frontend._profile_lock.release()
-                    _json_response(
-                        self, 200, {"profiled_s": seconds, "logdir": logdir}
-                    )
+                    code, obj = frontend._run_profile(seconds)
+                    _json_response(self, code, obj)
                     return
                 if self.path == "/cancel":
                     try:
@@ -691,6 +733,17 @@ class ServingFrontend:
                         raise ValueError(
                             "resume_tokens must be a list of ints"
                         )
+                    # Cross-node trace stitching (PR 9): a resume/hedge
+                    # re-route carries the originating request's 64-bit
+                    # trace id (int or hex string) so THIS node's spans
+                    # land in the same stitched timeline.
+                    trace_id = body.get("trace_id")
+                    if trace_id is not None:
+                        trace_id = int(str(trace_id), 0)
+                        if not 0 < trace_id < (1 << 64):
+                            raise ValueError(
+                                "trace_id must be a nonzero 64-bit int"
+                            )
                     slo_kw = {}
                     if frontend.slo_enabled:
                         # SLO fields (ignored without a control plane —
@@ -710,7 +763,8 @@ class ServingFrontend:
                     return
                 try:
                     req = frontend.runner.submit(
-                        ids, sampling, resume_tokens=resume_tokens, **slo_kw
+                        ids, sampling, resume_tokens=resume_tokens,
+                        trace_id=trace_id, **slo_kw
                     )
                 except RequestShed as e:  # overload control plane refusal
                     # A drain shed points the client at the router: the

@@ -55,7 +55,7 @@ from typing import Callable, Sequence
 import numpy as np
 
 from radixmesh_tpu.obs.metrics import RECOVERY_SECONDS_BUCKETS, get_registry
-from radixmesh_tpu.obs.trace_plane import get_recorder
+from radixmesh_tpu.obs.trace_plane import get_recorder, new_trace_id
 from radixmesh_tpu.policy.retry import (
     DeadlineBudget,
     RecoveryRecord,
@@ -156,9 +156,18 @@ class RecoveryCoordinator:
         deadline_s: float | None = None,
         seed: int | None = None,
         rid: int | None = None,
+        trace_id: int | None = None,
     ) -> RecoveryRecord:
         """Open a recovery record: THE admission instant — the deadline
-        budget starts here and is threaded through every later hop."""
+        budget starts here and is threaded through every later hop.
+
+        The record also owns the request's 64-bit trace id (cross-node
+        stitching, PR 9): minted here when tracing is on (or adopted
+        from ``trace_id``), and carried by every hop — serve_fn threads
+        it into ``/generate``/``mesh.insert`` so a resurrected request's
+        whole multi-node journey stitches under one id."""
+        if trace_id is None and get_recorder().enabled:
+            trace_id = new_trace_id()
         with self._lock:
             if rid is None:
                 self._rid_seq += 1
@@ -169,6 +178,7 @@ class RecoveryCoordinator:
                 sampling=sampling,
                 seed=seed,
                 budget=DeadlineBudget(deadline_s, clock=self._clock),
+                trace_id=trace_id or 0,
             )
             self.records[rid] = rec
             return rec
@@ -384,7 +394,8 @@ class RecoveryCoordinator:
             if rec.enabled:
                 rec.event(
                     self._trace_lane, "resurrect", self._clock(), 0.0,
-                    cat="recovery", rid=record.rid, cause=cause,
+                    cat="recovery", trace_id=record.trace_id,
+                    node=self.name, rid=record.rid, cause=cause,
                     delivered=len(record.delivered),
                     budget_left_s=round(
                         min(record.budget.remaining(), 1e9), 4
@@ -492,7 +503,8 @@ class RecoveryCoordinator:
                 if rec.enabled:
                     rec.event(
                         self._trace_lane, "hedge", now, 0.0,
-                        cat="recovery", rid=record.rid,
+                        cat="recovery", trace_id=record.trace_id,
+                        node=self.name, rid=record.rid,
                         primary=primary[0], secondary=secondary[0],
                     )
                 threads["secondary"] = threading.Thread(

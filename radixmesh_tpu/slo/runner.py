@@ -88,6 +88,7 @@ class SLORunner(EngineRunner):
         ttft_deadline_s: float | None = None,
         e2e_deadline_s: float | None = None,
         resume_tokens: Sequence[int] | None = None,
+        trace_id: int | None = None,
     ) -> Request:
         # Arrival is STAMPED BEFORE the lock: engine.step() runs under
         # self._lock, so a submit landing mid-step (or mid-jit-compile)
@@ -119,6 +120,7 @@ class SLORunner(EngineRunner):
                 ttft_deadline_s=ttft_deadline_s,
                 e2e_deadline_s=e2e_deadline_s,
                 resume_tokens=resume_tokens,
+                trace_id=trace_id,
             )
             req.submit_time = t_arrival
             decision = self.ctl.offer(

@@ -30,6 +30,7 @@ __all__ = [
     "run_engine_workload",
     "run_fleet_churn_workload",
     "run_kvflow_workload",
+    "run_obs_workload",
     "run_overload_workload",
     "synth_text",
 ]
@@ -1683,6 +1684,506 @@ def run_chaos_workload(
         for n in nodes:
             n.close()
         InprocHub.reset_default()
+
+
+def _obs_zipf_heat_phase(
+    *,
+    ring,
+    router_mesh,
+    by_rank,
+    rng,
+    wait_for,
+    zipf_keys: int,
+    zipf_inserts: int,
+    zipf_alpha: float,
+    key_len: int,
+) -> dict:
+    """OBS leg (b): per-shard heat & skew under a zipf-keyed insert mix.
+
+    ``zipf_keys`` distinct subtree roots receive deterministic insert
+    counts ∝ rank^-alpha (counts, not samples — the ground-truth shard
+    load is then computable exactly). Each key's traffic lands at its
+    shard's PRIMARY owner (what the router would do) and replicates to
+    the co-owners; every node then publishes one SHARD_SUMMARY whose
+    heat trailer gossips the decayed loads, and the ROUTER — which holds
+    no tree and saw none of the inserts — must detect the hot shard,
+    score the skew, and name the hot shard's owner set correctly."""
+    import time as _time
+
+    from radixmesh_tpu.cache.sharding import shard_of_tokens
+
+    # Deterministic zipf counts per key (rank-frequency, heaviest first).
+    weights = np.arange(1, zipf_keys + 1, dtype=np.float64) ** (-zipf_alpha)
+    counts = np.maximum(
+        1, np.floor(zipf_inserts * weights / weights.sum()).astype(int)
+    )
+    keys = [
+        np.concatenate(
+            [
+                np.asarray([7001 + k], dtype=np.int32),
+                rng.integers(1, 600, size=key_len - 1).astype(np.int32),
+            ]
+        )
+        for k in range(zipf_keys)
+    ]
+    any_node = ring[0]
+    page = max(1, any_node.page)
+    ownership = any_node.ownership
+    # Ground truth: tokens generated per shard (insert tokens + the hit
+    # walks below) — the shard the workload actually made hottest, which
+    # is the hot KEY's shard unless blake2b collided several mid-weight
+    # keys into one (the truth is then that shard; the detector must
+    # find IT, not our guess).
+    truth: dict[int, int] = {}
+    t0 = _time.monotonic()
+    total = 0
+    for k, key in enumerate(keys):
+        sid = shard_of_tokens(key[:page])
+        primary = ownership.primary(sid)
+        node = by_rank[primary]
+        slots = np.arange(len(key), dtype=np.int32)
+        n = int(counts[k])
+        for _ in range(n):
+            node.insert(key, slots)
+            # Every other insert also exercises the hit-heat path (a
+            # served prefix is load too — a read-hot shard must read hot).
+            node.match_prefix(key)
+        truth[sid] = truth.get(sid, 0) + n * len(key) * 2
+        total += n
+    expected_sid = max(truth, key=truth.get)
+    expected_owners = sorted(ownership.owners_of(expected_sid))
+    for n in ring:
+        n.broadcast_shard_summary()
+    # The router folds heat from the gossiped summaries (master fan-out).
+    wait_for(
+        lambda: router_mesh.fleet.shard_heat()["reporters"] >= len(ring) - 1
+    )
+    report = router_mesh.shard_heat_report()
+    detected = report.get("hot_shard")
+    return {
+        "performed": True,
+        "inserts": int(total),
+        "distinct_keys": int(zipf_keys),
+        "zipf_alpha": float(zipf_alpha),
+        "skew_score": report["skew_score"],
+        "hot_shard": detected,
+        "expected_hot_shard": int(expected_sid),
+        "hot_owners": sorted(report.get("hot_owners", [])),
+        "expected_hot_owners": expected_owners,
+        "owner_set_correct": bool(
+            detected == expected_sid
+            and sorted(report.get("hot_owners", [])) == expected_owners
+        ),
+        "reporters": int(report["reporters"]),
+        "reported_shards": len(report["shards"]),
+        "heat_s": round(_time.monotonic() - t0, 3),
+    }
+
+
+def _obs_stitch_phase(
+    *,
+    by_addr,
+    cr,
+    plan,
+    decode,
+    rng,
+    seed,
+    streams: int,
+    tokens_per_stream: int,
+    deadline_s: float,
+    on_kill=lambda addr: None,
+) -> tuple[dict, list]:
+    """OBS leg (a): crash + resurrection under full tracing — the
+    chaos-style run whose spans must stitch into ONE multi-node
+    timeline. Live streams decode with every emitted token published to
+    the mesh UNDER THE STREAM'S TRACE ID (the oplog trace trailer); the
+    busiest decode node is process-killed mid-stream; the recovery edge
+    resurrects the interrupted streams on the survivor. Returns the
+    phase report plus the interrupted records (the stitch audit reads
+    their trace ids)."""
+    import time as _time
+
+    from radixmesh_tpu.policy.retry import RetryPolicy
+    from radixmesh_tpu.server.recovery import HopTimeout, RecoveryCoordinator
+
+    t_phase = _time.monotonic()
+    policy = RetryPolicy(
+        hop_timeout_s=0.3,
+        max_retries=4,
+        backoff_base_s=0.05,
+        backoff_max_s=0.3,
+        jitter_frac=0.25,
+    )
+    coord = RecoveryCoordinator(policy, name="obs-edge", seed=seed)
+
+    def token_of(stream_seed: int, i: int) -> int:
+        return int((stream_seed * 7919 + i * 104729 + 13) % 600)
+
+    stream_recs = []
+    for s in range(streams):
+        prompt = rng.integers(0, 600, size=9).astype(np.int32)
+        rec = coord.admit(prompt, deadline_s=deadline_s, seed=seed * 977 + s)
+        res = cr.cache_aware_route(prompt)
+        rec.addr = res.decode_addr
+        stream_recs.append(rec)
+
+    def emit_one(rec) -> None:
+        node = by_addr[rec.addr]
+        i = len(rec.delivered)
+        tok = token_of(rec.seed, i)
+        key = np.concatenate(
+            [rec.resume_key(), np.asarray([tok], dtype=np.int32)]
+        )
+        # The mesh publish carries the stream's trace id: co-owner
+        # replicas open replication_lag spans under it — the stitched
+        # view's replication edges.
+        node.insert(key, np.arange(len(key), dtype=np.int32),
+                    trace_id=rec.trace_id)
+        rec.deliver(tok)
+
+    half = tokens_per_stream // 2
+    for _ in range(half):
+        for rec in stream_recs:
+            emit_one(rec)
+
+    per_addr: dict = {}
+    for rec in stream_recs:
+        per_addr[rec.addr] = per_addr.get(rec.addr, 0) + 1
+    victim = max(decode, key=lambda a: per_addr.get(a, 0))
+    interrupted = [r for r in stream_recs if r.addr == victim]
+    plan.kill(victim)
+    on_kill(victim)  # the process dies whole: its planes die with it
+    by_addr[victim].close()
+
+    def make_route_fn(rec):
+        def route_fn(key, exclude):
+            cur = rec.addr
+            if cur is not None and cur not in exclude:
+                return cur
+            return cr.cache_aware_route(key, exclude=exclude).decode_addr
+
+        return route_fn
+
+    def serve_fn(addr, rec, hop_deadline_s):
+        deadline = _time.monotonic() + hop_deadline_s
+        while len(rec.delivered) < tokens_per_stream:
+            if plan.is_killed(addr):
+                wait = deadline - _time.monotonic()
+                if wait > 0:
+                    _time.sleep(wait)
+                raise HopTimeout(f"no progress from {addr}")
+            emit_one(rec)
+
+    failed = 0
+    for rec in stream_recs:
+        try:
+            coord.run_to_completion(rec, make_route_fn(rec), serve_fn)
+        except Exception:  # noqa: BLE001 — failures are the measurement
+            failed += 1
+    resumed = sum(1 for r in interrupted if r.done and r.resurrections)
+    report = {
+        "performed": True,
+        "node": victim,
+        "streams": streams,
+        "tokens_per_stream": tokens_per_stream,
+        "interrupted": len(interrupted),
+        "resumed": resumed,
+        "failed": failed,
+        "stitch_s": round(_time.monotonic() - t_phase, 3),
+    }
+    return report, interrupted
+
+
+def run_obs_workload(
+    seed: int = 0,
+    replication_factor: int = 3,
+    streams: int = 8,
+    tokens_per_stream: int = 20,
+    zipf_keys: int = 64,
+    zipf_inserts: int = 400,
+    zipf_alpha: float = 1.4,
+    key_len: int = 8,
+    summary_interval_s: float = 0.2,
+    deadline_s: float = 20.0,
+    timeout_s: float = 60.0,
+    engine_steps: bool = True,
+    stitched_trace_path: str | None = None,
+) -> dict:
+    """The mesh-wide observability acceptance scenario (PR 9;
+    ``bench.validate_obs`` pins its artifact) — three legs over one
+    sharded cluster (4 prefill + 2 decode + 1 router, rf defaults 3):
+
+    a. **Cross-node trace stitching.** A chaos-style crash+resurrection
+       run under full tracing: every emitted token's mesh publish
+       carries the stream's 64-bit trace id (oplog trace trailer), the
+       busiest decode node is killed mid-stream, interrupted streams
+       resurrect on the survivor — and ONE stitched Perfetto export
+       must show the interrupted request's spans on ≥ 3 node tracks
+       under a single trace id, with publish/replication edges visible.
+    b. **Per-shard heat & skew.** Zipf-keyed inserts provably drive the
+       skew score: the router — no tree replica, fed only by SHARD_SUMMARY
+       heat trailers — must name the hot shard, its owner set, and a
+       skew score above the artifact's floor.
+    c. **TPU step attribution.** A CPU-backed tiny engine with
+       ``step_accounting=True`` serves a short burst and must report
+       per-wave MFU + pad fraction for BOTH prefill and decode.
+
+    Plus the **wire gate**: a traceless INSERT frame is bit-identical
+    to the pre-PR-9 encoding (no flag, no trailer) and a traced frame
+    differs by exactly the 8-byte trailer."""
+    import time as _time
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
+    from radixmesh_tpu.comm import faults
+    from radixmesh_tpu.comm.inproc import InprocHub
+    from radixmesh_tpu.config import MeshConfig, NodeRole
+    from radixmesh_tpu.obs.trace_plane import (
+        FlightRecorder,
+        get_recorder,
+        set_recorder,
+        stitch_traces,
+    )
+
+    def wait_for(pred, timeout=timeout_s, interval=0.02):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(interval)
+        return pred()
+
+    rng = np.random.default_rng(seed)
+    t_start = _time.monotonic()
+    InprocHub.reset_default()
+    prev_recorder = get_recorder()
+    set_recorder(
+        FlightRecorder(capacity=1 << 16, sample=1.0, node="obs-edge")
+    )
+    # 4 prefills so rf=3 owner sets are PROPER subsets of the prefill
+    # role (an all-nodes-own-everything fleet would make the hot-owner
+    # gate vacuous); 2 decodes so the crash leaves a survivor that
+    # co-owns every shard (decode owners = min(rf, 2)).
+    prefill = ["op0", "op1", "op2", "op3"]
+    decode = ["od0", "od1"]
+    router_addrs = ["or0"]
+    plan = faults.FaultPlan(seed=seed)
+    nodes: list = []
+    fleet_planes: list = []
+    try:
+        with faults.injected(plan):
+            from radixmesh_tpu.obs.fleet_plane import FleetPlane
+
+            for addr in prefill + decode + router_addrs:
+                cfg = MeshConfig(
+                    prefill_nodes=prefill,
+                    decode_nodes=decode,
+                    router_nodes=router_addrs,
+                    local_addr=addr,
+                    protocol="inproc",
+                    tick_interval_s=0.1,
+                    gc_interval_s=60.0,
+                    failure_timeout_s=60.0,
+                    replication_factor=replication_factor,
+                    shard_summary_interval_s=summary_interval_s,
+                )
+                nodes.append(MeshCache(cfg, pool=None).start())
+            for n in nodes:
+                if not n.wait_ready(timeout=timeout_s):
+                    raise RuntimeError(
+                        f"node {n.rank} never passed the barrier"
+                    )
+            ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+            router_mesh = nodes[-1]
+            by_addr = {n.cfg.local_addr: n for n in ring}
+            by_rank = {n.rank: n for n in ring}
+            # Digest gossip feeds the stitcher's clock-offset estimates
+            # (FleetView.clock_offsets) — the "correction from the
+            # existing digest timestamps" leg of the stitch contract.
+            fleet_planes = [
+                FleetPlane(n, interval_s=0.2).start() for n in ring
+            ]
+            plane_of = dict(zip([n.cfg.local_addr for n in ring], fleet_planes))
+            from radixmesh_tpu.router.cache_aware_router import (
+                CacheAwareRouter,
+            )
+
+            cr = CacheAwareRouter(router_mesh, router_mesh.cfg)
+            cr.watch_topology()
+            cr.finish_warm_up()
+            wait_for(lambda: len(router_mesh.fleet.clock_offsets()) >= 1)
+
+            # -- leg (b) first: heat needs the full fleet alive --------
+            heat_report = _obs_zipf_heat_phase(
+                ring=ring,
+                router_mesh=router_mesh,
+                by_rank=by_rank,
+                rng=rng,
+                wait_for=wait_for,
+                zipf_keys=zipf_keys,
+                zipf_inserts=zipf_inserts,
+                zipf_alpha=zipf_alpha,
+                key_len=key_len,
+            )
+
+            # -- leg (a): crash + resurrection under full tracing ------
+            stitch_report, interrupted = _obs_stitch_phase(
+                by_addr=by_addr,
+                cr=cr,
+                plan=plan,
+                decode=decode,
+                rng=rng,
+                seed=seed,
+                streams=streams,
+                tokens_per_stream=tokens_per_stream,
+                deadline_s=deadline_s,
+                on_kill=lambda addr: plane_of[addr].close(),
+            )
+
+            # Stitch audit: ONE merged export; the interrupted request's
+            # spans must land on >= 3 distinct node tracks under its
+            # single trace id, with publish + replication edges visible.
+            rec = get_recorder()
+            spans = rec.snapshot()
+            # Clock-offset correction from the digest timestamps the
+            # fleet already gossips (rank-keyed → node-label-keyed).
+            offsets = {
+                by_rank[r]._node_label: off
+                for r, off in router_mesh.fleet.clock_offsets().items()
+                if r in by_rank
+            }
+            stitched = stitch_traces([rec.export_spans()], offsets)
+            best = {"trace_id": 0, "nodes": set(), "lag": 0, "publish": 0}
+            for irec in interrupted:
+                tid = irec.trace_id
+                node_set = {
+                    s.node for s in spans if s.trace_id == tid and s.node
+                }
+                lag = sum(
+                    1
+                    for s in spans
+                    if s.trace_id == tid and s.name == "replication_lag"
+                )
+                pub = sum(
+                    1
+                    for s in spans
+                    if s.trace_id == tid and s.name == "mesh_publish"
+                )
+                if len(node_set) > len(best["nodes"]):
+                    best = {
+                        "trace_id": tid, "nodes": node_set,
+                        "lag": lag, "publish": pub,
+                    }
+            stitch_report.update(
+                {
+                    "trace_id": f"{best['trace_id']:#018x}",
+                    "node_tracks": len(best["nodes"]),
+                    "nodes_on_track": sorted(best["nodes"]),
+                    "replication_edges": int(best["lag"]),
+                    "publish_edges": int(best["publish"]),
+                    "span_count": len(spans),
+                    "stitched_events": len(stitched["traceEvents"]),
+                    "clock_offsets_applied": len(offsets),
+                }
+            )
+            if stitched_trace_path:
+                import json as _json
+
+                with open(stitched_trace_path, "w") as fh:
+                    _json.dump(stitched, fh)
+                stitch_report["stitched_artifact"] = stitched_trace_path
+
+            # -- wire gate: traceless frames are bit-for-bit pre-PR-9 --
+            base = dict(
+                op_type=OplogType.INSERT,
+                origin_rank=0,
+                logic_id=7,
+                ttl=3,
+                key=np.arange(1, 9, dtype=np.int32),
+                value=np.arange(8, dtype=np.int32),
+                value_rank=0,
+            )
+            import radixmesh_tpu.cache.oplog as oplog_mod
+
+            plain = serialize(Oplog(**base))
+            traced = serialize(Oplog(**base, trace_id=0xA5A5_5A5A_DEAD_BEEF))
+            # Strip the trailer + clear the flag bit: the result must be
+            # BYTE-IDENTICAL to the traceless frame — i.e. tracing-off
+            # frames are exactly the pre-PR-9 wire, and tracing adds
+            # exactly (flag bit, 8-byte trailer) and nothing else.
+            stripped = bytearray(traced[:-8])
+            stripped[oplog_mod._FLAGS_OFFSET] &= ~oplog_mod._FLAG_TRACE
+            wire_report = {
+                "rf0_traceless_unchanged": bool(
+                    bytes(stripped) == plain
+                    and oplog_mod.deserialize(plain).trace_id == 0
+                ),
+                "trace_trailer_roundtrip": bool(
+                    oplog_mod.deserialize(traced).trace_id
+                    == 0xA5A5_5A5A_DEAD_BEEF
+                ),
+                "trailer_bytes": len(traced) - len(plain),
+            }
+    finally:
+        set_recorder(prev_recorder)
+        for p in fleet_planes:
+            p.close()
+        for n in nodes:
+            n.close()
+        InprocHub.reset_default()
+
+    # -- leg (c): step attribution on a CPU-backed tiny engine ---------
+    steps_report: dict = {"performed": False}
+    if engine_steps:
+        import jax
+
+        from radixmesh_tpu.engine.engine import Engine
+        from radixmesh_tpu.models.llama import ModelConfig, init_params
+
+        mcfg = ModelConfig.tiny()
+        eng = Engine(
+            mcfg,
+            init_params(mcfg, jax.random.PRNGKey(seed)),
+            num_slots=512,
+            page_size=4,
+            max_batch=2,
+            name="obs-steps",
+            step_accounting=True,
+        )
+        sampling = None
+        prompts = [list(range(1, 14)), list(range(1, 18)), list(range(1, 14))]
+        eng.generate(prompts, sampling)
+        acct = eng.step_acct.report()
+        steps_report = {
+            "performed": True,
+            "n_params": acct["n_params"],
+            "peak_tflops": acct["peak_tflops"],
+            "prefill": {
+                k: acct["prefill"][k]
+                for k in (
+                    "waves", "real_tokens", "padded_tokens", "mfu",
+                    "pad_fraction",
+                )
+            },
+            "decode": {
+                k: acct["decode"][k]
+                for k in (
+                    "waves", "real_tokens", "padded_tokens", "mfu",
+                    "pad_fraction",
+                )
+            },
+        }
+
+    return {
+        "nodes": len(prefill) + len(decode) + len(router_addrs),
+        "topology": "4 prefill + 2 decode + 1 router (inproc)",
+        "replication_factor": replication_factor,
+        "stitch": stitch_report,
+        "heat": heat_report,
+        "steps": steps_report,
+        "wire": wire_report,
+        "wall_s": round(_time.monotonic() - t_start, 3),
+    }
 
 
 def run_kvflow_workload(

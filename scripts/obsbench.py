@@ -1,0 +1,100 @@
+"""Standalone mesh-wide observability bench (the OBS artifact's paired
+CLI emitter, like ``scripts/chaosbench.py`` is for CHAOS).
+
+Runs ``workload.run_obs_workload`` — (a) a crash+resurrection drill
+under full tracing whose spans must stitch into ONE Perfetto file with
+the interrupted request on >= 3 node tracks under a single 64-bit trace
+id, (b) zipf-keyed inserts that provably drive the per-shard skew score
+(the router names the hot shard + owner set from SHARD_SUMMARY heat
+gossip alone), and (c) a CPU tiny-engine burst with per-wave MFU + pad
+fraction step attribution — and prints ONE JSON line validated against
+the schema ``bench.validate_obs`` pins.
+
+Usage::
+
+    python scripts/obsbench.py [--seed 0] [--replication-factor 3] \
+        [--no-engine-steps] [--trace-out FILE] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+from radixmesh_tpu.workload import run_obs_workload  # noqa: E402
+
+
+def run(
+    seed: int,
+    replication_factor: int,
+    streams: int,
+    zipf_inserts: int,
+    engine_steps: bool = True,
+    stitched_trace_path: str | None = None,
+) -> dict:
+    res = run_obs_workload(
+        seed=seed,
+        replication_factor=replication_factor,
+        streams=streams,
+        zipf_inserts=zipf_inserts,
+        engine_steps=engine_steps,
+        stitched_trace_path=stitched_trace_path,
+    )
+    report = bench.build_obs_report(res)
+    problems = bench.validate_obs(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="obsbench")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--replication-factor", type=int, default=3, metavar="RF",
+        help="sharding factor for the mesh under test (the heat map and "
+        "owner-set gate need RF > 0; the acceptance run pins 3)",
+    )
+    ap.add_argument(
+        "--streams", type=int, default=8,
+        help="live traced streams decoding when the kill lands",
+    )
+    ap.add_argument(
+        "--zipf-inserts", type=int, default=400,
+        help="total zipf-distributed inserts driving the heat map",
+    )
+    ap.add_argument(
+        "--no-engine-steps", action="store_true",
+        help="skip the tiny-engine step-attribution leg (no jax compile)",
+    )
+    ap.add_argument(
+        "--trace-out", default=None, metavar="FILE",
+        help="also write the stitched Perfetto trace here",
+    )
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    args = ap.parse_args()
+    report = run(
+        args.seed,
+        args.replication_factor,
+        args.streams,
+        args.zipf_inserts,
+        engine_steps=not args.no_engine_steps,
+        stitched_trace_path=args.trace_out,
+    )
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -661,3 +661,151 @@ class TestRingscaleArtifactSchema:
             r for r in newest["results"] if int(r.get("rf", 0)) > 0
         ]
         assert any(r["n_nodes"] >= 200 for r in sharded)
+
+
+class TestObsArtifactSchema:
+    """OBS v1 (PR 9, mesh-wide observability): the stitched-trace gate
+    (interrupted request on >= OBS_MIN_NODE_TRACKS node tracks under one
+    trace id, replication edges visible, zero lost streams), the heat
+    gate (zipf hot shard detected with the correct owner set, skew above
+    the floor), the step-attribution gate (per-wave MFU + pad fraction
+    for prefill AND decode), and the wire gate (traceless frames
+    bit-for-bit pre-PR-9)."""
+
+    def _report(self) -> dict:
+        return {
+            "schema_version": bench.OBS_SCHEMA_VERSION,
+            "metric": "obs_stitched_node_tracks",
+            "value": 6,
+            "unit": "node tracks under a single 64-bit trace id",
+            "workload": "traced crash drill + zipf heat + tiny-engine burst",
+            "nodes": 7,
+            "topology": "4 prefill + 2 decode + 1 router (inproc)",
+            "replication_factor": 3,
+            "stitch": {
+                "performed": True, "node": "od0", "streams": 8,
+                "tokens_per_stream": 20, "interrupted": 6, "resumed": 6,
+                "failed": 0, "trace_id": "0x3da6417a0df7ba6d",
+                "node_tracks": 6,
+                "nodes_on_track": [
+                    "decode@4", "decode@5", "obs-edge",
+                    "prefill@0", "prefill@2", "prefill@3",
+                ],
+                "replication_edges": 37, "publish_edges": 20,
+                "span_count": 2544, "stitched_events": 2561,
+            },
+            "heat": {
+                "performed": True, "inserts": 394, "distinct_keys": 64,
+                "zipf_alpha": 1.4, "skew_score": 16.3,
+                "hot_shard": 7, "expected_hot_shard": 7,
+                "hot_owners": [0, 1, 2, 4, 5],
+                "expected_hot_owners": [0, 1, 2, 4, 5],
+                "owner_set_correct": True, "reporters": 6,
+            },
+            "steps": {
+                "performed": True, "n_params": 426624, "peak_tflops": 1.0,
+                "prefill": {
+                    "waves": 3, "real_tokens": 19, "padded_tokens": 32,
+                    "mfu": 1.0e-05, "pad_fraction": 0.40625,
+                },
+                "decode": {
+                    "waves": 30, "real_tokens": 45, "padded_tokens": 60,
+                    "mfu": 1.9e-05, "pad_fraction": 0.25,
+                },
+            },
+            "wire": {
+                "rf0_traceless_unchanged": True,
+                "trace_trailer_roundtrip": True,
+                "trailer_bytes": 8,
+            },
+            "wall_s": 10.7,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_obs(self._report()) == []
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["replication_factor"]
+        del report["stitch"]["trace_id"]
+        del report["heat"]["skew_score"]
+        del report["steps"]["prefill"]["mfu"]
+        del report["wire"]["trailer_bytes"]
+        missing = bench.validate_obs(report)
+        assert "replication_factor" in missing
+        assert "stitch.trace_id" in missing
+        assert any("skew_score" in m for m in missing)
+        assert "steps.prefill.mfu" in missing
+        assert "wire.trailer_bytes" in missing
+        assert bench.validate_obs(7) == ["artifact is not a JSON object"]
+
+    def test_stitch_gates_enforced(self):
+        report = self._report()
+        report["stitch"]["node_tracks"] = bench.OBS_MIN_NODE_TRACKS - 1
+        report["stitch"]["failed"] = 2
+        report["stitch"]["resumed"] = 3
+        report["stitch"]["replication_edges"] = 0
+        problems = "\n".join(bench.validate_obs(report))
+        assert "did not stitch" in problems
+        assert "LOST" in problems
+        assert "not all resurrected" in problems
+        assert "no replication edges" in problems
+
+    def test_heat_gates_enforced(self):
+        report = self._report()
+        report["heat"]["skew_score"] = bench.OBS_MIN_SKEW_SCORE - 0.5
+        report["heat"]["hot_shard"] = 9
+        report["heat"]["owner_set_correct"] = False
+        report["heat"]["reporters"] = 0
+        problems = "\n".join(bench.validate_obs(report))
+        assert "skew score" in problems
+        assert "ground truth" in problems
+        assert "owner set was not correctly named" in problems
+        assert "zero heat reporters" in problems
+
+    def test_step_and_wire_gates_enforced(self):
+        report = self._report()
+        report["steps"]["decode"]["waves"] = 0
+        report["steps"]["decode"]["mfu"] = 0.0
+        report["steps"]["prefill"]["pad_fraction"] = 1.5
+        report["wire"]["rf0_traceless_unchanged"] = False
+        problems = "\n".join(bench.validate_obs(report))
+        assert "zero decode waves" in problems
+        assert "decode MFU" in problems
+        assert "pad fraction" in problems
+        assert "bit-for-bit" in problems
+
+    def test_skipped_legs_are_schema_valid_but_gate_exempt(self):
+        report = self._report()
+        report["stitch"] = {"performed": False}
+        report["heat"] = {"performed": False}
+        report["steps"] = {"performed": False}
+        assert bench.validate_obs(report) == []
+
+    def test_build_report_matches_schema(self):
+        res = {
+            k: self._report()[k]
+            for k in (
+                "nodes", "topology", "replication_factor", "stitch",
+                "heat", "steps", "wire", "wall_s",
+            )
+        }
+        report = bench.build_obs_report(res)
+        assert bench.validate_obs(report) == []
+        assert report["value"] == res["stitch"]["node_tracks"]
+
+    def test_checked_in_artifact_validates_and_gates_green(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "OBS_r*.json")))
+        assert paths, "no OBS artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_obs(report) == []
+        assert "schema_violation" not in report
+        # The acceptance headline numbers really are in the artifact.
+        assert report["stitch"]["node_tracks"] >= bench.OBS_MIN_NODE_TRACKS
+        assert report["heat"]["skew_score"] >= bench.OBS_MIN_SKEW_SCORE
+        assert report["steps"]["performed"] is True

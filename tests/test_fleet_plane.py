@@ -494,3 +494,84 @@ class TestMeshTtlSweep:
             for n in nodes:
                 n.close()
             InprocHub.reset_default()
+
+
+class TestShardHeatFold:
+    """PR 9 leg (b): the FleetView folds per-shard decayed loads from
+    the SHARD_SUMMARY heat trailer into the cluster heat map + skew
+    score the future rebalancer consumes."""
+
+    def test_max_over_reporters_not_sum(self):
+        """Co-owners see the SAME inserts: a fleet load that summed
+        reporters would count one insert RF times."""
+        v = FleetView()
+        v.fold_shard_heat(0, {7: 10.0, 9: 1.0})
+        v.fold_shard_heat(1, {7: 8.0, 9: 2.0})
+        heat = v.shard_heat()
+        assert heat["shards"]["7"] == 10.0
+        assert heat["shards"]["9"] == 2.0
+        assert heat["hot_shard"] == 7
+        assert heat["reporters"] == 2
+        # skew = max / mean = 10 / 6
+        assert heat["skew_score"] == pytest.approx(10.0 / 6.0, abs=1e-3)
+
+    def test_whole_summary_swap_and_empty_fold_clears(self):
+        v = FleetView()
+        v.fold_shard_heat(3, {1: 5.0, 2: 5.0})
+        v.fold_shard_heat(3, {2: 1.0})  # ownership changed: shard 1 gone
+        assert v.shard_heat()["shards"] == {"2": 1.0}
+        v.fold_shard_heat(3, {})  # cold owner: cleared, not unknown
+        assert v.shard_heat()["reporters"] == 0
+        assert v.shard_heat()["hot_shard"] is None
+        assert v.shard_heat()["skew_score"] == 0.0
+
+    def test_forget_and_retain_drop_heat(self):
+        v = FleetView()
+        v.fold_shard_heat(4, {1: 3.0})
+        v.fold_shard_heat(5, {2: 4.0})
+        v.forget(4)
+        assert "1" not in v.shard_heat()["shards"]
+        v.retain([])
+        assert v.shard_heat()["reporters"] == 0
+
+    def test_snapshot_includes_heat_only_when_reported(self):
+        v = FleetView()
+        assert "shard_heat" not in v.snapshot()
+        v.fold_shard_heat(0, {3: 2.0})
+        assert v.snapshot()["shard_heat"]["hot_shard"] == 3
+
+
+class TestClockOffsets:
+    """PR 9 leg (a): per-rank wall-clock skew estimates derived from the
+    digest timestamps every node already gossips — the stitcher's
+    clock-offset correction input."""
+
+    def _digest(self, rank, seq, ts):
+        return NodeDigest(
+            rank=rank, role="prefill", seq=seq, ts=ts, epoch=0,
+            fingerprint=1, tree_tokens=0, cache_hit_rate=0.0,
+            pool_fill=0.0, host_fill=0.0, batch_occupancy=0.0,
+            decode_ewma_s=0.0, waiting=0, decode_steps=0,
+        )
+
+    def test_min_tracked_skew(self):
+        clock = {"t": 100.0}
+        v = FleetView(now=lambda: clock["t"])
+        # First fold: digest stamped 2s behind the local clock.
+        v.fold(self._digest(1, 1, ts=98.0))
+        assert v.clock_offsets()[1] == pytest.approx(2.0)
+        # A faster delivery tightens the estimate; a slower one never
+        # loosens it (min-tracking bounds the transit inflation).
+        clock["t"] = 101.0
+        v.fold(self._digest(1, 2, ts=100.5))
+        assert v.clock_offsets()[1] == pytest.approx(0.5)
+        clock["t"] = 110.0
+        v.fold(self._digest(1, 3, ts=105.0))
+        assert v.clock_offsets()[1] == pytest.approx(0.5)
+
+    def test_forget_drops_the_estimate(self):
+        v = FleetView(now=lambda: 10.0)
+        v.fold(self._digest(2, 1, ts=9.0))
+        assert 2 in v.clock_offsets()
+        v.forget(2)
+        assert v.clock_offsets() == {}

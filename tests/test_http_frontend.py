@@ -700,3 +700,55 @@ class TestClusterEndpoints:
             for n in nodes:
                 n.close()
             InprocHub.reset_default()
+
+
+class TestStitchingSurfaces:
+    """PR 9 cross-node stitching HTTP seams: /generate adopts an
+    upstream trace id (resume/hedge re-routes stitch under the
+    originating request), and /debug/trace?format=spans serves the raw
+    per-node span export the collector feeds to stitch_traces."""
+
+    def test_generate_adopts_trace_id_and_spans_export(self, frontend):
+        from radixmesh_tpu.obs.trace_plane import (
+            FlightRecorder,
+            set_recorder,
+            stitch_traces,
+        )
+
+        set_recorder(FlightRecorder(capacity=4096, sample=1.0, node="serve"))
+        status, out = _post(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            {
+                "input_ids": list(range(700, 720)),
+                "max_tokens": 2,
+                "trace_id": "0x00dead00beef0001",
+            },
+        )
+        assert status == 200
+        status, body = _get(
+            f"http://127.0.0.1:{frontend.port}/debug/trace?format=spans"
+        )
+        assert status == 200
+        export = json.loads(body)
+        assert export["node"] == "serve"
+        assert isinstance(export["wall_offset"], float)
+        adopted = [
+            s for s in export["spans"]
+            if s["trace_id"] == "0x00dead00beef0001"
+        ]
+        assert adopted, "no span adopted the upstream trace id"
+        assert {"prefill_wave", "publish"} <= {s["name"] for s in adopted}
+        # The export stitches into a valid single-process document.
+        import bench
+
+        assert bench.validate_trace(stitch_traces([export])) == []
+
+    def test_generate_rejects_bad_trace_id(self, frontend):
+        for bad in (0, "soup"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(
+                    f"http://127.0.0.1:{frontend.port}/generate",
+                    {"input_ids": [1, 2, 3], "max_tokens": 1,
+                     "trace_id": bad},
+                )
+            assert e.value.code == 400

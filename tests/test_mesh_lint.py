@@ -334,3 +334,67 @@ class TestOwnershipSingleWriter:
 
         src = inspect.getsource(MeshCache._after_view_change)
         assert "build_ownership(" in src
+
+
+class TestShardHeatSingleWriter:
+    """PR 9 satellite lint: per-shard heat counting has ONE writer (the
+    ownership-lint pattern). :class:`ShardHeat` is defined in
+    cache/sharding.py and constructed/mutated ONLY by
+    cache/mesh_cache.py — a second module noting heat would double-count
+    the same traffic and silently skew the rebalancer's trigger signal.
+    Everything else reads the folded FleetView heat map."""
+
+    _CONSTRUCT = re.compile(r"ShardHeat\(")
+    _NOTE = re.compile(r"\.note_(insert|hit|pull)\(")
+
+    def _product_sources(self):
+        import pathlib
+
+        import radixmesh_tpu
+
+        pkg = pathlib.Path(radixmesh_tpu.__file__).parent
+        for path in sorted(pkg.rglob("*.py")):
+            yield path, path.read_text()
+
+    def _is_writer(self, path) -> bool:
+        return path.parent.name == "cache" and path.name in (
+            "sharding.py",  # the class definition (no construction calls)
+            "mesh_cache.py",  # the sole constructor + note_* call sites
+        )
+
+    def test_no_module_outside_the_writer_counts_heat(self):
+        offenders = []
+        for path, src in self._product_sources():
+            if self._is_writer(path):
+                continue
+            for pat in (self._CONSTRUCT, self._NOTE):
+                for m in pat.finditer(src):
+                    line = src[: m.start()].count("\n") + 1
+                    offenders.append(f"{path}:{line}: {m.group(0)!r}")
+        assert not offenders, (
+            "per-shard heat counted outside cache/mesh_cache.py "
+            "(single-writer contract — the same traffic would be "
+            "double-counted): " + "; ".join(offenders)
+        )
+
+    def test_positive_control_mesh_cache_does_count(self):
+        import inspect
+
+        from radixmesh_tpu.cache import mesh_cache, sharding
+
+        mc_src = inspect.getsource(mesh_cache)
+        assert self._CONSTRUCT.search(mc_src)
+        assert self._NOTE.search(mc_src)
+        # And the class itself lives in the sharding module.
+        assert hasattr(sharding, "ShardHeat")
+
+    def test_all_three_heat_kinds_are_counted(self):
+        """The three traffic legs the ISSUE names — insert, hit,
+        pull-through — each have a live counting site in mesh_cache."""
+        import inspect
+
+        from radixmesh_tpu.cache import mesh_cache
+
+        src = inspect.getsource(mesh_cache)
+        for kind in ("note_insert", "note_hit", "note_pull"):
+            assert f".{kind}(" in src, f"no {kind} site in mesh_cache"

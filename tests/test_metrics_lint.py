@@ -23,6 +23,9 @@ GAUGE_SUFFIXES = UNIT_SUFFIXES + (
     "_state",  # lifecycle state code (policy/lifecycle.py)
     "_shards",  # owned-shard count (cache/sharding.py)
     "_bytes_per_insert",  # per-insert wire-cost EWMA (cache/sharding.py)
+    "_ratio",  # dimensionless max/mean skew (PR 9 heat map)
+    "_mfu",  # model-FLOPs-utilization estimate (obs/step_plane.py)
+    "_fraction",  # 0..1 share, e.g. wave padding (obs/step_plane.py)
 )
 
 
@@ -76,6 +79,11 @@ def _register_all_instrumented_families() -> None:
     # Request-recovery plane (server/recovery.py): registers the
     # retries/resurrections/hedges counters + recovery histogram.
     RecoveryCoordinator(name="lint-edge")
+    # TPU step attribution (obs/step_plane.py): registers the MFU /
+    # pad-fraction gauges + wave counter.
+    from radixmesh_tpu.obs.step_plane import StepAccounting
+
+    StepAccounting("lint-steps", n_params=1_000, peak_tflops=1.0)
 
 
 def _registered_families() -> dict[str, str]:
@@ -269,3 +277,66 @@ class TestMetricHygiene:
                     f'{{cause="{cause}",node="{node}"}}'
                 )
                 assert key in snap, (key, sorted(snap))
+
+
+    def test_observability_families_registered(self):
+        """Satellite (PR 9): the shard heat/skew gauges and the step-
+        attribution families are first-class — registered on every node
+        from construction so a fleet enabling the planes sees series
+        move from zero instead of appearing from nowhere."""
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        assert (
+            fams.get("radixmesh_shard_heat_tokens_per_second") == "gauge"
+        ), sorted(fams)
+        assert fams.get("radixmesh_shard_skew_ratio") == "gauge", sorted(fams)
+        assert fams.get("radixmesh_step_mfu") == "gauge", sorted(fams)
+        assert fams.get("radixmesh_wave_pad_fraction") == "gauge", sorted(fams)
+        assert fams.get("radixmesh_step_waves_total") == "counter", sorted(fams)
+        # Both wave kinds materialize eagerly per engine.
+        snap = get_registry().snapshot()
+        for kind in ("prefill", "decode"):
+            key = (
+                'radixmesh_step_mfu'
+                f'{{engine="lint-steps",kind="{kind}"}}'
+            )
+            assert key in snap, (key, sorted(k for k in snap if "mfu" in k))
+
+    def test_step_wave_and_mesh_publish_spans_recorded(self):
+        """PR 9 span lanes: step waves land on ``step:<engine>`` and a
+        trace-id-bearing mesh insert anchors a ``mesh_publish`` span on
+        the node's ring lane — the flight-recorder contract every plane
+        registers under."""
+        import numpy as np
+
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.config import MeshConfig
+        from radixmesh_tpu.obs.step_plane import StepAccounting
+        from radixmesh_tpu.obs.trace_plane import (
+            FlightRecorder,
+            get_recorder,
+            set_recorder,
+        )
+
+        prev = get_recorder()
+        set_recorder(FlightRecorder(capacity=64, sample=1.0))
+        try:
+            StepAccounting("span-steps", 1_000, peak_tflops=1.0).note_wave(
+                "decode", 4, 8, 0.001
+            )
+            mesh = MeshCache(MeshConfig(
+                prefill_nodes=["mp0", "mp1"], decode_nodes=[],
+                router_nodes=[], local_addr="mp0", protocol="inproc",
+            ))
+            mesh.insert(
+                np.arange(1, 5, dtype=np.int32),
+                np.arange(4, dtype=np.int32),
+                trace_id=0x51,
+            )
+            mesh.close()
+            spans = get_recorder().snapshot()
+            by_name = {s.name: s for s in spans}
+            assert by_name["step_wave"].lane == "step:span-steps"
+            assert by_name["mesh_publish"].trace_id == 0x51
+        finally:
+            set_recorder(prev)

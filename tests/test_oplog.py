@@ -359,3 +359,141 @@ class TestRepairOps:
 
         assert OplogType.REPAIR_PROBE in EXTENSION_KINDS
         assert OplogType.REPAIR_SUMMARY in EXTENSION_KINDS
+
+
+@pytest.mark.quick
+class TestTraceTrailer:
+    """PR 9 cross-node stitching: data frames may carry an OPTIONAL
+    8-byte trace-id trailer behind a v3 flags bit. The compat contract
+    is the EXTENSION_KINDS one transposed to payload bytes: a pre-PR-9
+    decoder parses exactly the offsets it knows and never inspects
+    trailing bytes (raw pass-through — forwarding patches the original
+    frame in place, so the trailer survives old hops untouched), and a
+    PR-9 decoder reads traceless frames exactly as before."""
+
+    def _op(self, trace_id=0):
+        return Oplog(
+            op_type=OplogType.INSERT,
+            origin_rank=1,
+            logic_id=99,
+            ttl=4,
+            key=np.arange(1, 9, dtype=np.int32),
+            value=np.arange(8, dtype=np.int32),
+            value_rank=1,
+            ts=12.5,
+            trace_id=trace_id,
+        )
+
+    @staticmethod
+    def _legacy_v3_decode(buf: bytes):
+        """A faithful PRE-PR-9 v3 parser (the header/array/GC layout
+        verbatim, no knowledge of the trace flag or trailer) — the
+        stand-in for an old peer's deserialize in the compat tests."""
+        import struct
+
+        mv = memoryview(buf)
+        hdr = struct.Struct("<BBBxiqiidBBxx")
+        (_, ver, op_type, origin, logic, ttl, value_rank, ts,
+         page, flags) = hdr.unpack_from(mv, 0)
+        assert ver == 3
+        off = hdr.size
+        key_len, val_len, n_gc = struct.unpack_from("<III", mv, off)
+        off += 12
+
+        def _arr(count, u24):
+            nonlocal off
+            if u24:
+                raw = np.frombuffer(mv, np.uint8, 3 * count, off)
+                out = np.zeros((count, 4), np.uint8)
+                out[:, :3] = raw.reshape(count, 3)
+                off += 3 * count
+                return out.view(np.int32).reshape(count)
+            a = np.frombuffer(mv, np.int32, count, off).copy()
+            off += 4 * count
+            return a
+
+        key = _arr(key_len, flags & 1)
+        value = _arr(val_len, flags & 2)
+        assert n_gc == 0
+        # A pre-PR-9 parser STOPS here: trailing bytes are never read.
+        return dict(
+            op_type=op_type, origin=origin, logic=logic, ttl=ttl,
+            value_rank=value_rank, ts=ts, page=page,
+            key=key, value=value, consumed=off,
+        )
+
+    def test_trace_id_round_trips(self):
+        op = self._op(trace_id=0xFEED_FACE_CAFE_F00D)
+        back = deserialize(serialize(op))
+        assert back == op
+        assert back.trace_id == 0xFEED_FACE_CAFE_F00D
+
+    def test_traceless_frame_is_bit_for_bit_pre_trace(self):
+        """trace_id=0 emits NO flag and NO trailer: stripping the traced
+        frame's trailer and clearing its flag bit yields byte-identical
+        output — i.e. tracing adds exactly (bit, 8 bytes) and tracing
+        OFF costs zero wire change."""
+        from radixmesh_tpu.cache import oplog as om
+
+        plain = serialize(self._op())
+        traced = serialize(self._op(trace_id=7))
+        assert len(traced) == len(plain) + 8
+        stripped = bytearray(traced[:-8])
+        assert stripped[om._FLAGS_OFFSET] & om._FLAG_TRACE
+        stripped[om._FLAGS_OFFSET] &= ~om._FLAG_TRACE
+        assert bytes(stripped) == plain
+        assert deserialize(plain).trace_id == 0
+
+    def test_trace_bearing_frame_decodes_on_a_pre_pr9_peer(self):
+        """The satellite compat gate: an OLD v3 parser reads every field
+        of a trace-bearing frame correctly and simply never sees the
+        trailer (its parse ends 8 bytes early — raw pass-through)."""
+        op = self._op(trace_id=0xAB_CDEF_0123_4567)
+        frame = serialize(op)
+        legacy = self._legacy_v3_decode(frame)
+        assert legacy["origin"] == op.origin_rank
+        assert legacy["ttl"] == op.ttl
+        assert legacy["ts"] == op.ts
+        assert np.array_equal(legacy["key"], op.key)
+        assert np.array_equal(legacy["value"], op.value)
+        assert legacy["consumed"] == len(frame) - 8
+
+    def test_patched_ttl_and_frame_preserve_the_trailer(self):
+        """Ring forwarding patches the ORIGINAL bytes (TTL / scope /
+        value_rank at fixed offsets), so the trailer must survive every
+        hop untouched — including hops through pre-PR-9 peers, which
+        use the same in-place patch."""
+        from radixmesh_tpu.cache.oplog import patched_frame
+
+        frame = serialize(self._op(trace_id=0x1234_5678_9ABC_DEF0))
+        hopped = patched_ttl(frame, 1)
+        assert deserialize(hopped).trace_id == 0x1234_5678_9ABC_DEF0
+        assert deserialize(hopped).ttl == 1
+        scoped = patched_frame(frame, ttl=2, spine=True, value_rank=5)
+        back = deserialize(scoped)
+        assert back.trace_id == 0x1234_5678_9ABC_DEF0
+        assert back.spine and back.value_rank == 5
+
+    def test_pre_v3_emit_drops_trace_silently(self):
+        """A rolling upgrade pinned to wire v2 cannot carry the trailer:
+        serialize drops the id (tracing degrades; the wire never
+        breaks), unlike page/spine which hard-fail because they change
+        APPLY semantics."""
+        from radixmesh_tpu.cache.oplog import set_emit_version
+
+        op = self._op(trace_id=42)
+        op.page = 1
+        try:
+            set_emit_version(2)
+            back = deserialize(serialize(op))
+            assert back.trace_id == 0
+        finally:
+            set_emit_version(3)
+
+    def test_truncated_trailer_degrades_to_untraced(self):
+        """Flag set but trailer missing (a corrupt or truncated frame):
+        decode as untraced rather than raise — stitching is telemetry,
+        never worth a dropped frame."""
+        frame = bytearray(serialize(self._op(trace_id=99)))
+        del frame[-8:]  # trailer gone, flag still set
+        assert deserialize(bytes(frame)).trace_id == 0

@@ -109,13 +109,34 @@ class TestShardSummaryWire:
             5: (0xDEADBEEF, [(123, 64), (456, 8)]),
             61: (0, []),
         }
-        origin, back = decode_shard_summary(encode_shard_summary(9, shards))
+        origin, back, loads = decode_shard_summary(
+            encode_shard_summary(9, shards)
+        )
         assert origin == 9
         assert back == shards
+        assert loads == {}  # no heat trailer emitted
+
+    def test_round_trip_with_heat_trailer(self):
+        """PR 9: per-shard decayed loads ride the summary as an
+        old-wire-tolerant trailer; a loadless encode stays bit-for-bit
+        the pre-heat payload (compat asserted by byte equality)."""
+        shards = {5: (0xDEADBEEF, [(123, 64)])}
+        plain = encode_shard_summary(9, shards)
+        heated = encode_shard_summary(9, shards, loads={5: 12.5, 7: 0.25})
+        assert bytes(plain.tobytes()) == bytes(
+            heated.tobytes()[: plain.nbytes]
+        )
+        origin, back, loads = decode_shard_summary(heated)
+        assert origin == 9 and back == shards
+        assert loads == {5: 12.5, 7: 0.25}
+        # A pre-PR-9 peer parses exactly n_shards sections and ignores
+        # the trailing bytes — so the v1 fields of the heated frame
+        # decode identically to the plain frame's.
+        assert decode_shard_summary(plain)[:2] == (origin, back)
 
     def test_root_budget_truncates(self):
         roots = [(i, 1000 - i) for i in range(1000)]
-        _, back = decode_shard_summary(
+        _, back, _ = decode_shard_summary(
             encode_shard_summary(0, {3: (1, roots)})
         )
         from radixmesh_tpu.cache.sharding import MAX_SUMMARY_ROOTS
@@ -525,3 +546,138 @@ class TestBootstrapConvergence:
         a.fleet.fold_shard_fps(b.rank, fps)
         assert not a.bootstrap_converged_with(b.rank)
         assert a.diverged_shards_with(b.rank) == [sid]
+
+
+@pytest.mark.quick
+class TestShardHeat:
+    """PR 9 leg (b): decayed per-shard traffic counters — the
+    rebalancer's measurement substrate (single-writer: only
+    cache/mesh_cache.py calls the note_* sites; test_mesh_lint pins
+    it)."""
+
+    def test_decay_halves_per_half_life(self):
+        from radixmesh_tpu.cache.sharding import ShardHeat
+
+        clock = {"t": 0.0}
+        h = ShardHeat(half_life_s=10.0, now=lambda: clock["t"])
+        h.note_insert(3, 100)
+        assert h.loads()[3] == pytest.approx(10.0)  # 100 tok / 10 s window
+        clock["t"] = 10.0
+        assert h.loads()[3] == pytest.approx(5.0)  # one half-life later
+        clock["t"] = 30.0
+        assert h.loads()[3] == pytest.approx(1.25)
+        # New traffic decays the old value first, then adds.
+        h.note_insert(3, 100)
+        assert h.loads()[3] == pytest.approx(11.25)
+
+    def test_kinds_tracked_separately_and_loads_combine_insert_hit(self):
+        from radixmesh_tpu.cache.sharding import ShardHeat
+
+        h = ShardHeat(half_life_s=10.0, now=lambda: 5.0)
+        h.note_insert(1, 40, nbytes=512)
+        h.note_hit(1, 60)
+        h.note_pull(1)
+        snap = h.snapshot()[1]
+        assert snap["insert_tokens"] == pytest.approx(40.0)
+        assert snap["hit_tokens"] == pytest.approx(60.0)
+        assert snap["pull_throughs"] == pytest.approx(1.0)
+        assert snap["bytes"] == pytest.approx(512.0)
+        assert h.loads()[1] == pytest.approx(10.0)  # (40+60)/10
+
+    def test_mesh_counts_insert_hit_and_reports_heat(self):
+        """Single-node seam: insert() and match_prefix() on a sharded
+        P/D mesh feed the heat tracker; broadcast_shard_summary folds
+        the loads into the local FleetView and shard_heat_report names
+        the hot shard + its owner set."""
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.config import MeshConfig
+
+        mesh = MeshCache(MeshConfig(
+            prefill_nodes=["hp0", "hp1"], decode_nodes=[], router_nodes=[],
+            local_addr="hp0", protocol="inproc", replication_factor=1,
+        ))
+        try:
+            rng = np.random.default_rng(5)
+            hot_key = None
+            for _ in range(64):
+                key = rng.integers(1, 50_000, size=8).astype(np.int32)
+                sid = shard_of_tokens(key[:1])
+                if mesh.ownership.is_owner(mesh.rank, sid):
+                    hot_key = key
+                    break
+            assert hot_key is not None
+            hot_sid = shard_of_tokens(hot_key[:1])
+            for _ in range(10):
+                mesh.insert(hot_key, np.arange(8, dtype=np.int32))
+                mesh.match_prefix(hot_key)
+            assert mesh.heat.loads().get(hot_sid, 0.0) > 0.0
+            assert mesh.broadcast_shard_summary() > 0
+            report = mesh.shard_heat_report()
+            assert report["hot_shard"] == hot_sid
+            assert report["hot_owners"] == list(
+                mesh.ownership.owners_of(hot_sid)
+            )
+            assert report["skew_score"] >= 1.0
+            assert report["reporters"] == 1
+        finally:
+            mesh.close()
+
+    def test_cooled_shard_zeroes_its_gauge_and_leaves_gossip(self):
+        """A scraped gauge has no whole-summary swap: a shard that cools
+        to (effectively) zero must export 0 — not its last hot value —
+        and must leave the heat trailer entirely (MIN_LOAD floor), so
+        the fleet map's empty-fold clears the reporter."""
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.config import MeshConfig
+        from radixmesh_tpu.obs.metrics import get_registry
+
+        clock = {"t": 0.0}
+        mesh = MeshCache(MeshConfig(
+            prefill_nodes=["zp0", "zp1"], decode_nodes=[], router_nodes=[],
+            local_addr="zp0", protocol="inproc", replication_factor=1,
+        ))
+        try:
+            mesh.heat._now = lambda: clock["t"]
+            rng = np.random.default_rng(5)
+            key = next(
+                k for k in (
+                    rng.integers(1, 50_000, size=8).astype(np.int32)
+                    for _ in range(64)
+                )
+                if mesh.ownership.is_owner(0, shard_of_tokens(k[:1]))
+            )
+            sid = shard_of_tokens(key[:1])
+            mesh.insert(key, np.arange(8, dtype=np.int32))
+            mesh.broadcast_shard_summary()
+            gauge = (
+                'radixmesh_shard_heat_tokens_per_second'
+                f'{{node="prefill@0",shard="{sid}"}}'
+            )
+            assert get_registry().snapshot()[gauge] > 0
+            clock["t"] = 10_000.0  # many half-lives: fully cooled
+            assert mesh.heat.loads() == {}
+            mesh.broadcast_shard_summary()
+            assert get_registry().snapshot()[gauge] == 0.0
+            assert mesh.fleet.shard_heat()["reporters"] == 0
+        finally:
+            mesh.close()
+
+    def test_unsharded_and_router_nodes_have_no_heat(self):
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.config import MeshConfig
+
+        plain = MeshCache(MeshConfig(
+            prefill_nodes=["up0", "up1"], decode_nodes=[], router_nodes=[],
+            local_addr="up0", protocol="inproc",
+        ))
+        router = MeshCache(MeshConfig(
+            prefill_nodes=["up2", "up3"], decode_nodes=[],
+            router_nodes=["ur0"], local_addr="ur0", protocol="inproc",
+            replication_factor=1,
+        ))
+        try:
+            assert plain.heat is None  # rf=0: no shard space to attribute
+            assert router.heat is None  # routers read the map, never write
+        finally:
+            plain.close()
+            router.close()

@@ -319,3 +319,237 @@ class TestLaunchTraceFlags:
         _dump_trace(args, logging.getLogger("t"))
         # No empty junk artifact that reads as "a trace was captured".
         assert not os.path.exists(args.trace_dir)
+
+
+class TestCrossNodeStitching:
+    """PR 9: 64-bit globally-unique trace ids that cross the wire, per-
+    span node attribution, and the merge path that folds many nodes'
+    exports into ONE Perfetto document with one process-track per node
+    and clock-offset correction."""
+
+    def test_new_trace_ids_are_unique_and_nonzero(self):
+        from radixmesh_tpu.obs.trace_plane import new_trace_id
+
+        ids = {new_trace_id() for _ in range(2000)}
+        assert len(ids) == 2000
+        assert all(0 < i < (1 << 64) for i in ids)
+
+    def test_trace_id_adoption_implies_force(self):
+        """A receiver handed an upstream id must keep it (the stitch
+        contract) and must not re-flip the sampling coin — the id's
+        existence IS the upstream decision."""
+        rec = FlightRecorder(capacity=8, sample=1e-9, node="n1")
+        ctx = rec.trace("req:7", trace_id=0xABCDE)
+        assert ctx is not None and ctx.trace_id == 0xABCDE
+        # The off switch still wins (tracing disabled = no spans, ever).
+        assert FlightRecorder(capacity=8, sample=0.0).trace(
+            "req:7", trace_id=0xABCDE
+        ) is None
+
+    def test_spans_carry_node_labels(self):
+        rec = FlightRecorder(capacity=8, sample=1.0, node="default-node")
+        rec.trace("req:1").add("a", 0.0, 0.1)
+        rec.trace("req:2", node="other-node").add("b", 0.0, 0.1)
+        rec.event("lane", "c", 0.0, 0.1)
+        nodes = [s.node for s in rec.snapshot()]
+        assert nodes == ["default-node", "other-node", "default-node"]
+
+    def test_event_with_trace_id_skips_coin_flip(self):
+        rec = FlightRecorder(capacity=64, sample=1e-9)
+        for _ in range(20):
+            rec.event("lane", "lag", 0.0, 0.1, trace_id=0x77)
+        assert len(rec) == 20
+        assert all(s.trace_id == 0x77 for s in rec.snapshot())
+
+    def test_merge_one_pid_per_node_with_clock_offsets(self):
+        """Two exports with different wall offsets (two processes) plus
+        a per-node skew estimate: the merged doc carries one process
+        track per node, process_name metadata, and validates against
+        the trace artifact contract."""
+        from radixmesh_tpu.obs.trace_plane import stitch_traces
+
+        a = FlightRecorder(capacity=8, sample=1.0, node="prefill@0")
+        b = FlightRecorder(capacity=8, sample=1.0, node="decode@1")
+        a.trace("req:1", trace_id=5).add("publish", 1.0, 0.2)
+        b.event("ring:decode@1", "replication_lag", 1.1, 0.1, trace_id=5)
+        ea, eb = a.export_spans(), b.export_spans()
+        eb["wall_offset"] += 3.0  # a second process's clock base
+        doc = stitch_traces([ea, eb], clock_offsets={"decode@1": 3.0})
+        assert bench.validate_trace(doc) == []
+        procs = {
+            ev["args"]["name"]
+            for ev in doc["traceEvents"]
+            if ev["ph"] == "M" and ev["name"] == "process_name"
+        }
+        assert procs == {"prefill@0", "decode@1"}
+        pids = {
+            ev["pid"] for ev in doc["traceEvents"] if ev.get("ph") == "X"
+        }
+        assert len(pids) == 2
+        # Both spans stitch under the SAME trace id.
+        tids = {
+            ev["args"]["trace_id"]
+            for ev in doc["traceEvents"]
+            if ev.get("ph") == "X"
+        }
+        assert len(tids) == 1
+        # The offset correction cancelled decode@1's +3s base: the two
+        # spans sit ~0.1s apart, not ~3s.
+        xs = sorted(
+            ev["ts"] for ev in doc["traceEvents"] if ev.get("ph") == "X"
+        )
+        assert xs[1] - xs[0] < 1e6  # microseconds
+
+    def test_single_inproc_export_groups_by_span_node(self):
+        """In-process multi-node harnesses share ONE recorder: the
+        stitcher must split tracks by each SPAN's node label."""
+        from radixmesh_tpu.obs.trace_plane import stitch_traces
+
+        rec = FlightRecorder(capacity=16, sample=1.0, node="edge")
+        for node in ("edge", "prefill@0", "decode@1"):
+            rec.event("lane", "e", 1.0, 0.1, trace_id=9, node=node)
+        doc = stitch_traces([rec.export_spans()])
+        procs = {
+            ev["args"]["name"]
+            for ev in doc["traceEvents"]
+            if ev["ph"] == "M" and ev["name"] == "process_name"
+        }
+        assert procs == {"edge", "prefill@0", "decode@1"}
+
+
+class TestNoOpGuardNewCallSites:
+    """The PR 2 invariant — sampling off means zero span allocations and
+    zero recorder writes — re-proven at the PR 9 call sites: the oplog
+    receive path (trace trailer handling) and the engine wave paths
+    (step accounting's seam)."""
+
+    def test_oplog_receive_with_trace_trailer_records_nothing_when_off(
+        self, monkeypatch
+    ):
+        import numpy as np
+
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.cache.oplog import Oplog, OplogType, serialize
+        from radixmesh_tpu.config import MeshConfig
+
+        calls = {"record": 0, "event": 0}
+        orig = FlightRecorder._record
+        monkeypatch.setattr(
+            FlightRecorder,
+            "_record",
+            lambda self, span: (calls.__setitem__(
+                "record", calls["record"] + 1
+            ), orig(self, span))[1],
+        )
+        set_recorder(FlightRecorder(capacity=64, sample=0.0))
+        mesh = MeshCache(MeshConfig(
+            prefill_nodes=["p0", "p1"], decode_nodes=[], router_nodes=[],
+            local_addr="p0", protocol="inproc",
+        ))
+        try:
+            frame = serialize(Oplog(
+                op_type=OplogType.INSERT, origin_rank=1, logic_id=1, ttl=2,
+                key=np.arange(1, 5, dtype=np.int32),
+                value=np.arange(4, dtype=np.int32),
+                value_rank=1, ts=time.time(),
+                trace_id=0xBEEF,  # trailer present; receiver must no-op
+            ))
+            mesh.oplog_received(frame)
+            assert mesh.tree.match_prefix(
+                np.arange(1, 5, dtype=np.int32)
+            ).length == 4  # the apply happened
+            assert calls["record"] == 0  # ...with zero recorder writes
+        finally:
+            mesh.close()
+
+    def test_wave_paths_with_accounting_off_touch_no_recorder(
+        self, monkeypatch
+    ):
+        """Default engines (step_accounting off) keep the wave hot paths
+        at one `is not None` branch: a full serve with sampling off
+        makes zero recorder writes and allocates no StepAccounting."""
+        calls = {"record": 0}
+        orig = FlightRecorder._record
+        monkeypatch.setattr(
+            FlightRecorder,
+            "_record",
+            lambda self, span: (calls.__setitem__(
+                "record", calls["record"] + 1
+            ), orig(self, span))[1],
+        )
+        set_recorder(FlightRecorder(capacity=64, sample=0.0))
+        eng = _tiny_engine("waves-off")
+        assert eng.step_acct is None
+        eng.add_request(list(range(1, 16)))
+        for _ in range(200):
+            if not eng.has_work():
+                break
+            eng.step()
+        assert calls["record"] == 0
+
+    def test_mesh_insert_without_trace_id_records_nothing(self):
+        import numpy as np
+
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.config import MeshConfig
+
+        set_recorder(FlightRecorder(capacity=64, sample=1.0))
+        mesh = MeshCache(MeshConfig(
+            prefill_nodes=["p0", "p1"], decode_nodes=[], router_nodes=[],
+            local_addr="p0", protocol="inproc",
+        ))
+        try:
+            mesh.insert(
+                np.arange(1, 5, dtype=np.int32),
+                np.arange(4, dtype=np.int32),
+            )
+            names = {s.name for s in get_recorder().snapshot()}
+            assert "mesh_publish" not in names  # untraced insert: no anchor
+            mesh.insert(
+                np.arange(1, 5, dtype=np.int32),
+                np.arange(4, dtype=np.int32),
+                trace_id=0x123,
+            )
+            spans = [
+                s for s in get_recorder().snapshot()
+                if s.name == "mesh_publish"
+            ]
+            assert spans and spans[0].trace_id == 0x123
+            assert spans[0].node == "prefill@0"
+        finally:
+            mesh.close()
+
+
+class TestStepAccounting:
+    """obs/step_plane.py unit math + the engine seam (leg (c) of the
+    observability tentpole)."""
+
+    def test_note_wave_math(self):
+        from radixmesh_tpu.obs.step_plane import StepAccounting
+
+        acct = StepAccounting("unit", n_params=1_000_000, peak_tflops=1.0)
+        # 500 real of 1000 launched tokens in 1 ms on a 1 TFLOP/s peak:
+        # 2e6 FLOPs/token * 500 / (1e12 * 1e-3) = 1e9/1e9 = 1.0e0... no:
+        # 2*1e6*500 = 1e9 FLOPs over 1e9 peak-FLOP budget -> MFU 1.0.
+        mfu = acct.note_wave("prefill", 500, 1000, 1e-3)
+        assert mfu == pytest.approx(1.0)
+        rep = acct.report()
+        assert rep["prefill"]["waves"] == 1
+        assert rep["prefill"]["pad_fraction"] == pytest.approx(0.5)
+        assert rep["prefill"]["mfu"] == pytest.approx(1.0)
+        assert rep["decode"]["waves"] == 0
+        with pytest.raises(ValueError):
+            acct.note_wave("warp", 1, 1, 1.0)
+
+    def test_engine_reports_prefill_and_decode_waves(self):
+        set_recorder(FlightRecorder(capacity=4096, sample=1.0))
+        eng = _tiny_engine("steps-on", step_accounting=True, peak_tflops=1.0)
+        eng.generate([list(range(1, 14)), list(range(1, 10))])
+        rep = eng.step_acct.report()
+        for kind in ("prefill", "decode"):
+            assert rep[kind]["waves"] > 0, rep
+            assert rep[kind]["mfu"] > 0
+            assert 0.0 <= rep[kind]["pad_fraction"] < 1.0
+        # The step_wave spans landed on the engine's step lane.
+        lanes = {s.lane for s in get_recorder().snapshot()}
+        assert "step:steps-on" in lanes
