@@ -1,0 +1,100 @@
+"""meshcheck: the AST-based static-analysis plane.
+
+Nine PRs grew the cache layer into a heavily threaded serving mesh whose
+safety invariants (send-seam confinement, lifecycle/ownership/heat
+single-writers, bounded waits, oplog-kind exhaustiveness) were enforced
+by ~850 lines of regex greps across three lint test files. A regex
+cannot see a lock acquired through a helper call, a write reached
+through an alias, or a blocking call two frames down a hot path — this
+package replaces the greps with real ``ast`` analysis:
+
+- :mod:`core` — the pluggable framework: :class:`SourceIndex` (one AST
+  parse per product file), the :class:`Checker` protocol, per-finding
+  ``file:line`` + invariant-id reporting, and the justification-comment
+  suppression grammar (``# meshcheck: ok[<invariant-id>] <why>``).
+- :mod:`lock_order` — lock-acquisition graph per module (``with``
+  nesting, including through one level of intra-module helper calls);
+  fails on cycles and on re-entry into a non-reentrant lock.
+- :mod:`single_writer` — assignment/call-site analysis for the
+  lifecycle-state, shard-ownership, and shard-heat single-writer
+  contracts (catches aliased writes and ``setattr``), plus the
+  mesh send-seam confinement rule.
+- :mod:`hot_path` — intra-package call graph from the serving entry
+  points; flags reachable no-timeout ``wait()/join()/get()``,
+  ``time.sleep``, and device-sync calls, and carries the tree-wide
+  timeout/sleep audits.
+- :mod:`wire_kinds` — every oplog kind in ``EXTENSION_KINDS`` /
+  ``DATA_KINDS`` has an encode site, a receive branch, and a
+  registration, verified structurally.
+- :mod:`metrics_vocab` — the ``radixmesh_`` prefix + unit-suffix
+  vocabulary, checked at ``counter()/gauge()/histogram()`` call sites.
+
+Run it: ``python scripts/meshcheck.py`` (CI: the whole plane is one
+quick-gate test, ``tests/test_analysis.py::test_tree_is_clean``).
+"""
+
+from __future__ import annotations
+
+from .core import (
+    AnalysisResult,
+    Checker,
+    Finding,
+    SourceIndex,
+    Suppression,
+    package_root,
+    run_checkers,
+)
+from .hot_path import HotPathChecker
+from .lock_order import LockOrderChecker
+from .metrics_vocab import MetricsVocabChecker
+from .single_writer import SingleWriterChecker
+from .wire_kinds import WireKindsChecker
+
+__all__ = [
+    "AnalysisResult",
+    "Checker",
+    "Finding",
+    "SourceIndex",
+    "Suppression",
+    "package_root",
+    "run_checkers",
+    "all_checkers",
+    "LockOrderChecker",
+    "SingleWriterChecker",
+    "HotPathChecker",
+    "WireKindsChecker",
+    "MetricsVocabChecker",
+]
+
+
+def all_checkers() -> list:
+    """One fresh instance of every registered checker, default config —
+    the set ``scripts/meshcheck.py`` and the quick gate run."""
+    return [
+        LockOrderChecker(),
+        SingleWriterChecker(),
+        HotPathChecker(),
+        WireKindsChecker(),
+        MetricsVocabChecker(),
+    ]
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def tree_index() -> SourceIndex:
+    """The installed package parsed once, cached for the process."""
+    return SourceIndex(package_root())
+
+
+@_functools.lru_cache(maxsize=1)
+def check_tree() -> AnalysisResult:
+    """The full default plane over the installed package, cached for
+    the process — the quick-gate lint tests (test_mesh_lint /
+    test_hotpath_lint / test_metrics_lint / test_analysis) all read
+    slices of ONE run instead of re-parsing the tree per file. Source
+    is assumed immutable within a process (true for tests and the
+    CLI); call ``check_tree.cache_clear()`` / ``tree_index.cache_clear()``
+    after editing files."""
+    return run_checkers(tree_index(), all_checkers())

@@ -1,0 +1,502 @@
+"""Hot-path blocking audit: call graph from the serving entry points.
+
+Builds an intra-package call graph (name-shaped resolution: same-module
+functions, ``self.`` methods, constructor-typed ``self.x`` / local
+attributes, imported symbols) rooted at the serving entry points —
+``Engine.step`` / ``Engine.enqueue`` (admission), ``match_prefix``,
+``OverloadController.enqueue``, the disagg submit/step path, and the
+oplog receive path — then flags what a grep scoped to one file can
+never see: a blocking call two frames down.
+
+Invariants:
+
+- ``hotpath-blocking`` — a function REACHABLE from a serving entry
+  point contains a no-timeout ``wait()/join()/get()``, a
+  ``time.sleep``, or a device-sync call
+  (``block_until_ready``/``jax.device_get``). The finding message
+  carries the call chain from the entry point.
+- ``timeout-audit`` — tree-wide: a blocking ``wait()/join()/get()``
+  with NO timeout/deadline argument anywhere in product code parks a
+  thread a dead peer can wedge forever (the PR 7 audit, AST-checked).
+  The few intentionally unbounded seams carry in-source
+  ``# meshcheck: ok[timeout-audit] <why>`` justifications.
+- ``sleep-audit`` — tree-wide: every ``time.sleep`` product call site
+  is either on a cold path with an in-source justification or a bug;
+  hot ones surface as ``hotpath-blocking`` instead.
+- ``hotpath-sync`` — the PR 4 staging boundary, scoped exactly as the
+  old grep lint was: the engine scheduler, the hierarchical cache's
+  match path, and the disagg admit path must not host-materialize KV
+  (``np.asarray(pool.gather...)``, ``gather_padded``, inline
+  ``host.read``) or force a device sync; ``cache/kv_transfer.py`` is
+  the ONE module allowed to block on device→host data.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Checker, Finding, SourceIndex, dotted_name, iter_functions
+
+__all__ = ["HotPathChecker", "DEFAULT_ENTRY_POINTS"]
+
+# (module, qualname) serving entry points. Missing ones are skipped so
+# the checker runs unmodified over positive-control fixture trees that
+# mimic only one corner of the package.
+DEFAULT_ENTRY_POINTS: tuple[tuple[str, str], ...] = (
+    ("engine/engine.py", "Engine.step"),
+    ("engine/engine.py", "Engine.enqueue"),
+    ("cache/mesh_cache.py", "MeshCache.match_prefix"),
+    ("cache/mesh_cache.py", "MeshCache.oplog_received"),
+    ("slo/control.py", "OverloadController.enqueue"),
+    ("engine/disagg.py", "DecodeWorker.submit"),
+    ("engine/disagg.py", "DecodeWorker.step"),
+)
+
+# The designated sync owner (PR 4): allowed to block on device→host.
+_SYNC_OWNER = "cache/kv_transfer.py"
+
+# The old test_hotpath_lint scopes: (functions-or-whole-module, banned
+# construct families). ``host_read`` is banned in the engine scheduler
+# but NOT in the hierarchical cache's match path — ``match_and_load``'s
+# arena read is the documented synchronous fallback; the fused sweep
+# gather lives in the flush/plane seam.
+_SYNC_SCOPES: dict[str, tuple[tuple[str, ...] | None, tuple[str, ...]]] = {
+    # None = the whole module.
+    "engine/engine.py": (
+        None, ("device_sync", "gather", "host_read"),
+    ),
+    "cache/host_cache.py": (
+        (
+            "HierarchicalCache.match_and_load",
+            "HierarchicalCache._writeback",
+            "HierarchicalCache._evict_host",
+        ),
+        ("device_sync", "gather"),
+    ),
+    "engine/disagg.py": (
+        ("DecodeWorker._admit_one",),
+        ("device_sync", "any_asarray"),
+    ),
+}
+
+_BLOCKING_ATTRS = ("wait", "join", "get")
+
+
+def _module_sleep_names(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(bare names bound to time.sleep via ``from time import sleep``
+    [as x], module aliases of ``time`` via ``import time as x``) — the
+    import styles that would otherwise evade a dotted-name match."""
+    bare: set[str] = set()
+    mods: set[str] = {"time", "_time"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    bare.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    mods.add(alias.asname or alias.name)
+    return bare, mods
+
+
+def _is_time_sleep(call: ast.Call, sleep_names=(), time_mods=("time", "_time")) -> bool:
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if len(parts) == 1:
+        return parts[0] in sleep_names
+    return len(parts) == 2 and parts[1] == "sleep" and parts[0] in time_mods
+
+
+def _is_unbounded_blocking(call: ast.Call) -> str | None:
+    """``x.wait()`` / ``x.join()`` / ``x.get()`` with NO argument at all
+    (a timeout positional or keyword makes those bounded) — plus the
+    ``get`` forms whose argument is the BLOCK flag, not a timeout:
+    ``q.get(True)`` / ``q.get(block=True)`` park forever."""
+    if not (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _BLOCKING_ATTRS
+    ):
+        return None
+    attr = call.func.attr
+    if not call.args and not call.keywords:
+        return attr
+    if attr == "get":
+        kw = {k.arg: k.value for k in call.keywords}
+        if "timeout" in kw or len(call.args) >= 2:
+            return None
+        block_true = (
+            call.args
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value is True
+        ) or (
+            isinstance(kw.get("block"), ast.Constant)
+            and kw["block"].value is True
+        )
+        if block_true:
+            return "get"
+    return None
+
+
+def _is_device_sync(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "block_until_ready":
+        return "block_until_ready"
+    name = dotted_name(call.func)
+    if name in ("jax.device_get",):
+        return "jax.device_get"
+    return None
+
+
+def _banned_construct(call: ast.Call, families: tuple[str, ...]) -> str | None:
+    """The PR 4 constructs, by family: ``device_sync``
+    (block_until_ready / jax.device_get), ``gather`` (np.asarray over a
+    pool gather, the fused gather helper), ``host_read`` (inline
+    host-arena read), ``any_asarray`` (the disagg admit path bans every
+    host materialization)."""
+    name = dotted_name(call.func)
+    if "device_sync" in families:
+        why = _is_device_sync(call)
+        if why is not None:
+            return why
+    if "gather" in families:
+        if name in ("gather_padded",) or (name or "").endswith(".gather_padded"):
+            return "gather_padded"
+        if name in ("np.asarray", "numpy.asarray") and call.args:
+            inner = call.args[0]
+            if isinstance(inner, ast.Call):
+                inner_name = dotted_name(inner.func) or ""
+                if inner_name.split(".")[-1] == "gather" and (
+                    "pool" in inner_name.split(".")
+                ):
+                    return "np.asarray(pool.gather...)"
+    if "host_read" in families:
+        if name is not None and name.split(".")[-1] == "read" and (
+            "host" in name.split(".")
+        ):
+            return "host.read"
+    if "any_asarray" in families:
+        if name in ("np.asarray", "numpy.asarray"):
+            return "np.asarray"
+    return None
+
+
+@dataclass(frozen=True)
+class _Func:
+    rel: str
+    qual: str  # "Class.method" or "func"
+    cls: str | None
+    node: ast.AST
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.rel, self.qual)
+
+
+class HotPathChecker:
+    id = "hot-path"
+    description = (
+        "no blocking call (unbounded wait/join/get, time.sleep, device "
+        "sync) reachable from a serving entry point; tree-wide "
+        "timeout/sleep audits; the PR 4 staging boundary"
+    )
+
+    def __init__(self, entry_points=DEFAULT_ENTRY_POINTS):
+        self.entry_points = tuple(entry_points)
+
+    # ------------------------------------------------------------------
+
+    def check(self, index: SourceIndex) -> list[Finding]:
+        # The class table is derived from THIS index — drop any memo a
+        # previous check() left so a reused instance never resolves
+        # classes against a stale tree.
+        self._class_cache = None
+        funcs, imports, attr_types = self._build_symbols(index)
+        edges = self._build_edges(index, funcs, imports, attr_types)
+        reachable, chains = self._reach(edges, funcs)
+        findings: list[Finding] = []
+        self._scan_blocking(index, funcs, reachable, chains, findings)
+        self._scan_sync_scopes(index, funcs, findings)
+        return findings
+
+    # ------------------------------------------------------------------
+    # symbol tables
+    # ------------------------------------------------------------------
+
+    def _build_symbols(self, index: SourceIndex):
+        funcs: dict[tuple[str, str], _Func] = {}
+        classes: dict[str, dict[str, str]] = {}  # class name -> {rel}
+        for mod in index.iter_modules():
+            if mod.tree is None:
+                continue
+            for qual, cls, fn in iter_functions(mod.tree):
+                funcs[(mod.rel, qual)] = _Func(mod.rel, qual, cls, fn)
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    classes.setdefault(node.name, {})[mod.rel] = node.name
+        # ONE construction site for the class table: prime the memo the
+        # edge-builder's resolver reads (check() reset it for this run).
+        self._class_cache = classes
+
+        # Per-module import map: name -> module rel it came from.
+        imports: dict[str, dict[str, str]] = {}
+        for mod in index.iter_modules():
+            if mod.tree is None:
+                continue
+            imap: dict[str, str] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ImportFrom):
+                    target = self._resolve_import(mod.rel, node, index)
+                    if target is None:
+                        continue
+                    for alias in node.names:
+                        imap[alias.asname or alias.name] = target
+            imports[mod.rel] = imap
+
+        # Constructor-typed self attributes: self.x = ClassName(...) in
+        # any method -> (class scope) x: rel-of-ClassName + ClassName.
+        attr_types: dict[tuple[str, str], dict[str, tuple[str, str]]] = {}
+        for mod in index.iter_modules():
+            if mod.tree is None:
+                continue
+            for qual, cls, fn in iter_functions(mod.tree):
+                if cls is None:
+                    continue
+                for node in ast.walk(fn):
+                    if not (
+                        isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Name)
+                    ):
+                        continue
+                    cname = node.value.func.id
+                    crel = self._class_rel(cname, mod.rel, imports, classes, index)
+                    if crel is None:
+                        continue
+                    for t in node.targets:
+                        name = dotted_name(t)
+                        if name and name.startswith("self.") and name.count(".") == 1:
+                            attr_types.setdefault((mod.rel, cls), {})[
+                                name.split(".", 1)[1]
+                            ] = (crel, cname)
+        return funcs, imports, attr_types
+
+    def _resolve_import(self, rel: str, node: ast.ImportFrom, index) -> str | None:
+        """Map an ImportFrom to a package-relative module path, or None
+        for out-of-package imports."""
+        if node.level == 0:
+            mod = node.module or ""
+            if not mod.startswith("radixmesh_tpu"):
+                return None
+            parts = mod.split(".")[1:]
+        else:
+            base = rel.split("/")[:-1]
+            up = node.level - 1
+            parts = (base[: len(base) - up] if up else base) + (
+                node.module.split(".") if node.module else []
+            )
+        cand = "/".join(parts) + ".py"
+        if cand in index:
+            return cand
+        pkg = "/".join(parts) + "/__init__.py"
+        if pkg in index:
+            return pkg
+        return None
+
+    def _class_rel(self, cname, rel, imports, classes, index) -> str | None:
+        rels = classes.get(cname)
+        if not rels:
+            return None
+        if rel in rels:
+            return rel
+        imported_from = imports.get(rel, {}).get(cname)
+        if imported_from in rels:
+            return imported_from
+        if len(rels) == 1:
+            return next(iter(rels))
+        return None
+
+    # ------------------------------------------------------------------
+    # call graph
+    # ------------------------------------------------------------------
+
+    def _build_edges(self, index, funcs, imports, attr_types):
+        edges: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        for (rel, qual), f in funcs.items():
+            out: set[tuple[str, str]] = set()
+            local_types: dict[str, tuple[str, str]] = {}
+            for node in ast.walk(f.node):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    # t = Thing(...) -> t.m() resolves one level.
+                    if isinstance(node.value.func, ast.Name):
+                        cname = node.value.func.id
+                        crel = self._class_rel_cached(
+                            cname, rel, imports, index
+                        )
+                        if crel is not None:
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    local_types[t.id] = (crel, cname)
+                if not isinstance(node, ast.Call):
+                    continue
+                for target in self._call_targets(
+                    node, f, funcs, imports, attr_types, local_types, index
+                ):
+                    out.add(target)
+            edges[(rel, qual)] = out
+        return edges
+
+    _class_cache: dict | None = None
+
+    def _class_rel_cached(self, cname, rel, imports, index):
+        # Primed by _build_symbols for this run's index.
+        assert self._class_cache is not None
+        return self._class_rel(cname, rel, imports, self._class_cache, index)
+
+    def _call_targets(
+        self, call, f, funcs, imports, attr_types, local_types, index,
+    ):
+        name = dotted_name(call.func)
+        if name is None:
+            return
+        rel = f.rel
+        parts = name.split(".")
+        if len(parts) == 1:
+            # bare g() — same module, else an imported function.
+            if (rel, parts[0]) in funcs:
+                yield (rel, parts[0])
+            else:
+                src = imports.get(rel, {}).get(parts[0])
+                if src and (src, parts[0]) in funcs:
+                    yield (src, parts[0])
+                # Constructor call: edge into __init__.
+                crel = self._class_rel_cached(parts[0], rel, imports, index)
+                if crel and (crel, f"{parts[0]}.__init__") in funcs:
+                    yield (crel, f"{parts[0]}.__init__")
+        elif parts[0] == "self" and f.cls is not None:
+            if len(parts) == 2:
+                if (rel, f"{f.cls}.{parts[1]}") in funcs:
+                    yield (rel, f"{f.cls}.{parts[1]}")
+            elif len(parts) == 3:
+                typed = attr_types.get((rel, f.cls), {}).get(parts[1])
+                if typed:
+                    trel, tcls = typed
+                    if (trel, f"{tcls}.{parts[2]}") in funcs:
+                        yield (trel, f"{tcls}.{parts[2]}")
+        elif len(parts) == 2:
+            # mod_alias.f() via `from radixmesh_tpu.x import y` is rare;
+            # local constructor-typed var.m().
+            typed = local_types.get(parts[0])
+            if typed:
+                trel, tcls = typed
+                if (trel, f"{tcls}.{parts[1]}") in funcs:
+                    yield (trel, f"{tcls}.{parts[1]}")
+
+    def _reach(self, edges, funcs):
+        chains: dict[tuple[str, str], tuple[str, ...]] = {}
+        frontier: list[tuple[str, str]] = []
+        for ep in self.entry_points:
+            if ep in funcs:
+                chains[ep] = (f"{ep[0]}:{ep[1]}",)
+                frontier.append(ep)
+        while frontier:
+            cur = frontier.pop()
+            for nxt in edges.get(cur, ()):
+                if nxt in chains:
+                    continue
+                chains[nxt] = chains[cur] + (f"{nxt[0]}:{nxt[1]}",)
+                frontier.append(nxt)
+        return set(chains), chains
+
+    # ------------------------------------------------------------------
+    # scans
+    # ------------------------------------------------------------------
+
+    def _scan_blocking(self, index, funcs, reachable, chains, findings):
+        sleep_names: dict[str, tuple[set[str], set[str]]] = {
+            mod.rel: _module_sleep_names(mod.tree)
+            for mod in index.iter_modules() if mod.tree is not None
+        }
+        for (rel, qual), f in funcs.items():
+            if rel.startswith("analysis/"):
+                continue
+            hot = (rel, qual) in reachable
+            bare, mods = sleep_names[rel]
+            for node in ast.walk(f.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = None
+                if _is_time_sleep(node, bare, mods):
+                    label, inv = "time.sleep", "sleep-audit"
+                else:
+                    b = _is_unbounded_blocking(node)
+                    if b is not None:
+                        label, inv = f".{b}() without a timeout", "timeout-audit"
+                    else:
+                        d = _is_device_sync(node)
+                        if d is not None and hot:
+                            label, inv = d, "hotpath-blocking"
+                if label is None:
+                    continue
+                if hot:
+                    chain = " -> ".join(chains[(rel, qual)])
+                    findings.append(Finding(
+                        rel, node.lineno, "hotpath-blocking",
+                        f"{label} on a serving hot path (reached via "
+                        f"{chain})",
+                    ))
+                else:
+                    findings.append(Finding(
+                        rel, node.lineno, inv,
+                        f"{label} — a dead peer (or a cold loop) parks "
+                        "this thread unboundedly; pass a deadline or "
+                        "justify in-source"
+                        if inv == "timeout-audit"
+                        else f"{label} off the hot path — convert to a "
+                        "condition/deadline wait or justify in-source",
+                    ))
+
+        # Module-level statements (rare, but a sleep at import time is
+        # still a sleep).
+        for mod in index.iter_modules():
+            if mod.tree is None or mod.rel.startswith("analysis/"):
+                continue
+            bare, mods = sleep_names[mod.rel]
+            for stmt in mod.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call) and _is_time_sleep(node, bare, mods):
+                        findings.append(Finding(
+                            mod.rel, node.lineno, "sleep-audit",
+                            "time.sleep at module scope",
+                        ))
+
+    def _scan_sync_scopes(self, index, funcs, findings):
+        for rel, (scope, families) in _SYNC_SCOPES.items():
+            if rel not in index:
+                continue
+            mod = index.module(rel)
+            if mod.tree is None:
+                continue
+            if scope is None:
+                nodes = [mod.tree]
+            else:
+                nodes = [
+                    f.node for (r, q), f in funcs.items()
+                    if r == rel and q in scope
+                ]
+            for root in nodes:
+                for node in ast.walk(root):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    why = _banned_construct(node, families)
+                    if why is not None:
+                        findings.append(Finding(
+                            rel, node.lineno, "hotpath-sync",
+                            f"{why} — blocking KV materialization "
+                            f"outside the staging module ({_SYNC_OWNER} "
+                            "is the one sync owner)",
+                        ))

@@ -1,0 +1,132 @@
+"""Metric vocabulary, checked at registration call sites.
+
+Every family the serving stack registers must be ``radixmesh_``-prefixed
+(one grep finds the fleet's series; no collision with other exporters on
+a shared scrape) and unit-suffixed so dashboards never guess units. The
+runtime lint (``tests/test_metrics_lint.py``) walks what actually landed
+in the registry; this checker reads the same rules off the AST at every
+``counter()/gauge()/histogram()`` call site, so a family registered only
+on a code path no lint test constructs is still checked.
+
+Invariants:
+
+- ``metrics-prefix`` — family name missing the ``radixmesh_`` prefix.
+- ``metrics-unit`` — counter without ``_total``; histogram without a
+  base unit (``_seconds``/``_bytes``/``_tokens``); gauge without a
+  declared unit from :data:`GAUGE_SUFFIXES` (a new suffix is a
+  conscious vocabulary decision made HERE, not a typo that slips
+  through).
+- ``metrics-literal`` — the family name is not a string literal; a
+  computed name can't be vocabulary-checked statically and breaks the
+  one-grep-finds-everything property.
+
+The suffix vocabulary lives here as the single source of truth; the
+runtime lint imports it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Finding, SourceIndex
+
+__all__ = ["MetricsVocabChecker", "UNIT_SUFFIXES", "GAUGE_SUFFIXES", "PREFIX"]
+
+PREFIX = "radixmesh_"
+
+# Base units (counters are ``_total``; histograms observe seconds/bytes/
+# tokens). Gauges may additionally be counts of a named thing or one of
+# the declared dimensionless states.
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_tokens")
+GAUGE_SUFFIXES = UNIT_SUFFIXES + (
+    "_requests", "_slots", "_nodes", "_rows",
+    "_epoch", "_rank", "_flag", "_tier", "_tokens_per_second",
+    "_state",  # lifecycle state code (policy/lifecycle.py)
+    "_shards",  # owned-shard count (cache/sharding.py)
+    "_bytes_per_insert",  # per-insert wire-cost EWMA (cache/sharding.py)
+    "_ratio",  # dimensionless max/mean skew (PR 9 heat map)
+    "_mfu",  # model-FLOPs-utilization estimate (obs/step_plane.py)
+    "_fraction",  # 0..1 share, e.g. wave padding (obs/step_plane.py)
+)
+
+_KINDS = ("counter", "gauge", "histogram")
+
+# The metrics framework itself (defines the factories) is exempt.
+_FRAMEWORK = "obs/metrics.py"
+
+
+class MetricsVocabChecker:
+    id = "metrics-vocab"
+    description = (
+        "metric families are radixmesh_-prefixed and unit-suffixed, "
+        "checked statically at every counter()/gauge()/histogram() "
+        "registration call site"
+    )
+
+    def check(self, index: SourceIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in index.iter_modules():
+            if (
+                mod.tree is None
+                or mod.rel == _FRAMEWORK
+                or mod.rel.startswith("analysis/")
+            ):
+                continue
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _KINDS
+                ):
+                    continue
+                kind = node.func.attr
+                # The family name is the first positional or the
+                # ``name=`` keyword — a keyword-form registration must
+                # not silently bypass the vocabulary.
+                if node.args:
+                    name_arg = node.args[0]
+                else:
+                    name_arg = next(
+                        (k.value for k in node.keywords if k.arg == "name"),
+                        None,
+                    )
+                    if name_arg is None:
+                        continue  # no name argument: not a registration
+                if not (
+                    isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)
+                ):
+                    findings.append(Finding(
+                        mod.rel, node.lineno, "metrics-literal",
+                        f"{kind}() family name is not a string literal — "
+                        "computed names defeat static vocabulary checks "
+                        "and fleet-wide grep",
+                    ))
+                    continue
+                name = name_arg.value
+                if not name.startswith(PREFIX):
+                    findings.append(Finding(
+                        mod.rel, node.lineno, "metrics-prefix",
+                        f"{name!r}: missing the {PREFIX!r} prefix",
+                    ))
+                    continue
+                if kind == "counter" and not name.endswith("_total"):
+                    findings.append(Finding(
+                        mod.rel, node.lineno, "metrics-unit",
+                        f"{name!r}: counter without _total",
+                    ))
+                elif kind == "histogram" and not name.endswith(
+                    ("_seconds", "_bytes", "_tokens")
+                ):
+                    findings.append(Finding(
+                        mod.rel, node.lineno, "metrics-unit",
+                        f"{name!r}: histogram without a base unit suffix",
+                    ))
+                elif kind == "gauge" and not name.endswith(GAUGE_SUFFIXES):
+                    findings.append(Finding(
+                        mod.rel, node.lineno, "metrics-unit",
+                        f"{name!r}: gauge without a declared unit (extend "
+                        "GAUGE_SUFFIXES in analysis/metrics_vocab.py if "
+                        "this is a conscious vocabulary addition)",
+                    ))
+        return findings

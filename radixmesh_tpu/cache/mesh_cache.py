@@ -671,7 +671,9 @@ class MeshCache:
                     return True
             if deadline is not None and time.monotonic() > deadline:
                 return False
-            time.sleep(0.01)
+            # Deadline-bounded wait on the stop event, not a bare sleep:
+            # close() interrupts the poll instead of waiting it out.
+            self._stop.wait(0.01)
         return False
 
     def close(self, graceful: bool = False) -> None:
@@ -1707,7 +1709,11 @@ class MeshCache:
                 and self._owner_q.empty()
             ):
                 return True
-            time.sleep(0.01)
+            # Bounded wait on the stop event: once sender threads are
+            # told to exit the queues will never drain, so give up
+            # immediately instead of spinning out the deadline.
+            if self._stop.wait(0.01):
+                return False
         return False
 
     def _announce_view(self, view: TopologyView) -> None:

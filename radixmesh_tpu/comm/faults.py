@@ -307,6 +307,9 @@ class _Scheduler:
         while True:
             with self._cond:
                 while not self._heap:
+                    # meshcheck: ok[timeout-audit] chaos-scheduler
+                    # condition, notified on every submit; exists only
+                    # under an armed fault plan, never on a serving path.
                     self._cond.wait()
                 due, _, fn = self._heap[0]
                 wait = due - time.monotonic()
@@ -449,6 +452,8 @@ class FaultyCommunicator(Communicator):
                     else "partition_blocked"
                 )
                 return False
+            # meshcheck: ok[sleep-audit] partition-blocked backoff inside
+            # the fault injector's bounded deadline loop (chaos only).
             time.sleep(0.002)
         if self._should_drop(self._rel()):
             return True  # silent loss: the sender believes it delivered

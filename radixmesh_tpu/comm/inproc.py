@@ -64,6 +64,9 @@ class InprocHub:
 
     def _run(self) -> None:
         while True:
+            # meshcheck: ok[timeout-audit] the hub's delivery pump blocks
+            # on its OWN queue and is woken by a None shutdown sentinel —
+            # no peer is involved, so there is nothing to deadline.
             item = self._q.get()
             if item is None:
                 return
@@ -110,6 +113,8 @@ class InprocCommunicator(Communicator):
                 return True
             if _time.monotonic() >= deadline:
                 return False
+            # meshcheck: ok[sleep-audit] bounded listener-appearance poll
+            # inside a deadline loop; the hub has no registration event.
             _time.sleep(0.005)
         raise RuntimeError("communicator closed")
 

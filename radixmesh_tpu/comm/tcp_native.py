@@ -159,6 +159,8 @@ class NativeTcpCommunicator(Communicator):
                 raise RuntimeError("communicator closed")
             if _time.monotonic() >= deadline:
                 return False
+            # meshcheck: ok[sleep-audit] bounded connect poll against the
+            # native library's connected flag (no readiness callback).
             _time.sleep(0.01)
         return True
 

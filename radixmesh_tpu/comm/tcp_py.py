@@ -117,6 +117,8 @@ class PyTcpCommunicator(Communicator):
                 s.settimeout(None)
                 return s
             except OSError:
+                # meshcheck: ok[sleep-audit] reconnect backoff between
+                # bounded create_connection attempts (peer not up yet).
                 time.sleep(0.1)
         raise RuntimeError("communicator closed while connecting")
 
@@ -155,6 +157,8 @@ class PyTcpCommunicator(Communicator):
                     if self._send_sock is not None:
                         self._send_sock.close()
                         self._send_sock = None
+                    # meshcheck: ok[sleep-audit] reconnect backoff after a
+                    # send failure; the outer loop is deadline-bounded.
                     time.sleep(0.05)
             if deadline is None:
                 raise RuntimeError("communicator closed while sending")

@@ -68,6 +68,10 @@ by ``tests/test_ops.py`` in interpreter mode and on real TPU by bench.py):
   causal block.
 """
 
+# meshcheck: file-ok[timeout-audit] every wait() in this file is a
+# pallas device-semaphore / copy-descriptor wait — a kernel DSL op
+# completing an async device DMA, not a thread parking on a peer.
+
 from __future__ import annotations
 
 import functools

@@ -84,6 +84,9 @@ class EngineRunner:
                 with self._lock:
                     if not self.engine.has_work():
                         break
+                # meshcheck: ok[sleep-audit] deadline-bounded drain poll;
+                # completion is engine.has_work() under the runner lock —
+                # no condition crosses the engine seam.
                 time.sleep(0.02)
         with self._lock:
             self._closed = True  # reject submits racing the sweep
@@ -149,6 +152,8 @@ class EngineRunner:
             with self._lock:
                 if not self.engine.has_work():
                     return True
+            # meshcheck: ok[sleep-audit] deadline-bounded drain poll
+            # (same seam as above: has_work() is the only signal).
             time.sleep(poll_s)
         with self._lock:
             n = self.engine.cancel_all()
@@ -547,6 +552,8 @@ class ServingFrontend:
                     f"{frontend._profile_seq:04d}",
                 )
                 with _profile(logdir):
+                    # meshcheck: ok[sleep-audit] the sleep IS the
+                    # requested jax.profiler capture window.
                     time.sleep(seconds)
             except Exception as e:  # noqa: BLE001 — report, don't kill the handler
                 return 500, {"error": str(e)}

@@ -17,6 +17,10 @@ Usage::
     report["hit_rate"], report["p50_ttft_s"]
 """
 
+# meshcheck: file-ok[sleep-audit] workload generators and scenario
+# drivers pace traffic, settle gossip, and hold chaos windows by wall
+# clock BY DESIGN — nothing here runs on a serving thread.
+
 from __future__ import annotations
 
 from dataclasses import dataclass, field

@@ -1,0 +1,147 @@
+"""meshcheck CLI: run the AST-based static-analysis plane.
+
+Runs every checker (``radixmesh_tpu/analysis/``) over the product
+package, runs the positive-control fixtures, prints findings as
+``file:line: [invariant-id] message``, and optionally writes the
+round's schema-pinned ``ANALYSIS_r{N}.json`` artifact (validated
+against ``bench.validate_analysis`` before writing — a violation is
+recorded in the artifact, not silently shipped).
+
+Exit status: 0 = tree clean AND all positive controls tripped;
+1 = findings (or a blind checker); 2 = could not run.
+
+Usage::
+
+    python scripts/meshcheck.py                # check, print, exit code
+    python scripts/meshcheck.py --json         # full report on stdout
+    python scripts/meshcheck.py --write-artifact            # ANALYSIS_r{N}.json
+    python scripts/meshcheck.py --write-artifact --out X.json
+    python scripts/meshcheck.py --no-fixtures  # skip positive controls
+
+The quick CI gate runs the same plane in-process as ONE test:
+``tests/test_analysis.py::test_tree_is_clean``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + validator live with the other validators)
+from radixmesh_tpu.analysis import all_checkers  # noqa: E402
+from radixmesh_tpu.analysis.controls import run_positive_controls  # noqa: E402
+from radixmesh_tpu.analysis.core import (  # noqa: E402
+    SourceIndex,
+    package_root,
+    run_checkers,
+)
+
+
+def analysis_round() -> int:
+    """The round in progress = 1 + the highest N across every OTHER
+    plane's recorded ``*_r{N}.json`` artifact (ANALYSIS rides whatever
+    round they are on — e.g. OBS_r09 makes this round 10). ANALYSIS'
+    own artifacts are excluded so a rerun overwrites the current
+    round's file instead of self-incrementing."""
+    rounds = [0]
+    for name in os.listdir(_REPO_ROOT):
+        m = re.fullmatch(r"[A-Z_]+_r(\d+)\.json", name)
+        if m and not name.startswith("ANALYSIS_"):
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--root", default=None,
+        help="package directory to analyze (default: the installed "
+        "radixmesh_tpu package)",
+    )
+    ap.add_argument(
+        "--fixtures", default=None,
+        help="positive-control fixtures root (default: "
+        "tests/fixtures/analysis)",
+    )
+    ap.add_argument(
+        "--no-fixtures", action="store_true",
+        help="skip the positive-control pass (a clean verdict then "
+        "proves less; the artifact writer refuses this mode)",
+    )
+    ap.add_argument("--json", action="store_true", help="print the full report")
+    ap.add_argument(
+        "--write-artifact", action="store_true",
+        help="write the round's ANALYSIS_r{N}.json to the repo root",
+    )
+    ap.add_argument("--out", default=None, help="artifact path override")
+    args = ap.parse_args()
+
+    root = args.root or package_root()
+    index = SourceIndex(root)
+    result = run_checkers(index, all_checkers())
+
+    controls = []
+    if not args.no_fixtures:
+        controls = run_positive_controls(args.fixtures)
+        if not controls:
+            print(
+                "meshcheck: no positive-control fixtures found "
+                "(tests/fixtures/analysis) — a clean tree proves nothing",
+                file=sys.stderr,
+            )
+            return 2
+
+    report = bench.build_analysis_report(result, controls, len(index.modules))
+    blind = [c for c in controls if not c.tripped]
+
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        for f in result.findings:
+            print(f)
+        for c in blind:
+            print(
+                f"POSITIVE CONTROL MISSED: {c.fixture} {c.invariant} at "
+                f"{c.file}:{c.line}"
+            )
+        print(
+            f"meshcheck: {len(index.modules)} files, "
+            f"{len(result.findings)} finding(s), "
+            f"{len(result.suppressed)} suppressed by "
+            f"{len(result.suppressions)} justification(s), "
+            f"{sum(c.tripped for c in controls)}/{len(controls)} "
+            "controls tripped"
+        )
+
+    if args.write_artifact:
+        if args.no_fixtures:
+            print(
+                "meshcheck: refusing --write-artifact with --no-fixtures "
+                "(the schema gates on positive controls)",
+                file=sys.stderr,
+            )
+            return 2
+        problems = bench.validate_analysis(report)
+        if problems:
+            report["schema_violation"] = problems
+            print(f"meshcheck: SCHEMA VIOLATION {problems}", file=sys.stderr)
+        path = args.out or os.path.join(
+            _REPO_ROOT, f"ANALYSIS_r{analysis_round():02d}.json"
+        )
+        with open(path, "w") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+        print(f"meshcheck: wrote {os.path.basename(path)}")
+
+    return 0 if (not result.findings and not blind) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

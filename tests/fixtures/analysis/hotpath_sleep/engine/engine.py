@@ -1,0 +1,16 @@
+"""Seeded hot-path blocking: the sleep is two frames down from
+``Engine.step`` — the call graph sees it, no module-scoped grep would
+(``_drain_slow`` lives behind an innocent-looking helper)."""
+
+import time
+
+
+class Engine:
+    def step(self):
+        self._admit()
+
+    def _admit(self):
+        self._drain_slow()
+
+    def _drain_slow(self):
+        time.sleep(0.25)  # seeded: hotpath-blocking

@@ -1,0 +1,28 @@
+"""Seeded lock-order deadlock: A→B directly, B→A through a helper.
+
+The B→A edge is invisible to any grep — ``report`` never mentions
+``_a`` — but the acquisition graph sees ``_flush`` acquire it while
+``report`` holds ``_b``.
+"""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.stats = {}
+
+    def step(self):
+        with self._a:
+            with self._b:  # seeded: lock-order-cycle
+                self.stats["steps"] = self.stats.get("steps", 0) + 1
+
+    def report(self):
+        with self._b:
+            return self._flush()
+
+    def _flush(self):
+        with self._a:
+            return dict(self.stats)

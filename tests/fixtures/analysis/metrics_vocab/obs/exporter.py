@@ -1,0 +1,9 @@
+"""Seeded metric-vocabulary breaches: an unprefixed family, a counter
+without ``_total``, and a computed family name."""
+
+
+def register(reg, name_suffix):
+    hits = reg.counter("cache_hits_total", "prefix hits")  # seeded: metrics-prefix
+    evictions = reg.counter("radixmesh_evictions", "evictions")  # seeded: metrics-unit
+    dyn = reg.gauge("radixmesh_" + name_suffix, "computed")  # seeded: metrics-literal
+    return hits, evictions, dyn

@@ -1,0 +1,16 @@
+"""Seeded send-seam breaches: a raw blocking ``.send(`` and a
+``try_send`` outside the documented seam methods."""
+
+
+class MeshCache:
+    def __init__(self, comm):
+        self._comm = comm
+
+    def publish(self, data):
+        self._comm.send(data)  # seeded: send-seam
+
+    def sneak_frame(self, data):
+        return self._comm.try_send(data, timeout=0.1)  # seeded: send-seam
+
+    def _sender_loop(self, data):
+        return self._comm.try_send(data, timeout=0.1)  # allowed seam

@@ -1,0 +1,16 @@
+"""Seeded single-writer violations, all through aliases — the shape the
+old regex lints could not see (no ``= LifecycleState.`` / ``.owners =``
+textual signature on the write line itself... except the binding, which
+is the point: the AST checker flags both ends of the alias)."""
+
+from radixmesh_tpu.policy.lifecycle import LifecycleState
+
+
+def undrain(plane):
+    st = LifecycleState.ACTIVE  # seeded: single-writer-lifecycle
+    plane.state = st  # seeded: single-writer-lifecycle
+
+
+def second_heat_counter(heat, sid):
+    note = heat.note_insert  # seeded: single-writer-heat
+    note(sid, 16)

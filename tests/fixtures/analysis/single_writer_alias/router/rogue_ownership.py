@@ -1,0 +1,13 @@
+"""Seeded ownership-map second writer: a private construction plus an
+owner-set poke — split-brain on the delivery plane."""
+
+from radixmesh_tpu.cache.sharding import OwnershipMap
+
+
+def build_private_map(view):
+    m = OwnershipMap(epoch=1, rf=2, ranks=(0, 1), owners=())  # seeded: single-writer-ownership
+    return m
+
+
+def steal_shard(m, sid, rank):
+    m.owners[sid] = (rank,)  # seeded: single-writer-ownership

@@ -1,0 +1,18 @@
+"""Seeded suppression-grammar breach: an ``ok[...]`` with no
+justification must be a finding itself, never a silent excuse."""
+
+import time
+
+
+def lazy_backoff():
+    # meshcheck: ok[sleep-audit]
+    time.sleep(0.5)  # seeded: sleep-audit
+
+
+def unjustified():
+    # The directive above lazy_backoff is missing its justification, so
+    # it both fails the grammar AND suppresses nothing.
+    return 7
+
+
+# seeded-at: utils/bad.py:8 suppression-grammar

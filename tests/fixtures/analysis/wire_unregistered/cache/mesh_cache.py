@@ -1,0 +1,30 @@
+"""Companion mesh for the wire fixture: the REGISTERED vocabulary has
+its encode sites and receive branches, so the only finding is the
+seeded unregistered kind."""
+
+from .oplog import Oplog, OplogType
+
+
+class MeshCache:
+    def insert(self, key):
+        self._emit(Oplog(OplogType.INSERT, key))
+
+    def delete(self, key):
+        self._emit(Oplog(OplogType.DELETE, key))
+
+    def reset_all(self):
+        self._emit(Oplog(OplogType.RESET))
+
+    def prefetch(self, key):
+        self._emit(Oplog(OplogType.PREFETCH, key))
+
+    def _emit(self, op):
+        pass
+
+    def oplog_received(self, op):
+        if op.op_type is OplogType.PREFETCH:
+            return
+        if op.op_type in (OplogType.INSERT, OplogType.DELETE):
+            return
+        if op.op_type is OplogType.RESET:
+            return

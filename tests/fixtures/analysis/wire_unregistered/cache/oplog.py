@@ -1,0 +1,24 @@
+"""Seeded wire-kind breach: ``SHINY_NEW`` post-dates the pass-through
+tolerance (declared after PREFETCH) but was never registered in
+EXTENSION_KINDS — an old wire would raise on it instead of
+forwarding."""
+
+import enum
+
+
+class OplogType(enum.IntEnum):
+    INSERT = 1
+    DELETE = 2
+    RESET = 3
+    PREFETCH = 11
+    SHINY_NEW = 12  # seeded: wire-unregistered
+
+
+EXTENSION_KINDS = frozenset({OplogType.PREFETCH})
+DATA_KINDS = frozenset({OplogType.INSERT, OplogType.DELETE, OplogType.RESET})
+
+
+class Oplog:
+    def __init__(self, op_type, key=None):
+        self.op_type = op_type
+        self.key = key

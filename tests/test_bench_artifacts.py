@@ -809,3 +809,125 @@ class TestObsArtifactSchema:
         assert report["stitch"]["node_tracks"] >= bench.OBS_MIN_NODE_TRACKS
         assert report["heat"]["skew_score"] >= bench.OBS_MIN_SKEW_SCORE
         assert report["steps"]["performed"] is True
+
+
+class TestAnalysisArtifactSchema:
+    """ANALYSIS v1 (PR 10, meshcheck): the static-analysis plane's
+    artifact gates on ZERO unsuppressed findings over the tree, every
+    default checker present, every positive-control fixture tripped
+    (a clean verdict is only evidence when the checkers demonstrably
+    still see the seeded bug classes), and a justification on every
+    suppression."""
+
+    def _report(self) -> dict:
+        checker = {
+            "id": "lock-order",
+            "description": "x",
+            "raw_findings": 0,
+            "kept_findings": 0,
+            "suppressed": 0,
+        }
+        return {
+            "schema_version": bench.ANALYSIS_SCHEMA_VERSION,
+            "metric": "unsuppressed_findings",
+            "value": 0,
+            "package": "radixmesh_tpu",
+            "files_indexed": 80,
+            "checkers": [
+                dict(checker, id=cid) for cid in bench.ANALYSIS_CHECKER_IDS
+            ],
+            "findings": [],
+            "suppressions": [
+                {
+                    "file": "workload.py", "line": 19, "scope": "file",
+                    "invariants": ["sleep-audit"],
+                    "justification": "generators pace by wall clock",
+                    "used": True,
+                },
+            ],
+            "positive_controls": [
+                {
+                    "fixture": "lock_cycle",
+                    "invariant": "lock-order-cycle",
+                    "file": "engine/engine.py", "line": 19,
+                    "tripped": True,
+                },
+            ],
+            "clean": True,
+        }
+
+    def test_valid_report_passes(self):
+        assert bench.validate_analysis(self._report()) == []
+
+    def test_missing_fields_reported(self):
+        report = self._report()
+        del report["positive_controls"]
+        del report["files_indexed"]
+        missing = bench.validate_analysis(report)
+        assert any("files_indexed" in p for p in missing)
+        assert any("positive_controls" in p for p in missing)
+
+    def test_non_dict_rejected(self):
+        assert bench.validate_analysis([1]) == ["artifact is not a JSON object"]
+
+    def test_findings_fail_the_gate(self):
+        report = self._report()
+        report["findings"] = [
+            {"file": "cache/mesh_cache.py", "line": 7,
+             "invariant": "send-seam", "message": "raw send"},
+        ]
+        report["clean"] = False
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "unsuppressed finding" in problems
+
+    def test_clean_flag_must_agree(self):
+        report = self._report()
+        report["clean"] = False
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "clean flag disagrees" in problems
+
+    def test_untripped_control_fails(self):
+        report = self._report()
+        report["positive_controls"][0]["tripped"] = False
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "NOT tripped" in problems and "went blind" in problems
+
+    def test_empty_controls_fail(self):
+        report = self._report()
+        report["positive_controls"] = []
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "proves nothing" in problems
+
+    def test_missing_checker_fails(self):
+        report = self._report()
+        report["checkers"] = report["checkers"][1:]
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "lock-order" in problems and "checked less" in problems
+
+    def test_unjustified_suppression_fails(self):
+        report = self._report()
+        report["suppressions"][0]["justification"] = "  "
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "silencing" in problems
+
+    def test_checked_in_artifact_validates_and_is_clean(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "ANALYSIS_r*.json")))
+        assert paths, "no ANALYSIS artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_analysis(report) == [], paths[-1]
+        assert "schema_violation" not in report
+        assert report["clean"] is True and report["value"] == 0
+        # Every checker of the default plane ran over a real tree.
+        assert report["files_indexed"] >= 70
+        # All controls tripped, and they cover every checker family.
+        fixtures = {c["fixture"] for c in report["positive_controls"]}
+        assert {
+            "lock_cycle", "single_writer_alias", "hotpath_sleep",
+            "wire_unregistered", "metrics_vocab", "send_seam",
+            "suppression_grammar",
+        } <= fixtures

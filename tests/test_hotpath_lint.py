@@ -1,101 +1,77 @@
-"""Hot-path synchronization lint: the serving loop must never regrow a
-blocking KV copy.
+"""Hot-path synchronization lint, running through the meshcheck
+framework: the serving loop must never regrow a blocking KV copy.
 
-PR 4 moved every bulk KV materialization (host-arena reads/writes, fused
-eviction gathers, handoff packing) into ``cache/kv_transfer.py`` — the
-ONE module allowed to block on device→host data. This lint pins that
-boundary with a source grep: the engine's step/admit code and the
-hierarchical cache's match path must not contain the constructs that
-silently reintroduce a synchronous copy. A legitimate new sync point
-belongs in the staging module (or earns an explicit allowlist entry
-here, with a comment defending it)."""
+PR 4 moved every bulk KV materialization into ``cache/kv_transfer.py``
+— the ONE module allowed to block on device→host data. The old version
+of this file pinned that boundary with regex greps over three scopes;
+the ``hot-path`` checker (``radixmesh_tpu/analysis/hot_path.py``) now
+enforces the same scoped bans off the AST (invariant ``hotpath-sync``)
+PLUS what a scope-grep cannot see: a blocking call N frames down the
+call graph from ``Engine.step`` / ``match_prefix`` / admission / oplog
+receive (invariant ``hotpath-blocking``). Test names preserved; each
+asserts its slice of the checker's findings is empty."""
 
-import inspect
-import re
+import ast
 
 import pytest
 
+from radixmesh_tpu.analysis import check_tree as _result
+from radixmesh_tpu.analysis import tree_index as _index
+
 pytestmark = pytest.mark.quick
 
-# Constructs that force a device→host materialization (or a full device
-# sync) when applied to a device array. ``np.asarray(sampled…)`` — the
-# designed one-sync-per-launch points — survive because they are matched
-# against KV-movement call patterns, not against every asarray.
-BANNED = {
-    # A full device sync anywhere in the scheduler is a stall by
-    # definition; the only block_until_ready in the repo belongs to
-    # benches and tests.
-    r"\.block_until_ready\(": "explicit device sync",
-    r"jax\.device_get\(": "blocking device→host copy",
-    # Materializing a pool gather on the host: the write-back / handoff
-    # stall this PR removed. (Device-side pool.gather feeding another
-    # device op — e.g. the dense-prefill cached-prefix gather — stays
-    # legal; wrapping it in np.asarray is not.)
-    r"(?<!j)np\.asarray\(\s*(?:self\.)?pool\.gather": "host-materialized pool gather",
-    r"gather_padded\(": "fused host gather (staging-module-only)",
-    # Reading the host arena inline (the synchronous restore stall).
-    r"(?:self\.)?host\.read\(": "host-arena read (staging/restore-path-only)",
-}
 
-
-def _source_of(*objects) -> str:
-    return "\n".join(inspect.getsource(o) for o in objects)
-
-
-def _violations(src: str, banned: dict) -> list[str]:
-    out = []
-    for pattern, why in banned.items():
-        for m in re.finditer(pattern, src):
-            line = src[: m.start()].count("\n") + 1
-            out.append(f"line ~{line}: {m.group(0)!r} — {why}")
-    return out
+def _sync_findings(rel: str):
+    return [
+        f for f in _result().findings
+        if f.invariant in ("hotpath-sync", "hotpath-blocking") and f.file == rel
+    ]
 
 
 class TestHotPathSyncLint:
     def test_engine_step_admit_paths_have_no_blocking_kv_copies(self):
-        from radixmesh_tpu.engine import engine as engine_mod
-
-        src = _source_of(engine_mod)
-        assert not _violations(src, BANNED), "\n".join(_violations(src, BANNED))
+        bad = _sync_findings("engine/engine.py")
+        assert not bad, "\n".join(str(f) for f in bad)
 
     def test_host_cache_match_path_stays_dispatch_only(self):
         """``match_and_load`` may read the arena (that is the documented
-        synchronous fallback) but must not host-materialize device
-        arrays; the fused sweep gather lives in the flush/plane seam."""
-        from radixmesh_tpu.cache.host_cache import HierarchicalCache
-
-        src = _source_of(
-            HierarchicalCache.match_and_load,
-            HierarchicalCache._writeback,
-            HierarchicalCache._evict_host,
-        )
-        banned = {
-            r"(?<!j)np\.asarray\(\s*(?:self\.)?pool\.gather": "host-materialized gather",
-            r"gather_padded\(": "per-node gather (must be sweep-fused)",
-            r"\.block_until_ready\(": "explicit device sync",
-            r"jax\.device_get\(": "blocking device→host copy",
-        }
-        assert not _violations(src, banned), "\n".join(_violations(src, banned))
+        synchronous fallback — the checker's host_cache scope bans the
+        gather/sync constructs, not ``host.read``); the fused sweep
+        gather lives in the flush/plane seam."""
+        bad = _sync_findings("cache/host_cache.py")
+        assert not bad, "\n".join(str(f) for f in bad)
 
     def test_disagg_admit_has_no_host_materialization(self):
         """The decode-side admit writes staged blocks; materializing a
         packet back to numpy there would undo the reader-thread
-        staging."""
-        from radixmesh_tpu.engine.disagg import DecodeWorker
+        staging. (The checker's disagg scope bans ANY np.asarray in
+        ``_admit_one``.)"""
+        bad = _sync_findings("engine/disagg.py")
+        assert not bad, "\n".join(str(f) for f in bad)
 
-        src = _source_of(DecodeWorker._admit_one)
-        banned = {
-            r"(?<!j)np\.asarray\(": "host materialization in the admit path",
-            r"\.block_until_ready\(": "explicit device sync",
-            r"jax\.device_get\(": "blocking device→host copy",
-        }
-        assert not _violations(src, banned), "\n".join(_violations(src, banned))
+    def test_nothing_reachable_from_serving_entry_points_blocks(self):
+        """The grep-invisible half: across the WHOLE package, no
+        function reachable from the serving entry points contains an
+        unbounded wait/sleep/device-sync."""
+        bad = [
+            f for f in _result().findings
+            if f.invariant == "hotpath-blocking"
+        ]
+        assert not bad, "\n".join(str(f) for f in bad)
 
     def test_staging_module_is_the_only_sync_owner(self):
-        """Positive control: the constructs ARE present in the staging
-        module (the lint greps for real patterns, not typos)."""
-        from radixmesh_tpu.cache import kv_transfer
-
-        src = inspect.getsource(kv_transfer)
-        assert re.search(r"(?<!j)np\.asarray\(", src)
-        assert re.search(r"host\.read\(", src)
+        """Positive control: the banned constructs ARE present in the
+        staging module (the checker scopes ban real patterns, not
+        typos) — and the staging module itself is exempt by design."""
+        tree = _index().module("cache/kv_transfer.py").tree
+        names = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    names.add(f.attr)
+                elif isinstance(f, ast.Name):
+                    names.add(f.id)
+        assert "asarray" in names, "kv_transfer no longer materializes?"
+        assert "read" in names, "kv_transfer no longer reads the arena?"
+        assert not _sync_findings("cache/kv_transfer.py")

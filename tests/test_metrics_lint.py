@@ -1,32 +1,23 @@
 """Metric hygiene lint: every family the serving stack registers must be
 ``radixmesh_``-prefixed (one grep finds the fleet's series; no collision
 with other exporters on a shared scrape) and unit-suffixed so dashboards
-never guess units. Families register at construction time, so the lint
-builds one of each instrumented component and walks what landed in the
-default registry."""
+never guess units.
+
+Two enforcement layers since PR 10, sharing ONE vocabulary
+(``radixmesh_tpu/analysis/metrics_vocab.py``): the static checker reads
+the rules off the AST at every ``counter()/gauge()/histogram()`` call
+site (so a family registered only on a code path no test constructs is
+still checked), and this file's runtime walk builds one of each
+instrumented component and checks what actually landed in the default
+registry (so a name computed at runtime is still checked)."""
 
 import jax
 import pytest
 
+from radixmesh_tpu.analysis.metrics_vocab import GAUGE_SUFFIXES
 from radixmesh_tpu.obs.metrics import get_registry
 
 pytestmark = pytest.mark.quick
-
-# Base units (counters are ``_total``; histograms observe seconds/bytes/
-# tokens). Gauges may additionally be counts of a named thing or one of
-# the declared dimensionless states — a new suffix here is a conscious
-# vocabulary decision, not a typo that slips through.
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_tokens")
-GAUGE_SUFFIXES = UNIT_SUFFIXES + (
-    "_requests", "_slots", "_nodes", "_rows",
-    "_epoch", "_rank", "_flag", "_tier", "_tokens_per_second",
-    "_state",  # lifecycle state code (policy/lifecycle.py)
-    "_shards",  # owned-shard count (cache/sharding.py)
-    "_bytes_per_insert",  # per-insert wire-cost EWMA (cache/sharding.py)
-    "_ratio",  # dimensionless max/mean skew (PR 9 heat map)
-    "_mfu",  # model-FLOPs-utilization estimate (obs/step_plane.py)
-    "_fraction",  # 0..1 share, e.g. wave padding (obs/step_plane.py)
-)
 
 
 def _register_all_instrumented_families() -> None:
@@ -98,6 +89,19 @@ def _registered_families() -> dict[str, str]:
 
 
 class TestMetricHygiene:
+    def test_registration_sites_pass_the_static_checker(self):
+        """The AST layer: zero metrics-vocab findings across every
+        product registration call site (including ones the runtime walk
+        below never constructs)."""
+        from radixmesh_tpu.analysis import check_tree
+
+        result = check_tree()
+        bad = [
+            f for f in result.findings
+            if f.invariant.startswith("metrics-")
+        ]
+        assert not bad, "\n".join(str(f) for f in bad)
+
     def test_all_families_prefixed_and_unit_suffixed(self):
         _register_all_instrumented_families()
         fams = _registered_families()
