@@ -981,37 +981,59 @@ def _kvflow_pass() -> dict:
 # validates against it before writing.
 # ----------------------------------------------------------------------
 
-ANALYSIS_SCHEMA_VERSION = 1
+ANALYSIS_SCHEMA_VERSION = 2
 
 # Every checker the default meshcheck run must include — a report that
 # silently dropped a checker would read as clean while checking less.
-ANALYSIS_CHECKER_IDS = (
+# v2 (PR 11) adds the concurrency plane: thread-roots / guarded-by /
+# protocol. v1 artifacts validate against the v1 tuple.
+ANALYSIS_CHECKER_IDS_V1 = (
     "lock-order", "single-writer", "hot-path", "wire-kinds",
     "metrics-vocab",
+)
+ANALYSIS_CHECKER_IDS = ANALYSIS_CHECKER_IDS_V1 + (
+    "thread-roots", "guarded-by", "protocol",
 )
 
 ANALYSIS_TOP_FIELDS = (
     "schema_version", "metric", "value", "package", "files_indexed",
     "checkers", "findings", "suppressions", "positive_controls", "clean",
 )
+# v2: the derived thread map rides the artifact (root count + entries) —
+# a concurrency verdict is only auditable alongside the roots it assumed.
+ANALYSIS_TOP_FIELDS_V2 = ANALYSIS_TOP_FIELDS + ("thread_roots",)
 ANALYSIS_CHECKER_FIELDS = (
     "id", "description", "raw_findings", "kept_findings", "suppressed",
+)
+# v2: per-checker positive-control accounting (count + tripped count).
+ANALYSIS_CHECKER_FIELDS_V2 = ANALYSIS_CHECKER_FIELDS + (
+    "controls", "controls_tripped",
 )
 ANALYSIS_CONTROL_FIELDS = ("fixture", "invariant", "file", "line", "tripped")
 ANALYSIS_SUPPRESSION_FIELDS = (
     "file", "line", "scope", "invariants", "justification",
 )
+ANALYSIS_THREAD_ROOT_FIELDS = ("name", "target", "file", "line", "multi", "kind")
 
 
 def validate_analysis(report) -> list[str]:
     """Schema violations of an ANALYSIS artifact vs the pinned contract
     (empty = valid). Gates: ZERO unsuppressed findings on the tree, all
-    default checkers present, every positive control tripped, and every
-    suppression carrying a non-empty justification. Import-safe from
-    artifact tests and scripts/meshcheck.py (no jax at module scope)."""
+    default checkers present (version-matched set), every positive
+    control tripped, every suppression carrying a non-empty
+    justification, and (v2) a non-empty thread map. v1 artifacts stay
+    valid against the v1 field/checker sets. Import-safe from artifact
+    tests and scripts/meshcheck.py (no jax at module scope)."""
     if not isinstance(report, dict):
         return ["artifact is not a JSON object"]
-    problems = [f for f in ANALYSIS_TOP_FIELDS if f not in report]
+    version = report.get("schema_version", 1)
+    v2 = isinstance(version, int) and version >= 2
+    top_fields = ANALYSIS_TOP_FIELDS_V2 if v2 else ANALYSIS_TOP_FIELDS
+    checker_ids = ANALYSIS_CHECKER_IDS if v2 else ANALYSIS_CHECKER_IDS_V1
+    checker_fields = (
+        ANALYSIS_CHECKER_FIELDS_V2 if v2 else ANALYSIS_CHECKER_FIELDS
+    )
+    problems = [f for f in top_fields if f not in report]
 
     findings = report.get("findings")
     if not isinstance(findings, list):
@@ -1035,10 +1057,10 @@ def validate_analysis(report) -> list[str]:
                 continue
             problems += [
                 f"checkers[{c.get('id', '?')}].{f}"
-                for f in ANALYSIS_CHECKER_FIELDS if f not in c
+                for f in checker_fields if f not in c
             ]
             seen.add(c.get("id"))
-        for cid in ANALYSIS_CHECKER_IDS:
+        for cid in checker_ids:
             if cid not in seen:
                 problems.append(
                     f"checker {cid!r} missing from the report — the run "
@@ -1084,32 +1106,69 @@ def validate_analysis(report) -> list[str]:
                 )
     elif sups is not None:
         problems.append("suppressions is not a list")
+
+    if v2:
+        roots = report.get("thread_roots")
+        if not isinstance(roots, dict):
+            problems.append("thread_roots is not an object")
+        else:
+            count = roots.get("count")
+            entries = roots.get("roots")
+            if not isinstance(count, int) or count < 1:
+                problems.append(
+                    "thread_roots.count < 1 — a concurrency plane that "
+                    "found no thread roots checked nothing"
+                )
+            if not isinstance(entries, list) or len(entries) != (count or 0):
+                problems.append("thread_roots.roots disagrees with count")
+            else:
+                for r in entries:
+                    problems += [
+                        f"thread_roots[{r.get('name', '?')}].{f}"
+                        for f in ANALYSIS_THREAD_ROOT_FIELDS if f not in r
+                    ]
     return problems
 
 
-def build_analysis_report(result, controls, files_indexed: int) -> dict:
+def build_analysis_report(
+    result, controls, files_indexed: int, thread_roots=None
+) -> dict:
     """Assemble a schema-complete ANALYSIS artifact from a framework
     :class:`~radixmesh_tpu.analysis.core.AnalysisResult` plus the
-    positive-control expectations (``analysis/controls.py``)."""
+    positive-control expectations (``analysis/controls.py``) and (v2)
+    the derived thread map (``analysis/thread_roots.py``)."""
     checkers_meta = []
     from radixmesh_tpu.analysis import all_checkers
 
-    for checker in all_checkers():
+    # invariant-id -> checker-id, for the per-checker control counts;
+    # framework invariants (syntax/suppression grammar/staleness) are
+    # controls on the framework itself.
+    owner: dict = {}
+    checkers = all_checkers()
+    for checker in checkers:
+        for inv in getattr(checker, "invariants", ()):
+            owner[inv] = checker.id
+    for checker in checkers:
         raw = result.raw_by_checker.get(checker.id, [])
         kept = result.kept_by_checker.get(checker.id, [])
+        mine = [c for c in controls if owner.get(c.invariant) == checker.id]
         checkers_meta.append({
             "id": checker.id,
             "description": checker.description,
             "raw_findings": len(raw),
             "kept_findings": len(kept),
             "suppressed": len(raw) - len(kept),
+            "controls": len(mine),
+            "controls_tripped": sum(c.tripped for c in mine),
         })
+    root_entries = [r.as_dict() for r in (thread_roots or [])]
     return {
         "schema_version": ANALYSIS_SCHEMA_VERSION,
         "metric": "unsuppressed_findings",
         "value": len(result.findings),
         "package": "radixmesh_tpu",
         "files_indexed": files_indexed,
+        "thread_roots": {"count": len(root_entries), "roots": root_entries},
         "checkers": checkers_meta,
         "findings": [
             {

@@ -44,10 +44,13 @@ from .core import (
     package_root,
     run_checkers,
 )
+from .guarded_by import GuardedByChecker
 from .hot_path import HotPathChecker
 from .lock_order import LockOrderChecker
 from .metrics_vocab import MetricsVocabChecker
+from .protocol import ProtocolChecker
 from .single_writer import SingleWriterChecker
+from .thread_roots import ThreadRootsChecker, get_thread_map
 from .wire_kinds import WireKindsChecker
 
 __all__ = [
@@ -59,24 +62,54 @@ __all__ = [
     "package_root",
     "run_checkers",
     "all_checkers",
+    "changed_scope",
     "LockOrderChecker",
     "SingleWriterChecker",
     "HotPathChecker",
     "WireKindsChecker",
     "MetricsVocabChecker",
+    "ThreadRootsChecker",
+    "GuardedByChecker",
+    "ProtocolChecker",
+    "get_thread_map",
 ]
 
 
 def all_checkers() -> list:
     """One fresh instance of every registered checker, default config —
-    the set ``scripts/meshcheck.py`` and the quick gate run."""
+    the set ``scripts/meshcheck.py`` and the quick gate run. Order: the
+    thread-root checker runs before guarded-by (both read the shared
+    thread map, memoized on the index either way)."""
     return [
         LockOrderChecker(),
         SingleWriterChecker(),
         HotPathChecker(),
         WireKindsChecker(),
         MetricsVocabChecker(),
+        ThreadRootsChecker(),
+        GuardedByChecker(),
+        ProtocolChecker(),
     ]
+
+
+def changed_scope(index: SourceIndex, changed: list[str]) -> set[str]:
+    """The file scope ``scripts/meshcheck.py --changed`` reports on: the
+    changed package-relative modules plus every module that (transitively)
+    imports one of them — a change can invalidate any finding computed in
+    a module that calls into it. Unknown paths are ignored (deleted
+    files)."""
+    from .callgraph import get_callgraph
+
+    dependents = get_callgraph(index).module_dependents()
+    scope: set[str] = set()
+    frontier = [rel for rel in changed if rel in index.modules]
+    while frontier:
+        rel = frontier.pop()
+        if rel in scope:
+            continue
+        scope.add(rel)
+        frontier.extend(dependents.get(rel, ()))
+    return scope
 
 
 import functools as _functools

@@ -34,9 +34,9 @@ Invariants:
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass
 
-from .core import Checker, Finding, SourceIndex, dotted_name, iter_functions
+from .callgraph import get_callgraph
+from .core import Checker, Finding, SourceIndex, dotted_name
 
 __all__ = ["HotPathChecker", "DEFAULT_ENTRY_POINTS"]
 
@@ -183,24 +183,15 @@ def _banned_construct(call: ast.Call, families: tuple[str, ...]) -> str | None:
     return None
 
 
-@dataclass(frozen=True)
-class _Func:
-    rel: str
-    qual: str  # "Class.method" or "func"
-    cls: str | None
-    node: ast.AST
-
-    @property
-    def key(self) -> tuple[str, str]:
-        return (self.rel, self.qual)
-
-
 class HotPathChecker:
     id = "hot-path"
     description = (
         "no blocking call (unbounded wait/join/get, time.sleep, device "
         "sync) reachable from a serving entry point; tree-wide "
         "timeout/sleep audits; the PR 4 staging boundary"
+    )
+    invariants = (
+        "hotpath-blocking", "timeout-audit", "sleep-audit", "hotpath-sync",
     )
 
     def __init__(self, entry_points=DEFAULT_ENTRY_POINTS):
@@ -209,206 +200,16 @@ class HotPathChecker:
     # ------------------------------------------------------------------
 
     def check(self, index: SourceIndex) -> list[Finding]:
-        # The class table is derived from THIS index — drop any memo a
-        # previous check() left so a reused instance never resolves
-        # classes against a stale tree.
-        self._class_cache = None
-        funcs, imports, attr_types = self._build_symbols(index)
-        edges = self._build_edges(index, funcs, imports, attr_types)
-        reachable, chains = self._reach(edges, funcs)
+        # The shared call graph (analysis/callgraph.py) — symbol tables
+        # and edges are built once per index and shared with the
+        # thread-root and guarded-by checkers.
+        cg = get_callgraph(index)
+        funcs = cg.funcs
+        reachable, chains = cg.reach(self.entry_points)
         findings: list[Finding] = []
         self._scan_blocking(index, funcs, reachable, chains, findings)
         self._scan_sync_scopes(index, funcs, findings)
         return findings
-
-    # ------------------------------------------------------------------
-    # symbol tables
-    # ------------------------------------------------------------------
-
-    def _build_symbols(self, index: SourceIndex):
-        funcs: dict[tuple[str, str], _Func] = {}
-        classes: dict[str, dict[str, str]] = {}  # class name -> {rel}
-        for mod in index.iter_modules():
-            if mod.tree is None:
-                continue
-            for qual, cls, fn in iter_functions(mod.tree):
-                funcs[(mod.rel, qual)] = _Func(mod.rel, qual, cls, fn)
-            for node in mod.tree.body:
-                if isinstance(node, ast.ClassDef):
-                    classes.setdefault(node.name, {})[mod.rel] = node.name
-        # ONE construction site for the class table: prime the memo the
-        # edge-builder's resolver reads (check() reset it for this run).
-        self._class_cache = classes
-
-        # Per-module import map: name -> module rel it came from.
-        imports: dict[str, dict[str, str]] = {}
-        for mod in index.iter_modules():
-            if mod.tree is None:
-                continue
-            imap: dict[str, str] = {}
-            for node in ast.walk(mod.tree):
-                if isinstance(node, ast.ImportFrom):
-                    target = self._resolve_import(mod.rel, node, index)
-                    if target is None:
-                        continue
-                    for alias in node.names:
-                        imap[alias.asname or alias.name] = target
-            imports[mod.rel] = imap
-
-        # Constructor-typed self attributes: self.x = ClassName(...) in
-        # any method -> (class scope) x: rel-of-ClassName + ClassName.
-        attr_types: dict[tuple[str, str], dict[str, tuple[str, str]]] = {}
-        for mod in index.iter_modules():
-            if mod.tree is None:
-                continue
-            for qual, cls, fn in iter_functions(mod.tree):
-                if cls is None:
-                    continue
-                for node in ast.walk(fn):
-                    if not (
-                        isinstance(node, ast.Assign)
-                        and isinstance(node.value, ast.Call)
-                        and isinstance(node.value.func, ast.Name)
-                    ):
-                        continue
-                    cname = node.value.func.id
-                    crel = self._class_rel(cname, mod.rel, imports, classes, index)
-                    if crel is None:
-                        continue
-                    for t in node.targets:
-                        name = dotted_name(t)
-                        if name and name.startswith("self.") and name.count(".") == 1:
-                            attr_types.setdefault((mod.rel, cls), {})[
-                                name.split(".", 1)[1]
-                            ] = (crel, cname)
-        return funcs, imports, attr_types
-
-    def _resolve_import(self, rel: str, node: ast.ImportFrom, index) -> str | None:
-        """Map an ImportFrom to a package-relative module path, or None
-        for out-of-package imports."""
-        if node.level == 0:
-            mod = node.module or ""
-            if not mod.startswith("radixmesh_tpu"):
-                return None
-            parts = mod.split(".")[1:]
-        else:
-            base = rel.split("/")[:-1]
-            up = node.level - 1
-            parts = (base[: len(base) - up] if up else base) + (
-                node.module.split(".") if node.module else []
-            )
-        cand = "/".join(parts) + ".py"
-        if cand in index:
-            return cand
-        pkg = "/".join(parts) + "/__init__.py"
-        if pkg in index:
-            return pkg
-        return None
-
-    def _class_rel(self, cname, rel, imports, classes, index) -> str | None:
-        rels = classes.get(cname)
-        if not rels:
-            return None
-        if rel in rels:
-            return rel
-        imported_from = imports.get(rel, {}).get(cname)
-        if imported_from in rels:
-            return imported_from
-        if len(rels) == 1:
-            return next(iter(rels))
-        return None
-
-    # ------------------------------------------------------------------
-    # call graph
-    # ------------------------------------------------------------------
-
-    def _build_edges(self, index, funcs, imports, attr_types):
-        edges: dict[tuple[str, str], set[tuple[str, str]]] = {}
-        for (rel, qual), f in funcs.items():
-            out: set[tuple[str, str]] = set()
-            local_types: dict[str, tuple[str, str]] = {}
-            for node in ast.walk(f.node):
-                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                    # t = Thing(...) -> t.m() resolves one level.
-                    if isinstance(node.value.func, ast.Name):
-                        cname = node.value.func.id
-                        crel = self._class_rel_cached(
-                            cname, rel, imports, index
-                        )
-                        if crel is not None:
-                            for t in node.targets:
-                                if isinstance(t, ast.Name):
-                                    local_types[t.id] = (crel, cname)
-                if not isinstance(node, ast.Call):
-                    continue
-                for target in self._call_targets(
-                    node, f, funcs, imports, attr_types, local_types, index
-                ):
-                    out.add(target)
-            edges[(rel, qual)] = out
-        return edges
-
-    _class_cache: dict | None = None
-
-    def _class_rel_cached(self, cname, rel, imports, index):
-        # Primed by _build_symbols for this run's index.
-        assert self._class_cache is not None
-        return self._class_rel(cname, rel, imports, self._class_cache, index)
-
-    def _call_targets(
-        self, call, f, funcs, imports, attr_types, local_types, index,
-    ):
-        name = dotted_name(call.func)
-        if name is None:
-            return
-        rel = f.rel
-        parts = name.split(".")
-        if len(parts) == 1:
-            # bare g() — same module, else an imported function.
-            if (rel, parts[0]) in funcs:
-                yield (rel, parts[0])
-            else:
-                src = imports.get(rel, {}).get(parts[0])
-                if src and (src, parts[0]) in funcs:
-                    yield (src, parts[0])
-                # Constructor call: edge into __init__.
-                crel = self._class_rel_cached(parts[0], rel, imports, index)
-                if crel and (crel, f"{parts[0]}.__init__") in funcs:
-                    yield (crel, f"{parts[0]}.__init__")
-        elif parts[0] == "self" and f.cls is not None:
-            if len(parts) == 2:
-                if (rel, f"{f.cls}.{parts[1]}") in funcs:
-                    yield (rel, f"{f.cls}.{parts[1]}")
-            elif len(parts) == 3:
-                typed = attr_types.get((rel, f.cls), {}).get(parts[1])
-                if typed:
-                    trel, tcls = typed
-                    if (trel, f"{tcls}.{parts[2]}") in funcs:
-                        yield (trel, f"{tcls}.{parts[2]}")
-        elif len(parts) == 2:
-            # mod_alias.f() via `from radixmesh_tpu.x import y` is rare;
-            # local constructor-typed var.m().
-            typed = local_types.get(parts[0])
-            if typed:
-                trel, tcls = typed
-                if (trel, f"{tcls}.{parts[1]}") in funcs:
-                    yield (trel, f"{tcls}.{parts[1]}")
-
-    def _reach(self, edges, funcs):
-        chains: dict[tuple[str, str], tuple[str, ...]] = {}
-        frontier: list[tuple[str, str]] = []
-        for ep in self.entry_points:
-            if ep in funcs:
-                chains[ep] = (f"{ep[0]}:{ep[1]}",)
-                frontier.append(ep)
-        while frontier:
-            cur = frontier.pop()
-            for nxt in edges.get(cur, ()):
-                if nxt in chains:
-                    continue
-                chains[nxt] = chains[cur] + (f"{nxt[0]}:{nxt[1]}",)
-                frontier.append(nxt)
-        return set(chains), chains
 
     # ------------------------------------------------------------------
     # scans

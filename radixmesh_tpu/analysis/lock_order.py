@@ -71,6 +71,7 @@ class LockOrderChecker:
         "intra-module helper calls) must be acyclic; non-reentrant locks "
         "must never be re-acquired while held"
     )
+    invariants = ("lock-order-cycle", "lock-order-reentry")
 
     def check(self, index: SourceIndex) -> list[Finding]:
         findings: list[Finding] = []

@@ -93,6 +93,10 @@ class SingleWriterChecker:
         "writer module (aliases and setattr count as writes); mesh "
         "network sends are confined to the try_send seam methods"
     )
+    invariants = (
+        "single-writer-lifecycle", "single-writer-ownership",
+        "single-writer-heat", "send-seam",
+    )
 
     def check(self, index: SourceIndex) -> list[Finding]:
         findings: list[Finding] = []

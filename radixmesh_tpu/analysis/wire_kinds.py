@@ -59,6 +59,10 @@ class WireKindsChecker:
         "site, an explicit receive branch, and (post-tolerance kinds) a "
         "registration — verified structurally, not by substring"
     )
+    invariants = (
+        "wire-unregistered", "wire-no-encode", "wire-no-receive",
+        "wire-data-kinds",
+    )
 
     def check(self, index: SourceIndex) -> list[Finding]:
         if _OPLOG not in index or index.module(_OPLOG).tree is None:

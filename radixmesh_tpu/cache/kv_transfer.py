@@ -300,6 +300,11 @@ class KVTransferPlane:
         rather than restore garbage. Slots reported bad are retired from
         the poison set — the caller's drop frees them for reuse, after
         which fresh writes make them trustworthy again."""
+        # meshcheck: ok[guarded-by-race] racy empty-read is a pure fast
+        # path: the sync caller ran wait_host_ready() first (the barrier
+        # drains every queued write-back and fails on poison), new
+        # poison can only be enqueued by this same engine thread's next
+        # sweep, and a non-empty set re-checks under the lock.
         if not self._poisoned_host:
             return True
         with self._lock:

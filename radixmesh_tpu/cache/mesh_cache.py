@@ -205,6 +205,11 @@ class MeshCache:
             ),
         )
         self._lock = threading.RLock()
+        # Flipped under the lock at the top of close(): the lazy p2p
+        # dialers check it after winning their setdefault, so a dial
+        # racing the close snapshot closes its own channel instead of
+        # inserting one nothing will ever close.
+        self._closing = False
         self._logic_op = AtomicCounter()
         self.dup_nodes: dict[NodeKey, PrefillValue | RouterValue] = {}
         # Slot-ownership ledger for locally-owned duplicate KV. Dup entries
@@ -681,6 +686,8 @@ class MeshCache:
         announces a view without this node, so peers re-form the ring
         immediately instead of waiting out ``failure_timeout_s``. The
         default mimics a crash (what failure detection exists to handle)."""
+        with self._lock:
+            self._closing = True
         if (
             graceful
             and self._started
@@ -728,13 +735,22 @@ class MeshCache:
             self._spine_comm.close()
         for c in self._router_comms:
             c.close()
-        for c in self._prefetch_comms.values():
-            c.close()
-        for c in self._repair_comms.values():
-            c.close()
-        for c in self._bootstrap_comms.values():
-            c.close()
-        for c in self._owner_comms.values():
+        # Snapshot the dedicated-channel maps under the lock before
+        # closing: the lazy dialers (_p2p_channel / _prefetch_channel)
+        # insert into these dicts from repair/router/transport-reader
+        # threads that can still be live here — the mesh keeps receiving
+        # for a beat after close(), and a peer's probe arriving
+        # mid-shutdown dials a reply channel — so an unlocked .values()
+        # iteration dies with "dictionary changed size during iteration"
+        # and leaks every channel after the insertion point.
+        with self._lock:
+            p2p_comms = (
+                list(self._prefetch_comms.values())
+                + list(self._repair_comms.values())
+                + list(self._bootstrap_comms.values())
+                + list(self._owner_comms.values())
+            )
+        for c in p2p_comms:
             c.close()
 
     # ------------------------------------------------------------------
@@ -1389,7 +1405,12 @@ class MeshCache:
             )
             return None
         with self._lock:
-            existing = self._prefetch_comms.setdefault(target_rank, comm)
+            if self._closing:
+                # close() already snapshotted the map: inserting now
+                # would leak the channel forever — refuse the dial.
+                existing = None
+            else:
+                existing = self._prefetch_comms.setdefault(target_rank, comm)
         if existing is not comm:
             comm.close()
         return existing
@@ -1519,7 +1540,12 @@ class MeshCache:
             )
             return None
         with self._lock:
-            existing = comms.setdefault(target_rank, comm)
+            if self._closing:
+                # close() already snapshotted the map: inserting now
+                # would leak the channel forever — refuse the dial.
+                existing = None
+            else:
+                existing = comms.setdefault(target_rank, comm)
         if existing is not comm:
             comm.close()
         return existing

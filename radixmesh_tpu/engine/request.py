@@ -40,6 +40,23 @@ class RequestState(enum.Enum):
     FINISHED = "finished"
 
 
+# The declared admission state machine — checked statically against
+# every assignment/dispatch site in the package by meshcheck's protocol
+# checker (analysis/protocol.py), the same way the lifecycle plane's
+# _VALID_TRANSITIONS is. FINISHED is terminal (a resurrection is a NEW
+# request, server/recovery.py); QUEUED re-entry covers both the
+# restore-complete requeue and mid-decode preemption.
+VALID_TRANSITIONS = {
+    (RequestState.QUEUED, RequestState.RUNNING),      # dispatch
+    (RequestState.QUEUED, RequestState.RESTORING),    # park for staged restore
+    (RequestState.QUEUED, RequestState.FINISHED),     # cancel/shed pre-dispatch
+    (RequestState.RESTORING, RequestState.QUEUED),    # restore landed: requeue
+    (RequestState.RESTORING, RequestState.FINISHED),  # cancel/deadline mid-park
+    (RequestState.RUNNING, RequestState.QUEUED),      # preempt (pool pressure)
+    (RequestState.RUNNING, RequestState.FINISHED),    # stop/cap/cancel/handoff
+}
+
+
 @dataclass
 class Request:
     prompt: np.ndarray  # int32 token ids

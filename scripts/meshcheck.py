@@ -7,16 +7,29 @@ round's schema-pinned ``ANALYSIS_r{N}.json`` artifact (validated
 against ``bench.validate_analysis`` before writing — a violation is
 recorded in the artifact, not silently shipped).
 
-Exit status: 0 = tree clean AND all positive controls tripped;
-1 = findings (or a blind checker); 2 = could not run.
+Exit status (pinned — commit hooks branch on it):
+  0 = tree clean AND all positive controls tripped
+  1 = findings (or a blind checker) — fix or justify in-source
+  2 = framework error: the run itself could not happen (missing
+      fixtures, git unavailable in --changed mode, refused flags)
 
 Usage::
 
-    python scripts/meshcheck.py                # check, print, exit code
+    python scripts/meshcheck.py                # full tree, exit code
+    python scripts/meshcheck.py --changed      # changed files + their
+                                               #   reverse-import deps —
+                                               #   cheap enough per commit
     python scripts/meshcheck.py --json         # full report on stdout
     python scripts/meshcheck.py --write-artifact            # ANALYSIS_r{N}.json
     python scripts/meshcheck.py --write-artifact --out X.json
     python scripts/meshcheck.py --no-fixtures  # skip positive controls
+
+``--changed`` analyzes the WHOLE tree (one parse is the cheap part;
+cross-module checkers need full context) but reports only findings in
+files touched by ``git diff HEAD`` / untracked files, widened to every
+module that transitively imports one (``analysis.changed_scope``).
+Positive controls are skipped in --changed mode unless a file under
+``analysis/`` changed — a checker edit must re-prove its controls.
 
 The quick CI gate runs the same plane in-process as ONE test:
 ``tests/test_analysis.py::test_tree_is_clean``.
@@ -28,6 +41,7 @@ import argparse
 import json
 import os
 import re
+import subprocess
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -35,7 +49,11 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 import bench  # noqa: E402  (schema + validator live with the other validators)
-from radixmesh_tpu.analysis import all_checkers  # noqa: E402
+from radixmesh_tpu.analysis import (  # noqa: E402
+    all_checkers,
+    changed_scope,
+    get_thread_map,
+)
 from radixmesh_tpu.analysis.controls import run_positive_controls  # noqa: E402
 from radixmesh_tpu.analysis.core import (  # noqa: E402
     SourceIndex,
@@ -47,15 +65,57 @@ from radixmesh_tpu.analysis.core import (  # noqa: E402
 def analysis_round() -> int:
     """The round in progress = 1 + the highest N across every OTHER
     plane's recorded ``*_r{N}.json`` artifact (ANALYSIS rides whatever
-    round they are on — e.g. OBS_r09 makes this round 10). ANALYSIS'
-    own artifacts are excluded so a rerun overwrites the current
-    round's file instead of self-incrementing."""
+    round they are on — e.g. OBS_r09 makes this round 10). An existing
+    ANALYSIS artifact at/after that round is OVERWRITTEN only when it
+    already carries the current schema version (a rerun of this round);
+    an older-schema artifact is history — a schema bump starts the next
+    round instead of clobbering it."""
     rounds = [0]
+    analysis_rounds = []
     for name in os.listdir(_REPO_ROOT):
         m = re.fullmatch(r"[A-Z_]+_r(\d+)\.json", name)
-        if m and not name.startswith("ANALYSIS_"):
+        if not m:
+            continue
+        if name.startswith("ANALYSIS_"):
+            analysis_rounds.append((int(m.group(1)), name))
+        else:
             rounds.append(int(m.group(1)))
-    return max(rounds) + 1
+    base = max(rounds) + 1
+    for n, name in sorted(analysis_rounds):
+        if n < base:
+            continue
+        try:
+            with open(os.path.join(_REPO_ROOT, name)) as fh:
+                version = json.load(fh).get("schema_version", 1)
+        except (OSError, ValueError):
+            version = None
+        base = n if version == bench.ANALYSIS_SCHEMA_VERSION else n + 1
+    return base
+
+
+def git_changed_files() -> list[str] | None:
+    """Package-relative paths of changed + untracked ``radixmesh_tpu``
+    modules, or None when git itself fails (framework error)."""
+    out: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=_REPO_ROOT, capture_output=True, text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(ln.strip() for ln in proc.stdout.splitlines() if ln.strip())
+    rels = []
+    for path in sorted(out):
+        if path.startswith("radixmesh_tpu/") and path.endswith(".py"):
+            rels.append(path[len("radixmesh_tpu/"):])
+    return rels
 
 
 def main() -> int:
@@ -75,6 +135,11 @@ def main() -> int:
         help="skip the positive-control pass (a clean verdict then "
         "proves less; the artifact writer refuses this mode)",
     )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="report only findings in git-changed files plus their "
+        "reverse-import dependents (the per-commit gate)",
+    )
     ap.add_argument("--json", action="store_true", help="print the full report")
     ap.add_argument(
         "--write-artifact", action="store_true",
@@ -83,12 +148,50 @@ def main() -> int:
     ap.add_argument("--out", default=None, help="artifact path override")
     args = ap.parse_args()
 
+    if args.changed and args.write_artifact:
+        print(
+            "meshcheck: refusing --write-artifact with --changed (the "
+            "round artifact must cover the whole tree)",
+            file=sys.stderr,
+        )
+        return 2
+
     root = args.root or package_root()
     index = SourceIndex(root)
     result = run_checkers(index, all_checkers())
+    thread_map = get_thread_map(index)
+    scope: set[str] | None = None
+
+    run_fixtures = not args.no_fixtures
+    if args.changed:
+        changed = git_changed_files()
+        if changed is None:
+            print("meshcheck: git diff failed — cannot scope", file=sys.stderr)
+            return 2
+        scope = changed_scope(index, changed)
+        # Fixture controls re-run per commit only when checker code
+        # itself changed — a checker edit must re-prove it still trips.
+        run_fixtures = run_fixtures and any(
+            rel.startswith("analysis/") for rel in changed
+        )
+        # Scope the WHOLE accounting, not just the headline list — a
+        # --json consumer reconciling value/findings against the
+        # per-checker counts must never see a contradiction.
+        result.findings = [f for f in result.findings if f.file in scope]
+        result.raw_by_checker = {
+            k: [f for f in v if f.file in scope]
+            for k, v in result.raw_by_checker.items()
+        }
+        result.kept_by_checker = {
+            k: [f for f in v if f.file in scope]
+            for k, v in result.kept_by_checker.items()
+        }
+        result.suppressed = [
+            (f, s) for f, s in result.suppressed if f.file in scope
+        ]
 
     controls = []
-    if not args.no_fixtures:
+    if run_fixtures:
         controls = run_positive_controls(args.fixtures)
         if not controls:
             print(
@@ -98,7 +201,9 @@ def main() -> int:
             )
             return 2
 
-    report = bench.build_analysis_report(result, controls, len(index.modules))
+    report = bench.build_analysis_report(
+        result, controls, len(index.modules), thread_map.roots
+    )
     blind = [c for c in controls if not c.tripped]
 
     if args.json:
@@ -111,13 +216,18 @@ def main() -> int:
                 f"POSITIVE CONTROL MISSED: {c.fixture} {c.invariant} at "
                 f"{c.file}:{c.line}"
             )
+        scoped = (
+            "" if scope is None
+            else f" (scope: {len(scope)}/{len(index.modules)} changed+dependent files)"
+        )
         print(
             f"meshcheck: {len(index.modules)} files, "
+            f"{len(thread_map.roots)} thread roots, "
             f"{len(result.findings)} finding(s), "
             f"{len(result.suppressed)} suppressed by "
             f"{len(result.suppressions)} justification(s), "
             f"{sum(c.tripped for c in controls)}/{len(controls)} "
-            "controls tripped"
+            f"controls tripped{scoped}"
         )
 
     if args.write_artifact:

@@ -1,0 +1,60 @@
+"""Seeded guarded-by races.
+
+``Plane``: the off-lock write sits TWO helper frames below its thread
+root (``_scan_loop`` → ``_note`` → ``_retire``) — no grep scoped to any
+one function can see that ``_retire``'s pop runs without the lock the
+class's other two write sites hold.
+
+``SplitLocks``: a write reachable from two thread roots with NO common
+lock — each loop is locally "locked", but against different locks, so
+the majority guard (``_la``) is absent on the ``_b_loop`` side.
+"""
+
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {}
+        self._t1 = threading.Thread(target=self._scan_loop, daemon=True)
+        self._t2 = threading.Thread(target=self._apply_loop, daemon=True)
+
+    def _scan_loop(self):
+        while True:
+            with self._lock:
+                self._pending["scan"] = 1
+            self._note()
+
+    def _note(self):
+        self._retire()
+
+    def _retire(self):
+        self._pending.pop("scan", None)  # seeded: guarded-by-race
+
+    def _apply_loop(self):
+        while True:
+            with self._lock:
+                if "scan" in self._pending:
+                    self._pending["scan"] = 2
+
+
+class SplitLocks:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+        self.heat = {}
+        self._ta = threading.Thread(target=self._a_loop, daemon=True)
+        self._tb = threading.Thread(target=self._b_loop, daemon=True)
+
+    def _a_loop(self):
+        with self._la:
+            self.heat["a"] = 1
+
+    def rollup(self):
+        with self._la:
+            self.heat["rollup"] = sum(self.heat.values())
+
+    def _b_loop(self):
+        with self._lb:
+            self.heat["b"] = 1  # seeded: guarded-by-race

@@ -1,9 +1,19 @@
 """Seeded metric-vocabulary breaches: an unprefixed family, a counter
-without ``_total``, and a computed family name."""
+without ``_total``, a computed family name, and a family registered but
+never emitted (the dead-series drift that hid the PR 9 heat-gauge
+clearing bug)."""
 
 
 def register(reg, name_suffix):
     hits = reg.counter("cache_hits_total", "prefix hits")  # seeded: metrics-prefix
     evictions = reg.counter("radixmesh_evictions", "evictions")  # seeded: metrics-unit
     dyn = reg.gauge("radixmesh_" + name_suffix, "computed")  # seeded: metrics-literal
+    hits.inc()
+    evictions.inc()
     return hits, evictions, dyn
+
+
+def register_ghost(reg):
+    reg.counter("radixmesh_ghost_requests_total", "never emitted")  # seeded: metrics-dead
+    live = reg.gauge("radixmesh_live_rows", "emitted below")
+    live.set(1.0)

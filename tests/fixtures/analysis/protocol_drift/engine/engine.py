@@ -1,0 +1,14 @@
+"""Seeded unhandled-state dispatch: the if/elif chain tests two of the
+four declared RequestStates with no else — RESTORING and FINISHED fall
+through silently (the wire_kinds fall-through shape, on a state
+machine)."""
+
+from .request import RequestState
+
+
+class Engine:
+    def poll(self, req):
+        if req.state is RequestState.QUEUED:  # seeded: protocol-unhandled-state
+            return "wait"
+        elif req.state is RequestState.RUNNING:
+            return "go"

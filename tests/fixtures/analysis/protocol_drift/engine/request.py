@@ -1,0 +1,18 @@
+"""The declared request state machine the sibling dispatch drifts from."""
+
+import enum
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RESTORING = "restoring"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+VALID_TRANSITIONS = {
+    (RequestState.QUEUED, RequestState.RUNNING),
+    (RequestState.QUEUED, RequestState.RESTORING),
+    (RequestState.RESTORING, RequestState.QUEUED),
+    (RequestState.RUNNING, RequestState.FINISHED),
+}

@@ -1,0 +1,35 @@
+"""Seeded lifecycle protocol drift: a state with no exit edge, and an
+undeclared LEFT→ACTIVE "revival" transition whose source state is
+statically known from the enclosing compare (nothing un-leaves)."""
+
+import enum
+
+
+class LifecycleState(enum.Enum):
+    BOOTSTRAPPING = "bootstrapping"
+    ACTIVE = "active"
+    DRAINING = "draining"
+    ZOMBIE = "zombie"  # seeded: protocol-no-exit
+    LEFT = "left"
+
+
+_VALID_TRANSITIONS = {
+    (LifecycleState.BOOTSTRAPPING, LifecycleState.ACTIVE),
+    (LifecycleState.ACTIVE, LifecycleState.DRAINING),
+    (LifecycleState.ACTIVE, LifecycleState.ZOMBIE),
+    (LifecycleState.DRAINING, LifecycleState.LEFT),
+}
+
+
+class LifecyclePlane:
+    def __init__(self):
+        self._state = LifecycleState.ACTIVE
+
+    def _transition(self, new):
+        if (self._state, new) not in _VALID_TRANSITIONS:
+            raise RuntimeError("illegal")
+        self._state = new
+
+    def revive(self):
+        if self._state is LifecycleState.LEFT:
+            self._state = LifecycleState.ACTIVE  # seeded: protocol-undeclared-transition

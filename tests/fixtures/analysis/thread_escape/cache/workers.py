@@ -1,0 +1,15 @@
+"""Seeded thread-map escapes: a lambda target no call graph can enter
+(every frame it runs is invisible to the concurrency plane), and a
+spawn without ``daemon=True`` that would wedge interpreter shutdown."""
+
+import threading
+
+
+class Workers:
+    def start(self):
+        t = threading.Thread(target=lambda: None, daemon=True)  # seeded: thread-target-unresolved
+        u = threading.Thread(target=self._run)  # seeded: thread-daemonless
+        return t, u
+
+    def _run(self):
+        pass

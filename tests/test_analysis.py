@@ -121,6 +121,36 @@ class TestPositiveControls:
         _tripped(controls, "suppression_grammar", "suppression-grammar")
         _tripped(controls, "suppression_grammar", "sleep-audit")
 
+    def test_seeded_offlock_write_two_helpers_down(self, controls):
+        """The guarded-by race: an off-lock write two helper frames
+        below its thread root, and a write reachable from two roots
+        with no common lock — both grep-invisible."""
+        hits = _tripped(controls, "guarded_race", "guarded-by-race")
+        assert {c.file for c in hits} == {"cache/plane.py"}
+        assert len(hits) == 2  # the helper-nested pop AND the split-lock write
+
+    def test_seeded_thread_escapes(self, controls):
+        """A lambda thread target escapes the map (blinding every
+        downstream concurrency verdict); a daemonless spawn wedges
+        shutdown."""
+        _tripped(controls, "thread_escape", "thread-target-unresolved")
+        _tripped(controls, "thread_escape", "thread-daemonless")
+
+    def test_seeded_protocol_drift(self, controls):
+        """An undeclared LEFT→ACTIVE revival (source known from the
+        enclosing compare), a state with no exit edge, and a dispatch
+        that silently drops two declared RequestStates."""
+        hits = _tripped(
+            controls, "protocol_drift", "protocol-undeclared-transition"
+        )
+        assert hits[0].file == "policy/lifecycle.py"
+        _tripped(controls, "protocol_drift", "protocol-no-exit")
+        hits = _tripped(controls, "protocol_drift", "protocol-unhandled-state")
+        assert hits[0].file == "engine/engine.py"
+
+    def test_seeded_dead_metric(self, controls):
+        _tripped(controls, "metrics_vocab", "metrics-dead")
+
 
 # ---------------------------------------------------------------------------
 # suppression grammar, live
@@ -421,8 +451,341 @@ class TestGrepInvisible:
 
 
 # ---------------------------------------------------------------------------
-# the CLI is the same plane
+# concurrency plane, live: the lock-set / thread-root / protocol rules
+# on synthetic trees (the shapes the gates must keep legal vs flag)
 # ---------------------------------------------------------------------------
+
+
+class TestGuardedByLive:
+    def test_compositional_lock_chain_stays_clean(self, tmp_path):
+        """A write three helper frames below the lock acquisition is
+        GUARDED — the ambient-set fixpoint follows the chain (the shape
+        that would false-positive under naive one-frame analysis:
+        oplog_received -> _gc_handle -> _fold -> del)."""
+        res = _run_on(tmp_path, "cache/plane.py", """\
+            import threading
+
+            class Plane:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pending = {}
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+                    self._u = threading.Thread(target=self._other, daemon=True)
+
+                def _loop(self):
+                    with self._lock:
+                        self._handle()
+
+                def _handle(self):
+                    self._fold()
+
+                def _fold(self):
+                    self._pending["x"] = 1
+
+                def _other(self):
+                    with self._lock:
+                        self._pending.pop("x", None)
+            """)
+        assert res.clean, res.pretty()
+
+    def test_single_root_state_never_fires(self, tmp_path):
+        """Engine-thread-only fields are allowed to mix locked and
+        unlocked access: one non-multi root cannot race itself."""
+        res = _run_on(tmp_path, "cache/plane.py", """\
+            import threading
+
+            class Plane:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+
+                def _loop(self):
+                    with self._lock:
+                        self._n = 1
+                    with self._lock:
+                        self._n = 2
+                    self._n = 3
+            """)
+        assert res.clean, res.pretty()
+
+    def test_deviant_read_against_unanimous_convention(self, tmp_path):
+        """Every access but one holds the guard, a guarded write runs on
+        another thread → the deviant read is a read-write race."""
+        res = _run_on(tmp_path, "cache/plane.py", """\
+            import threading
+
+            class Plane:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._map = {}
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+
+                def _loop(self):
+                    with self._lock:
+                        self._map["k"] = 1
+                    with self._lock:
+                        self._map["j"] = 2
+
+                def snapshot(self):
+                    return dict(self._map)
+            """)
+        hits = [f for f in res.findings if f.invariant == "guarded-by-race"]
+        assert hits and "read-write" in hits[0].message, res.pretty()
+
+    def test_volatile_read_idiom_stays_legal(self, tmp_path):
+        """TWO lock-free reads break unanimity — the codebase's own
+        convention declares the snapshot-read idiom legal here."""
+        res = _run_on(tmp_path, "cache/plane.py", """\
+            import threading
+
+            class Plane:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._map = {}
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+
+                def _loop(self):
+                    with self._lock:
+                        self._map["k"] = 1
+                    with self._lock:
+                        self._map["j"] = 2
+
+                def snapshot(self):
+                    return dict(self._map)
+
+                def peek(self):
+                    return len(self._map)
+            """)
+        assert res.clean, res.pretty()
+
+    def test_offlock_write_inside_spawned_closure(self, tmp_path):
+        """The hedge-leg shape: a closure handed to Thread runs OFF the
+        spawning frame's locks — an off-lock write inside it races the
+        guarded writes (review finding: the nested-def skip must not
+        blind the checker to spawned closures)."""
+        res = _run_on(tmp_path, "server/hedge.py", """\
+            import threading
+
+            class Hedger:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._winner = {}
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+
+                def _loop(self):
+                    with self._lock:
+                        self._winner["w"] = 1
+                    with self._lock:
+                        self._winner["v"] = 2
+
+                def race(self):
+                    def leg():
+                        self._winner["x"] = 3
+
+                    t = threading.Thread(target=leg, daemon=True)
+                    t.start()
+            """)
+        hits = [f for f in res.findings if f.invariant == "guarded-by-race"]
+        assert hits, res.pretty()
+
+    def test_inline_closure_under_lock_stays_clean(self, tmp_path):
+        """A closure called INLINE (sort key, local helper) runs on the
+        caller's thread under the caller's locks — only spawned
+        closures get the empty held set."""
+        res = _run_on(tmp_path, "server/sorter.py", """\
+            import threading
+
+            class Sorter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rows = {}
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+                    self._u = threading.Thread(target=self._loop2, daemon=True)
+
+                def _loop(self):
+                    with self._lock:
+                        def bump():
+                            self._rows["a"] = 1
+
+                        bump()
+
+                def _loop2(self):
+                    with self._lock:
+                        self._rows["b"] = 2
+            """)
+        assert res.clean, res.pretty()
+
+    def test_threadsafe_containers_exempt(self, tmp_path):
+        """Queue/Event attributes are internally synchronized — method
+        calls on them from any thread are not races."""
+        res = _run_on(tmp_path, "cache/plane.py", """\
+            import queue
+            import threading
+
+            class Plane:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                    self._evt = threading.Event()
+                    self._t = threading.Thread(target=self._loop, daemon=True)
+
+                def _loop(self):
+                    with self._lock:
+                        self._q.put_nowait(1)
+                    self._evt.set()
+
+                def submit(self):
+                    self._q.put_nowait(2)
+                    self._evt.clear()
+            """)
+        assert res.clean, res.pretty()
+
+
+class TestThreadMapLive:
+    def test_declared_roots_still_resolve(self):
+        """Same rot-guard as the hot-path entry points: a rename that
+        silently dropped a declared root would hollow out the
+        concurrency plane while everything stayed green."""
+        from radixmesh_tpu.analysis import tree_index
+        from radixmesh_tpu.analysis.thread_roots import DECLARED_ROOTS
+        from radixmesh_tpu.analysis.callgraph import get_callgraph
+
+        cg = get_callgraph(tree_index())
+        for rel, qual, name, _multi in DECLARED_ROOTS:
+            assert (rel, qual) in cg.funcs, f"declared root {name} vanished"
+
+    def test_product_tree_thread_map_is_complete(self):
+        """The documented long-lived threads all resolve as roots, and
+        the map is finding-free (every target resolved, every spawn
+        daemon=True)."""
+        from radixmesh_tpu.analysis import check_tree, get_thread_map, tree_index
+
+        assert not [
+            f for f in check_tree().findings
+            if f.invariant in ("thread-target-unresolved", "thread-daemonless")
+        ]
+        names = {r.name for r in get_thread_map(tree_index()).roots}
+        for expected in (
+            "mesh-sender", "mesh-owner-sender", "mesh-ticker", "mesh-gc",
+            "mesh-housekeeper", "kv-transfer", "repair-plane",
+            "lifecycle-plane", "lifecycle-drain", "engine-runner",
+            "wire-receive", "engine-loop",
+        ):
+            assert expected in names, f"thread root {expected!r} vanished"
+        # Per-connection concurrency is modeled: the HTTP handlers and
+        # the wire receive path are multi-instance roots.
+        tm = get_thread_map(tree_index())
+        assert tm.is_multi("wire-receive")
+        assert any(r.kind == "handler" and r.multi for r in tm.roots)
+
+    def test_nested_def_target_maps_to_enclosing(self, tmp_path):
+        """A closure handed to Thread (the hedge-leg shape) resolves to
+        its enclosing frame instead of escaping the map."""
+        res = _run_on(tmp_path, "server/hedge.py", """\
+            import threading
+
+            class Hedger:
+                def race(self):
+                    def leg():
+                        return 1
+
+                    t = threading.Thread(target=leg, daemon=True)
+                    t.start()
+            """)
+        assert not [
+            f for f in res.findings
+            if f.invariant == "thread-target-unresolved"
+        ], res.pretty()
+
+
+class TestProtocolLive:
+    def test_dispatch_with_else_is_exhaustive(self, tmp_path):
+        res = _run_on(tmp_path, "engine/engine.py", """\
+            from .request import RequestState
+
+            class Engine:
+                def poll(self, req):
+                    if req.state is RequestState.QUEUED:
+                        return "wait"
+                    elif req.state is RequestState.RUNNING:
+                        return "go"
+                    else:
+                        return "done"
+            """)
+        # No engine/request.py in this tree: the spec module is absent,
+        # so nothing fires either way — exhaustiveness needs the enum.
+        assert res.clean, res.pretty()
+
+    def test_declared_transition_stays_legal(self, tmp_path):
+        (tmp_path / "engine").mkdir(parents=True, exist_ok=True)
+        (tmp_path / "engine" / "request.py").write_text(textwrap.dedent("""\
+            import enum
+
+            class RequestState(enum.Enum):
+                QUEUED = "queued"
+                RUNNING = "running"
+                FINISHED = "finished"
+
+            VALID_TRANSITIONS = {
+                (RequestState.QUEUED, RequestState.RUNNING),
+                (RequestState.RUNNING, RequestState.FINISHED),
+            }
+            """))
+        res = _run_on(tmp_path, "engine/engine.py", """\
+            from .request import RequestState
+
+            class Engine:
+                def finish(self, req):
+                    if req.state is RequestState.RUNNING:
+                        req.state = RequestState.FINISHED
+            """)
+        assert res.clean, res.pretty()
+
+    def test_product_request_table_covers_every_live_transition(self):
+        """Runtime cross-check of the declared table: every enum member
+        participates, FINISHED is terminal, QUEUED is re-enterable
+        (preempt + restore-requeue)."""
+        from radixmesh_tpu.engine.request import (
+            RequestState,
+            VALID_TRANSITIONS,
+        )
+
+        members = set(RequestState)
+        assert {s for s, _ in VALID_TRANSITIONS} == members - {
+            RequestState.FINISHED
+        }
+        assert {d for _, d in VALID_TRANSITIONS} == members
+        assert (RequestState.RUNNING, RequestState.QUEUED) in VALID_TRANSITIONS
+
+
+# ---------------------------------------------------------------------------
+# --changed scoping: the per-commit gate
+# ---------------------------------------------------------------------------
+
+
+class TestChangedScope:
+    def test_scope_widens_by_reverse_imports(self, tmp_path):
+        from radixmesh_tpu.analysis import changed_scope
+
+        (tmp_path / "utils").mkdir()
+        (tmp_path / "cache").mkdir()
+        (tmp_path / "server").mkdir()
+        (tmp_path / "utils" / "base.py").write_text("def f():\n    return 1\n")
+        (tmp_path / "cache" / "mid.py").write_text(
+            "from radixmesh_tpu.utils.base import f\n"
+        )
+        (tmp_path / "server" / "top.py").write_text(
+            "from radixmesh_tpu.cache.mid import f\n"
+        )
+        (tmp_path / "server" / "aloof.py").write_text("x = 1\n")
+        index = SourceIndex(tmp_path)
+        scope = changed_scope(index, ["utils/base.py"])
+        # The change widens transitively up the import chain but never
+        # touches unrelated modules.
+        assert scope == {"utils/base.py", "cache/mid.py", "server/top.py"}
+        assert changed_scope(index, ["server/aloof.py"]) == {"server/aloof.py"}
+        assert changed_scope(index, ["gone/deleted.py"]) == set()
 
 def test_meshcheck_cli_exit_zero_on_clean_tree():
     proc = subprocess.run(
@@ -432,3 +795,28 @@ def test_meshcheck_cli_exit_zero_on_clean_tree():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 finding(s)" in proc.stdout
     assert "controls tripped" in proc.stdout
+    assert "thread roots" in proc.stdout
+
+
+def test_meshcheck_cli_changed_mode():
+    """The per-commit gate: scoped to git-changed files + reverse-import
+    dependents, same exit-code contract (0 = clean; a dirty tree in CI
+    is clean too, because the full tree is clean)."""
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "meshcheck.py"), "--changed"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "scope:" in proc.stdout
+
+
+def test_meshcheck_cli_changed_refuses_artifact():
+    proc = subprocess.run(
+        [
+            sys.executable, str(_REPO / "scripts" / "meshcheck.py"),
+            "--changed", "--write-artifact",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "whole tree" in proc.stderr

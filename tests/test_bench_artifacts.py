@@ -812,14 +812,16 @@ class TestObsArtifactSchema:
 
 
 class TestAnalysisArtifactSchema:
-    """ANALYSIS v1 (PR 10, meshcheck): the static-analysis plane's
-    artifact gates on ZERO unsuppressed findings over the tree, every
-    default checker present, every positive-control fixture tripped
-    (a clean verdict is only evidence when the checkers demonstrably
-    still see the seeded bug classes), and a justification on every
-    suppression."""
+    """ANALYSIS v2 (PR 11, meshcheck concurrency plane): the artifact
+    gates on ZERO unsuppressed findings over the tree, every default
+    checker present (now including thread-roots/guarded-by/protocol,
+    each with per-checker control counts), every positive-control
+    fixture tripped, a justification on every suppression, and a
+    non-empty derived thread map. v1 artifacts stay valid against the
+    v1 field/checker sets."""
 
-    def _report(self) -> dict:
+    def _report(self, version: int | None = None) -> dict:
+        version = bench.ANALYSIS_SCHEMA_VERSION if version is None else version
         checker = {
             "id": "lock-order",
             "description": "x",
@@ -827,15 +829,19 @@ class TestAnalysisArtifactSchema:
             "kept_findings": 0,
             "suppressed": 0,
         }
-        return {
-            "schema_version": bench.ANALYSIS_SCHEMA_VERSION,
+        ids = (
+            bench.ANALYSIS_CHECKER_IDS if version >= 2
+            else bench.ANALYSIS_CHECKER_IDS_V1
+        )
+        if version >= 2:
+            checker.update(controls=1, controls_tripped=1)
+        report = {
+            "schema_version": version,
             "metric": "unsuppressed_findings",
             "value": 0,
             "package": "radixmesh_tpu",
             "files_indexed": 80,
-            "checkers": [
-                dict(checker, id=cid) for cid in bench.ANALYSIS_CHECKER_IDS
-            ],
+            "checkers": [dict(checker, id=cid) for cid in ids],
             "findings": [],
             "suppressions": [
                 {
@@ -855,9 +861,76 @@ class TestAnalysisArtifactSchema:
             ],
             "clean": True,
         }
+        if version >= 2:
+            report["thread_roots"] = {
+                "count": 2,
+                "roots": [
+                    {
+                        "name": "mesh-sender",
+                        "target": "cache/mesh_cache.py:MeshCache._sender",
+                        "file": "cache/mesh_cache.py", "line": 624,
+                        "multi": False, "kind": "spawn",
+                    },
+                    {
+                        "name": "wire-receive",
+                        "target": "cache/mesh_cache.py:MeshCache.oplog_received",
+                        "file": "cache/mesh_cache.py", "line": 905,
+                        "multi": True, "kind": "declared",
+                    },
+                ],
+            }
+        return report
 
     def test_valid_report_passes(self):
         assert bench.validate_analysis(self._report()) == []
+
+    def test_v1_report_stays_valid(self):
+        """A PR 10 artifact (no thread map, five checkers, no control
+        counts) still validates — old rounds stay comparable."""
+        assert bench.validate_analysis(self._report(version=1)) == []
+
+    def test_v1_checked_in_artifact_still_validates(self):
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(repo, "ANALYSIS_r10.json")
+        with open(path) as fh:
+            assert bench.validate_analysis(json.load(fh)) == []
+
+    def test_v2_requires_concurrency_checkers(self):
+        report = self._report()
+        report["checkers"] = [
+            c for c in report["checkers"] if c["id"] != "guarded-by"
+        ]
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "guarded-by" in problems and "checked less" in problems
+
+    def test_v2_requires_thread_map(self):
+        report = self._report()
+        del report["thread_roots"]
+        assert any(
+            "thread_roots" in p for p in bench.validate_analysis(report)
+        )
+        report = self._report()
+        report["thread_roots"] = {"count": 0, "roots": []}
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "checked nothing" in problems
+
+    def test_v2_thread_map_entries_are_schema_complete(self):
+        report = self._report()
+        del report["thread_roots"]["roots"][0]["multi"]
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "mesh-sender" in problems and ".multi" in problems
+        report = self._report()
+        report["thread_roots"]["count"] = 5
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "disagrees" in problems
+
+    def test_v2_requires_control_counts(self):
+        report = self._report()
+        del report["checkers"][0]["controls_tripped"]
+        problems = "\n".join(bench.validate_analysis(report))
+        assert "controls_tripped" in problems
 
     def test_missing_fields_reported(self):
         report = self._report()
@@ -930,4 +1003,10 @@ class TestAnalysisArtifactSchema:
             "lock_cycle", "single_writer_alias", "hotpath_sleep",
             "wire_unregistered", "metrics_vocab", "send_seam",
             "suppression_grammar",
+            # v2 (PR 11): the concurrency plane's controls.
+            "guarded_race", "thread_escape", "protocol_drift",
         } <= fixtures
+        # v2: the thread map shipped with the verdict it parameterized.
+        assert report["thread_roots"]["count"] >= 15
+        root_names = {r["name"] for r in report["thread_roots"]["roots"]}
+        assert {"mesh-sender", "wire-receive", "kv-transfer"} <= root_names

@@ -59,6 +59,26 @@ class TestHotPathSyncLint:
         ]
         assert not bad, "\n".join(str(f) for f in bad)
 
+    def test_entry_points_and_thread_map_share_one_call_graph(self):
+        """PR 11 refactor guard: the hot-path checker, the thread map,
+        and guarded-by all resolve through ONE CallGraph per index
+        (analysis/callgraph.py) — a second derivation could silently
+        diverge on resolution rules, and the whole point of the shared
+        substrate is that a reachability fact proven for one checker
+        holds for all of them."""
+        from radixmesh_tpu.analysis.callgraph import get_callgraph
+        from radixmesh_tpu.analysis.hot_path import DEFAULT_ENTRY_POINTS
+
+        index = _index()
+        cg = get_callgraph(index)
+        assert get_callgraph(index) is cg  # memoized on the index
+        # The serving entry points resolve in the same graph the thread
+        # map used, and each reaches a non-trivial frame set.
+        for ep in DEFAULT_ENTRY_POINTS:
+            assert ep in cg.funcs, f"entry point {ep} not in the call graph"
+        reachable, _chains = cg.reach(DEFAULT_ENTRY_POINTS)
+        assert len(reachable) > 50, "serving call graph collapsed"
+
     def test_staging_module_is_the_only_sync_owner(self):
         """Positive control: the banned constructs ARE present in the
         staging module (the checker scopes ban real patterns, not

@@ -532,3 +532,57 @@ class TestPrefetchHints:
                 for n in cluster.ring_nodes
             )
         )
+
+
+class TestCloseVsDialRace:
+    """close() vs a racing lazy channel dial (the guarded-by race class:
+    the dedicated-channel maps are inserted into under the mesh lock by
+    repair/router/transport-reader threads that can still be live while
+    close() runs — the mesh keeps receiving for a beat on the exit
+    path). close() must snapshot the maps under the lock; iterating the
+    live dicts dies with "dictionary changed size during iteration" and
+    leaks every channel after the insertion point."""
+
+    @pytest.mark.quick
+    def test_close_survives_concurrent_channel_dial(self):
+        cfg = MeshConfig(
+            prefill_nodes=["p0", "p1"],
+            decode_nodes=[],
+            router_nodes=[],
+            local_addr="p0",
+            protocol="inproc",
+            tick_interval_s=0.1,
+            gc_interval_s=600.0,
+        )
+        mesh = MeshCache(cfg, pool=None).start()
+        closed: list[str] = []
+
+        class _Chan:
+            def __init__(self, name, on_close=None):
+                self.name = name
+                self.on_close = on_close
+
+            def close(self):
+                closed.append(self.name)
+                if self.on_close is not None:
+                    self.on_close()
+
+        # The first channel's close simulates a dialer landing mid-
+        # iteration: it inserts a NEW entry into the same map (exactly
+        # what _p2p_channel does under the lock from another thread).
+        def racing_dial():
+            mesh._repair_comms[97] = _Chan("race-late")
+
+        mesh._repair_comms[11] = _Chan("r11", on_close=racing_dial)
+        mesh._repair_comms[12] = _Chan("r12")
+        mesh._prefetch_comms[13] = _Chan("p13")
+        mesh.close()  # must not raise
+        # Every channel present when close() snapshotted is closed; the
+        # racing insert cannot crash the iteration.
+        assert {"r11", "r12", "p13"} <= set(closed)
+        # And the dialers REFUSE after close: a dial that loses the race
+        # to the snapshot closes its own channel instead of inserting
+        # one nothing will ever close (the leak half of the race).
+        before = dict(mesh._repair_comms)
+        assert mesh._p2p_channel(1, mesh._repair_comms) is None
+        assert mesh._repair_comms == before

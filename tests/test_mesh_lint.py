@@ -234,6 +234,74 @@ class TestOwnershipSingleWriter:
         pytest.fail("_after_view_change vanished from mesh_cache.py")
 
 
+class TestConcurrencyPlane:
+    """PR 11's meshcheck v2: guarded-by race inference, the tree-wide
+    thread map, and protocol state-machine checks over the product
+    tree. Each wrapper asserts zero unsuppressed findings; the positive
+    controls (tests/fixtures/analysis/{guarded_race,thread_escape,
+    protocol_drift}) prove the checkers still see the bug classes."""
+
+    def test_no_guarded_by_races(self):
+        bad = _kept("guarded-by-race")
+        assert not bad, "\n".join(str(f) for f in bad)
+
+    def test_guarded_by_ledger_is_live(self):
+        """The suppression ledger carries at least the documented
+        double-checked fast path (kv_transfer.host_slots_ok) and every
+        guarded-by excuse is used — the excuse-ledger rot rule."""
+        sups = [
+            s for s in _result().suppressions
+            if "guarded-by-race" in s.invariants
+        ]
+        assert sups and all(s.used for s in sups)
+        assert any(s.file == "cache/kv_transfer.py" for s in sups)
+
+    def test_thread_map_is_complete(self):
+        """Every Thread/Timer target resolves and every spawn is
+        daemon=True — an escaped target blinds guarded-by downstream."""
+        bad = _kept("thread-target-unresolved", "thread-daemonless")
+        assert not bad, "\n".join(str(f) for f in bad)
+
+    def test_positive_control_thread_map_sees_the_mesh_threads(self):
+        """The map is non-vacuous: the documented mesh sender loops and
+        the kv-transfer worker resolve as roots on the real tree."""
+        from radixmesh_tpu.analysis import get_thread_map
+
+        names = {r.name for r in get_thread_map(_index()).roots}
+        assert {"mesh-sender", "mesh-owner-sender", "kv-transfer"} <= names
+
+    def test_no_protocol_drift(self):
+        bad = _kept(
+            "protocol-undeclared-transition", "protocol-no-exit",
+            "protocol-unhandled-state", "protocol-no-table",
+        )
+        assert not bad, "\n".join(str(f) for f in bad)
+
+    def test_positive_control_declared_tables_exist(self):
+        """Both protocol tables parse off the real tree — a vanished
+        table would make the whole check vacuous (and is itself a
+        finding, protocol-no-table)."""
+        import ast as _ast
+
+        from radixmesh_tpu.analysis.protocol import (
+            DEFAULT_PROTOCOLS,
+            ProtocolChecker,
+        )
+
+        chk = ProtocolChecker()
+        for spec in DEFAULT_PROTOCOLS:
+            tree = _index().module(spec.module).tree
+            members = chk._enum_members(tree, spec.enum)
+            table, line = chk._table(tree, spec)
+            assert members, f"{spec.enum} vanished from {spec.module}"
+            assert line is not None and table, (
+                f"{spec.table} vanished from {spec.module}"
+            )
+            # Every edge references declared members only.
+            for s, d in table:
+                assert s in members and d in members, (spec.name, s, d)
+
+
 class TestShardHeatSingleWriter:
     """Per-shard heat counting has ONE writer (cache/mesh_cache.py; the
     class lives in cache/sharding.py) — a second counter would

@@ -102,6 +102,116 @@ class TestMetricHygiene:
         ]
         assert not bad, "\n".join(str(f) for f in bad)
 
+    def test_no_dead_families(self):
+        """Every registered family has an emit site somewhere in the
+        tree (the static dead-series detector): a registered-but-silent
+        family reads as 'zero activity' on every dashboard — the drift
+        that hid the PR 9 heat-gauge clearing bug."""
+        from radixmesh_tpu.analysis import check_tree
+
+        dead = [
+            f for f in check_tree().findings if f.invariant == "metrics-dead"
+        ]
+        assert not dead, "\n".join(str(f) for f in dead)
+
+    def test_positive_control_dead_family_detected(self, tmp_path):
+        """The detector still SEES a silent family — and handle flow
+        through a labels() fan-out keeps a live one quiet."""
+        import textwrap
+
+        from radixmesh_tpu.analysis.core import SourceIndex
+        from radixmesh_tpu.analysis.metrics_vocab import MetricsVocabChecker
+
+        (tmp_path / "obs").mkdir()
+        (tmp_path / "obs" / "plane.py").write_text(textwrap.dedent("""\
+            from radixmesh_tpu.obs.metrics import get_registry
+
+            class Plane:
+                def __init__(self, node):
+                    reg = get_registry()
+                    fam = reg.counter("radixmesh_live_ops_total", "d", ("node", "kind"))
+                    self._m = {k: fam.labels(node=node, kind=k) for k in ("a", "b")}
+                    self._silent = reg.gauge("radixmesh_silent_rows", "d", ("node",))
+
+                def tick(self):
+                    self._m["a"].inc()
+            """))
+        found = MetricsVocabChecker().check(SourceIndex(tmp_path))
+        dead = [f for f in found if f.invariant == "metrics-dead"]
+        assert len(dead) == 1, found
+        assert "radixmesh_silent_rows" in dead[0].message
+
+    def test_dead_family_not_hidden_by_name_collision(self, tmp_path):
+        """Taint is module-scoped (review finding): two unrelated
+        modules both calling their handle ``self._m`` must not alias —
+        module B's emit must not mark module A's dead family live."""
+        import textwrap
+
+        from radixmesh_tpu.analysis.core import SourceIndex
+        from radixmesh_tpu.analysis.metrics_vocab import MetricsVocabChecker
+
+        (tmp_path / "obs").mkdir()
+        (tmp_path / "obs" / "a.py").write_text(textwrap.dedent("""\
+            from radixmesh_tpu.obs.metrics import get_registry
+
+            class A:
+                def __init__(self):
+                    self._m = get_registry().gauge("radixmesh_dead_rows", "d", ())
+            """))
+        (tmp_path / "obs" / "b.py").write_text(textwrap.dedent("""\
+            from radixmesh_tpu.obs.metrics import get_registry
+
+            class B:
+                def __init__(self):
+                    self._m = get_registry().counter("radixmesh_live_ops_total", "d", ())
+
+                def tick(self):
+                    self._m.inc()
+            """))
+        found = MetricsVocabChecker().check(SourceIndex(tmp_path))
+        dead = [f for f in found if f.invariant == "metrics-dead"]
+        assert len(dead) == 1, found
+        assert "radixmesh_dead_rows" in dead[0].message
+
+    def test_factory_and_getattr_flow_cross_module(self, tmp_path):
+        """The two legal cross-module edges stay open: a handle factory
+        reached through an import, and a literal getattr indirection."""
+        import textwrap
+
+        from radixmesh_tpu.analysis.core import SourceIndex
+        from radixmesh_tpu.analysis.metrics_vocab import MetricsVocabChecker
+
+        (tmp_path / "obs").mkdir()
+        (tmp_path / "cache").mkdir()
+        (tmp_path / "obs" / "fams.py").write_text(textwrap.dedent("""\
+            from radixmesh_tpu.obs.metrics import get_registry
+
+            def make_counters():
+                fam = get_registry().counter("radixmesh_made_ops_total", "d", ("k",))
+                return {k: fam.labels(k=k) for k in ("a", "b")}
+
+            class Owner:
+                def __init__(self):
+                    self._m_indirect = get_registry().gauge(
+                        "radixmesh_indirect_rows", "d", ())
+            """))
+        (tmp_path / "cache" / "user.py").write_text(textwrap.dedent("""\
+            from radixmesh_tpu.obs.fams import make_counters
+
+            class User:
+                def __init__(self):
+                    self._m = make_counters()
+
+                def tick(self, owner):
+                    self._m["a"].inc()
+                    g = getattr(owner, "_m_indirect", None)
+                    if g is not None:
+                        g.set(1.0)
+            """))
+        found = MetricsVocabChecker().check(SourceIndex(tmp_path))
+        dead = [f for f in found if f.invariant == "metrics-dead"]
+        assert not dead, found
+
     def test_all_families_prefixed_and_unit_suffixed(self):
         _register_all_instrumented_families()
         fams = _registered_families()
