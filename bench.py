@@ -1191,6 +1191,519 @@ def build_analysis_report(
     }
 
 
+# ----------------------------------------------------------------------
+# DOCTOR stable schema (PR 12, the diagnosis plane): the acceptance
+# artifact for ``obs/doctor.py`` + ``obs/attribution.py``. One JSON per
+# round recording (a) ZERO findings over a provably healthy cluster
+# phase with every rule running, (b) three deterministically seeded
+# pathologies each NAMED by the doctor with correct pinned evidence
+# (the hot shard's true owner set, the convoying shape, the throttled
+# restore lane), (c) the critical-path decomposition summing to e2e
+# within epsilon on every audited request, and (d) the benchdiff
+# sentinel proving ``compare_rounds`` flags a synthetic regression while
+# passing an identical pair. ``workload.run_doctor_workload`` produces
+# the data; ``scripts/doctor.py --workload`` emits the artifact. Bump
+# the version ONLY when adding fields (never remove or rename).
+# ----------------------------------------------------------------------
+
+DOCTOR_SCHEMA_VERSION = 1
+
+DOCTOR_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload", "nodes",
+    "topology", "replication_factor", "healthy", "pathologies",
+    "attribution", "benchdiff", "wall_s",
+)
+DOCTOR_HEALTHY_FIELDS = (
+    "performed", "findings", "rules_checked", "inputs", "audited_requests",
+)
+# Every pathology section: did the doctor fire the right rule, and did
+# the finding's evidence match the seeded ground truth.
+DOCTOR_PATHOLOGY_FIELDS = (
+    "performed", "rule", "detected", "evidence_correct", "score",
+    "summary", "evidence", "expected",
+)
+# The three seeded pathologies the acceptance run must name.
+DOCTOR_PATHOLOGIES = ("hot_shard", "prefill_convoy", "restore_park_stall")
+DOCTOR_ATTRIBUTION_FIELDS = (
+    "audited", "refused", "max_sum_error_s", "epsilon_s", "sums_ok",
+    "phases",
+)
+DOCTOR_BENCHDIFF_FIELDS = (
+    "identical_clean", "regression_flagged", "mismatch_detected",
+)
+# |sum(exclusive phase times) - e2e| ceiling per audited request: the
+# decomposition is exact by construction (each elementary segment lands
+# in exactly one phase), so only float addition error is tolerated.
+DOCTOR_SUM_EPSILON_S = 1e-6
+
+
+def validate_doctor(report) -> list[str]:
+    """Schema violations of a DOCTOR artifact vs the pinned contract
+    (empty = valid). Gates: the healthy phase ran ALL rules and found
+    nothing; each seeded pathology was detected by its rule with
+    evidence matching the seeded ground truth (carrying at least the
+    rule's pinned evidence fields); every audited request's phase
+    decomposition summed to its e2e within epsilon with zero holed-trace
+    refusals; and the benchdiff sentinel passed an identical pair while
+    flagging a synthetic regression and a schema mismatch. Sections with
+    performed=False are schema-valid but gate-exempt (the CHAOS v2/v3
+    convention). Import-safe from artifact tests and scripts/doctor.py
+    (no jax at module scope)."""
+    from radixmesh_tpu.obs.doctor import RULE_EVIDENCE_FIELDS, RULES
+
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in DOCTOR_TOP_FIELDS if f not in report]
+    healthy = report.get("healthy")
+    if isinstance(healthy, dict) and healthy.get("performed"):
+        problems += [
+            f"healthy.{f}" for f in DOCTOR_HEALTHY_FIELDS if f not in healthy
+        ]
+        if healthy.get("findings") != []:
+            problems.append(
+                "healthy: the doctor reported findings on the healthy "
+                f"phase ({healthy.get('findings')}) — a diagnosis plane "
+                "that cries wolf gets muted"
+            )
+        checked = healthy.get("rules_checked") or []
+        missing_rules = [r for r in RULES if r not in checked]
+        if missing_rules:
+            problems.append(
+                f"healthy: rules {missing_rules} never ran — 'no findings' "
+                "is only evidence when every rule looked"
+            )
+        if not healthy.get("audited_requests", 0):
+            problems.append(
+                "healthy: zero audited requests — the healthy verdict "
+                "never saw real traffic"
+            )
+    pathologies = report.get("pathologies")
+    if isinstance(pathologies, dict):
+        problems += [
+            f"pathologies.{p}" for p in DOCTOR_PATHOLOGIES
+            if p not in pathologies
+        ]
+        for name in DOCTOR_PATHOLOGIES:
+            sec = pathologies.get(name)
+            if not isinstance(sec, dict) or not sec.get("performed"):
+                continue
+            problems += [
+                f"pathologies.{name}.{f}"
+                for f in DOCTOR_PATHOLOGY_FIELDS
+                if f not in sec
+            ]
+            if sec.get("detected") is not True:
+                problems.append(
+                    f"pathologies.{name}: the seeded pathology was NOT "
+                    "detected"
+                )
+            if sec.get("evidence_correct") is not True:
+                problems.append(
+                    f"pathologies.{name}: finding evidence does not match "
+                    f"the seeded ground truth ({sec.get('evidence')} vs "
+                    f"expected {sec.get('expected')})"
+                )
+            ev = sec.get("evidence")
+            if isinstance(ev, dict):
+                missing_ev = [
+                    k
+                    for k in RULE_EVIDENCE_FIELDS.get(sec.get("rule"), ())
+                    if k not in ev
+                ]
+                if missing_ev:
+                    problems.append(
+                        f"pathologies.{name}: evidence missing pinned "
+                        f"fields {missing_ev}"
+                    )
+    attribution = report.get("attribution")
+    if isinstance(attribution, dict):
+        problems += [
+            f"attribution.{f}"
+            for f in DOCTOR_ATTRIBUTION_FIELDS
+            if f not in attribution
+        ]
+        if not attribution.get("audited", 0):
+            problems.append("attribution: zero audited waterfalls")
+        if attribution.get("sums_ok") is not True:
+            problems.append(
+                "attribution: phase decomposition did NOT sum to e2e "
+                f"within epsilon (max error "
+                f"{attribution.get('max_sum_error_s')}s > "
+                f"{attribution.get('epsilon_s')}s)"
+            )
+        if attribution.get("refused", 0):
+            problems.append(
+                f"attribution: {attribution.get('refused')} holed-trace "
+                "refusal(s) during the acceptance run (the recorder ring "
+                "was sized to lose nothing)"
+            )
+    bd = report.get("benchdiff")
+    if isinstance(bd, dict):
+        problems += [
+            f"benchdiff.{f}" for f in DOCTOR_BENCHDIFF_FIELDS if f not in bd
+        ]
+        if bd.get("identical_clean") is not True:
+            problems.append(
+                "benchdiff: an identical artifact pair did not compare "
+                "clean"
+            )
+        if bd.get("regression_flagged") is not True:
+            problems.append(
+                "benchdiff: a synthetically regressed artifact was NOT "
+                "flagged"
+            )
+        if bd.get("mismatch_detected") is not True:
+            problems.append(
+                "benchdiff: a cross-schema pair was NOT rejected as a "
+                "mismatch"
+            )
+    return problems
+
+
+def build_doctor_report(res: dict) -> dict:
+    """Assemble a schema-complete DOCTOR artifact from
+    ``workload.run_doctor_workload``'s result."""
+    pathologies = res.get("pathologies", {})
+    detected = sum(
+        1
+        for p in DOCTOR_PATHOLOGIES
+        if pathologies.get(p, {}).get("detected")
+        and pathologies.get(p, {}).get("evidence_correct")
+    )
+    return {
+        "schema_version": DOCTOR_SCHEMA_VERSION,
+        "metric": "doctor_pathologies_named",
+        "value": detected,
+        "unit": (
+            f"of {len(DOCTOR_PATHOLOGIES)} deterministically seeded "
+            "pathologies named by the mesh doctor with correct pinned "
+            "evidence (and zero findings on the healthy phase)"
+        ),
+        "workload": (
+            "healthy balanced phase, then zipf heat storm + convoying "
+            "long-prompt burst + throttled restore lane over one rf=3 "
+            "inproc cluster and a traced CPU engine "
+            "(see workload.run_doctor_workload)"
+        ),
+        **res,
+    }
+
+
+# ----------------------------------------------------------------------
+# compare_rounds (PR 12, the bench regression sentinel): schema-aware
+# diffing of any two SAME-schema artifacts. Eleven artifact schemas
+# accumulated over eleven rounds with nothing machine-checking the
+# trajectory between them — a silently regressed hit ratio or a halved
+# ring throughput would ride a green round. Each kind pins the metrics
+# worth guarding (dotted path, direction, relative significance
+# threshold); everything else diffs informationally. scripts/benchdiff.py
+# is the CLI with pinned exit codes (0 clean / 1 regression / 2 schema
+# mismatch) so CI can gate on the trajectory.
+# ----------------------------------------------------------------------
+
+BENCHDIFF_EXIT_CLEAN = 0
+BENCHDIFF_EXIT_REGRESSION = 1
+BENCHDIFF_EXIT_MISMATCH = 2
+
+# Relative-change denominator floor for zero-valued baselines (a clean
+# 0.0 like attribution.max_sum_error_s must tolerate float dust without
+# any threshold being able to): deltas are judged relative to at least
+# this scale. 1e-6 = the attribution epsilon, the smallest magnitude any
+# guarded metric treats as meaningful.
+_ZERO_BASELINE_FLOOR = 1e-6
+
+# kind → ((dotted path, direction, relative significance threshold), …).
+# direction: "higher" = bigger is better, "lower" = smaller is better.
+# A move AGAINST direction by more than the threshold (relative to the
+# old value) is a regression; a move WITH it is an improvement; inside
+# the threshold is noise ("ok"). Thresholds are deliberately loose —
+# the sentinel exists to catch silent cliffs, not to litigate jitter.
+COMPARE_RULES: dict = {
+    "BENCH_FULL": (
+        ("value", "higher", 0.15),
+        ("vs_baseline", "higher", 0.15),
+        ("serving_mix.ratio", "higher", 0.15),
+        ("north_star.hit_rate", "higher", 0.10),
+        ("north_star.p99_ttft_ms", "lower", 0.50),
+    ),
+    "RINGBENCH": (
+        ("value", "higher", 0.20),
+        ("wire_bytes_per_insert", "lower", 0.05),
+        ("lap_latency.p99_ms", "lower", 0.50),
+        ("converge_s_max", "lower", 0.50),
+    ),
+    "RINGSCALE": (
+        ("bytes_per_insert_growth.rf3.growth", "lower", 0.25),
+    ),
+    "CHAOS": (
+        ("value", "lower", 0.50),
+        ("crash.resurrection_hit_ratio", "higher", 0.10),
+        ("repair.converge_s", "lower", 0.50),
+    ),
+    "FLEET": (
+        ("value", "lower", 0.50),
+        ("stall_reaction.reaction_s", "lower", 0.50),
+    ),
+    "KVFLOW": (
+        ("value", "lower", 0.20),
+        ("restore.decode_steps_during_restore", "higher", 0.30),
+        ("prefetch.hit_ahead_rate", "higher", 0.10),
+    ),
+    "OBS": (
+        ("value", "higher", 0.0),
+        ("heat.skew_score", "higher", 0.30),
+        ("stitch.replication_edges", "higher", 0.50),
+    ),
+    "ANALYSIS": (
+        ("value", "lower", 0.0),  # unsuppressed findings: any rise flags
+        ("files_indexed", "higher", 0.20),
+    ),
+    "DOCTOR": (
+        ("value", "higher", 0.0),
+        ("attribution.audited", "higher", 0.50),
+        ("attribution.max_sum_error_s", "lower", 10.0),
+    ),
+    # Kinds with no pinned directional metrics still get the schema
+    # check + informational numeric diff.
+    "SLO": (),
+    "SOAK": (
+        ("value", "higher", 0.20),
+        ("server_p50_ttft_ms", "lower", 0.50),
+    ),
+}
+
+# metric-name → kind, for artifacts compared without a filename (stdin,
+# tests). Filename prefixes remain the primary detector.
+_METRIC_KINDS = {
+    "decode_tokens_per_sec_per_chip": "BENCH_FULL",
+    "ring_insert_throughput": "RINGBENCH",
+    "ring_scale_sweep": "RINGSCALE",
+    "chaos_heal_converge_s": "CHAOS",
+    "fleet_digest_fan_in_p50_s": "FLEET",
+    "kv_restore_overlapped_ttft_ratio": "KVFLOW",
+    "obs_stitched_node_tracks": "OBS",
+    "unsuppressed_findings": "ANALYSIS",
+    "doctor_pathologies_named": "DOCTOR",
+    "slo_goodput_vs_offered_load": "SLO",
+    "soak_requests": "SOAK",
+}
+
+
+def artifact_kind(report, filename: str | None = None) -> str | None:
+    """The artifact's schema kind — from its ``<KIND>_r{N}.json``
+    filename when given, else from its pinned ``metric`` name. None =
+    unrecognized (compare_rounds refuses rather than guessing)."""
+    import re
+
+    if filename:
+        m = re.fullmatch(
+            r"([A-Z][A-Z0-9_]*?)_r\d+\.json",
+            os.path.basename(filename),
+        )
+        if m:
+            return m.group(1)
+    if isinstance(report, dict):
+        return _METRIC_KINDS.get(report.get("metric"))
+    return None
+
+
+def _dotted_get(obj, path: str):
+    """Resolve ``a.b.c`` through nested dicts; None when any hop is
+    absent or non-dict."""
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _numeric_leaves(obj, prefix: str = "", out: dict | None = None) -> dict:
+    """dotted-path → value for every bool/int/float leaf (lists skipped:
+    entry counts shift round-to-round and carry no stable identity)."""
+    if out is None:
+        out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _numeric_leaves(v, f"{prefix}{k}.", out)
+    elif isinstance(obj, bool) or isinstance(obj, (int, float)):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def compare_rounds(
+    old: dict,
+    new: dict,
+    kind: str | None = None,
+    old_name: str | None = None,
+    new_name: str | None = None,
+    threshold_scale: float = 1.0,
+) -> dict:
+    """Schema-aware diff of two same-schema artifacts.
+
+    Returns ``{"status": "clean"|"regression"|"schema_mismatch", ...}``
+    with per-metric rows. ``status`` maps one-to-one onto the CLI's
+    pinned exit codes (``BENCHDIFF_EXIT_*``). A diff across KINDS
+    compares apples to oranges and refuses outright. Schema versions
+    only bump additively in this repo (fields are never removed or
+    renamed), so a version difference is same-schema and diffable: it
+    is recorded in ``version_change`` and a pinned path present on
+    only one side is listed in ``skipped`` instead of judged. At EQUAL
+    versions a one-sided pinned path is real schema rot and refuses.
+    ``threshold_scale`` scales every significance threshold (CLI
+    ``--strict`` passes 0; 2.0 doubles the tolerance)."""
+    mismatches: list[str] = []
+    old_kind = kind or artifact_kind(old, old_name)
+    new_kind = kind or artifact_kind(new, new_name)
+    if old_kind is None or new_kind is None:
+        mismatches.append(
+            "unrecognized artifact kind "
+            f"(old={old_kind!r}, new={new_kind!r}) — name the files "
+            "<KIND>_r<N>.json or pass kind explicitly"
+        )
+    elif old_kind != new_kind:
+        mismatches.append(f"kind mismatch: {old_kind} vs {new_kind}")
+    if mismatches:
+        return {
+            "status": "schema_mismatch",
+            "kind": old_kind if old_kind == new_kind else None,
+            "mismatches": mismatches,
+            "rows": [],
+            "regressions": [],
+            "improvements": [],
+        }
+    ver_old, ver_new = old.get("schema_version"), new.get("schema_version")
+    version_change = (
+        None if ver_old == ver_new else {"old": ver_old, "new": ver_new}
+    )
+    rows: list[dict] = []
+    regressions: list[str] = []
+    improvements: list[str] = []
+    skipped: list[str] = []
+    rules = COMPARE_RULES.get(old_kind, ())
+    for path, direction, threshold in rules:
+        a, b = _dotted_get(old, path), _dotted_get(new, path)
+        if a is None and b is None:
+            continue  # optional section absent in both rounds
+        if a is None or b is None:
+            if version_change is not None:
+                # Additive schema change: the field arrived (or the
+                # section is newer than the old round) — declared, not
+                # silently dropped, and never judged.
+                skipped.append(path)
+            else:
+                mismatches.append(
+                    f"{path}: present in only one artifact at the same "
+                    f"schema version ({a!r} vs {b!r})"
+                )
+            continue
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            mismatches.append(f"{path}: non-numeric ({a!r} vs {b!r})")
+            continue
+        thr = threshold * threshold_scale
+        delta = b - a
+        # Zero baselines: a bare delta/0 makes ANY move from 0.0
+        # infinitely relative — no threshold could ever tolerate it, so
+        # a 2e-16 float-dust drift off a clean 0.0 (max_sum_error_s)
+        # would flag forever. Floor the denominator instead: moves the
+        # size of the floor read as moves relative to it, genuine
+        # regressions (0 findings → 1) still blow past any threshold.
+        rel = delta / max(abs(a), _ZERO_BASELINE_FLOOR)
+        adverse = -rel if direction == "higher" else rel
+        if adverse > thr:
+            verdict = "regression"
+            regressions.append(path)
+        elif adverse < -thr:
+            verdict = "improvement"
+            improvements.append(path)
+        else:
+            verdict = "ok"
+        rows.append({
+            "path": path,
+            "old": a,
+            "new": b,
+            "delta": round(delta, 6),
+            "rel": round(rel, 6) if rel != float("inf") else None,
+            "direction": direction,
+            "threshold": thr,
+            "verdict": verdict,
+        })
+    if mismatches:
+        return {
+            "status": "schema_mismatch",
+            "kind": old_kind,
+            "mismatches": mismatches,
+            "rows": rows,
+            "regressions": regressions,
+            "improvements": improvements,
+            "skipped": skipped,
+        }
+    # Informational sweep: every numeric leaf NOT already covered by a
+    # pinned rule, so a reviewer sees what else moved (no verdicts —
+    # direction is unknown there by definition).
+    pinned = {r["path"] for r in rows}
+    leaves_old = _numeric_leaves(old)
+    leaves_new = _numeric_leaves(new)
+    info: list[dict] = []
+    for path in sorted(leaves_old.keys() & leaves_new.keys()):
+        if path in pinned or path == "schema_version":
+            continue
+        a, b = leaves_old[path], leaves_new[path]
+        if a != b:
+            info.append({
+                "path": path, "old": a, "new": b,
+                "delta": round(b - a, 6),
+            })
+    return {
+        "status": "regression" if regressions else "clean",
+        "kind": old_kind,
+        "schema_version": ver_new,
+        "version_change": version_change,
+        "mismatches": [],
+        "rows": rows,
+        "regressions": regressions,
+        "improvements": improvements,
+        "skipped": skipped,
+        "info_changes": info,
+    }
+
+
+def benchdiff_selfcheck() -> dict:
+    """The regression sentinel's positive control, pinned and
+    deterministic (no checked-in files needed): an identical artifact
+    pair must compare clean, a synthetically regressed copy must flag,
+    and a cross-kind pair must refuse as a schema mismatch. The DOCTOR
+    artifact carries the result (``validate_doctor`` gates all three) —
+    a sentinel nobody proved can still fire is not a sentinel."""
+    base = {
+        "metric": "chaos_heal_converge_s",
+        "schema_version": CHAOS_SCHEMA_VERSION,
+        "value": 0.4,
+        "crash": {"resurrection_hit_ratio": 0.95},
+        "repair": {"converge_s": 0.4},
+    }
+    regressed = {
+        **base,
+        "value": 1.8,  # 4.5x slower heal: past the 50% threshold
+        "repair": {"converge_s": 1.8},
+    }
+    other_kind = {
+        "metric": "obs_stitched_node_tracks",
+        "schema_version": OBS_SCHEMA_VERSION,
+        "value": 6,
+    }
+    identical = compare_rounds(base, dict(base), kind="CHAOS")
+    regression = compare_rounds(base, regressed, kind="CHAOS")
+    mismatch = compare_rounds(base, other_kind)
+    return {
+        "identical_clean": identical["status"] == "clean",
+        "regression_flagged": regression["status"] == "regression"
+        and "repair.converge_s" in regression["regressions"],
+        "mismatch_detected": mismatch["status"] == "schema_mismatch",
+        "regressions_seen": regression["regressions"],
+    }
+
+
 def _error_json(msg: str) -> str:
     return json.dumps({
         "metric": "decode_tokens_per_sec_per_chip",

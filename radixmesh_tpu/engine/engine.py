@@ -52,6 +52,7 @@ from radixmesh_tpu.models.llama import (
     prefill_forward,
 )
 from radixmesh_tpu.ops.attention import default_use_kernel
+from radixmesh_tpu.obs.attribution import shape_bucket
 from radixmesh_tpu.obs.fleet_plane import eviction_counters
 from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
 from radixmesh_tpu.obs.trace_plane import get_recorder
@@ -442,6 +443,13 @@ class Engine:
         # Decode step-time EWMA (seconds per token) — the fleet digest's
         # latency signal; the histogram keeps the full distribution.
         self._decode_ewma = 0.0
+        # Per-shape speculative acceptance (prompt-length bucket →
+        # [proposed, accepted] draft tokens): the doctor's
+        # spec-efficiency rule and the ROADMAP item 1(a) adaptive-γ EWMA
+        # both need acceptance BY REQUEST CLASS, which the engine-wide
+        # counters above flatten away. Scheduler-thread-only writes
+        # (both spec sites run inside _decode_spec).
+        self._spec_shape: dict[str, list[int]] = {}
         # Request-flight tracing lane for engine-scope (not per-request)
         # events: evictions, preemption sweeps (obs/trace_plane.py).
         self._trace_lane = f"engine:{self.name}"
@@ -717,6 +725,27 @@ class Engine:
             "evictions": {
                 c: int(m.value) for c, m in self._m_evicted.items()
             },
+            "spec": self.spec_report(),
+        }
+
+    def spec_report(self) -> dict:
+        """Per-shape speculative acceptance (prompt-length bucket →
+        proposed/accepted draft tokens + acceptance rate) — the
+        spec-efficiency evidence the doctor's rule and /cluster/telemetry
+        surface, and the substrate the item-1(a) adaptive-γ EWMA will
+        fold. Snapshot read, same lock-free rationale as telemetry() —
+        but unlike telemetry()'s fixed-key dicts, _spec_shape GROWS when
+        the scheduler sees a new prompt bucket, so take the one-C-call
+        list() snapshot before iterating (a dict comprehension over the
+        live dict can raise dictionary-changed-size mid-GET)."""
+        cells = list(self._spec_shape.items())
+        return {
+            shape: {
+                "proposed": int(p),
+                "accepted": int(a),
+                "acceptance": round(a / p, 4) if p else 0.0,
+            }
+            for shape, (p, a) in sorted(cells)
         }
 
     def generate(
@@ -2097,6 +2126,11 @@ class Engine:
             draft_len[row] = len(draft)
             self.stats.spec_proposed += len(draft)
             self._m_spec_proposed.inc(len(draft))
+            if len(draft):
+                cell = self._spec_shape.setdefault(
+                    shape_bucket(len(req.prompt)), [0, 0]
+                )
+                cell[0] += len(draft)
 
         # The verify pass is just a C=γ+1 chunk; _forward_chunk picks the
         # pipeline schedule under pp (parallel/pp_serving.py).
@@ -2129,6 +2163,11 @@ class Engine:
             a = int(accept_len[row])
             self.stats.spec_accepted += a
             self._m_spec_accepted.inc(a)
+            if a:
+                cell = self._spec_shape.setdefault(
+                    shape_bucket(len(req.prompt)), [0, 0]
+                )
+                cell[1] += a
             base = req.kv_len
             for i in range(a + 1):  # a accepted drafts + 1 bonus token
                 pos = base + i

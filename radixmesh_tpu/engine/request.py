@@ -5,6 +5,7 @@ from __future__ import annotations
 import enum
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -142,6 +143,31 @@ class Request:
             if cond is not None:
                 with cond:
                     cond.notify_all()
+            # Span-retire funnel (obs/attribution.py): EVERY finish path
+            # — stop token, length cap, cancel, shed, drain, deadline —
+            # assigns FINISHED exactly here, so this is the one place a
+            # traced request's terminal ``request_done`` span (the phase
+            # attributor's retire trigger) cannot be missed by a new
+            # finish site. One `is not None` branch when untraced (the
+            # PR 2 contract); the _retired guard keeps a double
+            # transition from double-feeding the attributor.
+            tr = self.__dict__.get("trace")
+            if (
+                tr is not None
+                and self.__dict__.get("submit_time")
+                and not self.__dict__.get("_retired")
+            ):
+                object.__setattr__(self, "_retired", True)
+                tr.add(
+                    "request_done",
+                    self.submit_time,
+                    time.monotonic() - self.submit_time,
+                    cat="scheduler",
+                    prompt_tokens=len(self.prompt),
+                    output_tokens=len(self.output_tokens),
+                    cancelled=bool(self.cancelled),
+                    shed=bool(self.shed),
+                )
 
     @property
     def next_token(self) -> int:

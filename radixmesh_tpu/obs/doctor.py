@@ -1,0 +1,546 @@
+"""The mesh doctor: a rule engine over the observability planes.
+
+Every diagnosis since PR 9 was a human reading raw artifacts — the
+prefill convoy (BENCH_FULL_r05's 1 756 ms wide-shape p50 TTFT), the
+hot-shard skew (16.3, OBS_r09), the paged small-batch gap — all found
+by eyeball. This module closes the loop: it CONSUMES the substrate
+(FleetView health + shard heat, the phase attributor's per-shape
+waterfall aggregates, step accounting, the SLO token-bucket plane,
+engine spec counters) and emits **ranked findings, each carrying its
+evidence** — metric values, shard ids, owner sets — in a file-format
+the DOCTOR artifact schema pins (``bench.validate_doctor``), so "what
+is wrong with the mesh" is a GET, not an afternoon.
+
+Rules (each fires at most one finding; evidence fields are part of the
+schema contract — see :data:`RULE_EVIDENCE_FIELDS`):
+
+- ``hot_shard`` — fleet skew score over threshold: names the hot shard
+  AND its owner set (the item-2 rebalancer's trigger input).
+- ``prefill_convoy`` — one request shape's exclusive prefill-phase
+  share of e2e over threshold while slower than the rest of the
+  traffic: names the convoying shape (the BENCH_FULL_r05 pathology,
+  now machine-detected).
+- ``restore_park_stall`` — requests parked in RESTORING behind a slow
+  restore lane (live parked count + queued restores, or the
+  restore_park phase share): names the throttled lane.
+- ``replication_lag`` — gossiped per-node oplog origin→apply lag over
+  threshold: names the lagging ranks.
+- ``slo_burn_rate`` — multi-window (5 m AND 1 h) error-budget burn per
+  tenant over the token-bucket plane (the classic SRE pager rule:
+  both windows hot ⇒ neither a blip nor stale news).
+- ``spec_efficiency`` — per-shape speculative acceptance under the
+  floor with enough proposals to matter: names the shape whose drafts
+  miss (the item-1(a) adaptive-γ substrate).
+
+A healthy mesh yields ZERO findings — the acceptance workload
+(``workload.run_doctor_workload``) gates on that as hard as it gates on
+the seeded pathologies being named.
+
+Import-light on purpose (stdlib only): both frontends, the router, and
+``scripts/doctor.py`` construct doctors without a backend; every input
+is an optional duck-typed seam.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+__all__ = [
+    "DoctorConfig",
+    "Finding",
+    "BurnRateTracker",
+    "MeshDoctor",
+    "RULES",
+    "RULE_EVIDENCE_FIELDS",
+]
+
+# Rule ids in severity-tiebreak order (ranking is by score first; this
+# order breaks exact ties deterministically).
+RULES = (
+    "hot_shard",
+    "prefill_convoy",
+    "restore_park_stall",
+    "replication_lag",
+    "slo_burn_rate",
+    "spec_efficiency",
+)
+
+# The pinned evidence vocabulary per rule: every finding MUST carry at
+# least these keys (bench.validate_doctor checks artifacts against this
+# map; tests/test_doctor.py checks live findings against it). Evidence
+# without a contract rots into prose.
+RULE_EVIDENCE_FIELDS = {
+    "hot_shard": ("skew_score", "shard", "owners", "reporters"),
+    "prefill_convoy": (
+        "shape", "prefill_share", "mean_e2e_s", "fleet_mean_e2e_s",
+        "requests",
+    ),
+    "restore_park_stall": (
+        "lane", "parked", "restores_queued", "park_p99_s", "park_share",
+    ),
+    "replication_lag": ("ranks", "threshold_s", "worst_lag_s"),
+    "slo_burn_rate": (
+        "tenant", "burn_fast", "burn_slow", "fast_window_s",
+        "slow_window_s", "budget", "tier",
+    ),
+    "spec_efficiency": ("shape", "proposed", "accepted", "acceptance"),
+}
+
+
+@dataclass
+class DoctorConfig:
+    """Rule thresholds. Defaults are tuned so steady healthy serving —
+    balanced heat, sub-threshold lag, drafts landing — yields zero
+    findings (the acceptance workload's healthy-phase gate)."""
+
+    # hot_shard: fleet skew (max/mean over reported shards) above this
+    # with at least min_reporters heat reporters.
+    hot_shard_skew: float = 4.0
+    hot_shard_min_reporters: int = 1
+    # prefill_convoy: a shape's exclusive prefill share of its e2e, with
+    # at least min_requests audited waterfalls of that shape, while its
+    # mean e2e exceeds the other shapes' mean by slowdown×.
+    convoy_prefill_share: float = 0.55
+    convoy_min_requests: int = 3
+    convoy_slowdown: float = 1.5
+    # restore_park_stall: live parked requests + a queued restore lane,
+    # OR the audited restore_park share of e2e across requests.
+    park_min_parked: int = 2
+    park_share: float = 0.25
+    # replication_lag: gossiped per-node lag EWMA above this.
+    lag_threshold_s: float = 1.0
+    # slo_burn_rate: shed-fraction burn multiple over budget, both
+    # windows (SRE multi-window multi-burn: fast window catches the
+    # fire, slow window proves it is not a blip).
+    burn_budget: float = 0.01  # tolerable shed fraction (99% availability)
+    burn_fast_window_s: float = 300.0
+    burn_slow_window_s: float = 3600.0
+    burn_fast_threshold: float = 14.4
+    burn_slow_threshold: float = 6.0
+    burn_min_requests: int = 20
+    # spec_efficiency: acceptance floor with enough proposals to judge.
+    spec_accept_floor: float = 0.3
+    spec_min_proposed: int = 50
+
+
+@dataclass
+class Finding:
+    """One diagnosis: the rule that fired, a 0..1 severity score (1 =
+    drop everything), a one-line summary, and the rule's pinned
+    evidence dict."""
+
+    rule: str
+    score: float
+    summary: str
+    evidence: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "score": round(self.score, 4),
+            "summary": self.summary,
+            "evidence": self.evidence,
+        }
+
+
+class BurnRateTracker:
+    """Windowed error-budget burn over cumulative (admitted, shed)
+    request counters.
+
+    :meth:`sample` records one counter snapshot per tenant;
+    :meth:`burn` answers the shed-fraction burn multiple over a
+    trailing window by diffing against the oldest sample inside it.
+    Samples are bounded; the clock is injectable (virtual-time unit
+    tests). Burn = (shed / offered) / budget — 1.0 means exactly
+    spending the budget, 14.4 over 5 m AND 6 over 1 h is the classic
+    page condition.
+    """
+
+    MAX_SAMPLES = 720  # 1 h of 5 s cadence
+
+    def __init__(self, budget: float, now=time.monotonic):
+        self.budget = max(1e-9, float(budget))
+        self._now = now
+        self._lock = threading.Lock()
+        # tenant → deque[(t, admitted, shed)]
+        self._samples: dict[str, deque] = {}
+
+    def sample(self, counts: dict[str, dict[str, int]], t: float | None = None) -> None:
+        t = self._now() if t is None else t
+        with self._lock:
+            for tenant, c in counts.items():
+                dq = self._samples.setdefault(
+                    tenant, deque(maxlen=self.MAX_SAMPLES)
+                )
+                dq.append((t, int(c.get("admitted", 0)), int(c.get("shed", 0))))
+
+    def burn(
+        self, tenant: str, window_s: float, t: float | None = None
+    ) -> tuple[float, int]:
+        """(burn multiple, offered requests) over the trailing window —
+        offered lets callers gate on sample size."""
+        t = self._now() if t is None else t
+        with self._lock:
+            dq = self._samples.get(tenant)
+            if not dq or len(dq) < 2:
+                return 0.0, 0
+            newest = dq[-1]
+            base = None
+            for s in dq:
+                if s[0] >= t - window_s:
+                    base = s
+                    break
+            if base is None or base is newest:
+                # No sample besides the newest lies inside the window:
+                # there is no in-window history to diff against. Widening
+                # to the oldest sample would smear up to an hour of stale
+                # shed into a 5 m window (a storm from 50 minutes ago
+                # would page as a live fire under sparse polling) —
+                # answer "can't judge" instead.
+                return 0.0, 0
+        admitted = newest[1] - base[1]
+        shed = newest[2] - base[2]
+        offered = admitted + shed
+        if offered <= 0:
+            return 0.0, 0
+        return (shed / offered) / self.budget, offered
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._samples)
+
+
+class MeshDoctor:
+    """The diagnosis engine. Every input is an optional seam:
+
+    - ``mesh``: a MeshCache (FleetView health/heat + shard ownership).
+    - ``engine``: an Engine (kv_transfer lane depths, parked requests,
+      per-shape spec counters via ``telemetry()``).
+    - ``slo``: an OverloadController (``burn_counts()`` + ``.tier``).
+    - ``attributor``: a PhaseAttributor (per-shape phase aggregates).
+
+    Construct ONE per frontend and call :meth:`diagnose` per GET — the
+    burn tracker needs continuity across calls (a fresh doctor has no
+    windows). Absent seams silently skip their rules; ``rules_checked``
+    in the report says which actually ran, so "no findings" can never
+    be confused with "nothing was looked at".
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        engine=None,
+        slo=None,
+        attributor=None,
+        cfg: DoctorConfig | None = None,
+        now=time.monotonic,
+    ):
+        self.mesh = mesh
+        self.engine = engine
+        self.slo = slo
+        self._attributor = attributor
+        self.cfg = cfg or DoctorConfig()
+        self._now = now
+        self.burn_tracker = BurnRateTracker(self.cfg.burn_budget, now=now)
+
+    # The attributor seam is callable-or-instance: frontends pass
+    # obs.attribution.ensure_attributor so a test-swapped recorder
+    # transparently resolves to its fresh attributor.
+    @property
+    def attributor(self):
+        a = self._attributor
+        return a() if callable(a) else a
+
+    # -- rules ---------------------------------------------------------
+
+    def _rule_hot_shard(self) -> Finding | None:
+        if self.mesh is None or not getattr(self.mesh, "sharded", False):
+            return None
+        report = self.mesh.shard_heat_report()
+        skew = float(report.get("skew_score") or 0.0)
+        reporters = int(report.get("reporters") or 0)
+        if (
+            skew < self.cfg.hot_shard_skew
+            or reporters < self.cfg.hot_shard_min_reporters
+        ):
+            return None
+        shard = report.get("hot_shard")
+        owners = sorted(report.get("hot_owners", []))
+        return Finding(
+            "hot_shard",
+            min(1.0, 0.5 + skew / (8.0 * self.cfg.hot_shard_skew)),
+            f"shard {shard} is soaking the fleet (skew {skew:.1f}, "
+            f"owners {owners}) — rebalance or raise its RF",
+            {
+                "skew_score": round(skew, 4),
+                "shard": shard,
+                "owners": owners,
+                "reporters": reporters,
+            },
+        )
+
+    def _rule_prefill_convoy(self) -> Finding | None:
+        attr = self.attributor
+        if attr is None:
+            return None
+        shapes = {
+            k: v
+            for k, v in attr.by_shape().items()
+            if v["count"] >= self.cfg.convoy_min_requests and v["e2e_s"] > 0
+        }
+        if not shapes:
+            return None
+        worst = None
+        for shape, agg in shapes.items():
+            share = agg["phases"].get("prefill", 0.0) / agg["e2e_s"]
+            mean_e2e = agg["e2e_s"] / agg["count"]
+            others = [
+                (o["e2e_s"], o["count"])
+                for k, o in shapes.items()
+                if k != shape
+            ]
+            other_mean = (
+                sum(e for e, _ in others) / max(1, sum(c for _, c in others))
+                if others
+                else 0.0
+            )
+            if share < self.cfg.convoy_prefill_share:
+                continue
+            if others and mean_e2e < other_mean * self.cfg.convoy_slowdown:
+                # Prefill-heavy but not slower than the rest of the
+                # traffic: batch-1-style workloads are prefill-dominant
+                # by nature, not convoyed.
+                continue
+            cand = (share, shape, mean_e2e, other_mean, agg["count"])
+            if worst is None or cand > worst:
+                worst = cand
+        if worst is None:
+            return None
+        share, shape, mean_e2e, other_mean, count = worst
+        return Finding(
+            "prefill_convoy",
+            min(1.0, 0.4 + share / 2.0),
+            f"shape {shape} spends {share:.0%} of its e2e in prefill "
+            f"waves ({mean_e2e * 1e3:.0f} ms mean e2e vs "
+            f"{other_mean * 1e3:.0f} ms fleet) — long prompts are "
+            "convoying; interleave chunked prefill with decode",
+            {
+                "shape": shape,
+                "prefill_share": round(share, 4),
+                "mean_e2e_s": round(mean_e2e, 6),
+                "fleet_mean_e2e_s": round(other_mean, 6),
+                "requests": count,
+            },
+        )
+
+    def _rule_restore_park_stall(self) -> Finding | None:
+        eng = self.engine
+        attr = self.attributor
+        parked = restores_queued = 0
+        if eng is not None:
+            parked = len(getattr(eng, "_restoring", ()))
+            plane = getattr(eng, "kv_transfer", None)
+            if plane is not None:
+                st = plane.stats()
+                restores_queued = int(st.get("restores_queued", 0)) + int(
+                    st.get("staged_chunks", 0)
+                )
+        park_p99 = park_share = 0.0
+        if attr is not None:
+            hist = attr.phase_hist("restore_park")
+            total = sum(attr.phase_totals().values())
+            if hist is not None and hist.count:
+                park_p99 = hist.quantile(0.99)
+                park_share = hist.sum / total if total > 0 else 0.0
+        live_stall = (
+            parked >= self.cfg.park_min_parked and restores_queued > 0
+        )
+        audited_stall = park_share > self.cfg.park_share
+        if not (live_stall or audited_stall):
+            return None
+        return Finding(
+            "restore_park_stall",
+            min(1.0, 0.4 + 0.1 * parked + park_share),
+            f"{parked} request(s) parked in RESTORING behind "
+            f"{restores_queued} queued restore unit(s) "
+            f"(park share {park_share:.0%}, p99 {park_p99 * 1e3:.0f} ms) "
+            "— the restore lane is throttled; raise chunk size or lane "
+            "concurrency",
+            {
+                "lane": "restore",
+                "parked": parked,
+                "restores_queued": restores_queued,
+                "park_p99_s": round(park_p99, 6),
+                "park_share": round(park_share, 4),
+            },
+        )
+
+    def _rule_replication_lag(self) -> Finding | None:
+        if self.mesh is None:
+            return None
+        fleet = getattr(self.mesh, "fleet", None)
+        if fleet is None:
+            return None
+        lagging = {
+            rank: round(d.replication_lag_s, 4)
+            for rank, d in fleet.digests().items()
+            if d.replication_lag_s > self.cfg.lag_threshold_s
+        }
+        if not lagging:
+            return None
+        worst = max(lagging.values())
+        return Finding(
+            "replication_lag",
+            min(1.0, 0.4 + 0.1 * worst / self.cfg.lag_threshold_s),
+            f"{len(lagging)} node(s) applying oplog frames "
+            f"{worst:.1f}s after origin (threshold "
+            f"{self.cfg.lag_threshold_s}s): {sorted(lagging)} — "
+            "replicas are stale; failover there would lose prefix hits",
+            {
+                "ranks": {str(r): v for r, v in sorted(lagging.items())},
+                "threshold_s": self.cfg.lag_threshold_s,
+                "worst_lag_s": worst,
+            },
+        )
+
+    def _rule_slo_burn_rate(self) -> Finding | None:
+        slo = self.slo
+        if slo is None:
+            return None
+        self.burn_tracker.sample(slo.burn_counts())
+        cfg = self.cfg
+        worst: Finding | None = None
+        for tenant in self.burn_tracker.tenants():
+            fast, offered = self.burn_tracker.burn(
+                tenant, cfg.burn_fast_window_s
+            )
+            slow, _ = self.burn_tracker.burn(tenant, cfg.burn_slow_window_s)
+            if offered < cfg.burn_min_requests:
+                continue
+            if (
+                fast < cfg.burn_fast_threshold
+                or slow < cfg.burn_slow_threshold
+            ):
+                continue
+            f = Finding(
+                "slo_burn_rate",
+                min(1.0, 0.6 + fast / (10.0 * cfg.burn_fast_threshold)),
+                f"tenant {tenant!r} burning error budget at "
+                f"{fast:.1f}x over {cfg.burn_fast_window_s:.0f}s AND "
+                f"{slow:.1f}x over {cfg.burn_slow_window_s:.0f}s "
+                f"(budget {cfg.burn_budget:.2%} shed) — sustained "
+                "overload, not a blip",
+                {
+                    "tenant": tenant,
+                    "burn_fast": round(fast, 3),
+                    "burn_slow": round(slow, 3),
+                    "fast_window_s": cfg.burn_fast_window_s,
+                    "slow_window_s": cfg.burn_slow_window_s,
+                    "budget": cfg.burn_budget,
+                    "tier": int(getattr(slo, "tier", 0)),
+                },
+            )
+            if worst is None or f.score > worst.score:
+                worst = f
+        return worst
+
+    def _rule_spec_efficiency(self) -> Finding | None:
+        eng = self.engine
+        if eng is None:
+            return None
+        spec = eng.spec_report()
+        worst = None
+        for shape, c in spec.items():
+            if c["proposed"] < self.cfg.spec_min_proposed:
+                continue
+            if c["acceptance"] >= self.cfg.spec_accept_floor:
+                continue
+            cand = (
+                self.cfg.spec_accept_floor - c["acceptance"], shape, c,
+            )
+            if worst is None or cand > worst:
+                worst = cand
+        if worst is None:
+            return None
+        _, shape, c = worst
+        return Finding(
+            "spec_efficiency",
+            min(1.0, 0.3 + (self.cfg.spec_accept_floor - c["acceptance"])),
+            f"shape {shape} accepts only {c['acceptance']:.0%} of "
+            f"{c['proposed']} proposed draft tokens (floor "
+            f"{self.cfg.spec_accept_floor:.0%}) — speculative verify "
+            "waves are wasted compute there; shrink γ for that class",
+            {
+                "shape": shape,
+                "proposed": c["proposed"],
+                "accepted": c["accepted"],
+                "acceptance": c["acceptance"],
+            },
+        )
+
+    # -- the diagnosis -------------------------------------------------
+
+    def diagnose(self) -> dict:
+        """Run every rule whose inputs are attached; return the ranked
+        findings report (the ``GET /cluster/doctor`` body)."""
+        checks = {
+            "hot_shard": self._rule_hot_shard,
+            "prefill_convoy": self._rule_prefill_convoy,
+            "restore_park_stall": self._rule_restore_park_stall,
+            "replication_lag": self._rule_replication_lag,
+            "slo_burn_rate": self._rule_slo_burn_rate,
+            "spec_efficiency": self._rule_spec_efficiency,
+        }
+        # Seam presence per rule: a rule whose inputs are absent never
+        # looked at anything, so it must NOT appear in rules_checked —
+        # that list is the honesty field the module contract promises
+        # ("no findings" vs "nothing was looked at"), and the healthy-
+        # phase gate in bench.validate_doctor is vacuous without it.
+        attr = self.attributor
+        available = {
+            "hot_shard": self.mesh is not None,
+            "prefill_convoy": attr is not None,
+            "restore_park_stall": self.engine is not None
+            or attr is not None,
+            "replication_lag": self.mesh is not None,
+            "slo_burn_rate": self.slo is not None,
+            "spec_efficiency": self.engine is not None,
+        }
+        findings: list[Finding] = []
+        checked: list[str] = []
+        for rule in RULES:
+            if not available[rule]:
+                continue
+            try:
+                f = checks[rule]()
+            except Exception as e:  # noqa: BLE001 — a broken rule is a finding, not an outage
+                f = Finding(
+                    rule, 0.1,
+                    f"rule crashed: {e!r} (diagnosis plane bug — file it)",
+                    {"error": repr(e)},
+                )
+            checked.append(rule)
+            if f is not None:
+                missing = [
+                    k
+                    for k in RULE_EVIDENCE_FIELDS.get(rule, ())
+                    if k not in f.evidence and "error" not in f.evidence
+                ]
+                if missing:  # pinned-evidence contract, enforced live
+                    f.evidence["_missing_evidence"] = missing
+                findings.append(f)
+        findings.sort(key=lambda f: (-f.score, RULES.index(f.rule)))
+        return {
+            "findings": [f.as_dict() for f in findings],
+            "healthy": not findings,
+            "rules_checked": checked,
+            "inputs": {
+                "mesh": self.mesh is not None,
+                "engine": self.engine is not None,
+                "slo": self.slo is not None,
+                "attribution": self.attributor is not None,
+            },
+        }

@@ -35,6 +35,7 @@ __all__ = [
     "TRANSFER_SECONDS_BUCKETS",
     "REPAIR_SECONDS_BUCKETS",
     "RECOVERY_SECONDS_BUCKETS",
+    "PHASE_SECONDS_BUCKETS",
 ]
 
 # Latency-oriented default buckets (seconds): 1ms .. 60s.
@@ -77,6 +78,19 @@ REPAIR_SECONDS_BUCKETS: tuple[float, ...] = (
 RECOVERY_SECONDS_BUCKETS: tuple[float, ...] = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
     2.0, 5.0, 10.0, 30.0, 60.0,
+)
+
+# Critical-path phase buckets (seconds): one request's end-to-end time
+# decomposes into EXCLUSIVE per-phase slices (obs/attribution.py), and
+# those slices span five orders of magnitude — a publish is tens of µs,
+# a convoyed prefill wait is seconds, an SLO queue stall under overload
+# is tens of seconds. DEFAULT_BUCKETS' 1 ms floor would flatten the fast
+# phases to zeros and TRANSFER_SECONDS_BUCKETS tops out at 2 s, below a
+# convoy. Shared by every phase of radixmesh_request_phase_seconds so
+# p50/p99 phase breakdowns compare bucket-for-bucket.
+PHASE_SECONDS_BUCKETS: tuple[float, ...] = (
+    1e-4, 2.5e-4, 5e-4, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
 

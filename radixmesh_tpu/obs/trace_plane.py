@@ -86,6 +86,7 @@ __all__ = [
     "write_trace",
     "new_trace_id",
     "stitch_traces",
+    "dropped_spans_counter",
 ]
 
 _M64 = (1 << 64) - 1
@@ -100,6 +101,26 @@ def _mix64(x: int) -> int:
 
 
 _id_counter = itertools.count(1)
+
+
+def dropped_spans_counter():
+    """The trace-loss counter family,
+    ``radixmesh_trace_dropped_spans_total{node}`` — resolved from the
+    CURRENT registry at the drop site (not cached at recorder
+    construction) so registry swaps in tests never strand increments on
+    a stale registry. A bounded drop-oldest ring is correct storm
+    behavior, but a SILENT one lies: every evicted span now lands on a
+    scrapeable counter, every export declares its ``dropped`` total, and
+    the attributor refuses holed traces (see ``FlightRecorder``)."""
+    from radixmesh_tpu.obs.metrics import get_registry
+
+    return get_registry().counter(
+        "radixmesh_trace_dropped_spans_total",
+        "flight-recorder spans evicted by the ring bound before export "
+        "(trace-loss visibility: a stitched artifact from a node with "
+        "drops has declared, not silent, coverage gaps)",
+        ("node",),
+    )
 
 
 def new_trace_id() -> int:
@@ -206,7 +227,21 @@ class FlightRecorder:
     bounds post-mortem memory — a storm past it drops the OLDEST spans
     (the fresh ones are the ones a live debugger wants) and counts the
     drops.
+
+    Drops are never silent (PR 12): every eviction increments
+    ``radixmesh_trace_dropped_spans_total{node}``, every export carries
+    the lifetime ``dropped`` count (so a stitched artifact declares its
+    coverage), and evicting a trace-id-bearing span marks that trace id
+    as HOLED — the phase attributor (``obs/attribution.py``) refuses to
+    decompose a holed trace into a waterfall instead of publishing a
+    breakdown with interior gaps, and counts the refusal.
     """
+
+    # Bound on the holed-trace-id memory: past it the set stops growing
+    # and ``drops_untracked`` flips — attribution then refuses EVERY
+    # trace conservatively (a storm that evicted 4k distinct traces has
+    # destroyed any per-request story worth telling anyway).
+    DROPPED_TRACE_CAP = 4096
 
     def __init__(
         self, capacity: int = 8192, sample: float = 0.0, node: str = ""
@@ -227,6 +262,25 @@ class FlightRecorder:
         self._rng = random.Random(0xF117)  # deterministic sampling sequence
         self.recorded = 0  # spans accepted (lifetime)
         self.dropped = 0  # spans evicted by the ring bound (lifetime)
+        # Live per-trace span index: every buffered span with a nonzero
+        # trace id sits in exactly one list (evictions remove it), so a
+        # retire-time waterfall is one dict lookup, not a ring scan.
+        self._by_tid: dict[int, list[Span]] = {}
+        # Trace ids that LOST at least one span to the ring bound.
+        self._dropped_tids: set[int] = set()
+        self.drops_untracked = False  # dropped-tid set hit its cap
+        # Span-retire hook (obs/attribution.py installs it): called with
+        # (retire_span, recorder) AFTER the span landed, outside the
+        # buffer lock, whenever a span named in ``retire_spans`` records.
+        # None (the default) keeps _record one append — the PR 2
+        # one-branch contract extends here: sampling off records nothing,
+        # so the hook costs zero when tracing is off.
+        self.retire_hook = None
+        self.retire_spans: frozenset[str] = frozenset()
+        # The installed PhaseAttributor (obs/attribution.py), if any —
+        # carried on the recorder so a registry/recorder swap in tests
+        # gets a fresh one via ensure_attributor().
+        self.attributor = None
 
     # -- the hot-path gates -------------------------------------------
 
@@ -302,11 +356,38 @@ class FlightRecorder:
     # -- storage -------------------------------------------------------
 
     def _record(self, span: Span) -> None:
+        evicted: Span | None = None
         with self._lock:
             if len(self._buf) == self.capacity:
-                self.dropped += 1  # deque(maxlen) evicts the oldest
+                # Peek the victim BEFORE deque(maxlen) evicts it: the
+                # drop must be attributed (metric + holed-trace mark),
+                # not just counted.
+                evicted = self._buf[0]
+                self.dropped += 1
+                if evicted.trace_id:
+                    lst = self._by_tid.get(evicted.trace_id)
+                    if lst is not None:
+                        # Global FIFO order implies per-trace FIFO order:
+                        # the victim is the oldest span of its trace.
+                        if lst and lst[0] is evicted:
+                            lst.pop(0)
+                        if not lst:
+                            del self._by_tid[evicted.trace_id]
+                    if len(self._dropped_tids) < self.DROPPED_TRACE_CAP:
+                        self._dropped_tids.add(evicted.trace_id)
+                    elif evicted.trace_id not in self._dropped_tids:
+                        self.drops_untracked = True
             self._buf.append(span)
             self.recorded += 1
+            if span.trace_id:
+                self._by_tid.setdefault(span.trace_id, []).append(span)
+        if evicted is not None:
+            dropped_spans_counter().labels(
+                node=evicted.node or self.node or "node"
+            ).inc()
+        hook = self.retire_hook
+        if hook is not None and span.name in self.retire_spans:
+            hook(span, self)
 
     def __len__(self) -> int:
         with self._lock:
@@ -320,7 +401,27 @@ class FlightRecorder:
         with self._lock:
             out = list(self._buf)
             self._buf.clear()
+            self._by_tid.clear()
             return out
+
+    def spans_for_trace(self, trace_id: int) -> list[Span]:
+        """Every buffered span recorded under ``trace_id`` (insertion
+        order) — the attributor's per-request input, O(trace spans)."""
+        with self._lock:
+            return list(self._by_tid.get(int(trace_id) & _M64, ()))
+
+    def trace_has_drops(self, trace_id: int) -> bool:
+        """True when ``trace_id`` lost at least one span to the ring
+        bound (or the holed-trace set itself overflowed, in which case
+        EVERY trace answers True — coverage can no longer be proven).
+        The attributor's refusal predicate: a waterfall computed from a
+        holed trace would silently misattribute the missing intervals
+        to the residual phase."""
+        with self._lock:
+            return (
+                self.drops_untracked
+                or (int(trace_id) & _M64) in self._dropped_tids
+            )
 
     # -- export --------------------------------------------------------
 
@@ -393,6 +494,11 @@ class FlightRecorder:
         return {
             "node": self.node,
             "wall_offset": self.wall_offset,
+            # Coverage declaration: spans this recorder evicted before
+            # the export. A collector stitching multiple nodes folds
+            # these into the artifact's per-node dropped map — no
+            # silent caps.
+            "dropped": self.dropped,
             "spans": [
                 {
                     "name": s.name,
@@ -429,9 +535,14 @@ class FlightRecorder:
         shapes identically."""
         offsets = clock_offsets or {}
         rows: list[tuple[str, str, float, dict]] = []
+        dropped_by_node: dict[str, int] = {}
         for ex in exports:
             base_node = ex.get("node") or "node"
             wall = float(ex.get("wall_offset", 0.0))
+            if ex.get("dropped"):
+                dropped_by_node[base_node] = (
+                    dropped_by_node.get(base_node, 0) + int(ex["dropped"])
+                )
             for s in ex.get("spans", ()):
                 node = s.get("node") or base_node
                 t_wall = (
@@ -487,6 +598,11 @@ class FlightRecorder:
                 "stitched": True,
                 "nodes": sorted(pids),
                 "clock_offsets": {k: round(v, 6) for k, v in offsets.items()},
+                # Coverage: spans each contributing node evicted before
+                # exporting. A reader of the stitched doc knows exactly
+                # which nodes' timelines may have holes.
+                "dropped": dropped_by_node,
+                "dropped_total": sum(dropped_by_node.values()),
             },
         }
 
@@ -494,6 +610,8 @@ class FlightRecorder:
         """Programmatic recorder state for ``/debug/state``."""
         with self._lock:
             buffered = len(self._buf)
+            holed = len(self._dropped_tids)
+            drops_untracked = self.drops_untracked
         return {
             "capacity": self.capacity,
             "sample": self.sample,
@@ -501,6 +619,10 @@ class FlightRecorder:
             "buffered_spans": buffered,
             "recorded_spans": self.recorded,
             "dropped_spans": self.dropped,
+            # Traces that lost spans to the ring bound: the attributor
+            # refuses waterfalls for these (obs/attribution.py).
+            "holed_traces": holed,
+            "drops_untracked": drops_untracked,
         }
 
 
@@ -527,6 +649,9 @@ def configure(
     """Enable tracing process-wide: install a fresh recorder with the
     given bound + sampling rate (``launch.py --trace-capacity/-sample``).
     ``node`` labels this process's spans for the cross-node stitcher."""
+    # Materialize the trace-loss series at 0 from process start
+    # (dashboards never see gaps — the eviction_counters convention).
+    dropped_spans_counter().labels(node=node or "node")
     return set_recorder(
         FlightRecorder(capacity=capacity, sample=sample, node=node)
     )

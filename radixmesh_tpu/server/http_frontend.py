@@ -41,6 +41,8 @@ from typing import Sequence
 
 from radixmesh_tpu.engine.engine import Engine
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
+from radixmesh_tpu.obs.attribution import ensure_attributor
+from radixmesh_tpu.obs.doctor import MeshDoctor
 from radixmesh_tpu.obs.metrics import get_registry
 from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.policy.retry import jittered_retry_after
@@ -527,6 +529,21 @@ class ServingFrontend:
         self._debug_requests = _debug_requests
         self._debug_state = _debug_state
 
+        # Diagnosis plane (obs/doctor.py + obs/attribution.py): the
+        # attributor installs on the recorder's span-retire hook NOW so
+        # phase histograms accumulate from the first traced request;
+        # the doctor persists across GETs — its burn-rate windows need
+        # continuity — and resolves the attributor at diagnose time
+        # through the ensure_* seam (a swapped recorder gets a fresh
+        # one).
+        ensure_attributor()
+        self.doctor = MeshDoctor(
+            mesh=engine.mesh,
+            engine=engine,
+            slo=self.runner.ctl if self.slo_enabled else None,
+            attributor=ensure_attributor,
+        )
+
         def _run_profile(seconds: float) -> tuple[int, dict]:
             """One ``jax.profiler`` capture window into a fresh numbered
             subdirectory of the operator-configured base dir. Shared by
@@ -624,15 +641,26 @@ class ServingFrontend:
                     _json_response(self, 200, frontend._debug_requests())
                 elif self.path == "/debug/state":
                     _json_response(self, 200, frontend._debug_state())
+                elif self.path == "/debug/waterfall":
+                    # Critical-path attribution (obs/attribution.py):
+                    # p50/p99 phase breakdown + per-shape table +
+                    # recent per-request waterfalls.
+                    _json_response(self, 200, ensure_attributor().report())
                 elif self.path == "/cluster/telemetry":
-                    _json_response(
-                        self, 200,
-                        _cluster_telemetry(frontend.runner.engine.mesh),
-                    )
+                    body = _cluster_telemetry(frontend.runner.engine.mesh)
+                    # Per-shape speculative acceptance (the doctor's
+                    # spec-efficiency evidence) — engine-local, so it
+                    # rides the serving node's view only.
+                    body["spec"] = frontend.runner.engine.spec_report()
+                    _json_response(self, 200, body)
                 elif self.path == "/cluster/health":
                     _json_response(
                         self, 200, _cluster_health(frontend.runner.engine.mesh)
                     )
+                elif self.path == "/cluster/doctor":
+                    # The mesh doctor (obs/doctor.py): ranked findings
+                    # with pinned evidence over every attached plane.
+                    _json_response(self, 200, frontend.doctor.diagnose())
                 else:
                     _json_response(self, 404, {"error": "not found"})
 
@@ -992,6 +1020,15 @@ class RouterFrontend:
 
         self._debug_state = _debug_state
 
+        # Diagnosis plane: a router doctor sees the fleet-facing rules
+        # (hot shard, replication lag) — it holds no engine or SLO
+        # controller, and ``rules_checked``/``inputs`` in the report
+        # say so explicitly.
+        ensure_attributor()
+        self.doctor = MeshDoctor(
+            mesh=router.mesh_cache, attributor=ensure_attributor
+        )
+
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 frontend.log.debug(fmt, *args)
@@ -1022,6 +1059,8 @@ class RouterFrontend:
                     )
                 elif self.path == "/debug/state":
                     _json_response(self, 200, frontend._debug_state())
+                elif self.path == "/debug/waterfall":
+                    _json_response(self, 200, ensure_attributor().report())
                 elif self.path == "/cluster/telemetry":
                     _json_response(
                         self, 200,
@@ -1031,6 +1070,8 @@ class RouterFrontend:
                     _json_response(
                         self, 200, _cluster_health(frontend.router.mesh_cache)
                     )
+                elif self.path == "/cluster/doctor":
+                    _json_response(self, 200, frontend.doctor.diagnose())
                 else:
                     _json_response(self, 404, {"error": "not found"})
 

@@ -236,7 +236,7 @@ class _Bucket:
 class _TenantState:
     __slots__ = (
         "name", "cfg", "bucket", "queue", "vfinish", "queued_tokens",
-        "admitted_tokens",
+        "admitted_tokens", "admitted_requests", "shed_requests",
     )
 
     def __init__(self, cfg: TenantConfig, now: float, name: str = "default"):
@@ -251,6 +251,12 @@ class _TenantState:
         self.vfinish = 0.0
         self.queued_tokens = 0  # this tenant's share of the queue backlog
         self.admitted_tokens = 0  # dispatched to the engine (fairness probe)
+        # Cumulative REQUEST counts (admitted vs shed, every shed cause):
+        # the doctor's multi-window SLO burn-rate rule samples these
+        # (obs/doctor.py::BurnRateTracker) — burn is a fraction of
+        # requests, so token counts can't stand in for them.
+        self.admitted_requests = 0
+        self.shed_requests = 0
 
 
 class OverloadController:
@@ -433,6 +439,9 @@ class OverloadController:
     ) -> AdmissionDecision:
         self.total_shed += 1
         self._m_shed.labels(tenant=tenant, reason=reason).inc()
+        st = self._tenants.get(tenant)
+        if st is not None:
+            st.shed_requests += 1
         return AdmissionDecision(False, reason, retry_after_s)
 
     def enqueue(self, req, now: float | None = None) -> None:
@@ -504,6 +513,7 @@ class OverloadController:
                     self._ft_anchor = now  # system becomes busy
                 self._dispatched_tokens += cost
                 best.admitted_tokens += cost
+                best.admitted_requests += 1
                 self.total_admitted += 1
                 self._m_admitted.labels(tenant=best.name).inc()
                 self._m_admitted_tokens.labels(tenant=best.name).inc(cost)
@@ -519,9 +529,11 @@ class OverloadController:
         req.shed = True
         req.shed_reason = reason
         self.total_shed += 1
-        self._m_shed.labels(
-            tenant=self._label_locked(req.tenant), reason=reason
-        ).inc()
+        label = self._label_locked(req.tenant)
+        self._m_shed.labels(tenant=label, reason=reason).inc()
+        st = self._tenants.get(label)
+        if st is not None:
+            st.shed_requests += 1
         self._shed_at_dispatch.append(req)
 
     def cancel_queued(self, rid) -> object | None:
@@ -744,6 +756,21 @@ class OverloadController:
         with self._lock:
             return {
                 name: st.admitted_tokens for name, st in self._tenants.items()
+            }
+
+    def burn_counts(self) -> dict[str, dict[str, int]]:
+        """Cumulative per-tenant request outcomes (admitted vs shed,
+        all shed causes) — the doctor's SLO burn-rate sampler input
+        (obs/doctor.py; the degradation tier rides ``.tier``). One lock
+        hold; the sampler diffs consecutive snapshots into windowed
+        rates."""
+        with self._lock:
+            return {
+                name: {
+                    "admitted": st.admitted_requests,
+                    "shed": st.shed_requests,
+                }
+                for name, st in self._tenants.items()
             }
 
     def snapshot(self) -> dict:

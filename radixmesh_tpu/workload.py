@@ -2517,3 +2517,387 @@ def run_kvflow_workload(
         "page_size": page_size,
         "wall_s": round(_time.monotonic() - t_start, 3),
     }
+
+
+def run_doctor_workload(
+    seed: int = 0,
+    replication_factor: int = 3,
+    balanced_shards: int = 24,
+    zipf_keys: int = 64,
+    zipf_inserts: int = 400,
+    zipf_alpha: float = 1.4,
+    key_len: int = 8,
+    short_prompt: int = 96,
+    long_prompt: int = 1536,
+    restore_prompt: int = 512,
+    restore_chunk_tokens: int = 64,
+    summary_interval_s: float = 0.2,
+    timeout_s: float = 60.0,
+    max_steps: int = 20_000,
+) -> dict:
+    """The diagnosis-plane acceptance scenario (PR 12;
+    ``bench.validate_doctor`` pins its artifact): one rf=3 inproc mesh
+    (4 prefill + 2 decode + 1 router) plus a traced CPU engine, driven
+    through a provably HEALTHY phase and then three deterministically
+    seeded pathologies — and ONE :class:`~radixmesh_tpu.obs.doctor.
+    MeshDoctor` (the burn windows need continuity) must stay silent on
+    the former and NAME each of the latter with evidence matching the
+    seeded ground truth:
+
+    0. **Healthy.** One balanced insert per ``balanced_shards`` distinct
+       shards (skew ≈ 1) at each shard's primary owner, plus a traced
+       two-shape engine burst with decode-dominant requests. Every rule
+       runs; zero findings is the gate — a diagnosis plane that cries
+       wolf gets muted.
+    a. **Zipf heat storm** (reuses the OBS leg): deterministic
+       rank^-alpha insert counts drive one shard provably hottest; the
+       doctor must name THAT shard and its true owner set (the item-2
+       rebalancer's trigger evidence).
+    b. **Convoying long-prompt burst**: ``long_prompt``-token requests
+       served in small prefill waves spend most of their e2e in
+       exclusive prefill time and run well slower than the short-shape
+       fleet — the BENCH_FULL_r05 pathology, seeded on purpose; the
+       doctor must name the convoying SHAPE bucket from the phase
+       attributor's per-shape table.
+    c. **Throttled restore lane**: host-tier prefixes re-requested
+       through a tiny-chunk KV-transfer plane park in RESTORING behind
+       a staged-chunk backlog that is never pumped before the
+       diagnosis — the doctor must name the restore lane with the live
+       parked count.
+
+    The phase attributor audits every traced request along the way; the
+    workload returns its sum-error high-water mark so the artifact can
+    gate "exclusive phase times sum to e2e within epsilon" on real
+    traffic, not just the property test's synthetic traces."""
+    import time as _time
+
+    import jax
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.cache.sharding import shard_of_tokens
+    from radixmesh_tpu.comm.inproc import InprocHub
+    from radixmesh_tpu.config import MeshConfig, NodeRole
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.engine.request import SamplingParams
+    from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.obs.attribution import ensure_attributor, shape_bucket
+    from radixmesh_tpu.obs.doctor import MeshDoctor
+    from radixmesh_tpu.obs.trace_plane import (
+        FlightRecorder,
+        get_recorder,
+        set_recorder,
+    )
+    from radixmesh_tpu.slo.control import OverloadController, SLOConfig
+
+    def wait_for(pred, timeout=timeout_s, interval=0.02):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(interval)
+        return pred()
+
+    def finding_for(report: dict, rule: str) -> dict | None:
+        for f in report["findings"]:
+            if f["rule"] == rule:
+                return f
+        return None
+
+    rng = np.random.default_rng(seed)
+    t_start = _time.monotonic()
+    InprocHub.reset_default()
+    prev_recorder = get_recorder()
+    # 4 prefills so rf=3 owner sets are PROPER subsets of the prefill
+    # role (the hot-owner evidence gate must not be vacuous).
+    prefill = ["dp0", "dp1", "dp2", "dp3"]
+    decode = ["dd0", "dd1"]
+    router_addrs = ["dr0"]
+    nodes: list = []
+    eng = None
+    try:
+        for addr in prefill + decode + router_addrs:
+            cfg = MeshConfig(
+                prefill_nodes=prefill,
+                decode_nodes=decode,
+                router_nodes=router_addrs,
+                local_addr=addr,
+                protocol="inproc",
+                tick_interval_s=0.1,
+                gc_interval_s=60.0,
+                failure_timeout_s=60.0,
+                replication_factor=replication_factor,
+                shard_summary_interval_s=summary_interval_s,
+            )
+            nodes.append(MeshCache(cfg, pool=None).start())
+        for n in nodes:
+            if not n.wait_ready(timeout=timeout_s):
+                raise RuntimeError(f"node {n.rank} never passed the barrier")
+        ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+        router_mesh = nodes[-1]
+        by_rank = {n.rank: n for n in ring}
+        any_node = ring[0]
+        page = max(1, any_node.page)
+        ownership = any_node.ownership
+
+        # -- engine (the convoy + restore substrate) -------------------
+        mcfg = ModelConfig(
+            vocab_size=256, hidden=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            head_dim=32, intermediate=128,
+            max_seq_len=max(4096, 2 * long_prompt),
+        )
+        eng = Engine(
+            mcfg,
+            init_params(mcfg, jax.random.PRNGKey(seed)),
+            num_slots=16384,
+            page_size=4,
+            max_batch=12,
+            host_cache_slots=8192,
+            kv_transfer_async=True,
+            kv_transfer_chunk_tokens=restore_chunk_tokens,
+            name="doctor-eng",
+        )
+
+        def prompts_of(n_tokens: int, count: int) -> list[np.ndarray]:
+            return [
+                rng.integers(
+                    1, mcfg.vocab_size - 1, size=n_tokens
+                ).astype(np.int32)
+                for _ in range(count)
+            ]
+
+        short_sampling = SamplingParams(temperature=0.0, max_new_tokens=12)
+        long_sampling = SamplingParams(temperature=0.0, max_new_tokens=2)
+        healthy_sampling = SamplingParams(temperature=0.0, max_new_tokens=10)
+
+        # Warm-up UNTRACED (sample=0), one run of EVERY measured burst
+        # composition (same shapes, same batch sizes, fresh prompts):
+        # jit compiles land here, so the traced phases time steady-state
+        # waves, not compilation, and the attributor never sees these
+        # requests.
+        set_recorder(FlightRecorder(capacity=4096, sample=0.0, node="warm"))
+        eng.generate(
+            [list(p) for p in prompts_of(24, 3) + prompts_of(48, 3)],
+            healthy_sampling,
+        )
+        eng.generate(
+            [list(p) for p in prompts_of(short_prompt, 6)], short_sampling
+        )
+        eng.generate(
+            [list(p) for p in prompts_of(long_prompt, 3)], long_sampling
+        )
+        # Restore-phase prefixes seed (and compile) here too — their
+        # re-serve in pathology (c) must find them in the HOST tier.
+        restore_prompts = prompts_of(restore_prompt, 3)
+        eng.generate([list(p) for p in restore_prompts], long_sampling)
+
+        # Traced from here: fresh recorder at full sampling, attributor
+        # installed on its retire hook, ONE doctor over every plane.
+        rec = FlightRecorder(
+            capacity=1 << 16, sample=1.0, node="doctor-eng"
+        )
+        set_recorder(rec)
+        attr = ensure_attributor(rec)
+        slo = OverloadController(SLOConfig())
+        doctor = MeshDoctor(
+            mesh=router_mesh,
+            engine=eng,
+            slo=slo,
+            attributor=ensure_attributor,
+        )
+
+        # -- phase 0: healthy ------------------------------------------
+        # Balanced heat: ONE key per distinct shard, equal token counts,
+        # inserted at the shard's primary owner → skew ≈ 1.
+        seen_shards: set[int] = set()
+        attempts = 0
+        while len(seen_shards) < balanced_shards and attempts < 10_000:
+            attempts += 1
+            key = np.concatenate([
+                np.asarray([11_000 + attempts], dtype=np.int32),
+                rng.integers(1, 600, size=key_len - 1).astype(np.int32),
+            ])
+            sid = shard_of_tokens(key[:page])
+            if sid in seen_shards:
+                continue
+            seen_shards.add(sid)
+            node = by_rank[ownership.primary(sid)]
+            slots = np.arange(len(key), dtype=np.int32)
+            node.insert(key, slots)
+            node.match_prefix(key)
+        for n in ring:
+            n.broadcast_shard_summary()
+        wait_for(
+            lambda: router_mesh.fleet.shard_heat()["reporters"]
+            >= len(ring) - 1
+        )
+        # Decode-dominant two-shape burst: neither shape may look like a
+        # convoy (share < threshold, similar e2e).
+        healthy_prompts = prompts_of(24, 3) + prompts_of(48, 3)
+        eng.generate([list(p) for p in healthy_prompts], healthy_sampling)
+        healthy_report = doctor.diagnose()
+        healthy = {
+            "performed": True,
+            "findings": healthy_report["findings"],
+            "rules_checked": healthy_report["rules_checked"],
+            "inputs": healthy_report["inputs"],
+            "audited_requests": attr.stats()["audited"],
+            "balanced_shards": len(seen_shards),
+            "skew_score": router_mesh.shard_heat_report().get("skew_score"),
+        }
+
+        # -- pathology (a): zipf heat storm ----------------------------
+        heat = _obs_zipf_heat_phase(
+            ring=ring,
+            router_mesh=router_mesh,
+            by_rank=by_rank,
+            rng=rng,
+            wait_for=wait_for,
+            zipf_keys=zipf_keys,
+            zipf_inserts=zipf_inserts,
+            zipf_alpha=zipf_alpha,
+            key_len=key_len,
+        )
+        # The zipf phase's reporter wait can be satisfied by the STALE
+        # healthy-phase fold (reporters is a set size, not a freshness
+        # signal) — hold the diagnosis until the storm's heat actually
+        # folded at the router, or the doctor reads last round's map.
+        wait_for(
+            lambda: router_mesh.shard_heat_report().get("skew_score", 0.0)
+            >= doctor.cfg.hot_shard_skew
+        )
+        hot_finding = finding_for(doctor.diagnose(), "hot_shard")
+        hot_expected = {
+            "shard": heat["expected_hot_shard"],
+            "owners": heat["expected_hot_owners"],
+            "min_skew": doctor.cfg.hot_shard_skew,
+        }
+        hot = {
+            "performed": True,
+            "rule": "hot_shard",
+            "detected": hot_finding is not None,
+            "score": (hot_finding or {}).get("score"),
+            "summary": (hot_finding or {}).get("summary", ""),
+            "evidence": (hot_finding or {}).get("evidence", {}),
+            "expected": hot_expected,
+            "evidence_correct": bool(
+                hot_finding is not None
+                and hot_finding["evidence"].get("shard")
+                == heat["expected_hot_shard"]
+                and sorted(hot_finding["evidence"].get("owners", []))
+                == heat["expected_hot_owners"]
+                and hot_finding["evidence"].get("skew_score", 0)
+                >= doctor.cfg.hot_shard_skew
+            ),
+        }
+
+        # -- pathology (b): convoying long-prompt burst ----------------
+        eng.generate(
+            [list(p) for p in prompts_of(short_prompt, 6)], short_sampling
+        )
+        eng.generate(
+            [list(p) for p in prompts_of(long_prompt, 3)], long_sampling
+        )
+        convoy_shape = shape_bucket(long_prompt)
+        convoy_finding = finding_for(doctor.diagnose(), "prefill_convoy")
+        convoy_expected = {
+            "shape": convoy_shape,
+            "min_share": doctor.cfg.convoy_prefill_share,
+            "requests": 3,
+        }
+        convoy = {
+            "performed": True,
+            "rule": "prefill_convoy",
+            "detected": convoy_finding is not None,
+            "score": (convoy_finding or {}).get("score"),
+            "summary": (convoy_finding or {}).get("summary", ""),
+            "evidence": (convoy_finding or {}).get("evidence", {}),
+            "expected": convoy_expected,
+            "evidence_correct": bool(
+                convoy_finding is not None
+                and convoy_finding["evidence"].get("shape") == convoy_shape
+                and convoy_finding["evidence"].get("prefill_share", 0)
+                >= doctor.cfg.convoy_prefill_share
+                and convoy_finding["evidence"].get("requests") == 3
+            ),
+        }
+
+        # -- pathology (c): throttled restore lane ---------------------
+        # Push the warm-up prefixes to the HOST tier, re-request them
+        # through the tiny-chunk plane, step JUST until they park —
+        # then diagnose with the staged backlog deliberately unpumped
+        # (the engine thread is the only pump; we hold it).
+        eng.tree.evict(10 * restore_prompt * len(restore_prompts))
+        eng.kv_transfer.wait_host_ready()
+        parked_reqs = [
+            eng.add_request(list(p), long_sampling) for p in restore_prompts
+        ]
+        for _ in range(50):
+            eng.step()
+            if len(eng._restoring) >= len(parked_reqs):
+                break
+        stall_finding = finding_for(doctor.diagnose(), "restore_park_stall")
+        stall_expected = {
+            "lane": "restore",
+            "parked": len(parked_reqs),
+        }
+        stall = {
+            "performed": True,
+            "rule": "restore_park_stall",
+            "detected": stall_finding is not None,
+            "score": (stall_finding or {}).get("score"),
+            "summary": (stall_finding or {}).get("summary", ""),
+            "evidence": (stall_finding or {}).get("evidence", {}),
+            "expected": stall_expected,
+            "evidence_correct": bool(
+                stall_finding is not None
+                and stall_finding["evidence"].get("lane") == "restore"
+                and stall_finding["evidence"].get("parked")
+                == len(parked_reqs)
+                and stall_finding["evidence"].get("restores_queued", 0) > 0
+            ),
+        }
+        # Release the lane and let the parked requests finish — the
+        # pathology is a diagnosis scenario, not a leaked stall.
+        from radixmesh_tpu.engine.request import RequestState
+
+        for _ in range(max_steps):
+            eng.step()
+            if all(
+                r.state is RequestState.FINISHED for r in parked_reqs
+            ):
+                break
+
+        stats = attr.stats()
+        attribution = {
+            "audited": stats["audited"],
+            "refused": stats["refused"],
+            "max_sum_error_s": stats["max_sum_error_s"],
+            "epsilon_s": 1e-6,
+            "sums_ok": bool(stats["max_sum_error_s"] <= 1e-6),
+            "phases": {
+                p: {"count": v["count"], "p99_s": v["p99_s"]}
+                for p, v in attr.report()["phases"].items()
+            },
+        }
+    finally:
+        set_recorder(prev_recorder)
+        if eng is not None and eng.kv_transfer is not None:
+            eng.kv_transfer.close()
+        for n in nodes:
+            n.close()
+        InprocHub.reset_default()
+
+    return {
+        "nodes": len(prefill) + len(decode) + len(router_addrs),
+        "topology": "4 prefill + 2 decode + 1 router (inproc) + traced "
+        "CPU engine",
+        "replication_factor": replication_factor,
+        "healthy": healthy,
+        "pathologies": {
+            "hot_shard": hot,
+            "prefill_convoy": convoy,
+            "restore_park_stall": stall,
+        },
+        "attribution": attribution,
+        "wall_s": round(_time.monotonic() - t_start, 3),
+    }

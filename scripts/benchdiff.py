@@ -1,0 +1,129 @@
+"""Bench regression sentinel: schema-aware diff of two same-schema
+round artifacts (``bench.compare_rounds``).
+
+Eleven artifact schemas accumulated over eleven rounds with no machine
+check on the trajectory between them — a silently regressed hit ratio
+or a halved ring throughput would ride a green round. This CLI pins the
+check: each artifact kind declares the metrics worth guarding (dotted
+path, direction, relative significance threshold, ``bench.
+COMPARE_RULES``); everything else is reported informationally.
+
+Exit codes are PINNED (CI gates on them):
+
+- 0 — clean: no guarded metric moved adversely past its threshold
+- 1 — regression: at least one did (each is printed with its values)
+- 2 — schema mismatch: different artifact kinds, unrecognized kind,
+  unreadable input, or a guarded field one-sidedly missing at the SAME
+  schema version (fields are never removed in this repo, so that means
+  the schema drifted without a version bump)
+
+A schema-version DIFFERENCE is not a mismatch: versions only bump
+additively, so cross-version trajectory diffs (e.g. CHAOS v2 → v3) are
+legal — fields present on only one side are listed as skipped.
+
+Usage::
+
+    python scripts/benchdiff.py OLD.json NEW.json [--kind KIND]
+        [--json] [--strict | --threshold-scale X]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (compare_rounds + the pinned rule tables)
+
+
+def _load(path: str) -> dict:
+    with open(path) as fh:
+        obj = json.load(fh)
+    if not isinstance(obj, dict):
+        raise ValueError(f"{path}: artifact is not a JSON object")
+    return obj
+
+
+def _fmt_row(row: dict) -> str:
+    mark = {"regression": "✗", "improvement": "✓", "ok": "·"}[row["verdict"]]
+    rel = "" if row["rel"] is None else f" ({row['rel']:+.1%})"
+    return (
+        f"  {mark} {row['path']}: {row['old']} → {row['new']}{rel}"
+        f"  [{row['direction']} better, ±{row['threshold']:.0%}]"
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="benchdiff")
+    ap.add_argument("old", help="baseline artifact (<KIND>_r<N>.json)")
+    ap.add_argument("new", help="candidate artifact (same kind)")
+    ap.add_argument(
+        "--kind", default=None,
+        help="artifact kind override (else detected from filename/metric)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit the full diff as JSON"
+    )
+    ap.add_argument(
+        "--threshold-scale", type=float, default=1.0, metavar="X",
+        help="scale every significance threshold (2.0 = twice as lax)",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="zero thresholds: ANY adverse move flags (same as "
+        "--threshold-scale 0)",
+    )
+    args = ap.parse_args()
+
+    try:
+        old, new = _load(args.old), _load(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"benchdiff: cannot read artifact: {e}", file=sys.stderr)
+        return bench.BENCHDIFF_EXIT_MISMATCH
+
+    result = bench.compare_rounds(
+        old,
+        new,
+        kind=args.kind,
+        old_name=args.old,
+        new_name=args.new,
+        threshold_scale=0.0 if args.strict else args.threshold_scale,
+    )
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        status = result["status"]
+        print(
+            f"benchdiff: {os.path.basename(args.old)} → "
+            f"{os.path.basename(args.new)} "
+            f"[kind={result.get('kind')}] status={status.upper()}"
+        )
+        for m in result.get("mismatches", []):
+            print(f"  ! {m}")
+        for row in result.get("rows", []):
+            print(_fmt_row(row))
+        for path in result.get("skipped", []):
+            print(f"  - {path}: skipped (absent on one side of a "
+                  "schema-version change)")
+        vc = result.get("version_change")
+        if vc:
+            print(f"  ~ schema_version {vc['old']!r} → {vc['new']!r} "
+                  "(additive bump; diff proceeds)")
+        info = result.get("info_changes", [])
+        if info:
+            print(f"  … {len(info)} unguarded numeric field(s) moved "
+                  "(--json lists them)")
+    return {
+        "clean": bench.BENCHDIFF_EXIT_CLEAN,
+        "regression": bench.BENCHDIFF_EXIT_REGRESSION,
+        "schema_mismatch": bench.BENCHDIFF_EXIT_MISMATCH,
+    }[result["status"]]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

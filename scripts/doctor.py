@@ -1,0 +1,135 @@
+"""The mesh doctor's CLI: diagnose a LIVE cluster, or run the seeded
+acceptance workload and emit the round's DOCTOR artifact.
+
+Live mode (default) hits a frontend's ``GET /cluster/doctor``
+(``obs/doctor.py`` runs server-side — the burn-rate windows live in the
+frontend's persistent doctor, so the CLI is a thin, dependency-free
+reader) and renders the ranked findings with their pinned evidence.
+Exit codes: 0 healthy, 1 findings, 2 unreachable/bad response.
+
+Workload mode (``--workload``) runs ``workload.run_doctor_workload`` —
+healthy phase + three deterministically seeded pathologies over an rf=3
+inproc cluster — folds in the benchdiff sentinel self-check, validates
+against the pinned DOCTOR schema (``bench.validate_doctor``), and
+writes ``DOCTOR_r{N}.json``.
+
+Usage::
+
+    python scripts/doctor.py [--url http://HOST:PORT] [--watch SECONDS]
+    python scripts/doctor.py --workload [--seed 0] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _render(report: dict) -> None:
+    findings = report.get("findings", [])
+    checked = report.get("rules_checked", [])
+    inputs = report.get("inputs", {})
+    attached = [k for k, v in inputs.items() if v]
+    if not findings:
+        print(
+            f"HEALTHY — {len(checked)} rule(s) ran over planes "
+            f"{attached}, zero findings"
+        )
+        return
+    print(f"{len(findings)} finding(s), ranked (planes {attached}):")
+    for i, f in enumerate(findings, 1):
+        print(f"  {i}. [{f['rule']}] score={f['score']:.2f}")
+        print(f"     {f['summary']}")
+        ev = ", ".join(f"{k}={v!r}" for k, v in f["evidence"].items())
+        print(f"     evidence: {ev}")
+
+
+def _live(url: str, watch: float | None) -> int:
+    endpoint = url.rstrip("/") + "/cluster/doctor"
+    while True:
+        try:
+            with urllib.request.urlopen(endpoint, timeout=10) as resp:
+                report = json.load(resp)
+        except Exception as e:  # noqa: BLE001 — any transport failure is the same verdict
+            print(f"doctor: {endpoint} unreachable: {e}", file=sys.stderr)
+            return 2
+        if not isinstance(report, dict) or "findings" not in report:
+            print(f"doctor: {endpoint} returned no findings field",
+                  file=sys.stderr)
+            return 2
+        if watch is None:
+            _render(report)
+            return 0 if report.get("healthy") else 1
+        os.write(1, f"\n=== {time.strftime('%H:%M:%S')} ===\n".encode())
+        _render(report)
+        time.sleep(watch)
+
+
+def _workload(seed: int, out: str | None) -> int:
+    import bench
+    from radixmesh_tpu.workload import run_doctor_workload
+
+    res = run_doctor_workload(seed=seed)
+    res["benchdiff"] = bench.benchdiff_selfcheck()
+    report = bench.build_doctor_report(res)
+    problems = bench.validate_doctor(report)
+    if problems:
+        report["schema_violation"] = problems
+    path = out or os.path.join(
+        _REPO_ROOT, f"DOCTOR_r{bench.current_round():02d}.json"
+    )
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    named = report["value"]
+    total = len(bench.DOCTOR_PATHOLOGIES)
+    print(json.dumps({
+        "metric": report["metric"],
+        "value": named,
+        "healthy_findings": len(report["healthy"]["findings"]),
+        "audited": report["attribution"]["audited"],
+        "max_sum_error_s": report["attribution"]["max_sum_error_s"],
+        "benchdiff": report["benchdiff"],
+        "schema_violation": problems or None,
+        "artifact": os.path.basename(path),
+    }))
+    return 0 if named == total and not problems else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="doctor")
+    ap.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="frontend base URL (serving or router; both expose "
+        "/cluster/doctor)",
+    )
+    ap.add_argument(
+        "--watch", type=float, default=None, metavar="SECONDS",
+        help="re-diagnose every SECONDS (live mode only; ctrl-c to stop)",
+    )
+    ap.add_argument(
+        "--workload", action="store_true",
+        help="run the seeded acceptance workload and write DOCTOR_r{N}.json "
+        "instead of querying a live cluster",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--out", default=None, metavar="FILE",
+        help="workload-mode artifact path (default DOCTOR_r{N}.json)",
+    )
+    args = ap.parse_args()
+    if args.workload:
+        return _workload(args.seed, args.out)
+    return _live(args.url, args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,346 @@
+"""Critical-path latency attribution (obs/attribution.py): the
+exclusive phase decomposition MUST sum to end-to-end — the invariant
+the whole diagnosis plane stands on (a breakdown that doesn't sum is a
+lie with decimals) — proven here over seeded recorder traces including
+the PR 7 resurrection path and the PR 4 parked-RESTORING path, plus
+the trace-loss refusal contract: no waterfalls from holed traces."""
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.obs.attribution import (
+    PHASE_OF_SPAN,
+    PHASE_PRIORITY,
+    PHASES,
+    RESIDUAL_PHASE,
+    PhaseAttributor,
+    Waterfall,
+    ensure_attributor,
+    shape_bucket,
+    waterfall_from_spans,
+)
+from radixmesh_tpu.obs.metrics import Registry, get_registry, set_registry
+from radixmesh_tpu.obs.trace_plane import (
+    FlightRecorder,
+    Span,
+    get_recorder,
+    set_recorder,
+)
+
+pytestmark = pytest.mark.quick
+
+EPS = 1e-9
+
+
+@pytest.fixture
+def fresh_planes():
+    """Isolated registry + traced recorder with an installed attributor."""
+    old_reg = set_registry(Registry())
+    old_rec = get_recorder()
+    rec = FlightRecorder(capacity=256, sample=1.0, node="t0")
+    set_recorder(rec)
+    attr = ensure_attributor(rec)
+    yield rec, attr
+    set_recorder(old_rec)
+    set_registry(old_reg)
+
+
+def _span(name, t0, dur, tid=7, **args):
+    return Span(name, "req:1", t0, dur, tid, args=args or None, node="t0")
+
+
+def _retire(t0, dur, tid=7, **args):
+    return _span("request_done", t0, dur, tid, **args)
+
+
+class TestWaterfallDecomposition:
+    def test_sums_to_e2e_exactly_on_gapped_overlapping_spans(self):
+        # admission envelope covers everything; prefill + decode overlap
+        # it; a mid-window gap must land in the residual, not vanish.
+        spans = [
+            _span("slo_queue", 0.0, 0.1),
+            _span("admission_wait", 0.0, 0.5),
+            _span("prefill_wave", 0.2, 0.2),
+            _span("decode_chunk", 0.6, 0.3),
+        ]
+        wf = waterfall_from_spans(spans, _retire(0.0, 1.0))
+        assert abs(sum(wf.phases.values()) - wf.e2e_s) < EPS
+        assert wf.phases["slo_queue"] == pytest.approx(0.1)
+        assert wf.phases["prefill"] == pytest.approx(0.2)
+        assert wf.phases["decode"] == pytest.approx(0.3)
+        # admission exclusive = envelope minus the queue + prefill slices
+        assert wf.phases["admission"] == pytest.approx(0.2)
+        # 0.5..0.6 and 0.9..1.0 are covered by nothing → residual edge
+        assert wf.phases[RESIDUAL_PHASE] == pytest.approx(0.2)
+
+    def test_priority_most_specific_wins(self):
+        # decode and prefill both cover the instant: decode is listed
+        # first in PHASE_PRIORITY and must win the overlap.
+        spans = [
+            _span("prefill_wave", 0.0, 1.0),
+            _span("decode_chunk", 0.4, 0.2),
+        ]
+        wf = waterfall_from_spans(spans, _retire(0.0, 1.0))
+        assert wf.phases["decode"] == pytest.approx(0.2)
+        assert wf.phases["prefill"] == pytest.approx(0.8)
+
+    def test_spans_clipped_to_retire_window(self):
+        # A replication edge recorded after the engine window closed
+        # (receiver-side lag span) must not inflate the decomposition.
+        spans = [
+            _span("decode_chunk", 0.0, 0.5),
+            _span("replication_lag", 0.9, 0.8),  # sticks out past hi
+            _span("mesh_publish", -0.3, 0.4),  # starts before lo
+        ]
+        wf = waterfall_from_spans(spans, _retire(0.0, 1.0))
+        assert abs(sum(wf.phases.values()) - wf.e2e_s) < EPS
+        # lag clipped to [0.9, 1.0]; the publish head is clipped to
+        # [0.0, 0.1] but decode covers it and wins the overlap.
+        assert wf.phases["replication"] == pytest.approx(0.1)
+
+    def test_resurrection_path_sums(self):
+        # PR 7 shape: first life's spans, a resurrect edge, then the
+        # second life's admission + prefill replay + decode under ONE
+        # trace id (the adopted-id contract).
+        spans = [
+            _span("slo_queue", 0.00, 0.05),
+            _span("admission_wait", 0.00, 0.10),
+            _span("prefill_wave", 0.10, 0.15),
+            _span("decode_chunk", 0.25, 0.10),
+            _span("resurrect", 0.35, 0.20),  # detect + backoff + re-route
+            _span("hedge", 0.45, 0.05),  # overlaps the resurrect leg
+            _span("admission_wait", 0.55, 0.05),  # second life admits
+            _span("prefill_wave", 0.60, 0.10),  # replay = cache-hit prefill
+            _span("decode_chunk", 0.70, 0.25),
+            _span("mesh_publish", 0.95, 0.02),
+        ]
+        wf = waterfall_from_spans(spans, _retire(0.0, 1.0))
+        assert abs(sum(wf.phases.values()) - wf.e2e_s) < EPS
+        assert wf.phases["resurrection"] == pytest.approx(0.20)
+        assert wf.phases["decode"] == pytest.approx(0.35)
+        assert wf.phases["prefill"] == pytest.approx(0.25)
+
+    def test_parked_restoring_path_sums(self):
+        # PR 4 shape: the request parks in RESTORING behind a staged
+        # restore (kv_restore covers park→pages-landed), then prefills
+        # over the restored prefix.
+        spans = [
+            _span("admission_wait", 0.0, 0.40),
+            _span("kv_restore", 0.05, 0.30),
+            _span("prefill_wave", 0.40, 0.10),
+            _span("decode_chunk", 0.55, 0.40),
+        ]
+        wf = waterfall_from_spans(spans, _retire(0.0, 1.0))
+        assert abs(sum(wf.phases.values()) - wf.e2e_s) < EPS
+        assert wf.phases["restore_park"] == pytest.approx(0.30)
+        assert wf.phases["admission"] == pytest.approx(0.05 + 0.05)
+        # [0.5, 0.55] and [0.95, 1.0] are uncovered
+        assert wf.phases[RESIDUAL_PHASE] == pytest.approx(0.1)
+
+    def test_property_random_layouts_sum_to_e2e(self):
+        # The property the artifact gates on: ANY span soup — random
+        # phases, overlaps, gaps, clipping — decomposes exclusively.
+        rng = np.random.default_rng(0xD0C)
+        names = list(PHASE_OF_SPAN)
+        for trial in range(200):
+            n = int(rng.integers(0, 12))
+            spans = [
+                _span(
+                    names[int(rng.integers(0, len(names)))],
+                    float(rng.uniform(-0.2, 1.2)),
+                    float(rng.uniform(0.0, 0.6)),
+                )
+                for _ in range(n)
+            ]
+            e2e = float(rng.uniform(0.01, 2.0))
+            wf = waterfall_from_spans(spans, _retire(0.0, e2e))
+            total = sum(wf.phases.values())
+            assert abs(total - e2e) < 1e-7, (trial, total, e2e)
+            assert all(v >= 0.0 for v in wf.phases.values())
+            assert set(wf.phases) == set(PHASES)
+
+    def test_zero_length_window(self):
+        wf = waterfall_from_spans([_span("decode_chunk", 0.0, 1.0)],
+                                  _retire(0.5, 0.0))
+        assert wf.e2e_s == 0.0
+        assert sum(wf.phases.values()) == 0.0
+
+    def test_shape_and_tokens_from_retire_args(self):
+        wf = waterfall_from_spans(
+            [], _retire(0.0, 1.0, prompt_tokens=100, output_tokens=7)
+        )
+        assert wf.shape == "p128"
+        assert wf.prompt_tokens == 100
+        assert wf.output_tokens == 7
+
+
+class TestShapeBucket:
+    def test_pow2_buckets(self):
+        assert shape_bucket(1) == "p32"
+        assert shape_bucket(32) == "p32"
+        assert shape_bucket(33) == "p64"
+        assert shape_bucket(96) == "p128"
+        assert shape_bucket(1536) == "p2048"
+
+    def test_engine_and_attribution_share_the_bucket(self):
+        # The doctor compares the attributor's shape table against the
+        # engine's spec counters — one function, zero drift by import.
+        from radixmesh_tpu.engine.engine import (
+            shape_bucket as engine_bucket,
+        )
+
+        assert engine_bucket is shape_bucket
+
+
+class TestRetireHookAndHistograms:
+    def test_retire_feeds_phase_histograms(self, fresh_planes):
+        rec, attr = fresh_planes
+        ctx = rec.trace("req:1", node="t0")
+        t0 = 100.0
+        ctx.add("admission_wait", t0, 0.2, cat="queue")
+        ctx.add("decode_chunk", t0 + 0.2, 0.8, cat="decode",
+                prompt_tokens=50)
+        ctx.add("request_done", t0, 1.0, cat="scheduler",
+                prompt_tokens=50, output_tokens=9)
+        st = attr.stats()
+        assert st["audited"] == 1 and st["refused"] == 0
+        assert st["max_sum_error_s"] < EPS
+        rep = attr.report()
+        assert rep["phases"]["decode"]["count"] == 1
+        assert rep["phases"]["decode"]["sum_s"] == pytest.approx(0.8)
+        assert rep["by_shape"]["p64"]["count"] == 1
+        share = rep["by_shape"]["p64"]["phase_share"]["decode"]
+        assert share == pytest.approx(0.8, abs=0.01)
+
+    def test_every_phase_series_materialized_at_install(self, fresh_planes):
+        # Dashboards see all phase children at 0 from the start (the
+        # eviction_counters convention), not appearing from nowhere.
+        snap = get_registry().snapshot()
+        for phase in PHASES:
+            key = f'radixmesh_request_phase_seconds{{phase="{phase}"}}_count'
+            assert key in snap, sorted(snap)[:10]
+
+    def test_second_retire_widens_recent_not_histograms(self, fresh_planes):
+        rec, attr = fresh_planes
+        ctx = rec.trace("req:1", node="t0")
+        ctx.add("decode_chunk", 0.1, 0.5, cat="decode")
+        ctx.add("request_done", 0.1, 0.6, prompt_tokens=10)
+        ctx.add("http_request", 0.0, 1.0, prompt_tokens=10)  # envelope
+        st = attr.stats()
+        assert st["audited"] == 1  # histograms fed once
+        recent = attr.report()["recent"]
+        assert len(recent) == 1
+        assert recent[0]["retire"] == "http_request"
+        assert recent[0]["e2e_s"] == pytest.approx(1.0)
+
+    def test_untraced_spans_never_reach_the_attributor(self, fresh_planes):
+        rec, attr = fresh_planes
+        rec._record(Span("request_done", "req:9", 0.0, 1.0, 0))  # tid 0
+        assert attr.stats()["audited"] == 0
+
+    def test_sampling_off_is_a_noop(self):
+        # The PR 2 contract extends to the retire hook: recorder off →
+        # trace() is None → no spans → no retires, zero attributor work.
+        old_reg = set_registry(Registry())
+        old_rec = get_recorder()
+        rec = FlightRecorder(capacity=64, sample=0.0, node="off")
+        set_recorder(rec)
+        try:
+            attr = ensure_attributor(rec)
+            assert rec.trace("req:1") is None
+            assert attr.stats()["audited"] == 0
+            assert len(rec) == 0
+        finally:
+            set_recorder(old_rec)
+            set_registry(old_reg)
+
+    def test_ensure_attributor_reuses_and_swaps(self, fresh_planes):
+        rec, attr = fresh_planes
+        assert ensure_attributor(rec) is attr
+        rec2 = FlightRecorder(capacity=32, sample=1.0, node="t1")
+        attr2 = ensure_attributor(rec2)
+        assert attr2 is not attr and rec2.attributor is attr2
+
+
+class TestHoledTraceRefusal:
+    def test_refuses_waterfall_when_trace_lost_spans(self, fresh_planes):
+        rec = FlightRecorder(capacity=4, sample=1.0, node="t0")
+        attr = ensure_attributor(rec)
+        ctx = rec.trace("req:1", node="t0")
+        for i in range(8):  # 4 evictions, all from this trace
+            ctx.add("decode_chunk", float(i), 0.5, cat="decode")
+        assert rec.trace_has_drops(ctx.trace_id)
+        ctx.add("request_done", 0.0, 8.0)
+        st = attr.stats()
+        assert st["audited"] == 0
+        assert st["refused"] == 1
+        snap = get_registry().snapshot()
+        assert snap['radixmesh_trace_waterfall_refusals_total{node="t0"}'] == 1
+
+    def test_clean_trace_unaffected_by_other_traces_drops(self, fresh_planes):
+        rec = FlightRecorder(capacity=6, sample=1.0, node="t0")
+        attr = ensure_attributor(rec)
+        victim = rec.trace("req:1", node="t0")
+        for i in range(8):
+            victim.add("decode_chunk", float(i), 0.1, cat="decode")
+        clean = rec.trace("req:2", node="t0")
+        clean.add("decode_chunk", 0.0, 0.5, cat="decode")
+        clean.add("request_done", 0.0, 1.0)
+        assert attr.stats()["audited"] == 1
+        assert not rec.trace_has_drops(clean.trace_id)
+
+    def test_dropped_tid_cap_refuses_everything(self, fresh_planes):
+        rec, attr = fresh_planes
+        rec.drops_untracked = True  # the 4k-distinct-traces storm case
+        assert rec.trace_has_drops(123)
+        ctx = rec.trace("req:1", node="t0")
+        ctx.add("request_done", 0.0, 1.0)
+        assert attr.stats()["refused"] == 1
+
+
+class TestTraceLossVisibility:
+    def test_drop_increments_counter_and_export_declares(self, fresh_planes):
+        from radixmesh_tpu.obs.trace_plane import stitch_traces
+
+        rec = FlightRecorder(capacity=4, sample=1.0, node="t0")
+        ctx = rec.trace("req:1", node="t0")
+        for i in range(6):
+            ctx.add("publish", float(i), 0.1, cat="cache")
+        assert rec.dropped == 2
+        snap = get_registry().snapshot()
+        assert snap['radixmesh_trace_dropped_spans_total{node="t0"}'] == 2
+        export = rec.export_spans()
+        assert export["dropped"] == 2
+        stitched = stitch_traces([export])
+        meta = stitched["otherData"]
+        assert meta["dropped"] == {"t0": 2}
+        assert meta["dropped_total"] == 2
+
+    def test_state_reports_holed_traces(self, fresh_planes):
+        rec = FlightRecorder(capacity=2, sample=1.0, node="t0")
+        ctx = rec.trace("req:1", node="t0")
+        for i in range(4):
+            ctx.add("publish", float(i), 0.1, cat="cache")
+        st = rec.stats()
+        assert st["holed_traces"] == 1
+        assert st["dropped_spans"] == 2
+        assert st["drops_untracked"] is False
+
+
+class TestWaterfallDict:
+    def test_as_dict_round_numbers(self):
+        wf = Waterfall(
+            trace_id=0xAB, t0=0.0, e2e_s=1.0,
+            phases={p: 0.0 for p in PHASES}, retire="request_done",
+        )
+        d = wf.as_dict()
+        assert d["trace_id"] == f"{0xAB:#018x}"
+        assert set(d["phases"]) == set(PHASES)
+
+
+class TestVocabulary:
+    def test_every_mapped_phase_has_a_priority(self):
+        for phase in PHASE_OF_SPAN.values():
+            assert phase in PHASE_PRIORITY
+        assert RESIDUAL_PHASE not in PHASE_PRIORITY
+        assert RESIDUAL_PHASE in PHASES

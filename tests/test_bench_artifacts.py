@@ -1010,3 +1010,390 @@ class TestAnalysisArtifactSchema:
         assert report["thread_roots"]["count"] >= 15
         root_names = {r["name"] for r in report["thread_roots"]["roots"]}
         assert {"mesh-sender", "wire-receive", "kv-transfer"} <= root_names
+
+
+class TestDoctorArtifactSchema:
+    """DOCTOR v1 (PR 12, the diagnosis plane): zero findings on the
+    healthy phase with EVERY rule running, each seeded pathology named
+    with evidence matching the seeded ground truth, the phase
+    decomposition summing to e2e within epsilon on every audited
+    request, and the benchdiff sentinel's three-way self-check."""
+
+    def _pathology(self, rule: str, evidence: dict) -> dict:
+        return {
+            "performed": True,
+            "rule": rule,
+            "detected": True,
+            "evidence_correct": True,
+            "score": 0.9,
+            "summary": f"{rule} fired",
+            "evidence": evidence,
+            "expected": dict(evidence),
+        }
+
+    def _report(self) -> dict:
+        from radixmesh_tpu.obs.doctor import RULES
+
+        return {
+            "schema_version": bench.DOCTOR_SCHEMA_VERSION,
+            "metric": "doctor_pathologies_named",
+            "value": 3,
+            "unit": "of 3 seeded pathologies named with correct evidence",
+            "workload": "healthy + heat storm + convoy + throttled restore",
+            "nodes": 7,
+            "topology": "4 prefill + 2 decode + 1 router (inproc) + engine",
+            "replication_factor": 3,
+            "healthy": {
+                "performed": True,
+                "findings": [],
+                "rules_checked": list(RULES),
+                "inputs": {"mesh": True, "engine": True, "slo": True,
+                           "attribution": True},
+                "audited_requests": 6,
+            },
+            "pathologies": {
+                "hot_shard": self._pathology("hot_shard", {
+                    "skew_score": 19.5, "shard": 7,
+                    "owners": [0, 1, 2, 4, 5], "reporters": 6,
+                }),
+                "prefill_convoy": self._pathology("prefill_convoy", {
+                    "shape": "p2048", "prefill_share": 0.95,
+                    "mean_e2e_s": 0.2, "fleet_mean_e2e_s": 0.04,
+                    "requests": 3,
+                }),
+                "restore_park_stall": self._pathology("restore_park_stall", {
+                    "lane": "restore", "parked": 3, "restores_queued": 4,
+                    "park_p99_s": 0.0001, "park_share": 0.0,
+                }),
+            },
+            "attribution": {
+                "audited": 18, "refused": 0, "max_sum_error_s": 0.0,
+                "epsilon_s": bench.DOCTOR_SUM_EPSILON_S, "sums_ok": True,
+                "phases": {},
+            },
+            "benchdiff": {
+                "identical_clean": True, "regression_flagged": True,
+                "mismatch_detected": True,
+            },
+            "wall_s": 12.0,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_doctor(self._report()) == []
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["pathologies"]["hot_shard"]
+        del report["attribution"]["sums_ok"]
+        del report["healthy"]["audited_requests"]
+        problems = bench.validate_doctor(report)
+        assert any("pathologies.hot_shard" in p for p in problems)
+        assert any("attribution.sums_ok" in p for p in problems)
+        assert any("healthy.audited_requests" in p for p in problems)
+
+    def test_healthy_findings_fail_the_gate(self):
+        report = self._report()
+        report["healthy"]["findings"] = [{"rule": "hot_shard"}]
+        problems = "\n".join(bench.validate_doctor(report))
+        assert "cries wolf" in problems
+
+    def test_all_rules_must_have_run_on_healthy(self):
+        report = self._report()
+        report["healthy"]["rules_checked"] = ["hot_shard"]
+        problems = "\n".join(bench.validate_doctor(report))
+        assert "never ran" in problems
+
+    def test_undetected_pathology_fails(self):
+        report = self._report()
+        report["pathologies"]["prefill_convoy"]["detected"] = False
+        problems = "\n".join(bench.validate_doctor(report))
+        assert "NOT detected" in problems
+
+    def test_wrong_evidence_fails(self):
+        report = self._report()
+        report["pathologies"]["hot_shard"]["evidence_correct"] = False
+        problems = "\n".join(bench.validate_doctor(report))
+        assert "ground truth" in problems
+
+    def test_evidence_must_carry_pinned_fields(self):
+        report = self._report()
+        del report["pathologies"]["hot_shard"]["evidence"]["owners"]
+        problems = "\n".join(bench.validate_doctor(report))
+        assert "pinned" in problems and "owners" in problems
+
+    def test_sum_epsilon_gate_enforced(self):
+        report = self._report()
+        report["attribution"]["max_sum_error_s"] = 0.01
+        report["attribution"]["sums_ok"] = False
+        problems = "\n".join(bench.validate_doctor(report))
+        assert "sum to e2e" in problems
+
+    def test_refusals_fail_the_acceptance_run(self):
+        report = self._report()
+        report["attribution"]["refused"] = 2
+        problems = "\n".join(bench.validate_doctor(report))
+        assert "refusal" in problems
+
+    def test_benchdiff_sentinel_gates(self):
+        for key in ("identical_clean", "regression_flagged",
+                    "mismatch_detected"):
+            report = self._report()
+            report["benchdiff"][key] = False
+            assert bench.validate_doctor(report), key
+
+    def test_skipped_sections_are_schema_valid_but_gate_exempt(self):
+        report = self._report()
+        report["healthy"] = {"performed": False}
+        report["pathologies"]["hot_shard"] = {"performed": False}
+        assert bench.validate_doctor(report) == []
+
+    def test_build_report_matches_schema(self):
+        core = {k: v for k, v in self._report().items()
+                if k not in ("schema_version", "metric", "value", "unit",
+                             "workload")}
+        report = bench.build_doctor_report(core)
+        assert bench.validate_doctor(report) == []
+        assert report["value"] == 3
+
+    def test_checked_in_artifact_validates_and_gates_green(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "DOCTOR_r*.json")))
+        assert paths, "no DOCTOR artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_doctor(report) == [], paths[-1]
+        assert "schema_violation" not in report
+        assert report["value"] == len(bench.DOCTOR_PATHOLOGIES)
+        assert report["healthy"]["findings"] == []
+        # The hot shard's owner set was named AND matched ground truth.
+        hot = report["pathologies"]["hot_shard"]
+        assert hot["evidence"]["owners"] == hot["expected"]["owners"]
+        assert report["attribution"]["max_sum_error_s"] <= (
+            report["attribution"]["epsilon_s"]
+        )
+
+
+class TestCompareRounds:
+    """The regression sentinel (bench.compare_rounds): per-kind pinned
+    metric directions, additive-version tolerance, and the pinned
+    status vocabulary the CLI's exit codes map onto."""
+
+    def _chaos(self, **over) -> dict:
+        base = {
+            "metric": "chaos_heal_converge_s",
+            "schema_version": bench.CHAOS_SCHEMA_VERSION,
+            "value": 0.4,
+            "crash": {"resurrection_hit_ratio": 0.95},
+            "repair": {"converge_s": 0.4},
+        }
+        base.update(over)
+        return base
+
+    def test_identical_pair_is_clean(self):
+        r = bench.compare_rounds(self._chaos(), self._chaos(), kind="CHAOS")
+        assert r["status"] == "clean"
+        assert r["regressions"] == []
+
+    def test_adverse_move_past_threshold_flags(self):
+        worse = self._chaos(value=1.8, repair={"converge_s": 1.8})
+        r = bench.compare_rounds(self._chaos(), worse, kind="CHAOS")
+        assert r["status"] == "regression"
+        assert "repair.converge_s" in r["regressions"]
+
+    def test_adverse_move_inside_threshold_is_noise(self):
+        slightly = self._chaos(value=0.45, repair={"converge_s": 0.45})
+        r = bench.compare_rounds(self._chaos(), slightly, kind="CHAOS")
+        assert r["status"] == "clean"
+
+    def test_improvement_direction_respected(self):
+        better = self._chaos(
+            value=0.1, repair={"converge_s": 0.1},
+            crash={"resurrection_hit_ratio": 0.99},
+        )
+        r = bench.compare_rounds(self._chaos(), better, kind="CHAOS")
+        assert r["status"] == "clean"
+        assert "repair.converge_s" in r["improvements"]
+
+    def test_higher_better_metric_drop_flags(self):
+        worse = self._chaos(crash={"resurrection_hit_ratio": 0.5})
+        r = bench.compare_rounds(self._chaos(), worse, kind="CHAOS")
+        assert "crash.resurrection_hit_ratio" in r["regressions"]
+
+    def test_kind_mismatch_refuses(self):
+        obs = {"metric": "obs_stitched_node_tracks", "schema_version": 1,
+               "value": 6}
+        r = bench.compare_rounds(self._chaos(), obs)
+        assert r["status"] == "schema_mismatch"
+
+    def test_unrecognized_kind_refuses(self):
+        r = bench.compare_rounds({"metric": "nope"}, {"metric": "nope"})
+        assert r["status"] == "schema_mismatch"
+
+    def test_kind_detected_from_filename(self):
+        assert bench.artifact_kind({}, "CHAOS_r08.json") == "CHAOS"
+        assert bench.artifact_kind({}, "/a/b/BENCH_FULL_r05.json") == (
+            "BENCH_FULL"
+        )
+        assert bench.artifact_kind({}, "notes.json") is None
+
+    def test_version_bump_skips_one_sided_fields(self):
+        old = self._chaos(schema_version=2)
+        del old["crash"]  # the section arrived with v3
+        r = bench.compare_rounds(old, self._chaos(), kind="CHAOS")
+        assert r["status"] == "clean"
+        assert "crash.resurrection_hit_ratio" in r["skipped"]
+        assert r["version_change"] == {"old": 2, "new": 3}
+
+    def test_same_version_one_sided_field_refuses(self):
+        old = self._chaos()
+        del old["crash"]
+        r = bench.compare_rounds(old, self._chaos(), kind="CHAOS")
+        assert r["status"] == "schema_mismatch"
+
+    def test_threshold_scale_zero_flags_any_adverse_move(self):
+        slightly = self._chaos(value=0.41, repair={"converge_s": 0.41})
+        r = bench.compare_rounds(
+            self._chaos(), slightly, kind="CHAOS", threshold_scale=0.0
+        )
+        assert r["status"] == "regression"
+
+    def test_unguarded_numeric_moves_are_informational(self):
+        moved = self._chaos()
+        moved["wall_s"] = 99.0
+        old = self._chaos()
+        old["wall_s"] = 10.0
+        r = bench.compare_rounds(old, moved, kind="CHAOS")
+        assert r["status"] == "clean"
+        assert any(c["path"] == "wall_s" for c in r["info_changes"])
+
+    def test_every_rule_path_resolves_in_checked_in_artifacts(self):
+        """Rot guard: each kind's pinned paths must exist in the LATEST
+        checked-in artifact of that kind (else the sentinel silently
+        guards nothing)."""
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for kind, rules in bench.COMPARE_RULES.items():
+            paths = sorted(glob.glob(os.path.join(repo, f"{kind}_r*.json")))
+            if not paths or not rules:
+                continue
+            with open(paths[-1]) as fh:
+                artifact = json.load(fh)
+            for path, _, _ in rules:
+                v = bench._dotted_get(artifact, path)
+                assert isinstance(v, (int, float)), (
+                    f"{kind}: pinned path {path!r} does not resolve to a "
+                    f"number in {os.path.basename(paths[-1])} (got {v!r})"
+                )
+
+    def test_selfcheck_is_green(self):
+        check = bench.benchdiff_selfcheck()
+        assert check["identical_clean"] is True
+        assert check["regression_flagged"] is True
+        assert check["mismatch_detected"] is True
+
+
+class TestBenchdiffCLI:
+    """scripts/benchdiff.py pinned exit codes: 0 clean / 1 regression /
+    2 schema mismatch — the contract CI gates on."""
+
+    def _run(self, tmp_path, old, new, *flags, old_name="CHAOS_r01.json",
+             new_name="CHAOS_r02.json"):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        a, b = tmp_path / old_name, tmp_path / new_name
+        a.write_text(json.dumps(old))
+        b.write_text(json.dumps(new))
+        return subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "benchdiff.py"),
+             str(a), str(b), *flags],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def _chaos(self, **over) -> dict:
+        base = {
+            "metric": "chaos_heal_converge_s",
+            "schema_version": bench.CHAOS_SCHEMA_VERSION,
+            "value": 0.4,
+            "crash": {"resurrection_hit_ratio": 0.95},
+            "repair": {"converge_s": 0.4},
+        }
+        base.update(over)
+        return base
+
+    def test_identical_pair_exits_0(self, tmp_path):
+        p = self._run(tmp_path, self._chaos(), self._chaos())
+        assert p.returncode == bench.BENCHDIFF_EXIT_CLEAN, p.stdout + p.stderr
+        assert "CLEAN" in p.stdout
+
+    def test_regression_exits_1_and_names_the_metric(self, tmp_path):
+        worse = self._chaos(value=2.0, repair={"converge_s": 2.0})
+        p = self._run(tmp_path, self._chaos(), worse)
+        assert p.returncode == bench.BENCHDIFF_EXIT_REGRESSION
+        assert "repair.converge_s" in p.stdout
+
+    def test_cross_kind_exits_2(self, tmp_path):
+        obs = {"metric": "obs_stitched_node_tracks", "schema_version": 1,
+               "value": 6}
+        p = self._run(tmp_path, self._chaos(), obs,
+                      new_name="OBS_r02.json")
+        assert p.returncode == bench.BENCHDIFF_EXIT_MISMATCH
+
+    def test_unreadable_input_exits_2(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        a = tmp_path / "CHAOS_r01.json"
+        a.write_text("{not json")
+        p = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "benchdiff.py"),
+             str(a), str(a)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert p.returncode == bench.BENCHDIFF_EXIT_MISMATCH
+
+    def test_strict_flag_zeroes_thresholds(self, tmp_path):
+        slightly = self._chaos(value=0.41, repair={"converge_s": 0.41})
+        p0 = self._run(tmp_path, self._chaos(), slightly)
+        assert p0.returncode == bench.BENCHDIFF_EXIT_CLEAN
+        p1 = self._run(tmp_path, self._chaos(), slightly, "--strict")
+        assert p1.returncode == bench.BENCHDIFF_EXIT_REGRESSION
+
+    def test_json_output_carries_the_full_diff(self, tmp_path):
+        worse = self._chaos(value=2.0, repair={"converge_s": 2.0})
+        p = self._run(tmp_path, self._chaos(), worse, "--json")
+        out = json.loads(p.stdout)
+        assert out["status"] == "regression"
+        assert any(r["verdict"] == "regression" for r in out["rows"])
+
+    def test_real_checked_in_pair_diffs(self, tmp_path):
+        """The sentinel runs on the actual bench trajectory: the two
+        checked-in BENCH_FULL rounds compare without a schema refusal
+        (clean or regression both prove the machinery; mismatch would
+        mean the trajectory is not machine-comparable)."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        old = os.path.join(repo, "BENCH_FULL_r04.json")
+        new = os.path.join(repo, "BENCH_FULL_r05.json")
+        if not (os.path.exists(old) and os.path.exists(new)):
+            pytest.skip("BENCH_FULL pair not checked in")
+        p = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "benchdiff.py"),
+             old, new],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert p.returncode in (
+            bench.BENCHDIFF_EXIT_CLEAN, bench.BENCHDIFF_EXIT_REGRESSION,
+        ), p.stdout + p.stderr

@@ -1,0 +1,396 @@
+"""Mesh doctor rule engine (obs/doctor.py): every rule unit-tested
+against synthetic FleetView/heat/histogram fixtures — each fires on its
+seeded pathology with the pinned evidence fields, stays silent on the
+healthy shape of the same inputs, and a broken rule degrades to a
+finding instead of an outage. The burn-rate tracker runs on a virtual
+clock so the 5m/1h windows are exact, not slept."""
+
+import pytest
+
+from radixmesh_tpu.obs.attribution import ensure_attributor
+from radixmesh_tpu.obs.doctor import (
+    RULE_EVIDENCE_FIELDS,
+    RULES,
+    BurnRateTracker,
+    DoctorConfig,
+    Finding,
+    MeshDoctor,
+)
+from radixmesh_tpu.obs.metrics import Registry, set_registry
+from radixmesh_tpu.obs.trace_plane import FlightRecorder
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    old = set_registry(Registry())
+    yield
+    set_registry(old)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeMesh:
+    """MeshCache stand-in: sharded flag + heat report + fleet digests."""
+
+    def __init__(self, sharded=True, skew=1.0, hot_shard=7,
+                 hot_owners=(0, 1, 2), reporters=4, lags=None):
+        self.sharded = sharded
+        self._report = {
+            "skew_score": skew,
+            "hot_shard": hot_shard,
+            "hot_owners": list(hot_owners),
+            "reporters": reporters,
+            "shards": {},
+        }
+        self.fleet = self
+        self._lags = dict(lags or {})
+
+    def shard_heat_report(self):
+        return dict(self._report)
+
+    def digests(self):
+        class D:
+            def __init__(self, lag):
+                self.replication_lag_s = lag
+
+        return {rank: D(lag) for rank, lag in self._lags.items()}
+
+
+class FakeKVPlane:
+    def __init__(self, queued=0, staged=0):
+        self._s = {"restores_queued": queued, "staged_chunks": staged}
+
+    def stats(self):
+        return dict(self._s)
+
+
+class FakeEngine:
+    def __init__(self, parked=0, queued=0, staged=0, spec=None):
+        self._restoring = [(None, None)] * parked
+        self.kv_transfer = FakeKVPlane(queued, staged)
+        self._spec = spec or {}
+
+    def spec_report(self):
+        return {
+            shape: {
+                "proposed": p,
+                "accepted": a,
+                "acceptance": round(a / p, 4) if p else 0.0,
+            }
+            for shape, (p, a) in self._spec.items()
+        }
+
+
+class FakeSLO:
+    def __init__(self):
+        self.counts = {}
+        self.tier = 0
+
+    def burn_counts(self):
+        return {t: dict(c) for t, c in self.counts.items()}
+
+
+def _attr_with_shapes(shapes):
+    """An attributor whose by_shape table is fed synthetically:
+    shapes = {label: (count, e2e_each, {phase: seconds_each})}."""
+    rec = FlightRecorder(capacity=1024, sample=1.0, node="fx")
+    attr = ensure_attributor(rec)
+    from radixmesh_tpu.obs.attribution import PHASES, Waterfall
+
+    tid = 1
+    for shape, (count, e2e, phases) in shapes.items():
+        for _ in range(count):
+            full = {p: 0.0 for p in PHASES}
+            full.update(phases)
+            full["edge"] = max(0.0, e2e - sum(phases.values()))
+            wf = Waterfall(
+                trace_id=tid, t0=0.0, e2e_s=e2e, phases=full,
+                retire="request_done", shape=shape,
+            )
+            attr._feed_locked(wf)
+            tid += 1
+    return attr
+
+
+class TestBurnRateTracker:
+    def test_burn_multiple_over_window(self):
+        clk = FakeClock()
+        bt = BurnRateTracker(budget=0.01, now=clk)
+        bt.sample({"t0": {"admitted": 0, "shed": 0}})
+        for _ in range(60):
+            clk.advance(5.0)
+            bt.sample({"t0": {"admitted": 80, "shed": 20}})
+        burn, offered = bt.burn("t0", 300.0)
+        # 20% shed against a 1% budget = 20x burn.
+        assert burn == pytest.approx(20.0)
+        assert offered == 100
+
+    def test_zero_offered_is_zero_burn(self):
+        clk = FakeClock()
+        bt = BurnRateTracker(budget=0.01, now=clk)
+        bt.sample({"t0": {"admitted": 5, "shed": 0}})
+        clk.advance(10)
+        bt.sample({"t0": {"admitted": 5, "shed": 0}})
+        assert bt.burn("t0", 300.0) == (0.0, 0)
+
+    def test_window_diffs_against_oldest_inside_window(self):
+        clk = FakeClock()
+        bt = BurnRateTracker(budget=0.1, now=clk)
+        bt.sample({"t0": {"admitted": 0, "shed": 0}})
+        clk.advance(10)
+        bt.sample({"t0": {"admitted": 0, "shed": 100}})  # old storm
+        clk.advance(4000)
+        bt.sample({"t0": {"admitted": 100, "shed": 100}})
+        clk.advance(10)
+        bt.sample({"t0": {"admitted": 200, "shed": 100}})
+        # 5m window excludes the storm: zero NEW shed.
+        burn_fast, _ = bt.burn("t0", 300.0)
+        assert burn_fast == pytest.approx(0.0)
+        # 2h window reaches back to the oldest sample: 100 shed / 300.
+        burn_slow, _ = bt.burn("t0", 7200.0)
+        assert burn_slow == pytest.approx((100 / 300) / 0.1)
+
+
+class TestHotShardRule:
+    def test_fires_with_owner_evidence(self):
+        mesh = FakeMesh(skew=9.0, hot_shard=7, hot_owners=(4, 0, 2),
+                        reporters=5)
+        report = MeshDoctor(mesh=mesh).diagnose()
+        (f,) = report["findings"]
+        assert f["rule"] == "hot_shard"
+        assert f["evidence"]["shard"] == 7
+        assert f["evidence"]["owners"] == [0, 2, 4]  # sorted
+        assert f["evidence"]["skew_score"] == 9.0
+        assert f["evidence"]["reporters"] == 5
+
+    def test_silent_below_threshold_or_unsharded(self):
+        assert MeshDoctor(mesh=FakeMesh(skew=3.9)).diagnose()["findings"] == []
+        assert (
+            MeshDoctor(mesh=FakeMesh(sharded=False, skew=50.0))
+            .diagnose()["findings"]
+            == []
+        )
+
+
+class TestPrefillConvoyRule:
+    def test_fires_on_prefill_dominant_slow_shape(self):
+        attr = _attr_with_shapes({
+            "p2048": (3, 1.0, {"prefill": 0.8}),
+            "p128": (6, 0.1, {"decode": 0.08}),
+        })
+        report = MeshDoctor(attributor=attr).diagnose()
+        (f,) = report["findings"]
+        assert f["rule"] == "prefill_convoy"
+        assert f["evidence"]["shape"] == "p2048"
+        assert f["evidence"]["prefill_share"] == pytest.approx(0.8)
+        assert f["evidence"]["requests"] == 3
+
+    def test_silent_when_prefill_dominant_but_not_slower(self):
+        # Batch-1-style traffic: prefill-heavy is its nature, not a
+        # convoy — every shape at similar e2e stays silent.
+        attr = _attr_with_shapes({
+            "p2048": (3, 0.1, {"prefill": 0.08}),
+            "p128": (6, 0.1, {"decode": 0.08}),
+        })
+        assert MeshDoctor(attributor=attr).diagnose()["findings"] == []
+
+    def test_silent_below_min_requests(self):
+        attr = _attr_with_shapes({"p2048": (2, 1.0, {"prefill": 0.9})})
+        assert MeshDoctor(attributor=attr).diagnose()["findings"] == []
+
+
+class TestRestoreParkRule:
+    def test_fires_on_live_parked_backlog(self):
+        eng = FakeEngine(parked=3, queued=2, staged=8)
+        report = MeshDoctor(engine=eng).diagnose()
+        (f,) = report["findings"]
+        assert f["rule"] == "restore_park_stall"
+        assert f["evidence"]["lane"] == "restore"
+        assert f["evidence"]["parked"] == 3
+        assert f["evidence"]["restores_queued"] == 10
+
+    def test_fires_on_audited_park_share(self):
+        attr = _attr_with_shapes({
+            "p512": (4, 1.0, {"restore_park": 0.6, "decode": 0.2}),
+        })
+        eng = FakeEngine(parked=0)
+        report = MeshDoctor(engine=eng, attributor=attr).diagnose()
+        rules = [f["rule"] for f in report["findings"]]
+        assert "restore_park_stall" in rules
+
+    def test_silent_when_parked_without_backlog(self):
+        assert (
+            MeshDoctor(engine=FakeEngine(parked=3, queued=0, staged=0))
+            .diagnose()["findings"]
+            == []
+        )
+
+
+class TestReplicationLagRule:
+    def test_fires_naming_lagging_ranks(self):
+        mesh = FakeMesh(sharded=False, lags={0: 0.1, 3: 2.5, 5: 1.2})
+        report = MeshDoctor(mesh=mesh).diagnose()
+        (f,) = report["findings"]
+        assert f["rule"] == "replication_lag"
+        assert set(f["evidence"]["ranks"]) == {"3", "5"}
+        assert f["evidence"]["worst_lag_s"] == 2.5
+
+    def test_silent_below_threshold(self):
+        mesh = FakeMesh(sharded=False, lags={0: 0.9, 1: 0.3})
+        assert MeshDoctor(mesh=mesh).diagnose()["findings"] == []
+
+
+class TestBurnRateRule:
+    def test_fires_only_when_both_windows_burn(self):
+        clk = FakeClock()
+        slo = FakeSLO()
+        doctor = MeshDoctor(slo=slo, now=clk)
+        admitted = shed = 0
+        # One hour of sustained 20% shed at 5s cadence: both the 5m and
+        # the 1h windows burn past their thresholds.
+        for _ in range(720):
+            admitted += 8
+            shed += 2
+            slo.counts = {"bulk": {"admitted": admitted, "shed": shed}}
+            slo.tier = 2
+            clk.advance(5.0)
+            report = doctor.diagnose()
+        (f,) = report["findings"]
+        assert f["rule"] == "slo_burn_rate"
+        assert f["evidence"]["tenant"] == "bulk"
+        assert f["evidence"]["burn_fast"] > DoctorConfig().burn_fast_threshold
+        assert f["evidence"]["burn_slow"] > DoctorConfig().burn_slow_threshold
+        assert f["evidence"]["tier"] == 2
+
+    def test_short_blip_does_not_page(self):
+        clk = FakeClock()
+        slo = FakeSLO()
+        doctor = MeshDoctor(slo=slo, now=clk)
+        admitted = shed = 0
+        # 50 minutes clean...
+        for _ in range(600):
+            admitted += 10
+            slo.counts = {"bulk": {"admitted": admitted, "shed": shed}}
+            clk.advance(5.0)
+            doctor.diagnose()
+        # ...then a 30-second storm: the fast window burns, the slow
+        # window (diluted by the clean hour) does not → no page.
+        for _ in range(6):
+            shed += 5
+            admitted += 5
+            slo.counts = {"bulk": {"admitted": admitted, "shed": shed}}
+            clk.advance(5.0)
+            report = doctor.diagnose()
+        assert report["findings"] == []
+
+
+class TestSpecEfficiencyRule:
+    def test_fires_on_low_acceptance_shape(self):
+        eng = FakeEngine(spec={"p128": (200, 20), "p512": (200, 150)})
+        report = MeshDoctor(engine=eng).diagnose()
+        (f,) = report["findings"]
+        assert f["rule"] == "spec_efficiency"
+        assert f["evidence"]["shape"] == "p128"
+        assert f["evidence"]["proposed"] == 200
+        assert f["evidence"]["accepted"] == 20
+
+    def test_silent_below_min_proposals(self):
+        eng = FakeEngine(spec={"p128": (30, 0)})
+        assert MeshDoctor(engine=eng).diagnose()["findings"] == []
+
+
+class TestDiagnoseContract:
+    def test_absent_seams_drop_rules_from_checked(self):
+        # The honesty field: a rule whose input seam is absent never
+        # looked at anything, so it must not claim to have run — a bare
+        # doctor checked NOTHING, and the report says so.
+        report = MeshDoctor().diagnose()
+        assert report["findings"] == []
+        assert report["healthy"] is True
+        assert list(report["rules_checked"]) == []
+        assert report["inputs"] == {
+            "mesh": False, "engine": False, "slo": False,
+            "attribution": False,
+        }
+
+    def test_rules_checked_tracks_attached_seams(self):
+        report = MeshDoctor(mesh=FakeMesh(sharded=False)).diagnose()
+        assert list(report["rules_checked"]) == [
+            "hot_shard", "replication_lag",
+        ]
+        report = MeshDoctor(engine=FakeEngine()).diagnose()
+        assert list(report["rules_checked"]) == [
+            "restore_park_stall", "spec_efficiency",
+        ]
+
+    def test_findings_ranked_by_score(self):
+        mesh = FakeMesh(skew=100.0, lags={3: 1.5})
+        eng = FakeEngine(parked=2, queued=1)
+        report = MeshDoctor(mesh=mesh, engine=eng).diagnose()
+        scores = [f["score"] for f in report["findings"]]
+        assert scores == sorted(scores, reverse=True)
+        assert len(report["findings"]) == 3
+
+    def test_evidence_contract_enforced_live(self):
+        # A rule that fires with missing pinned evidence gets flagged in
+        # the finding itself, not silently shipped.
+        doctor = MeshDoctor(mesh=FakeMesh(skew=9.0))
+        orig = doctor._rule_hot_shard
+
+        def degraded():
+            f = orig()
+            del f.evidence["owners"]
+            return f
+
+        doctor._rule_hot_shard = degraded
+        (f,) = doctor.diagnose()["findings"]
+        assert f["evidence"]["_missing_evidence"] == ["owners"]
+
+    def test_crashed_rule_becomes_a_finding(self):
+        class Exploding:
+            sharded = True
+
+            def shard_heat_report(self):
+                raise RuntimeError("boom")
+
+        report = MeshDoctor(mesh=Exploding()).diagnose()
+        crashed = [f for f in report["findings"] if "crashed" in f["summary"]]
+        assert crashed and crashed[0]["rule"] == "hot_shard"
+        # ...and the mesh's other rule still ran.
+        assert list(report["rules_checked"]) == [
+            "hot_shard", "replication_lag",
+        ]
+
+    def test_every_rule_has_pinned_evidence_fields(self):
+        assert set(RULE_EVIDENCE_FIELDS) == set(RULES)
+        for fields in RULE_EVIDENCE_FIELDS.values():
+            assert fields  # never an empty contract
+
+    def test_callable_attributor_seam(self):
+        calls = []
+
+        def resolve():
+            calls.append(1)
+            return None
+
+        doctor = MeshDoctor(attributor=resolve)
+        assert doctor.attributor is None
+        assert calls
+
+    def test_finding_as_dict_shape(self):
+        d = Finding("hot_shard", 0.77777, "s", {"k": 1}).as_dict()
+        assert d == {
+            "rule": "hot_shard", "score": 0.7778, "summary": "s",
+            "evidence": {"k": 1},
+        }

@@ -75,6 +75,15 @@ def _register_all_instrumented_families() -> None:
     from radixmesh_tpu.obs.step_plane import StepAccounting
 
     StepAccounting("lint-steps", n_params=1_000, peak_tflops=1.0)
+    # Diagnosis plane (PR 12): the phase-attribution histogram + refusal
+    # counter (obs/attribution.py) and the trace-drop counter
+    # (obs/trace_plane.py) — lazily resolved in product code, so the
+    # walk must touch them explicitly.
+    from radixmesh_tpu.obs.attribution import PhaseAttributor
+    from radixmesh_tpu.obs.trace_plane import dropped_spans_counter
+
+    PhaseAttributor()
+    dropped_spans_counter()
 
 
 def _registered_families() -> dict[str, str]:
@@ -454,3 +463,29 @@ class TestMetricHygiene:
             assert by_name["mesh_publish"].trace_id == 0x51
         finally:
             set_recorder(prev)
+
+    def test_diagnosis_families_registered(self):
+        """Satellite (PR 12): the critical-path phase histogram, the
+        waterfall-refusal counter, and the trace-drop counter are
+        first-class families — with one eager child per taxonomy phase
+        so a p50/p99 phase breakdown never has series gaps."""
+        from radixmesh_tpu.obs.attribution import PHASES
+
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        assert (
+            fams.get("radixmesh_request_phase_seconds") == "histogram"
+        ), sorted(fams)
+        assert (
+            fams.get("radixmesh_trace_waterfall_refusals_total") == "counter"
+        ), sorted(fams)
+        assert (
+            fams.get("radixmesh_trace_dropped_spans_total") == "counter"
+        ), sorted(fams)
+        # Eager children: every phase's count series exists at 0 from
+        # attributor construction (same contract as the wave kinds).
+        snap = get_registry().snapshot()
+        for phase in PHASES:
+            key = f'radixmesh_request_phase_seconds{{phase="{phase}"}}_count'
+            assert key in snap, (key, sorted(
+                k for k in snap if "phase_seconds" in k))
