@@ -1390,6 +1390,174 @@ def build_doctor_report(res: dict) -> dict:
 
 
 # ----------------------------------------------------------------------
+# BLACKBOX stable schema (PR 13): the flight-recorder acceptance
+# artifact. A node killed mid-zipf-storm must yield black-box dumps
+# (obs/blackbox.py) from which the post-mortem doctor
+# (obs/doctor.py::postmortem_report) names the seeded hot shard and the
+# crash window FROM THE DUMPS ALONE, the live history-backed doctor must
+# stay silent on the healthy phase, and the telemetry sampler's
+# self-accounted overhead must stay under 1% of the (step-accounting)
+# run. scripts/blackboxbench.py is the paired emitter.
+# ----------------------------------------------------------------------
+
+BLACKBOX_SCHEMA_VERSION = 1
+
+BLACKBOX_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload", "nodes",
+    "topology", "replication_factor", "healthy", "storm", "crash",
+    "postmortem", "history", "blackbox", "wall_s",
+)
+BLACKBOX_HEALTHY_FIELDS = (
+    "performed", "findings", "rules_checked", "inputs", "history_samples",
+)
+BLACKBOX_CRASH_FIELDS = (
+    "performed", "victim_rank", "victim_is_hot_owner", "t_kill",
+    "observer_detected_live",
+)
+BLACKBOX_OVERHEAD_FIELDS = (
+    "sample_seconds_total", "wall_s", "fraction", "budget_fraction",
+    "under_budget",
+)
+# The three post-mortem verdicts the acceptance run must name from the
+# dumps alone.
+BLACKBOX_NAMED_TOTAL = 3
+
+
+def validate_blackbox(report) -> list[str]:
+    """Schema violations of a BLACKBOX artifact vs the pinned contract
+    (empty = valid). Gates: the healthy phase ran EVERY live rule and
+    found nothing; the post-mortem doctor named the seeded hot shard
+    and a crash window containing the true kill time from the
+    OBSERVER's dump, and the unclean-death truncation from the
+    VICTIM's segment-only dump; the victim's dump really is unclean
+    (segments, no final); and the sampler's self-accounted overhead
+    stayed under its budget. Sections with performed=False are
+    schema-valid but gate-exempt (the CHAOS convention). Import-safe
+    from artifact tests and scripts (no jax at module scope)."""
+    from radixmesh_tpu.obs.doctor import RULES
+
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in BLACKBOX_TOP_FIELDS if f not in report]
+    healthy = report.get("healthy")
+    if isinstance(healthy, dict) and healthy.get("performed"):
+        problems += [
+            f"healthy.{f}" for f in BLACKBOX_HEALTHY_FIELDS if f not in healthy
+        ]
+        if healthy.get("findings") != []:
+            problems.append(
+                "healthy: the live doctor reported findings on the "
+                f"healthy phase ({healthy.get('findings')})"
+            )
+        missing_rules = [
+            r for r in RULES if r not in (healthy.get("rules_checked") or [])
+        ]
+        if missing_rules:
+            problems.append(
+                f"healthy: rules {missing_rules} never ran — 'no "
+                "findings' is only evidence when every rule looked"
+            )
+        if not healthy.get("history_samples", 0):
+            problems.append(
+                "healthy: zero history samples — the rings never saw "
+                "the healthy phase"
+            )
+    crash = report.get("crash")
+    if isinstance(crash, dict) and crash.get("performed"):
+        problems += [
+            f"crash.{f}" for f in BLACKBOX_CRASH_FIELDS if f not in crash
+        ]
+        if crash.get("victim_is_hot_owner") is not True:
+            problems.append(
+                "crash: the killed node was not an owner of the hot "
+                "shard — the scenario must kill where the storm lives"
+            )
+        if crash.get("observer_detected_live") is not True:
+            problems.append(
+                "crash: the observer's rings never recorded the "
+                "victim's health collapse"
+            )
+    pm = report.get("postmortem")
+    if isinstance(pm, dict):
+        obs = pm.get("observer", {})
+        victim = pm.get("victim", {})
+        if obs.get("hot_shard_named") is not True:
+            problems.append(
+                "postmortem: the observer dump did not name the seeded "
+                f"hot shard (evidence {obs.get('hot_shard_evidence')} vs "
+                f"expected {pm.get('expected')})"
+            )
+        if obs.get("crash_window_named") is not True:
+            problems.append(
+                "postmortem: the observer dump's crash window does not "
+                f"contain the true kill time (evidence "
+                f"{obs.get('crash_evidence')} vs expected "
+                f"{pm.get('expected')})"
+            )
+        if victim.get("truncation_named") is not True:
+            problems.append(
+                "postmortem: the victim's segment-only dump did not "
+                "yield an unclean-death truncation window within one "
+                "segment of the kill"
+            )
+        if victim.get("unclean") is not True:
+            problems.append(
+                "postmortem: the victim dump is not unclean — a final "
+                "flush survived the 'hard kill', so nothing was proven "
+                "about crash survival"
+            )
+    hist = report.get("history")
+    if isinstance(hist, dict):
+        overhead = hist.get("self_overhead")
+        if not isinstance(overhead, dict):
+            problems.append("history.self_overhead")
+        else:
+            problems += [
+                f"history.self_overhead.{f}"
+                for f in BLACKBOX_OVERHEAD_FIELDS
+                if f not in overhead
+            ]
+            if overhead.get("under_budget") is not True:
+                problems.append(
+                    "history: sampler overhead "
+                    f"{overhead.get('fraction')} exceeded the "
+                    f"{overhead.get('budget_fraction')} budget"
+                )
+    if isinstance(pm, dict) and report.get("value") != BLACKBOX_NAMED_TOTAL:
+        problems.append(
+            f"value: {report.get('value')} of {BLACKBOX_NAMED_TOTAL} "
+            "post-mortem verdicts named"
+        )
+    return problems
+
+
+def build_blackbox_report(res: dict) -> dict:
+    """Assemble a schema-complete BLACKBOX artifact from
+    ``workload.run_blackbox_workload``'s result."""
+    return {
+        "schema_version": BLACKBOX_SCHEMA_VERSION,
+        "metric": "blackbox_postmortem_named",
+        "value": res.get("named", 0),
+        "unit": (
+            f"of {BLACKBOX_NAMED_TOTAL} post-mortem verdicts (hot shard, "
+            "crash window, unclean-death truncation) named from "
+            "black-box dumps alone, with zero live findings on the "
+            "healthy phase and sampler overhead under budget"
+        ),
+        "workload": (
+            "healthy balanced phase + zipf heat storm over one rf=3 "
+            "inproc cluster with per-node fleet digesters and a "
+            "step-accounted CPU engine; the hot shard's primary owner "
+            "is killed hard mid-storm (segments survive, no final "
+            "flush) and the post-mortem doctor diagnoses from the "
+            "observer + victim dumps alone "
+            "(see workload.run_blackbox_workload)"
+        ),
+        **res,
+    }
+
+
+# ----------------------------------------------------------------------
 # compare_rounds (PR 12, the bench regression sentinel): schema-aware
 # diffing of any two SAME-schema artifacts. Eleven artifact schemas
 # accumulated over eleven rounds with nothing machine-checking the
@@ -1463,6 +1631,11 @@ COMPARE_RULES: dict = {
         ("attribution.audited", "higher", 0.50),
         ("attribution.max_sum_error_s", "lower", 10.0),
     ),
+    "BLACKBOX": (
+        ("value", "higher", 0.0),  # named post-mortem verdicts: any drop flags
+        ("history.self_overhead.fraction", "lower", 2.0),
+        ("history.points", "higher", 0.75),
+    ),
     # Kinds with no pinned directional metrics still get the schema
     # check + informational numeric diff.
     "SLO": (),
@@ -1484,6 +1657,7 @@ _METRIC_KINDS = {
     "obs_stitched_node_tracks": "OBS",
     "unsuppressed_findings": "ANALYSIS",
     "doctor_pathologies_named": "DOCTOR",
+    "blackbox_postmortem_named": "BLACKBOX",
     "slo_goodput_vs_offered_load": "SLO",
     "soak_requests": "SOAK",
 }
@@ -1672,9 +1846,12 @@ def benchdiff_selfcheck() -> dict:
     """The regression sentinel's positive control, pinned and
     deterministic (no checked-in files needed): an identical artifact
     pair must compare clean, a synthetically regressed copy must flag,
-    and a cross-kind pair must refuse as a schema mismatch. The DOCTOR
-    artifact carries the result (``validate_doctor`` gates all three) —
-    a sentinel nobody proved can still fire is not a sentinel."""
+    and a cross-kind pair must refuse as a schema mismatch — proven for
+    BOTH the CHAOS schema and the BLACKBOX schema (PR 13), so every
+    pinned rule table a sentinel relies on has a demonstrated trigger.
+    The DOCTOR artifact carries the result (``validate_doctor`` gates
+    the three headline fields) — a sentinel nobody proved can still
+    fire is not a sentinel."""
     base = {
         "metric": "chaos_heal_converge_s",
         "schema_version": CHAOS_SCHEMA_VERSION,
@@ -1692,15 +1869,35 @@ def benchdiff_selfcheck() -> dict:
         "schema_version": OBS_SCHEMA_VERSION,
         "value": 6,
     }
+    bb_base = {
+        "metric": "blackbox_postmortem_named",
+        "schema_version": BLACKBOX_SCHEMA_VERSION,
+        "value": BLACKBOX_NAMED_TOTAL,
+        "history": {"points": 4000, "self_overhead": {"fraction": 0.004}},
+    }
+    bb_regressed = {
+        **bb_base,
+        # One lost verdict: the zero-threshold value rule must flag it.
+        "value": BLACKBOX_NAMED_TOTAL - 1,
+    }
     identical = compare_rounds(base, dict(base), kind="CHAOS")
     regression = compare_rounds(base, regressed, kind="CHAOS")
     mismatch = compare_rounds(base, other_kind)
+    bb_identical = compare_rounds(bb_base, dict(bb_base), kind="BLACKBOX")
+    bb_regression = compare_rounds(bb_base, bb_regressed, kind="BLACKBOX")
+    bb_mismatch = compare_rounds(bb_base, base)
     return {
-        "identical_clean": identical["status"] == "clean",
+        "identical_clean": identical["status"] == "clean"
+        and bb_identical["status"] == "clean",
         "regression_flagged": regression["status"] == "regression"
-        and "repair.converge_s" in regression["regressions"],
-        "mismatch_detected": mismatch["status"] == "schema_mismatch",
-        "regressions_seen": regression["regressions"],
+        and "repair.converge_s" in regression["regressions"]
+        and bb_regression["status"] == "regression"
+        and "value" in bb_regression["regressions"],
+        "mismatch_detected": mismatch["status"] == "schema_mismatch"
+        and bb_mismatch["status"] == "schema_mismatch",
+        "kinds_covered": ["CHAOS", "BLACKBOX"],
+        "regressions_seen": regression["regressions"]
+        + bb_regression["regressions"],
     }
 
 

@@ -56,6 +56,8 @@ GAUGE_SUFFIXES = UNIT_SUFFIXES + (
     "_ratio",  # dimensionless max/mean skew (PR 9 heat map)
     "_mfu",  # model-FLOPs-utilization estimate (obs/step_plane.py)
     "_fraction",  # 0..1 share, e.g. wave padding (obs/step_plane.py)
+    "_series",  # telemetry-history ring count (obs/timeseries.py)
+    "_points",  # telemetry-history retained points (obs/timeseries.py)
 )
 
 _KINDS = ("counter", "gauge", "histogram")

@@ -200,7 +200,8 @@ def _run_node(args: argparse.Namespace) -> int:
             router.finish_warm_up()
         host = parse_addr(cfg.local_addr)[0] or "127.0.0.1"
         frontend = RouterFrontend(
-            router, host=host, port=args.http_port, tokenizer=tokenizer
+            router, host=host, port=args.http_port, tokenizer=tokenizer,
+            **_history_kwargs(args),
         )
         log.info("routing API on port %d", frontend.port)
     elif serving:
@@ -258,8 +259,53 @@ def _run_node(args: argparse.Namespace) -> int:
         frontend = ServingFrontend(
             engine, host=host or "127.0.0.1",
             port=port + cfg.serve_port_offset, tokenizer=tokenizer,
+            **_history_kwargs(args),
         )
         log.info("serving API on port %d", frontend.port)
+
+    # Cache-only nodes (no frontend: non-router, no model: section) still
+    # honor the history/black-box flags — the planes compose without an
+    # HTTP surface, so a crashing cache node leaves the same dump a
+    # serving node does instead of silently ignoring --blackbox-dir.
+    history_plane = None
+    blackbox_plane = None
+    if frontend is None:
+        hk = _history_kwargs(args)
+        # Without an HTTP surface the dump is the ONLY reader of the
+        # rings, so a default cache node doesn't pay for a sampler
+        # thread (plus up to max_series retained rings) nobody can
+        # read — history only spins up when --blackbox-dir arms it.
+        if hk["blackbox_dir"] and hk["history_interval_s"] > 0:
+            from radixmesh_tpu.obs.timeseries import TelemetryHistory
+
+            history_plane = TelemetryHistory(
+                interval_s=hk["history_interval_s"],
+                mesh=node,
+                node=f"{role.value}@{rank}",
+            )
+        if hk["blackbox_dir"]:
+            from radixmesh_tpu.obs.blackbox import BlackBox
+            from radixmesh_tpu.obs.doctor import MeshDoctor
+            from radixmesh_tpu.obs.trace_plane import get_recorder
+
+            blackbox_plane = BlackBox(
+                hk["blackbox_dir"],
+                history=history_plane,
+                doctor=MeshDoctor(mesh=node, history=history_plane),
+                recorder=get_recorder,
+                node=f"{role.value}@{rank}",
+                watchdog_timeout_s=hk["blackbox_watchdog_s"],
+            )
+        if history_plane is not None:
+            # Started AFTER the black box installed its segment hook,
+            # so the very first samples are already crash-durable.
+            history_plane.start()
+            log.info(
+                "telemetry history sampling every %.1fs%s",
+                hk["history_interval_s"],
+                f" (black box: {hk['blackbox_dir']})"
+                if hk["blackbox_dir"] else "",
+            )
 
     # Fleet telemetry plane: ring nodes gossip a NodeDigest per interval
     # (serving nodes include engine occupancy/latency; cache-only nodes
@@ -337,6 +383,9 @@ def _run_node(args: argparse.Namespace) -> int:
             fleet_plane=fleet_plane,
             cfg=LifecycleConfig(drain_timeout_s=args.drain_timeout),
             bootstrap=(repair_plane is not None and digest_interval > 0),
+            # Drain step 5c flushes the black box, so a planned
+            # departure always leaves a complete post-mortem dump.
+            blackbox=getattr(frontend, "blackbox", None) or blackbox_plane,
         )
         if frontend is not None:
             frontend.lifecycle = lifecycle_plane
@@ -373,6 +422,10 @@ def _run_node(args: argparse.Namespace) -> int:
             fleet_plane.close()
         if frontend is not None:
             frontend.close()
+        if blackbox_plane is not None:
+            blackbox_plane.close(flush_cause="shutdown")
+        if history_plane is not None:
+            history_plane.close()
         node.close(graceful=True)
         _dump_trace(args, log)
     return 0
@@ -448,6 +501,7 @@ def _run_serve(args: argparse.Namespace) -> int:
     frontend = ServingFrontend(
         engine, host=args.host, port=args.http_port,
         profile_dir=args.profile_dir, tokenizer=tokenizer, slo=slo_cfg,
+        **_history_kwargs(args),
     )
     print(f"serving {args.model} on http://{args.host}:{frontend.port}", flush=True)
 
@@ -519,6 +573,64 @@ def _add_kv_transfer_args(sub: argparse.ArgumentParser) -> None:
         "N generated tokens (crash recovery: bounds a resurrected "
         "request's cache-hit loss to N tokens; default 0 = publish only "
         "at finish/preempt)",
+    )
+
+
+def _history_kwargs(args: argparse.Namespace) -> dict:
+    """Frontend kwargs for the telemetry-history + black-box planes
+    (``obs/timeseries.py`` / ``obs/blackbox.py``), shared by node +
+    serve so the wiring cannot drift. The watchdog default arms at
+    10x the sample interval whenever a dump directory exists — an
+    unclean death should leave a final artifact without the operator
+    remembering a flag."""
+    interval = args.telemetry_history_interval
+    watchdog = args.blackbox_watchdog
+    if watchdog is None:
+        watchdog = 10.0 * interval if args.blackbox_dir else 0.0
+    if args.blackbox_dir and interval <= 0:
+        # The flag's promise ("segments land here continuously", a
+        # watchdog-armed final) depends on the sampler; an armed box
+        # with no history records nothing — say so instead of leaving
+        # a manifest-only dir the operator will discover post-crash.
+        get_logger("launch").warning(
+            "--blackbox-dir %s is armed but --telemetry-history-interval "
+            "is 0: no history will be recorded, no segments written, and "
+            "the unclean-death watchdog stays off — only explicit "
+            "flushes (SIGTERM/drain/POST /admin/blackbox) leave a dump",
+            args.blackbox_dir,
+        )
+    return {
+        "history_interval_s": interval,
+        "blackbox_dir": args.blackbox_dir,
+        "blackbox_watchdog_s": watchdog,
+    }
+
+
+def _add_history_args(sub: argparse.ArgumentParser) -> None:
+    """Telemetry-history / black-box flags, shared by node + serve."""
+    sub.add_argument(
+        "--telemetry-history-interval", type=float, default=1.0,
+        metavar="SECONDS",
+        help="sample every registered metric family plus the fleet/"
+        "heat/step planes into bounded in-process time-series rings "
+        "every N seconds (obs/timeseries.py; ~15 min retained, served "
+        "on GET /debug/timeseries with cursor pagination; also feeds "
+        "the doctor's burn-rate windows). 0 disables",
+    )
+    sub.add_argument(
+        "--blackbox-dir", default=None, metavar="DIR",
+        help="arm the black box (obs/blackbox.py): incremental history "
+        "segments land here continuously (atomic renames — a kill -9 "
+        "keeps every completed segment), and SIGTERM / drain / the "
+        "unclean-death watchdog / POST /admin/blackbox flush a full "
+        "final dump (history + waterfalls + spans + doctor findings + "
+        "state) for scripts/doctor.py --blackbox",
+    )
+    sub.add_argument(
+        "--blackbox-watchdog", type=float, default=None, metavar="SECONDS",
+        help="flush the black box once if the history sampler stalls "
+        "this long (default: 10x the sample interval when "
+        "--blackbox-dir is set; 0 disables)",
     )
 
 
@@ -611,6 +723,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     _add_kv_transfer_args(node)
     _add_trace_args(node)
+    _add_history_args(node)
     node.set_defaults(fn=_run_node)
 
     serve = sub.add_parser("serve", help="run a single-node serving engine")
@@ -689,6 +802,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     _add_kv_transfer_args(serve)
     _add_trace_args(serve)
+    _add_history_args(serve)
     serve.set_defaults(fn=_run_serve)
 
     mh = sub.add_parser(

@@ -2,8 +2,10 @@
 tracing plane (SURVEY §5) + the fleet telemetry plane (gossiped node
 digests, radix-tree convergence audit, health scoring) + the mesh-wide
 plane (PR 9: cross-node trace stitching, per-shard heat/skew, TPU step
-attribution)."""
+attribution) + the history axis (PR 13: bounded telemetry time-series
+rings, crash-surviving black-box dumps, post-mortem doctoring)."""
 
+from radixmesh_tpu.obs.blackbox import BlackBox, load_blackbox
 from radixmesh_tpu.obs.fleet_plane import (
     FleetConfig,
     FleetPlane,
@@ -19,6 +21,7 @@ from radixmesh_tpu.obs.metrics import (
     set_registry,
 )
 from radixmesh_tpu.obs.step_plane import StepAccounting
+from radixmesh_tpu.obs.timeseries import TelemetryHistory
 from radixmesh_tpu.obs.trace_plane import (
     FlightRecorder,
     Span,
@@ -53,6 +56,9 @@ __all__ = [
     "new_trace_id",
     "stitch_traces",
     "StepAccounting",
+    "TelemetryHistory",
+    "BlackBox",
+    "load_blackbox",
     "annotate",
     "profile",
     "recorded",

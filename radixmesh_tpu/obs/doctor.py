@@ -43,6 +43,7 @@ is an optional duck-typed seam.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import deque
@@ -55,6 +56,9 @@ __all__ = [
     "MeshDoctor",
     "RULES",
     "RULE_EVIDENCE_FIELDS",
+    "POSTMORTEM_RULES",
+    "POSTMORTEM_EVIDENCE_FIELDS",
+    "postmortem_report",
 ]
 
 # Rule ids in severity-tiebreak order (ranking is by score first; this
@@ -148,21 +152,50 @@ class Finding:
 
 class BurnRateTracker:
     """Windowed error-budget burn over cumulative (admitted, shed)
-    request counters.
+    request counters, backed by the telemetry-history feed.
 
-    :meth:`sample` records one counter snapshot per tenant;
-    :meth:`burn` answers the shed-fraction burn multiple over a
-    trailing window by diffing against the oldest sample inside it.
-    Samples are bounded; the clock is injectable (virtual-time unit
-    tests). Burn = (shed / offered) / budget — 1.0 means exactly
-    spending the budget, 14.4 over 5 m AND 6 over 1 h is the classic
-    page condition.
+    :meth:`sample` records one counter snapshot per tenant (the history
+    sampler — ``obs/timeseries.py`` — feeds it every interval via
+    ``bind_burn_tracker``, so the windows are dense regardless of how
+    rarely anyone calls :meth:`burn`); :meth:`burn` answers the
+    shed-fraction burn multiple over a trailing window by diffing the
+    newest sample against the last sample AT OR BEFORE the window
+    start — the exact window diff, accurate to one sample spacing.
+    Retention is spacing-aware: samples closer together than
+    ``min_spacing_s`` collapse (the bounded ring then spans the full
+    1 h slow window even at a 1 s feed cadence). The clock is
+    injectable (virtual-time unit tests). Burn = (shed / offered) /
+    budget — 1.0 means exactly spending the budget, 14.4 over 5 m AND
+    6 over 1 h is the classic page condition.
+
+    With the history ring feeding every sample the base is always
+    within one spacing of the window start, so the window diff is
+    exact. A base more than ``max_base_lag_s`` older than the window
+    start means the feed is sparse (a history-less doctor polled
+    slower than the bound) — then the tracker degrades to the PR 12
+    conservative base, the first sample INSIDE the window, which
+    under-counts the window's head but never smears stale shed into
+    it. Only a feed with no in-window sample at all (sampler dead)
+    answers "can't judge".
     """
 
-    MAX_SAMPLES = 720  # 1 h of 5 s cadence
+    MAX_SAMPLES = 720  # the 1 h slow window at min_spacing_s granularity
 
-    def __init__(self, budget: float, now=time.monotonic):
+    def __init__(
+        self,
+        budget: float,
+        now=time.monotonic,
+        min_spacing_s: float = 5.0,
+        max_base_lag_s: float = 30.0,
+        max_samples: int | None = None,
+    ):
         self.budget = max(1e-9, float(budget))
+        self.min_spacing_s = float(min_spacing_s)
+        self.max_base_lag_s = float(max_base_lag_s)
+        # MAX_SAMPLES is sized for the live 5 s spacing; a replay over
+        # a finer-grained recording must widen the ring or eviction
+        # silently drops the pre-window base.
+        self.max_samples = int(max_samples) if max_samples else self.MAX_SAMPLES
         self._now = now
         self._lock = threading.Lock()
         # tenant → deque[(t, admitted, shed)]
@@ -173,8 +206,15 @@ class BurnRateTracker:
         with self._lock:
             for tenant, c in counts.items():
                 dq = self._samples.setdefault(
-                    tenant, deque(maxlen=self.MAX_SAMPLES)
+                    tenant, deque(maxlen=self.max_samples)
                 )
+                if dq and t - dq[-1][0] < self.min_spacing_s:
+                    # Spacing-aware retention: a 1 s history feed must
+                    # not shrink the ring's span below the slow window —
+                    # overwrite the newest slot instead of appending.
+                    dq[-1] = (dq[-1][0], int(c.get("admitted", 0)),
+                              int(c.get("shed", 0)))
+                    continue
                 dq.append((t, int(c.get("admitted", 0)), int(c.get("shed", 0))))
 
     def burn(
@@ -183,23 +223,37 @@ class BurnRateTracker:
         """(burn multiple, offered requests) over the trailing window —
         offered lets callers gate on sample size."""
         t = self._now() if t is None else t
+        start = t - window_s
         with self._lock:
             dq = self._samples.get(tenant)
             if not dq or len(dq) < 2:
                 return 0.0, 0
             newest = dq[-1]
-            base = None
-            for s in dq:
-                if s[0] >= t - window_s:
-                    base = s
-                    break
-            if base is None or base is newest:
-                # No sample besides the newest lies inside the window:
-                # there is no in-window history to diff against. Widening
-                # to the oldest sample would smear up to an hour of stale
-                # shed into a 5 m window (a storm from 50 minutes ago
-                # would page as a live fire under sparse polling) —
-                # answer "can't judge" instead.
+            # Last sample at or before the window start: the correct
+            # window-diff base (bisect on the time column).
+            times = [s[0] for s in dq]
+            i = bisect.bisect_right(times, start) - 1
+            if i < 0:
+                # The ring is younger than the window: every retained
+                # sample is in-window — judge over the actual span (a
+                # freshly booted node's honest answer).
+                base = dq[0]
+            else:
+                base = dq[i]
+                if start - base[0] > self.max_base_lag_s:
+                    # Feed gap: the nearest pre-window sample is too
+                    # stale to localize the in-window shed. Fall back
+                    # to the first IN-WINDOW sample (the conservative
+                    # PR 12 base) — the diff then under-counts the
+                    # window's head instead of smearing stale shed
+                    # into it, so a history-less doctor polled slower
+                    # than the lag bound still judges. A dead feed
+                    # (newest itself pre-window) still refuses below.
+                    j = bisect.bisect_left(times, start)
+                    if j >= len(dq) - 1:
+                        return 0.0, 0
+                    base = dq[j]
+            if base is newest:
                 return 0.0, 0
         admitted = newest[1] - base[1]
         shed = newest[2] - base[2]
@@ -221,6 +275,11 @@ class MeshDoctor:
       per-shape spec counters via ``telemetry()``).
     - ``slo``: an OverloadController (``burn_counts()`` + ``.tier``).
     - ``attributor``: a PhaseAttributor (per-shape phase aggregates).
+    - ``history``: a TelemetryHistory (``obs/timeseries.py``) — when
+      attached, its sampler feeds the burn tracker every interval, so
+      the 5 m / 1 h windows are dense regardless of how rarely anyone
+      GETs ``/cluster/doctor`` (the PR 12 can't-judge gap is closed by
+      construction).
 
     Construct ONE per frontend and call :meth:`diagnose` per GET — the
     burn tracker needs continuity across calls (a fresh doctor has no
@@ -235,6 +294,7 @@ class MeshDoctor:
         engine=None,
         slo=None,
         attributor=None,
+        history=None,
         cfg: DoctorConfig | None = None,
         now=time.monotonic,
     ):
@@ -242,9 +302,23 @@ class MeshDoctor:
         self.engine = engine
         self.slo = slo
         self._attributor = attributor
+        self.history = history
         self.cfg = cfg or DoctorConfig()
         self._now = now
         self.burn_tracker = BurnRateTracker(self.cfg.burn_budget, now=now)
+        # The history ring becomes the burn windows' clock source: every
+        # sampler tick forwards slo.burn_counts() into the tracker
+        # (diagnose() then never needs to self-sample). Only a history
+        # that itself holds an SLO seam ever feeds bound trackers — a
+        # doctor bound to an slo-less history would starve forever, so
+        # that shape keeps self-sampling instead.
+        self._burn_fed_by_history = (
+            history is not None
+            and slo is not None
+            and getattr(history, "slo", None) is not None
+        )
+        if self._burn_fed_by_history:
+            history.bind_burn_tracker(self.burn_tracker)
 
     # The attributor seam is callable-or-instance: frontends pass
     # obs.attribution.ensure_attributor so a test-swapped recorder
@@ -410,7 +484,12 @@ class MeshDoctor:
         slo = self.slo
         if slo is None:
             return None
-        self.burn_tracker.sample(slo.burn_counts())
+        if not self._burn_fed_by_history:
+            # Doctors whose tracker isn't fed by a sampler tick (no
+            # history, or a history built without an SLO seam) still
+            # self-sample at diagnose time; history-fed ones must not
+            # double-sample.
+            self.burn_tracker.sample(slo.burn_counts())
         cfg = self.cfg
         worst: Finding | None = None
         for tenant in self.burn_tracker.tenants():
@@ -542,5 +621,303 @@ class MeshDoctor:
                 "engine": self.engine is not None,
                 "slo": self.slo is not None,
                 "attribution": self.attributor is not None,
+                "history": self.history is not None,
             },
         }
+
+
+# ---------------------------------------------------------------------------
+# post-mortem doctoring: the same judgment, over a black-box dump alone
+# ---------------------------------------------------------------------------
+
+# Post-mortem rule ids in severity-tiebreak order. These replay the
+# live rules' judgment over RECORDED history series (a dump has no mesh
+# or engine object to duck-type), plus the one rule only hindsight can
+# run: node_crash.
+POSTMORTEM_RULES = (
+    "node_crash",
+    "hot_shard",
+    "replication_lag",
+    "slo_burn_rate",
+)
+
+POSTMORTEM_EVIDENCE_FIELDS = {
+    "node_crash": ("rank", "window", "detector"),
+    "hot_shard": ("shard", "skew_peak", "t_peak"),
+    "replication_lag": ("ranks", "threshold_s", "worst_lag_s"),
+    "slo_burn_rate": ("tenant", "burn_fast", "burn_slow", "t_peak"),
+}
+
+
+def _labeled_series(series: dict, prefix: str) -> dict[str, list]:
+    """label value → points, for series named ``prefix{label="X"}``."""
+    out: dict[str, list] = {}
+    head = prefix + "{"
+    for name, pts in series.items():
+        if name.startswith(head) and name.endswith('"}'):
+            label = name[len(head):-2].split('="', 1)[-1]
+            out[label] = pts
+    return out
+
+
+def _value_at(pts: list, t: float):
+    """The change-compressed series' value at time ``t`` (last point at
+    or before it); None before the first point."""
+    times = [p[1] for p in pts]
+    i = bisect.bisect_right(times, t) - 1
+    return pts[i][2] if i >= 0 else None
+
+
+def postmortem_report(dump: dict, cfg: DoctorConfig | None = None) -> dict:
+    """Replay the doctor's judgment over a black-box dump
+    (``obs/blackbox.py::load_blackbox``) — no live cluster required.
+    ``scripts/doctor.py --blackbox`` is the CLI.
+
+    Unlike the live rules, post-mortem rules judge the WHOLE recorded
+    window (a pathology that peaked mid-flight and cooled before the
+    dump still fired), and they can name the one thing no live rule
+    can: the crash itself —
+
+    - ``node_crash`` detector "health_drop": a fleet health score
+      falling below 0.5; the window is anchored by the recorded digest
+      age at the drop (the node was last heard from ``age`` seconds
+      before the drop sample).
+    - ``node_crash`` detector "history_truncated": the dump itself ends
+      without any final flush (the kill -9 signature) — the crash
+      window is the last recorded sample plus one segment of slack.
+    """
+    cfg = cfg or DoctorConfig()
+    series: dict = dump.get("series", {})
+    interval = float(dump.get("interval_s") or 1.0)
+    findings: list[Finding] = []
+    checked: list[str] = []
+
+    # -- node_crash ----------------------------------------------------
+    checked.append("node_crash")
+    ages = _labeled_series(series, "fleet:health_age_seconds")
+    for rank, pts in sorted(
+        _labeled_series(series, "fleet:health_score").items()
+    ):
+        seen_good = False
+        for seq, t, v in pts:
+            if v >= 0.5:
+                seen_good = True
+                continue
+            if not seen_good:
+                # A drop only counts after the rank has been seen
+                # healthy; leading sub-0.5 points (sampler started
+                # while the digest was still converging) are skipped,
+                # not terminal for the rank.
+                continue
+            age = _value_at(ages.get(rank, []), t) or 0.0
+            findings.append(Finding(
+                "node_crash",
+                0.9,
+                f"node rank {rank} went dark: health dropped to {v:.2f} "
+                f"at t={t:.1f}, last heard {age:.1f}s earlier — crash "
+                f"window [{t - age:.1f}, {t:.1f}]",
+                {
+                    "rank": rank,
+                    "window": [round(t - age, 3), round(t, 3)],
+                    "detector": "health_drop",
+                    "score_at_drop": v,
+                    "age_at_drop_s": round(age, 3),
+                },
+            ))
+            break
+    if dump.get("unclean") and dump.get("last_t") is None:
+        # The box was armed (a manifest exists) but no history was ever
+        # committed and no final flushed: the node died before its
+        # first segment — unclean by construction, but with nothing
+        # recorded there is no window to anchor.
+        findings.append(Finding(
+            "node_crash",
+            1.0,
+            f"node {dump.get('node', '?')}'s black box was armed but "
+            "holds NO committed history and NO final flush — unclean "
+            "death before the first segment; no crash window can be "
+            "anchored",
+            {
+                "rank": dump.get("node", "?"),
+                "window": [None, None],
+                "detector": "history_truncated",
+                "last_seq": None,
+            },
+        ))
+    if dump.get("unclean") and dump.get("last_t") is not None:
+        last_t = float(dump["last_t"])
+        slack = interval * float(
+            dump.get("manifest", {}).get("segment_every", 1) or 1
+        )
+        findings.append(Finding(
+            "node_crash",
+            1.0,
+            f"node {dump.get('node', '?')}'s own history ends at "
+            f"t={last_t:.1f} with NO final flush — unclean death; crash "
+            f"window [{last_t:.1f}, {last_t + slack:.1f}] (one segment "
+            "of slack past the last committed sample)",
+            {
+                "rank": dump.get("node", "?"),
+                "window": [round(last_t, 3), round(last_t + slack, 3)],
+                "detector": "history_truncated",
+                "last_seq": dump.get("last_seq"),
+            },
+        ))
+
+    # -- hot_shard (peak over the recorded window) ---------------------
+    checked.append("hot_shard")
+    skew_pts = series.get("shard:skew_ratio", [])
+    if skew_pts:
+        _, t_peak, skew_peak = max(skew_pts, key=lambda p: p[2])
+        if skew_peak >= cfg.hot_shard_skew:
+            heats = _labeled_series(series, "shard:heat")
+            hot, hot_load = None, -1.0
+            for sid, pts in heats.items():
+                v = _value_at(pts, t_peak)
+                if v is not None and v > hot_load:
+                    hot, hot_load = int(sid), v
+            if hot is None:
+                # Skew peaked but no shard:heat series has a point at
+                # or before the peak (heat rings pruned/capped, or the
+                # first heat sample landed after the skew one) — a
+                # "shard None peaked" finding would name nothing, so
+                # record the anomaly as unresolvable instead.
+                findings.append(Finding(
+                    "hot_shard",
+                    0.5,
+                    f"skew peaked at {skew_peak:.1f} (t={t_peak:.1f}) "
+                    "but the recorded heat series cannot resolve which "
+                    "shard — heat rings pruned or absent at the peak",
+                    {
+                        "shard": None,
+                        "skew_peak": round(skew_peak, 4),
+                        "t_peak": round(t_peak, 3),
+                        "hot_load": None,
+                    },
+                ))
+            else:
+                ev = {
+                    "shard": hot,
+                    "skew_peak": round(skew_peak, 4),
+                    "t_peak": round(t_peak, 3),
+                    "hot_load": round(hot_load, 4),
+                }
+                # The final dump's live findings can enrich the owner
+                # set (owners are an ownership-map fact no recorded
+                # series carries) — present only on dumps that reached
+                # a flush.
+                final = dump.get("final") or {}
+                for f in (final.get("doctor") or {}).get(
+                    "findings", ()
+                ):
+                    if f.get("rule") == "hot_shard" and f.get(
+                        "evidence", {}
+                    ).get("shard") == hot:
+                        ev["owners"] = f["evidence"].get("owners")
+                findings.append(Finding(
+                    "hot_shard",
+                    min(
+                        1.0,
+                        0.5 + skew_peak / (8.0 * cfg.hot_shard_skew),
+                    ),
+                    f"shard {hot} peaked at skew {skew_peak:.1f} "
+                    f"(t={t_peak:.1f}) over the recorded window",
+                    ev,
+                ))
+
+    # -- replication_lag (peak per rank) -------------------------------
+    checked.append("replication_lag")
+    lagging = {}
+    for rank, pts in _labeled_series(
+        series, "fleet:replication_lag_seconds"
+    ).items():
+        peak = max((p[2] for p in pts), default=0.0)
+        if peak > cfg.lag_threshold_s:
+            lagging[rank] = round(peak, 4)
+    if lagging:
+        findings.append(Finding(
+            "replication_lag",
+            min(1.0, 0.4 + 0.1 * max(lagging.values()) / cfg.lag_threshold_s),
+            f"{len(lagging)} node(s) peaked past {cfg.lag_threshold_s}s "
+            f"replication lag in the recorded window: {sorted(lagging)}",
+            {
+                "ranks": dict(sorted(lagging.items())),
+                "threshold_s": cfg.lag_threshold_s,
+                "worst_lag_s": max(lagging.values()),
+            },
+        ))
+
+    # -- slo_burn_rate (worst multi-window point in the record) --------
+    checked.append("slo_burn_rate")
+    adm = _labeled_series(series, "slo:admitted")
+    shed = _labeled_series(series, "slo:shed")
+    for tenant in sorted(set(adm) & set(shed)):
+        # Recorded series are change-compressed: a gap between points
+        # means the counters did not move, so an arbitrarily stale
+        # base is EXACT (the counter value at the window start), not a
+        # smear risk — a storm that follows a long idle stretch must
+        # still be named. No staleness refusal in replay.
+        merged = sorted(
+            {p[1] for p in adm[tenant]} | {p[1] for p in shed[tenant]}
+        )
+        tracker = BurnRateTracker(
+            cfg.burn_budget, min_spacing_s=0.0,
+            max_base_lag_s=float("inf"),
+            max_samples=len(merged) + 1,
+        )
+        worst = None
+        for t in merged:
+            a = _value_at(adm[tenant], t) or 0.0
+            s = _value_at(shed[tenant], t) or 0.0
+            tracker.sample(
+                {tenant: {"admitted": int(a), "shed": int(s)}}, t=t
+            )
+            fast, offered = tracker.burn(tenant, cfg.burn_fast_window_s, t=t)
+            slow, _ = tracker.burn(tenant, cfg.burn_slow_window_s, t=t)
+            if (
+                offered >= cfg.burn_min_requests
+                and fast >= cfg.burn_fast_threshold
+                and slow >= cfg.burn_slow_threshold
+                and (worst is None or fast > worst[0])
+            ):
+                worst = (fast, slow, t)
+        if worst is not None:
+            fast, slow, t = worst
+            findings.append(Finding(
+                "slo_burn_rate",
+                min(1.0, 0.6 + fast / (10.0 * cfg.burn_fast_threshold)),
+                f"tenant {tenant!r} burned error budget at {fast:.1f}x "
+                f"(5m) AND {slow:.1f}x (1h) peaking at t={t:.1f} in the "
+                "recorded window",
+                {
+                    "tenant": tenant,
+                    "burn_fast": round(fast, 3),
+                    "burn_slow": round(slow, 3),
+                    "t_peak": round(t, 3),
+                },
+            ))
+
+    findings.sort(
+        key=lambda f: (-f.score, POSTMORTEM_RULES.index(f.rule))
+    )
+    first_t = None
+    for pts in series.values():
+        for p in pts:
+            if first_t is None or p[1] < first_t:
+                first_t = p[1]
+    return {
+        "source": "blackbox",
+        "node": dump.get("node"),
+        "unclean": bool(dump.get("unclean")),
+        "findings": [f.as_dict() for f in findings],
+        "healthy": not findings,
+        "rules_checked": checked,
+        "window": [
+            round(first_t, 3) if first_t is not None else None,
+            round(float(dump["last_t"]), 3)
+            if dump.get("last_t") is not None
+            else None,
+        ],
+        "samples": int(dump.get("last_seq", -1)) + 1,
+        "series": len(series),
+    }

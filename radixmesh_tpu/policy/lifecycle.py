@@ -187,6 +187,10 @@ class LifecyclePlane:
     - ``requeue_fn`` / ``writeback_fn``: engine-less stand-ins for the
       drain's requeue and hot-prefix flush steps (the mesh-level chaos
       workload supplies these; with a ``runner`` they are ignored).
+    - ``blackbox``: the node's :class:`~radixmesh_tpu.obs.blackbox.
+      BlackBox`; the drain sequence flushes it (step 5c) once in-flight
+      work has settled, so every planned departure leaves a complete
+      post-mortem artifact behind.
     - ``clock`` / ``wait``: virtual-time injection (deflake contract).
     """
 
@@ -200,6 +204,7 @@ class LifecyclePlane:
         bootstrap: bool = False,
         requeue_fn=None,
         writeback_fn=None,
+        blackbox=None,
         clock=time.monotonic,
         wait=None,
     ):
@@ -207,6 +212,7 @@ class LifecyclePlane:
         self.repair = repair
         self.runner = runner
         self.fleet_plane = fleet_plane
+        self.blackbox = blackbox
         self.cfg = cfg or LifecycleConfig()
         self.requeue_fn = requeue_fn
         self.writeback_fn = writeback_fn
@@ -533,6 +539,17 @@ class LifecyclePlane:
                 self.log.exception("shard handoff failed")
                 stats["shard_transfer"] = {"shards": 0, "entries": 0,
                                            "targets": 0}
+        # 5c. Black-box flush (obs/blackbox.py): in-flight work has
+        #     settled and the write-back verdict is known — record the
+        #     full telemetry history + findings + state NOW, while the
+        #     node can still write. A flush failure must not block the
+        #     LEAVE (the dump is evidence, not a durability barrier).
+        if self.blackbox is not None:
+            try:
+                stats["blackbox"] = self.blackbox.flush("drain")["path"]
+            except Exception:  # noqa: BLE001 — a dump bug must not wedge the drain
+                self.log.exception("black-box drain flush failed")
+                stats["blackbox"] = None
         # 6. LEAVE: peers drop this node from the view as a PLANNED
         #    departure (cause="left" — failure detection never fires,
         #    FleetView state is forgotten, not left to rot). The frame

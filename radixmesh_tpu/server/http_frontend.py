@@ -17,7 +17,11 @@ that frontend with stdlib-only HTTP (no framework dependency):
   ``GET /debug/requests`` is the in-flight
   request table with per-phase elapsed times, ``GET /debug/state`` is a
   point-in-time node snapshot (batch occupancy, pool/cache/host-tier
-  fill, membership view, SLO tier, recorder stats).
+  fill, membership view, SLO tier, recorder stats), and
+  ``GET /debug/timeseries`` is the history axis (``obs/timeseries.py``):
+  cursor-paginated bounded rings of every metric family + derived plane,
+  with ``POST /admin/blackbox`` flushing the crash-surviving dump
+  (``obs/blackbox.py``).
 
 Threading model: the engine is single-threaded by design (host-side tree
 mutation between device steps, SURVEY §7 hard part (c)); an
@@ -42,8 +46,10 @@ from typing import Sequence
 from radixmesh_tpu.engine.engine import Engine
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
 from radixmesh_tpu.obs.attribution import ensure_attributor
+from radixmesh_tpu.obs.blackbox import BlackBox
 from radixmesh_tpu.obs.doctor import MeshDoctor
 from radixmesh_tpu.obs.metrics import get_registry
+from radixmesh_tpu.obs.timeseries import TelemetryHistory
 from radixmesh_tpu.obs.trace_plane import get_recorder
 from radixmesh_tpu.policy.retry import jittered_retry_after
 from radixmesh_tpu.slo.control import RequestShed
@@ -364,6 +370,57 @@ def _cluster_health(mesh) -> dict:
     }
 
 
+def _debug_timeseries_response(
+    handler: BaseHTTPRequestHandler, history
+) -> None:
+    """Serve the telemetry-history rings (``obs/timeseries.py``) with
+    cursor pagination: ``?family=`` prefix-filters series, ``?since=``
+    is the sample-sequence cursor from a previous response's
+    ``next_since``, ``?limit=`` bounds points per page (cut on a sample
+    boundary)."""
+    from urllib.parse import parse_qs, urlsplit
+
+    if history is None:
+        _json_response(
+            handler, 404,
+            {"error": "telemetry history disabled "
+             "(--telemetry-history-interval 0)"},
+        )
+        return
+    q = parse_qs(urlsplit(handler.path).query)
+    try:
+        family = q.get("family", [""])[-1] or None
+        since = int(q.get("since", ["-1"])[-1])
+        limit = int(q.get("limit", ["2000"])[-1])
+    except ValueError:
+        _json_response(
+            handler, 400, {"error": "since/limit must be integers"}
+        )
+        return
+    _json_response(
+        handler, 200, history.query(family=family, since=since, limit=limit)
+    )
+
+
+def _admin_blackbox_response(handler: BaseHTTPRequestHandler, blackbox) -> None:
+    """``POST /admin/blackbox``: flush the full black box now (the
+    operator's pre-restart snapshot — same artifact the SIGTERM/drain/
+    watchdog triggers write)."""
+    if blackbox is None:
+        _json_response(
+            handler, 404,
+            {"error": "no black box on this node (start with "
+             "--blackbox-dir)"},
+        )
+        return
+    try:
+        res = blackbox.flush("admin")
+    except OSError as e:
+        _json_response(handler, 500, {"error": str(e)})
+        return
+    _json_response(handler, 200, {"flushed": True, **res})
+
+
 def _debug_trace_response(handler: BaseHTTPRequestHandler) -> None:
     """Serve the flight recorder as Chrome trace-event JSON. Read-only by
     default — a GET must not destroy the post-mortem a later reader (or
@@ -397,6 +454,10 @@ class ServingFrontend:
         tokenizer=None,
         slo=None,
         lifecycle=None,
+        history_interval_s: float = 1.0,
+        history_capacity: int = 900,
+        blackbox_dir: str | None = None,
+        blackbox_watchdog_s: float = 0.0,
     ):
         # Membership lifecycle plane (policy/lifecycle.py). With one
         # attached, POST /admin/drain moves the node through DRAINING →
@@ -537,12 +598,45 @@ class ServingFrontend:
         # through the ensure_* seam (a swapped recorder gets a fresh
         # one).
         ensure_attributor()
+        # Telemetry history (obs/timeseries.py): bounded time-series
+        # rings over every plane, sampled at a fixed cadence; serves
+        # GET /debug/timeseries and feeds the doctor's burn windows.
+        # 0 disables (point-in-time-only, the pre-PR-13 behavior).
+        self.history = None
+        if history_interval_s > 0:
+            self.history = TelemetryHistory(
+                interval_s=history_interval_s,
+                capacity=history_capacity,
+                mesh=engine.mesh,
+                engine=engine,
+                slo=self.runner.ctl if self.slo_enabled else None,
+                node=engine.name,
+            )
         self.doctor = MeshDoctor(
             mesh=engine.mesh,
             engine=engine,
             slo=self.runner.ctl if self.slo_enabled else None,
             attributor=ensure_attributor,
+            history=self.history,
         )
+        # The black box (obs/blackbox.py): crash-surviving dumps of the
+        # history + waterfalls + spans + doctor findings + state.
+        self.blackbox = None
+        if blackbox_dir:
+            self.blackbox = BlackBox(
+                blackbox_dir,
+                history=self.history,
+                doctor=self.doctor,
+                recorder=get_recorder,
+                attributor_fn=ensure_attributor,
+                state_fn=_debug_state,
+                node=engine.name,
+                watchdog_timeout_s=blackbox_watchdog_s,
+            )
+        if self.history is not None:
+            # Started AFTER the black box installed its segment hook, so
+            # the very first samples are already crash-durable.
+            self.history.start()
 
         def _run_profile(seconds: float) -> tuple[int, dict]:
             """One ``jax.profiler`` capture window into a fresh numbered
@@ -641,6 +735,10 @@ class ServingFrontend:
                     _json_response(self, 200, frontend._debug_requests())
                 elif self.path == "/debug/state":
                     _json_response(self, 200, frontend._debug_state())
+                elif self.path.split("?", 1)[0] == "/debug/timeseries":
+                    # Telemetry history (obs/timeseries.py): cursor-
+                    # paginated time-series rings over every plane.
+                    _debug_timeseries_response(self, frontend.history)
                 elif self.path == "/debug/waterfall":
                     # Critical-path attribution (obs/attribution.py):
                     # p50/p99 phase breakdown + per-shape table +
@@ -665,6 +763,9 @@ class ServingFrontend:
                     _json_response(self, 404, {"error": "not found"})
 
             def do_POST(self):
+                if self.path == "/admin/blackbox":
+                    _admin_blackbox_response(self, frontend.blackbox)
+                    return
                 if self.path == "/admin/drain":
                     # Graceful drain (policy/lifecycle.py): kick the
                     # DRAINING → LEFT sequence asynchronously — the
@@ -977,6 +1078,13 @@ class ServingFrontend:
     def close(self, drain_s: float = 5.0) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self.blackbox is not None:
+            # Graceful shutdown writes one last final (the drain hook
+            # may already have written its own; each final is complete
+            # and the loader takes the newest).
+            self.blackbox.close(flush_cause="shutdown")
+        if self.history is not None:
+            self.history.close()
         self.runner.close(drain_s=drain_s)
 
 
@@ -989,6 +1097,10 @@ class RouterFrontend:
         host: str = "127.0.0.1",
         port: int = 0,
         tokenizer=None,
+        history_interval_s: float = 1.0,
+        history_capacity: int = 900,
+        blackbox_dir: str | None = None,
+        blackbox_watchdog_s: float = 0.0,
     ):
         self.router = router
         self.log = get_logger("http.route")
@@ -1025,9 +1137,38 @@ class RouterFrontend:
         # controller, and ``rules_checked``/``inputs`` in the report
         # say so explicitly.
         ensure_attributor()
+        # Telemetry history + black box, same wiring as the serving
+        # frontend minus the engine/SLO seams a router doesn't hold:
+        # the router's rings are the fleet-facing record (health, heat,
+        # skew) — the observer dump the post-mortem doctor reads when a
+        # serving node dies without flushing its own.
+        node_label = f"router@{router.mesh_cache.rank}"
+        self.history = None
+        if history_interval_s > 0:
+            self.history = TelemetryHistory(
+                interval_s=history_interval_s,
+                capacity=history_capacity,
+                mesh=router.mesh_cache,
+                node=node_label,
+            )
         self.doctor = MeshDoctor(
-            mesh=router.mesh_cache, attributor=ensure_attributor
+            mesh=router.mesh_cache, attributor=ensure_attributor,
+            history=self.history,
         )
+        self.blackbox = None
+        if blackbox_dir:
+            self.blackbox = BlackBox(
+                blackbox_dir,
+                history=self.history,
+                doctor=self.doctor,
+                recorder=get_recorder,
+                attributor_fn=ensure_attributor,
+                state_fn=_debug_state,
+                node=node_label,
+                watchdog_timeout_s=blackbox_watchdog_s,
+            )
+        if self.history is not None:
+            self.history.start()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -1059,6 +1200,8 @@ class RouterFrontend:
                     )
                 elif self.path == "/debug/state":
                     _json_response(self, 200, frontend._debug_state())
+                elif self.path.split("?", 1)[0] == "/debug/timeseries":
+                    _debug_timeseries_response(self, frontend.history)
                 elif self.path == "/debug/waterfall":
                     _json_response(self, 200, ensure_attributor().report())
                 elif self.path == "/cluster/telemetry":
@@ -1076,6 +1219,9 @@ class RouterFrontend:
                     _json_response(self, 404, {"error": "not found"})
 
             def do_POST(self):
+                if self.path == "/admin/blackbox":
+                    _admin_blackbox_response(self, frontend.blackbox)
+                    return
                 if self.path != "/route":
                     _json_response(self, 404, {"error": "not found"})
                     return
@@ -1116,3 +1262,7 @@ class RouterFrontend:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self.blackbox is not None:
+            self.blackbox.close(flush_cause="shutdown")
+        if self.history is not None:
+            self.history.close()

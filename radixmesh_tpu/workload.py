@@ -2901,3 +2901,449 @@ def run_doctor_workload(
         "attribution": attribution,
         "wall_s": round(_time.monotonic() - t_start, 3),
     }
+
+
+def run_blackbox_workload(
+    seed: int = 0,
+    replication_factor: int = 3,
+    history_interval_s: float = 0.25,
+    history_capacity: int = 900,
+    segment_every: int = 4,
+    balanced_shards: int = 16,
+    zipf_keys: int = 64,
+    zipf_inserts: int = 400,
+    zipf_alpha: float = 1.4,
+    key_len: int = 8,
+    digest_interval_s: float = 0.2,
+    stale_after_s: float = 0.6,
+    summary_interval_s: float = 0.2,
+    timeout_s: float = 60.0,
+    blackbox_dir: str | None = None,
+) -> dict:
+    """The black-box acceptance scenario (PR 13; ``bench.
+    validate_blackbox`` pins its artifact): one rf=3 inproc mesh
+    (4 prefill + 2 decode + 1 router, per-node fleet digesters) plus a
+    step-accounted CPU engine, with TWO telemetry histories recording —
+    the ROUTER's (the observer: fleet health, shard heat, skew) and the
+    hot shard's PRIMARY OWNER's (the victim) — each wired to a
+    :class:`~radixmesh_tpu.obs.blackbox.BlackBox` writing incremental
+    segments. The run:
+
+    0. **Healthy.** Balanced heat + a decode-dominant engine burst; the
+       live history-backed doctor must report ZERO findings with every
+       rule checked.
+    a. **Zipf heat storm** (the OBS leg): deterministic rank^-alpha
+       insert counts drive one shard provably hottest; the observer's
+       rings record the skew peak.
+    b. **Kill mid-storm.** The hot shard's primary owner dies HARD:
+       its fleet digester, history sampler, and black box stop with NO
+       final flush (the kill -9 simulation — only its committed
+       segments survive), then its mesh closes. The observer's rings
+       record the victim's health score collapsing.
+    c. **Post-mortem from the dumps alone.** ``obs/doctor.py::
+       postmortem_report`` over the OBSERVER's flushed dump must name
+       the seeded hot shard AND a crash window containing the true
+       kill time; over the VICTIM's segment-only dump it must flag the
+       unclean death with the truncation point within one segment of
+       the kill.
+
+    The sampler's own cost is gated: both histories' self-accounted
+    sweep seconds must stay under 1% of the run's wall clock (the run
+    is a step-accounting run — the engine leg has it on)."""
+    import os
+    import shutil
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.cache.sharding import shard_of_tokens
+    from radixmesh_tpu.comm.inproc import InprocHub
+    from radixmesh_tpu.config import MeshConfig, NodeRole
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.engine.request import SamplingParams
+    from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.obs.attribution import ensure_attributor
+    from radixmesh_tpu.obs.blackbox import BlackBox, load_blackbox
+    from radixmesh_tpu.obs.doctor import (
+        DoctorConfig,
+        MeshDoctor,
+        postmortem_report,
+    )
+    from radixmesh_tpu.obs.fleet_plane import FleetPlane
+    from radixmesh_tpu.obs.timeseries import TelemetryHistory
+    from radixmesh_tpu.obs.trace_plane import (
+        FlightRecorder,
+        get_recorder,
+        set_recorder,
+    )
+    from radixmesh_tpu.slo.control import OverloadController, SLOConfig
+
+    def wait_for(pred, timeout=timeout_s, interval=0.02):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(interval)
+        return pred()
+
+    def finding_for(report: dict, rule: str, detector: str | None = None):
+        for f in report["findings"]:
+            if f["rule"] != rule:
+                continue
+            if detector and f["evidence"].get("detector") != detector:
+                continue
+            return f
+        return None
+
+    def hist_points(hist: TelemetryHistory, name: str) -> list:
+        body = hist.query(family=name, since=-1, limit=1 << 62)
+        return body["series"].get(name, {}).get("points", [])
+
+    rng = np.random.default_rng(seed)
+    t_start = _time.monotonic()
+    InprocHub.reset_default()
+    prev_recorder = get_recorder()
+    own_tmp = blackbox_dir is None
+    out_root = blackbox_dir or tempfile.mkdtemp(prefix="blackbox-wl-")
+    obs_dir = os.path.join(out_root, "observer")
+    victim_dir = os.path.join(out_root, "victim")
+    prefill = ["bp0", "bp1", "bp2", "bp3"]
+    decode = ["bd0", "bd1"]
+    router_addrs = ["br0"]
+    nodes: list = []
+    fleet_planes: list = []
+    histories: list = []
+    boxes: list = []
+    eng = None
+    try:
+        for addr in prefill + decode + router_addrs:
+            cfg = MeshConfig(
+                prefill_nodes=prefill,
+                decode_nodes=decode,
+                router_nodes=router_addrs,
+                local_addr=addr,
+                protocol="inproc",
+                tick_interval_s=0.1,
+                gc_interval_s=60.0,
+                failure_timeout_s=60.0,
+                replication_factor=replication_factor,
+                shard_summary_interval_s=summary_interval_s,
+            )
+            nodes.append(MeshCache(cfg, pool=None).start())
+        for n in nodes:
+            if not n.wait_ready(timeout=timeout_s):
+                raise RuntimeError(f"node {n.rank} never passed the barrier")
+        ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+        router_mesh = nodes[-1]
+        by_rank = {n.rank: n for n in ring}
+        any_node = ring[0]
+        page = max(1, any_node.page)
+        ownership = any_node.ownership
+        # Fast staleness verdicts: the observer must see a dead node's
+        # digest go stale within a second, not the 15 s default. (Ring
+        # nodes' views adopt their FleetPlane's config; the router has
+        # no plane, so its view is tuned directly.)
+        router_mesh.fleet.cfg.stale_after_s = stale_after_s
+        for n in ring:
+            fleet_planes.append(
+                FleetPlane(n, interval_s=digest_interval_s).start()
+            )
+
+        # -- engine (the step-accounting leg) --------------------------
+        mcfg = ModelConfig(
+            vocab_size=256, hidden=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            head_dim=32, intermediate=128, max_seq_len=1024,
+        )
+        eng = Engine(
+            mcfg,
+            init_params(mcfg, jax.random.PRNGKey(seed)),
+            num_slots=2048,
+            page_size=4,
+            max_batch=8,
+            name="bb-eng",
+            step_accounting=True,
+        )
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
+
+        def prompts_of(n_tokens: int, count: int) -> list[list[int]]:
+            return [
+                list(rng.integers(1, mcfg.vocab_size - 1, size=n_tokens))
+                for _ in range(count)
+            ]
+
+        # Warm-up untraced: jit compiles land before anything is timed.
+        set_recorder(FlightRecorder(capacity=2048, sample=0.0, node="warm"))
+        eng.generate(prompts_of(24, 3) + prompts_of(48, 3), sampling)
+        rec = FlightRecorder(capacity=1 << 15, sample=1.0, node="bb-eng")
+        set_recorder(rec)
+        attr = ensure_attributor(rec)
+        slo = OverloadController(SLOConfig())
+
+        # -- histories + black boxes (observer = router; victim joins
+        #    after the storm names the hot owner) ----------------------
+        obs_hist = TelemetryHistory(
+            interval_s=history_interval_s,
+            capacity=history_capacity,
+            mesh=router_mesh,
+            engine=eng,
+            slo=slo,
+            node="observer-router",
+        )
+        histories.append(obs_hist)
+        obs_bb = BlackBox(
+            obs_dir,
+            history=obs_hist,
+            recorder=get_recorder,
+            attributor_fn=ensure_attributor,
+            node="observer-router",
+            segment_every=segment_every,
+        )
+        boxes.append(obs_bb)
+        doctor = MeshDoctor(
+            mesh=router_mesh,
+            engine=eng,
+            slo=slo,
+            attributor=ensure_attributor,
+            history=obs_hist,
+        )
+        obs_bb.doctor = doctor
+        obs_hist.start()
+
+        # -- phase 0: healthy ------------------------------------------
+        seen_shards: set[int] = set()
+        attempts = 0
+        while len(seen_shards) < balanced_shards and attempts < 10_000:
+            attempts += 1
+            key = np.concatenate([
+                np.asarray([23_000 + attempts], dtype=np.int32),
+                rng.integers(1, 600, size=key_len - 1).astype(np.int32),
+            ])
+            sid = shard_of_tokens(key[:page])
+            if sid in seen_shards:
+                continue
+            seen_shards.add(sid)
+            node = by_rank[ownership.primary(sid)]
+            node.insert(key, np.arange(len(key), dtype=np.int32))
+            node.match_prefix(key)
+        for n in ring:
+            n.broadcast_shard_summary()
+        wait_for(
+            lambda: router_mesh.fleet.shard_heat()["reporters"]
+            >= len(ring) - 1
+        )
+        eng.generate(prompts_of(24, 3) + prompts_of(48, 3), sampling)
+        # Health series need at least one full digest round folded.
+        wait_for(
+            lambda: len(router_mesh.fleet.health()) >= len(ring)
+        )
+        wait_for(lambda: obs_hist.stats()["seq"] >= 2)
+        healthy_report = doctor.diagnose()
+        healthy = {
+            "performed": True,
+            "findings": healthy_report["findings"],
+            "rules_checked": healthy_report["rules_checked"],
+            "inputs": healthy_report["inputs"],
+            "balanced_shards": len(seen_shards),
+            "skew_score": router_mesh.shard_heat_report().get("skew_score"),
+            "history_samples": obs_hist.stats()["seq"] + 1,
+        }
+
+        # -- phase a: zipf heat storm ----------------------------------
+        heat = _obs_zipf_heat_phase(
+            ring=ring,
+            router_mesh=router_mesh,
+            by_rank=by_rank,
+            rng=rng,
+            wait_for=wait_for,
+            zipf_keys=zipf_keys,
+            zipf_inserts=zipf_inserts,
+            zipf_alpha=zipf_alpha,
+            key_len=key_len,
+        )
+        expected_sid = heat["expected_hot_shard"]
+        expected_owners = heat["expected_hot_owners"]
+        victim_rank = ownership.primary(expected_sid)
+        victim = by_rank[victim_rank]
+        # The victim's OWN recorder: history + black box on the node
+        # about to die — only its committed segments will survive.
+        victim_hist = TelemetryHistory(
+            interval_s=history_interval_s,
+            capacity=history_capacity,
+            mesh=victim,
+            node=f"victim-rank{victim_rank}",
+        )
+        histories.append(victim_hist)
+        victim_bb = BlackBox(
+            victim_dir,
+            history=victim_hist,
+            node=f"victim-rank{victim_rank}",
+            segment_every=segment_every,
+        )
+        boxes.append(victim_bb)
+        victim_hist.start()
+        # The observer must SAMPLE the storm at its peak before the
+        # kill (the rings are the post-mortem's only evidence).
+        skew_threshold = DoctorConfig().hot_shard_skew
+        wait_for(
+            lambda: any(
+                p[2] >= skew_threshold
+                for p in hist_points(obs_hist, "shard:skew_ratio")
+            )
+        )
+        # ...and the victim must commit at least one segment.
+        wait_for(lambda: victim_bb.stats()["segments"] >= 1)
+
+        # -- phase b: kill the hot owner mid-storm ---------------------
+        for fp in fleet_planes:
+            if fp.mesh is victim:
+                fp.close()
+        victim_hist.close()
+        victim_bb.close()  # NO flush: the kill -9 simulation
+        victim.close()
+        # t_kill is stamped AFTER the teardown completes: a sampler
+        # tick or digest publish racing the close must land before it,
+        # or the truncation/crash-window gates flake on an otherwise
+        # correct run (last committed sample > t_kill).
+        t_kill = _time.monotonic()
+        detected = wait_for(
+            lambda: any(
+                p[2] < 0.5
+                for p in hist_points(
+                    obs_hist, f'fleet:health_score{{rank="{victim_rank}"}}'
+                )
+            ),
+            timeout=max(10.0, 20.0 * stale_after_s),
+        )
+        crash = {
+            "performed": True,
+            "victim_rank": victim_rank,
+            "victim_is_hot_owner": victim_rank in expected_owners,
+            "t_kill": round(t_kill, 3),
+            "observer_detected_live": bool(detected),
+        }
+
+        # -- flush the observer (the SIGTERM exit path) ----------------
+        flush_info = obs_bb.flush("sigterm")
+        obs_hist.close()
+
+        # -- phase c: post-mortem from the dumps alone -----------------
+        obs_dump = load_blackbox(obs_dir)
+        victim_dump = load_blackbox(victim_dir)
+        obs_pm = postmortem_report(obs_dump)
+        victim_pm = postmortem_report(victim_dump)
+        hot_f = finding_for(obs_pm, "hot_shard")
+        crash_f = finding_for(obs_pm, "node_crash", detector="health_drop")
+        trunc_f = finding_for(
+            victim_pm, "node_crash", detector="history_truncated"
+        )
+        window = (crash_f or {}).get("evidence", {}).get("window")
+        window_contains_kill = bool(
+            window is not None and window[0] - 0.05 <= t_kill <= window[1]
+        )
+        trunc_slack = 2.0 * segment_every * history_interval_s + 0.5
+        trunc_last_t = (victim_dump.get("last_t") or 0.0)
+        truncation_within = bool(
+            victim_dump["unclean"]
+            and trunc_f is not None
+            and 0.0 <= t_kill - trunc_last_t <= trunc_slack
+        )
+        postmortem = {
+            "observer": {
+                "findings": obs_pm["findings"],
+                "rules_checked": obs_pm["rules_checked"],
+                "samples": obs_pm["samples"],
+                "hot_shard_named": bool(
+                    hot_f is not None
+                    and hot_f["evidence"].get("shard") == expected_sid
+                ),
+                "hot_shard_evidence": (hot_f or {}).get("evidence", {}),
+                "crash_window_named": window_contains_kill,
+                "crash_evidence": (crash_f or {}).get("evidence", {}),
+            },
+            "victim": {
+                "findings": victim_pm["findings"],
+                "unclean": victim_pm["unclean"],
+                "segments": victim_dump["segments"],
+                "last_t": round(trunc_last_t, 3),
+                "truncation_slack_s": round(trunc_slack, 3),
+                "truncation_named": truncation_within,
+                "truncation_evidence": (trunc_f or {}).get("evidence", {}),
+            },
+            "expected": {
+                "hot_shard": expected_sid,
+                "hot_owners": expected_owners,
+                "t_kill": round(t_kill, 3),
+            },
+        }
+
+        wall_s = _time.monotonic() - t_start
+        sampler_cost = sum(
+            h.stats()["sample_seconds_total"] for h in histories
+        )
+        obs_stats = obs_hist.stats()
+        history = {
+            "interval_s": history_interval_s,
+            "capacity": history_capacity,
+            "samplers": len(histories),
+            "samples": obs_stats["seq"] + 1,
+            "series": obs_stats["series"],
+            "points": obs_stats["points"],
+            "dropped_series": obs_stats["dropped_series"],
+            "self_overhead": {
+                "sample_seconds_total": round(sampler_cost, 6),
+                "wall_s": round(wall_s, 3),
+                "fraction": round(sampler_cost / max(1e-9, wall_s), 6),
+                "budget_fraction": 0.01,
+                "under_budget": bool(sampler_cost / max(1e-9, wall_s) < 0.01),
+            },
+        }
+        blackbox = {
+            "schema_version": obs_dump["schema_version"],
+            "observer": {
+                "segments": obs_dump["segments"],
+                "finals": obs_dump["finals"],
+                "causes": obs_dump["causes"],
+                "bytes_final": flush_info["bytes"],
+            },
+            "victim": {
+                "segments": victim_dump["segments"],
+                "finals": victim_dump["finals"],
+                "unclean": victim_dump["unclean"],
+            },
+        }
+    finally:
+        set_recorder(prev_recorder)
+        for h in histories:
+            h.close()
+        for bb in boxes:
+            bb.close()
+        for fp in fleet_planes:
+            fp.close()
+        for n in nodes:
+            n.close()
+        InprocHub.reset_default()
+        if own_tmp:
+            shutil.rmtree(out_root, ignore_errors=True)
+
+    named = sum([
+        postmortem["observer"]["hot_shard_named"],
+        postmortem["observer"]["crash_window_named"],
+        postmortem["victim"]["truncation_named"],
+    ])
+    return {
+        "nodes": len(prefill) + len(decode) + len(router_addrs),
+        "topology": "4 prefill + 2 decode + 1 router (inproc, per-node "
+        "fleet digesters) + step-accounted CPU engine",
+        "replication_factor": replication_factor,
+        "named": named,
+        "healthy": healthy,
+        "storm": heat,
+        "crash": crash,
+        "postmortem": postmortem,
+        "history": history,
+        "blackbox": blackbox,
+        "attribution_audited": attr.stats()["audited"],
+        "wall_s": round(_time.monotonic() - t_start, 3),
+    }

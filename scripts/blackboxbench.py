@@ -1,0 +1,118 @@
+"""Standalone black-box acceptance bench (the BLACKBOX artifact's paired
+CLI emitter, like ``scripts/obsbench.py`` is for OBS).
+
+Runs ``workload.run_blackbox_workload`` — a healthy phase the live
+history-backed doctor must stay silent on, a zipf heat storm recorded
+into two nodes' telemetry-history rings, a hard kill of the hot shard's
+primary owner mid-storm (its black box keeps only committed segments —
+the kill -9 simulation), and an offline post-mortem
+(``obs/doctor.py::postmortem_report``) that must name the hot shard,
+the crash window, and the unclean-death truncation FROM THE DUMPS
+ALONE — and prints ONE JSON line validated against the schema
+``bench.validate_blackbox`` pins.
+
+Usage::
+
+    python scripts/blackboxbench.py [--seed 0] [--replication-factor 3] \
+        [--keep-dumps DIR] [--out FILE] [--write-artifact]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+from radixmesh_tpu.workload import run_blackbox_workload  # noqa: E402
+
+
+def blackbox_round() -> int:
+    """The round in progress = 1 + the highest N across every OTHER
+    plane's recorded artifact (BLACKBOX rides whatever round they are
+    on — the scripts/meshcheck.py analysis_round convention)."""
+    rounds = [0]
+    for name in os.listdir(_REPO_ROOT):
+        m = re.fullmatch(r"[A-Z_]+_r(\d+)\.json", name)
+        if m and not name.startswith("BLACKBOX_"):
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def run(
+    seed: int,
+    replication_factor: int,
+    history_interval_s: float,
+    blackbox_dir: str | None,
+) -> dict:
+    res = run_blackbox_workload(
+        seed=seed,
+        replication_factor=replication_factor,
+        history_interval_s=history_interval_s,
+        blackbox_dir=blackbox_dir,
+    )
+    report = bench.build_blackbox_report(res)
+    problems = bench.validate_blackbox(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="blackboxbench")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--replication-factor", type=int, default=3, metavar="RF",
+        help="sharding factor for the mesh under test (the hot-owner "
+        "kill needs RF > 0; the acceptance run pins 3)",
+    )
+    ap.add_argument(
+        "--history-interval", type=float, default=0.25, metavar="SECONDS",
+        help="telemetry-history sample cadence for the run (production "
+        "default is 1 s; the acceptance run samples faster so the "
+        "storm and the crash land in the rings quickly)",
+    )
+    ap.add_argument(
+        "--keep-dumps", default=None, metavar="DIR",
+        help="write the observer + victim black-box dumps under DIR and "
+        "keep them (default: a temp dir, removed after the run) — "
+        "point scripts/doctor.py --blackbox at DIR/observer to replay "
+        "the post-mortem yourself",
+    )
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument(
+        "--write-artifact", action="store_true",
+        help="write the round's BLACKBOX_r{N}.json to the repo root",
+    )
+    args = ap.parse_args()
+    report = run(
+        args.seed,
+        args.replication_factor,
+        args.history_interval,
+        args.keep_dumps,
+    )
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    if args.write_artifact:
+        path = os.path.join(
+            _REPO_ROOT, f"BLACKBOX_r{blackbox_round():02d}.json"
+        )
+        with open(path, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"blackboxbench: wrote {os.path.basename(path)}",
+              file=sys.stderr)
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

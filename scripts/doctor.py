@@ -1,11 +1,21 @@
-"""The mesh doctor's CLI: diagnose a LIVE cluster, or run the seeded
-acceptance workload and emit the round's DOCTOR artifact.
+"""The mesh doctor's CLI: diagnose a LIVE cluster, a DEAD node's
+black-box dump, or run the seeded acceptance workload and emit the
+round's DOCTOR artifact.
 
 Live mode (default) hits a frontend's ``GET /cluster/doctor``
 (``obs/doctor.py`` runs server-side — the burn-rate windows live in the
 frontend's persistent doctor, so the CLI is a thin, dependency-free
 reader) and renders the ranked findings with their pinned evidence.
 Exit codes: 0 healthy, 1 findings, 2 unreachable/bad response.
+
+Post-mortem mode (``--blackbox DIR``) loads a black-box dump directory
+(``obs/blackbox.py`` — written by ``launch.py --blackbox-dir`` on
+SIGTERM/drain/watchdog, or left as bare segments by a hard kill) and
+replays the doctor's judgment over the RECORDED telemetry history
+(``obs/doctor.py::postmortem_report``): hot shards, replication lag,
+burn rates at their in-window peaks, and the crash itself (health
+collapse windows, unclean-death truncation). Same exit codes; no
+cluster required.
 
 Workload mode (``--workload``) runs ``workload.run_doctor_workload`` —
 healthy phase + three deterministically seeded pathologies over an rf=3
@@ -16,6 +26,7 @@ writes ``DOCTOR_r{N}.json``.
 Usage::
 
     python scripts/doctor.py [--url http://HOST:PORT] [--watch SECONDS]
+    python scripts/doctor.py --blackbox /var/dumps/prefill@2 [--json]
     python scripts/doctor.py --workload [--seed 0] [--out FILE]
 """
 
@@ -73,6 +84,31 @@ def _live(url: str, watch: float | None) -> int:
         time.sleep(watch)
 
 
+def _postmortem(path: str, as_json: bool) -> int:
+    from radixmesh_tpu.obs.blackbox import load_blackbox
+    from radixmesh_tpu.obs.doctor import postmortem_report
+
+    try:
+        dump = load_blackbox(path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"doctor: cannot load black box at {path}: {e}",
+              file=sys.stderr)
+        return 2
+    report = postmortem_report(dump)
+    if as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        state = "UNCLEAN (segments only — hard kill)" if dump["unclean"] \
+            else f"flushed ({', '.join(dump['causes'])})"
+        print(
+            f"black box: node {dump['node']!r}, {dump['segments']} "
+            f"segment(s) + {dump['finals']} final(s) [{state}], "
+            f"{report['samples']} samples over {report['series']} series"
+        )
+        _render(report)
+    return 0 if report.get("healthy") else 1
+
+
 def _workload(seed: int, out: str | None) -> int:
     import bench
     from radixmesh_tpu.workload import run_doctor_workload
@@ -116,6 +152,16 @@ def main() -> int:
         help="re-diagnose every SECONDS (live mode only; ctrl-c to stop)",
     )
     ap.add_argument(
+        "--blackbox", default=None, metavar="DIR",
+        help="post-mortem mode: replay every doctor rule over a "
+        "black-box dump directory (obs/blackbox.py) instead of a live "
+        "cluster — works on segment-only dumps a hard kill left behind",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print the full post-mortem report as JSON (--blackbox mode)",
+    )
+    ap.add_argument(
         "--workload", action="store_true",
         help="run the seeded acceptance workload and write DOCTOR_r{N}.json "
         "instead of querying a live cluster",
@@ -126,6 +172,8 @@ def main() -> int:
         help="workload-mode artifact path (default DOCTOR_r{N}.json)",
     )
     args = ap.parse_args()
+    if args.blackbox:
+        return _postmortem(args.blackbox, args.json)
     if args.workload:
         return _workload(args.seed, args.out)
     return _live(args.url, args.watch)

@@ -820,3 +820,45 @@ def test_meshcheck_cli_changed_refuses_artifact():
     )
     assert proc.returncode == 2
     assert "whole tree" in proc.stderr
+
+
+def test_meshcheck_cli_exit_one_on_findings(tmp_path):
+    """The third pinned exit code (the per-PR quick gate's contract:
+    0 clean / 1 findings / 2 framework error): a seeded vocabulary
+    violation in a --root tree must exit 1 and print the finding."""
+    (tmp_path / "obs").mkdir()
+    (tmp_path / "obs" / "bad.py").write_text(
+        "from radixmesh_tpu.obs.metrics import get_registry\n"
+        "c = get_registry().counter('unprefixed_name', 'd')\n"
+        "c.inc()\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(_REPO / "scripts" / "meshcheck.py"),
+            "--root", str(tmp_path), "--no-fixtures",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "metrics-prefix" in proc.stdout
+
+
+def test_meshcheck_changed_gate_covers_this_pr(tmp_path):
+    """Satellite (PR 13): the --changed quick gate IS the per-PR static
+    pass — run it exactly as CI would and pin the full exit-code
+    contract in one place: clean tree + dirty worktree exits 0, the
+    artifact refusal exits 2 (exit 1 is proven by the seeded-finding
+    test above)."""
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "meshcheck.py"),
+         "--changed"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+    refused = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "meshcheck.py"),
+         "--changed", "--write-artifact"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert refused.returncode == 2

@@ -1397,3 +1397,197 @@ class TestBenchdiffCLI:
         assert p.returncode in (
             bench.BENCHDIFF_EXIT_CLEAN, bench.BENCHDIFF_EXIT_REGRESSION,
         ), p.stdout + p.stderr
+
+
+class TestBlackboxArtifactSchema:
+    """BLACKBOX v1 (PR 13, the flight-recorder plane): zero live
+    findings on the healthy phase with every rule running, the
+    post-mortem naming the hot shard + a crash window containing the
+    kill from the observer dump and the unclean-death truncation from
+    the victim's segment-only dump, and the sampler's self-accounted
+    overhead under budget."""
+
+    def _report(self) -> dict:
+        from radixmesh_tpu.obs.doctor import RULES
+
+        return {
+            "schema_version": bench.BLACKBOX_SCHEMA_VERSION,
+            "metric": "blackbox_postmortem_named",
+            "value": bench.BLACKBOX_NAMED_TOTAL,
+            "unit": "of 3 post-mortem verdicts named from dumps alone",
+            "workload": "healthy + zipf storm + hot-owner hard kill",
+            "nodes": 7,
+            "topology": "4 prefill + 2 decode + 1 router + engine",
+            "replication_factor": 3,
+            "healthy": {
+                "performed": True,
+                "findings": [],
+                "rules_checked": list(RULES),
+                "inputs": {"mesh": True, "engine": True, "slo": True,
+                           "attribution": True, "history": True},
+                "history_samples": 12,
+            },
+            "storm": {"performed": True, "expected_hot_shard": 7},
+            "crash": {
+                "performed": True,
+                "victim_rank": 2,
+                "victim_is_hot_owner": True,
+                "t_kill": 1000.0,
+                "observer_detected_live": True,
+            },
+            "postmortem": {
+                "observer": {
+                    "hot_shard_named": True,
+                    "hot_shard_evidence": {"shard": 7, "skew_peak": 18.0},
+                    "crash_window_named": True,
+                    "crash_evidence": {"window": [999.4, 1000.6]},
+                },
+                "victim": {
+                    "truncation_named": True,
+                    "unclean": True,
+                    "segments": 2,
+                },
+                "expected": {"hot_shard": 7, "t_kill": 1000.0},
+            },
+            "history": {
+                "interval_s": 0.25,
+                "capacity": 900,
+                "points": 4000,
+                "self_overhead": {
+                    "sample_seconds_total": 0.02,
+                    "wall_s": 10.0,
+                    "fraction": 0.002,
+                    "budget_fraction": 0.01,
+                    "under_budget": True,
+                },
+            },
+            "blackbox": {"schema_version": 1},
+            "wall_s": 10.0,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_blackbox(self._report()) == []
+
+    def test_missing_top_fields_named(self):
+        report = self._report()
+        del report["postmortem"]
+        del report["history"]
+        problems = bench.validate_blackbox(report)
+        assert "postmortem" in problems
+        assert "history" in problems
+
+    def test_healthy_findings_fail_the_gate(self):
+        report = self._report()
+        report["healthy"]["findings"] = [{"rule": "hot_shard"}]
+        assert any(
+            "healthy" in p for p in bench.validate_blackbox(report)
+        )
+
+    def test_all_rules_must_have_run_on_healthy(self):
+        report = self._report()
+        report["healthy"]["rules_checked"] = ["hot_shard"]
+        problems = "\n".join(bench.validate_blackbox(report))
+        assert "never ran" in problems
+
+    def test_postmortem_misses_fail(self):
+        for path, key in (
+            (("postmortem", "observer"), "hot_shard_named"),
+            (("postmortem", "observer"), "crash_window_named"),
+            (("postmortem", "victim"), "truncation_named"),
+            (("postmortem", "victim"), "unclean"),
+        ):
+            report = self._report()
+            sec = report
+            for p in path:
+                sec = sec[p]
+            sec[key] = False
+            assert bench.validate_blackbox(report), (path, key)
+
+    def test_kill_must_land_on_a_hot_owner(self):
+        report = self._report()
+        report["crash"]["victim_is_hot_owner"] = False
+        problems = "\n".join(bench.validate_blackbox(report))
+        assert "hot" in problems
+
+    def test_overhead_budget_gate(self):
+        report = self._report()
+        report["history"]["self_overhead"]["fraction"] = 0.05
+        report["history"]["self_overhead"]["under_budget"] = False
+        problems = "\n".join(bench.validate_blackbox(report))
+        assert "overhead" in problems
+
+    def test_value_must_count_every_verdict(self):
+        report = self._report()
+        report["value"] = 2
+        problems = "\n".join(bench.validate_blackbox(report))
+        assert "verdicts" in problems
+
+    def test_skipped_sections_are_schema_valid_but_gate_exempt(self):
+        report = self._report()
+        report["healthy"] = {"performed": False}
+        report["crash"] = {"performed": False}
+        assert bench.validate_blackbox(report) == []
+
+    def test_build_report_matches_schema(self):
+        core = {k: v for k, v in self._report().items()
+                if k not in ("schema_version", "metric", "value", "unit",
+                             "workload")}
+        core["named"] = 3
+        report = bench.build_blackbox_report(core)
+        assert bench.validate_blackbox(report) == []
+        assert report["value"] == 3
+        assert report["metric"] == "blackbox_postmortem_named"
+
+    def test_checked_in_artifact_validates_and_gates_green(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "BLACKBOX_r*.json")))
+        assert paths, "no BLACKBOX artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_blackbox(report) == [], paths[-1]
+        assert "schema_violation" not in report
+        assert report["value"] == bench.BLACKBOX_NAMED_TOTAL
+        assert report["healthy"]["findings"] == []
+        pm = report["postmortem"]
+        # The post-mortem named the SEEDED shard, and the crash window
+        # brackets the recorded kill instant.
+        assert (
+            pm["observer"]["hot_shard_evidence"]["shard"]
+            == pm["expected"]["hot_shard"]
+        )
+        lo, hi = pm["observer"]["crash_evidence"]["window"]
+        assert lo - 0.05 <= pm["expected"]["t_kill"] <= hi
+        assert pm["victim"]["unclean"] is True
+        assert report["history"]["self_overhead"]["fraction"] < 0.01
+
+    def test_blackbox_kind_registered_in_sentinel(self):
+        # COMPARE_RULES + metric-kind detection (the satellite-5 wiring).
+        assert "BLACKBOX" in bench.COMPARE_RULES
+        report = self._report()
+        assert bench.artifact_kind(report) == "BLACKBOX"
+        assert bench.artifact_kind({}, "BLACKBOX_r13.json") == "BLACKBOX"
+
+    def test_compare_rounds_flags_lost_verdict(self):
+        old = self._report()
+        new = self._report()
+        new["value"] = 2
+        res = bench.compare_rounds(old, new, kind="BLACKBOX")
+        assert res["status"] == "regression"
+        assert "value" in res["regressions"]
+
+    def test_compare_rounds_tolerates_overhead_jitter(self):
+        old = self._report()
+        new = self._report()
+        new["history"]["self_overhead"]["fraction"] = 0.004  # 2x, in budget
+        res = bench.compare_rounds(old, new, kind="BLACKBOX")
+        assert res["status"] == "clean"
+
+    def test_selfcheck_covers_the_blackbox_schema(self):
+        res = bench.benchdiff_selfcheck()
+        assert res["identical_clean"] is True
+        assert res["regression_flagged"] is True
+        assert res["mismatch_detected"] is True
+        assert "BLACKBOX" in res["kinds_covered"]

@@ -1,0 +1,451 @@
+"""The black box (obs/blackbox.py) + post-mortem doctoring
+(obs/doctor.py::postmortem_report): incremental segments survive a
+simulated hard kill, finals carry every section, the loader merges and
+flags unclean dumps, the watchdog flushes a stalled node once, and the
+post-mortem rules name crashes / hot shards / lag / burn from recorded
+series alone."""
+
+import json
+import os
+import time
+
+import pytest
+
+from radixmesh_tpu.obs.blackbox import (
+    BLACKBOX_SCHEMA_VERSION,
+    BlackBox,
+    load_blackbox,
+)
+from radixmesh_tpu.obs.doctor import (
+    POSTMORTEM_EVIDENCE_FIELDS,
+    POSTMORTEM_RULES,
+    DoctorConfig,
+    postmortem_report,
+)
+from radixmesh_tpu.obs.metrics import Registry, get_registry, set_registry
+from radixmesh_tpu.obs.timeseries import TelemetryHistory
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    old = set_registry(Registry())
+    yield
+    set_registry(old)
+
+
+def _history(**kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("capacity", 64)
+    return TelemetryHistory(**kw)
+
+
+class TestSegments:
+    def test_segment_cadence_and_atomic_files(self, tmp_path):
+        c = get_registry().counter("radixmesh_test_total", "t")
+        h = _history()
+        bb = BlackBox(str(tmp_path), history=h, node="n0", segment_every=3)
+        for t in range(9):
+            c.inc()
+            h.sample(t=float(t))
+        assert bb.stats()["segments"] == 3
+        node_dir = bb.dir
+        segs = sorted(
+            f for f in os.listdir(node_dir) if f.startswith("segment-")
+        )
+        assert segs == [f"segment-{i:06d}.json" for i in range(3)]
+        # Every committed file is complete JSON (atomic rename contract).
+        for f in segs:
+            with open(os.path.join(node_dir, f)) as fh:
+                seg = json.load(fh)
+            assert seg["schema_version"] == BLACKBOX_SCHEMA_VERSION
+            assert seg["kind"] == "segment"
+        # No temp litter.
+        assert not [f for f in os.listdir(node_dir) if ".tmp." in f]
+
+    def test_segments_carry_disjoint_seq_ranges(self, tmp_path):
+        c = get_registry().counter("radixmesh_test_total", "t")
+        h = _history()
+        bb = BlackBox(str(tmp_path), history=h, node="n0", segment_every=2)
+        for t in range(6):
+            c.inc()
+            h.sample(t=float(t))
+        dump = load_blackbox(str(tmp_path))
+        pts = dump["series"]["radixmesh_test_total"]
+        assert [p[0] for p in pts] == list(range(6))  # no dupes, no holes
+
+    def test_hard_kill_leaves_complete_segments_only(self, tmp_path):
+        c = get_registry().counter("radixmesh_test_total", "t")
+        h = _history()
+        bb = BlackBox(str(tmp_path), history=h, node="n0", segment_every=2)
+        for t in range(5):
+            c.inc()
+            h.sample(t=float(t))
+        bb.close()  # NO flush: the kill -9 simulation
+        h.close()
+        dump = load_blackbox(str(tmp_path))
+        assert dump["unclean"] is True
+        assert dump["segments"] == 2
+        assert dump["finals"] == 0
+        # Samples 0..3 were committed; sample 4 died with the process.
+        assert dump["last_seq"] == 3
+
+    def test_restart_rotates_prior_boot_dump(self, tmp_path):
+        # A supervisor restarting a crashed node into the same
+        # --blackbox-dir must not clobber the crash's evidence: the old
+        # segments would be overwritten by the reset numbering and a
+        # fresh final would erase the unclean signature.
+        c = get_registry().counter("radixmesh_test_total", "t")
+        h = _history()
+        bb = BlackBox(str(tmp_path), history=h, node="n0", segment_every=2)
+        for t in range(5):
+            c.inc()
+            h.sample(t=float(t))
+        bb.close()  # kill -9: segments only, no final
+        h.close()
+
+        h2 = _history()
+        bb2 = BlackBox(str(tmp_path), history=h2, node="n0", segment_every=2)
+        for t in range(3):
+            c.inc()
+            h2.sample(t=float(t))
+        bb2.flush("sigterm")
+        bb2.close()
+        h2.close()
+
+        # The prior boot's dump survived, intact and still unclean.
+        old = load_blackbox(os.path.join(bb2.dir, "prior-000"))
+        assert old["unclean"] is True
+        assert old["segments"] == 2
+        assert old["last_seq"] == 3
+        # The new boot's dump is its own clean story.
+        new = load_blackbox(str(tmp_path))
+        assert new["unclean"] is False
+        assert new["segments"] == 1
+        assert new["finals"] == 1
+
+
+class TestFlush:
+    def test_final_carries_every_section(self, tmp_path):
+        c = get_registry().counter("radixmesh_test_total", "t")
+        c.inc()
+        h = _history()
+
+        class FakeDoctor:
+            def diagnose(self):
+                return {"findings": [{"rule": "hot_shard"}], "healthy": False}
+
+        class FakeRecorder:
+            def export_spans(self):
+                return {"node": "n0", "spans": [], "dropped": 0}
+
+        class FakeAttr:
+            def report(self):
+                return {"phases": {}, "recent": []}
+
+        bb = BlackBox(
+            str(tmp_path),
+            history=h,
+            doctor=FakeDoctor(),
+            recorder=FakeRecorder(),
+            attributor_fn=lambda: FakeAttr(),
+            state_fn=lambda: {"engine": {"name": "x"}},
+            node="n0",
+        )
+        h.sample(t=0.0)
+        res = bb.flush("admin")
+        assert res["cause"] == "admin"
+        with open(res["path"]) as fh:
+            final = json.load(fh)
+        assert final["kind"] == "final"
+        assert final["history"]["series"]
+        assert final["doctor"]["findings"][0]["rule"] == "hot_shard"
+        assert final["spans"]["node"] == "n0"
+        assert final["waterfall"]["phases"] == {}
+        assert final["state"]["engine"]["name"] == "x"
+        snap = get_registry().snapshot()
+        assert snap['radixmesh_blackbox_flushes_total{cause="admin"}'] == 1.0
+        assert snap["radixmesh_blackbox_bytes_total"] > 0
+
+    def test_broken_section_loses_itself_not_the_dump(self, tmp_path):
+        h = _history()
+
+        class BrokenDoctor:
+            def diagnose(self):
+                raise RuntimeError("boom")
+
+        bb = BlackBox(
+            str(tmp_path), history=h, doctor=BrokenDoctor(), node="n0"
+        )
+        h.sample(t=0.0)
+        res = bb.flush("drain")
+        with open(res["path"]) as fh:
+            final = json.load(fh)
+        assert "doctor" not in final
+        assert final["history"]["series"]
+
+    def test_each_trigger_writes_its_own_final_newest_wins(self, tmp_path):
+        c = get_registry().counter("radixmesh_test_total", "t")
+        h = _history()
+        bb = BlackBox(str(tmp_path), history=h, node="n0")
+        c.inc()
+        h.sample(t=0.0)
+        bb.flush("drain")
+        c.inc()
+        h.sample(t=1.0)
+        bb.flush("sigterm")
+        dump = load_blackbox(str(tmp_path))
+        assert dump["finals"] == 2
+        assert dump["causes"] == ["drain", "sigterm"]
+        assert dump["unclean"] is False
+        # The merged series include the post-drain sample (newest final).
+        assert dump["series"]["radixmesh_test_total"][-1][2] == 2.0
+
+
+class TestWatchdog:
+    def test_stalled_sampler_flushes_once(self, tmp_path):
+        # interval must be well under timeout/2 or __init__ clamps the
+        # timeout to 10x the interval and the later sleeps span zero
+        # watchdog periods.
+        h = _history(interval_s=0.005)
+        h.sample(t=0.0)  # one heartbeat, then silence
+        bb = BlackBox(
+            str(tmp_path), history=h, node="n0",
+            watchdog_timeout_s=0.05,
+        )
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if bb.stats()["flushes"]:
+                break
+            time.sleep(0.01)
+        # meshcheck: the loop above polls a cross-thread verdict with a
+        # deadline — the watchdog thread owns the flush.
+        assert bb.stats()["flush_causes"] == ["watchdog"]
+        time.sleep(0.15)  # several more watchdog periods
+        assert bb.stats()["flushes"] == 1  # fired exactly once
+        bb.close()
+
+    def test_live_sampler_keeps_watchdog_quiet(self, tmp_path):
+        h = TelemetryHistory(interval_s=0.01, capacity=32)
+        bb = BlackBox(
+            str(tmp_path), history=h, node="n0",
+            watchdog_timeout_s=1.0,
+        )
+        h.start()
+        try:
+            time.sleep(0.1)
+            assert bb.stats()["flushes"] == 0
+        finally:
+            h.close()
+            bb.close()
+
+
+class TestLoader:
+    def test_refuses_empty_and_future_schema(self, tmp_path):
+        with pytest.raises(ValueError):
+            load_blackbox(str(tmp_path))
+        h = _history()
+        bb = BlackBox(str(tmp_path), history=h, node="n0")
+        manifest_path = os.path.join(bb.dir, "MANIFEST.json")
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+        manifest["schema_version"] = BLACKBOX_SCHEMA_VERSION + 1
+        with open(manifest_path, "w") as fh:
+            json.dump(manifest, fh)
+        with pytest.raises(ValueError):
+            load_blackbox(str(tmp_path))
+
+    def test_manifest_only_dump_is_unclean(self, tmp_path):
+        # A node that died before its first segment commit leaves only
+        # MANIFEST.json — every graceful exit writes a final, so a
+        # final-less dir must read UNCLEAN and the post-mortem must say
+        # so, not report a healthy dump.
+        BlackBox(str(tmp_path), history=_history(), node="n0")
+        dump = load_blackbox(str(tmp_path))
+        assert dump["unclean"] is True
+        assert dump["segments"] == 0 and dump["last_t"] is None
+        report = postmortem_report(dump)
+        f = next(
+            x for x in report["findings"]
+            if x["evidence"].get("detector") == "history_truncated"
+        )
+        assert f["rule"] == "node_crash"
+        assert f["evidence"]["window"] == [None, None]
+
+    def test_loads_node_dir_or_single_node_root(self, tmp_path):
+        c = get_registry().counter("radixmesh_test_total", "t")
+        c.inc()
+        h = _history()
+        bb = BlackBox(str(tmp_path), history=h, node="p@0")
+        h.sample(t=0.0)
+        bb.flush("admin")
+        by_root = load_blackbox(str(tmp_path))
+        by_dir = load_blackbox(bb.dir)
+        assert by_root["series"] == by_dir["series"]
+        assert by_root["node"] == "p@0"
+
+
+def _pts(vals, t0=1000.0, dt=1.0):
+    return [[i, t0 + i * dt, float(v)] for i, v in enumerate(vals)]
+
+
+class TestPostmortemRules:
+    def test_health_drop_names_rank_and_window(self):
+        dump = {
+            "series": {
+                'fleet:health_score{rank="3"}': _pts([1.0, 1.0, 0.2]),
+                'fleet:health_age_seconds{rank="3"}': _pts([0.1, 0.1, 0.9]),
+                'fleet:health_score{rank="0"}': _pts([1.0, 1.0, 1.0]),
+            },
+            "interval_s": 1.0,
+            "last_t": 1002.0,
+            "last_seq": 2,
+        }
+        report = postmortem_report(dump)
+        (f,) = report["findings"]
+        assert f["rule"] == "node_crash"
+        assert f["evidence"]["rank"] == "3"
+        assert f["evidence"]["detector"] == "health_drop"
+        lo, hi = f["evidence"]["window"]
+        assert lo == pytest.approx(1002.0 - 0.9)
+        assert hi == pytest.approx(1002.0)
+
+    def test_health_drop_detected_past_leading_bad_point(self):
+        # A rank whose FIRST recorded point is below 0.5 (sampler
+        # started while the digest was still converging) must not be
+        # permanently skipped: once it has been seen healthy, a later
+        # genuine drop is still a crash.
+        dump = {
+            "series": {
+                'fleet:health_score{rank="2"}': _pts([0.3, 1.0, 1.0, 0.1]),
+                'fleet:health_age_seconds{rank="2"}': _pts(
+                    [0.1, 0.1, 0.1, 0.8]
+                ),
+            },
+            "interval_s": 1.0,
+            "last_t": 1003.0,
+            "last_seq": 3,
+        }
+        report = postmortem_report(dump)
+        (f,) = report["findings"]
+        assert f["rule"] == "node_crash"
+        assert f["evidence"]["rank"] == "2"
+        assert f["evidence"]["window"][1] == pytest.approx(1003.0)
+
+    def test_truncated_unclean_dump_names_crash_window(self):
+        dump = {
+            "series": {"radixmesh_x_total": _pts([1, 2, 3])},
+            "interval_s": 0.5,
+            "last_t": 1002.0,
+            "last_seq": 2,
+            "unclean": True,
+            "node": "victim",
+            "manifest": {"segment_every": 4},
+        }
+        report = postmortem_report(dump)
+        f = next(
+            x for x in report["findings"]
+            if x["evidence"].get("detector") == "history_truncated"
+        )
+        assert f["evidence"]["window"] == [1002.0, 1004.0]  # +4*0.5s slack
+
+    def test_hot_shard_peak_named_even_after_cooldown(self):
+        dump = {
+            "series": {
+                "shard:skew_ratio": _pts([1.0, 9.0, 1.2]),
+                'shard:heat{shard="7"}': _pts([5.0, 90.0, 6.0]),
+                'shard:heat{shard="2"}': _pts([5.0, 10.0, 5.0]),
+            },
+            "interval_s": 1.0,
+            "last_t": 1002.0,
+            "last_seq": 2,
+        }
+        report = postmortem_report(dump)
+        (f,) = report["findings"]
+        assert f["rule"] == "hot_shard"
+        assert f["evidence"]["shard"] == 7
+        assert f["evidence"]["skew_peak"] == 9.0
+        assert f["evidence"]["t_peak"] == pytest.approx(1001.0)
+
+    def test_replication_lag_peak(self):
+        dump = {
+            "series": {
+                'fleet:replication_lag_seconds{rank="5"}': _pts(
+                    [0.1, 2.5, 0.2]
+                ),
+                'fleet:replication_lag_seconds{rank="0"}': _pts(
+                    [0.1, 0.1, 0.1]
+                ),
+            },
+            "interval_s": 1.0,
+            "last_t": 1002.0,
+            "last_seq": 2,
+        }
+        report = postmortem_report(dump)
+        (f,) = report["findings"]
+        assert f["rule"] == "replication_lag"
+        assert f["evidence"]["ranks"] == {"5": 2.5}
+
+    def test_burn_rate_peak_pages_even_after_recovery(self):
+        # One hour of sustained 20% shed recorded... then the dump ends
+        # on a clean stretch. The live rule would see the tail; the
+        # post-mortem names the in-window PEAK.
+        adm, shed = [], []
+        a = s = 0
+        for i in range(720):
+            a += 8
+            s += 2
+            adm.append(a)
+            shed.append(s)
+        for i in range(120):
+            a += 10
+            adm.append(a)
+            shed.append(s)
+        dump = {
+            "series": {
+                'slo:admitted{tenant="bulk"}': _pts(adm, dt=5.0),
+                'slo:shed{tenant="bulk"}': _pts(shed, dt=5.0),
+            },
+            "interval_s": 5.0,
+            "last_t": 1000.0 + 839 * 5.0,
+            "last_seq": 839,
+        }
+        report = postmortem_report(dump)
+        (f,) = report["findings"]
+        assert f["rule"] == "slo_burn_rate"
+        assert f["evidence"]["tenant"] == "bulk"
+        assert f["evidence"]["burn_fast"] >= DoctorConfig().burn_fast_threshold
+
+    def test_healthy_dump_zero_findings_all_rules_checked(self):
+        dump = {
+            "series": {
+                'fleet:health_score{rank="0"}': _pts([1.0, 1.0]),
+                "shard:skew_ratio": _pts([1.0, 1.1]),
+                'slo:admitted{tenant="t"}': _pts([10, 20]),
+                'slo:shed{tenant="t"}': _pts([0, 0]),
+            },
+            "interval_s": 1.0,
+            "last_t": 1001.0,
+            "last_seq": 1,
+        }
+        report = postmortem_report(dump)
+        assert report["findings"] == []
+        assert report["healthy"] is True
+        assert list(report["rules_checked"]) == list(POSTMORTEM_RULES)
+
+    def test_findings_carry_pinned_evidence(self):
+        for rule in POSTMORTEM_RULES:
+            assert rule in POSTMORTEM_EVIDENCE_FIELDS
+        dump = {
+            "series": {
+                'fleet:health_score{rank="3"}': _pts([1.0, 0.2]),
+                'fleet:health_age_seconds{rank="3"}': _pts([0.1, 0.9]),
+            },
+            "interval_s": 1.0,
+            "last_t": 1001.0,
+            "last_seq": 1,
+        }
+        (f,) = postmortem_report(dump)["findings"]
+        for k in POSTMORTEM_EVIDENCE_FIELDS["node_crash"]:
+            assert k in f["evidence"]

@@ -752,3 +752,253 @@ class TestStitchingSurfaces:
                      "trace_id": bad},
                 )
             assert e.value.code == 400
+
+
+class TestTimeseriesAndBlackboxEndpoints:
+    """PR 13 surfaces: GET /debug/timeseries (cursor pagination, both
+    frontends) + POST /admin/blackbox, hammered while the sampler
+    writes and while a drain-triggered black-box flush runs against
+    the lifecycle plane lock (the satellite-3 concurrency contract)."""
+
+    def test_timeseries_serves_rings_and_paginates(self, frontend):
+        status, body = _get(
+            f"http://127.0.0.1:{frontend.port}/debug/timeseries"
+            "?family=radixmesh_history&limit=50"
+        )
+        assert status == 200
+        page = json.loads(body)
+        assert page["interval_s"] == 1.0
+        # The self-accounting series exist from the first sample on.
+        deadline = 50
+        while not page["series"] and deadline:
+            deadline -= 1
+            import time as _t
+
+            _t.sleep(0.1)
+            page = json.loads(_get(
+                f"http://127.0.0.1:{frontend.port}/debug/timeseries"
+                "?family=radixmesh_history&limit=50"
+            )[1])
+        assert any(
+            n.startswith("radixmesh_history_samples_total")
+            for n in page["series"]
+        )
+        # Cursor round-trip: the next page starts past this one.
+        status, body2 = _get(
+            f"http://127.0.0.1:{frontend.port}/debug/timeseries"
+            f"?since={page['next_since']}"
+        )
+        page2 = json.loads(body2)
+        assert page2["since"] == page["next_since"]
+
+    def test_timeseries_rejects_bad_cursor(self, frontend):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(
+                f"http://127.0.0.1:{frontend.port}/debug/timeseries"
+                "?since=banana"
+            )
+        assert ei.value.code == 400
+
+    def test_disabled_history_404s(self):
+        cfg = ModelConfig.tiny()
+        eng = Engine(
+            cfg, init_params(cfg, jax.random.PRNGKey(0)),
+            num_slots=64, page_size=4, max_batch=1, name="nohist",
+        )
+        f = ServingFrontend(eng, port=0, history_interval_s=0.0)
+        try:
+            assert f.history is None
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://127.0.0.1:{f.port}/debug/timeseries")
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{f.port}/admin/blackbox", {})
+            assert ei.value.code == 404
+        finally:
+            f.close()
+
+    def test_admin_blackbox_flushes_a_final(self, tmp_path):
+        cfg = ModelConfig.tiny()
+        eng = Engine(
+            cfg, init_params(cfg, jax.random.PRNGKey(0)),
+            num_slots=64, page_size=4, max_batch=1, name="bb-http",
+        )
+        f = ServingFrontend(
+            eng, port=0, history_interval_s=0.05,
+            blackbox_dir=str(tmp_path),
+        )
+        try:
+            status, res = _post(
+                f"http://127.0.0.1:{f.port}/admin/blackbox", {}
+            )
+            assert status == 200
+            assert res["flushed"] is True
+            assert res["cause"] == "admin"
+            import os
+
+            assert os.path.isfile(res["path"])
+            with open(res["path"]) as fh:
+                final = json.load(fh)
+            # The final carries the /debug/state snapshot and the live
+            # doctor verdict alongside the history.
+            assert final["state"]["engine"]["name"] == "bb-http"
+            assert "findings" in final["doctor"]
+        finally:
+            f.close()
+
+    def test_timeseries_hammered_under_sampler_and_drain_flush(
+        self, tmp_path
+    ):
+        """The satellite-3 race: /debug/timeseries paginating from many
+        threads WHILE the 20ms sampler writes the rings, WHILE requests
+        generate, and WHILE a lifecycle drain (holding the plane lock)
+        runs its black-box flush — no deadlock, no malformed page."""
+        import concurrent.futures as cf
+
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.comm.inproc import InprocHub
+        from radixmesh_tpu.config import MeshConfig
+        from radixmesh_tpu.policy.lifecycle import (
+            LifecycleConfig,
+            LifecyclePlane,
+            LifecycleState,
+        )
+
+        InprocHub.reset_default()
+        cfg = ModelConfig.tiny()
+        eng = Engine(
+            cfg, init_params(cfg, jax.random.PRNGKey(0)),
+            num_slots=512, page_size=4, max_batch=2, name="bb-drain",
+        )
+        mesh_nodes = []
+        f = None
+        lc = None
+        try:
+            for addr in ("hp0", "hd0"):
+                mcfg = MeshConfig(
+                    prefill_nodes=["hp0"],
+                    decode_nodes=["hd0"],
+                    router_nodes=[],
+                    local_addr=addr,
+                    protocol="inproc",
+                    tick_interval_s=0.1,
+                    failure_timeout_s=60.0,
+                )
+                mesh_nodes.append(MeshCache(mcfg, pool=None).start())
+            for n in mesh_nodes:
+                assert n.wait_ready(timeout=30)
+            f = ServingFrontend(
+                eng, port=0, history_interval_s=0.02,
+                blackbox_dir=str(tmp_path),
+            )
+            lc = LifecyclePlane(
+                mesh_nodes[0],
+                runner=f.runner,
+                blackbox=f.blackbox,
+                cfg=LifecycleConfig(drain_timeout_s=10.0),
+            )
+            f.lifecycle = lc
+
+            def gen(i):
+                try:
+                    return _post(
+                        f"http://127.0.0.1:{f.port}/generate",
+                        {"input_ids": list(range(i, i + 8)),
+                         "max_tokens": 2},
+                        timeout=60,
+                    )[0]
+                except urllib.error.HTTPError as e:
+                    return e.code  # drain shed mid-storm is legal
+
+            def ts(i):
+                since = -1
+                for _ in range(4):
+                    status, body = _get(
+                        f"http://127.0.0.1:{f.port}/debug/timeseries"
+                        f"?since={since}&limit=200"
+                    )
+                    page = json.loads(body)  # well-formed under races
+                    since = page["next_since"]
+                return status
+
+            def drain():
+                return lc.drain(deadline_s=10.0)
+
+            with cf.ThreadPoolExecutor(10) as ex:
+                gens = [ex.submit(gen, 100 + 16 * i) for i in range(3)]
+                pages = [ex.submit(ts, i) for i in range(6)]
+                dr = ex.submit(drain)
+                stats = dr.result(timeout=60)
+                assert stats["blackbox"] is not None
+                assert all(p.result(timeout=60) == 200 for p in pages)
+                assert all(
+                    g.result(timeout=120) in (200, 503) for g in gens
+                )
+            assert lc.state is LifecycleState.LEFT
+            # The drain's flush landed as a complete final artifact.
+            from radixmesh_tpu.obs.blackbox import load_blackbox
+
+            dump = load_blackbox(str(tmp_path))
+            assert "drain" in dump["causes"]
+            assert dump["unclean"] is False
+        finally:
+            if lc is not None:
+                lc.close()
+            if f is not None:
+                f.close()
+            for n in mesh_nodes:
+                n.close()
+            InprocHub.reset_default()
+
+    def test_router_frontend_serves_timeseries(self):
+        import bench  # noqa: F401 — repo-root import convention
+        from radixmesh_tpu.cache.mesh_cache import MeshCache
+        from radixmesh_tpu.comm.inproc import InprocHub
+        from radixmesh_tpu.config import MeshConfig
+        from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+        InprocHub.reset_default()
+        prefill, decode, router = ["tp0"], ["td0"], ["tr0"]
+        nodes = []
+        rf = None
+        try:
+            for addr in prefill + decode + router:
+                cfg = MeshConfig(
+                    prefill_nodes=prefill,
+                    decode_nodes=decode,
+                    router_nodes=router,
+                    local_addr=addr,
+                    protocol="inproc",
+                    tick_interval_s=0.1,
+                    failure_timeout_s=60.0,
+                )
+                nodes.append(MeshCache(cfg, pool=None).start())
+            for n in nodes:
+                assert n.wait_ready(timeout=30)
+            r = CacheAwareRouter(nodes[-1], nodes[-1].cfg)
+            rf = RouterFrontend(r, port=0, history_interval_s=0.02)
+            deadline = 100
+            page = {}
+            while deadline:
+                deadline -= 1
+                status, body = _get(
+                    f"http://127.0.0.1:{rf.port}/debug/timeseries"
+                )
+                page = json.loads(body)
+                if page["series"]:
+                    break
+                import time as _t
+
+                _t.sleep(0.05)
+            assert status == 200
+            assert any(
+                n.startswith("radixmesh_") for n in page["series"]
+            )
+        finally:
+            if rf is not None:
+                rf.close()
+            for n in nodes:
+                n.close()
+            InprocHub.reset_default()

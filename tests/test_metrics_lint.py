@@ -84,6 +84,15 @@ def _register_all_instrumented_families() -> None:
 
     PhaseAttributor()
     dropped_spans_counter()
+    # The history axis (PR 13): the telemetry sampler's self-accounting
+    # families and the black box's flush/segment/bytes counters.
+    import tempfile
+
+    from radixmesh_tpu.obs.blackbox import BlackBox
+    from radixmesh_tpu.obs.timeseries import TelemetryHistory
+
+    with tempfile.TemporaryDirectory() as bb_dir:
+        BlackBox(bb_dir, history=TelemetryHistory(), node="lint-bb")
 
 
 def _registered_families() -> dict[str, str]:
@@ -489,3 +498,26 @@ class TestMetricHygiene:
             key = f'radixmesh_request_phase_seconds{{phase="{phase}"}}_count'
             assert key in snap, (key, sorted(
                 k for k in snap if "phase_seconds" in k))
+
+
+    def test_history_and_blackbox_families_registered(self):
+        """Satellite (PR 13): the telemetry-history sampler's
+        self-accounting (its own cost must be visible in the scrape it
+        samples) and the black box's flush/segment/byte counters are
+        first-class families from construction."""
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        assert fams.get("radixmesh_history_samples_total") == "counter"
+        assert fams.get("radixmesh_history_sample_seconds") == "histogram"
+        assert fams.get("radixmesh_history_series") == "gauge"
+        assert fams.get("radixmesh_history_points") == "gauge"
+        assert (
+            fams.get("radixmesh_history_dropped_series_total") == "counter"
+        )
+        assert fams.get("radixmesh_blackbox_flushes_total") == "counter"
+        assert fams.get("radixmesh_blackbox_segments_total") == "counter"
+        assert fams.get("radixmesh_blackbox_bytes_total") == "counter"
+        assert fams.get("radixmesh_blackbox_flush_seconds") == "histogram"
+        # The new gauge suffixes are conscious vocabulary additions.
+        assert "_series" in GAUGE_SUFFIXES
+        assert "_points" in GAUGE_SUFFIXES

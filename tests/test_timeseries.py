@@ -1,0 +1,299 @@
+"""Telemetry history (obs/timeseries.py): change-compressed bounded
+rings over every registered family + the derived planes, cursor
+pagination on a sample boundary, self-accounting, seam isolation, and
+the burn-tracker feed — all on a virtual clock (no sleeps except the
+one thread smoke test)."""
+
+import threading
+
+import pytest
+
+from radixmesh_tpu.obs.metrics import Registry, get_registry, set_registry
+from radixmesh_tpu.obs.timeseries import TelemetryHistory
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    old = set_registry(Registry())
+    yield
+    set_registry(old)
+
+
+class FakeFleet:
+    def __init__(self):
+        self.scores = {0: 1.0, 1: 1.0}
+        self.heat = {"7": 50.0, "9": 5.0}
+
+    def health(self):
+        return {
+            r: {"score": s, "age_s": 0.1, "reasons": [], "role": "prefill",
+                "lifecycle": "active"}
+            for r, s in self.scores.items()
+        }
+
+    def digests(self):
+        class D:
+            replication_lag_s = 0.05
+
+        return {r: D() for r in self.scores}
+
+    def shard_heat(self):
+        mean = sum(self.heat.values()) / len(self.heat)
+        return {
+            "shards": dict(self.heat),
+            "skew_score": max(self.heat.values()) / mean,
+            "reporters": 2,
+        }
+
+
+class FakeMesh:
+    sharded = True
+
+    def __init__(self):
+        self.fleet = FakeFleet()
+
+
+class FakeAcct:
+    def report(self):
+        return {
+            "prefill": {"mfu": 0.1, "pad_fraction": 0.2, "waves": 3},
+            "decode": {"mfu": 0.05, "pad_fraction": 0.0, "waves": 9},
+        }
+
+
+class FakeEngine:
+    step_acct = FakeAcct()
+
+
+class FakeSLO:
+    def __init__(self):
+        self.counts = {"t0": {"admitted": 0, "shed": 0}}
+
+    def burn_counts(self):
+        return {t: dict(c) for t, c in self.counts.items()}
+
+
+def _hist(**kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("capacity", 16)
+    return TelemetryHistory(**kw)
+
+
+class TestRings:
+    def test_change_compression_flat_series_is_one_point(self):
+        g = get_registry().gauge("radixmesh_test_flag", "t")
+        g.set(1.0)
+        h = _hist()
+        for t in range(8):
+            h.sample(t=float(t))
+        pts = h.query(family="radixmesh_test_flag")["series"][
+            "radixmesh_test_flag"
+        ]["points"]
+        assert len(pts) == 1  # never changed after the first sample
+        g.set(2.0)
+        h.sample(t=8.0)
+        pts = h.query(family="radixmesh_test_flag")["series"][
+            "radixmesh_test_flag"
+        ]["points"]
+        assert [p[2] for p in pts] == [1.0, 2.0]
+
+    def test_capacity_bounds_points(self):
+        c = get_registry().counter("radixmesh_test_total", "t")
+        h = _hist(capacity=8)
+        for t in range(50):
+            c.inc()
+            h.sample(t=float(t))
+        pts = h.query(family="radixmesh_test_total")["series"][
+            "radixmesh_test_total"
+        ]["points"]
+        assert len(pts) == 8  # ring bound
+        assert pts[-1][0] == 49  # ...holding the newest samples
+
+    def test_vanished_series_pruned_after_a_window(self):
+        h = _hist(capacity=4, mesh=FakeMesh())
+        mesh = h.mesh
+        h.sample(t=0.0)
+        assert any(
+            n.startswith("shard:heat") for n in h.query()["series"]
+        )
+        mesh.fleet.heat = {}  # the shard map empties
+        mesh.fleet.scores = {}
+        for t in range(1, 10):
+            h.sample(t=float(t))
+        names = set(h.query()["series"])
+        assert not any(n.startswith("shard:heat") for n in names)
+
+    def test_max_series_cap_drops_and_counts(self):
+        h = _hist(max_series=3)
+        h.sample(t=0.0)  # the self-accounting families already exceed 3
+        assert h.stats()["series"] == 3
+        assert h.stats()["dropped_series"] > 0
+
+    def test_dropped_series_counts_series_not_sample_writes(self):
+        # The counter means "series dropped", so the SAME refused names
+        # must not inflate it on every subsequent tick (the refused
+        # ledger only resets with the once-per-window prune sweep).
+        h = _hist(max_series=3, capacity=64)
+        h.sample(t=0.0)
+        first = h.stats()["dropped_series"]
+        for t in range(1, 20):
+            h.sample(t=float(t))
+        assert h.stats()["dropped_series"] == first
+
+
+class TestDerivedSeams:
+    def test_fleet_heat_step_slo_series(self):
+        slo = FakeSLO()
+        slo.counts = {"t0": {"admitted": 10, "shed": 2}}
+        h = _hist(mesh=FakeMesh(), engine=FakeEngine(), slo=slo)
+        h.sample(t=0.0)
+        s = h.query()["series"]
+        assert s['fleet:health_score{rank="0"}']["points"][0][2] == 1.0
+        assert s["fleet:alive_nodes"]["points"][0][2] == 2.0
+        assert s['shard:heat{shard="7"}']["points"][0][2] == 50.0
+        assert s["shard:skew_ratio"]["points"][0][2] == pytest.approx(
+            50.0 / 27.5
+        )
+        assert s['step:mfu{kind="prefill"}']["points"][0][2] == 0.1
+        assert s['slo:admitted{tenant="t0"}']["points"][0][2] == 10.0
+        assert s['slo:shed{tenant="t0"}']["points"][0][2] == 2.0
+
+    def test_broken_seam_loses_its_series_not_the_sample(self):
+        class BrokenMesh:
+            sharded = True
+
+            @property
+            def fleet(self):
+                raise RuntimeError("boom")
+
+        c = get_registry().counter("radixmesh_test_total", "t")
+        c.inc()
+        h = _hist(mesh=BrokenMesh())
+        seq = h.sample(t=0.0)
+        assert seq == 0
+        assert "radixmesh_test_total" in h.query()["series"]
+
+    def test_burn_tracker_fed_per_sample(self):
+        slo = FakeSLO()
+        h = _hist(slo=slo)
+
+        class Sink:
+            def __init__(self):
+                self.calls = []
+
+            def sample(self, counts, t=None):
+                self.calls.append((dict(counts), t))
+
+        sink = Sink()
+        h.bind_burn_tracker(sink)
+        h.bind_burn_tracker(sink)  # idempotent
+        slo.counts = {"t0": {"admitted": 5, "shed": 1}}
+        h.sample(t=42.0)
+        assert sink.calls == [({"t0": {"admitted": 5, "shed": 1}}, 42.0)]
+
+
+class TestQueryPagination:
+    def _filled(self, samples=10):
+        c = get_registry().counter("radixmesh_test_total", "t")
+        g = get_registry().gauge("radixmesh_test_flag", "t")
+        h = _hist(capacity=64)
+        for t in range(samples):
+            c.inc()
+            g.set(float(t % 2))
+            h.sample(t=float(t))
+        return h
+
+    def test_since_cursor_returns_only_newer_points(self):
+        h = self._filled()
+        full = h.query(family="radixmesh_test_total")
+        pts = full["series"]["radixmesh_test_total"]["points"]
+        mid = pts[4][0]
+        page = h.query(family="radixmesh_test_total", since=mid)
+        assert all(
+            p[0] > mid
+            for p in page["series"]["radixmesh_test_total"]["points"]
+        )
+
+    def test_limit_cuts_on_a_sample_boundary(self):
+        h = self._filled()
+        page = h.query(since=-1, limit=5)
+        cutoff = page["next_since"]
+        # Every series' page ends at or before the cutoff seq, and no
+        # sample is split across the boundary.
+        for body in page["series"].values():
+            assert all(p[0] <= cutoff for p in body["points"])
+        assert page["has_more"] is True
+
+    def test_pagination_loop_terminates_and_covers_everything(self):
+        h = self._filled()
+        all_pts = {
+            name: [tuple(p) for p in body["points"]]
+            for name, body in h.query(limit=1 << 62)["series"].items()
+        }
+        got: dict[str, list] = {name: [] for name in all_pts}
+        since, pages = -1, 0
+        while True:
+            page = h.query(since=since, limit=7)
+            for name, body in page["series"].items():
+                got.setdefault(name, []).extend(
+                    tuple(p) for p in body["points"]
+                )
+            pages += 1
+            assert pages < 100
+            if not page["has_more"]:
+                break
+            assert page["next_since"] > since
+            since = page["next_since"]
+        for name, pts in all_pts.items():
+            assert got[name] == pts
+
+    def test_unchanged_series_carries_last_value(self):
+        h = self._filled()
+        seq = h.query()["seq"]
+        page = h.query(family="radixmesh_test_total", since=seq)
+        body = page["series"]["radixmesh_test_total"]
+        assert body["points"] == []
+        assert body["last"][1] == 10.0  # current value, cursor-free
+
+
+class TestSelfAccounting:
+    def test_history_families_registered_and_emitted(self):
+        h = _hist()
+        h.sample(t=0.0)
+        snap = get_registry().snapshot()
+        assert snap["radixmesh_history_samples_total"] == 1.0
+        assert snap["radixmesh_history_sample_seconds_count"] == 1.0
+        assert snap["radixmesh_history_series"] > 0
+        assert snap["radixmesh_history_points"] > 0
+        assert "radixmesh_history_dropped_series_total" in snap
+        assert h.stats()["sample_seconds_total"] > 0.0
+
+    def test_sampler_cost_visible_in_its_own_rings(self):
+        h = _hist()
+        h.sample(t=0.0)
+        h.sample(t=1.0)
+        assert (
+            "radixmesh_history_samples_total" in h.query()["series"]
+        )
+
+
+class TestThread:
+    def test_start_close_samples(self):
+        h = TelemetryHistory(interval_s=0.01, capacity=32)
+        h.start()
+        try:
+            deadline = threading.Event()
+            for _ in range(200):
+                if h.stats()["seq"] >= 2:
+                    break
+                deadline.wait(0.01)
+            assert h.stats()["seq"] >= 2
+        finally:
+            h.close()
+        assert h.last_sample_age_s() < 60.0
+
+    def test_zero_interval_refuses_start(self):
+        with pytest.raises(ValueError):
+            TelemetryHistory(interval_s=0.0).start()
