@@ -399,7 +399,7 @@ def _fleet_pass() -> dict:
 # v1/v2 artifacts — which predate the newer sections — stay valid.
 # ----------------------------------------------------------------------
 
-CHAOS_SCHEMA_VERSION = 3
+CHAOS_SCHEMA_VERSION = 4
 
 CHAOS_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -448,6 +448,106 @@ CHAOS_CRASH_FIELDS = (
 )
 # The structural acceptance floor the resurrection claim rides on.
 CHAOS_CRASH_MIN_HIT_RATIO = 0.8
+
+# v4 robustness-loop sections (PR 14): heat-driven rebalancing under a
+# zipf storm, and a router kill at an N>=2 multi-router front door.
+# Required when performed=True; {"performed": false} is schema-valid
+# and gate-exempt, the v2/v3 convention.
+CHAOS_REBALANCE_FIELDS = (
+    "performed", "skew_before", "skew_after", "skew_dropped", "moves",
+    "max_moves_per_round", "moves_bounded", "boosted_shards", "hot_shard",
+    "attempted_mid_move", "ok_mid_move", "failed_mid_move",
+    "overrides_version", "overrides_converged", "handoff_entries",
+    "rebalance_s",
+)
+CHAOS_ROUTER_KILL_FIELDS = (
+    "performed", "routers", "killed", "survivor", "streams",
+    "inflight_at_kill", "completed", "failed", "failovers",
+    "survivor_served", "router_kill_s",
+)
+
+
+def _rebalance_section_problems(sec: dict) -> list[str]:
+    """Gates for a performed rebalance-under-storm section (shared by
+    validate_chaos and validate_rebalance): the skew score STRICTLY
+    dropped, zero requests failed mid-move, movement happened and
+    stayed bounded, and every node converged on the decider's override
+    version."""
+    problems = [
+        f"rebalance.{f}" for f in CHAOS_REBALANCE_FIELDS if f not in sec
+    ]
+    before, after = sec.get("skew_before"), sec.get("skew_after")
+    if (
+        not isinstance(before, (int, float))
+        or not isinstance(after, (int, float))
+        or not (after < before)
+    ):
+        problems.append(
+            f"rebalance: the zipf storm's skew score did not strictly "
+            f"drop under rebalancing ({before} -> {after})"
+        )
+    if sec.get("failed_mid_move", 1) != 0:
+        problems.append(
+            f"rebalance: {sec.get('failed_mid_move')} request(s) failed "
+            "mid-move — an ownership move must be invisible to traffic"
+        )
+    if not sec.get("moves", 0):
+        problems.append(
+            "rebalance: zero adopted moves (the storm never triggered "
+            "the rebalancer — the drop proves nothing)"
+        )
+    if sec.get("moves_bounded") is not True:
+        problems.append(
+            f"rebalance: {sec.get('moves')} moves exceeded the per-round "
+            f"bound of {sec.get('max_moves_per_round')}"
+        )
+    if sec.get("overrides_converged") is not True:
+        problems.append(
+            "rebalance: the fleet never converged on the decider's "
+            "override version (split-brain owner sets)"
+        )
+    return problems
+
+
+def _router_kill_section_problems(sec: dict) -> list[str]:
+    """Gates for a performed router-kill section: N >= 2 routers, the
+    kill landed mid-traffic, every in-flight request completed through
+    the surviving router's edge, and the front door actually failed
+    over (a kill nobody noticed proves nothing)."""
+    problems = [
+        f"router_kill.{f}" for f in CHAOS_ROUTER_KILL_FIELDS if f not in sec
+    ]
+    if int(sec.get("routers", 0) or 0) < 2:
+        problems.append(
+            f"router_kill: only {sec.get('routers')} router(s) — the "
+            "multi-router front door needs N >= 2 to prove failover"
+        )
+    if sec.get("failed", 1) != 0:
+        problems.append(
+            f"router_kill: {sec.get('failed')} request(s) LOST to the "
+            "router kill — the front door exists to make this zero"
+        )
+    if sec.get("completed") != sec.get("streams"):
+        problems.append(
+            "router_kill: in-flight requests did not all complete "
+            f"({sec.get('completed')}/{sec.get('streams')})"
+        )
+    if not sec.get("inflight_at_kill", 0):
+        problems.append(
+            "router_kill: the kill interrupted zero in-flight streams "
+            "(the failover path went unexercised)"
+        )
+    if not sec.get("failovers", 0):
+        problems.append(
+            "router_kill: the front door never failed over (was the "
+            "victim really killed mid-traffic?)"
+        )
+    if sec.get("survivor_served") is not True:
+        problems.append(
+            "router_kill: the surviving router's edge served no "
+            "post-kill routes"
+        )
+    return problems
 
 
 def validate_chaos(report) -> list[str]:
@@ -623,6 +723,19 @@ def validate_chaos(report) -> list[str]:
                     "crash: the hedge loser was not cancelled (its "
                     "pages would leak)"
                 )
+    # v4 robustness-loop sections + gates (v1-v3 artifacts predate them
+    # and stay valid without).
+    v4 = int(report.get("schema_version", 0) or 0) >= 4
+    reb = report.get("rebalance")
+    if v4 and not isinstance(reb, dict):
+        problems.append("rebalance section missing (schema v4)")
+    if isinstance(reb, dict) and reb.get("performed"):
+        problems += _rebalance_section_problems(reb)
+    rk = report.get("router_kill")
+    if v4 and not isinstance(rk, dict):
+        problems.append("router_kill section missing (schema v4)")
+    if isinstance(rk, dict) and rk.get("performed"):
+        problems += _router_kill_section_problems(rk)
     return problems
 
 
@@ -1206,7 +1319,10 @@ def build_analysis_report(
 # the version ONLY when adding fields (never remove or rename).
 # ----------------------------------------------------------------------
 
-DOCTOR_SCHEMA_VERSION = 1
+# v2 (PR 14): the healthy-phase rules_checked gate grew the
+# rebalancer_asleep rule — v1 artifacts validate against the pinned
+# DOCTOR_RULES_V1 six (see _required_doctor_rules).
+DOCTOR_SCHEMA_VERSION = 2
 
 DOCTOR_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -1235,6 +1351,23 @@ DOCTOR_BENCHDIFF_FIELDS = (
 # decomposition is exact by construction (each elementary segment lands
 # in exactly one phase), so only float addition error is tolerated.
 DOCTOR_SUM_EPSILON_S = 1e-6
+
+
+# Doctor rules that existed when the v1 DOCTOR/BLACKBOX artifacts were
+# pinned. Rules added later (rebalancer_asleep, PR 14) are required of
+# artifacts emitted at HIGHER schema versions only — a checked-in v1
+# artifact's healthy phase can never retroactively have run a rule that
+# postdates it.
+DOCTOR_RULES_V1 = (
+    "hot_shard", "prefill_convoy", "restore_park_stall",
+    "replication_lag", "slo_burn_rate", "spec_efficiency",
+)
+
+
+def _required_doctor_rules(report, live_rules) -> list[str]:
+    if int(report.get("schema_version", 0) or 0) <= 1:
+        return [r for r in live_rules if r in DOCTOR_RULES_V1]
+    return list(live_rules)
 
 
 def validate_doctor(report) -> list[str]:
@@ -1266,7 +1399,10 @@ def validate_doctor(report) -> list[str]:
                 "that cries wolf gets muted"
             )
         checked = healthy.get("rules_checked") or []
-        missing_rules = [r for r in RULES if r not in checked]
+        missing_rules = [
+            r for r in _required_doctor_rules(report, RULES)
+            if r not in checked
+        ]
         if missing_rules:
             problems.append(
                 f"healthy: rules {missing_rules} never ran — 'no findings' "
@@ -1400,7 +1536,10 @@ def build_doctor_report(res: dict) -> dict:
 # run. scripts/blackboxbench.py is the paired emitter.
 # ----------------------------------------------------------------------
 
-BLACKBOX_SCHEMA_VERSION = 1
+# v2 (PR 14): the healthy-phase rules_checked gate grew the
+# rebalancer_asleep rule — v1 artifacts validate against the pinned
+# DOCTOR_RULES_V1 six (see _required_doctor_rules).
+BLACKBOX_SCHEMA_VERSION = 2
 
 BLACKBOX_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -1450,7 +1589,8 @@ def validate_blackbox(report) -> list[str]:
                 f"healthy phase ({healthy.get('findings')})"
             )
         missing_rules = [
-            r for r in RULES if r not in (healthy.get("rules_checked") or [])
+            r for r in _required_doctor_rules(report, RULES)
+            if r not in (healthy.get("rules_checked") or [])
         ]
         if missing_rules:
             problems.append(
@@ -1558,6 +1698,113 @@ def build_blackbox_report(res: dict) -> dict:
 
 
 # ----------------------------------------------------------------------
+# REBALANCE stable schema (PR 14, the closed robustness loop): one
+# artifact per round recording (a) the heat-driven rebalancer dropping
+# a zipf storm's skew score with zero failed requests mid-move (elastic
+# RF boost + zero-loss ownership handoff), (b) a router kill at an
+# N>=2 multi-router front door completing every in-flight request
+# through the surviving router's edge, and (c) meshcheck reporting the
+# new rebalance plane clean. scripts/rebalancebench.py is the paired
+# emitter; the sections share their gate logic with CHAOS v4.
+# ----------------------------------------------------------------------
+
+REBALANCE_SCHEMA_VERSION = 1
+
+REBALANCE_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload", "nodes",
+    "topology", "replication_factor", "rebalance", "router_kill",
+    "meshcheck", "wall_s",
+)
+REBALANCE_MESHCHECK_FIELDS = ("files", "findings", "clean")
+
+
+def validate_rebalance(report) -> list[str]:
+    """Schema violations of a REBALANCE artifact vs the pinned contract
+    (empty = valid). Gates: the zipf storm's skew score strictly drops
+    under rebalancing with zero failed requests mid-move and bounded,
+    fleet-converged movement; a router kill at N >= 2 routers
+    mid-traffic completes every in-flight request via the surviving
+    router's edge with zero losses; and meshcheck reports 0 findings on
+    the rebalance plane. performed=False sections are schema-valid but
+    gate-exempt (the CHAOS convention). Import-safe from artifact tests
+    and ``scripts/rebalancebench.py`` (no jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in REBALANCE_TOP_FIELDS if f not in report]
+    reb = report.get("rebalance")
+    if "rebalance" in report and not isinstance(reb, dict):
+        # A present-but-garbage section must not silently skip every
+        # gate (the validate_chaos v4 discipline).
+        problems.append("rebalance section is not an object")
+    if isinstance(reb, dict) and reb.get("performed"):
+        problems += _rebalance_section_problems(reb)
+    rk = report.get("router_kill")
+    if "router_kill" in report and not isinstance(rk, dict):
+        problems.append("router_kill section is not an object")
+    if isinstance(rk, dict) and rk.get("performed"):
+        problems += _router_kill_section_problems(rk)
+    mc = report.get("meshcheck")
+    if "meshcheck" in report and not isinstance(mc, dict):
+        problems.append("meshcheck section is not an object")
+    if isinstance(mc, dict):
+        problems += [
+            f"meshcheck.{f}" for f in REBALANCE_MESHCHECK_FIELDS
+            if f not in mc
+        ]
+        if mc.get("clean") is not True or mc.get("findings", 1) != 0:
+            problems.append(
+                f"meshcheck: {mc.get('findings')} finding(s) on the "
+                "rebalance plane — the new single-writer plane must be "
+                "statically clean"
+            )
+    val = report.get("value")
+    if isinstance(reb, dict) and reb.get("performed"):
+        if not isinstance(val, (int, float)) or val <= 1.0:
+            problems.append(
+                f"value: skew drop ratio {val} is not > 1 (the storm "
+                "did not get flatter)"
+            )
+    return problems
+
+
+def build_rebalance_report(res: dict, meshcheck: dict | None = None) -> dict:
+    """Assemble a schema-complete REBALANCE artifact from
+    ``workload.run_chaos_workload``'s result (the rebalance +
+    router-kill phases) plus a meshcheck verdict on the plane."""
+    reb = res.get("rebalance", {}) or {}
+    before = float(reb.get("skew_before") or 0.0)
+    after = float(reb.get("skew_after") or 0.0)
+    ratio = round(before / after, 4) if after > 0 else 0.0
+    return {
+        "schema_version": REBALANCE_SCHEMA_VERSION,
+        "metric": "rebalance_skew_drop_ratio",
+        "value": ratio,
+        "unit": (
+            "zipf-storm skew score before / after heat-driven "
+            "rebalancing (elastic RF boost + zero-loss ownership "
+            "handoff), with zero failed requests mid-move and a "
+            "mid-traffic router kill losing nothing at an N>=2 "
+            "multi-router front door"
+        ),
+        "workload": (
+            "zipf heat storm over an rf>0 inproc cluster with a "
+            "RebalancePlane decider on the view master, then a second "
+            "storm wave under the adopted overrides; one of 2 routers "
+            "process-killed mid-traffic with client-side front-door "
+            "failover (see workload.run_chaos_workload rebalance / "
+            "router_kill phases)"
+        ),
+        "nodes": res.get("nodes"),
+        "topology": res.get("topology"),
+        "replication_factor": res.get("replication_factor"),
+        "rebalance": reb,
+        "router_kill": res.get("router_kill", {}),
+        "meshcheck": meshcheck or {"files": [], "findings": -1, "clean": False},
+        "wall_s": res.get("wall_s"),
+    }
+
+
+# ----------------------------------------------------------------------
 # compare_rounds (PR 12, the bench regression sentinel): schema-aware
 # diffing of any two SAME-schema artifacts. Eleven artifact schemas
 # accumulated over eleven rounds with nothing machine-checking the
@@ -1636,6 +1883,12 @@ COMPARE_RULES: dict = {
         ("history.self_overhead.fraction", "lower", 2.0),
         ("history.points", "higher", 0.75),
     ),
+    "REBALANCE": (
+        ("value", "higher", 0.30),  # skew drop ratio
+        ("rebalance.failed_mid_move", "lower", 0.0),  # any rise flags
+        ("router_kill.failed", "lower", 0.0),
+        ("meshcheck.findings", "lower", 0.0),
+    ),
     # Kinds with no pinned directional metrics still get the schema
     # check + informational numeric diff.
     "SLO": (),
@@ -1658,6 +1911,7 @@ _METRIC_KINDS = {
     "unsuppressed_findings": "ANALYSIS",
     "doctor_pathologies_named": "DOCTOR",
     "blackbox_postmortem_named": "BLACKBOX",
+    "rebalance_skew_drop_ratio": "REBALANCE",
     "slo_goodput_vs_offered_load": "SLO",
     "soak_requests": "SOAK",
 }

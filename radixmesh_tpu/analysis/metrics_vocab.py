@@ -58,6 +58,7 @@ GAUGE_SUFFIXES = UNIT_SUFFIXES + (
     "_fraction",  # 0..1 share, e.g. wave padding (obs/step_plane.py)
     "_series",  # telemetry-history ring count (obs/timeseries.py)
     "_points",  # telemetry-history retained points (obs/timeseries.py)
+    "_rf_boost",  # extra owners beyond the base walk (cache/rebalance.py)
 )
 
 _KINDS = ("counter", "gauge", "histogram")

@@ -23,6 +23,10 @@ Invariants:
 - ``single-writer-ownership`` — only ``cache/sharding.py`` constructs
   an ``OwnershipMap`` or pokes ``.owners`` (two nodes deriving
   different owner sets for one shard is delivery-plane split-brain).
+- ``single-writer-overrides`` — only ``cache/rebalance.py`` constructs
+  a ``ShardOverrides`` or pokes ``.moves`` (a second decision-maker
+  forking the override map forks the effective owner sets the whole
+  delivery plane derives from — the same split-brain one layer up).
 - ``single-writer-heat`` — only ``cache/mesh_cache.py`` (and the
   defining ``cache/sharding.py``) constructs ``ShardHeat`` or calls
   ``note_insert/note_hit/note_pull`` (a second counter double-counts
@@ -95,7 +99,7 @@ class SingleWriterChecker:
     )
     invariants = (
         "single-writer-lifecycle", "single-writer-ownership",
-        "single-writer-heat", "send-seam",
+        "single-writer-overrides", "single-writer-heat", "send-seam",
     )
 
     def check(self, index: SourceIndex) -> list[Finding]:
@@ -107,6 +111,8 @@ class SingleWriterChecker:
                 self._lifecycle(mod.rel, mod.tree, findings)
             if mod.rel != "cache/sharding.py":
                 self._ownership(mod.rel, mod.tree, findings)
+            if mod.rel != "cache/rebalance.py":
+                self._overrides(mod.rel, mod.tree, findings)
             if mod.rel not in ("cache/sharding.py", _MESH):
                 self._heat(mod.rel, mod.tree, findings)
             if mod.rel == _MESH:
@@ -236,6 +242,63 @@ class SingleWriterChecker:
                         rel, node.lineno, "single-writer-ownership",
                         "aliases the OwnershipMap constructor outside "
                         "cache/sharding.py",
+                    ))
+
+    # ------------------------------------------------------------------
+    # ownership overrides (cache/rebalance.py)
+    # ------------------------------------------------------------------
+
+    def _overrides(self, rel: str, tree: ast.Module, out: list[Finding]) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "ShardOverrides"
+                ):
+                    out.append(Finding(
+                        rel, node.lineno, "single-writer-overrides",
+                        "constructs a ShardOverrides outside "
+                        "cache/rebalance.py — decisions flow through the "
+                        "rebalance plane; everything else folds whole "
+                        "immutable instances",
+                    ))
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "setattr"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and node.args[1].value == "moves"
+                ):
+                    out.append(Finding(
+                        rel, node.lineno, "single-writer-overrides",
+                        "setattr on an override map's move set outside "
+                        "cache/rebalance.py",
+                    ))
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    base = t
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Attribute) and base.attr == "moves":
+                        out.append(Finding(
+                            rel, node.lineno, "single-writer-overrides",
+                            "mutates an override map's .moves outside "
+                            "cache/rebalance.py (forked owner sets on "
+                            "the delivery plane)",
+                        ))
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "ShardOverrides"
+                ):
+                    out.append(Finding(
+                        rel, node.lineno, "single-writer-overrides",
+                        "aliases the ShardOverrides constructor outside "
+                        "cache/rebalance.py",
                     ))
 
     # ------------------------------------------------------------------

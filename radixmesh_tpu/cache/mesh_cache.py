@@ -90,6 +90,11 @@ from radixmesh_tpu.cache.radix_tree import (
     as_key,
     root_page_hash,
 )
+from radixmesh_tpu.cache.rebalance import (
+    EMPTY_OVERRIDES,
+    decode_overrides,
+    encode_overrides,
+)
 from radixmesh_tpu.cache.sharding import (
     MAX_SUMMARY_ROOTS,
     ShardHeat,
@@ -226,10 +231,23 @@ class MeshCache:
         # Elastic membership (policy/topology.py): every TTL and GC
         # unanimity count derives from the CURRENT view, not static config.
         self.view = TopologyView.initial(cfg)
-        # View-epoch-consistent ownership map (cache/sharding.py is the
+        # Heat-driven rebalancing (cache/rebalance.py is the SINGLE
+        # writer of override maps — this module only folds whole
+        # immutable instances, epoch/version-guarded like views).
+        # EMPTY_OVERRIDES until a decider's first round lands.
+        self.overrides = EMPTY_OVERRIDES
+        # RebalancePlane seam when one is attached (launch.py
+        # --rebalance-interval). READ-ONLY here — the doctor and the
+        # frontends' status blocks consult it; only cache/rebalance.py
+        # makes decisions.
+        self.rebalance = None
+        # View-epoch-consistent ownership maps (cache/sharding.py is the
         # SINGLE writer — this module only swaps whole immutable maps,
-        # re-derived from every adopted view). None when unsharded.
-        self.ownership = (
+        # re-derived from every adopted view). The BASE map is the pure
+        # RF-successor walk (the rebalancer's boost baseline); the
+        # effective map layers the adopted overrides on top. None when
+        # unsharded.
+        self._base_ownership = (
             build_ownership(
                 self.view.alive, self.rf, self.view.epoch,
                 is_prefill=cfg.is_prefill_rank,
@@ -237,6 +255,7 @@ class MeshCache:
             if self.sharded
             else None
         )
+        self.ownership = self._base_ownership
         # Router-side compact replica substitute: per-rank per-shard
         # (fingerprint, root summaries) folded from SHARD_SUMMARY gossip.
         # Maintained on every role (cheap; P/D nodes use the fps for
@@ -252,7 +271,13 @@ class MeshCache:
         # P/D + sharded only — routers measure nothing; they read the
         # gossiped heat map.
         self.heat = (
-            ShardHeat()
+            ShardHeat(
+                **(
+                    {"half_life_s": cfg.heat_half_life_s}
+                    if cfg.heat_half_life_s > 0
+                    else {}
+                )
+            )
             if self.sharded and self.role is not NodeRole.ROUTER
             else None
         )
@@ -994,6 +1019,9 @@ class MeshCache:
             if op.op_type is OplogType.SHARD_PULL:
                 self._handle_shard_pull(op)
                 return
+            if op.op_type is OplogType.REBALANCE:
+                self._handle_rebalance(op, data)
+                return
             if op.op_type is OplogType.TICK:
                 # Counted before the origin-drop so the originator observes
                 # its own tick completing each lap (radix_mesh.py:356-360).
@@ -1262,6 +1290,21 @@ class MeshCache:
             )
             self._adopt_view(new_view)
             self._announce_view(new_view)
+            if self.sharded and len(self.overrides):
+                # A (re)joiner starts from EMPTY overrides: without a
+                # re-announcement its derived owner sets would fork from
+                # the fleet's until the next rebalance round. Duplicate
+                # receives refuse by (epoch, version) — idempotent.
+                self._broadcast(
+                    Oplog(
+                        op_type=OplogType.REBALANCE,
+                        origin_rank=self.rank,
+                        logic_id=self._logic_op.next(),
+                        ttl=self._data_ttl(),
+                        value=encode_overrides(self.overrides),
+                        value_rank=self.rank,
+                    )
+                )
         self._circulate(op, data, control=True)
 
     def _handle_leave(self, op: Oplog, data: bytes) -> None:
@@ -1519,8 +1562,7 @@ class MeshCache:
         the mesh lock: the transport reader thread needs that lock to
         apply oplogs, and a slow first connection must not stall ring
         processing (a racing duplicate dial just closes the loser)."""
-        n_total = self.cfg.num_ring + len(self.cfg.router_nodes)
-        if not 0 <= target_rank < n_total or target_rank == self.rank:
+        if not 0 <= target_rank < self.cfg.num_total or target_rank == self.rank:
             return None
         with self._lock:
             comm = comms.get(target_rank)
@@ -1764,15 +1806,16 @@ class MeshCache:
             view.epoch, view.alive, old.epoch, old.alive,
         )
         if self.sharded:
-            # Re-derive the ownership map from the ADOPTED view (same
+            # Re-derive BOTH ownership maps from the ADOPTED view (same
             # pure derivation on every node — epoch-consistent, zero
             # coordination; cache/sharding.py is the single writer of
-            # owner sets, this is a whole-map swap).
-            self.ownership = build_ownership(
+            # owner sets, these are whole-map swaps). Overrides naming
+            # a departed rank are forgotten inside the helper.
+            self._base_ownership = build_ownership(
                 view.alive, self.rf, view.epoch,
                 is_prefill=self.cfg.is_prefill_rank,
             )
-            self._refresh_owned_shards()
+            self._derive_effective_locked(self.overrides)
             if self._shard_table is not None:
                 # Departed ranks' summaries leave the routing table with
                 # the membership (their advertised warmth is unreachable;
@@ -1921,6 +1964,10 @@ class MeshCache:
 
     _CONTROL_TYPES = (
         OplogType.TICK, OplogType.TOPO, OplogType.JOIN, OplogType.LEAVE,
+        # Ownership moves are membership-grade control: an override
+        # queued behind a replication backlog would split the fleet's
+        # owner sets for the backlog's whole drain time.
+        OplogType.REBALANCE,
     )
 
     def _broadcast(self, op: Oplog) -> None:
@@ -2304,8 +2351,7 @@ class MeshCache:
         if self.role is NodeRole.ROUTER:
             return  # routers hold no indices to push
         target = op.value_rank
-        n_total = self.cfg.num_ring + len(self.cfg.router_nodes)
-        if not 0 <= target < n_total or target == self.rank:
+        if not 0 <= target < self.cfg.num_total or target == self.rank:
             return
         res = self.tree.match_prefix(op.key, split_partial=False)
         node = res.last_node
@@ -2398,6 +2444,10 @@ class MeshCache:
             future = build_ownership(
                 survivors, self.rf, self.view.epoch + 1,
                 is_prefill=self.cfg.is_prefill_rank,
+                # The survivors will keep the adopted overrides minus
+                # entries naming the leaver — hand off to the exact
+                # owner sets they will derive.
+                overrides=self.overrides.without_ranks({self.rank}),
             )
             owned = cur.owned_shards(self.rank)
             by_shard = self.tree.nodes_in_shards(owned)  # ONE tree walk
@@ -2431,6 +2481,170 @@ class MeshCache:
                         entries=int(entries),
                     )
         return stats
+
+    # ------------------------------------------------------------------
+    # heat-driven rebalancing (cache/rebalance.py; replication_factor > 0)
+    # ------------------------------------------------------------------
+
+    def heat_loads(self) -> dict[int, float]:
+        """This node's decayed per-shard loads, snapshotted under the
+        mesh lock (ShardHeat itself is not thread-safe — every counting
+        site runs under this lock, so readers must too). Empty when
+        unsharded / router."""
+        if self.heat is None:
+            return {}
+        with self._lock:
+            return self.heat.loads()
+
+    def base_owners_of(self, sid: int) -> tuple[int, ...]:
+        """The shard's BASE RF-successor walk under the current view —
+        the owner set with no overrides applied (the rebalancer's boost
+        baseline and shrink target). Empty when unsharded."""
+        with self._lock:
+            base = self._base_ownership
+        return base.owners_of(sid) if base is not None else ()
+
+    def adopt_overrides(self, ovr) -> bool:
+        """Adopt a LOCAL rebalance decision (``cache/rebalance.py`` is
+        the only caller that originates one): apply the overrides,
+        re-derive the effective ownership map, hand off entries to
+        ranks that gained ownership, and gossip the decision as a
+        REBALANCE oplog so every node converges on the same map.
+        Returns False when ``ovr`` does not supersede the current
+        overrides (stale epoch or replayed version — rollback refused)."""
+        if not self.sharded:
+            return False
+        with self._lock:
+            if not self._apply_overrides_locked(ovr):
+                return False
+            if self.role is not NodeRole.ROUTER:
+                self._broadcast(
+                    Oplog(
+                        op_type=OplogType.REBALANCE,
+                        origin_rank=self.rank,
+                        logic_id=self._logic_op.next(),
+                        ttl=self._data_ttl(),
+                        value=encode_overrides(self.overrides),
+                        value_rank=self.rank,
+                    )
+                )
+        return True
+
+    def _derive_effective_locked(self, ovr) -> None:
+        """THE one derivation of the effective ownership map (caller
+        holds the lock; both the view-change and override-fold paths
+        come through here so the forget discipline and the
+        empty-override fast path cannot fork): drop entries naming
+        ranks outside the current view — a departed rank's overrides
+        are forgotten (the FleetView.forget discipline; a decider
+        racing a death must not resurrect a ghost owner; (epoch,
+        version) preserved so the filter never reads as a rollback) —
+        then swap the whole map."""
+        dead = [
+            r for r in range(self.cfg.num_ring)
+            if not self.view.contains(r)
+        ]
+        ovr = ovr.without_ranks(dead)
+        self.overrides = ovr
+        self.ownership = (
+            self._base_ownership
+            if not len(ovr)
+            else build_ownership(
+                self.view.alive, self.rf, self.view.epoch,
+                is_prefill=self.cfg.is_prefill_rank,
+                overrides=ovr,
+            )
+        )
+        self._refresh_owned_shards()
+
+    def _apply_overrides_locked(self, ovr) -> bool:
+        """Fold one override map (caller holds the lock): strict
+        (epoch, version) supersession — an epoch rollback or a replayed
+        frame is refused — then the whole-map ownership swap and the
+        zero-loss handoff to gained owners."""
+        if not ovr.supersedes(self.overrides):
+            return False
+        old_map = self.ownership
+        self._derive_effective_locked(ovr)
+        self._handoff_gained_owners(old_map, self.ownership)
+        return True
+
+    def _handoff_gained_owners(self, old, new) -> int:
+        """Zero-loss ownership move (caller holds the lock): for every
+        shard whose owner set GREW, the shard's old PRIMARY owner
+        re-emits its cached entries point-to-point to each gained rank
+        — the drain-handoff machinery (``handoff_owned_shards``) scoped
+        to the moved shards. One pusher per shard (the deterministic
+        primary), so co-owners never multiply the same bytes; a dead
+        primary's gap is healed by owner-scoped anti-entropy repair.
+        In-flight requests on the old owners finish normally — their
+        replicas keep every entry; only responsibility moved."""
+        if (
+            old is None
+            or new is None
+            or self.role is NodeRole.ROUTER
+        ):
+            return 0
+        moved: dict[int, list[int]] = {}
+        for sid in old.owned_shards(self.rank):
+            if old.primary(sid) != self.rank:
+                continue
+            gained = [
+                r for r in new.owners_of(sid)
+                if r not in old.owners_of(sid) and r != self.rank
+            ]
+            if gained:
+                moved[sid] = gained
+        if not moved:
+            return 0
+        rec = get_recorder()
+        pushed = 0
+        by_shard = self.tree.nodes_in_shards(list(moved))  # ONE tree walk
+        for sid, gained in moved.items():
+            t0 = time.monotonic()
+            entries = 0
+            for n in by_shard.get(sid, ()):
+                if n.children:
+                    continue  # a leaf's re-emit covers its ancestors
+                for tgt in gained:
+                    if self._reemit_entry(n, target_rank=tgt):
+                        entries += 1
+            pushed += entries
+            if rec.enabled:
+                rec.event(
+                    f"ring:{self._node_label}",
+                    "shard_transfer",
+                    t0,
+                    time.monotonic() - t0,
+                    cat="ring",
+                    shard=int(sid),
+                    targets=len(gained),
+                    entries=int(entries),
+                    cause="rebalance",
+                )
+        return pushed
+
+    def _handle_rebalance(self, op: Oplog, data: bytes) -> None:
+        """Caller holds the lock; ttl already decremented. Fold-then-
+        forward like TOPO: idempotent ((epoch, version)-guarded whole-map
+        swap), and unsharded nodes still forward so a mixed roll cannot
+        partition the gossip."""
+        if op.origin_rank == self.rank:
+            return  # lap complete
+        if self.sharded:
+            try:
+                ovr = decode_overrides(op.value)
+            except ValueError:
+                if throttled(("bad_rebalance", self.rank),
+                             self.cfg.tick_interval_s):
+                    self.log.warning(
+                        "malformed REBALANCE payload from rank %d",
+                        op.origin_rank,
+                    )
+                self._circulate(op, data, control=True)
+                return
+            self._apply_overrides_locked(ovr)
+        self._circulate(op, data, control=True)
 
     def _sender(self) -> None:
         """Dedicated transmit thread: the only place the control plane

@@ -190,6 +190,16 @@ class OplogType(enum.IntEnum):
     # through the ordinary conflict-resolution path; a lost pull just
     # costs the target a cache miss.
     SHARD_PULL = 16
+    # Heat-driven shard rebalancing (cache/rebalance.py): the decider's
+    # per-shard ownership OVERRIDES, gossiped like a membership view
+    # (value = packed rebalance.encode_overrides). Idempotent and
+    # rollback-refusing: receivers adopt only a strictly newer
+    # (epoch, version) pair, re-derive the effective ownership map
+    # through the same pure derivation as a view change, and forward —
+    # so every node's owner sets move in lockstep with zero
+    # coordination. Droppable like TOPO: the decider re-gossips each
+    # round until the fleet converges.
+    REBALANCE = 17
 
 
 # Kinds added AFTER the unknown-kind pass-through tolerance shipped:
@@ -206,6 +216,7 @@ EXTENSION_KINDS = frozenset(
         OplogType.LEAVE,
         OplogType.SHARD_SUMMARY,
         OplogType.SHARD_PULL,
+        OplogType.REBALANCE,
     }
 )
 # Kinds that carry replicated cache DATA: losing one of these frames

@@ -132,6 +132,7 @@ def build_ownership(
     rf: int,
     epoch: int,
     is_prefill=None,
+    overrides=None,
 ) -> OwnershipMap:
     """Derive the ownership map for one membership view: consistent-hash
     the alive P/D ranks, then take the deterministic RF-successor walk
@@ -145,7 +146,16 @@ def build_ownership(
     joint walk could hand a shard three prefill owners and leave a
     crashed decode node's streams with no owner replica to resurrect
     on. ``None`` (role-blind) walks one joint ring — the cache-only /
-    single-role topologies."""
+    single-role topologies.
+
+    ``overrides`` (heat-driven rebalancing,
+    ``cache/rebalance.py::ShardOverrides``) replaces individual shards'
+    owner tuples AFTER the base walk: an override's ranks are filtered
+    to the alive set (a dead overridden rank must never be delivered
+    to) and deduplicated in order; an override left empty by that
+    filter falls back to the base walk. The result stays a pure
+    function of (alive set, rf, overrides) — every node derives the
+    identical effective map from the same adopted inputs."""
     # Deferred import: the router PACKAGE pulls in cache_aware_router →
     # mesh_cache → this module at import time; by the first map build
     # (MeshCache construction) the cycle has resolved.
@@ -165,14 +175,26 @@ def build_ownership(
         )
         for g in groups
     ]
-    owners = tuple(
-        tuple(
+    moves = getattr(overrides, "moves", None) or {}
+    alive = set(ranks)
+
+    def _owners_of(sid: int) -> tuple[int, ...]:
+        base = tuple(
             int(name.split(":", 1)[1])
             for ring in rings
             for name in ring.get_nodes(f"shard:{sid}", max(1, rf))
         )
-        for sid in range(NUM_SHARDS)
-    )
+        ovr = moves.get(sid)
+        if not ovr:
+            return base
+        seen: set[int] = set()
+        kept = tuple(
+            r for r in ovr
+            if r in alive and not (r in seen or seen.add(r))
+        )
+        return kept or base
+
+    owners = tuple(_owners_of(sid) for sid in range(NUM_SHARDS))
     return OwnershipMap(epoch=epoch, rf=rf, ranks=ranks, owners=owners)
 
 

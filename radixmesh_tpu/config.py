@@ -137,6 +137,18 @@ class MeshConfig:
     # tree AND the ring, so a node death costs a resurrected request at
     # most N tokens of cache hit. 0 = publish only at finish/preempt.
     stream_publish_tokens: int = 0
+    # Heat-driven shard rebalancing (cache/rebalance.py): decision
+    # cadence for the view master's RebalancePlane — per-shard ownership
+    # overrides (elastic RF boost/shrink under a hysteresis band,
+    # bounded moves per round) gossiped like the view. 0 disables the
+    # decider; folding received REBALANCE frames is always on. Requires
+    # replication_factor > 0. launch.py --rebalance-interval overrides.
+    rebalance_interval_s: float = 0.0
+    # Per-shard heat decay half-life (cache/sharding.py::ShardHeat).
+    # 0 = the library default (30 s). Short half-lives make the skew
+    # signal track traffic shifts faster — drills and rebalance benches
+    # use seconds; production keeps the default.
+    heat_half_life_s: float = 0.0
 
     @property
     def effective_startup_grace_s(self) -> float:
@@ -177,6 +189,15 @@ class MeshConfig:
         """Ring members = prefill + decode nodes (routers stay outside,
         reference ``sync_algo.py:57-75``)."""
         return self.num_prefill + self.num_decode
+
+    @property
+    def num_total(self) -> int:
+        """The whole global rank space: ring members plus EVERY router.
+        The one definition every rank-bound check derives from, so the
+        multi-router front door cannot drift out of the ring accounting
+        (two call sites computing ``num_ring + len(router_nodes)`` by
+        hand is how an off-by-one ships)."""
+        return self.num_ring + len(self.router_nodes)
 
     def is_prefill_rank(self, rank: int) -> bool:
         return 0 <= rank < self.num_prefill
@@ -243,10 +264,17 @@ class MeshConfig:
         return self.local_identity()[1]
 
     def validate(self) -> None:
-        if len(self.router_nodes) > 1:
-            # Reference restriction (cache_config.py:47-48); multi-router is
-            # future work in both.
-            raise ValueError("at most one router node is supported")
+        # Multi-router front door: N routers are first-class (the
+        # reference's single-router restriction, cache_config.py:47-48,
+        # is gone — every router rides the master fan-out and the
+        # global rank space already accounts for the whole list). What
+        # remains is REAL validation: distinct addresses (the global
+        # rank space is positional — a duplicate would alias two ranks)
+        # and non-empty entries.
+        if len(set(self.router_nodes)) != len(self.router_nodes):
+            raise ValueError("router_nodes must be distinct addresses")
+        if any(not a for a in self.router_nodes):
+            raise ValueError("router_nodes entries must be non-empty")
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
         if self.topology not in ("ring", "hier"):
@@ -285,6 +313,14 @@ class MeshConfig:
             # pacing entirely — the probe storm the plane's storm-control
             # invariants exist to prevent.
             raise ValueError("repair_backoff_s must be > 0")
+        if self.rebalance_interval_s < 0 or self.heat_half_life_s < 0:
+            raise ValueError("rebalance/heat timers must be >= 0")
+        if self.rebalance_interval_s > 0 and self.replication_factor == 0:
+            # The rebalancer moves OWNERSHIP; a full replica has none.
+            raise ValueError(
+                "rebalance_interval_s > 0 requires replication_factor > 0 "
+                "(ownership overrides are meaningless on a full replica)"
+            )
         if self.model:
             # Serving deployments derive each P/D node's HTTP port as
             # cache port + offset: both must be bindable and disjoint
@@ -312,11 +348,29 @@ class MeshConfig:
         self.local_identity()  # raises on bad membership
 
 
-def load_config(path: str) -> MeshConfig:
+def load_config(
+    path: str,
+    router_nodes: list[str] | None = None,
+    replication_factor: int | None = None,
+    rebalance_interval_s: float | None = None,
+) -> MeshConfig:
     """Load a YAML config file into a validated :class:`MeshConfig`
-    (reference ``load_server_args``, ``cache_config.py:38-76``)."""
+    (reference ``load_server_args``, ``cache_config.py:38-76``).
+
+    The keyword arguments are the CLI overrides (``--router-nodes`` /
+    ``--replication-factor`` / ``--rebalance-interval``), replacing the
+    file's values BEFORE validation — a router added by flag must be
+    able to find its own membership, and the rebalance/replication
+    cross-field check must judge the values the node will actually run
+    with; post-validation patching can give neither."""
     with open(path) as f:
         raw = yaml.safe_load(f) or {}
+    if router_nodes is not None:
+        raw["router_nodes"] = list(router_nodes)
+    if replication_factor is not None:
+        raw["replication_factor"] = int(replication_factor)
+    if rebalance_interval_s is not None:
+        raw["rebalance_interval_s"] = float(rebalance_interval_s)
     known = {
         "prefill_nodes",
         "decode_nodes",
@@ -344,6 +398,8 @@ def load_config(path: str) -> MeshConfig:
         "kv_transfer_chunk_tokens",
         "kv_transfer_min_restore_tokens",
         "stream_publish_tokens",
+        "rebalance_interval_s",
+        "heat_half_life_s",
         "model",
         "mesh_axes",
         "serve_port_offset",
@@ -389,6 +445,8 @@ def load_config(path: str) -> MeshConfig:
             raw.get("kv_transfer_min_restore_tokens", 0)
         ),
         stream_publish_tokens=int(raw.get("stream_publish_tokens", 0)),
+        rebalance_interval_s=float(raw.get("rebalance_interval_s", 0.0)),
+        heat_half_life_s=float(raw.get("heat_half_life_s", 0.0)),
         model=dict(raw.get("model", {})),
         mesh_axes=dict(raw.get("mesh_axes", {})),
         serve_port_offset=int(raw.get("serve_port_offset", 1000)),

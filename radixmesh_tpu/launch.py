@@ -86,14 +86,29 @@ def _run_node(args: argparse.Namespace) -> int:
     from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
     from radixmesh_tpu.server.http_frontend import RouterFrontend
 
-    cfg = load_config(args.config_file)
-    if args.replication_factor is not None:
-        # Prefix-ownership sharding override (cache/sharding.py): must be
-        # IDENTICAL on every node of the cluster (the ownership map is a
-        # pure function of the shared view + this factor), same contract
-        # as every other config key. 0 = full replica.
-        cfg.replication_factor = int(args.replication_factor)
-        cfg.validate()
+    # Multi-router front door override: like every topology key, the
+    # SAME list must be passed on every node of the cluster (the global
+    # rank space is positional). Applied BEFORE validation so a router
+    # added by flag can find its own membership.
+    router_override = (
+        [a.strip() for a in args.router_nodes.split(",") if a.strip()]
+        if args.router_nodes is not None
+        else None
+    )
+    # The --replication-factor override (prefix-ownership sharding,
+    # cache/sharding.py) must be IDENTICAL on every node, same contract
+    # as every other config key; applied pre-validation so the
+    # rebalance/replication cross-field check judges the factor the
+    # node actually runs with.
+    cfg = load_config(
+        args.config_file,
+        router_nodes=router_override,
+        replication_factor=args.replication_factor,
+        # Validated WITH the factor above: --rebalance-interval without
+        # sharding gets config.validate()'s refusal, the same error the
+        # YAML key gives (one rule, one home).
+        rebalance_interval_s=args.rebalance_interval,
+    )
     role, rank, _ = cfg.local_identity()
     configure_logger(f"{role.value}@{rank}")
     log = get_logger("launch")
@@ -362,6 +377,34 @@ def _run_node(args: argparse.Namespace) -> int:
             repair_interval, cfg.repair_age_threshold_s,
         )
 
+    # Heat-driven shard rebalancer (cache/rebalance.py): every sharded
+    # P/D node runs the plane; only the current view master decides
+    # (lowest-alive-rank failover, no election). Overrides gossip like
+    # the view, so arming it on every node costs one idle ticker per
+    # non-master.
+    rebalance_plane = None
+    # The CLI override already folded into cfg pre-validation (see
+    # load_config above), so the rf>0 requirement was enforced there.
+    rebalance_interval = cfg.rebalance_interval_s
+    if (
+        role is not NodeRole.ROUTER
+        and cfg.replication_factor > 0
+        and rebalance_interval > 0
+    ):
+        from radixmesh_tpu.cache.rebalance import (
+            RebalanceConfig,
+            RebalancePlane,
+        )
+
+        rebalance_plane = RebalancePlane(
+            node, RebalanceConfig(interval_s=rebalance_interval)
+        ).start()
+        log.info(
+            "heat-driven rebalancer armed (tick %.1fs; decider = view "
+            "master)",
+            rebalance_interval,
+        )
+
     # Membership lifecycle plane (policy/lifecycle.py): ring nodes get
     # the BOOTSTRAPPING → ACTIVE → DRAINING → LEFT state machine. Warm
     # bootstrap (bulk repair from a donor + router hit-withholding) only
@@ -416,6 +459,8 @@ def _run_node(args: argparse.Namespace) -> int:
             except Exception:  # noqa: BLE001 — drain failure must not block exit
                 log.exception("exit drain failed")
             lifecycle_plane.close()
+        if rebalance_plane is not None:
+            rebalance_plane.close()
         if repair_plane is not None:
             repair_plane.close()
         if fleet_plane is not None:
@@ -699,6 +744,24 @@ def main(argv: list[str] | None = None) -> int:
         "insert O(RF) instead of O(ring size). Must be identical on every "
         "node. 0 (the default) = full replication, bit-for-bit the old "
         "ring wire",
+    )
+    node.add_argument(
+        "--router-nodes", default=None, metavar="ADDR,ADDR",
+        help="multi-router front door override: comma-separated router "
+        "cache addresses replacing the config's router_nodes (must be "
+        "IDENTICAL on every node — the rank space is positional). Every "
+        "router is fed by the master fan-out; clients fail over between "
+        "them (router/front_door.py)",
+    )
+    node.add_argument(
+        "--rebalance-interval", type=float, default=None, metavar="SECONDS",
+        help="heat-driven shard rebalancing (cache/rebalance.py): the "
+        "view master consumes the gossiped heat map every N seconds — "
+        "hot shards temporarily gain owners (reads fan out), cooled "
+        "shards shrink back (hysteresis band), moves bounded per round "
+        "and handed off zero-loss. Requires --replication-factor > 0; "
+        "overrides the config's rebalance_interval_s; 0 disables the "
+        "decider (folding gossiped overrides stays on)",
     )
     node.add_argument(
         "--chaos-plan", default=None, metavar="FILE",

@@ -70,6 +70,7 @@ RULES = (
     "replication_lag",
     "slo_burn_rate",
     "spec_efficiency",
+    "rebalancer_asleep",
 )
 
 # The pinned evidence vocabulary per rule: every finding MUST carry at
@@ -91,6 +92,10 @@ RULE_EVIDENCE_FIELDS = {
         "slow_window_s", "budget", "tier",
     ),
     "spec_efficiency": ("shape", "proposed", "accepted", "acceptance"),
+    "rebalancer_asleep": (
+        "skew_peak", "sustained_s", "window_s", "moves_in_window",
+        "hot_shard", "plane_armed",
+    ),
 }
 
 
@@ -128,6 +133,21 @@ class DoctorConfig:
     # spec_efficiency: acceptance floor with enough proposals to judge.
     spec_accept_floor: float = 0.3
     spec_min_proposed: int = 50
+    # rebalancer_asleep: the fleet skew stayed above hot_shard_skew for
+    # at least sustain seconds inside the trailing window while the
+    # rebalance plane adopted ZERO moves in that window — the telemetry
+    # sees a storm the mesh is not acting on (a missing/off plane fires
+    # the same rule: that is the pre-rebalancer pathology by name).
+    rebalance_window_s: float = 120.0
+    rebalance_sustain_s: float = 10.0
+    # Persistence bound for SELF-SAMPLED skew points (no history ring):
+    # a diagnose-time sample only proves the skew at that instant, so
+    # its value persists at most this long toward "sustained" — sparse
+    # polling must not smear two momentary spikes into a storm (the
+    # same discipline BurnRateTracker's staleness bound applies).
+    # History-fed trajectories are change-compressed (a gap means NO
+    # CHANGE), so their persistence is exact and uncapped.
+    rebalance_max_sample_gap_s: float = 30.0
 
 
 @dataclass
@@ -319,6 +339,10 @@ class MeshDoctor:
         )
         if self._burn_fed_by_history:
             history.bind_burn_tracker(self.burn_tracker)
+        # Skew trajectory for the rebalancer_asleep rule when no
+        # history ring is attached: diagnose-time samples, bounded to
+        # the rule's window (the burn self-sampling pattern).
+        self._skew_samples: deque = deque(maxlen=1024)
 
     # The attributor seam is callable-or-instance: frontends pass
     # obs.attribution.ensure_attributor so a test-swapped recorder
@@ -560,6 +584,103 @@ class MeshDoctor:
             },
         )
 
+    def _skew_trajectory(
+        self, now: float
+    ) -> tuple[list[tuple[float, float]], bool]:
+        """((t, skew) points covering the trailing rule window, exact):
+        the history ring's change-compressed ``shard:skew_ratio`` series
+        when one is attached (dense regardless of diagnose cadence —
+        a gap means the value did NOT change, so persistence is exact),
+        else this doctor's own diagnose-time samples (a gap means
+        nobody LOOKED — persistence must be capped)."""
+        hist = self.history
+        if hist is not None:
+            try:
+                q = hist.query(family="shard:skew_ratio", limit=100000)
+                s = q["series"].get("shard:skew_ratio")
+                if s is not None:
+                    return [(p[1], float(p[2])) for p in s["points"]], True
+            except Exception:  # noqa: BLE001 — a broken seam degrades to self-sampling
+                pass
+        mesh = self.mesh
+        skew = 0.0
+        if mesh is not None and getattr(mesh, "sharded", False):
+            skew = float(mesh.fleet.shard_heat().get("skew_score") or 0.0)
+        self._skew_samples.append((now, skew))
+        return list(self._skew_samples), False
+
+    @staticmethod
+    def _sustained_above(
+        pts,
+        threshold: float,
+        start: float,
+        end: float,
+        max_gap_s: float | None = None,
+    ) -> tuple[float, float]:
+        """(seconds above threshold, peak value) over [start, end].
+        Each point's value persists until the next point — or at most
+        ``max_gap_s`` when given (self-sampled trajectories: a sparse
+        poll proves nothing about the time nobody looked, so two
+        momentary spikes must not smear into a sustained storm)."""
+        above_s = 0.0
+        peak = 0.0
+        for i, (t, v) in enumerate(pts):
+            nxt = pts[i + 1][0] if i + 1 < len(pts) else end
+            if max_gap_s is not None:
+                nxt = min(nxt, t + max_gap_s)
+            seg_start = max(t, start)
+            seg_end = min(nxt, end)
+            if seg_end <= seg_start:
+                continue
+            peak = max(peak, v)
+            if v >= threshold:
+                above_s += seg_end - seg_start
+        return above_s, peak
+
+    def _rule_rebalancer_asleep(self) -> Finding | None:
+        mesh = self.mesh
+        if mesh is None or not getattr(mesh, "sharded", False):
+            return None
+        cfg = self.cfg
+        now = self._now()
+        pts, exact = self._skew_trajectory(now)
+        sustained, peak = self._sustained_above(
+            pts, cfg.hot_shard_skew, now - cfg.rebalance_window_s, now,
+            max_gap_s=None if exact else cfg.rebalance_max_sample_gap_s,
+        )
+        if sustained < cfg.rebalance_sustain_s:
+            return None
+        plane = getattr(mesh, "rebalance", None)
+        moves = (
+            plane.moves_in_window(cfg.rebalance_window_s)
+            if plane is not None
+            else 0
+        )
+        if moves > 0:
+            return None
+        hot = mesh.fleet.shard_heat().get("hot_shard")
+        why = (
+            "no rebalance plane is armed"
+            if plane is None
+            else "the rebalance plane adopted zero moves"
+        )
+        return Finding(
+            "rebalancer_asleep",
+            min(1.0, 0.5 + peak / (8.0 * cfg.hot_shard_skew)),
+            f"skew held >= {cfg.hot_shard_skew:.1f} for {sustained:.0f}s "
+            f"(peak {peak:.1f}, hot shard {hot}) while {why} in the "
+            f"same {cfg.rebalance_window_s:.0f}s window — the heat map "
+            "sees a storm nothing is acting on",
+            {
+                "skew_peak": round(peak, 4),
+                "sustained_s": round(sustained, 3),
+                "window_s": cfg.rebalance_window_s,
+                "moves_in_window": int(moves),
+                "hot_shard": hot,
+                "plane_armed": plane is not None,
+            },
+        )
+
     # -- the diagnosis -------------------------------------------------
 
     def diagnose(self) -> dict:
@@ -572,6 +693,7 @@ class MeshDoctor:
             "replication_lag": self._rule_replication_lag,
             "slo_burn_rate": self._rule_slo_burn_rate,
             "spec_efficiency": self._rule_spec_efficiency,
+            "rebalancer_asleep": self._rule_rebalancer_asleep,
         }
         # Seam presence per rule: a rule whose inputs are absent never
         # looked at anything, so it must NOT appear in rules_checked —
@@ -587,6 +709,7 @@ class MeshDoctor:
             "replication_lag": self.mesh is not None,
             "slo_burn_rate": self.slo is not None,
             "spec_efficiency": self.engine is not None,
+            "rebalancer_asleep": self.mesh is not None,
         }
         findings: list[Finding] = []
         checked: list[str] = []

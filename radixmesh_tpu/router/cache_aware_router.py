@@ -367,11 +367,24 @@ class CacheAwareRouter:
         """One fallback choice: owner replicas first (sharded — traffic
         for a subtree concentrates where its inserts land, and failover
         must land on a replica that HOLDS the prefix), then the role's
-        consistent-hash ring."""
+        consistent-hash ring. Among eligible owner replicas the
+        LEAST-LOADED wins: under elastic replication
+        (cache/rebalance.py) a hot shard's boosted owner set is exactly
+        the fan-out surface — a first-owner-wins pick would re-convoy
+        the traffic the boost exists to spread."""
         exclude = exclude or set()
-        for addr in self._owner_addrs(key, role):
-            if addr not in exclude:
-                return addr
+        owners = [
+            a for a in self._owner_addrs(key, role) if a not in exclude
+        ]
+        if owners:
+            if len(owners) == 1:
+                return owners[0]
+            # Ties (an idle fleet) keep the walk order — cold routing
+            # stays deterministic at the primary owner.
+            return min(
+                enumerate(owners),
+                key=lambda ia: (self._loads.load(ia[1]), ia[0]),
+            )[1]
         ring = self._prefill_ring if role == "prefill" else self._decode_ring
         return ring.get_node(key, exclude=exclude or None)
 

@@ -938,6 +938,7 @@ def _chaos_join_drain_phases(
         failure_timeout_s=base_cfg.failure_timeout_s,
         replication_factor=base_cfg.replication_factor,
         shard_summary_interval_s=base_cfg.shard_summary_interval_s,
+        heat_half_life_s=base_cfg.heat_half_life_s,
     )
     joiner = MeshCache(jcfg, pool=None).start()
     nodes.append(joiner)
@@ -1308,6 +1309,392 @@ def _chaos_crash_phase(
     }
 
 
+def _chaos_rebalance_phase(
+    *,
+    ring,
+    router_mesh,
+    by_addr,
+    rng,
+    wait_for,
+    key_len: int,
+    zipf_keys: int = 24,
+    zipf_inserts: int = 160,
+    zipf_alpha: float = 1.6,
+    hits_per_request: int = 5,
+    wave_s: float = 2.0,
+    settle_s: float = 2.0,
+    mid_requests: int = 20,
+    max_moves_per_round: int = 4,
+    skew_trigger: float = 2.0,
+    timeout_s: float = 30.0,
+) -> dict:
+    """Rebalance-under-storm (the closed robustness loop,
+    cache/rebalance.py): a zipf-keyed storm concentrates insert+hit
+    heat on one shard's owners; the view master's RebalancePlane must
+    see the gossiped skew, boost the hot shards' owner sets (bounded
+    moves), hand the cached entries to the gained owners with ZERO
+    failed requests mid-move, and — once the fleet converges on the
+    override version — a second storm wave's reads fan out across the
+    boosted replicas until the router-observed skew score STRICTLY
+    drops. Deterministic: zipf counts (not samples), manual decider
+    ticks, deadline-bounded waits."""
+    import time as _time
+
+    from radixmesh_tpu.cache.rebalance import RebalanceConfig, RebalancePlane
+    from radixmesh_tpu.cache.sharding import NUM_SHARDS, shard_of_tokens
+    from radixmesh_tpu.router.cache_aware_router import CacheAwareRouter
+
+    t_phase = _time.monotonic()
+    # A phase-local router with an aggressive shed policy and a fresh
+    # load tracker: fan-out-under-boost IS the mechanism being proven,
+    # so the hot replica must shed to its (boosted) owner peers well
+    # before the default production thresholds.
+    cr = CacheAwareRouter(
+        router_mesh, router_mesh.cfg,
+        overload_factor=1.5, overload_floor=6.0, load_tau_s=5.0,
+    )
+    cr.finish_warm_up()
+    # One plane per ring node; decisions are manual ticks (the thread
+    # cadence is a production concern, not a phase invariant) and only
+    # the view master's plane ever acts.
+    planes = [
+        RebalancePlane(
+            n,
+            RebalanceConfig(
+                interval_s=3600.0,
+                skew_trigger=skew_trigger,
+                boost_factor=1.5,
+                shrink_factor=1.1,
+                rf_boost=2,
+                max_moves_per_round=max_moves_per_round,
+            ),
+        )
+        for n in ring
+    ]
+    try:
+        weights = np.arange(1, zipf_keys + 1, dtype=np.float64) ** (
+            -zipf_alpha
+        )
+        counts = np.maximum(
+            1, np.floor(zipf_inserts * weights / weights.sum()).astype(int)
+        )
+        keys = [
+            np.concatenate(
+                [
+                    np.asarray([8101 + k], dtype=np.int32),
+                    rng.integers(1, 600, size=key_len - 1).astype(np.int32),
+                ]
+            )
+            for k in range(zipf_keys)
+        ]
+        reqs = [k for k, c in enumerate(counts) for _ in range(int(c))]
+        reqs = [keys[i] for i in rng.permutation(reqs)]
+        page = max(1, ring[0].page)
+        by_rank = {n.rank: n for n in ring}
+
+        counters = {"attempted": 0, "ok": 0}
+
+        def _serve_at(target, key) -> bool:
+            counters["attempted"] += 1
+            try:
+                if target is None:
+                    raise RuntimeError("no serving node for request")
+                target.insert(key, np.arange(len(key), dtype=np.int32))
+                for _ in range(hits_per_request):
+                    target.match_prefix(key)
+                counters["ok"] += 1
+                return True
+            except Exception:  # noqa: BLE001 — failures are the measurement
+                return False
+
+        def _serve_routed(key) -> bool:
+            try:
+                res = cr.cache_aware_route(key)
+                target = by_addr.get(res.prefill_addr)
+            except Exception:  # noqa: BLE001
+                target = None
+            return _serve_at(target, key)
+
+        def _serve_primary(key) -> bool:
+            # The storm's concentration leg: traffic lands where a
+            # summary-warm router would send it — the shard's primary
+            # owner (deepest advertiser once warm).
+            sid = shard_of_tokens(key[:page])
+            primary = decider_mesh.ownership.primary(sid)
+            return _serve_at(by_rank.get(primary), key)
+
+        def _wave(serve) -> None:
+            pace = wave_s / max(1, len(reqs))
+            t0 = _time.monotonic()
+            for i, key in enumerate(reqs):
+                serve(key)
+                left = t0 + (i + 1) * pace - _time.monotonic()
+                if left > 0:
+                    _time.sleep(left)
+
+        def _skew_at(mesh) -> dict:
+            # Only ranks with nonzero OWNED-shard load ride the heat
+            # trailer (cold reporters clear themselves) — wait for
+            # exactly the set that just published something.
+            expected = set()
+            for n in ring:
+                if n.ownership is not None:
+                    owned = set(n.ownership.owned_shards(n.rank))
+                    # heat_loads() snapshots under the mesh lock — the
+                    # transport reader threads are still applying storm
+                    # oplogs and mutating the heat cells.
+                    if set(n.heat_loads()) & owned:
+                        expected.add(n.rank)
+                n.broadcast_shard_summary()
+            wait_for(
+                lambda m=mesh, e=expected: e
+                <= {int(r) for r in m.fleet.shard_heat()["by_rank"]},
+                timeout=timeout_s,
+            )
+            return mesh.fleet.shard_heat()
+
+        decider = next((p for p in planes if p.is_decider()), None)
+        if decider is None:
+            return {"performed": False, "reason": "no decider in ring"}
+        decider_mesh = decider.mesh
+
+        # -- wave 1: concentrate ---------------------------------------
+        _wave(_serve_primary)
+        # Both the observer router AND the decider need the heat folds.
+        heat_before = _skew_at(router_mesh)
+        _skew_at(decider_mesh)
+        skew_before = float(heat_before["skew_score"])
+        attempted_wave1 = counters["attempted"]
+
+        old_owners = {
+            sid: decider.mesh.ownership.owners_of(sid)
+            for sid in range(NUM_SHARDS)
+        }
+
+        # -- the move (traffic keeps flowing) --------------------------
+        # The mid-move trickle is PACED across a settle window that
+        # doubles as the wave-1 heat-decay gap: skew_after must measure
+        # wave 2's fanned-out traffic, not wave 1's residue.
+        mid0_attempted, mid0_ok = counters["attempted"], counters["ok"]
+        tick = decider.tick()
+        t_mid = _time.monotonic()
+        for i, key in enumerate(reqs[:mid_requests]):
+            _serve_routed(key)
+            left = (
+                t_mid + (i + 1) * settle_s / max(1, mid_requests)
+            ) - _time.monotonic()
+            if left > 0:
+                _time.sleep(left)
+        want = (decider_mesh.overrides.epoch, decider_mesh.overrides.version)
+        every = list(ring) + [router_mesh]
+        converged = wait_for(
+            lambda: all(
+                (n.overrides.epoch, n.overrides.version) == want
+                for n in every
+            ),
+            timeout=timeout_s,
+        )
+        # Zero-loss handoff audit: each rank that GAINED ownership of a
+        # boosted shard must hold that shard's hottest key (pushed
+        # point-to-point by the old primary, not waiting out repair).
+        sid_hot_key = {}
+        for k, key in enumerate(keys):
+            sid = shard_of_tokens(key[:page])
+            if sid not in sid_hot_key:
+                sid_hot_key[sid] = key
+        handoff_entries = 0
+        for sid in tick.get("boosted", []):
+            key = sid_hot_key.get(sid)
+            if key is None:
+                continue
+            gained = [
+                r for r in decider_mesh.ownership.owners_of(sid)
+                if r not in old_owners.get(sid, ()) and r in by_rank
+            ]
+            for r in gained:
+                if wait_for(
+                    lambda n=by_rank[r], k=key: n.tree.match_prefix(
+                        k, split_partial=False
+                    ).length
+                    > 0,
+                    timeout=timeout_s,
+                ):
+                    handoff_entries += 1
+
+        # -- wave 2: fan out under the adopted overrides ---------------
+        _wave(_serve_routed)
+        heat_after = _skew_at(router_mesh)
+        skew_after = float(heat_after["skew_score"])
+        mid_attempted = counters["attempted"] - mid0_attempted
+        mid_ok = counters["ok"] - mid0_ok
+        moves = len(tick.get("boosted", [])) + len(tick.get("shrunk", []))
+        return {
+            "performed": True,
+            "skew_before": round(skew_before, 4),
+            "skew_after": round(skew_after, 4),
+            "skew_dropped": bool(skew_after < skew_before),
+            "moves": int(moves),
+            "max_moves_per_round": int(max_moves_per_round),
+            "moves_bounded": bool(moves <= max_moves_per_round),
+            "boosted_shards": [int(s) for s in tick.get("boosted", [])],
+            "hot_shard": heat_before.get("hot_shard"),
+            "attempted_mid_move": int(mid_attempted),
+            "ok_mid_move": int(mid_ok),
+            "failed_mid_move": int(mid_attempted - mid_ok),
+            "overrides_version": int(want[1]),
+            "overrides_converged": bool(converged),
+            "handoff_entries": int(handoff_entries),
+            "requests_wave1": int(attempted_wave1),
+            "rebalance_s": round(_time.monotonic() - t_phase, 3),
+        }
+    finally:
+        for p in planes:
+            p.close()
+
+
+def _chaos_router_kill_phase(
+    *,
+    routers,
+    by_addr,
+    plan,
+    kill_router,
+    rng,
+    seed: int,
+    streams: int = 10,
+    tokens_per_stream: int = 16,
+    deadline_s: float = 30.0,
+) -> dict:
+    """Router-kill at the multi-router front door: live streams route
+    EVERY token through a :class:`RouterFrontDoor` over N >= 2 router
+    edges (each an independent RecoveryCoordinator edge); one router is
+    process-killed mid-traffic (stops serving AND acking, like a
+    blackholed peer); the front door's hop timeout detects it, hedges
+    to the survivor, and every in-flight request completes through the
+    surviving router's edge — zero lost requests. ``routers`` is an
+    ordered list of (addr, CacheAwareRouter)."""
+    import time as _time
+
+    from radixmesh_tpu.policy.retry import RetryPolicy
+    from radixmesh_tpu.router.front_door import RouterFrontDoor
+    from radixmesh_tpu.server.recovery import RecoveryCoordinator
+
+    t_phase = _time.monotonic()
+    policy = RetryPolicy(
+        hop_timeout_s=0.5, max_retries=4, backoff_base_s=0.05,
+        backoff_max_s=0.3, jitter_frac=0.25,
+    )
+    coords = {
+        addr: RecoveryCoordinator(policy, name=f"edge-{addr}", seed=seed)
+        for addr, _ in routers
+    }
+    served_by: dict[str, int] = {addr: 0 for addr, _ in routers}
+
+    def make_route_fn(addr, router):
+        def fn(key):
+            if plan.is_killed(addr):
+                # A killed process stops acking: from the client this
+                # is a hop that never answers, so the front door's
+                # timeout — not a clean error — must detect it.
+                _time.sleep(0.6)
+                raise RuntimeError(f"router {addr} gave no answer")
+            res = router.cache_aware_route(key)
+            served_by[addr] += 1
+            return res
+
+        return fn
+
+    fd = RouterFrontDoor(
+        [(addr, make_route_fn(addr, r)) for addr, r in routers],
+        hop_timeout_s=0.25,
+        name="chaos-frontdoor",
+    )
+    victim = routers[0][0]
+    survivor = routers[1][0] if len(routers) > 1 else None
+
+    recs = []
+    for s in range(streams):
+        prompt = rng.integers(0, 600, size=9).astype(np.int32)
+        rec = coords[victim].admit(
+            prompt, deadline_s=deadline_s, seed=seed * 1361 + s
+        )
+        recs.append(rec)
+
+    def token_of(stream_seed: int, i: int) -> int:
+        return int((stream_seed * 6151 + i * 104729 + 29) % 600)
+
+    failed = 0
+
+    def emit_one(rec) -> None:
+        key = rec.resume_key()
+        res = fd.route(key)
+        target = by_addr.get(res.prefill_addr)
+        if target is None:
+            raise RuntimeError("front door returned no prefill node")
+        tok = token_of(rec.seed, len(rec.delivered))
+        grown = np.concatenate([key, np.asarray([tok], dtype=np.int32)])
+        target.insert(grown, np.arange(len(grown), dtype=np.int32))
+        rec.deliver(tok)
+
+    half = tokens_per_stream // 2
+    for _ in range(half):
+        for rec in recs:
+            emit_one(rec)
+
+    # -- the kill: one of N routers dies mid-traffic -------------------
+    inflight_at_kill = sum(
+        1 for r in recs if len(r.delivered) < tokens_per_stream
+    )
+    served_at_kill = dict(served_by)
+    plan.kill(victim)
+    kill_router(victim)
+
+    # The victim's edge process died whole — its recovery records
+    # resurrect on the SURVIVING router's edge: re-admit each in-flight
+    # stream there (prompt + delivered replay) and finish through the
+    # front door, which fails over on the first unanswered hop.
+    migrated = []
+    for rec in recs:
+        if survivor is None:
+            break
+        nrec = coords[survivor].admit(
+            rec.prompt,
+            deadline_s=max(0.5, rec.budget.remaining()),
+            seed=rec.seed,
+            trace_id=rec.trace_id or None,
+        )
+        for tok in rec.delivered:
+            nrec.deliver(tok)
+        migrated.append(nrec)
+    for _ in range(tokens_per_stream - half):
+        for rec in migrated:
+            try:
+                if len(rec.delivered) < tokens_per_stream:
+                    emit_one(rec)
+            except Exception:  # noqa: BLE001 — failures are the measurement
+                failed += 1
+    completed = sum(
+        1 for r in migrated if len(r.delivered) >= tokens_per_stream
+    )
+    survivor_served = bool(
+        survivor is not None
+        and served_by.get(survivor, 0) > served_at_kill.get(survivor, 0)
+    )
+    return {
+        "performed": True,
+        "routers": len(routers),
+        "killed": victim,
+        "survivor": survivor,
+        "streams": streams,
+        "inflight_at_kill": int(inflight_at_kill),
+        "completed": int(completed),
+        "failed": int(failed),
+        "failovers": int(fd.failovers),
+        "hedges": int(fd.hedges),
+        "survivor_served": survivor_served,
+        "router_kill_s": round(_time.monotonic() - t_phase, 3),
+    }
+
+
 def run_chaos_workload(
     drop_p: float = 0.2,
     partition_s: float = 10.0,
@@ -1332,6 +1719,13 @@ def run_chaos_workload(
     crash_tokens: int = 24,
     crash_deadline_s: float = 20.0,
     replication_factor: int = 0,
+    rebalance: bool = True,
+    rebalance_wave_s: float = 2.0,
+    rebalance_keys: int = 24,
+    rebalance_inserts: int = 160,
+    router_kill: bool = True,
+    router_kill_streams: int = 10,
+    router_kill_tokens: int = 16,
 ) -> dict:
     """The chaos acceptance scenario (``bench.validate_chaos`` pins its
     artifact): a seeded FaultPlan injects ``drop_p`` frame loss across
@@ -1383,6 +1777,17 @@ def run_chaos_workload(
        a hedged-prefill drill (first-writer-wins, loser cancelled) runs
        in the same window.
 
+    With ``rebalance`` (sharded runs only) a rebalance-under-storm
+    phase runs after quiescence (``_chaos_rebalance_phase``): a zipf
+    storm's skew score must STRICTLY drop once the view master's
+    RebalancePlane boosts the hot shards' owner sets, with zero failed
+    requests mid-move and the override version converged fleet-wide.
+    With ``router_kill`` a final front-door phase
+    (``_chaos_router_kill_phase``) process-kills one of the two
+    routers mid-traffic: the client-side RouterFrontDoor must detect
+    it by hop timeout, hedge to the survivor, and complete every
+    in-flight request — zero lost.
+
     Deterministic by seeding: the FaultPlan's per-edge RNGs and the
     request stream derive from ``seed``; waits are deadline-bounded
     polls, never bare sleeps asserting timing."""
@@ -1407,14 +1812,18 @@ def run_chaos_workload(
     rng = np.random.default_rng(seed)
     t_start = _time.monotonic()
     InprocHub.reset_default()
-    # Three prefills: cp1 takes the phase-1 (and phase-6) partition;
+    # FOUR prefills: cp1 takes the phase-1 (and phase-6) partition;
     # cp2 is the drain/rejoin subject — its ring paths to the master
-    # and its donor avoid cp1, so a join can START under the partition.
+    # and its donor avoid cp1, so a join can START under the partition;
+    # the fourth keeps sharded runs (rf <= 2) below the N <= RF
+    # degeneracy so the rebalance phase has non-owners to boost ONTO.
     # TWO decodes: cd1 (or whichever serves more live streams) is the
     # phase-7 unclean-kill victim, and its sibling is the survivor the
-    # recovery plane resurrects interrupted streams onto.
+    # recovery plane resurrects interrupted streams onto. TWO routers:
+    # the multi-router front door — cr0 is the final-phase kill victim,
+    # cr1 the surviving edge every in-flight request completes through.
     prefill, decode, router_addrs = (
-        ["cp0", "cp1", "cp2"], ["cd0", "cd1"], ["cr0"],
+        ["cp0", "cp1", "cp2", "cp3"], ["cd0", "cd1"], ["cr0", "cr1"],
     )
     partitioned = prefill[1]
     fault_end_s = partition_delay_s + partition_s
@@ -1455,13 +1864,18 @@ def run_chaos_workload(
                     shard_summary_interval_s=min(
                         digest_interval_s, repair_interval_s
                     ),
+                    # Fast heat decay so the rebalance phase's second
+                    # wave measures ITS traffic, not the first wave's
+                    # residue (production keeps the 30 s default).
+                    heat_half_life_s=1.0,
                 )
                 nodes.append(MeshCache(cfg, pool=None).start())
             for n in nodes:
                 if not n.wait_ready(timeout=timeout_s):
                     raise RuntimeError(f"node {n.rank} never passed the barrier")
             ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
-            router_mesh = nodes[-1]
+            router_meshes = [n for n in nodes if n.role is NodeRole.ROUTER]
+            router_mesh = router_meshes[0]
             by_addr = {n.cfg.local_addr: n for n in ring}
             fleet_planes = [
                 FleetPlane(n, interval_s=digest_interval_s).start()
@@ -1566,6 +1980,24 @@ def run_chaos_workload(
                 _time.sleep(repair_interval_s)
             traffic_after = _repair_traffic()
 
+            # -- 4b: heat-driven rebalancing under a zipf storm --------
+            # (sharded runs only: a full replica has no ownership to
+            # move). Runs on the healed fleet, before membership churn.
+            rebalance_report: dict = {"performed": False}
+            if rebalance and replication_factor > 0:
+                rebalance_report = _chaos_rebalance_phase(
+                    ring=ring,
+                    router_mesh=router_mesh,
+                    by_addr=by_addr,
+                    rng=rng,
+                    wait_for=wait_for,
+                    key_len=key_len,
+                    zipf_keys=rebalance_keys,
+                    zipf_inserts=rebalance_inserts,
+                    wave_s=rebalance_wave_s,
+                    timeout_s=timeout_s,
+                )
+
             # -- 5: graceful drain of cp2 under re-opened seeded loss --
             join_report: dict = {"performed": False}
             drain_report: dict = {"performed": False}
@@ -1627,6 +2059,37 @@ def run_chaos_workload(
                     kill_planes=_kill_planes,
                 )
 
+            # -- 8: router kill at the multi-router front door ---------
+            # LAST: it takes a router down for good.
+            router_kill_report: dict = {"performed": False}
+            if router_kill and len(router_meshes) >= 2:
+                routers_rk = []
+                for rm in router_meshes:
+                    r = CacheAwareRouter(rm, rm.cfg)
+                    r.watch_topology()
+                    r.finish_warm_up()
+                    routers_rk.append((rm.cfg.local_addr, r))
+
+                def _kill_router(addr):
+                    rm = next(
+                        n for n in router_meshes
+                        if n.cfg.local_addr == addr
+                    )
+                    if rm in nodes:
+                        repair_planes[nodes.index(rm)].close()
+                    rm.close()
+
+                router_kill_report = _chaos_router_kill_phase(
+                    routers=routers_rk,
+                    by_addr=by_addr,
+                    plan=plan,
+                    kill_router=_kill_router,
+                    rng=rng,
+                    seed=seed,
+                    streams=router_kill_streams,
+                    tokens_per_stream=router_kill_tokens,
+                )
+
             repair_totals = {
                 k: sum(r.stats()[k] for r in repair_planes)
                 for k in (
@@ -1636,7 +2099,7 @@ def run_chaos_workload(
             }
             return {
                 "nodes": len({n.cfg.local_addr for n in nodes}),
-                "topology": "3 prefill + 2 decode + 1 router (inproc)",
+                "topology": "4 prefill + 2 decode + 2 routers (inproc)",
                 "replication_factor": replication_factor,
                 "round_budget": round_budget,
                 "fault_plan": {
@@ -1676,6 +2139,8 @@ def run_chaos_workload(
                 "drain": drain_report,
                 "join": join_report,
                 "crash": crash_report,
+                "rebalance": rebalance_report,
+                "router_kill": router_kill_report,
                 "wall_s": round(_time.monotonic() - t_start, 3),
             }
     finally:

@@ -41,6 +41,8 @@ def run(
     crash: bool = True,
     crash_streams: int = 12,
     replication_factor: int = 0,
+    rebalance: bool = True,
+    router_kill: bool = True,
 ) -> dict:
     res = run_chaos_workload(
         drop_p=drop_p,
@@ -53,6 +55,8 @@ def run(
         crash=crash,
         crash_streams=crash_streams,
         replication_factor=replication_factor,
+        rebalance=rebalance,
+        router_kill=router_kill,
     )
     report = bench.build_chaos_report(res)
     problems = bench.validate_chaos(report)
@@ -98,6 +102,15 @@ def main() -> int:
         "--crash-streams", type=int, default=12,
         help="live streams decoding when the kill lands",
     )
+    ap.add_argument(
+        "--no-rebalance", action="store_true",
+        help="skip the rebalance-under-storm phase (runs only on "
+        "sharded meshes — --replication-factor > 0 — anyway)",
+    )
+    ap.add_argument(
+        "--no-router-kill", action="store_true",
+        help="skip the multi-router front-door kill phase",
+    )
     ap.add_argument("--out", default=None, help="also write the JSON here")
     args = ap.parse_args()
     report = run(
@@ -106,6 +119,8 @@ def main() -> int:
         join_partition_s=args.join_partition,
         crash=args.crash, crash_streams=args.crash_streams,
         replication_factor=args.replication_factor,
+        rebalance=not args.no_rebalance,
+        router_kill=not args.no_router_kill,
     )
     line = json.dumps(report)
     print(line)

@@ -1,0 +1,140 @@
+"""Standalone rebalance acceptance bench (the REBALANCE artifact's
+paired CLI emitter, like ``scripts/blackboxbench.py`` is for BLACKBOX).
+
+Runs ``workload.run_chaos_workload`` with the membership/crash phases
+off and the two PR-14 robustness phases on:
+
+- **rebalance-under-storm**: a zipf storm concentrates heat; the view
+  master's RebalancePlane boosts the hot shards' owner sets (bounded
+  moves, hysteresis), hands entries off zero-loss, and a second storm
+  wave's reads fan out until the router-observed skew score strictly
+  drops — with zero failed requests mid-move.
+- **router-kill**: one of the 2 routers is process-killed mid-traffic;
+  the client-side RouterFrontDoor detects it by hop timeout, hedges to
+  the survivor, and every in-flight request completes — zero lost.
+
+Then runs meshcheck's checker set scoped to the new rebalance plane
+(``cache/rebalance.py`` + ``router/front_door.py``) — the artifact
+gates on 0 findings there — and prints ONE JSON line validated against
+the schema ``bench.validate_rebalance`` pins.
+
+Usage::
+
+    python scripts/rebalancebench.py [--seed 0] [--replication-factor 2] \
+        [--out FILE] [--write-artifact]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+
+# The new robustness plane meshcheck must report clean for the artifact
+# to gate green.
+PLANE_FILES = ("cache/rebalance.py", "router/front_door.py")
+
+
+def rebalance_round() -> int:
+    """The round in progress = 1 + the highest N across every OTHER
+    plane's recorded artifact (the scripts/meshcheck.py analysis_round
+    convention)."""
+    rounds = [0]
+    for name in os.listdir(_REPO_ROOT):
+        m = re.fullmatch(r"[A-Z_]+_r(\d+)\.json", name)
+        if m and not name.startswith("REBALANCE_"):
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def meshcheck_plane() -> dict:
+    """Run the full checker set over the product tree and keep the
+    findings that land on the rebalance plane's files — a full-tree
+    parse because the single-writer contracts are exactly about OTHER
+    modules touching this plane's types."""
+    from radixmesh_tpu.analysis import all_checkers, tree_index
+    from radixmesh_tpu.analysis.core import run_checkers
+
+    result = run_checkers(tree_index(), all_checkers())
+    plane_findings = [
+        f for f in result.findings
+        if f.file in PLANE_FILES
+        or "rebalance" in f.message
+        or "ShardOverrides" in f.message
+    ]
+    return {
+        "files": list(PLANE_FILES),
+        "findings": len(plane_findings),
+        "clean": not plane_findings,
+        "detail": [str(f) for f in plane_findings[:16]],
+    }
+
+
+def run(seed: int, replication_factor: int) -> dict:
+    from radixmesh_tpu.workload import run_chaos_workload
+
+    res = run_chaos_workload(
+        seed=seed,
+        # A short fault window: phases 1-4 are CHAOS's job — this
+        # artifact's evidence is the rebalance + router-kill phases.
+        partition_s=1.2,
+        partition_delay_s=0.3,
+        n_requests=60,
+        quiesce_window_s=0.8,
+        timeout_s=60.0,
+        join_drain=False,
+        crash=False,
+        replication_factor=replication_factor,
+        rebalance=True,
+        router_kill=True,
+    )
+    report = bench.build_rebalance_report(res, meshcheck=meshcheck_plane())
+    problems = bench.validate_rebalance(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="rebalancebench")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--replication-factor", type=int, default=2, metavar="RF",
+        help="sharding factor for the mesh under test (must leave the "
+        "6-node ring below the N <= RF degeneracy or there is nothing "
+        "to boost onto; the acceptance run pins 2)",
+    )
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument(
+        "--write-artifact", action="store_true",
+        help="write the round's REBALANCE_r{N}.json to the repo root",
+    )
+    args = ap.parse_args()
+    report = run(args.seed, args.replication_factor)
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    if args.write_artifact:
+        path = os.path.join(
+            _REPO_ROOT, f"REBALANCE_r{rebalance_round():02d}.json"
+        )
+        with open(path, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"rebalancebench: wrote {os.path.basename(path)}",
+              file=sys.stderr)
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

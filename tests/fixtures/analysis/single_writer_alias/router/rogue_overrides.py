@@ -1,0 +1,14 @@
+"""Seeded override-map second writer (cache/rebalance.py's contract):
+a private ShardOverrides construction plus a move-set poke — a second
+decision-maker forking the owner sets every node derives from."""
+
+from radixmesh_tpu.cache.rebalance import ShardOverrides
+
+
+def fork_the_map():
+    ovr = ShardOverrides(epoch=1, version=9, moves={3: (0, 1)})  # seeded: single-writer-overrides
+    return ovr
+
+
+def steal_move(ovr, sid, ranks):
+    ovr.moves[sid] = tuple(ranks)  # seeded: single-writer-overrides

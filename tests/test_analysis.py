@@ -94,6 +94,15 @@ class TestPositiveControls:
         )
         assert {c.line for c in hits} == {8, 13}  # construction AND poke
 
+    def test_seeded_override_second_writer(self, controls):
+        """PR 14: the rebalance plane's single-writer contract is
+        enforced, not aspirational — a private ShardOverrides
+        construction AND a .moves poke both trip."""
+        hits = _tripped(
+            controls, "single_writer_alias", "single-writer-overrides"
+        )
+        assert len(hits) == 2
+
     def test_seeded_hotpath_sleep(self, controls):
         """time.sleep two frames below Engine.step — reachable through
         the call graph, invisible to any module-scoped grep."""

@@ -407,6 +407,22 @@ class TestChaosArtifactSchema:
                 },
                 "crash_s": 9.2,
             },
+            "rebalance": {
+                "performed": True, "skew_before": 20.3, "skew_after": 14.6,
+                "skew_dropped": True, "moves": 4,
+                "max_moves_per_round": 4, "moves_bounded": True,
+                "boosted_shards": [19, 42, 37, 58], "hot_shard": 19,
+                "attempted_mid_move": 175, "ok_mid_move": 175,
+                "failed_mid_move": 0, "overrides_version": 1,
+                "overrides_converged": True, "handoff_entries": 8,
+                "requests_wave1": 155, "rebalance_s": 6.0,
+            },
+            "router_kill": {
+                "performed": True, "routers": 2, "killed": "cr0",
+                "survivor": "cr1", "streams": 10, "inflight_at_kill": 10,
+                "completed": 10, "failed": 0, "failovers": 1, "hedges": 1,
+                "survivor_served": True, "router_kill_s": 0.4,
+            },
             "wall_s": 14.7,
         }
 
@@ -511,7 +527,18 @@ class TestChaosArtifactSchema:
         validating with the join/drain sections but no crash."""
         report = self._report()
         del report["crash"]
+        del report["rebalance"]
+        del report["router_kill"]
         report["schema_version"] = 2
+        assert bench.validate_chaos(report) == []
+
+    def test_v3_artifact_without_robustness_sections_stays_valid(self):
+        """CHAOS_r08 predates the rebalance/router_kill sections (PR 14):
+        v3 artifacts must keep validating without them."""
+        report = self._report()
+        del report["rebalance"]
+        del report["router_kill"]
+        report["schema_version"] = 3
         assert bench.validate_chaos(report) == []
 
     def test_skipped_phase_is_schema_valid_but_gate_exempt(self):
@@ -519,7 +546,45 @@ class TestChaosArtifactSchema:
         report["drain"] = {"performed": False}
         report["join"] = {"performed": False}
         report["crash"] = {"performed": False}
+        report["rebalance"] = {"performed": False}
+        report["router_kill"] = {"performed": False}
         assert bench.validate_chaos(report) == []
+
+    def test_rebalance_gates_enforced(self):
+        """The PR 14 robustness-loop gates: a storm whose skew did not
+        strictly drop, requests failed mid-move, unbounded or zero
+        movement, or a fleet that never converged on the override
+        version must all be named violations."""
+        report = self._report()
+        report["rebalance"]["skew_after"] = report["rebalance"][
+            "skew_before"
+        ]
+        report["rebalance"]["failed_mid_move"] = 3
+        report["rebalance"]["moves"] = 0
+        report["rebalance"]["moves_bounded"] = False
+        report["rebalance"]["overrides_converged"] = False
+        problems = "\n".join(bench.validate_chaos(report))
+        assert "did not strictly drop" in problems
+        assert "failed mid-move" in problems
+        assert "zero adopted moves" in problems
+        assert "exceeded the per-round bound" in problems
+        assert "never converged on the decider's override version" in problems
+
+    def test_router_kill_gates_enforced(self):
+        report = self._report()
+        report["router_kill"]["routers"] = 1
+        report["router_kill"]["failed"] = 1
+        report["router_kill"]["completed"] = 8
+        report["router_kill"]["inflight_at_kill"] = 0
+        report["router_kill"]["failovers"] = 0
+        report["router_kill"]["survivor_served"] = False
+        problems = "\n".join(bench.validate_chaos(report))
+        assert "needs N >= 2" in problems
+        assert "LOST to the router kill" in problems
+        assert "did not all complete" in problems
+        assert "interrupted zero in-flight streams" in problems
+        assert "never failed over" in problems
+        assert "served no post-kill routes" in problems
 
     def test_build_report_matches_schema(self):
         res = {
@@ -527,7 +592,7 @@ class TestChaosArtifactSchema:
             for k in (
                 "nodes", "topology", "round_budget", "fault_plan", "served",
                 "divergence", "repair", "quiescence", "drain", "join",
-                "crash", "wall_s",
+                "crash", "rebalance", "router_kill", "wall_s",
             )
         }
         report = bench.build_chaos_report(res)
@@ -1245,7 +1310,9 @@ class TestCompareRounds:
         r = bench.compare_rounds(old, self._chaos(), kind="CHAOS")
         assert r["status"] == "clean"
         assert "crash.resurrection_hit_ratio" in r["skipped"]
-        assert r["version_change"] == {"old": 2, "new": 3}
+        assert r["version_change"] == {
+            "old": 2, "new": bench.CHAOS_SCHEMA_VERSION,
+        }
 
     def test_same_version_one_sided_field_refuses(self):
         old = self._chaos()
@@ -1591,3 +1658,151 @@ class TestBlackboxArtifactSchema:
         assert res["regression_flagged"] is True
         assert res["mismatch_detected"] is True
         assert "BLACKBOX" in res["kinds_covered"]
+
+
+class TestRebalanceArtifactSchema:
+    """The REBALANCE artifact (PR 14, the closed robustness loop):
+    zipf-storm skew strictly drops under rebalancing with zero failed
+    requests mid-move, a mid-traffic router kill at N >= 2 routers
+    loses nothing, and meshcheck reports the new plane clean."""
+
+    def _report(self) -> dict:
+        return {
+            "schema_version": bench.REBALANCE_SCHEMA_VERSION,
+            "metric": "rebalance_skew_drop_ratio",
+            "value": 1.39,
+            "unit": "zipf-storm skew before / after heat-driven rebalancing",
+            "workload": "zipf storm + router kill (run_chaos_workload)",
+            "nodes": 8,
+            "topology": "4 prefill + 2 decode + 2 routers (inproc)",
+            "replication_factor": 2,
+            "rebalance": {
+                "performed": True, "skew_before": 20.3, "skew_after": 14.6,
+                "skew_dropped": True, "moves": 4,
+                "max_moves_per_round": 4, "moves_bounded": True,
+                "boosted_shards": [19, 42], "hot_shard": 19,
+                "attempted_mid_move": 175, "ok_mid_move": 175,
+                "failed_mid_move": 0, "overrides_version": 1,
+                "overrides_converged": True, "handoff_entries": 8,
+                "requests_wave1": 155, "rebalance_s": 6.0,
+            },
+            "router_kill": {
+                "performed": True, "routers": 2, "killed": "cr0",
+                "survivor": "cr1", "streams": 10, "inflight_at_kill": 10,
+                "completed": 10, "failed": 0, "failovers": 1, "hedges": 1,
+                "survivor_served": True, "router_kill_s": 0.4,
+            },
+            "meshcheck": {
+                "files": ["cache/rebalance.py", "router/front_door.py"],
+                "findings": 0, "clean": True, "detail": [],
+            },
+            "wall_s": 11.6,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_rebalance(self._report()) == []
+        assert bench.validate_rebalance(7) == ["artifact is not a JSON object"]
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["replication_factor"]
+        del report["rebalance"]["overrides_converged"]
+        del report["router_kill"]["failovers"]
+        del report["meshcheck"]["clean"]
+        missing = bench.validate_rebalance(report)
+        assert "replication_factor" in missing
+        assert "rebalance.overrides_converged" in missing
+        assert "router_kill.failovers" in missing
+        assert "meshcheck.clean" in missing
+
+    def test_gates_enforced(self):
+        report = self._report()
+        report["rebalance"]["skew_after"] = 25.0
+        report["router_kill"]["failed"] = 2
+        report["meshcheck"]["clean"] = False
+        report["meshcheck"]["findings"] = 3
+        problems = "\n".join(bench.validate_rebalance(report))
+        assert "did not strictly drop" in problems
+        assert "LOST to the router kill" in problems
+        assert "statically clean" in problems
+
+    def test_value_gate(self):
+        report = self._report()
+        report["value"] = 0.9
+        problems = "\n".join(bench.validate_rebalance(report))
+        assert "not > 1" in problems
+
+    def test_skipped_sections_gate_exempt(self):
+        report = self._report()
+        report["rebalance"] = {"performed": False}
+        report["router_kill"] = {"performed": False}
+        report["value"] = 0.0
+        assert bench.validate_rebalance(report) == []
+
+    def test_non_dict_sections_are_violations(self):
+        """A present-but-garbage section must not silently skip every
+        gate and validate clean."""
+        report = self._report()
+        report["rebalance"] = True
+        report["router_kill"] = "done"
+        report["meshcheck"] = None
+        problems = "\n".join(bench.validate_rebalance(report))
+        assert "rebalance section is not an object" in problems
+        assert "router_kill section is not an object" in problems
+        assert "meshcheck section is not an object" in problems
+
+    def test_build_report_matches_schema(self):
+        res = {
+            "nodes": 8,
+            "topology": "4 prefill + 2 decode + 2 routers (inproc)",
+            "replication_factor": 2,
+            "rebalance": self._report()["rebalance"],
+            "router_kill": self._report()["router_kill"],
+            "wall_s": 11.6,
+        }
+        report = bench.build_rebalance_report(
+            res, meshcheck=self._report()["meshcheck"]
+        )
+        assert bench.validate_rebalance(report) == []
+        assert report["value"] == round(20.3 / 14.6, 4)
+
+    def test_build_report_without_meshcheck_fails_the_gate(self):
+        # A missing verdict must read as NOT clean, never as vacuously
+        # green.
+        res = {
+            "nodes": 8, "topology": "t", "replication_factor": 2,
+            "rebalance": self._report()["rebalance"],
+            "router_kill": self._report()["router_kill"], "wall_s": 1.0,
+        }
+        report = bench.build_rebalance_report(res)
+        problems = "\n".join(bench.validate_rebalance(report))
+        assert "statically clean" in problems
+
+    def test_rebalance_kind_registered_in_sentinel(self):
+        assert "REBALANCE" in bench.COMPARE_RULES
+        assert bench.artifact_kind(self._report()) == "REBALANCE"
+        assert (
+            bench.artifact_kind({}, "REBALANCE_r14.json") == "REBALANCE"
+        )
+
+    def test_compare_rounds_flags_regressions(self):
+        old = self._report()
+        new = self._report()
+        new["rebalance"]["failed_mid_move"] = 2
+        res = bench.compare_rounds(old, new, kind="REBALANCE")
+        assert res["status"] == "regression"
+        assert "rebalance.failed_mid_move" in res["regressions"]
+
+    def test_checked_in_artifact_validates(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "REBALANCE_r*.json")))
+        assert paths, "no REBALANCE artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_rebalance(report) == []
+        assert report["rebalance"]["performed"] is True
+        assert report["router_kill"]["performed"] is True
+        assert report["meshcheck"]["findings"] == 0

@@ -43,11 +43,42 @@ class TestRanks:
         with pytest.raises(ValueError):
             cluster("nope").local_identity()
 
-    def test_multi_router_rejected(self):
+    def test_multi_router_accepted_with_real_validation(self):
+        """The single-router cap is gone (multi-router front door):
+        N distinct routers validate, and the global rank space accounts
+        for every one of them."""
         cfg = cluster()
         cfg.router_nodes = ["r0", "r1"]
+        cfg.validate()
+        assert cfg.num_total == cfg.num_ring + 2
+        assert cfg.is_router_rank(cfg.num_ring)
+        assert cfg.is_router_rank(cfg.num_ring + 1)
+        assert cfg.addr_of_rank(cfg.num_ring + 1) == "r1"
+        # Role-consistent identity for the second router.
+        cfg2 = cluster()
+        cfg2.router_nodes = ["r0", "r1"]
+        cfg2.local_addr = "r1"
+        assert cfg2.local_identity() == (NodeRole.ROUTER, cfg2.num_ring + 1, 1)
+
+    def test_multi_router_duplicate_rejected(self):
+        cfg = cluster()
+        cfg.router_nodes = ["r0", "r0"]
         with pytest.raises(ValueError):
             cfg.validate()
+
+    def test_multi_router_empty_addr_rejected(self):
+        cfg = cluster()
+        cfg.router_nodes = ["r0", ""]
+        with pytest.raises(ValueError):
+            cfg.validate()
+
+    def test_rebalance_requires_sharding(self):
+        cfg = cluster()
+        cfg.rebalance_interval_s = 1.0
+        with pytest.raises(ValueError):
+            cfg.validate()
+        cfg.replication_factor = 2
+        cfg.validate()
 
     def test_duplicate_addr_rejected(self):
         cfg = cluster()

@@ -66,6 +66,19 @@ class FakeMesh:
 
         return {rank: D(lag) for rank, lag in self._lags.items()}
 
+    def shard_heat(self):
+        # The FleetView heat-map surface (fleet = self): the
+        # rebalancer_asleep rule's skew trajectory source.
+        return dict(self._report, by_rank={})
+
+
+class FakeRebalancePlane:
+    def __init__(self, moves: int = 0):
+        self.moves = moves
+
+    def moves_in_window(self, window_s: float) -> int:
+        return self.moves
+
 
 class FakeKVPlane:
     def __init__(self, queued=0, staged=0):
@@ -336,6 +349,118 @@ class TestSpecEfficiencyRule:
         assert MeshDoctor(engine=eng).diagnose()["findings"] == []
 
 
+class TestRebalancerAsleepRule:
+    """Satellite (PR 14): a SUSTAINED skew peak with zero rebalance
+    moves in the same window is a named pathology — the telemetry sees
+    a storm nothing is acting on. Virtual-clock driven: sustained means
+    seconds above threshold across diagnose samples, never one spike."""
+
+    def _doctor(self, mesh, clock):
+        return MeshDoctor(
+            mesh=mesh,
+            cfg=DoctorConfig(rebalance_window_s=60.0,
+                             rebalance_sustain_s=10.0),
+            now=clock,
+        )
+
+    def test_sustained_skew_with_no_plane_fires(self):
+        clk = FakeClock()
+        mesh = FakeMesh(skew=9.0, hot_shard=7)
+        doctor = self._doctor(mesh, clk)
+        assert not [
+            f for f in doctor.diagnose()["findings"]
+            if f["rule"] == "rebalancer_asleep"
+        ]  # a single spike is not sustained
+        clk.advance(15.0)
+        report = doctor.diagnose()
+        (f,) = [
+            f for f in report["findings"]
+            if f["rule"] == "rebalancer_asleep"
+        ]
+        ev = f["evidence"]
+        assert ev["moves_in_window"] == 0
+        assert ev["plane_armed"] is False
+        assert ev["hot_shard"] == 7
+        assert ev["sustained_s"] >= 10.0
+        assert ev["skew_peak"] >= 9.0
+        for k in RULE_EVIDENCE_FIELDS["rebalancer_asleep"]:
+            assert k in ev
+
+    def test_moves_in_window_silence_the_rule(self):
+        clk = FakeClock()
+        mesh = FakeMesh(skew=9.0)
+        mesh.rebalance = FakeRebalancePlane(moves=2)
+        doctor = self._doctor(mesh, clk)
+        doctor.diagnose()
+        clk.advance(15.0)
+        assert not [
+            f for f in doctor.diagnose()["findings"]
+            if f["rule"] == "rebalancer_asleep"
+        ]
+
+    def test_armed_but_idle_plane_still_fires(self):
+        clk = FakeClock()
+        mesh = FakeMesh(skew=9.0)
+        mesh.rebalance = FakeRebalancePlane(moves=0)
+        doctor = self._doctor(mesh, clk)
+        doctor.diagnose()
+        clk.advance(15.0)
+        (f,) = [
+            f for f in doctor.diagnose()["findings"]
+            if f["rule"] == "rebalancer_asleep"
+        ]
+        assert f["evidence"]["plane_armed"] is True
+
+    def test_short_or_low_skew_stays_silent(self):
+        clk = FakeClock()
+        doctor = self._doctor(FakeMesh(skew=9.0), clk)
+        doctor.diagnose()
+        clk.advance(5.0)  # above threshold but not sustained
+        assert not [
+            f for f in doctor.diagnose()["findings"]
+            if f["rule"] == "rebalancer_asleep"
+        ]
+        clk2 = FakeClock()
+        doctor2 = self._doctor(FakeMesh(skew=2.0), clk2)
+        doctor2.diagnose()
+        clk2.advance(30.0)
+        assert not [
+            f for f in doctor2.diagnose()["findings"]
+            if f["rule"] == "rebalancer_asleep"
+        ]
+
+    def test_sparse_self_samples_do_not_smear(self):
+        """Review hardening: two momentary spikes seen by diagnose
+        calls far apart must NOT read as a sustained storm — a
+        self-sampled point's persistence is capped (the BurnRateTracker
+        staleness discipline), unlike change-compressed history points
+        whose gaps genuinely mean 'unchanged'."""
+        clk = FakeClock()
+        mesh = FakeMesh(skew=9.0)
+        doctor = self._doctor(mesh, clk)
+        doctor.diagnose()  # spike 1
+        clk.advance(600.0)  # ten quiet minutes nobody looked at
+        report = doctor.diagnose()  # spike 2
+        assert not [
+            f for f in report["findings"]
+            if f["rule"] == "rebalancer_asleep"
+        ]
+
+    def test_skew_cooldown_resets_the_window(self):
+        clk = FakeClock()
+        mesh = FakeMesh(skew=9.0)
+        doctor = self._doctor(mesh, clk)
+        doctor.diagnose()
+        clk.advance(6.0)
+        mesh._report["skew_score"] = 1.0  # storm cooled before sustain
+        doctor.diagnose()
+        clk.advance(30.0)
+        assert not [
+            f for f in doctor.diagnose()["findings"]
+            if f["rule"] == "rebalancer_asleep"
+        ]
+
+
 class TestDiagnoseContract:
     def test_absent_seams_drop_rules_from_checked(self):
         # The honesty field: a rule whose input seam is absent never
@@ -353,7 +478,7 @@ class TestDiagnoseContract:
     def test_rules_checked_tracks_attached_seams(self):
         report = MeshDoctor(mesh=FakeMesh(sharded=False)).diagnose()
         assert list(report["rules_checked"]) == [
-            "hot_shard", "replication_lag",
+            "hot_shard", "replication_lag", "rebalancer_asleep",
         ]
         report = MeshDoctor(engine=FakeEngine()).diagnose()
         assert list(report["rules_checked"]) == [
@@ -395,7 +520,7 @@ class TestDiagnoseContract:
         assert crashed and crashed[0]["rule"] == "hot_shard"
         # ...and the mesh's other rule still ran.
         assert list(report["rules_checked"]) == [
-            "hot_shard", "replication_lag",
+            "hot_shard", "replication_lag", "rebalancer_asleep",
         ]
 
     def test_every_rule_has_pinned_evidence_fields(self):

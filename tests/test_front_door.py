@@ -1,0 +1,312 @@
+"""Multi-router front door (router/front_door.py): sticky preference,
+death failover, hedged retry on timeout, Retry-After pacing, revival,
+and the all-dead terminal case."""
+
+import threading
+import time
+
+import pytest
+
+from radixmesh_tpu.router.front_door import (
+    RetryAfter,
+    RouterDied,
+    RouterFrontDoor,
+)
+
+pytestmark = pytest.mark.quick
+
+
+def ok_router(name, log=None):
+    def fn(key):
+        if log is not None:
+            log.append((name, key))
+        return f"{name}:{key}"
+
+    return fn
+
+
+def dead_router(name):
+    def fn(key):
+        raise ConnectionRefusedError(f"{name} down")
+
+    return fn
+
+
+def slow_router(name, delay):
+    def fn(key):
+        time.sleep(delay)
+        return f"{name}:{key}"
+
+    return fn
+
+
+class TestFailover:
+    def test_sticky_preference_on_the_healthy_path(self):
+        log = []
+        fd = RouterFrontDoor(
+            [("r0", ok_router("r0", log)), ("r1", ok_router("r1", log))],
+            hop_timeout_s=0.5,
+        )
+        assert fd.route("a") == "r0:a"
+        assert fd.route("b") == "r0:b"
+        assert all(n == "r0" for n, _ in log)
+        assert fd.failovers == 0
+
+    def test_dead_primary_fails_over_and_sticks_on_survivor(self):
+        fd = RouterFrontDoor(
+            [("r0", dead_router("r0")), ("r1", ok_router("r1"))],
+            hop_timeout_s=0.3,
+        )
+        assert fd.route("k") == "r1:k"
+        assert "r0" in fd.dead_addrs()
+        assert fd.failovers == 1
+        # Sticky on the survivor: no second failover charged.
+        assert fd.route("k2") == "r1:k2"
+        assert fd.failovers == 1
+
+    def test_hedge_on_timeout_first_answer_wins(self):
+        fd = RouterFrontDoor(
+            [("r0", slow_router("r0", 1.5)), ("r1", ok_router("r1"))],
+            hop_timeout_s=0.1,
+        )
+        t0 = time.monotonic()
+        assert fd.route("k") == "r1:k"
+        assert time.monotonic() - t0 < 1.0  # did not wait out the slow leg
+        assert fd.hedges >= 1
+        # The slow router merely straggled — it was hedged past, not
+        # declared dead.
+        assert "r0" not in fd.dead_addrs()
+
+    def test_straggler_completing_first_still_wins(self):
+        # The hedge fires, but the primary answers before the hedge leg:
+        # first answer wins regardless of which leg it came from.
+        fd = RouterFrontDoor(
+            [("r0", slow_router("r0", 0.1)), ("r1", slow_router("r1", 1.0))],
+            hop_timeout_s=0.06,
+        )
+        assert fd.route("k") == "r0:k"
+
+    def test_all_dead_raises_router_died(self):
+        fd = RouterFrontDoor(
+            [("r0", dead_router("r0")), ("r1", dead_router("r1"))],
+            hop_timeout_s=0.1,
+        )
+        with pytest.raises(RouterDied):
+            fd.route("k")
+        assert fd.dead_addrs() == {"r0", "r1"}
+
+    def test_revive_readmits(self):
+        fd = RouterFrontDoor(
+            [("r0", dead_router("r0")), ("r1", ok_router("r1"))],
+            hop_timeout_s=0.2,
+        )
+        fd.route("k")
+        assert "r0" in fd.dead_addrs()
+        fd.revive("r0")
+        assert "r0" not in fd.dead_addrs()
+
+    def test_auto_revival_after_window(self):
+        calls = {"n": 0}
+
+        def flaky(key):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionRefusedError("cold start")
+            return f"r0:{key}"
+
+        clock = {"t": 100.0}
+        fd = RouterFrontDoor(
+            [("r0", flaky)],
+            hop_timeout_s=0.2,
+            revive_after_s=5.0,
+            clock=lambda: clock["t"],
+            sleep=lambda s: None,
+        )
+        with pytest.raises(RouterDied):
+            fd.route("k")
+        assert "r0" in fd.dead_addrs()
+        clock["t"] += 6.0  # past the revival window
+        assert fd.route("k") == "r0:k"
+
+
+class TestRetryAfter:
+    def test_pacing_honored_not_death(self):
+        n = {"c": 0}
+        waits = []
+
+        def shedding(key):
+            n["c"] += 1
+            if n["c"] < 3:
+                raise RetryAfter(0.01)
+            return f"ok:{key}"
+
+        fd = RouterFrontDoor(
+            [("r0", shedding)],
+            hop_timeout_s=0.3,
+            sleep=waits.append,
+        )
+        assert fd.route("k") == "ok:k"
+        assert len(waits) == 2 and fd.shed_waits == 2
+        assert not fd.dead_addrs()  # shedding is flow control, not death
+
+    def test_pacing_capped(self):
+        waits = []
+        n = {"c": 0}
+
+        def shedding(key):
+            n["c"] += 1
+            if n["c"] < 2:
+                raise RetryAfter(60.0)  # hostile hint
+            return "ok"
+
+        fd = RouterFrontDoor(
+            [("r0", shedding)],
+            hop_timeout_s=0.3,
+            retry_after_cap_s=0.5,
+            sleep=waits.append,
+        )
+        assert fd.route("k") == "ok"
+        assert waits == [0.5]
+
+    def test_all_shedding_past_budget_raises(self):
+        def shedding(key):
+            raise RetryAfter(0.001)
+
+        fd = RouterFrontDoor(
+            [("r0", shedding), ("r1", shedding)],
+            hop_timeout_s=0.3,
+            max_shed_waits=2,
+            sleep=lambda s: None,
+        )
+        with pytest.raises(RouterDied):
+            fd.route("k")
+        # Shedding routers are alive — none declared dead.
+        assert not fd.dead_addrs()
+
+    def test_shedding_router_survives_a_straggler_timeout(self):
+        """Review hardening: the final straggler-timeout branch must
+        declare only UNRESOLVED edges dead — an edge that answered
+        with RetryAfter is alive and flow-controlling, and its pacing
+        hint wins over the stragglers' silence."""
+        n = {"c": 0}
+
+        def shedding_then_ok(key):
+            n["c"] += 1
+            if n["c"] <= 2:
+                raise RetryAfter(0.001)
+            return f"ok:{key}"
+
+        fd = RouterFrontDoor(
+            [("r0", shedding_then_ok), ("r1", slow_router("r1", 5.0))],
+            hop_timeout_s=0.05,
+            sleep=lambda s: None,
+        )
+        assert fd.route("k") == "ok:k"
+        # The hung router died; the shedding one never did.
+        assert "r0" not in fd.dead_addrs()
+        assert "r1" in fd.dead_addrs()
+
+    def test_straggler_timeout_after_failover_kills_the_right_edge(self):
+        """Review hardening round 2: failed/shed are keyed by the
+        GLOBAL edge index, and the straggler-timeout kill loop must
+        test that index — not the candidate-list position, which
+        differs once the sticky preference has moved off edge 0. A
+        shedding edge behind a moved preference was being declared
+        dead while the true straggler survived."""
+        a_calls = {"n": 0}
+
+        def edge_a(key):
+            a_calls["n"] += 1
+            if a_calls["n"] == 1:
+                raise ConnectionRefusedError("A cold start")
+            if a_calls["n"] <= 3:
+                raise RetryAfter(0.001)
+            return f"A:{key}"
+
+        fd = RouterFrontDoor(
+            [("A", edge_a), ("B", ok_router("B"))],
+            hop_timeout_s=0.05,
+            sleep=lambda s: None,
+        )
+        # Route 1: A fails, preference moves to B (global index 1).
+        assert fd.route("k1") == "B:k1"
+        fd.revive("A")
+        # B now hangs; A (position 1 in cands, global index 0) sheds
+        # then recovers. The straggler B must die; A must survive its
+        # own RetryAfter and eventually serve.
+        fd._edges[1] = ("B", slow_router("B", 5.0))
+        assert fd.route("k2") == "A:k2"
+        assert "A" not in fd.dead_addrs()
+        assert "B" in fd.dead_addrs()
+
+    def test_leg_workers_are_reused_across_routes(self):
+        """Review hardening round 3: healthy multi-router routes reuse
+        parked daemon workers instead of spawning one thread per
+        request."""
+        threads = []
+
+        def edge(key):
+            threads.append(threading.current_thread())
+            return f"r0:{key}"
+
+        fd = RouterFrontDoor(
+            [("r0", edge), ("r1", ok_router("r1"))], hop_timeout_s=0.5,
+        )
+        for i in range(6):
+            assert fd.route(f"k{i}") == f"r0:k{i}"
+            time.sleep(0.01)  # let the worker park back in the idle pool
+        assert len(set(threads)) == 1  # one reused worker, six routes
+
+    def test_sole_edge_runs_inline(self):
+        """The single-live-edge fast path: no hedge is possible, so no
+        thread is spawned — the leg runs on the caller thread."""
+        seen = []
+
+        def edge(key):
+            seen.append(threading.current_thread())
+            return f"r0:{key}"
+
+        fd = RouterFrontDoor([("r0", edge)], hop_timeout_s=0.2)
+        assert fd.route("k") == "r0:k"
+        assert seen == [threading.current_thread()]
+
+    def test_shed_primary_with_healthy_secondary_wins(self):
+        # The hedge round collects the shed, but the healthy edge
+        # answers: no pacing wait at all.
+        def shedding(key):
+            raise RetryAfter(9.0)
+
+        fd = RouterFrontDoor(
+            [("r0", shedding), ("r1", ok_router("r1"))],
+            hop_timeout_s=0.2,
+            sleep=lambda s: (_ for _ in ()).throw(AssertionError("slept")),
+        )
+        assert fd.route("k") == "r1:k"
+
+
+class TestConcurrency:
+    def test_concurrent_routes_during_failover(self):
+        # Many request threads cross a router death: every route
+        # resolves on the survivor, none raises.
+        fd = RouterFrontDoor(
+            [("r0", dead_router("r0")), ("r1", ok_router("r1"))],
+            hop_timeout_s=0.2,
+        )
+        results, errors = [], []
+
+        def worker(i):
+            try:
+                results.append(fd.route(f"k{i}"))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors
+        assert len(results) == 12
+        assert all(r.startswith("r1:") for r in results)

@@ -147,6 +147,14 @@ class TestExtensionKindRegistration:
             "raise on a graceful departure instead of forwarding it"
         )
 
+    def test_rebalance_kind_is_registered(self):
+        from radixmesh_tpu.cache.oplog import EXTENSION_KINDS, OplogType
+
+        assert OplogType.REBALANCE in EXTENSION_KINDS, (
+            "REBALANCE missing from EXTENSION_KINDS — an old wire would "
+            "raise on an ownership move instead of forwarding it"
+        )
+
 
 class TestTimeoutAudit:
     """No product module parks a thread on a blocking
@@ -300,6 +308,46 @@ class TestConcurrencyPlane:
             # Every edge references declared members only.
             for s, d in table:
                 assert s in members and d in members, (spec.name, s, d)
+
+
+class TestOverridesSingleWriter:
+    """Ownership OVERRIDES have ONE writer (cache/rebalance.py);
+    everything else — the mesh fold included — swaps whole immutable
+    ShardOverrides instances. A second decision-maker forks the owner
+    sets every node derives from."""
+
+    def test_no_module_outside_rebalance_constructs_or_mutates(self):
+        bad = _kept("single-writer-overrides")
+        assert not bad, "\n".join(str(f) for f in bad)
+
+    def test_positive_control_rebalance_module_does_construct(self):
+        out = []
+        SingleWriterChecker()._overrides(
+            "cache/rebalance.py",
+            _index().module("cache/rebalance.py").tree,
+            out,
+        )
+        assert any("ShardOverrides" in f.message for f in out), (
+            "rebalance.py no longer constructs ShardOverrides?"
+        )
+
+    def test_mesh_folds_whole_instances_only(self):
+        """The mesh's fold path goes through _apply_overrides_locked
+        (supersession + whole-map swap) — never through a constructor
+        or a .moves poke (the single-writer wrapper above catches the
+        latter; this pins the structural seam by name)."""
+        tree = _index().module("cache/mesh_cache.py").tree
+        fold_fns = {
+            n.name
+            for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)
+            and n.name in ("_apply_overrides_locked", "adopt_overrides",
+                           "_handle_rebalance")
+        }
+        assert fold_fns == {
+            "_apply_overrides_locked", "adopt_overrides",
+            "_handle_rebalance",
+        }
 
 
 class TestShardHeatSingleWriter:

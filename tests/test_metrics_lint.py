@@ -93,6 +93,15 @@ def _register_all_instrumented_families() -> None:
 
     with tempfile.TemporaryDirectory() as bb_dir:
         BlackBox(bb_dir, history=TelemetryHistory(), node="lint-bb")
+    # The robustness loop (PR 14): the rebalancer's move counter +
+    # per-shard rf-boost gauge (cache/rebalance.py) and the
+    # multi-router front door's failover/hedge/pacing counters
+    # (router/front_door.py).
+    from radixmesh_tpu.cache.rebalance import RebalancePlane
+    from radixmesh_tpu.router.front_door import RouterFrontDoor
+
+    RebalancePlane(pd_mesh).close()
+    RouterFrontDoor([("r0", lambda *a: None)], name="lint-fd")
 
 
 def _registered_families() -> dict[str, str]:
@@ -521,3 +530,23 @@ class TestMetricHygiene:
         # The new gauge suffixes are conscious vocabulary additions.
         assert "_series" in GAUGE_SUFFIXES
         assert "_points" in GAUGE_SUFFIXES
+
+    def test_rebalance_and_frontdoor_families_registered(self):
+        """Satellite (PR 14): the rebalancer's cause-labeled move
+        counter + per-shard rf-boost gauge, and the multi-router front
+        door's failover/hedge/Retry-After counters, are first-class
+        families from construction — with `_rf_boost` a conscious
+        vocabulary addition."""
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        assert fams.get("radixmesh_rebalance_moves_total") == "counter"
+        assert fams.get("radixmesh_shard_rf_boost") == "gauge"
+        assert (
+            fams.get("radixmesh_frontdoor_failovers_total") == "counter"
+        )
+        assert fams.get("radixmesh_frontdoor_hedges_total") == "counter"
+        assert (
+            fams.get("radixmesh_frontdoor_retry_after_waits_total")
+            == "counter"
+        )
+        assert "_rf_boost" in GAUGE_SUFFIXES
