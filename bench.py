@@ -140,7 +140,13 @@ def validate_ringbench(report: dict) -> list[str]:
 # v1 artifacts (no schema_version; full-replica rows only) stay valid.
 # ----------------------------------------------------------------------
 
-RINGSCALE_SCHEMA_VERSION = 2
+# v3 (PR 15): the sweep carries at least one owner-propagation row
+# measured WITH an adopted ShardOverrides map (the PR 14 deferral) —
+# the override row must pass the same propagation gate as every sharded
+# row, and its measured writer-side serial cost must stay within
+# RINGSCALE_OVERRIDES_SERIAL_MAX_RATIO of the matching no-override row.
+# v1/v2 artifacts stay valid as-is.
+RINGSCALE_SCHEMA_VERSION = 3
 
 RINGSCALE_TOP_FIELDS = (
     "schema_version", "metric", "mode", "sizes", "hop_delays_ms", "rfs",
@@ -151,7 +157,12 @@ RINGSCALE_ROW_FIELDS = (
     "frames_per_insert", "measured_frames_per_insert",
     "ring_bytes_per_insert", "prop_p50_ms", "prop_p99_ms",
 )
+RINGSCALE_OVERRIDE_ROW_FIELDS = (
+    "overrides_active", "boosted_shards", "rf_boost",
+    "writer_serial_p50_ms", "writer_serial_p99_ms",
+)
 RINGSCALE_FLATNESS_MAX_RATIO = 1.5
+RINGSCALE_OVERRIDES_SERIAL_MAX_RATIO = 3.0
 
 
 def validate_ringscale(report) -> list[str]:
@@ -228,6 +239,55 @@ def validate_ringscale(report) -> list[str]:
                     f"N={floor['n_nodes']} ring's {floor['prop_p99_ms']}ms "
                     f"(delay={delay}ms, mode={mode})"
                 )
+    # v3: owner propagation under an ACTIVE override map (the PR 14
+    # deferral). The override row already rode the propagation gate
+    # above (it is a sharded row); additionally its writer-side serial
+    # cost — the component a wider owner fan-out actually grows — must
+    # stay within ratio of the matching no-override row.
+    version = report.get("schema_version")
+    if isinstance(version, int) and version >= 3:
+        ov_rows = [r for r in rows if r.get("overrides_active")]
+        if not ov_rows:
+            problems.append(
+                "v3 artifact has no overrides_active row — the "
+                "owner-propagation-under-overrides measurement is the "
+                "version's whole point"
+            )
+        for row in ov_rows:
+            problems += [
+                f"override row N={row.get('n_nodes')}: missing {f}"
+                for f in RINGSCALE_OVERRIDE_ROW_FIELDS
+                if f not in row
+            ]
+            if int(row.get("rf", 0)) <= 0:
+                problems.append(
+                    "override row must be sharded (rf > 0): overrides "
+                    "mean nothing on a full-replica ring"
+                )
+            pair = next(
+                (
+                    r for r in rows
+                    if not r.get("overrides_active")
+                    and r["n_nodes"] == row["n_nodes"]
+                    and r["rf"] == row["rf"]
+                    and r["hop_delay_ms"] == row["hop_delay_ms"]
+                    and r["mode"] == row["mode"]
+                    and "writer_serial_p99_ms" in r
+                ),
+                None,
+            )
+            if pair is not None and "writer_serial_p99_ms" in row:
+                lim = RINGSCALE_OVERRIDES_SERIAL_MAX_RATIO * max(
+                    1e-6, pair["writer_serial_p99_ms"]
+                )
+                if row["writer_serial_p99_ms"] > lim:
+                    problems.append(
+                        f"overrides: N={row['n_nodes']} rf={row['rf']} "
+                        f"writer-serial p99 {row['writer_serial_p99_ms']}"
+                        f"ms exceeds {RINGSCALE_OVERRIDES_SERIAL_MAX_RATIO}x "
+                        f"the no-override row's "
+                        f"{pair['writer_serial_p99_ms']}ms"
+                    )
     return problems
 
 
@@ -1320,9 +1380,11 @@ def build_analysis_report(
 # ----------------------------------------------------------------------
 
 # v2 (PR 14): the healthy-phase rules_checked gate grew the
-# rebalancer_asleep rule — v1 artifacts validate against the pinned
-# DOCTOR_RULES_V1 six (see _required_doctor_rules).
-DOCTOR_SCHEMA_VERSION = 2
+# rebalancer_asleep rule. v3 (PR 15): it grew tier_thrash (the durable
+# KV tier's flapping detector). Artifacts validate against the rule set
+# pinned for THEIR version (see _required_doctor_rules) — a checked-in
+# artifact can never retroactively have run a rule that postdates it.
+DOCTOR_SCHEMA_VERSION = 3
 
 DOCTOR_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -1362,11 +1424,15 @@ DOCTOR_RULES_V1 = (
     "hot_shard", "prefill_convoy", "restore_park_stall",
     "replication_lag", "slo_burn_rate", "spec_efficiency",
 )
+DOCTOR_RULES_V2 = DOCTOR_RULES_V1 + ("rebalancer_asleep",)
 
 
 def _required_doctor_rules(report, live_rules) -> list[str]:
-    if int(report.get("schema_version", 0) or 0) <= 1:
+    version = int(report.get("schema_version", 0) or 0)
+    if version <= 1:
         return [r for r in live_rules if r in DOCTOR_RULES_V1]
+    if version == 2:
+        return [r for r in live_rules if r in DOCTOR_RULES_V2]
     return list(live_rules)
 
 
@@ -1537,9 +1603,10 @@ def build_doctor_report(res: dict) -> dict:
 # ----------------------------------------------------------------------
 
 # v2 (PR 14): the healthy-phase rules_checked gate grew the
-# rebalancer_asleep rule — v1 artifacts validate against the pinned
-# DOCTOR_RULES_V1 six (see _required_doctor_rules).
-BLACKBOX_SCHEMA_VERSION = 2
+# rebalancer_asleep rule; v3 (PR 15): tier_thrash. Older artifacts
+# validate against their version's pinned rule set
+# (_required_doctor_rules).
+BLACKBOX_SCHEMA_VERSION = 3
 
 BLACKBOX_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -1805,6 +1872,227 @@ def build_rebalance_report(res: dict, meshcheck: dict | None = None) -> dict:
 
 
 # ----------------------------------------------------------------------
+# TIER stable schema (PR 15, the durable KV spill tier): one artifact
+# per round recording (a) hit-rate at a working set >= 10x host
+# capacity beating the no-tier baseline (the tier stack finally
+# outlives DRAM), (b) the restore-overlap contract extended one tier
+# down — decode never blocks on disk restores (KVFLOW's
+# decode-never-blocks discipline), (c) the cold-cell resurrection drill:
+# the WHOLE serving cell killed hard mid-decode, restarted, every
+# interrupted stream resumed byte-identical from disk alone, with
+# seeded torn/corrupt extents detected and dropped rather than served,
+# and (d) meshcheck clean on the new plane (the hotpath-file-io
+# invariant live with its positive control tripping).
+# scripts/tierbench.py is the paired emitter.
+# ----------------------------------------------------------------------
+
+TIER_SCHEMA_VERSION = 1
+
+TIER_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload",
+    "capacity", "spill", "restore_overlap", "cold_start", "corruption",
+    "meshcheck", "page_size", "wall_s",
+)
+TIER_CAPACITY_FIELDS = (
+    "working_set_tokens", "host_slots", "working_set_ratio",
+    "tier_hit_rate", "baseline_hit_rate", "hit_rate_gain",
+    "requests", "distinct_prefixes",
+)
+TIER_SPILL_FIELDS = (
+    "spilled_tokens", "extents", "demotes", "promotes", "drops",
+    "resident_bytes",
+)
+TIER_RESTORE_FIELDS = (
+    "parked_requests", "disk_restored_tokens",
+    "decode_steps_during_restore", "max_decode_gap_s", "overlap_ok",
+)
+TIER_COLD_START_FIELDS = (
+    "performed", "interrupted", "resumed", "byte_identical", "failed",
+    "disk_hit_tokens", "grafted_nodes", "orphaned",
+    "corrupt_detected", "corrupt_served", "restart_s",
+)
+TIER_CORRUPTION_FIELDS = (
+    "extents_attacked", "truncated", "bitflipped", "detected",
+    "served_corrupt",
+)
+TIER_MESHCHECK_FIELDS = ("files", "findings", "clean")
+TIER_MIN_WORKING_SET_RATIO = 10.0
+
+
+def validate_tier(report) -> list[str]:
+    """Schema violations of a TIER artifact vs the pinned contract
+    (empty = valid). Gates: working set >= 10x host capacity with the
+    tier's hit-rate strictly beating the no-tier baseline; decode
+    progress > 0 while disk restores were parked (the restore-overlap
+    contract one tier down); the cold-start phase losing zero requests,
+    resuming every interrupted stream byte-identical from disk alone,
+    and detecting (never serving) every seeded corrupt/torn extent; and
+    meshcheck clean on the tier plane. performed=False sections are
+    schema-valid but gate-exempt (the CHAOS convention). Import-safe
+    from artifact tests and scripts/tierbench.py (no jax at module
+    scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in TIER_TOP_FIELDS if f not in report]
+    for section, fields in (
+        ("capacity", TIER_CAPACITY_FIELDS),
+        ("spill", TIER_SPILL_FIELDS),
+        ("restore_overlap", TIER_RESTORE_FIELDS),
+        ("cold_start", TIER_COLD_START_FIELDS),
+        ("corruption", TIER_CORRUPTION_FIELDS),
+        ("meshcheck", TIER_MESHCHECK_FIELDS),
+    ):
+        sec = report.get(section)
+        if section in report and not isinstance(sec, dict):
+            problems.append(f"{section} section is not an object")
+            continue
+        if isinstance(sec, dict):
+            if section == "cold_start" and not sec.get("performed"):
+                # The CHAOS convention: a skipped phase is schema-valid
+                # ({"performed": False}) but gate-exempt.
+                continue
+            problems += [f"{section}.{f}" for f in fields if f not in sec]
+    cap = report.get("capacity")
+    if isinstance(cap, dict):
+        ratio = cap.get("working_set_ratio")
+        if isinstance(ratio, (int, float)) and ratio < TIER_MIN_WORKING_SET_RATIO:
+            problems.append(
+                f"capacity: working set only {ratio}x host capacity "
+                f"(gate {TIER_MIN_WORKING_SET_RATIO}x) — the claim is "
+                "'past DRAM', not 'fits in DRAM'"
+            )
+        t, b = cap.get("tier_hit_rate"), cap.get("baseline_hit_rate")
+        if (
+            isinstance(t, (int, float))
+            and isinstance(b, (int, float))
+            and not t > b
+        ):
+            problems.append(
+                f"capacity: tier hit-rate {t} does not beat the no-tier "
+                f"baseline {b}"
+            )
+    ro = report.get("restore_overlap")
+    if isinstance(ro, dict):
+        if not ro.get("parked_requests", 0):
+            problems.append(
+                "restore_overlap: zero parked disk restores — the "
+                "overlap claim never saw a disk restore"
+            )
+        steps = ro.get("decode_steps_during_restore")
+        if isinstance(steps, (int, float)) and not steps > 0:
+            problems.append(
+                "restore_overlap: decode made zero progress while disk "
+                "restores were in flight (decode-never-blocks contract, "
+                "one tier down)"
+            )
+        if ro.get("overlap_ok") is not True:
+            problems.append("restore_overlap: overlap_ok is not True")
+    cs = report.get("cold_start")
+    if isinstance(cs, dict) and cs.get("performed"):
+        if cs.get("failed", 1) != 0:
+            problems.append(
+                f"cold_start: {cs.get('failed')} request(s) failed — "
+                "the full-restart drill must lose nothing"
+            )
+        if not cs.get("interrupted", 0):
+            problems.append(
+                "cold_start: zero interrupted streams — nothing was "
+                "proven about mid-decode crash recovery"
+            )
+        if cs.get("resumed") != cs.get("interrupted"):
+            problems.append(
+                f"cold_start: resumed {cs.get('resumed')} != interrupted "
+                f"{cs.get('interrupted')}"
+            )
+        if cs.get("byte_identical") is not True:
+            problems.append(
+                "cold_start: resumed streams were NOT byte-identical to "
+                "their pre-kill expectation"
+            )
+        if not cs.get("disk_hit_tokens", 0):
+            problems.append(
+                "cold_start: zero disk-served hit tokens after restart "
+                "— recovery never actually read the durable tier"
+            )
+        if not cs.get("corrupt_detected", 0):
+            problems.append(
+                "cold_start: the seeded corrupt extent was not detected"
+            )
+        if cs.get("corrupt_served", 1) != 0:
+            problems.append(
+                f"cold_start: {cs.get('corrupt_served')} corrupt "
+                "extent(s) SERVED — the checksum gate failed"
+            )
+    cor = report.get("corruption")
+    if isinstance(cor, dict):
+        attacked = int(cor.get("extents_attacked", 0) or 0)
+        if attacked:
+            if cor.get("detected") != attacked:
+                problems.append(
+                    f"corruption: {cor.get('detected')} of {attacked} "
+                    "attacked extents detected — torn tails/bit-flips "
+                    "must never go unnoticed"
+                )
+            if cor.get("served_corrupt", 1) != 0:
+                problems.append(
+                    f"corruption: {cor.get('served_corrupt')} corrupt "
+                    "extent(s) served"
+                )
+    mc = report.get("meshcheck")
+    if isinstance(mc, dict):
+        if mc.get("clean") is not True or mc.get("findings", 1) != 0:
+            problems.append(
+                f"meshcheck: {mc.get('findings')} finding(s) on the "
+                "tier plane — the hotpath-file-io boundary must be "
+                "statically clean"
+            )
+    val = report.get("value")
+    if isinstance(cap, dict):
+        if not isinstance(val, (int, float)) or val <= 1.0:
+            problems.append(
+                f"value: hit-rate gain {val} is not > 1 (the tier did "
+                "not beat the no-tier baseline)"
+            )
+    return problems
+
+
+def build_tier_report(res: dict, meshcheck: dict | None = None) -> dict:
+    """Assemble a schema-complete TIER artifact from
+    ``workload.run_tier_workload``'s result plus a meshcheck verdict."""
+    cap = res.get("capacity", {}) or {}
+    return {
+        "schema_version": TIER_SCHEMA_VERSION,
+        "metric": "tier_hit_rate_gain",
+        "value": cap.get("hit_rate_gain"),
+        "unit": (
+            "prefix-cache hit-rate with the durable disk tier / no-tier "
+            "baseline, at a working set >= 10x host capacity (> 1 = the "
+            "tier serves what DRAM alone cannot), with decode never "
+            "blocking on disk restores and a whole-cell kill-and-restart "
+            "resuming every stream byte-identical from disk alone"
+        ),
+        "workload": (
+            "zipf re-visit traffic over a working set 10x the host "
+            "arena (tier vs no-tier engines), a parked-disk-restore "
+            "decode-overlap phase, and a cold-cell drill: every volatile "
+            "tier destroyed mid-decode, one extent bit-flipped + one "
+            "truncated, the cell restarted from the extent directory "
+            "and interrupted streams resumed byte-identical "
+            "(see workload.run_tier_workload)"
+        ),
+        "capacity": cap,
+        "spill": res.get("spill", {}),
+        "restore_overlap": res.get("restore_overlap", {}),
+        "cold_start": res.get("cold_start", {}),
+        "corruption": res.get("corruption", {}),
+        "meshcheck": meshcheck
+        or {"files": [], "findings": -1, "clean": False},
+        "page_size": res.get("page_size"),
+        "wall_s": res.get("wall_s"),
+    }
+
+
+# ----------------------------------------------------------------------
 # compare_rounds (PR 12, the bench regression sentinel): schema-aware
 # diffing of any two SAME-schema artifacts. Eleven artifact schemas
 # accumulated over eleven rounds with nothing machine-checking the
@@ -1889,6 +2177,13 @@ COMPARE_RULES: dict = {
         ("router_kill.failed", "lower", 0.0),
         ("meshcheck.findings", "lower", 0.0),
     ),
+    "TIER": (
+        ("value", "higher", 0.30),  # hit-rate gain over no-tier
+        ("cold_start.failed", "lower", 0.0),  # any rise flags
+        ("cold_start.corrupt_served", "lower", 0.0),
+        ("restore_overlap.decode_steps_during_restore", "higher", 0.50),
+        ("meshcheck.findings", "lower", 0.0),
+    ),
     # Kinds with no pinned directional metrics still get the schema
     # check + informational numeric diff.
     "SLO": (),
@@ -1912,6 +2207,7 @@ _METRIC_KINDS = {
     "doctor_pathologies_named": "DOCTOR",
     "blackbox_postmortem_named": "BLACKBOX",
     "rebalance_skew_drop_ratio": "REBALANCE",
+    "tier_hit_rate_gain": "TIER",
     "slo_goodput_vs_offered_load": "SLO",
     "soak_requests": "SOAK",
 }
@@ -2134,24 +2430,45 @@ def benchdiff_selfcheck() -> dict:
         # One lost verdict: the zero-threshold value rule must flag it.
         "value": BLACKBOX_NAMED_TOTAL - 1,
     }
+    tier_base = {
+        "metric": "tier_hit_rate_gain",
+        "schema_version": TIER_SCHEMA_VERSION,
+        "value": 8.0,
+        "cold_start": {"failed": 0, "corrupt_served": 0},
+        "restore_overlap": {"decode_steps_during_restore": 40},
+        "meshcheck": {"findings": 0},
+    }
+    tier_regressed = {
+        **tier_base,
+        # One corrupt extent served: the zero-threshold rule must flag.
+        "cold_start": {"failed": 0, "corrupt_served": 1},
+    }
     identical = compare_rounds(base, dict(base), kind="CHAOS")
     regression = compare_rounds(base, regressed, kind="CHAOS")
     mismatch = compare_rounds(base, other_kind)
     bb_identical = compare_rounds(bb_base, dict(bb_base), kind="BLACKBOX")
     bb_regression = compare_rounds(bb_base, bb_regressed, kind="BLACKBOX")
     bb_mismatch = compare_rounds(bb_base, base)
+    t_identical = compare_rounds(tier_base, dict(tier_base), kind="TIER")
+    t_regression = compare_rounds(tier_base, tier_regressed, kind="TIER")
+    t_mismatch = compare_rounds(tier_base, base)
     return {
         "identical_clean": identical["status"] == "clean"
-        and bb_identical["status"] == "clean",
+        and bb_identical["status"] == "clean"
+        and t_identical["status"] == "clean",
         "regression_flagged": regression["status"] == "regression"
         and "repair.converge_s" in regression["regressions"]
         and bb_regression["status"] == "regression"
-        and "value" in bb_regression["regressions"],
+        and "value" in bb_regression["regressions"]
+        and t_regression["status"] == "regression"
+        and "cold_start.corrupt_served" in t_regression["regressions"],
         "mismatch_detected": mismatch["status"] == "schema_mismatch"
-        and bb_mismatch["status"] == "schema_mismatch",
-        "kinds_covered": ["CHAOS", "BLACKBOX"],
+        and bb_mismatch["status"] == "schema_mismatch"
+        and t_mismatch["status"] == "schema_mismatch",
+        "kinds_covered": ["CHAOS", "BLACKBOX", "TIER"],
         "regressions_seen": regression["regressions"]
-        + bb_regression["regressions"],
+        + bb_regression["regressions"]
+        + t_regression["regressions"],
     }
 
 

@@ -29,6 +29,15 @@ Invariants:
   (``np.asarray(pool.gather...)``, ``gather_padded``, inline
   ``host.read``) or force a device sync; ``cache/kv_transfer.py`` is
   the ONE module allowed to block on device→host data.
+- ``hotpath-file-io`` — the PR 15 durable-tier boundary: no blocking
+  file I/O (builtin ``open``, ``os.fsync``/``os.replace``/renames/
+  unlinks/dir scans, pathlib read/write helpers) REACHABLE from a
+  serving entry point. The disk tier (``cache/kv_tier.py``) does all
+  of this on the KV-plane worker and on cold boot/drain paths; a
+  refactor that drags an extent read into ``Engine.step``,
+  ``match_prefix``, or the oplog receive path is a serving stall the
+  size of a disk seek, and this invariant is how it gets caught two
+  frames down.
 """
 
 from __future__ import annotations
@@ -141,6 +150,30 @@ def _is_unbounded_blocking(call: ast.Call) -> str | None:
     return None
 
 
+# Blocking file-I/O shapes for the ``hotpath-file-io`` invariant:
+# builtin/io open, the os-module file mutators the extent store uses,
+# and the pathlib one-shot read/write helpers.
+_FILE_IO_OS = {
+    "os.fsync", "os.replace", "os.rename", "os.remove", "os.unlink",
+    "os.listdir", "os.makedirs", "io.open",
+}
+_FILE_IO_ATTRS = ("write_bytes", "write_text", "read_bytes", "read_text")
+
+
+def _is_file_io(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name == "open":
+        return "open()"
+    if name in _FILE_IO_OS:
+        return name
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _FILE_IO_ATTRS
+    ):
+        return f".{call.func.attr}()"
+    return None
+
+
 def _is_device_sync(call: ast.Call) -> str | None:
     if isinstance(call.func, ast.Attribute) and call.func.attr == "block_until_ready":
         return "block_until_ready"
@@ -192,6 +225,7 @@ class HotPathChecker:
     )
     invariants = (
         "hotpath-blocking", "timeout-audit", "sleep-audit", "hotpath-sync",
+        "hotpath-file-io",
     )
 
     def __init__(self, entry_points=DEFAULT_ENTRY_POINTS):
@@ -229,6 +263,7 @@ class HotPathChecker:
                 if not isinstance(node, ast.Call):
                     continue
                 label = None
+                inv = None
                 if _is_time_sleep(node, bare, mods):
                     label, inv = "time.sleep", "sleep-audit"
                 else:
@@ -239,9 +274,22 @@ class HotPathChecker:
                         d = _is_device_sync(node)
                         if d is not None and hot:
                             label, inv = d, "hotpath-blocking"
+                        elif hot:
+                            f_io = _is_file_io(node)
+                            if f_io is not None:
+                                label, inv = f_io, "hotpath-file-io"
                 if label is None:
                     continue
-                if hot:
+                if inv == "hotpath-file-io":
+                    chain = " -> ".join(chains[(rel, qual)])
+                    findings.append(Finding(
+                        rel, node.lineno, "hotpath-file-io",
+                        f"{label} — blocking file I/O on a serving hot "
+                        f"path (reached via {chain}); the disk tier "
+                        "does file I/O only on the KV-plane worker "
+                        "(cache/kv_tier.py threading contract)",
+                    ))
+                elif hot:
                     chain = " -> ".join(chains[(rel, qual)])
                     findings.append(Finding(
                         rel, node.lineno, "hotpath-blocking",

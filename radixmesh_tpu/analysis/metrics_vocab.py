@@ -59,6 +59,7 @@ GAUGE_SUFFIXES = UNIT_SUFFIXES + (
     "_series",  # telemetry-history ring count (obs/timeseries.py)
     "_points",  # telemetry-history retained points (obs/timeseries.py)
     "_rf_boost",  # extra owners beyond the base walk (cache/rebalance.py)
+    "_extents",  # committed durable-tier extent files (cache/kv_tier.py)
 )
 
 _KINDS = ("counter", "gauge", "histogram")

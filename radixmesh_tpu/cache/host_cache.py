@@ -44,7 +44,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from radixmesh_tpu.cache.kv_pool import PagedKVPool, _pad_to_bucket, SlotAllocator
-from radixmesh_tpu.cache.radix_tree import MatchResult, RadixTree, TreeNode
+from radixmesh_tpu.cache.radix_tree import (
+    MatchResult,
+    RadixTree,
+    TreeNode,
+    match_len,
+)
 from radixmesh_tpu.obs.metrics import get_registry
 from radixmesh_tpu.utils.logging import get_logger
 
@@ -141,6 +146,7 @@ class HierarchicalCache(RadixTree):
         pool: PagedKVPool,
         host_store: HostKVStore,
         page_size: int | None = None,
+        disk_tier=None,
         **tree_kw,
     ):
         if pool.quant != host_store.quant:
@@ -151,6 +157,12 @@ class HierarchicalCache(RadixTree):
             )
         self.pool = pool
         self.host = host_store
+        # Durable third tier (cache/kv_tier.py::DiskKVTier). Disk
+        # restores and spills are ONLY reachable through the staged
+        # plane (file I/O is lint-banned from the admission path), so a
+        # disk tier without a plane is write-only dead weight — the
+        # engine arms the plane whenever it arms the tier.
+        self.disk = disk_tier
         self.log = get_logger("hicache")
         # Async KV-movement plane (cache/kv_transfer.py). None = every
         # copy is synchronous (the seed behavior, still the test
@@ -186,6 +198,11 @@ class HierarchicalCache(RadixTree):
             on_free_host=host_store.free,
             **tree_kw,
         )
+        if self.disk is not None:
+            # Extent refs leaving the tree (split/remove/reset) queue
+            # for worker-side unlink — an in-memory append, never file
+            # I/O on the engine thread.
+            self.on_disk_detach = self.disk.retire
 
     # ---- device eviction with write-back ----
 
@@ -226,6 +243,10 @@ class HierarchicalCache(RadixTree):
         room."""
         if node.host_value is not None:
             return True  # already backed up: re-eviction is free
+        if node.disk_value is not None:
+            # Durable on disk: demotion straight past the host tier is
+            # free too — the node stays matchable through its extent.
+            return True
         slots = np.asarray(node.value, dtype=np.int32)
         host_slots = self.host.alloc(len(slots))
         if host_slots is None:
@@ -261,9 +282,41 @@ class HierarchicalCache(RadixTree):
             self.host.write(all_host, *gather_padded(self.pool, all_slots))
 
     def _evict_host(self, num_tokens: int) -> int:
-        """LRU-drop host-ONLY nodes (never nodes that still hold device KV
-        — their host copy is just a free re-eviction) to make arena room."""
+        """Make arena room, preferring DEMOTE over DROP: a host copy
+        already destaged to a disk extent (``disk_value`` set) frees its
+        arena slots without losing the prefix — the node stays in the
+        tree, disk-resident. Only when that is not enough are host-ONLY
+        nodes LRU-dropped for real (the node dies; the prefix
+        recomputes). Never touches nodes that still hold device KV
+        (their host copy is just a free re-eviction) or nodes a staged
+        restore/spill is reading."""
         plane = self.plane
+        freed = 0
+        # Pass 1 — demote: disk-backed host copies are free to shed (any
+        # node, not just leaves: the node itself stays in the tree).
+        demote_host: list[np.ndarray] = []
+        for n in self._all_nodes():
+            if freed >= num_tokens:
+                break
+            if (
+                n is not self.root
+                and n.value is None
+                and n.host_value is not None
+                and n.disk_value is not None
+                and n.lock_ref == 0
+                and (
+                    plane is None
+                    or not (plane.is_pending(n) or plane.spill_pending(n))
+                )
+            ):
+                freed += len(n.host_value)
+                demote_host.append(n.host_value)
+                n.host_value = None
+        if demote_host:
+            self.host.free(np.concatenate(demote_host))
+        if freed >= num_tokens:
+            return freed
+        # Pass 2 — drop: LRU host-only leaves die for real.
         candidates = [
             n
             for n in self._all_nodes()
@@ -274,11 +327,14 @@ class HierarchicalCache(RadixTree):
             and not n.children  # leaves only: keep paths connected
             # A node mid-restore must keep its arena slots until the
             # staged copy lands (the plane's pending map is the host-tier
-            # analog of lock_ref).
-            and (plane is None or not plane.is_pending(n))
+            # analog of lock_ref); a node mid-spill must keep them until
+            # the extent commits.
+            and (
+                plane is None
+                or not (plane.is_pending(n) or plane.spill_pending(n))
+            )
         ]
         heapq.heapify(candidates)
-        freed = 0
         freed_host: list[np.ndarray] = []
         while candidates and freed < num_tokens:
             node = heapq.heappop(candidates)
@@ -292,11 +348,179 @@ class HierarchicalCache(RadixTree):
                 and parent.host_value is not None
                 and parent.lock_ref == 0
                 and not parent.children
+                # Same shields as the initial candidate filter: a node
+                # whose arena slots a staged restore or an in-flight
+                # spill is reading must not be dropped mid-read (the
+                # spill would otherwise commit a checksum-valid extent
+                # of recycled bytes).
+                and (
+                    plane is None
+                    or not (
+                        plane.is_pending(parent)
+                        or plane.spill_pending(parent)
+                    )
+                )
             ):
                 heapq.heappush(candidates, parent)
         if freed_host:
             self.host.free(np.concatenate(freed_host))
         return freed
+
+    # ---- durable disk tier (cache/kv_tier.py) ----
+
+    @staticmethod
+    def path_tokens(node: TreeNode) -> np.ndarray:
+        """Root→parent token path above ``node`` (the extent's prefix
+        field — what makes a spilled segment restorable by path alone)."""
+        parts = []
+        p = node.parent
+        while p is not None and p.parent is not None:  # stop at the root
+            parts.append(p.key)
+            p = p.parent
+        if not parts:
+            return np.empty(0, dtype=np.int32)
+        return np.concatenate(
+            [np.asarray(k, dtype=np.int32) for k in reversed(parts)]
+        )
+
+    def destage_cold(
+        self,
+        *,
+        watermark: float = 0.7,
+        min_heat: float = 0.0,
+        budget: int = 16,
+        force: bool = False,
+        now: float | None = None,
+    ) -> int:
+        """Write-behind destage: once the host arena fills past
+        ``watermark``, schedule disk spills for host-resident nodes not
+        yet extent-backed — coldest first, since they are next in the
+        eviction line — so that when ``_evict_host`` later needs room,
+        those nodes DEMOTE (arena slots freed, prefix kept on disk)
+        instead of dying. Per-node decayed heat (``kv_tier.node_heat``,
+        the PR 9 decay math per-node) draws the demote-vs-die line:
+        nodes colder than ``min_heat`` are not worth the disk write and
+        are left to die. ``force=True`` (the drain path) spills
+        everything regardless of watermark and heat. Returns spills
+        submitted; the plane worker does the file I/O and the engine's
+        next pump commits the refs."""
+        if self.disk is None or self.plane is None:
+            return 0
+        if self.host.num_slots <= 0:
+            return 0
+        fill = 1.0 - self.host.free_slots / self.host.num_slots
+        if not force and fill < watermark:
+            return 0
+        from radixmesh_tpu.cache.kv_tier import node_heat
+
+        t = self._time() if now is None else now
+        plane = self.plane
+        cands: list[TreeNode] = []
+        for n in self._all_nodes():
+            if (
+                n is self.root
+                or n.host_value is None
+                or n.disk_value is not None
+                or plane.is_pending(n)
+                or plane.spill_pending(n)
+            ):
+                continue
+            if not force and node_heat(n, t) < min_heat:
+                continue  # too cold to be worth a disk write: it may die
+            cands.append(n)
+        cands.sort(key=lambda n: n.last_access_time)  # coldest first
+        submitted = 0
+        for n in cands:
+            if submitted >= budget:
+                break
+            if plane.submit_spill(self, n, self.path_tokens(n)):
+                submitted += 1
+        return submitted
+
+    def resurrect_from_disk(self) -> dict:
+        """Cold-cell resurrection (COLD PATH: boot-time blocking file
+        I/O — never reachable from the serving entry points): scan the
+        extent directory, drop every torn/corrupt extent
+        (checksum-verified), and graft the verified paths back into the
+        tree as disk-resident nodes. Extents whose ancestor chain is
+        not fully covered (their parents' KV died un-spilled) are
+        orphans — unreachable prefixes — and are retired: restore
+        degrades to the longest VERIFIED prefix, never serves holes."""
+        out = {
+            "extents": 0,
+            "grafted_nodes": 0,
+            "grafted_tokens": 0,
+            "orphaned": 0,
+            "keys": [],
+        }
+        if self.disk is None:
+            return out
+        metas = self.disk.scan()
+        out["extents"] = len(metas)
+        for meta in metas:
+            node = self._graft_extent(meta)
+            if node is None:
+                out["orphaned"] += 1
+                self.disk.retire(meta.ref)
+            else:
+                out["grafted_nodes"] += 1
+                out["grafted_tokens"] += len(meta.seg_tokens)
+                out["keys"].append(
+                    np.concatenate(
+                        [
+                            np.asarray(meta.prefix_tokens, dtype=np.int32),
+                            np.asarray(meta.seg_tokens, dtype=np.int32),
+                        ]
+                    )
+                )
+        self.disk.drain_retired()  # cold path: inline unlink is fine
+        if out["grafted_nodes"]:
+            self.log.info(
+                "resurrected %d disk-resident node(s) / %d tokens from "
+                "%s (%d orphaned)",
+                out["grafted_nodes"], out["grafted_tokens"],
+                self.disk.dir, out["orphaned"],
+            )
+        return out
+
+    def _graft_extent(self, meta) -> TreeNode | None:
+        """Attach one verified extent under its recorded path; None =
+        orphan (prefix not fully covered, boundary mismatch, or the
+        slot is already occupied by live KV)."""
+        cur = self.root
+        toks = np.asarray(meta.prefix_tokens, dtype=np.int32)
+        i = 0
+        while i < len(toks):
+            child = cur.children.get(self._child_key(toks[i:]))
+            if child is None:
+                return None
+            m = match_len(child.key, toks[i:])
+            if m < len(child.key):
+                return None  # boundary mismatch: degrade to orphan
+            i += m
+            cur = child
+        seg = np.asarray(meta.seg_tokens, dtype=np.int32)
+        if len(seg) == 0:
+            return None
+        ck = self._child_key(seg)
+        existing = cur.children.get(ck)
+        if existing is not None:
+            if (
+                len(existing.key) == len(seg)
+                and match_len(existing.key, seg) == len(seg)
+                and existing.value is None
+                and existing.host_value is None
+                and existing.disk_value is None
+            ):
+                existing.disk_value = meta.ref
+                return existing
+            return None
+        leaf = TreeNode(parent=cur)
+        leaf.key = seg
+        leaf.disk_value = meta.ref
+        cur.children[ck] = leaf
+        self._fp_attach(leaf)
+        return leaf
 
     def _drop_poisoned_host(self, node: TreeNode) -> None:
         """Retire a host copy whose write-back never landed (plane

@@ -1,0 +1,605 @@
+"""Durable KV spill tier: checksummed, fsynced, schema-versioned extent files.
+
+Every tier above this one is volatile — HBM dies with the process, the
+host arena (``cache/host_cache.py``) dies with the machine. This module
+is the third tier: local-disk **extent files**, one per spilled radix
+node segment, written with the black box's crash-discipline
+(``obs/blackbox.py``): write-to-temp + flush + ``os.fsync`` +
+``os.replace``, so the rename is the commit point and a ``kill -9`` at
+any instant leaves every previously committed extent intact and at most
+one uncommitted temp file (cleaned at the next scan). A committed
+extent that is later truncated or bit-flipped is detected by its CRC
+and **dropped, never served** — restore degrades to a shorter verified
+prefix.
+
+The radix structure is what makes durable spill cheap: a prefix is an
+append-only token chain, so an extent records its full root→node token
+*path* plus its segment's KV bytes and is restorable by path alone —
+no index, no journal, no compaction. **Cold-cell resurrection** is a
+directory scan: verify every extent, graft the verified paths back
+into an empty tree (``HierarchicalCache.resurrect_from_disk``), and the
+node serves its pre-crash working set from disk even when every replica
+died.
+
+Threading contract (lint-pinned by ``analysis/hot_path.py``'s
+``hotpath-file-io`` invariant): all blocking file I/O here runs on the
+KV-transfer plane's worker thread (spills, reads, unlinks) or on cold
+paths (boot-time ``scan``, drain). The engine thread only manipulates
+in-memory :class:`ExtentRef` objects; deletions it triggers are queued
+via :meth:`retire` and unlinked later by the worker
+(:meth:`drain_retired`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+from radixmesh_tpu.obs.metrics import TRANSFER_SECONDS_BUCKETS, get_registry
+from radixmesh_tpu.utils.logging import get_logger
+
+__all__ = [
+    "EXTENT_SCHEMA_VERSION",
+    "ExtentRef",
+    "ExtentMeta",
+    "DiskKVTier",
+    "node_heat",
+]
+
+EXTENT_SCHEMA_VERSION = 1
+
+# Fixed preamble: magic, schema version, header-JSON length, header CRC.
+# The JSON header carries shapes/dtype/CRCs; its own CRC makes a torn or
+# flipped header detectable before any field is trusted.
+_MAGIC = b"RMKV"
+_PRE = struct.Struct("<4sHHII")  # magic, schema, reserved, hdr_len, hdr_crc
+
+# Per-node decayed heat (the PR 9 decay math applied per-node, not
+# per-shard): a node's hit count halves every ``half_life_s`` of
+# idleness. This is the demote-vs-die signal — warm-but-cold-ish
+# subtrees are worth a disk write; stone-cold ones are not.
+NODE_HEAT_HALF_LIFE_S = 120.0
+
+
+def node_heat(node, now: float, half_life_s: float = NODE_HEAT_HALF_LIFE_S) -> float:
+    """Exponentially-decayed per-node heat: ``hit_count`` halved per
+    ``half_life_s`` since the node's last touch."""
+    age = max(0.0, now - node.last_access_time)
+    return float(node.hit_count) * 0.5 ** (age / max(1e-9, half_life_s))
+
+
+@dataclass(frozen=True)
+class ExtentRef:
+    """In-memory handle to one committed extent (what
+    ``TreeNode.disk_value`` holds). ``len()`` is the segment token
+    count, mirroring how ``host_value``/``value`` report length in
+    :class:`~radixmesh_tpu.cache.radix_tree.MatchResult`."""
+
+    path: str  # absolute extent file path
+    n_seg: int  # segment token count
+    nbytes: int  # committed file size
+    shard: int  # bounded subtree id for the thrash/moves telemetry
+
+    def __len__(self) -> int:
+        return self.n_seg
+
+
+@dataclass(frozen=True)
+class ExtentMeta:
+    """One verified extent from a boot-time :meth:`DiskKVTier.scan`."""
+
+    ref: ExtentRef
+    prefix_tokens: np.ndarray  # root→parent token path (may be empty)
+    seg_tokens: np.ndarray  # this node's own key segment
+
+
+def _shard_of(tokens: np.ndarray, page_size: int) -> int:
+    """Bounded subtree id for tier telemetry: the same first-page
+    blake2b bucketing the sharding plane uses, independent of whether
+    the owning tree tracks shards."""
+    from radixmesh_tpu.cache.sharding import NUM_SHARDS, shard_of_tokens
+
+    head = np.asarray(tokens[: max(1, page_size)], dtype=np.int32)
+    if len(head) == 0:
+        return 0
+    return int(shard_of_tokens(head)) % NUM_SHARDS
+
+
+class DiskKVTier:
+    """The extent store. One instance per engine, one directory per
+    node. Thread-safety: the in-memory books (resident bytes, extent
+    map, retire queue, recent-move ring) are lock-guarded; file I/O
+    methods (:meth:`write_extent`, :meth:`read_extent`, :meth:`scan`,
+    :meth:`drain_retired`) must run on the plane worker or a cold path
+    (see module docstring)."""
+
+    def __init__(
+        self,
+        dir: str,
+        *,
+        capacity_bytes: int = 1 << 30,
+        page_size: int = 1,
+        name: str = "engine",
+    ):
+        self.dir = os.path.abspath(dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.capacity_bytes = int(capacity_bytes)
+        self.page_size = max(1, int(page_size))
+        self.name = name
+        self.log = get_logger("kvtier")
+        self._lock = threading.Lock()
+        # extent file path → ExtentRef (the live, committed set)
+        self._extents: dict[str, ExtentRef] = {}
+        self._resident_bytes = 0
+        self._retired: deque[ExtentRef] = deque()
+        # (monotonic t, shard, "demote"|"promote") ring — the doctor's
+        # tier_thrash fallback input when no history ring is attached.
+        self.recent_moves: deque = deque(maxlen=4096)
+
+        reg = get_registry()
+        lbl = {"tier": name}
+        self._m_spilled = reg.counter(
+            "radixmesh_kv_tier_spilled_tokens_total",
+            "tokens demoted host RAM -> disk extents (committed writes)",
+            ("tier",),
+        ).labels(**lbl)
+        self._m_restored = reg.counter(
+            "radixmesh_kv_tier_restored_tokens_total",
+            "tokens read back from verified disk extents",
+            ("tier",),
+        ).labels(**lbl)
+        self._m_bytes = reg.counter(
+            "radixmesh_kv_tier_bytes_total",
+            "extent bytes moved, by direction",
+            ("tier", "op"),
+        )
+        self._m_bytes_rw = {
+            op: self._m_bytes.labels(op=op, **lbl) for op in ("write", "read")
+        }
+        self._m_corrupt = reg.counter(
+            "radixmesh_kv_tier_corrupt_extents_total",
+            "extents dropped instead of served: torn tails, checksum "
+            "mismatches, future schemas, unreadable files",
+            ("tier", "cause"),
+        )
+        self._m_corrupt_by = {
+            c: self._m_corrupt.labels(cause=c, **lbl)
+            for c in ("truncated", "checksum", "schema", "io")
+        }
+        moves = reg.counter(
+            "radixmesh_kv_tier_moves_total",
+            "tier transitions by direction and subtree shard: demote = "
+            "host->disk spill committed, promote = disk->HBM restore "
+            "applied, drop = extent evicted for disk capacity",
+            ("tier", "dir", "shard"),
+        )
+        self._m_moves = moves
+        self._m_moves_lbl = lbl
+        self._m_resident = reg.gauge(
+            "radixmesh_kv_tier_resident_bytes",
+            "bytes held in committed extents",
+            ("tier",),
+        ).labels(**lbl)
+        self._m_extents = reg.gauge(
+            "radixmesh_kv_tier_extents",
+            "committed extent files currently live",
+            ("tier",),
+        ).labels(**lbl)
+        self._m_io = reg.histogram(
+            "radixmesh_kv_tier_io_seconds",
+            "one extent write (incl. fsync) or verified read",
+            ("tier", "op"),
+            buckets=TRANSFER_SECONDS_BUCKETS,
+        )
+        self._m_io_rw = {
+            op: self._m_io.labels(op=op, **lbl) for op in ("write", "read")
+        }
+
+    # ------------------------------------------------------------------
+    # bookkeeping
+    # ------------------------------------------------------------------
+
+    def _note_move(self, direction: str, shard: int) -> None:
+        self._m_moves.labels(
+            dir=direction, shard=str(int(shard)), **self._m_moves_lbl
+        ).inc()
+        with self._lock:
+            self.recent_moves.append((time.monotonic(), int(shard), direction))
+
+    def note_promote(self, ref: ExtentRef) -> None:
+        """Count one applied disk→HBM restore (engine thread, at unit
+        apply — in-memory accounting only)."""
+        self._m_restored.inc(ref.n_seg)
+        self._note_move("promote", ref.shard)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "dir": self.dir,
+                "extents": len(self._extents),
+                "resident_bytes": self._resident_bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "retire_queue": len(self._retired),
+            }
+
+    @property
+    def extents(self) -> int:
+        with self._lock:
+            return len(self._extents)
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident_bytes
+
+    # ------------------------------------------------------------------
+    # extent encoding
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _encode(
+        prefix_tokens: np.ndarray,
+        seg_tokens: np.ndarray,
+        kv: np.ndarray,
+        scales: np.ndarray | None,
+        page_size: int,
+    ) -> bytes:
+        prefix = np.ascontiguousarray(prefix_tokens, dtype=np.int32)
+        seg = np.ascontiguousarray(seg_tokens, dtype=np.int32)
+        kv = np.ascontiguousarray(kv)
+        payload = prefix.tobytes() + seg.tobytes() + kv.tobytes()
+        scales_b = b""
+        if scales is not None:
+            scales = np.ascontiguousarray(scales, dtype=np.float32)
+            scales_b = scales.tobytes()
+            payload += scales_b
+        hdr = json.dumps(
+            {
+                "page_size": int(page_size),
+                "n_prefix": int(len(prefix)),
+                "n_seg": int(len(seg)),
+                "kv_shape": list(kv.shape),
+                "kv_dtype": np.dtype(kv.dtype).name,
+                "scales_shape": (
+                    None if scales is None else list(scales.shape)
+                ),
+                "payload_crc": zlib.crc32(payload) & 0xFFFFFFFF,
+                "payload_len": len(payload),
+            },
+            sort_keys=True,
+        ).encode()
+        pre = _PRE.pack(
+            _MAGIC, EXTENT_SCHEMA_VERSION, 0, len(hdr),
+            zlib.crc32(hdr) & 0xFFFFFFFF,
+        )
+        return pre + hdr + payload
+
+    @staticmethod
+    def _dtype(name: str) -> np.dtype:
+        try:
+            return np.dtype(name)
+        except TypeError:
+            import ml_dtypes  # registered extension dtypes (bfloat16 etc.)
+
+            return np.dtype(getattr(ml_dtypes, name))
+
+    def _decode(self, raw: bytes) -> tuple[dict, np.ndarray, np.ndarray,
+                                           np.ndarray, np.ndarray | None]:
+        """(header, prefix, seg, kv, scales); raises ValueError naming a
+        corruption cause ("truncated" / "checksum" / "schema")."""
+        if len(raw) < _PRE.size:
+            raise ValueError("truncated")
+        magic, schema, _, hdr_len, hdr_crc = _PRE.unpack_from(raw, 0)
+        if magic != _MAGIC:
+            raise ValueError("schema")
+        if schema > EXTENT_SCHEMA_VERSION:
+            raise ValueError("schema")  # refuse the future, never misread
+        if len(raw) < _PRE.size + hdr_len:
+            raise ValueError("truncated")
+        hdr_b = raw[_PRE.size : _PRE.size + hdr_len]
+        if (zlib.crc32(hdr_b) & 0xFFFFFFFF) != hdr_crc:
+            raise ValueError("checksum")
+        try:
+            hdr = json.loads(hdr_b)
+        except ValueError:
+            raise ValueError("checksum") from None
+        payload = raw[_PRE.size + hdr_len :]
+        if len(payload) != int(hdr["payload_len"]):
+            raise ValueError("truncated")
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != int(hdr["payload_crc"]):
+            raise ValueError("checksum")
+        n_prefix, n_seg = int(hdr["n_prefix"]), int(hdr["n_seg"])
+        off = 0
+        prefix = np.frombuffer(payload, np.int32, n_prefix, off).copy()
+        off += 4 * n_prefix
+        seg = np.frombuffer(payload, np.int32, n_seg, off).copy()
+        off += 4 * n_seg
+        kv_dtype = self._dtype(hdr["kv_dtype"])
+        kv_shape = tuple(hdr["kv_shape"])
+        kv_count = int(np.prod(kv_shape)) if kv_shape else 0
+        kv = (
+            np.frombuffer(payload, kv_dtype, kv_count, off)
+            .reshape(kv_shape)
+            .copy()
+        )
+        off += kv_count * kv_dtype.itemsize
+        scales = None
+        if hdr.get("scales_shape") is not None:
+            s_shape = tuple(hdr["scales_shape"])
+            scales = (
+                np.frombuffer(payload, np.float32, int(np.prod(s_shape)), off)
+                .reshape(s_shape)
+                .copy()
+            )
+        return hdr, prefix, seg, kv, scales
+
+    # ------------------------------------------------------------------
+    # write path (plane worker)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _path_name(prefix_tokens: np.ndarray, seg_tokens: np.ndarray) -> str:
+        """Extent file name, keyed on the FULL root→node token path: a
+        re-spill of the same path (after boundary changes upstream)
+        atomically replaces the stale extent instead of duplicating it."""
+        import hashlib
+
+        full = np.concatenate([
+            np.asarray(prefix_tokens, dtype=np.int32),
+            np.asarray(seg_tokens, dtype=np.int32),
+        ])
+        return f"ext-{hashlib.blake2b(full.tobytes(), digest_size=12).hexdigest()}.kv"
+
+    def write_extent(
+        self,
+        prefix_tokens: np.ndarray,
+        seg_tokens: np.ndarray,
+        kv: np.ndarray,
+        scales: np.ndarray | None,
+    ) -> ExtentRef | None:
+        """Commit one extent (PLANE WORKER: blocking write + fsync).
+        Returns None on an I/O failure — the caller degrades (the node
+        simply stays volatile)."""
+        t0 = time.monotonic()
+        data = self._encode(
+            prefix_tokens, seg_tokens, kv, scales, self.page_size
+        )
+        path = os.path.join(
+            self.dir, self._path_name(prefix_tokens, seg_tokens)
+        )
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)  # the commit point
+        except OSError:
+            self.log.exception("extent write failed (%s)", path)
+            self._m_corrupt_by["io"].inc()
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return None
+        shard = _shard_of(
+            prefix_tokens if len(prefix_tokens) else seg_tokens,
+            self.page_size,
+        )
+        ref = ExtentRef(
+            path=path, n_seg=int(len(seg_tokens)), nbytes=len(data),
+            shard=shard,
+        )
+        replaced = None
+        with self._lock:
+            replaced = self._extents.get(path)
+            self._extents[path] = ref
+            self._resident_bytes += len(data) - (
+                replaced.nbytes if replaced else 0
+            )
+            resident = self._resident_bytes
+            n_ext = len(self._extents)
+        self._m_spilled.inc(ref.n_seg)
+        self._m_bytes_rw["write"].inc(len(data))
+        self._m_io_rw["write"].observe(time.monotonic() - t0)
+        self._m_resident.set(resident)
+        self._m_extents.set(n_ext)
+        self._note_move("demote", shard)
+        self._enforce_capacity(protect=path)
+        return ref
+
+    def _enforce_capacity(self, protect: str | None = None) -> None:
+        """Drop oldest extents (by mtime) until under capacity (PLANE
+        WORKER): ONE locked snapshot, ONE stat per victim, one sort —
+        a deep purge must not stall the shared worker on O(extents^2)
+        syscalls while restores queue behind it. A dropped extent
+        leaves its in-tree ref dangling — the next restore of that node
+        fails verification-by-absence and degrades to a recompute, the
+        documented cache semantics."""
+        with self._lock:
+            excess = self._resident_bytes - self.capacity_bytes
+            if excess <= 0:
+                return
+            victims = [r for p, r in self._extents.items() if p != protect]
+        victims.sort(
+            key=lambda r: (
+                os.path.getmtime(r.path) if os.path.exists(r.path) else 0.0
+            )
+        )
+        for victim in victims:
+            if excess <= 0:
+                return
+            if self.has(victim):  # identity: skip since-replaced paths
+                excess -= victim.nbytes
+                self._unlink(victim)
+                self._note_move("drop", victim.shard)
+
+    def has(self, ref: ExtentRef) -> bool:
+        """True while THIS ref is the live extent at its path (identity,
+        not path equality — a re-spill replaces the mapping)."""
+        with self._lock:
+            return self._extents.get(ref.path) is ref
+
+    def _unlink(self, ref: ExtentRef) -> None:
+        """Remove ``ref``'s file and books — IDENTITY-guarded: a stale
+        ref (its path since re-committed by a boundary-changed re-spill,
+        which maps a NEW ref at the same name) must not delete the live
+        extent or skew the resident accounting."""
+        with self._lock:
+            if self._extents.get(ref.path) is not ref:
+                return  # stale: a newer extent owns this path now
+            self._extents.pop(ref.path, None)
+            self._resident_bytes -= ref.nbytes
+            resident = self._resident_bytes
+            n_ext = len(self._extents)
+        try:
+            os.remove(ref.path)
+        except OSError:
+            pass
+        self._m_resident.set(resident)
+        self._m_extents.set(n_ext)
+
+    # ------------------------------------------------------------------
+    # read path (plane worker)
+    # ------------------------------------------------------------------
+
+    def read_extent(
+        self, ref: ExtentRef
+    ) -> tuple[np.ndarray, np.ndarray | None] | None:
+        """Read + VERIFY one extent (PLANE WORKER). Returns
+        ``(kv, scales)`` or None when the extent is missing, torn, or
+        corrupt — the corrupt file is unlinked and counted, and the
+        caller must degrade to a shorter hit (never serve the bytes)."""
+        t0 = time.monotonic()
+        try:
+            with open(ref.path, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            self._m_corrupt_by["io"].inc()
+            self._forget(ref)
+            return None
+        try:
+            _, _, _, kv, scales = self._decode(raw)
+        except ValueError as e:
+            cause = str(e) if str(e) in self._m_corrupt_by else "checksum"
+            self.log.warning(
+                "dropping corrupt extent %s (%s) — degrading to a "
+                "shorter verified prefix",
+                os.path.basename(ref.path), cause,
+            )
+            self._m_corrupt_by[cause].inc()
+            self._unlink(ref)
+            return None
+        if kv.shape[2] != ref.n_seg:
+            self._m_corrupt_by["schema"].inc()
+            self._unlink(ref)
+            return None
+        self._m_bytes_rw["read"].inc(len(raw))
+        self._m_io_rw["read"].observe(time.monotonic() - t0)
+        return kv, scales
+
+    def _forget(self, ref: ExtentRef) -> None:
+        with self._lock:
+            if self._extents.get(ref.path) is ref:
+                self._extents.pop(ref.path, None)
+                self._resident_bytes -= ref.nbytes
+            self._m_resident.set(self._resident_bytes)
+            self._m_extents.set(len(self._extents))
+
+    # ------------------------------------------------------------------
+    # retire queue (engine thread enqueues; worker unlinks)
+    # ------------------------------------------------------------------
+
+    def retire(self, ref) -> None:
+        """Queue an extent for deletion (ANY thread — in-memory append
+        only; the file dies at the worker's next
+        :meth:`drain_retired`). Tolerates non-ref garbage defensively.
+        Undeleted retirees after a crash simply re-graft at the next
+        boot — stale-but-valid data, the repair plane's documented
+        union semantics."""
+        if isinstance(ref, ExtentRef):
+            with self._lock:
+                self._retired.append(ref)
+
+    def drain_retired(self) -> int:
+        """Unlink every queued retiree (PLANE WORKER / cold paths)."""
+        n = 0
+        while True:
+            with self._lock:
+                if not self._retired:
+                    return n
+                ref = self._retired.popleft()
+            self._unlink(ref)
+            n += 1
+
+    # ------------------------------------------------------------------
+    # boot-time scan (cold path)
+    # ------------------------------------------------------------------
+
+    def scan(self) -> list[ExtentMeta]:
+        """Verify every extent in the directory (COLD PATH: boot).
+        Corrupt/torn extents are dropped and counted; leftover temp
+        files (a kill mid-write) are cleaned. Returns verified metas
+        sorted shallow-first, so grafting parents precedes children."""
+        metas: list[ExtentMeta] = []
+        for name in sorted(os.listdir(self.dir)):
+            full = os.path.join(self.dir, name)
+            if ".tmp." in name:
+                # An uncommitted write the crash interrupted: by
+                # construction nothing references it.
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+                continue
+            if not (name.startswith("ext-") and name.endswith(".kv")):
+                continue
+            try:
+                with open(full, "rb") as fh:
+                    raw = fh.read()
+            except OSError:
+                self._m_corrupt_by["io"].inc()
+                continue
+            try:
+                hdr, prefix, seg, _, _ = self._decode(raw)
+            except ValueError as e:
+                cause = (
+                    str(e) if str(e) in self._m_corrupt_by else "checksum"
+                )
+                self.log.warning(
+                    "scan: dropping corrupt extent %s (%s)", name, cause
+                )
+                self._m_corrupt_by[cause].inc()
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+                continue
+            if int(hdr["page_size"]) != self.page_size:
+                # A different paging regime's extents cannot graft into
+                # this tree; refuse rather than misalign.
+                self._m_corrupt_by["schema"].inc()
+                continue
+            shard = _shard_of(prefix if len(prefix) else seg, self.page_size)
+            ref = ExtentRef(
+                path=full, n_seg=int(len(seg)), nbytes=len(raw), shard=shard
+            )
+            with self._lock:
+                if full not in self._extents:
+                    self._extents[full] = ref
+                    self._resident_bytes += ref.nbytes
+            metas.append(ExtentMeta(ref=ref, prefix_tokens=prefix,
+                                    seg_tokens=seg))
+        with self._lock:
+            self._m_resident.set(self._resident_bytes)
+            self._m_extents.set(len(self._extents))
+        metas.sort(key=lambda m: len(m.prefix_tokens) + len(m.seg_tokens))
+        return metas

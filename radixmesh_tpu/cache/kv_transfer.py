@@ -60,7 +60,7 @@ from radixmesh_tpu.utils.logging import get_logger
 
 __all__ = ["KVTransferPlane", "RestoreTicket", "kv_token_bytes"]
 
-_LANES = ("restore", "writeback", "handoff")
+_LANES = ("restore", "writeback", "handoff", "spill")
 
 
 def kv_token_bytes(pool) -> int:
@@ -77,19 +77,30 @@ def kv_token_bytes(pool) -> int:
 
 @dataclass
 class _RestoreUnit:
-    """One host-resident tree node's restore. Shared between tickets
-    (a prefetch hint and a real admission racing on the same prefix join
-    the same unit instead of double-restoring)."""
+    """One host- or disk-resident tree node's restore. Shared between
+    tickets (a prefetch hint and a real admission racing on the same
+    prefix join the same unit instead of double-restoring). The source
+    is EITHER the host arena (``host_slots``) or a durable disk extent
+    (``extent``, read + checksum-verified on the worker — a corrupt
+    extent fails the unit, never installs)."""
 
     node: object  # TreeNode
     host_slots: np.ndarray
     dev_slots: np.ndarray
+    extent: object = None  # kv_tier.ExtentRef for disk-source units
+    n_tokens: int = 0
     refs: int = 0  # tickets referencing this unit
     applied: bool = False
     attached: bool = False  # node.value was actually installed
     locked: bool = False  # holds an eviction lock until refs drain
     failed: bool = False  # worker staging failed: never install
     tickets: list = field(default_factory=list)
+
+
+class _ExtentUnreadable(Exception):
+    """A disk extent failed verification (torn/corrupt/missing): the
+    unit degrades — expected under crash/corruption drills, so it logs
+    a warning, not a traceback."""
 
 
 class RestoreTicket:
@@ -106,7 +117,7 @@ class RestoreTicket:
         self.t0 = time.monotonic()
         self.auto_release = auto_release
         self.released = False
-        self.tokens = int(sum(len(u.host_slots) for u in units))
+        self.tokens = int(sum(u.n_tokens for u in units))
 
     @property
     def done(self) -> bool:
@@ -169,6 +180,14 @@ class KVTransferPlane:
         # node.id → in-flight _RestoreUnit (dedupe/join + the host-tier
         # eviction shield — host_cache._evict_host skips pending nodes).
         self._pending_nodes: dict[int, _RestoreUnit] = {}
+        # node.id → node with a disk spill in flight (the worker reads
+        # its arena slots, so eviction/destage must leave them alone
+        # until the extent commits at pump).
+        self._pending_spills: dict[int, object] = {}
+        # Worker-finished spills awaiting their engine-thread commit
+        # (node.disk_value installation happens at pump — only the
+        # engine thread mutates the tree).
+        self._spilled: deque[tuple] = deque()
         # Arena slot ids whose write-back materialization FAILED: the
         # bytes there were never written, so any node still pointing at
         # them must drop its host copy instead of restoring garbage.
@@ -247,6 +266,8 @@ class KVTransferPlane:
                 and not self._data_q
                 and not self._staged
                 and not self._pending_nodes
+                and not self._pending_spills
+                and not self._spilled
                 and not self._tickets
                 and not self._hints
             )
@@ -260,6 +281,8 @@ class KVTransferPlane:
                 "restores_queued": len(self._data_q),
                 "staged_chunks": len(self._staged),
                 "pending_restore_nodes": len(self._pending_nodes),
+                "pending_spills": len(self._pending_spills),
+                "spills_uncommitted": len(self._spilled),
                 "active_tickets": len(self._tickets),
                 "hints_queued": len(self._hints),
                 "hints_seen": self.hints_seen,
@@ -290,7 +313,9 @@ class KVTransferPlane:
         head start is the feature), and a drained engine must not strand
         a hint restore's staged chunks and eviction locks."""
         with self._lock:
-            return bool(self._hints or self._staged or self._tickets)
+            return bool(
+                self._hints or self._staged or self._tickets or self._spilled
+            )
 
     def host_slots_ok(self, slots) -> bool:
         """False if any of ``slots`` belongs to a FAILED write-back (its
@@ -300,11 +325,13 @@ class KVTransferPlane:
         rather than restore garbage. Slots reported bad are retired from
         the poison set — the caller's drop frees them for reuse, after
         which fresh writes make them trustworthy again."""
-        # meshcheck: ok[guarded-by-race] racy empty-read is a pure fast
-        # path: the sync caller ran wait_host_ready() first (the barrier
-        # drains every queued write-back and fails on poison), new
-        # poison can only be enqueued by this same engine thread's next
-        # sweep, and a non-empty set re-checks under the lock.
+        # Racy empty-read is a pure fast path: the sync caller ran
+        # wait_host_ready() first (the barrier drains every queued
+        # write-back and fails on poison), new poison can only be
+        # enqueued by this same engine thread's next sweep, and a
+        # non-empty set re-checks under the lock. (No longer needs a
+        # guarded-by suppression: _host_slots_poisoned's worker-side
+        # read makes the off-lock read a convention, not an outlier.)
         if not self._poisoned_host:
             return True
         with self._lock:
@@ -348,8 +375,13 @@ class KVTransferPlane:
         units: list[_RestoreUnit] = []
         new_units: list[_RestoreUnit] = []
         joined_hint = False
+        disk_tier = getattr(tree, "disk", None)
         with self._lock:
-            for node in match.host_nodes:
+            for node in (
+                match.restorable_nodes()
+                if hasattr(match, "restorable_nodes")
+                else match.host_nodes
+            ):
                 u = self._pending_nodes.get(node.id)
                 if u is not None:
                     u.refs += 1
@@ -359,20 +391,42 @@ class KVTransferPlane:
                     # sharing a prefix are dedupe, not prefetch credit.
                     joined_hint |= any(t.auto_release for t in u.tickets)
                     continue
-                if node.value is not None or node.host_value is None:
-                    break  # raced: already restored / detached mid-walk
-                if not self._host_slots_ok_locked(node.host_value):
-                    # Failed write-back: the arena bytes were never
-                    # written — retire the host copy (the check consumed
-                    # the poison entry, so the drop must happen here)
-                    # and stop; the hit is simply shorter.
-                    tree._drop_poisoned_host(node)
-                    break
-                host_slots = np.asarray(node.host_value, dtype=np.int32)
-                dev = alloc(len(host_slots))
-                if dev is None:
-                    break  # no room: the hit is simply shorter
-                u = _RestoreUnit(node, host_slots, dev[: len(host_slots)], refs=1)
+                if node.value is not None:
+                    break  # raced: already restored
+                if node.host_value is not None:
+                    if not self._host_slots_ok_locked(node.host_value):
+                        # Failed write-back: the arena bytes were never
+                        # written — retire the host copy (the check
+                        # consumed the poison entry, so the drop must
+                        # happen here) and stop; the hit is simply
+                        # shorter.
+                        tree._drop_poisoned_host(node)
+                        break
+                    host_slots = np.asarray(node.host_value, dtype=np.int32)
+                    n = len(host_slots)
+                    dev = alloc(n)
+                    if dev is None:
+                        break  # no room: the hit is simply shorter
+                    u = _RestoreUnit(
+                        node, host_slots, dev[:n], n_tokens=n, refs=1
+                    )
+                elif node.disk_value is not None and disk_tier is not None:
+                    # Disk-source unit: the worker reads + verifies the
+                    # extent; the checksum is the serve gate.
+                    n = len(node.disk_value)
+                    dev = alloc(n)
+                    if dev is None:
+                        break
+                    u = _RestoreUnit(
+                        node,
+                        np.empty(0, dtype=np.int32),
+                        dev[:n],
+                        extent=node.disk_value,
+                        n_tokens=n,
+                        refs=1,
+                    )
+                else:
+                    break  # detached mid-walk / tier unreachable
                 self._pending_nodes[node.id] = u
                 units.append(u)
                 new_units.append(u)
@@ -386,7 +440,7 @@ class KVTransferPlane:
             self._tickets.append(ticket)
             for u in new_units:
                 self._data_q.append(("restore", u, tree))
-            self._m_depth["restore"].inc(sum(len(u.host_slots) for u in new_units))
+            self._m_depth["restore"].inc(sum(u.n_tokens for u in new_units))
         if joined_hint:
             with self._lock:
                 self.hints_joined += 1
@@ -418,6 +472,42 @@ class KVTransferPlane:
             if last:
                 self._apply_unit(tree_ref, unit)
             progress = True
+        # Commit worker-finished spills (only the engine thread mutates
+        # the tree): install the extent ref when the node is unchanged;
+        # a raced node (split/removed/re-sliced since submit) retires
+        # the extent instead — the data was valid for the OLD segment,
+        # but the ref must map the node exactly.
+        while True:
+            with self._lock:
+                if not self._spilled:
+                    break
+                node, slots, ref, cause = self._spilled.popleft()
+                self._pending_spills.pop(node.id, None)
+            disk = getattr(tree, "disk", None)
+            unchanged = (
+                node.host_value is not None
+                and len(node.host_value) == len(slots)
+                and np.array_equal(node.host_value, slots)
+            )
+            if ref is not None:
+                if unchanged and node.disk_value is None:
+                    node.disk_value = ref
+                elif disk is not None:
+                    disk.retire(ref)
+            elif cause == "poisoned" and unchanged:
+                # The spill source itself was a failed write-back's
+                # arena slots: the host copy is garbage either way.
+                # Retire the poison entries FIRST — the drop frees the
+                # slots for reuse, and a stale entry would wrongly
+                # condemn the next tenant's freshly-written host copy
+                # (the "fresh writes make them trustworthy again"
+                # invariant).
+                with self._lock:
+                    self._poisoned_host.difference_update(
+                        int(s) for s in slots
+                    )
+                tree._drop_poisoned_host(node)
+            progress = True
         # Auto-release tickets (prefetch hints, cancelled requests) are
         # finished here; engine-owned tickets are finished by the engine
         # when it re-queues the parked request.
@@ -439,13 +529,31 @@ class KVTransferPlane:
         node = unit.node
         with self._lock:
             self._pending_nodes.pop(node.id, None)
-        raced = (
-            unit.failed
-            or node.host_value is None
-            or node.value is not None
-            or len(node.host_value) != len(unit.host_slots)
-            or not np.array_equal(node.host_value, unit.host_slots)
-        )
+        if unit.extent is not None:
+            raced = (
+                unit.failed
+                or node.value is not None
+                or node.disk_value is not unit.extent
+            )
+            if unit.failed and node.disk_value is unit.extent:
+                disk = getattr(tree, "disk", None)
+                if disk is None or not disk.has(unit.extent):
+                    # The extent failed VERIFICATION (corrupt/torn —
+                    # the tier already dropped the file): clear the
+                    # dangling ref so the node degrades to a recompute
+                    # instead of re-attempting a restore that can never
+                    # verify. A TRANSIENT failure (H2D allocation,
+                    # scatter error) leaves the intact extent attached
+                    # for the next attempt.
+                    node.disk_value = None
+        else:
+            raced = (
+                unit.failed
+                or node.host_value is None
+                or node.value is not None
+                or len(node.host_value) != len(unit.host_slots)
+                or not np.array_equal(node.host_value, unit.host_slots)
+            )
         if raced:
             tree.pool.free(unit.dev_slots)
         else:
@@ -459,9 +567,15 @@ class KVTransferPlane:
             tree.inc_lock_ref(node)
             unit.attached = True
             unit.locked = True
-            n = len(unit.host_slots)
+            n = unit.n_tokens
             self._m_restored.inc(n)
             self._m_bytes["restore"].inc(n * kv_token_bytes(tree.pool))
+            if unit.extent is not None:
+                # Tier promote accounting (radixmesh_kv_tier_*): the
+                # disk copy is KEPT — re-demotion of this node is free.
+                disk = getattr(tree, "disk", None)
+                if disk is not None:
+                    disk.note_promote(unit.extent)
             # Keep the hicache restore-token series continuous: existing
             # dashboards alert on it, and "plane on" must read as MORE
             # restore activity there, not zero. (The restore-STALL
@@ -474,7 +588,8 @@ class KVTransferPlane:
         if rec.enabled:
             rec.event(
                 self._trace_lane, "kv_restore", time.monotonic(), 0.0,
-                cat="kv", tokens=int(len(unit.host_slots)),
+                cat="kv", tokens=int(unit.n_tokens),
+                source="disk" if unit.extent is not None else "host",
                 attached=bool(unit.attached),
             )
         self._progress.set()
@@ -546,6 +661,103 @@ class KVTransferPlane:
                 return False
             if any(t.failed for t in pending):
                 return False
+
+    # ------------------------------------------------------------------
+    # spill lane (host tier → durable disk extents, cache/kv_tier.py)
+    # ------------------------------------------------------------------
+
+    def spill_pending(self, node) -> bool:
+        """True while a disk spill of ``node`` is in flight (its arena
+        slots must not be freed or re-destaged until the extent
+        commits)."""
+        with self._lock:
+            return node.id in self._pending_spills
+
+    def submit_spill(self, tree, node, prefix_tokens) -> bool:
+        """ENGINE THREAD: queue one host-resident node's demotion to a
+        disk extent. The worker reads the arena (after the write-back
+        priority drain — so the bytes are the landed ones) and writes
+        the checksummed, fsynced extent; the engine's next :meth:`pump`
+        installs ``node.disk_value``. Returns False when the node is
+        already being spilled/restored or holds no host copy."""
+        disk = getattr(tree, "disk", None)
+        if disk is None or node.host_value is None:
+            return False
+        with self._lock:
+            if (
+                node.id in self._pending_spills
+                or node.id in self._pending_nodes
+            ):
+                return False
+            slots = np.asarray(node.host_value, dtype=np.int32).copy()
+            seg = np.asarray(node.key, dtype=np.int32).copy()
+            self._pending_spills[node.id] = node
+            self._data_q.append(
+                (
+                    "spill",
+                    node,
+                    np.asarray(prefix_tokens, dtype=np.int32).copy(),
+                    seg,
+                    slots,
+                    tree,
+                )
+            )
+            self._m_depth["spill"].inc(len(slots))
+        self._work_evt.set()
+        return True
+
+    def _host_slots_poisoned(self, slots) -> bool:
+        """Read-only poison check (worker): unlike ``host_slots_ok``
+        this does NOT consume the poison entries — the restore path
+        still owns the retire-on-read contract. The unlocked empty-read
+        fast path shares host_slots_ok's justification."""
+        if not self._poisoned_host:
+            return False
+        with self._lock:
+            return any(int(s) in self._poisoned_host for s in slots)
+
+    def _run_spill(self, item) -> None:
+        """WORKER: one queued spill — arena read + extent write+fsync.
+        Every outcome (committed ref, poisoned source, I/O failure)
+        reports back through ``_spilled`` for the engine-thread commit."""
+        _, node, prefix, seg, slots, tree = item
+        t0 = time.monotonic()
+        ref = None
+        cause = None
+        try:
+            if self._host_slots_poisoned(slots):
+                cause = "poisoned"
+            else:
+                kv, scales = tree.host.read(slots)
+                ref = tree.disk.write_extent(prefix, seg, kv, scales)
+                if ref is None:
+                    cause = "io"
+        except Exception:  # noqa: BLE001 — a failed spill must not kill the lane
+            self.log.exception("disk spill failed; node stays volatile")
+            cause = "error"
+        dur = time.monotonic() - t0
+        self._m_seconds["spill"].observe(dur)
+        if ref is not None:
+            self._m_bytes["spill"].inc(ref.nbytes)
+            rec = get_recorder()
+            if rec.enabled:
+                rec.event(
+                    self._trace_lane, "kv_spill", t0, dur, cat="kv",
+                    tokens=int(len(slots)),
+                )
+        with self._lock:
+            self._m_depth["spill"].dec(len(slots))
+            self._spilled.append((node, slots, ref, cause))
+        tree.disk.drain_retired()
+        self._progress.set()
+
+    def spills_idle(self) -> bool:
+        """True when no spill is queued, in flight, or awaiting its
+        engine-thread commit."""
+        with self._lock:
+            if self._pending_spills or self._spilled:
+                return False
+            return not any(it[0] == "spill" for it in self._data_q)
 
     # ------------------------------------------------------------------
     # handoff lane (disagg pack/send pipelining)
@@ -674,13 +886,26 @@ class KVTransferPlane:
                 except Exception:  # noqa: BLE001 — a failed send must not kill the lane
                     self.log.exception("handoff task failed")
                 continue
+            if item[0] == "spill":
+                self._run_spill(item)
+                continue
             _, unit, tree = item
             host = tree.host
-            n = len(unit.host_slots)
+            n = unit.n_tokens
             n_chunks = max(1, -(-n // self.chunk_tokens))
             t0 = time.monotonic()
             staged_upto = 0
             try:
+                disk_kv = disk_scales = None
+                if unit.extent is not None:
+                    # Disk-source unit: ONE verified extent read up
+                    # front (checksum is the serve gate — a torn or
+                    # flipped extent returns None and the unit degrades
+                    # below, never installing a byte of it).
+                    payload = tree.disk.read_extent(unit.extent)
+                    if payload is None:
+                        raise _ExtentUnreadable(unit.extent.path)
+                    disk_kv, disk_scales = payload
                 for ci in range(n_chunks):
                     # Between chunks: write-backs first (priority), then
                     # the bounded staging window (pump releases slots).
@@ -695,7 +920,15 @@ class KVTransferPlane:
                         return
                     lo = ci * self.chunk_tokens
                     hi = min(n, (ci + 1) * self.chunk_tokens)
-                    kv_np, scale_np = host.read(unit.host_slots[lo:hi])
+                    if unit.extent is not None:
+                        kv_np = disk_kv[:, :, lo:hi]
+                        scale_np = (
+                            None
+                            if disk_scales is None
+                            else disk_scales[:, :, lo:hi]
+                        )
+                    else:
+                        kv_np, scale_np = host.read(unit.host_slots[lo:hi])
                     # jnp.asarray starts the H2D transfer NOW (async
                     # dispatch); the engine's pump only pays the scatter.
                     kv = jnp.asarray(kv_np)
@@ -706,13 +939,19 @@ class KVTransferPlane:
                         )
                     staged_upto = hi
                     self._progress.set()
-            except Exception:  # noqa: BLE001 — a failed stage must not wedge the ticket
+            except Exception as e:  # noqa: BLE001 — a failed stage must not wedge the ticket
                 # Mark the unit poisoned and hand it to the pump as its
                 # final "chunk": the engine applies it as raced (slots
-                # freed, node left host-resident, request re-queued with
-                # a shorter hit) instead of parking forever — and no
+                # freed, node left in its source tier — or degraded out
+                # of it for an unreadable extent — request re-queued
+                # with a shorter hit) instead of parking forever; no
                 # partially-written node is ever installed.
-                self.log.exception("restore staging failed; degrading unit")
+                if isinstance(e, _ExtentUnreadable):
+                    self.log.warning(
+                        "disk restore degraded: %s failed verification", e
+                    )
+                else:
+                    self.log.exception("restore staging failed; degrading unit")
                 unit.failed = True
                 self._m_depth["restore"].dec(n - staged_upto)
                 with self._lock:
@@ -720,4 +959,7 @@ class KVTransferPlane:
                         (unit, True, unit.dev_slots[:0], None, None, tree)
                     )
                 self._progress.set()
+            disk = getattr(tree, "disk", None)
+            if disk is not None:
+                disk.drain_retired()
             self._m_seconds["restore"].observe(time.monotonic() - t0)

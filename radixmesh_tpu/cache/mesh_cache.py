@@ -72,7 +72,11 @@ from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:  # kv_pool imports jax; a cache-only node never needs it
     from radixmesh_tpu.cache.kv_pool import PagedKVPool
-from radixmesh_tpu.cache.mesh_values import PrefillValue, RouterValue
+from radixmesh_tpu.cache.mesh_values import (
+    AdvertisedValue,
+    PrefillValue,
+    RouterValue,
+)
 from radixmesh_tpu.cache.oplog import (
     GCEntry,
     NodeKey,
@@ -782,7 +786,13 @@ class MeshCache:
     # public cache API
     # ------------------------------------------------------------------
 
-    def insert(self, key, slot_indices: np.ndarray, trace_id: int = 0) -> int:
+    def insert(
+        self,
+        key,
+        slot_indices: np.ndarray,
+        trace_id: int = 0,
+        advertise: bool = False,
+    ) -> int:
         """Insert a locally-computed prefix (KV already written to the local
         pool at ``slot_indices``) and replicate it around the ring
         (reference ``radix_mesh.py:193-201``). Prefill/decode only.
@@ -791,7 +801,14 @@ class MeshCache:
         the wire as the old-wire-tolerant trace trailer so every replica
         records its apply/lag spans under the originating request's
         timeline; 0 (tracing off) emits bit-for-bit the pre-trace
-        frame."""
+        frame.
+
+        ``advertise=True`` (cold-cell resurrection, PR 15): the indices
+        are a placeholder advertisement — the local KV lives in DISK
+        EXTENTS, not the pool, and is restored at admission time. The
+        local tree stores an :class:`AdvertisedValue` so authoritative
+        tree-path frees never release pool slots this prefix does not
+        own; the wire frame is a normal rank-tagged INSERT."""
         if self.role is NodeRole.ROUTER:
             raise RuntimeError("router nodes hold no KV; insert is P/D-only")
         key = as_key(key)
@@ -812,7 +829,11 @@ class MeshCache:
             key = key[:n]
             slot_indices = slot_indices[:n]
             wire_value = self._page_wire_value(slot_indices)
-        value = PrefillValue(slot_indices, self.rank)
+        value = (
+            AdvertisedValue(slot_indices, self.rank)
+            if advertise
+            else PrefillValue(slot_indices, self.rank)
+        )
         t0 = time.monotonic()
         with self._lock:
             prefix_len = self._mesh_insert(key, value)
@@ -2857,6 +2878,17 @@ class MeshCache:
         """Called by the tree for each matched node whose value differs
         from the incoming segment (mesh values compare by origin rank);
         returns the winning value and records the loser for GC."""
+        if (
+            isinstance(child.value, AdvertisedValue)
+            and not isinstance(new_seg, AdvertisedValue)
+            and new_seg.rank == child.value.rank
+        ):
+            # Resurrection placeholder upgraded by the origin's own REAL
+            # publish (the prefix was served through a disk restore and
+            # re-published with true pool slots): replace outright — no
+            # conflict counted, no dup recorded (the placeholder owns
+            # nothing to GC).
+            return new_seg
         self._m_conflicts.inc()
         full_key = self._full_key(child)
         if self.resolver.keep(child.value.rank, new_seg.rank):
@@ -2910,6 +2942,11 @@ class MeshCache:
         if (
             self.pool is None
             or not isinstance(value, PrefillValue)
+            # Advertised placeholders own no pool slots: claiming their
+            # arange ids would ledger LIVE slots belonging to unrelated
+            # requests, and a later _pending_free would free them out
+            # from under that data.
+            or isinstance(value, AdvertisedValue)
             or value.rank != self.rank
             or not len(value.indices)
         ):
@@ -2987,6 +3024,10 @@ class MeshCache:
         if (
             self.pool is not None
             and isinstance(value, PrefillValue)
+            # Advertised values (cold-cell resurrection) carry
+            # placeholder indices — the KV lives in disk extents, and
+            # freeing would release pool slots owned by live data.
+            and not isinstance(value, AdvertisedValue)
             and value.rank == self.rank
             and len(value.indices)
         ):

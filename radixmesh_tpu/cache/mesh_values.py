@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["PrefillValue", "RouterValue"]
+__all__ = ["PrefillValue", "AdvertisedValue", "RouterValue"]
 
 
 class PrefillValue:
@@ -48,6 +48,34 @@ class PrefillValue:
 
     def __repr__(self) -> str:
         return f"PrefillValue(rank={self.rank}, n={len(self.indices)})"
+
+
+class AdvertisedValue(PrefillValue):
+    """A PrefillValue whose indices are an ADVERTISEMENT, not pool
+    ownership — the cold-cell resurrection re-announce (PR 15,
+    ``Engine.announce_resurrected``): the origin serves the prefix
+    through a staged disk restore at admission time, so its local pool
+    owns nothing here and the authoritative tree-path frees
+    (``MeshCache._free_local``) must NOT release these ids. On the wire
+    it is indistinguishable from a normal publish (receivers store
+    rank-tagged values either way)."""
+
+    __slots__ = ()
+
+    def __getitem__(self, item) -> "AdvertisedValue":
+        if not isinstance(item, slice):
+            raise TypeError("PrefillValue supports slice indexing only")
+        return AdvertisedValue(self.indices[item], self.rank)
+
+    def __eq__(self, other) -> bool:
+        """DELIBERATELY asymmetric vs PrefillValue: an advertisement is
+        not equal to a same-rank REAL value, so the origin's later true
+        publish triggers the conflict hook and UPGRADES the placeholder
+        (``MeshCache._resolve_conflict``) instead of being swallowed by
+        rank-only equality. The reverse direction (real existing value,
+        advertised incoming) keeps PrefillValue's rank equality — a
+        late advertisement must never displace real KV."""
+        return isinstance(other, AdvertisedValue) and self.rank == other.rank
 
 
 class RouterValue:

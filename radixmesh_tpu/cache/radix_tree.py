@@ -183,6 +183,7 @@ class TreeNode:
         "key",
         "value",
         "host_value",
+        "disk_value",
         "lock_ref",
         "last_access_time",
         "hit_count",
@@ -203,6 +204,12 @@ class TreeNode:
         # ``cache/host_cache.py``). A node may hold both tiers (restored to
         # device with the host copy retained → re-eviction is free).
         self.host_value: np.ndarray | None = None
+        # Durable-tier extent handle (cache/kv_tier.py::ExtentRef) when
+        # this node's KV has been spilled to a disk extent. A node may
+        # hold any combination of tiers — a disk copy makes host/device
+        # re-eviction free, exactly like host_value does for the device
+        # tier. ``len(disk_value)`` is the segment token count.
+        self.disk_value: Any = None
         self.lock_ref = 0
         self.last_access_time = time.monotonic()
         self.hit_count = 0
@@ -252,12 +259,21 @@ class MatchResult:
     (the reference's ``host_hit_length``/``last_host_node``,
     ``radix_cache.py:67-84``). ``HierarchicalCache.load`` restores them
     into device slots.
+
+    ``disk_values``/``disk_nodes`` extend the chain one tier further:
+    nodes whose KV lives only in durable disk extents
+    (``cache/kv_tier.py``). They are restorable exclusively through the
+    staged KV-transfer plane (reading an extent is blocking file I/O,
+    lint-banned from the admission path), so the synchronous
+    ``match_and_load`` path ignores them and the hit is simply shorter.
     """
 
     values: list[Any] = field(default_factory=list)
     last_node: "TreeNode | None" = None
     host_values: list[np.ndarray] = field(default_factory=list)
     host_nodes: list["TreeNode"] = field(default_factory=list)
+    disk_values: list[Any] = field(default_factory=list)
+    disk_nodes: list["TreeNode"] = field(default_factory=list)
 
     @property
     def length(self) -> int:
@@ -267,6 +283,17 @@ class MatchResult:
     def host_length(self) -> int:
         """Tokens matched beyond ``length`` that live only in host RAM."""
         return sum(len(v) for v in self.host_values)
+
+    @property
+    def disk_length(self) -> int:
+        """Tokens matched beyond the host extension that live only in
+        disk extents."""
+        return sum(len(v) for v in self.disk_values)
+
+    def restorable_nodes(self) -> list["TreeNode"]:
+        """The ordered host+disk extension — the staged restore's unit
+        source (shallowest first; the restore must stay prefix-closed)."""
+        return list(self.host_nodes) + list(self.disk_nodes)
 
     @property
     def last_host_node(self) -> "TreeNode | None":
@@ -326,6 +353,12 @@ class RadixTree:
         # the owner-scoped convergence currency (whole-tree fingerprints
         # diverge BY DESIGN under sharding). None = tracking off.
         self.shard_fn = shard_fn
+        # Durable-tier detach hook (cache/kv_tier.py): called with an
+        # ExtentRef whenever a node carrying one leaves the tree or is
+        # split — the owner (HierarchicalCache) queues the extent for
+        # worker-side deletion. In-memory append only; never file I/O
+        # on the caller's thread.
+        self.on_disk_detach: Callable[[Any], None] | None = None
         # All remaining state (root, size counters) is established by reset().
         self.reset()
 
@@ -364,6 +397,11 @@ class RadixTree:
             ]
             if host:
                 self.on_free_host(np.concatenate(host))
+        if self.on_disk_detach is not None and getattr(self, "root", None) is not None:
+            for n in self._all_nodes():
+                if n is not self.root and n.disk_value is not None:
+                    self.on_disk_detach(n.disk_value)
+                    n.disk_value = None
         self.root = TreeNode()
         self.root.key = np.empty(0, dtype=np.int32)
         self.root.value = root_value
@@ -398,12 +436,15 @@ class RadixTree:
         key = as_key(key)
         if self.page_size > 1:
             key = key[: self._aligned_len(len(key))]
-        node = self.root  # walk pointer: advances through BOTH tiers
+        node = self.root  # walk pointer: advances through ALL tiers
         last_dev = self.root  # lock anchor: deepest device-resident node
         values: list[Any] = []
         host_values: list[np.ndarray] = []
         host_nodes: list[TreeNode] = []
+        disk_values: list[Any] = []
+        disk_nodes: list[TreeNode] = []
         in_host = False  # device residency is prefix-closed; host extends it
+        in_disk = False  # ... and durable disk extents extend the host chain
         now = self._time()
         node.last_access_time = now
         while len(key) > 0:
@@ -419,9 +460,23 @@ class RadixTree:
                 # Written back to host RAM (value lives in host_value): the
                 # device prefix ends here; keep walking the host extension.
                 in_host = True
-            if in_host and child.host_value is None:
-                break  # structural node with KV in neither tier
+            if in_host and not in_disk and child.host_value is None:
+                if child.disk_value is not None:
+                    # Demoted one tier further (cache/kv_tier.py): the
+                    # host extension ends here; keep walking the durable
+                    # disk extension.
+                    in_disk = True
+                else:
+                    break  # structural node with KV in no tier
+            if in_disk and child.disk_value is None:
+                break  # disk residency must stay prefix-closed too
             if m < len(child.key):
+                if in_disk:
+                    # An extent covers its whole segment — it cannot be
+                    # partially restored, so a mid-node divergence ends
+                    # the disk extension (never split here: splitting
+                    # would orphan the extent).
+                    break
                 if split_partial:
                     child = self._split_node(child, m)
                     if in_host:
@@ -441,7 +496,10 @@ class RadixTree:
                     else:
                         values.append(child.value[:m])
                 break
-            if in_host:
+            if in_disk:
+                disk_values.append(child.disk_value)
+                disk_nodes.append(child)
+            elif in_host:
                 host_values.append(child.host_value)
                 host_nodes.append(child)
             else:
@@ -454,6 +512,8 @@ class RadixTree:
             last_node=last_dev,
             host_values=host_values,
             host_nodes=host_nodes,
+            disk_values=disk_values,
+            disk_nodes=disk_nodes,
         )
 
     def insert(
@@ -654,7 +714,15 @@ class RadixTree:
             if n.host_value is not None:
                 freed_host.append(n.host_value)
             stack.extend(n.children.values())
-            # Clear both tiers on the detached nodes: any stale reference
+            if n.disk_value is not None:
+                # The extent is unreachable once the node leaves the
+                # tree: queue it for worker-side deletion. (If the
+                # process dies before the unlink, the extent re-grafts
+                # at the next boot — stale-but-valid union semantics.)
+                if self.on_disk_detach is not None:
+                    self.on_disk_detach(n.disk_value)
+                n.disk_value = None
+            # Clear every tier on the detached nodes: any stale reference
             # (e.g. a restore loop that matched before the removal) must
             # see "no KV here" rather than freed slot ids.
             n.value = None
@@ -902,6 +970,15 @@ class RadixTree:
         node.host_value = (
             None if node.host_value is None else node.host_value[split_len:]
         )
+        if node.disk_value is not None:
+            # An extent covers its node's exact segment and cannot be
+            # sliced: a split retires the ref (neither half keeps it).
+            # The caller just recomputed (or will recompute) this span,
+            # and pressure will re-spill it with the new boundaries —
+            # losing the extent costs a future disk write, never data.
+            if self.on_disk_detach is not None:
+                self.on_disk_detach(node.disk_value)
+            node.disk_value = None
         # Chain hashes are a pure function of the root path, so a split
         # partitions them between the halves — zero fingerprint delta.
         # Shard is a function of the path's FIRST page only, so both

@@ -132,6 +132,16 @@ class MeshConfig:
     # (parking a tiny restore costs more than it hides). 0 = always
     # staged when the plane is on.
     kv_transfer_min_restore_tokens: int = 0
+    # Durable KV spill tier (cache/kv_tier.py): directory for
+    # checksummed fsynced extent files — the third tier below HBM and
+    # host RAM. Setting it arms the async KV plane (disk I/O is
+    # staged-only) and enables cold-cell resurrection at boot. None =
+    # the tier stack ends at host RAM (the pre-PR-15 behavior).
+    # launch.py --kv-tier-dir overrides.
+    kv_tier_dir: str | None = None
+    # Disk budget for the extent store; oldest extents are dropped past
+    # it (cache semantics: a dangling ref degrades to a recompute).
+    kv_tier_capacity_bytes: int = 1 << 30
     # Mid-decode publish cadence (crash recovery, server/recovery.py):
     # every N generated tokens a request's grown prefix publishes to the
     # tree AND the ring, so a node death costs a resurrected request at
@@ -397,6 +407,8 @@ def load_config(
         "kv_transfer_async",
         "kv_transfer_chunk_tokens",
         "kv_transfer_min_restore_tokens",
+        "kv_tier_dir",
+        "kv_tier_capacity_bytes",
         "stream_publish_tokens",
         "rebalance_interval_s",
         "heat_half_life_s",
@@ -443,6 +455,10 @@ def load_config(
         kv_transfer_chunk_tokens=int(raw.get("kv_transfer_chunk_tokens", 512)),
         kv_transfer_min_restore_tokens=int(
             raw.get("kv_transfer_min_restore_tokens", 0)
+        ),
+        kv_tier_dir=raw.get("kv_tier_dir"),
+        kv_tier_capacity_bytes=int(
+            raw.get("kv_tier_capacity_bytes", 1 << 30)
         ),
         stream_publish_tokens=int(raw.get("stream_publish_tokens", 0)),
         rebalance_interval_s=float(raw.get("rebalance_interval_s", 0.0)),

@@ -149,6 +149,12 @@ class Engine:
         kv_transfer_async: bool = False,
         kv_transfer_chunk_tokens: int = 512,
         kv_transfer_min_restore_tokens: int = 0,
+        kv_tier_dir: str | None = None,
+        kv_tier_capacity_bytes: int = 1 << 30,
+        kv_tier_watermark: float = 0.7,
+        kv_tier_min_heat: float = 0.0,
+        kv_tier_destage_budget: int = 16,
+        kv_tier_destage_interval_s: float = 0.25,
         stream_publish_tokens: int = 0,
         step_accounting: bool = False,
         peak_tflops: float | None = None,
@@ -305,6 +311,12 @@ class Engine:
                 sharding=pool_sharding,
                 quant=kv_quant,
             )
+        if kv_tier_dir is not None and host_cache_slots <= 0:
+            raise ValueError(
+                "kv_tier_dir requires a host tier (host_cache_slots > 0): "
+                "the disk tier demotes from and restores through host RAM"
+            )
+        self._kv_tier = None
         if host_cache_slots > 0:
             # Hierarchical cache: HBM-evicted prefixes fall back to a
             # host-RAM tier and are restored on hit instead of recomputed
@@ -320,9 +332,43 @@ class Engine:
                 dtype=cfg.dtype,
                 quant=self.pool.quant,
             )
-            self.tree: RadixTree = HierarchicalCache(self.pool, host_store)
+            if kv_tier_dir is not None:
+                # Durable third tier (cache/kv_tier.py): checksummed
+                # fsynced extent files behind the staged executor, so a
+                # whole-cell power loss no longer erases the working
+                # set (ROADMAP item 3 + cold-cell resurrection).
+                from radixmesh_tpu.cache.kv_tier import DiskKVTier
+
+                self._kv_tier = DiskKVTier(
+                    kv_tier_dir,
+                    capacity_bytes=kv_tier_capacity_bytes,
+                    page_size=page_size,
+                    name=self.name,
+                )
+                # Disk I/O is only reachable through the plane worker
+                # (lint-pinned): a tier without the plane would be
+                # write-only dead weight, so arm it.
+                if not kv_transfer_async:
+                    kv_transfer_async = True
+                    self.log.info(
+                        "kv_tier_dir set: arming the async KV-movement "
+                        "plane (disk restores/spills are staged-only)"
+                    )
+            self.tree: RadixTree = HierarchicalCache(
+                self.pool, host_store, disk_tier=self._kv_tier
+            )
         else:
             self.tree = RadixTree(page_size=page_size, on_free=self.pool.free)
+        self._kv_tier_watermark = float(kv_tier_watermark)
+        self._kv_tier_min_heat = float(kv_tier_min_heat)
+        self._kv_tier_destage_budget = int(kv_tier_destage_budget)
+        # Destage cadence: the candidate walk is O(tree nodes) of
+        # engine-thread Python, so it runs at most this often — not
+        # per scheduler step (durability lags pressure by at most one
+        # interval, which the commit-by-rename discipline tolerates).
+        # 0 = every pump (tests/drills that need deterministic spills).
+        self._kv_tier_destage_interval_s = float(kv_tier_destage_interval_s)
+        self._kv_tier_last_destage = 0.0
         # Async KV-movement plane (cache/kv_transfer.py): host-tier
         # restores stage off the scheduling thread (requests park in
         # RESTORING while decode keeps stepping), eviction write-backs
@@ -346,6 +392,15 @@ class Engine:
             )
             if hasattr(self.tree, "host"):
                 self.tree.plane = self.kv_transfer
+        # Cold-cell resurrection (cache/kv_tier.py): scan the extent
+        # directory, drop torn/corrupt extents, graft the verified
+        # paths back as disk-resident nodes — the node serves its
+        # pre-crash working set from disk even when every replica died.
+        # Boot-time cold path (file I/O never runs on the serving path).
+        self.resurrected = {"extents": 0, "grafted_nodes": 0,
+                            "grafted_tokens": 0, "orphaned": 0, "keys": []}
+        if self._kv_tier is not None:
+            self.resurrected = self.tree.resurrect_from_disk()
         # Reserved scratch page: inactive decode rows write/read here.
         scratch = self.pool.alloc(page_size)
         assert scratch is not None
@@ -660,6 +715,55 @@ class Engine:
             total += freed
         return total
 
+    def drain_flush_disk(self, timeout_s: float = 30.0) -> tuple[int, bool]:
+        """Drain step: flush hot subtrees DISK-ward — force-destage
+        every host-resident prefix to checksummed extents and wait for
+        the commits, so the working set survives even if the whole cell
+        (this node included) later loses power before a rejoin. Returns
+        ``(spills submitted, all committed)``; (0, True) without a
+        tier. Run after :meth:`drain_flush_hot` so the device flush has
+        landed in the arena first."""
+        tree = self.tree
+        plane = self.kv_transfer
+        if self._kv_tier is None or plane is None:
+            return 0, True
+        submitted = tree.destage_cold(force=True, budget=1 << 30)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            plane.pump(tree)  # spill commits land on this (engine) thread
+            if plane.spills_idle():
+                return submitted, True
+            plane.wait_progress(0.02)
+        plane.pump(tree)
+        return submitted, plane.spills_idle()
+
+    def announce_resurrected(self) -> int:
+        """Re-announce resurrected prefixes through the existing
+        bootstrap/SHARD_SUMMARY path: each grafted key re-enters the
+        mesh tree via the normal insert (owner-addressed under
+        sharding), so summaries, fingerprints, and pull-through routing
+        advertise the disk-resident working set exactly like a live
+        one. Call after the mesh transport is up. Returns keys
+        announced."""
+        mesh = self.mesh
+        keys = self.resurrected.get("keys") or []
+        if mesh is None or not keys:
+            return 0
+        n = 0
+        for key in keys:
+            key = np.asarray(key, dtype=np.int32)
+            if len(key) == 0:
+                continue
+            # Advertisement-only insert (AdvertisedValue): replicas
+            # store origin-rank tags anyway, this node serves the
+            # prefix through a staged disk restore at admission time,
+            # and the placeholder indices are never pool-freed.
+            mesh.insert(
+                key, np.arange(len(key), dtype=np.int32), advertise=True
+            )
+            n += 1
+        return n
+
     def step(self) -> None:
         """One scheduler iteration: admit+prefill queued requests into free
         rows, then one batched decode step for everything running."""
@@ -726,6 +830,11 @@ class Engine:
                 c: int(m.value) for c, m in self._m_evicted.items()
             },
             "spec": self.spec_report(),
+            # Durable tier occupancy (None without a tier): lock-guarded
+            # snapshot reads inside stats().
+            "kv_tier": (
+                None if self._kv_tier is None else self._kv_tier.stats()
+            ),
         }
 
     def spec_report(self) -> dict:
@@ -858,8 +967,14 @@ class Engine:
                     match = None
                     if self.kv_transfer is not None:
                         match = self.tree.match_prefix(req.prompt)
-                        if match.host_nodes:
-                            if match.host_length >= self._kv_min_restore:
+                        if match.host_nodes or match.disk_nodes:
+                            # Disk extensions ALWAYS park (extent reads
+                            # are staged-only); host-only extensions
+                            # park past the min-restore threshold.
+                            if match.disk_nodes or (
+                                match.host_length + match.disk_length
+                                >= self._kv_min_restore
+                            ):
                                 if self._park_for_restore(req, match):
                                     self.waiting.pop(idx)
                                     continue  # parked; don't advance idx
@@ -1024,6 +1139,25 @@ class Engine:
         plane.pump(self.tree)
         for key in plane.take_hints():
             self._apply_prefetch_hint(key)
+        if self._kv_tier is not None and not self.draining:
+            # Write-behind destage (cache/kv_tier.py): past the arena
+            # watermark, cold-ish host prefixes spill to disk extents
+            # on the plane worker, so later arena pressure DEMOTES
+            # (free) instead of DROPPING (data loss). In-memory
+            # submission only — file I/O stays off this thread — and
+            # cadence-throttled: the candidate walk is O(tree), not
+            # per-step work.
+            now = time.monotonic()
+            if (
+                now - self._kv_tier_last_destage
+                >= self._kv_tier_destage_interval_s
+            ):
+                self._kv_tier_last_destage = now
+                self.tree.destage_cold(
+                    watermark=self._kv_tier_watermark,
+                    min_heat=self._kv_tier_min_heat,
+                    budget=self._kv_tier_destage_budget,
+                )
         if not self._restoring:
             return
         still: list[tuple[Request, object]] = []
@@ -1087,7 +1221,7 @@ class Engine:
             plane.count_hint("draining")
             return
         match = self.tree.match_prefix(key, split_partial=False)
-        if not match.host_nodes:
+        if not match.host_nodes and not match.disk_nodes:
             plane.count_hint("noop")
             return
         ticket = plane.begin_restore(

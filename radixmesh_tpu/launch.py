@@ -258,12 +258,31 @@ def _run_node(args: argparse.Namespace) -> int:
                 if args.stream_publish is not None
                 else cfg.stream_publish_tokens
             ),
+            kv_tier_dir=(args.kv_tier_dir or cfg.kv_tier_dir),
+            kv_tier_capacity_bytes=(
+                int(args.kv_tier_capacity_gb * (1 << 30))
+                if args.kv_tier_capacity_gb is not None
+                else cfg.kv_tier_capacity_bytes
+            ),
             # TPU step attribution (obs/step_plane.py): per-wave MFU +
             # pad-fraction accounting, opt-in via the model config (the
             # node subcommand is config-file-driven).
             step_accounting=bool(model.get("step_accounting", False)),
             peak_tflops=model.get("peak_tflops"),
         )
+        if engine.resurrected.get("grafted_nodes"):
+            # Cold-cell resurrection: the transport is up (node.start()
+            # above), so re-announce the disk-grafted working set
+            # through the normal insert/SHARD_SUMMARY path — routers
+            # and co-owners learn these prefixes exist again.
+            n = engine.announce_resurrected()
+            log.info(
+                "resurrected %d prefix(es) / %d tokens from %s; "
+                "re-announced %d",
+                engine.resurrected["grafted_nodes"],
+                engine.resurrected["grafted_tokens"],
+                args.kv_tier_dir or cfg.kv_tier_dir, n,
+            )
         if engine.kv_transfer is not None:
             # Predictive restores: PREFETCH hints received off the wire
             # land in the plane's bounded hint queue; the engine converts
@@ -515,6 +534,12 @@ def _run_serve(args: argparse.Namespace) -> int:
         kv_transfer_async=args.kv_transfer_async,
         kv_transfer_chunk_tokens=args.kv_transfer_chunk or 512,
         kv_transfer_min_restore_tokens=args.kv_transfer_min_restore or 0,
+        kv_tier_dir=args.kv_tier_dir,
+        kv_tier_capacity_bytes=(
+            int(args.kv_tier_capacity_gb * (1 << 30))
+            if args.kv_tier_capacity_gb is not None
+            else 1 << 30
+        ),
         stream_publish_tokens=args.stream_publish or 0,
         step_accounting=args.step_accounting,
         peak_tflops=args.peak_tflops,
@@ -611,6 +636,19 @@ def _add_kv_transfer_args(sub: argparse.ArgumentParser) -> None:
         "--kv-transfer-min-restore", type=int, default=None, metavar="TOKENS",
         help="restores shorter than this stay on the synchronous "
         "in-admission path (default 0 = always staged)",
+    )
+    sub.add_argument(
+        "--kv-tier-dir", default=None, metavar="DIR",
+        help="durable KV spill tier (cache/kv_tier.py): directory for "
+        "checksummed fsynced extent files below the host-RAM tier. "
+        "Arms the async plane; at boot the directory is scanned and "
+        "every verified prefix is resurrected (cold-cell recovery). "
+        "Requires a host tier (host_cache_slots > 0)",
+    )
+    sub.add_argument(
+        "--kv-tier-capacity-gb", type=float, default=None, metavar="GB",
+        help="extent-store disk budget (default 1 GiB); oldest extents "
+        "are dropped past it",
     )
     sub.add_argument(
         "--stream-publish", type=int, default=None, metavar="TOKENS",

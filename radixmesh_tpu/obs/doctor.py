@@ -71,6 +71,7 @@ RULES = (
     "slo_burn_rate",
     "spec_efficiency",
     "rebalancer_asleep",
+    "tier_thrash",
 )
 
 # The pinned evidence vocabulary per rule: every finding MUST carry at
@@ -95,6 +96,9 @@ RULE_EVIDENCE_FIELDS = {
     "rebalancer_asleep": (
         "skew_peak", "sustained_s", "window_s", "moves_in_window",
         "hot_shard", "plane_armed",
+    ),
+    "tier_thrash": (
+        "shard", "demotes", "promotes", "cycles", "window_s", "source",
     ),
 }
 
@@ -148,6 +152,13 @@ class DoctorConfig:
     # History-fed trajectories are change-compressed (a gap means NO
     # CHANGE), so their persistence is exact and uncapped.
     rebalance_max_sample_gap_s: float = 30.0
+    # tier_thrash: the durable KV tier (cache/kv_tier.py) demoting AND
+    # promoting the same subtree shard >= min_cycles times each inside
+    # one hysteresis window — the working set straddles the host
+    # watermark and every crossing pays a disk round trip. Cycles =
+    # min(demotes, promotes) within the window.
+    tier_thrash_window_s: float = 60.0
+    tier_thrash_min_cycles: int = 3
 
 
 @dataclass
@@ -285,6 +296,86 @@ class BurnRateTracker:
     def tenants(self) -> list[str]:
         with self._lock:
             return sorted(self._samples)
+
+
+def _parse_labels(name: str) -> dict[str, str]:
+    """Label dict off a rendered series name
+    (``family{k="v",k2="v2"}``); {} when unlabeled/malformed."""
+    i = name.find("{")
+    if i < 0 or not name.endswith("}"):
+        return {}
+    out: dict[str, str] = {}
+    for part in name[i + 1 : -1].split(","):
+        if "=" not in part:
+            continue
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def _tier_move_events(series: dict) -> list[tuple[float, int, str]]:
+    """(t, shard, dir) move events reconstructed from recorded
+    ``radixmesh_kv_tier_moves_total`` counter series. Counters are
+    change-compressed cumulative values, so each point's delta over the
+    previous point is the number of moves landing at that sample."""
+    events: list[tuple[float, int, str]] = []
+    for name, body in series.items():
+        if not name.startswith("radixmesh_kv_tier_moves_total{"):
+            continue
+        labels = _parse_labels(name)
+        d = labels.get("dir")
+        sh = labels.get("shard")
+        if d not in ("demote", "promote") or sh is None:
+            continue
+        pts = body["points"] if isinstance(body, dict) else body
+        prev = None
+        for _, t, v in pts:
+            if prev is None:
+                # The first retained point of a change-compressed ring
+                # carries the cumulative PRE-window count (late-started
+                # history, pruned head): it is the baseline, not a
+                # burst of moves at one instant. Deliberately
+                # conservative — for a ring that recorded from counter
+                # birth this swallows the first real move per series
+                # (at most one cycle at the exact threshold), which is
+                # the right trade against a pruned head replaying
+                # hundreds of phantom moves as a guaranteed false
+                # tier_thrash.
+                prev = float(v)
+                continue
+            delta = int(round(float(v) - prev))
+            prev = float(v)
+            events.extend((float(t), int(sh), d) for _ in range(max(0, delta)))
+    events.sort()
+    return events
+
+
+def _max_flap(
+    events: list[tuple[float, int, str]], window_s: float
+) -> tuple[int, int, int, int] | None:
+    """Worst flapping shard over any sliding window of ``window_s``:
+    (cycles, demotes, promotes, shard) where cycles = min(demotes,
+    promotes) inside the window — one 'cycle' is a full host→disk→host
+    round trip. None when no demote/promote events exist."""
+    by_shard: dict[int, list[tuple[float, int, str]]] = {}
+    for ev in events:
+        by_shard.setdefault(ev[1], []).append(ev)
+    best: tuple[int, int, int, int] | None = None
+    for sh, evs in sorted(by_shard.items()):
+        i = 0
+        counts = {"demote": 0, "promote": 0}
+        for j, (t, _, d) in enumerate(evs):
+            counts[d] += 1
+            while evs[i][0] < t - window_s:
+                counts[evs[i][2]] -= 1
+                i += 1
+            cand = (
+                min(counts["demote"], counts["promote"]),
+                counts["demote"], counts["promote"], sh,
+            )
+            if best is None or cand > best:
+                best = cand
+    return best
 
 
 class MeshDoctor:
@@ -681,6 +772,60 @@ class MeshDoctor:
             },
         )
 
+    def _rule_tier_thrash(self) -> Finding | None:
+        cfg = self.cfg
+        now = self._now()
+        events: list[tuple[float, int, str]] = []
+        source = None
+        hist = self.history
+        if hist is not None:
+            try:
+                q = hist.query(
+                    family="radixmesh_kv_tier_moves_total", limit=100000
+                )
+                events = _tier_move_events(q["series"])
+                if events:
+                    source = "history"
+            except Exception:  # noqa: BLE001 — a broken seam degrades to the live ring
+                events = []
+        if not events:
+            tier = getattr(self.engine, "_kv_tier", None) \
+                if self.engine is not None else None
+            if tier is None:
+                return None
+            # list() is one C call over the deque (GIL-atomic snapshot,
+            # the spec_report discipline).
+            events = [
+                (t, sh, d)
+                for (t, sh, d) in list(tier.recent_moves)
+                if d in ("demote", "promote")
+            ]
+            source = "live"
+        events = [
+            e for e in events if e[0] >= now - cfg.tier_thrash_window_s
+        ]
+        best = _max_flap(events, cfg.tier_thrash_window_s)
+        if best is None or best[0] < cfg.tier_thrash_min_cycles:
+            return None
+        cycles, demotes, promotes, shard = best
+        return Finding(
+            "tier_thrash",
+            min(1.0, 0.4 + 0.1 * cycles),
+            f"subtree shard {shard} flapped host<->disk {cycles}x "
+            f"({demotes} demotes / {promotes} promotes) inside the "
+            f"{cfg.tier_thrash_window_s:.0f}s hysteresis window — the "
+            "working set straddles the destage watermark; raise the "
+            "watermark or the host arena",
+            {
+                "shard": int(shard),
+                "demotes": int(demotes),
+                "promotes": int(promotes),
+                "cycles": int(cycles),
+                "window_s": cfg.tier_thrash_window_s,
+                "source": source,
+            },
+        )
+
     # -- the diagnosis -------------------------------------------------
 
     def diagnose(self) -> dict:
@@ -694,6 +839,7 @@ class MeshDoctor:
             "slo_burn_rate": self._rule_slo_burn_rate,
             "spec_efficiency": self._rule_spec_efficiency,
             "rebalancer_asleep": self._rule_rebalancer_asleep,
+            "tier_thrash": self._rule_tier_thrash,
         }
         # Seam presence per rule: a rule whose inputs are absent never
         # looked at anything, so it must NOT appear in rules_checked —
@@ -710,6 +856,11 @@ class MeshDoctor:
             "slo_burn_rate": self.slo is not None,
             "spec_efficiency": self.engine is not None,
             "rebalancer_asleep": self.mesh is not None,
+            # The tier series ride the history ring even for an
+            # engine-less doctor (a frontend sampling a remote
+            # registry), so either seam arms the rule.
+            "tier_thrash": self.engine is not None
+            or self.history is not None,
         }
         findings: list[Finding] = []
         checked: list[str] = []
@@ -762,6 +913,7 @@ POSTMORTEM_RULES = (
     "hot_shard",
     "replication_lag",
     "slo_burn_rate",
+    "tier_thrash",
 )
 
 POSTMORTEM_EVIDENCE_FIELDS = {
@@ -769,6 +921,7 @@ POSTMORTEM_EVIDENCE_FIELDS = {
     "hot_shard": ("shard", "skew_peak", "t_peak"),
     "replication_lag": ("ranks", "threshold_s", "worst_lag_s"),
     "slo_burn_rate": ("tenant", "burn_fast", "burn_slow", "t_peak"),
+    "tier_thrash": ("shard", "demotes", "promotes", "cycles", "window_s"),
 }
 
 
@@ -1019,6 +1172,29 @@ def postmortem_report(dump: dict, cfg: DoctorConfig | None = None) -> dict:
                     "t_peak": round(t, 3),
                 },
             ))
+
+    # -- tier_thrash (worst flapping window in the record) -------------
+    checked.append("tier_thrash")
+    events = _tier_move_events(series)
+    best = _max_flap(events, cfg.tier_thrash_window_s)
+    if best is not None and best[0] >= cfg.tier_thrash_min_cycles:
+        cycles, demotes, promotes, shard = best
+        findings.append(Finding(
+            "tier_thrash",
+            min(1.0, 0.4 + 0.1 * cycles),
+            f"subtree shard {shard} flapped host<->disk {cycles}x "
+            f"({demotes} demotes / {promotes} promotes) inside one "
+            f"{cfg.tier_thrash_window_s:.0f}s window of the recorded "
+            "history — the tier was paying a disk round trip per "
+            "watermark crossing before the dump",
+            {
+                "shard": int(shard),
+                "demotes": int(demotes),
+                "promotes": int(promotes),
+                "cycles": int(cycles),
+                "window_s": cfg.tier_thrash_window_s,
+            },
+        ))
 
     findings.sort(
         key=lambda f: (-f.score, POSTMORTEM_RULES.index(f.rule))

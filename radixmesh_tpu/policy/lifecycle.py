@@ -525,6 +525,22 @@ class LifecyclePlane:
         elif self.writeback_fn is not None:
             stats["writeback_tokens"] = int(self.writeback_fn() or 0)
             stats["writeback_flushed"] = True
+        # 5a. Hot subtrees → DISK (cache/kv_tier.py): the host flush
+        #     above only survives this process; forcing the arena's
+        #     working set into checksummed extents makes the departure
+        #     survivable even if the whole cell later loses power
+        #     before anyone rejoins. committed reports the spill
+        #     commits' verdict (the write-back discipline of step 5).
+        #     No-op (0, True) on runners without a disk tier.
+        if runner is not None and hasattr(runner, "drain_flush_disk"):
+            try:
+                spilled, committed = runner.drain_flush_disk()
+                stats["disk_spill_nodes"] = int(spilled)
+                stats["disk_spill_committed"] = bool(committed)
+            except Exception:  # noqa: BLE001 — a tier bug must not wedge the drain
+                self.log.exception("disk-tier drain flush failed")
+                stats["disk_spill_nodes"] = 0
+                stats["disk_spill_committed"] = False
         # 5b. Sharded ownership transfer (cache/sharding.py): hand each
         #     owned shard's entries to the ranks that BECOME owners once
         #     this node leaves — the RF invariant must survive the

@@ -193,6 +193,15 @@ class EngineRunner:
                 )
         return n, landed
 
+    def drain_flush_disk(self) -> tuple[int, bool]:
+        """Drain step 5d (policy/lifecycle.py): flush the hot subtrees
+        one tier further — host arena → durable disk extents — so the
+        working set survives a whole-cell power loss after this node
+        leaves. (0, True) without a tier. Run AFTER :meth:`drain_flush`
+        so the device flush has landed in the arena first."""
+        with self._lock:
+            return self.engine.drain_flush_disk()
+
     def wait(self, req: Request, timeout: float | None = None) -> list[int]:
         """Block until ``req`` finishes; returns its generated tokens.
 

@@ -283,7 +283,17 @@ def run_ring_sim(
     topology: str = "ring",
     hop_delay_ms: float = 1.0,
     rf: int = 0,
+    overrides: bool = False,
+    boosted_shards: int = 8,
 ) -> dict:
+    """``overrides=True`` (rf>0 only): before measuring, the writer
+    adopts a :class:`ShardOverrides` boosting ``boosted_shards`` shards
+    with one extra owner each and the ring converges on it — the PR 14
+    deferral: owner-propagation at scale WITH an active override map,
+    where every insert pays the override-aware derivation plus the
+    boosted shards' wider fan-out. The override adoption itself happens
+    before the frame counters reset, so bytes-per-insert stays an
+    insert cost, not a gossip echo."""
     from collections import deque
 
     from radixmesh_tpu.cache.mesh_cache import MeshCache
@@ -335,10 +345,41 @@ def run_ring_sim(
     rng = np.random.default_rng(7)
     keys = rng.integers(1, 50000, size=(n_inserts, KEY_LEN))
     writer = nodes[0]
+    rf_boost = 0
+    if overrides:
+        if rf <= 0:
+            raise ValueError("overrides require a sharded mesh (rf > 0)")
+        from radixmesh_tpu.cache.rebalance import ShardOverrides
+
+        moves = {}
+        for sid in range(boosted_shards):
+            base = writer.base_owners_of(sid)
+            extra = next(
+                (r for r in range(n_nodes) if r not in base), None
+            )
+            if extra is not None:
+                moves[sid] = tuple(base) + (extra,)
+        ovr = ShardOverrides(writer.view.epoch, 1, moves)
+        if not writer.adopt_overrides(ovr):
+            raise RuntimeError("override adoption refused in sim")
+        pump()  # converge the REBALANCE gossip before measuring
+        for node in nodes:
+            if len(node.overrides) != len(moves):
+                raise RuntimeError(
+                    f"rank {node.rank} did not adopt the overrides"
+                )
+        rf_boost = 1
+        # The adoption gossip must not pollute the per-insert numbers.
+        stats["frames"] = 0
+        stats["bytes"] = 0
+        t0 = time.monotonic()
+    serial_s: list[float] = []
     for i, key in enumerate(keys):
+        ti = time.monotonic()
         writer.insert(
             key.tolist(), np.arange(KEY_LEN, dtype=np.int32) + i * KEY_LEN
         )
+        serial_s.append(time.monotonic() - ti)
     pump()
     wall_s = time.monotonic() - t0
     # Every replica that must hold every key does (real apply path).
@@ -362,6 +403,7 @@ def run_ring_sim(
     # replica (ring) vs one parallel point-to-point hop (sharded).
     hops = 1 if rf > 0 else max(1, n_nodes - 1)
     prop_ms = round(hop_delay_ms * hops, 2)
+    ser = np.asarray(serial_s)
     return {
         "n_nodes": n_nodes,
         "topology": "ring",
@@ -379,6 +421,14 @@ def run_ring_sim(
         "frames_per_insert": frames,
         "measured_frames_per_insert": measured,
         "ring_bytes_per_insert": round(stats["bytes"] / n_inserts),
+        # Writer-side serial cost per insert (ownership walk + one
+        # serialization + per-owner enqueue) — the component an active
+        # override map actually grows; modeled hop latency cannot see it.
+        "writer_serial_p50_ms": round(float(np.percentile(ser, 50)) * 1e3, 4),
+        "writer_serial_p99_ms": round(float(np.percentile(ser, 99)) * 1e3, 4),
+        "overrides_active": bool(overrides),
+        "boosted_shards": int(boosted_shards) if overrides else 0,
+        "rf_boost": rf_boost,
     }
 
 
@@ -655,6 +705,13 @@ def main() -> int:
         help="one OS process per node over the native C++ transport "
         "(live sizes only, rf=0)",
     )
+    ap.add_argument(
+        "--overrides", action="store_true",
+        help="also measure the LARGEST sim size at each rf>0 with an "
+        "adopted ShardOverrides map (8 boosted shards, +1 owner each) — "
+        "the RINGSCALE v3 row: owner propagation under active "
+        "rebalancer overrides (the PR 14 deferral)",
+    )
     ap.add_argument("--node", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
@@ -678,6 +735,15 @@ def main() -> int:
                     r = run_ring(n, args.inserts, args.probes, "ring", delay, rf=rf)
                 print(json.dumps(r), file=sys.stderr, flush=True)
                 results.append(r)
+            if args.overrides and rf > 0:
+                sim_sizes = [s for s in sizes if s > args.sim_threshold]
+                if sim_sizes:
+                    r = run_ring_sim(
+                        max(sim_sizes), args.inserts, hop_delay_ms=delay,
+                        rf=rf, overrides=True,
+                    )
+                    print(json.dumps(r), file=sys.stderr, flush=True)
+                    results.append(r)
         if args.hier:
             for n in [s for s in sizes if s <= args.sim_threshold]:
                 r = run_ring(n, args.inserts, args.probes, "hier", delay)
@@ -702,8 +768,12 @@ def main() -> int:
                     2,
                 ),
             }
+    has_overrides = any(r.get("overrides_active") for r in results)
     report = {
-        "schema_version": 2,
+        # v3 = at least one owner-propagation-under-overrides row
+        # (bench.validate_ringscale gates it); override-less sweeps
+        # keep emitting the v2 shape.
+        "schema_version": 3 if has_overrides else 2,
         "metric": "ring_scale_sweep",
         "mode": "mixed:live+sim" if any(
             r.get("mode") == "sim" for r in results

@@ -1,0 +1,128 @@
+"""Standalone durable-tier acceptance bench (the TIER artifact's paired
+CLI emitter, like ``scripts/rebalancebench.py`` is for REBALANCE).
+
+Runs ``workload.run_tier_workload`` — the three PR-15 claims:
+
+- **capacity**: hit-rate at a working set >= 10x host capacity beats
+  the no-tier baseline (the tier stack finally outlives DRAM);
+- **restore overlap**: decode keeps stepping while requests park
+  behind staged disk restores (KVFLOW's decode-never-blocks contract
+  extended one tier down);
+- **cold-cell resurrection**: the whole cell killed hard mid-decode,
+  one extent bit-flipped + one truncated, restarted from the extent
+  directory alone — zero failed requests, every interrupted stream
+  resumed byte-identical from disk, corrupt extents detected and
+  dropped, never served.
+
+Then runs meshcheck's checker set and keeps the findings landing on the
+tier plane (``cache/kv_tier.py`` + the spill/restore lanes) — the
+artifact gates on 0 findings there, with the new ``hotpath-file-io``
+invariant's positive control tripping — and prints ONE JSON line
+validated against the schema ``bench.validate_tier`` pins.
+
+Usage::
+
+    python scripts/tierbench.py [--seed 0] [--out FILE] [--write-artifact]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+
+# The new durability plane meshcheck must report clean for the artifact
+# to gate green.
+PLANE_FILES = (
+    "cache/kv_tier.py", "cache/kv_transfer.py", "cache/host_cache.py",
+)
+
+
+def tier_round() -> int:
+    """The round in progress = 1 + the highest N across every OTHER
+    plane's recorded artifact (the scripts/meshcheck.py analysis_round
+    convention)."""
+    rounds = [0]
+    for name in os.listdir(_REPO_ROOT):
+        m = re.fullmatch(r"[A-Z_]+_r(\d+)\.json", name)
+        if m and not name.startswith("TIER_"):
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def meshcheck_plane() -> dict:
+    """Run the full checker set over the product tree and keep the
+    findings that land on the tier plane's files — a full-tree parse
+    because the hotpath-file-io invariant is exactly about OTHER
+    modules' call chains reaching this plane's I/O. Also asserts the
+    new invariant's positive control trips (a clean verdict from a
+    blind checker is not a verdict)."""
+    from radixmesh_tpu.analysis import all_checkers, tree_index
+    from radixmesh_tpu.analysis.controls import run_positive_controls
+    from radixmesh_tpu.analysis.core import run_checkers
+
+    result = run_checkers(tree_index(), all_checkers())
+    plane_findings = [
+        f for f in result.findings
+        if f.file in PLANE_FILES
+        or f.invariant == "hotpath-file-io"
+        or "kv_tier" in f.message
+    ]
+    controls = run_positive_controls()
+    fio = [c for c in controls if c.invariant == "hotpath-file-io"]
+    control_ok = bool(fio) and all(c.tripped for c in fio)
+    return {
+        "files": list(PLANE_FILES),
+        "findings": len(plane_findings) + (0 if control_ok else 1),
+        "clean": not plane_findings and control_ok,
+        "file_io_controls": len(fio),
+        "file_io_controls_tripped": sum(c.tripped for c in fio),
+        "detail": [str(f) for f in plane_findings[:16]],
+    }
+
+
+def run(seed: int) -> dict:
+    from radixmesh_tpu.workload import run_tier_workload
+
+    res = run_tier_workload(seed=seed)
+    report = bench.build_tier_report(res, meshcheck=meshcheck_plane())
+    problems = bench.validate_tier(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="tierbench")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument(
+        "--write-artifact", action="store_true",
+        help="write the round's TIER_r{N}.json to the repo root",
+    )
+    args = ap.parse_args()
+    report = run(args.seed)
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    if args.write_artifact:
+        path = os.path.join(_REPO_ROOT, f"TIER_r{tier_round():02d}.json")
+        with open(path, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"tierbench: wrote {os.path.basename(path)}", file=sys.stderr)
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

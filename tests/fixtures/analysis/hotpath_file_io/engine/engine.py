@@ -1,0 +1,28 @@
+"""Seeded hot-path file I/O: the extent read is two frames down from
+``Engine.step`` — a refactor dragging disk-tier I/O into the serving
+loop would look exactly like this, and only the call graph sees it
+(``_load_extent`` hides behind an innocent-looking helper). A second
+seed proves the ``os.fsync`` shape trips too."""
+
+import os
+
+
+class Engine:
+    def step(self):
+        self._admit()
+
+    def _admit(self):
+        self._load_extent()
+
+    def _load_extent(self):
+        with open("/tmp/extent.kv", "rb") as fh:  # seeded: hotpath-file-io
+            data = fh.read()
+        return data
+
+    def enqueue(self, req):
+        self._commit(req)
+
+    def _commit(self, req):
+        fd = os.open("/tmp/extent.kv", os.O_WRONLY)
+        os.fsync(fd)  # seeded: hotpath-file-io
+        return req

@@ -109,6 +109,15 @@ class TestPositiveControls:
         hits = _tripped(controls, "hotpath_sleep", "hotpath-blocking")
         assert hits[0].file == "engine/engine.py"
 
+    def test_seeded_hotpath_file_io(self, controls):
+        """PR 15's durable-tier boundary: an extent read (builtin open)
+        two frames below Engine.step AND an os.fsync below
+        Engine.enqueue both trip — the lint pin that keeps disk I/O on
+        the KV-plane worker, never the serving loop."""
+        hits = _tripped(controls, "hotpath_file_io", "hotpath-file-io")
+        assert len(hits) == 2
+        assert all(h.file == "engine/engine.py" for h in hits)
+
     def test_seeded_unregistered_oplog_kind(self, controls):
         hits = _tripped(controls, "wire_unregistered", "wire-unregistered")
         assert hits[0].file == "cache/oplog.py"

@@ -717,15 +717,23 @@ class TestRingscaleArtifactSchema:
             with open(path) as fh:
                 report = json.load(fh)
             assert bench.validate_ringscale(report) == [], path
-        # The newest artifact must be v2 and actually demonstrate the
-        # flat sharded curve at the 200-node ceiling.
+        # The newest artifact must be current-schema and actually
+        # demonstrate the flat sharded curve at the 200-node ceiling;
+        # from v3 on it must also carry the owner-propagation-under-
+        # overrides row (the PR 14 deferral, measured in PR 15).
         with open(paths[-1]) as fh:
             newest = json.load(fh)
-        assert newest.get("schema_version") == 2
+        assert newest.get("schema_version") >= 2
         sharded = [
             r for r in newest["results"] if int(r.get("rf", 0)) > 0
         ]
         assert any(r["n_nodes"] >= 200 for r in sharded)
+        if newest.get("schema_version") >= 3:
+            ov = [
+                r for r in newest["results"] if r.get("overrides_active")
+            ]
+            assert ov and all(int(r.get("rf", 0)) > 0 for r in ov)
+            assert any(r["n_nodes"] >= 200 for r in ov)
 
 
 class TestObsArtifactSchema:
@@ -1806,3 +1814,213 @@ class TestRebalanceArtifactSchema:
         assert report["rebalance"]["performed"] is True
         assert report["router_kill"]["performed"] is True
         assert report["meshcheck"]["findings"] == 0
+
+
+class TestTierArtifactSchema:
+    """The TIER artifact (PR 15, the durable KV spill tier): hit-rate
+    at a working set >= 10x host capacity beats the no-tier baseline,
+    decode never blocks on disk restores, the whole-cell kill-and-
+    restart drill resumes every stream byte-identical from disk alone
+    with seeded corrupt/torn extents detected and never served, and
+    meshcheck reports the tier plane clean."""
+
+    def _report(self) -> dict:
+        return {
+            "schema_version": bench.TIER_SCHEMA_VERSION,
+            "metric": "tier_hit_rate_gain",
+            "value": 16.0,
+            "unit": "tier hit-rate / no-tier baseline at 12x host capacity",
+            "workload": "zipf re-visit + overlap + cold-cell drill",
+            "capacity": {
+                "working_set_tokens": 6144, "host_slots": 512,
+                "working_set_ratio": 12.0, "tier_hit_rate": 0.99,
+                "baseline_hit_rate": 0.06, "hit_rate_gain": 16.0,
+                "requests": 32, "distinct_prefixes": 16,
+            },
+            "spill": {
+                "spilled_tokens": 6144, "extents": 16, "demotes": 16,
+                "promotes": 15, "drops": 0, "resident_bytes": 3_000_000,
+            },
+            "restore_overlap": {
+                "parked_requests": 3, "disk_restored_tokens": 6912,
+                "decode_steps_during_restore": 2,
+                "max_decode_gap_s": 1.5, "overlap_ok": True,
+            },
+            "cold_start": {
+                "performed": True, "interrupted": 5, "resumed": 5,
+                "byte_identical": True, "failed": 0,
+                "disk_hit_tokens": 2064, "grafted_nodes": 4,
+                "orphaned": 0, "corrupt_detected": 2,
+                "corrupt_served": 0, "restart_s": 0.005,
+            },
+            "corruption": {
+                "extents_attacked": 2, "truncated": 1, "bitflipped": 1,
+                "detected": 2, "served_corrupt": 0,
+            },
+            "meshcheck": {
+                "files": ["cache/kv_tier.py"], "findings": 0,
+                "clean": True,
+            },
+            "page_size": 4,
+            "wall_s": 9.3,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_tier(self._report()) == []
+        assert bench.validate_tier(7) == ["artifact is not a JSON object"]
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["capacity"]["working_set_ratio"]
+        del report["cold_start"]["byte_identical"]
+        del report["corruption"]["served_corrupt"]
+        missing = bench.validate_tier(report)
+        assert "capacity.working_set_ratio" in missing
+        assert "cold_start.byte_identical" in missing
+        assert "corruption.served_corrupt" in missing
+
+    def test_capacity_gates(self):
+        report = self._report()
+        report["capacity"]["working_set_ratio"] = 4.0
+        report["capacity"]["tier_hit_rate"] = 0.05
+        problems = "\n".join(bench.validate_tier(report))
+        assert "10.0x" in problems
+        assert "does not beat" in problems
+
+    def test_cold_start_gates(self):
+        report = self._report()
+        report["cold_start"]["failed"] = 1
+        report["cold_start"]["resumed"] = 4
+        report["cold_start"]["byte_identical"] = False
+        report["cold_start"]["corrupt_served"] = 1
+        report["cold_start"]["corrupt_detected"] = 0
+        report["cold_start"]["disk_hit_tokens"] = 0
+        problems = "\n".join(bench.validate_tier(report))
+        assert "must lose nothing" in problems
+        assert "resumed 4 != interrupted 5" in problems
+        assert "byte-identical" in problems
+        assert "SERVED" in problems
+        assert "was not detected" in problems
+        assert "never actually read the durable tier" in problems
+
+    def test_overlap_gates(self):
+        report = self._report()
+        report["restore_overlap"]["parked_requests"] = 0
+        report["restore_overlap"]["decode_steps_during_restore"] = 0
+        report["restore_overlap"]["overlap_ok"] = False
+        problems = "\n".join(bench.validate_tier(report))
+        assert "zero parked disk restores" in problems
+        assert "decode made zero progress" in problems
+
+    def test_corruption_gates(self):
+        report = self._report()
+        report["corruption"]["detected"] = 1
+        problems = "\n".join(bench.validate_tier(report))
+        assert "1 of 2 attacked" in problems
+
+    def test_meshcheck_and_value_gates(self):
+        report = self._report()
+        report["meshcheck"]["clean"] = False
+        report["meshcheck"]["findings"] = 2
+        report["value"] = 0.8
+        problems = "\n".join(bench.validate_tier(report))
+        assert "statically clean" in problems
+        assert "not > 1" in problems
+
+    def test_skipped_cold_start_gate_exempt(self):
+        report = self._report()
+        report["cold_start"] = {"performed": False}
+        report["corruption"]["extents_attacked"] = 0
+        assert bench.validate_tier(report) == []
+
+    def test_non_dict_sections_are_violations(self):
+        report = self._report()
+        report["cold_start"] = "done"
+        problems = "\n".join(bench.validate_tier(report))
+        assert "cold_start section is not an object" in problems
+
+    def test_build_report_matches_schema(self):
+        base = self._report()
+        res = {
+            k: base[k]
+            for k in (
+                "capacity", "spill", "restore_overlap", "cold_start",
+                "corruption", "page_size", "wall_s",
+            )
+        }
+        report = bench.build_tier_report(res, meshcheck=base["meshcheck"])
+        assert bench.validate_tier(report) == []
+        assert report["value"] == base["capacity"]["hit_rate_gain"]
+
+    def test_build_report_without_meshcheck_fails_the_gate(self):
+        base = self._report()
+        res = {
+            k: base[k]
+            for k in (
+                "capacity", "spill", "restore_overlap", "cold_start",
+                "corruption", "page_size", "wall_s",
+            )
+        }
+        problems = "\n".join(bench.validate_tier(bench.build_tier_report(res)))
+        assert "statically clean" in problems
+
+    def test_tier_kind_registered_in_sentinel(self):
+        assert "TIER" in bench.COMPARE_RULES
+        assert bench.artifact_kind(self._report()) == "TIER"
+        assert bench.artifact_kind({}, "TIER_r15.json") == "TIER"
+        res = bench.benchdiff_selfcheck()
+        assert "TIER" in res["kinds_covered"]
+
+    def test_compare_rounds_flags_corrupt_served(self):
+        old = self._report()
+        new = self._report()
+        new["cold_start"]["corrupt_served"] = 1
+        res = bench.compare_rounds(old, new, kind="TIER")
+        assert res["status"] == "regression"
+        assert "cold_start.corrupt_served" in res["regressions"]
+
+    def test_checked_in_artifact_validates(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "TIER_r*.json")))
+        assert paths, "no TIER artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_tier(report) == []
+        assert report["cold_start"]["performed"] is True
+        assert report["cold_start"]["byte_identical"] is True
+        assert report["corruption"]["served_corrupt"] == 0
+        assert report["meshcheck"]["findings"] == 0
+        # The new lint invariant's positive control demonstrably trips
+        # in the artifact's meshcheck verdict.
+        assert report["meshcheck"]["file_io_controls_tripped"] >= 1
+
+
+class TestDoctorRuleVersionGating:
+    """DOCTOR/BLACKBOX v3 (PR 15): artifacts validate against the rule
+    set pinned for THEIR schema version — a checked-in v1/v2 artifact
+    can never retroactively have run tier_thrash."""
+
+    def test_v1_requires_the_pinned_six(self):
+        from radixmesh_tpu.obs.doctor import RULES
+
+        req = bench._required_doctor_rules({"schema_version": 1}, RULES)
+        assert tuple(req) == bench.DOCTOR_RULES_V1
+
+    def test_v2_requires_the_pinned_seven(self):
+        from radixmesh_tpu.obs.doctor import RULES
+
+        req = bench._required_doctor_rules({"schema_version": 2}, RULES)
+        assert tuple(req) == bench.DOCTOR_RULES_V2
+        assert "tier_thrash" not in req
+
+    def test_v3_requires_every_live_rule(self):
+        from radixmesh_tpu.obs.doctor import RULES
+
+        req = bench._required_doctor_rules(
+            {"schema_version": bench.DOCTOR_SCHEMA_VERSION}, RULES
+        )
+        assert "tier_thrash" in req
+        assert tuple(req) == RULES

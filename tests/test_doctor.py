@@ -482,7 +482,7 @@ class TestDiagnoseContract:
         ]
         report = MeshDoctor(engine=FakeEngine()).diagnose()
         assert list(report["rules_checked"]) == [
-            "restore_park_stall", "spec_efficiency",
+            "restore_park_stall", "spec_efficiency", "tier_thrash",
         ]
 
     def test_findings_ranked_by_score(self):

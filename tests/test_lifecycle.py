@@ -134,6 +134,51 @@ class TestStateMachine:
         # Idempotent once LEFT.
         assert lc.drain(deadline_s=0.1) == stats
 
+    def test_drain_step_5d_flushes_disk_ward(self):
+        """PR 15: a runner exposing drain_flush_disk has its hot
+        subtrees forced into durable extents as drain step 5d, with the
+        commit verdict recorded — and a tier bug never wedges the LEAVE
+        (crash-isolated like the black-box flush)."""
+        mesh = solo_mesh()
+
+        class DiskRunner:
+            def begin_drain(self, retry_after_s=None):
+                pass
+
+            def drain_requeue(self):
+                return 0
+
+            def drain_wait_idle(self, deadline_s):
+                return True
+
+            def drain_flush(self):
+                return 7, True
+
+            def drain_flush_disk(self):
+                return 3, True
+
+        lc = LifecyclePlane(
+            mesh, runner=DiskRunner(),
+            cfg=LifecycleConfig(leave_retries=1, leave_confirm_s=0.0),
+        )
+        stats = lc.drain(deadline_s=0.1)
+        assert lc.state is LifecycleState.LEFT
+        assert stats["disk_spill_nodes"] == 3
+        assert stats["disk_spill_committed"] is True
+
+        class ExplodingDiskRunner(DiskRunner):
+            def drain_flush_disk(self):
+                raise RuntimeError("tier down")
+
+        mesh2 = solo_mesh("solo-disk")
+        lc2 = LifecyclePlane(
+            mesh2, runner=ExplodingDiskRunner(),
+            cfg=LifecycleConfig(leave_retries=1, leave_confirm_s=0.0),
+        )
+        stats2 = lc2.drain(deadline_s=0.1)
+        assert lc2.state is LifecycleState.LEFT  # never wedged
+        assert stats2["disk_spill_committed"] is False
+
     def test_failed_drain_releases_claim_for_retry(self):
         """A drain step that raises must not wedge the node in DRAINING
         forever: the claim releases so a retry can finish the exit

@@ -254,15 +254,19 @@ class TestConcurrencyPlane:
         assert not bad, "\n".join(str(f) for f in bad)
 
     def test_guarded_by_ledger_is_live(self):
-        """The suppression ledger carries at least the documented
-        double-checked fast path (kv_transfer.host_slots_ok) and every
-        guarded-by excuse is used — the excuse-ledger rot rule."""
+        """Every guarded-by excuse present is USED — the excuse-ledger
+        rot rule. (PR 15 made the ledger empty for this invariant: the
+        host_slots_ok fast path's off-lock read became a two-site
+        convention once the spill lane's worker-side check joined it,
+        so the checker no longer flags it and the stale-suppression
+        rule forced the comment out. An empty ledger is legal; a rotted
+        one is not — and the guarded_race fixture's positive control
+        still proves the checker sees the bug class.)"""
         sups = [
             s for s in _result().suppressions
             if "guarded-by-race" in s.invariants
         ]
-        assert sups and all(s.used for s in sups)
-        assert any(s.file == "cache/kv_transfer.py" for s in sups)
+        assert all(s.used for s in sups)
 
     def test_thread_map_is_complete(self):
         """Every Thread/Timer target resolves and every spawn is

@@ -102,6 +102,13 @@ def _register_all_instrumented_families() -> None:
 
     RebalancePlane(pd_mesh).close()
     RouterFrontDoor([("r0", lambda *a: None)], name="lint-fd")
+    # The durable KV spill tier (PR 15): spill/restore/corruption
+    # counters, move counter, and the resident-bytes/extent gauges
+    # (cache/kv_tier.py).
+    from radixmesh_tpu.cache.kv_tier import DiskKVTier
+
+    with tempfile.TemporaryDirectory() as tier_dir:
+        DiskKVTier(tier_dir, name="lint-tier")
 
 
 def _registered_families() -> dict[str, str]:
@@ -550,3 +557,26 @@ class TestMetricHygiene:
             == "counter"
         )
         assert "_rf_boost" in GAUGE_SUFFIXES
+
+    def test_kv_tier_families_registered(self):
+        """Satellite (PR 15): the durable tier's spill/restore byte +
+        token counters, the cause-labeled corruption counter, the
+        direction+shard-labeled move counter (the tier_thrash rule's
+        recorded input), and the resident/extent gauges are first-class
+        families from construction — with `_extents` a conscious
+        vocabulary addition."""
+        _register_all_instrumented_families()
+        fams = _registered_families()
+        assert fams.get("radixmesh_kv_tier_spilled_tokens_total") == "counter"
+        assert (
+            fams.get("radixmesh_kv_tier_restored_tokens_total") == "counter"
+        )
+        assert fams.get("radixmesh_kv_tier_bytes_total") == "counter"
+        assert (
+            fams.get("radixmesh_kv_tier_corrupt_extents_total") == "counter"
+        )
+        assert fams.get("radixmesh_kv_tier_moves_total") == "counter"
+        assert fams.get("radixmesh_kv_tier_resident_bytes") == "gauge"
+        assert fams.get("radixmesh_kv_tier_extents") == "gauge"
+        assert fams.get("radixmesh_kv_tier_io_seconds") == "histogram"
+        assert "_extents" in GAUGE_SUFFIXES
