@@ -1381,10 +1381,13 @@ def build_analysis_report(
 
 # v2 (PR 14): the healthy-phase rules_checked gate grew the
 # rebalancer_asleep rule. v3 (PR 15): it grew tier_thrash (the durable
-# KV tier's flapping detector). Artifacts validate against the rule set
-# pinned for THEIR version (see _required_doctor_rules) — a checked-in
-# artifact can never retroactively have run a rule that postdates it.
-DOCTOR_SCHEMA_VERSION = 3
+# KV tier's flapping detector). v4 (PR 17): it grew the three fleet
+# rules (straggler_node, fleet_burn_slope, telemetry_gap) judged over
+# the FleetAggregator's cross-node store. Artifacts validate against
+# the rule set pinned for THEIR version (see _required_doctor_rules) —
+# a checked-in artifact can never retroactively have run a rule that
+# postdates it.
+DOCTOR_SCHEMA_VERSION = 4
 
 DOCTOR_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -1425,6 +1428,7 @@ DOCTOR_RULES_V1 = (
     "replication_lag", "slo_burn_rate", "spec_efficiency",
 )
 DOCTOR_RULES_V2 = DOCTOR_RULES_V1 + ("rebalancer_asleep",)
+DOCTOR_RULES_V3 = DOCTOR_RULES_V2 + ("tier_thrash",)
 
 
 def _required_doctor_rules(report, live_rules) -> list[str]:
@@ -1433,6 +1437,8 @@ def _required_doctor_rules(report, live_rules) -> list[str]:
         return [r for r in live_rules if r in DOCTOR_RULES_V1]
     if version == 2:
         return [r for r in live_rules if r in DOCTOR_RULES_V2]
+    if version == 3:
+        return [r for r in live_rules if r in DOCTOR_RULES_V3]
     return list(live_rules)
 
 
@@ -1603,10 +1609,11 @@ def build_doctor_report(res: dict) -> dict:
 # ----------------------------------------------------------------------
 
 # v2 (PR 14): the healthy-phase rules_checked gate grew the
-# rebalancer_asleep rule; v3 (PR 15): tier_thrash. Older artifacts
-# validate against their version's pinned rule set
-# (_required_doctor_rules).
-BLACKBOX_SCHEMA_VERSION = 3
+# rebalancer_asleep rule; v3 (PR 15): tier_thrash; v4 (PR 17): the
+# three fleet rules (the workload arms an in-proc FleetAggregator for
+# its healthy phase). Older artifacts validate against their version's
+# pinned rule set (_required_doctor_rules).
+BLACKBOX_SCHEMA_VERSION = 4
 
 BLACKBOX_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -2093,6 +2100,215 @@ def build_tier_report(res: dict, meshcheck: dict | None = None) -> dict:
 
 
 # ----------------------------------------------------------------------
+# AGG stable schema (PR 17, the control room): one artifact per round
+# recording fleet-wide telemetry aggregation over an inproc 4P+2D+2R
+# rf=3 cell — (a) the fleet-MERGED p99 TTFT (bucket counts summed
+# across nodes, obs/aggregator.py) matching ground truth computed from
+# raw request records within one histogram bucket, (b) a seeded
+# straggler (delayed decode node) named BY RANK by the fleet doctor,
+# (c) the fleet-p99-bucket exemplar resolving to a stitched trace
+# containing the slow node's span, (d) a killed node surfacing as
+# telemetry_gap rather than silence, (e) aggregation overhead under 1%
+# of run wall time, and (f) an N=200 simulated-transport fan-in row
+# completing one pull sweep within one cadence interval.
+# scripts/aggbench.py is the paired emitter.
+# ----------------------------------------------------------------------
+
+AGG_SCHEMA_VERSION = 1
+
+AGG_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload", "nodes",
+    "topology", "replication_factor", "percentiles", "straggler",
+    "exemplar", "gap", "overhead", "fan_in", "wall_s",
+)
+AGG_PERCENTILE_FIELDS = (
+    "performed", "tenant", "fleet_p99_s", "truth_p99_s", "bucket_lo_s",
+    "bucket_hi_s", "within_one_bucket", "count", "nodes",
+)
+AGG_STRAGGLER_FIELDS = (
+    "performed", "seeded_rank", "named_rank", "detected", "ratio",
+    "signal",
+)
+AGG_EXEMPLAR_FIELDS = (
+    "performed", "trace_id", "node", "le", "stitched",
+    "has_straggler_span",
+)
+AGG_GAP_FIELDS = (
+    "performed", "killed_peer", "detected", "verdict", "stalled_s",
+)
+AGG_OVERHEAD_FIELDS = (
+    "pull_seconds_total", "wall_s", "fraction", "budget_fraction",
+    "under_budget",
+)
+AGG_FANIN_FIELDS = (
+    "performed", "peers", "sweep_s", "cadence_s", "within_cadence",
+    "points",
+)
+# The four fleet verdicts the acceptance run must name (percentile
+# match, straggler by rank, exemplar→trace, killed node as gap).
+AGG_NAMED_TOTAL = 4
+
+
+def validate_agg(report) -> list[str]:
+    """Schema violations of an AGG artifact vs the pinned contract
+    (empty = valid). Gates: the fleet-merged p99 TTFT lands within one
+    histogram bucket of the raw-record ground truth; the seeded
+    straggler is named by rank; the merged-p99-bucket exemplar resolves
+    to a stitched trace carrying the slow node's span; the killed node
+    surfaces as ``telemetry_gap`` (never silence); aggregation overhead
+    stays under its budget; and the N=200 fan-in sweep completes inside
+    one pull cadence. Sections with performed=False are schema-valid
+    but gate-exempt (the CHAOS convention). Import-safe from artifact
+    tests and scripts/aggbench.py (no jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in AGG_TOP_FIELDS if f not in report]
+    named = 0
+    pct = report.get("percentiles")
+    if "percentiles" in report and not isinstance(pct, dict):
+        problems.append("percentiles section is not an object")
+    if isinstance(pct, dict) and pct.get("performed"):
+        problems += [
+            f"percentiles.{f}" for f in AGG_PERCENTILE_FIELDS if f not in pct
+        ]
+        if pct.get("within_one_bucket") is not True:
+            problems.append(
+                f"percentiles: fleet-merged p99 {pct.get('fleet_p99_s')}s "
+                f"is NOT within one bucket of the raw-record truth "
+                f"{pct.get('truth_p99_s')}s — the merge is the whole "
+                "point; average-of-percentiles would fail exactly here"
+            )
+        else:
+            named += 1
+        if not pct.get("count", 0):
+            problems.append(
+                "percentiles: zero merged observations — the fleet "
+                "store never saw a request"
+            )
+        if len(pct.get("nodes") or []) < 2:
+            problems.append(
+                "percentiles: fewer than 2 reporting nodes — nothing "
+                "was merged ACROSS nodes"
+            )
+    strag = report.get("straggler")
+    if "straggler" in report and not isinstance(strag, dict):
+        problems.append("straggler section is not an object")
+    if isinstance(strag, dict) and strag.get("performed"):
+        problems += [
+            f"straggler.{f}" for f in AGG_STRAGGLER_FIELDS if f not in strag
+        ]
+        if strag.get("detected") is not True or str(
+            strag.get("named_rank")
+        ) != str(strag.get("seeded_rank")):
+            problems.append(
+                f"straggler: seeded rank {strag.get('seeded_rank')} was "
+                f"not named (doctor named {strag.get('named_rank')}, "
+                f"detected={strag.get('detected')})"
+            )
+        else:
+            named += 1
+    ex = report.get("exemplar")
+    if "exemplar" in report and not isinstance(ex, dict):
+        problems.append("exemplar section is not an object")
+    if isinstance(ex, dict) and ex.get("performed"):
+        problems += [
+            f"exemplar.{f}" for f in AGG_EXEMPLAR_FIELDS if f not in ex
+        ]
+        if (
+            ex.get("stitched") is not True
+            or ex.get("has_straggler_span") is not True
+        ):
+            problems.append(
+                "exemplar: the fleet-p99-bucket exemplar did not "
+                "resolve to a stitched trace containing the slow "
+                f"node's span (stitched={ex.get('stitched')}, "
+                f"straggler span={ex.get('has_straggler_span')})"
+            )
+        else:
+            named += 1
+    gap = report.get("gap")
+    if "gap" in report and not isinstance(gap, dict):
+        problems.append("gap section is not an object")
+    if isinstance(gap, dict) and gap.get("performed"):
+        problems += [f"gap.{f}" for f in AGG_GAP_FIELDS if f not in gap]
+        if gap.get("detected") is not True or gap.get("verdict") not in (
+            "node_dead", "sampler_dead",
+        ):
+            problems.append(
+                f"gap: killed peer {gap.get('killed_peer')} did not "
+                f"surface as telemetry_gap (detected="
+                f"{gap.get('detected')}, verdict={gap.get('verdict')}) "
+                "— a dead ring must never read as silence"
+            )
+        else:
+            named += 1
+    ov = report.get("overhead")
+    if "overhead" in report and not isinstance(ov, dict):
+        problems.append("overhead section is not an object")
+    if isinstance(ov, dict):
+        problems += [
+            f"overhead.{f}" for f in AGG_OVERHEAD_FIELDS if f not in ov
+        ]
+        if ov.get("under_budget") is not True:
+            problems.append(
+                f"overhead: aggregation cost {ov.get('fraction')} of "
+                f"wall exceeded the {ov.get('budget_fraction')} budget"
+            )
+    fi = report.get("fan_in")
+    if "fan_in" in report and not isinstance(fi, dict):
+        problems.append("fan_in section is not an object")
+    if isinstance(fi, dict) and fi.get("performed"):
+        problems += [f"fan_in.{f}" for f in AGG_FANIN_FIELDS if f not in fi]
+        if int(fi.get("peers", 0) or 0) < 200:
+            problems.append(
+                f"fan_in: only {fi.get('peers')} simulated peers — the "
+                "row exists to prove the N=200 ringscale regime"
+            )
+        if fi.get("within_cadence") is not True:
+            problems.append(
+                f"fan_in: one sweep took {fi.get('sweep_s')}s, past the "
+                f"{fi.get('cadence_s')}s pull cadence — the aggregator "
+                "would fall behind its own schedule"
+            )
+    performed_any = any(
+        isinstance(report.get(s), dict) and report.get(s, {}).get("performed")
+        for s in ("percentiles", "straggler", "exemplar", "gap")
+    )
+    if performed_any and report.get("value") != named:
+        problems.append(
+            f"value: {report.get('value')} does not equal the {named} "
+            "fleet verdict(s) actually named"
+        )
+    return problems
+
+
+def build_agg_report(res: dict) -> dict:
+    """Assemble a schema-complete AGG artifact from
+    ``workload.run_agg_workload``'s result."""
+    return {
+        "schema_version": AGG_SCHEMA_VERSION,
+        "metric": "agg_fleet_verdicts_named",
+        "value": res.get("named", 0),
+        "unit": (
+            f"of {AGG_NAMED_TOTAL} fleet verdicts (merged-p99-vs-truth "
+            "within one bucket, straggler named by rank, p99 exemplar "
+            "resolved to a stitched trace with the slow node's span, "
+            "killed node surfaced as telemetry_gap) named over the "
+            "aggregator's cross-node store, with aggregation overhead "
+            "under budget and N=200 fan-in inside one cadence"
+        ),
+        "workload": (
+            "inproc 4P+2D+2R rf=3 cell with per-node telemetry "
+            "histories cursor-pulled by a router-hosted "
+            "FleetAggregator; one decode node seeded slow, one node "
+            "killed mid-run, plus an N=200 simulated-transport fan-in "
+            "row (see workload.run_agg_workload)"
+        ),
+        **res,
+    }
+
+
+# ----------------------------------------------------------------------
 # compare_rounds (PR 12, the bench regression sentinel): schema-aware
 # diffing of any two SAME-schema artifacts. Eleven artifact schemas
 # accumulated over eleven rounds with nothing machine-checking the
@@ -2184,6 +2400,12 @@ COMPARE_RULES: dict = {
         ("restore_overlap.decode_steps_during_restore", "higher", 0.50),
         ("meshcheck.findings", "lower", 0.0),
     ),
+    "AGG": (
+        ("value", "higher", 0.0),  # named fleet verdicts: any drop flags
+        ("overhead.fraction", "lower", 2.0),
+        ("fan_in.sweep_s", "lower", 1.0),
+        ("percentiles.count", "higher", 0.75),
+    ),
     # Kinds with no pinned directional metrics still get the schema
     # check + informational numeric diff.
     "SLO": (),
@@ -2208,6 +2430,7 @@ _METRIC_KINDS = {
     "blackbox_postmortem_named": "BLACKBOX",
     "rebalance_skew_drop_ratio": "REBALANCE",
     "tier_hit_rate_gain": "TIER",
+    "agg_fleet_verdicts_named": "AGG",
     "slo_goodput_vs_offered_load": "SLO",
     "soak_requests": "SOAK",
 }
@@ -2397,8 +2620,8 @@ def benchdiff_selfcheck() -> dict:
     deterministic (no checked-in files needed): an identical artifact
     pair must compare clean, a synthetically regressed copy must flag,
     and a cross-kind pair must refuse as a schema mismatch — proven for
-    BOTH the CHAOS schema and the BLACKBOX schema (PR 13), so every
-    pinned rule table a sentinel relies on has a demonstrated trigger.
+    the CHAOS, BLACKBOX, TIER, and AGG schemas, so every pinned rule
+    table a sentinel relies on has a demonstrated trigger.
     The DOCTOR artifact carries the result (``validate_doctor`` gates
     the three headline fields) — a sentinel nobody proved can still
     fire is not a sentinel."""
@@ -2443,6 +2666,19 @@ def benchdiff_selfcheck() -> dict:
         # One corrupt extent served: the zero-threshold rule must flag.
         "cold_start": {"failed": 0, "corrupt_served": 1},
     }
+    agg_base = {
+        "metric": "agg_fleet_verdicts_named",
+        "schema_version": AGG_SCHEMA_VERSION,
+        "value": AGG_NAMED_TOTAL,
+        "overhead": {"fraction": 0.002},
+        "fan_in": {"sweep_s": 0.05},
+        "percentiles": {"count": 400},
+    }
+    agg_regressed = {
+        **agg_base,
+        # One lost fleet verdict: the zero-threshold value rule must flag.
+        "value": AGG_NAMED_TOTAL - 1,
+    }
     identical = compare_rounds(base, dict(base), kind="CHAOS")
     regression = compare_rounds(base, regressed, kind="CHAOS")
     mismatch = compare_rounds(base, other_kind)
@@ -2452,23 +2688,31 @@ def benchdiff_selfcheck() -> dict:
     t_identical = compare_rounds(tier_base, dict(tier_base), kind="TIER")
     t_regression = compare_rounds(tier_base, tier_regressed, kind="TIER")
     t_mismatch = compare_rounds(tier_base, base)
+    a_identical = compare_rounds(agg_base, dict(agg_base), kind="AGG")
+    a_regression = compare_rounds(agg_base, agg_regressed, kind="AGG")
+    a_mismatch = compare_rounds(agg_base, base)
     return {
         "identical_clean": identical["status"] == "clean"
         and bb_identical["status"] == "clean"
-        and t_identical["status"] == "clean",
+        and t_identical["status"] == "clean"
+        and a_identical["status"] == "clean",
         "regression_flagged": regression["status"] == "regression"
         and "repair.converge_s" in regression["regressions"]
         and bb_regression["status"] == "regression"
         and "value" in bb_regression["regressions"]
         and t_regression["status"] == "regression"
-        and "cold_start.corrupt_served" in t_regression["regressions"],
+        and "cold_start.corrupt_served" in t_regression["regressions"]
+        and a_regression["status"] == "regression"
+        and "value" in a_regression["regressions"],
         "mismatch_detected": mismatch["status"] == "schema_mismatch"
         and bb_mismatch["status"] == "schema_mismatch"
-        and t_mismatch["status"] == "schema_mismatch",
-        "kinds_covered": ["CHAOS", "BLACKBOX", "TIER"],
+        and t_mismatch["status"] == "schema_mismatch"
+        and a_mismatch["status"] == "schema_mismatch",
+        "kinds_covered": ["CHAOS", "BLACKBOX", "TIER", "AGG"],
         "regressions_seen": regression["regressions"]
         + bb_regression["regressions"]
-        + t_regression["regressions"],
+        + t_regression["regressions"]
+        + a_regression["regressions"],
     }
 
 

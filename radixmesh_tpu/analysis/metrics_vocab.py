@@ -60,6 +60,7 @@ GAUGE_SUFFIXES = UNIT_SUFFIXES + (
     "_points",  # telemetry-history retained points (obs/timeseries.py)
     "_rf_boost",  # extra owners beyond the base walk (cache/rebalance.py)
     "_extents",  # committed durable-tier extent files (cache/kv_tier.py)
+    "_peers",  # fleet-aggregator polled peer count (obs/aggregator.py)
 )
 
 _KINDS = ("counter", "gauge", "histogram")

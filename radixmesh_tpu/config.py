@@ -159,6 +159,14 @@ class MeshConfig:
     # signal track traffic shifts faster — drills and rebalance benches
     # use seconds; production keeps the default.
     heat_half_life_s: float = 0.0
+    # Fleet telemetry aggregation (obs/aggregator.py): router nodes
+    # cursor-pull every ring node's /debug/timeseries at this cadence
+    # into one node-labeled fleet store — GET /cluster/timeseries, true
+    # cross-node percentiles on GET /cluster/slo, and the fleet doctor
+    # rules (straggler_node / fleet_burn_slope / telemetry_gap) ride on
+    # it. 0 disables the collector; serving nodes ignore the key.
+    # launch.py --agg-interval overrides.
+    agg_interval_s: float = 0.0
 
     @property
     def effective_startup_grace_s(self) -> float:
@@ -325,6 +333,8 @@ class MeshConfig:
             raise ValueError("repair_backoff_s must be > 0")
         if self.rebalance_interval_s < 0 or self.heat_half_life_s < 0:
             raise ValueError("rebalance/heat timers must be >= 0")
+        if self.agg_interval_s < 0:
+            raise ValueError("agg_interval_s must be >= 0")
         if self.rebalance_interval_s > 0 and self.replication_factor == 0:
             # The rebalancer moves OWNERSHIP; a full replica has none.
             raise ValueError(
@@ -412,6 +422,7 @@ def load_config(
         "stream_publish_tokens",
         "rebalance_interval_s",
         "heat_half_life_s",
+        "agg_interval_s",
         "model",
         "mesh_axes",
         "serve_port_offset",
@@ -463,6 +474,7 @@ def load_config(
         stream_publish_tokens=int(raw.get("stream_publish_tokens", 0)),
         rebalance_interval_s=float(raw.get("rebalance_interval_s", 0.0)),
         heat_half_life_s=float(raw.get("heat_half_life_s", 0.0)),
+        agg_interval_s=float(raw.get("agg_interval_s", 0.0)),
         model=dict(raw.get("model", {})),
         mesh_axes=dict(raw.get("mesh_axes", {})),
         serve_port_offset=int(raw.get("serve_port_offset", 1000)),

@@ -485,6 +485,24 @@ class Engine:
             "active request)",
             ("engine",),
         ).labels(**lbl)
+        # Per-TENANT request latency (unlike the per-engine families
+        # above): the series a fleet collector (obs/aggregator.py)
+        # merges bucket-by-bucket across nodes so /cluster/slo reports
+        # the TRUE fleet p50/p99 per tenant — never an average of
+        # per-node percentiles. Observed with the request's trace id as
+        # exemplar, so a fleet p99 outlier links to its stitched trace.
+        self._m_req_ttft = reg.histogram(
+            "radixmesh_request_ttft_seconds",
+            "submit-to-first-token latency per tenant (fleet-mergeable "
+            "buckets; exemplars carry trace ids)",
+            ("tenant",),
+        )
+        self._m_req_e2e = reg.histogram(
+            "radixmesh_request_e2e_seconds",
+            "submit-to-finish latency per tenant (fleet-mergeable "
+            "buckets; exemplars carry trace ids)",
+            ("tenant",),
+        )
         self._m_hit_len = reg.histogram(
             "radixmesh_engine_prefix_hit_tokens",
             "prefix-cache hit length per admitted request (tokens)",
@@ -1376,6 +1394,10 @@ class Engine:
     def _record_first_token(self, req: Request) -> None:
         self.stats.ttft_s.append(req.first_token_time - req.submit_time)
         self._m_ttft.observe(req.first_token_time - req.submit_time)
+        self._m_req_ttft.labels(tenant=req.tenant).observe(
+            req.first_token_time - req.submit_time,
+            trace_id=getattr(req.trace, "trace_id", None),
+        )
         tr = req.trace
         if tr is not None:
             tr.add(
@@ -2346,6 +2368,11 @@ class Engine:
                 self.stats.generated_tokens -= 1
             else:
                 self._m_generated.inc()
+            if req.submit_time:
+                self._m_req_e2e.labels(tenant=req.tenant).observe(
+                    time.monotonic() - req.submit_time,
+                    trace_id=getattr(req.trace, "trace_id", None),
+                )
             req.state = RequestState.FINISHED
             self.stats.finished += 1
             self._release(req)

@@ -214,11 +214,45 @@ def _run_node(args: argparse.Namespace) -> int:
         if not args.warm_up:
             router.finish_warm_up()
         host = parse_addr(cfg.local_addr)[0] or "127.0.0.1"
+        # Fleet aggregation (obs/aggregator.py): with a pull cadence
+        # configured, this router cursor-pulls every ring node's
+        # /debug/timeseries into one fleet store. The peer list is
+        # DERIVED from the topology (each node's serving HTTP address),
+        # named to match the engines' node labels ("prefill0",
+        # "decode2", ...) so fleet series line up with per-node ones.
+        agg_interval = (
+            args.agg_interval
+            if args.agg_interval is not None
+            else cfg.agg_interval_s
+        )
+        agg_peers = []
+        if agg_interval > 0:
+            for r in range(cfg.num_ring):
+                serve = cfg.serve_addr(cfg.addr_of_rank(r))
+                if serve is None:  # portless inproc address: no HTTP tier
+                    continue
+                agg_peers.append(
+                    (f"{cfg.role_of_rank(r).value}{r}", f"http://{serve}", r)
+                )
+            if not agg_peers:
+                log.warning(
+                    "--agg-interval %.1fs set but no ring node has an "
+                    "HTTP serving address — fleet aggregator stays off",
+                    agg_interval,
+                )
         frontend = RouterFrontend(
             router, host=host, port=args.http_port, tokenizer=tokenizer,
+            aggregator_peers=agg_peers,
+            aggregator_interval_s=agg_interval or 2.0,
             **_history_kwargs(args),
         )
         log.info("routing API on port %d", frontend.port)
+        if frontend.aggregator is not None:
+            log.info(
+                "fleet aggregator ON: pulling %d peer(s) every %.1fs "
+                "(GET /cluster/timeseries, /cluster/slo)",
+                len(agg_peers), agg_interval,
+            )
     elif serving:
         from radixmesh_tpu.engine.engine import Engine
         from radixmesh_tpu.models import init_params
@@ -815,6 +849,16 @@ def main(argv: list[str] | None = None) -> int:
         "finish while new work sheds retriably (503 + Retry-After at "
         "the router), parked restores are requeued, hot prefixes are "
         "written back to the host tier, and the node announces LEAVE",
+    )
+    node.add_argument(
+        "--agg-interval", type=float, default=None, metavar="SECONDS",
+        help="router role: host the fleet telemetry aggregator "
+        "(obs/aggregator.py) — cursor-pull every ring node's "
+        "/debug/timeseries at this cadence into one node-labeled fleet "
+        "store, served on GET /cluster/timeseries with true cross-node "
+        "percentiles on GET /cluster/slo (and the fleet doctor rules: "
+        "straggler_node, fleet_burn_slope, telemetry_gap). Overrides "
+        "the config's agg_interval_s; 0 disables",
     )
     node.add_argument(
         "--kv-prefetch-hints", action="store_true",

@@ -72,6 +72,11 @@ RULES = (
     "spec_efficiency",
     "rebalancer_asleep",
     "tier_thrash",
+    # Fleet rules (PR 17): judged over the FleetAggregator's cross-node
+    # store — no single node's seams can run them.
+    "straggler_node",
+    "fleet_burn_slope",
+    "telemetry_gap",
 )
 
 # The pinned evidence vocabulary per rule: every finding MUST carry at
@@ -99,6 +104,16 @@ RULE_EVIDENCE_FIELDS = {
     ),
     "tier_thrash": (
         "shard", "demotes", "promotes", "cycles", "window_s", "source",
+    ),
+    "straggler_node": (
+        "rank", "signal", "value_s", "fleet_median_s", "ratio", "ranks",
+    ),
+    "fleet_burn_slope": (
+        "tenant", "burn_fast", "burn_slow", "slope_per_s", "budget",
+        "offered",
+    ),
+    "telemetry_gap": (
+        "peer", "rank", "stalled_s", "peer_seq", "verdict",
     ),
 }
 
@@ -159,6 +174,24 @@ class DoctorConfig:
     # min(demotes, promotes) within the window.
     tier_thrash_window_s: float = 60.0
     tier_thrash_min_cycles: int = 3
+    # straggler_node: one rank's decode-step (or replication-lag) EWMA
+    # at least ratio× the fleet median across >= min_ranks ACTIVE ranks
+    # (zeros are ranks not running that plane, not fast ranks), above
+    # an absolute floor so uniform microsecond noise never fires.
+    straggler_ratio: float = 3.0
+    straggler_min_ranks: int = 2
+    straggler_floor_s: float = 0.005
+    # fleet_burn_slope: aggregated (fleet-summed) multi-window burn.
+    # Deliberately LOWER thresholds than the per-node page rule: this
+    # is the pre-scale signal (ROADMAP item 2) — it should fire, with
+    # its slope, before anyone's pager does.
+    fleet_burn_fast_threshold: float = 6.0
+    fleet_burn_slow_threshold: float = 3.0
+    fleet_burn_min_requests: int = 20
+    # telemetry_gap: floor on how long a peer's ring may sit still
+    # before it counts as stalled (the aggregator's per-peer
+    # cadence-scaled threshold also applies — whichever is larger).
+    telemetry_gap_s: float = 5.0
 
 
 @dataclass
@@ -406,6 +439,7 @@ class MeshDoctor:
         slo=None,
         attributor=None,
         history=None,
+        aggregator=None,
         cfg: DoctorConfig | None = None,
         now=time.monotonic,
     ):
@@ -414,6 +448,11 @@ class MeshDoctor:
         self.slo = slo
         self._attributor = attributor
         self.history = history
+        # A FleetAggregator (obs/aggregator.py): the cross-node seam
+        # behind the three fleet rules — straggler_node over per-rank
+        # signal folds, fleet_burn_slope over fleet-summed burn
+        # windows, telemetry_gap over per-peer pull bookkeeping.
+        self.aggregator = aggregator
         self.cfg = cfg or DoctorConfig()
         self._now = now
         self.burn_tracker = BurnRateTracker(self.cfg.burn_budget, now=now)
@@ -826,6 +865,159 @@ class MeshDoctor:
             },
         )
 
+    def _rule_straggler_node(self) -> Finding | None:
+        agg = self.aggregator
+        if agg is None:
+            return None
+        cfg = self.cfg
+        worst = None
+        for signal, family in (
+            ("decode_ewma", "fleet:decode_ewma_seconds"),
+            ("replication_lag", "fleet:replication_lag_seconds"),
+        ):
+            vals = {
+                r: v for r, v in agg.rank_signal(family).items() if v > 0
+            }
+            if len(vals) < cfg.straggler_min_ranks:
+                continue
+            svals = sorted(vals.values())
+            # Lower median: with two active ranks the baseline is the
+            # FASTER one, so a 2-decode cell can still name its
+            # straggler instead of comparing the slow rank to itself.
+            median = svals[(len(svals) - 1) // 2]
+            rank, v = max(vals.items(), key=lambda kv: kv[1])
+            if v < cfg.straggler_floor_s:
+                continue
+            ratio = v / max(median, 1e-9)
+            if ratio < cfg.straggler_ratio:
+                continue
+            cand = (ratio, signal, rank, v, median, len(vals))
+            if worst is None or cand > worst:
+                worst = cand
+        if worst is None:
+            return None
+        ratio, signal, rank, v, median, n_ranks = worst
+        return Finding(
+            "straggler_node",
+            min(1.0, 0.5 + ratio / (10.0 * cfg.straggler_ratio)),
+            f"rank {rank} is a straggler: {signal} EWMA {v * 1e3:.1f} ms "
+            f"vs fleet median {median * 1e3:.1f} ms ({ratio:.1f}x over "
+            f"{n_ranks} active ranks) — drain or replace it before the "
+            "mesh convoys behind it",
+            {
+                "rank": str(rank),
+                "signal": signal,
+                "value_s": round(v, 6),
+                "fleet_median_s": round(median, 6),
+                "ratio": round(ratio, 3),
+                "ranks": n_ranks,
+            },
+        )
+
+    def _rule_fleet_burn_slope(self) -> Finding | None:
+        agg = self.aggregator
+        if agg is None:
+            return None
+        cfg = self.cfg
+        report = agg.fleet_burn_report(
+            fast_window_s=cfg.burn_fast_window_s,
+            slow_window_s=cfg.burn_slow_window_s,
+        )
+        worst: Finding | None = None
+        for tenant, r in report.items():
+            if r["offered"] < cfg.fleet_burn_min_requests:
+                continue
+            if (
+                r["burn_fast"] < cfg.fleet_burn_fast_threshold
+                or r["burn_slow"] < cfg.fleet_burn_slow_threshold
+            ):
+                continue
+            slope = r["slope_per_s"]
+            trend = (
+                "and RISING" if slope > 0
+                else ("and falling" if slope < 0 else "flat")
+            )
+            f = Finding(
+                "fleet_burn_slope",
+                min(
+                    1.0,
+                    0.5
+                    + r["burn_fast"] / (10.0 * cfg.fleet_burn_fast_threshold)
+                    + max(0.0, slope),
+                ),
+                f"tenant {tenant!r} burning error budget FLEET-WIDE at "
+                f"{r['burn_fast']:.1f}x (fast) / {r['burn_slow']:.1f}x "
+                f"(slow), slope {slope:+.4f}/s {trend} — the pre-scale "
+                "signal: add capacity before the per-node pager trips",
+                {
+                    "tenant": tenant,
+                    "burn_fast": r["burn_fast"],
+                    "burn_slow": r["burn_slow"],
+                    "slope_per_s": slope,
+                    "budget": r["budget"],
+                    "offered": r["offered"],
+                },
+            )
+            if worst is None or f.score > worst.score:
+                worst = f
+        return worst
+
+    def _rule_telemetry_gap(self) -> Finding | None:
+        agg = self.aggregator
+        if agg is None:
+            return None
+        cfg = self.cfg
+        worst = None
+        for name, st in agg.peer_status().items():
+            stalled = st.get("stalled_s")
+            if stalled is None:
+                # Never pulled successfully: the aggregator cannot tell
+                # a dead peer from one it has not reached yet.
+                continue
+            thresh = max(cfg.telemetry_gap_s, st.get("gap_threshold_s", 0.0))
+            if stalled < thresh:
+                continue
+            # Disambiguate dead sampler vs dead node via the gossip
+            # plane: a rank the FleetView still scores healthy has a
+            # live process whose SAMPLER stopped; a rank gossip also
+            # lost is simply dead.
+            verdict = "unknown"
+            rank = st.get("rank")
+            if rank is not None and self.mesh is not None:
+                try:
+                    h = self.mesh.fleet.health().get(rank)
+                    if h is not None and h["score"] >= 0.5:
+                        verdict = "sampler_dead"
+                    else:
+                        verdict = "node_dead"
+                except Exception:  # noqa: BLE001 — gossip seam optional for the verdict
+                    pass
+            cand = (stalled, name, rank, st, verdict)
+            if worst is None or cand[0] > worst[0]:
+                worst = cand
+        if worst is None:
+            return None
+        stalled, name, rank, st, verdict = worst
+        what = {
+            "sampler_dead": "its process still gossips healthy — the "
+            "SAMPLER died, not the node",
+            "node_dead": "gossip lost it too — the node is dead",
+            "unknown": "no gossip view to disambiguate",
+        }[verdict]
+        return Finding(
+            "telemetry_gap",
+            min(1.0, 0.5 + 0.05 * stalled),
+            f"peer {name!r} ring stopped advancing {stalled:.1f}s ago "
+            f"(last seq {st['seq']}); {what}",
+            {
+                "peer": name,
+                "rank": None if rank is None else str(rank),
+                "stalled_s": round(stalled, 3),
+                "peer_seq": st["seq"],
+                "verdict": verdict,
+            },
+        )
+
     # -- the diagnosis -------------------------------------------------
 
     def diagnose(self) -> dict:
@@ -840,6 +1032,9 @@ class MeshDoctor:
             "spec_efficiency": self._rule_spec_efficiency,
             "rebalancer_asleep": self._rule_rebalancer_asleep,
             "tier_thrash": self._rule_tier_thrash,
+            "straggler_node": self._rule_straggler_node,
+            "fleet_burn_slope": self._rule_fleet_burn_slope,
+            "telemetry_gap": self._rule_telemetry_gap,
         }
         # Seam presence per rule: a rule whose inputs are absent never
         # looked at anything, so it must NOT appear in rules_checked —
@@ -861,6 +1056,11 @@ class MeshDoctor:
             # registry), so either seam arms the rule.
             "tier_thrash": self.engine is not None
             or self.history is not None,
+            # The fleet rules judge the aggregator's cross-node store;
+            # no single-node seam can substitute for it.
+            "straggler_node": self.aggregator is not None,
+            "fleet_burn_slope": self.aggregator is not None,
+            "telemetry_gap": self.aggregator is not None,
         }
         findings: list[Finding] = []
         checked: list[str] = []
@@ -896,6 +1096,7 @@ class MeshDoctor:
                 "slo": self.slo is not None,
                 "attribution": self.attributor is not None,
                 "history": self.history is not None,
+                "aggregator": self.aggregator is not None,
             },
         }
 

@@ -250,11 +250,15 @@ class Histogram(_Family):
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
         self._sum = 0.0
+        # Trace exemplars: bucket index -> (trace_id, value, wall time),
+        # the LAST traced observation to land in that bucket. Lazily
+        # allocated — an untraced histogram never pays the dict.
+        self._exemplars: dict[int, tuple[int, float, float]] | None = None
 
     def _new_child(self) -> "Histogram":
         return Histogram(self.name, self.help, buckets=self.buckets)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: int | None = None) -> None:
         # bisect_left finds the first bound >= value — the bucket whose
         # "<= upper bound" predicate the value satisfies; past the last
         # bound it lands on the +Inf slot. O(log buckets) instead of the
@@ -265,10 +269,56 @@ class Histogram(_Family):
         with self._lock:
             self._sum += value
             self._counts[i if i < len(self.buckets) else -1] += 1
+            # One `is not None` test when tracing is off (the step-
+            # accounting hot-path contract); a traced observation pins
+            # itself as the bucket's exemplar so a percentile that
+            # resolves into this bucket links to a concrete request
+            # flight (obs/trace_plane.py stitching).
+            if trace_id is not None:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[min(i, len(self.buckets))] = (
+                    int(trace_id), float(value), time.time()
+                )
 
     def time(self) -> _HistTimer:
         """``with hist.time(): ...`` observes the block's wall time."""
         return _HistTimer(self)
+
+    def _le_str(self, i: int) -> str:
+        return (
+            _fmt_value(self.buckets[i]) if i < len(self.buckets) else "+Inf"
+        )
+
+    def exemplars(self) -> dict[str, dict]:
+        """Per-bucket trace exemplars of THIS series: ``le`` string →
+        ``{trace_id, value, wall_time}`` with the trace id rendered the
+        way span exports carry it (``trace_plane.export_spans``), so a
+        reader can join straight into a stitched trace. {} when no
+        traced observation ever landed."""
+        with self._lock:
+            ex = dict(self._exemplars) if self._exemplars else {}
+        return {
+            self._le_str(i): {
+                "trace_id": f"{tid:#018x}",
+                "value": v,
+                "wall_time": round(t, 6),
+            }
+            for i, (tid, v, t) in sorted(ex.items())
+        }
+
+    def bucket_counts(self) -> list[int]:
+        """Cumulative per-bucket counts (Prometheus ``le`` semantics:
+        entry i counts observations <= buckets[i]; the final entry is
+        +Inf == count), read under one lock so the vector is a
+        consistent snapshot — the cross-node merge sums these."""
+        with self._lock:
+            out = []
+            cum = 0
+            for c in self._counts:
+                cum += c
+                out.append(cum)
+            return out
 
     @property
     def count(self) -> int:
@@ -313,6 +363,7 @@ class Histogram(_Family):
         lines: list[str] = []
         for s in self._series():
             with s._lock:
+                ex = dict(s._exemplars) if s._exemplars else {}
                 cum = 0
                 for i, ub in enumerate(s.buckets):
                     cum += s._counts[i]
@@ -321,10 +372,27 @@ class Histogram(_Family):
                     lines.append(
                         f"{self.name}_bucket{_fmt_labels(_label_key(lbl))} {cum}"
                     )
+                    if i in ex:
+                        tid, v, t = ex[i]
+                        lines.append(
+                            f"# EXEMPLAR {self.name}_bucket"
+                            f"{_fmt_labels(_label_key(lbl))} "
+                            f"trace_id={tid:#018x} value={_fmt_value(v)} "
+                            f"wall_time={t:.6f}"
+                        )
                 cum += s._counts[-1]
                 lbl = dict(s._labels)
                 lbl["le"] = "+Inf"
                 lines.append(f"{self.name}_bucket{_fmt_labels(_label_key(lbl))} {cum}")
+                inf_key = len(s.buckets)
+                if inf_key in ex:
+                    tid, v, t = ex[inf_key]
+                    lines.append(
+                        f"# EXEMPLAR {self.name}_bucket"
+                        f"{_fmt_labels(_label_key(lbl))} "
+                        f"trace_id={tid:#018x} value={_fmt_value(v)} "
+                        f"wall_time={t:.6f}"
+                    )
                 lines.append(f"{self.name}_sum{_fmt_labels(s._labels)} {_fmt_value(s._sum)}")
                 lines.append(f"{self.name}_count{_fmt_labels(s._labels)} {cum}")
         return lines
@@ -390,8 +458,20 @@ class Registry:
             out.extend(f._render_lines())
         return "\n".join(out) + "\n"
 
-    def snapshot(self) -> dict[str, float]:
-        """Flat programmatic view: scalar series by rendered name."""
+    def snapshot(
+        self, bucket_families: Sequence[str] = ()
+    ) -> dict[str, float]:
+        """Flat programmatic view: scalar series by rendered name.
+
+        Histograms flatten to ``_count``/``_sum`` scalars; families
+        named in ``bucket_families`` ADDITIONALLY emit their cumulative
+        per-bucket counts as ``name_bucket{...,le="x"}`` series — the
+        transport for cross-node percentile merging (a fleet collector
+        sums bucket counts across nodes; averaging per-node quantiles
+        is statistically wrong). Opt-in per family on purpose: buckets
+        multiply series count ~16x, and only families a fleet view
+        merges (the per-tenant request-latency histograms) earn that."""
+        bucket_families = set(bucket_families)
         snap: dict[str, float] = {}
         with self._lock:
             families = list(self._families.values())
@@ -401,9 +481,35 @@ class Registry:
                 if isinstance(s, Histogram):
                     snap[key + "_count"] = s.count
                     snap[key + "_sum"] = s.sum
+                    if f.name in bucket_families:
+                        for i, cum in enumerate(s.bucket_counts()):
+                            lbl = dict(s._labels)
+                            lbl["le"] = s._le_str(i)
+                            snap[
+                                f"{f.name}_bucket"
+                                f"{_fmt_labels(_label_key(lbl))}"
+                            ] = float(cum)
                 else:
                     snap[key] = s.value
         return snap
+
+    def exemplars(self) -> dict[str, dict[str, dict]]:
+        """Every histogram series' trace exemplars, keyed the way
+        :meth:`snapshot` keys series (``family{labels}``): the
+        ``/debug/state`` exemplar section and the in-proc source a
+        fleet collector joins against merged bucket counts. Series
+        with no traced observations are omitted."""
+        out: dict[str, dict[str, dict]] = {}
+        with self._lock:
+            families = list(self._families.values())
+        for f in families:
+            if not isinstance(f, Histogram):
+                continue
+            for s in f._series():
+                ex = s.exemplars()
+                if ex:
+                    out[f"{f.name}{_fmt_labels(s._labels)}"] = ex
+        return out
 
 
 _default = Registry()

@@ -58,7 +58,7 @@ from radixmesh_tpu.obs.metrics import (
 )
 from radixmesh_tpu.utils.logging import get_logger, throttled
 
-__all__ = ["TelemetryHistory", "DERIVED_PREFIXES"]
+__all__ = ["TelemetryHistory", "DERIVED_PREFIXES", "BUCKET_FAMILIES"]
 
 # Derived-source series namespaces (everything else in the rings is a
 # registry family). Kept distinct from the ``radixmesh_`` scrape
@@ -66,6 +66,18 @@ __all__ = ["TelemetryHistory", "DERIVED_PREFIXES"]
 # (fleet fold, heat map, step accounting, SLO counters), not registered
 # families — a collision would double-count a real series.
 DERIVED_PREFIXES = ("fleet:", "shard:", "step:", "slo:")
+
+# Histogram families sampled WITH their cumulative per-bucket counts
+# (``Registry.snapshot(bucket_families=...)``): the per-tenant request
+# latency distributions a fleet collector (obs/aggregator.py) merges
+# bucket-by-bucket across nodes for true fleet percentiles. Opt-in and
+# short on purpose — buckets multiply a family's series count ~16x, and
+# change-compression only keeps that cheap for families whose buckets
+# move at request cadence, not token cadence.
+BUCKET_FAMILIES = (
+    "radixmesh_request_ttft_seconds",
+    "radixmesh_request_e2e_seconds",
+)
 
 
 class _Series:
@@ -110,6 +122,7 @@ class TelemetryHistory:
         max_series: int = 4096,
         registry=None,
         now=time.monotonic,
+        bucket_families: tuple = BUCKET_FAMILIES,
     ):
         if capacity <= 0:
             raise ValueError("history capacity must be positive")
@@ -120,6 +133,7 @@ class TelemetryHistory:
         self.slo = slo
         self.node = node
         self.max_series = int(max_series)
+        self.bucket_families = tuple(bucket_families)
         self._registry = registry
         self._now = now
         # Monotonic→wall conversion for post-mortem readers (the
@@ -195,7 +209,7 @@ class TelemetryHistory:
         t = self._now() if t is None else float(t)
         snap: dict[str, float] = {}
         reg = self._registry if self._registry is not None else get_registry()
-        snap.update(reg.snapshot())
+        snap.update(reg.snapshot(bucket_families=self.bucket_families))
         self._derived_snapshot(snap)
         burn_counts = None
         if self.slo is not None:
@@ -286,6 +300,9 @@ class TelemetryHistory:
                     snap[
                         f'fleet:replication_lag_seconds{{rank="{rank}"}}'
                     ] = float(d.replication_lag_s)
+                    snap[
+                        f'fleet:decode_ewma_seconds{{rank="{rank}"}}'
+                    ] = float(getattr(d, "decode_ewma_s", 0.0))
             except Exception:  # noqa: BLE001 — seam isolation
                 pass
             try:
@@ -314,6 +331,69 @@ class TelemetryHistory:
                         )
             except Exception:  # noqa: BLE001 — seam isolation
                 pass
+
+    # -- fleet ingest ---------------------------------------------------
+
+    def ingest(self, node: str, body: dict) -> int:
+        """Fold one ``/debug/timeseries`` page from a peer into these
+        rings, node-labeled — the fleet aggregator's write path. The
+        fold is cursor-agnostic: the caller (obs/aggregator.py) owns
+        ``since``/``next_since`` bookkeeping; this method just stores
+        whatever page it is handed.
+
+        Semantics that keep the store a valid :class:`TelemetryHistory`:
+
+        - **One store sequence per call.** Peer sequence numbers from
+          different nodes are incomparable, so every point of the page
+          lands under a single local seq — deques stay seq-ordered and
+          :meth:`query` pagination cuts stay on whole-ingest boundaries.
+        - **Peer time is rebased to this store's clock** via the page's
+          ``wall_offset`` (``t + peer_wall_offset - self.wall_offset``),
+          so a peer restart (monotonic reset) cannot reorder its points.
+        - **Node labels are injected**, never trusted from the wire:
+          ``fam{k="v"}`` becomes ``fam{k="v",node="peer"}``, so two
+          peers' identical series never collide in one ring.
+        - A series with no points in the page but a live ``last`` value
+          is seeded once (change-compression: "no point" means "did not
+          change", and a merge still needs its current value).
+        """
+        peer_offset = float(body.get("wall_offset", self.wall_offset))
+        shift = peer_offset - self.wall_offset
+        series = body.get("series", {})
+        t_now = self._now()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            dropped = 0
+            for name, sdata in series.items():
+                if name.endswith("}"):
+                    labeled = name[:-1] + f',node="{node}"' + "}"
+                else:
+                    labeled = f'{name}{{node="{node}"}}'
+                s = self._series.get(labeled)
+                if s is None:
+                    if len(self._series) >= self.max_series:
+                        if labeled not in self._refused:
+                            self._refused.add(labeled)
+                            dropped += 1
+                        continue
+                    self._refused.discard(labeled)
+                    s = self._series[labeled] = _Series(self.capacity)
+                s.last_seen_seq = seq
+                pts = sdata.get("points") or ()
+                for p in pts:
+                    s.points.append((seq, float(p[1]) + shift, float(p[2])))
+                if pts:
+                    s.last_value = float(pts[-1][2])
+                elif s.last_value is None:
+                    last = sdata.get("last") or (None, None)
+                    if last[1] is not None:
+                        s.points.append((seq, t_now, float(last[1])))
+                        s.last_value = float(last[1])
+            self._dropped_series += dropped
+        if dropped:
+            self._m_dropped.inc(dropped)
+        return seq
 
     # -- reads ---------------------------------------------------------
 

@@ -45,6 +45,7 @@ from typing import Sequence
 
 from radixmesh_tpu.engine.engine import Engine
 from radixmesh_tpu.engine.request import Request, RequestState, SamplingParams
+from radixmesh_tpu.obs.aggregator import FleetAggregator, HttpPeer
 from radixmesh_tpu.obs.attribution import ensure_attributor
 from radixmesh_tpu.obs.blackbox import BlackBox
 from radixmesh_tpu.obs.doctor import MeshDoctor
@@ -411,6 +412,57 @@ def _debug_timeseries_response(
     )
 
 
+def _cluster_timeseries_response(
+    handler: BaseHTTPRequestHandler, aggregator
+) -> None:
+    """``GET /cluster/timeseries``: the fleet-merged history — every
+    peer's rings folded into one node-labeled store (``obs/
+    aggregator.py``). Same query surface as ``/debug/timeseries``
+    (``family``/``since``/``limit`` cursor pagination), because the
+    fleet store IS a :class:`TelemetryHistory` — readers built for one
+    node read the fleet unchanged. 404 on nodes that host no
+    aggregator (serving nodes; routers started without peers)."""
+    from urllib.parse import parse_qs, urlsplit
+
+    if aggregator is None:
+        _json_response(
+            handler, 404,
+            {"error": "no fleet aggregator hosted here — query a router "
+             "started with --agg-interval > 0 (serving nodes export "
+             "/debug/timeseries only)"},
+        )
+        return
+    q = parse_qs(urlsplit(handler.path).query)
+    try:
+        family = q.get("family", [""])[-1] or None
+        since = int(q.get("since", ["-1"])[-1])
+        limit = int(q.get("limit", ["2000"])[-1])
+    except ValueError:
+        _json_response(
+            handler, 400, {"error": "since/limit must be integers"}
+        )
+        return
+    body = aggregator.store.query(family=family, since=since, limit=limit)
+    body["aggregator"] = aggregator.stats()
+    _json_response(handler, 200, body)
+
+
+def _cluster_slo_response(handler: BaseHTTPRequestHandler, aggregator) -> None:
+    """``GET /cluster/slo``: TRUE fleet percentiles — per-tenant
+    p50/p99 TTFT and e2e from merged histogram bucket counts across
+    every node (never an average of per-node percentiles), each tail
+    quantile carrying its bucket and the freshest trace exemplar that
+    landed in it (``obs/aggregator.py::FleetAggregator.fleet_slo``)."""
+    if aggregator is None:
+        _json_response(
+            handler, 404,
+            {"error": "no fleet aggregator hosted here — query a router "
+             "started with --agg-interval > 0"},
+        )
+        return
+    _json_response(handler, 200, aggregator.fleet_slo())
+
+
 def _admin_blackbox_response(handler: BaseHTTPRequestHandler, blackbox) -> None:
     """``POST /admin/blackbox``: flush the full black box now (the
     operator's pre-restart snapshot — same artifact the SIGTERM/drain/
@@ -562,6 +614,10 @@ class ServingFrontend:
                     "protected_tokens": getattr(tree, "protected_size_", None),
                 },
                 "trace": get_recorder().stats(),
+                # Per-bucket trace exemplars (obs/metrics.py): the fleet
+                # aggregator's HTTP peer transport reads this section to
+                # link fleet-tail buckets back to stitched traces.
+                "exemplars": get_registry().exemplars(),
             }
             host = getattr(tree, "host", None)
             if host is not None:
@@ -621,6 +677,11 @@ class ServingFrontend:
                 slo=self.runner.ctl if self.slo_enabled else None,
                 node=engine.name,
             )
+        # Serving nodes never host a fleet aggregator (that's the
+        # router/front-door role) — the attribute exists so the
+        # /cluster/timeseries and /cluster/slo handlers answer with a
+        # uniform pointer instead of a bare 404.
+        self.aggregator = None
         self.doctor = MeshDoctor(
             mesh=engine.mesh,
             engine=engine,
@@ -768,6 +829,10 @@ class ServingFrontend:
                     # The mesh doctor (obs/doctor.py): ranked findings
                     # with pinned evidence over every attached plane.
                     _json_response(self, 200, frontend.doctor.diagnose())
+                elif self.path.split("?", 1)[0] == "/cluster/timeseries":
+                    _cluster_timeseries_response(self, frontend.aggregator)
+                elif self.path == "/cluster/slo":
+                    _cluster_slo_response(self, frontend.aggregator)
                 else:
                     _json_response(self, 404, {"error": "not found"})
 
@@ -1110,6 +1175,8 @@ class RouterFrontend:
         history_capacity: int = 900,
         blackbox_dir: str | None = None,
         blackbox_watchdog_s: float = 0.0,
+        aggregator_peers: Sequence[tuple] = (),  # (name, base_url[, rank])
+        aggregator_interval_s: float = 2.0,
     ):
         self.router = router
         self.log = get_logger("http.route")
@@ -1137,6 +1204,7 @@ class RouterFrontend:
                 },
                 "membership": _membership_state(r.mesh_cache),
                 "trace": get_recorder().stats(),
+                "exemplars": get_registry().exemplars(),
             }
 
         self._debug_state = _debug_state
@@ -1160,9 +1228,32 @@ class RouterFrontend:
                 mesh=router.mesh_cache,
                 node=node_label,
             )
+        # Fleet aggregation (obs/aggregator.py): the router is the
+        # front door, so it hosts the collector — cursor-pulling every
+        # peer's /debug/timeseries ring into one node-labeled fleet
+        # store, served on /cluster/timeseries + /cluster/slo. Started
+        # only when peers are configured (launch.py --agg-interval); the
+        # doctor gets the aggregator seam either way, so its
+        # ``available`` map states the truth.
+        self.aggregator = None
+        if aggregator_peers:
+            self.aggregator = FleetAggregator(
+                peers=[
+                    # The optional third element is the peer's ring rank
+                    # — the telemetry_gap rule needs it to cross-
+                    # reference gossip health for its dead-node vs
+                    # dead-sampler verdict.
+                    HttpPeer(p[0], p[1], rank=p[2] if len(p) > 2 else None)
+                    for p in aggregator_peers
+                ],
+                interval_s=aggregator_interval_s,
+                capacity=history_capacity,
+                node=node_label,
+                registry=get_registry(),
+            )
         self.doctor = MeshDoctor(
             mesh=router.mesh_cache, attributor=ensure_attributor,
-            history=self.history,
+            history=self.history, aggregator=self.aggregator,
         )
         self.blackbox = None
         if blackbox_dir:
@@ -1178,6 +1269,8 @@ class RouterFrontend:
             )
         if self.history is not None:
             self.history.start()
+        if self.aggregator is not None:
+            self.aggregator.start()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -1224,6 +1317,10 @@ class RouterFrontend:
                     )
                 elif self.path == "/cluster/doctor":
                     _json_response(self, 200, frontend.doctor.diagnose())
+                elif self.path.split("?", 1)[0] == "/cluster/timeseries":
+                    _cluster_timeseries_response(self, frontend.aggregator)
+                elif self.path == "/cluster/slo":
+                    _cluster_slo_response(self, frontend.aggregator)
                 else:
                     _json_response(self, 404, {"error": "not found"})
 
@@ -1271,6 +1368,10 @@ class RouterFrontend:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self.aggregator is not None:
+            # Before the history: a puller sweep racing shutdown must
+            # not ingest into a store whose owner is tearing down.
+            self.aggregator.close()
         if self.blackbox is not None:
             self.blackbox.close(flush_cause="shutdown")
         if self.history is not None:

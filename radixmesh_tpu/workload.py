@@ -3394,8 +3394,10 @@ def run_doctor_workload(
     from radixmesh_tpu.engine.engine import Engine
     from radixmesh_tpu.engine.request import SamplingParams
     from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.obs.aggregator import FleetAggregator, InprocPeer
     from radixmesh_tpu.obs.attribution import ensure_attributor, shape_bucket
     from radixmesh_tpu.obs.doctor import MeshDoctor
+    from radixmesh_tpu.obs.timeseries import TelemetryHistory
     from radixmesh_tpu.obs.trace_plane import (
         FlightRecorder,
         get_recorder,
@@ -3512,11 +3514,25 @@ def run_doctor_workload(
         set_recorder(rec)
         attr = ensure_attributor(rec)
         slo = OverloadController(SLOConfig())
+        # Fleet-aggregation seam (PR 17): an in-proc aggregator over the
+        # router's own ring, pulled by hand before each diagnosis, so
+        # the fleet rules (straggler_node / fleet_burn_slope /
+        # telemetry_gap) RUN in the healthy phase — schema v4's
+        # rules_checked gate requires all eleven, and a quiet fleet
+        # must yield zero fleet findings.
+        agg_hist = TelemetryHistory(
+            interval_s=0.2, mesh=router_mesh, node="dr0"
+        )
+        agg = FleetAggregator(
+            peers=[InprocPeer("dr0", agg_hist, rank=router_mesh.rank)],
+            interval_s=0.2,
+        )
         doctor = MeshDoctor(
             mesh=router_mesh,
             engine=eng,
             slo=slo,
             attributor=ensure_attributor,
+            aggregator=agg,
         )
 
         # -- phase 0: healthy ------------------------------------------
@@ -3548,6 +3564,8 @@ def run_doctor_workload(
         # convoy (share < threshold, similar e2e).
         healthy_prompts = prompts_of(24, 3) + prompts_of(48, 3)
         eng.generate([list(p) for p in healthy_prompts], healthy_sampling)
+        agg_hist.sample()
+        agg.pull_once()
         healthy_report = doctor.diagnose()
         healthy = {
             "performed": True,
@@ -3778,6 +3796,7 @@ def run_blackbox_workload(
     from radixmesh_tpu.engine.engine import Engine
     from radixmesh_tpu.engine.request import SamplingParams
     from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.obs.aggregator import FleetAggregator, InprocPeer
     from radixmesh_tpu.obs.attribution import ensure_attributor
     from radixmesh_tpu.obs.blackbox import BlackBox, load_blackbox
     from radixmesh_tpu.obs.doctor import (
@@ -3915,12 +3934,25 @@ def run_blackbox_workload(
             segment_every=segment_every,
         )
         boxes.append(obs_bb)
+        # Fleet-aggregation seam (PR 17): the observer doubles as the
+        # aggregation host, pulling its own ring — schema v4 requires
+        # the fleet rules in the healthy rules_checked, and a healthy
+        # fleet must keep them silent.
+        agg = FleetAggregator(
+            peers=[
+                InprocPeer(
+                    "observer-router", obs_hist, rank=router_mesh.rank
+                )
+            ],
+            interval_s=history_interval_s,
+        )
         doctor = MeshDoctor(
             mesh=router_mesh,
             engine=eng,
             slo=slo,
             attributor=ensure_attributor,
             history=obs_hist,
+            aggregator=agg,
         )
         obs_bb.doctor = doctor
         obs_hist.start()
@@ -3953,6 +3985,7 @@ def run_blackbox_workload(
             lambda: len(router_mesh.fleet.health()) >= len(ring)
         )
         wait_for(lambda: obs_hist.stats()["seq"] >= 2)
+        agg.pull_once()
         healthy_report = doctor.diagnose()
         healthy = {
             "performed": True,
@@ -4159,5 +4192,482 @@ def run_blackbox_workload(
         "history": history,
         "blackbox": blackbox,
         "attribution_audited": attr.stats()["audited"],
+        "wall_s": round(_time.monotonic() - t_start, 3),
+    }
+
+
+class _FixedDecodeTelemetry:
+    """Engine stand-in for straggler seeding: reports a constant decode
+    step-time EWMA through the fleet-digest seam
+    (``obs/fleet_plane.py::FleetPlane.build_digest`` reads exactly
+    ``telemetry()["decode_ewma_s"]``) — the AGG workload pins one decode
+    node's signal high and its sibling's low without needing a real
+    engine to actually be slow."""
+
+    def __init__(self, decode_ewma_s: float):
+        self._ewma = float(decode_ewma_s)
+
+    def telemetry(self) -> dict:
+        return {"decode_ewma_s": self._ewma}
+
+
+def run_agg_workload(
+    seed: int = 0,
+    replication_factor: int = 3,
+    history_interval_s: float = 0.2,
+    agg_interval_s: float = 0.25,
+    digest_interval_s: float = 0.2,
+    stale_after_s: float = 0.6,
+    straggler_ewma_s: float = 0.08,
+    healthy_ewma_s: float = 0.004,
+    telemetry_gap_s: float = 1.0,
+    request_batches: int = 3,
+    batch_size: int = 8,
+    sim_peers: int = 200,
+    sim_cadence_s: float = 2.0,
+    overhead_budget: float = 0.01,
+    timeout_s: float = 60.0,
+) -> dict:
+    """The control-room acceptance scenario (PR 17; ``bench.
+    validate_agg`` pins its artifact): an inproc 4 prefill + 2 decode +
+    2 router rf=3 cell where every ring node runs a fleet digester and
+    its own telemetry history, all cursor-pulled by one router-hosted
+    :class:`~radixmesh_tpu.obs.aggregator.FleetAggregator`. Four fleet
+    verdicts must be NAMED over the merged store, never hand-waved:
+
+    a. **Merged percentiles.** A traced CPU-engine burst lands TTFT
+       observations; every request object is retained, so the raw
+       records ARE the ground truth. The fleet-merged p99 (bucket
+       counts summed across the reporting nodes, quantile interpolated
+       inside the merged distribution) must land within one histogram
+       bucket of the raw-record p99 — the gate average-of-percentiles
+       fails exactly when it matters.
+    b. **Straggler by rank.** One decode node's digest carries a
+       pinned-high decode EWMA (the seeded delay); the fleet doctor's
+       ``straggler_node`` rule must name that RANK from the aggregated
+       per-rank signal fold.
+    c. **Exemplar → stitched trace.** The merged-p99 bucket's exemplar
+       (collected off the slow node's registry during the pull sweep)
+       must join by trace id into a stitched trace containing the slow
+       node's span.
+    d. **Gap, not silence.** One prefill node dies (digester + sampler
+       stop); the doctor's ``telemetry_gap`` rule must surface it with
+       a dead-vs-sampler verdict from the mesh health cross-check.
+
+    Plus two budget rows: total aggregation cost under 1% of run wall
+    time, and an N=200 simulated-transport fan-in sweep completing
+    inside one pull cadence."""
+    import bisect
+    import time as _time
+
+    import jax
+
+    from radixmesh_tpu.cache.mesh_cache import MeshCache
+    from radixmesh_tpu.comm.inproc import InprocHub
+    from radixmesh_tpu.config import MeshConfig, NodeRole
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.engine.request import SamplingParams
+    from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.obs.aggregator import FleetAggregator, InprocPeer
+    from radixmesh_tpu.obs.attribution import ensure_attributor
+    from radixmesh_tpu.obs.doctor import DoctorConfig, MeshDoctor
+    from radixmesh_tpu.obs.fleet_plane import FleetPlane
+    from radixmesh_tpu.obs.metrics import DEFAULT_BUCKETS, get_registry
+    from radixmesh_tpu.obs.timeseries import TelemetryHistory
+    from radixmesh_tpu.obs.trace_plane import (
+        FlightRecorder,
+        get_recorder,
+        set_recorder,
+        stitch_traces,
+    )
+
+    def wait_for(pred, timeout=timeout_s, interval=0.02):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(interval)
+        return pred()
+
+    def finding_for(report: dict, rule: str):
+        for f in report["findings"]:
+            if f["rule"] == rule:
+                return f
+        return None
+
+    def bucket_index(value: float) -> int:
+        # The bucket a value lands in, by the same predicate
+        # Histogram.observe uses (first bound >= value; past the last
+        # bound = the +Inf slot at len(buckets)).
+        return bisect.bisect_left(DEFAULT_BUCKETS, value)
+
+    def le_index(le: str) -> int:
+        if le == "+Inf":
+            return len(DEFAULT_BUCKETS)
+        return bisect.bisect_left(DEFAULT_BUCKETS, float(le))
+
+    rng = np.random.default_rng(seed)
+    t_start = _time.monotonic()
+    InprocHub.reset_default()
+    prev_recorder = get_recorder()
+    prefill = ["ap0", "ap1", "ap2", "ap3"]
+    decode = ["ad0", "ad1"]
+    router_addrs = ["ar0", "ar1"]
+    nodes: list = []
+    fleet_planes: list = []
+    histories: list = []
+    aggs: list = []
+    try:
+        for addr in prefill + decode + router_addrs:
+            cfg = MeshConfig(
+                prefill_nodes=prefill,
+                decode_nodes=decode,
+                router_nodes=router_addrs,
+                local_addr=addr,
+                protocol="inproc",
+                tick_interval_s=0.1,
+                gc_interval_s=60.0,
+                failure_timeout_s=60.0,
+                replication_factor=replication_factor,
+                shard_summary_interval_s=0.2,
+            )
+            nodes.append(MeshCache(cfg, pool=None).start())
+        for n in nodes:
+            if not n.wait_ready(timeout=timeout_s):
+                raise RuntimeError(f"node {n.rank} never passed the barrier")
+        ring = [n for n in nodes if n.role is not NodeRole.ROUTER]
+        routers = [n for n in nodes if n.role is NodeRole.ROUTER]
+        router_mesh = routers[0]
+        # Fast staleness verdicts on the aggregation host: the
+        # telemetry_gap rule's dead-vs-sampler cross-check reads this
+        # mesh's health view, which must see a killed node's digest go
+        # stale within a second, not the 15 s default.
+        for r in routers:
+            r.fleet.cfg.stale_after_s = stale_after_s
+
+        def peer_name(n) -> str:
+            return f"{n.role.value}{n.rank}"
+
+        # -- per-node digesters: the straggler seed ---------------------
+        # Decode nodes publish a pinned decode EWMA through the real
+        # digest seam — one high (the straggler), one low (the healthy
+        # sibling the ratio is judged against). Prefill planes publish
+        # 0.0, which the straggler rule filters as "not a decode rank".
+        straggler_rank = None
+        for n in ring:
+            stub = None
+            if n.role is NodeRole.DECODE:
+                if straggler_rank is None:
+                    straggler_rank = n.rank
+                    stub = _FixedDecodeTelemetry(straggler_ewma_s)
+                else:
+                    stub = _FixedDecodeTelemetry(healthy_ewma_s)
+            fleet_planes.append(
+                FleetPlane(n, engine=stub, interval_s=digest_interval_s)
+                .start()
+            )
+        straggler_name = f"decode{straggler_rank}"
+
+        # -- the traced engine (runs ON the straggler node) -------------
+        # Everything is traced from the first request: the compile-heavy
+        # first batch IS the p99 tail, and the exemplar gate needs the
+        # p99-bucket observation to carry a trace id.
+        rec = FlightRecorder(
+            capacity=1 << 15, sample=1.0, node=straggler_name
+        )
+        set_recorder(rec)
+        mcfg = ModelConfig(
+            vocab_size=256, hidden=64, n_layers=2, n_heads=2, n_kv_heads=2,
+            head_dim=32, intermediate=128, max_seq_len=1024,
+        )
+        eng = Engine(
+            mcfg,
+            init_params(mcfg, jax.random.PRNGKey(seed)),
+            num_slots=2048,
+            page_size=4,
+            max_batch=8,
+            name=straggler_name,
+        )
+        sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
+
+        def run_batch(n_tokens: int, count: int) -> list:
+            reqs = [
+                eng.add_request(
+                    list(rng.integers(1, mcfg.vocab_size - 1, size=n_tokens)),
+                    sampling,
+                )
+                for _ in range(count)
+            ]
+            while eng.has_work():
+                eng.step()
+            return reqs
+
+        # Raw records: EVERY request object is retained — their
+        # first-token stamps are the ground truth the merged quantile
+        # is judged against, so nothing (warm compiles included) may
+        # observe into the histogram without also landing here.
+        all_reqs = run_batch(24, 4) + run_batch(48, 2)
+        for _ in range(request_batches):
+            all_reqs += run_batch(int(rng.integers(16, 49)), batch_size)
+
+        # -- per-node histories + the router-hosted aggregator ----------
+        for n in ring:
+            h = TelemetryHistory(
+                interval_s=history_interval_s,
+                mesh=n,
+                node=peer_name(n),
+            )
+            histories.append(h)
+            h.start()
+        # Only the straggler's peer carries a registry: each real node
+        # would serve its own process registry; in this one-process cell
+        # the engine ran on the straggler, so its peer is the one whose
+        # exemplar fetch may claim the traced observations.
+        agg = FleetAggregator(
+            peers=[
+                InprocPeer(
+                    peer_name(n),
+                    h,
+                    registry=(
+                        get_registry() if n.rank == straggler_rank else None
+                    ),
+                    rank=n.rank,
+                )
+                for n, h in zip(ring, histories)
+            ],
+            interval_s=agg_interval_s,
+            node=f"router{router_mesh.rank}",
+        )
+        aggs.append(agg)
+        doctor = MeshDoctor(
+            mesh=router_mesh,
+            attributor=ensure_attributor,
+            aggregator=agg,
+            cfg=DoctorConfig(telemetry_gap_s=telemetry_gap_s),
+        )
+
+        # -- verdict a: merged p99 vs raw-record truth ------------------
+        ttfts = sorted(
+            r.first_token_time - r.submit_time
+            for r in all_reqs
+            if r.first_token_time and r.submit_time
+        )
+        # Every reporting node must have sampled the burst's final
+        # counts before the pull that feeds the merge.
+        ttft_total = len(ttfts)
+        wait_for(
+            lambda: all(h.stats()["seq"] >= 1 for h in histories)
+        )
+        _time.sleep(history_interval_s + 0.05)
+        agg.pull_once()
+        fleet = agg.fleet_slo()
+        tenants = fleet["tenants"]
+        tenant = "default" if "default" in tenants else next(iter(tenants))
+        tb = tenants[tenant]["ttft"]
+        truth_p99 = float(np.quantile(np.asarray(ttfts), 0.99))
+        fleet_le = tb.get("p99_bucket")
+        idx_truth = bucket_index(truth_p99)
+        idx_fleet = le_index(fleet_le) if fleet_le else -99
+        bucket_lo = (
+            DEFAULT_BUCKETS[idx_fleet - 1]
+            if 0 < idx_fleet <= len(DEFAULT_BUCKETS)
+            else 0.0
+        )
+        bucket_hi = (
+            DEFAULT_BUCKETS[idx_fleet]
+            if 0 <= idx_fleet < len(DEFAULT_BUCKETS)
+            else None
+        )
+        percentiles = {
+            "performed": True,
+            "tenant": tenant,
+            "fleet_p99_s": tb.get("p99"),
+            "truth_p99_s": round(truth_p99, 6),
+            "bucket_lo_s": bucket_lo,
+            "bucket_hi_s": bucket_hi,
+            "within_one_bucket": bool(abs(idx_fleet - idx_truth) <= 1),
+            "count": tb.get("count", 0),
+            "nodes": tb.get("nodes", []),
+            "raw_requests": ttft_total,
+        }
+
+        # -- verdict b: straggler named by rank -------------------------
+        # The seeded EWMA must cross gossip → per-node derived series →
+        # pull → per-rank fold before the rule can see both decode
+        # ranks.
+        def decode_ranks_folded() -> bool:
+            agg.pull_once()
+            vals = agg.rank_signal("fleet:decode_ewma_seconds")
+            return (
+                vals.get(str(straggler_rank), 0.0) > 0.0
+                and sum(1 for v in vals.values() if v > 0.0) >= 2
+            )
+
+        wait_for(decode_ranks_folded)
+        strag_report = doctor.diagnose()
+        strag_f = finding_for(strag_report, "straggler_node")
+        strag_ev = (strag_f or {}).get("evidence", {})
+        straggler = {
+            "performed": True,
+            "seeded_rank": straggler_rank,
+            "named_rank": strag_ev.get("rank"),
+            "detected": strag_f is not None,
+            "ratio": strag_ev.get("ratio"),
+            "signal": strag_ev.get("signal"),
+        }
+
+        # -- verdict c: p99 exemplar → stitched trace -------------------
+        ex = tb.get("p99_exemplar") or {}
+        stitched_doc = stitch_traces([rec.export_spans()])
+        node_of_pid = {
+            e.get("pid"): e.get("args", {}).get("name")
+            for e in stitched_doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        tid = ex.get("trace_id")
+        hit_nodes = {
+            node_of_pid.get(e.get("pid"))
+            for e in stitched_doc["traceEvents"]
+            if tid and e.get("args", {}).get("trace_id") == tid
+        }
+        exemplar = {
+            "performed": True,
+            "trace_id": tid,
+            "node": ex.get("node"),
+            "le": ex.get("le"),
+            "stitched": bool(tid and hit_nodes),
+            "has_straggler_span": straggler_name in hit_nodes,
+        }
+
+        # -- verdict d: killed node surfaces as telemetry_gap -----------
+        victim = ring[0]
+        victim_name = peer_name(victim)
+        for fp in fleet_planes:
+            if fp.mesh is victim:
+                fp.close()
+        histories[0].close()
+
+        gap_f = None
+
+        def gap_named() -> bool:
+            nonlocal gap_f
+            agg.pull_once()
+            rep = doctor.diagnose()
+            f = finding_for(rep, "telemetry_gap")
+            if f is not None and f["evidence"].get("peer") == victim_name:
+                gap_f = f
+                return True
+            return False
+
+        wait_for(gap_named, interval=0.1)
+        gap_ev = (gap_f or {}).get("evidence", {})
+        gap = {
+            "performed": True,
+            "killed_peer": victim_name,
+            "detected": gap_f is not None,
+            "verdict": gap_ev.get("verdict"),
+            "stalled_s": gap_ev.get("stalled_s"),
+        }
+
+        # -- fan-in row: N=200 simulated peers, one sweep ---------------
+        # Each simulated peer is a real TelemetryHistory fed through the
+        # real ingest path (no sampler thread, no sockets): the sweep
+        # exercises the true query/fold pipeline at ringscale N without
+        # 200 registry snapshots.
+        sim_histories = []
+        t_sim = _time.monotonic()
+        for i in range(sim_peers):
+            h = TelemetryHistory(
+                interval_s=0.5, capacity=16, node=f"sim{i:03d}",
+                max_series=64,
+            )
+            for k in range(2):
+                h.ingest(f"sim{i:03d}", {
+                    "seq": k,
+                    "interval_s": 0.5,
+                    "wall_offset": h.wall_offset,
+                    "series": {
+                        "engine:decode_steps": {
+                            "points": [[k, t_sim + 0.5 * k, float(7 * k + i)]],
+                        },
+                        f'fleet:health_score{{rank="{i}"}}': {
+                            "points": [[k, t_sim + 0.5 * k, 1.0]],
+                        },
+                        "slo:queue_depth": {
+                            "points": [[k, t_sim + 0.5 * k, float(i % 5)]],
+                        },
+                    },
+                })
+            sim_histories.append(h)
+        fan_agg = FleetAggregator(
+            peers=[
+                InprocPeer(f"sim{i:03d}", h)
+                for i, h in enumerate(sim_histories)
+            ],
+            interval_s=sim_cadence_s,
+            capacity=64,
+            node="fan-in",
+            max_series=32768,
+        )
+        aggs.append(fan_agg)
+        sweep = fan_agg.pull_once()
+        fan_in = {
+            "performed": True,
+            "peers": sweep["peers"],
+            "sweep_s": round(sweep["duration_s"], 6),
+            "cadence_s": sim_cadence_s,
+            "within_cadence": bool(sweep["duration_s"] < sim_cadence_s),
+            "points": sweep["points"],
+            "errors": sweep["errors"],
+        }
+
+        # -- overhead row -----------------------------------------------
+        wall_s = _time.monotonic() - t_start
+        pull_cost = sum(a.stats()["pull_seconds_total"] for a in aggs)
+        overhead = {
+            "pull_seconds_total": round(pull_cost, 6),
+            "wall_s": round(wall_s, 3),
+            "fraction": round(pull_cost / max(1e-9, wall_s), 6),
+            "budget_fraction": overhead_budget,
+            "under_budget": bool(
+                pull_cost / max(1e-9, wall_s) < overhead_budget
+            ),
+        }
+    finally:
+        set_recorder(prev_recorder)
+        for a in aggs:
+            a.close()
+        for h in histories:
+            h.close()
+        for fp in fleet_planes:
+            fp.close()
+        for n in nodes:
+            n.close()
+        InprocHub.reset_default()
+
+    named = sum([
+        percentiles["within_one_bucket"],
+        bool(
+            straggler["detected"]
+            and str(straggler["named_rank"]) == str(straggler["seeded_rank"])
+        ),
+        bool(exemplar["stitched"] and exemplar["has_straggler_span"]),
+        bool(gap["detected"] and gap["verdict"] in (
+            "node_dead", "sampler_dead",
+        )),
+    ])
+    return {
+        "nodes": len(prefill) + len(decode) + len(router_addrs),
+        "topology": "4 prefill + 2 decode + 2 routers (inproc, per-node "
+        "fleet digesters + telemetry histories, router-hosted "
+        "aggregator) + traced CPU engine on the slow decode node",
+        "replication_factor": replication_factor,
+        "named": named,
+        "percentiles": percentiles,
+        "straggler": straggler,
+        "exemplar": exemplar,
+        "gap": gap,
+        "overhead": overhead,
+        "fan_in": fan_in,
         "wall_s": round(_time.monotonic() - t_start, 3),
     }

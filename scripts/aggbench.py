@@ -1,0 +1,133 @@
+"""Standalone fleet-aggregation acceptance bench (the AGG artifact's
+paired CLI emitter, like ``scripts/blackboxbench.py`` is for BLACKBOX).
+
+Runs ``workload.run_agg_workload`` — a live cell whose per-node
+telemetry rings a router-hosted ``FleetAggregator`` cursor-pulls into
+one fleet store — and checks the four named verdicts end to end:
+
+- **percentiles** — the fleet p99 computed by merging per-node bucket
+  counts lands within one histogram bucket of the ground-truth p99
+  taken over the raw request records (average-of-per-node-p99s would
+  not);
+- **straggler** — a decode rank seeded with a 20x decode EWMA is named
+  BY RANK by the fleet doctor's ``straggler_node`` rule off the folded
+  ``fleet:`` gossip series;
+- **exemplar** — the fleet p99 bucket carries a trace exemplar whose
+  trace id stitches to a real span set that includes the straggler
+  node;
+- **gap** — killing one peer's sampler mid-run is detected by the
+  ``telemetry_gap`` rule with a node-dead/sampler-dead verdict.
+
+Plus two always-on gates: aggregation overhead stays under its pull
+budget, and a 200-peer fan-in sweep completes within one cadence.
+Prints ONE JSON line validated against the schema ``bench.validate_agg``
+pins.
+
+Usage::
+
+    python scripts/aggbench.py [--seed 0] [--replication-factor 3] \
+        [--sim-peers 200] [--out FILE] [--write-artifact]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+from radixmesh_tpu.workload import run_agg_workload  # noqa: E402
+
+
+def agg_round() -> int:
+    """The round in progress = 1 + the highest N across every OTHER
+    plane's recorded artifact (AGG rides whatever round they are on —
+    the scripts/meshcheck.py analysis_round convention)."""
+    rounds = [0]
+    for name in os.listdir(_REPO_ROOT):
+        m = re.fullmatch(r"[A-Z_]+_r(\d+)\.json", name)
+        if m and not name.startswith("AGG_"):
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def run(
+    seed: int,
+    replication_factor: int,
+    history_interval_s: float,
+    agg_interval_s: float,
+    sim_peers: int,
+) -> dict:
+    res = run_agg_workload(
+        seed=seed,
+        replication_factor=replication_factor,
+        history_interval_s=history_interval_s,
+        agg_interval_s=agg_interval_s,
+        sim_peers=sim_peers,
+    )
+    report = bench.build_agg_report(res)
+    problems = bench.validate_agg(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="aggbench")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--replication-factor", type=int, default=3, metavar="RF",
+        help="sharding factor for the mesh under test (the acceptance "
+        "run pins 3)",
+    )
+    ap.add_argument(
+        "--history-interval", type=float, default=0.2, metavar="SECONDS",
+        help="per-node telemetry-history sample cadence (production "
+        "default is 1 s; the acceptance run samples faster so verdicts "
+        "land in the rings quickly)",
+    )
+    ap.add_argument(
+        "--agg-interval", type=float, default=0.25, metavar="SECONDS",
+        help="aggregator pull cadence (production default is 2 s)",
+    )
+    ap.add_argument(
+        "--sim-peers", type=int, default=200, metavar="N",
+        help="synthetic ring count for the fan-in gate (the schema "
+        "floor is 200; lowering it below that fails validation — use "
+        "for local profiling only)",
+    )
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument(
+        "--write-artifact", action="store_true",
+        help="write the round's AGG_r{N}.json to the repo root",
+    )
+    args = ap.parse_args()
+    report = run(
+        args.seed,
+        args.replication_factor,
+        args.history_interval,
+        args.agg_interval,
+        args.sim_peers,
+    )
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    if args.write_artifact:
+        path = os.path.join(_REPO_ROOT, f"AGG_r{agg_round():02d}.json")
+        with open(path, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"aggbench: wrote {os.path.basename(path)}", file=sys.stderr)
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

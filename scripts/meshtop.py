@@ -1,0 +1,164 @@
+"""``top`` for the mesh: one screen of fleet truth off a router's
+aggregation endpoints, refreshed in place.
+
+Reads ``GET /cluster/slo`` (true cross-node per-tenant percentiles from
+merged histogram bucket counts, each tail bucket carrying its freshest
+trace exemplar) and ``GET /cluster/timeseries`` (the fleet store's
+stats plus the ``fleet:`` gossip series), and renders:
+
+- the aggregator line — sweeps, folded points, pull cost, peer count;
+- the peer table — per peer: rank, ring seq, pull cursor, errors,
+  resets (peer restarts detected by the seq-below-cursor signature),
+  and how long since its ring last advanced (the ``telemetry_gap``
+  rule's raw signal);
+- per-rank decode EWMA / replication lag off the folded gossip series
+  (the ``straggler_node`` rule's raw signal);
+- the tenant SLO table — p50/p99 TTFT and e2e with the p99 bucket and
+  its exemplar trace id (paste the id into the trace viewer to see the
+  exact request that set the tail).
+
+Exit codes: 0 rendered, 2 unreachable / no aggregator hosted there.
+
+Usage::
+
+    python scripts/meshtop.py [--url http://HOST:PORT] [--watch 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _get(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.load(resp)
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    if v < 0.001:
+        return f"{v * 1e6:.0f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v:.2f}s"
+
+
+def _rank_row(series: dict, family: str) -> dict:
+    """rank → freshest value from a folded ``fleet:`` gossip family
+    (same freshest-point-per-rank fold the straggler rule uses)."""
+    best: dict[str, tuple[int, float]] = {}
+    for key, s in series.items():
+        if not key.startswith(family + "{") or 'rank="' not in key:
+            continue
+        rank = key.split('rank="', 1)[1].split('"', 1)[0]
+        pts = s.get("points") or []
+        if not pts:
+            continue
+        seq, _t, val = pts[-1]
+        if rank not in best or seq > best[rank][0]:
+            best[rank] = (int(seq), float(val))
+    return {r: v for r, (_s, v) in sorted(best.items(), key=lambda kv: kv[0])}
+
+
+def _render(slo: dict, ts: dict) -> None:
+    agg = ts.get("aggregator", {})
+    store = agg.get("store", {})
+    print(
+        f"mesh {slo.get('node', '?')!r} — sweeps={agg.get('sweeps', 0)} "
+        f"points={store.get('points', '?')} series={store.get('series', '?')} "
+        f"pull_cost={_fmt_s(agg.get('pull_seconds_total'))} "
+        f"peers={agg.get('peers', 0)}"
+    )
+    peers = slo.get("peers", {})
+    if peers:
+        print(f"\n  {'PEER':<12}{'RANK':>5}{'SEQ':>8}{'CURSOR':>8}"
+              f"{'ERR':>5}{'RST':>5}{'STALLED':>9}")
+        for name, st in sorted(peers.items()):
+            stalled = st.get("stalled_s")
+            mark = ""
+            if stalled is not None and stalled > st.get(
+                "gap_threshold_s", float("inf")
+            ):
+                mark = "  <- GAP"  # the telemetry_gap rule's threshold
+            print(
+                f"  {name:<12}{str(st.get('rank', '-')):>5}"
+                f"{st.get('seq', -1):>8}{st.get('cursor', -1):>8}"
+                f"{st.get('errors', 0):>5}{st.get('resets', 0):>5}"
+                f"{_fmt_s(stalled):>9}{mark}"
+            )
+    for label, family in (
+        ("decode EWMA", "fleet:decode_ewma_seconds"),
+        ("repl lag", "fleet:replication_lag_seconds"),
+    ):
+        row = _rank_row(ts.get("series", {}), family)
+        if row:
+            cells = "  ".join(f"r{r}={_fmt_s(v)}" for r, v in row.items())
+            print(f"\n  {label:<12} {cells}")
+    tenants = slo.get("tenants", {})
+    if tenants:
+        print(f"\n  {'TENANT':<10}{'SIG':<6}{'N':>7}{'P50':>9}{'P99':>9}"
+              f"{'BUCKET':>8}  EXEMPLAR")
+        for tenant, sigs in sorted(tenants.items()):
+            for sig in ("ttft", "e2e"):
+                b = sigs.get(sig)
+                if not b or not b.get("count"):
+                    continue
+                ex = b.get("p99_exemplar") or {}
+                tag = ""
+                if ex:
+                    tag = f"{ex.get('trace_id', '?')} @{ex.get('node', '?')}"
+                print(
+                    f"  {tenant:<10}{sig:<6}{b['count']:>7}"
+                    f"{_fmt_s(b.get('p50')):>9}{_fmt_s(b.get('p99')):>9}"
+                    f"{str(b.get('p99_bucket', '-')):>8}  {tag}"
+                )
+    else:
+        print("\n  no tenant SLO series folded yet "
+              "(no radixmesh_request_* buckets in any peer ring)")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="meshtop")
+    ap.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="router frontend base URL (must host the fleet aggregator, "
+        "i.e. launched with --agg-interval > 0)",
+    )
+    ap.add_argument(
+        "--watch", type=float, default=None, metavar="SECONDS",
+        help="refresh the screen every SECONDS (ctrl-c to stop); "
+        "default is one shot",
+    )
+    args = ap.parse_args()
+    base = args.url.rstrip("/")
+    while True:
+        try:
+            slo = _get(base + "/cluster/slo")
+            ts = _get(base + "/cluster/timeseries?limit=4000")
+        except Exception as e:  # noqa: BLE001 — any transport failure is the same verdict
+            print(f"meshtop: {base} unreachable: {e}", file=sys.stderr)
+            return 2
+        if "error" in slo:
+            print(f"meshtop: {slo['error']}", file=sys.stderr)
+            return 2
+        if args.watch is None:
+            _render(slo, ts)
+            return 0
+        os.write(1, b"\x1b[2J\x1b[H")  # clear + home, top-style redraw
+        print(f"=== {time.strftime('%H:%M:%S')} (refresh {args.watch:g}s) ===")
+        _render(slo, ts)
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

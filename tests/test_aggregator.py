@@ -1,0 +1,551 @@
+"""Fleet aggregator (obs/aggregator.py): cross-node bucket-count
+merging quantile-matches direct observation of the union stream (the
+property average-of-percentiles fails), cursor pulls resume across peer
+restarts without double-counting, exemplars ride the pull sweep from
+``Histogram.observe(trace_id=)`` to the merged quantile's bucket, and
+the three fleet doctor rules (straggler_node / fleet_burn_slope /
+telemetry_gap) fire on their seeded pathologies and stay silent on the
+healthy shape — all on virtual clocks (no sleeps, no sockets)."""
+
+import random
+
+import pytest
+
+from radixmesh_tpu.obs.aggregator import (
+    FleetAggregator,
+    InprocPeer,
+    merge_bucket_counts,
+    merge_quantile,
+)
+from radixmesh_tpu.obs.doctor import DoctorConfig, MeshDoctor
+from radixmesh_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    Registry,
+    get_registry,
+    set_registry,
+)
+from radixmesh_tpu.obs.timeseries import TelemetryHistory
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    old = set_registry(Registry())
+    yield
+    set_registry(old)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _bucket_map(h: Histogram) -> dict:
+    """``le`` string → cumulative count, the per-node wire shape the
+    merge consumes."""
+    return {
+        h._le_str(i): float(c) for i, c in enumerate(h.bucket_counts())
+    }
+
+
+def _bucket_of(value: float, bounds) -> int:
+    for i, ub in enumerate(bounds):
+        if value <= ub:
+            return i
+    return len(bounds)
+
+
+# ---------------------------------------------------------------------------
+# merged percentiles
+# ---------------------------------------------------------------------------
+
+
+class TestMergeQuantile:
+    def test_empty_and_zero_total(self):
+        assert merge_quantile((), [], 0.99) == (0.0, None)
+        assert merge_quantile((1.0,), [0.0, 0.0], 0.99) == (0.0, None)
+
+    def test_single_node_identity(self):
+        """A one-node fleet answers exactly what the node's own
+        histogram answers — the merge is a no-op, not an estimate."""
+        h = Histogram("radixmesh_x_seconds")
+        rng = random.Random(7)
+        for _ in range(500):
+            h.observe(rng.lognormvariate(-4.0, 1.5))
+        bounds, cum = merge_bucket_counts([_bucket_map(h)])
+        for q in (0.5, 0.9, 0.99):
+            est, _le = merge_quantile(bounds + (float("inf"),), cum, q)
+            assert est == pytest.approx(h.quantile(q), rel=1e-9)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_merged_matches_union_stream_property(self, seed):
+        """K nodes observe disjoint streams; merging their bucket
+        counts must answer the same quantile (same bucket, same
+        interpolated estimate) as one histogram that saw the union
+        stream directly. This is the property averaging per-node
+        percentiles breaks: the skewed-node case below fails it by
+        construction."""
+        rng = random.Random(seed)
+        k = rng.randint(2, 6)
+        union = Histogram("radixmesh_u_seconds")
+        per_node = []
+        for node in range(k):
+            h = Histogram("radixmesh_n_seconds")
+            mu = rng.uniform(-6.0, -2.0)  # per-node latency regime
+            for _ in range(rng.randint(20, 300)):
+                v = rng.lognormvariate(mu, 1.0)
+                h.observe(v)
+                union.observe(v)
+            per_node.append(_bucket_map(h))
+        bounds, cum = merge_bucket_counts(per_node)
+        assert cum[-1] == union.count
+        for q in (0.5, 0.9, 0.99):
+            est, _le = merge_quantile(bounds + (float("inf"),), cum, q)
+            assert est == pytest.approx(union.quantile(q), rel=1e-9)
+
+    def test_average_of_percentiles_would_lie(self):
+        """One slow node out of four: the union p99 sits in the slow
+        regime, but the mean of per-node p99s lands buckets below it —
+        the merged answer must side with the union."""
+        fast = [Histogram("radixmesh_f_seconds") for _ in range(3)]
+        slow = Histogram("radixmesh_s_seconds")
+        union = Histogram("radixmesh_u_seconds")
+        for h in fast:
+            for _ in range(100):
+                h.observe(0.002)
+                union.observe(0.002)
+        for _ in range(100):
+            slow.observe(8.0)
+            union.observe(8.0)
+        maps = [_bucket_map(h) for h in (*fast, slow)]
+        bounds, cum = merge_bucket_counts(maps)
+        est, le = merge_quantile(bounds + (float("inf"),), cum, 0.99)
+        assert est == pytest.approx(union.quantile(0.99), rel=1e-9)
+        avg_p99 = sum(h.quantile(0.99) for h in (*fast, slow)) / 4
+        # The wrong answer is more than one bucket away from the truth;
+        # the merged answer is in the truth's bucket.
+        assert _bucket_of(est, DEFAULT_BUCKETS) == _bucket_of(
+            union.quantile(0.99), DEFAULT_BUCKETS
+        )
+        assert (
+            _bucket_of(avg_p99, DEFAULT_BUCKETS)
+            < _bucket_of(est, DEFAULT_BUCKETS) - 1
+        )
+
+    def test_overflow_bucket_answers_largest_finite_bound(self):
+        h = Histogram("radixmesh_o_seconds", buckets=(0.1, 1.0))
+        for _ in range(10):
+            h.observe(50.0)
+        bounds, cum = merge_bucket_counts([_bucket_map(h)])
+        est, le = merge_quantile(bounds + (float("inf"),), cum, 0.99)
+        assert est == 1.0
+        assert le == "+Inf"
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+
+class TestExemplars:
+    def test_untraced_observation_allocates_nothing(self):
+        """Tracing off = no exemplar dict, no exposition comment — the
+        hot path pays one ``is not None`` test and nothing else."""
+        h = Histogram("radixmesh_x_seconds")
+        h.observe(0.03)
+        assert h._exemplars is None
+        assert h.exemplars() == {}
+
+    def test_traced_observation_pins_bucket_exemplar(self):
+        h = Histogram("radixmesh_x_seconds")
+        h.observe(0.03, trace_id=0xABC)
+        h.observe(0.04, trace_id=0xDEF)  # same bucket: last one wins
+        ex = h.exemplars()
+        assert list(ex) == ["0.05"]
+        assert ex["0.05"]["trace_id"] == f"{0xDEF:#018x}"
+        assert ex["0.05"]["value"] == 0.04
+
+    def test_exposition_renders_exemplar_comment_line(self):
+        reg = Registry()
+        h = reg.histogram("radixmesh_x_seconds", "x")
+        h.observe(0.03, trace_id=0xABC)
+        text = reg.render()
+        lines = [ln for ln in text.splitlines() if ln.startswith("# EXEMPLAR")]
+        assert len(lines) == 1
+        assert 'radixmesh_x_seconds_bucket{le="0.05"}' in lines[0]
+        assert f"trace_id={0xABC:#018x}" in lines[0]
+        # Comment lines stay comments: a Prometheus scraper ignores them.
+        assert lines[0].startswith("# ")
+
+    def test_registry_exemplars_keyed_like_snapshot(self):
+        reg = Registry()
+        h = reg.histogram("radixmesh_x_seconds", "x", ("tenant",))
+        h.labels(tenant="t0").observe(0.03, trace_id=1)
+        h.labels(tenant="t1").observe(0.2)  # untraced: omitted
+        ex = reg.exemplars()
+        assert list(ex) == ['radixmesh_x_seconds{tenant="t0"}']
+
+
+# ---------------------------------------------------------------------------
+# the pull sweep: cursors, restarts, node labeling
+# ---------------------------------------------------------------------------
+
+
+def _mk_history(clock, node="n0", interval_s=1.0):
+    return TelemetryHistory(interval_s=interval_s, node=node, now=clock)
+
+
+class TestPullSweep:
+    def test_fold_is_node_labeled_and_cursor_advances(self):
+        clock = FakeClock()
+        c = get_registry().counter("radixmesh_seen_total", "x")
+        hist = _mk_history(clock)
+        c.inc(3)
+        hist.sample()
+        agg = FleetAggregator(
+            peers=[InprocPeer("n0", hist)], now=clock
+        )
+        sweep = agg.pull_once()
+        assert sweep["errors"] == 0 and sweep["points"] > 0
+        q = agg.store.query(family="radixmesh_seen_total")
+        assert 'radixmesh_seen_total{node="n0"}' in q["series"]
+        st = agg.peer_status()["n0"]
+        assert st["seq"] == 0 and st["cursor"] == 0
+
+    def test_change_compressed_pull_never_double_counts(self):
+        """Two pulls over one unchanged ring: the second sweep folds
+        zero new points (the cursor, not a timestamp heuristic, is the
+        dedup)."""
+        clock = FakeClock()
+        c = get_registry().counter("radixmesh_seen_total", "x")
+        hist = _mk_history(clock)
+        c.inc()
+        hist.sample()
+        agg = FleetAggregator(peers=[InprocPeer("n0", hist)], now=clock)
+        first = agg.pull_once()
+        assert first["points"] > 0
+        assert agg.pull_once()["points"] == 0
+        # New delta → the counter series gains exactly one point (the
+        # sweep also folds the ring's changed self-metrics, so total
+        # sweep points is not the right measure).
+        def counter_points():
+            q = agg.store.query(family="radixmesh_seen_total")
+            return q["series"]['radixmesh_seen_total{node="n0"}']["points"]
+
+        before = len(counter_points())
+        c.inc()
+        clock.advance(1.0)
+        hist.sample()
+        assert agg.pull_once()["points"] > 0
+        assert len(counter_points()) == before + 1
+
+    def test_peer_restart_rewinds_cursor_without_gaps(self):
+        """A peer restart (fresh ring, per-boot seq) is detected by the
+        seq-below-cursor signature: one counted reset, the new boot's
+        ring re-pulled from its start, and the fleet store's view of
+        the counter ends at the live value — no gap, no double count
+        (the old boot's points stay under their own ingest seqs)."""
+        clock = FakeClock()
+        c = get_registry().counter("radixmesh_seen_total", "x")
+        hist = _mk_history(clock)
+        peer = InprocPeer("n0", hist)
+        agg = FleetAggregator(peers=[peer], now=clock)
+        c.inc()
+        hist.sample()
+        clock.advance(1.0)
+        c.inc()
+        hist.sample()
+        agg.pull_once()
+        assert agg.peer_status()["n0"]["seq"] == 1
+        # The restart: the prior boot's ring is gone, a fresh history
+        # re-snapshots the (persistent) process counters from seq 0.
+        hist.close()
+        peer.history = _mk_history(clock)
+        clock.advance(1.0)
+        peer.history.sample()
+        sweep = agg.pull_once()
+        st = agg.peer_status()["n0"]
+        assert st["resets"] == 1
+        assert st["seq"] == 0 and sweep["errors"] == 0
+        pts = agg.store.query(family="radixmesh_seen_total")["series"][
+            'radixmesh_seen_total{node="n0"}'
+        ]["points"]
+        # Boot 1 recorded 1 then 2; boot 2 re-ships the live value 2.
+        assert [p[2] for p in pts] == [1.0, 2.0, 2.0]
+        assert pts[-1][2] == c.value
+
+    def test_deep_backlog_paginates_within_one_sweep(self):
+        clock = FakeClock()
+        c = get_registry().counter("radixmesh_seen_total", "x")
+        hist = _mk_history(clock)
+        for _ in range(6):
+            c.inc()
+            hist.sample()
+            clock.advance(1.0)
+        agg = FleetAggregator(
+            peers=[InprocPeer("n0", hist)], now=clock, page_limit=1
+        )
+        agg.pull_once()
+        st = agg.peer_status()["n0"]
+        assert st["seq"] == 5
+        with agg._lock:
+            assert agg._state["n0"].pages > 1
+
+    def test_dead_peer_is_an_error_not_a_sweep_kill(self):
+        class DeadPeer:
+            name = "rip"
+            rank = None
+
+            def fetch(self, since, limit):
+                raise OSError("connection refused")
+
+            def fetch_exemplars(self):
+                return {}
+
+        clock = FakeClock()
+        hist = _mk_history(clock)
+        hist.sample()
+        agg = FleetAggregator(
+            peers=[DeadPeer(), InprocPeer("n0", hist)], now=clock
+        )
+        sweep = agg.pull_once()
+        assert sweep["errors"] == 1
+        assert agg.peer_status()["rip"]["stalled_s"] is None
+        assert agg.peer_status()["n0"]["seq"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet SLO: merged quantiles + exemplars over the store
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSlo:
+    def test_fleet_p99_merges_across_nodes_with_exemplar(self):
+        """Two nodes, distinct registries (a real fleet): the fast node
+        dominates the median, the slow node owns the p99 — fleet_slo
+        must report the union quantile and hand back the slow node's
+        traced exemplar for the p99 bucket."""
+        clock = FakeClock()
+        regs, peers, hists = [], [], []
+        for node, (lat, n, tid) in {
+            "fast": (0.002, 200, None),
+            "slow": (4.0, 30, 0xBEEF),
+        }.items():
+            reg = Registry()
+            h = reg.histogram(
+                "radixmesh_request_ttft_seconds", "x", ("tenant",)
+            )
+            for _ in range(n):
+                h.labels(tenant="default").observe(lat, trace_id=tid)
+            hist = TelemetryHistory(
+                interval_s=1.0, node=node, now=clock, registry=reg
+            )
+            hist.sample()
+            regs.append(reg)
+            hists.append(hist)
+            peers.append(InprocPeer(node, hist, registry=reg))
+        agg = FleetAggregator(peers=peers, now=clock)
+        agg.pull_once()
+        slo = agg.fleet_slo()
+        tb = slo["tenants"]["default"]["ttft"]
+        assert tb["count"] == 230
+        assert tb["nodes"] == ["fast", "slow"]
+        # p50 in the fast regime, p99 in the slow node's bucket.
+        assert tb["p50"] <= 0.0025
+        assert tb["p99"] > 2.5
+        ex = tb["p99_exemplar"]
+        assert ex["node"] == "slow"
+        assert ex["trace_id"] == f"{0xBEEF:#018x}"
+
+
+# ---------------------------------------------------------------------------
+# the fleet doctor rules
+# ---------------------------------------------------------------------------
+
+
+def _ingest_rank_series(agg, family, values, t=1000.0, seq=0):
+    agg.store.ingest("router0", {
+        "seq": seq,
+        "interval_s": 1.0,
+        "wall_offset": agg.store.wall_offset,
+        "series": {
+            f'{family}{{rank="{r}"}}': {"points": [[seq, t, v]]}
+            for r, v in values.items()
+        },
+    })
+
+
+class FakeHealthMesh:
+    """The telemetry_gap verdict's gossip seam: rank → health score."""
+
+    def __init__(self, scores):
+        self.fleet = self
+        self._scores = scores
+
+    def health(self):
+        return {r: {"score": s} for r, s in self._scores.items()}
+
+
+class TestFleetDoctorRules:
+    def test_straggler_named_by_rank(self):
+        clock = FakeClock()
+        agg = FleetAggregator(now=clock)
+        _ingest_rank_series(
+            agg, "fleet:decode_ewma_seconds",
+            {4: 0.08, 5: 0.004, 0: 0.0},  # prefill's 0.0 is filtered
+        )
+        doc = MeshDoctor(aggregator=agg)
+        report = doc.diagnose()
+        f = next(
+            f for f in report["findings"] if f["rule"] == "straggler_node"
+        )
+        assert f["evidence"]["rank"] == "4"
+        assert f["evidence"]["signal"] == "decode_ewma"
+        assert f["evidence"]["ratio"] == pytest.approx(20.0)
+        for rule in ("straggler_node", "fleet_burn_slope", "telemetry_gap"):
+            assert rule in report["rules_checked"]
+
+    def test_straggler_silent_on_level_fleet_and_below_floor(self):
+        clock = FakeClock()
+        agg = FleetAggregator(now=clock)
+        # Level fleet: 1.25x spread, under the 3x ratio.
+        _ingest_rank_series(
+            agg, "fleet:decode_ewma_seconds", {4: 0.005, 5: 0.004}
+        )
+        # Microsecond replication lags: 20x spread but under the floor —
+        # sub-5ms "stragglers" are noise, not findings.
+        _ingest_rank_series(
+            agg, "fleet:replication_lag_seconds",
+            {0: 0.000_05, 1: 0.001}, seq=1,
+        )
+        report = MeshDoctor(aggregator=agg).diagnose()
+        assert not [
+            f for f in report["findings"] if f["rule"] == "straggler_node"
+        ]
+
+    def test_straggler_replication_lag_signal(self):
+        clock = FakeClock()
+        agg = FleetAggregator(now=clock)
+        _ingest_rank_series(
+            agg, "fleet:replication_lag_seconds", {0: 0.9, 1: 0.01, 2: 0.02}
+        )
+        f = next(
+            f
+            for f in MeshDoctor(aggregator=agg).diagnose()["findings"]
+            if f["rule"] == "straggler_node"
+        )
+        assert f["evidence"]["rank"] == "0"
+        assert f["evidence"]["signal"] == "replication_lag"
+
+    def _gap_fixture(self, clock):
+        """Two pulled peers; then one sampler stops while the other
+        keeps advancing across 12 virtual seconds of pulls."""
+        live = _mk_history(clock, node="live")
+        dead = _mk_history(clock, node="dead")
+        get_registry().counter("radixmesh_seen_total", "x").inc()
+        live.sample()
+        dead.sample()
+        agg = FleetAggregator(
+            peers=[
+                InprocPeer("live", live, rank=1),
+                InprocPeer("dead", dead, rank=2),
+            ],
+            now=clock,
+        )
+        agg.pull_once()
+        for _ in range(6):
+            clock.advance(2.0)
+            live.sample()  # the live sampler ticks on; the dead one stopped
+            agg.pull_once()
+        return agg
+
+    def test_telemetry_gap_dead_node_verdict(self):
+        clock = FakeClock()
+        agg = self._gap_fixture(clock)
+        doc = MeshDoctor(
+            mesh=FakeHealthMesh({1: 0.95, 2: 0.1}), aggregator=agg
+        )
+        f = next(
+            f
+            for f in doc.diagnose()["findings"]
+            if f["rule"] == "telemetry_gap"
+        )
+        assert f["evidence"]["peer"] == "dead"
+        assert f["evidence"]["rank"] == "2"
+        assert f["evidence"]["verdict"] == "node_dead"
+        assert f["evidence"]["stalled_s"] >= 12.0
+
+    def test_telemetry_gap_sampler_dead_verdict(self):
+        """Gossip still scores the rank healthy → the process is alive,
+        its SAMPLER died — a different pager than a dead node."""
+        clock = FakeClock()
+        agg = self._gap_fixture(clock)
+        doc = MeshDoctor(
+            mesh=FakeHealthMesh({1: 0.95, 2: 0.9}), aggregator=agg
+        )
+        f = next(
+            f
+            for f in doc.diagnose()["findings"]
+            if f["rule"] == "telemetry_gap"
+        )
+        assert f["evidence"]["verdict"] == "sampler_dead"
+
+    def test_telemetry_gap_silent_while_rings_advance(self):
+        clock = FakeClock()
+        live = _mk_history(clock, node="live")
+        live.sample()
+        agg = FleetAggregator(peers=[InprocPeer("live", live, rank=1)],
+                              now=clock)
+        agg.pull_once()
+        clock.advance(2.0)
+        live.sample()
+        agg.pull_once()
+        report = MeshDoctor(aggregator=agg).diagnose()
+        assert not [
+            f for f in report["findings"] if f["rule"] == "telemetry_gap"
+        ]
+
+    def test_fleet_burn_slope_fires_on_aggregated_burn(self):
+        """Per-node shed counters sum across the fleet before the burn
+        judgment: 10% of offered shed against a 1% budget is a 10x burn
+        in both windows."""
+        clock = FakeClock()
+        agg = FleetAggregator(now=clock)
+
+        def feed(seq, admitted, shed):
+            agg.store.ingest("router0", {
+                "seq": seq,
+                "interval_s": 1.0,
+                "wall_offset": agg.store.wall_offset,
+                "series": {
+                    'slo:admitted{tenant="t0"}': {
+                        "points": [[seq, clock.t, float(admitted)]]
+                    },
+                    'slo:shed{tenant="t0"}': {
+                        "points": [[seq, clock.t, float(shed)]]
+                    },
+                },
+            })
+            agg.pull_once()  # zero peers: the sweep just feeds burn
+
+        feed(0, 0, 0)
+        for i in range(1, 7):
+            clock.advance(10.0)
+            feed(i, i * 135, i * 15)  # offered 150/step, 10% shed
+        report = MeshDoctor(aggregator=agg).diagnose()
+        f = next(
+            f
+            for f in report["findings"]
+            if f["rule"] == "fleet_burn_slope"
+        )
+        assert f["evidence"]["tenant"] == "t0"
+        assert f["evidence"]["burn_fast"] == pytest.approx(10.0, rel=0.01)
+        assert f["evidence"]["offered"] >= 20

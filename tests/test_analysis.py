@@ -688,7 +688,7 @@ class TestThreadMapLive:
             "mesh-sender", "mesh-owner-sender", "mesh-ticker", "mesh-gc",
             "mesh-housekeeper", "kv-transfer", "repair-plane",
             "lifecycle-plane", "lifecycle-drain", "engine-runner",
-            "wire-receive", "engine-loop",
+            "wire-receive", "engine-loop", "fleet-aggregator",
         ):
             assert expected in names, f"thread root {expected!r} vanished"
         # Per-connection concurrency is modeled: the HTTP handlers and

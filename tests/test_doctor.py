@@ -472,7 +472,7 @@ class TestDiagnoseContract:
         assert list(report["rules_checked"]) == []
         assert report["inputs"] == {
             "mesh": False, "engine": False, "slo": False,
-            "attribution": False, "history": False,
+            "attribution": False, "history": False, "aggregator": False,
         }
 
     def test_rules_checked_tracks_attached_seams(self):
