@@ -1383,11 +1383,14 @@ def build_analysis_report(
 # rebalancer_asleep rule. v3 (PR 15): it grew tier_thrash (the durable
 # KV tier's flapping detector). v4 (PR 17): it grew the three fleet
 # rules (straggler_node, fleet_burn_slope, telemetry_gap) judged over
-# the FleetAggregator's cross-node store. Artifacts validate against
-# the rule set pinned for THEIR version (see _required_doctor_rules) —
-# a checked-in artifact can never retroactively have run a rule that
-# postdates it.
-DOCTOR_SCHEMA_VERSION = 4
+# the FleetAggregator's cross-node store. v5 (PR 18): it grew the three
+# token-plane rules (decode_stall, spec_misconfigured,
+# goodput_regression) judged over the per-token timeline, the
+# speculation ledger, and the history ring's goodput series. Artifacts
+# validate against the rule set pinned for THEIR version (see
+# _required_doctor_rules) — a checked-in artifact can never
+# retroactively have run a rule that postdates it.
+DOCTOR_SCHEMA_VERSION = 5
 
 DOCTOR_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -1429,6 +1432,9 @@ DOCTOR_RULES_V1 = (
 )
 DOCTOR_RULES_V2 = DOCTOR_RULES_V1 + ("rebalancer_asleep",)
 DOCTOR_RULES_V3 = DOCTOR_RULES_V2 + ("tier_thrash",)
+DOCTOR_RULES_V4 = DOCTOR_RULES_V3 + (
+    "straggler_node", "fleet_burn_slope", "telemetry_gap",
+)
 
 
 def _required_doctor_rules(report, live_rules) -> list[str]:
@@ -1439,6 +1445,8 @@ def _required_doctor_rules(report, live_rules) -> list[str]:
         return [r for r in live_rules if r in DOCTOR_RULES_V2]
     if version == 3:
         return [r for r in live_rules if r in DOCTOR_RULES_V3]
+    if version == 4:
+        return [r for r in live_rules if r in DOCTOR_RULES_V4]
     return list(live_rules)
 
 
@@ -1611,9 +1619,11 @@ def build_doctor_report(res: dict) -> dict:
 # v2 (PR 14): the healthy-phase rules_checked gate grew the
 # rebalancer_asleep rule; v3 (PR 15): tier_thrash; v4 (PR 17): the
 # three fleet rules (the workload arms an in-proc FleetAggregator for
-# its healthy phase). Older artifacts validate against their version's
-# pinned rule set (_required_doctor_rules).
-BLACKBOX_SCHEMA_VERSION = 4
+# its healthy phase); v5 (PR 18): the three token-plane rules
+# (decode_stall, spec_misconfigured, goodput_regression). Older
+# artifacts validate against their version's pinned rule set
+# (_required_doctor_rules).
+BLACKBOX_SCHEMA_VERSION = 5
 
 BLACKBOX_TOP_FIELDS = (
     "schema_version", "metric", "value", "unit", "workload", "nodes",
@@ -2309,6 +2319,183 @@ def build_agg_report(res: dict) -> dict:
 
 
 # ----------------------------------------------------------------------
+# SPEC stable schema (PR 18, the speedometer): one artifact per round
+# recording the speculation/token-plane verdicts — (a) draft-token
+# conservation (proposed == accepted + rejected on every verify path)
+# with accepted-tokens-per-verify-wave broken down BY SHAPE and BY
+# DRAFT SOURCE (tree-peek vs n-gram), (b) per-token ITL percentiles
+# from the bounded timeline ring with a SEEDED stall named by cause,
+# (c) the adaptive-γ controller A-B: acceptance-weighted goodput no
+# worse than the fixed-γ baseline, and (d) the token-timeline sampler's
+# measured overhead under 1% of run wall. scripts/specbench.py is the
+# paired emitter; ROADMAP item 1's gate names this artifact.
+# ----------------------------------------------------------------------
+
+SPEC_SCHEMA_VERSION = 1
+
+SPEC_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload",
+    "acceptance", "itl", "adaptive", "overhead", "wall_s",
+)
+SPEC_ACCEPTANCE_FIELDS = (
+    "performed", "proposed", "accepted", "rejected", "conserved",
+    "accepted_per_step", "waves", "by_shape", "by_source",
+)
+SPEC_ITL_FIELDS = (
+    "performed", "count", "p50_s", "p99_s", "stalls", "stall_seconds",
+    "seeded_cause", "seeded_detected",
+)
+SPEC_ADAPTIVE_FIELDS = (
+    "performed", "gamma_base", "fixed_goodput_tps",
+    "adaptive_goodput_tps", "goodput_ratio", "no_worse",
+    "fixed_acceptance", "adaptive_acceptance",
+)
+SPEC_OVERHEAD_FIELDS = (
+    "tokens", "timeline_on_s", "timeline_off_s", "fraction",
+    "budget_fraction", "under_budget",
+)
+# Adaptive-γ may not cost goodput: the A-B ratio floor (a hair under
+# 1.0 — CPU-tier walltime jitter must not fail a controller that is
+# actually neutral-or-better).
+SPEC_ADAPTIVE_RATIO_FLOOR = 0.85
+SPEC_OVERHEAD_BUDGET = 0.01
+
+
+def validate_spec(report) -> list[str]:
+    """Schema violations of a SPEC artifact vs the pinned contract
+    (empty = valid). Gates: draft-token conservation held on every
+    verify path (proposed == accepted + rejected) with per-shape AND
+    per-draft-source breakdowns present and a positive
+    accepted-per-wave rate; the ITL section saw real tokens and named
+    the seeded stall by cause; the adaptive-γ A-B's acceptance-weighted
+    goodput is no worse than fixed γ (ratio over the pinned floor); and
+    the token-timeline sampler's measured overhead stays under its 1%
+    budget. Sections with performed=False are schema-valid but
+    gate-exempt (the CHAOS convention). Import-safe from artifact tests
+    and scripts/specbench.py (no jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in SPEC_TOP_FIELDS if f not in report]
+    acc = report.get("acceptance")
+    if "acceptance" in report and not isinstance(acc, dict):
+        problems.append("acceptance section is not an object")
+    if isinstance(acc, dict) and acc.get("performed"):
+        problems += [
+            f"acceptance.{f}" for f in SPEC_ACCEPTANCE_FIELDS if f not in acc
+        ]
+        if acc.get("conserved") is not True:
+            problems.append(
+                f"acceptance: conservation broke — proposed "
+                f"{acc.get('proposed')} != accepted {acc.get('accepted')}"
+                f" + rejected {acc.get('rejected')} (a verify path is "
+                "dropping draft tokens from the ledger)"
+            )
+        if not acc.get("proposed", 0):
+            problems.append(
+                "acceptance: zero proposed draft tokens — nothing was "
+                "proven about speculation"
+            )
+        aps = acc.get("accepted_per_step")
+        if isinstance(aps, (int, float)) and not aps > 0:
+            problems.append(
+                f"acceptance: accepted-per-wave {aps} is not > 0 — "
+                "every draft missed; that is a broken drafter, not a "
+                "measured one"
+            )
+        for axis in ("by_shape", "by_source"):
+            ax = acc.get(axis)
+            if isinstance(ax, dict) and not ax:
+                problems.append(
+                    f"acceptance: {axis} is empty — the per-class "
+                    "breakdown is the artifact's reason to exist"
+                )
+    itl = report.get("itl")
+    if "itl" in report and not isinstance(itl, dict):
+        problems.append("itl section is not an object")
+    if isinstance(itl, dict) and itl.get("performed"):
+        problems += [f"itl.{f}" for f in SPEC_ITL_FIELDS if f not in itl]
+        if not itl.get("count", 0):
+            problems.append(
+                "itl: zero timed inter-token gaps — the percentiles "
+                "are vacuous"
+            )
+        if itl.get("seeded_detected") is not True:
+            problems.append(
+                f"itl: the seeded {itl.get('seeded_cause')!r} stall was "
+                "not attributed — stall-cause attribution is the "
+                "timeline's whole point"
+            )
+        p50, p99 = itl.get("p50_s"), itl.get("p99_s")
+        if (
+            isinstance(p50, (int, float))
+            and isinstance(p99, (int, float))
+            and p99 < p50
+        ):
+            problems.append(f"itl: p99 {p99} < p50 {p50}")
+    ad = report.get("adaptive")
+    if "adaptive" in report and not isinstance(ad, dict):
+        problems.append("adaptive section is not an object")
+    if isinstance(ad, dict) and ad.get("performed"):
+        problems += [
+            f"adaptive.{f}" for f in SPEC_ADAPTIVE_FIELDS if f not in ad
+        ]
+        if ad.get("no_worse") is not True:
+            problems.append(
+                f"adaptive: goodput ratio {ad.get('goodput_ratio')} "
+                f"(adaptive/fixed) under the "
+                f"{SPEC_ADAPTIVE_RATIO_FLOOR} floor — the controller "
+                "costs more than it saves"
+            )
+    ov = report.get("overhead")
+    if "overhead" in report and not isinstance(ov, dict):
+        problems.append("overhead section is not an object")
+    if isinstance(ov, dict):
+        problems += [
+            f"overhead.{f}" for f in SPEC_OVERHEAD_FIELDS if f not in ov
+        ]
+        if ov.get("under_budget") is not True:
+            problems.append(
+                f"overhead: timeline cost {ov.get('fraction')} of wall "
+                f"exceeded the {ov.get('budget_fraction')} budget — the "
+                "speedometer may not slow the car"
+            )
+    val = report.get("value")
+    if isinstance(acc, dict) and acc.get("performed"):
+        if not isinstance(val, (int, float)) or not val > 0:
+            problems.append(
+                f"value: accepted tokens per verify wave {val} is not "
+                "> 0"
+            )
+    return problems
+
+
+def build_spec_report(res: dict) -> dict:
+    """Assemble a schema-complete SPEC artifact from
+    ``workload.run_spec_workload``'s result."""
+    acc = res.get("acceptance", {}) or {}
+    return {
+        "schema_version": SPEC_SCHEMA_VERSION,
+        "metric": "spec_accepted_tokens_per_step",
+        "value": acc.get("accepted_per_step"),
+        "unit": (
+            "draft tokens accepted per speculative verify wave, with "
+            "conservation (proposed == accepted + rejected) on every "
+            "verify path, per-shape and per-draft-source breakdowns, "
+            "seeded-stall ITL attribution, adaptive-γ goodput no worse "
+            "than fixed γ, and token-timeline overhead under 1% of wall"
+        ),
+        "workload": (
+            "repetitive + replayed prompts over a tiny CPU model so "
+            "tree-peek and n-gram drafts land; a mid-decode driver "
+            "sleep seeding a scheduler_wait stall; fixed-γ vs "
+            "adaptive-γ A-B on identical seeds; timeline on/off A-B "
+            "for the overhead bound (see workload.run_spec_workload)"
+        ),
+        **res,
+    }
+
+
+# ----------------------------------------------------------------------
 # compare_rounds (PR 12, the bench regression sentinel): schema-aware
 # diffing of any two SAME-schema artifacts. Eleven artifact schemas
 # accumulated over eleven rounds with nothing machine-checking the
@@ -2406,6 +2593,13 @@ COMPARE_RULES: dict = {
         ("fan_in.sweep_s", "lower", 1.0),
         ("percentiles.count", "higher", 0.75),
     ),
+    "SPEC": (
+        ("value", "higher", 0.20),  # accepted draft tokens per verify wave
+        ("acceptance.accepted_per_step", "higher", 0.20),
+        ("adaptive.goodput_ratio", "higher", 0.20),
+        ("itl.p99_s", "lower", 1.0),
+        ("overhead.fraction", "lower", 2.0),
+    ),
     # Kinds with no pinned directional metrics still get the schema
     # check + informational numeric diff.
     "SLO": (),
@@ -2431,6 +2625,7 @@ _METRIC_KINDS = {
     "rebalance_skew_drop_ratio": "REBALANCE",
     "tier_hit_rate_gain": "TIER",
     "agg_fleet_verdicts_named": "AGG",
+    "spec_accepted_tokens_per_step": "SPEC",
     "slo_goodput_vs_offered_load": "SLO",
     "soak_requests": "SOAK",
 }
@@ -2620,8 +2815,8 @@ def benchdiff_selfcheck() -> dict:
     deterministic (no checked-in files needed): an identical artifact
     pair must compare clean, a synthetically regressed copy must flag,
     and a cross-kind pair must refuse as a schema mismatch — proven for
-    the CHAOS, BLACKBOX, TIER, and AGG schemas, so every pinned rule
-    table a sentinel relies on has a demonstrated trigger.
+    the CHAOS, BLACKBOX, TIER, AGG, and SPEC schemas, so every pinned
+    rule table a sentinel relies on has a demonstrated trigger.
     The DOCTOR artifact carries the result (``validate_doctor`` gates
     the three headline fields) — a sentinel nobody proved can still
     fire is not a sentinel."""
@@ -2679,6 +2874,21 @@ def benchdiff_selfcheck() -> dict:
         # One lost fleet verdict: the zero-threshold value rule must flag.
         "value": AGG_NAMED_TOTAL - 1,
     }
+    spec_base = {
+        "metric": "spec_accepted_tokens_per_step",
+        "schema_version": SPEC_SCHEMA_VERSION,
+        "value": 1.6,
+        "acceptance": {"accepted_per_step": 1.6},
+        "adaptive": {"goodput_ratio": 1.02},
+        "itl": {"p99_s": 0.004},
+        "overhead": {"fraction": 0.003},
+    }
+    spec_regressed = {
+        **spec_base,
+        # Acceptance nearly halved: past the 20% threshold.
+        "value": 0.9,
+        "acceptance": {"accepted_per_step": 0.9},
+    }
     identical = compare_rounds(base, dict(base), kind="CHAOS")
     regression = compare_rounds(base, regressed, kind="CHAOS")
     mismatch = compare_rounds(base, other_kind)
@@ -2691,11 +2901,15 @@ def benchdiff_selfcheck() -> dict:
     a_identical = compare_rounds(agg_base, dict(agg_base), kind="AGG")
     a_regression = compare_rounds(agg_base, agg_regressed, kind="AGG")
     a_mismatch = compare_rounds(agg_base, base)
+    s_identical = compare_rounds(spec_base, dict(spec_base), kind="SPEC")
+    s_regression = compare_rounds(spec_base, spec_regressed, kind="SPEC")
+    s_mismatch = compare_rounds(spec_base, base)
     return {
         "identical_clean": identical["status"] == "clean"
         and bb_identical["status"] == "clean"
         and t_identical["status"] == "clean"
-        and a_identical["status"] == "clean",
+        and a_identical["status"] == "clean"
+        and s_identical["status"] == "clean",
         "regression_flagged": regression["status"] == "regression"
         and "repair.converge_s" in regression["regressions"]
         and bb_regression["status"] == "regression"
@@ -2703,16 +2917,20 @@ def benchdiff_selfcheck() -> dict:
         and t_regression["status"] == "regression"
         and "cold_start.corrupt_served" in t_regression["regressions"]
         and a_regression["status"] == "regression"
-        and "value" in a_regression["regressions"],
+        and "value" in a_regression["regressions"]
+        and s_regression["status"] == "regression"
+        and "acceptance.accepted_per_step" in s_regression["regressions"],
         "mismatch_detected": mismatch["status"] == "schema_mismatch"
         and bb_mismatch["status"] == "schema_mismatch"
         and t_mismatch["status"] == "schema_mismatch"
-        and a_mismatch["status"] == "schema_mismatch",
-        "kinds_covered": ["CHAOS", "BLACKBOX", "TIER", "AGG"],
+        and a_mismatch["status"] == "schema_mismatch"
+        and s_mismatch["status"] == "schema_mismatch",
+        "kinds_covered": ["CHAOS", "BLACKBOX", "TIER", "AGG", "SPEC"],
         "regressions_seen": regression["regressions"]
         + bb_regression["regressions"]
         + t_regression["regressions"]
-        + a_regression["regressions"],
+        + a_regression["regressions"]
+        + s_regression["regressions"],
     }
 
 

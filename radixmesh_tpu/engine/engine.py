@@ -105,6 +105,7 @@ class EngineStats:
     preemptions: int = 0
     spec_proposed: int = 0  # draft tokens offered for verification
     spec_accepted: int = 0  # draft tokens accepted (KV kept, step skipped)
+    spec_rejected: int = 0  # draft tokens rejected by verification
     resurrections: int = 0  # resume-mode admissions (crash recovery)
     replayed_tokens: int = 0  # already-delivered tokens re-prefilled
     replayed_cached_tokens: int = 0  # ... of which the cache served
@@ -143,6 +144,9 @@ class Engine:
         decode_steps_per_launch: int = 1,
         spec_decode_tokens: int = 0,
         spec_ngram: int = 3,
+        spec_adaptive: bool = False,
+        token_timeline_capacity: int = 4096,
+        token_stall_threshold_s: float = 0.05,
         kv_quant: str | None = None,
         weight_quant: str | None = None,
         device_mesh=None,
@@ -474,6 +478,12 @@ class Engine:
             "draft tokens accepted by speculative verification",
             ("engine",),
         ).labels(**lbl)
+        self._m_spec_rejected = reg.counter(
+            "radixmesh_engine_spec_rejected_tokens_total",
+            "draft tokens rejected by speculative verification "
+            "(conservation: proposed == accepted + rejected)",
+            ("engine",),
+        ).labels(**lbl)
         self._m_ttft = reg.histogram(
             "radixmesh_engine_ttft_seconds",
             "submit-to-first-token latency",
@@ -523,6 +533,32 @@ class Engine:
         # counters above flatten away. Scheduler-thread-only writes
         # (both spec sites run inside _decode_spec).
         self._spec_shape: dict[str, list[int]] = {}
+        # Token-level speed plane (obs/token_timeline.py): the per-token
+        # ITL ring + stall attribution, the per-class speculation ledger
+        # (and its adaptive-γ controller, off unless spec_adaptive), and
+        # the per-tenant goodput decomposition. The timeline/goodput pair
+        # keeps the one-branch-when-off contract in _consume_token; the
+        # ledger always exists — spec counting must stay conserved
+        # whether or not anyone is watching.
+        from radixmesh_tpu.obs.token_timeline import (
+            GoodputLedger, SpecLedger, TokenTimeline,
+        )
+
+        self.spec_ledger = SpecLedger(adaptive=spec_adaptive, node=self.name)
+        self.timeline = None
+        self.goodput = None
+        if token_timeline_capacity > 0:
+            self.timeline = TokenTimeline(
+                capacity=token_timeline_capacity,
+                stall_threshold_s=token_stall_threshold_s,
+                node=self.name,
+            )
+            self.goodput = GoodputLedger(node=self.name)
+        # Stall-attribution hints: the instant the last prefill wave
+        # launched (prefill_convoy), and a one-shot cause latch external
+        # planes set via hint_stall() (rebalance_handoff).
+        self._last_prefill_t = 0.0
+        self._stall_hint: str | None = None
         # Request-flight tracing lane for engine-scope (not per-request)
         # events: evictions, preemption sweeps (obs/trace_plane.py).
         self._trace_lane = f"engine:{self.name}"
@@ -1316,6 +1352,9 @@ class Engine:
         req.row = row
 
         self.stats.prefills += 1
+        # Stall attribution (obs/token_timeline.py): a decode gap that
+        # spans this instant is a prefill convoy, not a scheduler stall.
+        self._last_prefill_t = time.monotonic()
         self.stats.prompt_tokens += len(req.prompt)
         self.stats.cached_tokens += reuse
         self._m_prompt.inc(len(req.prompt))
@@ -1450,6 +1489,12 @@ class Engine:
             req.first_token_time = now
             req.output_tokens = [int(tok)]
             self._tokens[req.row] = int(tok)
+            # The ITL clock starts HERE: the first token's latency is
+            # TTFT, so the timeline's first gap is token 1 → token 2 —
+            # but the first token is still useful output.
+            if self.goodput is not None:
+                req.last_token_time = now
+                self.goodput.note_token(req.tenant)
             self._record_first_token(req)
             # Wake streamers parked on the request condition: this is
             # THE first-token site, and the next _consume_token notify
@@ -1814,17 +1859,17 @@ class Engine:
             # Draft BEFORE committing to the wide verify launch: when no
             # row's history repeats its tail there is nothing to verify,
             # and the plain/fused path emits the same tokens cheaper.
-            drafts = {
-                row: (
-                    self._draft_for(req)
-                    if self._spec_row_ok(req, g)
-                    else req.prompt[:0]
-                )
-                for row, req in enumerate(self._rows)
-                if req is not None
-            }
+            drafts: dict[int, np.ndarray] = {}
+            sources: dict[int, str] = {}
+            for row, req in enumerate(self._rows):
+                if req is None:
+                    continue
+                if self._spec_row_ok(req, g):
+                    drafts[row], sources[row] = self._draft_for(req)
+                else:
+                    drafts[row], sources[row] = req.prompt[:0], "none"
             if any(len(d) for d in drafts.values()):
-                self._decode_spec_once(g, drafts)
+                self._decode_spec_once(g, drafts, sources)
                 return
         k = self.decode_steps_per_launch
         if k > 1:
@@ -2156,7 +2201,19 @@ class Engine:
     # inter-launch critical path of a 32k-token generation.
     _SPEC_WINDOW = 1024
 
-    def _draft_for(self, req: Request) -> np.ndarray:
+    def _draft_for(self, req: Request) -> tuple[np.ndarray, str]:
+        """Returns ``(draft, source)`` — source ∈ DRAFT_SOURCES, the
+        speculation ledger's per-class key (tree drafts and n-gram
+        drafts have very different acceptance profiles, and tuning γ on
+        their blend hides which drafter is actually paying)."""
+        # γ for this request's class: the configured window, shrunk or
+        # regrown per (tenant, shape) by the acceptance-adaptive
+        # controller when --spec-adaptive is on (clamped to [1, base];
+        # base 0 — including the SLO tier-1 spec-off — always wins).
+        gamma = self.spec_ledger.gamma_for(
+            req.tenant, shape_bucket(len(req.prompt)),
+            self.spec_decode_tokens,
+        )
         hist = self._sequence_key(req, req.kv_len + 1)
         # Best drafter first: the radix tree itself. A replayed
         # conversation (same prompt served before) finds the PREVIOUS
@@ -2171,13 +2228,14 @@ class Engine:
         if req.tree_draft_ok and req.prefix_len >= max(
             0, len(req.prompt) - self.page_size
         ):
-            cont = self.tree.peek_continuation(hist, self.spec_decode_tokens)
+            cont = self.tree.peek_continuation(hist, gamma)
             if len(cont):
-                return cont
+                return cont, "tree"
             req.tree_draft_ok = False
-        return self._ngram_draft(
-            hist[-self._SPEC_WINDOW :], self.spec_decode_tokens, self.spec_ngram
+        draft = self._ngram_draft(
+            hist[-self._SPEC_WINDOW :], gamma, self.spec_ngram
         )
+        return draft, ("ngram" if len(draft) else "none")
 
     @staticmethod
     def _ngram_draft(hist: np.ndarray, gamma: int, n: int) -> np.ndarray:
@@ -2226,7 +2284,12 @@ class Engine:
             self._preempt(req)
         return [(row, r) for row, r in enumerate(self._rows) if r is not None]
 
-    def _decode_spec_once(self, g: int, drafts: dict[int, np.ndarray]) -> None:
+    def _decode_spec_once(
+        self,
+        g: int,
+        drafts: dict[int, np.ndarray],
+        sources: dict[int, str] | None = None,
+    ) -> None:
         """One speculative launch: verify [fed_token, draft…] (C=γ+1
         positions per row) in a single ``prefill_chunk_paged`` call, then
         accept per row via ``spec_verify_sample`` — greedy rows take the
@@ -2280,6 +2343,13 @@ class Engine:
             sl[row, :w] = pt[row, pos[:w] // ps] * ps + pos[:w] % ps
             kvlen[row] = req.kv_len + w
             draft_len[row] = len(draft)
+            # Conservation contract: a draft counts as PROPOSED here iff
+            # its row survives to the verify below — every proposed
+            # token is then accounted accepted or rejected in the
+            # accept loop, so proposed == accepted + rejected holds on
+            # every path (drafts of rows preempted by _provision_rows
+            # above were never proposed). The per-class ledger rides the
+            # same two sites, so it cannot undercount either.
             self.stats.spec_proposed += len(draft)
             self._m_spec_proposed.inc(len(draft))
             if len(draft):
@@ -2317,13 +2387,30 @@ class Engine:
         for row, req in active:
             draft = drafts[row]
             a = int(accept_len[row])
+            rejected = len(draft) - a
             self.stats.spec_accepted += a
+            self.stats.spec_rejected += rejected
             self._m_spec_accepted.inc(a)
+            if rejected:
+                self._m_spec_rejected.inc(rejected)
+            # Rejected tail: the gap before this row's NEXT token
+            # includes re-decoding it — the spec_verify_miss stall
+            # attribution (consumed by _stall_cause).
+            req.spec_miss = rejected
             if a:
                 cell = self._spec_shape.setdefault(
                     shape_bucket(len(req.prompt)), [0, 0]
                 )
                 cell[1] += a
+            if len(draft):
+                self.spec_ledger.note_wave(
+                    req.tenant,
+                    shape_bucket(len(req.prompt)),
+                    sources.get(row, "ngram") if sources else "ngram",
+                    len(draft),
+                    a,
+                    len(draft),
+                )
             base = req.kv_len
             for i in range(a + 1):  # a accepted drafts + 1 bonus token
                 pos = base + i
@@ -2351,6 +2438,57 @@ class Engine:
                     accepted_tokens=int(accept_len[row]),
                 )
 
+    def hint_stall(self, cause: str) -> None:
+        """One-shot stall-cause latch for external planes: the next
+        over-threshold inter-token gap is attributed to ``cause``
+        instead of the engine's own inference. The rebalance executor
+        latches ``rebalance_handoff`` here while an ownership move
+        drains this node's shard."""
+        from radixmesh_tpu.obs.token_timeline import STALL_CAUSES
+
+        if cause not in STALL_CAUSES:
+            raise ValueError(f"unknown stall cause {cause!r}")
+        self._stall_hint = cause
+
+    def _stall_cause(self, req: Request, now: float, gap_s: float) -> str:
+        """Attribute one over-threshold inter-token gap to the single
+        most likely cause, in the taxonomy's priority order (see
+        obs/token_timeline.py::STALL_CAUSES)."""
+        hint = self._stall_hint
+        if hint is not None:
+            self._stall_hint = None
+            return hint
+        if self._restoring:
+            return "restore_park"
+        if now - self._last_prefill_t <= gap_s:
+            # A prefill wave launched inside the gap: the decode convoy.
+            return "prefill_convoy"
+        if req.spec_miss:
+            req.spec_miss = 0
+            return "spec_verify_miss"
+        return "scheduler_wait"
+
+    def _note_token_time(self, req: Request) -> None:
+        """Per-emitted-token timeline/goodput accounting. The FIRST
+        token of a request only stamps the clock (its latency is TTFT,
+        not ITL); every later token records its inter-token gap, with
+        over-threshold gaps attributed to a stall cause."""
+        now = time.monotonic()
+        prev = req.last_token_time
+        req.last_token_time = now
+        self.goodput.note_token(req.tenant)
+        if not prev:
+            return
+        gap = now - prev
+        cause = None
+        if gap >= self.timeline.stall_threshold_s:
+            cause = self._stall_cause(req, now, gap)
+            self.goodput.note_stall(req.tenant, gap)
+        self.timeline.note_token(
+            req.rid, req.tenant, gap, cause,
+            trace_id=getattr(req.trace, "trace_id", None), now=now,
+        )
+
     def _consume_token(self, req: Request, row: int, slot: int, token: int) -> bool:
         """Account one decode iteration for ``req``: the fed token's KV
         landed at ``slot``, ``token`` was sampled. Returns True when the
@@ -2361,6 +2499,8 @@ class Engine:
         req.kv_len += 1
         req.output_tokens.append(token)
         self.stats.generated_tokens += 1
+        if self.timeline is not None:  # one branch when off (PR 2 contract)
+            self._note_token_time(req)
         if req.is_finished_by(token) or req.num_tokens >= self.max_seq_len:
             # Don't count the terminal token as output if it's a stop.
             if token in req.sampling.stop_token_ids:
@@ -2416,6 +2556,10 @@ class Engine:
         self._release(req)
         req.state = RequestState.QUEUED
         req.output_tokens = []
+        # Token-timeline clock resets with the life: the retry's first
+        # token is TTFT again, not a giant inter-token gap.
+        req.last_token_time = 0.0
+        req.spec_miss = 0
         req.kv_len = 0
         req.prefix_len = 0
         req.token_slots = np.empty(0, dtype=np.int32)

@@ -109,6 +109,15 @@ class Request:
     tree_draft_ok: bool = True
     submit_time: float = 0.0
     first_token_time: float = 0.0
+    # -- token timeline (radixmesh_tpu/obs/token_timeline.py) --
+    # Monotonic stamp of the last emitted token (0 = none yet this
+    # life): the inter-token-latency clock. Reset by Engine._preempt so
+    # a requeued life's first token reads as TTFT, not a huge gap.
+    last_token_time: float = 0.0
+    # Draft tokens the LAST speculative wave rejected for this row:
+    # the spec_verify_miss stall attribution, consumed (zeroed) by
+    # Engine._stall_cause.
+    spec_miss: int = 0
 
     # -- request-flight tracing (radixmesh_tpu/obs/trace_plane.py) --
     # TraceContext when this request won the sampling coin flip, else

@@ -270,6 +270,13 @@ def _run_node(args: argparse.Namespace) -> int:
             host_cache_slots=int(model.get("host_cache_slots", 0)),
             decode_steps_per_launch=int(model.get("decode_steps_per_launch", 1)),
             spec_decode_tokens=int(model.get("spec_decode_tokens", 0)),
+            spec_adaptive=bool(model.get("spec_adaptive", False)),
+            token_timeline_capacity=int(
+                model.get("token_timeline_capacity", 4096)
+            ),
+            token_stall_threshold_s=float(
+                model.get("token_stall_threshold_s", 0.05)
+            ),
             kv_quant=model.get("kv_quant"),
             weight_quant=model.get("weight_quant"),
             mesh=node,
@@ -563,6 +570,9 @@ def _run_serve(args: argparse.Namespace) -> int:
         host_cache_slots=args.host_cache_slots,
         decode_steps_per_launch=args.decode_steps_per_launch,
         spec_decode_tokens=args.spec_decode_tokens,
+        spec_adaptive=args.spec_adaptive,
+        token_timeline_capacity=args.token_timeline_capacity,
+        token_stall_threshold_s=args.token_stall_threshold_ms / 1e3,
         kv_quant=args.kv_quant,
         weight_quant=args.weight_quant,
         kv_transfer_async=args.kv_transfer_async,
@@ -926,6 +936,26 @@ def main(argv: list[str] | None = None) -> int:
         help="speculative decoding: draft up to N tokens by prompt lookup "
         "and verify them in one chunked pass (greedy rows by argmax-prefix, "
         "sampled rows by exact rejection sampling)",
+    )
+    serve.add_argument(
+        "--spec-adaptive", action="store_true",
+        help="acceptance-adaptive draft width: per-(tenant, shape) γ "
+        "shrinks where the speculation ledger's acceptance EWMA misses "
+        "its floor and regrows where it clears the ceiling, clamped to "
+        "[1, --spec-decode-tokens] (off by default; inert unless "
+        "--spec-decode-tokens > 0)",
+    )
+    serve.add_argument(
+        "--token-timeline-capacity", type=int, default=4096,
+        help="bounded per-token ITL ring entries for /debug/tokens "
+        "(change-compressed, drop-oldest; 0 disables the token "
+        "timeline and the goodput ledger entirely)",
+    )
+    serve.add_argument(
+        "--token-stall-threshold-ms", type=float, default=50.0,
+        help="inter-token gap above which the timeline attributes a "
+        "stall to a cause (restore park, prefill convoy, rebalance "
+        "handoff, spec-verify miss, scheduler wait)",
     )
     serve.add_argument(
         "--slo", action="store_true",

@@ -492,13 +492,16 @@ class FleetAggregator:
 
     def fleet_slo(self, quantiles=(0.5, 0.99)) -> dict:
         """The ``GET /cluster/slo`` body: per tenant, the TRUE fleet
-        quantiles of TTFT and e2e — bucket counts summed across nodes,
-        quantile interpolated inside the merged distribution — each
-        with the exemplar (trace id + node) of its selected bucket."""
+        quantiles of TTFT, e2e, and inter-token latency — bucket counts
+        summed across nodes, quantile interpolated inside the merged
+        distribution — each with the exemplar (trace id + node) of its
+        selected bucket, plus the per-tenant speculation acceptance
+        panel folded from the ``radixmesh_spec_*`` families."""
         out: dict[str, dict] = {}
         for metric, family in (
             ("ttft", "radixmesh_request_ttft_seconds"),
             ("e2e", "radixmesh_request_e2e_seconds"),
+            ("itl", "radixmesh_token_itl_seconds"),
         ):
             q = self.store.query(family=family + "_bucket", limit=1)
             # (tenant, node) → {le: cumulative count}
@@ -529,6 +532,7 @@ class FleetAggregator:
                     ex = self._find_exemplar(family, tenant, le, bounds)
                     if ex is not None:
                         ent[f"{key}_exemplar"] = ex
+        self._fold_spec_panel(out)
         with self._lock:
             last_sweep = self._last_sweep_t
         return {
@@ -537,6 +541,72 @@ class FleetAggregator:
             "peers": self.peer_status(),
             "last_sweep_t": round(last_sweep, 6),
         }
+
+    def _fold_spec_panel(self, out: dict[str, dict]) -> None:
+        """Per-tenant speculation acceptance across the fleet (PR 18's
+        token-speed plane): for every (tenant, shape, draft-source)
+        class, the freshest acceptance EWMA and γ-used per node plus
+        proposed/accepted totals SUMMED across nodes — so
+        ``/cluster/slo`` answers "is speculation paying for tenant X"
+        without a per-node walk. Classes land under
+        ``tenants[t]["spec"]["classes"]["shape/source"]``."""
+        # (tenant, shape, source) → {"ewma": (seq, val), sums…}
+        cells: dict[tuple[str, str, str], dict] = {}
+
+        def _fold(family: str, key: str, freshest: bool):
+            q = self.store.query(family=family, limit=1)
+            for name, s in q["series"].items():
+                labels = _parse_labels(name)
+                tenant = labels.get("tenant")
+                shape = labels.get("shape")
+                source = labels.get("source")
+                last = s.get("last")
+                if tenant is None or shape is None or last is None:
+                    continue
+                seq, val = last
+                if val is None:
+                    continue
+                cell = cells.setdefault(
+                    (tenant, shape, source or "?"), {}
+                )
+                if freshest:
+                    prev = cell.get(key)
+                    if prev is None or seq > prev[0]:
+                        cell[key] = (seq, float(val))
+                else:
+                    cell[key] = cell.get(key, 0.0) + float(val)
+
+        _fold("radixmesh_spec_accept_ratio", "ewma", freshest=True)
+        _fold("radixmesh_spec_gamma_used_tokens", "gamma", freshest=True)
+        _fold("radixmesh_spec_proposed_tokens_total", "proposed", freshest=False)
+        _fold("radixmesh_spec_accepted_tokens_total", "accepted", freshest=False)
+        for (tenant, shape, source), cell in sorted(cells.items()):
+            panel = out.setdefault(tenant, {}).setdefault(
+                "spec", {"classes": {}}
+            )
+            proposed = cell.get("proposed", 0.0)
+            accepted = cell.get("accepted", 0.0)
+            panel["classes"][f"{shape}/{source}"] = {
+                "accept_ewma": (
+                    round(cell["ewma"][1], 4) if "ewma" in cell else None
+                ),
+                "gamma_tokens": (
+                    cell["gamma"][1] if "gamma" in cell else None
+                ),
+                "proposed": int(proposed),
+                "accepted": int(accepted),
+            }
+        # One headline rate per tenant: acceptance weighted by proposal
+        # volume (an EWMA mean would overweight idle classes).
+        for tenant, sigs in out.items():
+            panel = sigs.get("spec")
+            if not panel:
+                continue
+            p = sum(c["proposed"] for c in panel["classes"].values())
+            a = sum(c["accepted"] for c in panel["classes"].values())
+            panel["proposed"] = p
+            panel["accepted"] = a
+            panel["accept_rate"] = round(a / p, 4) if p else None
 
     def _find_exemplar(
         self, family: str, tenant: str, le: str | None, bounds

@@ -77,6 +77,12 @@ RULES = (
     "straggler_node",
     "fleet_burn_slope",
     "telemetry_gap",
+    # Token-plane rules (PR 18): judged over the engine's per-token
+    # timeline / speculation ledger and the history ring's goodput
+    # series.
+    "decode_stall",
+    "spec_misconfigured",
+    "goodput_regression",
 )
 
 # The pinned evidence vocabulary per rule: every finding MUST carry at
@@ -114,6 +120,15 @@ RULE_EVIDENCE_FIELDS = {
     ),
     "telemetry_gap": (
         "peer", "rank", "stalled_s", "peer_seq", "verdict",
+    ),
+    "decode_stall": (
+        "cause", "stalls", "stall_seconds", "p99_itl_s", "threshold_s",
+    ),
+    "spec_misconfigured": (
+        "tenant", "shape", "source", "gamma", "accept_ewma", "proposed",
+    ),
+    "goodput_regression": (
+        "recent_tps", "baseline_tps", "drop_frac", "window_s",
     ),
 }
 
@@ -192,6 +207,23 @@ class DoctorConfig:
     # before it counts as stalled (the aggregator's per-peer
     # cadence-scaled threshold also applies — whichever is larger).
     telemetry_gap_s: float = 5.0
+    # decode_stall: minimum attributed stall events before the token
+    # timeline's dominant cause is worth a finding (a handful of gaps
+    # is jitter, not a pathology).
+    decode_stall_min_events: int = 10
+    # spec_misconfigured: a (tenant, shape, draft-source) class whose
+    # acceptance EWMA sits under spec_accept_floor while γ stays wide —
+    # judged only with enough proposals, and only when γ was NOT zeroed
+    # by the SLO degradation ladder (that is policy, not mistuning).
+    spec_misconfig_min_proposed: int = 50
+    # goodput_regression: recent-window mean of the history ring's
+    # goodput:tokens_per_second at least regress_frac below the
+    # preceding baseline window's mean (floored so an idle engine's
+    # near-zero throughput never reads as a collapse).
+    goodput_regress_frac: float = 0.3
+    goodput_recent_window_s: float = 60.0
+    goodput_baseline_window_s: float = 300.0
+    goodput_min_tps: float = 1.0
 
 
 @dataclass
@@ -1018,6 +1050,143 @@ class MeshDoctor:
             },
         )
 
+    def _rule_decode_stall(self) -> Finding | None:
+        """Token-timeline stall histogram: enough attributed inter-token
+        gaps over the stall threshold, with the DOMINANT cause named —
+        this is the per-token refinement of restore_park_stall (which
+        sees parks, not the gap each park put into someone's stream)."""
+        eng = self.engine
+        tl = getattr(eng, "timeline", None) if eng is not None else None
+        if tl is None:
+            return None
+        snap = tl.snapshot(limit=0)
+        stalls = snap.get("stalls") or {}
+        total = sum(stalls.values())
+        if total < self.cfg.decode_stall_min_events:
+            return None
+        cause = max(stalls, key=stalls.get)
+        stall_s = float((snap.get("stall_seconds") or {}).get(cause, 0.0))
+        p99 = max(
+            (t.get("p99_s") or 0.0 for t in snap.get("itl", {}).values()),
+            default=0.0,
+        )
+        return Finding(
+            "decode_stall",
+            min(1.0, 0.3 + 0.05 * stall_s + min(0.3, total / 200.0)),
+            f"{total} decode stalls (>{snap['stall_threshold_s'] * 1e3:.0f}ms "
+            f"inter-token gap), dominant cause {cause!r} "
+            f"({stalls[cause]} events, {stall_s:.2f}s of stream time); "
+            f"worst tenant p99 ITL {p99 * 1e3:.1f}ms",
+            {
+                "cause": cause,
+                "stalls": total,
+                "stall_seconds": round(stall_s, 3),
+                "p99_itl_s": round(p99, 6),
+                "threshold_s": snap["stall_threshold_s"],
+            },
+        )
+
+    def _rule_spec_misconfigured(self) -> Finding | None:
+        """γ and acceptance diverge: a (tenant, shape, draft-source)
+        class keeps proposing wide waves whose EWMA acceptance sits
+        under the floor. Distinct from spec_efficiency (raw per-shape
+        counters): this judges the LEDGER's smoothed per-class view and
+        stays silent when the SLO ladder zeroed γ on purpose."""
+        eng = self.engine
+        led = getattr(eng, "spec_ledger", None) if eng is not None else None
+        if led is None:
+            return None
+        if getattr(eng, "spec_decode_tokens", 0) <= 0:
+            return None  # speculation is off — nothing to mis-tune
+        if getattr(led, "last_tier", 0) >= 1:
+            return None  # γ zeroed by SLO policy, not by mistuning
+        cfg = self.cfg
+        worst = None
+        for c in led.report().values():
+            if c["proposed"] < cfg.spec_misconfig_min_proposed:
+                continue
+            ewma = c.get("accept_ewma")
+            if ewma is None or ewma >= cfg.spec_accept_floor:
+                continue
+            if c.get("gamma_used", 0) <= 1:
+                continue  # already at the narrowest useful γ
+            cand = (cfg.spec_accept_floor - ewma, c)
+            if worst is None or cand[0] > worst[0]:
+                worst = cand
+        if worst is None:
+            return None
+        gap, c = worst
+        return Finding(
+            "spec_misconfigured",
+            min(1.0, 0.3 + gap),
+            f"class {c['tenant']}/{c['shape']}/{c['source']} runs "
+            f"γ={c['gamma_used']} while EWMA acceptance is "
+            f"{c['accept_ewma']:.0%} (floor "
+            f"{cfg.spec_accept_floor:.0%}, {c['proposed']} proposed) — "
+            "shrink γ for that class or enable --spec-adaptive",
+            {
+                "tenant": c["tenant"],
+                "shape": c["shape"],
+                "source": c["source"],
+                "gamma": c["gamma_used"],
+                "accept_ewma": c["accept_ewma"],
+                "proposed": c["proposed"],
+            },
+        )
+
+    def _rule_goodput_regression(self) -> Finding | None:
+        """The history ring's ``goodput:tokens_per_second`` series in
+        the trailing recent window fell regress_frac below the preceding
+        baseline window — useful throughput collapsed while the fleet is
+        still up (the waste decomposition in /debug/tokens says where it
+        went)."""
+        hist = self.history
+        if hist is None:
+            return None
+        try:
+            q = hist.query(family="goodput:tokens_per_second", limit=100000)
+            s = q["series"].get("goodput:tokens_per_second")
+        except Exception:  # noqa: BLE001 — a broken seam is silence, not a crash
+            return None
+        if s is None:
+            return None
+        pts = [(p[1], float(p[2])) for p in s["points"]]
+        if len(pts) < 2:
+            return None
+        cfg = self.cfg
+        now = pts[-1][0]
+        recent = [v for t, v in pts if t >= now - cfg.goodput_recent_window_s]
+        base = [
+            v
+            for t, v in pts
+            if now - cfg.goodput_baseline_window_s
+            <= t
+            < now - cfg.goodput_recent_window_s
+        ]
+        if not recent or not base:
+            return None
+        r = sum(recent) / len(recent)
+        b = sum(base) / len(base)
+        if b < cfg.goodput_min_tps:
+            return None  # idle baseline: nothing to regress from
+        drop = (b - r) / b
+        if drop < cfg.goodput_regress_frac:
+            return None
+        return Finding(
+            "goodput_regression",
+            min(1.0, 0.3 + drop),
+            f"useful throughput fell {drop:.0%}: {r:.1f} tok/s over the "
+            f"last {cfg.goodput_recent_window_s:.0f}s vs {b:.1f} tok/s "
+            "baseline — check the /debug/tokens waste decomposition "
+            "(padding vs rejected drafts vs stalls)",
+            {
+                "recent_tps": round(r, 3),
+                "baseline_tps": round(b, 3),
+                "drop_frac": round(drop, 4),
+                "window_s": cfg.goodput_recent_window_s,
+            },
+        )
+
     # -- the diagnosis -------------------------------------------------
 
     def diagnose(self) -> dict:
@@ -1035,6 +1204,9 @@ class MeshDoctor:
             "straggler_node": self._rule_straggler_node,
             "fleet_burn_slope": self._rule_fleet_burn_slope,
             "telemetry_gap": self._rule_telemetry_gap,
+            "decode_stall": self._rule_decode_stall,
+            "spec_misconfigured": self._rule_spec_misconfigured,
+            "goodput_regression": self._rule_goodput_regression,
         }
         # Seam presence per rule: a rule whose inputs are absent never
         # looked at anything, so it must NOT appear in rules_checked —
@@ -1061,6 +1233,12 @@ class MeshDoctor:
             "straggler_node": self.aggregator is not None,
             "fleet_burn_slope": self.aggregator is not None,
             "telemetry_gap": self.aggregator is not None,
+            # Token-plane rules: the timeline/ledger hang off the
+            # engine; the goodput series rides the history ring (so a
+            # frontend sampling a remote registry can still run it).
+            "decode_stall": self.engine is not None,
+            "spec_misconfigured": self.engine is not None,
+            "goodput_regression": self.history is not None,
         }
         findings: list[Finding] = []
         checked: list[str] = []

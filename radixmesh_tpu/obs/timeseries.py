@@ -65,7 +65,7 @@ __all__ = ["TelemetryHistory", "DERIVED_PREFIXES", "BUCKET_FAMILIES"]
 # namespace on purpose: these are *readings of other planes' reports*
 # (fleet fold, heat map, step accounting, SLO counters), not registered
 # families — a collision would double-count a real series.
-DERIVED_PREFIXES = ("fleet:", "shard:", "step:", "slo:")
+DERIVED_PREFIXES = ("fleet:", "shard:", "step:", "slo:", "goodput:")
 
 # Histogram families sampled WITH their cumulative per-bucket counts
 # (``Registry.snapshot(bucket_families=...)``): the per-tenant request
@@ -77,6 +77,12 @@ DERIVED_PREFIXES = ("fleet:", "shard:", "step:", "slo:")
 BUCKET_FAMILIES = (
     "radixmesh_request_ttft_seconds",
     "radixmesh_request_e2e_seconds",
+    # Per-tenant inter-token latency (obs/token_timeline.py): token-
+    # cadence observations, but the RING only pays per bucket-count
+    # CHANGE per sample tick — steady decode moves one or two buckets
+    # per second, the same cost profile as the request families under
+    # load. Fleet ITL percentiles merge these in obs/aggregator.py.
+    "radixmesh_token_itl_seconds",
 )
 
 
@@ -329,6 +335,28 @@ class TelemetryHistory:
                         snap[f'step:waves{{kind="{kind}"}}'] = float(
                             k["waves"]
                         )
+            except Exception:  # noqa: BLE001 — seam isolation
+                pass
+        gp = getattr(eng, "goodput", None) if eng is not None else None
+        if gp is not None:
+            try:
+                rep = gp.report(
+                    step_acct=acct, spec=getattr(eng, "spec_ledger", None)
+                )
+                snap["goodput:tokens_per_second"] = float(
+                    rep["tokens_per_second"]
+                )
+                for tenant, t in rep["tenants"].items():
+                    snap[
+                        f'goodput:tokens_per_second{{tenant="{tenant}"}}'
+                    ] = float(t["tokens_per_second"])
+                    snap[
+                        f'goodput:stall_seconds{{tenant="{tenant}"}}'
+                    ] = float(t["stall_seconds"])
+                for kind, frac in rep["waste"].items():
+                    snap[f'goodput:waste_fraction{{kind="{kind}"}}'] = (
+                        float(frac)
+                    )
             except Exception:  # noqa: BLE001 — seam isolation
                 pass
 

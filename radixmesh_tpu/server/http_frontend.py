@@ -20,6 +20,9 @@ that frontend with stdlib-only HTTP (no framework dependency):
   fill, membership view, SLO tier, recorder stats), and
   ``GET /debug/timeseries`` is the history axis (``obs/timeseries.py``):
   cursor-paginated bounded rings of every metric family + derived plane,
+  ``GET /debug/tokens`` is the token-level speed plane
+  (``obs/token_timeline.py``): the per-token ITL ring with stall-cause
+  attribution, the speculation ledger, and the goodput decomposition,
   with ``POST /admin/blackbox`` flushing the crash-surviving dump
   (``obs/blackbox.py``).
 
@@ -503,6 +506,39 @@ def _debug_trace_response(handler: BaseHTTPRequestHandler) -> None:
     _json_response(handler, 200, get_recorder().chrome_trace(drain=drain))
 
 
+def _debug_tokens_response(handler: BaseHTTPRequestHandler, engine) -> None:
+    """Serve the token-level speed plane (obs/token_timeline.py): the
+    change-compressed per-token ITL ring with stall-cause attribution,
+    the per-(tenant, shape, draft-source) speculation ledger, and the
+    goodput/waste decomposition. ``?limit=N`` bounds the raw ring tail
+    (default 256). 404 when the engine was built with the timeline off
+    (``token_timeline_capacity=0``) — absent, not silently empty."""
+    from urllib.parse import parse_qs, urlsplit
+
+    tl = getattr(engine, "timeline", None)
+    if tl is None:
+        _json_response(
+            handler, 404,
+            {"error": "token timeline disabled on this engine "
+             "(token_timeline_capacity=0)"},
+        )
+        return
+    query = parse_qs(urlsplit(handler.path).query)
+    try:
+        limit = int(query.get("limit", ["256"])[-1])
+    except ValueError:
+        _json_response(handler, 400, {"error": "limit must be an integer"})
+        return
+    led = getattr(engine, "spec_ledger", None)
+    gp = getattr(engine, "goodput", None)
+    acct = getattr(engine, "step_acct", None)
+    _json_response(handler, 200, {
+        "timeline": tl.snapshot(limit=max(0, limit)),
+        "spec": {} if led is None else led.report(),
+        "goodput": {} if gp is None else gp.report(step_acct=acct, spec=led),
+    })
+
+
 class ServingFrontend:
     """HTTP API over one serving engine."""
 
@@ -814,6 +850,11 @@ class ServingFrontend:
                     # p50/p99 phase breakdown + per-shape table +
                     # recent per-request waterfalls.
                     _json_response(self, 200, ensure_attributor().report())
+                elif self.path.split("?", 1)[0] == "/debug/tokens":
+                    # Token-level speed plane (obs/token_timeline.py):
+                    # ITL ring + stall causes, speculation ledger,
+                    # goodput/waste decomposition.
+                    _debug_tokens_response(self, frontend.runner.engine)
                 elif self.path == "/cluster/telemetry":
                     body = _cluster_telemetry(frontend.runner.engine.mesh)
                     # Per-shape speculative acceptance (the doctor's

@@ -179,6 +179,14 @@ class SLORunner(EngineRunner):
     def _apply_tier(self, tier: int) -> None:
         eng = self.engine
         eng.spec_decode_tokens = 0 if tier >= 1 else self._base_spec
+        # Tell the speculation ledger WHY γ went to zero: the doctor's
+        # spec_misconfigured rule must distinguish "off by SLO policy"
+        # from "mistuned", and the adaptive-γ controller (which clamps
+        # to the base γ at draft time) inherits the zero automatically —
+        # it never fights the ladder.
+        led = getattr(eng, "spec_ledger", None)
+        if led is not None:
+            led.note_tier(tier)
         eng.prefill_wave_tokens = (
             max(
                 eng.prefill_chunk,

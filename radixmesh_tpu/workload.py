@@ -3471,6 +3471,10 @@ def run_doctor_workload(
             kv_transfer_async=True,
             kv_transfer_chunk_tokens=restore_chunk_tokens,
             name="doctor-eng",
+            # CPU-tier jit compiles take seconds; a serving-tuned 50ms
+            # stall threshold would attribute compile time as decode
+            # stalls and trip the healthy-phase zero-findings gate.
+            token_stall_threshold_s=5.0,
         )
 
         def prompts_of(n_tokens: int, count: int) -> list[np.ndarray]:
@@ -3517,9 +3521,10 @@ def run_doctor_workload(
         # Fleet-aggregation seam (PR 17): an in-proc aggregator over the
         # router's own ring, pulled by hand before each diagnosis, so
         # the fleet rules (straggler_node / fleet_burn_slope /
-        # telemetry_gap) RUN in the healthy phase — schema v4's
-        # rules_checked gate requires all eleven, and a quiet fleet
-        # must yield zero fleet findings.
+        # telemetry_gap) RUN in the healthy phase — the schema's
+        # rules_checked gate requires every live rule, and a quiet
+        # fleet must yield zero fleet findings. The history seam (PR 18)
+        # arms goodput_regression the same way.
         agg_hist = TelemetryHistory(
             interval_s=0.2, mesh=router_mesh, node="dr0"
         )
@@ -3532,6 +3537,7 @@ def run_doctor_workload(
             engine=eng,
             slo=slo,
             attributor=ensure_attributor,
+            history=agg_hist,
             aggregator=agg,
         )
 
@@ -3897,6 +3903,10 @@ def run_blackbox_workload(
             max_batch=8,
             name="bb-eng",
             step_accounting=True,
+            # CPU-tier jit compiles take seconds; a serving-tuned 50ms
+            # stall threshold would attribute compile time as decode
+            # stalls and trip the healthy-phase zero-findings gate.
+            token_stall_threshold_s=5.0,
         )
         sampling = SamplingParams(temperature=0.0, max_new_tokens=8)
 
@@ -4669,5 +4679,220 @@ def run_agg_workload(
         "gap": gap,
         "overhead": overhead,
         "fan_in": fan_in,
+        "wall_s": round(_time.monotonic() - t_start, 3),
+    }
+
+
+def run_spec_workload(
+    seed: int = 0,
+    gamma: int = 4,
+    stall_sleep_s: float = 0.5,
+    stall_threshold_s: float = 0.2,
+    overhead_tokens: int = 1000,
+    overhead_budget: float = 0.01,
+    adaptive_ratio_floor: float = 0.85,
+) -> dict:
+    """The SPEC acceptance workload (PR 18, the speedometer): one CPU
+    cell proving the token-level observability plane end to end.
+
+    a. **Acceptance + conservation.** Repetitive prompts (n-gram
+       drafts land) generated once, then REPLAYED (the first pass's
+       continuations live in the radix tree, so tree-peek drafts land
+       too) — every verify path must conserve draft tokens
+       (proposed == accepted + rejected, engine counters AND ledger
+       totals), with the per-shape and per-draft-source breakdowns
+       populated.
+    b. **ITL + seeded stall.** A driver-side sleep between mid-decode
+       steps is a real scheduler-side stall; the timeline must
+       attribute at least one stall event to ``scheduler_wait`` and
+       yield per-token percentiles from >0 timed gaps.
+    c. **Adaptive-γ A-B.** Fixed-γ vs ``--spec-adaptive`` engines on
+       identical seeds and prompt schedules: the controller's
+       acceptance-weighted goodput (useful tokens per wall second) must
+       land no worse than the fixed baseline (floor loose enough that
+       CPU jitter cannot fail a neutral controller).
+    d. **Overhead.** The marginal cost of the token-append path,
+       measured directly (N appends timed against the same loop with
+       the timeline's one-branch no-op), judged against wall time at a
+       1k tok/s decode cadence — the speedometer may not slow the car.
+    """
+    import time as _time
+
+    import jax
+
+    from radixmesh_tpu.engine.engine import Engine
+    from radixmesh_tpu.engine.request import SamplingParams
+    from radixmesh_tpu.models.llama import ModelConfig, init_params
+    from radixmesh_tpu.obs.token_timeline import TokenTimeline
+
+    rng = np.random.default_rng(seed)
+    t_start = _time.monotonic()
+    mcfg = ModelConfig(
+        vocab_size=256, hidden=64, n_layers=2, n_heads=2, n_kv_heads=2,
+        head_dim=32, intermediate=128, max_seq_len=1024,
+    )
+    params = init_params(mcfg, jax.random.PRNGKey(seed))
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=16)
+
+    def make_engine(adaptive: bool, capacity: int = 4096) -> Engine:
+        return Engine(
+            mcfg,
+            params,
+            num_slots=4096,
+            page_size=4,
+            max_batch=8,
+            spec_decode_tokens=gamma,
+            spec_adaptive=adaptive,
+            token_timeline_capacity=capacity,
+            token_stall_threshold_s=stall_threshold_s,
+            name="spec-eng",
+        )
+
+    def prompts_for(n_tokens: int, count: int) -> list[list[int]]:
+        # Period-4 repeating tails: the n-gram drafter finds its
+        # context match, and greedy decoding over a tiny model keeps
+        # continuations deterministic for the replay pass.
+        out = []
+        for i in range(count):
+            head = list(
+                rng.integers(1, mcfg.vocab_size - 1, size=4).astype(int)
+            )
+            out.append((head * ((n_tokens // 4) + 1))[:n_tokens])
+        return out
+
+    # -- phase a: acceptance + conservation ----------------------------
+    eng = make_engine(adaptive=False)
+    schedule = prompts_for(16, 3) + prompts_for(48, 3)
+    eng.generate(schedule, sampling)
+    eng.generate(schedule, sampling)  # replay: tree-peek drafts land
+    led = eng.spec_ledger
+    totals = led.totals()
+    st = eng.stats
+    conserved = (
+        st.spec_proposed == st.spec_accepted + st.spec_rejected
+        and totals["proposed"] == totals["accepted"] + totals["rejected"]
+        and totals["proposed"] == st.spec_proposed
+    )
+    by_shape: dict = {}
+    by_source: dict = {}
+    waves = 0
+    for c in led.report().values():
+        waves += c["waves"]
+        for axis, key in ((by_shape, c["shape"]), (by_source, c["source"])):
+            cell = axis.setdefault(
+                key, {"proposed": 0, "accepted": 0, "rejected": 0}
+            )
+            cell["proposed"] += c["proposed"]
+            cell["accepted"] += c["accepted"]
+            cell["rejected"] += c["rejected"]
+    for axis in (by_shape, by_source):
+        for cell in axis.values():
+            cell["acceptance"] = round(
+                cell["accepted"] / max(1, cell["proposed"]), 4
+            )
+    acceptance = {
+        "performed": True,
+        "proposed": totals["proposed"],
+        "accepted": totals["accepted"],
+        "rejected": totals["rejected"],
+        "conserved": bool(conserved),
+        "waves": waves,
+        "accepted_per_step": round(totals["accepted"] / max(1, waves), 4),
+        "by_shape": by_shape,
+        "by_source": by_source,
+    }
+
+    # -- phase b: ITL + seeded scheduler_wait stall --------------------
+    reqs = [eng.add_request(p, sampling) for p in prompts_for(16, 2)]
+    steps = 0
+    while eng.has_work() and steps < 200:
+        eng.step()
+        steps += 1
+        if steps == 3:
+            # Mid-decode driver sleep: from the stream's point of view
+            # this IS a scheduler-side stall (nothing else is parked,
+            # restoring, or mid-prefill).
+            _time.sleep(stall_sleep_s)
+    snap = eng.timeline.snapshot(limit=16)
+    itl_all = snap["itl"].get("default", {})
+    seeded_cause = "scheduler_wait"
+    itl = {
+        "performed": True,
+        "count": int(itl_all.get("count", 0)),
+        "p50_s": itl_all.get("p50_s"),
+        "p99_s": itl_all.get("p99_s"),
+        "stalls": snap["stalls"],
+        "stall_seconds": snap["stall_seconds"],
+        "seeded_cause": seeded_cause,
+        "seeded_detected": bool(snap["stalls"].get(seeded_cause, 0) >= 1),
+    }
+
+    # -- phase c: adaptive-γ A-B ---------------------------------------
+    ab = {}
+    for label, adaptive in (("fixed", False), ("adaptive", True)):
+        e = make_engine(adaptive=adaptive)
+        sched = prompts_for(16, 3) + prompts_for(48, 3)
+        e.generate(sched, sampling)  # warm pass: compiles + tree fill
+        warm_tokens = e.stats.generated_tokens
+        t0 = _time.monotonic()
+        e.generate(sched, sampling)
+        t1 = _time.monotonic()
+        tot = e.spec_ledger.totals()
+        timed_tokens = e.stats.generated_tokens - warm_tokens
+        ab[label] = {
+            "tokens": timed_tokens,
+            "wall_s": round(t1 - t0, 4),
+            "tps": round(timed_tokens / max(1e-9, t1 - t0), 2),
+            "acceptance": round(
+                tot["accepted"] / max(1, tot["proposed"]), 4
+            ),
+        }
+    ratio = ab["adaptive"]["tps"] / max(1e-9, ab["fixed"]["tps"])
+    adaptive = {
+        "performed": True,
+        "gamma_base": gamma,
+        "fixed_goodput_tps": ab["fixed"]["tps"],
+        "adaptive_goodput_tps": ab["adaptive"]["tps"],
+        "goodput_ratio": round(ratio, 4),
+        "no_worse": bool(ratio >= adaptive_ratio_floor),
+        "fixed_acceptance": ab["fixed"]["acceptance"],
+        "adaptive_acceptance": ab["adaptive"]["acceptance"],
+    }
+
+    # -- phase d: token-append overhead at a 1k tok/s cadence ----------
+    tl = TokenTimeline(
+        capacity=4096, stall_threshold_s=stall_threshold_s, node="ovh"
+    )
+    gaps = rng.uniform(0.001, 0.02, size=overhead_tokens)
+    t0 = _time.monotonic()
+    for i in range(overhead_tokens):
+        tl.note_token(i % 8, "default", float(gaps[i]), now=float(i))
+    on_s = _time.monotonic() - t0
+    none_tl = None
+    t0 = _time.monotonic()
+    for i in range(overhead_tokens):
+        # The disabled path the engine hot loop pays: one branch.
+        if none_tl is not None:
+            none_tl.note_token(i % 8, "default", float(gaps[i]))
+    off_s = _time.monotonic() - t0
+    # Marginal append cost vs the wall available at 1k tok/s (1 ms per
+    # token): the <1% budget the tentpole promises.
+    wall_at_1k = overhead_tokens * 1e-3
+    fraction = max(0.0, on_s - off_s) / wall_at_1k
+    overhead = {
+        "tokens": overhead_tokens,
+        "timeline_on_s": round(on_s, 6),
+        "timeline_off_s": round(off_s, 6),
+        "fraction": round(fraction, 6),
+        "budget_fraction": overhead_budget,
+        "under_budget": bool(fraction < overhead_budget),
+    }
+
+    return {
+        "acceptance": acceptance,
+        "itl": itl,
+        "adaptive": adaptive,
+        "overhead": overhead,
+        "requests": len(schedule) * 2 + len(reqs),
         "wall_s": round(_time.monotonic() - t_start, 3),
     }

@@ -13,9 +13,17 @@ stats plus the ``fleet:`` gossip series), and renders:
   rule's raw signal);
 - per-rank decode EWMA / replication lag off the folded gossip series
   (the ``straggler_node`` rule's raw signal);
-- the tenant SLO table — p50/p99 TTFT and e2e with the p99 bucket and
-  its exemplar trace id (paste the id into the trace viewer to see the
-  exact request that set the tail).
+- the tenant SLO table — p50/p99 TTFT, e2e, and per-token ITL with the
+  p99 bucket and its exemplar trace id (paste the id into the trace
+  viewer to see the exact request that set the tail);
+- the speculation panel — per-tenant draft acceptance off the fleet
+  ledger fold (``spec`` block of ``/cluster/slo``), with the worst
+  (shape, draft-source) class named;
+- the goodput panel — per-tenant useful tokens/s off the folded
+  ``goodput:`` series plus the fleet's stall-cause counters (the
+  ``decode_stall`` rule's raw signal).
+
+No new endpoints: everything renders from the two aggregation GETs.
 
 Exit codes: 0 rendered, 2 unreachable / no aggregator hosted there.
 
@@ -70,6 +78,22 @@ def _rank_row(series: dict, family: str) -> dict:
     return {r: v for r, (_s, v) in sorted(best.items(), key=lambda kv: kv[0])}
 
 
+def _label_row(series: dict, family: str, label: str) -> dict:
+    """label value → freshest point per series, summed across peers
+    (distinct series names carrying the same label are different nodes'
+    copies of the same counter/gauge family)."""
+    out: dict[str, float] = {}
+    for key, s in series.items():
+        if not key.startswith(family + "{") or f'{label}="' not in key:
+            continue
+        val = key.split(f'{label}="', 1)[1].split('"', 1)[0]
+        pts = s.get("points") or []
+        if not pts:
+            continue
+        out[val] = out.get(val, 0.0) + float(pts[-1][2])
+    return dict(sorted(out.items()))
+
+
 def _render(slo: dict, ts: dict) -> None:
     agg = ts.get("aggregator", {})
     store = agg.get("store", {})
@@ -109,7 +133,7 @@ def _render(slo: dict, ts: dict) -> None:
         print(f"\n  {'TENANT':<10}{'SIG':<6}{'N':>7}{'P50':>9}{'P99':>9}"
               f"{'BUCKET':>8}  EXEMPLAR")
         for tenant, sigs in sorted(tenants.items()):
-            for sig in ("ttft", "e2e"):
+            for sig in ("ttft", "e2e", "itl"):
                 b = sigs.get(sig)
                 if not b or not b.get("count"):
                     continue
@@ -125,6 +149,44 @@ def _render(slo: dict, ts: dict) -> None:
     else:
         print("\n  no tenant SLO series folded yet "
               "(no radixmesh_request_* buckets in any peer ring)")
+    # -- speculation panel (the fleet ledger fold) ---------------------
+    spec_rows = [
+        (t, sigs["spec"])
+        for t, sigs in sorted(tenants.items())
+        if isinstance(sigs.get("spec"), dict) and sigs["spec"].get("proposed")
+    ]
+    if spec_rows:
+        print(f"\n  {'TENANT':<10}{'PROPOSED':>9}{'ACCEPTED':>9}"
+              f"{'RATE':>7}  WORST CLASS")
+        for tenant, sp in spec_rows:
+            classes = sp.get("classes") or {}
+            worst = min(
+                (
+                    (c.get("accept_ewma"), key)
+                    for key, c in classes.items()
+                    if c.get("accept_ewma") is not None
+                ),
+                default=(None, None),
+            )
+            tag = ""
+            if worst[1] is not None:
+                tag = f"{worst[1]} ewma={worst[0]:.0%}"
+            print(
+                f"  {tenant:<10}{sp.get('proposed', 0):>9}"
+                f"{sp.get('accepted', 0):>9}"
+                f"{sp.get('accept_rate', 0.0):>7.0%}  {tag}"
+            )
+    # -- goodput + stall-cause panel -----------------------------------
+    series = ts.get("series", {})
+    gp = _label_row(series, "goodput:tokens_per_second", "tenant")
+    if gp:
+        cells = "  ".join(f"{t}={v:.1f} tok/s" for t, v in gp.items())
+        print(f"\n  {'goodput':<12} {cells}")
+    stalls = _label_row(series, "radixmesh_token_stalls_total", "cause")
+    if stalls:
+        ranked = sorted(stalls.items(), key=lambda kv: -kv[1])
+        cells = "  ".join(f"{c}={int(n)}" for c, n in ranked)
+        print(f"  {'stalls':<12} {cells}")
 
 
 def main() -> int:

@@ -1,0 +1,105 @@
+"""Standalone speculation/token-plane acceptance bench (the SPEC
+artifact's paired CLI emitter, like ``scripts/aggbench.py`` is for AGG).
+
+Runs ``workload.run_spec_workload`` — one CPU engine driven through
+repeat-then-replay prompt schedules so both draft sources (tree-peek
+and n-gram) land — and checks the four token-plane verdicts end to end:
+
+- **acceptance** — draft-token conservation (proposed == accepted +
+  rejected on EVERY verify path, engine counters and ledger totals
+  agreeing), with accepted-tokens-per-verify-wave broken down by shape
+  and by draft source;
+- **itl** — the bounded per-token timeline produced real inter-token
+  percentiles AND attributed a seeded mid-decode driver sleep to
+  ``scheduler_wait``;
+- **adaptive** — the acceptance-adaptive γ controller's goodput lands
+  no worse than the fixed-γ baseline on an identical-seed A-B;
+- **overhead** — the token-append path's marginal cost stays under 1%
+  of wall at a 1k tok/s decode cadence.
+
+Prints ONE JSON line validated against the schema
+``bench.validate_spec`` pins.
+
+Usage::
+
+    python scripts/specbench.py [--seed 0] [--gamma 4] [--out FILE] \
+        [--write-artifact]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402  (schema + report assembly live with the other validators)
+from radixmesh_tpu.workload import run_spec_workload  # noqa: E402
+
+
+def spec_round() -> int:
+    """The round in progress = 1 + the highest N across every OTHER
+    plane's recorded artifact (SPEC rides whatever round they are on —
+    the scripts/meshcheck.py analysis_round convention)."""
+    rounds = [0]
+    for name in os.listdir(_REPO_ROOT):
+        m = re.fullmatch(r"[A-Z_]+_r(\d+)\.json", name)
+        if m and not name.startswith("SPEC_"):
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def run(seed: int, gamma: int, overhead_tokens: int) -> dict:
+    res = run_spec_workload(
+        seed=seed,
+        gamma=gamma,
+        overhead_tokens=overhead_tokens,
+    )
+    report = bench.build_spec_report(res)
+    problems = bench.validate_spec(report)
+    if problems:
+        report["schema_violation"] = problems
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="specbench")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--gamma", type=int, default=4, metavar="N",
+        help="base speculative draft width for both A-B arms (the "
+        "adaptive arm may clamp below it, never above)",
+    )
+    ap.add_argument(
+        "--overhead-tokens", type=int, default=1000, metavar="N",
+        help="synthetic appends for the overhead row (judged against "
+        "wall at a 1k tok/s decode cadence)",
+    )
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument(
+        "--write-artifact", action="store_true",
+        help="write the round's SPEC_r{N}.json to the repo root",
+    )
+    args = ap.parse_args()
+    report = run(args.seed, args.gamma, args.overhead_tokens)
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    if args.write_artifact:
+        path = os.path.join(_REPO_ROOT, f"SPEC_r{spec_round():02d}.json")
+        with open(path, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"specbench: wrote {os.path.basename(path)}", file=sys.stderr)
+    return 1 if "schema_violation" in report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2024,3 +2024,191 @@ class TestDoctorRuleVersionGating:
         )
         assert "tier_thrash" in req
         assert tuple(req) == RULES
+
+    def test_v4_pins_pr17_rules_without_token_plane(self):
+        from radixmesh_tpu.obs.doctor import RULES
+
+        req = bench._required_doctor_rules({"schema_version": 4}, RULES)
+        assert tuple(req) == bench.DOCTOR_RULES_V4
+        assert "straggler_node" in req
+        assert "decode_stall" not in req
+
+
+class TestSpecArtifactSchema:
+    """The SPEC artifact (PR 18, the speedometer): draft-token
+    conservation on every verify path with per-shape and per-draft-
+    source breakdowns, seeded-stall ITL attribution, the adaptive-γ
+    goodput A-B, and the token-timeline overhead bound — the artifact
+    ROADMAP item 1's gate names."""
+
+    def _report(self) -> dict:
+        return {
+            "schema_version": bench.SPEC_SCHEMA_VERSION,
+            "metric": "spec_accepted_tokens_per_step",
+            "value": 1.6,
+            "unit": "draft tokens accepted per verify wave",
+            "workload": "repetitive + replayed prompts, tiny CPU model",
+            "acceptance": {
+                "performed": True, "proposed": 120, "accepted": 72,
+                "rejected": 48, "conserved": True,
+                "accepted_per_step": 1.6, "waves": 45,
+                "by_shape": {
+                    "p32": {"proposed": 60, "accepted": 30, "rejected": 30,
+                            "acceptance": 0.5},
+                    "p64": {"proposed": 60, "accepted": 42, "rejected": 18,
+                            "acceptance": 0.7},
+                },
+                "by_source": {
+                    "tree": {"proposed": 54, "accepted": 54, "rejected": 0,
+                             "acceptance": 1.0},
+                    "ngram": {"proposed": 66, "accepted": 18, "rejected": 48,
+                              "acceptance": 0.2727},
+                },
+            },
+            "itl": {
+                "performed": True, "count": 196, "p50_s": 0.004,
+                "p99_s": 1.9, "stalls": {"scheduler_wait": 9},
+                "stall_seconds": {"scheduler_wait": 11.2},
+                "seeded_cause": "scheduler_wait", "seeded_detected": True,
+            },
+            "adaptive": {
+                "performed": True, "gamma_base": 4,
+                "fixed_goodput_tps": 1900.0,
+                "adaptive_goodput_tps": 2050.0, "goodput_ratio": 1.0789,
+                "no_worse": True, "fixed_acceptance": 0.87,
+                "adaptive_acceptance": 0.94,
+            },
+            "overhead": {
+                "tokens": 1000, "timeline_on_s": 0.0019,
+                "timeline_off_s": 0.0001, "fraction": 0.0018,
+                "budget_fraction": 0.01, "under_budget": True,
+            },
+            "wall_s": 12.8,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_spec(self._report()) == []
+        assert bench.validate_spec(7) == ["artifact is not a JSON object"]
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["wall_s"]
+        del report["acceptance"]["conserved"]
+        del report["itl"]["seeded_detected"]
+        del report["adaptive"]["goodput_ratio"]
+        del report["overhead"]["fraction"]
+        missing = bench.validate_spec(report)
+        assert "wall_s" in missing
+        assert "acceptance.conserved" in missing
+        assert "itl.seeded_detected" in missing
+        assert "adaptive.goodput_ratio" in missing
+        assert "overhead.fraction" in missing
+
+    def test_conservation_gates(self):
+        report = self._report()
+        report["acceptance"]["conserved"] = False
+        report["acceptance"]["accepted"] = 70
+        problems = "\n".join(bench.validate_spec(report))
+        assert "conservation broke" in problems
+        report = self._report()
+        report["acceptance"]["proposed"] = 0
+        problems = "\n".join(bench.validate_spec(report))
+        assert "zero proposed draft tokens" in problems
+        report = self._report()
+        report["acceptance"]["accepted_per_step"] = 0.0
+        report["value"] = 0.0
+        problems = "\n".join(bench.validate_spec(report))
+        assert "every draft missed" in problems
+        assert "not > 0" in problems
+
+    def test_empty_breakdowns_are_violations(self):
+        report = self._report()
+        report["acceptance"]["by_shape"] = {}
+        report["acceptance"]["by_source"] = {}
+        problems = "\n".join(bench.validate_spec(report))
+        assert "by_shape is empty" in problems
+        assert "by_source is empty" in problems
+
+    def test_itl_gates(self):
+        report = self._report()
+        report["itl"]["count"] = 0
+        report["itl"]["seeded_detected"] = False
+        report["itl"]["p99_s"] = 0.001
+        problems = "\n".join(bench.validate_spec(report))
+        assert "zero timed inter-token gaps" in problems
+        assert "'scheduler_wait' stall was not attributed" in problems
+        assert "p99 0.001 < p50 0.004" in problems
+
+    def test_adaptive_and_overhead_gates(self):
+        report = self._report()
+        report["adaptive"]["no_worse"] = False
+        report["adaptive"]["goodput_ratio"] = 0.7
+        report["overhead"]["under_budget"] = False
+        report["overhead"]["fraction"] = 0.04
+        problems = "\n".join(bench.validate_spec(report))
+        assert "the controller costs more than it saves" in problems
+        assert "may not slow the car" in problems
+
+    def test_skipped_sections_gate_exempt(self):
+        # performed=False sections are schema-valid but gate-exempt
+        # (the CHAOS convention) — a partial run still emits a valid,
+        # honestly-labelled artifact. Overhead has no performed flag:
+        # the bound is cheap enough to always measure.
+        report = self._report()
+        report["acceptance"] = {"performed": False}
+        report["itl"] = {"performed": False}
+        report["adaptive"] = {"performed": False}
+        report["value"] = None
+        assert bench.validate_spec(report) == []
+
+    def test_non_dict_sections_are_violations(self):
+        report = self._report()
+        report["acceptance"] = "done"
+        report["overhead"] = 3
+        problems = "\n".join(bench.validate_spec(report))
+        assert "acceptance section is not an object" in problems
+        assert "overhead section is not an object" in problems
+
+    def test_build_report_matches_schema(self):
+        base = self._report()
+        res = {
+            k: base[k]
+            for k in ("acceptance", "itl", "adaptive", "overhead", "wall_s")
+        }
+        report = bench.build_spec_report(res)
+        assert bench.validate_spec(report) == []
+        assert report["value"] == base["acceptance"]["accepted_per_step"]
+        assert report["metric"] == "spec_accepted_tokens_per_step"
+
+    def test_spec_kind_registered_in_sentinel(self):
+        assert "SPEC" in bench.COMPARE_RULES
+        assert bench.artifact_kind(self._report()) == "SPEC"
+        assert bench.artifact_kind({}, "SPEC_r18.json") == "SPEC"
+        res = bench.benchdiff_selfcheck()
+        assert "SPEC" in res["kinds_covered"]
+
+    def test_compare_rounds_flags_acceptance_drop(self):
+        old = self._report()
+        new = self._report()
+        new["value"] = 0.9
+        new["acceptance"]["accepted_per_step"] = 0.9
+        res = bench.compare_rounds(old, new, kind="SPEC")
+        assert res["status"] == "regression"
+        assert "acceptance.accepted_per_step" in res["regressions"]
+
+    def test_checked_in_artifact_validates(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "SPEC_r*.json")))
+        assert paths, "no SPEC artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_spec(report) == []
+        assert report["acceptance"]["conserved"] is True
+        assert report["acceptance"]["by_shape"]
+        assert report["acceptance"]["by_source"]
+        assert report["itl"]["seeded_detected"] is True
+        assert report["adaptive"]["no_worse"] is True
+        assert report["overhead"]["under_budget"] is True

@@ -483,6 +483,7 @@ class TestDiagnoseContract:
         report = MeshDoctor(engine=FakeEngine()).diagnose()
         assert list(report["rules_checked"]) == [
             "restore_park_stall", "spec_efficiency", "tier_thrash",
+            "decode_stall", "spec_misconfigured",
         ]
 
     def test_findings_ranked_by_score(self):
@@ -670,3 +671,181 @@ class TestHistoryBackedBurn:
             report = doctor.diagnose()
         (f,) = report["findings"]
         assert f["rule"] == "slo_burn_rate"
+
+
+class _TokenEngine:
+    """Engine stand-in for the token-plane rules: a real TokenTimeline
+    and SpecLedger hung off the attributes the doctor duck-types."""
+
+    def __init__(self, spec_decode_tokens=4):
+        from radixmesh_tpu.obs.token_timeline import (
+            SpecLedger,
+            TokenTimeline,
+        )
+
+        self.timeline = TokenTimeline(
+            capacity=256, stall_threshold_s=0.05, node="fx"
+        )
+        self.spec_ledger = SpecLedger(node="fx")
+        self.spec_decode_tokens = spec_decode_tokens
+
+    def spec_report(self):
+        return {}  # keeps the raw-counter spec_efficiency rule silent
+
+
+class TestDecodeStallRule:
+    """Tentpole (PR 18): the token-timeline stall histogram pages with
+    the DOMINANT cause named — the per-token refinement of
+    restore_park_stall."""
+
+    def test_fires_with_dominant_cause(self):
+        eng = _TokenEngine()
+        for i in range(12):
+            eng.timeline.note_token(
+                i, "default", 0.2, cause="restore_park", now=float(i)
+            )
+        eng.timeline.note_token(99, "default", 0.2, cause="spec_verify_miss",
+                                now=99.0)
+        (f,) = MeshDoctor(engine=eng).diagnose()["findings"]
+        assert f["rule"] == "decode_stall"
+        assert f["evidence"]["cause"] == "restore_park"
+        assert f["evidence"]["stalls"] == 13
+        assert f["evidence"]["stall_seconds"] == pytest.approx(2.4)
+        assert f["evidence"]["threshold_s"] == 0.05
+        assert f["evidence"]["p99_itl_s"] >= 0.2
+
+    def test_silent_below_min_events(self):
+        eng = _TokenEngine()
+        for i in range(DoctorConfig().decode_stall_min_events - 1):
+            eng.timeline.note_token(
+                i, "default", 0.2, cause="scheduler_wait", now=float(i)
+            )
+        report = MeshDoctor(engine=eng).diagnose()
+        assert report["findings"] == []
+        # Vacuous-pass honesty: the rule RAN and found nothing.
+        assert "decode_stall" in report["rules_checked"]
+
+    def test_silent_on_fast_tokens(self):
+        eng = _TokenEngine()
+        for i in range(100):
+            eng.timeline.note_token(i, "default", 0.002, now=float(i))
+        assert MeshDoctor(engine=eng).diagnose()["findings"] == []
+
+
+class TestSpecMisconfiguredRule:
+    """Tentpole (PR 18): γ and EWMA acceptance diverging on a ledger
+    class pages — but never when the SLO ladder zeroed γ on purpose."""
+
+    def _miss_waves(self, eng, n=30):
+        for _ in range(n):
+            eng.spec_ledger.note_wave(
+                "default", "p32", "ngram", proposed=4, accepted=0, gamma=4
+            )
+
+    def test_fires_on_low_ewma_wide_gamma(self):
+        eng = _TokenEngine()
+        self._miss_waves(eng)
+        (f,) = MeshDoctor(engine=eng).diagnose()["findings"]
+        assert f["rule"] == "spec_misconfigured"
+        ev = f["evidence"]
+        assert (ev["tenant"], ev["shape"], ev["source"]) == (
+            "default", "p32", "ngram",
+        )
+        assert ev["gamma"] == 4
+        assert ev["accept_ewma"] == pytest.approx(0.0)
+        assert ev["proposed"] == 120
+
+    def test_silent_when_tier_zeroed_gamma(self):
+        # The SLO ladder shed speculation deliberately: not a mistuning.
+        eng = _TokenEngine()
+        self._miss_waves(eng)
+        eng.spec_ledger.note_tier(1)
+        assert MeshDoctor(engine=eng).diagnose()["findings"] == []
+
+    def test_silent_when_spec_off(self):
+        eng = _TokenEngine(spec_decode_tokens=0)
+        self._miss_waves(eng)
+        assert MeshDoctor(engine=eng).diagnose()["findings"] == []
+
+    def test_silent_below_min_proposed(self):
+        eng = _TokenEngine()
+        self._miss_waves(eng, n=5)  # 20 proposed < the 50 floor
+        report = MeshDoctor(engine=eng).diagnose()
+        assert report["findings"] == []
+        assert "spec_misconfigured" in report["rules_checked"]
+
+    def test_silent_on_healthy_acceptance(self):
+        eng = _TokenEngine()
+        for _ in range(30):
+            eng.spec_ledger.note_wave(
+                "default", "p32", "tree", proposed=4, accepted=4, gamma=4
+            )
+        assert MeshDoctor(engine=eng).diagnose()["findings"] == []
+
+
+class _FakeGoodputHistory:
+    """History-ring stand-in serving one synthetic
+    ``goodput:tokens_per_second`` series; points are (seq, t, value)."""
+
+    def __init__(self, points):
+        self._points = list(points)
+
+    def query(self, family=None, limit=0):
+        assert family == "goodput:tokens_per_second"
+        return {
+            "series": {
+                "goodput:tokens_per_second": {"points": list(self._points)}
+            }
+        }
+
+
+class TestGoodputRegressionRule:
+    """Tentpole (PR 18): recent-window mean tokens/s collapsing below
+    the baseline window pages with the drop fraction pinned."""
+
+    def test_fires_on_collapse(self):
+        # Baseline 100 tok/s inside [now-300, now-60), then 10 tok/s
+        # for the last minute: a 90% drop.
+        pts = [(i, 100.0 + i * 5.0, 100.0) for i in range(40)]
+        pts += [(40 + i, 310.0 + i * 10.0, 10.0) for i in range(6)]
+        hist = _FakeGoodputHistory(pts)
+        report = MeshDoctor(history=hist).diagnose()
+        found = [
+            f for f in report["findings"] if f["rule"] == "goodput_regression"
+        ]
+        (f,) = found
+        assert f["evidence"]["recent_tps"] == pytest.approx(10.0)
+        assert f["evidence"]["baseline_tps"] == pytest.approx(100.0)
+        assert f["evidence"]["drop_frac"] == pytest.approx(0.9)
+        assert f["evidence"]["window_s"] == 60.0
+
+    def test_silent_on_steady_throughput(self):
+        pts = [(i, 100.0 + i * 5.0, 100.0) for i in range(60)]
+        hist = _FakeGoodputHistory(pts)
+        report = MeshDoctor(history=hist).diagnose()
+        assert not [
+            f for f in report["findings"] if f["rule"] == "goodput_regression"
+        ]
+        # Vacuous-pass honesty: the history seam armed the rule.
+        assert "goodput_regression" in report["rules_checked"]
+
+    def test_silent_on_idle_baseline(self):
+        # Baseline under goodput_min_tps: nothing to regress FROM —
+        # an idle mesh starting work must not page.
+        pts = [(i, 100.0 + i * 5.0, 0.1) for i in range(40)]
+        pts += [(40 + i, 310.0 + i * 10.0, 0.0) for i in range(6)]
+        report = MeshDoctor(history=_FakeGoodputHistory(pts)).diagnose()
+        assert not [
+            f for f in report["findings"] if f["rule"] == "goodput_regression"
+        ]
+
+    def test_silent_on_empty_series(self):
+        class Empty:
+            def query(self, family=None, limit=0):
+                return {"series": {}}
+
+        assert not [
+            f
+            for f in MeshDoctor(history=Empty()).diagnose()["findings"]
+            if f["rule"] == "goodput_regression"
+        ]

@@ -95,3 +95,20 @@ class TestHotPathSyncLint:
         assert "asarray" in names, "kv_transfer no longer materializes?"
         assert "read" in names, "kv_transfer no longer reads the arena?"
         assert not _sync_findings("cache/kv_transfer.py")
+
+    def test_token_timeline_rides_the_hot_path_clean(self):
+        """PR 18: the token timeline's ``note_token`` runs once per
+        decoded token INSIDE the serving loop — the speedometer module
+        must carry zero blocking findings, and the call graph must
+        actually see it from the engine entry points (otherwise the
+        reachability guarantee above is vacuous for the newest
+        per-token code)."""
+        assert not _sync_findings("obs/token_timeline.py")
+        from radixmesh_tpu.analysis.callgraph import get_callgraph
+        from radixmesh_tpu.analysis.hot_path import DEFAULT_ENTRY_POINTS
+
+        cg = get_callgraph(_index())
+        reachable, _chains = cg.reach(DEFAULT_ENTRY_POINTS)
+        hits = {fn[1] for fn in reachable if "note_token" in fn[1]}
+        assert "TokenTimeline.note_token" in hits
+        assert "GoodputLedger.note_token" in hits

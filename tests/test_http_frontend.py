@@ -473,6 +473,46 @@ class TestDebugEndpoints:
         # Finished requests leave the table; it reports only live state.
         assert table["waiting"] == 0
 
+    def test_debug_tokens_serves_the_token_plane(self, frontend):
+        _post(
+            f"http://127.0.0.1:{frontend.port}/generate",
+            {"input_ids": list(range(310, 330)), "max_tokens": 4},
+        )
+        status, body = _get(f"http://127.0.0.1:{frontend.port}/debug/tokens")
+        assert status == 200
+        out = json.loads(body)
+        tl = out["timeline"]
+        assert tl["capacity"] > 0
+        assert set(tl["stalls"].keys()) <= {
+            "restore_park", "prefill_convoy", "rebalance_handoff",
+            "spec_verify_miss", "scheduler_wait",
+        }
+        assert out["goodput"]["useful_tokens"] >= 4
+        assert isinstance(out["spec"], dict)
+        # ?limit= caps the recent tail; a bad limit is a 400, not a 500.
+        status, body = _get(
+            f"http://127.0.0.1:{frontend.port}/debug/tokens?limit=1"
+        )
+        assert len(json.loads(body)["timeline"]["recent"]) <= 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://127.0.0.1:{frontend.port}/debug/tokens?limit=zap")
+        assert ei.value.code == 400
+
+    def test_debug_tokens_404_when_plane_disabled(self):
+        cfg = ModelConfig.tiny()
+        eng = Engine(
+            cfg, init_params(cfg, jax.random.PRNGKey(0)),
+            num_slots=64, page_size=4, max_batch=1, name="notl",
+            token_timeline_capacity=0,
+        )
+        f = ServingFrontend(eng, port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://127.0.0.1:{f.port}/debug/tokens")
+            assert ei.value.code == 404
+        finally:
+            f.close()
+
     def test_debug_trace_drains_chrome_json(self, frontend):
         import bench
         from radixmesh_tpu.obs.trace_plane import (
