@@ -2495,10 +2495,209 @@ def build_spec_report(res: dict) -> dict:
     }
 
 
+CONVOY_SCHEMA_VERSION = 1
+
+CONVOY_TOP_FIELDS = (
+    "schema_version", "metric", "value", "unit", "workload",
+    "interleave", "stalls", "starvation", "crossover", "wall_s",
+)
+CONVOY_INTERLEAVE_FIELDS = (
+    "performed", "reps", "inline_budget", "base_ttft_p50_s",
+    "mixed_ttft_p50_s", "ttft_ratio", "base_itl_p99_s",
+    "mixed_itl_p99_s", "outputs_match", "base_accepted_per_wave",
+    "mixed_accepted_per_wave", "waves",
+)
+CONVOY_STALL_FIELDS = (
+    "performed", "stall_threshold_s", "base_convoy_s_per_req",
+    "mixed_convoy_s_per_req", "convoy_drop_ratio", "base_causes",
+    "mixed_causes", "inline_attributed_s",
+)
+CONVOY_STARVATION_FIELDS = (
+    "performed", "skew", "max_defer_bound", "max_step_gap",
+    "max_defer_observed", "boost_waves", "bounded", "carrier_tokens",
+)
+CONVOY_CROSSOVER_FIELDS = (
+    "performed", "paged_min_batch", "sweep", "small_batch_ok",
+    "large_batch_ok",
+)
+# The ISSUE's acceptance bars: mixed waves must better the convoy'd
+# TTFT by at least this factor, and the per-request prefill_convoy
+# stall seconds must drop by at least the drop floor. The ITL ceiling
+# and spec floor keep the win honest — interleaving may not buy TTFT
+# by starving decode or breaking speculation.
+CONVOY_TTFT_RATIO_FLOOR = 1.5
+CONVOY_DROP_FLOOR = 2.0
+CONVOY_ITL_CEILING = 1.5
+CONVOY_SPEC_FLOOR = 0.9
+CONVOY_CROSSOVER_FLOOR = 0.9
+
+
+def validate_convoy(report) -> list[str]:
+    """Schema violations of a CONVOY artifact vs the pinned contract
+    (empty = valid). Gates: the mixed-wave arm beats the legacy
+    alternating schedule's late-arrival TTFT by the pinned floor with
+    BIT-IDENTICAL outputs, decode ITL p99 within the ceiling, and spec
+    accepted-per-wave within the floor; the per-request
+    ``prefill_convoy`` stall seconds drop by the drop floor; the
+    starvation proof held its wave-count bound with boost waves
+    actually exercised; and the paged/dense crossover chose a path
+    within the floor of dense at every swept batch. Sections with
+    performed=False are schema-valid but gate-exempt (the CHAOS
+    convention). Import-safe from artifact tests and
+    scripts/convoybench.py (no jax at module scope)."""
+    if not isinstance(report, dict):
+        return ["artifact is not a JSON object"]
+    problems = [f for f in CONVOY_TOP_FIELDS if f not in report]
+    il = report.get("interleave")
+    if "interleave" in report and not isinstance(il, dict):
+        problems.append("interleave section is not an object")
+    if isinstance(il, dict) and il.get("performed"):
+        problems += [
+            f"interleave.{f}" for f in CONVOY_INTERLEAVE_FIELDS if f not in il
+        ]
+        ratio = il.get("ttft_ratio")
+        if isinstance(ratio, (int, float)) and not (
+            ratio >= CONVOY_TTFT_RATIO_FLOOR
+        ):
+            problems.append(
+                f"interleave: late-arrival TTFT ratio {ratio} under the "
+                f"{CONVOY_TTFT_RATIO_FLOOR} floor — mixed waves did not "
+                "beat the convoy"
+            )
+        if il.get("outputs_match") is not True:
+            problems.append(
+                "interleave: outputs diverged between the legacy and "
+                "mixed schedules — interleaving changed WHAT was "
+                "generated, not just when"
+            )
+        b_itl, m_itl = il.get("base_itl_p99_s"), il.get("mixed_itl_p99_s")
+        if (
+            isinstance(b_itl, (int, float))
+            and isinstance(m_itl, (int, float))
+            and m_itl > b_itl * CONVOY_ITL_CEILING
+        ):
+            problems.append(
+                f"interleave: mixed decode ITL p99 {m_itl} exceeds "
+                f"base {b_itl} x{CONVOY_ITL_CEILING} — the TTFT win "
+                "was bought by starving decode"
+            )
+        b_acc = il.get("base_accepted_per_wave")
+        m_acc = il.get("mixed_accepted_per_wave")
+        if (
+            isinstance(b_acc, (int, float))
+            and isinstance(m_acc, (int, float))
+            and b_acc > 0
+            and m_acc < b_acc * CONVOY_SPEC_FLOOR
+        ):
+            problems.append(
+                f"interleave: spec accepted-per-wave fell {b_acc} -> "
+                f"{m_acc} under the {CONVOY_SPEC_FLOOR} floor — inline "
+                "chunks are breaking speculation"
+            )
+    st = report.get("stalls")
+    if "stalls" in report and not isinstance(st, dict):
+        problems.append("stalls section is not an object")
+    if isinstance(st, dict) and st.get("performed"):
+        problems += [
+            f"stalls.{f}" for f in CONVOY_STALL_FIELDS if f not in st
+        ]
+        drop = st.get("convoy_drop_ratio")
+        if isinstance(drop, (int, float)) and not (
+            drop >= CONVOY_DROP_FLOOR
+        ):
+            problems.append(
+                f"stalls: prefill_convoy s/req drop ratio {drop} under "
+                f"the {CONVOY_DROP_FLOOR} floor — the convoy survived "
+                "the interleave"
+            )
+        if not isinstance(st.get("base_causes"), dict) or not st.get(
+            "base_causes"
+        ):
+            problems.append(
+                "stalls: base_causes decomposition is empty — the base "
+                "arm never even stalled; nothing was proven"
+            )
+    sv = report.get("starvation")
+    if "starvation" in report and not isinstance(sv, dict):
+        problems.append("starvation section is not an object")
+    if isinstance(sv, dict) and sv.get("performed"):
+        problems += [
+            f"starvation.{f}" for f in CONVOY_STARVATION_FIELDS if f not in sv
+        ]
+        if sv.get("bounded") is not True:
+            problems.append(
+                f"starvation: decode went {sv.get('max_step_gap')} steps "
+                f"(defer {sv.get('max_defer_observed')}) without a token "
+                f"against a bound of {sv.get('max_defer_bound')} — the "
+                "starvation bound broke"
+            )
+        if not sv.get("boost_waves", 0):
+            problems.append(
+                "starvation: zero boost waves fired — the skew never "
+                "exercised deferral, so the bound was proven vacuously"
+            )
+    cx = report.get("crossover")
+    if "crossover" in report and not isinstance(cx, dict):
+        problems.append("crossover section is not an object")
+    if isinstance(cx, dict) and cx.get("performed"):
+        problems += [
+            f"crossover.{f}" for f in CONVOY_CROSSOVER_FIELDS if f not in cx
+        ]
+        if isinstance(cx.get("sweep"), list) and not cx["sweep"]:
+            problems.append(
+                "crossover: empty sweep — no batch sizes were measured"
+            )
+        if cx.get("small_batch_ok") is not True:
+            problems.append(
+                "crossover: small-batch effective path fell under "
+                f"{CONVOY_CROSSOVER_FLOOR} of dense — the dispatch is "
+                "picking the slow path below --paged-min-batch"
+            )
+        if cx.get("large_batch_ok") is not True:
+            problems.append(
+                "crossover: bucketed wrapper regressed the at-bucket "
+                "batch — padding is costing where it should be free"
+            )
+    val = report.get("value")
+    if isinstance(il, dict) and il.get("performed"):
+        if not isinstance(val, (int, float)) or not val > 0:
+            problems.append(
+                f"value: late-arrival TTFT speedup {val} is not > 0"
+            )
+    return problems
+
+
+def build_convoy_report(res: dict) -> dict:
+    """Assemble a schema-complete CONVOY artifact from
+    ``workload.run_convoy_workload``'s result."""
+    il = res.get("interleave", {}) or {}
+    return {
+        "schema_version": CONVOY_SCHEMA_VERSION,
+        "metric": "convoy_ttft_speedup",
+        "value": il.get("ttft_ratio"),
+        "unit": (
+            "late-arrival p50 TTFT ratio (legacy alternating waves / "
+            "decode-interleaved mixed waves) on an identical virtual "
+            "arrival schedule, with bit-identical outputs, decode ITL "
+            "p99 and spec accepted-per-wave no worse, prefill_convoy "
+            "stall s/req dropped, a wave-counted starvation bound, and "
+            "the paged/dense crossover holding at small batch"
+        ),
+        "workload": (
+            "a decoding carrier stream convoyed by a 960-token prompt "
+            "with a late 16-token arrival, A-B across "
+            "prefill_inline_budget 0 vs >0; 20:1 skew with boost waves "
+            "for the starvation proof; jnp-path dense/bucketed timing "
+            "sweep at batch 2/4/8/32 (see workload.run_convoy_workload)"
+        ),
+        **res,
+    }
+
+
 # ----------------------------------------------------------------------
 # compare_rounds (PR 12, the bench regression sentinel): schema-aware
-# diffing of any two SAME-schema artifacts. Eleven artifact schemas
-# accumulated over eleven rounds with nothing machine-checking the
+# diffing of any two SAME-schema artifacts. The artifact schemas
+# accumulated round over round with nothing machine-checking the
 # trajectory between them — a silently regressed hit ratio or a halved
 # ring throughput would ride a green round. Each kind pins the metrics
 # worth guarding (dotted path, direction, relative significance
@@ -2600,6 +2799,13 @@ COMPARE_RULES: dict = {
         ("itl.p99_s", "lower", 1.0),
         ("overhead.fraction", "lower", 2.0),
     ),
+    "CONVOY": (
+        ("value", "higher", 0.25),  # late-arrival TTFT speedup
+        ("interleave.ttft_ratio", "higher", 0.25),
+        ("stalls.mixed_convoy_s_per_req", "lower", 1.0),
+        ("interleave.mixed_itl_p99_s", "lower", 1.0),
+        ("starvation.max_defer_observed", "lower", 0.0),  # any rise flags
+    ),
     # Kinds with no pinned directional metrics still get the schema
     # check + informational numeric diff.
     "SLO": (),
@@ -2626,6 +2832,7 @@ _METRIC_KINDS = {
     "tier_hit_rate_gain": "TIER",
     "agg_fleet_verdicts_named": "AGG",
     "spec_accepted_tokens_per_step": "SPEC",
+    "convoy_ttft_speedup": "CONVOY",
     "slo_goodput_vs_offered_load": "SLO",
     "soak_requests": "SOAK",
 }
@@ -2815,8 +3022,8 @@ def benchdiff_selfcheck() -> dict:
     deterministic (no checked-in files needed): an identical artifact
     pair must compare clean, a synthetically regressed copy must flag,
     and a cross-kind pair must refuse as a schema mismatch — proven for
-    the CHAOS, BLACKBOX, TIER, AGG, and SPEC schemas, so every pinned
-    rule table a sentinel relies on has a demonstrated trigger.
+    the CHAOS, BLACKBOX, TIER, AGG, SPEC, and CONVOY schemas, so every
+    pinned rule table a sentinel relies on has a demonstrated trigger.
     The DOCTOR artifact carries the result (``validate_doctor`` gates
     the three headline fields) — a sentinel nobody proved can still
     fire is not a sentinel."""
@@ -2889,6 +3096,20 @@ def benchdiff_selfcheck() -> dict:
         "value": 0.9,
         "acceptance": {"accepted_per_step": 0.9},
     }
+    cv_base = {
+        "metric": "convoy_ttft_speedup",
+        "schema_version": CONVOY_SCHEMA_VERSION,
+        "value": 4.0,
+        "interleave": {"ttft_ratio": 4.0, "mixed_itl_p99_s": 0.05},
+        "stalls": {"mixed_convoy_s_per_req": 0.0},
+        "starvation": {"max_defer_observed": 2},
+    }
+    cv_regressed = {
+        **cv_base,
+        # The convoy came back: TTFT speedup down 70%, past 25%.
+        "value": 1.2,
+        "interleave": {"ttft_ratio": 1.2, "mixed_itl_p99_s": 0.05},
+    }
     identical = compare_rounds(base, dict(base), kind="CHAOS")
     regression = compare_rounds(base, regressed, kind="CHAOS")
     mismatch = compare_rounds(base, other_kind)
@@ -2904,12 +3125,16 @@ def benchdiff_selfcheck() -> dict:
     s_identical = compare_rounds(spec_base, dict(spec_base), kind="SPEC")
     s_regression = compare_rounds(spec_base, spec_regressed, kind="SPEC")
     s_mismatch = compare_rounds(spec_base, base)
+    c_identical = compare_rounds(cv_base, dict(cv_base), kind="CONVOY")
+    c_regression = compare_rounds(cv_base, cv_regressed, kind="CONVOY")
+    c_mismatch = compare_rounds(cv_base, base)
     return {
         "identical_clean": identical["status"] == "clean"
         and bb_identical["status"] == "clean"
         and t_identical["status"] == "clean"
         and a_identical["status"] == "clean"
-        and s_identical["status"] == "clean",
+        and s_identical["status"] == "clean"
+        and c_identical["status"] == "clean",
         "regression_flagged": regression["status"] == "regression"
         and "repair.converge_s" in regression["regressions"]
         and bb_regression["status"] == "regression"
@@ -2919,18 +3144,22 @@ def benchdiff_selfcheck() -> dict:
         and a_regression["status"] == "regression"
         and "value" in a_regression["regressions"]
         and s_regression["status"] == "regression"
-        and "acceptance.accepted_per_step" in s_regression["regressions"],
+        and "acceptance.accepted_per_step" in s_regression["regressions"]
+        and c_regression["status"] == "regression"
+        and "interleave.ttft_ratio" in c_regression["regressions"],
         "mismatch_detected": mismatch["status"] == "schema_mismatch"
         and bb_mismatch["status"] == "schema_mismatch"
         and t_mismatch["status"] == "schema_mismatch"
         and a_mismatch["status"] == "schema_mismatch"
-        and s_mismatch["status"] == "schema_mismatch",
-        "kinds_covered": ["CHAOS", "BLACKBOX", "TIER", "AGG", "SPEC"],
+        and s_mismatch["status"] == "schema_mismatch"
+        and c_mismatch["status"] == "schema_mismatch",
+        "kinds_covered": ["CHAOS", "BLACKBOX", "TIER", "AGG", "SPEC", "CONVOY"],
         "regressions_seen": regression["regressions"]
         + bb_regression["regressions"]
         + t_regression["regressions"]
         + a_regression["regressions"]
-        + s_regression["regressions"],
+        + s_regression["regressions"]
+        + c_regression["regressions"],
     }
 
 

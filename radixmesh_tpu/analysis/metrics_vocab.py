@@ -61,6 +61,7 @@ GAUGE_SUFFIXES = UNIT_SUFFIXES + (
     "_rf_boost",  # extra owners beyond the base walk (cache/rebalance.py)
     "_extents",  # committed durable-tier extent files (cache/kv_tier.py)
     "_peers",  # fleet-aggregator polled peer count (obs/aggregator.py)
+    "_waves",  # consecutive decode-deferred wave count (engine/waves.py)
 )
 
 _KINDS = ("counter", "gauge", "histogram")

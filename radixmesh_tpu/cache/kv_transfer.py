@@ -576,6 +576,14 @@ class KVTransferPlane:
                 disk = getattr(tree, "disk", None)
                 if disk is not None:
                     disk.note_promote(unit.extent)
+            # Draft-ahead (ROADMAP 1a′): a PREFETCH fill or disk
+            # promotion just attached continuation KV this node did not
+            # compute natively — bump the tree's draft epoch so
+            # Engine._draft_for re-arms tree drafting for in-flight
+            # requests whose earlier peek predated this install.
+            note = getattr(tree, "note_draft_ready", None)
+            if note is not None:
+                note()
             # Keep the hicache restore-token series continuous: existing
             # dashboards alert on it, and "plane on" must read as MORE
             # restore activity there, not zero. (The restore-STALL

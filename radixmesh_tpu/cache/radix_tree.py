@@ -359,8 +359,23 @@ class RadixTree:
         # worker-side deletion. In-memory append only; never file I/O
         # on the caller's thread.
         self.on_disk_detach: Callable[[Any], None] | None = None
+        # Draft-ahead epoch (ROADMAP 1a′): bumped by note_draft_ready()
+        # whenever a PREFETCH fill or disk promotion ATTACHES continuation
+        # KV this tree did not serve natively (cache/kv_transfer.py's
+        # apply path). ``Engine._draft_for`` compares it against each
+        # request's last-peeked epoch to re-arm the one-shot tree-draft
+        # latch — promoted/remote-resident hits then draft exactly like
+        # native ones. Deliberately NOT reset() state: residency changes,
+        # the monotonic clock of arrivals does not.
+        self.draft_ready_epoch = 0
         # All remaining state (root, size counters) is established by reset().
         self.reset()
+
+    def note_draft_ready(self) -> None:
+        """Mark that restored/promoted continuation KV just landed (any
+        engine-thread apply site). Cheap int bump — safe on the hot
+        path; readers only ever compare for inequality."""
+        self.draft_ready_epoch += 1
 
     # ---- key plumbing ----
 

@@ -51,7 +51,11 @@ from radixmesh_tpu.models.llama import (
     prefill_chunk_paged,
     prefill_forward,
 )
-from radixmesh_tpu.ops.attention import default_use_kernel
+from radixmesh_tpu.ops.attention import (
+    default_use_kernel,
+    last_dispatch,
+    select_paged,
+)
 from radixmesh_tpu.obs.attribution import shape_bucket
 from radixmesh_tpu.obs.fleet_plane import eviction_counters
 from radixmesh_tpu.obs.metrics import TOKEN_LEN_BUCKETS, get_registry
@@ -89,6 +93,24 @@ def _pow2_at_least(n: int, floor: int = 8) -> int:
 # and attend_chunk_hybrid requires max_pages to divide by it — one
 # constant so the padding and the kernels can't drift apart.
 _KV_BLOCK_PAGES = 32
+
+
+@dataclass
+class _InlineJob:
+    """One partially-prefilled request riding the mixed-wave backlog
+    (engine/waves.py). Slots and the batch row are acquired UP FRONT by
+    the normal admission path (``_acquire_prompt_slots``); only the
+    compute advances chunk-by-chunk — ``pos`` is the exact resume offset
+    (tokens of the prompt whose KV is already in the pool), the chunk
+    interleave invariant the wave tests pin."""
+
+    req: Request
+    row: int
+    reuse: int
+    own: np.ndarray
+    token_slots: np.ndarray  # slot per prompt position (prefix + own)
+    pos: int  # next un-prefilled prompt offset (starts at reuse)
+    total: int  # len(prompt)
 
 
 @dataclass
@@ -142,6 +164,9 @@ class Engine:
         long_prefill_threshold: int = 1024,
         sp_prefill_threshold: int = 4096,
         decode_steps_per_launch: int = 1,
+        prefill_inline_budget: int = 0,
+        prefill_inline_max_defer: int = 2,
+        paged_min_batch: int = 0,
         spec_decode_tokens: int = 0,
         spec_ngram: int = 3,
         spec_adaptive: bool = False,
@@ -251,6 +276,24 @@ class Engine:
         # positional writes.
         self.spec_decode_tokens = spec_decode_tokens
         self.spec_ngram = max(2, spec_ngram)
+        # Mixed compute waves (engine/waves.py, the Sarathi-Serve
+        # schedule): > 0 arms the wave scheduler — while decode rows are
+        # running, admission routes new prompts into an inline backlog
+        # that advances up to this many prefill tokens PER WAVE on the
+        # same fused chunk launch as the decode step, so a long prompt
+        # stops convoying interactive streams. 0 (default) keeps the
+        # legacy whole-wave alternation every existing test pins.
+        self.prefill_inline_budget = max(0, prefill_inline_budget)
+        self.prefill_inline_max_defer = max(0, prefill_inline_max_defer)
+        # Small-batch paged crossover (ops/attention.py::select_paged):
+        # decode waves narrower than this take the dense/compact gather
+        # path instead of the paged kernel (the ctx-sweep ratios say
+        # dispatch overhead beats the kernel at batch ≤ 8). 0 = always
+        # honor default_use_kernel.
+        self.paged_min_batch = max(0, paged_min_batch)
+        # Last decode dispatch decision (ops.note_dispatch mirror) for
+        # /debug/state — which path ran, at what batch/bucket.
+        self._last_dispatch: dict | None = None
         self.log = get_logger("engine")
         # Resolved early: the KV plane (below) and the metric labels
         # (further down) both key their series on it.
@@ -554,11 +597,34 @@ class Engine:
                 node=self.name,
             )
             self.goodput = GoodputLedger(node=self.name)
-        # Stall-attribution hints: the instant the last prefill wave
-        # launched (prefill_convoy), and a one-shot cause latch external
-        # planes set via hint_stall() (rebalance_handoff).
+        # Stall-attribution hints: the instant the last WHOLE prefill
+        # wave launched (prefill_convoy), the instant the last INLINE
+        # prefill chunk rode a mixed wave (prefill_inline — distinct on
+        # purpose: the bounded mitigation must not read as the convoy it
+        # replaces, nor as an unexplained scheduler_wait), and a one-shot
+        # cause latch external planes set via hint_stall()
+        # (rebalance_handoff).
         self._last_prefill_t = 0.0
+        self._last_inline_prefill_t = 0.0
         self._stall_hint: str | None = None
+        # Mixed-wave state (engine/waves.py): the inline prefill backlog
+        # — requests that acquired slots + a batch row but advance their
+        # prefill chunk-by-chunk inside decode waves — and the rows they
+        # reserve (kept OUT of _rows until install so every decode-path
+        # iteration over _rows stays oblivious to them).
+        self._inline: list[_InlineJob] = []
+        self._inline_rows: set[int] = set()
+        self.waves = None
+        if self.prefill_inline_budget > 0:
+            from radixmesh_tpu.engine.waves import WaveScheduler
+
+            self.waves = WaveScheduler(
+                inline_budget=self.prefill_inline_budget,
+                max_defer=self.prefill_inline_max_defer,
+                chunk=self.prefill_chunk,
+                boost_tokens=self.prefill_wave_tokens,
+                node=self.name,
+            )
         # Request-flight tracing lane for engine-scope (not per-request)
         # events: evictions, preemption sweeps (obs/trace_plane.py).
         self._trace_lane = f"engine:{self.name}"
@@ -712,6 +778,24 @@ class Engine:
                 ticket.auto_release = True
                 self._restoring.pop(i)
                 return True
+        for i, job in enumerate(self._inline):
+            if job.req.rid == rid:
+                # Cancel mid-inline-prefill: the job never installed, so
+                # nothing published — release the row reservation, the
+                # prefix lock, and the acquired pages (partially-written
+                # chunk KV is discarded with them).
+                self._inline.pop(i)
+                self._inline_rows.discard(job.row)
+                req = job.req
+                if job.own.size:
+                    self.pool.free(job.own)
+                if req.lock_node is not None:
+                    self.tree.dec_lock_ref(req.lock_node)
+                    req.lock_node = None
+                req.cancelled = True
+                req.state = RequestState.FINISHED
+                self.stats.finished += 1
+                return True
         return False
 
     def cancel_all(self) -> int:
@@ -721,6 +805,7 @@ class Engine:
             [r.rid for r in self.waiting]
             + [r.rid for r in self._rows if r is not None]
             + [r.rid for r, _ in self._restoring]
+            + [j.req.rid for j in self._inline]
         )
         return sum(1 for rid in rids if self.cancel(rid))
 
@@ -736,8 +821,15 @@ class Engine:
         The ``drain_requeue`` shed reason tells the client (and the
         chaos workload) to resubmit via the router, not give up.
         Restore tickets flip to auto-release (the existing cancel path),
-        so no eviction shield outlives the departing request."""
-        victims = list(self.waiting) + [r for r, _ in self._restoring]
+        so no eviction shield outlives the departing request.
+        Mid-inline-prefill requests count too: they have not produced a
+        token either (only partial KV, discarded by cancel), so bouncing
+        them loses at most one chunk of compute."""
+        victims = (
+            list(self.waiting)
+            + [r for r, _ in self._restoring]
+            + [j.req for j in self._inline]
+        )
         n = 0
         for req in victims:
             req.shed = True
@@ -819,9 +911,19 @@ class Engine:
         return n
 
     def step(self) -> None:
-        """One scheduler iteration: admit+prefill queued requests into free
-        rows, then one batched decode step for everything running."""
+        """One scheduler iteration — ONE compute wave. Legacy schedule
+        (``prefill_inline_budget == 0``): admit+prefill queued requests
+        to completion, then one batched decode step for everything
+        running. Mixed schedule (budget > 0, engine/waves.py): while
+        decode rows are running, admission parks new prompts in the
+        inline backlog and each wave packs the decode step PLUS a
+        budget-bounded slice of their chunked prefill into a single
+        fused launch — long prompts advance between decode steps instead
+        of convoying them."""
         self._admit()
+        if self._inline:
+            self._wave_step()
+            return
         if any(r is not None for r in self._rows):
             self._decode_once()
         elif not self.waiting and (
@@ -840,6 +942,7 @@ class Engine:
     def has_work(self) -> bool:
         return (
             bool(self.waiting)
+            or bool(self._inline)
             or bool(self._restoring)
             or any(r is not None for r in self._rows)
             or (
@@ -847,6 +950,40 @@ class Engine:
                 and self.kv_transfer.has_engine_work()
             )
         )
+
+    def _wave_step(self) -> None:
+        """Run one wave while the inline backlog is non-empty: ask the
+        wave scheduler for the wave's composition, execute it, commit
+        the defer/metric accounting. Decode-bearing plans fuse the
+        inline chunks into the decode launch itself; prefill/boost
+        plans advance the backlog alone (and count against the
+        starvation bound when decode rows are waiting)."""
+        decode_rows = sum(1 for r in self._rows if r is not None)
+        plan = self.waves.plan(
+            decode_rows, [j.total - j.pos for j in self._inline]
+        )
+        if plan.decode and decode_rows:
+            if self._seeded_launch(self._rows):
+                # All-seeded batches keep the canonical per-row
+                # (seed, position) decode launch bit-identical to the
+                # legacy path (the replay-determinism contract), so the
+                # wave runs as two launches: decode, then the budgeted
+                # inline slice.
+                self._decode_once()
+                self._inline_advance(plan.allot)
+            else:
+                self._decode_once(inline_allot=plan.allot)
+        else:
+            self._inline_advance(plan.allot)
+        self.waves.note(plan)
+
+    def _inline_advance(self, allot: list[int]) -> None:
+        """Advance the inline backlog WITHOUT a decode step: prefill and
+        boost waves, plus the second launch of the all-seeded fallback.
+        Same fused chunk builder, decode disabled."""
+        if not any(allot):
+            return
+        self._decode_spec_once(0, {}, None, inline=allot, decode=False)
 
     def _note_decode_time(self, per_token_s: float) -> None:
         """Funnel for every decode-latency sample: the TPOT histogram
@@ -871,10 +1008,12 @@ class Engine:
             host_fill = 1.0 - host.free_slots / host.num_slots
         return {
             "batch_occupancy": rows / max(1, self.max_batch),
-            # Parked-for-restore requests count as waiting: they are
-            # queued demand the fleet plane should see, just queued on a
-            # KV transfer instead of a batch row.
-            "waiting": len(self.waiting) + len(self._restoring),
+            # Parked-for-restore and inline-prefilling requests count as
+            # waiting: they are queued demand the fleet plane should
+            # see, just queued on a KV transfer / the wave scheduler's
+            # chunk budget instead of a batch row.
+            "waiting": len(self.waiting) + len(self._restoring)
+            + len(self._inline),
             "decode_steps": self.stats.decode_steps,
             "decode_ewma_s": self._decode_ewma,
             "cache_hit_rate": self.stats.hit_rate,
@@ -940,7 +1079,10 @@ class Engine:
 
     def _free_row(self) -> int:
         for i, r in enumerate(self._rows):
-            if r is None:
+            # Rows parked behind an inline prefill job hold a batch seat
+            # but no Request yet (the install happens on the job's final
+            # chunk) — not free.
+            if r is None and i not in self._inline_rows:
                 return i
         return -1
 
@@ -1005,6 +1147,16 @@ class Engine:
         self._pressure = False  # batch drained: safe to admit again
         made_progress = True
         while self.waiting and made_progress:
+            # Mixed compute waves (engine/waves.py): while decode rows
+            # are running, acquired prompts park in the inline backlog
+            # instead of prefilling here — _wave_step rides their chunks
+            # on the decode launches, budget-bounded, so the running
+            # streams never see a whole-prefill convoy. With no decode
+            # rows (cold start / drained batch) the legacy bulk subwave
+            # path below keeps its full-width TTFT.
+            mix = self.waves is not None and any(
+                r is not None for r in self._rows
+            )
             group: list[tuple] = []
             idx = 0
             while idx < len(self.waiting):
@@ -1088,6 +1240,28 @@ class Engine:
             made_progress = bool(group)
             if not group:
                 break
+            if mix:
+                for req, row, reuse, prefix_slots, own in group:
+                    total = len(req.prompt)
+                    self._inline.append(
+                        _InlineJob(
+                            req=req,
+                            row=row,
+                            reuse=reuse,
+                            own=own,
+                            token_slots=np.concatenate(
+                                [prefix_slots, own[: total - reuse]]
+                            ),
+                            pos=reuse,
+                            total=total,
+                        )
+                    )
+                    # Reserve the batch seat without a Request in it:
+                    # the request stays QUEUED (state-machine-wise it is
+                    # still waiting for its first token) until the final
+                    # chunk installs it RUNNING.
+                    self._inline_rows.add(row)
+                continue
             # Sub-waves by prefill-size bucket, shortest first: a short
             # request must not ride as a padded row through a 32k
             # groupmate's chunks, nor wait for them to sample its first
@@ -1288,9 +1462,11 @@ class Engine:
     ) -> bool:
         """True if ``req`` shares ≥1 page of NOT-yet-cached prefix (beyond
         its ``cached`` match length) with a request already collected this
-        wave: the groupmate will publish that span, so waiting one wave
-        turns recomputation into a hit."""
-        if not group:
+        wave — or parked in the inline backlog (mixed waves): either one
+        will publish that span, so waiting turns recomputation into a
+        hit."""
+        peers = [g[0] for g in group] + [j.req for j in self._inline]
+        if not peers:
             return False
         prompt = req.prompt
         span = cached - cached % self.page_size + self.page_size
@@ -1298,8 +1474,8 @@ class Engine:
             return False
         head = prompt[:span]
         return any(
-            len(g[0].prompt) >= span and np.array_equal(g[0].prompt[:span], head)
-            for g in group
+            len(p.prompt) >= span and np.array_equal(p.prompt[:span], head)
+            for p in peers
         )
 
     def _acquire_prompt_slots(
@@ -1339,7 +1515,9 @@ class Engine:
         self._install_prefilled(req, row, reuse)
         self._record_first_token(req)
 
-    def _install_prefilled(self, req: Request, row: int, reuse: int) -> None:
+    def _install_prefilled(
+        self, req: Request, row: int, reuse: int, inline: bool = False
+    ) -> None:
         """Mark RUNNING, record stats, publish the prompt
         (``cache_unfinished_req``, ``radix_cache.py:488-519``), and wire the
         decode row. ``req.kv_len``/``token_slots``/``own_slots`` must be
@@ -1353,8 +1531,14 @@ class Engine:
 
         self.stats.prefills += 1
         # Stall attribution (obs/token_timeline.py): a decode gap that
-        # spans this instant is a prefill convoy, not a scheduler stall.
-        self._last_prefill_t = time.monotonic()
+        # spans this instant is a prefill convoy, not a scheduler stall
+        # — UNLESS the prefill was a budget-bounded inline chunk riding
+        # the decode wave, which gets its own (non-convoy) cause so the
+        # mitigation cannot masquerade as the disease it cures.
+        if inline:
+            self._last_inline_prefill_t = time.monotonic()
+        else:
+            self._last_prefill_t = time.monotonic()
         self.stats.prompt_tokens += len(req.prompt)
         self.stats.cached_tokens += reuse
         self._m_prompt.inc(len(req.prompt))
@@ -1853,23 +2037,33 @@ class Engine:
         # max_pages gathers junk that attention masks — never an OOB id.
         return self._page_table_padded[:, :maxp]
 
-    def _decode_once(self) -> None:
+    def _decode_once(self, inline_allot: list[int] | None = None) -> None:
         g = self.spec_decode_tokens
-        if g > 0 and self._spec_ok(g):
+        spec = g > 0 and self._spec_ok(g)
+        if spec or inline_allot is not None:
             # Draft BEFORE committing to the wide verify launch: when no
             # row's history repeats its tail there is nothing to verify,
             # and the plain/fused path emits the same tokens cheaper.
+            # A mixed wave (inline_allot) ALWAYS takes the fused chunk
+            # launch — drafted rows verify, undrafted rows ride as
+            # width-1 windows, and the inline chunks fill the rest of
+            # the chunk width — so speculation and inline prefill
+            # compose in one device call.
             drafts: dict[int, np.ndarray] = {}
             sources: dict[int, str] = {}
             for row, req in enumerate(self._rows):
                 if req is None:
                     continue
-                if self._spec_row_ok(req, g):
+                if spec and self._spec_row_ok(req, g):
                     drafts[row], sources[row] = self._draft_for(req)
                 else:
                     drafts[row], sources[row] = req.prompt[:0], "none"
-            if any(len(d) for d in drafts.values()):
-                self._decode_spec_once(g, drafts, sources)
+            if inline_allot is not None or any(
+                len(d) for d in drafts.values()
+            ):
+                self._decode_spec_once(
+                    g if spec else 0, drafts, sources, inline=inline_allot
+                )
                 return
         k = self.decode_steps_per_launch
         if k > 1:
@@ -1878,17 +2072,26 @@ class Engine:
                 self._decode_multi_once(k_eff)
                 return
         seeded = self._seeded_launch(self._rows)
-        if (
-            not self._pp
-            and not default_use_kernel(self.cfg.head_dim)
-            and not seeded
-        ):
-            # Kernel-less single step: the same compact working-set path
-            # with k=1 — a decode_step launch would otherwise pay the
-            # whole-pool donation-copy for one token. Seeded launches
-            # skip it: its device-side draw is batch-shaped, and replay
-            # needs the canonical per-row (seed, position) draw below.
-            self._decode_multi_once(1)
+        n_rows = sum(1 for r in self._rows if r is not None)
+        use_paged = select_paged(
+            n_rows,
+            self.cfg.head_dim,
+            min_batch=self.paged_min_batch,
+            max_len=max(
+                (r.kv_len for r in self._rows if r is not None), default=0
+            ),
+        )
+        self._last_dispatch = last_dispatch()
+        if not self._pp and not use_paged and not seeded:
+            # Dense single step (small-batch paged fast path,
+            # ops/attention.py::select_paged): either no paged kernel on
+            # this backend, or the batch sits below --paged-min-batch —
+            # where the paged launch's whole-pool donation-copy and
+            # block bookkeeping lose to the compact gathered working
+            # set. Seeded launches skip it: its device-side draw is
+            # batch-shaped, and replay needs the canonical per-row
+            # (seed, position) draw below.
+            self._decode_multi_once(1, force_compact=True)
             return
         slots = np.full(self.max_batch, self._scratch_slot, dtype=np.int32)
         lengths = np.ones(self.max_batch, dtype=np.int32)
@@ -2052,10 +2255,13 @@ class Engine:
             )
         return compact, pt_c
 
-    def _decode_multi_once(self, k: int) -> None:
+    def _decode_multi_once(self, k: int, force_compact: bool = False) -> None:
         """One ``decode_multi`` launch: k tokens per active request with a
         single host round trip (device-side sampling feeds each step). See
-        ``models/llama.py::decode_multi`` for the latency rationale."""
+        ``models/llama.py::decode_multi`` for the latency rationale.
+        ``force_compact`` pins the gathered compact-working-set variant
+        even where the paged kernel exists — the small-batch crossover
+        (``select_paged``) chose dense for this wave."""
         lengths = np.ones(self.max_batch, dtype=np.int32)
         active = self._provision_rows(k - 1)
         if not active:
@@ -2084,10 +2290,11 @@ class Engine:
                 kv_scale=self.pool.kv_scale,
                 scratch_slot=self._scratch_slot,
             )
-        elif not default_use_kernel(self.cfg.head_dim):
-            # No aliased kernel on this backend: decode over a gathered
-            # compact working set so each launch pays ONE pool gather +
-            # ONE scatter-back instead of k·L pool-sized scatter copies
+        elif force_compact or not default_use_kernel(self.cfg.head_dim):
+            # No aliased kernel on this backend (or the crossover chose
+            # dense): decode over a gathered compact working set so each
+            # launch pays ONE pool gather + ONE scatter-back instead of
+            # k·L pool-sized scatter copies
             # (see models/llama.py::decode_multi_compact).
             compact, pt_c = self._compact_decode_tables(active, k)
             res = decode_multi_compact(
@@ -2225,8 +2432,20 @@ class Engine:
         # candidates) and stops the first time it comes back empty —
         # novel generations never pay it per launch (_SPEC_WINDOW bounds
         # their n-gram scan instead).
-        if req.tree_draft_ok and req.prefix_len >= max(
-            0, len(req.prompt) - self.page_size
+        # Draft-ahead from the mesh (ROADMAP 1a′): a PREFETCH fill or a
+        # disk promotion may have attached a continuation AFTER this
+        # request's last peek latched tree drafting off — the tree's
+        # draft_ready_epoch (bumped by kv_transfer's apply site) says so
+        # without a walk. Re-arm and peek again, so a remote/disk-
+        # resident hit drafts exactly like a natively-published one.
+        epoch = getattr(self.tree, "draft_ready_epoch", 0)
+        promoted = epoch > req.draft_epoch
+        if promoted:
+            req.tree_draft_ok = True
+            req.draft_epoch = epoch
+        if req.tree_draft_ok and (
+            promoted
+            or req.prefix_len >= max(0, len(req.prompt) - self.page_size)
         ):
             cont = self.tree.peek_continuation(hist, gamma)
             if len(cont):
@@ -2289,26 +2508,57 @@ class Engine:
         g: int,
         drafts: dict[int, np.ndarray],
         sources: dict[int, str] | None = None,
+        inline: list[int] | None = None,
+        decode: bool = True,
     ) -> None:
-        """One speculative launch: verify [fed_token, draft…] (C=γ+1
-        positions per row) in a single ``prefill_chunk_paged`` call, then
-        accept per row via ``spec_verify_sample`` — greedy rows take the
-        longest argmax-matching draft prefix, stochastic rows accept each
-        draft token with its target probability (exact rejection sampling)
-        — and emit one bonus token. Fed positions' K/V is written by the
-        verify pass itself, so accepted tokens cost no extra work; rejected positions
-        hold stale K/V that the next launch overwrites (slots are purely
-        positional) and that attention never reads (masked by length)."""
-        C = g + 1
+        """One fused chunk launch: decode rows verify [fed_token, draft…]
+        (w=draft+1 live positions per row; w=1 = a plain step) and —
+        mixed compute waves — inline prefill jobs ride the SAME call as
+        rows whose live window is their allotted slice of prompt tokens
+        (``inline`` = tokens per backlog job, from WaveScheduler.plan).
+        Acceptance per decode row via ``spec_verify_sample`` — greedy
+        rows take the longest argmax-matching draft prefix, stochastic
+        rows accept each draft token with its target probability (exact
+        rejection sampling) — and emit one bonus token. Fed positions'
+        K/V is written by the pass itself, so accepted tokens cost no
+        extra work; rejected positions hold stale K/V that the next
+        launch overwrites (slots are purely positional) and that
+        attention never reads (masked by length). An inline job whose
+        final chunk lands here installs + finalizes its first token in
+        the same wave. ``decode=False`` (prefill/boost waves, the
+        all-seeded fallback's second launch) advances the backlog alone."""
         ps = self.page_size
+        jobs = (
+            [
+                (job, w)
+                for job, w in zip(self._inline, inline)
+                if w > 0 and job.pos < job.total
+            ]
+            if inline is not None
+            else []
+        )
+        if inline is None:
+            C = g + 1  # legacy speculative shape, untouched
+        else:
+            # Chunk width covers the widest live window this wave —
+            # pow2-bucketed so varying allotments reuse compiled
+            # variants (floor matches _prefill_group's chunk floor).
+            C = _pow2_at_least(
+                max([g + 1] + [w for _, w in jobs]), floor=16
+            )
         # Provision only each row's actual verify window (draft + bonus):
         # an opted-out row (empty draft) needs exactly the one position a
         # plain step would, so γ positions of headroom it lacks must not
-        # preempt it.
-        active = self._provision_rows(
-            g, extras={row: len(d) for row, d in drafts.items()}
+        # preempt it. Inline jobs never provision — their pages were all
+        # acquired up front at admission.
+        active = (
+            self._provision_rows(
+                g, extras={row: len(d) for row, d in drafts.items()}
+            )
+            if decode
+            else []
         )
-        if not active:
+        if not active and not jobs:
             return
         step_t0 = time.monotonic()
 
@@ -2316,8 +2566,12 @@ class Engine:
         kv_block = _KV_BLOCK_PAGES
         maxp = _pow2_at_least(
             max(
-                (r.kv_len + len(drafts.get(row, r.prompt[:0]))) // ps + 1
-                for row, r in active
+                [
+                    (r.kv_len + len(drafts.get(row, r.prompt[:0]))) // ps
+                    + 1
+                    for row, r in active
+                ]
+                + [(job.pos + w) // ps + 1 for job, w in jobs]
             ),
             floor=kv_block,
         )
@@ -2358,8 +2612,23 @@ class Engine:
                 )
                 cell[0] += len(draft)
 
-        # The verify pass is just a C=γ+1 chunk; _forward_chunk picks the
-        # pipeline schedule under pp (parallel/pp_serving.py).
+        for job, w in jobs:
+            # Inline prefill rows: the live window is the job's allotted
+            # prompt slice [pos, pos+w) — exactly a _prefill_group chunk
+            # for one row, riding the decode launch. draft_len stays 0,
+            # so the verify below treats the row as undrafted and its
+            # (meaningless mid-prompt) bonus sample is never consumed.
+            row, pos, prompt = job.row, job.pos, job.req.prompt
+            toks[row, :w] = prompt[pos : pos + w]
+            p = pos + np.arange(C, dtype=np.int32)
+            poss[row] = np.minimum(p, self.max_seq_len - 1)
+            sl[row, :w] = job.token_slots[pos : pos + w]
+            kvlen[row] = pos + w
+            npg = min(-(-job.total // ps), maxp)
+            pt[row, :npg] = job.token_slots[::ps][:npg] // ps
+
+        # The verify pass is just a C-wide chunk; _forward_chunk picks
+        # the pipeline schedule under pp (parallel/pp_serving.py).
         res = self._forward_chunk(
             jnp.asarray(toks),
             jnp.asarray(poss),
@@ -2369,19 +2638,20 @@ class Engine:
             kv_block,
         )
         logits = self._commit_pool_update(res)
-        self._rng, key = jax.random.split(self._rng)
-        accept_len, bonus = spec_verify_sample(
-            logits,
-            jnp.asarray(toks[:, 1:]),
-            jnp.asarray(draft_len),
-            key,
-            jnp.asarray(self._temps),
-            jnp.asarray(self._top_ps),
-            jnp.asarray(self._top_ks),
-        )
-        accept_len = np.asarray(accept_len)  # [B] one sync
-        bonus = np.asarray(bonus)
-        self.stats.decode_steps += 1
+        if active:
+            self._rng, key = jax.random.split(self._rng)
+            accept_len, bonus = spec_verify_sample(
+                logits,
+                jnp.asarray(toks[:, 1:]),
+                jnp.asarray(draft_len),
+                key,
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ps),
+                jnp.asarray(self._top_ks),
+            )
+            accept_len = np.asarray(accept_len)  # [B] one sync
+            bonus = np.asarray(bonus)
+            self.stats.decode_steps += 1
 
         emitted_total = 0
         for row, req in active:
@@ -2419,14 +2689,55 @@ class Engine:
                 emitted_total += 1
                 if self._consume_token(req, row, slot, token):
                     break
+        inline_tok = 0
+        pending: list[tuple] = []
+        for job, w in jobs:
+            start = job.pos
+            job.pos += w  # exact chunk resume offset for the next wave
+            inline_tok += w
+            tr = job.req.trace
+            if tr is not None:
+                tr.add(
+                    "prefill_inline", step_t0,
+                    time.monotonic() - step_t0, cat="prefill",
+                    chunk_tokens=int(w), resume_offset=int(start),
+                )
+            if job.pos >= job.total:
+                # Final chunk: install + hand the last prompt position's
+                # logits to the shared first-token finalizer (one
+                # batched sample for every job finishing this wave).
+                req = job.req
+                req.output_tokens = []
+                req.kv_len = job.total
+                req.token_slots = job.token_slots
+                req.own_slots = job.own
+                self._inline_rows.discard(job.row)
+                self._install_prefilled(
+                    req, job.row, job.reuse, inline=True
+                )
+                pending.append((req, logits[job.row, w - 1]))
+        if jobs:
+            # Stall attribution: inline chunks advanced inside this wave
+            # (finished or not) — a decode gap spanning this instant is
+            # prefill_inline, never scheduler_wait (and not a convoy).
+            self._last_inline_prefill_t = time.monotonic()
+            self._inline = [j for j in self._inline if j.pos < j.total]
+        if pending:
+            self._finalize_first_tokens(pending)
         elapsed = time.monotonic() - step_t0
-        for _ in range(max(emitted_total, 1)):
-            self._note_decode_time(elapsed / max(emitted_total, 1))
+        if active:
+            for _ in range(max(emitted_total, 1)):
+                self._note_decode_time(elapsed / max(emitted_total, 1))
         if self.step_acct is not None:
-            # The verify launch processes B·C positions; the USEFUL
-            # output is the accepted+bonus tokens actually emitted.
+            # The launch processes B·C positions; the USEFUL work is the
+            # accepted+bonus decode tokens actually emitted plus the
+            # inline prefill tokens advanced.
             self.step_acct.note_wave(
-                "decode", emitted_total, B * C, elapsed, rows=len(active),
+                "decode" if active else "prefill",
+                emitted_total + inline_tok,
+                B * C,
+                elapsed,
+                rows=len(active) + len(jobs),
             )
         for row, req in active:
             tr = req.trace
@@ -2463,6 +2774,13 @@ class Engine:
         if now - self._last_prefill_t <= gap_s:
             # A prefill wave launched inside the gap: the decode convoy.
             return "prefill_convoy"
+        if now - self._last_inline_prefill_t <= gap_s:
+            # An inline prefill chunk (mixed compute wave) launched
+            # inside the gap: budget-bounded by design, so it is NOT a
+            # convoy — before this branch existed, a gap spanning a
+            # wave boundary with an inline chunk in it fell through to
+            # scheduler_wait, hiding the interleave's (bounded) cost.
+            return "prefill_inline"
         if req.spec_miss:
             req.spec_miss = 0
             return "spec_verify_miss"

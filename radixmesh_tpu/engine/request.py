@@ -107,6 +107,13 @@ class Request:
     # request, so novel generations never re-walk the whole history
     # every launch (the walk is O(context)).
     tree_draft_ok: bool = True
+    # Draft-ahead from the mesh (ROADMAP 1a′): the tree's
+    # ``draft_ready_epoch`` value this request last peeked at. When a
+    # PREFETCH fill or disk promotion lands a continuation AFTER that
+    # (tree epoch > this), ``Engine._draft_for`` re-arms ``tree_draft_ok``
+    # and peeks again — a remote/disk-resident hit drafts exactly like a
+    # native one instead of staying latched off forever.
+    draft_epoch: int = 0
     submit_time: float = 0.0
     first_token_time: float = 0.0
     # -- token timeline (radixmesh_tpu/obs/token_timeline.py) --

@@ -477,7 +477,13 @@ class Registry:
             families = list(self._families.values())
         for f in families:
             for s in f._series():
-                key = f"{f.name}{_fmt_labels(s._labels)}"
+                # Rendered key cached per series: labels are fixed at
+                # child creation, and the history sampler calls this for
+                # every series at every tick — re-formatting hundreds of
+                # label strings per sweep was the sampler's top cost.
+                key = getattr(s, "_snap_key", None)
+                if key is None:
+                    key = s._snap_key = f"{f.name}{_fmt_labels(s._labels)}"
                 if isinstance(s, Histogram):
                     snap[key + "_count"] = s.count
                     snap[key + "_sum"] = s.sum

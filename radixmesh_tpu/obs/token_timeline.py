@@ -58,8 +58,17 @@ __all__ = [
 #
 # - ``restore_park``     — a KV-plane restore was in flight (requests
 #                          parked in RESTORING while decode waited).
-# - ``prefill_convoy``   — a prefill wave ran inside the gap (the wide-
-#                          shape TTFT collapse, seen from the token side).
+# - ``prefill_convoy``   — a WHOLE prefill wave ran inside the gap (the
+#                          wide-shape TTFT collapse, seen from the token
+#                          side).
+# - ``prefill_inline``   — a budget-bounded inline prefill chunk rode the
+#                          decode wave inside the gap (mixed compute
+#                          waves, ``--prefill-inline-budget``). Distinct
+#                          from the convoy on purpose: inline chunks are
+#                          the MITIGATION, bounded by the budget, and a
+#                          gap they stretch must not read as either a
+#                          convoy regression or an unexplained
+#                          ``scheduler_wait``.
 # - ``rebalance_handoff``— an ownership move was draining this node
 #                          (external planes latch it via
 #                          ``Engine.hint_stall``).
@@ -70,6 +79,7 @@ __all__ = [
 STALL_CAUSES = (
     "restore_park",
     "prefill_convoy",
+    "prefill_inline",
     "rebalance_handoff",
     "spec_verify_miss",
     "scheduler_wait",

@@ -679,6 +679,22 @@ class ServingFrontend:
                 # TPU step attribution (obs/step_plane.py): per-wave MFU
                 # estimate + pad fraction aggregates.
                 state["step_accounting"] = acct.report()
+            waves = getattr(eng, "waves", None)
+            if waves is not None:
+                # Mixed compute waves (engine/waves.py): wave-kind mix,
+                # inline-token throughput, and the decode-defer counter
+                # against its starvation bound.
+                state["waves"] = {
+                    **waves.snapshot(),
+                    "inline_backlog": len(getattr(eng, "_inline", ())),
+                }
+            dispatch = getattr(eng, "_last_dispatch", None)
+            if dispatch is not None:
+                # Small-batch paged fast path: the chosen decode
+                # attention path (paged kernel vs dense compact) for the
+                # last wave, with its batch bucket — the visibility half
+                # of the ops/attention.py::select_paged crossover.
+                state["decode_dispatch"] = dispatch
             if eng.mesh is not None:
                 state["membership"] = _membership_state(eng.mesh)
             if self.slo_enabled:

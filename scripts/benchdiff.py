@@ -1,7 +1,7 @@
 """Bench regression sentinel: schema-aware diff of two same-schema
 round artifacts (``bench.compare_rounds``).
 
-Eleven artifact schemas accumulated over eleven rounds with no machine
+The artifact schemas accumulated round over round with no machine
 check on the trajectory between them — a silently regressed hit ratio
 or a halved ring throughput would ride a green round. This CLI pins the
 check: each artifact kind declares the metrics worth guarding (dotted

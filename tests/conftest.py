@@ -23,6 +23,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite is dominated by CPU
+# compiles of tiny-model program variants, and the module-boundary
+# ``jax.clear_caches()`` below (required — see the fixture) forces
+# cross-module recompiles of identical programs. A disk cache turns
+# those, and every rerun of the suite, into deserialize hits (keys hash
+# the optimized HLO + backend fingerprint, so code changes miss
+# naturally and staleness is impossible). Opt out with
+# RADIXMESH_NO_COMPILE_CACHE=1; relocate with JAX_COMPILATION_CACHE_DIR.
+if not os.environ.get("RADIXMESH_NO_COMPILE_CACHE"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/tmp/radixmesh_xla_cache"
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 
 import pytest
 

@@ -2212,3 +2212,179 @@ class TestSpecArtifactSchema:
         assert report["itl"]["seeded_detected"] is True
         assert report["adaptive"]["no_worse"] is True
         assert report["overhead"]["under_budget"] is True
+
+
+class TestConvoyArtifactSchema:
+    """The CONVOY artifact (PR 19, killing the prefill convoy):
+    decode-interleaved chunked prefill's TTFT win with bit-identical
+    outputs, the prefill_convoy stall drop, the wave-counted starvation
+    bound, and the paged/dense crossover — the ISSUE's gate names."""
+
+    def _report(self) -> dict:
+        return {
+            "schema_version": bench.CONVOY_SCHEMA_VERSION,
+            "metric": "convoy_ttft_speedup",
+            "value": 4.2,
+            "unit": "late-arrival p50 TTFT ratio (legacy / mixed waves)",
+            "workload": "carrier + 960-token convoy + late 16-token "
+            "arrival, A-B across prefill_inline_budget",
+            "interleave": {
+                "performed": True, "reps": 5, "inline_budget": 32,
+                "base_ttft_p50_s": 0.18, "mixed_ttft_p50_s": 0.043,
+                "ttft_ratio": 4.2, "base_itl_p99_s": 0.09,
+                "mixed_itl_p99_s": 0.05, "outputs_match": True,
+                "base_accepted_per_wave": 0.36,
+                "mixed_accepted_per_wave": 0.35,
+                "waves": {"counts": {"mixed": 120, "boost": 0},
+                          "inline_tokens": 3904},
+            },
+            "stalls": {
+                "performed": True, "stall_threshold_s": 0.02,
+                "base_convoy_s_per_req": 0.058,
+                "mixed_convoy_s_per_req": 0.002,
+                "convoy_drop_ratio": 29.0,
+                "base_causes": {"prefill_convoy": 0.52},
+                "mixed_causes": {"prefill_inline": 0.4},
+                "inline_attributed_s": 0.4,
+            },
+            "starvation": {
+                "performed": True, "skew": "320:16",
+                "max_defer_bound": 2, "max_step_gap": 1,
+                "max_defer_observed": 2, "boost_waves": 2,
+                "bounded": True, "carrier_tokens": 48,
+            },
+            "crossover": {
+                "performed": True, "paged_min_batch": 16,
+                "sweep": [
+                    {"batch": 2, "bucket": 2, "paged_selected": False,
+                     "effective_over_dense": 1.0,
+                     "bucketed_over_direct": 1.01},
+                    {"batch": 32, "bucket": 32, "paged_selected": False,
+                     "effective_over_dense": 1.0,
+                     "bucketed_over_direct": 0.99},
+                ],
+                "small_batch_ok": True,
+                "large_batch_ok": True,
+            },
+            "wall_s": 40.0,
+        }
+
+    def test_complete_report_validates(self):
+        assert bench.validate_convoy(self._report()) == []
+        assert bench.validate_convoy(7) == ["artifact is not a JSON object"]
+
+    def test_missing_fields_are_named(self):
+        report = self._report()
+        del report["wall_s"]
+        del report["interleave"]["ttft_ratio"]
+        del report["stalls"]["convoy_drop_ratio"]
+        del report["starvation"]["bounded"]
+        del report["crossover"]["small_batch_ok"]
+        missing = bench.validate_convoy(report)
+        assert "wall_s" in missing
+        assert "interleave.ttft_ratio" in missing
+        assert "stalls.convoy_drop_ratio" in missing
+        assert "starvation.bounded" in missing
+        assert "crossover.small_batch_ok" in missing
+
+    def test_interleave_gates(self):
+        report = self._report()
+        report["interleave"]["ttft_ratio"] = 1.1
+        report["interleave"]["outputs_match"] = False
+        problems = "\n".join(bench.validate_convoy(report))
+        assert "did not beat the convoy" in problems
+        assert "outputs diverged" in problems
+        report = self._report()
+        report["interleave"]["mixed_itl_p99_s"] = 0.5
+        report["interleave"]["mixed_accepted_per_wave"] = 0.1
+        problems = "\n".join(bench.validate_convoy(report))
+        assert "bought by starving decode" in problems
+        assert "breaking speculation" in problems
+
+    def test_stall_gates(self):
+        report = self._report()
+        report["stalls"]["convoy_drop_ratio"] = 1.2
+        report["stalls"]["base_causes"] = {}
+        problems = "\n".join(bench.validate_convoy(report))
+        assert "the convoy survived" in problems
+        assert "base_causes decomposition is empty" in problems
+
+    def test_starvation_gates(self):
+        report = self._report()
+        report["starvation"]["bounded"] = False
+        report["starvation"]["max_step_gap"] = 7
+        problems = "\n".join(bench.validate_convoy(report))
+        assert "starvation bound broke" in problems
+        report = self._report()
+        report["starvation"]["boost_waves"] = 0
+        problems = "\n".join(bench.validate_convoy(report))
+        assert "proven vacuously" in problems
+
+    def test_crossover_gates(self):
+        report = self._report()
+        report["crossover"]["small_batch_ok"] = False
+        report["crossover"]["large_batch_ok"] = False
+        report["crossover"]["sweep"] = []
+        problems = "\n".join(bench.validate_convoy(report))
+        assert "picking the slow path" in problems
+        assert "padding is costing" in problems
+        assert "empty sweep" in problems
+
+    def test_skipped_sections_gate_exempt(self):
+        report = self._report()
+        for section in ("interleave", "stalls", "starvation", "crossover"):
+            report[section] = {"performed": False}
+        report["value"] = None
+        assert bench.validate_convoy(report) == []
+
+    def test_non_dict_sections_are_violations(self):
+        report = self._report()
+        report["interleave"] = "done"
+        report["crossover"] = 3
+        problems = "\n".join(bench.validate_convoy(report))
+        assert "interleave section is not an object" in problems
+        assert "crossover section is not an object" in problems
+
+    def test_build_report_matches_schema(self):
+        base = self._report()
+        res = {
+            k: base[k]
+            for k in ("interleave", "stalls", "starvation", "crossover",
+                      "wall_s")
+        }
+        report = bench.build_convoy_report(res)
+        assert bench.validate_convoy(report) == []
+        assert report["value"] == base["interleave"]["ttft_ratio"]
+        assert report["metric"] == "convoy_ttft_speedup"
+
+    def test_convoy_kind_registered_in_sentinel(self):
+        assert "CONVOY" in bench.COMPARE_RULES
+        assert bench.artifact_kind(self._report()) == "CONVOY"
+        assert bench.artifact_kind({}, "CONVOY_r19.json") == "CONVOY"
+        res = bench.benchdiff_selfcheck()
+        assert "CONVOY" in res["kinds_covered"]
+        assert res["identical_clean"] and res["regression_flagged"]
+        assert res["mismatch_detected"]
+
+    def test_compare_rounds_flags_ttft_collapse(self):
+        old = self._report()
+        new = self._report()
+        new["value"] = 1.6
+        new["interleave"]["ttft_ratio"] = 1.6
+        res = bench.compare_rounds(old, new, kind="CONVOY")
+        assert res["status"] == "regression"
+        assert "interleave.ttft_ratio" in res["regressions"]
+
+    def test_checked_in_artifact_validates(self):
+        import glob
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(repo, "CONVOY_r*.json")))
+        assert paths, "no CONVOY artifact checked in"
+        with open(paths[-1]) as fh:
+            report = json.load(fh)
+        assert bench.validate_convoy(report) == []
+        assert report["interleave"]["outputs_match"] is True
+        assert report["starvation"]["bounded"] is True
+        assert report["crossover"]["small_batch_ok"] is True

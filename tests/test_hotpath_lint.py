@@ -112,3 +112,24 @@ class TestHotPathSyncLint:
         hits = {fn[1] for fn in reachable if "note_token" in fn[1]}
         assert "TokenTimeline.note_token" in hits
         assert "GoodputLedger.note_token" in hits
+
+    def test_wave_scheduler_and_paged_dispatch_ride_the_hot_path_clean(self):
+        """PR 19: the wave scheduler's ``plan``/``note`` run once per
+        compute wave and the paged/dense crossover once per decode
+        launch — both INSIDE the serving loop. Zero blocking findings
+        in the new policy module, and the call graph must actually see
+        both seams from the engine entry points (a wave scheduler the
+        reachability proof can't see would make the starvation bound
+        unauditable)."""
+        assert not _sync_findings("engine/waves.py")
+        from radixmesh_tpu.analysis.callgraph import get_callgraph
+        from radixmesh_tpu.analysis.hot_path import DEFAULT_ENTRY_POINTS
+
+        cg = get_callgraph(_index())
+        reachable, _chains = cg.reach(DEFAULT_ENTRY_POINTS)
+        names = {fn[1] for fn in reachable}
+        assert "WaveScheduler.plan" in names
+        assert "WaveScheduler.note" in names
+        assert {n for n in names if "select_paged" in n}, (
+            "the paged/dense crossover is not on the serving call graph"
+        )

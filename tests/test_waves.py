@@ -1,0 +1,137 @@
+"""Wave-scheduler invariants (PR 19, mixed compute waves): the pure
+host-side policy in engine/waves.py, provable without a device —
+the budget is never exceeded, decode is never deferred past
+``--prefill-inline-max-defer`` consecutive waves, allotment is
+shortest-remaining-first with FIFO tiebreak, and the accounting the
+/debug/state snapshot reads stays consistent."""
+
+import numpy as np
+import pytest
+
+from radixmesh_tpu.engine.waves import WAVE_KINDS, WavePlan, WaveScheduler
+
+pytestmark = pytest.mark.quick
+
+
+def make(budget=32, max_defer=2, chunk=512, boost=128):
+    return WaveScheduler(
+        inline_budget=budget, max_defer=max_defer, chunk=chunk,
+        boost_tokens=boost,
+    )
+
+
+class TestBudgetInvariant:
+    def test_mixed_wave_never_exceeds_budget(self):
+        rng = np.random.default_rng(0)
+        ws = make(budget=32, boost=10_000)  # boost unreachable
+        for _ in range(200):
+            backlog = rng.integers(0, 400, size=rng.integers(1, 6)).tolist()
+            plan = ws.plan(decode_rows=2, backlog=backlog)
+            assert plan.kind in WAVE_KINDS
+            assert sum(plan.allot) <= ws.inline_budget
+            for a, r in zip(plan.allot, backlog):
+                assert 0 <= a <= min(r, ws.chunk)
+
+    def test_boost_wave_bounded_by_boost_tokens(self):
+        ws = make(budget=32, boost=128)
+        plan = ws.plan(decode_rows=1, backlog=[500, 500])
+        assert plan.kind == "boost"
+        assert not plan.decode
+        assert sum(plan.allot) <= ws.boost_tokens
+
+    def test_chunk_caps_single_job_share(self):
+        ws = make(budget=4096, chunk=512, boost=100_000)
+        plan = ws.plan(decode_rows=1, backlog=[10_000])
+        assert plan.allot == [512]
+
+    def test_zero_budget_rejected(self):
+        with pytest.raises(ValueError):
+            WaveScheduler(inline_budget=0)
+
+
+class TestStarvationBound:
+    def test_defer_never_exceeds_bound_under_adversarial_backlog(self):
+        # Backlog always deep enough to justify a boost: the scheduler
+        # must still hand decode a wave every max_defer+1 waves. This is
+        # the virtual-time starvation proof — wave COUNTS, no clocks.
+        ws = make(budget=32, max_defer=2, boost=128)
+        consecutive = 0
+        for _ in range(100):
+            plan = ws.plan(decode_rows=3, backlog=[10_000, 10_000])
+            ws.note(plan)
+            if plan.decode:
+                consecutive = 0
+            else:
+                consecutive += 1
+            assert consecutive <= ws.max_defer
+        assert ws.max_defer_observed <= ws.max_defer
+        assert ws.counts["boost"] > 0  # the bound was actually exercised
+
+    def test_mixed_wave_always_carries_decode(self):
+        ws = make(budget=32, boost=10_000)
+        plan = ws.plan(decode_rows=2, backlog=[40])
+        assert plan.kind == "mixed"
+        assert plan.decode
+
+    def test_pure_prefill_waves_do_not_charge_the_bound(self):
+        # No decode rows = nobody to starve: full-width prefill waves
+        # must not inflate max_defer_observed (they are the cold-start
+        # drain path after the last decoder finishes).
+        ws = make(budget=32, max_defer=1, boost=128)
+        for _ in range(5):
+            plan = ws.plan(decode_rows=0, backlog=[10_000])
+            assert plan.kind == "prefill"
+            ws.note(plan)
+        assert ws.max_defer_observed == 0
+
+    def test_max_defer_zero_disables_boost(self):
+        ws = make(budget=32, max_defer=0, boost=128)
+        plan = ws.plan(decode_rows=1, backlog=[10_000])
+        assert plan.kind == "mixed"
+        assert plan.decode
+
+
+class TestAllotmentPolicy:
+    def test_shortest_remaining_first(self):
+        ws = make(budget=32, boost=10_000)
+        plan = ws.plan(decode_rows=1, backlog=[100, 16, 20])
+        # 16-token job fully served first, then the 20-token job gets
+        # the remaining 16; the 100-token job waits.
+        assert plan.allot == [0, 16, 16]
+
+    def test_fifo_tiebreak_on_equal_remaining(self):
+        ws = make(budget=16)
+        plan = ws.plan(decode_rows=1, backlog=[16, 16])
+        assert plan.allot == [16, 0]
+
+    def test_empty_backlog_plans_pure_decode(self):
+        ws = make()
+        plan = ws.plan(decode_rows=2, backlog=[])
+        assert plan.kind == "decode"
+        assert plan.decode
+        assert plan.allot == []
+
+    def test_drained_jobs_get_zero(self):
+        ws = make(budget=32)
+        plan = ws.plan(decode_rows=1, backlog=[0, 10])
+        assert plan.allot == [0, 10]
+
+
+class TestAccounting:
+    def test_note_and_snapshot_roundtrip(self):
+        ws = make(budget=32, boost=128)
+        ws.note(WavePlan("mixed", [16, 8], True))
+        ws.note(WavePlan("boost", [128], False))
+        ws.note(WavePlan("mixed", [4], True))
+        snap = ws.snapshot()
+        assert snap["counts"]["mixed"] == 2
+        assert snap["counts"]["boost"] == 1
+        assert snap["inline_tokens"] == 16 + 8 + 128 + 4
+        assert snap["decode_defer"] == 0  # last wave carried decode
+        assert snap["max_defer_observed"] == 1
+        assert snap["budget"] == 32
+        assert snap["max_defer"] == 2
+
+    def test_boost_floor_is_inline_budget(self):
+        ws = WaveScheduler(inline_budget=256, boost_tokens=64)
+        assert ws.boost_tokens == 256
